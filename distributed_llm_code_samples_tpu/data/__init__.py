@@ -1,0 +1,83 @@
+"""Deterministic seeds-as-dataset data layer.
+
+Parity target: the reference's mock data pipeline (``train_ffns.py:144-151``,
+``:350, :356-360``) where **data distribution = seed distribution**: the
+dataset is never materialized centrally; each training step is defined by one
+integer seed, and each strategy decides which ranks consume which seeds.
+This is what makes cross-strategy differential testing possible.
+
+TPU-native translation: counter-based RNG. A step's ``(x, dloss_dx)`` pair is
+a pure function of its integer seed via ``jax.random.fold_in`` — so the same
+seed produces bit-identical data on every rank, on every strategy, inside or
+outside ``jit``/``shard_map``/``scan`` (the idiomatic equivalent of the
+reference's re-seeded ``torch.Generator`` per step).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import DLOSS_DX_COEF
+
+# Base key folded with each per-step seed; fixed, like the reference's fresh
+# torch.Generator per step (train_ffns.py:145-148).
+_DATA_KEY = 0
+
+
+def batch_from_seed(seed: jax.Array, batch_size: int, model_size: int,
+                    dtype=jnp.float32):
+    """One step's ``(x, dloss_dx)`` from its integer seed.
+
+    ``x = normal([batch, d])``; the loss is mocked by a randomized upstream
+    gradient ``dloss_dx = 0.1 * normal([batch, d])`` "coming from the right"
+    (``train_ffns.py:12, :30, :149-150``). ``seed`` may be a traced scalar —
+    this works inside ``lax.scan`` over a seed schedule.
+    """
+    key = jax.random.fold_in(jax.random.PRNGKey(_DATA_KEY), seed)
+    kx, kd = jax.random.split(key)
+    x = jax.random.normal(kx, (batch_size, model_size)).astype(dtype)
+    dloss_dx = (DLOSS_DX_COEF *
+                jax.random.normal(kd, (batch_size, model_size))).astype(dtype)
+    return x, dloss_dx
+
+
+def mock_data(seeds, batch_size: int, model_size: int, dtype=jnp.float32):
+    """Eager generator over the seed schedule — host-side analogue of the
+    reference's ``mock_data`` (``train_ffns.py:144-151``). The jitted training
+    paths use ``batch_from_seed`` inside the step instead."""
+    for seed in np.asarray(seeds).tolist():
+        yield batch_from_seed(jnp.int32(seed), batch_size, model_size, dtype)
+
+
+def make_seed_schedule(num_steps: int, random_seed: int = 0) -> jnp.ndarray:
+    """``num_steps`` integer seeds in ``[0, 100_000)`` (``train_ffns.py:360``).
+
+    ``random_seed != 0`` makes the schedule reproducible across runs
+    (``train_ffns.py:350, :356-359``); ``0`` draws from OS entropy like the
+    reference's default generator.
+    """
+    if random_seed != 0:
+        rng = np.random.default_rng(random_seed)
+    else:
+        rng = np.random.default_rng()
+    return jnp.asarray(rng.integers(0, 100_000, size=(num_steps,)),
+                       dtype=jnp.int32)
+
+
+def shard_seeds_strided(seeds, n_ranks: int) -> jnp.ndarray:
+    """Strided seed split: returns ``[steps_per_rank, n_ranks]`` where column
+    ``r`` is rank ``r``'s schedule — rank ``r``'s step ``t`` consumes global
+    seed ``seeds[t * n_ranks + r]``, exactly the reference's
+    ``seeds.reshape((-1, nGPUs)).chunk(nGPUs, dim=1)`` (``train_ffns.py:182``).
+
+    Getting this wrong silently breaks DDP == FSDP differential tests
+    (SURVEY.md section 7, "hard parts").
+    """
+    seeds = jnp.asarray(seeds)
+    if seeds.shape[0] % n_ranks != 0:
+        raise ValueError(
+            f"num_steps={seeds.shape[0]} not divisible by n_ranks={n_ranks} "
+            "(reference asserts the same, train_ffns.py:175)")
+    return seeds.reshape(-1, n_ranks)

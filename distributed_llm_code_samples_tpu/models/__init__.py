@@ -1,0 +1,8 @@
+"""Model families. The flagship is the Transformer FFN stack (the reference's
+entire model surface); attention/long-context extensions live here too."""
+
+from .ffn_stack import (FFNStackParams, init_ffn_stack, clone_params,
+                        params_size_gb)
+
+__all__ = ["FFNStackParams", "init_ffn_stack", "clone_params",
+           "params_size_gb"]

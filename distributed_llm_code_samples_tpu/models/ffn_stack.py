@@ -1,0 +1,87 @@
+"""The flagship model family: a stack of Transformer FFN sublayers.
+
+The reference's "model" is a plain list of ``[W1, W2]`` pairs with no module
+abstraction (``train_ffns.py:38-39, :361``). Here the same stance is kept —
+params are raw arrays in a NamedTuple pytree — but the per-layer lists are
+stacked on a leading layer axis so the whole model lives under a single
+``NamedSharding`` and can be scanned over.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.linear import init_linear
+
+
+class FFNStackParams(NamedTuple):
+    """``w1 [L, ffn_dim, d_model]``, ``w2 [L, d_model, ffn_dim]``.
+
+    ``w1[l]`` / ``w2[l]`` correspond to the reference's
+    ``layers_params[l][0] / [1]`` (``train_ffns.py:38-39``): weights stored
+    transposed ``[out, in]``, no biases.
+    """
+    w1: jax.Array
+    w2: jax.Array
+
+    @property
+    def n_layers(self) -> int:
+        return self.w1.shape[0]
+
+    @property
+    def d_model(self) -> int:
+        return self.w1.shape[2]
+
+    @property
+    def ffn_dim(self) -> int:
+        return self.w1.shape[1]
+
+    def num_params(self) -> int:
+        return self.w1.size + self.w2.size
+
+
+def init_ffn_stack(key: jax.Array, d_model: int, n_layers: int,
+                   ffn_dim: int | None = None, scale: float = 2e-2,
+                   dtype=jnp.float32) -> FFNStackParams:
+    """Initialize the stack; ``ffn_dim`` defaults to ``4 * d_model``
+    (``train_ffns.py:361``)."""
+    ffn_dim = 4 * d_model if ffn_dim is None else ffn_dim
+    keys = jax.random.split(key, 2 * n_layers)
+    w1 = jnp.stack([init_linear(keys[2 * l], d_model, ffn_dim, scale, dtype)
+                    for l in range(n_layers)])
+    w2 = jnp.stack([init_linear(keys[2 * l + 1], ffn_dim, d_model, scale, dtype)
+                    for l in range(n_layers)])
+    return FFNStackParams(w1=w1, w2=w2)
+
+
+@jax.jit
+def _fresh_copy(tree):
+    return jax.tree_util.tree_map(jnp.copy, tree)
+
+
+def clone_params(params: FFNStackParams) -> FFNStackParams:
+    """Fresh buffers for a launcher to own (and donate into its step loop)
+    without consuming the caller's copy — the reference's
+    ``clone_layers_params`` (``train_ffns.py:177-181``), needed because
+    ``--method 0`` feeds the same initial params to every strategy.
+
+    Implemented as a jitted copy: jit outputs never alias non-donated
+    inputs, whereas ``device_put(..., may_alias=False)`` can still share
+    buffers through a replicating reshard on some backends."""
+    return _fresh_copy(params)
+
+
+def reshard_copy(params: FFNStackParams, out_shardings) -> FFNStackParams:
+    """Reshard + fresh-copy in one compiled step: the launcher-side param
+    layout surgery (``train_ffns.py:265-272, :316-323``) expressed as an
+    ``out_shardings`` constraint, with the same non-aliasing guarantee as
+    ``clone_params``."""
+    return jax.jit(_fresh_copy, out_shardings=out_shardings)(params)
+
+
+def params_size_gb(params: FFNStackParams) -> float:
+    """fp32 GB, matching the reference's report (``train_ffns.py:363-366``)."""
+    return 4 * params.num_params() / (1024 ** 3)

@@ -1,0 +1,35 @@
+"""Bias-free linear layer with hand-written forward and backward.
+
+Functional parity with the reference's numerical core
+(``train_ffns.py:35-45``): weights are stored transposed ``[out, in]``,
+there is no bias ("as simplification"), and the backward pass is the
+manually-derived VJP written as two einsums — autograd is never used for
+the model math.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def init_linear(key: jax.Array, in_dim: int, out_dim: int,
+                scale: float = 2e-2, dtype=jnp.float32) -> jax.Array:
+    """``scale * normal([out_dim, in_dim])`` — reference ``train_ffns.py:35-36``."""
+    return (scale * jax.random.normal(key, (out_dim, in_dim))).astype(dtype)
+
+
+def linear_fwd(w: jax.Array, x: jax.Array) -> jax.Array:
+    """``y = x @ w.T`` on ``[tokens, in_dim]`` inputs (``train_ffns.py:41-42``)."""
+    return jnp.matmul(x, w.T)
+
+
+def linear_bwd(dy: jax.Array, w: jax.Array, x: jax.Array):
+    """Manual linear VJP (``train_ffns.py:44-45``).
+
+    Returns ``(dw, dx)`` with ``dw = dy^T x`` and ``dx = dy w`` — the two
+    einsum contractions the reference writes out by hand.
+    """
+    dw = jnp.einsum("bc,bd->cd", dy, x)
+    dx = jnp.einsum("bc,cd->bd", dy, w)
+    return dw, dx

@@ -1,0 +1,103 @@
+"""FFN-stack forward/backward with communication hook injection points.
+
+Parity target: ``tlayers_ffn_fwd`` / ``tlayers_ffn_bkwd``
+(``train_ffns.py:72-94``). The reference threads ``before_comms_hook`` /
+``after_comms_hook`` callables through its layer loops so each parallelism
+strategy can splice collectives into the right spot. Here the same
+architecture is functional: a strategy customizes
+
+- ``block_fwd(w1, w2, x) -> y`` — e.g. TP appends a ``psum`` after the block
+  (``train_ffns.py:303``); FSDP all-gathers the layer's param shards first
+  (``train_ffns.py:200-225``).
+- ``block_bwd(dy, w1, w2, x) -> (dx, (dw1, dw2))`` — e.g. TP ``psum``s the
+  returned ``dx`` (``train_ffns.py:309``); FSDP gathers shards before the VJP.
+- ``grad_hook(dw1, dw2) -> (dw1, dw2)`` — fires the moment a layer's grads
+  exist, exactly like the reference's ``after_comms_hook``
+  (``train_ffns.py:90-91``): DDP ``psum``s them (``:164-165``), FSDP
+  ``psum_scatter``s them (``:255-256``). XLA's latency-hiding scheduler
+  overlaps these collectives with the remaining backward compute — the role
+  the reference's async handles + ``wait()`` played by hand.
+
+Only **block inputs** are saved as activations (``train_ffns.py:77``); each
+block's backward recomputes its pre-activation (see ``ops.ffn``).
+
+Two loop forms are provided. ``unroll=True`` (default) emits one HLO region
+per layer — XLA can software-pipeline collectives of layer ``l+1`` against
+compute of layer ``l``, which is how the reference's explicit prefetch
+machinery (``train_ffns.py:236-249``) is recovered on TPU. ``unroll=False``
+uses ``lax.scan`` for O(1)-in-depth compile time on deep stacks.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .ffn import ffn_fwd, ffn_bwd
+
+BlockFwd = Callable[[jax.Array, jax.Array, jax.Array], jax.Array]
+BlockBwd = Callable[..., tuple]
+GradHook = Callable[[jax.Array, jax.Array], tuple]
+
+
+def stack_fwd(w1s: jax.Array, w2s: jax.Array, x: jax.Array, *,
+              block_fwd: BlockFwd = ffn_fwd, unroll: bool = True):
+    """Run the stack forward; returns ``(y, acts)`` with ``acts`` = block inputs.
+
+    ``w1s [L, ffn, d]`` / ``w2s [L, d, ffn]`` hold the per-layer params
+    stacked on a leading layer axis (the reference's list-of-layers,
+    ``train_ffns.py:361``, as one array so it can live under one sharding).
+    In sharded strategies these are the *local shards*; ``block_fwd`` is
+    responsible for any gathering.
+    """
+    n_layers = w1s.shape[0]
+    if unroll:
+        acts = []
+        y = x
+        for l in range(n_layers):
+            acts.append(y)
+            y = block_fwd(w1s[l], w2s[l], y)
+        return y, jnp.stack(acts)
+
+    def body(y, layer):
+        w1, w2 = layer
+        return block_fwd(w1, w2, y), y
+
+    y, acts = lax.scan(body, x, (w1s, w2s))
+    return y, acts
+
+
+def stack_bwd(dy: jax.Array, w1s: jax.Array, w2s: jax.Array,
+              acts: jax.Array, *,
+              block_bwd: BlockBwd = ffn_bwd,
+              grad_hook: Optional[GradHook] = None,
+              unroll: bool = True):
+    """Walk the stack backward (reverse layer order, ``train_ffns.py:83-94``).
+
+    Returns ``(dx, (g1s, g2s))`` with grads stacked in layer order. If
+    ``grad_hook`` is given it is applied to each layer's ``(dw1, dw2)``
+    immediately after they are produced — the gradient-comm/compute overlap
+    injection point.
+    """
+    n_layers = acts.shape[0]
+    if unroll:
+        g1, g2 = [None] * n_layers, [None] * n_layers
+        for l in reversed(range(n_layers)):
+            dy, (dw1, dw2) = block_bwd(dy, w1s[l], w2s[l], acts[l])
+            if grad_hook is not None:
+                dw1, dw2 = grad_hook(dw1, dw2)
+            g1[l], g2[l] = dw1, dw2
+        return dy, (jnp.stack(g1), jnp.stack(g2))
+
+    def body(dy, xs):
+        w1, w2, act = xs
+        dy, (dw1, dw2) = block_bwd(dy, w1, w2, act)
+        if grad_hook is not None:
+            dw1, dw2 = grad_hook(dw1, dw2)
+        return dy, (dw1, dw2)
+
+    dx, (g1s, g2s) = lax.scan(body, dy, (w1s, w2s, acts), reverse=True)
+    return dx, (g1s, g2s)

@@ -1,0 +1,22 @@
+"""Inline SGD — the reference's entire optimizer surface.
+
+``param = param - LR * grad`` with unscaled summed gradients
+(``train_ffns.py:29, :114, :171-172, :258-259, :311-312``). No optimizer
+state, no classes. Gradients across data-parallel ranks are reduced with
+**SUM, not mean** (``train_ffns.py:165``) and the LR is left unscaled — so
+multi-rank results intentionally differ from the single-device run; only
+strategy-vs-strategy equivalence is asserted, mirroring the reference's
+verification design (``train_ffns.py:386-391``).
+"""
+
+from __future__ import annotations
+
+import jax
+
+from . import LR
+
+
+def sgd(params, grads, lr: float = LR):
+    """Functional SGD over an arbitrary param pytree."""
+    return jax.tree_util.tree_map(lambda p, g: p - lr * g.astype(p.dtype),
+                                  params, grads)

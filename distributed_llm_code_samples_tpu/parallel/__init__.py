@@ -1,0 +1,34 @@
+"""Parallelism strategies, hand-rolled over raw collectives on a device mesh.
+
+Dispatch surface mirrors the reference's ``fns`` table
+(``train_ffns.py:373``): single-device, DDP, FSDP, TP — plus the hybrid
+DDP x TP mesh the BASELINE adds. All launchers share the uniform signature
+``train(params, seeds, batch_size, model_size, mesh, lr) -> params``
+(SURVEY.md L4).
+"""
+
+from .mesh import make_mesh, guard_multi_device, DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+from . import collectives
+from .single import train_single
+from .ddp import train_ddp
+from .fsdp import train_fsdp
+from .tp import train_tp
+from .hybrid import train_hybrid
+
+# Method-number parity with the reference CLI (train_ffns.py:6, :373):
+# 1=single, 2=DDP, 3=FSDP, 4=TP; 5 extends with the hybrid mesh.
+STRATEGIES = {
+    1: ("train_single", train_single),
+    2: ("train_ddp", train_ddp),
+    3: ("train_fsdp", train_fsdp),
+    4: ("train_tp", train_tp),
+    5: ("train_hybrid", train_hybrid),
+}
+
+__all__ = [
+    "make_mesh", "guard_multi_device",
+    "DATA_AXIS", "MODEL_AXIS", "SEQ_AXIS",
+    "collectives",
+    "train_single", "train_ddp", "train_fsdp", "train_tp", "train_hybrid",
+    "STRATEGIES",
+]

@@ -1,0 +1,70 @@
+"""Raw collective primitives, named after the reference's NCCL surface.
+
+This is the native-surface ledger of SURVEY.md section 2.7 made code: every
+collective the reference consumed through ``torch.distributed``/NCCL has a
+TPU-native equivalent here, lowering to XLA collective HLOs that ride ICI:
+
+=====================  ==============================  =======================
+reference (NCCL)        usage                           here (XLA over ICI)
+=====================  ==============================  =======================
+``all_reduce(SUM)``     ``train_ffns.py:165,303,309``   ``lax.psum``
+``all_gather``          ``train_ffns.py:203``           ``lax.all_gather``
+``reduce_scatter(SUM)`` ``train_ffns.py:255-256``       ``lax.psum_scatter``
+send/recv rings         (absent; BASELINE config 3)     ``lax.ppermute``
+async handles+wait      ``train_ffns.py:165,170``       XLA async start/done
+                                                        pairs, scheduler-driven
+=====================  ==============================  =======================
+
+All functions must be called under ``jax.shard_map`` with the named axis
+bound by the mesh. Asynchrony is not expressed in user code: XLA emits
+``all-reduce-start``/``all-reduce-done`` pairs and its latency-hiding
+scheduler moves independent compute between them — the role the reference's
+``async_op=True`` + ``handle.wait()`` discipline played by hand.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+
+def all_reduce(x, axis_name: str):
+    """Sum across the mesh axis — NCCL ``all_reduce(SUM)`` / ``dist.all_reduce``."""
+    return lax.psum(x, axis_name)
+
+
+def all_gather(x, axis_name: str, *, dim: int = 0):
+    """Concatenate shards along ``dim`` across the axis — NCCL ``all_gather``.
+
+    ``tiled=True`` matches the reference's ``torch.cat(sharded_ps)``
+    re-assembly (``train_ffns.py:209``): output dim = shard dim * axis size.
+    """
+    return lax.all_gather(x, axis_name, axis=dim, tiled=True)
+
+
+def reduce_scatter(x, axis_name: str, *, dim: int = 0):
+    """Sum then scatter shards along ``dim`` — NCCL ``reduce_scatter(SUM)``
+    (``train_ffns.py:255-256``)."""
+    return lax.psum_scatter(x, axis_name, scatter_dimension=dim, tiled=True)
+
+
+def ring_shift(x, axis_name: str, *, shift: int = 1):
+    """Neighbor exchange on the axis ring via ``ppermute`` — the send/recv
+    primitive (used by ring attention and the pipeline path; the reference
+    has no p2p, SURVEY.md section 2.2)."""
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def axis_index(axis_name: str):
+    """This shard's coordinate on the axis — the reference's ``local_rank``."""
+    return lax.axis_index(axis_name)
+
+
+def barrier(x, axis_name: str):
+    """In-program ordering fence across the axis: a zero-byte-ish psum that
+    orders everything before it on every shard before anything after it —
+    the SPMD answer to ``mp.Barrier`` (``test_mp_barrier_gpus.py:32-34``)."""
+    token = lax.psum(jax.numpy.zeros(()), axis_name)
+    return lax.optimization_barrier((x, token))[0]

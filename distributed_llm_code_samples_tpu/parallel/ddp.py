@@ -1,0 +1,71 @@
+"""DDP: replicated params, sharded data, per-layer gradient all-reduce.
+
+Parity target: ``train_ddp`` / ``train_process_ddp``
+(``train_ffns.py:154-193``). The reference clones params onto every GPU,
+splits the seed schedule stride-wise across ranks, and — the load-bearing
+detail — fires an **async all_reduce(SUM) per layer the moment that layer's
+grads exist** (``ddp_comms_hook``, ``:164-165``), waiting only when the
+optimizer needs the result, so gradient communication overlaps the rest of
+the backward.
+
+TPU translation: ``jax.shard_map`` over a 1-D ``("data",)`` mesh. Params
+enter replicated (``P()``), each shard consumes its own seed column, and the
+``grad_hook`` injects ``psum`` per layer inside the backward walk — XLA emits
+``all-reduce-start/done`` pairs and its latency-hiding scheduler overlaps
+them with the remaining backward compute, which is exactly the role of the
+reference's handle bookkeeping (``:168-172``). Gradient reduction is SUM
+with unscaled LR (``:165``, ``optim.py``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+from jax.sharding import PartitionSpec as P
+
+from .. import LR
+from ..data import batch_from_seed, shard_seeds_strided
+from ..models.ffn_stack import FFNStackParams, clone_params
+from ..optim import sgd
+from ..ops.stack import stack_fwd, stack_bwd
+from .collectives import all_reduce
+from .launcher import launch
+from .mesh import DATA_AXIS, require_axes
+
+
+def make_step(batch_size: int, model_size: int, lr: float = LR,
+              unroll: bool = True, axis: str = DATA_AXIS):
+    """One DDP step for one shard: local fwd/bwd with per-layer grad psum."""
+
+    def step(params: FFNStackParams, seed) -> FFNStackParams:
+        x, dloss_dx = batch_from_seed(seed, batch_size, model_size,
+                                      params.w1.dtype)
+        _, acts = stack_fwd(params.w1, params.w2, x, unroll=unroll)
+
+        def grad_hook(dw1, dw2):  # fires per layer, like train_ffns.py:164-165
+            return all_reduce(dw1, axis), all_reduce(dw2, axis)
+
+        _, (g1, g2) = stack_bwd(dloss_dx, params.w1, params.w2, acts,
+                                grad_hook=grad_hook, unroll=unroll)
+        return sgd(params, FFNStackParams(g1, g2), lr)
+
+    return step
+
+
+def train_ddp(params: FFNStackParams, seeds, batch_size: int,
+              model_size: int, mesh, lr: float = LR,
+              unroll: bool = True) -> FFNStackParams:
+    """Run the full DDP schedule; returns the (replicated) final params.
+
+    ``seeds`` is the *global* schedule; the strided split across ranks
+    reproduces ``train_ffns.py:182`` so differential tests against FSDP
+    keep their power.
+    """
+    require_axes(mesh, DATA_AXIS)
+    n = mesh.shape[DATA_AXIS]
+    seed_cols = shard_seeds_strided(seeds, n)  # [steps/rank, n]
+    step = make_step(batch_size, model_size, lr, unroll)
+
+    return launch(step, clone_params(params), seed_cols, mesh,
+                  param_specs=P(), seed_spec=P(None, DATA_AXIS),
+                  select_local=lambda s: s[:, 0])

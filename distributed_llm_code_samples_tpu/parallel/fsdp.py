@@ -1,0 +1,119 @@
+"""FSDP / ZeRO-3: params sharded, gathered per layer, grads reduce-scattered.
+
+Parity target: ``train_fsdp`` / ``train_process_fsdp``
+(``train_ffns.py:195-287``). The reference chunks every param along dim 0
+across ranks, then per step:
+
+- forward: all-gathers each layer's two param shards, **prefetching layer
+  l+1's gather during layer l's compute** (``gather_layer_params`` closure,
+  ``:200-225``; prefetch chain ``:236-241``);
+- backward: same gather machinery walking in reverse (``:245-249``), then
+  ``reduce_scatter(SUM)`` of each layer's grads back to shards
+  (``:255-256``) — which the reference could *not* overlap (its TODO at
+  ``:14, :252``);
+- SGD on the local shard only (``:258-259``).
+
+TPU translation: params live sharded along their dim 0 on the ``"data"``
+axis (``w1: P(None, "data", None)``, ``w2: P(None, "data", None)`` on the
+stacked layout). Inside ``shard_map`` the layer loop is unrolled, so each
+layer's ``all_gather`` is an independent async HLO that XLA's scheduler
+hoists ahead of the previous layer's compute — the reference's hand-built
+prefetch, recovered from the dependence structure alone. The backward's
+``psum_scatter`` is likewise async-schedulable, closing the reference's
+known overlap gap for free (SURVEY.md section 7 step 4). The
+all_gather-forward / reduce_scatter-backward correspondence the reference
+builds by hand is explicit here: ``grad_hook`` is literally the VJP of the
+gather.
+
+Memory property (the reference's README demo: FSDP fits where DDP OOMs):
+full layers exist only transiently; persistent state is ``1/n``-th of the
+model per shard. Verified by compiled memory analysis in the test suite.
+"""
+
+from __future__ import annotations
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .. import LR
+from ..data import batch_from_seed, shard_seeds_strided
+from ..models.ffn_stack import FFNStackParams, reshard_copy
+from ..optim import sgd
+from ..ops.ffn import ffn_fwd, ffn_bwd
+from ..ops.stack import stack_fwd, stack_bwd
+from .collectives import all_gather, reduce_scatter
+from .launcher import launch
+from .mesh import DATA_AXIS, require_axes
+
+# Stacked-layout shard specs: per-layer dim 0 == stacked axis 1.
+PARAM_SPECS = FFNStackParams(w1=P(None, DATA_AXIS, None),
+                             w2=P(None, DATA_AXIS, None))
+
+
+def shard_params(params: FFNStackParams, mesh) -> FFNStackParams:
+    """Lay params out sharded — the launcher-side ``chunk_p``
+    (``train_ffns.py:265-272``) expressed as a sharding, not list surgery."""
+    return reshard_copy(params, FFNStackParams(
+        w1=NamedSharding(mesh, PARAM_SPECS.w1),
+        w2=NamedSharding(mesh, PARAM_SPECS.w2)))
+
+
+def make_step(batch_size: int, model_size: int, lr: float = LR,
+              unroll: bool = True, axis: str = DATA_AXIS):
+    """One FSDP step for one shard (operates on local shard views)."""
+
+    def gather(w1_shard, w2_shard):
+        # train_ffns.py:200-225 — async all_gather of both params of a layer;
+        # tiled concat matches the torch.cat re-assembly (:209).
+        return (all_gather(w1_shard, axis, dim=0),
+                all_gather(w2_shard, axis, dim=0))
+
+    def block_fwd(w1_shard, w2_shard, x):
+        w1, w2 = gather(w1_shard, w2_shard)
+        return ffn_fwd(w1, w2, x)
+
+    def block_bwd(dy, w1_shard, w2_shard, x):
+        # Backward re-gathers the layer (train_ffns.py:245-249); the gathered
+        # full params are transient, never stored.
+        w1, w2 = gather(w1_shard, w2_shard)
+        return ffn_bwd(dy, w1, w2, x)
+
+    def grad_hook(dw1, dw2):
+        # The VJP of all_gather is reduce_scatter: full grads -> summed shard
+        # (train_ffns.py:255-256), SUM semantics, unscaled LR.
+        return (reduce_scatter(dw1, axis, dim=0),
+                reduce_scatter(dw2, axis, dim=0))
+
+    def step(params: FFNStackParams, seed) -> FFNStackParams:
+        x, dloss_dx = batch_from_seed(seed, batch_size, model_size,
+                                      params.w1.dtype)
+        _, acts = stack_fwd(params.w1, params.w2, x, block_fwd=block_fwd,
+                            unroll=unroll)
+        _, (g1, g2) = stack_bwd(dloss_dx, params.w1, params.w2, acts,
+                                block_bwd=block_bwd, grad_hook=grad_hook,
+                                unroll=unroll)
+        # Sharded SGD on the local chunk only (train_ffns.py:258-259).
+        return sgd(params, FFNStackParams(g1, g2), lr)
+
+    return step
+
+
+def train_fsdp(params: FFNStackParams, seeds, batch_size: int,
+               model_size: int, mesh, lr: float = LR,
+               unroll: bool = True) -> FFNStackParams:
+    """Run the full FSDP schedule; returns final params as a global array
+    (re-assembly is implicit in the output sharding — no host-side concat
+    like ``train_ffns.py:284-287`` is needed)."""
+    require_axes(mesh, DATA_AXIS)
+    n = mesh.shape[DATA_AXIS]
+    if params.w1.shape[1] % n or params.w2.shape[1] % n:
+        raise ValueError(
+            f"param dims {params.w1.shape[1]}x{params.w2.shape[1]} not "
+            f"divisible by {n} shards (the reference's chunk() had the same "
+            "implicit requirement)")
+    seed_cols = shard_seeds_strided(seeds, n)
+    params = shard_params(params, mesh)
+    step = make_step(batch_size, model_size, lr, unroll)
+
+    return launch(step, params, seed_cols, mesh,
+                  param_specs=PARAM_SPECS, seed_spec=P(None, DATA_AXIS),
+                  select_local=lambda s: s[:, 0])

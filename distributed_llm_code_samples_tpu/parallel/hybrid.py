@@ -1,0 +1,85 @@
+"""Hybrid DDP x TP on a 2-D device mesh — beyond the reference.
+
+The reference never composes strategies (every run is a flat world,
+``train_ffns.py:25``), but the driver's north star adds a hybrid
+DDP x MP mesh (BASELINE.md config 4). Composition here is free because each
+strategy is just a set of collectives bound to a mesh *axis name*:
+
+- params are TP-sharded over ``"model"`` and replicated over ``"data"``;
+- data is strided over ``"data"`` ranks and replicated over ``"model"``;
+- backward: per-layer ``psum`` of the input grad over ``"model"`` (the TP
+  f/g trick) and per-layer ``psum`` of the *weight* grads over ``"data"``
+  (the DDP hook) — two independent orthogonal reductions.
+
+With ``model=1`` this degenerates to DDP; with ``data=1`` to TP. The
+differential tests assert both degeneracies plus DDP(d) == hybrid(d x m).
+"""
+
+from __future__ import annotations
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .. import LR
+from ..data import batch_from_seed, shard_seeds_strided
+from ..models.ffn_stack import FFNStackParams, reshard_copy
+from ..optim import sgd
+from ..ops.ffn import ffn_fwd, ffn_bwd
+from ..ops.stack import stack_fwd, stack_bwd
+from .collectives import all_reduce
+from .launcher import launch
+from .mesh import DATA_AXIS, MODEL_AXIS, require_axes
+
+PARAM_SPECS = FFNStackParams(w1=P(None, MODEL_AXIS, None),
+                             w2=P(None, None, MODEL_AXIS))
+
+
+def shard_params(params: FFNStackParams, mesh) -> FFNStackParams:
+    return reshard_copy(params, FFNStackParams(
+        w1=NamedSharding(mesh, PARAM_SPECS.w1),
+        w2=NamedSharding(mesh, PARAM_SPECS.w2)))
+
+
+def make_step(batch_size: int, model_size: int, lr: float = LR,
+              unroll: bool = True):
+    def block_fwd(w1_shard, w2_shard, x):
+        return all_reduce(ffn_fwd(w1_shard, w2_shard, x), MODEL_AXIS)
+
+    def block_bwd(dy, w1_shard, w2_shard, x):
+        dx, grads = ffn_bwd(dy, w1_shard, w2_shard, x)
+        return all_reduce(dx, MODEL_AXIS), grads
+
+    def grad_hook(dw1, dw2):
+        # DDP reduction of the TP-local weight-grad shards across replicas.
+        return (all_reduce(dw1, DATA_AXIS), all_reduce(dw2, DATA_AXIS))
+
+    def step(params: FFNStackParams, seed) -> FFNStackParams:
+        x, dloss_dx = batch_from_seed(seed, batch_size, model_size,
+                                      params.w1.dtype)
+        _, acts = stack_fwd(params.w1, params.w2, x, block_fwd=block_fwd,
+                            unroll=unroll)
+        _, (g1, g2) = stack_bwd(dloss_dx, params.w1, params.w2, acts,
+                                block_bwd=block_bwd, grad_hook=grad_hook,
+                                unroll=unroll)
+        return sgd(params, FFNStackParams(g1, g2), lr)
+
+    return step
+
+
+def train_hybrid(params: FFNStackParams, seeds, batch_size: int,
+                 model_size: int, mesh, lr: float = LR,
+                 unroll: bool = True) -> FFNStackParams:
+    """Run the full hybrid schedule on a mesh with ``"data"`` and ``"model"``
+    axes. Seeds are strided across ``"data"`` only."""
+    require_axes(mesh, DATA_AXIS, MODEL_AXIS)
+    dp = mesh.shape[DATA_AXIS]
+    tp = mesh.shape[MODEL_AXIS]
+    if params.w1.shape[1] % tp:
+        raise ValueError(f"ffn_dim {params.w1.shape[1]} not divisible by "
+                         f"{tp} model shards")
+    seed_cols = shard_seeds_strided(seeds, dp)
+    params = shard_params(params, mesh)
+    step = make_step(batch_size, model_size, lr, unroll)
+
+    return launch(step, params, seed_cols, mesh,
+                  param_specs=PARAM_SPECS, seed_spec=P(None, DATA_AXIS),
+                  select_local=lambda s: s[:, 0])

@@ -1,0 +1,34 @@
+"""Shared launcher tail for every multi-device strategy.
+
+The reference's launchers all share the same skeleton — shard params and
+seeds, spawn workers, join, re-assemble (``train_ffns.py:174-193, :262-287,
+:315-338``). The SPMD analogue is one function: ``shard_map`` the per-shard
+step loop over the mesh, jit with donation, run. Each strategy is then just
+its specs + hooks.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+from jax import lax
+
+
+def launch(step: Callable, params, seeds_arr, mesh, param_specs, seed_spec,
+           select_local: Callable = lambda s: s):
+    """Run ``lax.scan(step)`` over the seed schedule under ``shard_map``.
+
+    ``select_local`` maps the shard's view of the seed array to its 1-D
+    schedule (e.g. ``s[:, 0]`` for a strided column split). ``params`` must
+    already be owned by the launcher (cloned/resharded) — they are donated.
+    """
+
+    def run(params, seeds):
+        local = select_local(seeds)
+        return lax.scan(lambda p, s: (step(p, s), None), params, local)[0]
+
+    run_sharded = jax.shard_map(run, mesh=mesh,
+                                in_specs=(param_specs, seed_spec),
+                                out_specs=param_specs)
+    return jax.jit(run_sharded, donate_argnums=0)(params, seeds_arr)

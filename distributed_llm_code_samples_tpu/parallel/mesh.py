@@ -1,0 +1,73 @@
+"""Device-mesh bootstrap — the TPU replacement for the reference's process
+runtime (``train_ffns.py:121-127, :184-191``).
+
+The reference spawns one OS process per GPU and rendezvous over
+``MASTER_ADDR/PORT`` + NCCL. On TPU the whole pattern collapses into SPMD:
+one process per host, an explicit ``jax.sharding.Mesh`` over ICI (and DCN
+across hosts), and collectives addressed by mesh axis *name* instead of
+process-group handles. Axis names used across the framework:
+
+- ``"data"``  — data parallelism (DDP and FSDP both shard over it)
+- ``"model"`` — tensor parallelism (Megatron-style)
+- ``"seq"``   — sequence/context parallelism (long-context extensions)
+
+Multi-chip without hardware: ``XLA_FLAGS=--xla_force_host_platform_device_count=N``
+with ``JAX_PLATFORMS=cpu`` gives N fake devices, so every strategy and every
+collective test runs on a dev box — this replaces the reference's hard
+dependency on physical multi-GPU (SURVEY.md section 4).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+
+
+def make_mesh(axes: Mapping[str, int] | None = None,
+              devices: Sequence[jax.Device] | None = None) -> Mesh:
+    """Build a mesh with named axes from the first ``prod(axes)`` devices.
+
+    ``axes=None`` uses every visible device on a 1-D ``("data",)`` mesh —
+    the analogue of the reference's flat ``world_size = nGPUs``
+    (``train_ffns.py:25, :125``).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if axes is None:
+        axes = {DATA_AXIS: len(devices)}
+    n = math.prod(axes.values())
+    if n > len(devices):
+        raise ValueError(f"mesh {dict(axes)} needs {n} devices, "
+                         f"only {len(devices)} visible")
+    arr = np.asarray(devices[:n]).reshape(tuple(axes.values()))
+    return Mesh(arr, tuple(axes.keys()))
+
+
+def require_axes(mesh: Mesh, *axes: str) -> None:
+    """Fail with a readable message when a strategy is handed a mesh without
+    the axis names it shards over."""
+    missing = [a for a in axes if a not in mesh.shape]
+    if missing:
+        raise ValueError(
+            f"mesh has axes {dict(mesh.shape)} but this strategy needs "
+            f"{missing} — build it with make_mesh({{'"
+            + "': n, '".join(axes) + "': n})")
+
+
+def guard_multi_device(min_devices: int = 2) -> None:
+    """Startup guard mirroring the reference's 1-GPU refusal
+    (``train_ffns.py:25-27``) — but also guarding 0, which it didn't."""
+    n = jax.device_count()
+    if n < min_devices:
+        raise RuntimeError(
+            f"Only {n} device(s) available; multi-device strategies need "
+            f">= {min_devices}. For a fake multi-chip mesh set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+            "JAX_PLATFORMS=cpu before importing jax.")

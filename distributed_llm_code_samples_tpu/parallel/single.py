@@ -1,0 +1,51 @@
+"""Single-device trainer — reference semantics for the ops layer.
+
+Parity target: ``train_1gpu`` (``train_ffns.py:101-116``): per step, forward
+the stack, hand-written backward, functional SGD rebuild ``p - LR*g``. The
+step loop is a ``lax.scan`` over the seed schedule so the whole run is one
+XLA program (steps/sec is measured without per-step dispatch overhead).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import LR
+from ..data import batch_from_seed
+from ..models.ffn_stack import FFNStackParams, clone_params
+from ..optim import sgd
+from ..ops.stack import stack_fwd, stack_bwd
+
+
+def make_step(batch_size: int, model_size: int, lr: float = LR,
+              unroll: bool = True):
+    """Build one training step ``(params, seed) -> params`` — forward,
+    manual backward, inline SGD (``train_ffns.py:105-114``)."""
+
+    def step(params: FFNStackParams, seed) -> FFNStackParams:
+        x, dloss_dx = batch_from_seed(seed, batch_size, model_size,
+                                      params.w1.dtype)
+        _, acts = stack_fwd(params.w1, params.w2, x, unroll=unroll)
+        _, (g1, g2) = stack_bwd(dloss_dx, params.w1, params.w2, acts,
+                                unroll=unroll)
+        return sgd(params, FFNStackParams(g1, g2), lr)
+
+    return step
+
+
+@partial(jax.jit, static_argnums=(2, 3, 4, 5), donate_argnums=0)
+def _run(params, seeds, batch_size, model_size, lr, unroll):
+    step = make_step(batch_size, model_size, lr, unroll)
+    return lax.scan(lambda p, s: (step(p, s), None), params, seeds)[0]
+
+
+def train_single(params: FFNStackParams, seeds, batch_size: int,
+                 model_size: int, mesh=None, lr: float = LR,
+                 unroll: bool = True) -> FFNStackParams:
+    """Uniform launcher signature (SURVEY.md L4); ``mesh`` ignored."""
+    return _run(clone_params(params), jnp.asarray(seeds), batch_size,
+                model_size, lr, unroll)

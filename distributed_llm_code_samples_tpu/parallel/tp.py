@@ -1,0 +1,90 @@
+"""Megatron-style tensor parallelism: column-parallel W1, row-parallel W2.
+
+Parity target: ``train_tp`` / ``train_process_tp`` (``train_ffns.py:289-338``).
+W1 is chunked on its output (ffn) dim — column parallel — and W2 on its
+input (ffn) dim — row parallel (``chunk_p(p, dim=i)``, ``:316-319``). The
+chunk dims are conjugate, so **no communication crosses the ReLU** (the
+Megatron f/g trick): each rank computes a full-width slice of the hidden
+activation, and one ``all_reduce(SUM)`` per layer per direction restores the
+replicated activation (forward ``:303``) / input grad (backward ``:309``).
+Data is replicated to all ranks (``:324``); weight grads stay local — each
+rank owns its shard's optimizer step (``:311-312``).
+
+TPU translation: params sharded ``w1: P(None, "model", None)``,
+``w2: P(None, None, "model")`` on the stacked layout; ``block_fwd`` /
+``block_bwd`` append the per-layer ``psum`` — injected through the same hook
+surface the other strategies use (``ops.stack``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .. import LR
+from ..data import batch_from_seed
+from ..models.ffn_stack import FFNStackParams, reshard_copy
+from ..optim import sgd
+from ..ops.ffn import ffn_fwd, ffn_bwd
+from ..ops.stack import stack_fwd, stack_bwd
+from .collectives import all_reduce
+from .launcher import launch
+from .mesh import MODEL_AXIS, require_axes
+
+# w1 [L, ffn, d] sharded on ffn (column-parallel); w2 [L, d, ffn] sharded on
+# ffn (row-parallel) — train_ffns.py:316-319 on the stacked layout.
+PARAM_SPECS = FFNStackParams(w1=P(None, MODEL_AXIS, None),
+                             w2=P(None, None, MODEL_AXIS))
+
+
+def shard_params(params: FFNStackParams, mesh) -> FFNStackParams:
+    return reshard_copy(params, FFNStackParams(
+        w1=NamedSharding(mesh, PARAM_SPECS.w1),
+        w2=NamedSharding(mesh, PARAM_SPECS.w2)))
+
+
+def make_step(batch_size: int, model_size: int, lr: float = LR,
+              unroll: bool = True, axis: str = MODEL_AXIS):
+    def block_fwd(w1_shard, w2_shard, x):
+        # Partial y per rank, then sync all_reduce(SUM) — train_ffns.py:302-303.
+        return all_reduce(ffn_fwd(w1_shard, w2_shard, x), axis)
+
+    def block_bwd(dy, w1_shard, w2_shard, x):
+        # Local VJP on the shard, then all_reduce the input grad — :308-309.
+        # The recompute of the (local slice of the) pre-activation happens
+        # inside ffn_bwd, same as the reference's per-rank recompute.
+        dx, grads = ffn_bwd(dy, w1_shard, w2_shard, x)
+        return all_reduce(dx, axis), grads
+
+    def step(params: FFNStackParams, seed) -> FFNStackParams:
+        x, dloss_dx = batch_from_seed(seed, batch_size, model_size,
+                                      params.w1.dtype)
+        _, acts = stack_fwd(params.w1, params.w2, x, block_fwd=block_fwd,
+                            unroll=unroll)
+        _, (g1, g2) = stack_bwd(dloss_dx, params.w1, params.w2, acts,
+                                block_bwd=block_bwd, unroll=unroll)
+        # Weight grads are local to the shard; local SGD (:311-312).
+        return sgd(params, FFNStackParams(g1, g2), lr)
+
+    return step
+
+
+def train_tp(params: FFNStackParams, seeds, batch_size: int,
+             model_size: int, mesh, lr: float = LR,
+             unroll: bool = True) -> FFNStackParams:
+    """Run the full TP schedule. Data (seeds) is replicated to all shards
+    (``train_ffns.py:324``), so TP consumes the *same* steps as the
+    single-device run — they must agree numerically (a differential test
+    the reference never asserted)."""
+    import jax.numpy as jnp
+
+    require_axes(mesh, MODEL_AXIS)
+    n = mesh.shape[MODEL_AXIS]
+    if params.w1.shape[1] % n:
+        raise ValueError(f"ffn_dim {params.w1.shape[1]} not divisible by "
+                         f"{n} model shards")
+    params = shard_params(params, mesh)
+    step = make_step(batch_size, model_size, lr, unroll)
+
+    return launch(step, params, jnp.asarray(seeds), mesh,
+                  param_specs=PARAM_SPECS, seed_spec=P())

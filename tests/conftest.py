@@ -1,0 +1,48 @@
+"""Test bootstrap: 8 fake CPU devices so every strategy, collective, and
+hybrid mesh runs without TPU hardware (SURVEY.md section 4, "multi-node
+without a cluster"). Must run before jax initializes its backends."""
+
+import os
+import sys
+
+import re as _re
+
+_FLAG = "--xla_force_host_platform_device_count=8"
+_flags = os.environ.get("XLA_FLAGS", "")
+# replace any pre-existing count (a shell pinning =4 would break the mesh
+# fixtures), then append ours
+_flags = _re.sub(r"--xla_force_host_platform_device_count=\d+", "", _flags)
+os.environ["XLA_FLAGS"] = (_flags + " " + _FLAG).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+# The axon sitecustomize pins JAX_PLATFORMS=axon (single real TPU chip);
+# tests run on the fake 8-device CPU backend instead.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+from distributed_llm_code_samples_tpu.parallel import (  # noqa: E402
+    make_mesh, DATA_AXIS, MODEL_AXIS)
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    return make_mesh({DATA_AXIS: 8})
+
+
+@pytest.fixture(scope="session")
+def mesh4():
+    return make_mesh({DATA_AXIS: 4})
+
+
+@pytest.fixture(scope="session")
+def mesh_model4():
+    return make_mesh({MODEL_AXIS: 4})
+
+
+@pytest.fixture(scope="session")
+def mesh4x2():
+    return make_mesh({DATA_AXIS: 4, MODEL_AXIS: 2})
