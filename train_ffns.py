@@ -1,0 +1,18 @@
+#!/usr/bin/env python
+"""Reference-parity entrypoint: same UX as the reference's ``train_ffns.py``
+(same flags, same printout shape), running the TPU-native framework.
+
+    python train_ffns.py --num_steps 16 --batch_size 8 --seq_len 1024 \
+        --layers 1 --model_size 8192 --method M
+
+M: 0=all, 1=single device, 2=DDP, 3=FSDP, 4=TP (Megatron), 5=hybrid DDP x TP.
+Add ``--fake_devices 8`` to run the multi-device methods without TPU
+hardware on a virtual CPU mesh.
+"""
+
+import sys
+
+from distributed_llm_code_samples_tpu.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())
