@@ -1,0 +1,174 @@
+// Host ring-collective engine — the framework's native communication core.
+//
+// The reference consumed its collectives from NCCL through torch.distributed
+// (train_ffns.py:20,125; test_nccl.py:2). On TPU the device-side collectives
+// are XLA HLOs over ICI (parallel/collectives.py); THIS engine is the
+// native host-side counterpart: real ring algorithms (reduce-scatter +
+// all-gather phases, N ranks as threads over shared memory) used as
+//   (a) an independent native oracle for the XLA collectives in tests —
+//       the CPU-oracle pattern of test_nccl.py with the oracle itself
+//       implemented from first principles, and
+//   (b) the host-side reduction fallback for runtime components that
+//       operate outside any XLA program (e.g. cross-process data-layer
+//       reductions).
+//
+// C ABI only; bound from Python via ctypes (runtime/native.py).
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// Reusable N-thread barrier (generation-counted).
+class Barrier {
+ public:
+  explicit Barrier(int n) : n_(n), waiting_(0), generation_(0) {}
+  void wait() {
+    std::unique_lock<std::mutex> lk(mu_);
+    int gen = generation_;
+    if (++waiting_ == n_) {
+      waiting_ = 0;
+      ++generation_;
+      cv_.notify_all();
+    } else {
+      cv_.wait(lk, [&] { return gen != generation_; });
+    }
+  }
+
+ private:
+  int n_;
+  int waiting_;
+  int generation_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+};
+
+inline int64_t chunk_begin(int64_t count, int n, int c) {
+  int64_t base = count / n, rem = count % n;
+  return c * base + (c < rem ? c : rem);
+}
+inline int64_t chunk_end(int64_t count, int n, int c) {
+  return chunk_begin(count, n, c + 1);
+}
+inline int mod(int a, int n) { return ((a % n) + n) % n; }
+
+// Ring all-reduce over shared memory: the classic two phases.
+// Phase 1 (reduce-scatter): n-1 steps; at step s, rank r accumulates its
+// predecessor's chunk mod(r-1-s, n) into its own copy. Afterwards rank r
+// holds the fully-reduced chunk mod(r+1, n).
+// Phase 2 (all-gather): n-1 steps; at step s, rank r copies chunk
+// mod(r-s, n) from its predecessor. Barriers order the steps; reads and
+// writes of a step touch disjoint chunks.
+void ring_all_reduce(float** bufs, int n, int64_t count) {
+  if (n == 1) return;
+  Barrier bar(n);
+  std::vector<std::thread> ts;
+  ts.reserve(n);
+  for (int r = 0; r < n; ++r) {
+    ts.emplace_back([&, r] {
+      int pred = mod(r - 1, n);
+      for (int s = 0; s < n - 1; ++s) {  // reduce-scatter phase
+        int c = mod(r - 1 - s, n);
+        int64_t b = chunk_begin(count, n, c), e = chunk_end(count, n, c);
+        for (int64_t i = b; i < e; ++i) bufs[r][i] += bufs[pred][i];
+        bar.wait();
+      }
+      for (int s = 0; s < n - 1; ++s) {  // all-gather phase
+        int c = mod(r - s, n);
+        int64_t b = chunk_begin(count, n, c), e = chunk_end(count, n, c);
+        std::memcpy(bufs[r] + b, bufs[pred] + b, (e - b) * sizeof(float));
+        bar.wait();
+      }
+    });
+  }
+  for (auto& t : ts) t.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// In-place SUM all-reduce across n_ranks buffers of `count` floats.
+void dlcs_all_reduce_sum_f32(float** bufs, int n_ranks, int64_t count) {
+  ring_all_reduce(bufs, n_ranks, count);
+}
+
+// Each rank contributes `shard_count` floats; every output buffer receives
+// the rank-order concatenation (n_ranks * shard_count floats).
+void dlcs_all_gather_f32(const float** shards, float** outs, int n_ranks,
+                         int64_t shard_count) {
+  Barrier bar(n_ranks);
+  std::vector<std::thread> ts;
+  ts.reserve(n_ranks);
+  for (int r = 0; r < n_ranks; ++r) {
+    ts.emplace_back([&, r] {
+      // seed own shard at its slot, then ring-forward predecessor slots
+      std::memcpy(outs[r] + r * shard_count, shards[r],
+                  shard_count * sizeof(float));
+      bar.wait();
+      int pred = mod(r - 1, n_ranks);
+      for (int s = 0; s < n_ranks - 1; ++s) {
+        int c = mod(r - 1 - s, n_ranks);
+        std::memcpy(outs[r] + c * shard_count, outs[pred] + c * shard_count,
+                    shard_count * sizeof(float));
+        bar.wait();
+      }
+    });
+  }
+  for (auto& t : ts) t.join();
+}
+
+// Each rank contributes n_ranks*shard_count floats; rank r's output gets
+// the SUM over ranks of shard r. Implemented as a reduce-scatter ring over
+// an internal scratch copy (inputs are not modified).
+void dlcs_reduce_scatter_sum_f32(const float** ins, float** outs, int n_ranks,
+                                 int64_t shard_count) {
+  int n = n_ranks;
+  int64_t count = static_cast<int64_t>(n) * shard_count;
+  std::vector<std::vector<float>> scratch(n);
+  std::vector<float*> bufs(n);
+  for (int r = 0; r < n; ++r) {
+    scratch[r].assign(ins[r], ins[r] + count);
+    bufs[r] = scratch[r].data();
+  }
+  if (n > 1) {
+    Barrier bar(n);
+    std::vector<std::thread> ts;
+    ts.reserve(n);
+    for (int r = 0; r < n; ++r) {
+      ts.emplace_back([&, r] {
+        int pred = mod(r - 1, n);
+        for (int s = 0; s < n - 1; ++s) {
+          int c = mod(r - 1 - s, n);
+          for (int64_t i = c * shard_count; i < (c + 1) * shard_count; ++i)
+            bufs[r][i] += bufs[pred][i];
+          bar.wait();
+        }
+      });
+    }
+    for (auto& t : ts) t.join();
+  }
+  // after the ring, chunk c is fully reduced on rank mod(c+1... owner of
+  // chunk c is the rank r with mod(r+1, n) == c, i.e. r = mod(c-1, n)
+  for (int c = 0; c < n; ++c) {
+    int owner = mod(c - 1, n);
+    std::memcpy(outs[c], bufs[owner] + c * shard_count,
+                shard_count * sizeof(float));
+  }
+}
+
+// ppermute on a ring: out[mod(r+shift, n)] = ins[r].
+void dlcs_ring_permute_f32(const float** ins, float** outs, int n_ranks,
+                           int64_t count, int shift) {
+  for (int r = 0; r < n_ranks; ++r) {
+    int dst = mod(r + shift, n_ranks);
+    std::memcpy(outs[dst], ins[r], count * sizeof(float));
+  }
+}
+
+}  // extern "C"

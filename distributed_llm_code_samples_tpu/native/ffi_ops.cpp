@@ -1,0 +1,57 @@
+// Native XLA custom calls via the XLA FFI — C++ kernels that run INSIDE
+// jitted XLA programs.
+//
+// The reference reached native compute through torch's prebuilt CUDA
+// kernels; here the native path is first-party: kernels registered with
+// the XLA runtime through the stable FFI ABI (headers shipped with jaxlib,
+// see jax.ffi.include_dir()). Registered on the CPU platform (TPU custom
+// calls are not user-extensible; on TPU the equivalent role is played by
+// Pallas kernels in ops/pallas_ffn.py).
+//
+// Kernels:
+//   dlcs_fused_sgd  — out = p - lr * g, one pass (the reference's inline
+//                     SGD, train_ffns.py:171-172, as a fused native op)
+//   dlcs_relu_bwd   — out = where(x <= 0, 0, dy) (train_ffns.py:50-52)
+
+#include <cstdint>
+
+#include "xla/ffi/api/ffi.h"
+
+namespace ffi = xla::ffi;
+
+static ffi::Error FusedSgdImpl(ffi::Buffer<ffi::F32> p,
+                               ffi::Buffer<ffi::F32> g,
+                               ffi::Buffer<ffi::F32> lr,
+                               ffi::ResultBuffer<ffi::F32> out) {
+  const float* pp = p.typed_data();
+  const float* gg = g.typed_data();
+  const float lrv = lr.typed_data()[0];
+  float* oo = out->typed_data();
+  const int64_t n = static_cast<int64_t>(p.element_count());
+  for (int64_t i = 0; i < n; ++i) oo[i] = pp[i] - lrv * gg[i];
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(DlcsFusedSgd, FusedSgdImpl,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::Buffer<ffi::F32>>()   // p
+                                  .Arg<ffi::Buffer<ffi::F32>>()   // g
+                                  .Arg<ffi::Buffer<ffi::F32>>()   // lr (scalar)
+                                  .Ret<ffi::Buffer<ffi::F32>>()); // out
+
+static ffi::Error ReluBwdImpl(ffi::Buffer<ffi::F32> dy,
+                              ffi::Buffer<ffi::F32> x,
+                              ffi::ResultBuffer<ffi::F32> out) {
+  const float* d = dy.typed_data();
+  const float* xx = x.typed_data();
+  float* oo = out->typed_data();
+  const int64_t n = static_cast<int64_t>(dy.element_count());
+  for (int64_t i = 0; i < n; ++i) oo[i] = xx[i] <= 0.0f ? 0.0f : d[i];
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(DlcsReluBwd, ReluBwdImpl,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::Buffer<ffi::F32>>()   // dy
+                                  .Arg<ffi::Buffer<ffi::F32>>()   // x
+                                  .Ret<ffi::Buffer<ffi::F32>>()); // out

@@ -1,0 +1,152 @@
+// Native TCP rendezvous + barrier — the process-group bootstrap.
+//
+// The reference rendezvous is MASTER_ADDR/MASTER_PORT + NCCL process-group
+// init (train_ffns.py:121-127), and its host-side sync experiment is
+// multiprocessing.Barrier (test_mp_barrier_gpus.py:32-34). This is the
+// native counterpart used by the framework's multi-host runtime: rank 0
+// listens, peers dial in, everyone learns (rank, world_size), and barrier()
+// is a coordinator round-trip. jax.distributed.initialize plays this role
+// for the XLA runtime itself (runtime/init.py); this engine covers
+// host-side coordination outside XLA (e.g. multi-process tests, launcher
+// handshakes) without any torch/NCCL dependency.
+//
+// C ABI only; bound via ctypes (runtime/native.py).
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+struct Rendezvous {
+  int world_size = 0;
+  int rank = -1;
+  int listen_fd = -1;               // coordinator only
+  std::vector<int> peer_fds;        // coordinator: world_size-1 peers
+  int coord_fd = -1;                // non-coordinator: socket to rank 0
+
+  ~Rendezvous() {                   // every delete path closes its fds
+    for (int fd : peer_fds) ::close(fd);
+    if (coord_fd >= 0) ::close(coord_fd);
+    if (listen_fd >= 0) ::close(listen_fd);
+  }
+};
+
+bool send_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t w = ::send(fd, p, n, 0);
+    if (w <= 0) return false;
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool recv_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Coordinator (rank 0): bind+listen on addr:port, accept world_size-1
+// peers, assign ranks by arrival order. Returns handle or nullptr.
+void* dlcs_rdzv_coordinator(const char* addr, int port, int world_size) {
+  auto* R = new Rendezvous;
+  R->world_size = world_size;
+  R->rank = 0;
+  R->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  ::setsockopt(R->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in sa{};
+  sa.sin_family = AF_INET;
+  sa.sin_port = htons(static_cast<uint16_t>(port));
+  ::inet_pton(AF_INET, addr, &sa.sin_addr);
+  if (::bind(R->listen_fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) != 0 ||
+      ::listen(R->listen_fd, world_size) != 0) {
+    delete R;  // destructor closes listen_fd
+    return nullptr;
+  }
+  for (int i = 1; i < world_size; ++i) {
+    int fd = ::accept(R->listen_fd, nullptr, nullptr);
+    if (fd < 0) {
+      delete R;
+      return nullptr;
+    }
+    int32_t hdr[2] = {i, world_size};  // assigned rank, world size
+    if (!send_all(fd, hdr, sizeof(hdr))) {
+      delete R;
+      return nullptr;
+    }
+    R->peer_fds.push_back(fd);
+  }
+  return R;
+}
+
+// Peer: dial the coordinator, learn the assigned rank. Returns handle.
+void* dlcs_rdzv_join(const char* addr, int port) {
+  auto* R = new Rendezvous;
+  R->coord_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in sa{};
+  sa.sin_family = AF_INET;
+  sa.sin_port = htons(static_cast<uint16_t>(port));
+  ::inet_pton(AF_INET, addr, &sa.sin_addr);
+  // retry while the coordinator comes up
+  for (int attempt = 0; attempt < 200; ++attempt) {
+    if (::connect(R->coord_fd, reinterpret_cast<sockaddr*>(&sa),
+                  sizeof(sa)) == 0)
+      break;
+    ::usleep(50 * 1000);
+    ::close(R->coord_fd);
+    R->coord_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  }
+  int32_t hdr[2];
+  if (!recv_all(R->coord_fd, hdr, sizeof(hdr))) {
+    delete R;
+    return nullptr;
+  }
+  R->rank = hdr[0];
+  R->world_size = hdr[1];
+  return R;
+}
+
+int dlcs_rdzv_rank(void* h) { return static_cast<Rendezvous*>(h)->rank; }
+int dlcs_rdzv_world(void* h) {
+  return static_cast<Rendezvous*>(h)->world_size;
+}
+
+// Barrier: peers send a token to the coordinator; once all arrived, the
+// coordinator releases everyone. Returns 0 on success.
+int dlcs_rdzv_barrier(void* h) {
+  auto* R = static_cast<Rendezvous*>(h);
+  char tok = 1;
+  if (R->rank == 0) {
+    for (int fd : R->peer_fds)
+      if (!recv_all(fd, &tok, 1)) return 1;
+    for (int fd : R->peer_fds)
+      if (!send_all(fd, &tok, 1)) return 1;
+    return 0;
+  }
+  if (!send_all(R->coord_fd, &tok, 1)) return 1;
+  if (!recv_all(R->coord_fd, &tok, 1)) return 1;
+  return 0;
+}
+
+void dlcs_rdzv_destroy(void* h) { delete static_cast<Rendezvous*>(h); }
+
+}  // extern "C"

@@ -1,0 +1,13 @@
+"""Runtime layer: process bootstrap + native C++ components.
+
+The TPU-native replacement for the reference's L0/L4 runtime surface
+(SURVEY.md): ``init`` wraps the multi-host bootstrap
+(``jax.distributed``); ``native`` binds the in-tree C++ engines (host ring
+collectives, prefetching data loader, TCP rendezvous/barrier, XLA FFI
+custom calls).
+"""
+
+from . import native
+from .init import initialize, runtime_info, DEFAULT_COORDINATOR
+
+__all__ = ["native", "initialize", "runtime_info", "DEFAULT_COORDINATOR"]

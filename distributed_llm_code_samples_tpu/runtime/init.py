@@ -1,0 +1,49 @@
+"""Multi-host process bootstrap — the ``init_process`` analogue.
+
+The reference's per-process rendezvous (``train_ffns.py:121-127``) sets
+MASTER_ADDR/PORT and calls ``dist.init_process_group("nccl", rank,
+world_size)``. In SPMD JAX the per-device process model collapses to one
+process per *host*; this module wraps ``jax.distributed.initialize`` with
+the same ergonomics, and exposes the runtime facts the reference's workers
+read from their args.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+DEFAULT_COORDINATOR = "127.0.0.1:29500"  # the reference's addr:port (:123-124)
+
+
+def initialize(coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None) -> None:
+    """Join the multi-host runtime. No-op on a single-process run.
+
+    Arguments fall back to the standard env vars
+    (``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID``)
+    the way the reference fell back to MASTER_ADDR/PORT.
+    """
+    coordinator_address = (coordinator_address
+                           or os.environ.get("JAX_COORDINATOR_ADDRESS"))
+    if num_processes is None:
+        num_processes = int(os.environ.get("JAX_NUM_PROCESSES", "1"))
+    if process_id is None:
+        process_id = int(os.environ.get("JAX_PROCESS_ID", "0"))
+    if num_processes <= 1:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address or DEFAULT_COORDINATOR,
+        num_processes=num_processes, process_id=process_id)
+
+
+def runtime_info() -> dict:
+    """The facts every reference worker carried in its args: rank/world."""
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": jax.device_count(),
+    }

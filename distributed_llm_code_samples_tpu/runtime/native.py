@@ -1,0 +1,266 @@
+"""ctypes bindings + build driver for the native C++ runtime components.
+
+Covers the SURVEY.md section 2.7 native-surface ledger: host ring
+collectives (``collectives.cpp``), prefetching seeded data loader
+(``dataloader.cpp``), TCP rendezvous/barrier (``rendezvous.cpp``), and the
+XLA-FFI custom-call kernels (``ffi_ops.cpp``). Libraries are built on
+demand with the in-tree Makefile (g++ is assumed; there is no wheel step).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Sequence
+
+import numpy as np
+
+from .. import DLOSS_DX_COEF
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_NATIVE_DIR = os.path.join(os.path.dirname(_DIR), "native")
+_LIB = None
+_FFI_LIB = None
+_FFI_REGISTERED = False
+
+
+def _make(target: str, env_extra: dict | None = None) -> None:
+    env = dict(os.environ)
+    if env_extra:
+        env.update(env_extra)
+    proc = subprocess.run(["make", "-C", _NATIVE_DIR, target],
+                          capture_output=True, text=True, env=env)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"native build failed (make {target}):\n{proc.stdout}\n"
+            f"{proc.stderr}")
+
+
+def lib() -> ctypes.CDLL:
+    """The host-runtime library, built on first use."""
+    global _LIB
+    if _LIB is None:
+        # always invoke make: its prerequisite rules rebuild only when the
+        # sources are newer than the .so (stale-binary trap otherwise)
+        path = os.path.join(_NATIVE_DIR, "libdlcs_native.so")
+        _make("all")
+        _LIB = ctypes.CDLL(path)
+        _LIB.dlcs_loader_create.restype = ctypes.c_void_p
+        _LIB.dlcs_loader_create.argtypes = [ctypes.c_int64, ctypes.c_int64,
+                                            ctypes.c_int, ctypes.c_float]
+        _LIB.dlcs_loader_submit.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        _LIB.dlcs_loader_next.restype = ctypes.c_int64
+        _LIB.dlcs_loader_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                          ctypes.c_void_p]
+        _LIB.dlcs_loader_destroy.argtypes = [ctypes.c_void_p]
+        _LIB.dlcs_rdzv_coordinator.restype = ctypes.c_void_p
+        _LIB.dlcs_rdzv_coordinator.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                               ctypes.c_int]
+        _LIB.dlcs_rdzv_join.restype = ctypes.c_void_p
+        _LIB.dlcs_rdzv_join.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        for f in ("dlcs_rdzv_rank", "dlcs_rdzv_world", "dlcs_rdzv_barrier"):
+            getattr(_LIB, f).restype = ctypes.c_int
+            getattr(_LIB, f).argtypes = [ctypes.c_void_p]
+        _LIB.dlcs_rdzv_destroy.argtypes = [ctypes.c_void_p]
+    return _LIB
+
+
+def _float_ptr_array(arrays: Sequence[np.ndarray]):
+    Ptrs = ctypes.POINTER(ctypes.c_float) * len(arrays)
+    return Ptrs(*[a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+                  for a in arrays])
+
+
+def _check_same_size(arrays: Sequence[np.ndarray]) -> None:
+    sizes = {a.size for a in arrays}
+    if len(sizes) != 1:
+        raise ValueError(f"per-rank arrays must have equal sizes, got "
+                         f"{[a.size for a in arrays]}")
+
+
+# ---------------------------------------------------------------- collectives
+
+def all_reduce_sum(arrays: Sequence[np.ndarray]) -> list[np.ndarray]:
+    """Ring all-reduce(SUM) across per-rank float32 arrays (native engine);
+    returns the reduced copies, inputs untouched."""
+    _check_same_size(arrays)
+    bufs = [np.ascontiguousarray(a, dtype=np.float32).copy() for a in arrays]
+    lib().dlcs_all_reduce_sum_f32(_float_ptr_array(bufs), len(bufs),
+                                  ctypes.c_int64(bufs[0].size))
+    return bufs
+
+
+def all_gather(shards: Sequence[np.ndarray]) -> list[np.ndarray]:
+    """Ring all-gather: every rank receives the rank-order concatenation."""
+    _check_same_size(shards)
+    shards = [np.ascontiguousarray(s, dtype=np.float32) for s in shards]
+    n, cnt = len(shards), shards[0].size
+    outs = [np.empty(n * cnt, dtype=np.float32) for _ in range(n)]
+    lib().dlcs_all_gather_f32(_float_ptr_array(shards),
+                              _float_ptr_array(outs), n,
+                              ctypes.c_int64(cnt))
+    return outs
+
+
+def reduce_scatter_sum(full_arrays: Sequence[np.ndarray]) -> list[np.ndarray]:
+    """Ring reduce-scatter(SUM): rank r receives the sum of everyone's
+    r-th shard (arrays must have size divisible by n_ranks)."""
+    _check_same_size(full_arrays)
+    ins = [np.ascontiguousarray(a, dtype=np.float32).ravel()
+           for a in full_arrays]
+    n = len(ins)
+    if ins[0].size % n:
+        raise ValueError(f"array size {ins[0].size} not divisible by {n}")
+    shard = ins[0].size // n
+    outs = [np.empty(shard, dtype=np.float32) for _ in range(n)]
+    lib().dlcs_reduce_scatter_sum_f32(_float_ptr_array(ins),
+                                      _float_ptr_array(outs), n,
+                                      ctypes.c_int64(shard))
+    return outs
+
+
+def ring_permute(arrays: Sequence[np.ndarray], shift: int = 1) -> list[np.ndarray]:
+    """ppermute on a ring: out[(r+shift) % n] = in[r]."""
+    _check_same_size(arrays)
+    ins = [np.ascontiguousarray(a, dtype=np.float32) for a in arrays]
+    outs = [np.empty_like(a) for a in ins]
+    lib().dlcs_ring_permute_f32(_float_ptr_array(ins), _float_ptr_array(outs),
+                                len(ins), ctypes.c_int64(ins[0].size),
+                                ctypes.c_int(shift))
+    return outs
+
+
+# ---------------------------------------------------------------- data loader
+
+class NativeLoader:
+    """Prefetching native data loader (see ``dataloader.cpp``).
+
+    Usage::
+
+        with NativeLoader(batch, d) as loader:
+            loader.submit_all(seeds)
+            for _ in seeds:
+                seed, x, dloss_dx = loader.next()
+    """
+
+    def __init__(self, batch: int, d: int, n_threads: int = 2,
+                 dloss_coef: float = DLOSS_DX_COEF):
+        self.batch, self.d = batch, d
+        self._h = lib().dlcs_loader_create(batch, d, n_threads,
+                                           ctypes.c_float(dloss_coef))
+
+    def submit(self, seed: int) -> None:
+        lib().dlcs_loader_submit(self._h, int(seed))
+
+    def submit_all(self, seeds) -> None:
+        for s in np.asarray(seeds).tolist():
+            self.submit(s)
+
+    def next(self):
+        x = np.empty((self.batch, self.d), dtype=np.float32)
+        dl = np.empty((self.batch, self.d), dtype=np.float32)
+        seed = lib().dlcs_loader_next(
+            self._h, x.ctypes.data_as(ctypes.c_void_p),
+            dl.ctypes.data_as(ctypes.c_void_p))
+        if seed < 0:
+            raise RuntimeError("loader.next() called more times than "
+                               "batches were submitted")
+        return seed, x, dl
+
+    def close(self) -> None:
+        if self._h:
+            lib().dlcs_loader_destroy(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+# ----------------------------------------------------------------- rendezvous
+
+class Rendezvous:
+    """TCP rendezvous + barrier (MASTER_ADDR/PORT analogue, rendezvous.cpp)."""
+
+    def __init__(self, addr: str, port: int, world_size: int | None = None,
+                 coordinator: bool = False):
+        if coordinator:
+            if world_size is None:
+                raise ValueError("coordinator needs world_size")
+            self._h = lib().dlcs_rdzv_coordinator(addr.encode(), port,
+                                                  world_size)
+        else:
+            self._h = lib().dlcs_rdzv_join(addr.encode(), port)
+        if not self._h:
+            raise RuntimeError("rendezvous failed")
+
+    @property
+    def rank(self) -> int:
+        return lib().dlcs_rdzv_rank(self._h)
+
+    @property
+    def world_size(self) -> int:
+        return lib().dlcs_rdzv_world(self._h)
+
+    def barrier(self) -> None:
+        if lib().dlcs_rdzv_barrier(self._h) != 0:
+            raise RuntimeError("barrier failed")
+
+    def close(self) -> None:
+        if self._h:
+            lib().dlcs_rdzv_destroy(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+# -------------------------------------------------------- XLA FFI custom ops
+
+def register_ffi_targets() -> None:
+    """Build + register the native XLA custom calls on the CPU platform."""
+    global _FFI_LIB, _FFI_REGISTERED
+    if _FFI_REGISTERED:
+        return
+    import jax
+    import jax.ffi
+
+    path = os.path.join(_NATIVE_DIR, "libdlcs_ffi.so")
+    _make("ffi", {"JAXLIB_INCLUDE": jax.ffi.include_dir()})
+    _FFI_LIB = ctypes.CDLL(path)
+    jax.ffi.register_ffi_target(
+        "dlcs_fused_sgd", jax.ffi.pycapsule(_FFI_LIB.DlcsFusedSgd),
+        platform="cpu")
+    jax.ffi.register_ffi_target(
+        "dlcs_relu_bwd", jax.ffi.pycapsule(_FFI_LIB.DlcsReluBwd),
+        platform="cpu")
+    _FFI_REGISTERED = True
+
+
+def fused_sgd(p, g, lr: float):
+    """``p - lr * g`` as a native XLA custom call (CPU platform)."""
+    import jax
+    import jax.ffi
+    import jax.numpy as jnp
+
+    register_ffi_targets()
+    call = jax.ffi.ffi_call("dlcs_fused_sgd",
+                            jax.ShapeDtypeStruct(p.shape, p.dtype))
+    return call(p, g, jnp.asarray(lr, dtype=jnp.float32))
+
+
+def native_relu_bwd(dy, x):
+    """``where(x <= 0, 0, dy)`` as a native XLA custom call (CPU platform)."""
+    import jax
+    import jax.ffi
+
+    register_ffi_targets()
+    call = jax.ffi.ffi_call("dlcs_relu_bwd",
+                            jax.ShapeDtypeStruct(dy.shape, dy.dtype))
+    return call(dy, x)
