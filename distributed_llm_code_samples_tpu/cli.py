@@ -45,6 +45,9 @@ def build_parser() -> argparse.ArgumentParser:
                    default="float32")
     p.add_argument("--scan", action="store_true",
                    help="lax.scan over layers instead of unrolling")
+    p.add_argument("--pallas", action="store_true",
+                   help="use the fused Pallas FFN kernels for the "
+                        "single-device method (interpret mode off-TPU)")
     p.add_argument("--strict", action="store_true",
                    help="make the cross-strategy verification hard-failing "
                         "(the reference only soft-asserts, :386-391)")
@@ -115,6 +118,9 @@ def main(argv=None) -> int:
         name, fn = STRATEGIES[m]
         mesh = mesh_for(m)
         kwargs = dict(lr=lr, unroll=unroll)
+        if m == 1 and args.pallas:
+            kwargs["use_pallas"] = True
+            kwargs["interpret"] = jax.default_backend() != "tpu"
         if mesh is not None:
             kwargs["mesh"] = mesh
         t0 = time.time()
@@ -131,13 +137,16 @@ def main(argv=None) -> int:
     failed = False
     if args.method == 0:
         # the reference compares DDP vs FSDP (:386-391); we also pin TP to
-        # the single-device oracle (same data schedule).
+        # the single-device oracle (same data schedule). The Pallas kernels'
+        # tiled f32 accumulation order differs from plain XLA, so loosen
+        # the tolerance when they computed method 1.
+        rtol, atol = (1e-4, 1e-5) if args.pallas else (1e-5, 1e-7)
         checks = [("ddp", "fsdp", results[2], results[3]),
                   ("1dev", "tp", results[1], results[4])]
         for la, lb, a, b in checks:
             for side, pa, pb in (("[0]", a.w1, b.w1), ("[1]", a.w2, b.w2)):
                 if not np.allclose(np.asarray(pa), np.asarray(pb),
-                                   rtol=1e-5, atol=1e-7):
+                                   rtol=rtol, atol=atol):
                     print(f"SoftAssertionError: {la}{side} vs {lb}{side} "
                           f"max|diff|="
                           f"{np.abs(np.asarray(pa) - np.asarray(pb)).max()}")

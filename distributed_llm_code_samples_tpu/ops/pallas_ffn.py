@@ -1,0 +1,224 @@
+"""Fused Pallas TPU kernels for the FFN block — the hot-op path.
+
+The FFN sublayer ``y = relu(x @ w1.T) @ w2.T`` decomposes exactly over the
+ffn dimension: ``y = sum_k relu(x @ w1_k.T) @ w2_k.T`` (ReLU is elementwise,
+so each ffn slice is independent). These kernels exploit that to fuse the
+whole block: the ``[tokens, ffn]`` hidden activation never round-trips to
+HBM — it lives tile-by-tile in VMEM between the two MXU contractions. The
+plain-XLA path (``ops.ffn``) keeps the same math; these kernels are the
+hand-scheduled equivalent (the role CUDA kernels played underneath the
+reference's torch ops, here first-party).
+
+Three kernels mirror the hand-written VJP structure (``train_ffns.py:54-70``):
+
+- ``ffn_fwd_pallas``    — fused fwd; grid (token tiles x ffn tiles), ffn as
+  the reduction axis, f32 VMEM accumulator.
+- ``ffn_bwd_dx_pallas`` — input grad with pre-activation *recompute* (the
+  block checkpoints only its input, ``train_ffns.py:63``); reduces over ffn.
+- ``ffn_bwd_dw_pallas`` — both weight grads; reduces over token tiles.
+
+``pallas_ffn_block`` wires them into ``jax.custom_vjp`` so the kernels ARE
+the differentiation rule, exactly like ``ops.ffn.ffn_block``. All kernels
+run under ``interpret=True`` on CPU for the hardware-free test suite.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _pick_block(size: int, preferred: int, quantum: int) -> int:
+    """Largest divisor of ``size`` that is <= preferred and a multiple of
+    ``quantum`` (falls back to ``size`` itself for tiny shapes)."""
+    best = None
+    b = quantum
+    while b <= min(size, preferred):
+        if size % b == 0:
+            best = b
+        b += quantum
+    return best if best is not None else size
+
+# f32 min sublane tile is 8; lanes are 128 (guide: Tiling Constraints)
+_TOKEN_QUANTUM = 8
+_FFN_QUANTUM = 128
+
+
+def _fwd_kernel(x_ref, w1_ref, w2_ref, y_ref, acc_ref):
+    k = pl.program_id(1)
+
+    @pl.when(k == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    h = jnp.dot(x_ref[:], w1_ref[:].T, preferred_element_type=jnp.float32)
+    a = jnp.maximum(h, 0.0).astype(x_ref.dtype)
+    acc_ref[:] += jnp.dot(a, w2_ref[:].T, preferred_element_type=jnp.float32)
+
+    @pl.when(k == pl.num_programs(1) - 1)
+    def _():
+        y_ref[:] = acc_ref[:].astype(y_ref.dtype)
+
+
+def ffn_fwd_pallas(w1: jax.Array, w2: jax.Array, x: jax.Array, *,
+                   block_t: int = 256, block_f: int = 512,
+                   interpret: bool = False) -> jax.Array:
+    """Fused linear->ReLU->linear forward. ``w1 [ffn, d]``, ``w2 [d, ffn]``,
+    ``x [T, d]`` -> ``[T, d]``; hidden tiles stay in VMEM."""
+    T, d = x.shape
+    ffn = w1.shape[0]
+    bt = _pick_block(T, block_t, _TOKEN_QUANTUM)
+    bf = _pick_block(ffn, block_f, _FFN_QUANTUM)
+    grid = (T // bt, ffn // bf)
+    return pl.pallas_call(
+        _fwd_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bt, d), lambda i, k: (i, 0)),   # x tile
+            pl.BlockSpec((bf, d), lambda i, k: (k, 0)),   # w1 ffn-slice
+            pl.BlockSpec((d, bf), lambda i, k: (0, k)),   # w2 ffn-slice
+        ],
+        out_specs=pl.BlockSpec((bt, d), lambda i, k: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((T, d), x.dtype),
+        scratch_shapes=[pltpu.VMEM((bt, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * T * d * ffn,
+            bytes_accessed=(T * d + 2 * d * ffn + T * d) * x.dtype.itemsize,
+            transcendentals=0),
+        interpret=interpret,
+    )(x, w1, w2)
+
+
+def _bwd_dx_kernel(x_ref, dy_ref, w1_ref, w2_ref, dx_ref, acc_ref):
+    k = pl.program_id(1)
+
+    @pl.when(k == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # recompute the pre-activation slice (checkpoint-block-inputs-only)
+    h = jnp.dot(x_ref[:], w1_ref[:].T, preferred_element_type=jnp.float32)
+    da = jnp.dot(dy_ref[:], w2_ref[:], preferred_element_type=jnp.float32)
+    dh = jnp.where(h <= 0.0, 0.0, da).astype(x_ref.dtype)
+    acc_ref[:] += jnp.dot(dh, w1_ref[:], preferred_element_type=jnp.float32)
+
+    @pl.when(k == pl.num_programs(1) - 1)
+    def _():
+        dx_ref[:] = acc_ref[:].astype(dx_ref.dtype)
+
+
+def ffn_bwd_dx_pallas(dy: jax.Array, w1: jax.Array, w2: jax.Array,
+                      x: jax.Array, *, block_t: int = 256,
+                      block_f: int = 512,
+                      interpret: bool = False) -> jax.Array:
+    """Input gradient ``dx = (relu'(x w1^T) * (dy w2)) w1`` fused."""
+    T, d = x.shape
+    ffn = w1.shape[0]
+    bt = _pick_block(T, block_t, _TOKEN_QUANTUM)
+    bf = _pick_block(ffn, block_f, _FFN_QUANTUM)
+    grid = (T // bt, ffn // bf)
+    return pl.pallas_call(
+        _bwd_dx_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bt, d), lambda i, k: (i, 0)),   # x tile
+            pl.BlockSpec((bt, d), lambda i, k: (i, 0)),   # dy tile
+            pl.BlockSpec((bf, d), lambda i, k: (k, 0)),   # w1 slice
+            pl.BlockSpec((d, bf), lambda i, k: (0, k)),   # w2 slice
+        ],
+        out_specs=pl.BlockSpec((bt, d), lambda i, k: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((T, d), x.dtype),
+        scratch_shapes=[pltpu.VMEM((bt, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(x, dy, w1, w2)
+
+
+def _bwd_dw_kernel(x_ref, dy_ref, w1_ref, w2_ref, dw1_ref, dw2_ref,
+                   acc1_ref, acc2_ref):
+    t = pl.program_id(1)
+
+    @pl.when(t == 0)
+    def _():
+        acc1_ref[:] = jnp.zeros_like(acc1_ref)
+        acc2_ref[:] = jnp.zeros_like(acc2_ref)
+
+    h = jnp.dot(x_ref[:], w1_ref[:].T, preferred_element_type=jnp.float32)
+    a = jnp.maximum(h, 0.0).astype(x_ref.dtype)
+    da = jnp.dot(dy_ref[:], w2_ref[:], preferred_element_type=jnp.float32)
+    dh = jnp.where(h <= 0.0, 0.0, da).astype(x_ref.dtype)
+    # dw1 slice [bf, d] = dh^T x ; dw2 slice [d, bf] = dy^T a
+    acc1_ref[:] += jnp.dot(dh.T, x_ref[:], preferred_element_type=jnp.float32)
+    acc2_ref[:] += jnp.dot(dy_ref[:].T, a, preferred_element_type=jnp.float32)
+
+    @pl.when(t == pl.num_programs(1) - 1)
+    def _():
+        dw1_ref[:] = acc1_ref[:].astype(dw1_ref.dtype)
+        dw2_ref[:] = acc2_ref[:].astype(dw2_ref.dtype)
+
+
+def ffn_bwd_dw_pallas(dy: jax.Array, w1: jax.Array, w2: jax.Array,
+                      x: jax.Array, *, block_t: int = 256,
+                      block_f: int = 512, interpret: bool = False):
+    """Both weight gradients, fused, reducing over token tiles:
+    ``dw1 = (relu'(h) * (dy w2))^T x``, ``dw2 = dy^T relu(h)``."""
+    T, d = x.shape
+    ffn = w1.shape[0]
+    bt = _pick_block(T, block_t, _TOKEN_QUANTUM)
+    bf = _pick_block(ffn, block_f, _FFN_QUANTUM)
+    grid = (ffn // bf, T // bt)  # token axis is the reduction
+    return pl.pallas_call(
+        _bwd_dw_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bt, d), lambda j, t: (t, 0)),   # x tile
+            pl.BlockSpec((bt, d), lambda j, t: (t, 0)),   # dy tile
+            pl.BlockSpec((bf, d), lambda j, t: (j, 0)),   # w1 slice
+            pl.BlockSpec((d, bf), lambda j, t: (0, j)),   # w2 slice
+        ],
+        out_specs=[
+            pl.BlockSpec((bf, d), lambda j, t: (j, 0)),   # dw1 slice
+            pl.BlockSpec((d, bf), lambda j, t: (0, j)),   # dw2 slice
+        ],
+        out_shape=[jax.ShapeDtypeStruct(w1.shape, w1.dtype),
+                   jax.ShapeDtypeStruct(w2.shape, w2.dtype)],
+        scratch_shapes=[pltpu.VMEM((bf, d), jnp.float32),
+                        pltpu.VMEM((d, bf), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(x, dy, w1, w2)
+
+
+def ffn_bwd_pallas(dy, w1, w2, x, *, interpret: bool = False):
+    """Full-block VJP from the fused kernels — same signature as
+    ``ops.ffn.ffn_bwd``: returns ``(dx, (dw1, dw2))``."""
+    dx = ffn_bwd_dx_pallas(dy, w1, w2, x, interpret=interpret)
+    dw1, dw2 = ffn_bwd_dw_pallas(dy, w1, w2, x, interpret=interpret)
+    return dx, (dw1, dw2)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def pallas_ffn_block(w1, w2, x, interpret=False):
+    """FFN block computed by the fused kernels, differentiated by them too."""
+    return ffn_fwd_pallas(w1, w2, x, interpret=interpret)
+
+
+def _block_fwd(w1, w2, x, interpret):
+    return ffn_fwd_pallas(w1, w2, x, interpret=interpret), (w1, w2, x)
+
+
+def _block_bwd(interpret, res, dy):
+    w1, w2, x = res
+    dx, (dw1, dw2) = ffn_bwd_pallas(dy, w1, w2, x, interpret=interpret)
+    return dw1, dw2, dx
+
+
+pallas_ffn_block.defvjp(_block_fwd, _block_bwd)

@@ -18,34 +18,52 @@ from .. import LR
 from ..data import batch_from_seed
 from ..models.ffn_stack import FFNStackParams, clone_params
 from ..optim import sgd
+from ..ops.ffn import ffn_fwd, ffn_bwd
 from ..ops.stack import stack_fwd, stack_bwd
 
 
 def make_step(batch_size: int, model_size: int, lr: float = LR,
-              unroll: bool = True):
+              unroll: bool = True, use_pallas: bool = False,
+              interpret: bool = False):
     """Build one training step ``(params, seed) -> params`` — forward,
-    manual backward, inline SGD (``train_ffns.py:105-114``)."""
+    manual backward, inline SGD (``train_ffns.py:105-114``).
+
+    ``use_pallas`` swaps the per-block compute for the fused Pallas TPU
+    kernels (``ops.pallas_ffn``); ``interpret`` runs them in interpreter
+    mode for CPU testing."""
+    if use_pallas:
+        from ..ops.pallas_ffn import ffn_fwd_pallas, ffn_bwd_pallas
+        block_fwd = lambda w1, w2, x: ffn_fwd_pallas(  # noqa: E731
+            w1, w2, x, interpret=interpret)
+        block_bwd = lambda dy, w1, w2, x: ffn_bwd_pallas(  # noqa: E731
+            dy, w1, w2, x, interpret=interpret)
+    else:
+        block_fwd, block_bwd = ffn_fwd, ffn_bwd
 
     def step(params: FFNStackParams, seed) -> FFNStackParams:
         x, dloss_dx = batch_from_seed(seed, batch_size, model_size,
                                       params.w1.dtype)
-        _, acts = stack_fwd(params.w1, params.w2, x, unroll=unroll)
+        _, acts = stack_fwd(params.w1, params.w2, x, block_fwd=block_fwd,
+                            unroll=unroll)
         _, (g1, g2) = stack_bwd(dloss_dx, params.w1, params.w2, acts,
-                                unroll=unroll)
+                                block_bwd=block_bwd, unroll=unroll)
         return sgd(params, FFNStackParams(g1, g2), lr)
 
     return step
 
 
-@partial(jax.jit, static_argnums=(2, 3, 4, 5), donate_argnums=0)
-def _run(params, seeds, batch_size, model_size, lr, unroll):
-    step = make_step(batch_size, model_size, lr, unroll)
+@partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7), donate_argnums=0)
+def _run(params, seeds, batch_size, model_size, lr, unroll, use_pallas,
+         interpret):
+    step = make_step(batch_size, model_size, lr, unroll, use_pallas,
+                     interpret)
     return lax.scan(lambda p, s: (step(p, s), None), params, seeds)[0]
 
 
 def train_single(params: FFNStackParams, seeds, batch_size: int,
                  model_size: int, mesh=None, lr: float = LR,
-                 unroll: bool = True) -> FFNStackParams:
+                 unroll: bool = True, use_pallas: bool = False,
+                 interpret: bool = False) -> FFNStackParams:
     """Uniform launcher signature (SURVEY.md L4); ``mesh`` ignored."""
     return _run(clone_params(params), jnp.asarray(seeds), batch_size,
-                model_size, lr, unroll)
+                model_size, lr, unroll, use_pallas, interpret)
