@@ -3,6 +3,7 @@ entire model surface); attention/long-context extensions live here too."""
 
 from .ffn_stack import (FFNStackParams, init_ffn_stack, clone_params,
                         params_size_gb)
+from .attention import attention, mha
 
 __all__ = ["FFNStackParams", "init_ffn_stack", "clone_params",
-           "params_size_gb"]
+           "params_size_gb", "attention", "mha"]

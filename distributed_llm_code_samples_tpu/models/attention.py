@@ -1,0 +1,89 @@
+"""Hand-written scaled-dot-product attention (single-device oracle).
+
+The reference has **no attention at all** — FFN sublayers only
+(``README.md:6``; SURVEY.md section 5 "long-context: absent"). Long-context
+support is a first-class extension of this framework, so the model family
+grows an attention op built in the same first-principles style as the FFN
+core: forward written out, backward derived by hand and installed as the
+``custom_vjp`` rule.
+
+Shapes are single-head ``[T, d]``; multi-head is ``jax.vmap`` over a heads
+axis (kept out of the op to keep the math readable). The distributed
+sequence-parallel form (ring attention over ``ppermute``) lives in
+``parallel.sequence``; this module is its correctness oracle.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def causal_mask(Tq: int, Tk: int, q_offset: int = 0, k_offset: int = 0):
+    """True where query position may attend key position (q_pos >= k_pos).
+
+    Offsets give the *global* positions of the local blocks — the thing a
+    sequence-sharded ring step needs (``parallel.sequence``)."""
+    q_pos = q_offset + jnp.arange(Tq)[:, None]
+    k_pos = k_offset + jnp.arange(Tk)[None, :]
+    return q_pos >= k_pos
+
+
+def attn_fwd(q: jax.Array, k: jax.Array, v: jax.Array,
+             causal: bool = True):
+    """Softmax attention forward; returns ``(y, (p,))`` with the probability
+    matrix saved for the manual backward."""
+    d = q.shape[-1]
+    s = (q @ k.T) / jnp.sqrt(jnp.asarray(d, q.dtype))
+    if causal:
+        s = jnp.where(causal_mask(q.shape[0], k.shape[0]), s,
+                      jnp.asarray(-jnp.inf, s.dtype))
+    p = jax.nn.softmax(s, axis=-1)
+    return p @ v, (p,)
+
+
+def attn_bwd(dy: jax.Array, q, k, v, p, causal: bool = True):
+    """Manual attention VJP.
+
+    With ``y = p v``, ``p = softmax(s)``, ``s = q k^T / sqrt(d)``:
+    ``dv = p^T dy``; ``dp = dy v^T``;
+    ``ds = p * (dp - rowsum(dp * p))`` (softmax VJP);
+    ``dq = ds k / sqrt(d)``; ``dk = ds^T q / sqrt(d)``.
+    """
+    d = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, q.dtype))
+    dv = p.T @ dy
+    dp = dy @ v.T
+    ds = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))
+    dq = (ds @ k) * scale
+    dk = (ds.T @ q) * scale
+    return dq, dk, dv
+
+
+@jax.custom_vjp
+def attention(q: jax.Array, k: jax.Array, v: jax.Array,
+              causal: bool = True) -> jax.Array:
+    """Attention whose differentiation rule is the hand-written VJP."""
+    y, _ = attn_fwd(q, k, v, causal)
+    return y
+
+
+def _attention_fwd(q, k, v, causal):
+    y, (p,) = attn_fwd(q, k, v, causal)
+    return y, (q, k, v, p, causal)
+
+
+def _attention_bwd(res, dy):
+    q, k, v, p, causal = res
+    dq, dk, dv = attn_bwd(dy, q, k, v, p, causal)
+    return dq, dk, dv, None
+
+
+attention.defvjp(_attention_fwd, _attention_bwd)
+
+
+def mha(q: jax.Array, k: jax.Array, v: jax.Array,
+        causal: bool = True) -> jax.Array:
+    """Multi-head convenience: vmap ``attention`` over a leading heads axis
+    (``[H, T, d] -> [H, T, d]``)."""
+    return jax.vmap(lambda q, k, v: attention(q, k, v, causal))(q, k, v)

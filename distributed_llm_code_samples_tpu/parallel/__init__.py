@@ -14,6 +14,7 @@ from .ddp import train_ddp
 from .fsdp import train_fsdp
 from .tp import train_tp
 from .hybrid import train_hybrid
+from .sequence import ring_attention, sequence_parallel_attention
 
 # Method-number parity with the reference CLI (train_ffns.py:6, :373):
 # 1=single, 2=DDP, 3=FSDP, 4=TP; 5 extends with the hybrid mesh.
@@ -30,5 +31,6 @@ __all__ = [
     "DATA_AXIS", "MODEL_AXIS", "SEQ_AXIS",
     "collectives",
     "train_single", "train_ddp", "train_fsdp", "train_tp", "train_hybrid",
+    "ring_attention", "sequence_parallel_attention",
     "STRATEGIES",
 ]

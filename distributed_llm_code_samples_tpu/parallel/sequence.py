@@ -1,0 +1,103 @@
+"""Sequence/context parallelism: ring attention over ``ppermute``.
+
+Long sequences are sharded across the ``"seq"`` mesh axis. For the FFN
+stack this is free (token-pointwise math — the reference already folds
+sequence into batch, ``train_ffns.py:379``); attention is where sequence
+parallelism earns its name: every query block must see every key/value
+block without any device materializing the full sequence.
+
+**Ring attention**: each shard keeps its Q block resident and its KV block
+rotating. At step ``i`` a shard holds the KV block of shard
+``(rank - i) mod n``, folds it into a running flash-style online softmax
+(running row-max ``m``, denominator ``l``, numerator ``acc``), then passes
+the KV block to its ring successor via ``ppermute`` — n steps, n-1 hops,
+peak memory O(T/n * T/n) per shard. Causality uses *global* positions
+(block offsets), so shards skip blocks entirely in their masked direction.
+XLA schedules each hop's ``collective-permute`` asynchronously against the
+block compute — compute/comm overlap on the ICI ring with no handles.
+
+The backward pass is JAX-transposed through the loop (the transpose of
+``ppermute`` is the reverse permute); a hand-scheduled Pallas ring kernel
+is the planned next step of this path (see ``pallas_guide.md`` "Ring
+Collectives").
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..models.attention import causal_mask
+from .mesh import SEQ_AXIS, require_axes
+
+_NEG = -1e30  # finite -inf stand-in: keeps the online-softmax updates NaN-free
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str = SEQ_AXIS, causal: bool = True):
+    """Ring attention for one shard (call under ``shard_map``).
+
+    ``q, k, v: [T_local, d]`` — this shard's sequence block. Returns the
+    ``[T_local, d]`` attention output as if computed over the full
+    sequence.
+    """
+    n = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    t_local, d = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(i, carry):
+        k_blk, v_blk, m, l, acc = carry
+        src = (rank - i) % n  # whose KV block we hold at this step
+        s = (q @ k_blk.T).astype(jnp.float32) * scale  # [T, T] scores
+        if causal:
+            # global positions: this shard's Q block vs the held KV block
+            allowed = causal_mask(t_local, t_local, rank * t_local,
+                                  src * t_local)
+            s = jnp.where(allowed, s, _NEG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m - m_new)          # rescale old accumulator
+        p = jnp.exp(s - m_new[:, None])     # [T, T]
+        l = l * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[:, None] + p @ v_blk.astype(jnp.float32)
+        # pass the KV block around the ring for the next step
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return k_blk, v_blk, m_new, l, acc
+
+    # mark the accumulators shard-varying so the fori_loop carry typechecks
+    # under shard_map's varying-manual-axes analysis
+    m0 = lax.pvary(jnp.full((t_local,), _NEG, jnp.float32), axis_name)
+    l0 = lax.pvary(jnp.zeros((t_local,), jnp.float32), axis_name)
+    acc0 = lax.pvary(jnp.zeros((t_local, d), jnp.float32), axis_name)
+    *_, m, l, acc = lax.fori_loop(0, n, step, (k, v, m0, l0, acc0))
+    return (acc / l[:, None]).astype(q.dtype)
+
+
+def sequence_parallel_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                                mesh, causal: bool = True) -> jax.Array:
+    """Launcher: shard ``[T, d]`` tensors over the ``"seq"`` axis, run ring
+    attention, return the global result (sharded along the same axis)."""
+    require_axes(mesh, SEQ_AXIS)
+    n = mesh.shape[SEQ_AXIS]
+    if q.shape[0] % n:
+        raise ValueError(f"sequence length {q.shape[0]} not divisible by "
+                         f"{n} seq shards")
+    spec = P(SEQ_AXIS, None)
+    sharded = [jax.device_put(t, NamedSharding(mesh, spec)) for t in (q, k, v)]
+    return _ring_fn(mesh, causal)(*sharded)
+
+
+@functools.lru_cache(maxsize=32)
+def _ring_fn(mesh, causal: bool):
+    """Cached jitted ring program per (mesh, causal) so repeat calls hit
+    the jit cache instead of retracing."""
+    spec = P(SEQ_AXIS, None)
+    return jax.jit(jax.shard_map(
+        functools.partial(ring_attention, axis_name=SEQ_AXIS, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec))

@@ -1,0 +1,116 @@
+"""Attention + sequence-parallel (ring attention) tests — the long-context
+extension (absent from the reference, SURVEY.md section 5). Oracles: plain
+softmax attention + jax autograd."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llm_code_samples_tpu.models.attention import (
+    attention, attn_fwd, attn_bwd, mha, causal_mask)
+from distributed_llm_code_samples_tpu.parallel import make_mesh, SEQ_AXIS
+from distributed_llm_code_samples_tpu.parallel.sequence import (
+    ring_attention, sequence_parallel_attention)
+
+T, D = 64, 16
+
+
+@pytest.fixture(scope="module")
+def qkv():
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+    return (jax.random.normal(k1, (T, D)), jax.random.normal(k2, (T, D)),
+            jax.random.normal(k3, (T, D)))
+
+
+def _plain(q, k, v, causal):
+    s = (q @ k.T) / jnp.sqrt(jnp.asarray(D, q.dtype))
+    if causal:
+        s = jnp.where(causal_mask(T, T), s, -jnp.inf)
+    return jax.nn.softmax(s, -1) @ v
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_attn_fwd_matches_plain(qkv, causal):
+    q, k, v = qkv
+    y, _ = attn_fwd(q, k, v, causal)
+    np.testing.assert_allclose(y, _plain(q, k, v, causal), rtol=1e-5,
+                               atol=1e-6)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_attn_bwd_matches_autograd(qkv, causal):
+    q, k, v = qkv
+    dy = jax.random.normal(jax.random.PRNGKey(9), (T, D))
+    _, vjp = jax.vjp(lambda q, k, v: _plain(q, k, v, causal), q, k, v)
+    dq_r, dk_r, dv_r = vjp(dy)
+    _, (p,) = attn_fwd(q, k, v, causal)
+    dq, dk, dv = attn_bwd(dy, q, k, v, p, causal)
+    np.testing.assert_allclose(dq, dq_r, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(dk, dk_r, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(dv, dv_r, rtol=1e-5, atol=1e-6)
+
+
+def test_custom_vjp_installs_manual_rule(qkv):
+    q, k, v = qkv
+    dy = jax.random.normal(jax.random.PRNGKey(3), (T, D))
+    _, vjp_ref = jax.vjp(lambda q, k, v: _plain(q, k, v, True), q, k, v)
+    _, vjp_man = jax.vjp(lambda q, k, v: attention(q, k, v, True), q, k, v)
+    for a, b in zip(vjp_man(dy), vjp_ref(dy)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_mha_vmaps_over_heads():
+    H = 4
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = jax.random.normal(k1, (H, T, D))
+    k = jax.random.normal(k2, (H, T, D))
+    v = jax.random.normal(k3, (H, T, D))
+    y = mha(q, k, v, True)
+    assert y.shape == (H, T, D)
+    for h in range(H):
+        np.testing.assert_allclose(y[h], _plain(q[h], k[h], v[h], True),
+                                   rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_matches_oracle(qkv, causal):
+    q, k, v = qkv
+    mesh = make_mesh({SEQ_AXIS: 8})
+    y = sequence_parallel_attention(q, k, v, mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(y), _plain(q, k, v, causal),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_ring_attention_4_shards(qkv):
+    q, k, v = qkv
+    mesh = make_mesh({SEQ_AXIS: 4})
+    y = sequence_parallel_attention(q, k, v, mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(y), _plain(q, k, v, True),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_ring_attention_grad_flows(qkv):
+    # autograd transposes the ring (ppermute transpose = reverse permute)
+    from jax.sharding import PartitionSpec as P
+    q, k, v = qkv
+    mesh = make_mesh({SEQ_AXIS: 4})
+    spec = P(SEQ_AXIS, None)
+
+    def loss(q, k, v):
+        f = jax.shard_map(lambda q, k, v: ring_attention(q, k, v, SEQ_AXIS),
+                          mesh=mesh, in_specs=(spec, spec, spec),
+                          out_specs=spec)
+        return jnp.sum(f(q, k, v) ** 2)
+
+    g_ring = jax.grad(loss)(q, k, v)
+    g_ref = jax.grad(lambda q, k, v: jnp.sum(_plain(q, k, v, True) ** 2))(
+        q, k, v)
+    np.testing.assert_allclose(g_ring, g_ref, rtol=1e-4, atol=1e-5)
+
+
+def test_sequence_parallel_rejects_indivisible(qkv):
+    q, k, v = qkv
+    mesh = make_mesh({SEQ_AXIS: 8})
+    with pytest.raises(ValueError):
+        sequence_parallel_attention(q[:60], k[:60], v[:60], mesh)
