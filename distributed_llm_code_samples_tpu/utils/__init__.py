@@ -1,0 +1,14 @@
+"""Cross-cutting utilities: profiling/tracing, HLO comms introspection,
+per-device memory accounting."""
+
+from .profiling import trace, profile_rank_0, timed
+from .hlo import (lowered_text, count_collectives, compiled_text,
+                  async_collective_pairs, COLLECTIVE_OPS)
+from .memory import compiled_memory, params_bytes_per_device
+
+__all__ = [
+    "trace", "profile_rank_0", "timed",
+    "lowered_text", "count_collectives", "compiled_text",
+    "async_collective_pairs", "COLLECTIVE_OPS",
+    "compiled_memory", "params_bytes_per_device",
+]

@@ -1,0 +1,50 @@
+"""HLO introspection: verify that the hand-rolled communication is exactly
+what we wrote.
+
+The reference's pedagogical point is *which* collectives fire *where*
+(per-layer async all-reduce in DDP, gather/scatter pairs in FSDP, one
+all-reduce per direction in TP). On TPU the program is compiled, so the
+ground truth is the lowered IR: these helpers count collective ops in a
+jitted function's StableHLO so tests can pin the communication schedule —
+the comms-count analogue of the reference's printed-handle discipline. The
+optimized-HLO variants detect the async ``-start``/``-done`` split that
+realizes compute/comm overlap (the role of ``async_op=True`` +
+``handle.wait()``, ``train_ffns.py:165-170``; overlap the reference never
+achieved for reduce-scatter, ``:14``).
+"""
+
+from __future__ import annotations
+
+import re
+from collections import Counter
+
+import jax
+
+# StableHLO op names for the collectives we hand-roll
+COLLECTIVE_OPS = ("all_reduce", "all_gather", "reduce_scatter",
+                  "collective_permute")
+
+
+def lowered_text(fn, *args, **kwargs) -> str:
+    """StableHLO of ``fn`` lowered (pre-optimization) for the given args."""
+    return jax.jit(fn).lower(*args, **kwargs).as_text()
+
+
+def count_collectives(fn, *args, **kwargs) -> Counter:
+    """Occurrences of each collective op in the lowered StableHLO."""
+    text = lowered_text(fn, *args, **kwargs)
+    return Counter({op: len(re.findall(rf"stablehlo\.{op}\b|\"{op}", text))
+                    for op in COLLECTIVE_OPS})
+
+
+def compiled_text(fn, *args, **kwargs) -> str:
+    """Optimized backend HLO (post-XLA-passes)."""
+    return jax.jit(fn).lower(*args, **kwargs).compile().as_text()
+
+
+def async_collective_pairs(fn, *args, **kwargs) -> Counter:
+    """Counts of async ``<op>-start`` ops in the optimized HLO — nonzero
+    means XLA split the collective for compute/comm overlap."""
+    text = compiled_text(fn, *args, **kwargs)
+    return Counter({op: len(re.findall(rf"{op.replace('_', '-')}-start", text))
+                    for op in COLLECTIVE_OPS})

@@ -1,0 +1,47 @@
+"""Per-device memory accounting — the sharding-actually-shards check.
+
+The reference's only memory tooling is a param-count/GB printout
+(``train_ffns.py:363-366``) plus a falsifiable capability demo: a ~4.3B
+fp32 model must OOM under DDP and train under FSDP (``README.md``,
+``train_ffns.py:8-10``). On TPU the compiler knows the per-device
+footprint *before* running: these helpers read the compiled memory
+analysis so the DDP-vs-FSDP capability claim becomes a unit test instead
+of a 4-GPU OOM experiment (v5e budget: 16 GB HBM/chip).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+
+def compiled_memory(fn, *args, **kwargs) -> dict[str, Any] | None:
+    """Compiled memory analysis (bytes, per device) of jitted ``fn``.
+
+    Returns None when the backend doesn't implement memory analysis.
+    """
+    compiled = jax.jit(fn).lower(*args, **kwargs).compile()
+    try:
+        m = compiled.memory_analysis()
+    except Exception:
+        return None
+    if m is None:
+        return None
+    return {
+        "argument_bytes": m.argument_size_in_bytes,
+        "output_bytes": m.output_size_in_bytes,
+        "temp_bytes": m.temp_size_in_bytes,
+        "peak_bytes": getattr(m, "peak_memory_in_bytes", None),
+    }
+
+
+def params_bytes_per_device(params) -> int:
+    """Actual bytes this process's devices hold for a (possibly sharded)
+    param pytree, using the largest per-device sum across devices."""
+    per_device: dict[Any, int] = {}
+    for leaf in jax.tree_util.tree_leaves(params):
+        for shard in leaf.addressable_shards:
+            per_device[shard.device] = (per_device.get(shard.device, 0) +
+                                        shard.data.nbytes)
+    return max(per_device.values()) if per_device else 0
