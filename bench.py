@@ -18,7 +18,11 @@ Timing methodology (load-bearing on this hardware): the axon relay does
 not make ``block_until_ready`` wait for chained per-step dispatches, so
 BOTH paths run their full schedule as ONE compiled program (lax.scan over
 steps) and completion is forced by a dependent scalar readback. Never time
-python-loop dispatches here.
+python-loop dispatches here. The relay also adds ~70ms of fixed overhead
+per program round-trip (measured: a trivial jitted scalar add takes ~70ms
+wall), so the timed schedule must be long enough to amortize it — at the
+default 64 steps the overhead is ~3% of the measurement, at 8 steps it
+was ~17% and compressed every comparison toward 1.0.
 """
 
 import json
@@ -34,7 +38,7 @@ from jax import lax
 D_MODEL = int(os.environ.get("BENCH_D", 768))
 N_LAYERS = int(os.environ.get("BENCH_LAYERS", 24))
 TOKENS = int(os.environ.get("BENCH_TOKENS", 8 * 1024))
-TIMED_STEPS = int(os.environ.get("BENCH_STEPS", 8))
+TIMED_STEPS = int(os.environ.get("BENCH_STEPS", 64))
 LR = 0.1
 
 if os.environ.get("BENCH_PLATFORM"):

@@ -43,8 +43,21 @@ def compiled_text(fn, *args, **kwargs) -> str:
 
 
 def async_collective_pairs(fn, *args, **kwargs) -> Counter:
-    """Counts of async ``<op>-start`` ops in the optimized HLO — nonzero
-    means XLA split the collective for compute/comm overlap."""
+    """Counts of async-split collectives in the optimized HLO — nonzero
+    means XLA split the collective for compute/comm overlap.
+
+    Two spellings exist: dedicated opcodes (``all-reduce-start``,
+    ``all-gather-start``, ``collective-permute-start``) and the generic
+    wrapper ``async-start`` whose operand names the collective (the only
+    form reduce-scatter gets — XLA has no ``reduce-scatter-start`` opcode).
+    Both are counted."""
     text = compiled_text(fn, *args, **kwargs)
-    return Counter({op: len(re.findall(rf"{op.replace('_', '-')}-start", text))
-                    for op in COLLECTIVE_OPS})
+    counts = Counter()
+    for op in COLLECTIVE_OPS:
+        dashed = op.replace("_", "-")
+        dedicated = len(re.findall(rf"{dashed}-start", text))
+        # generic async wrapper: `async-start` line whose callee/body names
+        # the collective, e.g. `... async-start(...), calls=%reduce-scatter...`
+        generic = len(re.findall(rf"async-start[^\n]*{dashed}", text))
+        counts[op] = dedicated + generic
+    return counts

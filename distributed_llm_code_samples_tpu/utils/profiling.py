@@ -53,8 +53,10 @@ def timed(fn, *args, sync_scalar: bool = True, **kwargs):
     t0 = time.perf_counter()
     out = fn(*args, **kwargs)
     if sync_scalar:
-        leaves = jax.tree_util.tree_leaves(out)
-        if leaves:
-            float(leaves[0].sum())
+        # every leaf gets its own readback: leaves may come from separate
+        # dispatches, and forcing only one chain would stop the clock with
+        # the others still in flight
+        for leaf in jax.tree_util.tree_leaves(out):
+            float(leaf.sum())
     jax.block_until_ready(out)
     return out, time.perf_counter() - t0
