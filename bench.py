@@ -87,13 +87,18 @@ def main():
     warm = make_seed_schedule(TIMED_STEPS, random_seed=1)
     timed = make_seed_schedule(TIMED_STEPS, random_seed=2)
 
+    reps = int(os.environ.get("BENCH_REPS", 3))
+
     def measure(run_fn, p0):
         out = run_fn(p0, warm)  # compile + warm
         _sync(out)
-        t0 = time.perf_counter()
-        out = run_fn(out, timed)
-        _sync(out)
-        return TIMED_STEPS / (time.perf_counter() - t0)
+        best = 0.0
+        for _ in range(reps):  # best-of-N: the relay adds run-to-run jitter
+            t0 = time.perf_counter()
+            out = run_fn(out, timed)
+            _sync(out)
+            best = max(best, TIMED_STEPS / (time.perf_counter() - t0))
+        return best
 
     ours_sps = measure(
         lambda p, s: train_single(p, s, TOKENS, D_MODEL, lr=LR), params)

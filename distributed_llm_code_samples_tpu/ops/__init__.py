@@ -5,12 +5,14 @@ TPU-native counterpart of the reference's ops layer (``train_ffns.py:33-94``).
 
 from .linear import init_linear, linear_fwd, linear_bwd
 from .activations import relu_fwd, relu_bwd
-from .ffn import ffn_fwd, ffn_bwd, ffn_block
+from .ffn import (ffn_fwd, ffn_bwd, ffn_block, ffn_bwd_saved,
+                  ffn_block_saved, ffn_block_mixed)
 from .stack import stack_fwd, stack_bwd, stack_grads
 
 __all__ = [
     "init_linear", "linear_fwd", "linear_bwd",
     "relu_fwd", "relu_bwd",
-    "ffn_fwd", "ffn_bwd", "ffn_block",
+    "ffn_fwd", "ffn_bwd", "ffn_block", "ffn_bwd_saved", "ffn_block_saved",
+    "ffn_block_mixed",
     "stack_fwd", "stack_bwd", "stack_grads",
 ]

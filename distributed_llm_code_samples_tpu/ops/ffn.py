@@ -49,6 +49,27 @@ def ffn_bwd(dy: jax.Array, w1: jax.Array, w2: jax.Array, x: jax.Array):
     return dx, (dw1, dw2)
 
 
+def ffn_bwd_saved(dy: jax.Array, w1: jax.Array, w2: jax.Array, x: jax.Array,
+                  a: jax.Array):
+    """Manual block VJP using the **saved** post-ReLU activation ``a``.
+
+    Identical math to ``ffn_bwd`` — ``a = relu(h)`` so the ReLU mask
+    ``h > 0`` equals ``a > 0`` — but skips the pre-activation recompute
+    (``train_ffns.py:63``), trading one ``[tokens, ffn]`` residual in HBM
+    for one fewer matmul per block backward. Measured throughput-equal to
+    the recompute policy on the v5e-class bench chip (the extra residual
+    traffic costs what the extra matmul costs), so ``ffn_block`` (remat)
+    stays the default for its memory profile; this variant exists for
+    HBM-rich parts where the trade tips the other way.
+
+    Returns ``(dx, (dw1, dw2))``.
+    """
+    dw2, da = linear_bwd(dy, w2, a)
+    dh = relu_bwd(da, a)  # mask a > 0 == h > 0
+    dw1, dx = linear_bwd(dh, w1, x)
+    return dx, (dw1, dw2)
+
+
 @jax.custom_vjp
 def ffn_block(w1: jax.Array, w2: jax.Array, x: jax.Array) -> jax.Array:
     """FFN block whose differentiation rule is the hand-written VJP above."""
@@ -68,3 +89,70 @@ def _ffn_block_bwd(res, dy):
 
 
 ffn_block.defvjp(_ffn_block_fwd, _ffn_block_bwd)
+
+
+@jax.custom_vjp
+def ffn_block_saved(w1: jax.Array, w2: jax.Array, x: jax.Array) -> jax.Array:
+    """FFN block differentiated by ``ffn_bwd_saved`` — the no-recompute
+    fast path. Same forward, same gradients (the mask identity makes the
+    two rules produce identical values)."""
+    return ffn_fwd(w1, w2, x)
+
+
+def _ffn_block_saved_fwd(w1, w2, x):
+    h = linear_fwd(w1, x)
+    a = relu_fwd(h)
+    return linear_fwd(w2, a), (w1, w2, x, a)
+
+
+def _ffn_block_saved_bwd(res, dy):
+    w1, w2, x, a = res
+    dx, (dw1, dw2) = ffn_bwd_saved(dy, w1, w2, x, a)
+    return dw1, dw2, dx
+
+
+ffn_block_saved.defvjp(_ffn_block_saved_fwd, _ffn_block_saved_bwd)
+
+
+# --- Mixed-precision block: bf16 on the MXU, fp32 params/accumulation -----
+#
+# The TPU-first precision policy (absent from the fp32 reference): matmul
+# *inputs* are cast to bfloat16 — the MXU's native format — while params,
+# gradients, and every accumulation stay float32 (`preferred_element_type`).
+# Residuals are saved in bf16, halving activation HBM traffic. The backward
+# is still the hand-written rule, not autograd.
+
+def _dot(a, b, dims):
+    return jax.lax.dot_general(a, b, (dims, ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+@jax.custom_vjp
+def ffn_block_mixed(w1: jax.Array, w2: jax.Array, x: jax.Array) -> jax.Array:
+    """linear -> ReLU -> linear with bf16 MXU compute, fp32 accumulate."""
+    y, _ = _ffn_block_mixed_fwd(w1, w2, x)
+    return y
+
+
+def _ffn_block_mixed_fwd(w1, w2, x):
+    bf = jnp.bfloat16
+    xb, w1b, w2b = x.astype(bf), w1.astype(bf), w2.astype(bf)
+    h = _dot(xb, w1b, (((1,), (1,))))          # [T,d]@[ffn,d]^T -> [T,ffn] f32
+    ab = jnp.maximum(h, 0.0).astype(bf)        # saved post-ReLU, bf16
+    y = _dot(ab, w2b, (((1,), (1,))))          # [T,ffn]@[d,ffn]^T -> [T,d] f32
+    return y, (w1b, w2b, xb, ab)
+
+
+def _ffn_block_mixed_bwd(res, dy):
+    w1b, w2b, xb, ab = res
+    bf = jnp.bfloat16
+    dyb = dy.astype(bf)
+    dw2 = _dot(dyb, ab, (((0,), (0,))))        # dy^T a   -> [d,ffn] f32
+    da = _dot(dyb, w2b, (((1,), (0,))))        # dy  w2   -> [T,ffn] f32
+    dhb = jnp.where(ab > 0, da, jnp.zeros((), jnp.float32)).astype(bf)
+    dw1 = _dot(dhb, xb, (((0,), (0,))))        # dh^T x   -> [ffn,d] f32
+    dx = _dot(dhb, w1b, (((1,), (0,))))        # dh  w1   -> [T,d]   f32
+    return dw1, dw2, dx
+
+
+ffn_block_mixed.defvjp(_ffn_block_mixed_fwd, _ffn_block_mixed_bwd)

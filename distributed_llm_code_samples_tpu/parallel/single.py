@@ -24,7 +24,8 @@ from ..ops.stack import stack_fwd, stack_bwd, stack_grads
 
 def make_step(batch_size: int, model_size: int, lr: float = LR,
               unroll: bool = True, use_pallas: bool = False,
-              interpret: bool = False, manual_loop: bool = False):
+              interpret: bool = False, manual_loop: bool = False,
+              remat: bool | None = None, mixed: bool = False):
     """Build one training step ``(params, seed) -> params`` — forward,
     manual backward, inline SGD (``train_ffns.py:105-114``).
 
@@ -39,7 +40,29 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
 
     ``use_pallas`` swaps the per-block compute for the fused Pallas TPU
     kernels (``ops.pallas_ffn``); ``interpret`` runs them in interpreter
-    mode for CPU testing."""
+    mode for CPU testing.
+
+    ``remat=False`` saves the post-ReLU activation instead of recomputing
+    the ffn1 pre-activation in the backward (``ops.ffn.ffn_block_saved``)
+    — one fewer matmul per block backward, same hand-written math, same
+    gradients. Measured on the v5e-class bench chip at the BASELINE
+    config-5 shape the two are throughput-equal (the step is
+    matmul-issue-bound either way), so the default keeps the reference's
+    memory-lean recompute policy (``train_ffns.py:63``).
+
+    ``mixed`` selects the TPU-first precision policy
+    (``ops.ffn.ffn_block_mixed``): bf16 matmul inputs on the MXU, fp32
+    params/gradients/accumulation, bf16 residuals. On this bench chip the
+    default f32 matmul already lowers to bf16 MXU passes, so this is a
+    numerics-layout option, not a speed lever."""
+    if mixed and (use_pallas or remat or manual_loop):
+        raise ValueError("mixed=True is its own block implementation; it "
+                         "cannot combine with use_pallas/remat/manual_loop")
+    if use_pallas and remat is False:
+        raise ValueError("the Pallas block has its own residual policy; "
+                         "remat=False cannot combine with use_pallas")
+    if remat is None:
+        remat = True  # the reference's recompute policy is the default
     if manual_loop:
         if use_pallas:
             from ..ops.pallas_ffn import ffn_fwd_pallas, ffn_bwd_pallas
@@ -65,8 +88,12 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
         from ..ops.pallas_ffn import pallas_ffn_block
         block = lambda w1, w2, x: pallas_ffn_block(  # noqa: E731
             w1, w2, x, interpret)
-    else:
+    elif mixed:
+        from ..ops.ffn import ffn_block_mixed as block
+    elif remat:
         from ..ops.ffn import ffn_block as block
+    else:
+        from ..ops.ffn import ffn_block_saved as block
 
     def step(params: FFNStackParams, seed) -> FFNStackParams:
         x, dloss_dx = batch_from_seed(seed, batch_size, model_size,
@@ -78,19 +105,21 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
     return step
 
 
-@partial(jax.jit, static_argnums=(2, 3, 4, 5, 6, 7, 8), donate_argnums=0)
+@partial(jax.jit, static_argnums=tuple(range(2, 11)), donate_argnums=0)
 def _run(params, seeds, batch_size, model_size, lr, unroll, use_pallas,
-         interpret, manual_loop):
+         interpret, manual_loop, remat, mixed):
     step = make_step(batch_size, model_size, lr, unroll, use_pallas,
-                     interpret, manual_loop)
+                     interpret, manual_loop, remat, mixed)
     return lax.scan(lambda p, s: (step(p, s), None), params, seeds)[0]
 
 
 def train_single(params: FFNStackParams, seeds, batch_size: int,
                  model_size: int, mesh=None, lr: float = LR,
                  unroll: bool = True, use_pallas: bool = False,
-                 interpret: bool = False,
-                 manual_loop: bool = False) -> FFNStackParams:
+                 interpret: bool = False, manual_loop: bool = False,
+                 remat: bool | None = None,
+                 mixed: bool = False) -> FFNStackParams:
     """Uniform launcher signature (SURVEY.md L4); ``mesh`` ignored."""
     return _run(clone_params(params), jnp.asarray(seeds), batch_size,
-                model_size, lr, unroll, use_pallas, interpret, manual_loop)
+                model_size, lr, unroll, use_pallas, interpret, manual_loop,
+                remat, mixed)

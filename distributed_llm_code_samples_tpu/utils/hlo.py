@@ -55,9 +55,16 @@ def async_collective_pairs(fn, *args, **kwargs) -> Counter:
     counts = Counter()
     for op in COLLECTIVE_OPS:
         dashed = op.replace("_", "-")
-        dedicated = len(re.findall(rf"{dashed}-start", text))
-        # generic async wrapper: `async-start` line whose callee/body names
-        # the collective, e.g. `... async-start(...), calls=%reduce-scatter...`
-        generic = len(re.findall(rf"async-start[^\n]*{dashed}", text))
-        counts[op] = dedicated + generic
+        n = 0
+        for line in text.splitlines():
+            # one count per *defining* line: `%foo = ... <opcode>(...)`.
+            # Matching anywhere would double-count — the `-done` line names
+            # the `-start` value as its operand, and a generic async-start
+            # line can also contain the dedicated spelling in its callee.
+            if not re.search(r"=\s*[^\s(]*\s*(async|" + dashed + r")-start\(",
+                             line):
+                continue
+            if re.search(rf"{dashed}-start\(", line) or dashed in line:
+                n += 1
+        counts[op] = n
     return counts
