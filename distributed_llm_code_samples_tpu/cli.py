@@ -29,9 +29,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-l", "--layers", type=int, default=1)
     p.add_argument("-d", "--model_size", type=int, default=4)
     p.add_argument("-m", "--method", type=int, default=0,
-                   choices=range(6),
+                   choices=range(7),
                    help="0=all(1-4), 1=single, 2=DDP, 3=FSDP, 4=TP, "
-                        "5=hybrid DDP x TP")
+                        "5=hybrid DDP x TP, 6=pipeline (ppermute send/recv)")
     p.add_argument("-r", "--random_seed", type=int, default=0,
                    help="!=0 makes runs reproducible (train_ffns.py:350)")
     # TPU-build extensions
@@ -39,6 +39,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="data-axis size for --method 5 (0 = devices//tp)")
     p.add_argument("--tp", type=int, default=2,
                    help="model-axis size for --method 5")
+    p.add_argument("--microbatches", type=int, default=0,
+                   help="GPipe microbatches for --method 6 (0 = n_stages)")
     p.add_argument("--lr", type=float, default=None,
                    help="override LR (default 1e-5, train_ffns.py:29)")
     p.add_argument("--dtype", choices=["float32", "bfloat16"],
@@ -76,7 +78,7 @@ def main(argv=None) -> int:
     from .data import make_seed_schedule
     from .models import init_ffn_stack, params_size_gb
     from .parallel import (make_mesh, guard_multi_device, STRATEGIES,
-                           DATA_AXIS, MODEL_AXIS)
+                           DATA_AXIS, MODEL_AXIS, PIPE_AXIS)
 
     lr = LR if args.lr is None else args.lr
     dtype = jnp.float32 if args.dtype == "float32" else jnp.bfloat16
@@ -108,6 +110,8 @@ def main(argv=None) -> int:
             return make_mesh({DATA_AXIS: n_dev})
         if method == 4:
             return make_mesh({MODEL_AXIS: n_dev})
+        if method == 6:
+            return make_mesh({PIPE_AXIS: n_dev})
         tp = args.tp
         dp = args.dp or max(1, n_dev // tp)
         return make_mesh({DATA_AXIS: dp, MODEL_AXIS: tp})
@@ -118,6 +122,10 @@ def main(argv=None) -> int:
         name, fn = STRATEGIES[m]
         mesh = mesh_for(m)
         kwargs = dict(lr=lr, unroll=unroll)
+        if m == 6:
+            kwargs = dict(lr=lr)  # PP's tick loop has its own structure
+            if args.microbatches:
+                kwargs["n_microbatches"] = args.microbatches
         if m == 1 and args.pallas:
             kwargs["use_pallas"] = True
             kwargs["interpret"] = jax.default_backend() != "tpu"

@@ -7,30 +7,35 @@ DDP x TP mesh the BASELINE adds. All launchers share the uniform signature
 (SURVEY.md L4).
 """
 
-from .mesh import make_mesh, guard_multi_device, DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+from .mesh import (make_mesh, guard_multi_device, DATA_AXIS, MODEL_AXIS,
+                   SEQ_AXIS, PIPE_AXIS, EXPERT_AXIS)
 from . import collectives
 from .single import train_single
 from .ddp import train_ddp
 from .fsdp import train_fsdp
 from .tp import train_tp
 from .hybrid import train_hybrid
+from .pipeline import train_pp
 from .sequence import ring_attention, sequence_parallel_attention
 
 # Method-number parity with the reference CLI (train_ffns.py:6, :373):
-# 1=single, 2=DDP, 3=FSDP, 4=TP; 5 extends with the hybrid mesh.
+# 1=single, 2=DDP, 3=FSDP, 4=TP; 5+ extend with the hybrid mesh and the
+# BASELINE's send/recv pipeline path.
 STRATEGIES = {
     1: ("train_single", train_single),
     2: ("train_ddp", train_ddp),
     3: ("train_fsdp", train_fsdp),
     4: ("train_tp", train_tp),
     5: ("train_hybrid", train_hybrid),
+    6: ("train_pp", train_pp),
 }
 
 __all__ = [
     "make_mesh", "guard_multi_device",
-    "DATA_AXIS", "MODEL_AXIS", "SEQ_AXIS",
+    "DATA_AXIS", "MODEL_AXIS", "SEQ_AXIS", "PIPE_AXIS", "EXPERT_AXIS",
     "collectives",
     "train_single", "train_ddp", "train_fsdp", "train_tp", "train_hybrid",
+    "train_pp",
     "ring_attention", "sequence_parallel_attention",
     "STRATEGIES",
 ]

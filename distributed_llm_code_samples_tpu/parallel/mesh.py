@@ -7,9 +7,11 @@ one process per host, an explicit ``jax.sharding.Mesh`` over ICI (and DCN
 across hosts), and collectives addressed by mesh axis *name* instead of
 process-group handles. Axis names used across the framework:
 
-- ``"data"``  — data parallelism (DDP and FSDP both shard over it)
-- ``"model"`` — tensor parallelism (Megatron-style)
-- ``"seq"``   — sequence/context parallelism (long-context extensions)
+- ``"data"``   — data parallelism (DDP and FSDP both shard over it)
+- ``"model"``  — tensor parallelism (Megatron-style)
+- ``"seq"``    — sequence/context parallelism (long-context extensions)
+- ``"pipe"``   — pipeline parallelism (layers staged, ppermute send/recv)
+- ``"expert"`` — expert parallelism (MoE experts, all_to_all dispatch)
 
 Multi-chip without hardware: ``XLA_FLAGS=--xla_force_host_platform_device_count=N``
 with ``JAX_PLATFORMS=cpu`` gives N fake devices, so every strategy and every
@@ -29,6 +31,8 @@ from jax.sharding import Mesh
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
+PIPE_AXIS = "pipe"
+EXPERT_AXIS = "expert"
 
 
 def make_mesh(axes: Mapping[str, int] | None = None,

@@ -1,0 +1,128 @@
+"""Pipeline parallelism: layers staged across the ``"pipe"`` axis, with
+hand-rolled ``ppermute`` send/recv and GPipe microbatching.
+
+The reference has **no** pipeline parallelism and no point-to-point
+send/recv anywhere (SURVEY.md section 2.2) — but the driver's BASELINE
+config 3 asks for an "MP mode, 8-layer FFN split across 4 devices
+(exercise send/recv + barrier)". This module is that path, built the TPU
+way: one SPMD program over a ``("pipe",)`` mesh axis where every stage
+runs the same code and neighbor transfer is ``lax.ppermute`` over the ICI
+ring (``collectives.ring_shift``) — the XLA lowering of NCCL send/recv.
+
+Schedule (GPipe): the step's ``tokens`` are split into ``M`` microbatches.
+Forward runs ``M + S - 1`` ticks; at tick ``t`` stage ``s`` computes
+microbatch ``t - s`` (a bubble of ``S - 1`` idle ticks per direction is
+masked out, the standard GPipe cost). Activations stream stage-to-stage
+with a ``+1`` ring shift. The backward walks the same wavefront in
+reverse with a ``-1`` shift, consuming per-tick stashed block inputs.
+Because the mock loss needs no forward output (``dloss_dx`` is generated
+from the step seed, ``train_ffns.py:150``), the last stage starts the
+backward from its own locally-generated ``dloss_dx`` — no loss broadcast.
+
+Gradient semantics are exact: microbatch weight-grads sum to the
+full-batch grad, so PP's final params equal the single-device run's
+bit-for-tolerance (a differential test the suite asserts). Weight grads
+never cross stages; each stage runs SGD on its own layers
+(``train_ffns.py:311-312`` locality, transplanted to the layer dimension).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .. import LR
+from ..data import batch_from_seed
+from ..models.ffn_stack import FFNStackParams, reshard_copy
+from ..optim import sgd
+from ..ops.stack import stack_fwd, stack_bwd
+from .collectives import ring_shift, axis_index, barrier
+from .launcher import launch
+from .mesh import PIPE_AXIS, require_axes
+
+# Layers are staged: stacked layer axis sharded across the pipe ring.
+PARAM_SPECS = FFNStackParams(w1=P(PIPE_AXIS, None, None),
+                             w2=P(PIPE_AXIS, None, None))
+
+
+def shard_params(params: FFNStackParams, mesh) -> FFNStackParams:
+    return reshard_copy(params, FFNStackParams(
+        w1=NamedSharding(mesh, PARAM_SPECS.w1),
+        w2=NamedSharding(mesh, PARAM_SPECS.w2)))
+
+
+def make_step(batch_size: int, model_size: int, n_stages: int,
+              n_microbatches: int, lr: float = LR, axis: str = PIPE_AXIS):
+    """One PP step for one stage (local views: ``w1 [L/S, ffn, d]``)."""
+    S, M = n_stages, n_microbatches
+    if batch_size % M:
+        raise ValueError(f"tokens {batch_size} not divisible by "
+                         f"{M} microbatches")
+    mb = batch_size // M
+    ticks = M + S - 1
+
+    def step(params: FFNStackParams, seed) -> FFNStackParams:
+        s = axis_index(axis)
+        x, dloss_dx = batch_from_seed(seed, batch_size, model_size,
+                                      params.w1.dtype)
+        x_mb = x.reshape(M, mb, model_size)
+        dy_mb = dloss_dx.reshape(M, mb, model_size)
+        n_local = params.w1.shape[0]
+
+        # ---- forward wavefront: activation streams +1 around the ring ----
+        state = jnp.zeros((mb, model_size), x.dtype)
+        stash = jnp.zeros((ticks, n_local, mb, model_size), x.dtype)
+        for t in range(ticks):
+            # stage 0 injects microbatch t; everyone else consumes the recv
+            inp = jnp.where(s == 0, x_mb[min(t, M - 1)], state)
+            y, acts = stack_fwd(params.w1, params.w2, inp)
+            stash = stash.at[t].set(acts)
+            state = ring_shift(y, axis, shift=1)
+
+        # the reference's host-side Barrier between phases
+        # (test_mp_barrier_gpus.py:32-34) becomes an in-program fence on
+        # the stash the backward consumes
+        stash = barrier(stash, axis)
+
+        # ---- backward wavefront: grads stream -1 around the ring ----
+        dstate = jnp.zeros((mb, model_size), x.dtype)
+        g1 = jnp.zeros_like(params.w1)
+        g2 = jnp.zeros_like(params.w2)
+        for u in range(ticks):
+            # stage s backward-processes microbatch m at tick u
+            m = u - (S - 1) + s
+            valid = (m >= 0) & (m < M)
+            dy_in = jnp.where(s == S - 1, dy_mb[min(u, M - 1)], dstate)
+            # its forward stash for microbatch m lives at tick m + s
+            t_idx = jnp.clip(u - (S - 1) + 2 * s, 0, ticks - 1)
+            acts = jnp.take(stash, t_idx, axis=0)
+            dx, (dg1, dg2) = stack_bwd(dy_in, params.w1, params.w2, acts)
+            g1 = g1 + jnp.where(valid, dg1, jnp.zeros((), g1.dtype))
+            g2 = g2 + jnp.where(valid, dg2, jnp.zeros((), g2.dtype))
+            dstate = ring_shift(dx, axis, shift=-1)
+
+        # per-stage SGD on the stage's own layers
+        return sgd(params, FFNStackParams(g1, g2), lr)
+
+    return step
+
+
+def train_pp(params: FFNStackParams, seeds, batch_size: int,
+             model_size: int, mesh, lr: float = LR,
+             n_microbatches: int | None = None) -> FFNStackParams:
+    """Run the full PP schedule. Data (seeds) is replicated — every stage
+    regenerates the step's batch locally and uses the slice of the
+    wavefront that is its own, so PP consumes the same steps as the
+    single-device run and must agree with it numerically."""
+    require_axes(mesh, PIPE_AXIS)
+    S = mesh.shape[PIPE_AXIS]
+    if params.w1.shape[0] % S:
+        raise ValueError(f"{params.w1.shape[0]} layers not divisible into "
+                         f"{S} pipeline stages")
+    M = S if n_microbatches is None else n_microbatches
+    params = shard_params(params, mesh)
+    step = make_step(batch_size, model_size, S, M, lr)
+
+    return launch(step, params, jnp.asarray(seeds), mesh,
+                  param_specs=PARAM_SPECS, seed_spec=P())

@@ -55,7 +55,7 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
     params/gradients/accumulation, bf16 residuals. On this bench chip the
     default f32 matmul already lowers to bf16 MXU passes, so this is a
     numerics-layout option, not a speed lever."""
-    if mixed and (use_pallas or remat or manual_loop):
+    if mixed and (use_pallas or remat is not None or manual_loop):
         raise ValueError("mixed=True is its own block implementation; it "
                          "cannot combine with use_pallas/remat/manual_loop")
     if use_pallas and remat is False:

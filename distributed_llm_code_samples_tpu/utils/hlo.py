@@ -57,14 +57,14 @@ def async_collective_pairs(fn, *args, **kwargs) -> Counter:
         dashed = op.replace("_", "-")
         n = 0
         for line in text.splitlines():
-            # one count per *defining* line: `%foo = ... <opcode>(...)`.
-            # Matching anywhere would double-count — the `-done` line names
-            # the `-start` value as its operand, and a generic async-start
-            # line can also contain the dedicated spelling in its callee.
-            if not re.search(r"=\s*[^\s(]*\s*(async|" + dashed + r")-start\(",
-                             line):
+            # count *defining* start lines only. The `-done` line names the
+            # `-start` value as its operand (and would double-count), so it
+            # is excluded first; result types may be tuples, so the opcode
+            # is matched by its trailing `(` rather than by line position.
+            if "-done(" in line:
                 continue
-            if re.search(rf"{dashed}-start\(", line) or dashed in line:
+            if (re.search(rf"{dashed}-start\(", line)
+                    or ("async-start(" in line and dashed in line)):
                 n += 1
         counts[op] = n
     return counts

@@ -13,7 +13,7 @@ from distributed_llm_code_samples_tpu.data import make_seed_schedule
 from distributed_llm_code_samples_tpu.models import init_ffn_stack
 from distributed_llm_code_samples_tpu.parallel import (
     make_mesh, train_single, train_ddp, train_fsdp, train_tp, train_hybrid,
-    DATA_AXIS, MODEL_AXIS)
+    train_pp, DATA_AXIS, MODEL_AXIS, PIPE_AXIS)
 
 D, L, B, S = 64, 3, 32, 8
 LR_TEST = 0.1  # the reference's testing LR (train_ffns.py:29)
@@ -103,6 +103,62 @@ def test_hybrid_2d_matches_ddp(setup, mesh4x2):
     mesh_ddp = make_mesh({DATA_AXIS: 4})
     _assert_params_close(train_ddp(params, seeds, B, D, mesh_ddp, lr=LR_TEST),
                          train_hybrid(params, seeds, B, D, mesh4x2, lr=LR_TEST))
+
+
+def test_pp_matches_single_device(setup):
+    # PP replicates the data and microbatch grads sum to the full-batch
+    # grad, so the staged run must equal the 1-device oracle. Needs a
+    # layer count divisible by the stage count.
+    params = init_ffn_stack(jax.random.PRNGKey(42), D, 4)
+    _, seeds = setup
+    mesh = make_mesh({PIPE_AXIS: 4})
+    p_single = train_single(params, seeds, B, D, lr=LR_TEST)
+    p_pp = train_pp(params, seeds, B, D, mesh, lr=LR_TEST)
+    _assert_params_close(p_single, p_pp)
+
+
+def test_pp_more_microbatches_than_stages(setup):
+    params = init_ffn_stack(jax.random.PRNGKey(42), D, 4)
+    _, seeds = setup
+    mesh = make_mesh({PIPE_AXIS: 4})
+    p_single = train_single(params, seeds, B, D, lr=LR_TEST)
+    p_pp = train_pp(params, seeds, B, D, mesh, lr=LR_TEST, n_microbatches=8)
+    _assert_params_close(p_single, p_pp)
+
+
+def test_pp_two_stages_multi_layer(setup):
+    # 2 stages x 2 layers/stage: the local stack loop inside a stage
+    params = init_ffn_stack(jax.random.PRNGKey(42), D, 4)
+    _, seeds = setup
+    mesh = make_mesh({PIPE_AXIS: 2})
+    p_single = train_single(params, seeds, B, D, lr=LR_TEST)
+    p_pp = train_pp(params, seeds, B, D, mesh, lr=LR_TEST)
+    _assert_params_close(p_single, p_pp)
+
+
+def test_pp_rejects_indivisible_layers(setup):
+    params, seeds = setup  # L=3 not divisible by 4 stages
+    mesh = make_mesh({PIPE_AXIS: 4})
+    with pytest.raises(ValueError):
+        train_pp(params, seeds, B, D, mesh, lr=LR_TEST)
+
+
+def test_pp_uses_collective_permute(setup):
+    # the send/recv path must actually lower to collective_permute HLOs
+    from distributed_llm_code_samples_tpu.parallel import pipeline
+    from distributed_llm_code_samples_tpu.utils.hlo import count_collectives
+    params = init_ffn_stack(jax.random.PRNGKey(42), D, 4)
+    mesh = make_mesh({PIPE_AXIS: 4})
+    sharded = pipeline.shard_params(params, mesh)
+    step = pipeline.make_step(B, D, 4, 4, lr=LR_TEST)
+    from jax.sharding import PartitionSpec as P
+    run = jax.shard_map(step, mesh=mesh,
+                        in_specs=(pipeline.PARAM_SPECS, P()),
+                        out_specs=pipeline.PARAM_SPECS)
+    counts = count_collectives(run, sharded, jnp.int32(3))
+    # one shift per tick per direction; each direction's final shift is
+    # dead (nothing consumes it) and trace-time DCE'd
+    assert counts["collective_permute"] >= 2 * (4 + 4 - 2)
 
 
 def test_scan_path_agrees(setup, mesh4):
