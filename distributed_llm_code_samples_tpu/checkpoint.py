@@ -1,0 +1,231 @@
+"""Checkpoint / resume subsystem.
+
+The reference has **no serialization anywhere** — final params are only
+returned in-memory and printed (``train_ffns.py:383-384``); its only
+"resume" story is seed-schedule reproducibility via ``--random_seed``
+(``:350, :356-360``). This framework makes checkpoint/resume a first-class
+subsystem (SURVEY.md section 5), built on the same deterministic
+seeds-as-dataset design: a checkpoint is ``(params, step, seed schedule)``,
+and restoring it mid-run continues the *exact* run — same data, same
+gradients, same final params as an uninterrupted run.
+
+Format (first-principles, like the rest of the framework): one directory per
+step, ``step_{N}/`` containing ``arrays.npz`` (every pytree leaf, keyed by
+its tree path) and ``meta.json`` (step, schedule, user metadata). Writes are
+atomic: staged into ``step_{N}.tmp`` and ``os.rename``d, so ``latest_step``
+never sees a torn checkpoint (a crash mid-write leaves only a ``.tmp``
+directory, which restore ignores and the next save overwrites).
+
+Sharding-aware: ``save_checkpoint`` accepts arrays living on any
+single-process sharding (``np.asarray`` assembles fully-addressable shards);
+``restore_checkpoint`` takes an optional ``shardings`` pytree and
+``device_put``s each leaf straight onto its mesh placement, so an FSDP run
+restores to sharded buffers without ever materializing a replicated copy per
+device. An optional orbax backend (``backend="orbax"``) delegates the array
+I/O to ``orbax.checkpoint`` for multi-host/async use, same directory layout
+one level down.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+from typing import Any
+
+import jax
+import numpy as np
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """Resolve a saved dtype name, including the ml_dtypes ones (bfloat16,
+    float8_*) numpy can't look up by string."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _to_numpy(leaf) -> np.ndarray:
+    """Host copy in an npz-safe dtype: extended dtypes (bfloat16, ...) are
+    byte-views as unsigned ints — np.savez would otherwise write them as raw
+    void and the restore would be unloadable. The true dtype travels in
+    meta.json."""
+    arr = np.asarray(leaf)
+    if arr.dtype.kind == "V":  # ml_dtypes extension type
+        arr = arr.view(f"u{arr.dtype.itemsize}")
+    return arr
+
+
+def _flatten(tree):
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    names = [jax.tree_util.keystr(p) for p, _ in flat]
+    leaves = [leaf for _, leaf in flat]
+    return names, leaves, treedef
+
+
+def save_checkpoint(ckpt_dir: str, params: Any, step: int, seeds=None,
+                    meta: dict | None = None, backend: str = "npz") -> str:
+    """Write ``step_{step}/`` atomically; returns the final path.
+
+    ``params`` is any pytree of arrays (sharded arrays are gathered via
+    their addressable shards — single-process; multi-host goes through the
+    orbax backend). ``seeds`` is the full seed schedule, saved so a resumed
+    run replays the identical data stream.
+    """
+    os.makedirs(ckpt_dir, exist_ok=True)
+    final = os.path.join(ckpt_dir, f"step_{step}")
+    tmp = final + ".tmp"
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+
+    names, leaves, _ = _flatten(params)
+    if backend == "orbax":
+        import orbax.checkpoint as ocp
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save(os.path.join(os.path.abspath(tmp), "arrays"), params)
+    else:
+        np.savez(os.path.join(tmp, "arrays.npz"),
+                 **{n: _to_numpy(l) for n, l in zip(names, leaves)})
+    # metadata from array attributes only — no host fetch (multi-host arrays
+    # are not fully addressable; orbax handles their device I/O above)
+    doc = {"step": int(step), "backend": backend, "leaf_names": names,
+           "leaf_shapes": [list(np.shape(l)) for l in leaves],
+           "leaf_dtypes": [np.dtype(getattr(l, "dtype", type(l))).name
+                           for l in leaves]}
+    if seeds is not None:
+        doc["seeds"] = np.asarray(seeds).tolist()
+    if meta:
+        doc["meta"] = meta
+    with open(os.path.join(tmp, "meta.json"), "w") as f:
+        json.dump(doc, f)
+    if os.path.exists(final):
+        shutil.rmtree(final)
+    os.rename(tmp, final)  # atomic publish
+    return final
+
+
+def latest_step(ckpt_dir: str) -> int | None:
+    """Highest completed (published, non-``.tmp``) step, or None."""
+    if not os.path.isdir(ckpt_dir):
+        return None
+    steps = [int(m.group(1)) for name in os.listdir(ckpt_dir)
+             if (m := _STEP_RE.match(name))]
+    return max(steps) if steps else None
+
+
+def restore_checkpoint(ckpt_dir: str, target: Any, step: int | None = None,
+                       shardings: Any = None):
+    """Restore ``(params, step, seeds)``.
+
+    ``target`` is an example pytree (same structure/dtypes as saved — e.g.
+    the freshly-initialized params) used to rebuild the tree. ``shardings``,
+    if given, is a matching pytree (or single sharding) of placements; each
+    leaf is ``device_put`` directly onto it.
+    """
+    if step is None:
+        step = latest_step(ckpt_dir)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {ckpt_dir}")
+    path = os.path.join(ckpt_dir, f"step_{step}")
+    with open(os.path.join(path, "meta.json")) as f:
+        doc = json.load(f)
+
+    names, leaves, treedef = _flatten(target)
+    if doc.get("leaf_names") != names:
+        raise ValueError(
+            f"checkpoint tree {doc.get('leaf_names')} != target tree {names}")
+    saved_shapes = [tuple(s) for s in doc.get("leaf_shapes", [])]
+    target_shapes = [tuple(np.shape(l)) for l in leaves]
+    if saved_shapes and saved_shapes != target_shapes:
+        raise ValueError(
+            f"checkpoint shapes {saved_shapes} != target shapes "
+            f"{target_shapes} — the checkpoint is from a different model "
+            "config (layers/model_size)")
+    saved_dtypes = doc.get("leaf_dtypes", [])
+    target_dtypes = [np.dtype(getattr(l, "dtype", type(l))).name
+                     for l in leaves]
+    if saved_dtypes and saved_dtypes != target_dtypes:
+        raise ValueError(
+            f"checkpoint dtypes {saved_dtypes} != target dtypes "
+            f"{target_dtypes} — resuming would silently continue in the "
+            "saved dtype; re-init the run or match --dtype")
+    if doc.get("backend") == "orbax":
+        import orbax.checkpoint as ocp
+        ckptr = ocp.PyTreeCheckpointer()
+        params = ckptr.restore(os.path.join(os.path.abspath(path), "arrays"))
+        new_leaves = jax.tree_util.tree_leaves(params)
+    else:
+        dtypes = [_np_dtype(n) for n in doc.get("leaf_dtypes", [])] \
+            or [None] * len(names)
+        with np.load(os.path.join(path, "arrays.npz")) as z:
+            new_leaves = [z[n] if dt is None or z[n].dtype == dt
+                          else z[n].view(dt)
+                          for n, dt in zip(names, dtypes)]
+
+    if shardings is not None:
+        sh_leaves = jax.tree_util.tree_leaves(shardings)
+        if len(sh_leaves) == 1:
+            sh_leaves = sh_leaves * len(new_leaves)
+        new_leaves = [jax.device_put(l, s)
+                      for l, s in zip(new_leaves, sh_leaves)]
+    else:
+        new_leaves = [jax.device_put(np.asarray(l)) for l in new_leaves]
+    params = jax.tree_util.tree_unflatten(treedef, new_leaves)
+    seeds = np.asarray(doc["seeds"], np.int32) if "seeds" in doc else None
+    return params, int(doc["step"]), seeds
+
+
+def run_with_checkpointing(train_fn, params, seeds, *args,
+                           ckpt_dir: str, every: int = 0, resume: bool = True,
+                           backend: str = "npz", **kwargs):
+    """Drive any strategy launcher (uniform L4 signature,
+    ``fn(params, seeds, batch, d, **kw)``) with periodic checkpointing.
+
+    The schedule is chunked into ``every``-step segments (0 = one segment);
+    after each segment the params and the *full* schedule are saved under
+    ``step_{completed}``. On ``resume``, the latest checkpoint's params and
+    schedule are authoritative — a run killed between segments continues
+    exactly where it stopped and lands on the same final params as an
+    uninterrupted run (allclose-verified in tests/test_checkpoint.py).
+    Passing a *longer* schedule than the saved one extends the run: the
+    completed prefix keeps its saved data, the extra steps train on the new
+    schedule's tail. ``resume=False`` clears existing ``step_*`` dirs first,
+    so a later resume can't pick up a stale higher step from a previous run.
+
+    Note: for data-parallel strategies, pick ``every`` divisible by the
+    data-axis size (the strided seed split asserts divisibility,
+    ``train_ffns.py:175``).
+    """
+    seeds = np.asarray(seeds)
+    start = 0
+    if resume and latest_step(ckpt_dir) is not None:
+        params, start, saved = restore_checkpoint(ckpt_dir, params)
+        if saved is not None and len(saved):
+            if len(seeds) > len(saved):
+                # a longer re-run extends the saved run: completed steps keep
+                # their saved data, the extra steps use the new schedule
+                seeds = np.concatenate([saved, seeds[len(saved):]])
+            else:
+                seeds = saved  # saved schedule is authoritative on resume
+    else:
+        if os.path.isdir(ckpt_dir):  # restart: drop stale step_* dirs so a
+            for name in os.listdir(ckpt_dir):  # later resume can't pick up
+                if _STEP_RE.match(name):       # a higher step from this run
+                    shutil.rmtree(os.path.join(ckpt_dir, name))
+        # publish step_0 so the schedule survives a crash in segment 1
+        save_checkpoint(ckpt_dir, params, 0, seeds, backend=backend)
+    total = len(seeds)
+    chunk = every if every > 0 else total
+    while start < total:
+        n = min(chunk, total - start)
+        params = train_fn(params, seeds[start:start + n], *args, **kwargs)
+        jax.block_until_ready(params)
+        start += n
+        save_checkpoint(ckpt_dir, params, start, seeds, backend=backend)
+    return params

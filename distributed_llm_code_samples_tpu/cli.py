@@ -56,6 +56,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fake_devices", type=int, default=0,
                    help="run on N virtual CPU devices "
                         "(xla_force_host_platform_device_count)")
+    p.add_argument("--checkpoint_dir", default=None,
+                   help="enable checkpoint/resume: save params + seed "
+                        "schedule here (per-method subdirs); a re-run with "
+                        "the same dir resumes from the latest checkpoint")
+    p.add_argument("--checkpoint_every", type=int, default=0,
+                   help="save every N steps (0 = final only); for DP "
+                        "methods pick N divisible by the data-axis size")
+    p.add_argument("--no_resume", action="store_true",
+                   help="ignore existing checkpoints (restart from step 0)")
     return p
 
 
@@ -132,7 +141,15 @@ def main(argv=None) -> int:
         if mesh is not None:
             kwargs["mesh"] = mesh
         t0 = time.time()
-        out = fn(params, seeds, tokens, args.model_size, **kwargs)
+        if args.checkpoint_dir:
+            from .checkpoint import run_with_checkpointing
+            out = run_with_checkpointing(
+                fn, params, seeds, tokens, args.model_size,
+                ckpt_dir=os.path.join(args.checkpoint_dir, name),
+                every=args.checkpoint_every, resume=not args.no_resume,
+                **kwargs)
+        else:
+            out = fn(params, seeds, tokens, args.model_size, **kwargs)
         jax.block_until_ready(out)
         t1 = time.time()
         results[m] = out

@@ -1,0 +1,220 @@
+"""Checkpoint/resume subsystem tests.
+
+The reference has no serialization (SURVEY.md section 5: "Checkpoint /
+resume: absent"); the contract here is ours: a run interrupted between
+checkpoint segments and resumed must land on the same final params as an
+uninterrupted run (the differential-testing stance of ``train_ffns.py:386-391``
+applied to fault recovery).
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_llm_code_samples_tpu.checkpoint import (
+    latest_step, restore_checkpoint, run_with_checkpointing, save_checkpoint)
+from distributed_llm_code_samples_tpu.data import make_seed_schedule
+from distributed_llm_code_samples_tpu.models import init_ffn_stack
+from distributed_llm_code_samples_tpu.parallel import (
+    DATA_AXIS, train_ddp, train_single)
+
+
+@pytest.fixture
+def params():
+    return init_ffn_stack(jax.random.PRNGKey(0), 16, 2)
+
+
+def test_round_trip(tmp_path, params):
+    seeds = make_seed_schedule(4, random_seed=7)
+    save_checkpoint(str(tmp_path), params, 3, seeds, meta={"note": "x"})
+    got, step, got_seeds = restore_checkpoint(str(tmp_path), params)
+    assert step == 3
+    np.testing.assert_array_equal(np.asarray(got_seeds), np.asarray(seeds))
+    np.testing.assert_array_equal(np.asarray(got.w1), np.asarray(params.w1))
+    np.testing.assert_array_equal(np.asarray(got.w2), np.asarray(params.w2))
+
+
+def test_latest_step_ignores_torn_tmp(tmp_path, params):
+    save_checkpoint(str(tmp_path), params, 2)
+    os.makedirs(tmp_path / "step_9.tmp")  # crash mid-write artifact
+    assert latest_step(str(tmp_path)) == 2
+
+
+def test_restore_specific_step_and_overwrite(tmp_path, params):
+    save_checkpoint(str(tmp_path), params, 1)
+    bumped = params._replace(w1=params.w1 + 1.0)
+    save_checkpoint(str(tmp_path), bumped, 2)
+    save_checkpoint(str(tmp_path), params, 2)  # overwrite same step
+    got, step, _ = restore_checkpoint(str(tmp_path), params, step=2)
+    np.testing.assert_array_equal(np.asarray(got.w1), np.asarray(params.w1))
+    got1, _, _ = restore_checkpoint(str(tmp_path), params, step=1)
+    np.testing.assert_array_equal(np.asarray(got1.w1), np.asarray(params.w1))
+
+
+def test_tree_mismatch_raises(tmp_path, params):
+    save_checkpoint(str(tmp_path), params, 0)
+    with pytest.raises(ValueError, match="tree"):
+        restore_checkpoint(str(tmp_path), {"other": params.w1})
+
+
+def test_sharded_restore(tmp_path, params, mesh8):
+    """Restore straight onto FSDP-style placements: each leaf lands sharded
+    over the data axis, values identical to the saved replicated copy."""
+    save_checkpoint(str(tmp_path), params, 5)
+    sh = NamedSharding(mesh8, P(None, DATA_AXIS))
+    got, step, _ = restore_checkpoint(
+        str(tmp_path), params, shardings=type(params)(w1=sh, w2=sh))
+    assert step == 5
+    assert got.w1.sharding == sh and got.w2.sharding == sh
+    np.testing.assert_array_equal(np.asarray(got.w1), np.asarray(params.w1))
+
+
+def test_sharded_save(tmp_path, params, mesh8):
+    """A sharded array saves through its addressable shards and restores to
+    the same values."""
+    sh = NamedSharding(mesh8, P(None, DATA_AXIS))
+    sharded = jax.device_put(params, type(params)(w1=sh, w2=sh))
+    save_checkpoint(str(tmp_path), sharded, 1)
+    got, _, _ = restore_checkpoint(str(tmp_path), params)
+    np.testing.assert_array_equal(np.asarray(got.w1), np.asarray(params.w1))
+
+
+@pytest.mark.parametrize("backend", ["npz", "orbax"])
+def test_backend_round_trip(tmp_path, params, backend):
+    if backend == "orbax":
+        pytest.importorskip("orbax.checkpoint")
+    save_checkpoint(str(tmp_path), params, 4, backend=backend)
+    got, step, _ = restore_checkpoint(str(tmp_path), params)
+    assert step == 4
+    np.testing.assert_array_equal(np.asarray(got.w1), np.asarray(params.w1))
+
+
+def _oracle(params, seeds, tokens, d):
+    return train_single(params, seeds, tokens, d)
+
+
+def test_resume_matches_uninterrupted(tmp_path, params):
+    """Kill the run after the first 2-step segment; the resumed run must
+    reach the exact final params of an uninterrupted 6-step run."""
+    seeds = make_seed_schedule(6, random_seed=3)
+    tokens, d = 32, 16
+    oracle = _oracle(params, seeds, tokens, d)
+
+    calls = {"n": 0}
+
+    def crashing(p, s, *a, **kw):
+        if calls["n"] == 1:
+            raise RuntimeError("injected crash")
+        calls["n"] += 1
+        return train_single(p, s, *a, **kw)
+
+    with pytest.raises(RuntimeError, match="injected"):
+        run_with_checkpointing(crashing, params, seeds, tokens, d,
+                               ckpt_dir=str(tmp_path), every=2)
+    assert latest_step(str(tmp_path)) == 2
+
+    out = run_with_checkpointing(train_single, params, seeds, tokens, d,
+                                 ckpt_dir=str(tmp_path), every=2)
+    assert latest_step(str(tmp_path)) == 6
+    np.testing.assert_allclose(np.asarray(out.w1), np.asarray(oracle.w1),
+                               rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(out.w2), np.asarray(oracle.w2),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_resume_uses_saved_schedule(tmp_path, params):
+    """The checkpointed schedule is authoritative on resume — a resumed run
+    ignores a different schedule passed in (the --random_seed 0 entropy
+    case)."""
+    seeds = make_seed_schedule(4, random_seed=3)
+    other = make_seed_schedule(4, random_seed=99)
+    tokens, d = 32, 16
+    oracle = _oracle(params, seeds, tokens, d)
+
+    run_with_checkpointing(train_single, params, seeds[:0], tokens, d,
+                           ckpt_dir=str(tmp_path))  # publishes step_0 only
+    # overwrite step_0 with the real schedule, then resume with `other`
+    save_checkpoint(str(tmp_path), params, 0, seeds)
+    out = run_with_checkpointing(train_single, params, other, tokens, d,
+                                 ckpt_dir=str(tmp_path), every=2)
+    np.testing.assert_allclose(np.asarray(out.w1), np.asarray(oracle.w1),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_bfloat16_round_trip(tmp_path):
+    """bf16 leaves survive npz (stored as byte views + dtype in meta)."""
+    import jax.numpy as jnp
+    p = init_ffn_stack(jax.random.PRNGKey(1), 16, 2, dtype=jnp.bfloat16)
+    save_checkpoint(str(tmp_path), p, 0)
+    got, _, _ = restore_checkpoint(str(tmp_path), p)
+    assert got.w1.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(np.asarray(got.w1).view("u2"),
+                                  np.asarray(p.w1).view("u2"))
+
+
+def test_shape_mismatch_raises(tmp_path, params):
+    """A checkpoint from a different model config (same leaf names, other
+    shapes) must not restore silently."""
+    save_checkpoint(str(tmp_path), params, 0)
+    bigger = init_ffn_stack(jax.random.PRNGKey(0), 16, 4)
+    with pytest.raises(ValueError, match="different model config"):
+        restore_checkpoint(str(tmp_path), bigger)
+
+
+def test_dtype_mismatch_raises(tmp_path, params):
+    """Resuming an f32 checkpoint into a bf16 target must not silently
+    continue in f32."""
+    import jax.numpy as jnp
+    save_checkpoint(str(tmp_path), params, 0)
+    bf16 = init_ffn_stack(jax.random.PRNGKey(0), 16, 2, dtype=jnp.bfloat16)
+    with pytest.raises(ValueError, match="dtype"):
+        restore_checkpoint(str(tmp_path), bf16)
+
+
+def test_no_resume_clears_stale_steps(tmp_path, params):
+    """resume=False restarts from 0 AND drops higher stale steps, so the
+    next resumed run can't continue a previous run's schedule."""
+    seeds6 = make_seed_schedule(6, random_seed=3)
+    seeds4 = make_seed_schedule(4, random_seed=8)
+    tokens, d = 32, 16
+    run_with_checkpointing(train_single, params, seeds6, tokens, d,
+                           ckpt_dir=str(tmp_path), every=2)
+    assert latest_step(str(tmp_path)) == 6
+    out = run_with_checkpointing(train_single, params, seeds4, tokens, d,
+                                 ckpt_dir=str(tmp_path), every=2,
+                                 resume=False)
+    assert latest_step(str(tmp_path)) == 4
+    oracle = _oracle(params, seeds4, tokens, d)
+    np.testing.assert_allclose(np.asarray(out.w1), np.asarray(oracle.w1),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_resume_extends_with_longer_schedule(tmp_path, params):
+    """Re-running with a longer schedule trains the extra steps (saved
+    prefix keeps its data), matching an uninterrupted run on the merged
+    schedule."""
+    seeds8 = make_seed_schedule(8, random_seed=3)
+    tokens, d = 32, 16
+    run_with_checkpointing(train_single, params, seeds8[:4], tokens, d,
+                           ckpt_dir=str(tmp_path))
+    out = run_with_checkpointing(train_single, params, seeds8, tokens, d,
+                                 ckpt_dir=str(tmp_path))
+    assert latest_step(str(tmp_path)) == 8
+    oracle = _oracle(params, seeds8, tokens, d)
+    np.testing.assert_allclose(np.asarray(out.w1), np.asarray(oracle.w1),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_checkpointed_ddp(tmp_path, params, mesh8):
+    """Segmented DDP equals one-shot DDP (segment length divisible by the
+    data-axis size)."""
+    seeds = make_seed_schedule(16, random_seed=5)
+    tokens, d = 32, 16
+    oracle = train_ddp(params, seeds, tokens, d, mesh=mesh8)
+    out = run_with_checkpointing(train_ddp, params, seeds, tokens, d,
+                                 ckpt_dir=str(tmp_path), every=8, mesh=mesh8)
+    np.testing.assert_allclose(np.asarray(out.w1), np.asarray(oracle.w1),
+                               rtol=1e-6, atol=1e-7)

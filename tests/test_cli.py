@@ -40,6 +40,41 @@ def test_cli_hybrid_method():
     assert "train_hybrid takes" in r.stdout
 
 
+@pytest.mark.slow
+def test_cli_checkpoint_resume(tmp_path):
+    """A CLI run with --checkpoint_dir publishes restorable checkpoints whose
+    final params equal an in-process run on the same schedule; a second
+    invocation resumes (trains 0 remaining steps) without error."""
+    import numpy as np
+    from distributed_llm_code_samples_tpu.checkpoint import (
+        latest_step, restore_checkpoint)
+    from distributed_llm_code_samples_tpu.data import make_seed_schedule
+    from distributed_llm_code_samples_tpu.models import init_ffn_stack
+    from distributed_llm_code_samples_tpu.parallel import train_single
+
+    ck = str(tmp_path / "ck")
+    args = ("-s", "4", "-bs", "2", "-n", "16", "-l", "2", "-d", "64",
+            "-m", "1", "-r", "7", "--lr", "0.1", "--fake_devices", "1",
+            "--checkpoint_dir", ck, "--checkpoint_every", "2")
+    r = _run_cli(*args)
+    assert r.returncode == 0, r.stdout + r.stderr
+    method_dir = os.path.join(ck, "train_single")
+    assert latest_step(method_dir) == 4
+
+    import jax
+    params = init_ffn_stack(jax.random.PRNGKey(7), 64, 2)
+    seeds = make_seed_schedule(4, random_seed=7)
+    oracle = train_single(params, seeds, 2 * 16, 64, lr=0.1)
+    got, step, _ = restore_checkpoint(method_dir, params)
+    assert step == 4
+    np.testing.assert_allclose(np.asarray(got.w1), np.asarray(oracle.w1),
+                               rtol=1e-6, atol=1e-7)
+
+    r2 = _run_cli(*args)  # resume: nothing left to train
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    assert latest_step(method_dir) == 4
+
+
 def test_graft_entry_fn_is_jittable():
     import jax
     import __graft_entry__ as g  # conftest puts the repo root on sys.path
