@@ -104,9 +104,18 @@ def save_checkpoint(ckpt_dir: str, params: Any, step: int, seeds=None,
         doc["meta"] = meta
     with open(os.path.join(tmp, "meta.json"), "w") as f:
         json.dump(doc, f)
+    old = None
     if os.path.exists(final):
-        shutil.rmtree(final)
+        # keep the previous version valid until the new one is published:
+        # move it aside (its .tmp suffix hides it from latest_step), swap
+        # in the new dir, then drop it
+        old = final + ".old.tmp"
+        if os.path.exists(old):
+            shutil.rmtree(old)
+        os.rename(final, old)
     os.rename(tmp, final)  # atomic publish
+    if old is not None:
+        shutil.rmtree(old)
     return final
 
 
@@ -172,6 +181,11 @@ def restore_checkpoint(ckpt_dir: str, target: Any, step: int | None = None,
         sh_leaves = jax.tree_util.tree_leaves(shardings)
         if len(sh_leaves) == 1:
             sh_leaves = sh_leaves * len(new_leaves)
+        if len(sh_leaves) != len(new_leaves):
+            raise ValueError(
+                f"shardings tree has {len(sh_leaves)} leaves but params "
+                f"tree has {len(new_leaves)} — pass one sharding per leaf "
+                "(or a single sharding for all)")
         new_leaves = [jax.device_put(l, s)
                       for l, s in zip(new_leaves, sh_leaves)]
     else:

@@ -29,9 +29,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-l", "--layers", type=int, default=1)
     p.add_argument("-d", "--model_size", type=int, default=4)
     p.add_argument("-m", "--method", type=int, default=0,
-                   choices=range(7),
+                   choices=range(8),
                    help="0=all(1-4), 1=single, 2=DDP, 3=FSDP, 4=TP, "
-                        "5=hybrid DDP x TP, 6=pipeline (ppermute send/recv)")
+                        "5=hybrid DDP x TP, 6=pipeline (ppermute send/recv), "
+                        "7=MoE expert parallelism (all_to_all)")
     p.add_argument("-r", "--random_seed", type=int, default=0,
                    help="!=0 makes runs reproducible (train_ffns.py:350)")
     # TPU-build extensions
@@ -41,6 +42,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="model-axis size for --method 5")
     p.add_argument("--microbatches", type=int, default=0,
                    help="GPipe microbatches for --method 6 (0 = n_stages)")
+    p.add_argument("--experts", type=int, default=8,
+                   help="expert count for --method 7 (MoE)")
     p.add_argument("--lr", type=float, default=None,
                    help="override LR (default 1e-5, train_ffns.py:29)")
     p.add_argument("--dtype", choices=["float32", "bfloat16"],
@@ -61,8 +64,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "schedule here (per-method subdirs); a re-run with "
                         "the same dir resumes from the latest checkpoint")
     p.add_argument("--checkpoint_every", type=int, default=0,
-                   help="save every N steps (0 = final only); for DP "
-                        "methods pick N divisible by the data-axis size")
+                   help="save every N steps (0 = final only); for methods "
+                        "that shard the seed schedule (2, 3, 5, 7) pick N "
+                        "divisible by the sharding-axis size")
     p.add_argument("--no_resume", action="store_true",
                    help="ignore existing checkpoints (restart from step 0)")
     return p
@@ -85,9 +89,9 @@ def main(argv=None) -> int:
 
     from . import LR
     from .data import make_seed_schedule
-    from .models import init_ffn_stack, params_size_gb
+    from .models import init_ffn_stack, init_moe_stack, params_size_gb
     from .parallel import (make_mesh, guard_multi_device, STRATEGIES,
-                           DATA_AXIS, MODEL_AXIS, PIPE_AXIS)
+                           DATA_AXIS, MODEL_AXIS, PIPE_AXIS, EXPERT_AXIS)
 
     lr = LR if args.lr is None else args.lr
     dtype = jnp.float32 if args.dtype == "float32" else jnp.bfloat16
@@ -100,13 +104,19 @@ def main(argv=None) -> int:
 
     seeds = make_seed_schedule(args.num_steps, args.random_seed)
     key = jax.random.PRNGKey(args.random_seed)
-    params = init_ffn_stack(key, args.model_size, args.layers, dtype=dtype)
+    if args.method == 7:
+        params = init_moe_stack(key, args.model_size, args.layers,
+                                args.experts, dtype=dtype)
+    else:
+        params = init_ffn_stack(key, args.model_size, args.layers,
+                                dtype=dtype)
 
     print(f"PARAMS: {params.num_params():_} "
           f"(size {params_size_gb(params)} GB)\n\n")
+    corner = (lambda w: w[0, 0]) if args.method == 7 else (lambda w: w[0])
     print("initial layers_params[0]", params.w1[0].shape, params.w2[0].shape)
-    print("initial layers_params[0]", params.w1[0][:5, :5],
-          params.w2[0][:5, :5])
+    print("initial layers_params[0]", corner(params.w1)[:5, :5],
+          corner(params.w2)[:5, :5])
 
     n_dev = jax.device_count()
     tokens = args.batch_size * args.seq_len  # seq folded into batch (:379)
@@ -121,6 +131,8 @@ def main(argv=None) -> int:
             return make_mesh({MODEL_AXIS: n_dev})
         if method == 6:
             return make_mesh({PIPE_AXIS: n_dev})
+        if method == 7:
+            return make_mesh({EXPERT_AXIS: n_dev})
         tp = args.tp
         dp = args.dp or max(1, n_dev // tp)
         return make_mesh({DATA_AXIS: dp, MODEL_AXIS: tp})
@@ -135,6 +147,8 @@ def main(argv=None) -> int:
             kwargs = dict(lr=lr)  # PP's tick loop has its own structure
             if args.microbatches:
                 kwargs["n_microbatches"] = args.microbatches
+        if m == 7:
+            kwargs = dict(lr=lr)  # EP's expert loop has its own structure
         if m == 1 and args.pallas:
             kwargs["use_pallas"] = True
             kwargs["interpret"] = jax.default_backend() != "tpu"
@@ -156,8 +170,8 @@ def main(argv=None) -> int:
         print(f"\n{name} takes {t1 - t0} seconds")
         print(f"final {name} layers_params[0]", out.w1[0].shape,
               out.w2[0].shape)
-        print(f"final {name} layers_params[0]", out.w1[0][:5, :5],
-              out.w2[0][:5, :5])
+        print(f"final {name} layers_params[0]", corner(out.w1)[:5, :5],
+              corner(out.w2)[:5, :5])
 
     failed = False
     if args.method == 0:

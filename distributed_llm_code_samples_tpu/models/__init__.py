@@ -4,6 +4,8 @@ entire model surface); attention/long-context extensions live here too."""
 from .ffn_stack import (FFNStackParams, init_ffn_stack, clone_params,
                         params_size_gb)
 from .attention import attention, mha
+from .moe import MoEStackParams, init_moe_stack
 
 __all__ = ["FFNStackParams", "init_ffn_stack", "clone_params",
-           "params_size_gb", "attention", "mha"]
+           "params_size_gb", "attention", "mha",
+           "MoEStackParams", "init_moe_stack"]

@@ -82,6 +82,7 @@ def reshard_copy(params: FFNStackParams, out_shardings) -> FFNStackParams:
     return jax.jit(_fresh_copy, out_shardings=out_shardings)(params)
 
 
-def params_size_gb(params: FFNStackParams) -> float:
-    """fp32 GB, matching the reference's report (``train_ffns.py:363-366``)."""
+def params_size_gb(params) -> float:
+    """fp32 GB for any params container with ``num_params()`` (FFN stack,
+    MoE stack), matching the reference's report (``train_ffns.py:363-366``)."""
     return 4 * params.num_params() / (1024 ** 3)

@@ -1,0 +1,69 @@
+"""Mixture-of-Experts FFN stack — the expert-parallel model family.
+
+The reference has no MoE (its entire model surface is the dense FFN stack,
+``train_ffns.py:38-39``); expert parallelism is a first-class extension of
+this framework, in the same no-module-abstraction style: params are raw
+stacked arrays in a NamedTuple pytree. Each MoE layer replaces the dense FFN
+with ``n_experts`` independent expert FFNs (same ``[ffn, d] / [d, ffn]``
+transposed no-bias weights as ``FFNStackParams``) plus a top-1 router.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.linear import init_linear
+
+
+class MoEStackParams(NamedTuple):
+    """``wg [L, E, d]`` router, ``w1 [L, E, ffn, d]``, ``w2 [L, E, d, ffn]``.
+
+    ``w1[l, e] / w2[l, e]`` are expert ``e``'s FFN weights, identical layout
+    to the dense stack's ``w1[l] / w2[l]`` — an MoE layer with ``E=1`` and
+    its router ignored *is* the dense block.
+    """
+    wg: jax.Array
+    w1: jax.Array
+    w2: jax.Array
+
+    @property
+    def n_layers(self) -> int:
+        return self.w1.shape[0]
+
+    @property
+    def n_experts(self) -> int:
+        return self.w1.shape[1]
+
+    @property
+    def d_model(self) -> int:
+        return self.w1.shape[3]
+
+    @property
+    def ffn_dim(self) -> int:
+        return self.w1.shape[2]
+
+    def num_params(self) -> int:
+        return self.wg.size + self.w1.size + self.w2.size
+
+
+def init_moe_stack(key: jax.Array, d_model: int, n_layers: int,
+                   n_experts: int, ffn_dim: int | None = None,
+                   scale: float = 2e-2, dtype=jnp.float32) -> MoEStackParams:
+    """Initialize the MoE stack; ``ffn_dim`` defaults to ``4 * d_model``
+    like the dense stack (``train_ffns.py:361``)."""
+    ffn_dim = 4 * d_model if ffn_dim is None else ffn_dim
+    kg, k1, k2 = jax.random.split(key, 3)
+
+    def grid(k, m, n):
+        keys = jax.random.split(k, n_layers * n_experts)
+        w = jnp.stack([init_linear(keys[i], m, n, scale, dtype)
+                       for i in range(n_layers * n_experts)])
+        return w.reshape(n_layers, n_experts, n, m)
+
+    wg = (scale * jax.random.normal(kg, (n_layers, n_experts, d_model))
+          ).astype(dtype)
+    return MoEStackParams(wg=wg, w1=grid(k1, d_model, ffn_dim),
+                          w2=grid(k2, ffn_dim, d_model))

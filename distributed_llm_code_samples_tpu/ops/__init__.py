@@ -8,6 +8,8 @@ from .activations import relu_fwd, relu_bwd
 from .ffn import (ffn_fwd, ffn_bwd, ffn_block, ffn_bwd_saved,
                   ffn_block_saved, ffn_block_mixed)
 from .stack import stack_fwd, stack_bwd, stack_grads
+from .moe import (expert_capacity, route_top1, dispatch_tensor, moe_layer,
+                  moe_stack_fwd)
 
 __all__ = [
     "init_linear", "linear_fwd", "linear_bwd",
@@ -15,4 +17,6 @@ __all__ = [
     "ffn_fwd", "ffn_bwd", "ffn_block", "ffn_bwd_saved", "ffn_block_saved",
     "ffn_block_mixed",
     "stack_fwd", "stack_bwd", "stack_grads",
+    "expert_capacity", "route_top1", "dispatch_tensor", "moe_layer",
+    "moe_stack_fwd",
 ]

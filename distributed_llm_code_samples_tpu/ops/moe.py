@@ -1,0 +1,88 @@
+"""MoE routing + dispatch/combine ops (single-device oracle for EP).
+
+Built TPU-first: the router is top-1 (Switch-style) with a **static
+capacity** per expert, and dispatch/combine are dense one-hot einsums —
+every shape is static, every FLOP lands on the MXU, and there is no
+data-dependent control flow for XLA to choke on. Tokens overflowing an
+expert's capacity are dropped (emit zeros), the standard Switch behavior;
+with the default ``capacity_factor`` sized for the test workloads nothing
+drops.
+
+Differentiation follows the framework's stance (``train_ffns.py:1-3``): the
+expert FFN compute runs the hand-written ``ffn_block`` VJP (vmapped over
+experts); dispatch/combine are *linear* one-hot contractions whose VJPs are
+exact transposes that ``jax.vjp`` composes; the router gradient flows
+through the softmax gate that scales the combine (the argmax one-hot itself
+is piecewise-constant — zero gradient — as in Switch).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .ffn import ffn_block
+
+
+def expert_capacity(tokens: int, n_experts: int,
+                    capacity_factor: float = 2.0) -> int:
+    """Static per-expert slot count: ``ceil(tokens/E * factor)``."""
+    return max(1, int(math.ceil(tokens / n_experts * capacity_factor)))
+
+
+def route_top1(wg: jax.Array, x: jax.Array):
+    """Top-1 router. ``wg [E, d]``, ``x [T, d]`` -> ``(idx [T], gate [T])``
+    where ``gate`` is the chosen expert's softmax probability (the
+    differentiable path to the router weights)."""
+    logits = x @ wg.T                      # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    idx = jnp.argmax(logits, axis=-1)      # [T]
+    gate = jnp.take_along_axis(probs, idx[:, None], axis=-1)[:, 0]
+    return idx, gate
+
+
+def dispatch_tensor(idx: jax.Array, n_experts: int, capacity: int,
+                    dtype=jnp.float32):
+    """One-hot dispatch ``D [T, E, C]``: ``D[t, e, c] = 1`` iff token ``t``
+    is the ``c``-th token routed to expert ``e`` (first-come-first-served in
+    token order; overflow rows are all-zero — the token is dropped).
+
+    Slot positions are counted in f32 regardless of ``dtype`` (a bf16
+    cumsum misorders slots past 256 tokens); only the output adopts it.
+    """
+    onehot = jax.nn.one_hot(idx, n_experts, dtype=jnp.float32)   # [T, E]
+    pos = jnp.cumsum(onehot, axis=0) * onehot - onehot           # [T, E]
+    keep = (pos < capacity).astype(jnp.float32) * onehot
+    slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                          dtype=jnp.float32)                      # [T, E, C]
+    return (slot * keep[:, :, None]).astype(dtype)
+
+
+def moe_layer(wg: jax.Array, w1: jax.Array, w2: jax.Array, x: jax.Array,
+              capacity_factor: float = 2.0) -> jax.Array:
+    """One MoE FFN layer, dense single-device form.
+
+    ``wg [E, d]``, ``w1 [E, ffn, d]``, ``w2 [E, d, ffn]``, ``x [T, d]``.
+    Dispatch -> per-expert hand-VJP FFN (``ffn_block`` vmapped over the
+    expert axis) -> gate-scaled combine. Dropped tokens produce zeros.
+    """
+    n_experts = w1.shape[0]
+    cap = expert_capacity(x.shape[0], n_experts, capacity_factor)
+    idx, gate = route_top1(wg, x)
+    disp = dispatch_tensor(idx, n_experts, cap, x.dtype)          # [T, E, C]
+    xe = jnp.einsum("tec,td->ecd", disp, x)                       # [E, C, d]
+    ye = jax.vmap(ffn_block)(w1, w2, xe)                          # [E, C, d]
+    comb = disp * gate[:, None, None]
+    return jnp.einsum("tec,ecd->td", comb, ye)
+
+
+def moe_stack_fwd(params, x: jax.Array,
+                  capacity_factor: float = 2.0) -> jax.Array:
+    """Stack of MoE layers (``MoEStackParams``), block input chaining like
+    the dense stack (``train_ffns.py:72-81``)."""
+    for l in range(params.w1.shape[0]):
+        x = moe_layer(params.wg[l], params.w1[l], params.w2[l], x,
+                      capacity_factor)
+    return x

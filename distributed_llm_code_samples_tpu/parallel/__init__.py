@@ -17,6 +17,7 @@ from .tp import train_tp
 from .hybrid import train_hybrid
 from .pipeline import train_pp
 from .sequence import ring_attention, sequence_parallel_attention
+from .expert import train_moe_ep, moe_layer_ep
 
 # Method-number parity with the reference CLI (train_ffns.py:6, :373):
 # 1=single, 2=DDP, 3=FSDP, 4=TP; 5+ extend with the hybrid mesh and the
@@ -28,6 +29,7 @@ STRATEGIES = {
     4: ("train_tp", train_tp),
     5: ("train_hybrid", train_hybrid),
     6: ("train_pp", train_pp),
+    7: ("train_moe_ep", train_moe_ep),
 }
 
 __all__ = [
@@ -35,7 +37,7 @@ __all__ = [
     "DATA_AXIS", "MODEL_AXIS", "SEQ_AXIS", "PIPE_AXIS", "EXPERT_AXIS",
     "collectives",
     "train_single", "train_ddp", "train_fsdp", "train_tp", "train_hybrid",
-    "train_pp",
+    "train_pp", "train_moe_ep", "moe_layer_ep",
     "ring_attention", "sequence_parallel_attention",
     "STRATEGIES",
 ]

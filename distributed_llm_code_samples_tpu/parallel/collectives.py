@@ -48,6 +48,15 @@ def reduce_scatter(x, axis_name: str, *, dim: int = 0):
     return lax.psum_scatter(x, axis_name, scatter_dimension=dim, tiled=True)
 
 
+def all_to_all(x, axis_name: str, *, split_dim: int, concat_dim: int):
+    """Transpose shard ownership of one dimension — NCCL ``all_to_all``
+    (absent from the reference, which has no EP/Ulysses paths; SURVEY.md
+    section 2.2). Splits ``split_dim`` across the axis and concatenates the
+    received blocks on ``concat_dim`` (``tiled``)."""
+    return lax.all_to_all(x, axis_name, split_axis=split_dim,
+                          concat_axis=concat_dim, tiled=True)
+
+
 def ring_shift(x, axis_name: str, *, shift: int = 1):
     """Neighbor exchange on the axis ring via ``ppermute`` — the send/recv
     primitive (used by ring attention and the pipeline path; the reference

@@ -1,0 +1,116 @@
+"""Expert parallelism: experts sharded over the mesh, all_to_all dispatch.
+
+No reference counterpart (SURVEY.md section 2.2: expert parallelism absent);
+this is the framework's EP extension, built the same way as the other
+strategies: the per-shard program and its collectives written out by hand
+inside ``shard_map``.
+
+Layout (GShard-style, data group == expert group): tokens are sharded over
+the ``"expert"`` mesh axis (each shard routes its own ``T/n`` tokens); the
+``E`` experts' FFN weights are sharded over the same axis (``E/n`` experts
+live on each device); the router is replicated. Per layer:
+
+- each shard routes locally and builds its ``[T_local, E, C]`` dispatch,
+- ``all_to_all`` (split experts, concat capacity) carries every shard's
+  slots for experts ``e`` onto the device that owns ``e``,
+- the local experts run the hand-VJP ``ffn_block`` on their combined
+  ``[E_local, n*C, d]`` slot block,
+- the reverse ``all_to_all`` returns results for the shard's own tokens,
+  and the gate-scaled combine finishes the layer.
+
+Gradients: expert-weight grads are complete locally (every token routed to
+an expert arrives on its device — the a2a *is* the reduction's data
+movement); router grads are per-shard partial sums and get an explicit
+``psum`` (SUM, matching the framework's unscaled-LR convention,
+``train_ffns.py:165``). The backward through the a2a pair is the transposed
+a2a pair, composed by ``jax.vjp`` around the hand-written block rules.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .. import LR
+from ..data import batch_from_seed, shard_seeds_strided
+from ..models.moe import MoEStackParams
+from ..models.ffn_stack import clone_params
+from ..ops.ffn import ffn_block
+from ..ops.moe import dispatch_tensor, expert_capacity, route_top1
+from ..optim import sgd
+from .collectives import all_reduce, all_to_all
+from .launcher import launch
+from .mesh import EXPERT_AXIS, require_axes
+
+
+def moe_layer_ep(wg, w1_local, w2_local, x, capacity_factor: float = 2.0,
+                 axis: str = EXPERT_AXIS):
+    """One expert-parallel MoE layer, per-shard view.
+
+    ``wg [E, d]`` (replicated), ``w1_local [E/n, ffn, d]``,
+    ``w2_local [E/n, d, ffn]``, ``x [T_local, d]``.
+    """
+    n_experts = wg.shape[0]
+    cap = expert_capacity(x.shape[0], n_experts, capacity_factor)
+    idx, gate = route_top1(wg, x)
+    disp = dispatch_tensor(idx, n_experts, cap, x.dtype)  # [T_loc, E, C]
+    xe = jnp.einsum("tec,td->ecd", disp, x)              # [E, C, d]
+    # experts -> their owners; slots from all shards stack on the cap axis
+    xe = all_to_all(xe, axis, split_dim=0, concat_dim=1)  # [E/n, n*C, d]
+    ye = jax.vmap(ffn_block)(w1_local, w2_local, xe)      # [E/n, n*C, d]
+    # results return to the tokens' home shards
+    ye = all_to_all(ye, axis, split_dim=1, concat_dim=0)  # [E, C, d]
+    comb = disp * gate[:, None, None]
+    return jnp.einsum("tec,ecd->td", comb, ye)
+
+
+def make_step(batch_size: int, model_size: int, lr: float = LR,
+              capacity_factor: float = 2.0, axis: str = EXPERT_AXIS):
+    """One EP step for one shard: local fwd, ``jax.vjp``-composed backward
+    over the hand-written rules, explicit router-grad psum, local SGD."""
+
+    def fwd(params: MoEStackParams, x):
+        for l in range(params.w1.shape[0]):
+            x = moe_layer_ep(params.wg[l], params.w1[l], params.w2[l], x,
+                             capacity_factor, axis)
+        return x
+
+    def step(params: MoEStackParams, seed) -> MoEStackParams:
+        x, dloss_dx = batch_from_seed(seed, batch_size, model_size,
+                                      params.w1.dtype)
+        _, vjp = jax.vjp(lambda p: fwd(p, x), params)
+        grads = vjp(dloss_dx)[0]
+        # router is replicated; its per-shard partial grads sum across the
+        # expert axis (train_ffns.py:165 semantics). Expert grads are
+        # already complete on their owner shard.
+        grads = grads._replace(wg=all_reduce(grads.wg, axis))
+        return sgd(params, grads, lr)
+
+    return step
+
+
+def train_moe_ep(params: MoEStackParams, seeds, batch_size: int,
+                 model_size: int, mesh, lr: float = LR,
+                 capacity_factor: float = 2.0) -> MoEStackParams:
+    """Run the EP schedule; returns fully-assembled final params.
+
+    ``batch_size`` is the *global* token count per step; each shard routes
+    ``batch_size/n`` tokens (data and experts shard over the same axis).
+    Seeds shard stride-wise like the DP strategies (``train_ffns.py:182``).
+    """
+    require_axes(mesh, EXPERT_AXIS)
+    n = mesh.shape[EXPERT_AXIS]
+    if params.n_experts % n != 0:
+        raise ValueError(f"n_experts={params.n_experts} not divisible by "
+                         f"expert-axis size {n}")
+    if batch_size % n != 0:
+        raise ValueError(f"batch_size={batch_size} not divisible by "
+                         f"expert-axis size {n}")
+    seed_cols = shard_seeds_strided(seeds, n)
+    step = make_step(batch_size // n, model_size, lr, capacity_factor)
+    specs = MoEStackParams(wg=P(), w1=P(None, EXPERT_AXIS),
+                           w2=P(None, EXPERT_AXIS))
+    return launch(step, clone_params(params), seed_cols, mesh,
+                  param_specs=specs, seed_spec=P(None, EXPERT_AXIS),
+                  select_local=lambda s: s[:, 0])
