@@ -15,6 +15,8 @@ sequence-parallel form (ring attention over ``ppermute``) lives in
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -60,23 +62,27 @@ def attn_bwd(dy: jax.Array, q, k, v, p, causal: bool = True):
     return dq, dk, dv
 
 
-@jax.custom_vjp
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def attention(q: jax.Array, k: jax.Array, v: jax.Array,
               causal: bool = True) -> jax.Array:
-    """Attention whose differentiation rule is the hand-written VJP."""
+    """Attention whose differentiation rule is the hand-written VJP.
+
+    ``causal`` is a static (nondiff) argument: it selects the mask at trace
+    time, so the op works identically in eager code and under jit/shard_map
+    (as an operand it would be traced and break the Python branch)."""
     y, _ = attn_fwd(q, k, v, causal)
     return y
 
 
 def _attention_fwd(q, k, v, causal):
     y, (p,) = attn_fwd(q, k, v, causal)
-    return y, (q, k, v, p, causal)
+    return y, (q, k, v, p)
 
 
-def _attention_bwd(res, dy):
-    q, k, v, p, causal = res
+def _attention_bwd(causal, res, dy):
+    q, k, v, p = res
     dq, dk, dv = attn_bwd(dy, q, k, v, p, causal)
-    return dq, dk, dv, None
+    return dq, dk, dv
 
 
 attention.defvjp(_attention_fwd, _attention_bwd)

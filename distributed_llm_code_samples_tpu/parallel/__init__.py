@@ -16,7 +16,8 @@ from .fsdp import train_fsdp
 from .tp import train_tp
 from .hybrid import train_hybrid
 from .pipeline import train_pp
-from .sequence import ring_attention, sequence_parallel_attention
+from .sequence import (ring_attention, sequence_parallel_attention,
+                       ulysses_attention, ulysses_parallel_attention)
 from .expert import train_moe_ep, moe_layer_ep
 
 # Method-number parity with the reference CLI (train_ffns.py:6, :373):
@@ -39,5 +40,6 @@ __all__ = [
     "train_single", "train_ddp", "train_fsdp", "train_tp", "train_hybrid",
     "train_pp", "train_moe_ep", "moe_layer_ep",
     "ring_attention", "sequence_parallel_attention",
+    "ulysses_attention", "ulysses_parallel_attention",
     "STRATEGIES",
 ]

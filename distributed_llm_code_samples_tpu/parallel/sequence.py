@@ -72,9 +72,12 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     # mark the accumulators shard-varying so the fori_loop carry typechecks
     # under shard_map's varying-manual-axes analysis
-    m0 = lax.pvary(jnp.full((t_local,), _NEG, jnp.float32), axis_name)
-    l0 = lax.pvary(jnp.zeros((t_local,), jnp.float32), axis_name)
-    acc0 = lax.pvary(jnp.zeros((t_local, d), jnp.float32), axis_name)
+    def _varying(t):
+        return lax.pcast(t, axis_name, to="varying")
+
+    m0 = _varying(jnp.full((t_local,), _NEG, jnp.float32))
+    l0 = _varying(jnp.zeros((t_local,), jnp.float32))
+    acc0 = _varying(jnp.zeros((t_local, d), jnp.float32))
     *_, m, l, acc = lax.fori_loop(0, n, step, (k, v, m0, l0, acc0))
     return (acc / l[:, None]).astype(q.dtype)
 
@@ -100,4 +103,62 @@ def _ring_fn(mesh, causal: bool):
     spec = P(SEQ_AXIS, None)
     return jax.jit(jax.shard_map(
         functools.partial(ring_attention, axis_name=SEQ_AXIS, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec))
+
+
+# --- Ulysses: all_to_all head-scatter / sequence-gather -------------------
+#
+# The other canonical sequence-parallel scheme (DeepSpeed-Ulysses): instead
+# of rotating KV blocks around a ring, two all_to_alls re-shard the problem
+# so that attention itself runs unsharded. Shards hold a sequence block of
+# every head; the first a2a trades heads for sequence (each shard ends up
+# with the FULL sequence of H/n heads), full-sequence hand-VJP attention
+# runs locally, and the second a2a trades back. Communication is 2 a2a of
+# the activations per call (vs n-1 ppermute hops of KV for the ring) —
+# cheaper when H >= n and the sequence fits per-head; the ring wins when
+# the sequence itself must never materialize. Both are exposed; both
+# differentiate through the a2a transposes around the hand-written rule.
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      axis_name: str = SEQ_AXIS, causal: bool = True):
+    """Ulysses attention for one shard (call under ``shard_map``).
+
+    ``q, k, v: [H, T_local, dh]`` — this shard's sequence block of every
+    head; ``H`` must be divisible by the axis size. Returns the same shape,
+    exact full-sequence attention (no online-softmax approximation path).
+    """
+    from ..models.attention import mha
+    from .collectives import all_to_all
+
+    def scatter_heads(t):  # [H, T_local, dh] -> [H/n, T, dh]
+        return all_to_all(t, axis_name, split_dim=0, concat_dim=1)
+
+    y = mha(*map(scatter_heads, (q, k, v)), causal=causal)
+    return all_to_all(y, axis_name, split_dim=1, concat_dim=0)
+
+
+def ulysses_parallel_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                               mesh, causal: bool = True) -> jax.Array:
+    """Launcher: shard ``[H, T, dh]`` tensors over the ``"seq"`` axis
+    (sequence dim), run Ulysses, return the result sharded the same way."""
+    require_axes(mesh, SEQ_AXIS)
+    n = mesh.shape[SEQ_AXIS]
+    if q.shape[1] % n:
+        raise ValueError(f"sequence length {q.shape[1]} not divisible by "
+                         f"{n} seq shards")
+    if q.shape[0] % n:
+        raise ValueError(f"head count {q.shape[0]} not divisible by "
+                         f"{n} seq shards (Ulysses scatters heads)")
+    spec = P(None, SEQ_AXIS, None)
+    sharded = [jax.device_put(t, NamedSharding(mesh, spec))
+               for t in (q, k, v)]
+    return _ulysses_fn(mesh, causal)(*sharded)
+
+
+@functools.lru_cache(maxsize=32)
+def _ulysses_fn(mesh, causal: bool):
+    spec = P(None, SEQ_AXIS, None)
+    return jax.jit(jax.shard_map(
+        functools.partial(ulysses_attention, axis_name=SEQ_AXIS,
+                          causal=causal),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec))

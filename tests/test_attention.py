@@ -114,3 +114,71 @@ def test_sequence_parallel_rejects_indivisible(qkv):
     mesh = make_mesh({SEQ_AXIS: 8})
     with pytest.raises(ValueError):
         sequence_parallel_attention(q[:60], k[:60], v[:60], mesh)
+
+
+# --- Ulysses (all_to_all head-scatter) ------------------------------------
+
+H = 8
+
+
+@pytest.fixture(scope="module")
+def qkv_heads():
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(4), 3)
+    return (jax.random.normal(k1, (H, T, D)),
+            jax.random.normal(k2, (H, T, D)),
+            jax.random.normal(k3, (H, T, D)))
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("shards", [4, 8])
+def test_ulysses_matches_mha_oracle(qkv_heads, causal, shards):
+    from distributed_llm_code_samples_tpu.parallel import (
+        ulysses_parallel_attention)
+    q, k, v = qkv_heads
+    mesh = make_mesh({SEQ_AXIS: shards})
+    y = ulysses_parallel_attention(q, k, v, mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(mha(q, k, v, causal)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_ulysses_equals_ring_per_head(qkv_heads):
+    """The two sequence-parallel schemes agree with each other."""
+    from distributed_llm_code_samples_tpu.parallel import (
+        ulysses_parallel_attention)
+    q, k, v = qkv_heads
+    mesh = make_mesh({SEQ_AXIS: 4})
+    y_u = ulysses_parallel_attention(q, k, v, mesh, causal=True)
+    for h in range(H):
+        y_r = sequence_parallel_attention(q[h], k[h], v[h], mesh, causal=True)
+        np.testing.assert_allclose(np.asarray(y_u[h]), np.asarray(y_r),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_ulysses_grad_flows(qkv_heads):
+    from jax.sharding import PartitionSpec as P
+    from distributed_llm_code_samples_tpu.parallel.sequence import (
+        ulysses_attention)
+    q, k, v = qkv_heads
+    mesh = make_mesh({SEQ_AXIS: 4})
+    spec = P(None, SEQ_AXIS, None)
+
+    def loss(q, k, v):
+        f = jax.shard_map(lambda q, k, v: ulysses_attention(q, k, v, SEQ_AXIS),
+                          mesh=mesh, in_specs=(spec, spec, spec),
+                          out_specs=spec)
+        return jnp.sum(f(q, k, v) ** 2)
+
+    g_u = jax.grad(loss)(q, k, v)
+    g_ref = jax.grad(lambda q, k, v: jnp.sum(mha(q, k, v, True) ** 2))(q, k, v)
+    for a, b in zip(g_u, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_ulysses_rejects_indivisible_heads(qkv_heads):
+    from distributed_llm_code_samples_tpu.parallel import (
+        ulysses_parallel_attention)
+    q, k, v = qkv_heads
+    mesh = make_mesh({SEQ_AXIS: 8})
+    with pytest.raises(ValueError, match="head count"):
+        ulysses_parallel_attention(q[:6], k[:6], v[:6], mesh)
