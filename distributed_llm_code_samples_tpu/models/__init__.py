@@ -5,7 +5,10 @@ from .ffn_stack import (FFNStackParams, init_ffn_stack, clone_params,
                         params_size_gb)
 from .attention import attention, mha
 from .moe import MoEStackParams, init_moe_stack
+from .transformer import (TransformerParams, init_transformer,
+                          transformer_fwd)
 
 __all__ = ["FFNStackParams", "init_ffn_stack", "clone_params",
            "params_size_gb", "attention", "mha",
-           "MoEStackParams", "init_moe_stack"]
+           "MoEStackParams", "init_moe_stack",
+           "TransformerParams", "init_transformer", "transformer_fwd"]

@@ -1,0 +1,116 @@
+"""Full transformer block stack — pre-LN attention + FFN with residuals.
+
+The reference's model surface is FFN sublayers only (``README.md:6``); this
+module completes the transformer block the TPU-first way while keeping the
+framework's stance: raw stacked arrays in a NamedTuple (no module
+abstraction, ``train_ffns.py:38-39``), no biases (``:35``), every nonlinear
+op differentiated by a hand-written ``custom_vjp`` rule (attention:
+``models.attention``; FFN: ``ops.ffn``; LayerNorm: ``ops.norm``) with the
+linear projections left to ``jax.vjp``'s exact transposes.
+
+Block (pre-LN): ``x += W_o · attn(split_heads(W_q a, W_k a, W_v a))`` with
+``a = LN1(x)``, then ``x += FFN(LN2(x))``. Sequence structure matters here
+(unlike the FFN stack, where seq folds into batch, ``train_ffns.py:379``):
+activations are ``[B, T, d]`` and attention runs per batch element over
+``n_heads`` heads.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.linear import init_linear
+from ..ops.ffn import ffn_block
+from ..ops.norm import layernorm
+from .attention import mha
+
+
+class TransformerParams(NamedTuple):
+    """Stacked per-layer weights, all ``[out, in]`` transposed, no biases.
+
+    ``ln1, ln2 [L, d]`` gains; ``wq, wk, wv, wo [L, d, d]``;
+    ``w1 [L, ffn, d]``, ``w2 [L, d, ffn]`` (the FFN pair is laid out
+    exactly like ``FFNStackParams`` — the dense stack embeds in this model).
+    """
+    ln1: jax.Array
+    wq: jax.Array
+    wk: jax.Array
+    wv: jax.Array
+    wo: jax.Array
+    ln2: jax.Array
+    w1: jax.Array
+    w2: jax.Array
+
+    @property
+    def n_layers(self) -> int:
+        return self.w1.shape[0]
+
+    @property
+    def d_model(self) -> int:
+        return self.w1.shape[2]
+
+    def num_params(self) -> int:
+        return sum(l.size for l in self)
+
+
+def init_transformer(key: jax.Array, d_model: int, n_layers: int,
+                     ffn_dim: int | None = None, scale: float = 2e-2,
+                     dtype=jnp.float32) -> TransformerParams:
+    """Init all stacks; ``ffn_dim`` defaults to ``4 * d_model``. LN gains
+    start at 1."""
+    ffn_dim = 4 * d_model if ffn_dim is None else ffn_dim
+    keys = jax.random.split(key, 6 * n_layers)
+
+    def stack(off, m, n):
+        return jnp.stack([init_linear(keys[6 * l + off], m, n, scale, dtype)
+                          for l in range(n_layers)])
+
+    ones = jnp.ones((n_layers, d_model), dtype)
+    return TransformerParams(
+        ln1=ones, wq=stack(0, d_model, d_model), wk=stack(1, d_model, d_model),
+        wv=stack(2, d_model, d_model), wo=stack(3, d_model, d_model),
+        ln2=ones, w1=stack(4, d_model, ffn_dim), w2=stack(5, ffn_dim, d_model))
+
+
+def split_heads(t: jax.Array, n_heads: int) -> jax.Array:
+    """``[B, T, d] -> [B, H, T, d/H]``."""
+    b, s, d = t.shape
+    return t.reshape(b, s, n_heads, d // n_heads).transpose(0, 2, 1, 3)
+
+
+def merge_heads(t: jax.Array) -> jax.Array:
+    """``[B, H, T, dh] -> [B, T, H*dh]``."""
+    b, h, s, dh = t.shape
+    return t.transpose(0, 2, 1, 3).reshape(b, s, h * dh)
+
+
+def attn_sublayer(wq, wk, wv, wo, a: jax.Array, n_heads: int,
+                  causal: bool = True) -> jax.Array:
+    """Projections + multi-head hand-VJP attention. ``a [B, T, d]``;
+    weights ``[d_out, d]`` (``d_out`` may be a head-sharded slice under
+    TP — heads live on the leading output dim)."""
+    q, k, v = (split_heads(a @ w.T, n_heads) for w in (wq, wk, wv))
+    y = jax.vmap(lambda q, k, v: mha(q, k, v, causal))(q, k, v)
+    return merge_heads(y) @ wo.T
+
+
+def transformer_block(ln1, wq, wk, wv, wo, ln2, w1, w2, x: jax.Array,
+                      n_heads: int, causal: bool = True) -> jax.Array:
+    """One pre-LN block. ``x [B, T, d]`` -> ``[B, T, d]``."""
+    b, s, d = x.shape
+    x = x + attn_sublayer(wq, wk, wv, wo, layernorm(ln1, x), n_heads, causal)
+    f = layernorm(ln2, x).reshape(b * s, d)
+    return x + ffn_block(w1, w2, f).reshape(b, s, d)
+
+
+def transformer_fwd(params: TransformerParams, x: jax.Array, n_heads: int,
+                    causal: bool = True) -> jax.Array:
+    """Stack forward. ``x [B, T, d]``."""
+    for l in range(params.n_layers):
+        x = transformer_block(params.ln1[l], params.wq[l], params.wk[l],
+                              params.wv[l], params.wo[l], params.ln2[l],
+                              params.w1[l], params.w2[l], x, n_heads, causal)
+    return x

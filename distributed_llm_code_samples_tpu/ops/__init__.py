@@ -10,6 +10,7 @@ from .ffn import (ffn_fwd, ffn_bwd, ffn_block, ffn_bwd_saved,
 from .stack import stack_fwd, stack_bwd, stack_grads
 from .moe import (expert_capacity, route_top1, dispatch_tensor, moe_layer,
                   moe_stack_fwd)
+from .norm import ln_fwd, ln_bwd, layernorm
 
 __all__ = [
     "init_linear", "linear_fwd", "linear_bwd",
@@ -19,4 +20,5 @@ __all__ = [
     "stack_fwd", "stack_bwd", "stack_grads",
     "expert_capacity", "route_top1", "dispatch_tensor", "moe_layer",
     "moe_stack_fwd",
+    "ln_fwd", "ln_bwd", "layernorm",
 ]

@@ -1,0 +1,69 @@
+"""LayerNorm, hand-differentiated (no autograd), gain-only.
+
+The reference has no normalization (FFN sublayers only, ``README.md:6``);
+the transformer model family adds pre-LN blocks, so the norm gets the same
+first-principles treatment as the linear/ReLU core (``train_ffns.py:33-52``):
+forward written out, backward derived by hand, installed via ``custom_vjp``
+and checked against ``jax.grad`` in the tests. No bias/offset parameter —
+the framework keeps the reference's no-bias simplification
+(``train_ffns.py:35``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+EPS = 1e-5
+
+
+def ln_fwd(g: jax.Array, x: jax.Array, eps: float = EPS):
+    """Row-wise LayerNorm over the last dim. ``g [d]``, ``x [..., d]``.
+
+    Returns ``(y, (xhat, rstd))`` with the normalized input and reciprocal
+    std saved for the manual backward.
+    """
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    xc = x - mu
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = xc * rstd
+    return g * xhat, (xhat, rstd)
+
+
+def ln_bwd(dy: jax.Array, g: jax.Array, xhat: jax.Array, rstd: jax.Array):
+    """Manual LayerNorm VJP.
+
+    With ``y = g * xhat``, ``xhat = (x - mu) * rstd``:
+    ``dg = sum_rows(dy * xhat)``;
+    ``dx = rstd * (dxh - mean(dxh) - xhat * mean(dxh * xhat))`` where
+    ``dxh = dy * g`` — the standard three-term row formula (the two mean
+    terms are the VJPs through mu and var).
+    """
+    dg = jnp.sum((dy * xhat).reshape(-1, g.shape[-1]), axis=0)
+    dxh = dy * g
+    m1 = jnp.mean(dxh, axis=-1, keepdims=True)
+    m2 = jnp.mean(dxh * xhat, axis=-1, keepdims=True)
+    dx = rstd * (dxh - m1 - xhat * m2)
+    return dg, dx
+
+
+@jax.custom_vjp
+def layernorm(g: jax.Array, x: jax.Array) -> jax.Array:
+    """LayerNorm whose differentiation rule is the hand-written VJP."""
+    y, _ = ln_fwd(g, x)
+    return y
+
+
+def _layernorm_fwd(g, x):
+    y, (xhat, rstd) = ln_fwd(g, x)
+    return y, (g, xhat, rstd)
+
+
+def _layernorm_bwd(res, dy):
+    g, xhat, rstd = res
+    dg, dx = ln_bwd(dy, g, xhat, rstd)
+    return dg, dx
+
+
+layernorm.defvjp(_layernorm_fwd, _layernorm_bwd)

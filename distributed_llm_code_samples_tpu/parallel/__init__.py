@@ -19,6 +19,8 @@ from .pipeline import train_pp
 from .sequence import (ring_attention, sequence_parallel_attention,
                        ulysses_attention, ulysses_parallel_attention)
 from .expert import train_moe_ep, moe_layer_ep
+from .transformer import (train_transformer_single, train_transformer_ddp,
+                          train_transformer_tp)
 
 # Method-number parity with the reference CLI (train_ffns.py:6, :373):
 # 1=single, 2=DDP, 3=FSDP, 4=TP; 5+ extend with the hybrid mesh and the
@@ -39,6 +41,8 @@ __all__ = [
     "collectives",
     "train_single", "train_ddp", "train_fsdp", "train_tp", "train_hybrid",
     "train_pp", "train_moe_ep", "moe_layer_ep",
+    "train_transformer_single", "train_transformer_ddp",
+    "train_transformer_tp",
     "ring_attention", "sequence_parallel_attention",
     "ulysses_attention", "ulysses_parallel_attention",
     "STRATEGIES",

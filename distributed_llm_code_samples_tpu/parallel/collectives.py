@@ -48,6 +48,24 @@ def reduce_scatter(x, axis_name: str, *, dim: int = 0):
     return lax.psum_scatter(x, axis_name, scatter_dimension=dim, tiled=True)
 
 
+def grad_reduce(g, axis_name: str):
+    """Sum a *gradient* across the axis iff it is still a partial sum.
+
+    Under JAX's varying-manual-axes (vma) typing, a cotangent's provenance
+    decides its state: transposes of plain ops auto-reduce cotangents onto
+    axis-invariant (replicated) primals — the transpose of the implicit
+    ``pvary`` is a ``psum`` — so they arrive already summed (axis absent
+    from ``typeof(g).vma``); cotangents built inside hand-written
+    ``custom_vjp`` rules (this framework's entire ops layer) arrive still
+    partial (axis present). An unconditional ``psum`` would double-reduce
+    the former — grads scale by the axis size. The check is static at
+    trace time.
+    """
+    if axis_name in jax.typeof(g).vma:
+        return lax.psum(g, axis_name)
+    return g
+
+
 def all_to_all(x, axis_name: str, *, split_dim: int, concat_dim: int):
     """Transpose shard ownership of one dimension — NCCL ``all_to_all``
     (absent from the reference, which has no EP/Ulysses paths; SURVEY.md

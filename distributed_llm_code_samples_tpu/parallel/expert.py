@@ -39,7 +39,7 @@ from ..models.ffn_stack import clone_params
 from ..ops.ffn import ffn_block
 from ..ops.moe import dispatch_tensor, expert_capacity, route_top1
 from ..optim import sgd
-from .collectives import all_reduce, all_to_all
+from .collectives import all_to_all, grad_reduce
 from .launcher import launch
 from .mesh import EXPERT_AXIS, require_axes
 
@@ -84,7 +84,7 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
         # router is replicated; its per-shard partial grads sum across the
         # expert axis (train_ffns.py:165 semantics). Expert grads are
         # already complete on their owner shard.
-        grads = grads._replace(wg=all_reduce(grads.wg, axis))
+        grads = grads._replace(wg=grad_reduce(grads.wg, axis))
         return sgd(params, grads, lr)
 
     return step

@@ -1,0 +1,195 @@
+"""Transformer trainers: single-device, DDP, and Megatron-style TP.
+
+The strategies mirror the FFN-stack ones (``ddp.py``, ``tp.py``) applied to
+the full pre-LN block stack (``models.transformer``). The backward composes
+the hand-written block rules via ``jax.vjp`` (the framework's composition
+precedent), with the collectives placed by hand:
+
+- **DDP**: replicated params, strided seed shards, one grad ``psum`` per
+  step (SUM, unscaled LR — ``train_ffns.py:165`` semantics).
+- **TP**: Megatron attention + FFN sharding on the ``"model"`` axis. Heads
+  are column-parallel (``wq/wk/wv`` split on the output dim — each shard
+  runs ``H/n`` whole heads), ``wo`` row-parallel, FFN ``w1``/``w2``
+  column/row-parallel (the existing ``tp.py`` layout), LN replicated. The
+  Megatron f/g operator pair is explicit: ``g`` is the forward ``psum``
+  after each sublayer's row-parallel matmul (backward: identity — ``psum``'s
+  transpose); ``f`` is ``_f_gate`` below — identity forward, ``psum``
+  backward — applied to each sublayer's post-LN input so the partial
+  input-gradients of the column-parallel projections are summed before
+  flowing into the (replicated) LayerNorm backward. Omitting ``f`` leaves
+  ``dx`` partial and silently wrong — the TP==single differential test is
+  the guard.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .. import LR
+from ..data import batch_from_seed, shard_seeds_strided
+from ..models.ffn_stack import clone_params, reshard_copy
+from ..models.transformer import (TransformerParams, attn_sublayer,
+                                  transformer_fwd)
+from ..ops.ffn import ffn_block
+from ..ops.norm import layernorm
+from ..optim import sgd
+from .collectives import all_reduce, grad_reduce
+from .launcher import launch
+from .mesh import DATA_AXIS, MODEL_AXIS, require_axes
+
+# TP layout: column-parallel projections shard the output dim (heads for
+# attention, ffn features for w1); row-parallel shard the input dim.
+TP_SPECS = TransformerParams(
+    ln1=P(), wq=P(None, MODEL_AXIS, None), wk=P(None, MODEL_AXIS, None),
+    wv=P(None, MODEL_AXIS, None), wo=P(None, None, MODEL_AXIS),
+    ln2=P(), w1=P(None, MODEL_AXIS, None), w2=P(None, None, MODEL_AXIS))
+
+
+def _f_gate(axis: str):
+    """Megatron's ``f`` operator: identity forward, all-reduce backward —
+    but *vma-aware*. Under JAX's varying-manual-axes typing, cotangents
+    flowing back through plain ops are auto-reduced when they cross an
+    implicit ``pvary`` (its transpose is ``psum``), while cotangents
+    produced inside hand-written ``custom_vjp`` rules (``ffn_block``,
+    ``attention``) come back still partial (axis in ``typeof(dy).vma``).
+    The gate psums exactly when the cotangent is still partial — a static,
+    trace-time check — so neither path is double-reduced. (The symptom of
+    an unconditional psum: LN grads scale by the axis size on whichever
+    sublayer's backward was auto-reduced.)"""
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    f.defvjp(lambda x: (x, None), lambda _, dy: (grad_reduce(dy, axis),))
+    return f
+
+
+def _reshape_batch(seed, tokens: int, seq_len: int, model_size: int, dtype):
+    x, dloss_dx = batch_from_seed(seed, tokens, model_size, dtype)
+    b = tokens // seq_len
+    return (x.reshape(b, seq_len, model_size),
+            dloss_dx.reshape(b, seq_len, model_size))
+
+
+def _make_single_step(tokens: int, model_size: int, seq_len: int,
+                      n_heads: int, lr: float, causal: bool = True):
+    def step(params: TransformerParams, seed) -> TransformerParams:
+        x, dloss_dx = _reshape_batch(seed, tokens, seq_len, model_size,
+                                     params.w1.dtype)
+        _, vjp = jax.vjp(lambda p: transformer_fwd(p, x, n_heads, causal),
+                         params)
+        return sgd(params, vjp(dloss_dx)[0], lr)
+
+    return step
+
+
+def train_transformer_single(params: TransformerParams, seeds,
+                             batch_size: int, model_size: int, mesh=None,
+                             lr: float = LR, *, seq_len: int, n_heads: int,
+                             causal: bool = True) -> TransformerParams:
+    """Single-device trainer; ``batch_size`` is tokens/step (seq folded,
+    CLI convention ``train_ffns.py:379``), unfolded to
+    ``[batch_size/seq_len, seq_len, d]`` for attention."""
+    if batch_size % seq_len:
+        raise ValueError(f"tokens {batch_size} not divisible by "
+                         f"seq_len {seq_len}")
+    step = _make_single_step(batch_size, model_size, seq_len, n_heads, lr,
+                             causal)
+
+    @jax.jit
+    def run(params, seeds):
+        return lax.scan(lambda p, s: (step(p, s), None), params, seeds)[0]
+
+    return run(clone_params(params), jnp.asarray(seeds))
+
+
+def train_transformer_ddp(params: TransformerParams, seeds, batch_size: int,
+                          model_size: int, mesh, lr: float = LR, *,
+                          seq_len: int, n_heads: int,
+                          causal: bool = True) -> TransformerParams:
+    """DDP: each shard trains its seed column on the full replicated model;
+    grads psum per step."""
+    require_axes(mesh, DATA_AXIS)
+    n = mesh.shape[DATA_AXIS]
+    if batch_size % seq_len:
+        raise ValueError(f"tokens {batch_size} not divisible by "
+                         f"seq_len {seq_len}")
+    seed_cols = shard_seeds_strided(seeds, n)
+
+    def step(params: TransformerParams, seed) -> TransformerParams:
+        x, dloss_dx = _reshape_batch(seed, batch_size, seq_len, model_size,
+                                     params.w1.dtype)
+        _, vjp = jax.vjp(lambda p: transformer_fwd(p, x, n_heads, causal),
+                         params)
+        grads = vjp(dloss_dx)[0]
+        grads = jax.tree_util.tree_map(
+            lambda g: grad_reduce(g, DATA_AXIS), grads)
+        return sgd(params, grads, lr)
+
+    return launch(step, clone_params(params), seed_cols, mesh,
+                  param_specs=P(), seed_spec=P(None, DATA_AXIS),
+                  select_local=lambda s: s[:, 0])
+
+
+def tp_block(ln1, wq, wk, wv, wo, ln2, w1, w2, x, n_heads_local: int,
+             axis: str = MODEL_AXIS, causal: bool = True):
+    """One TP transformer block, per-shard view (local weights)."""
+    f = _f_gate(axis)
+    b, s, d = x.shape
+    a = f(layernorm(ln1, x))
+    x = x + all_reduce(                                    # Megatron g
+        attn_sublayer(wq, wk, wv, wo, a, n_heads_local, causal), axis)
+    h = f(layernorm(ln2, x)).reshape(b * s, d)
+    y = all_reduce(ffn_block(w1, w2, h), axis)             # Megatron g
+    return x + y.reshape(b, s, d)
+
+
+def train_transformer_tp(params: TransformerParams, seeds, batch_size: int,
+                         model_size: int, mesh, lr: float = LR, *,
+                         seq_len: int, n_heads: int,
+                         causal: bool = True) -> TransformerParams:
+    """Megatron TP over the ``"model"`` axis: data replicated, heads and
+    FFN features sharded, two psums per block per direction
+    (``train_ffns.py:303, :309`` cadence on the transformer block)."""
+    require_axes(mesh, MODEL_AXIS)
+    n = mesh.shape[MODEL_AXIS]
+    if n_heads % n:
+        raise ValueError(f"n_heads={n_heads} not divisible by model-axis "
+                         f"size {n}")
+    ffn_dim = params.w1.shape[1]
+    if ffn_dim % n:
+        raise ValueError(f"ffn_dim={ffn_dim} not divisible by model-axis "
+                         f"size {n}")
+    if batch_size % seq_len:
+        raise ValueError(f"tokens {batch_size} not divisible by "
+                         f"seq_len {seq_len}")
+    h_local = n_heads // n
+
+    def step(params: TransformerParams, seed) -> TransformerParams:
+        x, dloss_dx = _reshape_batch(seed, batch_size, seq_len, model_size,
+                                     params.w1.dtype)
+
+        def fwd(p):
+            y = x
+            for l in range(p.w1.shape[0]):
+                y = tp_block(p.ln1[l], p.wq[l], p.wk[l], p.wv[l], p.wo[l],
+                             p.ln2[l], p.w1[l], p.w2[l], y, h_local,
+                             causal=causal)
+            return y
+
+        _, vjp = jax.vjp(fwd, params)
+        grads = vjp(dloss_dx)[0]
+        # projection/FFN grads are shard-local (each shard owns its heads/
+        # features); LN grads replicate — data and dx are identical on all
+        # shards after the f-gate psums, so no further reduction is needed
+        return sgd(params, grads, lr)
+
+    sharded = reshard_copy(params, jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec), TP_SPECS,
+        is_leaf=lambda v: isinstance(v, P)))
+    return launch(step, sharded, jnp.asarray(seeds), mesh,
+                  param_specs=TP_SPECS, seed_spec=P())

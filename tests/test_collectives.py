@@ -111,6 +111,38 @@ def test_barrier_preserves_value(mesh8):
     np.testing.assert_array_equal(got, x)
 
 
+def test_grad_reduce_both_regimes(mesh8):
+    """grad_reduce must sum exactly once whether the cotangent was already
+    auto-reduced (plain-op transpose) or arrives partial (custom_vjp rule).
+    Both losses below are mathematically identical: sum over shards of
+    w . x_shard, so dw = sum(x) in both cases."""
+    x = np.random.default_rng(7).normal(size=(N, 4)).astype(np.float32)
+    w = np.random.default_rng(8).normal(size=(4,)).astype(np.float32)
+
+    @jax.custom_vjp
+    def dot_manual(w, xs):
+        return jnp.vdot(w, xs)
+
+    dot_manual.defvjp(lambda w, xs: (jnp.vdot(w, xs), (w, xs)),
+                      lambda res, dy: (dy * res[1], dy * res[0]))
+
+    def make_loss(dot):
+        def body(w, xs):  # w replicated, xs one shard row
+            g = jax.grad(lambda w: dot(w, xs[0]))(w)
+            return coll.grad_reduce(g, DATA_AXIS)
+
+        return jax.jit(jax.shard_map(body, mesh=mesh8,
+                                     in_specs=(P(), P(DATA_AXIS)),
+                                     out_specs=P()))
+
+    expected = x.sum(axis=0)
+    plain = make_loss(lambda w, xs: jnp.vdot(w, xs))(jnp.asarray(w),
+                                                     jnp.asarray(x))
+    manual = make_loss(dot_manual)(jnp.asarray(w), jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(plain), expected, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(manual), expected, rtol=1e-6)
+
+
 def test_repeated_collective_rounds(mesh8):
     # test_torch_distributed.py:13-21 — 10 rounds of all_reduce on the same
     # group; value after k rounds of summing N copies is x * N^k.

@@ -1,0 +1,182 @@
+"""Transformer model family tests.
+
+Oracles: an independent plain-jnp implementation differentiated with
+``jax.grad`` (for the hand-written LN/attention/FFN rules), and the
+single-device trainer (for the TP/DDP differential checks,
+``train_ffns.py:386-391`` stance).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llm_code_samples_tpu.data import (batch_from_seed,
+                                                   make_seed_schedule)
+from distributed_llm_code_samples_tpu.models import (TransformerParams,
+                                                     init_transformer,
+                                                     transformer_fwd)
+from distributed_llm_code_samples_tpu.ops.norm import layernorm, ln_fwd
+from distributed_llm_code_samples_tpu.optim import sgd
+from distributed_llm_code_samples_tpu.parallel import (
+    DATA_AXIS, MODEL_AXIS, make_mesh, train_transformer_ddp,
+    train_transformer_single, train_transformer_tp)
+
+B, T, D, H, L = 2, 16, 32, 4, 2
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_transformer(jax.random.PRNGKey(0), D, L)
+
+
+@pytest.fixture(scope="module")
+def x():
+    return jax.random.normal(jax.random.PRNGKey(1), (B, T, D))
+
+
+# --- LayerNorm op ---------------------------------------------------------
+
+def _ln_ref(g, x, eps=1e-5):
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return g * (x - mu) / jnp.sqrt(var + eps)
+
+
+def test_ln_fwd_matches_ref():
+    g = jax.random.normal(jax.random.PRNGKey(2), (D,))
+    xx = jax.random.normal(jax.random.PRNGKey(3), (8, D))
+    y, _ = ln_fwd(g, xx)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(_ln_ref(g, xx)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_ln_bwd_matches_autograd():
+    g = jax.random.normal(jax.random.PRNGKey(2), (D,))
+    xx = jax.random.normal(jax.random.PRNGKey(3), (8, D))
+    dy = jax.random.normal(jax.random.PRNGKey(4), (8, D))
+    _, vjp_man = jax.vjp(layernorm, g, xx)
+    _, vjp_ref = jax.vjp(_ln_ref, g, xx)
+    for a, b in zip(vjp_man(dy), vjp_ref(dy)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+# --- Block / stack vs independent reference -------------------------------
+
+def _ref_fwd(p: TransformerParams, x, n_heads):
+    """Independent plain-jnp transformer (no custom_vjp rules anywhere)."""
+    def attn(q, k, v):  # [T, dh] single head, causal
+        s = (q @ k.T) / jnp.sqrt(jnp.asarray(q.shape[-1], q.dtype))
+        mask = jnp.tril(jnp.ones((q.shape[0], q.shape[0]), bool))
+        s = jnp.where(mask, s, -jnp.inf)
+        return jax.nn.softmax(s, -1) @ v
+
+    for l in range(p.n_layers):
+        a = _ln_ref(p.ln1[l], x)
+        q, k, v = (jnp.einsum("btd,ed->bte", a, w).reshape(
+            B, T, n_heads, D // n_heads).transpose(0, 2, 1, 3)
+            for w in (p.wq[l], p.wk[l], p.wv[l]))
+        y = jax.vmap(jax.vmap(attn))(q, k, v)
+        y = y.transpose(0, 2, 1, 3).reshape(B, T, D)
+        x = x + jnp.einsum("btd,ed->bte", y, p.wo[l])
+        f = _ln_ref(p.ln2[l], x)
+        h = jnp.maximum(jnp.einsum("btd,fd->btf", f, p.w1[l]), 0.0)
+        x = x + jnp.einsum("btf,df->btd", h, p.w2[l])
+    return x
+
+
+def test_transformer_fwd_matches_ref(params, x):
+    y = transformer_fwd(params, x, H)
+    np.testing.assert_allclose(np.asarray(y),
+                               np.asarray(_ref_fwd(params, x, H)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_transformer_grads_match_autograd(params, x):
+    """The composed hand-written rules (LN + attention + FFN) equal full
+    autograd of the independent reference."""
+    dy = 0.1 * jax.random.normal(jax.random.PRNGKey(5), (B, T, D))
+    _, vjp_man = jax.vjp(lambda p: transformer_fwd(p, x, H), params)
+    _, vjp_ref = jax.vjp(lambda p: _ref_fwd(p, x, H), params)
+    g_man, g_ref = vjp_man(dy)[0], vjp_ref(dy)[0]
+    for name, a, b in zip(TransformerParams._fields, g_man, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4,
+                                   atol=1e-5, err_msg=name)
+
+
+# --- Strategies -----------------------------------------------------------
+
+TOKENS = B * T
+
+
+def test_tp_matches_single(params):
+    """Megatron TP (heads + FFN sharded, f/g operator pair) == single-device
+    on identical seeds — exact semantics, the f-gate guard."""
+    seeds = make_seed_schedule(3, random_seed=11)
+    single = train_transformer_single(params, seeds, TOKENS, D, lr=0.05,
+                                      seq_len=T, n_heads=H)
+    mesh = make_mesh({MODEL_AXIS: 4})
+    tp = train_transformer_tp(params, seeds, TOKENS, D, mesh, lr=0.05,
+                              seq_len=T, n_heads=H)
+    for name, a, b in zip(TransformerParams._fields, tp, single):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4,
+                                   atol=1e-5, err_msg=name)
+
+
+def test_ddp_matches_summed_grad_oracle(params):
+    """One DDP step over 4 shards == one oracle step whose grads are the
+    SUM of the 4 per-seed grads (train_ffns.py:165 semantics)."""
+    n = 4
+    seeds = make_seed_schedule(n, random_seed=7)
+    mesh = make_mesh({DATA_AXIS: n})
+    ddp = train_transformer_ddp(params, seeds, TOKENS, D, mesh, lr=0.05,
+                                seq_len=T, n_heads=H)
+
+    def seed_grads(seed):
+        xx, dloss = batch_from_seed(seed, TOKENS, D, jnp.float32)
+        xx, dloss = xx.reshape(B, T, D), dloss.reshape(B, T, D)
+        _, vjp = jax.vjp(lambda p: transformer_fwd(p, xx, H), params)
+        return vjp(dloss)[0]
+
+    total = seed_grads(seeds[0])
+    for s in seeds[1:]:
+        total = jax.tree_util.tree_map(jnp.add, total, seed_grads(s))
+    oracle = sgd(params, total, 0.05)
+    for name, a, b in zip(TransformerParams._fields, ddp, oracle):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4,
+                                   atol=1e-5, err_msg=name)
+
+
+def test_tp_rejects_indivisible_heads(params):
+    mesh = make_mesh({MODEL_AXIS: 8})
+    with pytest.raises(ValueError, match="n_heads"):
+        train_transformer_tp(params, make_seed_schedule(1, 1), TOKENS, D,
+                             mesh, seq_len=T, n_heads=H)  # 4 heads, 8 shards
+
+
+def test_tp_rejects_indivisible_ffn(params):
+    mesh = make_mesh({MODEL_AXIS: 2})
+    odd = init_transformer(jax.random.PRNGKey(0), D, L, ffn_dim=101)
+    with pytest.raises(ValueError, match="ffn_dim"):
+        train_transformer_tp(odd, make_seed_schedule(1, 1), TOKENS, D,
+                             mesh, seq_len=T, n_heads=2)
+
+
+def test_non_causal_tp_matches_single(params):
+    """causal=False threads through both trainers consistently."""
+    seeds = make_seed_schedule(2, random_seed=4)
+    single = train_transformer_single(params, seeds, TOKENS, D, lr=0.05,
+                                      seq_len=T, n_heads=H, causal=False)
+    mesh = make_mesh({MODEL_AXIS: 4})
+    tp = train_transformer_tp(params, seeds, TOKENS, D, mesh, lr=0.05,
+                              seq_len=T, n_heads=H, causal=False)
+    for name, a, b in zip(TransformerParams._fields, tp, single):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4,
+                                   atol=1e-5, err_msg=name)
+
+
+def test_seq_len_divisibility(params):
+    with pytest.raises(ValueError, match="seq_len"):
+        train_transformer_single(params, make_seed_schedule(1, 1), 33, D,
+                                 seq_len=T, n_heads=H)
