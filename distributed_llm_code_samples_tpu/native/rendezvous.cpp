@@ -15,8 +15,12 @@
 #include <arpa/inet.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
 #include <sys/socket.h>
 #include <unistd.h>
+
+#include <cerrno>
+#include <chrono>
 
 #include <cstdint>
 #include <cstring>
@@ -58,6 +62,30 @@ bool recv_all(int fd, void* buf, size_t n) {
     n -= static_cast<size_t>(r);
   }
   return true;
+}
+
+// recv with a wall-clock deadline; returns 0 ok, 1 socket error, 2 timeout.
+int recv_all_deadline(int fd, void* buf, size_t n,
+                      std::chrono::steady_clock::time_point deadline) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+                    deadline - std::chrono::steady_clock::now())
+                    .count();
+    if (left <= 0) return 2;
+    pollfd pfd{fd, POLLIN, 0};
+    int pr = ::poll(&pfd, 1, static_cast<int>(left));
+    if (pr == 0) return 2;
+    if (pr < 0) {
+      if (errno == EINTR) continue;  // signal (SIGCHLD etc.), not a failure
+      return 1;
+    }
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return 1;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return 0;
 }
 
 }  // namespace
@@ -145,6 +173,31 @@ int dlcs_rdzv_barrier(void* h) {
   if (!send_all(R->coord_fd, &tok, 1)) return 1;
   if (!recv_all(R->coord_fd, &tok, 1)) return 1;
   return 0;
+}
+
+// Barrier with failure detection: like dlcs_rdzv_barrier, but any peer that
+// fails to arrive within timeout_ms is detected instead of hanging forever
+// (the reference's join() has no timeout, train_ffns.py:190-191).
+// Returns 0 ok, 1 socket error (peer died), 2 timeout (peer wedged).
+// After a nonzero return the handle is desynchronized (tokens may remain
+// buffered on some sockets) and must not be reused for further barriers —
+// detection hands off to recovery: tear the group down and re-rendezvous.
+int dlcs_rdzv_barrier_timeout(void* h, int timeout_ms) {
+  auto* R = static_cast<Rendezvous*>(h);
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  char tok = 1;
+  if (R->rank == 0) {
+    for (int fd : R->peer_fds) {
+      int rc = recv_all_deadline(fd, &tok, 1, deadline);
+      if (rc != 0) return rc;
+    }
+    for (int fd : R->peer_fds)
+      if (!send_all(fd, &tok, 1)) return 1;
+    return 0;
+  }
+  if (!send_all(R->coord_fd, &tok, 1)) return 1;
+  return recv_all_deadline(R->coord_fd, &tok, 1, deadline);
 }
 
 void dlcs_rdzv_destroy(void* h) { delete static_cast<Rendezvous*>(h); }

@@ -1,13 +1,17 @@
-"""Runtime layer: process bootstrap + native C++ components.
+"""Runtime layer: process bootstrap + native C++ components + failure
+supervision.
 
 The TPU-native replacement for the reference's L0/L4 runtime surface
 (SURVEY.md): ``init`` wraps the multi-host bootstrap
 (``jax.distributed``); ``native`` binds the in-tree C++ engines (host ring
-collectives, prefetching data loader, TCP rendezvous/barrier, XLA FFI
-custom calls).
+collectives, prefetching data loader, TCP rendezvous/barrier with timeout,
+watchdog, XLA FFI custom calls); ``failure`` adds hang/peer/device failure
+detection and checkpoint-based elastic recovery.
 """
 
 from . import native
+from .failure import (HealthCheckError, device_healthcheck, supervise)
 from .init import initialize, runtime_info, DEFAULT_COORDINATOR
 
-__all__ = ["native", "initialize", "runtime_info", "DEFAULT_COORDINATOR"]
+__all__ = ["native", "initialize", "runtime_info", "DEFAULT_COORDINATOR",
+           "HealthCheckError", "device_healthcheck", "supervise"]

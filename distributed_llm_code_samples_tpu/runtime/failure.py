@@ -1,0 +1,86 @@
+"""Failure detection + elastic recovery supervisor.
+
+The reference has none of this: no try/except around workers, no timeout on
+``join`` (``train_ffns.py:190-191``), no restart, no health checks
+(SURVEY.md section 5). This module is the framework's answer, built from
+the pieces the other subsystems provide:
+
+- **detection**: the native ``Watchdog`` (hang detection,
+  ``native/watchdog.cpp``), ``Rendezvous.barrier_timeout`` (dead/wedged
+  peer detection at sync points), and ``device_healthcheck`` (a tiny
+  compiled program proves each device still executes);
+- **recovery**: ``supervise`` wraps ``checkpoint.run_with_checkpointing``
+  — on failure it restarts the run, which resumes from the last published
+  checkpoint and (by the checkpoint subsystem's exact-resume contract)
+  lands on the same final params as an uninterrupted run.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+import jax
+import numpy as np
+
+from ..checkpoint import run_with_checkpointing
+
+
+class HealthCheckError(RuntimeError):
+    """A device failed the liveness probe."""
+
+
+def device_healthcheck(devices=None, timeout_s: float = 30.0) -> list:
+    """Prove each device still compiles and executes: run ``x + 1`` on a
+    tiny buffer per device and check the result. Returns the healthy
+    devices; raises ``HealthCheckError`` naming the first failure.
+
+    (A hung device surfaces as the jit call blocking — pair the probe with
+    a ``Watchdog`` when that matters; XLA offers no portable async cancel.)
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    healthy = []
+    for d in devices:
+        t0 = time.monotonic()
+        try:
+            y = jax.device_put(np.ones((8,), np.float32), d) + 1.0
+            ok = bool(np.all(np.asarray(y) == 2.0))
+        except Exception as e:  # noqa: BLE001 — any backend error is a failure
+            raise HealthCheckError(f"device {d} failed liveness probe: {e}")
+        if not ok:
+            raise HealthCheckError(f"device {d} returned wrong result")
+        if time.monotonic() - t0 > timeout_s:
+            raise HealthCheckError(f"device {d} probe exceeded {timeout_s}s")
+        healthy.append(d)
+    return healthy
+
+
+def supervise(train_fn: Callable, params, seeds, *args,
+              ckpt_dir: str, every: int, max_restarts: int = 3,
+              on_failure: Callable[[int, BaseException], None] | None = None,
+              healthcheck: bool = False, **kwargs):
+    """Run a strategy launcher under failure supervision.
+
+    Each attempt drives ``run_with_checkpointing`` (segment size ``every``);
+    a raised exception costs one restart, optionally re-probes the devices,
+    and the next attempt resumes from the last published checkpoint — work
+    completed before the failure is never recomputed, and the final params
+    equal an uninterrupted run (tests/test_failure.py). ``on_failure`` is
+    called with ``(attempt, exception)`` before each restart.
+    """
+    last: BaseException | None = None
+    for attempt in range(max_restarts + 1):
+        try:
+            return run_with_checkpointing(train_fn, params, seeds, *args,
+                                          ckpt_dir=ckpt_dir, every=every,
+                                          **kwargs)
+        except Exception as e:  # noqa: BLE001 — supervisor catches all
+            last = e
+            if attempt == max_restarts:
+                break  # exhausted: no restart follows, skip the probes
+            if on_failure is not None:
+                on_failure(attempt, e)
+            if healthcheck:
+                device_healthcheck()
+    raise RuntimeError(
+        f"training failed after {max_restarts} restarts") from last

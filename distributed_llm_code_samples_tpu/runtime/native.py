@@ -62,7 +62,16 @@ def lib() -> ctypes.CDLL:
         for f in ("dlcs_rdzv_rank", "dlcs_rdzv_world", "dlcs_rdzv_barrier"):
             getattr(_LIB, f).restype = ctypes.c_int
             getattr(_LIB, f).argtypes = [ctypes.c_void_p]
+        _LIB.dlcs_rdzv_barrier_timeout.restype = ctypes.c_int
+        _LIB.dlcs_rdzv_barrier_timeout.argtypes = [ctypes.c_void_p,
+                                                   ctypes.c_int]
         _LIB.dlcs_rdzv_destroy.argtypes = [ctypes.c_void_p]
+        _LIB.dlcs_watchdog_create.restype = ctypes.c_void_p
+        _LIB.dlcs_watchdog_create.argtypes = [ctypes.c_int]
+        _LIB.dlcs_watchdog_kick.argtypes = [ctypes.c_void_p]
+        _LIB.dlcs_watchdog_expired.restype = ctypes.c_int
+        _LIB.dlcs_watchdog_expired.argtypes = [ctypes.c_void_p]
+        _LIB.dlcs_watchdog_destroy.argtypes = [ctypes.c_void_p]
     return _LIB
 
 
@@ -209,9 +218,65 @@ class Rendezvous:
         if lib().dlcs_rdzv_barrier(self._h) != 0:
             raise RuntimeError("barrier failed")
 
+    def barrier_timeout(self, timeout_ms: int) -> None:
+        """Barrier that detects dead/wedged peers instead of hanging
+        (the reference's join() has no timeout, ``train_ffns.py:190-191``).
+        Raises ``PeerFailure`` with the failure kind. After a failure the
+        group is desynchronized (in-flight tokens may remain buffered):
+        ``close()`` it and re-rendezvous — detection hands off to
+        recovery, it does not resume the same barrier."""
+        rc = lib().dlcs_rdzv_barrier_timeout(self._h, timeout_ms)
+        if rc == 1:
+            raise PeerFailure("peer connection lost (process died)")
+        if rc == 2:
+            raise PeerFailure(f"peer missed barrier within {timeout_ms}ms "
+                              "(wedged)")
+
     def close(self) -> None:
         if self._h:
             lib().dlcs_rdzv_destroy(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class PeerFailure(RuntimeError):
+    """A rendezvous peer died or missed a sync deadline."""
+
+
+class Watchdog:
+    """Native hang detector: a monitor thread (immune to a GIL held by hung
+    Python) latches ``expired`` if ``kick()`` isn't called within
+    ``timeout_ms``. Check the latch *before* kicking — ``kick()`` clears
+    it. Usage::
+
+        with Watchdog(5_000) as dog:
+            for step in schedule:
+                train_step(...)
+                if dog.expired:   # this step overran the deadline
+                    recover()
+                dog.kick()        # re-arm for the next step
+    """
+
+    def __init__(self, timeout_ms: int):
+        self._h = lib().dlcs_watchdog_create(timeout_ms)
+        if not self._h:
+            raise RuntimeError("watchdog thread creation failed")
+
+    def kick(self) -> None:
+        lib().dlcs_watchdog_kick(self._h)
+
+    @property
+    def expired(self) -> bool:
+        return bool(lib().dlcs_watchdog_expired(self._h))
+
+    def close(self) -> None:
+        if self._h:
+            lib().dlcs_watchdog_destroy(self._h)
             self._h = None
 
     def __enter__(self):
