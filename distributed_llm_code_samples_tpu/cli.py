@@ -29,21 +29,24 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-l", "--layers", type=int, default=1)
     p.add_argument("-d", "--model_size", type=int, default=4)
     p.add_argument("-m", "--method", type=int, default=0,
-                   choices=range(8),
+                   choices=range(9),
                    help="0=all(1-4), 1=single, 2=DDP, 3=FSDP, 4=TP, "
                         "5=hybrid DDP x TP, 6=pipeline (ppermute send/recv), "
-                        "7=MoE expert parallelism (all_to_all)")
+                        "7=MoE expert parallelism (all_to_all), "
+                        "8=transformer blocks (Megatron TP; --heads)")
     p.add_argument("-r", "--random_seed", type=int, default=0,
                    help="!=0 makes runs reproducible (train_ffns.py:350)")
     # TPU-build extensions
     p.add_argument("--dp", type=int, default=0,
                    help="data-axis size for --method 5 (0 = devices//tp)")
     p.add_argument("--tp", type=int, default=2,
-                   help="model-axis size for --method 5")
+                   help="model-axis size for --method 5 and 8")
     p.add_argument("--microbatches", type=int, default=0,
                    help="GPipe microbatches for --method 6 (0 = n_stages)")
     p.add_argument("--experts", type=int, default=8,
                    help="expert count for --method 7 (MoE)")
+    p.add_argument("--heads", type=int, default=4,
+                   help="attention heads for --method 8 (transformer)")
     p.add_argument("--lr", type=float, default=None,
                    help="override LR (default 1e-5, train_ffns.py:29)")
     p.add_argument("--dtype", choices=["float32", "bfloat16"],
@@ -89,7 +92,8 @@ def main(argv=None) -> int:
 
     from . import LR
     from .data import make_seed_schedule
-    from .models import init_ffn_stack, init_moe_stack, params_size_gb
+    from .models import (init_ffn_stack, init_moe_stack, init_transformer,
+                         params_size_gb)
     from .parallel import (make_mesh, guard_multi_device, STRATEGIES,
                            DATA_AXIS, MODEL_AXIS, PIPE_AXIS, EXPERT_AXIS)
 
@@ -107,6 +111,9 @@ def main(argv=None) -> int:
     if args.method == 7:
         params = init_moe_stack(key, args.model_size, args.layers,
                                 args.experts, dtype=dtype)
+    elif args.method == 8:
+        params = init_transformer(key, args.model_size, args.layers,
+                                  dtype=dtype)
     else:
         params = init_ffn_stack(key, args.model_size, args.layers,
                                 dtype=dtype)
@@ -133,6 +140,10 @@ def main(argv=None) -> int:
             return make_mesh({PIPE_AXIS: n_dev})
         if method == 7:
             return make_mesh({EXPERT_AXIS: n_dev})
+        if method == 8:
+            # model axis sized by --tp (like method 5): all-devices would
+            # demand n_heads divisible by every possible device count
+            return make_mesh({MODEL_AXIS: min(args.tp, n_dev)})
         tp = args.tp
         dp = args.dp or max(1, n_dev // tp)
         return make_mesh({DATA_AXIS: dp, MODEL_AXIS: tp})
@@ -149,6 +160,8 @@ def main(argv=None) -> int:
                 kwargs["n_microbatches"] = args.microbatches
         if m == 7:
             kwargs = dict(lr=lr)  # EP's expert loop has its own structure
+        if m == 8:
+            kwargs = dict(lr=lr, seq_len=args.seq_len, n_heads=args.heads)
         if m == 1 and args.pallas:
             kwargs["use_pallas"] = True
             kwargs["interpret"] = jax.default_backend() != "tpu"

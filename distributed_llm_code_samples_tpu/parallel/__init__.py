@@ -2,9 +2,12 @@
 
 Dispatch surface mirrors the reference's ``fns`` table
 (``train_ffns.py:373``): single-device, DDP, FSDP, TP — plus the hybrid
-DDP x TP mesh the BASELINE adds. All launchers share the uniform signature
+DDP x TP mesh the BASELINE adds, pipeline, MoE expert parallelism, and the
+transformer trainers. Launchers share the uniform positional signature
 ``train(params, seeds, batch_size, model_size, mesh, lr) -> params``
-(SURVEY.md L4).
+(SURVEY.md L4); the transformer entries additionally require keyword-only
+``seq_len``/``n_heads`` (attention needs real sequence structure), so
+generic consumers of ``STRATEGIES`` must pass those for method 8.
 """
 
 from .mesh import (make_mesh, guard_multi_device, DATA_AXIS, MODEL_AXIS,
@@ -33,6 +36,7 @@ STRATEGIES = {
     5: ("train_hybrid", train_hybrid),
     6: ("train_pp", train_pp),
     7: ("train_moe_ep", train_moe_ep),
+    8: ("train_transformer_tp", train_transformer_tp),
 }
 
 __all__ = [

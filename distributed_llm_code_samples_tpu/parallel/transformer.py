@@ -75,6 +75,16 @@ def _reshape_batch(seed, tokens: int, seq_len: int, model_size: int, dtype):
             dloss_dx.reshape(b, seq_len, model_size))
 
 
+def _validate_shapes(batch_size: int, seq_len: int, model_size: int,
+                     n_heads: int) -> None:
+    if batch_size % seq_len:
+        raise ValueError(f"tokens {batch_size} not divisible by "
+                         f"seq_len {seq_len}")
+    if model_size % n_heads:
+        raise ValueError(f"model_size={model_size} not divisible by "
+                         f"n_heads={n_heads} (head dim must be whole)")
+
+
 def _make_single_step(tokens: int, model_size: int, seq_len: int,
                       n_heads: int, lr: float, causal: bool = True):
     def step(params: TransformerParams, seed) -> TransformerParams:
@@ -94,9 +104,7 @@ def train_transformer_single(params: TransformerParams, seeds,
     """Single-device trainer; ``batch_size`` is tokens/step (seq folded,
     CLI convention ``train_ffns.py:379``), unfolded to
     ``[batch_size/seq_len, seq_len, d]`` for attention."""
-    if batch_size % seq_len:
-        raise ValueError(f"tokens {batch_size} not divisible by "
-                         f"seq_len {seq_len}")
+    _validate_shapes(batch_size, seq_len, model_size, n_heads)
     step = _make_single_step(batch_size, model_size, seq_len, n_heads, lr,
                              causal)
 
@@ -115,9 +123,7 @@ def train_transformer_ddp(params: TransformerParams, seeds, batch_size: int,
     grads psum per step."""
     require_axes(mesh, DATA_AXIS)
     n = mesh.shape[DATA_AXIS]
-    if batch_size % seq_len:
-        raise ValueError(f"tokens {batch_size} not divisible by "
-                         f"seq_len {seq_len}")
+    _validate_shapes(batch_size, seq_len, model_size, n_heads)
     seed_cols = shard_seeds_strided(seeds, n)
 
     def step(params: TransformerParams, seed) -> TransformerParams:
@@ -164,9 +170,7 @@ def train_transformer_tp(params: TransformerParams, seeds, batch_size: int,
     if ffn_dim % n:
         raise ValueError(f"ffn_dim={ffn_dim} not divisible by model-axis "
                          f"size {n}")
-    if batch_size % seq_len:
-        raise ValueError(f"tokens {batch_size} not divisible by "
-                         f"seq_len {seq_len}")
+    _validate_shapes(batch_size, seq_len, model_size, n_heads)
     h_local = n_heads // n
 
     def step(params: TransformerParams, seed) -> TransformerParams:

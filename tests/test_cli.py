@@ -50,6 +50,15 @@ def test_cli_moe_ep_method():
 
 
 @pytest.mark.slow
+def test_cli_transformer_method():
+    r = _run_cli("-s", "2", "-bs", "2", "-n", "16", "-l", "2", "-d", "32",
+                 "-m", "8", "-r", "3", "--fake_devices", "4", "--heads",
+                 "4", "--lr", "0.1")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "train_transformer_tp takes" in r.stdout
+
+
+@pytest.mark.slow
 def test_cli_checkpoint_resume(tmp_path):
     """A CLI run with --checkpoint_dir publishes restorable checkpoints whose
     final params equal an in-process run on the same schedule; a second
