@@ -1,12 +1,16 @@
-"""Transformer trainers: single-device, DDP, and Megatron-style TP.
+"""Transformer trainers: single-device, DDP, FSDP/ZeRO-3, and Megatron TP.
 
-The strategies mirror the FFN-stack ones (``ddp.py``, ``tp.py``) applied to
-the full pre-LN block stack (``models.transformer``). The backward composes
-the hand-written block rules via ``jax.vjp`` (the framework's composition
-precedent), with the collectives placed by hand:
+The strategies mirror the FFN-stack ones (``ddp.py``, ``fsdp.py``,
+``tp.py``) applied to the full pre-LN block stack (``models.transformer``).
+The backward composes the hand-written block rules via ``jax.vjp`` (the
+framework's composition precedent), with the collectives placed by hand:
 
 - **DDP**: replicated params, strided seed shards, one grad ``psum`` per
   step (SUM, unscaled LR — ``train_ffns.py:165`` semantics).
+- **FSDP**: every param stack sharded over the data axis, layers
+  ``all_gather``-ed transiently per step; the gather's AD transpose is
+  ``psum_scatter``, which sums grads across shards and scatters them onto
+  the local chunks in one collective.
 - **TP**: Megatron attention + FFN sharding on the ``"model"`` axis. Heads
   are column-parallel (``wq/wk/wv`` split on the output dim — each shard
   runs ``H/n`` whole heads), ``wo`` row-parallel, FFN ``w1``/``w2``
@@ -32,11 +36,11 @@ from .. import LR
 from ..data import batch_from_seed, shard_seeds_strided
 from ..models.ffn_stack import clone_params, reshard_copy
 from ..models.transformer import (TransformerParams, attn_sublayer,
-                                  transformer_fwd)
+                                  transformer_block, transformer_fwd)
 from ..ops.ffn import ffn_block
 from ..ops.norm import layernorm
 from ..optim import sgd
-from .collectives import all_reduce, grad_reduce
+from .collectives import all_gather, all_reduce, grad_reduce
 from .launcher import launch
 from .mesh import DATA_AXIS, MODEL_AXIS, require_axes
 
@@ -46,6 +50,15 @@ TP_SPECS = TransformerParams(
     ln1=P(), wq=P(None, MODEL_AXIS, None), wk=P(None, MODEL_AXIS, None),
     wv=P(None, MODEL_AXIS, None), wo=P(None, None, MODEL_AXIS),
     ln2=P(), w1=P(None, MODEL_AXIS, None), w2=P(None, None, MODEL_AXIS))
+
+# FSDP layout: every stack sharded on its first per-layer dim (stacked
+# axis 1) across the data axis — the reference's chunk-along-dim-0
+# (train_ffns.py:265-266) on the transformer's parameter surface.
+FSDP_SPECS = TransformerParams(
+    ln1=P(None, DATA_AXIS), wq=P(None, DATA_AXIS, None),
+    wk=P(None, DATA_AXIS, None), wv=P(None, DATA_AXIS, None),
+    wo=P(None, DATA_AXIS, None), ln2=P(None, DATA_AXIS),
+    w1=P(None, DATA_AXIS, None), w2=P(None, DATA_AXIS, None))
 
 
 def _f_gate(axis: str):
@@ -138,6 +151,54 @@ def train_transformer_ddp(params: TransformerParams, seeds, batch_size: int,
 
     return launch(step, clone_params(params), seed_cols, mesh,
                   param_specs=P(), seed_spec=P(None, DATA_AXIS),
+                  select_local=lambda s: s[:, 0])
+
+
+def train_transformer_fsdp(params: TransformerParams, seeds,
+                           batch_size: int, model_size: int, mesh,
+                           lr: float = LR, *, seq_len: int, n_heads: int,
+                           causal: bool = True) -> TransformerParams:
+    """FSDP/ZeRO-3 on the transformer: every param stack sharded over the
+    data axis, each layer ``all_gather``-ed transiently per step (the
+    unrolled loop lets XLA prefetch layer l+1's gathers during layer l's
+    compute, ``train_ffns.py:200-249``). The backward needs no explicit
+    collective at all: the AD transpose of the forward's ``all_gather`` IS
+    ``psum_scatter``, so grads come back simultaneously summed across the
+    data shards and scattered onto the local chunks (the gather/
+    reduce-scatter correspondence the reference built by hand at
+    ``:245-256``). Sharded SGD on the local chunk only.
+    """
+    require_axes(mesh, DATA_AXIS)
+    n = mesh.shape[DATA_AXIS]
+    _validate_shapes(batch_size, seq_len, model_size, n_heads)
+    for name, leaf in zip(TransformerParams._fields, params):
+        if leaf.shape[1] % n:
+            raise ValueError(f"{name} dim {leaf.shape[1]} not divisible by "
+                             f"{n} shards")
+    seed_cols = shard_seeds_strided(seeds, n)
+
+    def step(params: TransformerParams, seed) -> TransformerParams:
+        x, dloss_dx = _reshape_batch(seed, batch_size, seq_len, model_size,
+                                     params.w1.dtype)
+
+        def fwd(p):
+            y = x
+            for l in range(p.w1.shape[0]):
+                # gather this layer's full params (transient, never stored)
+                # and run the exact single-device block on them
+                full = (all_gather(leaf[l], DATA_AXIS, dim=0) for leaf in p)
+                y = transformer_block(*full, y, n_heads, causal)
+            return y
+
+        _, vjp = jax.vjp(fwd, params)
+        grads = vjp(dloss_dx)[0]  # psum_scatter'd by the gather transpose
+        return sgd(params, grads, lr)
+
+    sharded = reshard_copy(params, jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec), FSDP_SPECS,
+        is_leaf=lambda v: isinstance(v, P)))
+    return launch(step, sharded, seed_cols, mesh,
+                  param_specs=FSDP_SPECS, seed_spec=P(None, DATA_AXIS),
                   select_local=lambda s: s[:, 0])
 
 

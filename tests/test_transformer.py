@@ -20,7 +20,7 @@ from distributed_llm_code_samples_tpu.ops.norm import layernorm, ln_fwd
 from distributed_llm_code_samples_tpu.optim import sgd
 from distributed_llm_code_samples_tpu.parallel import (
     DATA_AXIS, MODEL_AXIS, make_mesh, train_transformer_ddp,
-    train_transformer_single, train_transformer_tp)
+    train_transformer_fsdp, train_transformer_single, train_transformer_tp)
 
 B, T, D, H, L = 2, 16, 32, 4, 2
 
@@ -146,6 +146,29 @@ def test_ddp_matches_summed_grad_oracle(params):
     for name, a, b in zip(TransformerParams._fields, ddp, oracle):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4,
                                    atol=1e-5, err_msg=name)
+
+
+def test_fsdp_matches_ddp(params):
+    """FSDP == DDP on identical strided seed schedules — the reference's
+    core differential check (train_ffns.py:386-391) on the transformer."""
+    n = 4
+    seeds = make_seed_schedule(2 * n, random_seed=13)
+    mesh = make_mesh({DATA_AXIS: n})
+    ddp = train_transformer_ddp(params, seeds, TOKENS, D, mesh, lr=0.05,
+                                seq_len=T, n_heads=H)
+    fsdp = train_transformer_fsdp(params, seeds, TOKENS, D, mesh, lr=0.05,
+                                  seq_len=T, n_heads=H)
+    for name, a, b in zip(TransformerParams._fields, fsdp, ddp):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4,
+                                   atol=1e-5, err_msg=name)
+
+
+def test_fsdp_rejects_indivisible_dims():
+    mesh = make_mesh({DATA_AXIS: 8})
+    odd = init_transformer(jax.random.PRNGKey(0), D, L, ffn_dim=100)
+    with pytest.raises(ValueError, match="divisible"):
+        train_transformer_fsdp(odd, make_seed_schedule(8, 1), TOKENS, D,
+                               mesh, seq_len=T, n_heads=H)
 
 
 def test_tp_rejects_indivisible_heads(params):
