@@ -61,6 +61,13 @@ FSDP_SPECS = TransformerParams(
     w1=P(None, DATA_AXIS, None), w2=P(None, DATA_AXIS, None))
 
 
+def _shard(params: TransformerParams, mesh, specs) -> TransformerParams:
+    """Lay params out per a spec pytree (fresh buffers, launcher-owned)."""
+    return reshard_copy(params, jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec), specs,
+        is_leaf=lambda v: isinstance(v, P)))
+
+
 def _f_gate(axis: str):
     """Megatron's ``f`` operator: identity forward, all-reduce backward —
     but *vma-aware*. Under JAX's varying-manual-axes typing, cotangents
@@ -194,10 +201,7 @@ def train_transformer_fsdp(params: TransformerParams, seeds,
         grads = vjp(dloss_dx)[0]  # psum_scatter'd by the gather transpose
         return sgd(params, grads, lr)
 
-    sharded = reshard_copy(params, jax.tree_util.tree_map(
-        lambda spec: NamedSharding(mesh, spec), FSDP_SPECS,
-        is_leaf=lambda v: isinstance(v, P)))
-    return launch(step, sharded, seed_cols, mesh,
+    return launch(step, _shard(params, mesh, FSDP_SPECS), seed_cols, mesh,
                   param_specs=FSDP_SPECS, seed_spec=P(None, DATA_AXIS),
                   select_local=lambda s: s[:, 0])
 
@@ -253,8 +257,5 @@ def train_transformer_tp(params: TransformerParams, seeds, batch_size: int,
         # shards after the f-gate psums, so no further reduction is needed
         return sgd(params, grads, lr)
 
-    sharded = reshard_copy(params, jax.tree_util.tree_map(
-        lambda spec: NamedSharding(mesh, spec), TP_SPECS,
-        is_leaf=lambda v: isinstance(v, P)))
-    return launch(step, sharded, jnp.asarray(seeds), mesh,
-                  param_specs=TP_SPECS, seed_spec=P())
+    return launch(step, _shard(params, mesh, TP_SPECS), jnp.asarray(seeds),
+                  mesh, param_specs=TP_SPECS, seed_spec=P())

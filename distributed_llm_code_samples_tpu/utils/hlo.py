@@ -22,7 +22,7 @@ import jax
 
 # StableHLO op names for the collectives we hand-roll
 COLLECTIVE_OPS = ("all_reduce", "all_gather", "reduce_scatter",
-                  "collective_permute")
+                  "collective_permute", "all_to_all")
 
 
 def lowered_text(fn, *args, **kwargs) -> str:

@@ -25,7 +25,7 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 from distributed_llm_code_samples_tpu.parallel import (  # noqa: E402
-    make_mesh, DATA_AXIS, MODEL_AXIS)
+    make_mesh, DATA_AXIS, EXPERT_AXIS, MODEL_AXIS)
 
 
 @pytest.fixture(scope="session")
@@ -46,3 +46,8 @@ def mesh_model4():
 @pytest.fixture(scope="session")
 def mesh4x2():
     return make_mesh({DATA_AXIS: 4, MODEL_AXIS: 2})
+
+
+@pytest.fixture(scope="session")
+def mesh4_expert():
+    return make_mesh({EXPERT_AXIS: 4})

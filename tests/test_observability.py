@@ -80,6 +80,105 @@ def test_hybrid_comms_schedule(params, mesh4x2):
     assert c["all_reduce"] == 4 * L - 2
 
 
+def test_ep_comms_schedule(mesh4_expert):
+    """MoE EP forward: exactly 2 all_to_alls per layer (dispatch to expert
+    owners + return to token homes) and nothing else."""
+    from distributed_llm_code_samples_tpu.models import init_moe_stack
+    from distributed_llm_code_samples_tpu.parallel import EXPERT_AXIS
+    from distributed_llm_code_samples_tpu.parallel.expert import moe_layer_ep
+    from distributed_llm_code_samples_tpu.models.ffn_stack import reshard_copy
+    from jax.sharding import NamedSharding
+
+    Lm = 2
+    moe = init_moe_stack(jax.random.PRNGKey(0), 16, Lm, 8)
+    specs = type(moe)(wg=P(), w1=P(None, EXPERT_AXIS),
+                      w2=P(None, EXPERT_AXIS))
+    sp = reshard_copy(moe, jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh4_expert, s), specs,
+        is_leaf=lambda v: isinstance(v, P)))
+
+    def fwd(p, x):
+        for l in range(Lm):
+            x = moe_layer_ep(p.wg[l], p.w1[l], p.w2[l], x)
+        return x
+
+    f = jax.shard_map(fwd, mesh=mesh4_expert,
+                      in_specs=(specs, P(EXPERT_AXIS)),
+                      out_specs=P(EXPERT_AXIS))
+    c = count_collectives(f, sp, jnp.ones((64, 16)))
+    assert c["all_to_all"] == 2 * Lm
+    assert c["all_reduce"] == 0 and c["all_gather"] == 0
+
+
+def test_ulysses_comms_schedule():
+    """Ulysses: exactly 4 all_to_alls per attention call — q/k/v head
+    scatter + output return — and no other collective."""
+    import functools
+    from distributed_llm_code_samples_tpu.parallel import SEQ_AXIS, make_mesh
+    from distributed_llm_code_samples_tpu.parallel.sequence import (
+        ulysses_attention)
+
+    mesh = make_mesh({SEQ_AXIS: 4})
+    spec = P(None, SEQ_AXIS, None)
+    q = jnp.ones((8, 64, 16))
+    f = jax.shard_map(functools.partial(ulysses_attention,
+                                        axis_name=SEQ_AXIS),
+                      mesh=mesh, in_specs=(spec, spec, spec),
+                      out_specs=spec)
+    c = count_collectives(f, q, q, q)
+    assert c["all_to_all"] == 4
+    assert sum(c.values()) == 4
+
+
+def test_ring_attention_comms_schedule():
+    """Ring attention: exactly 2 ppermutes in the rotation body (K and V
+    blocks) — the whole ring is one fori_loop, so the lowered IR carries
+    one pair."""
+    import functools
+    from distributed_llm_code_samples_tpu.parallel import SEQ_AXIS, make_mesh
+    from distributed_llm_code_samples_tpu.parallel.sequence import (
+        ring_attention)
+
+    mesh = make_mesh({SEQ_AXIS: 4})
+    spec = P(SEQ_AXIS, None)
+    q = jnp.ones((64, 16))
+    f = jax.shard_map(functools.partial(ring_attention, axis_name=SEQ_AXIS),
+                      mesh=mesh, in_specs=(spec, spec, spec),
+                      out_specs=spec)
+    c = count_collectives(f, q, q, q)
+    assert c["collective_permute"] == 2
+    assert sum(c.values()) == 2
+
+
+def test_transformer_tp_fwd_comms_schedule():
+    """Transformer TP forward: the two Megatron g-psums per block (post-
+    attention and post-FFN), nothing else."""
+    from distributed_llm_code_samples_tpu.models import init_transformer
+    from distributed_llm_code_samples_tpu.models.ffn_stack import reshard_copy
+    from distributed_llm_code_samples_tpu.parallel import make_mesh
+    from distributed_llm_code_samples_tpu.parallel import transformer as tf
+    from jax.sharding import NamedSharding
+
+    Lm = 2
+    mesh = make_mesh({MODEL_AXIS: 4})
+    p = init_transformer(jax.random.PRNGKey(0), 32, Lm)
+    sp = reshard_copy(p, jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), tf.TP_SPECS,
+        is_leaf=lambda v: isinstance(v, P)))
+
+    def fwd(pp, x):
+        for l in range(Lm):
+            x = tf.tp_block(pp.ln1[l], pp.wq[l], pp.wk[l], pp.wv[l],
+                            pp.wo[l], pp.ln2[l], pp.w1[l], pp.w2[l], x, 1)
+        return x
+
+    f = jax.shard_map(fwd, mesh=mesh, in_specs=(tf.TP_SPECS, P()),
+                      out_specs=P())
+    c = count_collectives(f, sp, jnp.ones((2, 16, 32)))
+    assert c["all_reduce"] == 2 * Lm
+    assert sum(c.values()) == 2 * Lm
+
+
 @pytest.mark.tpu
 def test_fsdp_async_overlap_on_tpu(params):
     """On TPU, XLA must split FSDP's collectives into -start/-done pairs —
