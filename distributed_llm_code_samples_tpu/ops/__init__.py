@@ -11,6 +11,8 @@ from .stack import stack_fwd, stack_bwd, stack_grads
 from .moe import (expert_capacity, route_top1, dispatch_tensor, moe_layer,
                   moe_stack_fwd)
 from .norm import ln_fwd, ln_bwd, layernorm
+# Pallas modules (pallas_ffn, pallas_attention) stay off the eager import
+# path — import them at call sites like parallel/single.py does.
 
 __all__ = [
     "init_linear", "linear_fwd", "linear_bwd",
