@@ -2,7 +2,7 @@
 """Benchmark: flagship FFN-stack training throughput on real hardware.
 
 Prints ONE JSON line:
-``{"metric": ..., "value": N, "unit": "steps/s", "vs_baseline": N}``
+``{"metric": ..., "value": N, "unit": "steps/s", "vs_baseline": N, ...}``
 
 Workload: the BASELINE config-5 shape — GPT-2-small-width FFN stack
 (d_model=768, 24 layers, ffn=3072) at 8*1024 tokens/step, fp32 (the
@@ -13,6 +13,26 @@ framework's hand-written-VJP + scan + donation path.
 reference's training step: plain jnp ops differentiated with jax.vjp
 (all activations saved, no recompute policy, no custom-VJP structure).
 >1.0 means the TPU-first design beats the port.
+
+Extra fields:
+- ``mfu``: achieved model-FLOPs utilization of our path against the
+  detected chip's bf16 peak (JAX's default f32 matmul precision on TPU
+  lowers to single-pass bf16 MXU ops, so bf16 peak is the honest
+  denominator). ``model_tflops_per_step`` documents the numerator: the
+  hand-counted matmul FLOPs of the recompute-policy step
+  (fwd 4·T·d·ffn + bwd 10·T·d·ffn per layer, of which 2·T·d·ffn is the
+  ffn1 pre-activation recompute, ``train_ffns.py:63`` semantics).
+- ``pallas_vs_xla``: fused Pallas FFN block (``ops/pallas_ffn.py``)
+  vs the XLA path at the same shape, on the same chip. (Absent or an
+  error string if the Pallas path failed; BENCH_PALLAS=0 skips.)
+
+Resilience (the round-1 failure mode): the axon TPU relay sporadically
+fails backend init with ``UNAVAILABLE``. The bench probes the backend
+first and, on an infrastructure-shaped error (UNAVAILABLE / backend
+setup / DEADLINE), sleeps with backoff and re-execs itself for a fresh
+backend, up to BENCH_MAX_ATTEMPTS (5, ~5 min total). On final failure
+it still prints a parseable one-line JSON diagnostic (value 0.0) plus
+the error tail — never a bare traceback with rc=1.
 
 Timing methodology (load-bearing on this hardware): the axon relay does
 not make ``block_until_ready`` wait for chained per-step dispatches, so
@@ -27,7 +47,10 @@ was ~17% and compressed every comparison toward 1.0.
 
 import json
 import os
+import sys
+import threading
 import time
+import traceback
 
 import jax
 import jax.numpy as jnp
@@ -40,9 +63,109 @@ N_LAYERS = int(os.environ.get("BENCH_LAYERS", 24))
 TOKENS = int(os.environ.get("BENCH_TOKENS", 8 * 1024))
 TIMED_STEPS = int(os.environ.get("BENCH_STEPS", 64))
 LR = 0.1
+MAX_ATTEMPTS = int(os.environ.get("BENCH_MAX_ATTEMPTS", 5))
+_ATTEMPT_VAR = "BENCH_ATTEMPT"
 
 if os.environ.get("BENCH_PLATFORM"):
     jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
+FFN = 4 * D_MODEL
+# Hand-counted matmul FLOPs of one step of OUR path (recompute policy):
+# per layer fwd 2 matmuls (4Tdf) + bwd 5 matmuls (10Tdf, incl. the 2Tdf
+# ffn1 recompute), f = 4d. The naive-port baseline does 12Tdf (no
+# recompute) — we report MFU for our path only.
+MODEL_FLOPS_PER_STEP = 14 * TOKENS * D_MODEL * FFN * N_LAYERS
+
+# bf16 peak matmul FLOP/s by chip generation (public spec sheets). The
+# default f32 jnp matmul on TPU lowers to single-pass bf16 MXU ops, so
+# this is the ceiling the step actually runs against.
+_PEAK_BF16 = {
+    "v2": 45e12, "v3": 123e12, "v4": 275e12,
+    "v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12, "v5": 459e12,
+    "v6 lite": 918e12, "v6e": 918e12,
+}
+
+
+def _peak_flops(device_kind: str):
+    kind = device_kind.lower()
+    # match the most specific key first ("v5 lite" before "v5")
+    for key in sorted(_PEAK_BF16, key=len, reverse=True):
+        if key in kind:
+            return _PEAK_BF16[key], False
+    return 197e12, True  # assume v5e-class if unrecognized
+
+
+def _metric_name():
+    return f"ffn{N_LAYERS}_d{D_MODEL}_tok{TOKENS}_fp32_steps_per_sec_per_chip"
+
+
+def _emit(payload):
+    print(json.dumps(payload))
+    sys.stdout.flush()
+
+
+def _is_infra_error(exc: BaseException) -> bool:
+    msg = f"{type(exc).__name__}: {exc}"
+    return any(s in msg for s in (
+        "UNAVAILABLE", "Unable to initialize backend", "DEADLINE",
+        "backend setup", "Socket closed", "failed to connect",
+        "Connection reset", "ABORTED"))
+
+
+def _retry_or_bail(exc: BaseException):
+    """Backoff + re-exec for a fresh backend; final failure emits JSON."""
+    attempt = int(os.environ.get(_ATTEMPT_VAR, "0"))
+    tail = "".join(traceback.format_exception(exc))[-1500:]
+    if attempt + 1 >= MAX_ATTEMPTS or not _is_infra_error(exc):
+        _emit({
+            "metric": _metric_name(),
+            "value": 0.0,
+            "unit": "steps/s",
+            "vs_baseline": 0.0,
+            "error": (f"{'infra' if _is_infra_error(exc) else 'bench'} "
+                      f"failure after {attempt + 1} attempt(s): "
+                      f"{type(exc).__name__}: {str(exc)[:400]}"),
+        })
+        print(f"--- attempt {attempt + 1} traceback tail ---\n{tail}",
+              file=sys.stderr)
+        sys.exit(0)
+    sleep_s = min(15 * (2 ** attempt), 120)
+    print(f"bench: backend attempt {attempt + 1}/{MAX_ATTEMPTS} failed "
+          f"({type(exc).__name__}: {str(exc)[:200]}); retrying in "
+          f"{sleep_s}s", file=sys.stderr)
+    sys.stderr.flush()
+    time.sleep(sleep_s)
+    os.environ[_ATTEMPT_VAR] = str(attempt + 1)
+    os.execv(sys.executable, [sys.executable] + sys.argv)
+
+
+def _watchdog(label: str, timeout_s: float):
+    """The relay's other failure mode (observed this round): backend init
+    *hangs* instead of raising. A daemon timer re-execs for a fresh attempt
+    (or emits the diagnostic JSON if attempts are spent) — exceptions can't
+    catch a hang. Returns the timer; ``.cancel()`` it on success."""
+    def fire():
+        attempt = int(os.environ.get(_ATTEMPT_VAR, "0"))
+        if attempt + 1 >= MAX_ATTEMPTS:
+            _emit({
+                "metric": _metric_name(),
+                "value": 0.0,
+                "unit": "steps/s",
+                "vs_baseline": 0.0,
+                "error": (f"infra failure after {attempt + 1} attempt(s): "
+                          f"{label} hung >{timeout_s:.0f}s"),
+            })
+            os._exit(0)
+        print(f"bench: {label} hung >{timeout_s:.0f}s on attempt "
+              f"{attempt + 1}/{MAX_ATTEMPTS}; re-execing", file=sys.stderr)
+        sys.stderr.flush()
+        os.environ[_ATTEMPT_VAR] = str(attempt + 1)
+        os.execv(sys.executable, [sys.executable] + sys.argv)
+
+    t = threading.Timer(timeout_s, fire)
+    t.daemon = True
+    t.start()
+    return t
 
 
 def _naive_run():
@@ -76,6 +199,25 @@ def _sync(params) -> float:
 
 
 def main():
+    probe_guard = _watchdog("backend init",
+                            float(os.environ.get("BENCH_PROBE_TIMEOUT", 240)))
+    try:
+        devices = jax.devices()  # the round-1 failure point — probe first
+        device_kind = devices[0].device_kind
+        # touch the compile+execute path too: infra errors can also first
+        # surface at program dispatch, not backend init
+        jax.block_until_ready(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+    except Exception as exc:  # noqa: BLE001
+        probe_guard.cancel()
+        _retry_or_bail(exc)
+        return
+    probe_guard.cancel()
+    # the measurement itself can also stall mid-run on a flaky relay; give
+    # it a generous ceiling (first compile of the big stack takes ~40s,
+    # three paths x reps each well under that)
+    run_guard = _watchdog("measurement",
+                          float(os.environ.get("BENCH_RUN_TIMEOUT", 1500)))
+
     from distributed_llm_code_samples_tpu.data import make_seed_schedule
     from distributed_llm_code_samples_tpu.models import init_ffn_stack
     from distributed_llm_code_samples_tpu.parallel import train_single
@@ -100,18 +242,60 @@ def main():
             best = max(best, TIMED_STEPS / (time.perf_counter() - t0))
         return best
 
-    ours_sps = measure(
-        lambda p, s: train_single(p, s, TOKENS, D_MODEL, lr=LR), params)
-    naive_sps = measure(_naive_run(), params)
+    try:
+        ours_sps = measure(
+            lambda p, s: train_single(p, s, TOKENS, D_MODEL, lr=LR), params)
+        naive_sps = measure(_naive_run(), params)
+    except Exception as exc:  # noqa: BLE001
+        _retry_or_bail(exc)
+        return
 
-    # single-device workload: exactly one chip does the work regardless of
-    # how many are visible
-    print(json.dumps({
-        "metric": f"ffn{N_LAYERS}_d{D_MODEL}_tok{TOKENS}_fp32_steps_per_sec_per_chip",
+    peak, peak_assumed = _peak_flops(device_kind)
+    mfu = ours_sps * MODEL_FLOPS_PER_STEP / peak
+
+    payload = {
+        "metric": _metric_name(),
         "value": round(ours_sps, 4),
         "unit": "steps/s",
         "vs_baseline": round(ours_sps / naive_sps, 4),
-    }))
+        "mfu": round(mfu, 4),
+        "model_tflops_per_step": round(MODEL_FLOPS_PER_STEP / 1e12, 4),
+        "device_kind": device_kind,
+        "peak_bf16_tflops": round(peak / 1e12, 1),
+        "naive_steps_per_sec": round(naive_sps, 4),
+        "attempts": int(os.environ.get(_ATTEMPT_VAR, "0")) + 1,
+    }
+    if peak_assumed:
+        payload["peak_assumed"] = True
+
+    run_guard.cancel()
+
+    # Pallas fused-FFN path vs the XLA path, same chip, same shape
+    # (VERDICT r1 #3). A Pallas failure or hang must not cost the headline
+    # number: its watchdog emits the payload in hand and exits.
+    if os.environ.get("BENCH_PALLAS", "1") != "0":
+        def bail_with_headline():
+            payload["pallas_vs_xla"] = "error: pallas measurement hung"
+            _emit(payload)
+            os._exit(0)
+
+        guard = threading.Timer(
+            float(os.environ.get("BENCH_PALLAS_TIMEOUT", 600)),
+            bail_with_headline)
+        guard.daemon = True
+        guard.start()
+        try:
+            pallas_sps = measure(
+                lambda p, s: train_single(p, s, TOKENS, D_MODEL, lr=LR,
+                                          use_pallas=True), params)
+            payload["pallas_vs_xla"] = round(pallas_sps / ours_sps, 4)
+            payload["pallas_steps_per_sec"] = round(pallas_sps, 4)
+        except Exception as exc:  # noqa: BLE001
+            payload["pallas_vs_xla"] = (
+                f"error: {type(exc).__name__}: {str(exc)[:200]}")
+        guard.cancel()
+
+    _emit(payload)
 
 
 if __name__ == "__main__":

@@ -167,7 +167,12 @@ def restore_checkpoint(ckpt_dir: str, target: Any, step: int | None = None,
     if doc.get("backend") == "orbax":
         import orbax.checkpoint as ocp
         ckptr = ocp.PyTreeCheckpointer()
-        params = ckptr.restore(os.path.join(os.path.abspath(path), "arrays"))
+        # restore WITH the target: an untargeted restore returns a plain
+        # dict whose tree_leaves come out in dict-key-sorted order, not the
+        # target NamedTuple's field order — for MoEStackParams that silently
+        # permuted (wg, w1, w2) into (w1, w2, wg)
+        params = ckptr.restore(os.path.join(os.path.abspath(path), "arrays"),
+                               item=target)
         new_leaves = jax.tree_util.tree_leaves(params)
     else:
         dtypes = [_np_dtype(n) for n in doc.get("leaf_dtypes", [])] \
@@ -197,7 +202,8 @@ def restore_checkpoint(ckpt_dir: str, target: Any, step: int | None = None,
 
 def run_with_checkpointing(train_fn, params, seeds, *args,
                            ckpt_dir: str, every: int = 0, resume: bool = True,
-                           backend: str = "npz", **kwargs):
+                           backend: str = "npz", seeds_divisor: int = 1,
+                           **kwargs):
     """Drive any strategy launcher (uniform L4 signature,
     ``fn(params, seeds, batch, d, **kw)``) with periodic checkpointing.
 
@@ -212,11 +218,23 @@ def run_with_checkpointing(train_fn, params, seeds, *args,
     schedule's tail. ``resume=False`` clears existing ``step_*`` dirs first,
     so a later resume can't pick up a stale higher step from a previous run.
 
-    Note: for data-parallel strategies, pick ``every`` divisible by the
+    For data-parallel strategies, ``every`` must be divisible by the
     data-axis size (the strided seed split asserts divisibility,
-    ``train_ffns.py:175``).
+    ``train_ffns.py:182`` semantics) — pass it as ``seeds_divisor`` so a
+    bad value fails *here*, up front, instead of as a divisibility assert
+    deep inside the strategy (possibly after a restore mid-run).
     """
     seeds = np.asarray(seeds)
+    if seeds_divisor > 1:
+        if every > 0 and every % seeds_divisor:
+            raise ValueError(
+                f"checkpoint every={every} must be a multiple of the "
+                f"data-shard count {seeds_divisor}: each segment's seeds "
+                "are split strided across the data axis")
+        if len(seeds) % seeds_divisor:
+            raise ValueError(
+                f"{len(seeds)} seeds do not divide across "
+                f"{seeds_divisor} data shards")
     start = 0
     if resume and latest_step(ckpt_dir) is not None:
         params, start, saved = restore_checkpoint(ckpt_dir, params)

@@ -170,11 +170,19 @@ def main(argv=None) -> int:
         t0 = time.time()
         if args.checkpoint_dir:
             from .checkpoint import run_with_checkpointing
+            # strategies that split seeds strided across a data-ish axis
+            # (data or expert; model/pipe axes replicate seeds) need
+            # every/len(seeds) divisible by it — validated up front.
+            # Derived from the mesh so new strategies can't drift past it.
+            divisor = 1
+            if mesh is not None:
+                divisor = (mesh.shape.get(DATA_AXIS, 1)
+                           * mesh.shape.get(EXPERT_AXIS, 1))
             out = run_with_checkpointing(
                 fn, params, seeds, tokens, args.model_size,
                 ckpt_dir=os.path.join(args.checkpoint_dir, name),
                 every=args.checkpoint_every, resume=not args.no_resume,
-                **kwargs)
+                seeds_divisor=divisor, **kwargs)
         else:
             out = fn(params, seeds, tokens, args.model_size, **kwargs)
         jax.block_until_ready(out)

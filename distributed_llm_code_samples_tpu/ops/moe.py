@@ -1,12 +1,24 @@
 """MoE routing + dispatch/combine ops (single-device oracle for EP).
 
-Built TPU-first: the router is top-1 (Switch-style) with a **static
-capacity** per expert, and dispatch/combine are dense one-hot einsums —
-every shape is static, every FLOP lands on the MXU, and there is no
-data-dependent control flow for XLA to choke on. Tokens overflowing an
-expert's capacity are dropped (emit zeros), the standard Switch behavior;
-with the default ``capacity_factor`` sized for the test workloads nothing
-drops.
+Built TPU-first: the router is top-k (k=1 Switch-style, k=2 GShard-style)
+with a **static capacity** per expert, and dispatch/combine are dense
+one-hot einsums — every shape is static, every FLOP lands on the MXU, and
+there is no data-dependent control flow for XLA to choke on.
+
+Capacity semantics (Switch/GShard): tokens overflowing an expert's
+capacity are dropped from the expert computation; the *stack* passes every
+token through a residual connection (``moe_stack_fwd``), so a dropped
+token keeps its input activation instead of zeroing out for the rest of
+the stack — the standard Switch drop behavior. ``moe_layer`` itself (the
+raw layer, no residual) emits zeros for dropped tokens. With k=2, rank-0
+choices of *all* tokens claim slots before any rank-1 choice (choice-major
+priority), the GShard ordering.
+
+Load balancing: ``router_aux_loss`` is the Switch auxiliary loss
+``E * sum_e f_e * P_e`` (``f_e`` = fraction of tokens whose top-1 choice
+is expert ``e``, ``P_e`` = mean router probability of ``e``) — minimized
+at uniform routing, differentiable through ``P_e``. Trainers add
+``aux_coef * d(aux)/d(params)`` to the gradients.
 
 Differentiation follows the framework's stance (``train_ffns.py:1-3``): the
 expert FFN compute runs the hand-written ``ffn_block`` VJP (vmapped over
@@ -43,6 +55,20 @@ def route_top1(wg: jax.Array, x: jax.Array):
     return idx, gate
 
 
+def route_topk(wg: jax.Array, x: jax.Array, k: int = 2,
+               renormalize: bool = True):
+    """Top-k router. Returns ``(idx [T, k], gates [T, k])``; with
+    ``renormalize`` the k gates sum to 1 per token (the GShard top-2
+    convention; k=1 + renormalize=False reduces to ``route_top1``)."""
+    logits = x @ wg.T                              # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    _, idx = jax.lax.top_k(logits, k)              # [T, k], distinct experts
+    gates = jnp.take_along_axis(probs, idx, axis=-1)
+    if renormalize:
+        gates = gates / jnp.sum(gates, axis=-1, keepdims=True)
+    return idx, gates
+
+
 def dispatch_tensor(idx: jax.Array, n_experts: int, capacity: int,
                     dtype=jnp.float32):
     """One-hot dispatch ``D [T, E, C]``: ``D[t, e, c] = 1`` iff token ``t``
@@ -60,29 +86,88 @@ def dispatch_tensor(idx: jax.Array, n_experts: int, capacity: int,
     return (slot * keep[:, :, None]).astype(dtype)
 
 
+def dispatch_tensor_topk(idx: jax.Array, n_experts: int, capacity: int,
+                         dtype=jnp.float32):
+    """Top-k dispatch ``D [k, T, E, C]`` with choice-major slot priority:
+    every token's rank-0 choice claims its slot before any token's rank-1
+    choice (GShard ordering), so under pressure second choices drop first.
+
+    ``idx [T, k]``. Each (token, choice) pair gets at most one slot;
+    summing over ``k`` gives the combined ``[T, E, C]`` dispatch (a token's
+    k choices are distinct experts, so slots never collide).
+    """
+    t, k = idx.shape
+    flat = idx.T.reshape(-1)                       # [k*T], choice-major
+    disp = dispatch_tensor(flat, n_experts, capacity, dtype)  # [k*T, E, C]
+    return disp.reshape(k, t, n_experts, capacity)
+
+
+def router_aux_loss(wg: jax.Array, x: jax.Array) -> jax.Array:
+    """Switch load-balancing loss ``E * sum_e f_e * P_e`` on one layer's
+    input tokens. ``f_e`` uses the (non-differentiable) top-1 assignment;
+    the gradient flows through ``P_e``. Equals 1 at perfectly uniform
+    routing; rises as routing collapses."""
+    logits = x @ wg.T
+    n_experts = wg.shape[0]
+    probs = jax.nn.softmax(logits, axis=-1)                  # [T, E]
+    top1 = jax.lax.stop_gradient(
+        jax.nn.one_hot(jnp.argmax(logits, axis=-1), n_experts,
+                       dtype=probs.dtype))
+    f = jnp.mean(top1, axis=0)                               # [E]
+    p = jnp.mean(probs, axis=0)                              # [E]
+    return n_experts * jnp.sum(f * p)
+
+
 def moe_layer(wg: jax.Array, w1: jax.Array, w2: jax.Array, x: jax.Array,
-              capacity_factor: float = 2.0) -> jax.Array:
-    """One MoE FFN layer, dense single-device form.
+              capacity_factor: float = 2.0, k: int = 1) -> jax.Array:
+    """One MoE FFN layer, dense single-device form (no residual here —
+    the stack adds it).
 
     ``wg [E, d]``, ``w1 [E, ffn, d]``, ``w2 [E, d, ffn]``, ``x [T, d]``.
     Dispatch -> per-expert hand-VJP FFN (``ffn_block`` vmapped over the
-    expert axis) -> gate-scaled combine. Dropped tokens produce zeros.
+    expert axis) -> gate-scaled combine. Dropped (token, choice) pairs
+    contribute zero.
     """
     n_experts = w1.shape[0]
     cap = expert_capacity(x.shape[0], n_experts, capacity_factor)
-    idx, gate = route_top1(wg, x)
-    disp = dispatch_tensor(idx, n_experts, cap, x.dtype)          # [T, E, C]
-    xe = jnp.einsum("tec,td->ecd", disp, x)                       # [E, C, d]
-    ye = jax.vmap(ffn_block)(w1, w2, xe)                          # [E, C, d]
-    comb = disp * gate[:, None, None]
+    if k == 1:
+        idx, gate = route_top1(wg, x)
+        disp = dispatch_tensor(idx, n_experts, cap, x.dtype)  # [T, E, C]
+        comb = disp * gate[:, None, None]
+    else:
+        idx, gates = route_topk(wg, x, k)
+        disp_k = dispatch_tensor_topk(idx, n_experts, cap, x.dtype)
+        disp = jnp.sum(disp_k, axis=0)                        # [T, E, C]
+        comb = jnp.einsum("ktec,tk->tec", disp_k, gates)
+    xe = jnp.einsum("tec,td->ecd", disp, x)                   # [E, C, d]
+    ye = jax.vmap(ffn_block)(w1, w2, xe)                      # [E, C, d]
     return jnp.einsum("tec,ecd->td", comb, ye)
 
 
-def moe_stack_fwd(params, x: jax.Array,
-                  capacity_factor: float = 2.0) -> jax.Array:
-    """Stack of MoE layers (``MoEStackParams``), block input chaining like
-    the dense stack (``train_ffns.py:72-81``)."""
+def moe_stack_fwd_aux(params, x: jax.Array, capacity_factor: float = 2.0,
+                      k: int = 1):
+    """Stack of MoE layers (``MoEStackParams``) with a residual around each
+    layer (Switch semantics: a capacity-dropped token passes through
+    unchanged rather than zeroing for the rest of the stack). Returns
+    ``(y, aux)`` where ``aux`` is the total ``router_aux_loss``, each
+    layer scored on its own residual-chained input — one walk computes
+    both, so trainers can take a single ``vjp`` with cotangents
+    ``(dloss_dx, aux_coef)``."""
+    aux = jnp.asarray(0.0, jnp.float32)
     for l in range(params.w1.shape[0]):
-        x = moe_layer(params.wg[l], params.w1[l], params.w2[l], x,
-                      capacity_factor)
-    return x
+        aux = aux + router_aux_loss(params.wg[l], x)
+        x = x + moe_layer(params.wg[l], params.w1[l], params.w2[l], x,
+                          capacity_factor, k)
+    return x, aux
+
+
+def moe_stack_fwd(params, x: jax.Array, capacity_factor: float = 2.0,
+                  k: int = 1) -> jax.Array:
+    """Output half of ``moe_stack_fwd_aux``."""
+    return moe_stack_fwd_aux(params, x, capacity_factor, k)[0]
+
+
+def moe_stack_aux(params, x: jax.Array, capacity_factor: float = 2.0,
+                  k: int = 1) -> jax.Array:
+    """Aux half of ``moe_stack_fwd_aux``."""
+    return moe_stack_fwd_aux(params, x, capacity_factor, k)[1]

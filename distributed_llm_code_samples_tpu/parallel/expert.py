@@ -18,18 +18,31 @@ live on each device); the router is replicated. Per layer:
 - the reverse ``all_to_all`` returns results for the shard's own tokens,
   and the gate-scaled combine finishes the layer.
 
+Capacity is derived from the **global** token count (``T_local * n``) and
+split evenly across source shards (``C_local = ceil(C_global / n)``), so
+EP and the dense oracle agree on how many slots each expert exposes. Drop
+*order* is grouped (each shard fills only its own ``C_local`` share —
+GShard's grouped dispatch): a shard routing unusually many tokens to one
+expert drops locally even if another shard left slots free. The oracle
+emulates this exactly by routing each shard's tokens independently with
+the same per-group capacity (``tests/test_moe.py``).
+
 Gradients: expert-weight grads are complete locally (every token routed to
 an expert arrives on its device — the a2a *is* the reduction's data
 movement); router grads are per-shard partial sums and get an explicit
 ``psum`` (SUM, matching the framework's unscaled-LR convention,
 ``train_ffns.py:165``). The backward through the a2a pair is the transposed
 a2a pair, composed by ``jax.vjp`` around the hand-written block rules.
+The Switch load-balancing auxiliary loss (``aux_coef > 0``) is computed
+per shard on local tokens (GShard's per-group convention) and folds into
+the same router psum.
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from .. import LR
@@ -37,50 +50,81 @@ from ..data import batch_from_seed, shard_seeds_strided
 from ..models.moe import MoEStackParams
 from ..models.ffn_stack import clone_params
 from ..ops.ffn import ffn_block
-from ..ops.moe import dispatch_tensor, expert_capacity, route_top1
+from ..ops.moe import (dispatch_tensor, dispatch_tensor_topk,
+                       expert_capacity, route_top1, route_topk,
+                       router_aux_loss)
 from ..optim import sgd
 from .collectives import all_to_all, grad_reduce
 from .launcher import launch
 from .mesh import EXPERT_AXIS, require_axes
 
 
+def _local_capacity(t_local: int, n_shards: int, n_experts: int,
+                    capacity_factor: float) -> int:
+    """This shard's slice of the global per-expert capacity: derive from
+    the global token count, then ceil-split across source shards."""
+    cap_global = expert_capacity(t_local * n_shards, n_experts,
+                                 capacity_factor)
+    return max(1, -(-cap_global // n_shards))
+
+
 def moe_layer_ep(wg, w1_local, w2_local, x, capacity_factor: float = 2.0,
-                 axis: str = EXPERT_AXIS):
-    """One expert-parallel MoE layer, per-shard view.
+                 axis: str = EXPERT_AXIS, k: int = 1):
+    """One expert-parallel MoE layer, per-shard view (no residual here —
+    the step adds it).
 
     ``wg [E, d]`` (replicated), ``w1_local [E/n, ffn, d]``,
     ``w2_local [E/n, d, ffn]``, ``x [T_local, d]``.
     """
     n_experts = wg.shape[0]
-    cap = expert_capacity(x.shape[0], n_experts, capacity_factor)
-    idx, gate = route_top1(wg, x)
-    disp = dispatch_tensor(idx, n_experts, cap, x.dtype)  # [T_loc, E, C]
+    cap = _local_capacity(x.shape[0], lax.axis_size(axis), n_experts,
+                          capacity_factor)
+    if k == 1:
+        idx, gate = route_top1(wg, x)
+        disp = dispatch_tensor(idx, n_experts, cap, x.dtype)  # [T_loc, E, C]
+        comb = disp * gate[:, None, None]
+    else:
+        idx, gates = route_topk(wg, x, k)
+        disp_k = dispatch_tensor_topk(idx, n_experts, cap, x.dtype)
+        disp = jnp.sum(disp_k, axis=0)
+        comb = jnp.einsum("ktec,tk->tec", disp_k, gates)
     xe = jnp.einsum("tec,td->ecd", disp, x)              # [E, C, d]
     # experts -> their owners; slots from all shards stack on the cap axis
     xe = all_to_all(xe, axis, split_dim=0, concat_dim=1)  # [E/n, n*C, d]
     ye = jax.vmap(ffn_block)(w1_local, w2_local, xe)      # [E/n, n*C, d]
     # results return to the tokens' home shards
     ye = all_to_all(ye, axis, split_dim=1, concat_dim=0)  # [E, C, d]
-    comb = disp * gate[:, None, None]
     return jnp.einsum("tec,ecd->td", comb, ye)
 
 
 def make_step(batch_size: int, model_size: int, lr: float = LR,
-              capacity_factor: float = 2.0, axis: str = EXPERT_AXIS):
-    """One EP step for one shard: local fwd, ``jax.vjp``-composed backward
-    over the hand-written rules, explicit router-grad psum, local SGD."""
+              capacity_factor: float = 2.0, axis: str = EXPERT_AXIS,
+              k: int = 1, aux_coef: float = 0.0):
+    """One EP step for one shard: local fwd (residual per layer),
+    ``jax.vjp``-composed backward over the hand-written rules, optional
+    load-balancing aux term, explicit router-grad psum, local SGD.
 
-    def fwd(params: MoEStackParams, x):
+    Fwd and aux come from ONE stack walk returning ``(y, aux)``; the
+    combined gradient is a single vjp with cotangents
+    ``(dloss_dx, aux_coef)`` — no second forward, no duplicated a2a."""
+
+    def fwd_aux(params: MoEStackParams, x):
+        aux = jnp.asarray(0.0, jnp.float32)
         for l in range(params.w1.shape[0]):
-            x = moe_layer_ep(params.wg[l], params.w1[l], params.w2[l], x,
-                             capacity_factor, axis)
-        return x
+            aux = aux + router_aux_loss(params.wg[l], x)
+            x = x + moe_layer_ep(params.wg[l], params.w1[l], params.w2[l],
+                                 x, capacity_factor, axis, k)
+        return x, aux
 
     def step(params: MoEStackParams, seed) -> MoEStackParams:
         x, dloss_dx = batch_from_seed(seed, batch_size, model_size,
                                       params.w1.dtype)
-        _, vjp = jax.vjp(lambda p: fwd(p, x), params)
-        grads = vjp(dloss_dx)[0]
+        _, vjp = jax.vjp(lambda p: fwd_aux(p, x), params)
+        # the aux output is shard-varying under shard_map; its cotangent
+        # (the constant aux coefficient) must be cast to match
+        coef = lax.pcast(jnp.asarray(aux_coef, jnp.float32), axis,
+                         to="varying")
+        grads = vjp((dloss_dx, coef))[0]
         # router is replicated; its per-shard partial grads sum across the
         # expert axis (train_ffns.py:165 semantics). Expert grads are
         # already complete on their owner shard.
@@ -92,12 +136,15 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
 
 def train_moe_ep(params: MoEStackParams, seeds, batch_size: int,
                  model_size: int, mesh, lr: float = LR,
-                 capacity_factor: float = 2.0) -> MoEStackParams:
+                 capacity_factor: float = 2.0, k: int = 1,
+                 aux_coef: float = 0.0) -> MoEStackParams:
     """Run the EP schedule; returns fully-assembled final params.
 
     ``batch_size`` is the *global* token count per step; each shard routes
     ``batch_size/n`` tokens (data and experts shard over the same axis).
     Seeds shard stride-wise like the DP strategies (``train_ffns.py:182``).
+    ``k`` selects top-k routing; ``aux_coef`` scales the Switch
+    load-balancing loss into the router gradients.
     """
     require_axes(mesh, EXPERT_AXIS)
     n = mesh.shape[EXPERT_AXIS]
@@ -108,7 +155,8 @@ def train_moe_ep(params: MoEStackParams, seeds, batch_size: int,
         raise ValueError(f"batch_size={batch_size} not divisible by "
                          f"expert-axis size {n}")
     seed_cols = shard_seeds_strided(seeds, n)
-    step = make_step(batch_size // n, model_size, lr, capacity_factor)
+    step = make_step(batch_size // n, model_size, lr, capacity_factor,
+                     k=k, aux_coef=aux_coef)
     specs = MoEStackParams(wg=P(), w1=P(None, EXPERT_AXIS),
                            w2=P(None, EXPERT_AXIS))
     return launch(step, clone_params(params), seed_cols, mesh,

@@ -3,12 +3,13 @@ per-device memory accounting."""
 
 from .profiling import trace, profile_rank_0, timed
 from .hlo import (lowered_text, count_collectives, compiled_text,
-                  async_collective_pairs, COLLECTIVE_OPS)
+                  async_collective_pairs, count_async_pairs,
+                  COLLECTIVE_OPS)
 from .memory import compiled_memory, params_bytes_per_device
 
 __all__ = [
     "trace", "profile_rank_0", "timed",
     "lowered_text", "count_collectives", "compiled_text",
-    "async_collective_pairs", "COLLECTIVE_OPS",
+    "async_collective_pairs", "count_async_pairs", "COLLECTIVE_OPS",
     "compiled_memory", "params_bytes_per_device",
 ]

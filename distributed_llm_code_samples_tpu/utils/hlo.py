@@ -42,29 +42,46 @@ def compiled_text(fn, *args, **kwargs) -> str:
     return jax.jit(fn).lower(*args, **kwargs).compile().as_text()
 
 
-def async_collective_pairs(fn, *args, **kwargs) -> Counter:
-    """Counts of async-split collectives in the optimized HLO — nonzero
+def count_async_pairs(text: str) -> Counter:
+    """Counts of async-split collectives in optimized HLO text — nonzero
     means XLA split the collective for compute/comm overlap.
 
-    Two spellings exist: dedicated opcodes (``all-reduce-start``,
-    ``all-gather-start``, ``collective-permute-start``) and the generic
-    wrapper ``async-start`` whose operand names the collective (the only
-    form reduce-scatter gets — XLA has no ``reduce-scatter-start`` opcode).
-    Both are counted."""
-    text = compiled_text(fn, *args, **kwargs)
+    Three spellings exist across backends/generations: dedicated opcodes
+    (``all-reduce-start``, ``all-gather-start``,
+    ``collective-permute-start``), the generic wrapper ``async-start``
+    whose operand names the collective, and the TPU codegen form
+    ``async-collective-start`` (counted under the ``async_collective``
+    key — the wrapped op is a custom-call whose kind isn't named on the
+    defining line). Only *defining* lines are counted: the ``-done`` line
+    names the ``-start`` value as its operand and would double-count."""
     counts = Counter()
-    for op in COLLECTIVE_OPS:
-        dashed = op.replace("_", "-")
-        n = 0
-        for line in text.splitlines():
-            # count *defining* start lines only. The `-done` line names the
-            # `-start` value as its operand (and would double-count), so it
-            # is excluded first; result types may be tuples, so the opcode
-            # is matched by its trailing `(` rather than by line position.
-            if "-done(" in line:
-                continue
-            if (re.search(rf"{dashed}-start\(", line)
-                    or ("async-start(" in line and dashed in line)):
-                n += 1
-        counts[op] = n
+    for line in text.splitlines():
+        if "=" not in line:
+            continue
+        lhs, rhs = line.split("=", 1)
+        # TPU codegen form: the async start is a *fusion* whose VALUE NAME
+        # is %async-collective-start[.N] — there is no dedicated opcode on
+        # the line, so this one is detected by name (suffixes allowed)
+        if re.search(r"%async-collective-start[.\w]*\s*$", lhs.strip()):
+            counts["async_collective"] += 1
+            continue
+        # dedicated / generic opcodes: match the OPCODE token (directly
+        # followed by "(") on the right-hand side — rename-proof, and a
+        # `-done` line references the `-start` value only paren-free
+        if re.search(r"\basync-start\(", rhs):
+            for op in COLLECTIVE_OPS:
+                if op.replace("_", "-") in rhs:
+                    counts[op] += 1
+                    break
+        else:
+            for op in COLLECTIVE_OPS:
+                if re.search(rf"\b{op.replace('_', '-')}-start\(", rhs):
+                    counts[op] += 1
+                    break
     return counts
+
+
+def async_collective_pairs(fn, *args, **kwargs) -> Counter:
+    """``count_async_pairs`` of ``fn``'s optimized HLO on the current
+    backend (compile-and-inspect; see ``count_async_pairs`` for keys)."""
+    return count_async_pairs(compiled_text(fn, *args, **kwargs))

@@ -109,6 +109,65 @@ def test_ring_attention_grad_flows(qkv):
     np.testing.assert_allclose(g_ring, g_ref, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_grad_matches_oracle_all_inputs(causal):
+    """The hand-written backward ring: dq/dk/dv each match the quadratic
+    oracle's grads (non-causal exercises the all-blocks path; causal the
+    skip-masked path)."""
+    from jax.sharding import PartitionSpec as P
+    key = jax.random.PRNGKey(7)
+    q, k, v = (jax.random.normal(kk, (T, D)) for kk in jax.random.split(key, 3))
+    mesh = make_mesh({SEQ_AXIS: 4})
+    spec = P(SEQ_AXIS, None)
+    f = jax.shard_map(
+        lambda q, k, v: ring_attention(q, k, v, SEQ_AXIS, causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    cot = jax.random.normal(jax.random.PRNGKey(9), (T, D))
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v) * cot)
+
+    g_ring = jax.grad(loss(f), argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss(lambda q, k, v: _plain(q, k, v, causal)),
+                     argnums=(0, 1, 2))(q, k, v)
+    for got, ref, name in zip(g_ring, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5, err_msg=name)
+
+
+def test_ring_attention_residual_memory_constant_in_ring_size():
+    """The point of the hand-written backward (VERDICT r1 item 5): the
+    forward saves O(T_local * d) residuals — per-shard compiled memory of
+    the grad program must NOT grow with the ring size. Autograd through
+    the rotation loop would stash every step's KV blocks
+    (O(n * T_local * d)) and fail this."""
+    from jax.sharding import PartitionSpec as P
+    from distributed_llm_code_samples_tpu.utils.memory import compiled_memory
+    t_local, d = 64, 32
+
+    def mem_for(n):
+        mesh = make_mesh({SEQ_AXIS: n})
+        spec = P(SEQ_AXIS, None)
+        f = jax.shard_map(
+            lambda q, k, v: ring_attention(q, k, v, SEQ_AXIS, True),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+
+        def loss(q, k, v):
+            return jnp.sum(f(q, k, v))
+
+        q = jax.device_put(
+            jnp.ones((n * t_local, d)),
+            jax.sharding.NamedSharding(mesh, spec))
+        return compiled_memory(jax.grad(loss, argnums=(0, 1, 2)), q, q, q)
+
+    m2, m8 = mem_for(2), mem_for(8)
+    if m2 is None or m8 is None:
+        pytest.skip("backend exposes no memory analysis")
+    # temps hold the residuals; identical T_local => identical per-shard
+    # footprint regardless of ring size (small slack for scheduling noise)
+    assert m8["temp_bytes"] <= m2["temp_bytes"] * 1.1, (m2, m8)
+
+
 def test_sequence_parallel_rejects_indivisible(qkv):
     q, k, v = qkv
     mesh = make_mesh({SEQ_AXIS: 8})

@@ -92,6 +92,42 @@ def test_backend_round_trip(tmp_path, params, backend):
     np.testing.assert_array_equal(np.asarray(got.w1), np.asarray(params.w1))
 
 
+@pytest.mark.parametrize("backend", ["npz", "orbax"])
+def test_round_trip_nonalphabetical_fields(tmp_path, backend):
+    """Regression: NamedTuples whose field order differs from alphabetical
+    (MoEStackParams: wg, w1, w2; TransformerParams: ln1, wq, wk, ...) must
+    restore each leaf into its own field. An untargeted orbax restore
+    yields dict-key-sorted leaves; rebuilding the tree from those silently
+    permuted same-shaped fields."""
+    if backend == "orbax":
+        pytest.importorskip("orbax.checkpoint")
+    from distributed_llm_code_samples_tpu.models import (init_moe_stack,
+                                                         init_transformer)
+    for name, p in (("moe", init_moe_stack(jax.random.PRNGKey(0), 8, 2, 4)),
+                    ("tf", init_transformer(jax.random.PRNGKey(1), 16, 2))):
+        d = str(tmp_path / f"{name}_{backend}")
+        save_checkpoint(d, p, 1, backend=backend)
+        got, _, _ = restore_checkpoint(d, p)
+        for field in type(p)._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(got, field)),
+                np.asarray(getattr(p, field)), err_msg=field)
+
+
+def test_checkpoint_every_divisor_validated(tmp_path, params):
+    """A bad --checkpoint_every fails up front with a clear error, not as a
+    divisibility assert deep inside the strategy after segment 1."""
+    seeds = make_seed_schedule(8, random_seed=3)
+    with pytest.raises(ValueError, match="multiple of the data-shard"):
+        run_with_checkpointing(train_single, params, seeds, 32, 16,
+                               ckpt_dir=str(tmp_path), every=3,
+                               seeds_divisor=4)
+    with pytest.raises(ValueError, match="do not divide"):
+        run_with_checkpointing(train_single, params, seeds[:6], 32, 16,
+                               ckpt_dir=str(tmp_path), every=0,
+                               seeds_divisor=4)
+
+
 def _oracle(params, seeds, tokens, d):
     return train_single(params, seeds, tokens, d)
 
