@@ -88,29 +88,38 @@ def merge_heads(t: jax.Array) -> jax.Array:
 
 
 def attn_sublayer(wq, wk, wv, wo, a: jax.Array, n_heads: int,
-                  causal: bool = True) -> jax.Array:
+                  causal: bool = True, attn=None) -> jax.Array:
     """Projections + multi-head hand-VJP attention. ``a [B, T, d]``;
     weights ``[d_out, d]`` (``d_out`` may be a head-sharded slice under
-    TP — heads live on the leading output dim)."""
+    TP — heads live on the leading output dim).
+
+    ``attn`` swaps the per-batch multi-head attention op
+    (``(q, k, v, causal) -> y`` on ``[H, T, dh]``); None uses the
+    quadratic hand-VJP oracle ``mha``, trainers pass the fused Pallas
+    ``flash_mha`` via ``attn_impl="flash"``."""
     q, k, v = (split_heads(a @ w.T, n_heads) for w in (wq, wk, wv))
-    y = jax.vmap(lambda q, k, v: mha(q, k, v, causal))(q, k, v)
+    op = mha if attn is None else attn
+    y = jax.vmap(lambda q, k, v: op(q, k, v, causal))(q, k, v)
     return merge_heads(y) @ wo.T
 
 
 def transformer_block(ln1, wq, wk, wv, wo, ln2, w1, w2, x: jax.Array,
-                      n_heads: int, causal: bool = True) -> jax.Array:
+                      n_heads: int, causal: bool = True,
+                      attn=None) -> jax.Array:
     """One pre-LN block. ``x [B, T, d]`` -> ``[B, T, d]``."""
     b, s, d = x.shape
-    x = x + attn_sublayer(wq, wk, wv, wo, layernorm(ln1, x), n_heads, causal)
+    x = x + attn_sublayer(wq, wk, wv, wo, layernorm(ln1, x), n_heads,
+                          causal, attn)
     f = layernorm(ln2, x).reshape(b * s, d)
     return x + ffn_block(w1, w2, f).reshape(b, s, d)
 
 
 def transformer_fwd(params: TransformerParams, x: jax.Array, n_heads: int,
-                    causal: bool = True) -> jax.Array:
+                    causal: bool = True, attn=None) -> jax.Array:
     """Stack forward. ``x [B, T, d]``."""
     for l in range(params.n_layers):
         x = transformer_block(params.ln1[l], params.wq[l], params.wk[l],
                               params.wv[l], params.wo[l], params.ln2[l],
-                              params.w1[l], params.w2[l], x, n_heads, causal)
+                              params.w1[l], params.w2[l], x, n_heads,
+                              causal, attn)
     return x

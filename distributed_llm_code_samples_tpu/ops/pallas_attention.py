@@ -37,6 +37,16 @@ _LANES = 128
 _Q_QUANTUM = 8
 
 
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct carrying ``like``'s varying-manual-axes type, so
+    the kernels can be called from inside ``shard_map`` bodies (Ulysses /
+    TP / hybrid trainers) under JAX's ``check_vma`` typing."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _positions(i, j, bq, bk):
     q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
@@ -115,8 +125,8 @@ def flash_attention_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *,
             pl.BlockSpec((bq, dh), lambda i, j: (i, 0)),
             pl.BlockSpec((1, bq), lambda i, j: (0, i)),
         ],
-        out_shape=[jax.ShapeDtypeStruct((T, dh), q.dtype),
-                   jax.ShapeDtypeStruct((1, T), jnp.float32)],
+        out_shape=[_sds((T, dh), q.dtype, q),
+                   _sds((1, T), jnp.float32, q)],
         scratch_shapes=[pltpu.VMEM((bq, _LANES), jnp.float32),
                         pltpu.VMEM((bq, _LANES), jnp.float32),
                         pltpu.VMEM((bq, dh), jnp.float32)],
@@ -217,7 +227,7 @@ def flash_attention_bwd(dy: jax.Array, q, k, v, y, lse, *,
             pl.BlockSpec((1, bq), lambda i, j: (0, i)),    # D
         ],
         out_specs=pl.BlockSpec((bq, dh), lambda i, j: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((T, dh), q.dtype),
+        out_shape=_sds((T, dh), q.dtype, q),
         scratch_shapes=[pltpu.VMEM((bq, dh), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
@@ -240,8 +250,8 @@ def flash_attention_bwd(dy: jax.Array, q, k, v, y, lse, *,
             pl.BlockSpec((bk, dh), lambda j, t: (j, 0)),
             pl.BlockSpec((bk, dh), lambda j, t: (j, 0)),
         ],
-        out_shape=[jax.ShapeDtypeStruct((Tk, dh), k.dtype),
-                   jax.ShapeDtypeStruct((Tk, dh), v.dtype)],
+        out_shape=[_sds((Tk, dh), k.dtype, k),
+                   _sds((Tk, dh), v.dtype, v)],
         scratch_shapes=[pltpu.VMEM((bk, dh), jnp.float32),
                         pltpu.VMEM((bk, dh), jnp.float32)],
         compiler_params=pltpu.CompilerParams(

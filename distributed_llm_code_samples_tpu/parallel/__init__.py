@@ -23,7 +23,8 @@ from .sequence import (ring_attention, sequence_parallel_attention,
                        ulysses_attention, ulysses_parallel_attention)
 from .expert import train_moe_ep, moe_layer_ep
 from .transformer import (train_transformer_single, train_transformer_ddp,
-                          train_transformer_fsdp, train_transformer_tp)
+                          train_transformer_fsdp, train_transformer_tp,
+                          train_transformer_hybrid)
 
 # Method-number parity with the reference CLI (train_ffns.py:6, :373):
 # 1=single, 2=DDP, 3=FSDP, 4=TP; 5+ extend with the hybrid mesh and the
@@ -47,6 +48,7 @@ __all__ = [
     "train_pp", "train_moe_ep", "moe_layer_ep",
     "train_transformer_single", "train_transformer_ddp",
     "train_transformer_fsdp", "train_transformer_tp",
+    "train_transformer_hybrid",
     "ring_attention", "sequence_parallel_attention",
     "ulysses_attention", "ulysses_parallel_attention",
     "STRATEGIES",

@@ -194,12 +194,16 @@ def _ring_fn(mesh, causal: bool):
 # differentiate through the a2a transposes around the hand-written rule.
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                      axis_name: str = SEQ_AXIS, causal: bool = True):
+                      axis_name: str = SEQ_AXIS, causal: bool = True,
+                      attn=None):
     """Ulysses attention for one shard (call under ``shard_map``).
 
     ``q, k, v: [H, T_local, dh]`` — this shard's sequence block of every
     head; ``H`` must be divisible by the axis size. Returns the same shape,
-    exact full-sequence attention (no online-softmax approximation path).
+    exact full-sequence attention. ``attn`` swaps the local multi-head op
+    (None = quadratic hand-VJP ``mha``; pass the fused Pallas ``flash_mha``
+    — the a2a re-shard hands each shard FULL sequences of ``H/n`` heads,
+    exactly the shape the flash kernels tile best).
     """
     from ..models.attention import mha
     from .collectives import all_to_all
@@ -207,14 +211,18 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     def scatter_heads(t):  # [H, T_local, dh] -> [H/n, T, dh]
         return all_to_all(t, axis_name, split_dim=0, concat_dim=1)
 
-    y = mha(*map(scatter_heads, (q, k, v)), causal=causal)
+    op = mha if attn is None else attn
+    y = op(*map(scatter_heads, (q, k, v)), causal)
     return all_to_all(y, axis_name, split_dim=1, concat_dim=0)
 
 
 def ulysses_parallel_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                               mesh, causal: bool = True) -> jax.Array:
+                               mesh, causal: bool = True,
+                               attn_impl: str | None = None) -> jax.Array:
     """Launcher: shard ``[H, T, dh]`` tensors over the ``"seq"`` axis
-    (sequence dim), run Ulysses, return the result sharded the same way."""
+    (sequence dim), run Ulysses, return the result sharded the same way.
+    ``attn_impl="flash"`` runs the local attention on the fused Pallas
+    kernels (interpret mode off-TPU)."""
     require_axes(mesh, SEQ_AXIS)
     n = mesh.shape[SEQ_AXIS]
     if q.shape[1] % n:
@@ -226,13 +234,19 @@ def ulysses_parallel_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     spec = P(None, SEQ_AXIS, None)
     sharded = [jax.device_put(t, NamedSharding(mesh, spec))
                for t in (q, k, v)]
-    return _ulysses_fn(mesh, causal)(*sharded)
+    return _ulysses_fn(mesh, causal, attn_impl)(*sharded)
 
 
 @functools.lru_cache(maxsize=32)
-def _ulysses_fn(mesh, causal: bool):
+def _ulysses_fn(mesh, causal: bool, attn_impl: str | None = None):
+    from .transformer import resolve_attn
     spec = P(None, SEQ_AXIS, None)
+    # the Pallas interpreter mis-types scratch-vs-operand vma for the
+    # non-causal kernels (jax's own error suggests check_vma=False as the
+    # workaround); the oracle path keeps full vma checking
+    check = attn_impl in (None, "oracle") or causal
     return jax.jit(jax.shard_map(
         functools.partial(ulysses_attention, axis_name=SEQ_AXIS,
-                          causal=causal),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec))
+                          causal=causal, attn=resolve_attn(attn_impl)),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=check))

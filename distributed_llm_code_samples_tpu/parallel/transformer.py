@@ -105,13 +105,29 @@ def _validate_shapes(batch_size: int, seq_len: int, model_size: int,
                          f"n_heads={n_heads} (head dim must be whole)")
 
 
+def resolve_attn(attn_impl: str | None):
+    """Map an ``attn_impl`` name to the multi-head attention op the model
+    plugs in (``models.transformer.attn_sublayer``): None/"oracle" = the
+    quadratic hand-VJP ``mha``; "flash" = the fused Pallas kernels
+    (interpret mode automatically off-TPU), custom-VJP'd end to end."""
+    if attn_impl in (None, "oracle"):
+        return None
+    if attn_impl == "flash":
+        from ..ops.pallas_attention import flash_mha
+        interpret = jax.default_backend() != "tpu"
+        return lambda q, k, v, causal: flash_mha(q, k, v, causal, interpret)
+    raise ValueError(f"unknown attn_impl {attn_impl!r} "
+                     "(expected 'oracle' or 'flash')")
+
+
 def _make_single_step(tokens: int, model_size: int, seq_len: int,
-                      n_heads: int, lr: float, causal: bool = True):
+                      n_heads: int, lr: float, causal: bool = True,
+                      attn=None):
     def step(params: TransformerParams, seed) -> TransformerParams:
         x, dloss_dx = _reshape_batch(seed, tokens, seq_len, model_size,
                                      params.w1.dtype)
-        _, vjp = jax.vjp(lambda p: transformer_fwd(p, x, n_heads, causal),
-                         params)
+        _, vjp = jax.vjp(
+            lambda p: transformer_fwd(p, x, n_heads, causal, attn), params)
         return sgd(params, vjp(dloss_dx)[0], lr)
 
     return step
@@ -120,13 +136,15 @@ def _make_single_step(tokens: int, model_size: int, seq_len: int,
 def train_transformer_single(params: TransformerParams, seeds,
                              batch_size: int, model_size: int, mesh=None,
                              lr: float = LR, *, seq_len: int, n_heads: int,
-                             causal: bool = True) -> TransformerParams:
+                             causal: bool = True,
+                             attn_impl: str | None = None
+                             ) -> TransformerParams:
     """Single-device trainer; ``batch_size`` is tokens/step (seq folded,
     CLI convention ``train_ffns.py:379``), unfolded to
     ``[batch_size/seq_len, seq_len, d]`` for attention."""
     _validate_shapes(batch_size, seq_len, model_size, n_heads)
     step = _make_single_step(batch_size, model_size, seq_len, n_heads, lr,
-                             causal)
+                             causal, resolve_attn(attn_impl))
 
     @jax.jit
     def run(params, seeds):
@@ -137,20 +155,21 @@ def train_transformer_single(params: TransformerParams, seeds,
 
 def train_transformer_ddp(params: TransformerParams, seeds, batch_size: int,
                           model_size: int, mesh, lr: float = LR, *,
-                          seq_len: int, n_heads: int,
-                          causal: bool = True) -> TransformerParams:
+                          seq_len: int, n_heads: int, causal: bool = True,
+                          attn_impl: str | None = None) -> TransformerParams:
     """DDP: each shard trains its seed column on the full replicated model;
     grads psum per step."""
     require_axes(mesh, DATA_AXIS)
     n = mesh.shape[DATA_AXIS]
     _validate_shapes(batch_size, seq_len, model_size, n_heads)
     seed_cols = shard_seeds_strided(seeds, n)
+    attn = resolve_attn(attn_impl)
 
     def step(params: TransformerParams, seed) -> TransformerParams:
         x, dloss_dx = _reshape_batch(seed, batch_size, seq_len, model_size,
                                      params.w1.dtype)
-        _, vjp = jax.vjp(lambda p: transformer_fwd(p, x, n_heads, causal),
-                         params)
+        _, vjp = jax.vjp(
+            lambda p: transformer_fwd(p, x, n_heads, causal, attn), params)
         grads = vjp(dloss_dx)[0]
         grads = jax.tree_util.tree_map(
             lambda g: grad_reduce(g, DATA_AXIS), grads)
@@ -164,7 +183,9 @@ def train_transformer_ddp(params: TransformerParams, seeds, batch_size: int,
 def train_transformer_fsdp(params: TransformerParams, seeds,
                            batch_size: int, model_size: int, mesh,
                            lr: float = LR, *, seq_len: int, n_heads: int,
-                           causal: bool = True) -> TransformerParams:
+                           causal: bool = True,
+                           attn_impl: str | None = None
+                           ) -> TransformerParams:
     """FSDP/ZeRO-3 on the transformer: every param stack sharded over the
     data axis, each layer ``all_gather``-ed transiently per step (the
     unrolled loop lets XLA prefetch layer l+1's gathers during layer l's
@@ -183,6 +204,7 @@ def train_transformer_fsdp(params: TransformerParams, seeds,
             raise ValueError(f"{name} dim {leaf.shape[1]} not divisible by "
                              f"{n} shards")
     seed_cols = shard_seeds_strided(seeds, n)
+    attn = resolve_attn(attn_impl)
 
     def step(params: TransformerParams, seed) -> TransformerParams:
         x, dloss_dx = _reshape_batch(seed, batch_size, seq_len, model_size,
@@ -194,7 +216,7 @@ def train_transformer_fsdp(params: TransformerParams, seeds,
                 # gather this layer's full params (transient, never stored)
                 # and run the exact single-device block on them
                 full = (all_gather(leaf[l], DATA_AXIS, dim=0) for leaf in p)
-                y = transformer_block(*full, y, n_heads, causal)
+                y = transformer_block(*full, y, n_heads, causal, attn)
             return y
 
         _, vjp = jax.vjp(fwd, params)
@@ -207,27 +229,19 @@ def train_transformer_fsdp(params: TransformerParams, seeds,
 
 
 def tp_block(ln1, wq, wk, wv, wo, ln2, w1, w2, x, n_heads_local: int,
-             axis: str = MODEL_AXIS, causal: bool = True):
+             axis: str = MODEL_AXIS, causal: bool = True, attn=None):
     """One TP transformer block, per-shard view (local weights)."""
     f = _f_gate(axis)
     b, s, d = x.shape
     a = f(layernorm(ln1, x))
     x = x + all_reduce(                                    # Megatron g
-        attn_sublayer(wq, wk, wv, wo, a, n_heads_local, causal), axis)
+        attn_sublayer(wq, wk, wv, wo, a, n_heads_local, causal, attn), axis)
     h = f(layernorm(ln2, x)).reshape(b * s, d)
     y = all_reduce(ffn_block(w1, w2, h), axis)             # Megatron g
     return x + y.reshape(b, s, d)
 
 
-def train_transformer_tp(params: TransformerParams, seeds, batch_size: int,
-                         model_size: int, mesh, lr: float = LR, *,
-                         seq_len: int, n_heads: int,
-                         causal: bool = True) -> TransformerParams:
-    """Megatron TP over the ``"model"`` axis: data replicated, heads and
-    FFN features sharded, two psums per block per direction
-    (``train_ffns.py:303, :309`` cadence on the transformer block)."""
-    require_axes(mesh, MODEL_AXIS)
-    n = mesh.shape[MODEL_AXIS]
+def _validate_tp(params, n_heads: int, n: int) -> int:
     if n_heads % n:
         raise ValueError(f"n_heads={n_heads} not divisible by model-axis "
                          f"size {n}")
@@ -235,8 +249,21 @@ def train_transformer_tp(params: TransformerParams, seeds, batch_size: int,
     if ffn_dim % n:
         raise ValueError(f"ffn_dim={ffn_dim} not divisible by model-axis "
                          f"size {n}")
+    return n_heads // n
+
+
+def train_transformer_tp(params: TransformerParams, seeds, batch_size: int,
+                         model_size: int, mesh, lr: float = LR, *,
+                         seq_len: int, n_heads: int, causal: bool = True,
+                         attn_impl: str | None = None) -> TransformerParams:
+    """Megatron TP over the ``"model"`` axis: data replicated, heads and
+    FFN features sharded, two psums per block per direction
+    (``train_ffns.py:303, :309`` cadence on the transformer block)."""
+    require_axes(mesh, MODEL_AXIS)
+    n = mesh.shape[MODEL_AXIS]
+    h_local = _validate_tp(params, n_heads, n)
     _validate_shapes(batch_size, seq_len, model_size, n_heads)
-    h_local = n_heads // n
+    attn = resolve_attn(attn_impl)
 
     def step(params: TransformerParams, seed) -> TransformerParams:
         x, dloss_dx = _reshape_batch(seed, batch_size, seq_len, model_size,
@@ -247,7 +274,7 @@ def train_transformer_tp(params: TransformerParams, seeds, batch_size: int,
             for l in range(p.w1.shape[0]):
                 y = tp_block(p.ln1[l], p.wq[l], p.wk[l], p.wv[l], p.wo[l],
                              p.ln2[l], p.w1[l], p.w2[l], y, h_local,
-                             causal=causal)
+                             causal=causal, attn=attn)
             return y
 
         _, vjp = jax.vjp(fwd, params)
@@ -259,3 +286,51 @@ def train_transformer_tp(params: TransformerParams, seeds, batch_size: int,
 
     return launch(step, _shard(params, mesh, TP_SPECS), jnp.asarray(seeds),
                   mesh, param_specs=TP_SPECS, seed_spec=P())
+
+
+def train_transformer_hybrid(params: TransformerParams, seeds,
+                             batch_size: int, model_size: int, mesh,
+                             lr: float = LR, *, seq_len: int, n_heads: int,
+                             causal: bool = True,
+                             attn_impl: str | None = None
+                             ) -> TransformerParams:
+    """Hybrid DDP x TP on a 2-D ``(data, model)`` mesh — the BASELINE
+    config-4 composition on the transformer: TP's two per-block psums ride
+    the ``"model"`` axis inside each block, DDP's weight-grad psum rides
+    the orthogonal ``"data"`` axis once per step (``hybrid.py`` semantics
+    on the transformer surface). Seeds shard strided over ``data``
+    (``train_ffns.py:182``); params shard over ``model`` only."""
+    require_axes(mesh, DATA_AXIS, MODEL_AXIS)
+    dp = mesh.shape[DATA_AXIS]
+    n = mesh.shape[MODEL_AXIS]
+    h_local = _validate_tp(params, n_heads, n)
+    _validate_shapes(batch_size, seq_len, model_size, n_heads)
+    seed_cols = shard_seeds_strided(seeds, dp)
+    attn = resolve_attn(attn_impl)
+
+    def step(params: TransformerParams, seed) -> TransformerParams:
+        x, dloss_dx = _reshape_batch(seed, batch_size, seq_len, model_size,
+                                     params.w1.dtype)
+
+        def fwd(p):
+            y = x
+            for l in range(p.w1.shape[0]):
+                y = tp_block(p.ln1[l], p.wq[l], p.wk[l], p.wv[l], p.wo[l],
+                             p.ln2[l], p.w1[l], p.w2[l], y, h_local,
+                             causal=causal, attn=attn)
+            return y
+
+        _, vjp = jax.vjp(fwd, params)
+        grads = vjp(dloss_dx)[0]
+        # TP leaves weight grads complete within a model shard; the data
+        # axis still needs the DDP reduction (orthogonal psums, the 2-D
+        # mesh composition)
+        grads = jax.tree_util.tree_map(
+            lambda g: grad_reduce(g, DATA_AXIS), grads)
+        return sgd(params, grads, lr)
+
+    # params: sharded over model, replicated over data; seeds: one strided
+    # column per data shard, same column for every model shard
+    return launch(step, _shard(params, mesh, TP_SPECS), seed_cols, mesh,
+                  param_specs=TP_SPECS, seed_spec=P(None, DATA_AXIS),
+                  select_local=lambda s: s[:, 0])

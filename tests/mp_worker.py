@@ -1,0 +1,54 @@
+"""Worker for the real multi-process runtime test (tests/test_multiprocess.py).
+
+Two of these processes rendezvous through ``runtime.init.initialize`` (the
+``init_process`` analogue, ``train_ffns.py:121-127``), form one global
+4-device mesh (2 fake CPU devices per process), and run the DDP strategy
+across the process boundary. Process 0 saves the final params for the
+parent test to compare against a single-process run of the same schedule.
+
+Usage: ``python mp_worker.py <port> <process_id> <out_npz>``
+(XLA_FLAGS with ``--xla_force_host_platform_device_count=2`` must be set
+by the parent.)
+"""
+
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main():
+    port, process_id, out_path = (sys.argv[1], int(sys.argv[2]),
+                                  sys.argv[3])
+    from distributed_llm_code_samples_tpu.runtime.init import (initialize,
+                                                               runtime_info)
+    initialize(f"127.0.0.1:{port}", num_processes=2, process_id=process_id)
+
+    info = runtime_info()
+    assert info["process_count"] == 2, info
+    assert info["global_devices"] == 4, info
+    assert info["local_devices"] == 2, info
+
+    import numpy as np
+    from distributed_llm_code_samples_tpu.data import make_seed_schedule
+    from distributed_llm_code_samples_tpu.models import init_ffn_stack
+    from distributed_llm_code_samples_tpu.parallel import (make_mesh,
+                                                           train_ddp,
+                                                           DATA_AXIS)
+
+    params = init_ffn_stack(jax.random.PRNGKey(0), 16, 2)
+    seeds = make_seed_schedule(8, random_seed=5)
+    mesh = make_mesh({DATA_AXIS: 4})  # spans both processes
+    out = train_ddp(params, seeds, 16, 16, mesh, lr=0.1)
+    jax.block_until_ready(out)
+
+    if process_id == 0:
+        np.savez(out_path, w1=np.asarray(out.w1), w2=np.asarray(out.w2))
+    # all processes exit the distributed service cleanly
+    jax.distributed.shutdown()
+    print(f"mp_worker {process_id}: ok")
+
+
+if __name__ == "__main__":
+    main()

@@ -200,6 +200,23 @@ def test_ulysses_matches_mha_oracle(qkv_heads, causal, shards):
                                rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_flash_matches_oracle(qkv_heads, causal):
+    """Ulysses with the fused Pallas flash kernels as the local attention
+    (attn_impl='flash'): the a2a re-shard hands each shard full sequences
+    of H/n heads, which flash tiles without materializing [T, T]; results
+    equal the quadratic-oracle Ulysses path."""
+    from distributed_llm_code_samples_tpu.parallel import (
+        ulysses_parallel_attention)
+    q, k, v = qkv_heads
+    mesh = make_mesh({SEQ_AXIS: 4})
+    y = ulysses_parallel_attention(q, k, v, mesh, causal=causal,
+                                   attn_impl="flash")
+    np.testing.assert_allclose(np.asarray(y),
+                               np.asarray(mha(q, k, v, causal)),
+                               rtol=1e-4, atol=1e-5)
+
+
 def test_ulysses_equals_ring_per_head(qkv_heads):
     """The two sequence-parallel schemes agree with each other."""
     from distributed_llm_code_samples_tpu.parallel import (

@@ -1,0 +1,74 @@
+"""Real multi-process runtime test (VERDICT r1 item 6).
+
+The reference actually spawns N OS processes that rendezvous over TCP and
+train together (``train_ffns.py:121-127, :184-191``). This framework's
+analogue is one process per host + ``jax.distributed``; here we prove that
+path end-to-end: two subprocesses, each owning 2 fake CPU devices, join
+through ``runtime.init.initialize`` and run DDP over one global 4-device
+mesh. The result must equal the same schedule run in a single process —
+the process boundary is invisible to the math.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_ddp_equals_single_process(tmp_path):
+    port = _free_port()
+    out_npz = str(tmp_path / "mp_out.npz")
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("JAX_PLATFORMS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.join(_REPO, "tests", "mp_worker.py"),
+             str(port), str(i), out_npz],
+            cwd=_REPO, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multi-process workers timed out (rendezvous hang?)")
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out[-3000:]}"
+
+    # single-process oracle: the SAME schedule on this process's own
+    # 4-device mesh (conftest gives 8 fake devices)
+    from distributed_llm_code_samples_tpu.data import make_seed_schedule
+    from distributed_llm_code_samples_tpu.models import init_ffn_stack
+    from distributed_llm_code_samples_tpu.parallel import (make_mesh,
+                                                           train_ddp,
+                                                           DATA_AXIS)
+    params = init_ffn_stack(jax.random.PRNGKey(0), 16, 2)
+    seeds = make_seed_schedule(8, random_seed=5)
+    ref = train_ddp(params, seeds, 16, 16, make_mesh({DATA_AXIS: 4}),
+                    lr=0.1)
+
+    got = np.load(out_npz)
+    np.testing.assert_allclose(got["w1"], np.asarray(ref.w1),
+                               rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(got["w2"], np.asarray(ref.w2),
+                               rtol=1e-6, atol=1e-7)

@@ -20,7 +20,8 @@ from distributed_llm_code_samples_tpu.ops.norm import layernorm, ln_fwd
 from distributed_llm_code_samples_tpu.optim import sgd
 from distributed_llm_code_samples_tpu.parallel import (
     DATA_AXIS, MODEL_AXIS, make_mesh, train_transformer_ddp,
-    train_transformer_fsdp, train_transformer_single, train_transformer_tp)
+    train_transformer_fsdp, train_transformer_single, train_transformer_tp,
+    train_transformer_hybrid)
 
 B, T, D, H, L = 2, 16, 32, 4, 2
 
@@ -203,3 +204,66 @@ def test_seq_len_divisibility(params):
     with pytest.raises(ValueError, match="seq_len"):
         train_transformer_single(params, make_seed_schedule(1, 1), 33, D,
                                  seq_len=T, n_heads=H)
+
+
+def test_hybrid_matches_ddp(params):
+    """Hybrid DDP x TP (2x2 mesh) == plain DDP (2 shards) on the same
+    strided schedule — the 2-D composition leaves the math invariant
+    (the FFN-stack hybrid test's stance on the transformer)."""
+    seeds = make_seed_schedule(4, random_seed=21)
+    ddp = train_transformer_ddp(params, seeds, TOKENS, D,
+                                make_mesh({DATA_AXIS: 2}), lr=0.05,
+                                seq_len=T, n_heads=H)
+    hyb = train_transformer_hybrid(params, seeds, TOKENS, D,
+                                   make_mesh({DATA_AXIS: 2, MODEL_AXIS: 2}),
+                                   lr=0.05, seq_len=T, n_heads=H)
+    for name, a, b in zip(TransformerParams._fields, hyb, ddp):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4,
+                                   atol=1e-5, err_msg=name)
+
+
+# --- Flash attention in the training path ---------------------------------
+
+def test_flash_single_matches_oracle_attention(params):
+    """The fused Pallas flash kernels as the training-path attention
+    (attn_impl='flash', interpret off-TPU) reproduce the quadratic
+    hand-VJP oracle through a full multi-step training run."""
+    seeds = make_seed_schedule(2, random_seed=17)
+    base = train_transformer_single(params, seeds, TOKENS, D, lr=0.05,
+                                    seq_len=T, n_heads=H)
+    flash = train_transformer_single(params, seeds, TOKENS, D, lr=0.05,
+                                     seq_len=T, n_heads=H,
+                                     attn_impl="flash")
+    for name, a, b in zip(TransformerParams._fields, flash, base):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4,
+                                   atol=1e-5, err_msg=name)
+
+
+def test_flash_tp_matches_single(params):
+    """flash attention composes with Megatron TP: each shard flashes its
+    own H/n heads; TP==single still holds."""
+    seeds = make_seed_schedule(2, random_seed=19)
+    single = train_transformer_single(params, seeds, TOKENS, D, lr=0.05,
+                                      seq_len=T, n_heads=H,
+                                      attn_impl="flash")
+    tp = train_transformer_tp(params, seeds, TOKENS, D,
+                              make_mesh({MODEL_AXIS: 4}), lr=0.05,
+                              seq_len=T, n_heads=H, attn_impl="flash")
+    for name, a, b in zip(TransformerParams._fields, tp, single):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4,
+                                   atol=1e-5, err_msg=name)
+
+
+def test_flash_hybrid_matches_oracle_hybrid(params):
+    """attn_impl='flash' through the 2-D hybrid trainer changes nothing
+    numerically (same hand-VJP math, fused tiling)."""
+    seeds = make_seed_schedule(2, random_seed=23)
+    mesh = make_mesh({DATA_AXIS: 2, MODEL_AXIS: 2})
+    base = train_transformer_hybrid(params, seeds, TOKENS, D, mesh, lr=0.05,
+                                    seq_len=T, n_heads=H)
+    flash = train_transformer_hybrid(params, seeds, TOKENS, D, mesh,
+                                     lr=0.05, seq_len=T, n_heads=H,
+                                     attn_impl="flash")
+    for name, a, b in zip(TransformerParams._fields, flash, base):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4,
+                                   atol=1e-5, err_msg=name)
