@@ -42,7 +42,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tp", type=int, default=2,
                    help="model-axis size for --method 5 and 8")
     p.add_argument("--microbatches", type=int, default=0,
-                   help="GPipe microbatches for --method 6 (0 = n_stages)")
+                   help="pipeline microbatches for --method 6 (0 = n_stages)")
+    p.add_argument("--pp_schedule", choices=["gpipe", "1f1b"],
+                   default="gpipe",
+                   help="pipeline schedule for --method 6: gpipe (two "
+                        "wavefronts, stash of M microbatches) or 1f1b "
+                        "(interleaved, stash bounded by stage depth)")
     p.add_argument("--experts", type=int, default=8,
                    help="expert count for --method 7 (MoE)")
     p.add_argument("--heads", type=int, default=4,
@@ -155,7 +160,7 @@ def main(argv=None) -> int:
         mesh = mesh_for(m)
         kwargs = dict(lr=lr, unroll=unroll)
         if m == 6:
-            kwargs = dict(lr=lr)  # PP's tick loop has its own structure
+            kwargs = dict(lr=lr, schedule=args.pp_schedule)
             if args.microbatches:
                 kwargs["n_microbatches"] = args.microbatches
         if m == 7:

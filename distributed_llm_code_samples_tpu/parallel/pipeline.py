@@ -1,5 +1,5 @@
 """Pipeline parallelism: layers staged across the ``"pipe"`` axis, with
-hand-rolled ``ppermute`` send/recv and GPipe microbatching.
+hand-rolled ``ppermute`` send/recv and two microbatch schedules.
 
 The reference has **no** pipeline parallelism and no point-to-point
 send/recv anywhere (SURVEY.md section 2.2) — but the driver's BASELINE
@@ -9,20 +9,38 @@ way: one SPMD program over a ``("pipe",)`` mesh axis where every stage
 runs the same code and neighbor transfer is ``lax.ppermute`` over the ICI
 ring (``collectives.ring_shift``) — the XLA lowering of NCCL send/recv.
 
-Schedule (GPipe): the step's ``tokens`` are split into ``M`` microbatches.
-Forward runs ``M + S - 1`` ticks; at tick ``t`` stage ``s`` computes
-microbatch ``t - s`` (a bubble of ``S - 1`` idle ticks per direction is
-masked out, the standard GPipe cost). Activations stream stage-to-stage
-with a ``+1`` ring shift. The backward walks the same wavefront in
-reverse with a ``-1`` shift, consuming per-tick stashed block inputs.
-Because the mock loss needs no forward output (``dloss_dx`` is generated
-from the step seed, ``train_ffns.py:150``), the last stage starts the
-backward from its own locally-generated ``dloss_dx`` — no loss broadcast.
+Two schedules, selected by ``schedule=``:
 
-Gradient semantics are exact: microbatch weight-grads sum to the
-full-batch grad, so PP's final params equal the single-device run's
-bit-for-tolerance (a differential test the suite asserts). Weight grads
-never cross stages; each stage runs SGD on its own layers
+**"gpipe"** (default): all ``M`` forwards wave through the ring
+(``M + S - 1`` ticks), then all backwards in reverse. At tick ``t`` stage
+``s`` computes microbatch ``t - s``; bubble ticks take a ``lax.cond``
+idle branch, so a stage *skips* its out-of-wavefront compute instead of
+computing-and-masking it. The stash holds one activation set per
+**microbatch** (``[M, L/S, mb, d]``) — the minimum GPipe needs.
+
+**"1f1b"**: forward and backward wavefronts share one slot stream of the
+same ``2(M + S - 1)`` length, with stage ``s`` forwarding microbatch
+``m`` at slot ``s + 2m`` and backwarding it at slot ``2S - 1 - s + 2m``
+(the classic one-forward-one-backward interleave, expressed lockstep:
+F and B land on opposite slot parities per stage so each slot runs at
+most one block compute via ``lax.switch``). A microbatch's activations
+live ``2(S - s) - 1`` slots, so the stash is a circular buffer of depth
+``min(S, M)`` — peak activation memory is bounded by the *stage depth*,
+not the microbatch count, which is the whole point of 1F1B: with
+``M >> S`` the GPipe stash grows linearly while this one is constant
+(pinned by a structural test on the traced program's buffer shapes).
+
+Every slot moves both streams: activation ``+1`` and gradient ``-1``
+ring shifts. Stage 0 injects inputs, the last stage injects
+``dloss_dx``. Because the mock loss needs no forward output
+(``dloss_dx`` is generated from the step seed, ``train_ffns.py:150``),
+the last stage starts each microbatch's backward from its own
+locally-generated slice — no loss broadcast.
+
+Gradient semantics are exact under both schedules: microbatch
+weight-grads sum to the full-batch grad, so PP's final params equal the
+single-device run's bit-for-tolerance (differential tests assert this).
+Weight grads never cross stages; each stage runs SGD on its own layers
 (``train_ffns.py:311-312`` locality, transplanted to the layer dimension).
 """
 
@@ -30,6 +48,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import LR
@@ -45,6 +64,8 @@ from .mesh import PIPE_AXIS, require_axes
 PARAM_SPECS = FFNStackParams(w1=P(PIPE_AXIS, None, None),
                              w2=P(PIPE_AXIS, None, None))
 
+SCHEDULES = ("gpipe", "1f1b")
+
 
 def shard_params(params: FFNStackParams, mesh) -> FFNStackParams:
     return reshard_copy(params, FFNStackParams(
@@ -52,15 +73,143 @@ def shard_params(params: FFNStackParams, mesh) -> FFNStackParams:
         w2=NamedSharding(mesh, PARAM_SPECS.w2)))
 
 
+def _vzeros(shape, dtype, axis: str):
+    """Zeros typed as *varying* over the pipe axis, so idle ``cond``/
+    ``switch`` branches match the compute branches' vma types."""
+    return lax.pvary(jnp.zeros(shape, dtype), (axis,))
+
+
+def _gpipe_step(params: FFNStackParams, x_mb, dy_mb, s, M: int, S: int,
+                axis: str):
+    """GPipe: forward wavefront, fence, backward wavefront."""
+    mb, d = x_mb.shape[1:]
+    dtype = x_mb.dtype
+    ticks = M + S - 1
+    n_local = params.w1.shape[0]
+
+    # ---- forward wavefront: activation streams +1 around the ring ----
+    state = _vzeros((mb, d), dtype, axis)
+    stash = _vzeros((M, n_local, mb, d), dtype, axis)
+    for t in range(ticks):
+        m = t - s  # this stage's microbatch this tick (traced: s varies)
+        valid = (m >= 0) & (m < M)
+        mc = jnp.clip(m, 0, M - 1)
+        # stage 0 injects microbatch t; everyone else consumes the recv
+        inp = jnp.where(s == 0, x_mb[min(t, M - 1)], state)
+
+        def fwd_branch(stash):
+            y, acts = stack_fwd(params.w1, params.w2, inp)
+            return stash.at[mc].set(acts), y
+
+        def fwd_idle(stash):
+            return stash, _vzeros((mb, d), dtype, axis)
+
+        # bubble ticks skip the block compute entirely (idle branch), they
+        # don't compute-and-mask
+        stash, y = lax.cond(valid, fwd_branch, fwd_idle, stash)
+        state = ring_shift(y, axis, shift=1)
+
+    # the reference's host-side Barrier between phases
+    # (test_mp_barrier_gpus.py:32-34) becomes an in-program fence on
+    # the stash the backward consumes
+    stash = barrier(stash, axis)
+
+    # ---- backward wavefront: grads stream -1 around the ring ----
+    dstate = _vzeros((mb, d), dtype, axis)
+    g1 = _vzeros(params.w1.shape, params.w1.dtype, axis)
+    g2 = _vzeros(params.w2.shape, params.w2.dtype, axis)
+    for u in range(ticks):
+        m = u - (S - 1) + s  # stage s backward-processes microbatch m
+        valid = (m >= 0) & (m < M)
+        mc = jnp.clip(m, 0, M - 1)
+        dy_in = jnp.where(s == S - 1, dy_mb[min(u, M - 1)], dstate)
+
+        def bwd_branch(carry):
+            g1, g2 = carry
+            dx, (dg1, dg2) = stack_bwd(dy_in, params.w1, params.w2,
+                                       stash[mc])
+            return (g1 + dg1, g2 + dg2), dx
+
+        def bwd_idle(carry):
+            return carry, _vzeros((mb, d), dtype, axis)
+
+        (g1, g2), dx = lax.cond(valid, bwd_branch, bwd_idle, (g1, g2))
+        dstate = ring_shift(dx, axis, shift=-1)
+
+    return g1, g2
+
+
+def _1f1b_step(params: FFNStackParams, x_mb, dy_mb, s, M: int, S: int,
+               axis: str):
+    """1F1B: one slot stream; stage ``s`` forwards microbatch ``m`` at slot
+    ``s + 2m`` and backwards it at slot ``2S - 1 - s + 2m``. The two land
+    on opposite slot parities per stage, so every slot is exactly one of
+    {forward, backward, bubble} — picked by ``lax.switch``. The circular
+    stash never clobbers a live entry: slot ``m % K``'s next write
+    (forward of ``m + K``) happens at ``s + 2m + 2K >= s + 2m + 2S``,
+    after its read (backward of ``m``) at ``2S - 1 - s + 2m``."""
+    mb, d = x_mb.shape[1:]
+    dtype = x_mb.dtype
+    n_local = params.w1.shape[0]
+    K = min(S, M)  # in-flight microbatches per stage — the 1F1B bound
+
+    state_f = _vzeros((mb, d), dtype, axis)  # activation arriving from s-1
+    state_b = _vzeros((mb, d), dtype, axis)  # gradient arriving from s+1
+    stash = _vzeros((K, n_local, mb, d), dtype, axis)
+    g1 = _vzeros(params.w1.shape, params.w1.dtype, axis)
+    g2 = _vzeros(params.w2.shape, params.w2.dtype, axis)
+
+    for tau in range(2 * (M + S - 1)):
+        mf = (tau - s) // 2  # fwd microbatch, live when (tau - s) is even
+        f_valid = ((tau - s) % 2 == 0) & (mf >= 0) & (mf < M)
+        mbk = (tau + s + 1 - 2 * S) // 2  # bwd microbatch, opposite parity
+        b_valid = ((tau + s + 1 - 2 * S) % 2 == 0) & (mbk >= 0) & (mbk < M)
+        mfc = jnp.clip(mf, 0, M - 1)
+        mbc = jnp.clip(mbk, 0, M - 1)
+
+        inp = jnp.where(s == 0, x_mb[mfc], state_f)
+        dy_in = jnp.where(s == S - 1, dy_mb[mbc], state_b)
+
+        def idle(carry):
+            stash, g1, g2 = carry
+            z = _vzeros((mb, d), dtype, axis)
+            return stash, g1, g2, z, z
+
+        def fwd_branch(carry):
+            stash, g1, g2 = carry
+            y, acts = stack_fwd(params.w1, params.w2, inp)
+            return (stash.at[mfc % K].set(acts), g1, g2, y,
+                    _vzeros((mb, d), dtype, axis))
+
+        def bwd_branch(carry):
+            stash, g1, g2 = carry
+            dx, (dg1, dg2) = stack_bwd(dy_in, params.w1, params.w2,
+                                       stash[mbc % K])
+            return (stash, g1 + dg1, g2 + dg2,
+                    _vzeros((mb, d), dtype, axis), dx)
+
+        which = jnp.where(f_valid, 1, jnp.where(b_valid, 2, 0))
+        stash, g1, g2, y, dx = lax.switch(
+            which, (idle, fwd_branch, bwd_branch), (stash, g1, g2))
+        state_f = ring_shift(y, axis, shift=1)
+        state_b = ring_shift(dx, axis, shift=-1)
+
+    return g1, g2
+
+
 def make_step(batch_size: int, model_size: int, n_stages: int,
-              n_microbatches: int, lr: float = LR, axis: str = PIPE_AXIS):
+              n_microbatches: int, lr: float = LR, axis: str = PIPE_AXIS,
+              schedule: str = "gpipe"):
     """One PP step for one stage (local views: ``w1 [L/S, ffn, d]``)."""
     S, M = n_stages, n_microbatches
     if batch_size % M:
         raise ValueError(f"tokens {batch_size} not divisible by "
                          f"{M} microbatches")
+    if schedule not in SCHEDULES:
+        raise ValueError(f"unknown pipeline schedule {schedule!r} "
+                         f"(expected one of {SCHEDULES})")
     mb = batch_size // M
-    ticks = M + S - 1
+    sched = _gpipe_step if schedule == "gpipe" else _1f1b_step
 
     def step(params: FFNStackParams, seed) -> FFNStackParams:
         s = axis_index(axis)
@@ -68,40 +217,7 @@ def make_step(batch_size: int, model_size: int, n_stages: int,
                                       params.w1.dtype)
         x_mb = x.reshape(M, mb, model_size)
         dy_mb = dloss_dx.reshape(M, mb, model_size)
-        n_local = params.w1.shape[0]
-
-        # ---- forward wavefront: activation streams +1 around the ring ----
-        state = jnp.zeros((mb, model_size), x.dtype)
-        stash = jnp.zeros((ticks, n_local, mb, model_size), x.dtype)
-        for t in range(ticks):
-            # stage 0 injects microbatch t; everyone else consumes the recv
-            inp = jnp.where(s == 0, x_mb[min(t, M - 1)], state)
-            y, acts = stack_fwd(params.w1, params.w2, inp)
-            stash = stash.at[t].set(acts)
-            state = ring_shift(y, axis, shift=1)
-
-        # the reference's host-side Barrier between phases
-        # (test_mp_barrier_gpus.py:32-34) becomes an in-program fence on
-        # the stash the backward consumes
-        stash = barrier(stash, axis)
-
-        # ---- backward wavefront: grads stream -1 around the ring ----
-        dstate = jnp.zeros((mb, model_size), x.dtype)
-        g1 = jnp.zeros_like(params.w1)
-        g2 = jnp.zeros_like(params.w2)
-        for u in range(ticks):
-            # stage s backward-processes microbatch m at tick u
-            m = u - (S - 1) + s
-            valid = (m >= 0) & (m < M)
-            dy_in = jnp.where(s == S - 1, dy_mb[min(u, M - 1)], dstate)
-            # its forward stash for microbatch m lives at tick m + s
-            t_idx = jnp.clip(u - (S - 1) + 2 * s, 0, ticks - 1)
-            acts = jnp.take(stash, t_idx, axis=0)
-            dx, (dg1, dg2) = stack_bwd(dy_in, params.w1, params.w2, acts)
-            g1 = g1 + jnp.where(valid, dg1, jnp.zeros((), g1.dtype))
-            g2 = g2 + jnp.where(valid, dg2, jnp.zeros((), g2.dtype))
-            dstate = ring_shift(dx, axis, shift=-1)
-
+        g1, g2 = sched(params, x_mb, dy_mb, s, M, S, axis)
         # per-stage SGD on the stage's own layers
         return sgd(params, FFNStackParams(g1, g2), lr)
 
@@ -110,7 +226,8 @@ def make_step(batch_size: int, model_size: int, n_stages: int,
 
 def train_pp(params: FFNStackParams, seeds, batch_size: int,
              model_size: int, mesh, lr: float = LR,
-             n_microbatches: int | None = None) -> FFNStackParams:
+             n_microbatches: int | None = None,
+             schedule: str = "gpipe") -> FFNStackParams:
     """Run the full PP schedule. Data (seeds) is replicated — every stage
     regenerates the step's batch locally and uses the slice of the
     wavefront that is its own, so PP consumes the same steps as the
@@ -122,7 +239,7 @@ def train_pp(params: FFNStackParams, seeds, batch_size: int,
                          f"{S} pipeline stages")
     M = S if n_microbatches is None else n_microbatches
     params = shard_params(params, mesh)
-    step = make_step(batch_size, model_size, S, M, lr)
+    step = make_step(batch_size, model_size, S, M, lr, schedule=schedule)
 
     return launch(step, params, jnp.asarray(seeds), mesh,
                   param_specs=PARAM_SPECS, seed_spec=P())

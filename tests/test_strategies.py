@@ -161,6 +161,65 @@ def test_pp_uses_collective_permute(setup):
     assert counts["collective_permute"] >= 2 * (4 + 4 - 2)
 
 
+@pytest.mark.parametrize("n_mb", [2, 4, 8])
+def test_pp_1f1b_matches_single_device(setup, n_mb):
+    # the 1F1B interleave covers all three regimes: M < S (deep warmup),
+    # M == S, M > S (circular stash wraps)
+    params = init_ffn_stack(jax.random.PRNGKey(42), D, 4)
+    _, seeds = setup
+    mesh = make_mesh({PIPE_AXIS: 4})
+    p_single = train_single(params, seeds, B, D, lr=LR_TEST)
+    p_pp = train_pp(params, seeds, B, D, mesh, lr=LR_TEST,
+                    n_microbatches=n_mb, schedule="1f1b")
+    _assert_params_close(p_single, p_pp)
+
+
+def test_pp_1f1b_stash_depth_is_stage_bound(setup):
+    """1F1B's point: in-flight activations are bounded by the stage depth
+    S, not the microbatch count M. Structurally: the traced step holds a
+    stash of depth min(S, M); no buffer of depth M (or M+S-1, the old
+    per-tick stash) may exist for M > S. GPipe's stash, by contrast, is
+    exactly M deep."""
+    from distributed_llm_code_samples_tpu.parallel import pipeline
+    from distributed_llm_code_samples_tpu.models.ffn_stack import (
+        FFNStackParams)
+    from jax.sharding import PartitionSpec as P
+    S_, M_ = 4, 16
+    n_local, mb = 1, B // M_  # 4 layers over 4 stages
+
+    def stash_str(depth):  # the stash's printed aval, e.g. f32[4,1,2,64]
+        return f"f32[{depth},{n_local},{mb},{D}]"
+
+    def trace(schedule):
+        step = pipeline.make_step(B, D, S_, M_, lr=LR_TEST,
+                                  schedule=schedule)
+        mesh = make_mesh({PIPE_AXIS: S_})
+        run = jax.shard_map(step, mesh=mesh,
+                            in_specs=(pipeline.PARAM_SPECS, P()),
+                            out_specs=pipeline.PARAM_SPECS)
+        full = FFNStackParams(
+            w1=jax.ShapeDtypeStruct((S_, 4 * D, D), jnp.float32),
+            w2=jax.ShapeDtypeStruct((S_, D, 4 * D), jnp.float32))
+        return str(jax.make_jaxpr(run)(
+            full, jax.ShapeDtypeStruct((), jnp.int32)))
+
+    jx = trace("1f1b")
+    assert stash_str(S_) in jx, "1f1b stash of depth min(S,M) missing"
+    assert stash_str(M_) not in jx, "1f1b allocated an M-deep buffer"
+    assert stash_str(M_ + S_ - 1) not in jx, "per-tick stash came back"
+    jg = trace("gpipe")
+    assert stash_str(M_) in jg, "gpipe stash should be exactly M deep"
+    assert stash_str(M_ + S_ - 1) not in jg, "per-tick stash came back"
+
+
+def test_pp_rejects_unknown_schedule(setup):
+    params, seeds = setup
+    mesh = make_mesh({PIPE_AXIS: 4})
+    with pytest.raises(ValueError, match="schedule"):
+        train_pp(init_ffn_stack(jax.random.PRNGKey(0), D, 4), seeds, B, D,
+                 mesh, lr=LR_TEST, schedule="interleaved")
+
+
 def test_scan_path_agrees(setup, mesh4):
     params, seeds = setup
     p_u = train_ddp(params, seeds, B, D, mesh4, lr=LR_TEST, unroll=True)
