@@ -29,11 +29,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-l", "--layers", type=int, default=1)
     p.add_argument("-d", "--model_size", type=int, default=4)
     p.add_argument("-m", "--method", type=int, default=0,
-                   choices=range(9),
+                   choices=range(10),
                    help="0=all(1-4), 1=single, 2=DDP, 3=FSDP, 4=TP, "
                         "5=hybrid DDP x TP, 6=pipeline (ppermute send/recv), "
                         "7=MoE expert parallelism (all_to_all), "
-                        "8=transformer blocks (Megatron TP; --heads)")
+                        "8=transformer blocks (Megatron TP; --heads), "
+                        "9=all(1-8) with every strategy cross-verified "
+                        "against its oracle")
     p.add_argument("-r", "--random_seed", type=int, default=0,
                    help="!=0 makes runs reproducible (train_ffns.py:350)")
     # TPU-build extensions
@@ -113,16 +115,28 @@ def main(argv=None) -> int:
 
     seeds = make_seed_schedule(args.num_steps, args.random_seed)
     key = jax.random.PRNGKey(args.random_seed)
-    if args.method == 7:
-        params = init_moe_stack(key, args.model_size, args.layers,
-                                args.experts, dtype=dtype)
-    elif args.method == 8:
-        params = init_transformer(key, args.model_size, args.layers,
-                                  dtype=dtype)
-    else:
-        params = init_ffn_stack(key, args.model_size, args.layers,
-                                dtype=dtype)
 
+    def family_of(method: int) -> str:
+        return {7: "moe", 8: "transformer"}.get(method, "ffn")
+
+    _family_params = {}
+
+    def params_for(method: int):
+        fam = family_of(method)
+        if fam not in _family_params:
+            if fam == "moe":
+                _family_params[fam] = init_moe_stack(
+                    key, args.model_size, args.layers, args.experts,
+                    dtype=dtype)
+            elif fam == "transformer":
+                _family_params[fam] = init_transformer(
+                    key, args.model_size, args.layers, dtype=dtype)
+            else:
+                _family_params[fam] = init_ffn_stack(
+                    key, args.model_size, args.layers, dtype=dtype)
+        return _family_params[fam]
+
+    params = params_for(args.method if args.method != 9 else 1)
     print(f"PARAMS: {params.num_params():_} "
           f"(size {params_size_gb(params)} GB)\n\n")
     corner = (lambda w: w[0, 0]) if args.method == 7 else (lambda w: w[0])
@@ -153,10 +167,16 @@ def main(argv=None) -> int:
         dp = args.dp or max(1, n_dev // tp)
         return make_mesh({DATA_AXIS: dp, MODEL_AXIS: tp})
 
-    selected = [1, 2, 3, 4] if args.method == 0 else [args.method]
+    if args.method == 0:
+        selected = [1, 2, 3, 4]
+    elif args.method == 9:
+        selected = [1, 2, 3, 4, 5, 6, 7, 8]
+    else:
+        selected = [args.method]
     results = {}
     for m in selected:
         name, fn = STRATEGIES[m]
+        params = params_for(m)
         mesh = mesh_for(m)
         kwargs = dict(lr=lr, unroll=unroll)
         if m == 6:
@@ -193,28 +213,60 @@ def main(argv=None) -> int:
         jax.block_until_ready(out)
         t1 = time.time()
         results[m] = out
+        corner_m = (lambda w: w[0, 0]) if m == 7 else (lambda w: w[0])
         print(f"\n{name} takes {t1 - t0} seconds")
         print(f"final {name} layers_params[0]", out.w1[0].shape,
               out.w2[0].shape)
-        print(f"final {name} layers_params[0]", corner(out.w1)[:5, :5],
-              corner(out.w2)[:5, :5])
+        print(f"final {name} layers_params[0]", corner_m(out.w1)[:5, :5],
+              corner_m(out.w2)[:5, :5])
 
     failed = False
-    if args.method == 0:
+    if args.method in (0, 9):
         # the reference compares DDP vs FSDP (:386-391); we also pin TP to
         # the single-device oracle (same data schedule). The Pallas kernels'
         # tiled f32 accumulation order differs from plain XLA, so loosen
         # the tolerance when they computed method 1.
         rtol, atol = (1e-4, 1e-5) if args.pallas else (1e-5, 1e-7)
-        checks = [("ddp", "fsdp", results[2], results[3]),
-                  ("1dev", "tp", results[1], results[4])]
-        for la, lb, a, b in checks:
-            for side, pa, pb in (("[0]", a.w1, b.w1), ("[1]", a.w2, b.w2)):
-                if not np.allclose(np.asarray(pa), np.asarray(pb),
-                                   rtol=rtol, atol=atol):
-                    print(f"SoftAssertionError: {la}{side} vs {lb}{side} "
-                          f"max|diff|="
-                          f"{np.abs(np.asarray(pa) - np.asarray(pb)).max()}")
+        checks = [("ddp", "fsdp", results[2], results[3], rtol, atol),
+                  ("1dev", "tp", results[1], results[4], rtol, atol)]
+        if args.method == 9:
+            # every extension strategy against its oracle (the reference's
+            # --method 0 idea extended to the full surface)
+            from .parallel import (train_ddp, train_moe_dense,
+                                   train_transformer_single)
+            # hybrid(dp x tp) == DDP over a dp-sized mesh: TP is an exact
+            # decomposition, so only the data axis affects the math
+            dp = args.dp or max(1, n_dev // args.tp)
+            ddp_dp = train_ddp(params_for(2), seeds, tokens,
+                               args.model_size,
+                               make_mesh({DATA_AXIS: dp}), lr=lr,
+                               unroll=unroll)
+            checks.append(("hybrid", f"ddp({dp})", results[5], ddp_dp,
+                           rtol, atol))
+            # PP replicates the data; microbatch grads sum to the
+            # full-batch grad => equals the single-device run
+            checks.append(("pp", "1dev", results[6], results[1],
+                           rtol, atol))
+            # EP == the dense grouped-dispatch oracle, no mesh involved
+            moe_dense = train_moe_dense(params_for(7), seeds, tokens,
+                                        args.model_size, lr=lr,
+                                        n_groups=n_dev)
+            checks.append(("moe_ep", "moe_dense", results[7], moe_dense,
+                           1e-4, 1e-5))
+            # transformer TP replicates the data => equals transformer
+            # single-device
+            t_single = train_transformer_single(
+                params_for(8), seeds, tokens, args.model_size, lr=lr,
+                seq_len=args.seq_len, n_heads=args.heads)
+            checks.append(("ttp", "t1dev", results[8], t_single,
+                           1e-4, 1e-5))
+        for la, lb, a, b, rt, at in checks:
+            for field in type(a)._fields:
+                pa = np.asarray(getattr(a, field))
+                pb = np.asarray(getattr(b, field))
+                if not np.allclose(pa, pb, rtol=rt, atol=at):
+                    print(f"SoftAssertionError: {la}.{field} vs "
+                          f"{lb}.{field} max|diff|={np.abs(pa - pb).max()}")
                     failed = True
     return 1 if (failed and args.strict) else 0
 

@@ -119,17 +119,21 @@ def router_aux_loss(wg: jax.Array, x: jax.Array) -> jax.Array:
 
 
 def moe_layer(wg: jax.Array, w1: jax.Array, w2: jax.Array, x: jax.Array,
-              capacity_factor: float = 2.0, k: int = 1) -> jax.Array:
+              capacity_factor: float = 2.0, k: int = 1,
+              capacity: int | None = None) -> jax.Array:
     """One MoE FFN layer, dense single-device form (no residual here —
     the stack adds it).
 
     ``wg [E, d]``, ``w1 [E, ffn, d]``, ``w2 [E, d, ffn]``, ``x [T, d]``.
     Dispatch -> per-expert hand-VJP FFN (``ffn_block`` vmapped over the
     expert axis) -> gate-scaled combine. Dropped (token, choice) pairs
-    contribute zero.
+    contribute zero. ``capacity`` overrides the per-expert slot count
+    (the EP-emulating dense oracle passes the grouped EP capacity, which
+    ceil-rounds differently from deriving it from this ``x``'s tokens).
     """
     n_experts = w1.shape[0]
-    cap = expert_capacity(x.shape[0], n_experts, capacity_factor)
+    cap = (expert_capacity(x.shape[0], n_experts, capacity_factor)
+           if capacity is None else capacity)
     if k == 1:
         idx, gate = route_top1(wg, x)
         disp = dispatch_tensor(idx, n_experts, cap, x.dtype)  # [T, E, C]
@@ -145,7 +149,7 @@ def moe_layer(wg: jax.Array, w1: jax.Array, w2: jax.Array, x: jax.Array,
 
 
 def moe_stack_fwd_aux(params, x: jax.Array, capacity_factor: float = 2.0,
-                      k: int = 1):
+                      k: int = 1, capacity: int | None = None):
     """Stack of MoE layers (``MoEStackParams``) with a residual around each
     layer (Switch semantics: a capacity-dropped token passes through
     unchanged rather than zeroing for the rest of the stack). Returns
@@ -157,17 +161,17 @@ def moe_stack_fwd_aux(params, x: jax.Array, capacity_factor: float = 2.0,
     for l in range(params.w1.shape[0]):
         aux = aux + router_aux_loss(params.wg[l], x)
         x = x + moe_layer(params.wg[l], params.w1[l], params.w2[l], x,
-                          capacity_factor, k)
+                          capacity_factor, k, capacity)
     return x, aux
 
 
 def moe_stack_fwd(params, x: jax.Array, capacity_factor: float = 2.0,
-                  k: int = 1) -> jax.Array:
+                  k: int = 1, capacity: int | None = None) -> jax.Array:
     """Output half of ``moe_stack_fwd_aux``."""
-    return moe_stack_fwd_aux(params, x, capacity_factor, k)[0]
+    return moe_stack_fwd_aux(params, x, capacity_factor, k, capacity)[0]
 
 
 def moe_stack_aux(params, x: jax.Array, capacity_factor: float = 2.0,
-                  k: int = 1) -> jax.Array:
+                  k: int = 1, capacity: int | None = None) -> jax.Array:
     """Aux half of ``moe_stack_fwd_aux``."""
-    return moe_stack_fwd_aux(params, x, capacity_factor, k)[1]
+    return moe_stack_fwd_aux(params, x, capacity_factor, k, capacity)[1]

@@ -21,7 +21,7 @@ from .hybrid import train_hybrid
 from .pipeline import train_pp
 from .sequence import (ring_attention, sequence_parallel_attention,
                        ulysses_attention, ulysses_parallel_attention)
-from .expert import train_moe_ep, moe_layer_ep
+from .expert import train_moe_ep, train_moe_dense, moe_layer_ep
 from .transformer import (train_transformer_single, train_transformer_ddp,
                           train_transformer_fsdp, train_transformer_tp,
                           train_transformer_hybrid)
@@ -45,7 +45,7 @@ __all__ = [
     "DATA_AXIS", "MODEL_AXIS", "SEQ_AXIS", "PIPE_AXIS", "EXPERT_AXIS",
     "collectives",
     "train_single", "train_ddp", "train_fsdp", "train_tp", "train_hybrid",
-    "train_pp", "train_moe_ep", "moe_layer_ep",
+    "train_pp", "train_moe_ep", "train_moe_dense", "moe_layer_ep",
     "train_transformer_single", "train_transformer_ddp",
     "train_transformer_fsdp", "train_transformer_tp",
     "train_transformer_hybrid",

@@ -51,8 +51,8 @@ from ..models.moe import MoEStackParams
 from ..models.ffn_stack import clone_params
 from ..ops.ffn import ffn_block
 from ..ops.moe import (dispatch_tensor, dispatch_tensor_topk,
-                       expert_capacity, route_top1, route_topk,
-                       router_aux_loss)
+                       expert_capacity, moe_stack_fwd_aux, route_top1,
+                       route_topk, router_aux_loss)
 from ..optim import sgd
 from .collectives import all_to_all, grad_reduce
 from .launcher import launch
@@ -162,3 +162,48 @@ def train_moe_ep(params: MoEStackParams, seeds, batch_size: int,
     return launch(step, clone_params(params), seed_cols, mesh,
                   param_specs=specs, seed_spec=P(None, EXPERT_AXIS),
                   select_local=lambda s: s[:, 0])
+
+
+def train_moe_dense(params: MoEStackParams, seeds, batch_size: int,
+                    model_size: int, lr: float = LR,
+                    capacity_factor: float = 2.0, k: int = 1,
+                    aux_coef: float = 0.0,
+                    n_groups: int = 1) -> MoEStackParams:
+    """Single-device dense MoE trainer with EP's exact semantics — no mesh,
+    no collectives; the user-facing oracle for ``train_moe_ep``.
+
+    ``n_groups=1`` is plain dense MoE training (capacity from the global
+    token count). ``n_groups=n`` emulates the ``n``-shard EP run *exactly*:
+    the strided seed split (``train_ffns.py:182``), GShard's grouped
+    dispatch (each group routes its ``batch_size/n`` tokens independently
+    against its ``ceil(C_global/n)`` capacity share), per-group aux terms,
+    and router grads summed across groups (SUM, unscaled LR,
+    ``train_ffns.py:165`` semantics) — so
+    ``train_moe_ep(p, seeds, B, d, mesh_n) ==
+    train_moe_dense(p, seeds, B, d, n_groups=n)`` is the --method 7
+    differential check, runnable without a device mesh.
+    """
+    if batch_size % n_groups:
+        raise ValueError(f"batch_size={batch_size} not divisible by "
+                         f"n_groups={n_groups}")
+    t_local = batch_size // n_groups
+    cap = _local_capacity(t_local, n_groups, params.n_experts,
+                          capacity_factor)
+    rows = shard_seeds_strided(seeds, n_groups)  # [global_steps, n_groups]
+
+    def fwd_aux(p, xs):  # xs [n_groups, t_local, d]
+        y, aux = jax.vmap(
+            lambda x: moe_stack_fwd_aux(p, x, capacity_factor, k, cap))(xs)
+        return y, jnp.sum(aux)
+
+    def step(p, row):
+        xs, dls = jax.vmap(
+            lambda s: batch_from_seed(s, t_local, model_size,
+                                      p.w1.dtype))(row)
+        _, vjp = jax.vjp(lambda p: fwd_aux(p, xs), p)
+        grads = vjp((dls, jnp.asarray(aux_coef, jnp.float32)))[0]
+        return sgd(p, grads, lr), None
+
+    run = jax.jit(lambda p, rows: lax.scan(step, p, rows)[0],
+                  donate_argnums=0)
+    return run(clone_params(params), rows)

@@ -33,6 +33,22 @@ def test_cli_all_methods_verify():
 
 
 @pytest.mark.slow
+def test_cli_method9_verifies_every_strategy():
+    """--method 9: all eight strategies run and every extension is pinned
+    to its oracle (hybrid==DDP(dp), PP==single, EP==dense grouped oracle,
+    transformer TP==transformer single) — hard-failing under --strict."""
+    r = _run_cli("-s", "8", "-bs", "8", "-n", "16", "-l", "8", "-d", "16",
+                 "-m", "9", "-r", "3", "--lr", "0.1", "--fake_devices",
+                 "8", "--strict", "--heads", "4")
+    assert r.returncode == 0, r.stdout + r.stderr
+    for name in ("train_single", "train_ddp", "train_fsdp", "train_tp",
+                 "train_hybrid", "train_pp", "train_moe_ep",
+                 "train_transformer_tp"):
+        assert f"{name} takes" in r.stdout
+    assert "SoftAssertionError" not in r.stdout
+
+
+@pytest.mark.slow
 def test_cli_hybrid_method():
     r = _run_cli("-s", "4", "-bs", "2", "-n", "16", "-l", "2", "-d", "64",
                  "-m", "5", "-r", "3", "--fake_devices", "8", "--tp", "2")

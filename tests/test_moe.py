@@ -28,6 +28,7 @@ from distributed_llm_code_samples_tpu.ops.moe import (dispatch_tensor,
 from distributed_llm_code_samples_tpu.optim import sgd
 from distributed_llm_code_samples_tpu.parallel import (EXPERT_AXIS,
                                                        make_mesh,
+                                                       train_moe_dense,
                                                        train_moe_ep)
 
 D, L, E, T = 16, 2, 8, 64  # d_model, layers, experts, tokens per shard
@@ -287,3 +288,34 @@ def test_ep_validates_divisibility(params, mesh_ep4):
                      seeds, 4 * T, D, mesh_ep4)
     with pytest.raises(ValueError, match="divisible"):
         train_moe_ep(params, seeds, 4 * T + 2, D, mesh_ep4)
+
+
+@pytest.mark.parametrize("k,aux_coef", [(1, 0.0), (2, 0.01)])
+def test_train_moe_dense_is_user_facing_ep_oracle(params, mesh_ep4, k,
+                                                  aux_coef):
+    """The package's own dense trainer (``train_moe_dense(n_groups=n)``)
+    reproduces the EP run — the oracle behind the CLI's --method 9 check,
+    independent of this file's hand-rolled ``_oracle_step``."""
+    n = 4
+    seeds = make_seed_schedule(2 * n, random_seed=13)
+    ep = train_moe_ep(params, seeds, n * T, D, mesh_ep4, lr=0.1, k=k,
+                      aux_coef=aux_coef)
+    dense = train_moe_dense(params, seeds, n * T, D, lr=0.1, k=k,
+                            aux_coef=aux_coef, n_groups=n)
+    for f in MoEStackParams._fields:
+        np.testing.assert_allclose(np.asarray(getattr(ep, f)),
+                                   np.asarray(getattr(dense, f)),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_train_moe_dense_global_capacity_differs_from_grouped(params):
+    """n_groups=1 (global capacity, one routing group) is a *different*
+    semantics from the grouped EP emulation — the distinction
+    ``parallel/expert.py`` documents. Under overflow pressure they must
+    diverge; losing that divergence means the grouping is dead code."""
+    seeds = make_seed_schedule(4, random_seed=3)
+    kwargs = dict(lr=0.1, capacity_factor=0.25)  # force drops
+    dense1 = train_moe_dense(params, seeds, 4 * T, D, n_groups=1, **kwargs)
+    dense4 = train_moe_dense(params, seeds, 4 * T, D, n_groups=4, **kwargs)
+    assert not np.allclose(np.asarray(dense1.w1), np.asarray(dense4.w1),
+                           rtol=1e-5, atol=1e-7)
