@@ -24,6 +24,18 @@ restores to sharded buffers without ever materializing a replicated copy per
 device. An optional orbax backend (``backend="orbax"``) delegates the array
 I/O to ``orbax.checkpoint`` for multi-host/async use, same directory layout
 one level down.
+
+Multi-host (``jax.process_count() > 1``): filesystem mutations (staging,
+npz write, atomic renames, restart cleanup) happen on process 0 only,
+bracketed by ``sync_global_devices`` barriers so no process observes a
+half-published step; the orbax save is collective (every process writes
+its addressable shards), with host-local leaves lifted to
+globally-replicated arrays first. The npz backend handles replicated
+params (DDP) across processes; process-spanning *sharded* params (FSDP)
+require ``backend="orbax"`` and say so in the error. Proven end-to-end by
+``tests/test_multiprocess.py::test_two_process_checkpoint_resume``:
+2-process kill-at-step-4 + resume equals the uninterrupted run, both
+backends.
 """
 
 from __future__ import annotations
@@ -38,6 +50,37 @@ import jax
 import numpy as np
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+def _primary() -> bool:
+    """Exactly one process owns filesystem mutations (dir staging, npz
+    write, atomic renames) — the multi-host analogue of the reference
+    writing results from rank 0 only (``train_ffns.py:193``)."""
+    return jax.process_index() == 0
+
+
+def _sync(tag: str) -> None:
+    """Cross-process barrier (no-op single-process): keeps every process's
+    view of the checkpoint directory consistent around primary-only
+    mutations and collective orbax writes. ``ckpt_dir`` must be a shared
+    filesystem — every process reads the steps the primary publishes."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(f"ckpt:{tag}")
+
+
+def _agreed_latest_step(ckpt_dir: str) -> int | None:
+    """``latest_step`` as decided by the primary and broadcast, so every
+    process takes the same resume-vs-restart branch. A divergent local
+    view (per-host disk, NFS attribute-cache lag) would otherwise send
+    processes to mismatched ``_sync`` barriers — a hang, not an error."""
+    step = latest_step(ckpt_dir)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        step = int(multihost_utils.broadcast_one_to_all(
+            np.int32(-1 if step is None else step)))
+        step = None if step < 0 else step
+    return step
 
 
 def _np_dtype(name: str) -> np.dtype:
@@ -61,6 +104,29 @@ def _to_numpy(leaf) -> np.ndarray:
     return arr
 
 
+def _ensure_global_fn():
+    """Multi-host orbax can only serialize *global* arrays. Returns a
+    per-leaf converter (one shared all-devices mesh per save, not one per
+    leaf): leaves that are still host-local (fresh params before the first
+    training segment, or a replicated result pulled to one device) are
+    identical on every process by the framework's determinism, so lift
+    them to a globally-replicated array over all devices; process-spanning
+    arrays pass through."""
+    if jax.process_count() == 1:
+        return lambda leaf: leaf
+    mesh = jax.sharding.Mesh(np.asarray(jax.devices()), ("_ckpt",))
+    sharding = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+
+    def ensure(leaf):
+        if hasattr(leaf, "sharding") and not leaf.is_fully_addressable:
+            return leaf  # already global
+        arr = np.asarray(leaf)
+        return jax.make_array_from_callback(arr.shape, sharding,
+                                            lambda idx: arr[idx])
+
+    return ensure
+
+
 def _flatten(tree):
     flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
     names = [jax.tree_util.keystr(p) for p, _ in flat]
@@ -77,19 +143,36 @@ def save_checkpoint(ckpt_dir: str, params: Any, step: int, seeds=None,
     orbax backend). ``seeds`` is the full seed schedule, saved so a resumed
     run replays the identical data stream.
     """
-    os.makedirs(ckpt_dir, exist_ok=True)
+    names, leaves, _ = _flatten(params)
+    if jax.process_count() > 1 and backend != "orbax":
+        # npz gathers through np.asarray, which only works when every
+        # process holds the full value; process-spanning shards need the
+        # collective orbax path
+        for n, leaf in zip(names, leaves):
+            if (hasattr(leaf, "is_fully_replicated")
+                    and not leaf.is_fully_replicated
+                    and not getattr(leaf, "is_fully_addressable", True)):
+                raise ValueError(
+                    f"leaf {n} spans processes and is not replicated; "
+                    "the npz backend cannot gather it — use "
+                    "backend='orbax' for multi-host sharded checkpoints")
+
     final = os.path.join(ckpt_dir, f"step_{step}")
     tmp = final + ".tmp"
-    if os.path.exists(tmp):
-        shutil.rmtree(tmp)
-    os.makedirs(tmp)
+    if _primary():
+        os.makedirs(ckpt_dir, exist_ok=True)
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+    _sync(f"staged-{step}")  # tmp dir visible to all before collective I/O
 
-    names, leaves, _ = _flatten(params)
     if backend == "orbax":
         import orbax.checkpoint as ocp
         ckptr = ocp.PyTreeCheckpointer()
-        ckptr.save(os.path.join(os.path.abspath(tmp), "arrays"), params)
-    else:
+        # collective: every process writes its addressable shards
+        ckptr.save(os.path.join(os.path.abspath(tmp), "arrays"),
+                   jax.tree_util.tree_map(_ensure_global_fn(), params))
+    elif _primary():
         np.savez(os.path.join(tmp, "arrays.npz"),
                  **{n: _to_numpy(l) for n, l in zip(names, leaves)})
     # metadata from array attributes only — no host fetch (multi-host arrays
@@ -102,20 +185,22 @@ def save_checkpoint(ckpt_dir: str, params: Any, step: int, seeds=None,
         doc["seeds"] = np.asarray(seeds).tolist()
     if meta:
         doc["meta"] = meta
-    with open(os.path.join(tmp, "meta.json"), "w") as f:
-        json.dump(doc, f)
-    old = None
-    if os.path.exists(final):
-        # keep the previous version valid until the new one is published:
-        # move it aside (its .tmp suffix hides it from latest_step), swap
-        # in the new dir, then drop it
-        old = final + ".old.tmp"
-        if os.path.exists(old):
+    if _primary():
+        with open(os.path.join(tmp, "meta.json"), "w") as f:
+            json.dump(doc, f)
+        old = None
+        if os.path.exists(final):
+            # keep the previous version valid until the new one is
+            # published: move it aside (its .tmp suffix hides it from
+            # latest_step), swap in the new dir, then drop it
+            old = final + ".old.tmp"
+            if os.path.exists(old):
+                shutil.rmtree(old)
+            os.rename(final, old)
+        os.rename(tmp, final)  # atomic publish
+        if old is not None:
             shutil.rmtree(old)
-        os.rename(final, old)
-    os.rename(tmp, final)  # atomic publish
-    if old is not None:
-        shutil.rmtree(old)
+    _sync(f"published-{step}")  # no process proceeds past an unpublished step
     return final
 
 
@@ -236,8 +321,9 @@ def run_with_checkpointing(train_fn, params, seeds, *args,
                 f"{len(seeds)} seeds do not divide across "
                 f"{seeds_divisor} data shards")
     start = 0
-    if resume and latest_step(ckpt_dir) is not None:
-        params, start, saved = restore_checkpoint(ckpt_dir, params)
+    if resume and (agreed := _agreed_latest_step(ckpt_dir)) is not None:
+        params, start, saved = restore_checkpoint(ckpt_dir, params,
+                                                  step=agreed)
         if saved is not None and len(saved):
             if len(seeds) > len(saved):
                 # a longer re-run extends the saved run: completed steps keep
@@ -246,10 +332,13 @@ def run_with_checkpointing(train_fn, params, seeds, *args,
             else:
                 seeds = saved  # saved schedule is authoritative on resume
     else:
-        if os.path.isdir(ckpt_dir):  # restart: drop stale step_* dirs so a
-            for name in os.listdir(ckpt_dir):  # later resume can't pick up
-                if _STEP_RE.match(name):       # a higher step from this run
+        if _primary() and os.path.isdir(ckpt_dir):
+            # restart: drop stale step_* dirs so a later resume can't pick
+            # up a higher step from a previous run
+            for name in os.listdir(ckpt_dir):
+                if _STEP_RE.match(name):
                     shutil.rmtree(os.path.join(ckpt_dir, name))
+        _sync("restart-cleared")
         # publish step_0 so the schedule survives a crash in segment 1
         save_checkpoint(ckpt_dir, params, 0, seeds, backend=backend)
     total = len(seeds)

@@ -163,9 +163,12 @@ def main(argv=None) -> int:
             # model axis sized by --tp (like method 5): all-devices would
             # demand n_heads divisible by every possible device count
             return make_mesh({MODEL_AXIS: min(args.tp, n_dev)})
-        tp = args.tp
-        dp = args.dp or max(1, n_dev // tp)
-        return make_mesh({DATA_AXIS: dp, MODEL_AXIS: tp})
+        return make_mesh({DATA_AXIS: hybrid_dp(), MODEL_AXIS: args.tp})
+
+    def hybrid_dp() -> int:
+        # one derivation for both the method-5 mesh and its method-9
+        # verification oracle — they must never drift apart
+        return args.dp or max(1, n_dev // args.tp)
 
     if args.method == 0:
         selected = [1, 2, 3, 4]
@@ -236,7 +239,7 @@ def main(argv=None) -> int:
                                    train_transformer_single)
             # hybrid(dp x tp) == DDP over a dp-sized mesh: TP is an exact
             # decomposition, so only the data axis affects the math
-            dp = args.dp or max(1, n_dev // args.tp)
+            dp = hybrid_dp()
             ddp_dp = train_ddp(params_for(2), seeds, tokens,
                                args.model_size,
                                make_mesh({DATA_AXIS: dp}), lr=lr,

@@ -1,4 +1,4 @@
-"""Worker for the real multi-process runtime test (tests/test_multiprocess.py).
+"""Worker for the real multi-process runtime tests (tests/test_multiprocess.py).
 
 Two of these processes rendezvous through ``runtime.init.initialize`` (the
 ``init_process`` analogue, ``train_ffns.py:121-127``), form one global
@@ -6,9 +6,22 @@ Two of these processes rendezvous through ``runtime.init.initialize`` (the
 across the process boundary. Process 0 saves the final params for the
 parent test to compare against a single-process run of the same schedule.
 
-Usage: ``python mp_worker.py <port> <process_id> <out_npz>``
-(XLA_FLAGS with ``--xla_force_host_platform_device_count=2`` must be set
-by the parent.)
+Modes (argv[4], default ``ddp``):
+
+- ``ddp``: train the full 8-step schedule, dump final params.
+- ``ckpt_first``: run only the first half of the schedule through
+  ``run_with_checkpointing`` (a checkpoint is published at step 4), then
+  exit — simulating a killed run.
+- ``ckpt_resume``: run the *full* schedule through
+  ``run_with_checkpointing`` with resume on: restores the step-4
+  checkpoint the first pair published and completes the run.
+
+``argv[5]`` = checkpoint dir, ``argv[6]`` = backend (npz|orbax) for the
+ckpt modes.
+
+Usage: ``python mp_worker.py <port> <process_id> <out_npz> [mode] [dir]
+[backend]`` (XLA_FLAGS with ``--xla_force_host_platform_device_count=2``
+must be set by the parent.)
 """
 
 import sys
@@ -17,10 +30,13 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+N_STEPS, D, TOKENS = 8, 16, 16
+
 
 def main():
     port, process_id, out_path = (sys.argv[1], int(sys.argv[2]),
                                   sys.argv[3])
+    mode = sys.argv[4] if len(sys.argv) > 4 else "ddp"
     from distributed_llm_code_samples_tpu.runtime.init import (initialize,
                                                                runtime_info)
     initialize(f"127.0.0.1:{port}", num_processes=2, process_id=process_id)
@@ -37,17 +53,28 @@ def main():
                                                            train_ddp,
                                                            DATA_AXIS)
 
-    params = init_ffn_stack(jax.random.PRNGKey(0), 16, 2)
-    seeds = make_seed_schedule(8, random_seed=5)
+    params = init_ffn_stack(jax.random.PRNGKey(0), D, 2)
+    seeds = make_seed_schedule(N_STEPS, random_seed=5)
     mesh = make_mesh({DATA_AXIS: 4})  # spans both processes
-    out = train_ddp(params, seeds, 16, 16, mesh, lr=0.1)
+
+    if mode == "ddp":
+        out = train_ddp(params, seeds, TOKENS, D, mesh, lr=0.1)
+    else:
+        from distributed_llm_code_samples_tpu.checkpoint import (
+            run_with_checkpointing)
+        ckpt_dir, backend = sys.argv[5], sys.argv[6]
+        use = seeds[:N_STEPS // 2] if mode == "ckpt_first" else seeds
+        out = run_with_checkpointing(
+            train_ddp, params, use, TOKENS, D, ckpt_dir=ckpt_dir,
+            every=N_STEPS // 2, backend=backend, seeds_divisor=4,
+            mesh=mesh, lr=0.1)
     jax.block_until_ready(out)
 
     if process_id == 0:
         np.savez(out_path, w1=np.asarray(out.w1), w2=np.asarray(out.w2))
     # all processes exit the distributed service cleanly
     jax.distributed.shutdown()
-    print(f"mp_worker {process_id}: ok")
+    print(f"mp_worker {process_id} [{mode}]: ok")
 
 
 if __name__ == "__main__":

@@ -1,4 +1,4 @@
-"""Real multi-process runtime test (VERDICT r1 item 6).
+"""Real multi-process runtime tests (VERDICT r1 item 6; r1 weak item 10).
 
 The reference actually spawns N OS processes that rendezvous over TCP and
 train together (``train_ffns.py:121-127, :184-191``). This framework's
@@ -7,6 +7,13 @@ path end-to-end: two subprocesses, each owning 2 fake CPU devices, join
 through ``runtime.init.initialize`` and run DDP over one global 4-device
 mesh. The result must equal the same schedule run in a single process —
 the process boundary is invisible to the math.
+
+The checkpoint test adds the multi-host story: pair 1 trains half the
+schedule through ``run_with_checkpointing`` (publishing a mid-run
+checkpoint with process-coordinated I/O) and exits; pair 2 resumes from
+that checkpoint and completes. Final params must equal the uninterrupted
+single-process run — kill-and-resume across the process boundary loses
+nothing.
 """
 
 import os
@@ -27,10 +34,8 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.slow
-def test_two_process_ddp_equals_single_process(tmp_path):
+def _run_pair(out_npz, *extra):
     port = _free_port()
-    out_npz = str(tmp_path / "mp_out.npz")
     env = dict(os.environ)
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
@@ -38,7 +43,7 @@ def test_two_process_ddp_equals_single_process(tmp_path):
     procs = [
         subprocess.Popen(
             [sys.executable, os.path.join(_REPO, "tests", "mp_worker.py"),
-             str(port), str(i), out_npz],
+             str(port), str(i), out_npz, *extra],
             cwd=_REPO, env=env, stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT, text=True)
         for i in range(2)
@@ -55,8 +60,10 @@ def test_two_process_ddp_equals_single_process(tmp_path):
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i} failed:\n{out[-3000:]}"
 
-    # single-process oracle: the SAME schedule on this process's own
-    # 4-device mesh (conftest gives 8 fake devices)
+
+def _single_process_oracle():
+    """The SAME schedule on this process's own 4-device mesh (conftest
+    gives 8 fake devices)."""
     from distributed_llm_code_samples_tpu.data import make_seed_schedule
     from distributed_llm_code_samples_tpu.models import init_ffn_stack
     from distributed_llm_code_samples_tpu.parallel import (make_mesh,
@@ -64,11 +71,37 @@ def test_two_process_ddp_equals_single_process(tmp_path):
                                                            DATA_AXIS)
     params = init_ffn_stack(jax.random.PRNGKey(0), 16, 2)
     seeds = make_seed_schedule(8, random_seed=5)
-    ref = train_ddp(params, seeds, 16, 16, make_mesh({DATA_AXIS: 4}),
-                    lr=0.1)
+    return train_ddp(params, seeds, 16, 16, make_mesh({DATA_AXIS: 4}),
+                     lr=0.1)
 
+
+def _assert_matches_oracle(out_npz):
+    ref = _single_process_oracle()
     got = np.load(out_npz)
     np.testing.assert_allclose(got["w1"], np.asarray(ref.w1),
                                rtol=1e-6, atol=1e-7)
     np.testing.assert_allclose(got["w2"], np.asarray(ref.w2),
                                rtol=1e-6, atol=1e-7)
+
+
+@pytest.mark.slow
+def test_two_process_ddp_equals_single_process(tmp_path):
+    out_npz = str(tmp_path / "mp_out.npz")
+    _run_pair(out_npz)
+    _assert_matches_oracle(out_npz)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("backend", ["npz", "orbax"])
+def test_two_process_checkpoint_resume(tmp_path, backend):
+    """Kill-and-resume across the process boundary: pair 1 checkpoints at
+    step 4 and exits; pair 2 restores and finishes; result equals the
+    uninterrupted single-process run."""
+    ckpt_dir = str(tmp_path / f"ckpt_{backend}")
+    out_npz = str(tmp_path / f"mp_ckpt_{backend}.npz")
+    _run_pair(str(tmp_path / "ignored.npz"), "ckpt_first", ckpt_dir,
+              backend)
+    assert os.path.isdir(os.path.join(ckpt_dir, "step_4")), (
+        "pair 1 did not publish the mid-run checkpoint")
+    _run_pair(out_npz, "ckpt_resume", ckpt_dir, backend)
+    _assert_matches_oracle(out_npz)
