@@ -56,6 +56,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="attention heads for --method 8 (transformer)")
     p.add_argument("--lr", type=float, default=None,
                    help="override LR (default 1e-5, train_ffns.py:29)")
+    p.add_argument("--optimizer", choices=["sgd", "momentum", "adam"],
+                   default="sgd",
+                   help="update rule for --method 2 (DDP): sgd is the "
+                        "reference's stateless inline update; momentum/"
+                        "adam carry hand-written optimizer state")
+    p.add_argument("--zero1", action="store_true",
+                   help="with --method 2: shard the optimizer state "
+                        "across the data axis (ZeRO-1; reduce_scatter + "
+                        "all_gather instead of all_reduce)")
     p.add_argument("--dtype", choices=["float32", "bfloat16"],
                    default="float32")
     p.add_argument("--scan", action="store_true",
@@ -103,6 +112,21 @@ def main(argv=None) -> int:
                          params_size_gb)
     from .parallel import (make_mesh, guard_multi_device, STRATEGIES,
                            DATA_AXIS, MODEL_AXIS, PIPE_AXIS, EXPERT_AXIS)
+
+    if (args.optimizer != "sgd" or args.zero1) and args.method != 2:
+        # methods 0/9 cross-check DDP against strategies that would still
+        # run inline SGD — a guaranteed spurious differential failure
+        print("error: --optimizer/--zero1 apply to --method 2 only",
+              file=sys.stderr)
+        return 2
+    if (args.optimizer != "sgd" and args.checkpoint_dir
+            and args.checkpoint_every):
+        # segment boundaries re-init optimizer state (only params are
+        # checkpointed), silently changing the math vs an uninterrupted run
+        print("error: --checkpoint_every does not checkpoint momentum/adam "
+              "state; use the default final-only checkpoint (0) with a "
+              "stateful optimizer", file=sys.stderr)
+        return 2
 
     lr = LR if args.lr is None else args.lr
     dtype = jnp.float32 if args.dtype == "float32" else jnp.bfloat16
@@ -182,6 +206,12 @@ def main(argv=None) -> int:
         params = params_for(m)
         mesh = mesh_for(m)
         kwargs = dict(lr=lr, unroll=unroll)
+        if m == 2 and (args.optimizer != "sgd" or args.zero1):
+            from .optim import OPTIMIZERS
+            kwargs["optimizer"] = OPTIMIZERS[args.optimizer]()
+            if args.zero1:
+                from .parallel import train_ddp_zero1
+                name, fn = "train_ddp_zero1", train_ddp_zero1
         if m == 6:
             kwargs = dict(lr=lr, schedule=args.pp_schedule)
             if args.microbatches:

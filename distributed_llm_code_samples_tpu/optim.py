@@ -1,5 +1,6 @@
-"""Inline SGD — the reference's entire optimizer surface.
+"""Hand-written optimizers — from inline SGD up to Adam, all functional.
 
+The reference's entire optimizer surface is inline SGD,
 ``param = param - LR * grad`` with unscaled summed gradients
 (``train_ffns.py:29, :114, :171-172, :258-259, :311-312``). No optimizer
 state, no classes. Gradients across data-parallel ranks are reduced with
@@ -7,11 +8,22 @@ state, no classes. Gradients across data-parallel ranks are reduced with
 multi-rank results intentionally differ from the single-device run; only
 strategy-vs-strategy equivalence is asserted, mirroring the reference's
 verification design (``train_ffns.py:386-391``).
+
+Beyond the reference, this module adds *stateful* optimizers in the same
+first-principles style: an ``Optimizer`` is a ``(init, update)`` pair of
+pure functions over arbitrary param pytrees, with the update math written
+out by hand (verified against the optax implementations in
+``tests/test_optim.py`` — optax is the test oracle, never the training
+path). Stateful optimizers are what make ZeRO-1 meaningful: the state is
+the thing worth sharding (``parallel/zero1.py``).
 """
 
 from __future__ import annotations
 
+from typing import Any, Callable, NamedTuple
+
 import jax
+import jax.numpy as jnp
 
 from . import LR
 
@@ -20,3 +32,79 @@ def sgd(params, grads, lr: float = LR):
     """Functional SGD over an arbitrary param pytree."""
     return jax.tree_util.tree_map(lambda p, g: p - lr * g.astype(p.dtype),
                                   params, grads)
+
+
+class Optimizer(NamedTuple):
+    """A functional optimizer: ``init(params) -> state`` and
+    ``update(grads, state, params, lr) -> (new_params, new_state)``."""
+    init: Callable[[Any], Any]
+    update: Callable[[Any, Any, Any, float], tuple]
+    name: str = "optimizer"
+
+
+def sgd_optimizer() -> Optimizer:
+    """The reference's stateless SGD as an ``Optimizer`` (empty state), so
+    every strategy that takes an optimizer degrades to exact reference
+    semantics."""
+    def update(grads, state, params, lr):
+        return sgd(params, grads, lr), state
+    return Optimizer(init=lambda params: (), update=update, name="sgd")
+
+
+def momentum(beta: float = 0.9) -> Optimizer:
+    """Heavy-ball momentum: ``v = beta*v + g``, ``p = p - lr*v`` (the
+    classic accumulator form, optax's default convention)."""
+    def init(params):
+        return jax.tree_util.tree_map(jnp.zeros_like, params)
+
+    def update(grads, vel, params, lr):
+        vel = jax.tree_util.tree_map(
+            lambda v, g: beta * v + g.astype(v.dtype), vel, grads)
+        params = jax.tree_util.tree_map(lambda p, v: p - lr * v,
+                                        params, vel)
+        return params, vel
+
+    return Optimizer(init=init, update=update, name=f"momentum({beta})")
+
+
+class AdamState(NamedTuple):
+    mu: Any          # first-moment pytree, like params
+    nu: Any          # second-moment pytree, like params
+    count: jax.Array  # step counter for bias correction
+
+
+def adam(b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8) -> Optimizer:
+    """Adam (Kingma & Ba) with bias correction, written out by hand:
+    ``mu = b1*mu + (1-b1)*g``; ``nu = b2*nu + (1-b2)*g^2``;
+    ``p -= lr * (mu/(1-b1^t)) / (sqrt(nu/(1-b2^t)) + eps)``."""
+    def init(params):
+        zeros = lambda p: jnp.zeros_like(p)  # noqa: E731
+        return AdamState(mu=jax.tree_util.tree_map(zeros, params),
+                         nu=jax.tree_util.tree_map(zeros, params),
+                         count=jnp.zeros((), jnp.int32))
+
+    def update(grads, state, params, lr):
+        count = state.count + 1
+        t = count.astype(jnp.float32)
+        c1 = 1.0 - b1 ** t
+        c2 = 1.0 - b2 ** t
+        mu = jax.tree_util.tree_map(
+            lambda m, g: b1 * m + (1.0 - b1) * g.astype(m.dtype),
+            state.mu, grads)
+        nu = jax.tree_util.tree_map(
+            lambda n, g: b2 * n + (1.0 - b2) * jnp.square(g.astype(n.dtype)),
+            state.nu, grads)
+        params = jax.tree_util.tree_map(
+            lambda p, m, n: p - lr * (m / c1) / (jnp.sqrt(n / c2) + eps),
+            params, mu, nu)
+        return params, AdamState(mu=mu, nu=nu, count=count)
+
+    return Optimizer(init=init, update=update,
+                     name=f"adam({b1},{b2},{eps})")
+
+
+OPTIMIZERS = {
+    "sgd": sgd_optimizer,
+    "momentum": momentum,
+    "adam": adam,
+}

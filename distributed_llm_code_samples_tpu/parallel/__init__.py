@@ -15,6 +15,7 @@ from .mesh import (make_mesh, guard_multi_device, DATA_AXIS, MODEL_AXIS,
 from . import collectives
 from .single import train_single
 from .ddp import train_ddp
+from .zero1 import train_ddp_zero1
 from .fsdp import train_fsdp
 from .tp import train_tp
 from .hybrid import train_hybrid
@@ -44,7 +45,8 @@ __all__ = [
     "make_mesh", "guard_multi_device",
     "DATA_AXIS", "MODEL_AXIS", "SEQ_AXIS", "PIPE_AXIS", "EXPERT_AXIS",
     "collectives",
-    "train_single", "train_ddp", "train_fsdp", "train_tp", "train_hybrid",
+    "train_single", "train_ddp", "train_ddp_zero1", "train_fsdp",
+    "train_tp", "train_hybrid",
     "train_pp", "train_moe_ep", "train_moe_dense", "moe_layer_ep",
     "train_transformer_single", "train_transformer_ddp",
     "train_transformer_fsdp", "train_transformer_tp",

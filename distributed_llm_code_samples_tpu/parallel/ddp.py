@@ -26,46 +26,72 @@ from jax.sharding import PartitionSpec as P
 from .. import LR
 from ..data import batch_from_seed, shard_seeds_strided
 from ..models.ffn_stack import FFNStackParams, clone_params
-from ..optim import sgd
+from ..optim import Optimizer, sgd
 from ..ops.stack import stack_fwd, stack_bwd
 from .collectives import all_reduce
 from .launcher import launch
 from .mesh import DATA_AXIS, require_axes
 
 
+def local_grads(params: FFNStackParams, seed, batch_size: int,
+                model_size: int, unroll: bool = True, grad_hook=None):
+    """One shard's fwd/bwd: the shared compute of DDP and ZeRO-1."""
+    x, dloss_dx = batch_from_seed(seed, batch_size, model_size,
+                                  params.w1.dtype)
+    _, acts = stack_fwd(params.w1, params.w2, x, unroll=unroll)
+    _, (g1, g2) = stack_bwd(dloss_dx, params.w1, params.w2, acts,
+                            grad_hook=grad_hook, unroll=unroll)
+    return FFNStackParams(g1, g2)
+
+
 def make_step(batch_size: int, model_size: int, lr: float = LR,
-              unroll: bool = True, axis: str = DATA_AXIS):
-    """One DDP step for one shard: local fwd/bwd with per-layer grad psum."""
+              unroll: bool = True, axis: str = DATA_AXIS,
+              optimizer: Optimizer | None = None):
+    """One DDP step for one shard: local fwd/bwd with per-layer grad psum.
+
+    Without ``optimizer`` the step is the reference's stateless inline SGD
+    (``(params, seed) -> params``). With one, the step maps
+    ``((params, opt_state), seed) -> (params, opt_state)`` — the optimizer
+    state is replicated like the params (the baseline ZeRO-1 improves on,
+    ``parallel/zero1.py``)."""
+
+    def grad_hook(dw1, dw2):  # fires per layer, like train_ffns.py:164-165
+        return all_reduce(dw1, axis), all_reduce(dw2, axis)
 
     def step(params: FFNStackParams, seed) -> FFNStackParams:
-        x, dloss_dx = batch_from_seed(seed, batch_size, model_size,
-                                      params.w1.dtype)
-        _, acts = stack_fwd(params.w1, params.w2, x, unroll=unroll)
+        grads = local_grads(params, seed, batch_size, model_size, unroll,
+                            grad_hook)
+        return sgd(params, grads, lr)
 
-        def grad_hook(dw1, dw2):  # fires per layer, like train_ffns.py:164-165
-            return all_reduce(dw1, axis), all_reduce(dw2, axis)
+    def step_opt(carry, seed):
+        params, state = carry
+        grads = local_grads(params, seed, batch_size, model_size, unroll,
+                            grad_hook)
+        return optimizer.update(grads, state, params, lr)
 
-        _, (g1, g2) = stack_bwd(dloss_dx, params.w1, params.w2, acts,
-                                grad_hook=grad_hook, unroll=unroll)
-        return sgd(params, FFNStackParams(g1, g2), lr)
-
-    return step
+    return step if optimizer is None else step_opt
 
 
 def train_ddp(params: FFNStackParams, seeds, batch_size: int,
-              model_size: int, mesh, lr: float = LR,
-              unroll: bool = True) -> FFNStackParams:
+              model_size: int, mesh, lr: float = LR, unroll: bool = True,
+              optimizer: Optimizer | None = None) -> FFNStackParams:
     """Run the full DDP schedule; returns the (replicated) final params.
 
     ``seeds`` is the *global* schedule; the strided split across ranks
     reproduces ``train_ffns.py:182`` so differential tests against FSDP
-    keep their power.
+    keep their power. ``optimizer`` selects a stateful update rule
+    (``optim.momentum``/``optim.adam``) with replicated state; None keeps
+    the reference's inline SGD.
     """
     require_axes(mesh, DATA_AXIS)
     n = mesh.shape[DATA_AXIS]
     seed_cols = shard_seeds_strided(seeds, n)  # [steps/rank, n]
-    step = make_step(batch_size, model_size, lr, unroll)
+    step = make_step(batch_size, model_size, lr, unroll,
+                     optimizer=optimizer)
 
+    make_carry = None
+    if optimizer is not None:
+        make_carry = lambda p: (p, optimizer.init(p))  # noqa: E731
     return launch(step, clone_params(params), seed_cols, mesh,
                   param_specs=P(), seed_spec=P(None, DATA_AXIS),
-                  select_local=lambda s: s[:, 0])
+                  select_local=lambda s: s[:, 0], make_carry=make_carry)

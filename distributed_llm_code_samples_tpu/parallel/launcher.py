@@ -16,19 +16,36 @@ from jax import lax
 
 
 def launch(step: Callable, params, seeds_arr, mesh, param_specs, seed_spec,
-           select_local: Callable = lambda s: s):
+           select_local: Callable = lambda s: s,
+           make_carry: Callable | None = None,
+           check_vma: bool = True):
     """Run ``lax.scan(step)`` over the seed schedule under ``shard_map``.
 
     ``select_local`` maps the shard's view of the seed array to its 1-D
     schedule (e.g. ``s[:, 0]`` for a strided column split). ``params`` must
     already be owned by the launcher (cloned/resharded) — they are donated.
+
+    Stateful strategies (optimizer state, ZeRO shards) pass ``make_carry``:
+    it builds the scan carry from the per-shard params *inside* the
+    ``shard_map`` body (so per-shard state can be sliced from the shard's
+    view), ``step`` then maps ``(carry, seed) -> carry``, and the carry's
+    first element is returned as the final params.
+
+    ``check_vma=False`` disables shard_map's varying-manual-axes typing for
+    strategies whose replicated outputs the type system cannot prove —
+    e.g. ZeRO-1's params re-assembled by ``all_gather`` from
+    ``axis_index``-sliced shards (identical by construction on every
+    rank, but typed varying; JAX offers no varying->invariant cast).
     """
 
     def run(params, seeds):
         local = select_local(seeds)
-        return lax.scan(lambda p, s: (step(p, s), None), params, local)[0]
+        carry = params if make_carry is None else make_carry(params)
+        out = lax.scan(lambda c, s: (step(c, s), None), carry, local)[0]
+        return out if make_carry is None else out[0]
 
     run_sharded = jax.shard_map(run, mesh=mesh,
                                 in_specs=(param_specs, seed_spec),
-                                out_specs=param_specs)
+                                out_specs=param_specs,
+                                check_vma=check_vma)
     return jax.jit(run_sharded, donate_argnums=0)(params, seeds_arr)

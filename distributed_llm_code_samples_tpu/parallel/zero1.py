@@ -1,0 +1,113 @@
+"""ZeRO-1: DDP with the optimizer state sharded across the data axis.
+
+The reference stops at ZeRO-3-style param sharding for SGD
+(``train_ffns.py:195-287``) — with no optimizer state, stage 1 has nothing
+to shard there. This framework's stateful optimizers (``optim.momentum``,
+``optim.adam``) change that: replicated Adam state costs 2x params per
+device; ZeRO-1 cuts it to 2x/n while keeping DDP's compute and comms
+shape.
+
+Hand-rolled over raw collectives, like every other strategy here:
+
+- params stay **replicated** (DDP layout); each shard computes local
+  grads for its own data column.
+- grads are **reduce_scattered** along the layer axis (SUM — the same
+  total bytes on the wire as DDP's all_reduce, but each rank ends up
+  owning only its ``L/n`` layers' summed grads: ZeRO's observation that
+  the reduction and the partition can be the same collective).
+- each rank updates only its ``L/n``-layer param slice with its local
+  optimizer-state shard — the only place state exists.
+- updated slices are **all_gathered** back to full replicated params for
+  the next step's forward.
+
+Per-step comms: 1 reduce_scatter + 1 all_gather per param tensor vs
+DDP's 1 all_reduce — identical bandwidth on a ring (an all_reduce *is*
+reduce_scatter + all_gather), so the state sharding is free. The
+partition unit is whole layers (leading axis of the stacked params),
+which requires ``L % n == 0``; matching the strategy-wide convention
+(e.g. ``pipeline.py``).
+
+Differential guarantees (tests/test_optim.py): with SGD, ZeRO-1 equals
+plain DDP exactly (stateless update commutes with the partition); with
+momentum/Adam it equals DDP running the same optimizer with replicated
+state — sharding the state changes where it lives, never the math.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .. import LR
+from ..data import shard_seeds_strided
+from ..models.ffn_stack import FFNStackParams, clone_params
+from ..optim import Optimizer, adam
+from .collectives import all_gather, axis_index, reduce_scatter
+from .ddp import local_grads
+from .launcher import launch
+from .mesh import DATA_AXIS, require_axes
+
+
+def make_step(batch_size: int, model_size: int, n_shards: int,
+              lr: float = LR, unroll: bool = True, axis: str = DATA_AXIS,
+              optimizer: Optimizer | None = None):
+    """One ZeRO-1 step for one shard: ``((params, state), seed) ->
+    (params, state)`` with ``state`` covering only this rank's layers."""
+    opt = adam() if optimizer is None else optimizer
+
+    def shard_of(tree):
+        """This rank's ``L/n``-layer slice of a stacked-leaf pytree."""
+        r = axis_index(axis)
+        return jax.tree_util.tree_map(
+            lambda a: lax.dynamic_slice_in_dim(
+                a, r * (a.shape[0] // n_shards), a.shape[0] // n_shards, 0),
+            tree)
+
+    def step(carry, seed):
+        params, state = carry
+        grads = local_grads(params, seed, batch_size, model_size, unroll)
+        # SUM-reduce AND partition in one collective: rank r receives the
+        # summed grads of its own layers only (train_ffns.py:165 SUM
+        # semantics; ZeRO's reduce-scatter observation)
+        gshard = jax.tree_util.tree_map(
+            lambda g: reduce_scatter(g, axis, dim=0), grads)
+        pshard, state = opt.update(gshard, state, shard_of(params), lr)
+        # re-assemble replicated params for the next forward
+        params = jax.tree_util.tree_map(
+            lambda p: all_gather(p, axis, dim=0), pshard)
+        return params, state
+
+    return step, shard_of, opt
+
+
+def train_ddp_zero1(params: FFNStackParams, seeds, batch_size: int,
+                    model_size: int, mesh, lr: float = LR,
+                    unroll: bool = True,
+                    optimizer: Optimizer | None = None) -> FFNStackParams:
+    """Run the ZeRO-1 schedule; returns the (replicated) final params.
+
+    ``optimizer`` defaults to ``optim.adam()`` — the state-heavy case
+    ZeRO-1 exists for. Data sharding matches DDP (strided seed columns,
+    ``train_ffns.py:182``), so ``train_ddp_zero1(optimizer=o)`` ==
+    ``train_ddp(optimizer=o)`` leaf-for-leaf.
+    """
+    require_axes(mesh, DATA_AXIS)
+    n = mesh.shape[DATA_AXIS]
+    n_layers = params.w1.shape[0]
+    if n_layers % n:
+        raise ValueError(
+            f"{n_layers} layers not divisible across {n} ranks: ZeRO-1 "
+            "partitions optimizer state in whole-layer units")
+    seed_cols = shard_seeds_strided(seeds, n)
+    step, shard_of, opt = make_step(batch_size, model_size, n, lr, unroll,
+                                    optimizer=optimizer)
+
+    # check_vma off: the re-assembled params are replicated by construction
+    # (every rank all_gathers the same disjoint slices) but typed varying —
+    # see launcher.launch
+    return launch(step, clone_params(params), seed_cols, mesh,
+                  param_specs=P(), seed_spec=P(None, DATA_AXIS),
+                  select_local=lambda s: s[:, 0],
+                  make_carry=lambda p: (p, opt.init(shard_of(p))),
+                  check_vma=False)
