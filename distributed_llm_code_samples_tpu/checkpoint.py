@@ -288,7 +288,7 @@ def restore_checkpoint(ckpt_dir: str, target: Any, step: int | None = None,
 def run_with_checkpointing(train_fn, params, seeds, *args,
                            ckpt_dir: str, every: int = 0, resume: bool = True,
                            backend: str = "npz", seeds_divisor: int = 1,
-                           **kwargs):
+                           stateful: bool = False, **kwargs):
     """Drive any strategy launcher (uniform L4 signature,
     ``fn(params, seeds, batch, d, **kw)``) with periodic checkpointing.
 
@@ -322,6 +322,17 @@ def run_with_checkpointing(train_fn, params, seeds, *args,
                 f"{seeds_divisor} data shards")
     start = 0
     if resume and (agreed := _agreed_latest_step(ckpt_dir)) is not None:
+        if stateful and agreed > 0:
+            # only params are checkpointed: resuming/extending a partly-
+            # trained run would re-init optimizer state (mu/nu/count back
+            # to zero) and silently change the math vs an uninterrupted
+            # run. Fail loudly instead.
+            raise ValueError(
+                f"cannot resume a stateful-optimizer run from step "
+                f"{agreed}: optimizer state is not checkpointed, so the "
+                "continuation would restart momentum/Adam statistics from "
+                "zero; pass resume=False (--no_resume) to retrain from "
+                "step 0, or use the stateless sgd optimizer")
         params, start, saved = restore_checkpoint(ckpt_dir, params,
                                                   step=agreed)
         if saved is not None and len(saved):

@@ -122,10 +122,12 @@ def main(argv=None) -> int:
     if (args.optimizer != "sgd" and args.checkpoint_dir
             and args.checkpoint_every):
         # segment boundaries re-init optimizer state (only params are
-        # checkpointed), silently changing the math vs an uninterrupted run
+        # checkpointed), silently changing the math vs an uninterrupted
+        # run; resuming a finished/partial run is likewise rejected at
+        # run time (run_with_checkpointing stateful=True)
         print("error: --checkpoint_every does not checkpoint momentum/adam "
-              "state; use the default final-only checkpoint (0) with a "
-              "stateful optimizer", file=sys.stderr)
+              "state; with a stateful optimizer only whole-run "
+              "checkpoints (0) are supported", file=sys.stderr)
         return 2
 
     lr = LR if args.lr is None else args.lr
@@ -240,7 +242,9 @@ def main(argv=None) -> int:
                 fn, params, seeds, tokens, args.model_size,
                 ckpt_dir=os.path.join(args.checkpoint_dir, name),
                 every=args.checkpoint_every, resume=not args.no_resume,
-                seeds_divisor=divisor, **kwargs)
+                seeds_divisor=divisor,
+                stateful=("optimizer" in kwargs
+                          and kwargs["optimizer"].name != "sgd"), **kwargs)
         else:
             out = fn(params, seeds, tokens, args.model_size, **kwargs)
         jax.block_until_ready(out)

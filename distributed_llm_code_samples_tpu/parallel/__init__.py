@@ -25,7 +25,7 @@ from .sequence import (ring_attention, sequence_parallel_attention,
 from .expert import train_moe_ep, train_moe_dense, moe_layer_ep
 from .transformer import (train_transformer_single, train_transformer_ddp,
                           train_transformer_fsdp, train_transformer_tp,
-                          train_transformer_hybrid)
+                          train_transformer_hybrid, train_transformer_seq)
 
 # Method-number parity with the reference CLI (train_ffns.py:6, :373):
 # 1=single, 2=DDP, 3=FSDP, 4=TP; 5+ extend with the hybrid mesh and the
@@ -50,7 +50,7 @@ __all__ = [
     "train_pp", "train_moe_ep", "train_moe_dense", "moe_layer_ep",
     "train_transformer_single", "train_transformer_ddp",
     "train_transformer_fsdp", "train_transformer_tp",
-    "train_transformer_hybrid",
+    "train_transformer_hybrid", "train_transformer_seq",
     "ring_attention", "sequence_parallel_attention",
     "ulysses_attention", "ulysses_parallel_attention",
     "STRATEGIES",

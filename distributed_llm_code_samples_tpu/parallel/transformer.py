@@ -40,9 +40,9 @@ from ..models.transformer import (TransformerParams, attn_sublayer,
 from ..ops.ffn import ffn_block
 from ..ops.norm import layernorm
 from ..optim import sgd
-from .collectives import all_gather, all_reduce, grad_reduce
+from .collectives import all_gather, all_reduce, axis_index, grad_reduce
 from .launcher import launch
-from .mesh import DATA_AXIS, MODEL_AXIS, require_axes
+from .mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS, require_axes
 
 # TP layout: column-parallel projections shard the output dim (heads for
 # attention, ffn features for w1); row-parallel shard the input dim.
@@ -286,6 +286,75 @@ def train_transformer_tp(params: TransformerParams, seeds, batch_size: int,
 
     return launch(step, _shard(params, mesh, TP_SPECS), jnp.asarray(seeds),
                   mesh, param_specs=TP_SPECS, seed_spec=P())
+
+
+def train_transformer_seq(params: TransformerParams, seeds,
+                          batch_size: int, model_size: int, mesh,
+                          lr: float = LR, *, seq_len: int, n_heads: int,
+                          causal: bool = True,
+                          seq_impl: str = "ring") -> TransformerParams:
+    """Long-context training: the sequence dim sharded over the ``"seq"``
+    axis — the first-class path that makes ring attention / Ulysses a
+    *training* capability rather than an op-level demo.
+
+    Everything token-pointwise (LN, projections, FFN, residuals) runs on
+    the shard's own ``T/n`` tokens untouched; only attention crosses
+    shards, via the hand-written ring (KV blocks rotating over
+    ``ppermute``, ``sequence.ring_attention``) or Ulysses (two
+    ``all_to_all``s trading heads for sequence). No device ever holds the
+    full ``[T, T]`` score matrix — or, for the ring, even the full
+    sequence of activations.
+
+    Data is replicated like TP (every shard generates the step's full
+    batch from the seed and slices its own token block — global causal
+    positions stay exact); weight grads are per-shard partials over the
+    token dim, summed with one ``psum`` per step (SUM, unscaled LR,
+    ``train_ffns.py:165`` semantics). Differential guarantee:
+    ``train_transformer_seq == train_transformer_single`` on the same
+    schedule, both impls (tests/test_transformer.py).
+    """
+    from .sequence import ring_attention, ulysses_attention
+    require_axes(mesh, SEQ_AXIS)
+    n = mesh.shape[SEQ_AXIS]
+    _validate_shapes(batch_size, seq_len, model_size, n_heads)
+    if seq_len % n:
+        raise ValueError(f"seq_len={seq_len} not divisible by seq-axis "
+                         f"size {n}")
+    if seq_impl == "ring":
+        def attn(q, k, v, causal):  # [H, T_local, dh]: ring per head
+            return jax.vmap(
+                lambda q, k, v: ring_attention(q, k, v, SEQ_AXIS, causal)
+            )(q, k, v)
+    elif seq_impl == "ulysses":
+        if n_heads % n:
+            raise ValueError(f"n_heads={n_heads} not divisible by "
+                             f"seq-axis size {n} (Ulysses scatters heads)")
+        def attn(q, k, v, causal):
+            return ulysses_attention(q, k, v, SEQ_AXIS, causal)
+    else:
+        raise ValueError(f"unknown seq_impl {seq_impl!r} "
+                         "(expected 'ring' or 'ulysses')")
+    t_local = seq_len // n
+
+    def step(params: TransformerParams, seed) -> TransformerParams:
+        x, dloss_dx = _reshape_batch(seed, batch_size, seq_len, model_size,
+                                     params.w1.dtype)
+        r = axis_index(SEQ_AXIS)
+        # this shard's token block (global batch regenerated from the
+        # seed, so positions/causality are exact without a scatter)
+        x, dloss_dx = (lax.dynamic_slice_in_dim(t, r * t_local, t_local, 1)
+                       for t in (x, dloss_dx))
+
+        _, vjp = jax.vjp(
+            lambda p: transformer_fwd(p, x, n_heads, causal, attn), params)
+        grads = vjp(dloss_dx)[0]
+        # weight grads are partial sums over this shard's tokens
+        grads = jax.tree_util.tree_map(
+            lambda g: grad_reduce(g, SEQ_AXIS), grads)
+        return sgd(params, grads, lr)
+
+    return launch(step, clone_params(params), jnp.asarray(seeds), mesh,
+                  param_specs=P(), seed_spec=P())
 
 
 def train_transformer_hybrid(params: TransformerParams, seeds,

@@ -254,3 +254,22 @@ def test_checkpointed_ddp(tmp_path, params, mesh8):
                                  ckpt_dir=str(tmp_path), every=8, mesh=mesh8)
     np.testing.assert_allclose(np.asarray(out.w1), np.asarray(oracle.w1),
                                rtol=1e-6, atol=1e-7)
+
+
+def test_stateful_resume_is_rejected(tmp_path, mesh4, params):
+    """Optimizer state is not checkpointed: resuming a stateful-optimizer
+    run mid-way would silently re-init Adam's moments. The checkpoint
+    layer must fail loudly instead (code-review r2 finding)."""
+    from distributed_llm_code_samples_tpu.optim import adam
+    tokens, d = 32, 16
+    seeds = make_seed_schedule(8, random_seed=5)
+    ck = str(tmp_path / "ck")
+    run_with_checkpointing(train_ddp, params, seeds, tokens, d, ckpt_dir=ck,
+                           stateful=True, seeds_divisor=4, mesh=mesh4,
+                           lr=0.1, optimizer=adam())
+    # extending the finished run must refuse to resume with fresh state
+    longer = make_seed_schedule(16, random_seed=5)
+    with pytest.raises(ValueError, match="stateful"):
+        run_with_checkpointing(train_ddp, params, longer, tokens, d,
+                               ckpt_dir=ck, stateful=True, seeds_divisor=4,
+                               mesh=mesh4, lr=0.1, optimizer=adam())

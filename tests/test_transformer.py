@@ -267,3 +267,57 @@ def test_flash_hybrid_matches_oracle_hybrid(params):
     for name, a, b in zip(TransformerParams._fields, flash, base):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4,
                                    atol=1e-5, err_msg=name)
+
+
+# --- Sequence-parallel (long-context) training ----------------------------
+
+@pytest.mark.parametrize("seq_impl", ["ring", "ulysses"])
+def test_seq_parallel_matches_single(params, seq_impl):
+    """Long-context training over the seq axis — ring attention or
+    Ulysses a2a — equals the single-device run: sharding the sequence
+    changes where tokens live, never the math."""
+    from distributed_llm_code_samples_tpu.parallel import (
+        SEQ_AXIS, train_transformer_seq)
+    seeds = make_seed_schedule(4, random_seed=29)
+    single = train_transformer_single(params, seeds, TOKENS, D, lr=0.05,
+                                      seq_len=T, n_heads=H)
+    mesh = make_mesh({SEQ_AXIS: 4})
+    seq = train_transformer_seq(params, seeds, TOKENS, D, mesh, lr=0.05,
+                                seq_len=T, n_heads=H, seq_impl=seq_impl)
+    for name, a, b in zip(TransformerParams._fields, seq, single):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4,
+                                   atol=1e-5, err_msg=name)
+
+
+def test_seq_parallel_non_causal(params):
+    from distributed_llm_code_samples_tpu.parallel import (
+        SEQ_AXIS, train_transformer_seq)
+    seeds = make_seed_schedule(2, random_seed=31)
+    single = train_transformer_single(params, seeds, TOKENS, D, lr=0.05,
+                                      seq_len=T, n_heads=H, causal=False)
+    mesh = make_mesh({SEQ_AXIS: 4})
+    seq = train_transformer_seq(params, seeds, TOKENS, D, mesh, lr=0.05,
+                                seq_len=T, n_heads=H, causal=False)
+    for name, a, b in zip(TransformerParams._fields, seq, single):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4,
+                                   atol=1e-5, err_msg=name)
+
+
+def test_seq_parallel_validations(params):
+    from distributed_llm_code_samples_tpu.parallel import (
+        SEQ_AXIS, train_transformer_seq)
+    seeds = make_seed_schedule(1, random_seed=1)
+    mesh = make_mesh({SEQ_AXIS: 4})
+    with pytest.raises(ValueError, match="seq_impl"):
+        train_transformer_seq(params, seeds, TOKENS, D, mesh, seq_len=T,
+                              n_heads=H, seq_impl="megatron")
+    with pytest.raises(ValueError, match="divisible"):
+        # seq_len 20 does not divide across 8 seq shards
+        train_transformer_seq(params, seeds, 2 * 20, D,
+                              make_mesh({SEQ_AXIS: 8}), seq_len=20,
+                              n_heads=H, seq_impl="ring")
+    with pytest.raises(ValueError, match="heads"):
+        # Ulysses scatters heads: 4 heads cannot split over 8 shards
+        train_transformer_seq(params, seeds, 2 * T, D,
+                              make_mesh({SEQ_AXIS: 8}), seq_len=T,
+                              n_heads=H, seq_impl="ulysses")
