@@ -69,6 +69,10 @@ def build_parser() -> argparse.ArgumentParser:
                    default="float32")
     p.add_argument("--scan", action="store_true",
                    help="lax.scan over layers instead of unrolling")
+    p.add_argument("--accum", type=int, default=1,
+                   help="gradient-accumulation chunks per step for "
+                        "--method 1/2 (exact: SUM semantics, ~1/accum "
+                        "activation memory)")
     p.add_argument("--pallas", action="store_true",
                    help="use the fused Pallas FFN kernels for the "
                         "single-device method (interpret mode off-TPU)")
@@ -113,6 +117,18 @@ def main(argv=None) -> int:
     from .parallel import (make_mesh, guard_multi_device, STRATEGIES,
                            DATA_AXIS, MODEL_AXIS, PIPE_AXIS, EXPERT_AXIS)
 
+    if args.zero1 and args.accum > 1:
+        print("error: --accum is not supported with --zero1",
+              file=sys.stderr)
+        return 2
+    if args.accum > 1 and args.method not in (1, 2):
+        # methods 0/9 would cross-verify chunked-accumulation runs against
+        # full-batch strategies at the tight tolerance (different f32
+        # reduction order => spurious differential failures); other
+        # methods would silently ignore the flag
+        print("error: --accum applies to --method 1 or 2 only",
+              file=sys.stderr)
+        return 2
     if (args.optimizer != "sgd" or args.zero1) and args.method != 2:
         # methods 0/9 cross-check DDP against strategies that would still
         # run inline SGD — a guaranteed spurious differential failure
@@ -208,6 +224,8 @@ def main(argv=None) -> int:
         params = params_for(m)
         mesh = mesh_for(m)
         kwargs = dict(lr=lr, unroll=unroll)
+        if m in (1, 2) and args.accum > 1:
+            kwargs["accum"] = args.accum
         if m == 2 and (args.optimizer != "sgd" or args.zero1):
             from .optim import OPTIMIZERS
             kwargs["optimizer"] = OPTIMIZERS[args.optimizer]()

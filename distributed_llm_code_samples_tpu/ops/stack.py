@@ -103,6 +103,28 @@ def stack_bwd(dy: jax.Array, w1s: jax.Array, w2s: jax.Array,
     return dx, (g1s, g2s)
 
 
+def accumulated_grads(grad_fn, x: jax.Array, dy: jax.Array, accum: int):
+    """Sum ``grad_fn(x_chunk, dy_chunk)`` over ``accum`` leading-dim
+    chunks via ``lax.scan`` — the shared gradient-accumulation engine of
+    the single-device and DDP trainers. Exact under SUM semantics (grads
+    are linear in the batch); peak activation memory drops ~1/accum
+    because only one chunk's residuals are live at a time."""
+    if accum == 1:
+        return grad_fn(x, dy)
+    tokens = x.shape[0]
+    if tokens % accum:
+        raise ValueError(f"tokens {tokens} not divisible into "
+                         f"{accum} accumulation chunks")
+    xc = x.reshape(accum, tokens // accum, *x.shape[1:])
+    dc = dy.reshape(accum, tokens // accum, *dy.shape[1:])
+
+    def body(total, xd):
+        g = grad_fn(*xd)
+        return jax.tree_util.tree_map(jnp.add, total, g), None
+
+    return lax.scan(body, grad_fn(xc[0], dc[0]), (xc[1:], dc[1:]))[0]
+
+
 def stack_grads(w1s: jax.Array, w2s: jax.Array, x: jax.Array,
                 dy: jax.Array, *, block=ffn_block, unroll: bool = True):
     """Whole-stack gradients with the hand-written VJP as the per-block rule

@@ -21,73 +21,97 @@ from __future__ import annotations
 
 from functools import partial
 
+import jax
+from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from .. import LR
 from ..data import batch_from_seed, shard_seeds_strided
 from ..models.ffn_stack import FFNStackParams, clone_params
 from ..optim import Optimizer, sgd
-from ..ops.stack import stack_fwd, stack_bwd
+from ..ops.stack import accumulated_grads, stack_fwd, stack_bwd
 from .collectives import all_reduce
 from .launcher import launch
 from .mesh import DATA_AXIS, require_axes
 
 
-def local_grads(params: FFNStackParams, seed, batch_size: int,
-                model_size: int, unroll: bool = True, grad_hook=None):
-    """One shard's fwd/bwd: the shared compute of DDP and ZeRO-1."""
-    x, dloss_dx = batch_from_seed(seed, batch_size, model_size,
-                                  params.w1.dtype)
+def grads_for_batch(params: FFNStackParams, x, dy, unroll: bool = True,
+                    grad_hook=None) -> FFNStackParams:
+    """One fwd/bwd over given data — the compute shared by DDP, ZeRO-1,
+    and the gradient-accumulation chunks."""
     _, acts = stack_fwd(params.w1, params.w2, x, unroll=unroll)
-    _, (g1, g2) = stack_bwd(dloss_dx, params.w1, params.w2, acts,
+    _, (g1, g2) = stack_bwd(dy, params.w1, params.w2, acts,
                             grad_hook=grad_hook, unroll=unroll)
     return FFNStackParams(g1, g2)
 
 
+def local_grads(params: FFNStackParams, seed, batch_size: int,
+                model_size: int, unroll: bool = True, grad_hook=None):
+    """One shard's step grads from its seed (see ``grads_for_batch``)."""
+    x, dloss_dx = batch_from_seed(seed, batch_size, model_size,
+                                  params.w1.dtype)
+    return grads_for_batch(params, x, dloss_dx, unroll, grad_hook)
+
+
 def make_step(batch_size: int, model_size: int, lr: float = LR,
               unroll: bool = True, axis: str = DATA_AXIS,
-              optimizer: Optimizer | None = None):
+              optimizer: Optimizer | None = None, accum: int = 1):
     """One DDP step for one shard: local fwd/bwd with per-layer grad psum.
 
     Without ``optimizer`` the step is the reference's stateless inline SGD
     (``(params, seed) -> params``). With one, the step maps
     ``((params, opt_state), seed) -> (params, opt_state)`` — the optimizer
     state is replicated like the params (the baseline ZeRO-1 improves on,
-    ``parallel/zero1.py``)."""
+    ``parallel/zero1.py``).
+
+    ``accum > 1`` gradient-accumulates over token chunks
+    (``ops.stack.accumulated_grads``): local grads sum across chunks
+    unreduced, then ONE tree-wide psum replaces the per-layer-per-chunk
+    hooks — same math, 1/accum the collectives and ~1/accum the
+    activation memory."""
 
     def grad_hook(dw1, dw2):  # fires per layer, like train_ffns.py:164-165
         return all_reduce(dw1, axis), all_reduce(dw2, axis)
 
+    def grads_of(params, seed):
+        if accum == 1:
+            return local_grads(params, seed, batch_size, model_size,
+                               unroll, grad_hook)
+        x, dy = batch_from_seed(seed, batch_size, model_size,
+                                params.w1.dtype)
+        total = accumulated_grads(
+            lambda x, dy: grads_for_batch(params, x, dy, unroll),
+            x, dy, accum)
+        return jax.tree_util.tree_map(lambda g: all_reduce(g, axis), total)
+
     def step(params: FFNStackParams, seed) -> FFNStackParams:
-        grads = local_grads(params, seed, batch_size, model_size, unroll,
-                            grad_hook)
-        return sgd(params, grads, lr)
+        return sgd(params, grads_of(params, seed), lr)
 
     def step_opt(carry, seed):
         params, state = carry
-        grads = local_grads(params, seed, batch_size, model_size, unroll,
-                            grad_hook)
-        return optimizer.update(grads, state, params, lr)
+        return optimizer.update(grads_of(params, seed), state, params, lr)
 
     return step if optimizer is None else step_opt
 
 
 def train_ddp(params: FFNStackParams, seeds, batch_size: int,
               model_size: int, mesh, lr: float = LR, unroll: bool = True,
-              optimizer: Optimizer | None = None) -> FFNStackParams:
+              optimizer: Optimizer | None = None,
+              accum: int = 1) -> FFNStackParams:
     """Run the full DDP schedule; returns the (replicated) final params.
 
     ``seeds`` is the *global* schedule; the strided split across ranks
     reproduces ``train_ffns.py:182`` so differential tests against FSDP
     keep their power. ``optimizer`` selects a stateful update rule
     (``optim.momentum``/``optim.adam``) with replicated state; None keeps
-    the reference's inline SGD.
+    the reference's inline SGD. ``accum`` gradient-accumulates each step
+    over token chunks (see ``make_step``).
     """
     require_axes(mesh, DATA_AXIS)
     n = mesh.shape[DATA_AXIS]
     seed_cols = shard_seeds_strided(seeds, n)  # [steps/rank, n]
     step = make_step(batch_size, model_size, lr, unroll,
-                     optimizer=optimizer)
+                     optimizer=optimizer, accum=accum)
 
     make_carry = None
     if optimizer is not None:

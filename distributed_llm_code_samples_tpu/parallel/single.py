@@ -19,13 +19,15 @@ from ..data import batch_from_seed
 from ..models.ffn_stack import FFNStackParams, clone_params
 from ..optim import sgd
 from ..ops.ffn import ffn_fwd, ffn_bwd
-from ..ops.stack import stack_fwd, stack_bwd, stack_grads
+from ..ops.stack import (accumulated_grads, stack_fwd, stack_bwd,
+                         stack_grads)
 
 
 def make_step(batch_size: int, model_size: int, lr: float = LR,
               unroll: bool = True, use_pallas: bool = False,
               interpret: bool = False, manual_loop: bool = False,
-              remat: bool | None = None, mixed: bool = False):
+              remat: bool | None = None, mixed: bool = False,
+              accum: int = 1):
     """Build one training step ``(params, seed) -> params`` — forward,
     manual backward, inline SGD (``train_ffns.py:105-114``).
 
@@ -54,7 +56,13 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
     (``ops.ffn.ffn_block_mixed``): bf16 matmul inputs on the MXU, fp32
     params/gradients/accumulation, bf16 residuals. On this bench chip the
     default f32 matmul already lowers to bf16 MXU passes, so this is a
-    numerics-layout option, not a speed lever."""
+    numerics-layout option, not a speed lever.
+
+    ``accum`` splits the step's tokens into that many gradient-
+    accumulation chunks (``lax.scan``, summed grads, one update): peak
+    activation memory drops ~1/accum while the math is exactly the
+    full-batch step (grads are linear in the batch; the mock loss has no
+    mean to rescale — SUM semantics throughout, ``train_ffns.py:165``)."""
     if mixed and (use_pallas or remat is not None or manual_loop):
         raise ValueError("mixed=True is its own block implementation; it "
                          "cannot combine with use_pallas/remat/manual_loop")
@@ -63,6 +71,10 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
                          "remat=False cannot combine with use_pallas")
     if remat is None:
         remat = True  # the reference's recompute policy is the default
+
+    def accumulate(grad_fn, x, dy):
+        return accumulated_grads(grad_fn, x, dy, accum)
+
     if manual_loop:
         if use_pallas:
             from ..ops.pallas_ffn import ffn_fwd_pallas, ffn_bwd_pallas
@@ -76,11 +88,15 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
         def step(params: FFNStackParams, seed) -> FFNStackParams:
             x, dloss_dx = batch_from_seed(seed, batch_size, model_size,
                                           params.w1.dtype)
-            _, acts = stack_fwd(params.w1, params.w2, x, block_fwd=block_fwd,
-                                unroll=unroll)
-            _, (g1, g2) = stack_bwd(dloss_dx, params.w1, params.w2, acts,
-                                    block_bwd=block_bwd, unroll=unroll)
-            return sgd(params, FFNStackParams(g1, g2), lr)
+
+            def grad_fn(x, dy):
+                _, acts = stack_fwd(params.w1, params.w2, x,
+                                    block_fwd=block_fwd, unroll=unroll)
+                _, (g1, g2) = stack_bwd(dy, params.w1, params.w2, acts,
+                                        block_bwd=block_bwd, unroll=unroll)
+                return FFNStackParams(g1, g2)
+
+            return sgd(params, accumulate(grad_fn, x, dloss_dx), lr)
 
         return step
 
@@ -98,18 +114,21 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
     def step(params: FFNStackParams, seed) -> FFNStackParams:
         x, dloss_dx = batch_from_seed(seed, batch_size, model_size,
                                       params.w1.dtype)
-        _, (g1, g2) = stack_grads(params.w1, params.w2, x, dloss_dx,
-                                  block=block, unroll=unroll)
-        return sgd(params, FFNStackParams(g1, g2), lr)
+
+        def grad_fn(x, dy):
+            return FFNStackParams(*stack_grads(params.w1, params.w2, x, dy,
+                                               block=block, unroll=unroll)[1])
+
+        return sgd(params, accumulate(grad_fn, x, dloss_dx), lr)
 
     return step
 
 
-@partial(jax.jit, static_argnums=tuple(range(2, 11)), donate_argnums=0)
+@partial(jax.jit, static_argnums=tuple(range(2, 12)), donate_argnums=0)
 def _run(params, seeds, batch_size, model_size, lr, unroll, use_pallas,
-         interpret, manual_loop, remat, mixed):
+         interpret, manual_loop, remat, mixed, accum):
     step = make_step(batch_size, model_size, lr, unroll, use_pallas,
-                     interpret, manual_loop, remat, mixed)
+                     interpret, manual_loop, remat, mixed, accum)
     return lax.scan(lambda p, s: (step(p, s), None), params, seeds)[0]
 
 
@@ -117,9 +136,9 @@ def train_single(params: FFNStackParams, seeds, batch_size: int,
                  model_size: int, mesh=None, lr: float = LR,
                  unroll: bool = True, use_pallas: bool = False,
                  interpret: bool = False, manual_loop: bool = False,
-                 remat: bool | None = None,
-                 mixed: bool = False) -> FFNStackParams:
+                 remat: bool | None = None, mixed: bool = False,
+                 accum: int = 1) -> FFNStackParams:
     """Uniform launcher signature (SURVEY.md L4); ``mesh`` ignored."""
     return _run(clone_params(params), jnp.asarray(seeds), batch_size,
                 model_size, lr, unroll, use_pallas, interpret, manual_loop,
-                remat, mixed)
+                remat, mixed, accum)

@@ -260,3 +260,26 @@ def test_ddp_on_8_devices(setup, mesh8):
     p_ddp8 = train_ddp(params, seeds, B, D, mesh8, lr=LR_TEST)
     p_fsdp8 = train_fsdp(params, seeds, B, D, mesh8, lr=LR_TEST)
     _assert_params_close(p_ddp8, p_fsdp8)
+
+
+@pytest.mark.parametrize("accum", [2, 4])
+def test_accumulation_matches_full_batch_single(setup, accum):
+    """Gradient accumulation is exactly the full-batch step: grads are
+    linear in the batch and the update is SUM-semantics throughout."""
+    params, seeds = setup
+    full = train_single(params, seeds, B, D, lr=LR_TEST)
+    acc = train_single(params, seeds, B, D, lr=LR_TEST, accum=accum)
+    _assert_params_close(full, acc)
+
+
+def test_accumulation_matches_full_batch_ddp(setup, mesh4):
+    params, seeds = setup
+    full = train_ddp(params, seeds, B, D, mesh4, lr=LR_TEST)
+    acc = train_ddp(params, seeds, B, D, mesh4, lr=LR_TEST, accum=4)
+    _assert_params_close(full, acc)
+
+
+def test_accumulation_rejects_indivisible(setup):
+    params, seeds = setup
+    with pytest.raises(ValueError, match="accumulation"):
+        train_single(params, seeds, B, D, lr=LR_TEST, accum=5)
