@@ -82,6 +82,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fake_devices", type=int, default=0,
                    help="run on N virtual CPU devices "
                         "(xla_force_host_platform_device_count)")
+    p.add_argument("--profile_dir", default=None,
+                   help="profile each method's run into this directory "
+                        "(Perfetto/TensorBoard trace, process 0 only — "
+                        "the reference's torch_profile_rank_0 surface, "
+                        "train_ffns.py:129-141, on by flag instead of by "
+                        "commented-out decorator)")
     p.add_argument("--checkpoint_dir", default=None,
                    help="enable checkpoint/resume: save params + seed "
                         "schedule here (per-method subdirs); a re-run with "
@@ -119,6 +125,10 @@ def main(argv=None) -> int:
 
     if args.zero1 and args.accum > 1:
         print("error: --accum is not supported with --zero1",
+              file=sys.stderr)
+        return 2
+    if args.accum < 1:
+        print(f"error: --accum must be >= 1 (got {args.accum})",
               file=sys.stderr)
         return 2
     if args.accum > 1 and args.method not in (1, 2):
@@ -245,6 +255,12 @@ def main(argv=None) -> int:
             kwargs["interpret"] = jax.default_backend() != "tpu"
         if mesh is not None:
             kwargs["mesh"] = mesh
+        if args.profile_dir:
+            # wrap fn itself so BOTH the direct and the checkpointing
+            # branches profile (each checkpoint segment gets its own
+            # timestamped trace run in the same directory)
+            from .utils.profiling import profile_rank_0
+            fn = profile_rank_0(os.path.join(args.profile_dir, name))(fn)
         t0 = time.time()
         if args.checkpoint_dir:
             from .checkpoint import run_with_checkpointing

@@ -122,7 +122,18 @@ def accumulated_grads(grad_fn, x: jax.Array, dy: jax.Array, accum: int):
         g = grad_fn(*xd)
         return jax.tree_util.tree_map(jnp.add, total, g), None
 
-    return lax.scan(body, grad_fn(xc[0], dc[0]), (xc[1:], dc[1:]))[0]
+    # start from typed zeros so the grad graph is emitted ONCE (inside the
+    # scan body) — seeding the carry with grad_fn(chunk 0) would duplicate
+    # the whole fwd+bwd HLO. eval_shape carries vma, so the zeros can be
+    # pcast to match shard-varying grads under shard_map.
+    def zero_of(aval):
+        z = jnp.zeros(aval.shape, aval.dtype)
+        vma = tuple(getattr(aval, "vma", ()) or ())
+        return lax.pcast(z, vma, to="varying") if vma else z
+
+    init = jax.tree_util.tree_map(zero_of,
+                                  jax.eval_shape(grad_fn, xc[0], dc[0]))
+    return lax.scan(body, init, (xc, dc))[0]
 
 
 def stack_grads(w1s: jax.Array, w2s: jax.Array, x: jax.Array,

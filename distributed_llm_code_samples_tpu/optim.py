@@ -103,6 +103,51 @@ def adam(b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8) -> Optimizer:
                      name=f"adam({b1},{b2},{eps})")
 
 
+def warmup_cosine(peak_lr: float, warmup_steps: int, total_steps: int,
+                  min_lr: float = 0.0):
+    """The standard LLM-training schedule, written out: linear warmup from
+    0 to ``peak_lr`` over ``warmup_steps``, then cosine decay to
+    ``min_lr`` at ``total_steps``. Returns ``step -> lr`` on a traced
+    int step."""
+    def schedule(step):
+        t = step.astype(jnp.float32)
+        warm = peak_lr * (t + 1.0) / max(warmup_steps, 1)
+        frac = jnp.clip((t - warmup_steps) /
+                        max(total_steps - warmup_steps, 1), 0.0, 1.0)
+        cos = min_lr + 0.5 * (peak_lr - min_lr) * (1.0 +
+                                                   jnp.cos(jnp.pi * frac))
+        return jnp.where(t < warmup_steps, warm, cos)
+
+    return schedule
+
+
+def constant_with_warmup(peak_lr: float, warmup_steps: int):
+    """Linear warmup to ``peak_lr``, constant after."""
+    def schedule(step):
+        t = step.astype(jnp.float32)
+        return jnp.minimum(peak_lr, peak_lr * (t + 1.0) /
+                           max(warmup_steps, 1))
+
+    return schedule
+
+
+def scheduled(opt: Optimizer, schedule) -> Optimizer:
+    """Wrap an optimizer with a per-step LR schedule. The wrapper keeps
+    its own step counter in the state, so it composes with any strategy
+    that threads optimizer state (DDP, ZeRO-1) — the trainer's static
+    ``lr`` argument is superseded by ``schedule(step)``."""
+    def init(params):
+        return (opt.init(params), jnp.zeros((), jnp.int32))
+
+    def update(grads, state, params, lr):
+        inner, count = state
+        params, inner = opt.update(grads, inner, params, schedule(count))
+        return params, (inner, count + 1)
+
+    return Optimizer(init=init, update=update,
+                     name=f"scheduled({opt.name})")
+
+
 OPTIMIZERS = {
     "sgd": sgd_optimizer,
     "momentum": momentum,
