@@ -51,6 +51,37 @@ import numpy as np
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
 
+_ASYNC_WRITER = None
+_ERRORS_SEEN = 0  # errors already reported by a previous wait_pending
+_TMP_SEQ = 0      # unique tmp-dir suffixes for async staging
+
+
+def _writer():
+    """Process-wide native async checkpoint writer (lazy; 2 I/O threads)."""
+    global _ASYNC_WRITER
+    if _ASYNC_WRITER is None:
+        from .runtime.native import AsyncCheckpointWriter
+        _ASYNC_WRITER = AsyncCheckpointWriter(n_threads=2)
+    return _ASYNC_WRITER
+
+
+def wait_pending() -> None:
+    """Block until every ``backend="native"`` checkpoint submitted by this
+    process is published; raises if any write failed *since the last
+    wait* — an old failure must not mask later successful saves or block
+    an in-process restore of a still-good checkpoint."""
+    global _ERRORS_SEEN
+    if _ASYNC_WRITER is None:
+        return
+    _ASYNC_WRITER.wait()
+    errs = _ASYNC_WRITER.errors()
+    new = errs - _ERRORS_SEEN
+    _ERRORS_SEEN = errs
+    if new:
+        raise RuntimeError(
+            f"{new} async checkpoint write(s) failed "
+            "(their step_*.tmp dirs are left behind for inspection)")
+
 
 def _primary() -> bool:
     """Exactly one process owns filesystem mutations (dir staging, npz
@@ -144,6 +175,9 @@ def save_checkpoint(ckpt_dir: str, params: Any, step: int, seeds=None,
     run replays the identical data stream.
     """
     names, leaves, _ = _flatten(params)
+    if backend == "native" and any("/" in n for n in names):
+        raise ValueError("native backend writes one file per leaf; tree "
+                         f"paths may not contain '/': {names}")
     if jax.process_count() > 1 and backend != "orbax":
         # npz gathers through np.asarray, which only works when every
         # process holds the full value; process-spanning shards need the
@@ -159,6 +193,13 @@ def save_checkpoint(ckpt_dir: str, params: Any, step: int, seeds=None,
 
     final = os.path.join(ckpt_dir, f"step_{step}")
     tmp = final + ".tmp"
+    if backend == "native":
+        # unique staging dir per submit: a re-save of the same step must
+        # not race an in-flight worker on the same tmp path (the _STEP_RE
+        # filter hides any crash-leftover .tmp.* dirs from latest_step)
+        global _TMP_SEQ
+        _TMP_SEQ += 1
+        tmp = f"{final}.tmp.{os.getpid()}.{_TMP_SEQ}"
     if _primary():
         os.makedirs(ckpt_dir, exist_ok=True)
         if os.path.exists(tmp):
@@ -172,7 +213,7 @@ def save_checkpoint(ckpt_dir: str, params: Any, step: int, seeds=None,
         # collective: every process writes its addressable shards
         ckptr.save(os.path.join(os.path.abspath(tmp), "arrays"),
                    jax.tree_util.tree_map(_ensure_global_fn(), params))
-    elif _primary():
+    elif backend != "native" and _primary():
         np.savez(os.path.join(tmp, "arrays.npz"),
                  **{n: _to_numpy(l) for n, l in zip(names, leaves)})
     # metadata from array attributes only — no host fetch (multi-host arrays
@@ -188,18 +229,33 @@ def save_checkpoint(ckpt_dir: str, params: Any, step: int, seeds=None,
     if _primary():
         with open(os.path.join(tmp, "meta.json"), "w") as f:
             json.dump(doc, f)
-        old = None
-        if os.path.exists(final):
-            # keep the previous version valid until the new one is
-            # published: move it aside (its .tmp suffix hides it from
-            # latest_step), swap in the new dir, then drop it
-            old = final + ".old.tmp"
-            if os.path.exists(old):
+        if backend == "native":
+            # async: the native worker pool copies the buffers now, writes
+            # the .raw leaves and atomically renames tmp -> final off this
+            # thread (native/ckpt_writer.cpp) — training overlaps the I/O.
+            # Re-publishing the SAME step drops the old version first
+            # (brief no-version window; distinct steps are unaffected).
+            if os.path.exists(final):
+                shutil.rmtree(final)
+            _writer().submit(tmp, final, names,
+                             [_to_numpy(l) for l in leaves])
+            if jax.process_count() > 1:
+                # peers read the step right after the barrier; asynchrony
+                # is a single-host feature
+                wait_pending()
+        else:
+            old = None
+            if os.path.exists(final):
+                # keep the previous version valid until the new one is
+                # published: move it aside (its .tmp suffix hides it from
+                # latest_step), swap in the new dir, then drop it
+                old = final + ".old.tmp"
+                if os.path.exists(old):
+                    shutil.rmtree(old)
+                os.rename(final, old)
+            os.rename(tmp, final)  # atomic publish
+            if old is not None:
                 shutil.rmtree(old)
-            os.rename(final, old)
-        os.rename(tmp, final)  # atomic publish
-        if old is not None:
-            shutil.rmtree(old)
     _sync(f"published-{step}")  # no process proceeds past an unpublished step
     return final
 
@@ -222,6 +278,7 @@ def restore_checkpoint(ckpt_dir: str, target: Any, step: int | None = None,
     if given, is a matching pytree (or single sharding) of placements; each
     leaf is ``device_put`` directly onto it.
     """
+    wait_pending()  # a native-backend save from this process may be in flight
     if step is None:
         step = latest_step(ckpt_dir)
         if step is None:
@@ -259,6 +316,13 @@ def restore_checkpoint(ckpt_dir: str, target: Any, step: int | None = None,
         params = ckptr.restore(os.path.join(os.path.abspath(path), "arrays"),
                                item=target)
         new_leaves = jax.tree_util.tree_leaves(params)
+    elif doc.get("backend") == "native":
+        new_leaves = []
+        for n, dt_name, shape in zip(names, doc["leaf_dtypes"],
+                                     doc["leaf_shapes"]):
+            dt = _np_dtype(dt_name)
+            raw = np.fromfile(os.path.join(path, n + ".raw"), np.uint8)
+            new_leaves.append(raw.view(dt).reshape(shape))
     else:
         dtypes = [_np_dtype(n) for n in doc.get("leaf_dtypes", [])] \
             or [None] * len(names)
@@ -321,6 +385,7 @@ def run_with_checkpointing(train_fn, params, seeds, *args,
                 f"{len(seeds)} seeds do not divide across "
                 f"{seeds_divisor} data shards")
     start = 0
+    wait_pending()  # flush any in-flight native saves before reading state
     if resume and (agreed := _agreed_latest_step(ckpt_dir)) is not None:
         if stateful and agreed > 0:
             # only params are checkpointed: resuming/extending a partly-
@@ -359,5 +424,8 @@ def run_with_checkpointing(train_fn, params, seeds, *args,
         params = train_fn(params, seeds[start:start + n], *args, **kwargs)
         jax.block_until_ready(params)
         start += n
+        # with backend="native" this returns immediately (buffers copied);
+        # the next segment's training overlaps the disk write
         save_checkpoint(ckpt_dir, params, start, seeds, backend=backend)
+    wait_pending()  # durable-on-return contract for the native backend
     return params

@@ -92,6 +92,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="enable checkpoint/resume: save params + seed "
                         "schedule here (per-method subdirs); a re-run with "
                         "the same dir resumes from the latest checkpoint")
+    p.add_argument("--checkpoint_backend",
+                   choices=["npz", "orbax", "native"], default="npz",
+                   help="checkpoint array I/O: npz (portable), orbax "
+                        "(multi-host sharded), native (async C++ writer — "
+                        "training overlaps the disk write)")
     p.add_argument("--checkpoint_every", type=int, default=0,
                    help="save every N steps (0 = final only); for methods "
                         "that shard the seed schedule (2, 3, 5, 7) pick N "
@@ -276,7 +281,7 @@ def main(argv=None) -> int:
                 fn, params, seeds, tokens, args.model_size,
                 ckpt_dir=os.path.join(args.checkpoint_dir, name),
                 every=args.checkpoint_every, resume=not args.no_resume,
-                seeds_divisor=divisor,
+                seeds_divisor=divisor, backend=args.checkpoint_backend,
                 stateful=("optimizer" in kwargs
                           and kwargs["optimizer"].name != "sgd"), **kwargs)
         else:

@@ -72,6 +72,18 @@ def lib() -> ctypes.CDLL:
         _LIB.dlcs_watchdog_expired.restype = ctypes.c_int
         _LIB.dlcs_watchdog_expired.argtypes = [ctypes.c_void_p]
         _LIB.dlcs_watchdog_destroy.argtypes = [ctypes.c_void_p]
+        _LIB.dlcs_ckpt_writer_create.restype = ctypes.c_void_p
+        _LIB.dlcs_ckpt_writer_create.argtypes = [ctypes.c_int]
+        _LIB.dlcs_ckpt_writer_submit.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int]
+        for f in ("dlcs_ckpt_writer_pending", "dlcs_ckpt_writer_errors"):
+            getattr(_LIB, f).restype = ctypes.c_int
+            getattr(_LIB, f).argtypes = [ctypes.c_void_p]
+        _LIB.dlcs_ckpt_writer_wait.argtypes = [ctypes.c_void_p]
+        _LIB.dlcs_ckpt_writer_destroy.argtypes = [ctypes.c_void_p]
     return _LIB
 
 
@@ -329,3 +341,52 @@ def native_relu_bwd(dy, x):
     call = jax.ffi.ffi_call("dlcs_relu_bwd",
                             jax.ShapeDtypeStruct(dy.shape, dy.dtype))
     return call(dy, x)
+
+
+class AsyncCheckpointWriter:
+    """Background checkpoint writes through the native worker pool
+    (``native/ckpt_writer.cpp``): ``submit`` copies the buffers and
+    returns immediately — training on the next segment overlaps the disk
+    write, and the staged directory is atomically renamed to ``final_dir``
+    when complete (the checkpoint subsystem's publish protocol, done
+    natively)."""
+
+    def __init__(self, n_threads: int = 2):
+        self._h = lib().dlcs_ckpt_writer_create(n_threads)
+
+    def submit(self, tmp_dir: str, final_dir: str, names, arrays) -> None:
+        """Queue one checkpoint: write each ``arrays[i]`` (C-contiguous
+        numpy) to ``<tmp_dir>/<names[i]>.raw``, then rename to
+        ``final_dir``. Buffers are copied before returning."""
+        arrays = [np.ascontiguousarray(a) for a in arrays]
+        n = len(arrays)
+        c_names = (ctypes.c_char_p * n)(
+            *[name.encode() for name in names])
+        c_ptrs = (ctypes.c_void_p * n)(
+            *[a.ctypes.data_as(ctypes.c_void_p) for a in arrays])
+        c_sizes = (ctypes.c_int64 * n)(*[a.nbytes for a in arrays])
+        lib().dlcs_ckpt_writer_submit(
+            self._h, tmp_dir.encode(), final_dir.encode(),
+            c_names, c_ptrs, c_sizes, n)
+
+    def pending(self) -> int:
+        return lib().dlcs_ckpt_writer_pending(self._h)
+
+    def wait(self) -> None:
+        """Block until every submitted checkpoint is published."""
+        lib().dlcs_ckpt_writer_wait(self._h)
+
+    def errors(self) -> int:
+        """Failed jobs so far (their tmp dirs are left for inspection)."""
+        return lib().dlcs_ckpt_writer_errors(self._h)
+
+    def close(self) -> None:
+        if self._h is not None:
+            lib().dlcs_ckpt_writer_destroy(self._h)  # drains first
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
