@@ -61,6 +61,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="update rule for --method 2 (DDP): sgd is the "
                         "reference's stateless inline update; momentum/"
                         "adam carry hand-written optimizer state")
+    p.add_argument("--tp_sp", action="store_true",
+                   help="with --method 4: Megatron sequence-parallel TP "
+                        "(token-sharded activations; all_gather + "
+                        "reduce_scatter instead of all_reduce)")
     p.add_argument("--zero1", action="store_true",
                    help="with --method 2: shard the optimizer state "
                         "across the data axis (ZeRO-1; reduce_scatter + "
@@ -143,6 +147,9 @@ def main(argv=None) -> int:
         # methods would silently ignore the flag
         print("error: --accum applies to --method 1 or 2 only",
               file=sys.stderr)
+        return 2
+    if args.tp_sp and args.method != 4:
+        print("error: --tp_sp applies to --method 4 only", file=sys.stderr)
         return 2
     if (args.optimizer != "sgd" or args.zero1) and args.method != 2:
         # methods 0/9 cross-check DDP against strategies that would still
@@ -247,6 +254,9 @@ def main(argv=None) -> int:
             if args.zero1:
                 from .parallel import train_ddp_zero1
                 name, fn = "train_ddp_zero1", train_ddp_zero1
+        if m == 4 and args.tp_sp:
+            from .parallel import train_tp_sp
+            name, fn = "train_tp_sp", train_tp_sp
         if m == 6:
             kwargs = dict(lr=lr, schedule=args.pp_schedule)
             if args.microbatches:

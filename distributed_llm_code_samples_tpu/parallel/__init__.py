@@ -17,7 +17,7 @@ from .single import train_single
 from .ddp import train_ddp
 from .zero1 import train_ddp_zero1
 from .fsdp import train_fsdp
-from .tp import train_tp
+from .tp import train_tp, train_tp_sp
 from .hybrid import train_hybrid
 from .pipeline import train_pp
 from .sequence import (ring_attention, sequence_parallel_attention,
@@ -46,7 +46,7 @@ __all__ = [
     "DATA_AXIS", "MODEL_AXIS", "SEQ_AXIS", "PIPE_AXIS", "EXPERT_AXIS",
     "collectives",
     "train_single", "train_ddp", "train_ddp_zero1", "train_fsdp",
-    "train_tp", "train_hybrid",
+    "train_tp", "train_tp_sp", "train_hybrid",
     "train_pp", "train_moe_ep", "train_moe_dense", "moe_layer_ep",
     "train_transformer_single", "train_transformer_ddp",
     "train_transformer_fsdp", "train_transformer_tp",

@@ -21,13 +21,15 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from jax import lax
+
 from .. import LR
 from ..data import batch_from_seed
 from ..models.ffn_stack import FFNStackParams, reshard_copy
 from ..optim import sgd
 from ..ops.ffn import ffn_fwd, ffn_bwd
 from ..ops.stack import stack_fwd, stack_bwd
-from .collectives import all_reduce
+from .collectives import all_gather, all_reduce, axis_index, reduce_scatter
 from .launcher import launch
 from .mesh import MODEL_AXIS, require_axes
 
@@ -67,6 +69,81 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
         return sgd(params, FFNStackParams(g1, g2), lr)
 
     return step
+
+
+def make_sp_step(batch_size: int, model_size: int, n_shards: int,
+                 lr: float = LR, unroll: bool = True,
+                 axis: str = MODEL_AXIS):
+    """Megatron *sequence-parallel* TP (Korthikanti et al.): between
+    blocks the activation stream lives **token-sharded** (``[T/n, d]``
+    per rank) instead of replicated, and each per-layer-per-direction
+    ``all_reduce`` is replaced by its ring-equal decomposition
+    ``all_gather`` (tokens in) + ``reduce_scatter`` (tokens out) — same
+    bytes on the wire, but every saved residual shrinks by ``n``.
+
+    The backward is hand-threaded through the same hook surface as plain
+    TP: the block backward **re-gathers** its token shard (recompute, not
+    residual — the whole point), gathers the upstream grad (the
+    ``reduce_scatter`` transpose), runs the hand-written block VJP on
+    full tokens, and ``reduce_scatter``s the input grad (the
+    ``all_gather`` transpose — which also sums the partials, the sharded
+    form of ``train_ffns.py:309``'s all_reduce). Weight grads see all
+    tokens, so they are complete per shard, exactly like plain TP."""
+    if batch_size % n_shards:
+        raise ValueError(f"tokens {batch_size} not divisible by "
+                         f"{n_shards} model shards (sequence-parallel TP "
+                         "shards the token dim between blocks)")
+    t_local = batch_size // n_shards
+
+    def block_fwd(w1_shard, w2_shard, x_s):
+        full = all_gather(x_s, axis, dim=0)              # [T, d]
+        part = ffn_fwd(w1_shard, w2_shard, full)         # partial over ffn
+        return reduce_scatter(part, axis, dim=0)         # [T/n, d], summed
+
+    def block_bwd(dy_s, w1_shard, w2_shard, x_s):
+        full = all_gather(x_s, axis, dim=0)      # recomputed, not saved
+        dy_full = all_gather(dy_s, axis, dim=0)  # reduce_scatter transpose
+        dx_full, grads = ffn_bwd(dy_full, w1_shard, w2_shard, full)
+        # all_gather transpose: scatter AND sum the rank-partial dx
+        return reduce_scatter(dx_full, axis, dim=0), grads
+
+    def step(params: FFNStackParams, seed) -> FFNStackParams:
+        x, dloss_dx = batch_from_seed(seed, batch_size, model_size,
+                                      params.w1.dtype)
+        r = axis_index(axis)
+        x_s, dy_s = (lax.dynamic_slice_in_dim(t, r * t_local, t_local, 0)
+                     for t in (x, dloss_dx))
+        # acts holds the SHARDED block inputs — [L, T/n, d], the 1/n
+        # activation-memory claim (structurally asserted in tests)
+        _, acts = stack_fwd(params.w1, params.w2, x_s, block_fwd=block_fwd,
+                            unroll=unroll)
+        _, (g1, g2) = stack_bwd(dy_s, params.w1, params.w2, acts,
+                                block_bwd=block_bwd, unroll=unroll)
+        return sgd(params, FFNStackParams(g1, g2), lr)
+
+    return step
+
+
+def train_tp_sp(params: FFNStackParams, seeds, batch_size: int,
+                model_size: int, mesh, lr: float = LR,
+                unroll: bool = True) -> FFNStackParams:
+    """Sequence-parallel Megatron TP (see ``make_sp_step``). Data is
+    replicated like plain TP (each rank regenerates the step's batch and
+    slices its token block), so ``train_tp_sp == train_tp == single`` —
+    the decomposition changes memory and comms shape, never the math."""
+    require_axes(mesh, MODEL_AXIS)
+    n = mesh.shape[MODEL_AXIS]
+    if params.w1.shape[1] % n:
+        raise ValueError(f"ffn_dim {params.w1.shape[1]} not divisible by "
+                         f"{n} model shards")
+    params = shard_params(params, mesh)
+    step = make_sp_step(batch_size, model_size, n, lr, unroll)
+
+    # check_vma off: reduce_scatter of a varying partial and the final
+    # replicated-params claim mirror zero1's situation (launcher.launch)
+    return launch(step, params, jnp.asarray(seeds), mesh,
+                  param_specs=PARAM_SPECS, seed_spec=P(),
+                  check_vma=False)
 
 
 def train_tp(params: FFNStackParams, seeds, batch_size: int,
