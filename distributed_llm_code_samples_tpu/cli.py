@@ -62,8 +62,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "reference's stateless inline update; momentum/"
                         "adam carry hand-written optimizer state")
     p.add_argument("--tp_sp", action="store_true",
-                   help="with --method 4: Megatron sequence-parallel TP "
-                        "(token-sharded activations; all_gather + "
+                   help="with --method 4 or 8: Megatron sequence-parallel "
+                        "TP (token-sharded activations; all_gather + "
                         "reduce_scatter instead of all_reduce)")
     p.add_argument("--zero1", action="store_true",
                    help="with --method 2: shard the optimizer state "
@@ -148,8 +148,9 @@ def main(argv=None) -> int:
         print("error: --accum applies to --method 1 or 2 only",
               file=sys.stderr)
         return 2
-    if args.tp_sp and args.method != 4:
-        print("error: --tp_sp applies to --method 4 only", file=sys.stderr)
+    if args.tp_sp and args.method not in (4, 8):
+        print("error: --tp_sp applies to --method 4 or 8 only",
+              file=sys.stderr)
         return 2
     if (args.optimizer != "sgd" or args.zero1) and args.method != 2:
         # methods 0/9 cross-check DDP against strategies that would still
@@ -265,6 +266,8 @@ def main(argv=None) -> int:
             kwargs = dict(lr=lr)  # EP's expert loop has its own structure
         if m == 8:
             kwargs = dict(lr=lr, seq_len=args.seq_len, n_heads=args.heads)
+            if args.tp_sp:
+                kwargs["sequence_parallel"] = True
         if m == 1 and args.pallas:
             kwargs["use_pallas"] = True
             kwargs["interpret"] = jax.default_backend() != "tpu"

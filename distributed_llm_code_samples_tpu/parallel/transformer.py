@@ -40,7 +40,8 @@ from ..models.transformer import (TransformerParams, attn_sublayer,
 from ..ops.ffn import ffn_block
 from ..ops.norm import layernorm
 from ..optim import sgd
-from .collectives import all_gather, all_reduce, axis_index, grad_reduce
+from .collectives import (all_gather, all_reduce, axis_index, grad_reduce,
+                          reduce_scatter)
 from .launcher import launch
 from .mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS, require_axes
 
@@ -241,6 +242,31 @@ def tp_block(ln1, wq, wk, wv, wo, ln2, w1, w2, x, n_heads_local: int,
     return x + y.reshape(b, s, d)
 
 
+def sp_block(ln1, wq, wk, wv, wo, ln2, w1, w2, x_s, n_heads_local: int,
+             axis: str = MODEL_AXIS, causal: bool = True, attn=None):
+    """One sequence-parallel TP transformer block (Korthikanti et al.),
+    per-shard view: ``x_s [b, s/n, d]`` — the residual stream, LayerNorms,
+    and both residual adds live on this rank's **token shard**; only the
+    sublayer cores see full tokens, via ``all_gather`` (sequence in) +
+    ``reduce_scatter`` (sequence out) — the ring-equal decomposition of
+    ``tp_block``'s two ``psum``s, with every stream activation 1/n the
+    size. The gathers/scatters differentiate by their exact transposes
+    (gather <-> scatter+sum), composed by ``jax.vjp`` around the
+    hand-written sublayer rules; the ``_f_gate`` is subsumed — the
+    backward's ``reduce_scatter`` already sums the column-parallel
+    projections' partial input-grads."""
+    g = lambda t: all_gather(t, axis, dim=1)           # noqa: E731
+    rs = lambda t: reduce_scatter(t, axis, dim=1)      # noqa: E731
+    b, s_local, d = x_s.shape
+    a = g(layernorm(ln1, x_s))                          # [b, s, d] full
+    x_s = x_s + rs(
+        attn_sublayer(wq, wk, wv, wo, a, n_heads_local, causal, attn))
+    h = g(layernorm(ln2, x_s))
+    full_tokens = b * s_local * lax.axis_size(axis)
+    y = rs(ffn_block(w1, w2, h.reshape(full_tokens, d)).reshape(b, -1, d))
+    return x_s + y
+
+
 def _validate_tp(params, n_heads: int, n: int) -> int:
     if n_heads % n:
         raise ValueError(f"n_heads={n_heads} not divisible by model-axis "
@@ -255,37 +281,77 @@ def _validate_tp(params, n_heads: int, n: int) -> int:
 def train_transformer_tp(params: TransformerParams, seeds, batch_size: int,
                          model_size: int, mesh, lr: float = LR, *,
                          seq_len: int, n_heads: int, causal: bool = True,
-                         attn_impl: str | None = None) -> TransformerParams:
+                         attn_impl: str | None = None,
+                         sequence_parallel: bool = False
+                         ) -> TransformerParams:
     """Megatron TP over the ``"model"`` axis: data replicated, heads and
     FFN features sharded, two psums per block per direction
-    (``train_ffns.py:303, :309`` cadence on the transformer block)."""
+    (``train_ffns.py:303, :309`` cadence on the transformer block).
+
+    ``sequence_parallel=True`` selects the Korthikanti et al. form
+    (``sp_block``): the residual stream, LayerNorms, and dropout-free
+    elementwise work live token-sharded (``[b, s/n, d]``), each psum
+    decomposed into ``all_gather`` + ``reduce_scatter``. Same math
+    (differential-tested against this trainer's plain form and the
+    single-device oracle), 1/n the stream activations. LN gains then see
+    only the shard's tokens, so their grads pick up one ``psum`` over the
+    model axis; projection/FFN grads stay shard-complete."""
     require_axes(mesh, MODEL_AXIS)
     n = mesh.shape[MODEL_AXIS]
     h_local = _validate_tp(params, n_heads, n)
     _validate_shapes(batch_size, seq_len, model_size, n_heads)
-    attn = resolve_attn(attn_impl)
+    step = make_tp_step(batch_size, model_size, seq_len, h_local, n, lr,
+                        causal, resolve_attn(attn_impl), sequence_parallel)
+    return launch(step, _shard(params, mesh, TP_SPECS), jnp.asarray(seeds),
+                  mesh, param_specs=TP_SPECS, seed_spec=P())
+
+
+def make_tp_step(batch_size: int, model_size: int, seq_len: int,
+                 h_local: int, n_shards: int, lr: float = LR,
+                 causal: bool = True, attn=None,
+                 sequence_parallel: bool = False):
+    """One TP step for one shard — the shared builder behind
+    ``train_transformer_tp`` (tests shard_map this directly to pin the
+    comms schedule against the real implementation)."""
+    if sequence_parallel and seq_len % n_shards:
+        raise ValueError(f"seq_len={seq_len} not divisible by model-axis "
+                         f"size {n_shards} (sequence-parallel TP shards "
+                         "tokens)")
+    t_local = seq_len // n_shards if sequence_parallel else seq_len
+    block = sp_block if sequence_parallel else tp_block
 
     def step(params: TransformerParams, seed) -> TransformerParams:
         x, dloss_dx = _reshape_batch(seed, batch_size, seq_len, model_size,
                                      params.w1.dtype)
+        if sequence_parallel:
+            r = axis_index(MODEL_AXIS)
+            x, dloss_dx = (
+                lax.dynamic_slice_in_dim(t, r * t_local, t_local, 1)
+                for t in (x, dloss_dx))
 
         def fwd(p):
             y = x
             for l in range(p.w1.shape[0]):
-                y = tp_block(p.ln1[l], p.wq[l], p.wk[l], p.wv[l], p.wo[l],
-                             p.ln2[l], p.w1[l], p.w2[l], y, h_local,
-                             causal=causal, attn=attn)
+                y = block(p.ln1[l], p.wq[l], p.wk[l], p.wv[l], p.wo[l],
+                          p.ln2[l], p.w1[l], p.w2[l], y, h_local,
+                          causal=causal, attn=attn)
             return y
 
         _, vjp = jax.vjp(fwd, params)
         grads = vjp(dloss_dx)[0]
+        if sequence_parallel:
+            # LN gains saw only this shard's tokens: sum over the model
+            # axis. Everything else saw full (gathered) tokens and is
+            # complete per shard.
+            grads = grads._replace(
+                ln1=grad_reduce(grads.ln1, MODEL_AXIS),
+                ln2=grad_reduce(grads.ln2, MODEL_AXIS))
         # projection/FFN grads are shard-local (each shard owns its heads/
-        # features); LN grads replicate — data and dx are identical on all
-        # shards after the f-gate psums, so no further reduction is needed
+        # features); in the plain form LN grads replicate — data and dx
+        # are identical on all shards after the f-gate psums
         return sgd(params, grads, lr)
 
-    return launch(step, _shard(params, mesh, TP_SPECS), jnp.asarray(seeds),
-                  mesh, param_specs=TP_SPECS, seed_spec=P())
+    return step
 
 
 def train_transformer_seq(params: TransformerParams, seeds,

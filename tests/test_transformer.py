@@ -321,3 +321,51 @@ def test_seq_parallel_validations(params):
         train_transformer_seq(params, seeds, 2 * T, D,
                               make_mesh({SEQ_AXIS: 8}), seq_len=T,
                               n_heads=H, seq_impl="ulysses")
+
+
+# --- Sequence-parallel TP (Korthikanti et al.) ----------------------------
+
+def test_tp_sequence_parallel_matches_plain_and_single(params):
+    """sp_block's gather/scatter decomposition == tp_block's psums ==
+    single device: memory/comms shape changes, math doesn't."""
+    seeds = make_seed_schedule(4, random_seed=33)
+    single = train_transformer_single(params, seeds, TOKENS, D, lr=0.05,
+                                      seq_len=T, n_heads=H)
+    mesh = make_mesh({MODEL_AXIS: 4})
+    plain = train_transformer_tp(params, seeds, TOKENS, D, mesh, lr=0.05,
+                                 seq_len=T, n_heads=H)
+    sp = train_transformer_tp(params, seeds, TOKENS, D, mesh, lr=0.05,
+                              seq_len=T, n_heads=H, sequence_parallel=True)
+    for name, a, b, c in zip(TransformerParams._fields, sp, plain, single):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4,
+                                   atol=1e-5, err_msg=f"sp vs tp: {name}")
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c), rtol=2e-4,
+                                   atol=1e-5, err_msg=f"sp vs 1dev: {name}")
+
+
+def test_tp_sequence_parallel_comms(params):
+    """The stream psums are gone: only the LN-grad reductions remain as
+    all_reduce; the sublayer boundaries carry all_gather/reduce_scatter.
+    Pinned against the trainer's own step builder (make_tp_step), not a
+    re-implementation."""
+    from distributed_llm_code_samples_tpu.parallel import transformer as tf
+    from distributed_llm_code_samples_tpu.utils.hlo import count_collectives
+    from jax.sharding import PartitionSpec as P
+    mesh = make_mesh({MODEL_AXIS: 4})
+    sp = tf._shard(params, mesh, tf.TP_SPECS)
+    step = tf.make_tp_step(TOKENS, D, T, H // 4, 4, lr=0.05,
+                           sequence_parallel=True)
+    run = jax.shard_map(step, mesh=mesh, in_specs=(tf.TP_SPECS, P()),
+                        out_specs=tf.TP_SPECS)
+    c = count_collectives(run, sp, jnp.int32(3))
+    assert c["all_reduce"] <= 2, dict(c)         # LN grad sums only
+    assert c["all_gather"] >= 2 * L, dict(c)     # fwd gathers + transposes
+    assert c["reduce_scatter"] >= 2 * L, dict(c)
+
+
+def test_tp_sequence_parallel_rejects_indivisible_seq(params):
+    seeds = make_seed_schedule(1, random_seed=1)
+    mesh = make_mesh({MODEL_AXIS: 4})
+    with pytest.raises(ValueError, match="seq_len"):
+        train_transformer_tp(params, seeds, 2 * 18, D, mesh, seq_len=18,
+                             n_heads=H, sequence_parallel=True)
