@@ -42,6 +42,17 @@ from .mesh import SEQ_AXIS, require_axes
 _NEG = -1e30  # finite -inf stand-in: keeps the online-softmax updates NaN-free
 
 
+def _varying_like(t, ref, axis_name: str):
+    """Type ``t`` as shard-varying over every axis ``ref`` varies on plus
+    the ring axis — so fori_loop carries typecheck under shard_map's vma
+    analysis on any mesh (a 2-D data x seq mesh adds "data" to the q/k/v
+    blocks' vma; casting to the ring axis alone would drift after one
+    fold)."""
+    need = tuple(a for a in (jax.typeof(ref).vma | {axis_name})
+                 if a not in jax.typeof(t).vma)
+    return lax.pcast(t, need, to="varying") if need else t
+
+
 def _ring_fwd_core(q, k, v, axis_name: str, causal: bool):
     """One shard's forward ring; returns ``(y, lse)`` where ``lse`` is the
     per-row logsumexp of the full (masked) score matrix — the only softmax
@@ -71,14 +82,9 @@ def _ring_fwd_core(q, k, v, axis_name: str, causal: bool):
         v_blk = lax.ppermute(v_blk, axis_name, perm)
         return k_blk, v_blk, m_new, l, acc
 
-    # mark the accumulators shard-varying so the fori_loop carry typechecks
-    # under shard_map's varying-manual-axes analysis
-    def _varying(t):
-        return lax.pcast(t, axis_name, to="varying")
-
-    m0 = _varying(jnp.full((t_local,), _NEG, jnp.float32))
-    l0 = _varying(jnp.zeros((t_local,), jnp.float32))
-    acc0 = _varying(jnp.zeros((t_local, d), jnp.float32))
+    m0 = _varying_like(jnp.full((t_local,), _NEG, jnp.float32), q, axis_name)
+    l0 = _varying_like(jnp.zeros((t_local,), jnp.float32), q, axis_name)
+    acc0 = _varying_like(jnp.zeros((t_local, d), jnp.float32), q, axis_name)
     *_, m, l, acc = lax.fori_loop(0, n, step, (k, v, m0, l0, acc0))
     return (acc / l[:, None]).astype(q.dtype), m + jnp.log(l)
 
@@ -133,10 +139,7 @@ def _ring_attention_bwd(axis_name, causal, res, dy):
         dv = lax.ppermute(dv, axis_name, perm)
         return k_blk, v_blk, dk, dv, dq
 
-    def _varying(t):
-        return lax.pcast(t, axis_name, to="varying")
-
-    zeros = _varying(jnp.zeros((t_local, d), jnp.float32))
+    zeros = _varying_like(jnp.zeros((t_local, d), jnp.float32), q, axis_name)
     *_, dk, dv, dq = lax.fori_loop(0, n, step,
                                    (k, v, zeros, zeros, zeros))
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)

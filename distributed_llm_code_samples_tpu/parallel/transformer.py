@@ -371,17 +371,24 @@ def train_transformer_seq(params: TransformerParams, seeds,
     full ``[T, T]`` score matrix — or, for the ring, even the full
     sequence of activations.
 
-    Data is replicated like TP (every shard generates the step's full
-    batch from the seed and slices its own token block — global causal
-    positions stay exact); weight grads are per-shard partials over the
-    token dim, summed with one ``psum`` per step (SUM, unscaled LR,
-    ``train_ffns.py:165`` semantics). Differential guarantee:
-    ``train_transformer_seq == train_transformer_single`` on the same
-    schedule, both impls (tests/test_transformer.py).
+    Within a data replica, data is replicated like TP (every seq shard
+    generates the step's full batch from the seed and slices its own
+    token block — global causal positions stay exact); weight grads are
+    per-shard partials over the token dim, summed with one ``psum`` per
+    step (SUM, unscaled LR, ``train_ffns.py:165`` semantics).
+
+    A 2-D ``(data, seq)`` mesh composes long context with data
+    parallelism: the seed schedule shards strided over ``data`` (each
+    data replica trains its own steps, DDP-style) while each replica's
+    sequence shards over ``seq`` — the grad psum then rides both axes.
+    Differential guarantees (tests/test_transformer.py):
+    seq-only == ``train_transformer_single``; data x seq ==
+    ``train_transformer_ddp`` over the data axis alone.
     """
     from .sequence import ring_attention, ulysses_attention
     require_axes(mesh, SEQ_AXIS)
     n = mesh.shape[SEQ_AXIS]
+    dp = dict(mesh.shape).get(DATA_AXIS, 1)
     _validate_shapes(batch_size, seq_len, model_size, n_heads)
     if seq_len % n:
         raise ValueError(f"seq_len={seq_len} not divisible by seq-axis "
@@ -414,11 +421,23 @@ def train_transformer_seq(params: TransformerParams, seeds,
         _, vjp = jax.vjp(
             lambda p: transformer_fwd(p, x, n_heads, causal, attn), params)
         grads = vjp(dloss_dx)[0]
-        # weight grads are partial sums over this shard's tokens
-        grads = jax.tree_util.tree_map(
-            lambda g: grad_reduce(g, SEQ_AXIS), grads)
+        # weight grads are partial sums over this shard's tokens — and,
+        # on a 2-D mesh, over the data replicas (DDP semantics). One
+        # fused psum over both axes per leaf, not one per axis.
+        axes = (SEQ_AXIS, DATA_AXIS) if dp > 1 else (SEQ_AXIS,)
+
+        def reduce_leaf(g):
+            pending = tuple(a for a in axes if a in jax.typeof(g).vma)
+            return lax.psum(g, pending) if pending else g
+
+        grads = jax.tree_util.tree_map(reduce_leaf, grads)
         return sgd(params, grads, lr)
 
+    if dp > 1:
+        seed_cols = shard_seeds_strided(seeds, dp)
+        return launch(step, clone_params(params), seed_cols, mesh,
+                      param_specs=P(), seed_spec=P(None, DATA_AXIS),
+                      select_local=lambda s: s[:, 0])
     return launch(step, clone_params(params), jnp.asarray(seeds), mesh,
                   param_specs=P(), seed_spec=P())
 

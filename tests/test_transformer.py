@@ -369,3 +369,23 @@ def test_tp_sequence_parallel_rejects_indivisible_seq(params):
     with pytest.raises(ValueError, match="seq_len"):
         train_transformer_tp(params, seeds, 2 * 18, D, mesh, seq_len=18,
                              n_heads=H, sequence_parallel=True)
+
+
+@pytest.mark.parametrize("seq_impl", ["ring", "ulysses"])
+def test_seq_parallel_composes_with_data_parallel(params, seq_impl):
+    """2-D data x seq mesh: each data replica trains its own strided
+    steps with its sequence ring/a2a-sharded; grads psum over both axes.
+    Must equal plain DDP over the data axis alone (sp is exact within a
+    replica)."""
+    from distributed_llm_code_samples_tpu.parallel import (
+        SEQ_AXIS, train_transformer_seq)
+    seeds = make_seed_schedule(4, random_seed=37)
+    ddp = train_transformer_ddp(params, seeds, TOKENS, D,
+                                make_mesh({DATA_AXIS: 2}), lr=0.05,
+                                seq_len=T, n_heads=H)
+    mesh = make_mesh({DATA_AXIS: 2, SEQ_AXIS: 4})
+    got = train_transformer_seq(params, seeds, TOKENS, D, mesh, lr=0.05,
+                                seq_len=T, n_heads=H, seq_impl=seq_impl)
+    for name, a, b in zip(TransformerParams._fields, got, ddp):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4,
+                                   atol=1e-5, err_msg=name)
