@@ -48,8 +48,9 @@ def reduce_scatter(x, axis_name: str, *, dim: int = 0):
     return lax.psum_scatter(x, axis_name, scatter_dimension=dim, tiled=True)
 
 
-def grad_reduce(g, axis_name: str):
-    """Sum a *gradient* across the axis iff it is still a partial sum.
+def grad_reduce(g, axis_name):
+    """Sum a *gradient* across one axis (or a tuple of axes, one fused
+    ``psum``) iff it is still a partial sum there.
 
     Under JAX's varying-manual-axes (vma) typing, a cotangent's provenance
     decides its state: transposes of plain ops auto-reduce cotangents onto
@@ -61,9 +62,9 @@ def grad_reduce(g, axis_name: str):
     the former — grads scale by the axis size. The check is static at
     trace time.
     """
-    if axis_name in jax.typeof(g).vma:
-        return lax.psum(g, axis_name)
-    return g
+    axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+    pending = tuple(a for a in axes if a in jax.typeof(g).vma)
+    return lax.psum(g, pending) if pending else g
 
 
 def all_to_all(x, axis_name: str, *, split_dim: int, concat_dim: int):

@@ -1,5 +1,6 @@
 """Pipeline parallelism: layers staged across the ``"pipe"`` axis, with
-hand-rolled ``ppermute`` send/recv and two microbatch schedules.
+hand-rolled ``ppermute`` send/recv, two microbatch schedules, and optional
+data/tensor axes — the full 3-D composition.
 
 The reference has **no** pipeline parallelism and no point-to-point
 send/recv anywhere (SURVEY.md section 2.2) — but the driver's BASELINE
@@ -37,10 +38,22 @@ ring shifts. Stage 0 injects inputs, the last stage injects
 the last stage starts each microbatch's backward from its own
 locally-generated slice — no loss broadcast.
 
-Gradient semantics are exact under both schedules: microbatch
-weight-grads sum to the full-batch grad, so PP's final params equal the
-single-device run's bit-for-tolerance (differential tests assert this).
-Weight grads never cross stages; each stage runs SGD on its own layers
+**3-D composition**: give ``train_pp`` a mesh with ``"data"`` and/or
+``"model"`` axes alongside ``"pipe"`` and it becomes full 3-D
+parallelism. The ``data`` axis replicates the pipeline, strides the seed
+schedule DDP-style, and sums weight grads with one ``psum`` per step;
+the ``model`` axis Megatron-shards each stage's layers (column/row
+conjugate chunks, one ``psum`` per layer per direction *inside* the
+stage compute, riding an axis orthogonal to the pipe ring). Under
+shard_map's vma typing, all schedule carries are normalized to vary over
+every participating axis (``_vary_to``), since the wavefront state mixes
+pipe-varying indices with data-varying batches and model-varying shards.
+
+Gradient semantics are exact under both schedules and all compositions:
+microbatch weight-grads sum to the full-batch grad, so PP's final params
+equal the single-device run's (and dp x pp [x tp] equals DDP over the
+data axis alone) — differential tests assert every composition. Weight
+grads never cross stages; each stage runs SGD on its own layers
 (``train_ffns.py:311-312`` locality, transplanted to the layer dimension).
 """
 
@@ -52,44 +65,66 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import LR
-from ..data import batch_from_seed
+from ..data import batch_from_seed, shard_seeds_strided
 from ..models.ffn_stack import FFNStackParams, reshard_copy
 from ..optim import sgd
+from ..ops.ffn import ffn_fwd, ffn_bwd
 from ..ops.stack import stack_fwd, stack_bwd
-from .collectives import ring_shift, axis_index, barrier
+from .collectives import all_reduce, ring_shift, axis_index, barrier
 from .launcher import launch
-from .mesh import PIPE_AXIS, require_axes
+from .mesh import DATA_AXIS, MODEL_AXIS, PIPE_AXIS, require_axes
 
 # Layers are staged: stacked layer axis sharded across the pipe ring.
 PARAM_SPECS = FFNStackParams(w1=P(PIPE_AXIS, None, None),
                              w2=P(PIPE_AXIS, None, None))
+# With a model axis, each stage's layers are additionally Megatron-sharded
+# (w1 column-parallel on ffn, w2 row-parallel on ffn — tp.py's layout).
+PARAM_SPECS_TP = FFNStackParams(w1=P(PIPE_AXIS, MODEL_AXIS, None),
+                                w2=P(PIPE_AXIS, None, MODEL_AXIS))
 
 SCHEDULES = ("gpipe", "1f1b")
 
 
-def shard_params(params: FFNStackParams, mesh) -> FFNStackParams:
+def shard_params(params: FFNStackParams, mesh,
+                 specs: FFNStackParams = PARAM_SPECS) -> FFNStackParams:
     return reshard_copy(params, FFNStackParams(
-        w1=NamedSharding(mesh, PARAM_SPECS.w1),
-        w2=NamedSharding(mesh, PARAM_SPECS.w2)))
+        w1=NamedSharding(mesh, specs.w1),
+        w2=NamedSharding(mesh, specs.w2)))
 
 
-def _vzeros(shape, dtype, axis: str):
-    """Zeros typed as *varying* over the pipe axis, so idle ``cond``/
-    ``switch`` branches match the compute branches' vma types."""
-    return lax.pvary(jnp.zeros(shape, dtype), (axis,))
+def _vary_to(t, vary_axes):
+    """Normalize ``t`` to vary over ``vary_axes``: schedule carries and
+    ``cond``/``switch`` branch outputs must share one vma type even
+    though their ingredients vary over different axis subsets (pipe
+    indices, data batches, model shards)."""
+    need = tuple(a for a in vary_axes if a not in jax.typeof(t).vma)
+    return lax.pcast(t, need, to="varying") if need else t
+
+
+def _vzeros(shape, dtype, vary_axes):
+    return _vary_to(jnp.zeros(shape, dtype), vary_axes)
+
+
+def _vary_tree(tree, vary_axes):
+    """``_vary_to`` over a pytree — normalizes a schedule branch's whole
+    output tuple in one place for both schedules."""
+    return jax.tree_util.tree_map(lambda t: _vary_to(t, vary_axes), tree)
 
 
 def _gpipe_step(params: FFNStackParams, x_mb, dy_mb, s, M: int, S: int,
-                axis: str):
+                axis: str, vary_axes, block_fwd, block_bwd):
     """GPipe: forward wavefront, fence, backward wavefront."""
     mb, d = x_mb.shape[1:]
     dtype = x_mb.dtype
     ticks = M + S - 1
     n_local = params.w1.shape[0]
 
+    def vary(tree):
+        return _vary_tree(tree, vary_axes)
+
     # ---- forward wavefront: activation streams +1 around the ring ----
-    state = _vzeros((mb, d), dtype, axis)
-    stash = _vzeros((M, n_local, mb, d), dtype, axis)
+    state = _vzeros((mb, d), dtype, vary_axes)
+    stash = _vzeros((M, n_local, mb, d), dtype, vary_axes)
     for t in range(ticks):
         m = t - s  # this stage's microbatch this tick (traced: s varies)
         valid = (m >= 0) & (m < M)
@@ -98,11 +133,12 @@ def _gpipe_step(params: FFNStackParams, x_mb, dy_mb, s, M: int, S: int,
         inp = jnp.where(s == 0, x_mb[min(t, M - 1)], state)
 
         def fwd_branch(stash):
-            y, acts = stack_fwd(params.w1, params.w2, inp)
-            return stash.at[mc].set(acts), y
+            y, acts = stack_fwd(params.w1, params.w2, inp,
+                                block_fwd=block_fwd)
+            return vary((stash.at[mc].set(acts), y))
 
         def fwd_idle(stash):
-            return stash, _vzeros((mb, d), dtype, axis)
+            return stash, _vzeros((mb, d), dtype, vary_axes)
 
         # bubble ticks skip the block compute entirely (idle branch), they
         # don't compute-and-mask
@@ -115,9 +151,9 @@ def _gpipe_step(params: FFNStackParams, x_mb, dy_mb, s, M: int, S: int,
     stash = barrier(stash, axis)
 
     # ---- backward wavefront: grads stream -1 around the ring ----
-    dstate = _vzeros((mb, d), dtype, axis)
-    g1 = _vzeros(params.w1.shape, params.w1.dtype, axis)
-    g2 = _vzeros(params.w2.shape, params.w2.dtype, axis)
+    dstate = _vzeros((mb, d), dtype, vary_axes)
+    g1 = _vzeros(params.w1.shape, params.w1.dtype, vary_axes)
+    g2 = _vzeros(params.w2.shape, params.w2.dtype, vary_axes)
     for u in range(ticks):
         m = u - (S - 1) + s  # stage s backward-processes microbatch m
         valid = (m >= 0) & (m < M)
@@ -127,11 +163,11 @@ def _gpipe_step(params: FFNStackParams, x_mb, dy_mb, s, M: int, S: int,
         def bwd_branch(carry):
             g1, g2 = carry
             dx, (dg1, dg2) = stack_bwd(dy_in, params.w1, params.w2,
-                                       stash[mc])
-            return (g1 + dg1, g2 + dg2), dx
+                                       stash[mc], block_bwd=block_bwd)
+            return vary(((g1 + dg1, g2 + dg2), dx))
 
         def bwd_idle(carry):
-            return carry, _vzeros((mb, d), dtype, axis)
+            return carry, _vzeros((mb, d), dtype, vary_axes)
 
         (g1, g2), dx = lax.cond(valid, bwd_branch, bwd_idle, (g1, g2))
         dstate = ring_shift(dx, axis, shift=-1)
@@ -140,7 +176,7 @@ def _gpipe_step(params: FFNStackParams, x_mb, dy_mb, s, M: int, S: int,
 
 
 def _1f1b_step(params: FFNStackParams, x_mb, dy_mb, s, M: int, S: int,
-               axis: str):
+               axis: str, vary_axes, block_fwd, block_bwd):
     """1F1B: one slot stream; stage ``s`` forwards microbatch ``m`` at slot
     ``s + 2m`` and backwards it at slot ``2S - 1 - s + 2m``. The two land
     on opposite slot parities per stage, so every slot is exactly one of
@@ -153,11 +189,14 @@ def _1f1b_step(params: FFNStackParams, x_mb, dy_mb, s, M: int, S: int,
     n_local = params.w1.shape[0]
     K = min(S, M)  # in-flight microbatches per stage — the 1F1B bound
 
-    state_f = _vzeros((mb, d), dtype, axis)  # activation arriving from s-1
-    state_b = _vzeros((mb, d), dtype, axis)  # gradient arriving from s+1
-    stash = _vzeros((K, n_local, mb, d), dtype, axis)
-    g1 = _vzeros(params.w1.shape, params.w1.dtype, axis)
-    g2 = _vzeros(params.w2.shape, params.w2.dtype, axis)
+    def vary(tree):
+        return _vary_tree(tree, vary_axes)
+
+    state_f = _vzeros((mb, d), dtype, vary_axes)  # activation from s-1
+    state_b = _vzeros((mb, d), dtype, vary_axes)  # gradient from s+1
+    stash = _vzeros((K, n_local, mb, d), dtype, vary_axes)
+    g1 = _vzeros(params.w1.shape, params.w1.dtype, vary_axes)
+    g2 = _vzeros(params.w2.shape, params.w2.dtype, vary_axes)
 
     for tau in range(2 * (M + S - 1)):
         mf = (tau - s) // 2  # fwd microbatch, live when (tau - s) is even
@@ -172,21 +211,22 @@ def _1f1b_step(params: FFNStackParams, x_mb, dy_mb, s, M: int, S: int,
 
         def idle(carry):
             stash, g1, g2 = carry
-            z = _vzeros((mb, d), dtype, axis)
+            z = _vzeros((mb, d), dtype, vary_axes)
             return stash, g1, g2, z, z
 
         def fwd_branch(carry):
             stash, g1, g2 = carry
-            y, acts = stack_fwd(params.w1, params.w2, inp)
-            return (stash.at[mfc % K].set(acts), g1, g2, y,
-                    _vzeros((mb, d), dtype, axis))
+            y, acts = stack_fwd(params.w1, params.w2, inp,
+                                block_fwd=block_fwd)
+            return vary((stash.at[mfc % K].set(acts), g1, g2, y,
+                         jnp.zeros((mb, d), dtype)))
 
         def bwd_branch(carry):
             stash, g1, g2 = carry
             dx, (dg1, dg2) = stack_bwd(dy_in, params.w1, params.w2,
-                                       stash[mbc % K])
-            return (stash, g1 + dg1, g2 + dg2,
-                    _vzeros((mb, d), dtype, axis), dx)
+                                       stash[mbc % K], block_bwd=block_bwd)
+            return vary((stash, g1 + dg1, g2 + dg2,
+                         jnp.zeros((mb, d), dtype), dx))
 
         which = jnp.where(f_valid, 1, jnp.where(b_valid, 2, 0))
         stash, g1, g2, y, dx = lax.switch(
@@ -199,8 +239,14 @@ def _1f1b_step(params: FFNStackParams, x_mb, dy_mb, s, M: int, S: int,
 
 def make_step(batch_size: int, model_size: int, n_stages: int,
               n_microbatches: int, lr: float = LR, axis: str = PIPE_AXIS,
-              schedule: str = "gpipe"):
-    """One PP step for one stage (local views: ``w1 [L/S, ffn, d]``)."""
+              schedule: str = "gpipe", data_axis: str | None = None,
+              model_axis: str | None = None):
+    """One PP step for one stage (local views: ``w1 [L/S, ffn(/n), d]``).
+
+    ``data_axis`` strides the batch DDP-style (the seed arriving here is
+    already this replica's column) and psums weight grads; ``model_axis``
+    runs each block Megatron-sharded with one ``psum`` per layer per
+    direction inside the stage (``tp.py`` semantics on the pipe ring)."""
     S, M = n_stages, n_microbatches
     if batch_size % M:
         raise ValueError(f"tokens {batch_size} not divisible by "
@@ -210,6 +256,19 @@ def make_step(batch_size: int, model_size: int, n_stages: int,
                          f"(expected one of {SCHEDULES})")
     mb = batch_size // M
     sched = _gpipe_step if schedule == "gpipe" else _1f1b_step
+    vary_axes = tuple(a for a in (axis, data_axis, model_axis) if a)
+
+    if model_axis is None:
+        block_fwd, block_bwd = ffn_fwd, ffn_bwd
+    else:
+        def block_fwd(w1_shard, w2_shard, x):
+            # Megatron g: partial y per model shard, then psum — the TP
+            # reduction rides the model axis inside the stage compute
+            return all_reduce(ffn_fwd(w1_shard, w2_shard, x), model_axis)
+
+        def block_bwd(dy, w1_shard, w2_shard, x):
+            dx, grads = ffn_bwd(dy, w1_shard, w2_shard, x)
+            return all_reduce(dx, model_axis), grads
 
     def step(params: FFNStackParams, seed) -> FFNStackParams:
         s = axis_index(axis)
@@ -217,8 +276,14 @@ def make_step(batch_size: int, model_size: int, n_stages: int,
                                       params.w1.dtype)
         x_mb = x.reshape(M, mb, model_size)
         dy_mb = dloss_dx.reshape(M, mb, model_size)
-        g1, g2 = sched(params, x_mb, dy_mb, s, M, S, axis)
-        # per-stage SGD on the stage's own layers
+        g1, g2 = sched(params, x_mb, dy_mb, s, M, S, axis, vary_axes,
+                       block_fwd, block_bwd)
+        if data_axis is not None:
+            # DDP reduction across pipeline replicas (SUM, unscaled LR,
+            # train_ffns.py:165 semantics)
+            g1 = all_reduce(g1, data_axis)
+            g2 = all_reduce(g2, data_axis)
+        # per-stage SGD on the stage's own layers (and model shard)
         return sgd(params, FFNStackParams(g1, g2), lr)
 
     return step
@@ -228,18 +293,34 @@ def train_pp(params: FFNStackParams, seeds, batch_size: int,
              model_size: int, mesh, lr: float = LR,
              n_microbatches: int | None = None,
              schedule: str = "gpipe") -> FFNStackParams:
-    """Run the full PP schedule. Data (seeds) is replicated — every stage
-    regenerates the step's batch locally and uses the slice of the
-    wavefront that is its own, so PP consumes the same steps as the
-    single-device run and must agree with it numerically."""
+    """Run the full PP schedule over ``mesh``. A pure ``("pipe",)`` mesh
+    replicates the data (every stage regenerates the step's batch and
+    consumes its own slice of the wavefront), so PP equals the
+    single-device run. Adding ``"data"`` and/or ``"model"`` axes gives
+    dp x pp x tp — 3-D parallelism — which equals DDP over the data axis
+    alone (differential tests pin every composition)."""
     require_axes(mesh, PIPE_AXIS)
-    S = mesh.shape[PIPE_AXIS]
+    shape = dict(mesh.shape)
+    S = shape[PIPE_AXIS]
+    dp = shape.get(DATA_AXIS, 1)
+    tp_n = shape.get(MODEL_AXIS, 1)
     if params.w1.shape[0] % S:
         raise ValueError(f"{params.w1.shape[0]} layers not divisible into "
                          f"{S} pipeline stages")
+    if params.w1.shape[1] % tp_n:
+        raise ValueError(f"ffn_dim {params.w1.shape[1]} not divisible by "
+                         f"{tp_n} model shards")
     M = S if n_microbatches is None else n_microbatches
-    params = shard_params(params, mesh)
-    step = make_step(batch_size, model_size, S, M, lr, schedule=schedule)
+    specs = PARAM_SPECS_TP if tp_n > 1 else PARAM_SPECS
+    params = shard_params(params, mesh, specs)
+    step = make_step(batch_size, model_size, S, M, lr, schedule=schedule,
+                     data_axis=DATA_AXIS if dp > 1 else None,
+                     model_axis=MODEL_AXIS if tp_n > 1 else None)
 
+    if dp > 1:
+        seed_cols = shard_seeds_strided(seeds, dp)
+        return launch(step, params, seed_cols, mesh, param_specs=specs,
+                      seed_spec=P(None, DATA_AXIS),
+                      select_local=lambda s: s[:, 0])
     return launch(step, params, jnp.asarray(seeds), mesh,
-                  param_specs=PARAM_SPECS, seed_spec=P())
+                  param_specs=specs, seed_spec=P())

@@ -48,7 +48,9 @@ def _varying_like(t, ref, axis_name: str):
     analysis on any mesh (a 2-D data x seq mesh adds "data" to the q/k/v
     blocks' vma; casting to the ring axis alone would drift after one
     fold)."""
-    need = tuple(a for a in (jax.typeof(ref).vma | {axis_name})
+    # sorted: iterating the frozenset union directly would make the axis
+    # order (hence the lowered program text) hash-randomized run to run
+    need = tuple(a for a in sorted(jax.typeof(ref).vma | {axis_name})
                  if a not in jax.typeof(t).vma)
     return lax.pcast(t, need, to="varying") if need else t
 

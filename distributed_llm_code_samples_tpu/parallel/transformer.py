@@ -425,12 +425,8 @@ def train_transformer_seq(params: TransformerParams, seeds,
         # on a 2-D mesh, over the data replicas (DDP semantics). One
         # fused psum over both axes per leaf, not one per axis.
         axes = (SEQ_AXIS, DATA_AXIS) if dp > 1 else (SEQ_AXIS,)
-
-        def reduce_leaf(g):
-            pending = tuple(a for a in axes if a in jax.typeof(g).vma)
-            return lax.psum(g, pending) if pending else g
-
-        grads = jax.tree_util.tree_map(reduce_leaf, grads)
+        grads = jax.tree_util.tree_map(
+            lambda g: grad_reduce(g, axes), grads)
         return sgd(params, grads, lr)
 
     if dp > 1:

@@ -325,3 +325,37 @@ def test_tp_sp_rejects_indivisible_tokens(setup, mesh_model4):
     params, seeds = setup
     with pytest.raises(ValueError, match="tokens"):
         train_tp_sp(params, seeds, B + 2, D, mesh_model4, lr=LR_TEST)
+
+
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_pp_3d_compositions(setup, schedule):
+    """3-D parallelism: the pipe ring composed with a DDP data axis
+    and/or a Megatron model axis inside each stage. dp x pp [x tp] ==
+    DDP over the data axis alone; pp x tp == single — the TP and PP
+    decompositions are exact, so only the data axis changes the math."""
+    params = init_ffn_stack(jax.random.PRNGKey(42), D, 4)
+    _, seeds = setup
+    single = train_single(params, seeds, B, D, lr=LR_TEST)
+    ddp2 = train_ddp(params, seeds, B, D, make_mesh({DATA_AXIS: 2}),
+                     lr=LR_TEST)
+    pp_tp = train_pp(params, seeds, B, D,
+                     make_mesh({PIPE_AXIS: 2, MODEL_AXIS: 2}), lr=LR_TEST,
+                     schedule=schedule)
+    _assert_params_close(pp_tp, single, rtol=1e-5, atol=1e-6)
+    dp_pp = train_pp(params, seeds, B, D,
+                     make_mesh({DATA_AXIS: 2, PIPE_AXIS: 2}), lr=LR_TEST,
+                     schedule=schedule)
+    _assert_params_close(dp_pp, ddp2, rtol=1e-5, atol=1e-6)
+    dp_pp_tp = train_pp(
+        params, seeds, B, D,
+        make_mesh({DATA_AXIS: 2, PIPE_AXIS: 2, MODEL_AXIS: 2}),
+        lr=LR_TEST, schedule=schedule)
+    _assert_params_close(dp_pp_tp, ddp2, rtol=1e-5, atol=1e-6)
+
+
+def test_pp_3d_rejects_indivisible_ffn(setup):
+    _, seeds = setup
+    odd = init_ffn_stack(jax.random.PRNGKey(0), D, 4, ffn_dim=98)
+    with pytest.raises(ValueError, match="ffn_dim"):
+        train_pp(odd, seeds, B, D,
+                 make_mesh({PIPE_AXIS: 2, MODEL_AXIS: 4}), lr=LR_TEST)
