@@ -5,10 +5,15 @@ from .ffn_stack import (FFNStackParams, init_ffn_stack, clone_params,
                         params_size_gb)
 from .attention import attention, mha
 from .moe import MoEStackParams, init_moe_stack
+from .moe_transformer import (MoETransformerParams,
+                              init_moe_transformer,
+                              moe_transformer_fwd_aux)
 from .transformer import (TransformerParams, init_transformer,
                           transformer_fwd)
 
 __all__ = ["FFNStackParams", "init_ffn_stack", "clone_params",
            "params_size_gb", "attention", "mha",
            "MoEStackParams", "init_moe_stack",
+           "MoETransformerParams", "init_moe_transformer",
+           "moe_transformer_fwd_aux",
            "TransformerParams", "init_transformer", "transformer_fwd"]

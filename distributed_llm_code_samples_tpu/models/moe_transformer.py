@@ -1,0 +1,115 @@
+"""MoE transformer — pre-LN attention blocks with Mixture-of-Experts FFNs.
+
+The GShard/Switch architecture, built from this framework's existing
+pieces in the same no-module-abstraction style: ``attn_sublayer`` (hand-
+VJP attention + projections, ``models.transformer``) for the first
+sublayer, ``ops.moe.moe_layer`` (top-k router, capacity dispatch,
+per-expert hand-VJP FFN) for the second. The reference has neither
+attention nor MoE (``README.md:6``); this family exists so expert
+parallelism composes with a real sequence model, not just the flat MoE
+stack — the trainers in ``parallel/moe_transformer.py`` run attention
+data-parallel and the FFN expert-parallel over one mesh axis, exactly
+GShard's layout.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.linear import init_linear
+from ..ops.moe import moe_layer, router_aux_loss
+from ..ops.norm import layernorm
+from .transformer import attn_sublayer
+
+
+class MoETransformerParams(NamedTuple):
+    """Per-layer stacks: ``ln1, ln2 [L, d]``; ``wq/wk/wv/wo [L, d, d]``;
+    ``wg [L, E, d]`` router; ``w1 [L, E, ffn, d]``, ``w2 [L, E, d, ffn]``
+    expert FFNs (``MoEStackParams`` layout inside ``TransformerParams``
+    structure)."""
+    ln1: jax.Array
+    wq: jax.Array
+    wk: jax.Array
+    wv: jax.Array
+    wo: jax.Array
+    ln2: jax.Array
+    wg: jax.Array
+    w1: jax.Array
+    w2: jax.Array
+
+    @property
+    def n_layers(self) -> int:
+        return self.w1.shape[0]
+
+    @property
+    def n_experts(self) -> int:
+        return self.w1.shape[1]
+
+    @property
+    def d_model(self) -> int:
+        return self.w1.shape[3]
+
+    def num_params(self) -> int:
+        return sum(l.size for l in self)
+
+
+def init_moe_transformer(key: jax.Array, d_model: int, n_layers: int,
+                         n_experts: int, ffn_dim: int | None = None,
+                         scale: float = 2e-2,
+                         dtype=jnp.float32) -> MoETransformerParams:
+    ffn_dim = 4 * d_model if ffn_dim is None else ffn_dim
+    keys = jax.random.split(key, 7 * n_layers)
+
+    def stack(off, m, n):
+        return jnp.stack([init_linear(keys[7 * l + off], m, n, scale,
+                                      dtype) for l in range(n_layers)])
+
+    def estack(off, m, n):
+        return jnp.stack([
+            jnp.stack([init_linear(
+                jax.random.fold_in(keys[7 * l + off], e), m, n, scale,
+                dtype) for e in range(n_experts)])
+            for l in range(n_layers)])
+
+    ones = jnp.ones((n_layers, d_model), dtype)
+    kg = jax.random.fold_in(key, 7 * n_layers)
+    wg = (scale * jax.random.normal(kg, (n_layers, n_experts, d_model))
+          ).astype(dtype)
+    return MoETransformerParams(
+        ln1=ones, wq=stack(0, d_model, d_model),
+        wk=stack(1, d_model, d_model), wv=stack(2, d_model, d_model),
+        wo=stack(3, d_model, d_model), ln2=ones, wg=wg,
+        w1=estack(5, d_model, ffn_dim), w2=estack(6, ffn_dim, d_model))
+
+
+def moe_transformer_fwd_aux(params: MoETransformerParams, x: jax.Array,
+                            n_heads: int, causal: bool = True,
+                            capacity_factor: float = 2.0, k: int = 1,
+                            capacity: int | None = None,
+                            moe_fn=None, attn=None):
+    """Stack forward. ``x [B, T, d]``. Returns ``(y, aux)`` with ``aux``
+    the summed load-balancing loss over layers (one walk computes both,
+    the ``ops.moe.moe_stack_fwd_aux`` convention). ``moe_fn`` swaps the
+    MoE sublayer core (the EP trainer passes its all_to_all form); the
+    default is the dense ``ops.moe.moe_layer``."""
+    if moe_fn is not None and capacity is not None:
+        raise ValueError("moe_fn supplies its own dispatch; the explicit "
+                         "capacity argument would be silently ignored")
+    b, t, d = x.shape
+    aux = jnp.asarray(0.0, jnp.float32)
+    for l in range(params.n_layers):
+        x = x + attn_sublayer(params.wq[l], params.wk[l], params.wv[l],
+                              params.wo[l], layernorm(params.ln1[l], x),
+                              n_heads, causal, attn)
+        h = layernorm(params.ln2[l], x).reshape(b * t, d)
+        aux = aux + router_aux_loss(params.wg[l], h)
+        if moe_fn is None:
+            y = moe_layer(params.wg[l], params.w1[l], params.w2[l], h,
+                          capacity_factor, k, capacity)
+        else:
+            y = moe_fn(params.wg[l], params.w1[l], params.w2[l], h)
+        x = x + y.reshape(b, t, d)
+    return x, aux

@@ -23,6 +23,8 @@ from .pipeline import train_pp
 from .sequence import (ring_attention, sequence_parallel_attention,
                        ulysses_attention, ulysses_parallel_attention)
 from .expert import train_moe_ep, train_moe_dense, moe_layer_ep
+from .moe_transformer import (train_moe_transformer_ep,
+                              train_moe_transformer_dense)
 from .transformer import (train_transformer_single, train_transformer_ddp,
                           train_transformer_fsdp, train_transformer_tp,
                           train_transformer_hybrid, train_transformer_seq)
@@ -48,6 +50,7 @@ __all__ = [
     "train_single", "train_ddp", "train_ddp_zero1", "train_fsdp",
     "train_tp", "train_tp_sp", "train_hybrid",
     "train_pp", "train_moe_ep", "train_moe_dense", "moe_layer_ep",
+    "train_moe_transformer_ep", "train_moe_transformer_dense",
     "train_transformer_single", "train_transformer_ddp",
     "train_transformer_fsdp", "train_transformer_tp",
     "train_transformer_hybrid", "train_transformer_seq",

@@ -26,12 +26,12 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from .. import LR
-from ..data import batch_from_seed, shard_seeds_strided
+from ..data import batch_from_seed
 from ..models.ffn_stack import FFNStackParams, clone_params
 from ..optim import Optimizer, sgd
 from ..ops.stack import accumulated_grads, stack_fwd, stack_bwd
 from .collectives import all_reduce
-from .launcher import launch
+from .launcher import launch, launch_strided
 from .mesh import DATA_AXIS, require_axes
 
 
@@ -109,13 +109,11 @@ def train_ddp(params: FFNStackParams, seeds, batch_size: int,
     """
     require_axes(mesh, DATA_AXIS)
     n = mesh.shape[DATA_AXIS]
-    seed_cols = shard_seeds_strided(seeds, n)  # [steps/rank, n]
     step = make_step(batch_size, model_size, lr, unroll,
                      optimizer=optimizer, accum=accum)
 
     make_carry = None
     if optimizer is not None:
         make_carry = lambda p: (p, optimizer.init(p))  # noqa: E731
-    return launch(step, clone_params(params), seed_cols, mesh,
-                  param_specs=P(), seed_spec=P(None, DATA_AXIS),
-                  select_local=lambda s: s[:, 0], make_carry=make_carry)
+    return launch_strided(step, clone_params(params), seeds, mesh,
+                          DATA_AXIS, P(), n, make_carry=make_carry)

@@ -55,7 +55,7 @@ from ..ops.moe import (dispatch_tensor, dispatch_tensor_topk,
                        route_topk, router_aux_loss)
 from ..optim import sgd
 from .collectives import all_to_all, grad_reduce
-from .launcher import launch
+from .launcher import launch_strided
 from .mesh import EXPERT_AXIS, require_axes
 
 
@@ -154,14 +154,12 @@ def train_moe_ep(params: MoEStackParams, seeds, batch_size: int,
     if batch_size % n != 0:
         raise ValueError(f"batch_size={batch_size} not divisible by "
                          f"expert-axis size {n}")
-    seed_cols = shard_seeds_strided(seeds, n)
     step = make_step(batch_size // n, model_size, lr, capacity_factor,
                      k=k, aux_coef=aux_coef)
     specs = MoEStackParams(wg=P(), w1=P(None, EXPERT_AXIS),
                            w2=P(None, EXPERT_AXIS))
-    return launch(step, clone_params(params), seed_cols, mesh,
-                  param_specs=specs, seed_spec=P(None, EXPERT_AXIS),
-                  select_local=lambda s: s[:, 0])
+    return launch_strided(step, clone_params(params), seeds, mesh,
+                          EXPERT_AXIS, specs, n)
 
 
 def train_moe_dense(params: MoEStackParams, seeds, batch_size: int,

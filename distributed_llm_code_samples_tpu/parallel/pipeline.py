@@ -65,13 +65,13 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import LR
-from ..data import batch_from_seed, shard_seeds_strided
+from ..data import batch_from_seed
 from ..models.ffn_stack import FFNStackParams, reshard_copy
 from ..optim import sgd
 from ..ops.ffn import ffn_fwd, ffn_bwd
 from ..ops.stack import stack_fwd, stack_bwd
 from .collectives import all_reduce, ring_shift, axis_index, barrier
-from .launcher import launch
+from .launcher import launch, launch_strided
 from .mesh import DATA_AXIS, MODEL_AXIS, PIPE_AXIS, require_axes
 
 # Layers are staged: stacked layer axis sharded across the pipe ring.
@@ -318,9 +318,7 @@ def train_pp(params: FFNStackParams, seeds, batch_size: int,
                      model_axis=MODEL_AXIS if tp_n > 1 else None)
 
     if dp > 1:
-        seed_cols = shard_seeds_strided(seeds, dp)
-        return launch(step, params, seed_cols, mesh, param_specs=specs,
-                      seed_spec=P(None, DATA_AXIS),
-                      select_local=lambda s: s[:, 0])
+        return launch_strided(step, params, seeds, mesh, DATA_AXIS,
+                              specs, dp)
     return launch(step, params, jnp.asarray(seeds), mesh,
                   param_specs=specs, seed_spec=P())

@@ -1,0 +1,127 @@
+"""MoE transformer (GShard layout) tests.
+
+Differential stance as everywhere (``train_ffns.py:386-391``): the
+expert-parallel trainer must reproduce the package's dense grouped
+oracle; the dense trainer with one expert must reproduce the plain dense
+transformer (the MoE layer with E=1 has gate 1 and IS the FFN block).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llm_code_samples_tpu.data import make_seed_schedule
+from distributed_llm_code_samples_tpu.models import (MoETransformerParams,
+                                                     TransformerParams,
+                                                     init_moe_transformer)
+from distributed_llm_code_samples_tpu.parallel import (
+    EXPERT_AXIS, make_mesh, train_moe_transformer_dense,
+    train_moe_transformer_ep, train_transformer_single)
+
+D, L, E, H, T = 32, 2, 8, 4, 8
+N = 4
+TOKENS = N * 2 * T  # 2 sequences of T tokens per shard
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_moe_transformer(jax.random.PRNGKey(0), D, L, E)
+
+
+@pytest.fixture(scope="module")
+def mesh_ep():
+    return make_mesh({EXPERT_AXIS: N})
+
+
+def _assert_close(a, b, rtol=2e-4, atol=1e-5):
+    for name in MoETransformerParams._fields:
+        np.testing.assert_allclose(np.asarray(getattr(a, name)),
+                                   np.asarray(getattr(b, name)),
+                                   rtol=rtol, atol=atol, err_msg=name)
+
+
+@pytest.mark.parametrize("k,aux_coef", [(1, 0.0), (2, 0.0), (2, 0.01)])
+def test_ep_matches_dense_oracle(params, mesh_ep, k, aux_coef):
+    """GShard layout (data-parallel attention + expert-parallel FFN over
+    one axis) == the dense grouped oracle, incl. top-2 and the aux loss."""
+    seeds = make_seed_schedule(2 * N, random_seed=9)
+    ep = train_moe_transformer_ep(params, seeds, TOKENS, D, mesh_ep,
+                                  lr=0.1, seq_len=T, n_heads=H, k=k,
+                                  aux_coef=aux_coef)
+    dense = train_moe_transformer_dense(params, seeds, TOKENS, D, lr=0.1,
+                                        seq_len=T, n_heads=H, k=k,
+                                        aux_coef=aux_coef, n_groups=N)
+    _assert_close(ep, dense)
+
+
+def test_ep_matches_dense_under_overflow(params, mesh_ep):
+    """Capacity pressure: grouped drops must agree between EP and the
+    oracle (the semantics that silently diverge if capacity derivation
+    drifts)."""
+    seeds = make_seed_schedule(N, random_seed=3)
+    kwargs = dict(lr=0.1, seq_len=T, n_heads=H, capacity_factor=0.5)
+    ep = train_moe_transformer_ep(params, seeds, TOKENS, D, mesh_ep,
+                                  **kwargs)
+    dense = train_moe_transformer_dense(params, seeds, TOKENS, D,
+                                        n_groups=N, **kwargs)
+    _assert_close(ep, dense)
+
+
+def test_single_expert_is_plain_transformer():
+    """E=1 with ample capacity: the router's gate is softmax over one
+    logit == 1, so the MoE layer IS the dense FFN block — the whole model
+    must equal the plain transformer with the same weights."""
+    moe_p = init_moe_transformer(jax.random.PRNGKey(2), D, L, 1)
+    plain = TransformerParams(
+        ln1=moe_p.ln1, wq=moe_p.wq, wk=moe_p.wk, wv=moe_p.wv, wo=moe_p.wo,
+        ln2=moe_p.ln2, w1=moe_p.w1[:, 0], w2=moe_p.w2[:, 0])
+    seeds = make_seed_schedule(3, random_seed=5)
+    tokens = 2 * T
+    a = train_moe_transformer_dense(moe_p, seeds, tokens, D, lr=0.1,
+                                    seq_len=T, n_heads=H,
+                                    capacity_factor=1.0)
+    b = train_transformer_single(plain, seeds, tokens, D, lr=0.1,
+                                 seq_len=T, n_heads=H)
+    for name in TransformerParams._fields:
+        got = getattr(a, name)
+        if name in ("w1", "w2"):
+            got = got[:, 0]
+        np.testing.assert_allclose(np.asarray(got),
+                                   np.asarray(getattr(b, name)),
+                                   rtol=2e-4, atol=1e-5, err_msg=name)
+
+
+def test_router_learns(params):
+    """The router weights must actually receive gradient through the
+    gate (guards a silently-detached router)."""
+    seeds = make_seed_schedule(2, random_seed=11)
+    out = train_moe_transformer_dense(params, seeds, 2 * T, D, lr=0.1,
+                                      seq_len=T, n_heads=H)
+    assert not np.allclose(np.asarray(out.wg), np.asarray(params.wg))
+
+
+def test_validations(params, mesh_ep):
+    seeds = make_seed_schedule(N, random_seed=1)
+    with pytest.raises(ValueError, match="tokens"):
+        train_moe_transformer_ep(params, seeds, TOKENS + 2, D, mesh_ep,
+                                 seq_len=T, n_heads=H)
+    with pytest.raises(ValueError, match="seq_len"):
+        train_moe_transformer_ep(params, seeds, N * (T + N), D, mesh_ep,
+                                 seq_len=T, n_heads=H)
+    with pytest.raises(ValueError, match="n_experts"):
+        odd = init_moe_transformer(jax.random.PRNGKey(1), D, L, 6)
+        train_moe_transformer_ep(odd, seeds, TOKENS, D, mesh_ep,
+                                 seq_len=T, n_heads=H)
+
+
+def test_flash_attention_in_ep_path(params, mesh_ep):
+    """attn_impl='flash' (interpret off-TPU) through the GShard trainer
+    changes nothing numerically."""
+    seeds = make_seed_schedule(N, random_seed=17)
+    base = train_moe_transformer_ep(params, seeds, TOKENS, D, mesh_ep,
+                                    lr=0.1, seq_len=T, n_heads=H)
+    flash = train_moe_transformer_ep(params, seeds, TOKENS, D, mesh_ep,
+                                     lr=0.1, seq_len=T, n_heads=H,
+                                     attn_impl="flash")
+    _assert_close(flash, base, rtol=1e-4, atol=1e-5)
