@@ -95,9 +95,12 @@ def moe_transformer_fwd_aux(params: MoETransformerParams, x: jax.Array,
     the ``ops.moe.moe_stack_fwd_aux`` convention). ``moe_fn`` swaps the
     MoE sublayer core (the EP trainer passes its all_to_all form); the
     default is the dense ``ops.moe.moe_layer``."""
-    if moe_fn is not None and capacity is not None:
-        raise ValueError("moe_fn supplies its own dispatch; the explicit "
-                         "capacity argument would be silently ignored")
+    if moe_fn is not None and (capacity is not None or k != 1
+                               or capacity_factor != 2.0):
+        raise ValueError("moe_fn supplies its own routing/dispatch; the "
+                         "explicit capacity_factor/k/capacity arguments "
+                         "would be silently ignored — configure them on "
+                         "the moe_fn itself")
     b, t, d = x.shape
     aux = jnp.asarray(0.0, jnp.float32)
     for l in range(params.n_layers):

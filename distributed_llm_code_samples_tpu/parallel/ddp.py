@@ -116,4 +116,4 @@ def train_ddp(params: FFNStackParams, seeds, batch_size: int,
     if optimizer is not None:
         make_carry = lambda p: (p, optimizer.init(p))  # noqa: E731
     return launch_strided(step, clone_params(params), seeds, mesh,
-                          DATA_AXIS, P(), n, make_carry=make_carry)
+                          DATA_AXIS, P(), make_carry=make_carry)

@@ -159,7 +159,7 @@ def train_moe_ep(params: MoEStackParams, seeds, batch_size: int,
     specs = MoEStackParams(wg=P(), w1=P(None, EXPERT_AXIS),
                            w2=P(None, EXPERT_AXIS))
     return launch_strided(step, clone_params(params), seeds, mesh,
-                          EXPERT_AXIS, specs, n)
+                          EXPERT_AXIS, specs)
 
 
 def train_moe_dense(params: MoEStackParams, seeds, batch_size: int,

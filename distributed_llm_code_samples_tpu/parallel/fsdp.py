@@ -35,13 +35,13 @@ from __future__ import annotations
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import LR
-from ..data import batch_from_seed, shard_seeds_strided
+from ..data import batch_from_seed
 from ..models.ffn_stack import FFNStackParams, reshard_copy
 from ..optim import sgd
 from ..ops.ffn import ffn_fwd, ffn_bwd
 from ..ops.stack import stack_fwd, stack_bwd
 from .collectives import all_gather, reduce_scatter
-from .launcher import launch
+from .launcher import launch_strided
 from .mesh import DATA_AXIS, require_axes
 
 # Stacked-layout shard specs: per-layer dim 0 == stacked axis 1.
@@ -110,10 +110,8 @@ def train_fsdp(params: FFNStackParams, seeds, batch_size: int,
             f"param dims {params.w1.shape[1]}x{params.w2.shape[1]} not "
             f"divisible by {n} shards (the reference's chunk() had the same "
             "implicit requirement)")
-    seed_cols = shard_seeds_strided(seeds, n)
     params = shard_params(params, mesh)
     step = make_step(batch_size, model_size, lr, unroll)
 
-    return launch(step, params, seed_cols, mesh,
-                  param_specs=PARAM_SPECS, seed_spec=P(None, DATA_AXIS),
-                  select_local=lambda s: s[:, 0])
+    return launch_strided(step, params, seeds, mesh, DATA_AXIS,
+                          PARAM_SPECS)

@@ -20,13 +20,13 @@ from __future__ import annotations
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import LR
-from ..data import batch_from_seed, shard_seeds_strided
+from ..data import batch_from_seed
 from ..models.ffn_stack import FFNStackParams, reshard_copy
 from ..optim import sgd
 from ..ops.ffn import ffn_fwd, ffn_bwd
 from ..ops.stack import stack_fwd, stack_bwd
 from .collectives import all_reduce
-from .launcher import launch
+from .launcher import launch_strided
 from .mesh import DATA_AXIS, MODEL_AXIS, require_axes
 
 PARAM_SPECS = FFNStackParams(w1=P(None, MODEL_AXIS, None),
@@ -76,10 +76,8 @@ def train_hybrid(params: FFNStackParams, seeds, batch_size: int,
     if params.w1.shape[1] % tp:
         raise ValueError(f"ffn_dim {params.w1.shape[1]} not divisible by "
                          f"{tp} model shards")
-    seed_cols = shard_seeds_strided(seeds, dp)
     params = shard_params(params, mesh)
     step = make_step(batch_size, model_size, lr, unroll)
 
-    return launch(step, params, seed_cols, mesh,
-                  param_specs=PARAM_SPECS, seed_spec=P(None, DATA_AXIS),
-                  select_local=lambda s: s[:, 0])
+    return launch_strided(step, params, seeds, mesh, DATA_AXIS,
+                          PARAM_SPECS)

@@ -52,16 +52,18 @@ def launch(step: Callable, params, seeds_arr, mesh, param_specs, seed_spec,
 
 
 def launch_strided(step: Callable, params, seeds, mesh, axis: str,
-                   param_specs, n_shards: int, **kwargs):
+                   param_specs, **kwargs):
     """``launch`` with the strided seed split every data-sharding strategy
     uses (``train_ffns.py:182`` semantics, ``data.shard_seeds_strided``):
     rank ``r``'s step ``t`` consumes global seed ``seeds[t*n + r]``. One
     helper so the convention — which silently breaks the DDP==FSDP
-    differential tests if it drifts — lives in one place."""
+    differential tests if it drifts — lives in one place. The shard count
+    is ``mesh.shape[axis]`` by construction: a caller-supplied count could
+    silently mis-assign seeds if it drifted from the mesh."""
     from jax.sharding import PartitionSpec as P
 
     from ..data import shard_seeds_strided
-    seed_cols = shard_seeds_strided(seeds, n_shards)
+    seed_cols = shard_seeds_strided(seeds, dict(mesh.shape)[axis])
     return launch(step, params, seed_cols, mesh, param_specs=param_specs,
                   seed_spec=P(None, axis), select_local=lambda s: s[:, 0],
                   **kwargs)

@@ -46,7 +46,11 @@ EP_SPECS = MoETransformerParams(
 _REPLICATED = ("ln1", "wq", "wk", "wv", "wo", "ln2", "wg")
 
 
-def _validate(params, batch_size: int, seq_len: int, n: int) -> int:
+def _validate(params, batch_size: int, seq_len: int, n: int,
+              model_size: int, n_heads: int) -> int:
+    if model_size % n_heads:
+        raise ValueError(f"model_size={model_size} not divisible by "
+                         f"n_heads={n_heads} (head dim must be whole)")
     if batch_size % n:
         raise ValueError(f"batch_size={batch_size} tokens not divisible "
                          f"by {n} expert shards")
@@ -76,7 +80,8 @@ def train_moe_transformer_ep(params: MoETransformerParams, seeds,
     from .transformer import resolve_attn
     require_axes(mesh, EXPERT_AXIS)
     n = mesh.shape[EXPERT_AXIS]
-    t_local = _validate(params, batch_size, seq_len, n)
+    t_local = _validate(params, batch_size, seq_len, n,
+                        model_size, n_heads)
     b_local = t_local // seq_len
     attn = resolve_attn(attn_impl)
 
@@ -102,7 +107,7 @@ def train_moe_transformer_ep(params: MoETransformerParams, seeds,
         return sgd(params, grads, lr)
 
     return launch_strided(step, clone_params(params), seeds, mesh,
-                          EXPERT_AXIS, EP_SPECS, n)
+                          EXPERT_AXIS, EP_SPECS)
 
 
 def train_moe_transformer_dense(params: MoETransformerParams, seeds,
@@ -117,7 +122,8 @@ def train_moe_transformer_dense(params: MoETransformerParams, seeds,
     user-facing oracle for ``train_moe_transformer_ep`` (``n_groups=n``),
     or plain dense MoE-transformer training (``n_groups=1``)."""
     from .transformer import resolve_attn
-    t_local = _validate(params, batch_size, seq_len, n_groups)
+    t_local = _validate(params, batch_size, seq_len, n_groups,
+                        model_size, n_heads)
     b_local = t_local // seq_len
     cap = _local_capacity(t_local, n_groups, params.n_experts,
                           capacity_factor)

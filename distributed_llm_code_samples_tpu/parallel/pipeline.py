@@ -319,6 +319,6 @@ def train_pp(params: FFNStackParams, seeds, batch_size: int,
 
     if dp > 1:
         return launch_strided(step, params, seeds, mesh, DATA_AXIS,
-                              specs, dp)
+                              specs)
     return launch(step, params, jnp.asarray(seeds), mesh,
                   param_specs=specs, seed_spec=P())

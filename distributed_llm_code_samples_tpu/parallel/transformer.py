@@ -33,7 +33,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import LR
-from ..data import batch_from_seed, shard_seeds_strided
+from ..data import batch_from_seed
 from ..models.ffn_stack import clone_params, reshard_copy
 from ..models.transformer import (TransformerParams, attn_sublayer,
                                   transformer_block, transformer_fwd)
@@ -42,7 +42,7 @@ from ..ops.norm import layernorm
 from ..optim import sgd
 from .collectives import (all_gather, all_reduce, axis_index, grad_reduce,
                           reduce_scatter)
-from .launcher import launch
+from .launcher import launch, launch_strided
 from .mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS, require_axes
 
 # TP layout: column-parallel projections shard the output dim (heads for
@@ -163,7 +163,6 @@ def train_transformer_ddp(params: TransformerParams, seeds, batch_size: int,
     require_axes(mesh, DATA_AXIS)
     n = mesh.shape[DATA_AXIS]
     _validate_shapes(batch_size, seq_len, model_size, n_heads)
-    seed_cols = shard_seeds_strided(seeds, n)
     attn = resolve_attn(attn_impl)
 
     def step(params: TransformerParams, seed) -> TransformerParams:
@@ -176,9 +175,8 @@ def train_transformer_ddp(params: TransformerParams, seeds, batch_size: int,
             lambda g: grad_reduce(g, DATA_AXIS), grads)
         return sgd(params, grads, lr)
 
-    return launch(step, clone_params(params), seed_cols, mesh,
-                  param_specs=P(), seed_spec=P(None, DATA_AXIS),
-                  select_local=lambda s: s[:, 0])
+    return launch_strided(step, clone_params(params), seeds, mesh,
+                          DATA_AXIS, P())
 
 
 def train_transformer_fsdp(params: TransformerParams, seeds,
@@ -204,7 +202,6 @@ def train_transformer_fsdp(params: TransformerParams, seeds,
         if leaf.shape[1] % n:
             raise ValueError(f"{name} dim {leaf.shape[1]} not divisible by "
                              f"{n} shards")
-    seed_cols = shard_seeds_strided(seeds, n)
     attn = resolve_attn(attn_impl)
 
     def step(params: TransformerParams, seed) -> TransformerParams:
@@ -224,9 +221,8 @@ def train_transformer_fsdp(params: TransformerParams, seeds,
         grads = vjp(dloss_dx)[0]  # psum_scatter'd by the gather transpose
         return sgd(params, grads, lr)
 
-    return launch(step, _shard(params, mesh, FSDP_SPECS), seed_cols, mesh,
-                  param_specs=FSDP_SPECS, seed_spec=P(None, DATA_AXIS),
-                  select_local=lambda s: s[:, 0])
+    return launch_strided(step, _shard(params, mesh, FSDP_SPECS), seeds,
+                          mesh, DATA_AXIS, FSDP_SPECS)
 
 
 def tp_block(ln1, wq, wk, wv, wo, ln2, w1, w2, x, n_heads_local: int,
@@ -430,10 +426,8 @@ def train_transformer_seq(params: TransformerParams, seeds,
         return sgd(params, grads, lr)
 
     if dp > 1:
-        seed_cols = shard_seeds_strided(seeds, dp)
-        return launch(step, clone_params(params), seed_cols, mesh,
-                      param_specs=P(), seed_spec=P(None, DATA_AXIS),
-                      select_local=lambda s: s[:, 0])
+        return launch_strided(step, clone_params(params), seeds, mesh,
+                              DATA_AXIS, P())
     return launch(step, clone_params(params), jnp.asarray(seeds), mesh,
                   param_specs=P(), seed_spec=P())
 
@@ -455,7 +449,6 @@ def train_transformer_hybrid(params: TransformerParams, seeds,
     n = mesh.shape[MODEL_AXIS]
     h_local = _validate_tp(params, n_heads, n)
     _validate_shapes(batch_size, seq_len, model_size, n_heads)
-    seed_cols = shard_seeds_strided(seeds, dp)
     attn = resolve_attn(attn_impl)
 
     def step(params: TransformerParams, seed) -> TransformerParams:
@@ -481,6 +474,5 @@ def train_transformer_hybrid(params: TransformerParams, seeds,
 
     # params: sharded over model, replicated over data; seeds: one strided
     # column per data shard, same column for every model shard
-    return launch(step, _shard(params, mesh, TP_SPECS), seed_cols, mesh,
-                  param_specs=TP_SPECS, seed_spec=P(None, DATA_AXIS),
-                  select_local=lambda s: s[:, 0])
+    return launch_strided(step, _shard(params, mesh, TP_SPECS), seeds,
+                          mesh, DATA_AXIS, TP_SPECS)

@@ -40,12 +40,11 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from .. import LR
-from ..data import shard_seeds_strided
 from ..models.ffn_stack import FFNStackParams, clone_params
 from ..optim import Optimizer, adam
 from .collectives import all_gather, axis_index, reduce_scatter
 from .ddp import local_grads
-from .launcher import launch
+from .launcher import launch_strided
 from .mesh import DATA_AXIS, require_axes
 
 
@@ -99,15 +98,13 @@ def train_ddp_zero1(params: FFNStackParams, seeds, batch_size: int,
         raise ValueError(
             f"{n_layers} layers not divisible across {n} ranks: ZeRO-1 "
             "partitions optimizer state in whole-layer units")
-    seed_cols = shard_seeds_strided(seeds, n)
     step, shard_of, opt = make_step(batch_size, model_size, n, lr, unroll,
                                     optimizer=optimizer)
 
     # check_vma off: the re-assembled params are replicated by construction
     # (every rank all_gathers the same disjoint slices) but typed varying —
     # see launcher.launch
-    return launch(step, clone_params(params), seed_cols, mesh,
-                  param_specs=P(), seed_spec=P(None, DATA_AXIS),
-                  select_local=lambda s: s[:, 0],
-                  make_carry=lambda p: (p, opt.init(shard_of(p))),
-                  check_vma=False)
+    return launch_strided(step, clone_params(params), seeds, mesh,
+                          DATA_AXIS, P(),
+                          make_carry=lambda p: (p, opt.init(shard_of(p))),
+                          check_vma=False)
