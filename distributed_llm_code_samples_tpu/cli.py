@@ -29,13 +29,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-l", "--layers", type=int, default=1)
     p.add_argument("-d", "--model_size", type=int, default=4)
     p.add_argument("-m", "--method", type=int, default=0,
-                   choices=range(10),
+                   choices=range(11),
                    help="0=all(1-4), 1=single, 2=DDP, 3=FSDP, 4=TP, "
                         "5=hybrid DDP x TP, 6=pipeline (ppermute send/recv), "
                         "7=MoE expert parallelism (all_to_all), "
                         "8=transformer blocks (Megatron TP; --heads), "
-                        "9=all(1-8) with every strategy cross-verified "
-                        "against its oracle")
+                        "9=all(1-8,10) with every strategy cross-verified "
+                        "against its oracle, 10=MoE transformer (GShard: "
+                        "data-parallel attention + expert-parallel FFN)")
     p.add_argument("-r", "--random_seed", type=int, default=0,
                    help="!=0 makes runs reproducible (train_ffns.py:350)")
     # TPU-build extensions
@@ -51,9 +52,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "wavefronts, stash of M microbatches) or 1f1b "
                         "(interleaved, stash bounded by stage depth)")
     p.add_argument("--experts", type=int, default=8,
-                   help="expert count for --method 7 (MoE)")
+                   help="expert count for --method 7/10 (MoE)")
     p.add_argument("--heads", type=int, default=4,
-                   help="attention heads for --method 8 (transformer)")
+                   help="attention heads for --method 8/10")
     p.add_argument("--lr", type=float, default=None,
                    help="override LR (default 1e-5, train_ffns.py:29)")
     p.add_argument("--optimizer", choices=["sgd", "momentum", "adam"],
@@ -103,8 +104,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "training overlaps the disk write)")
     p.add_argument("--checkpoint_every", type=int, default=0,
                    help="save every N steps (0 = final only); for methods "
-                        "that shard the seed schedule (2, 3, 5, 7) pick N "
-                        "divisible by the sharding-axis size")
+                        "that shard the seed schedule (2, 3, 5, 7, 10) "
+                        "pick N divisible by the sharding-axis size")
     p.add_argument("--no_resume", action="store_true",
                    help="ignore existing checkpoints (restart from step 0)")
     return p
@@ -182,7 +183,8 @@ def main(argv=None) -> int:
     key = jax.random.PRNGKey(args.random_seed)
 
     def family_of(method: int) -> str:
-        return {7: "moe", 8: "transformer"}.get(method, "ffn")
+        return {7: "moe", 8: "transformer",
+                10: "moe_transformer"}.get(method, "ffn")
 
     _family_params = {}
 
@@ -196,6 +198,11 @@ def main(argv=None) -> int:
             elif fam == "transformer":
                 _family_params[fam] = init_transformer(
                     key, args.model_size, args.layers, dtype=dtype)
+            elif fam == "moe_transformer":
+                from .models import init_moe_transformer
+                _family_params[fam] = init_moe_transformer(
+                    key, args.model_size, args.layers, args.experts,
+                    dtype=dtype)
             else:
                 _family_params[fam] = init_ffn_stack(
                     key, args.model_size, args.layers, dtype=dtype)
@@ -204,7 +211,8 @@ def main(argv=None) -> int:
     params = params_for(args.method if args.method != 9 else 1)
     print(f"PARAMS: {params.num_params():_} "
           f"(size {params_size_gb(params)} GB)\n\n")
-    corner = (lambda w: w[0, 0]) if args.method == 7 else (lambda w: w[0])
+    corner = ((lambda w: w[0, 0]) if args.method in (7, 10)
+              else (lambda w: w[0]))
     print("initial layers_params[0]", params.w1[0].shape, params.w2[0].shape)
     print("initial layers_params[0]", corner(params.w1)[:5, :5],
           corner(params.w2)[:5, :5])
@@ -222,7 +230,7 @@ def main(argv=None) -> int:
             return make_mesh({MODEL_AXIS: n_dev})
         if method == 6:
             return make_mesh({PIPE_AXIS: n_dev})
-        if method == 7:
+        if method in (7, 10):
             return make_mesh({EXPERT_AXIS: n_dev})
         if method == 8:
             # model axis sized by --tp (like method 5): all-devices would
@@ -238,7 +246,7 @@ def main(argv=None) -> int:
     if args.method == 0:
         selected = [1, 2, 3, 4]
     elif args.method == 9:
-        selected = [1, 2, 3, 4, 5, 6, 7, 8]
+        selected = [1, 2, 3, 4, 5, 6, 7, 8, 10]
     else:
         selected = [args.method]
     results = {}
@@ -264,9 +272,9 @@ def main(argv=None) -> int:
                 kwargs["n_microbatches"] = args.microbatches
         if m == 7:
             kwargs = dict(lr=lr)  # EP's expert loop has its own structure
-        if m == 8:
+        if m in (8, 10):
             kwargs = dict(lr=lr, seq_len=args.seq_len, n_heads=args.heads)
-            if args.tp_sp:
+            if args.tp_sp and m == 8:
                 kwargs["sequence_parallel"] = True
         if m == 1 and args.pallas:
             kwargs["use_pallas"] = True
@@ -302,7 +310,8 @@ def main(argv=None) -> int:
         jax.block_until_ready(out)
         t1 = time.time()
         results[m] = out
-        corner_m = (lambda w: w[0, 0]) if m == 7 else (lambda w: w[0])
+        corner_m = ((lambda w: w[0, 0]) if m in (7, 10)
+                    else (lambda w: w[0]))
         print(f"\n{name} takes {t1 - t0} seconds")
         print(f"final {name} layers_params[0]", out.w1[0].shape,
               out.w2[0].shape)
@@ -349,6 +358,13 @@ def main(argv=None) -> int:
                 seq_len=args.seq_len, n_heads=args.heads)
             checks.append(("ttp", "t1dev", results[8], t_single,
                            1e-4, 1e-5))
+            # GShard MoE transformer == its dense grouped oracle
+            from .parallel import train_moe_transformer_dense
+            mt_dense = train_moe_transformer_dense(
+                params_for(10), seeds, tokens, args.model_size, lr=lr,
+                seq_len=args.seq_len, n_heads=args.heads, n_groups=n_dev)
+            checks.append(("moe_tf_ep", "moe_tf_dense", results[10],
+                           mt_dense, 1e-4, 1e-5))
         for la, lb, a, b, rt, at in checks:
             for field in type(a)._fields:
                 pa = np.asarray(getattr(a, field))

@@ -87,7 +87,8 @@ def init_moe_transformer(key: jax.Array, d_model: int, n_layers: int,
 
 def moe_transformer_fwd_aux(params: MoETransformerParams, x: jax.Array,
                             n_heads: int, causal: bool = True,
-                            capacity_factor: float = 2.0, k: int = 1,
+                            capacity_factor: float | None = None,
+                            k: int | None = None,
                             capacity: int | None = None,
                             moe_fn=None, attn=None):
     """Stack forward. ``x [B, T, d]``. Returns ``(y, aux)`` with ``aux``
@@ -95,12 +96,14 @@ def moe_transformer_fwd_aux(params: MoETransformerParams, x: jax.Array,
     the ``ops.moe.moe_stack_fwd_aux`` convention). ``moe_fn`` swaps the
     MoE sublayer core (the EP trainer passes its all_to_all form); the
     default is the dense ``ops.moe.moe_layer``."""
-    if moe_fn is not None and (capacity is not None or k != 1
-                               or capacity_factor != 2.0):
+    if moe_fn is not None and (capacity is not None or k is not None
+                               or capacity_factor is not None):
         raise ValueError("moe_fn supplies its own routing/dispatch; the "
                          "explicit capacity_factor/k/capacity arguments "
                          "would be silently ignored — configure them on "
                          "the moe_fn itself")
+    capacity_factor = 2.0 if capacity_factor is None else capacity_factor
+    k = 1 if k is None else k
     b, t, d = x.shape
     aux = jnp.asarray(0.0, jnp.float32)
     for l in range(params.n_layers):

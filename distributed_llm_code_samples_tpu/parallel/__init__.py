@@ -41,6 +41,7 @@ STRATEGIES = {
     6: ("train_pp", train_pp),
     7: ("train_moe_ep", train_moe_ep),
     8: ("train_transformer_tp", train_transformer_tp),
+    10: ("train_moe_transformer_ep", train_moe_transformer_ep),
 }
 
 __all__ = [

@@ -108,7 +108,6 @@ def train_ddp(params: FFNStackParams, seeds, batch_size: int,
     over token chunks (see ``make_step``).
     """
     require_axes(mesh, DATA_AXIS)
-    n = mesh.shape[DATA_AXIS]
     step = make_step(batch_size, model_size, lr, unroll,
                      optimizer=optimizer, accum=accum)
 

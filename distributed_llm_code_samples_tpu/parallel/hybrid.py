@@ -71,7 +71,6 @@ def train_hybrid(params: FFNStackParams, seeds, batch_size: int,
     """Run the full hybrid schedule on a mesh with ``"data"`` and ``"model"``
     axes. Seeds are strided across ``"data"`` only."""
     require_axes(mesh, DATA_AXIS, MODEL_AXIS)
-    dp = mesh.shape[DATA_AXIS]
     tp = mesh.shape[MODEL_AXIS]
     if params.w1.shape[1] % tp:
         raise ValueError(f"ffn_dim {params.w1.shape[1]} not divisible by "

@@ -161,7 +161,6 @@ def train_transformer_ddp(params: TransformerParams, seeds, batch_size: int,
     """DDP: each shard trains its seed column on the full replicated model;
     grads psum per step."""
     require_axes(mesh, DATA_AXIS)
-    n = mesh.shape[DATA_AXIS]
     _validate_shapes(batch_size, seq_len, model_size, n_heads)
     attn = resolve_attn(attn_impl)
 
@@ -445,7 +444,6 @@ def train_transformer_hybrid(params: TransformerParams, seeds,
     on the transformer surface). Seeds shard strided over ``data``
     (``train_ffns.py:182``); params shard over ``model`` only."""
     require_axes(mesh, DATA_AXIS, MODEL_AXIS)
-    dp = mesh.shape[DATA_AXIS]
     n = mesh.shape[MODEL_AXIS]
     h_local = _validate_tp(params, n_heads, n)
     _validate_shapes(batch_size, seq_len, model_size, n_heads)

@@ -43,7 +43,7 @@ def test_cli_method9_verifies_every_strategy():
     assert r.returncode == 0, r.stdout + r.stderr
     for name in ("train_single", "train_ddp", "train_fsdp", "train_tp",
                  "train_hybrid", "train_pp", "train_moe_ep",
-                 "train_transformer_tp"):
+                 "train_transformer_tp", "train_moe_transformer_ep"):
         assert f"{name} takes" in r.stdout
     assert "SoftAssertionError" not in r.stdout
 
@@ -121,3 +121,12 @@ def test_graft_entry_fn_is_jittable():
 def test_graft_dryrun_multichip():
     import __graft_entry__ as g
     g.dryrun_multichip(8)  # conftest provides 8 fake CPU devices
+
+
+@pytest.mark.slow
+def test_cli_moe_transformer_method():
+    r = _run_cli("-s", "4", "-bs", "4", "-n", "8", "-l", "2", "-d", "32",
+                 "-m", "10", "-r", "3", "--fake_devices", "4", "--experts",
+                 "8", "--heads", "4", "--lr", "0.1")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "train_moe_transformer_ep takes" in r.stdout
