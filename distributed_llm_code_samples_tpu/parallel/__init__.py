@@ -5,9 +5,10 @@ Dispatch surface mirrors the reference's ``fns`` table
 DDP x TP mesh the BASELINE adds, pipeline, MoE expert parallelism, and the
 transformer trainers. Launchers share the uniform positional signature
 ``train(params, seeds, batch_size, model_size, mesh, lr) -> params``
-(SURVEY.md L4); the transformer entries additionally require keyword-only
-``seq_len``/``n_heads`` (attention needs real sequence structure), so
-generic consumers of ``STRATEGIES`` must pass those for method 8.
+(SURVEY.md L4); the transformer-family entries (methods 8 and 10)
+additionally require keyword-only ``seq_len``/``n_heads`` (attention
+needs real sequence structure), so generic consumers of ``STRATEGIES``
+must pass those for them.
 """
 
 from .mesh import (make_mesh, guard_multi_device, DATA_AXIS, MODEL_AXIS,
