@@ -352,7 +352,8 @@ def restore_checkpoint(ckpt_dir: str, target: Any, step: int | None = None,
 def run_with_checkpointing(train_fn, params, seeds, *args,
                            ckpt_dir: str, every: int = 0, resume: bool = True,
                            backend: str = "npz", seeds_divisor: int = 1,
-                           stateful: bool = False, **kwargs):
+                           stateful: bool = False, optimizer=None,
+                           thread_state: bool | None = None, **kwargs):
     """Drive any strategy launcher (uniform L4 signature,
     ``fn(params, seeds, batch, d, **kw)``) with periodic checkpointing.
 
@@ -384,22 +385,43 @@ def run_with_checkpointing(train_fn, params, seeds, *args,
             raise ValueError(
                 f"{len(seeds)} seeds do not divide across "
                 f"{seeds_divisor} data shards")
+    # with an optimizer and a trainer that supports opt_state/
+    # return_state (train_ddp), the checkpointed tree is (params,
+    # opt_state) and the state threads through each segment — an
+    # interrupted Adam run resumes its statistics exactly.
+    # thread_state=False opts a stateful trainer WITHOUT that surface
+    # (e.g. ZeRO-1's per-rank state shards) back into passing the
+    # optimizer straight through, with the resume rejection as the guard.
+    thread = optimizer is not None if thread_state is None else thread_state
+    if optimizer is not None and not thread:
+        kwargs["optimizer"] = optimizer
+        # only genuinely stateful optimizers need the resume rejection;
+        # a pass-through sgd keeps resuming exactly as before (unknown
+        # names are treated as stateful — the safe default)
+        stateful = stateful or getattr(optimizer, "name", "?") != "sgd"
+        optimizer = None
+    opt_state = optimizer.init(params) if optimizer is not None else None
+    tree = (params, opt_state) if optimizer is not None else params
+
     start = 0
     wait_pending()  # flush any in-flight native saves before reading state
     if resume and (agreed := _agreed_latest_step(ckpt_dir)) is not None:
-        if stateful and agreed > 0:
-            # only params are checkpointed: resuming/extending a partly-
-            # trained run would re-init optimizer state (mu/nu/count back
-            # to zero) and silently change the math vs an uninterrupted
-            # run. Fail loudly instead.
+        if stateful and optimizer is None and agreed > 0:
+            # only params are checkpointed on this path: resuming/extending
+            # a partly-trained run would re-init optimizer state (mu/nu/
+            # count back to zero) and silently change the math vs an
+            # uninterrupted run. Fail loudly instead.
             raise ValueError(
                 f"cannot resume a stateful-optimizer run from step "
-                f"{agreed}: optimizer state is not checkpointed, so the "
-                "continuation would restart momentum/Adam statistics from "
-                "zero; pass resume=False (--no_resume) to retrain from "
+                f"{agreed}: optimizer state is not checkpointed for this "
+                "trainer; pass resume=False (--no_resume) to retrain from "
                 "step 0, or use the stateless sgd optimizer")
-        params, start, saved = restore_checkpoint(ckpt_dir, params,
-                                                  step=agreed)
+        tree, start, saved = restore_checkpoint(ckpt_dir, tree,
+                                                step=agreed)
+        if optimizer is not None:
+            params, opt_state = tree
+        else:
+            params = tree
         if saved is not None and len(saved):
             if len(seeds) > len(saved):
                 # a longer re-run extends the saved run: completed steps keep
@@ -416,16 +438,24 @@ def run_with_checkpointing(train_fn, params, seeds, *args,
                     shutil.rmtree(os.path.join(ckpt_dir, name))
         _sync("restart-cleared")
         # publish step_0 so the schedule survives a crash in segment 1
-        save_checkpoint(ckpt_dir, params, 0, seeds, backend=backend)
+        save_checkpoint(ckpt_dir, tree, 0, seeds, backend=backend)
     total = len(seeds)
     chunk = every if every > 0 else total
     while start < total:
         n = min(chunk, total - start)
-        params = train_fn(params, seeds[start:start + n], *args, **kwargs)
-        jax.block_until_ready(params)
+        if optimizer is not None:
+            params, opt_state = train_fn(
+                params, seeds[start:start + n], *args, optimizer=optimizer,
+                opt_state=opt_state, return_state=True, **kwargs)
+            tree = (params, opt_state)
+        else:
+            params = train_fn(params, seeds[start:start + n], *args,
+                              **kwargs)
+            tree = params
+        jax.block_until_ready(tree)
         start += n
         # with backend="native" this returns immediately (buffers copied);
         # the next segment's training overlaps the disk write
-        save_checkpoint(ckpt_dir, params, start, seeds, backend=backend)
+        save_checkpoint(ckpt_dir, tree, start, seeds, backend=backend)
     wait_pending()  # durable-on-return contract for the native backend
     return params

@@ -159,14 +159,13 @@ def main(argv=None) -> int:
         print("error: --optimizer/--zero1 apply to --method 2 only",
               file=sys.stderr)
         return 2
-    if (args.optimizer != "sgd" and args.checkpoint_dir
+    if (args.zero1 and args.optimizer != "sgd" and args.checkpoint_dir
             and args.checkpoint_every):
-        # segment boundaries re-init optimizer state (only params are
-        # checkpointed), silently changing the math vs an uninterrupted
-        # run; resuming a finished/partial run is likewise rejected at
-        # run time (run_with_checkpointing stateful=True)
-        print("error: --checkpoint_every does not checkpoint momentum/adam "
-              "state; with a stateful optimizer only whole-run "
+        # ZeRO-1's per-rank state shards have no opt_state surface yet;
+        # segment boundaries would re-init them (train_ddp checkpoints
+        # its optimizer state and has no such restriction)
+        print("error: --checkpoint_every does not checkpoint ZeRO-1's "
+              "sharded optimizer state; with --zero1 only whole-run "
               "checkpoints (0) are supported", file=sys.stderr)
         return 2
 
@@ -298,13 +297,19 @@ def main(argv=None) -> int:
             if mesh is not None:
                 divisor = (mesh.shape.get(DATA_AXIS, 1)
                            * mesh.shape.get(EXPERT_AXIS, 1))
+            ck_kwargs = dict(kwargs)
+            opt = ck_kwargs.pop("optimizer", None)
+            stateful_opt = opt is not None and opt.name != "sgd"
             out = run_with_checkpointing(
                 fn, params, seeds, tokens, args.model_size,
                 ckpt_dir=os.path.join(args.checkpoint_dir, name),
                 every=args.checkpoint_every, resume=not args.no_resume,
                 seeds_divisor=divisor, backend=args.checkpoint_backend,
-                stateful=("optimizer" in kwargs
-                          and kwargs["optimizer"].name != "sgd"), **kwargs)
+                optimizer=opt,
+                # train_ddp threads (params, opt_state) through segments;
+                # ZeRO-1's sharded state has no such surface yet
+                thread_state=stateful_opt and not args.zero1,
+                stateful=stateful_opt and args.zero1, **ck_kwargs)
         else:
             out = fn(params, seeds, tokens, args.model_size, **kwargs)
         jax.block_until_ready(out)

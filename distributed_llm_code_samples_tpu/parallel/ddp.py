@@ -96,8 +96,8 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
 
 def train_ddp(params: FFNStackParams, seeds, batch_size: int,
               model_size: int, mesh, lr: float = LR, unroll: bool = True,
-              optimizer: Optimizer | None = None,
-              accum: int = 1) -> FFNStackParams:
+              optimizer: Optimizer | None = None, accum: int = 1,
+              opt_state=None, return_state: bool = False):
     """Run the full DDP schedule; returns the (replicated) final params.
 
     ``seeds`` is the *global* schedule; the strided split across ranks
@@ -106,13 +106,22 @@ def train_ddp(params: FFNStackParams, seeds, batch_size: int,
     (``optim.momentum``/``optim.adam``) with replicated state; None keeps
     the reference's inline SGD. ``accum`` gradient-accumulates each step
     over token chunks (see ``make_step``).
+
+    ``opt_state``/``return_state`` pass the optimizer state through the
+    program boundary: a resumed segment continues Adam's statistics
+    exactly where a previous segment's returned state left them (the
+    checkpoint subsystem's stateful-resume path).
     """
     require_axes(mesh, DATA_AXIS)
     step = make_step(batch_size, model_size, lr, unroll,
                      optimizer=optimizer, accum=accum)
 
-    make_carry = None
-    if optimizer is not None:
-        make_carry = lambda p: (p, optimizer.init(p))  # noqa: E731
+    if optimizer is None:
+        if return_state or opt_state is not None:
+            raise ValueError("opt_state/return_state need an optimizer")
+        return launch_strided(step, clone_params(params), seeds, mesh,
+                              DATA_AXIS, P())
+    state = optimizer.init(params) if opt_state is None else opt_state
     return launch_strided(step, clone_params(params), seeds, mesh,
-                          DATA_AXIS, P(), make_carry=make_carry)
+                          DATA_AXIS, P(), state=state, state_specs=P(),
+                          return_state=return_state)

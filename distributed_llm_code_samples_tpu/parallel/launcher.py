@@ -18,7 +18,8 @@ from jax import lax
 def launch(step: Callable, params, seeds_arr, mesh, param_specs, seed_spec,
            select_local: Callable = lambda s: s,
            make_carry: Callable | None = None,
-           check_vma: bool = True):
+           check_vma: bool = True,
+           state=None, state_specs=None, return_state: bool = False):
     """Run ``lax.scan(step)`` over the seed schedule under ``shard_map``.
 
     ``select_local`` maps the shard's view of the seed array to its 1-D
@@ -31,12 +32,33 @@ def launch(step: Callable, params, seeds_arr, mesh, param_specs, seed_spec,
     view), ``step`` then maps ``(carry, seed) -> carry``, and the carry's
     first element is returned as the final params.
 
+    Alternatively, ``state``/``state_specs`` pass explicit optimizer
+    state *through* the program boundary: the carry is ``(params,
+    state)`` and with ``return_state=True`` the final state comes back
+    out — what checkpoint/resume needs to continue an Adam run exactly.
+
     ``check_vma=False`` disables shard_map's varying-manual-axes typing for
     strategies whose replicated outputs the type system cannot prove —
     e.g. ZeRO-1's params re-assembled by ``all_gather`` from
     ``axis_index``-sliced shards (identical by construction on every
     rank, but typed varying; JAX offers no varying->invariant cast).
     """
+
+    if state is not None:
+        def run_state(params, state, seeds):
+            local = select_local(seeds)
+            out = lax.scan(lambda c, s: (step(c, s), None),
+                           (params, state), local)[0]
+            return out if return_state else out[0]
+
+        out_specs = ((param_specs, state_specs) if return_state
+                     else param_specs)
+        run_sharded = jax.shard_map(
+            run_state, mesh=mesh,
+            in_specs=(param_specs, state_specs, seed_spec),
+            out_specs=out_specs, check_vma=check_vma)
+        return jax.jit(run_sharded, donate_argnums=(0, 1))(params, state,
+                                                           seeds_arr)
 
     def run(params, seeds):
         local = select_local(seeds)

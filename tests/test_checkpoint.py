@@ -265,14 +265,16 @@ def test_stateful_resume_is_rejected(tmp_path, mesh4, params):
     seeds = make_seed_schedule(8, random_seed=5)
     ck = str(tmp_path / "ck")
     run_with_checkpointing(train_ddp, params, seeds, tokens, d, ckpt_dir=ck,
-                           stateful=True, seeds_divisor=4, mesh=mesh4,
+                           thread_state=False, seeds_divisor=4, mesh=mesh4,
                            lr=0.1, optimizer=adam())
     # extending the finished run must refuse to resume with fresh state
+    # (thread_state=False models trainers without the opt_state surface)
     longer = make_seed_schedule(16, random_seed=5)
     with pytest.raises(ValueError, match="stateful"):
         run_with_checkpointing(train_ddp, params, longer, tokens, d,
-                               ckpt_dir=ck, stateful=True, seeds_divisor=4,
-                               mesh=mesh4, lr=0.1, optimizer=adam())
+                               ckpt_dir=ck, thread_state=False,
+                               seeds_divisor=4, mesh=mesh4, lr=0.1,
+                               optimizer=adam())
 
 
 def test_native_backend_is_async_and_exact(tmp_path, params, mesh4):
@@ -315,3 +317,35 @@ def test_native_backend_bfloat16_leaves(tmp_path):
     assert got.w1.dtype == jnp.bfloat16
     np.testing.assert_array_equal(np.asarray(got.w1, dtype=np.float32),
                                   np.asarray(p.w1, dtype=np.float32))
+
+
+def test_stateful_checkpoint_resume_is_exact(tmp_path, mesh4, params):
+    """With optimizer= given, the checkpoint tree is (params, opt_state)
+    and a kill-and-resume Adam run equals the uninterrupted one — the
+    statistics continue, they don't re-init (closes the stateful-resume
+    rejection)."""
+    from distributed_llm_code_samples_tpu.optim import adam
+    tokens, d = 32, 16
+    seeds = make_seed_schedule(8, random_seed=5)
+    # uninterrupted oracle: one segmented run with state threading
+    ck_a = str(tmp_path / "full")
+    full = run_with_checkpointing(train_ddp, params, seeds, tokens, d,
+                                  ckpt_dir=ck_a, every=0, optimizer=adam(),
+                                  seeds_divisor=4, mesh=mesh4, lr=0.1)
+    # interrupted: first half, checkpoint at 4, then resume the full run
+    ck_b = str(tmp_path / "interrupted")
+    run_with_checkpointing(train_ddp, params, seeds[:4], tokens, d,
+                           ckpt_dir=ck_b, every=4, optimizer=adam(),
+                           seeds_divisor=4, mesh=mesh4, lr=0.1)
+    out = run_with_checkpointing(train_ddp, params, seeds, tokens, d,
+                                 ckpt_dir=ck_b, every=4, optimizer=adam(),
+                                 seeds_divisor=4, mesh=mesh4, lr=0.1)
+    np.testing.assert_allclose(np.asarray(out.w1), np.asarray(full.w1),
+                               rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(out.w2), np.asarray(full.w2),
+                               rtol=1e-6, atol=1e-7)
+    # and segmented == one-shot train_ddp with the same optimizer
+    oneshot = train_ddp(params, seeds, tokens, d, mesh4, lr=0.1,
+                        optimizer=adam())
+    np.testing.assert_allclose(np.asarray(out.w1), np.asarray(oneshot.w1),
+                               rtol=1e-6, atol=1e-7)
