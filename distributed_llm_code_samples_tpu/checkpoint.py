@@ -385,20 +385,17 @@ def run_with_checkpointing(train_fn, params, seeds, *args,
             raise ValueError(
                 f"{len(seeds)} seeds do not divide across "
                 f"{seeds_divisor} data shards")
-    # with an optimizer and a trainer that supports opt_state/
-    # return_state (train_ddp), the checkpointed tree is (params,
-    # opt_state) and the state threads through each segment — an
-    # interrupted Adam run resumes its statistics exactly.
-    # thread_state=False opts a stateful trainer WITHOUT that surface
-    # (e.g. ZeRO-1's per-rank state shards) back into passing the
-    # optimizer straight through, with the resume rejection as the guard.
-    thread = optimizer is not None if thread_state is None else thread_state
+    # with an optimizer AND thread_state=True (opt-in: the trainer must
+    # support the opt_state/return_state surface, e.g. train_ddp), the
+    # checkpointed tree is (params, opt_state) and the state threads
+    # through each segment — an interrupted Adam run resumes its
+    # statistics exactly. Otherwise the optimizer passes straight through
+    # to the trainer and the resume rejection guards genuinely stateful
+    # rules (Optimizer.stateless is the single source of truth).
+    thread = bool(thread_state)
     if optimizer is not None and not thread:
         kwargs["optimizer"] = optimizer
-        # only genuinely stateful optimizers need the resume rejection;
-        # a pass-through sgd keeps resuming exactly as before (unknown
-        # names are treated as stateful — the safe default)
-        stateful = stateful or getattr(optimizer, "name", "?") != "sgd"
+        stateful = stateful or not getattr(optimizer, "stateless", False)
         optimizer = None
     opt_state = optimizer.init(params) if optimizer is not None else None
     tree = (params, opt_state) if optimizer is not None else params

@@ -133,10 +133,6 @@ def main(argv=None) -> int:
     from .parallel import (make_mesh, guard_multi_device, STRATEGIES,
                            DATA_AXIS, MODEL_AXIS, PIPE_AXIS, EXPERT_AXIS)
 
-    if args.zero1 and args.accum > 1:
-        print("error: --accum is not supported with --zero1",
-              file=sys.stderr)
-        return 2
     if args.accum < 1:
         print(f"error: --accum must be >= 1 (got {args.accum})",
               file=sys.stderr)
@@ -255,7 +251,7 @@ def main(argv=None) -> int:
         mesh = mesh_for(m)
         kwargs = dict(lr=lr, unroll=unroll)
         if m in (1, 2) and args.accum > 1:
-            kwargs["accum"] = args.accum
+            kwargs["accum"] = args.accum  # train_ddp_zero1 accepts it too
         if m == 2 and (args.optimizer != "sgd" or args.zero1):
             from .optim import OPTIMIZERS
             kwargs["optimizer"] = OPTIMIZERS[args.optimizer]()
@@ -299,7 +295,7 @@ def main(argv=None) -> int:
                            * mesh.shape.get(EXPERT_AXIS, 1))
             ck_kwargs = dict(kwargs)
             opt = ck_kwargs.pop("optimizer", None)
-            stateful_opt = opt is not None and opt.name != "sgd"
+            stateful_opt = opt is not None and not opt.stateless
             out = run_with_checkpointing(
                 fn, params, seeds, tokens, args.model_size,
                 ckpt_dir=os.path.join(args.checkpoint_dir, name),

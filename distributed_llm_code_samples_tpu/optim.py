@@ -36,10 +36,14 @@ def sgd(params, grads, lr: float = LR):
 
 class Optimizer(NamedTuple):
     """A functional optimizer: ``init(params) -> state`` and
-    ``update(grads, state, params, lr) -> (new_params, new_state)``."""
+    ``update(grads, state, params, lr) -> (new_params, new_state)``.
+    ``stateless=True`` marks an empty-state rule (plain SGD): the
+    checkpoint layer uses it to decide whether a resume without saved
+    state would change the math."""
     init: Callable[[Any], Any]
     update: Callable[[Any, Any, Any, float], tuple]
     name: str = "optimizer"
+    stateless: bool = False
 
 
 def sgd_optimizer() -> Optimizer:
@@ -48,7 +52,8 @@ def sgd_optimizer() -> Optimizer:
     semantics."""
     def update(grads, state, params, lr):
         return sgd(params, grads, lr), state
-    return Optimizer(init=lambda params: (), update=update, name="sgd")
+    return Optimizer(init=lambda params: (), update=update, name="sgd",
+                     stateless=True)
 
 
 def momentum(beta: float = 0.9) -> Optimizer:

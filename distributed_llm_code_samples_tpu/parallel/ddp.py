@@ -46,11 +46,21 @@ def grads_for_batch(params: FFNStackParams, x, dy, unroll: bool = True,
 
 
 def local_grads(params: FFNStackParams, seed, batch_size: int,
-                model_size: int, unroll: bool = True, grad_hook=None):
-    """One shard's step grads from its seed (see ``grads_for_batch``)."""
+                model_size: int, unroll: bool = True, grad_hook=None,
+                accum: int = 1):
+    """One shard's step grads from its seed (see ``grads_for_batch``).
+
+    ``accum > 1`` sums over token chunks (``ops.stack.accumulated_grads``)
+    — UNREDUCED: the hook does not apply on this path, so the caller
+    reduces the summed grads once (DDP all_reduce / ZeRO-1 reduce_scatter).
+    """
     x, dloss_dx = batch_from_seed(seed, batch_size, model_size,
                                   params.w1.dtype)
-    return grads_for_batch(params, x, dloss_dx, unroll, grad_hook)
+    if accum == 1:
+        return grads_for_batch(params, x, dloss_dx, unroll, grad_hook)
+    return accumulated_grads(
+        lambda x, dy: grads_for_batch(params, x, dy, unroll),
+        x, dloss_dx, accum)
 
 
 def make_step(batch_size: int, model_size: int, lr: float = LR,
@@ -77,11 +87,8 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
         if accum == 1:
             return local_grads(params, seed, batch_size, model_size,
                                unroll, grad_hook)
-        x, dy = batch_from_seed(seed, batch_size, model_size,
-                                params.w1.dtype)
-        total = accumulated_grads(
-            lambda x, dy: grads_for_batch(params, x, dy, unroll),
-            x, dy, accum)
+        total = local_grads(params, seed, batch_size, model_size, unroll,
+                            accum=accum)
         return jax.tree_util.tree_map(lambda g: all_reduce(g, axis), total)
 
     def step(params: FFNStackParams, seed) -> FFNStackParams:

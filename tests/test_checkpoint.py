@@ -331,15 +331,18 @@ def test_stateful_checkpoint_resume_is_exact(tmp_path, mesh4, params):
     ck_a = str(tmp_path / "full")
     full = run_with_checkpointing(train_ddp, params, seeds, tokens, d,
                                   ckpt_dir=ck_a, every=0, optimizer=adam(),
-                                  seeds_divisor=4, mesh=mesh4, lr=0.1)
+                                  thread_state=True, seeds_divisor=4,
+                                  mesh=mesh4, lr=0.1)
     # interrupted: first half, checkpoint at 4, then resume the full run
     ck_b = str(tmp_path / "interrupted")
     run_with_checkpointing(train_ddp, params, seeds[:4], tokens, d,
                            ckpt_dir=ck_b, every=4, optimizer=adam(),
-                           seeds_divisor=4, mesh=mesh4, lr=0.1)
+                           thread_state=True, seeds_divisor=4, mesh=mesh4,
+                           lr=0.1)
     out = run_with_checkpointing(train_ddp, params, seeds, tokens, d,
                                  ckpt_dir=ck_b, every=4, optimizer=adam(),
-                                 seeds_divisor=4, mesh=mesh4, lr=0.1)
+                                 thread_state=True, seeds_divisor=4,
+                                 mesh=mesh4, lr=0.1)
     np.testing.assert_allclose(np.asarray(out.w1), np.asarray(full.w1),
                                rtol=1e-6, atol=1e-7)
     np.testing.assert_allclose(np.asarray(out.w2), np.asarray(full.w2),

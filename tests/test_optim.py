@@ -227,3 +227,12 @@ def test_constant_with_warmup_shape():
     np.testing.assert_allclose(lrs[:4], [0.125, 0.25, 0.375, 0.5],
                                rtol=1e-6)
     np.testing.assert_allclose(lrs[4:], [0.5] * 4, rtol=1e-6)
+
+
+def test_zero1_accumulation_matches_full_batch(setup, mesh4):
+    from distributed_llm_code_samples_tpu.optim import adam
+    params, seeds = setup
+    full = train_ddp_zero1(params, seeds, B, D, mesh4, optimizer=adam())
+    acc = train_ddp_zero1(params, seeds, B, D, mesh4, optimizer=adam(),
+                          accum=4)
+    _assert_close(full, acc)
