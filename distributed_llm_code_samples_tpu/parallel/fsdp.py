@@ -37,7 +37,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from .. import LR
 from ..data import batch_from_seed
 from ..models.ffn_stack import FFNStackParams, reshard_copy
-from ..optim import sgd
+from ..optim import Optimizer, sgd
 from ..ops.ffn import ffn_fwd, ffn_bwd
 from ..ops.stack import stack_fwd, stack_bwd
 from .collectives import all_gather, reduce_scatter
@@ -58,8 +58,14 @@ def shard_params(params: FFNStackParams, mesh) -> FFNStackParams:
 
 
 def make_step(batch_size: int, model_size: int, lr: float = LR,
-              unroll: bool = True, axis: str = DATA_AXIS):
-    """One FSDP step for one shard (operates on local shard views)."""
+              unroll: bool = True, axis: str = DATA_AXIS,
+              optimizer: Optimizer | None = None):
+    """One FSDP step for one shard (operates on local shard views).
+
+    With ``optimizer``, its state is created from — and lives as — the
+    LOCAL param shards: ZeRO-3's full story (params, grads, AND
+    optimizer state all 1/n per device; the state never needs a
+    collective because the sharded update is elementwise)."""
 
     def gather(w1_shard, w2_shard):
         # train_ffns.py:200-225 — async all_gather of both params of a layer;
@@ -83,7 +89,7 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
         return (reduce_scatter(dw1, axis, dim=0),
                 reduce_scatter(dw2, axis, dim=0))
 
-    def step(params: FFNStackParams, seed) -> FFNStackParams:
+    def local_grads_of(params, seed):
         x, dloss_dx = batch_from_seed(seed, batch_size, model_size,
                                       params.w1.dtype)
         _, acts = stack_fwd(params.w1, params.w2, x, block_fwd=block_fwd,
@@ -91,18 +97,28 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
         _, (g1, g2) = stack_bwd(dloss_dx, params.w1, params.w2, acts,
                                 block_bwd=block_bwd, grad_hook=grad_hook,
                                 unroll=unroll)
-        # Sharded SGD on the local chunk only (train_ffns.py:258-259).
-        return sgd(params, FFNStackParams(g1, g2), lr)
+        return FFNStackParams(g1, g2)
 
-    return step
+    def step(params: FFNStackParams, seed) -> FFNStackParams:
+        # Sharded SGD on the local chunk only (train_ffns.py:258-259).
+        return sgd(params, local_grads_of(params, seed), lr)
+
+    def step_opt(carry, seed):
+        params, state = carry
+        return optimizer.update(local_grads_of(params, seed), state,
+                                params, lr)
+
+    return step if optimizer is None else step_opt
 
 
 def train_fsdp(params: FFNStackParams, seeds, batch_size: int,
-               model_size: int, mesh, lr: float = LR,
-               unroll: bool = True) -> FFNStackParams:
+               model_size: int, mesh, lr: float = LR, unroll: bool = True,
+               optimizer: Optimizer | None = None) -> FFNStackParams:
     """Run the full FSDP schedule; returns final params as a global array
     (re-assembly is implicit in the output sharding — no host-side concat
-    like ``train_ffns.py:284-287`` is needed)."""
+    like ``train_ffns.py:284-287`` is needed). ``optimizer`` runs a
+    stateful update on the local shards — the optimizer state inherits
+    the 1/n param sharding (full ZeRO-3)."""
     require_axes(mesh, DATA_AXIS)
     n = mesh.shape[DATA_AXIS]
     if params.w1.shape[1] % n or params.w2.shape[1] % n:
@@ -111,7 +127,12 @@ def train_fsdp(params: FFNStackParams, seeds, batch_size: int,
             f"divisible by {n} shards (the reference's chunk() had the same "
             "implicit requirement)")
     params = shard_params(params, mesh)
-    step = make_step(batch_size, model_size, lr, unroll)
+    step = make_step(batch_size, model_size, lr, unroll,
+                     optimizer=optimizer)
 
+    make_carry = None
+    if optimizer is not None:
+        # state built from the LOCAL shard views inside shard_map
+        make_carry = lambda p: (p, optimizer.init(p))  # noqa: E731
     return launch_strided(step, params, seeds, mesh, DATA_AXIS,
-                          PARAM_SPECS)
+                          PARAM_SPECS, make_carry=make_carry)
