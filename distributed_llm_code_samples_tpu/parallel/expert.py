@@ -55,8 +55,8 @@ from ..ops.moe import (dispatch_tensor, dispatch_tensor_topk,
                        route_topk, router_aux_loss)
 from ..optim import sgd
 from .collectives import all_to_all, grad_reduce
-from .launcher import launch_strided
-from .mesh import EXPERT_AXIS, require_axes
+from .launcher import launch, launch_strided
+from .mesh import DATA_AXIS, EXPERT_AXIS, require_axes
 
 
 def _local_capacity(t_local: int, n_shards: int, n_experts: int,
@@ -99,7 +99,8 @@ def moe_layer_ep(wg, w1_local, w2_local, x, capacity_factor: float = 2.0,
 
 def make_step(batch_size: int, model_size: int, lr: float = LR,
               capacity_factor: float = 2.0, axis: str = EXPERT_AXIS,
-              k: int = 1, aux_coef: float = 0.0):
+              k: int = 1, aux_coef: float = 0.0,
+              data_axis: str | None = None):
     """One EP step for one shard: local fwd (residual per layer),
     ``jax.vjp``-composed backward over the hand-written rules, optional
     load-balancing aux term, explicit router-grad psum, local SGD.
@@ -107,6 +108,8 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
     Fwd and aux come from ONE stack walk returning ``(y, aux)``; the
     combined gradient is a single vjp with cotangents
     ``(dloss_dx, aux_coef)`` — no second forward, no duplicated a2a."""
+
+    axes = (axis,) if data_axis is None else (axis, data_axis)
 
     def fwd_aux(params: MoEStackParams, x):
         aux = jnp.asarray(0.0, jnp.float32)
@@ -121,14 +124,22 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
                                       params.w1.dtype)
         _, vjp = jax.vjp(lambda p: fwd_aux(p, x), params)
         # the aux output is shard-varying under shard_map; its cotangent
-        # (the constant aux coefficient) must be cast to match
-        coef = lax.pcast(jnp.asarray(aux_coef, jnp.float32), axis,
+        # (the constant aux coefficient) must be cast to match — over
+        # every axis the aux varies on (a 2-D mesh adds "data")
+        coef = lax.pcast(jnp.asarray(aux_coef, jnp.float32), axes,
                          to="varying")
         grads = vjp((dloss_dx, coef))[0]
         # router is replicated; its per-shard partial grads sum across the
-        # expert axis (train_ffns.py:165 semantics). Expert grads are
-        # already complete on their owner shard.
-        grads = grads._replace(wg=grad_reduce(grads.wg, axis))
+        # expert axis (train_ffns.py:165 semantics) — and across the data
+        # axis on a 2-D mesh. Expert grads are complete on their owner
+        # shard within an EP group; the data axis replicates the groups,
+        # so they too sum over data (grad_reduce is vma-aware: it never
+        # touches the expert axis for them).
+        grads = grads._replace(wg=grad_reduce(grads.wg, axes))
+        if data_axis is not None:
+            grads = grads._replace(
+                w1=grad_reduce(grads.w1, data_axis),
+                w2=grad_reduce(grads.w2, data_axis))
         return sgd(params, grads, lr)
 
     return step
@@ -140,14 +151,22 @@ def train_moe_ep(params: MoEStackParams, seeds, batch_size: int,
                  aux_coef: float = 0.0) -> MoEStackParams:
     """Run the EP schedule; returns fully-assembled final params.
 
-    ``batch_size`` is the *global* token count per step; each shard routes
-    ``batch_size/n`` tokens (data and experts shard over the same axis).
-    Seeds shard stride-wise like the DP strategies (``train_ffns.py:182``).
-    ``k`` selects top-k routing; ``aux_coef`` scales the Switch
-    load-balancing loss into the router gradients.
+    ``batch_size`` is the *global token count per EP group* per step; each
+    shard routes ``batch_size/n`` tokens (data and experts shard over the
+    same axis). Seeds shard stride-wise like the DP strategies
+    (``train_ffns.py:182``). ``k`` selects top-k routing; ``aux_coef``
+    scales the Switch load-balancing loss into the router gradients.
+
+    A 2-D ``(data, expert)`` mesh replicates the EP group ``dp`` times
+    (DDP-style): seeds stride over the flattened ``dp x n`` grid, each
+    replica routes independently with its own group capacities, and
+    router/expert grads take one extra ``psum`` over the data axis.
+    Exactly ``train_moe_dense(batch_size*dp, n_groups=dp*n,
+    capacity_groups=n)`` — the differential test.
     """
     require_axes(mesh, EXPERT_AXIS)
     n = mesh.shape[EXPERT_AXIS]
+    dp = dict(mesh.shape).get(DATA_AXIS, 1)
     if params.n_experts % n != 0:
         raise ValueError(f"n_experts={params.n_experts} not divisible by "
                          f"expert-axis size {n}")
@@ -155,9 +174,20 @@ def train_moe_ep(params: MoEStackParams, seeds, batch_size: int,
         raise ValueError(f"batch_size={batch_size} not divisible by "
                          f"expert-axis size {n}")
     step = make_step(batch_size // n, model_size, lr, capacity_factor,
-                     k=k, aux_coef=aux_coef)
+                     k=k, aux_coef=aux_coef,
+                     data_axis=DATA_AXIS if dp > 1 else None)
     specs = MoEStackParams(wg=P(), w1=P(None, EXPERT_AXIS),
                            w2=P(None, EXPERT_AXIS))
+    if dp > 1:
+        # 2-D data x expert: the seed schedule strides over BOTH axes —
+        # shard (d, e) of step t consumes seeds[t*dp*n + d*n + e], the
+        # flat strided order the grouped dense oracle reproduces with
+        # n_groups=dp*n
+        cols = shard_seeds_strided(seeds, dp * n).reshape(-1, dp, n)
+        return launch(step, clone_params(params), cols, mesh,
+                      param_specs=specs,
+                      seed_spec=P(None, DATA_AXIS, EXPERT_AXIS),
+                      select_local=lambda s: s[:, 0, 0])
     return launch_strided(step, clone_params(params), seeds, mesh,
                           EXPERT_AXIS, specs)
 
@@ -165,8 +195,8 @@ def train_moe_ep(params: MoEStackParams, seeds, batch_size: int,
 def train_moe_dense(params: MoEStackParams, seeds, batch_size: int,
                     model_size: int, lr: float = LR,
                     capacity_factor: float = 2.0, k: int = 1,
-                    aux_coef: float = 0.0,
-                    n_groups: int = 1) -> MoEStackParams:
+                    aux_coef: float = 0.0, n_groups: int = 1,
+                    capacity_groups: int | None = None) -> MoEStackParams:
     """Single-device dense MoE trainer with EP's exact semantics — no mesh,
     no collectives; the user-facing oracle for ``train_moe_ep``.
 
@@ -185,8 +215,13 @@ def train_moe_dense(params: MoEStackParams, seeds, batch_size: int,
         raise ValueError(f"batch_size={batch_size} not divisible by "
                          f"n_groups={n_groups}")
     t_local = batch_size // n_groups
-    cap = _local_capacity(t_local, n_groups, params.n_experts,
-                          capacity_factor)
+    # capacity_groups: EP derives each group's slot share from its OWN
+    # EP-group size (the expert-axis extent) — on a 2-D data x expert
+    # mesh that is n_expert_shards, not the total dp*n group count
+    cap = _local_capacity(t_local,
+                          capacity_groups if capacity_groups is not None
+                          else n_groups,
+                          params.n_experts, capacity_factor)
     rows = shard_seeds_strided(seeds, n_groups)  # [global_steps, n_groups]
 
     def fwd_aux(p, xs):  # xs [n_groups, t_local, d]

@@ -32,6 +32,7 @@ model per shard. Verified by compiled memory analysis in the test suite.
 
 from __future__ import annotations
 
+import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import LR
@@ -113,12 +114,17 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
 
 def train_fsdp(params: FFNStackParams, seeds, batch_size: int,
                model_size: int, mesh, lr: float = LR, unroll: bool = True,
-               optimizer: Optimizer | None = None) -> FFNStackParams:
+               optimizer: Optimizer | None = None, opt_state=None,
+               return_state: bool = False):
     """Run the full FSDP schedule; returns final params as a global array
     (re-assembly is implicit in the output sharding — no host-side concat
     like ``train_ffns.py:284-287`` is needed). ``optimizer`` runs a
     stateful update on the local shards — the optimizer state inherits
-    the 1/n param sharding (full ZeRO-3)."""
+    the 1/n param sharding (full ZeRO-3). ``opt_state``/``return_state``
+    thread the state through the program boundary (same checkpoint
+    surface as ``train_ddp``); state leaves must be params-like (they
+    take the param sharding) or scalars (replicated) — true of every
+    optimizer in ``optim.py``."""
     require_axes(mesh, DATA_AXIS)
     n = mesh.shape[DATA_AXIS]
     if params.w1.shape[1] % n or params.w2.shape[1] % n:
@@ -130,9 +136,18 @@ def train_fsdp(params: FFNStackParams, seeds, batch_size: int,
     step = make_step(batch_size, model_size, lr, unroll,
                      optimizer=optimizer)
 
-    make_carry = None
-    if optimizer is not None:
-        # state built from the LOCAL shard views inside shard_map
-        make_carry = lambda p: (p, optimizer.init(p))  # noqa: E731
+    if optimizer is None:
+        if return_state or opt_state is not None:
+            raise ValueError("opt_state/return_state need an optimizer")
+        return launch_strided(step, params, seeds, mesh, DATA_AXIS,
+                              PARAM_SPECS)
+    # zeros_like of the sharded params keeps their sharding, so the state
+    # enters shard_map already 1/n per device; scalar leaves replicate
+    state = optimizer.init(params) if opt_state is None else opt_state
+    state_specs = jax.tree_util.tree_map(
+        lambda l: P(None, DATA_AXIS, None) if getattr(l, "ndim", 0) == 3
+        else P(), state)
     return launch_strided(step, params, seeds, mesh, DATA_AXIS,
-                          PARAM_SPECS, make_carry=make_carry)
+                          PARAM_SPECS, state=state,
+                          state_specs=state_specs,
+                          return_state=return_state)

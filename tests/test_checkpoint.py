@@ -352,3 +352,27 @@ def test_stateful_checkpoint_resume_is_exact(tmp_path, mesh4, params):
                         optimizer=adam())
     np.testing.assert_allclose(np.asarray(out.w1), np.asarray(oneshot.w1),
                                rtol=1e-6, atol=1e-7)
+
+
+def test_stateful_fsdp_checkpoint_resume_is_exact(tmp_path, mesh4, params):
+    """Full ZeRO-3 resume: the SHARDED Adam state rides the (params,
+    opt_state) checkpoint tree through kill-and-resume."""
+    from distributed_llm_code_samples_tpu.optim import adam
+    from distributed_llm_code_samples_tpu.parallel import train_fsdp
+    tokens, d = 32, 16
+    seeds = make_seed_schedule(8, random_seed=5)
+    oneshot = train_fsdp(params, seeds, tokens, d, mesh4, lr=0.1,
+                         optimizer=adam())
+    ck = str(tmp_path / "fsdp_ck")
+    run_with_checkpointing(train_fsdp, params, seeds[:4], tokens, d,
+                           ckpt_dir=ck, every=4, optimizer=adam(),
+                           thread_state=True, seeds_divisor=4, mesh=mesh4,
+                           lr=0.1)
+    out = run_with_checkpointing(train_fsdp, params, seeds, tokens, d,
+                                 ckpt_dir=ck, every=4, optimizer=adam(),
+                                 thread_state=True, seeds_divisor=4,
+                                 mesh=mesh4, lr=0.1)
+    np.testing.assert_allclose(np.asarray(out.w1), np.asarray(oneshot.w1),
+                               rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(out.w2), np.asarray(oneshot.w2),
+                               rtol=1e-6, atol=1e-7)

@@ -319,3 +319,25 @@ def test_train_moe_dense_global_capacity_differs_from_grouped(params):
     dense4 = train_moe_dense(params, seeds, 4 * T, D, n_groups=4, **kwargs)
     assert not np.allclose(np.asarray(dense1.w1), np.asarray(dense4.w1),
                            rtol=1e-5, atol=1e-7)
+
+
+@pytest.mark.parametrize("k,aux_coef,cf", [(1, 0.0, 2.0), (2, 0.01, 2.0),
+                                           (1, 0.0, 0.5)])
+def test_ep_composes_with_data_parallel(params, k, aux_coef, cf):
+    """2-D data x expert mesh: dp DDP-style replicas of the EP group,
+    seeds strided over the flat dp x n grid, grads psum'd over data. ==
+    the grouped dense oracle with per-EP-group capacities
+    (capacity_groups=n), including under overflow pressure (cf=0.5)."""
+    dp, n = 2, 4
+    seeds = make_seed_schedule(2 * dp * n, random_seed=9)
+    tokens = n * T  # per EP group per step
+    mesh = make_mesh({"data": dp, EXPERT_AXIS: n})
+    got = train_moe_ep(params, seeds, tokens, D, mesh, lr=0.1, k=k,
+                       aux_coef=aux_coef, capacity_factor=cf)
+    want = train_moe_dense(params, seeds, tokens * dp, D, lr=0.1, k=k,
+                           aux_coef=aux_coef, capacity_factor=cf,
+                           n_groups=dp * n, capacity_groups=n)
+    for f in MoEStackParams._fields:
+        np.testing.assert_allclose(np.asarray(getattr(got, f)),
+                                   np.asarray(getattr(want, f)),
+                                   rtol=2e-4, atol=1e-5, err_msg=f)
