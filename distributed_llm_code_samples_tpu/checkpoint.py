@@ -353,7 +353,8 @@ def run_with_checkpointing(train_fn, params, seeds, *args,
                            ckpt_dir: str, every: int = 0, resume: bool = True,
                            backend: str = "npz", seeds_divisor: int = 1,
                            stateful: bool = False, optimizer=None,
-                           thread_state: bool | None = None, **kwargs):
+                           thread_state: bool | None = None,
+                           restore_shardings=None, **kwargs):
     """Drive any strategy launcher (uniform L4 signature,
     ``fn(params, seeds, batch, d, **kw)``) with periodic checkpointing.
 
@@ -413,8 +414,12 @@ def run_with_checkpointing(train_fn, params, seeds, *args,
                 f"{agreed}: optimizer state is not checkpointed for this "
                 "trainer; pass resume=False (--no_resume) to retrain from "
                 "step 0, or use the stateless sgd optimizer")
-        tree, start, saved = restore_checkpoint(ckpt_dir, tree,
-                                                step=agreed)
+        # restore_shardings: place restored leaves straight onto their
+        # mesh layout (FSDP's 1/n shards, fsdp.checkpoint_shardings) —
+        # without it a big resume materializes params + full Adam state
+        # replicated on one device, the spike FSDP exists to avoid
+        tree, start, saved = restore_checkpoint(
+            ckpt_dir, tree, step=agreed, shardings=restore_shardings)
         if optimizer is not None:
             params, opt_state = tree
         else:

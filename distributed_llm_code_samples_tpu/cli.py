@@ -59,7 +59,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="override LR (default 1e-5, train_ffns.py:29)")
     p.add_argument("--optimizer", choices=["sgd", "momentum", "adam"],
                    default="sgd",
-                   help="update rule for --method 2 (DDP): sgd is the "
+                   help="update rule for --method 2 (DDP) or 3 (FSDP, "
+                        "state sharded with the params): sgd is the "
                         "reference's stateless inline update; momentum/"
                         "adam carry hand-written optimizer state")
     p.add_argument("--tp_sp", action="store_true",
@@ -149,10 +150,13 @@ def main(argv=None) -> int:
         print("error: --tp_sp applies to --method 4 or 8 only",
               file=sys.stderr)
         return 2
-    if (args.optimizer != "sgd" or args.zero1) and args.method != 2:
-        # methods 0/9 cross-check DDP against strategies that would still
-        # run inline SGD — a guaranteed spurious differential failure
-        print("error: --optimizer/--zero1 apply to --method 2 only",
+    if args.zero1 and args.method != 2:
+        print("error: --zero1 applies to --method 2 only", file=sys.stderr)
+        return 2
+    if args.optimizer != "sgd" and args.method not in (2, 3):
+        # methods 0/9 cross-check against strategies that would still run
+        # inline SGD — a guaranteed spurious differential failure
+        print("error: --optimizer applies to --method 2 or 3 only",
               file=sys.stderr)
         return 2
     if (args.zero1 and args.optimizer != "sgd" and args.checkpoint_dir
@@ -252,7 +256,7 @@ def main(argv=None) -> int:
         kwargs = dict(lr=lr, unroll=unroll)
         if m in (1, 2) and args.accum > 1:
             kwargs["accum"] = args.accum  # train_ddp_zero1 accepts it too
-        if m == 2 and (args.optimizer != "sgd" or args.zero1):
+        if m in (2, 3) and (args.optimizer != "sgd" or args.zero1):
             from .optim import OPTIMIZERS
             kwargs["optimizer"] = OPTIMIZERS[args.optimizer]()
             if args.zero1:

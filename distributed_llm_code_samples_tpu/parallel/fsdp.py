@@ -58,6 +58,24 @@ def shard_params(params: FFNStackParams, mesh) -> FFNStackParams:
         w2=NamedSharding(mesh, PARAM_SPECS.w2)))
 
 
+def checkpoint_shardings(params: FFNStackParams, optimizer: Optimizer,
+                         mesh):
+    """The ``(params, opt_state)`` sharding tree for
+    ``run_with_checkpointing(restore_shardings=...)``: a resume restores
+    each leaf straight onto its 1/n mesh layout instead of transiently
+    materializing the full replicated params + Adam moments on one
+    device (exactly the spike FSDP exists to avoid)."""
+    pspec = jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec), PARAM_SPECS,
+        is_leaf=lambda v: isinstance(v, P))
+    state_shapes = jax.eval_shape(optimizer.init, params)
+    sspec = jax.tree_util.tree_map(
+        lambda l: NamedSharding(
+            mesh, P(None, DATA_AXIS, None) if l.ndim == 3 else P()),
+        state_shapes)
+    return (pspec, sspec)
+
+
 def make_step(batch_size: int, model_size: int, lr: float = LR,
               unroll: bool = True, axis: str = DATA_AXIS,
               optimizer: Optimizer | None = None):

@@ -368,10 +368,13 @@ def test_stateful_fsdp_checkpoint_resume_is_exact(tmp_path, mesh4, params):
                            ckpt_dir=ck, every=4, optimizer=adam(),
                            thread_state=True, seeds_divisor=4, mesh=mesh4,
                            lr=0.1)
-    out = run_with_checkpointing(train_fsdp, params, seeds, tokens, d,
-                                 ckpt_dir=ck, every=4, optimizer=adam(),
-                                 thread_state=True, seeds_divisor=4,
-                                 mesh=mesh4, lr=0.1)
+    from distributed_llm_code_samples_tpu.parallel.fsdp import (
+        checkpoint_shardings)
+    out = run_with_checkpointing(
+        train_fsdp, params, seeds, tokens, d, ckpt_dir=ck, every=4,
+        optimizer=adam(), thread_state=True, seeds_divisor=4, mesh=mesh4,
+        lr=0.1,
+        restore_shardings=checkpoint_shardings(params, adam(), mesh4))
     np.testing.assert_allclose(np.asarray(out.w1), np.asarray(oneshot.w1),
                                rtol=1e-6, atol=1e-7)
     np.testing.assert_allclose(np.asarray(out.w2), np.asarray(oneshot.w2),
