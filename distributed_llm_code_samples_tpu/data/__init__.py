@@ -51,6 +51,20 @@ def mock_data(seeds, batch_size: int, model_size: int, dtype=jnp.float32):
         yield batch_from_seed(jnp.int32(seed), batch_size, model_size, dtype)
 
 
+def lm_batch_from_seed(seed: jax.Array, batch: int, seq_len: int,
+                       vocab: int):
+    """One LM step's ``(tokens, targets)`` from its integer seed: a
+    deterministic ``[batch, seq_len + 1]`` token draw, split next-token
+    style (``targets`` = ``tokens`` shifted left by one). Same counter-RNG
+    contract as ``batch_from_seed`` — bit-identical on every rank, traced
+    or eager — so the LM strategies keep the framework's seeds-as-dataset
+    differential-testing story."""
+    key = jax.random.fold_in(jax.random.PRNGKey(_DATA_KEY), seed)
+    toks = jax.random.randint(key, (batch, seq_len + 1), 0, vocab,
+                              dtype=jnp.int32)
+    return toks[:, :-1], toks[:, 1:]
+
+
 def make_seed_schedule(num_steps: int, random_seed: int = 0) -> jnp.ndarray:
     """``num_steps`` integer seeds in ``[0, 100_000)`` (``train_ffns.py:360``).
 

@@ -10,10 +10,14 @@ from .moe_transformer import (MoETransformerParams,
                               moe_transformer_fwd_aux)
 from .transformer import (TransformerParams, init_transformer,
                           transformer_fwd)
+from .lm import (LMParams, init_lm, lm_logits, lm_loss, KVCache,
+                 init_cache, decode_step, generate)
 
 __all__ = ["FFNStackParams", "init_ffn_stack", "clone_params",
            "params_size_gb", "attention", "mha",
            "MoEStackParams", "init_moe_stack",
            "MoETransformerParams", "init_moe_transformer",
            "moe_transformer_fwd_aux",
-           "TransformerParams", "init_transformer", "transformer_fwd"]
+           "TransformerParams", "init_transformer", "transformer_fwd",
+           "LMParams", "init_lm", "lm_logits", "lm_loss", "KVCache",
+           "init_cache", "decode_step", "generate"]

@@ -1,0 +1,200 @@
+"""Language model: token/position embeddings + transformer stack + tied head.
+
+The reference trains on mocked data with a mocked upstream gradient — no
+tokens, no loss (``train_ffns.py:12, :144-151``). This family completes the
+path from token ids to a real scalar objective while keeping the framework's
+stance: raw stacked arrays in a NamedTuple (``train_ffns.py:38-39``), no
+biases (``:35``), hand-written VJPs for every nonlinear op (blocks:
+``models.transformer``; loss: ``ops.xent``) with the linear pieces — the
+embedding gather and the tied-head matmul — left to ``jax.vjp``'s exact
+transposes (gather <-> scatter-add).
+
+GPT-2 shape conventions: learned positional embeddings, pre-LN blocks, a
+final LayerNorm, and the LM head tied to the token embedding
+(``logits = h @ wte.T``) so ``wte`` receives gradient from both ends.
+
+Decode (``generate``) is inference-only — a jitted ``lax.scan`` over
+positions with a static-shape KV cache updated via
+``dynamic_update_slice`` — so it uses plain jnp ops (no VJP rules needed)
+and never retraces as the sequence grows: the TPU-native shape discipline
+(one compiled program, no per-token recompilation).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.norm import layernorm
+from ..ops.xent import xent_loss
+from .transformer import (TransformerParams, init_transformer,
+                          transformer_fwd)
+
+
+class LMParams(NamedTuple):
+    """``wte [V, d]`` token embedding (tied LM head); ``wpe [T_max, d]``
+    learned positions; ``blocks`` the pre-LN transformer stack; ``ln_f [d]``
+    the final LayerNorm gain."""
+    wte: jax.Array
+    wpe: jax.Array
+    blocks: TransformerParams
+    ln_f: jax.Array
+
+    @property
+    def vocab(self) -> int:
+        return self.wte.shape[0]
+
+    @property
+    def d_model(self) -> int:
+        return self.wte.shape[1]
+
+    @property
+    def max_seq_len(self) -> int:
+        return self.wpe.shape[0]
+
+    @property
+    def n_layers(self) -> int:
+        return self.blocks.n_layers
+
+    def num_params(self) -> int:
+        return (self.wte.size + self.wpe.size + self.ln_f.size +
+                self.blocks.num_params())
+
+
+def init_lm(key: jax.Array, vocab: int, d_model: int, n_layers: int,
+            max_seq_len: int, ffn_dim: int | None = None,
+            scale: float = 2e-2, dtype=jnp.float32) -> LMParams:
+    """Same init family as the rest of the framework: ``scale * normal``
+    (``train_ffns.py:35-36``), LN gains at 1."""
+    ke, kp, kb = jax.random.split(key, 3)
+    return LMParams(
+        wte=scale * jax.random.normal(ke, (vocab, d_model), dtype),
+        wpe=scale * jax.random.normal(kp, (max_seq_len, d_model), dtype),
+        blocks=init_transformer(kb, d_model, n_layers, ffn_dim, scale,
+                                dtype),
+        ln_f=jnp.ones((d_model,), dtype))
+
+
+def lm_hidden(params: LMParams, tokens: jax.Array, n_heads: int,
+              attn=None) -> jax.Array:
+    """Embed + blocks + final LN. ``tokens [B, T]`` int -> ``[B, T, d]``."""
+    t = tokens.shape[1]
+    x = params.wte[tokens] + params.wpe[:t]
+    x = transformer_fwd(params.blocks, x, n_heads, causal=True, attn=attn)
+    return layernorm(params.ln_f, x)
+
+
+def lm_logits(params: LMParams, tokens: jax.Array, n_heads: int,
+              attn=None) -> jax.Array:
+    """``tokens [B, T]`` -> logits ``[B, T, V]`` via the tied head."""
+    h = lm_hidden(params, tokens, n_heads, attn)
+    return h @ params.wte.T
+
+
+def lm_loss(params: LMParams, tokens: jax.Array, targets: jax.Array,
+            n_heads: int, attn=None) -> jax.Array:
+    """Mean next-token cross-entropy. ``tokens, targets [B, T]`` int."""
+    logits = lm_logits(params, tokens, n_heads, attn)
+    v = logits.shape[-1]
+    return xent_loss(logits.reshape(-1, v), targets.reshape(-1))
+
+
+# ---------------------------------------------------------------------------
+# Decode: static-shape KV cache + greedy generation under one jitted scan.
+
+
+class KVCache(NamedTuple):
+    """Per-layer key/value blocks, ``[L, B, H, T_max, dh]`` each, written
+    in place (functionally) at the current position each decode step."""
+    k: jax.Array
+    v: jax.Array
+
+
+def init_cache(params: LMParams, batch: int, n_heads: int,
+               dtype=None) -> KVCache:
+    shape = (params.n_layers, batch, n_heads, params.max_seq_len,
+             params.d_model // n_heads)
+    dtype = params.wte.dtype if dtype is None else dtype
+    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+def _decode_attn(q, ck, cv, pos):
+    """Single-query attention over the cache. ``q [B, H, dh]``,
+    ``ck/cv [B, H, T_max, dh]``; positions ``> pos`` are masked (the cache
+    beyond the write head is zeros, never probability mass)."""
+    dh = q.shape[-1]
+    s = jnp.einsum("bhd,bhtd->bht", q, ck) / jnp.sqrt(
+        jnp.asarray(dh, q.dtype))
+    mask = jnp.arange(ck.shape[2]) <= pos
+    s = jnp.where(mask, s, jnp.asarray(-1e30, s.dtype))
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bht,bhtd->bhd", p, cv)
+
+
+def decode_step(params: LMParams, cache: KVCache, token: jax.Array,
+                pos: jax.Array, n_heads: int):
+    """One token through the stack at position ``pos`` (traced scalar).
+
+    ``token [B]`` int -> ``(logits [B, V], cache')``. Static shapes
+    throughout: the cache is written at ``pos`` via
+    ``dynamic_update_slice``, attention masks the unwritten tail.
+    """
+    b = token.shape[0]
+    p = params.blocks
+    dh = params.d_model // n_heads
+    x = params.wte[token] + params.wpe[pos]                  # [B, d]
+    new_k, new_v = cache.k, cache.v
+    for l in range(p.n_layers):
+        a = layernorm(p.ln1[l], x)
+        q, k, v = (
+            (a @ w[l].T).reshape(b, n_heads, dh)
+            for w in (p.wq, p.wk, p.wv))
+        new_k = lax.dynamic_update_slice(
+            new_k, k[None, :, :, None, :], (l, 0, 0, pos, 0))
+        new_v = lax.dynamic_update_slice(
+            new_v, v[None, :, :, None, :], (l, 0, 0, pos, 0))
+        y = _decode_attn(q, new_k[l], new_v[l], pos)
+        x = x + y.reshape(b, params.d_model) @ p.wo[l].T
+        h = layernorm(p.ln2[l], x)
+        x = x + jnp.maximum(h @ p.w1[l].T, 0.0) @ p.w2[l].T
+    h = layernorm(params.ln_f, x)
+    return h @ params.wte.T, KVCache(new_k, new_v)
+
+
+def generate(params: LMParams, prompt: jax.Array, n_new: int,
+             n_heads: int) -> jax.Array:
+    """Greedy decode: ``prompt [B, T0]`` -> ``[B, T0 + n_new]``.
+
+    One ``lax.scan`` covers prefill and generation: step ``t`` feeds the
+    prompt token while ``t < T0`` (teacher-forced prefill filling the
+    cache) and the previous argmax after — so the compiled program is
+    independent of where the prompt ends, and a whole batch decodes in one
+    dispatch.
+    """
+    b, t0 = prompt.shape
+    total = t0 + n_new
+    if total > params.max_seq_len:
+        raise ValueError(f"prompt {t0} + n_new {n_new} exceeds "
+                         f"max_seq_len {params.max_seq_len}")
+    padded = jnp.concatenate(
+        [prompt, jnp.zeros((b, n_new), prompt.dtype)], axis=1)
+
+    def step(carry, pos):
+        cache, toks, prev = carry
+        token = jnp.where(pos < t0, toks[:, pos], prev)
+        logits, cache = decode_step(params, cache, token, pos, n_heads)
+        nxt = jnp.argmax(logits, axis=-1).astype(toks.dtype)
+        toks = lax.dynamic_update_slice(
+            toks, jnp.where(pos + 1 < t0, toks[:, pos + 1], nxt)[:, None],
+            (0, pos + 1))
+        return (cache, toks, nxt), None
+
+    cache = init_cache(params, b, n_heads)
+    init = (cache, jnp.concatenate(
+        [padded, jnp.zeros((b, 1), prompt.dtype)], axis=1),
+        padded[:, 0])
+    (_, toks, _), _ = lax.scan(step, init, jnp.arange(total - 1))
+    return toks[:, :total]

@@ -11,6 +11,7 @@ from .stack import stack_fwd, stack_bwd, stack_grads
 from .moe import (expert_capacity, route_top1, dispatch_tensor, moe_layer,
                   moe_stack_fwd)
 from .norm import ln_fwd, ln_bwd, layernorm
+from .xent import xent_fwd, xent_bwd, xent_loss
 # Pallas modules (pallas_ffn, pallas_attention) stay off the eager import
 # path — import them at call sites like parallel/single.py does.
 
@@ -23,4 +24,5 @@ __all__ = [
     "expert_capacity", "route_top1", "dispatch_tensor", "moe_layer",
     "moe_stack_fwd",
     "ln_fwd", "ln_bwd", "layernorm",
+    "xent_fwd", "xent_bwd", "xent_loss",
 ]

@@ -1,0 +1,70 @@
+"""Softmax cross-entropy, hand-differentiated (no autograd).
+
+The reference never computes a loss — its "gradient from the right" is a
+mocked ``dloss_dx`` (``train_ffns.py:12, :30, :149-150``). The language-model
+family replaces the mock with the real LM objective, and the objective gets
+the same first-principles treatment as the rest of the numerical core
+(``train_ffns.py:33-52``): forward written out via a stable logsumexp,
+backward derived by hand (``softmax - onehot``), installed as a
+``custom_vjp`` and checked against ``jax.grad`` in the tests.
+
+Mean reduction over rows: ``loss = mean_i( lse_i - z_i[t_i] )`` where
+``lse_i = logsumexp(z_i)``. The VJP is the classic
+``dz_i = (softmax(z_i) - onehot(t_i)) * dy / N``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def xent_fwd(logits: jax.Array, targets: jax.Array):
+    """Row-mean cross-entropy. ``logits [N, V]`` float, ``targets [N]`` int.
+
+    Returns ``(loss, (softmax, targets))`` — the softmax is the only
+    residual the manual backward needs (the logsumexp subsumes the max
+    trick; no ``[N, V]`` one-hot is ever materialized).
+    """
+    m = jax.lax.stop_gradient(jnp.max(logits, axis=-1, keepdims=True))
+    shifted = logits - m
+    sumexp = jnp.sum(jnp.exp(shifted), axis=-1, keepdims=True)
+    lse = jnp.log(sumexp) + m                                  # [N, 1]
+    target_z = jnp.take_along_axis(logits, targets[:, None], axis=-1)
+    loss = jnp.mean(lse - target_z)
+    return loss, (jnp.exp(shifted) / sumexp, targets)
+
+
+def xent_bwd(dy: jax.Array, probs: jax.Array, targets: jax.Array):
+    """Manual VJP: ``dlogits = dy/N * (softmax - onehot(targets))``.
+
+    The one-hot subtraction is a scatter-add on the target column, not a
+    dense ``[N, V]`` one-hot product.
+    """
+    n = probs.shape[0]
+    dz = probs * (dy / n)
+    return dz.at[jnp.arange(n), targets].add(-dy / n)
+
+
+@jax.custom_vjp
+def xent_loss(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Cross-entropy whose differentiation rule is the hand-written VJP.
+
+    ``targets`` is non-differentiable (integer class ids); its cotangent
+    slot returns None.
+    """
+    loss, _ = xent_fwd(logits, targets)
+    return loss
+
+
+def _xent_fwd(logits, targets):
+    loss, res = xent_fwd(logits, targets)
+    return loss, res
+
+
+def _xent_bwd(res, dy):
+    probs, targets = res
+    return xent_bwd(dy, probs, targets), None
+
+
+xent_loss.defvjp(_xent_fwd, _xent_bwd)

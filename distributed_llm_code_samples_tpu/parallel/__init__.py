@@ -29,6 +29,8 @@ from .moe_transformer import (train_moe_transformer_ep,
 from .transformer import (train_transformer_single, train_transformer_ddp,
                           train_transformer_fsdp, train_transformer_tp,
                           train_transformer_hybrid, train_transformer_seq)
+from .lm import (train_lm_single, train_lm_ddp, train_lm_fsdp, train_lm_tp,
+                 vp_embed, vp_xent)
 
 # Method-number parity with the reference CLI (train_ffns.py:6, :373):
 # 1=single, 2=DDP, 3=FSDP, 4=TP; 5+ extend with the hybrid mesh and the
@@ -58,5 +60,7 @@ __all__ = [
     "train_transformer_hybrid", "train_transformer_seq",
     "ring_attention", "sequence_parallel_attention",
     "ulysses_attention", "ulysses_parallel_attention",
+    "train_lm_single", "train_lm_ddp", "train_lm_fsdp", "train_lm_tp",
+    "vp_embed", "vp_xent",
     "STRATEGIES",
 ]

@@ -1,0 +1,279 @@
+"""Language-model trainers: single-device, DDP, FSDP/ZeRO-3, Megatron TP.
+
+The LM family (``models.lm``) is the transformer stack plus the pieces the
+reference mocked away — embeddings, a real cross-entropy objective
+(``ops.xent``), a tied head — so the strategies here are the transformer
+trainers (``parallel/transformer.py``) extended over that surface:
+
+- **DDP**: replicated params, strided seed shards, one grad ``psum`` per
+  step (SUM, unscaled LR — ``train_ffns.py:165`` semantics).
+- **FSDP/ZeRO-3**: every leaf sharded over the data axis (blocks on their
+  stacked layer dim, ``wte``/``wpe`` on rows, ``ln_f`` on features),
+  gathered transiently; grads return pre-scattered through the gathers'
+  ``psum_scatter`` transposes.
+- **TP (Megatron-LM)**: the block stack shards as in
+  ``parallel/transformer.py`` (heads column-, ``wo``/``w2`` row-parallel);
+  the embedding and the tied head shard the **vocab** dim — each shard owns
+  ``V/n`` rows of ``wte``, looks up / scores only its own slice, and the
+  cross-entropy runs **vocab-parallel**: max, normalizer, and target-logit
+  terms each complete with one collective over the model axis
+  (``vp_xent``), so the full ``[N, V]`` logits never exist on any device —
+  the memory-critical piece at real vocab sizes, where the logits would
+  dwarf every activation.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .. import LR
+from ..data import lm_batch_from_seed
+from ..models.ffn_stack import clone_params
+from ..models.lm import LMParams, lm_loss
+from ..models.transformer import transformer_block
+from ..ops.norm import layernorm
+from ..ops.xent import xent_loss
+from ..optim import sgd
+from .collectives import all_gather, all_reduce, axis_index, grad_reduce
+from .launcher import launch, launch_strided
+from .mesh import DATA_AXIS, MODEL_AXIS, require_axes
+from .transformer import (TP_SPECS, _f_gate, _shard, _validate_tp,
+                          resolve_attn, tp_block)
+
+def _lm_fsdp_specs() -> LMParams:
+    from .transformer import FSDP_SPECS
+    return LMParams(wte=P(DATA_AXIS, None), wpe=P(DATA_AXIS, None),
+                    blocks=FSDP_SPECS, ln_f=P(DATA_AXIS))
+
+
+def _lm_tp_specs() -> LMParams:
+    return LMParams(wte=P(MODEL_AXIS, None), wpe=P(), blocks=TP_SPECS,
+                    ln_f=P())
+
+
+def _validate_lm(batch_size: int, seq_len: int, model_size: int,
+                 n_heads: int, params: LMParams) -> None:
+    if batch_size % seq_len:
+        raise ValueError(f"tokens {batch_size} not divisible by "
+                         f"seq_len {seq_len}")
+    if model_size % n_heads:
+        raise ValueError(f"model_size={model_size} not divisible by "
+                         f"n_heads={n_heads}")
+    if seq_len > params.max_seq_len:
+        raise ValueError(f"seq_len={seq_len} exceeds the model's "
+                         f"max_seq_len={params.max_seq_len}")
+
+
+def _make_step(batch_size: int, model_size: int, seq_len: int,
+               n_heads: int, lr: float, attn=None, reduce_axes=()):
+    """One SGD step on the real LM objective; ``batch_size`` is tokens/step
+    (seq folded, CLI convention ``train_ffns.py:379``)."""
+    b = batch_size // seq_len
+
+    def step(params: LMParams, seed) -> LMParams:
+        tokens, targets = lm_batch_from_seed(seed, b, seq_len, params.vocab)
+        grads = jax.grad(lm_loss)(params, tokens, targets, n_heads, attn)
+        if reduce_axes:
+            grads = jax.tree_util.tree_map(
+                lambda g: grad_reduce(g, reduce_axes), grads)
+        return sgd(params, grads, lr)
+
+    return step
+
+
+def train_lm_single(params: LMParams, seeds, batch_size: int,
+                    model_size: int, mesh=None, lr: float = LR, *,
+                    seq_len: int, n_heads: int,
+                    attn_impl: str | None = None) -> LMParams:
+    """Single-device LM trainer — the oracle the parallel forms are pinned
+    to."""
+    _validate_lm(batch_size, seq_len, model_size, n_heads, params)
+    step = _make_step(batch_size, model_size, seq_len, n_heads, lr,
+                      resolve_attn(attn_impl))
+
+    @jax.jit
+    def run(params, seeds):
+        return lax.scan(lambda p, s: (step(p, s), None), params, seeds)[0]
+
+    return run(clone_params(params), jnp.asarray(seeds))
+
+
+def train_lm_ddp(params: LMParams, seeds, batch_size: int, model_size: int,
+                 mesh, lr: float = LR, *, seq_len: int, n_heads: int,
+                 attn_impl: str | None = None) -> LMParams:
+    """DDP: replicated params, strided seeds, grads summed per step."""
+    require_axes(mesh, DATA_AXIS)
+    _validate_lm(batch_size, seq_len, model_size, n_heads, params)
+    step = _make_step(batch_size, model_size, seq_len, n_heads, lr,
+                      resolve_attn(attn_impl), reduce_axes=(DATA_AXIS,))
+    return launch_strided(step, clone_params(params), seeds, mesh,
+                          DATA_AXIS, P())
+
+
+def train_lm_fsdp(params: LMParams, seeds, batch_size: int, model_size: int,
+                  mesh, lr: float = LR, *, seq_len: int, n_heads: int,
+                  attn_impl: str | None = None) -> LMParams:
+    """FSDP/ZeRO-3 over the whole LM surface: block stacks gathered layer
+    by layer (the transformer FSDP loop), the embedding/head table and
+    positions gathered once per step — transiently, so peak param memory
+    stays ``O(|params|/n + one layer)``. All grads come back pre-scattered
+    through the gathers' ``psum_scatter`` transposes; sharded SGD."""
+    require_axes(mesh, DATA_AXIS)
+    n = mesh.shape[DATA_AXIS]
+    _validate_lm(batch_size, seq_len, model_size, n_heads, params)
+    for name, leaf in [("wte", params.wte), ("wpe", params.wpe),
+                       ("ln_f", params.ln_f)]:
+        if leaf.shape[0] % n:
+            raise ValueError(f"{name} dim {leaf.shape[0]} not divisible by "
+                             f"{n} shards")
+    for name, leaf in zip(params.blocks._fields, params.blocks):
+        if leaf.shape[1] % n:
+            raise ValueError(f"blocks.{name} dim {leaf.shape[1]} not "
+                             f"divisible by {n} shards")
+    attn = resolve_attn(attn_impl)
+    b = batch_size // seq_len
+    vocab = params.vocab  # the global count — p.wte is a shard inside step
+
+    def step(params: LMParams, seed) -> LMParams:
+        tokens, targets = lm_batch_from_seed(seed, b, seq_len, vocab)
+
+        def loss_fn(p: LMParams):
+            wte = all_gather(p.wte, DATA_AXIS, dim=0)
+            wpe = all_gather(p.wpe, DATA_AXIS, dim=0)
+            ln_f = all_gather(p.ln_f, DATA_AXIS, dim=0)
+            x = wte[tokens] + wpe[:seq_len]
+            for l in range(p.blocks.w1.shape[0]):
+                full = (all_gather(leaf[l], DATA_AXIS, dim=0)
+                        for leaf in p.blocks)
+                x = transformer_block(*full, x, n_heads, causal=True,
+                                      attn=attn)
+            h = layernorm(ln_f, x)
+            logits = h @ wte.T
+            return xent_loss(logits.reshape(-1, wte.shape[0]),
+                             targets.reshape(-1))
+
+        grads = jax.grad(loss_fn)(params)
+        return sgd(params, grads, lr)
+
+    return launch_strided(step, _shard(params, mesh, _lm_fsdp_specs()),
+                          seeds, mesh, DATA_AXIS, _lm_fsdp_specs())
+
+
+# ---------------------------------------------------------------------------
+# Vocab-parallel pieces (Megatron-LM): embedding + cross-entropy over the
+# model axis, hand-differentiated where nonlinear.
+
+
+def vp_embed(wte_local: jax.Array, tokens: jax.Array,
+             axis: str = MODEL_AXIS) -> jax.Array:
+    """Vocab-parallel embedding lookup: each shard resolves only tokens in
+    its ``[offset, offset + V/n)`` row range (zeros elsewhere) and one
+    ``psum`` completes the rows. Linear, so ``jax.vjp``'s exact transposes
+    (psum -> identity, gather -> scatter-add) give each shard the complete
+    gradient for its own rows."""
+    v_local = wte_local.shape[0]
+    offset = axis_index(axis) * v_local
+    local = tokens - offset
+    in_range = (local >= 0) & (local < v_local)
+    rows = wte_local[jnp.clip(local, 0, v_local - 1)]
+    return all_reduce(jnp.where(in_range[..., None], rows, 0), axis)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def vp_xent(logits_local: jax.Array, targets: jax.Array,
+            axis: str = MODEL_AXIS) -> jax.Array:
+    """Vocab-parallel cross-entropy: ``logits_local [N, V/n]`` is this
+    shard's slice of the row; the row max (``pmax``), normalizer
+    (``psum`` of local sum-exp), and target logit (``psum`` of the
+    in-range pick) each complete with one collective — no shard ever holds
+    a full ``[N, V]`` row. Backward is the hand-written
+    ``(softmax - onehot) * dy / N`` restricted to the local slice, with no
+    collective at all (the residuals are already local)."""
+    loss, _ = _vp_xent_fwd(logits_local, targets, axis)
+    return loss
+
+
+def _vp_xent_fwd(logits_local, targets, axis):
+    v_local = logits_local.shape[-1]
+    offset = axis_index(axis) * v_local
+    m = lax.pmax(jnp.max(logits_local, axis=-1, keepdims=True), axis)
+    e = jnp.exp(logits_local - m)
+    sumexp = all_reduce(jnp.sum(e, axis=-1, keepdims=True), axis)
+    lse = jnp.log(sumexp) + m                                   # [N, 1]
+    local_t = targets - offset
+    in_range = (local_t >= 0) & (local_t < v_local)
+    picked = jnp.take_along_axis(
+        logits_local, jnp.clip(local_t, 0, v_local - 1)[:, None],
+        axis=-1)[:, 0]
+    z_t = all_reduce(jnp.where(in_range, picked, 0.0), axis)
+    loss = jnp.mean(lse[:, 0] - z_t)
+    return loss, (e / sumexp, jnp.clip(local_t, 0, v_local - 1), in_range)
+
+
+def _vp_xent_bwd(axis, res, dy):
+    probs_local, local_t, in_range = res
+    n = probs_local.shape[0]
+    dz = probs_local * (dy / n)
+    dz = dz.at[jnp.arange(n), local_t].add(
+        jnp.where(in_range, -dy / n, 0.0))
+    return dz, None
+
+
+vp_xent.defvjp(lambda l, t, a: _vp_xent_fwd(l, t, a), _vp_xent_bwd)
+
+
+def train_lm_tp(params: LMParams, seeds, batch_size: int, model_size: int,
+                mesh, lr: float = LR, *, seq_len: int, n_heads: int,
+                attn_impl: str | None = None) -> LMParams:
+    """Megatron-LM TP over the model axis: blocks shard heads/features
+    (``tp_block``), ``wte`` shards vocab rows serving both the parallel
+    embedding and the tied parallel head, and the loss runs vocab-parallel
+    (``vp_xent``). ``wpe``/LN grads replicate (complete ``dx`` on every
+    shard, the ``_f_gate`` discipline); ``wte``/block grads are
+    shard-complete. Data replicated, as in ``train_transformer_tp``."""
+    require_axes(mesh, MODEL_AXIS)
+    n = mesh.shape[MODEL_AXIS]
+    h_local = _validate_tp(params.blocks, n_heads, n)
+    _validate_lm(batch_size, seq_len, model_size, n_heads, params)
+    if params.vocab % n:
+        raise ValueError(f"vocab={params.vocab} not divisible by "
+                         f"model-axis size {n}")
+    attn = resolve_attn(attn_impl)
+    b = batch_size // seq_len
+    vocab = params.vocab
+
+    def step(params: LMParams, seed) -> LMParams:
+        tokens, targets = lm_batch_from_seed(seed, b, seq_len, vocab)
+        f = _f_gate(MODEL_AXIS)
+
+        def loss_fn(p: LMParams):
+            x = vp_embed(p.wte, tokens) + p.wpe[:seq_len]
+            for l in range(p.blocks.w1.shape[0]):
+                blk = p.blocks
+                x = tp_block(blk.ln1[l], blk.wq[l], blk.wk[l], blk.wv[l],
+                             blk.wo[l], blk.ln2[l], blk.w1[l], blk.w2[l],
+                             x, h_local, causal=True, attn=attn)
+            h = f(layernorm(p.ln_f, x))       # dx from the head: psum
+            logits_local = h.reshape(-1, model_size) @ p.wte.T
+            return vp_xent(logits_local, targets.reshape(-1))
+
+        grads = jax.grad(loss_fn)(params)
+        # wpe and the LN gains saw complete, replicated dx — but the
+        # cotangents produced inside the hand-written rules come back
+        # typed varying; grad_reduce psums exactly the pending ones.
+        grads = grads._replace(
+            wpe=grad_reduce(grads.wpe, MODEL_AXIS),
+            ln_f=grad_reduce(grads.ln_f, MODEL_AXIS),
+            blocks=grads.blocks._replace(
+                ln1=grad_reduce(grads.blocks.ln1, MODEL_AXIS),
+                ln2=grad_reduce(grads.blocks.ln2, MODEL_AXIS)))
+        return sgd(params, grads, lr)
+
+    return launch(step, _shard(params, mesh, _lm_tp_specs()),
+                  jnp.asarray(seeds), mesh, param_specs=_lm_tp_specs(),
+                  seed_spec=P())
