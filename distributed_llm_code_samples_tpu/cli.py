@@ -300,6 +300,12 @@ def main(argv=None) -> int:
             ck_kwargs = dict(kwargs)
             opt = ck_kwargs.pop("optimizer", None)
             stateful_opt = opt is not None and not opt.stateless
+            restore_shardings = None
+            if m == 3 and stateful_opt and mesh is not None:
+                # resume straight onto the 1/n FSDP layout — never
+                # materialize full params + Adam moments on one device
+                from .parallel.fsdp import checkpoint_shardings
+                restore_shardings = checkpoint_shardings(params, opt, mesh)
             out = run_with_checkpointing(
                 fn, params, seeds, tokens, args.model_size,
                 ckpt_dir=os.path.join(args.checkpoint_dir, name),
@@ -309,7 +315,8 @@ def main(argv=None) -> int:
                 # train_ddp threads (params, opt_state) through segments;
                 # ZeRO-1's sharded state has no such surface yet
                 thread_state=stateful_opt and not args.zero1,
-                stateful=stateful_opt and args.zero1, **ck_kwargs)
+                stateful=stateful_opt and args.zero1,
+                restore_shardings=restore_shardings, **ck_kwargs)
         else:
             out = fn(params, seeds, tokens, args.model_size, **kwargs)
         jax.block_until_ready(out)

@@ -193,8 +193,6 @@ def generate(params: LMParams, prompt: jax.Array, n_new: int,
         return (cache, toks, nxt), None
 
     cache = init_cache(params, b, n_heads)
-    init = (cache, jnp.concatenate(
-        [padded, jnp.zeros((b, 1), prompt.dtype)], axis=1),
-        padded[:, 0])
+    init = (cache, padded, padded[:, 0])
     (_, toks, _), _ = lax.scan(step, init, jnp.arange(total - 1))
-    return toks[:, :total]
+    return toks

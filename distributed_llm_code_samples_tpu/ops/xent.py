@@ -57,14 +57,9 @@ def xent_loss(logits: jax.Array, targets: jax.Array) -> jax.Array:
     return loss
 
 
-def _xent_fwd(logits, targets):
-    loss, res = xent_fwd(logits, targets)
-    return loss, res
-
-
 def _xent_bwd(res, dy):
     probs, targets = res
     return xent_bwd(dy, probs, targets), None
 
 
-xent_loss.defvjp(_xent_fwd, _xent_bwd)
+xent_loss.defvjp(xent_fwd, _xent_bwd)

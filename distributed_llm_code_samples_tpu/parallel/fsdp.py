@@ -50,6 +50,15 @@ PARAM_SPECS = FFNStackParams(w1=P(None, DATA_AXIS, None),
                              w2=P(None, DATA_AXIS, None))
 
 
+def state_spec(leaf) -> P:
+    """Optimizer-state leaf -> its ZeRO-3 spec: param-shaped moments
+    (stacked ``[L, out, in]``) shard with the params, scalar bookkeeping
+    (step counts) replicates. One rule shared by the training path and
+    ``checkpoint_shardings`` so the run and the restore can't drift."""
+    return (P(None, DATA_AXIS, None) if getattr(leaf, "ndim", 0) == 3
+            else P())
+
+
 def shard_params(params: FFNStackParams, mesh) -> FFNStackParams:
     """Lay params out sharded — the launcher-side ``chunk_p``
     (``train_ffns.py:265-272``) expressed as a sharding, not list surgery."""
@@ -70,9 +79,7 @@ def checkpoint_shardings(params: FFNStackParams, optimizer: Optimizer,
         is_leaf=lambda v: isinstance(v, P))
     state_shapes = jax.eval_shape(optimizer.init, params)
     sspec = jax.tree_util.tree_map(
-        lambda l: NamedSharding(
-            mesh, P(None, DATA_AXIS, None) if l.ndim == 3 else P()),
-        state_shapes)
+        lambda l: NamedSharding(mesh, state_spec(l)), state_shapes)
     return (pspec, sspec)
 
 
@@ -162,9 +169,7 @@ def train_fsdp(params: FFNStackParams, seeds, batch_size: int,
     # zeros_like of the sharded params keeps their sharding, so the state
     # enters shard_map already 1/n per device; scalar leaves replicate
     state = optimizer.init(params) if opt_state is None else opt_state
-    state_specs = jax.tree_util.tree_map(
-        lambda l: P(None, DATA_AXIS, None) if getattr(l, "ndim", 0) == 3
-        else P(), state)
+    state_specs = jax.tree_util.tree_map(state_spec, state)
     return launch_strided(step, params, seeds, mesh, DATA_AXIS,
                           PARAM_SPECS, state=state,
                           state_specs=state_specs,

@@ -42,8 +42,8 @@ from ..optim import sgd
 from .collectives import all_gather, all_reduce, axis_index, grad_reduce
 from .launcher import launch, launch_strided
 from .mesh import DATA_AXIS, MODEL_AXIS, require_axes
-from .transformer import (TP_SPECS, _f_gate, _shard, _validate_tp,
-                          resolve_attn, tp_block)
+from .transformer import (TP_SPECS, _f_gate, _shard, _validate_shapes,
+                          _validate_tp, resolve_attn, tp_block)
 
 def _lm_fsdp_specs() -> LMParams:
     from .transformer import FSDP_SPECS
@@ -58,12 +58,7 @@ def _lm_tp_specs() -> LMParams:
 
 def _validate_lm(batch_size: int, seq_len: int, model_size: int,
                  n_heads: int, params: LMParams) -> None:
-    if batch_size % seq_len:
-        raise ValueError(f"tokens {batch_size} not divisible by "
-                         f"seq_len {seq_len}")
-    if model_size % n_heads:
-        raise ValueError(f"model_size={model_size} not divisible by "
-                         f"n_heads={n_heads}")
+    _validate_shapes(batch_size, seq_len, model_size, n_heads)
     if seq_len > params.max_seq_len:
         raise ValueError(f"seq_len={seq_len} exceeds the model's "
                          f"max_seq_len={params.max_seq_len}")
@@ -224,7 +219,7 @@ def _vp_xent_bwd(axis, res, dy):
     return dz, None
 
 
-vp_xent.defvjp(lambda l, t, a: _vp_xent_fwd(l, t, a), _vp_xent_bwd)
+vp_xent.defvjp(_vp_xent_fwd, _vp_xent_bwd)
 
 
 def train_lm_tp(params: LMParams, seeds, batch_size: int, model_size: int,
