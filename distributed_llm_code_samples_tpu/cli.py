@@ -29,14 +29,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-l", "--layers", type=int, default=1)
     p.add_argument("-d", "--model_size", type=int, default=4)
     p.add_argument("-m", "--method", type=int, default=0,
-                   choices=range(11),
+                   choices=range(12),
                    help="0=all(1-4), 1=single, 2=DDP, 3=FSDP, 4=TP, "
                         "5=hybrid DDP x TP, 6=pipeline (ppermute send/recv), "
                         "7=MoE expert parallelism (all_to_all), "
                         "8=transformer blocks (Megatron TP; --heads), "
-                        "9=all(1-8,10) with every strategy cross-verified "
+                        "9=all(1-8,10,11) with every strategy cross-verified "
                         "against its oracle, 10=MoE transformer (GShard: "
-                        "data-parallel attention + expert-parallel FFN)")
+                        "data-parallel attention + expert-parallel FFN), "
+                        "11=language model on the real cross-entropy "
+                        "objective (vocab-parallel Megatron TP; --vocab "
+                        "--heads)")
     p.add_argument("-r", "--random_seed", type=int, default=0,
                    help="!=0 makes runs reproducible (train_ffns.py:350)")
     # TPU-build extensions
@@ -54,7 +57,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--experts", type=int, default=8,
                    help="expert count for --method 7/10 (MoE)")
     p.add_argument("--heads", type=int, default=4,
-                   help="attention heads for --method 8/10")
+                   help="attention heads for --method 8/10/11")
+    p.add_argument("--vocab", type=int, default=256,
+                   help="vocabulary size for --method 11 (the LM family; "
+                        "must be divisible by the model-axis size)")
     p.add_argument("--lr", type=float, default=None,
                    help="override LR (default 1e-5, train_ffns.py:29)")
     p.add_argument("--optimizer", choices=["sgd", "momentum", "adam"],
@@ -183,7 +189,7 @@ def main(argv=None) -> int:
 
     def family_of(method: int) -> str:
         return {7: "moe", 8: "transformer",
-                10: "moe_transformer"}.get(method, "ffn")
+                10: "moe_transformer", 11: "lm"}.get(method, "ffn")
 
     _family_params = {}
 
@@ -202,6 +208,11 @@ def main(argv=None) -> int:
                 _family_params[fam] = init_moe_transformer(
                     key, args.model_size, args.layers, args.experts,
                     dtype=dtype)
+            elif fam == "lm":
+                from .models import init_lm
+                _family_params[fam] = init_lm(
+                    key, args.vocab, args.model_size, args.layers,
+                    max_seq_len=args.seq_len, dtype=dtype)
             else:
                 _family_params[fam] = init_ffn_stack(
                     key, args.model_size, args.layers, dtype=dtype)
@@ -231,7 +242,7 @@ def main(argv=None) -> int:
             return make_mesh({PIPE_AXIS: n_dev})
         if method in (7, 10):
             return make_mesh({EXPERT_AXIS: n_dev})
-        if method == 8:
+        if method in (8, 11):
             # model axis sized by --tp (like method 5): all-devices would
             # demand n_heads divisible by every possible device count
             return make_mesh({MODEL_AXIS: min(args.tp, n_dev)})
@@ -245,7 +256,7 @@ def main(argv=None) -> int:
     if args.method == 0:
         selected = [1, 2, 3, 4]
     elif args.method == 9:
-        selected = [1, 2, 3, 4, 5, 6, 7, 8, 10]
+        selected = [1, 2, 3, 4, 5, 6, 7, 8, 10, 11]
     else:
         selected = [args.method]
     results = {}
@@ -271,7 +282,7 @@ def main(argv=None) -> int:
                 kwargs["n_microbatches"] = args.microbatches
         if m == 7:
             kwargs = dict(lr=lr)  # EP's expert loop has its own structure
-        if m in (8, 10):
+        if m in (8, 10, 11):
             kwargs = dict(lr=lr, seq_len=args.seq_len, n_heads=args.heads)
             if args.tp_sp and m == 8:
                 kwargs["sequence_parallel"] = True
@@ -377,13 +388,25 @@ def main(argv=None) -> int:
                 seq_len=args.seq_len, n_heads=args.heads, n_groups=n_dev)
             checks.append(("moe_tf_ep", "moe_tf_dense", results[10],
                            mt_dense, 1e-4, 1e-5))
+            # vocab-parallel LM TP replicates the data => equals the LM
+            # single-device oracle on the real objective
+            from .parallel import train_lm_single
+            lm_single = train_lm_single(
+                params_for(11), seeds, tokens, args.model_size, lr=lr,
+                seq_len=args.seq_len, n_heads=args.heads)
+            checks.append(("lm_tp", "lm_1dev", results[11], lm_single,
+                           1e-4, 1e-5))
         for la, lb, a, b, rt, at in checks:
-            for field in type(a)._fields:
-                pa = np.asarray(getattr(a, field))
-                pb = np.asarray(getattr(b, field))
+            # leaves-with-paths rather than _fields: the LM family's params
+            # nest (blocks is a NamedTuple inside LMParams)
+            flat_a = jax.tree_util.tree_flatten_with_path(a)[0]
+            flat_b = jax.tree_util.tree_leaves(b)
+            for (path, leaf_a), leaf_b in zip(flat_a, flat_b):
+                field = jax.tree_util.keystr(path)
+                pa, pb = np.asarray(leaf_a), np.asarray(leaf_b)
                 if not np.allclose(pa, pb, rtol=rt, atol=at):
-                    print(f"SoftAssertionError: {la}.{field} vs "
-                          f"{lb}.{field} max|diff|={np.abs(pa - pb).max()}")
+                    print(f"SoftAssertionError: {la}{field} vs "
+                          f"{lb}{field} max|diff|={np.abs(pa - pb).max()}")
                     failed = True
     return 1 if (failed and args.strict) else 0
 

@@ -63,6 +63,17 @@ class LMParams(NamedTuple):
         return (self.wte.size + self.wpe.size + self.ln_f.size +
                 self.blocks.num_params())
 
+    # The CLI's uniform per-layer report reads ``.w1``/``.w2``
+    # (train_ffns.py:370-371 prints layers_params[0]); delegate to the
+    # block stack's FFN pair.
+    @property
+    def w1(self) -> jax.Array:
+        return self.blocks.w1
+
+    @property
+    def w2(self) -> jax.Array:
+        return self.blocks.w2
+
 
 def init_lm(key: jax.Array, vocab: int, d_model: int, n_layers: int,
             max_seq_len: int, ffn_dim: int | None = None,

@@ -45,6 +45,7 @@ STRATEGIES = {
     7: ("train_moe_ep", train_moe_ep),
     8: ("train_transformer_tp", train_transformer_tp),
     10: ("train_moe_transformer_ep", train_moe_transformer_ep),
+    11: ("train_lm_tp", train_lm_tp),
 }
 
 __all__ = [

@@ -34,18 +34,29 @@ def test_cli_all_methods_verify():
 
 @pytest.mark.slow
 def test_cli_method9_verifies_every_strategy():
-    """--method 9: all eight strategies run and every extension is pinned
-    to its oracle (hybrid==DDP(dp), PP==single, EP==dense grouped oracle,
-    transformer TP==transformer single) — hard-failing under --strict."""
+    """--method 9: every strategy runs and every extension is pinned to
+    its oracle (hybrid==DDP(dp), PP==single, EP==dense grouped oracle,
+    transformer TP==transformer single, LM TP==LM single on the real
+    objective) — hard-failing under --strict."""
     r = _run_cli("-s", "8", "-bs", "8", "-n", "16", "-l", "8", "-d", "16",
                  "-m", "9", "-r", "3", "--lr", "0.1", "--fake_devices",
-                 "8", "--strict", "--heads", "4")
+                 "8", "--strict", "--heads", "4", "--vocab", "64")
     assert r.returncode == 0, r.stdout + r.stderr
     for name in ("train_single", "train_ddp", "train_fsdp", "train_tp",
                  "train_hybrid", "train_pp", "train_moe_ep",
-                 "train_transformer_tp", "train_moe_transformer_ep"):
+                 "train_transformer_tp", "train_moe_transformer_ep",
+                 "train_lm_tp"):
         assert f"{name} takes" in r.stdout
     assert "SoftAssertionError" not in r.stdout
+
+
+@pytest.mark.slow
+def test_cli_lm_method():
+    r = _run_cli("-s", "4", "-bs", "4", "-n", "8", "-l", "2", "-d", "32",
+                 "-m", "11", "-r", "3", "--fake_devices", "4", "--tp", "4",
+                 "--heads", "4", "--vocab", "64", "--lr", "0.1")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "train_lm_tp takes" in r.stdout
 
 
 @pytest.mark.slow
