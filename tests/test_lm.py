@@ -14,7 +14,7 @@ from jax.sharding import PartitionSpec as P
 
 from distributed_llm_code_samples_tpu.data import lm_batch_from_seed
 from distributed_llm_code_samples_tpu.models import (
-    generate, init_lm, lm_logits, lm_loss)
+    generate, init_lm, lm_logits, lm_loss, sample)
 from distributed_llm_code_samples_tpu.ops.xent import xent_loss
 from distributed_llm_code_samples_tpu.parallel import (
     MODEL_AXIS, train_lm_ddp, train_lm_fsdp,
@@ -220,6 +220,39 @@ def test_generate_matches_full_forward_argmax():
         nxt = np.asarray(jnp.argmax(logits[:, -1], axis=-1))
         toks = np.concatenate([toks, nxt[:, None]], axis=1)
     np.testing.assert_array_equal(np.asarray(got), toks)
+
+
+def test_sample_topk1_is_greedy():
+    """top_k=1 truncates to the argmax token: sampling must reproduce the
+    greedy path exactly, at any temperature."""
+    params = small_lm(seed=5)
+    prompt = jax.random.randint(jax.random.PRNGKey(10), (2, 3), 0, V)
+    greedy = generate(params, prompt, 5, HEADS)
+    sampled = sample(params, prompt, 5, HEADS, temperature=2.0, top_k=1,
+                     seed=11)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(sampled))
+
+
+def test_sample_deterministic_per_seed():
+    """Counter-RNG sampling: same seed -> identical continuation; the
+    temperature is high enough that distinct seeds disagree somewhere."""
+    params = small_lm(seed=7)
+    prompt = jax.random.randint(jax.random.PRNGKey(12), (4, 2), 0, V)
+    a = sample(params, prompt, 8, HEADS, temperature=5.0, seed=1)
+    b = sample(params, prompt, 8, HEADS, temperature=5.0, seed=1)
+    c = sample(params, prompt, 8, HEADS, temperature=5.0, seed=2)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+
+def test_sample_validates_arguments():
+    params = small_lm()
+    prompt = jnp.zeros((1, 2), jnp.int32)
+    import pytest
+    with pytest.raises(ValueError, match="temperature"):
+        sample(params, prompt, 2, HEADS, temperature=0.0)
+    with pytest.raises(ValueError, match="top_k"):
+        sample(params, prompt, 2, HEADS, top_k=V + 1)
 
 
 def test_generate_is_prompt_length_oblivious():
