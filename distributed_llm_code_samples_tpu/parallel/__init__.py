@@ -30,7 +30,7 @@ from .transformer import (train_transformer_single, train_transformer_ddp,
                           train_transformer_fsdp, train_transformer_tp,
                           train_transformer_hybrid, train_transformer_seq)
 from .lm import (train_lm_single, train_lm_ddp, train_lm_fsdp, train_lm_tp,
-                 vp_embed, vp_xent)
+                 train_lm_hybrid, train_lm_seq, vp_embed, vp_xent)
 
 # Method-number parity with the reference CLI (train_ffns.py:6, :373):
 # 1=single, 2=DDP, 3=FSDP, 4=TP; 5+ extend with the hybrid mesh and the
@@ -62,6 +62,6 @@ __all__ = [
     "ring_attention", "sequence_parallel_attention",
     "ulysses_attention", "ulysses_parallel_attention",
     "train_lm_single", "train_lm_ddp", "train_lm_fsdp", "train_lm_tp",
-    "vp_embed", "vp_xent",
+    "train_lm_hybrid", "train_lm_seq", "vp_embed", "vp_xent",
     "STRATEGIES",
 ]

@@ -35,13 +35,13 @@ from .. import LR
 from ..data import lm_batch_from_seed
 from ..models.ffn_stack import clone_params
 from ..models.lm import LMParams, lm_loss
-from ..models.transformer import transformer_block
+from ..models.transformer import transformer_block, transformer_fwd
 from ..ops.norm import layernorm
 from ..ops.xent import xent_loss
 from ..optim import sgd
 from .collectives import all_gather, all_reduce, axis_index, grad_reduce
 from .launcher import launch, launch_strided
-from .mesh import DATA_AXIS, MODEL_AXIS, require_axes
+from .mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS, require_axes
 from .transformer import (TP_SPECS, _f_gate, _shard, _validate_shapes,
                           _validate_tp, resolve_attn, tp_block)
 
@@ -222,25 +222,14 @@ def _vp_xent_bwd(axis, res, dy):
 vp_xent.defvjp(_vp_xent_fwd, _vp_xent_bwd)
 
 
-def train_lm_tp(params: LMParams, seeds, batch_size: int, model_size: int,
-                mesh, lr: float = LR, *, seq_len: int, n_heads: int,
-                attn_impl: str | None = None) -> LMParams:
-    """Megatron-LM TP over the model axis: blocks shard heads/features
-    (``tp_block``), ``wte`` shards vocab rows serving both the parallel
-    embedding and the tied parallel head, and the loss runs vocab-parallel
-    (``vp_xent``). ``wpe``/LN grads replicate (complete ``dx`` on every
-    shard, the ``_f_gate`` discipline); ``wte``/block grads are
-    shard-complete. Data replicated, as in ``train_transformer_tp``."""
-    require_axes(mesh, MODEL_AXIS)
-    n = mesh.shape[MODEL_AXIS]
-    h_local = _validate_tp(params.blocks, n_heads, n)
-    _validate_lm(batch_size, seq_len, model_size, n_heads, params)
-    if params.vocab % n:
-        raise ValueError(f"vocab={params.vocab} not divisible by "
-                         f"model-axis size {n}")
-    attn = resolve_attn(attn_impl)
+def _make_tp_step(batch_size: int, model_size: int, seq_len: int,
+                  h_local: int, vocab: int, lr: float, attn=None,
+                  data_axes=()):
+    """One vocab-parallel TP step for one model shard; ``data_axes`` adds
+    the orthogonal DDP reduction for the hybrid 2-D mesh (every leaf is a
+    partial sum over those axes; LN/positions additionally over the model
+    axis — one fused psum per leaf, ``grad_reduce`` on an axis tuple)."""
     b = batch_size // seq_len
-    vocab = params.vocab
 
     def step(params: LMParams, seed) -> LMParams:
         tokens, targets = lm_batch_from_seed(seed, b, seq_len, vocab)
@@ -261,14 +250,122 @@ def train_lm_tp(params: LMParams, seeds, batch_size: int, model_size: int,
         # wpe and the LN gains saw complete, replicated dx — but the
         # cotangents produced inside the hand-written rules come back
         # typed varying; grad_reduce psums exactly the pending ones.
+        # Head/projection/FFN grads are shard-complete on the model axis
+        # and reduce only over the data axes (hybrid).
+        model_and_data = (MODEL_AXIS,) + data_axes
         grads = grads._replace(
-            wpe=grad_reduce(grads.wpe, MODEL_AXIS),
-            ln_f=grad_reduce(grads.ln_f, MODEL_AXIS),
+            wpe=grad_reduce(grads.wpe, model_and_data),
+            ln_f=grad_reduce(grads.ln_f, model_and_data),
             blocks=grads.blocks._replace(
-                ln1=grad_reduce(grads.blocks.ln1, MODEL_AXIS),
-                ln2=grad_reduce(grads.blocks.ln2, MODEL_AXIS)))
+                ln1=grad_reduce(grads.blocks.ln1, model_and_data),
+                ln2=grad_reduce(grads.blocks.ln2, model_and_data)))
+        if data_axes:
+            grads = jax.tree_util.tree_map(
+                lambda g: grad_reduce(g, data_axes), grads)
         return sgd(params, grads, lr)
 
+    return step
+
+
+def train_lm_tp(params: LMParams, seeds, batch_size: int, model_size: int,
+                mesh, lr: float = LR, *, seq_len: int, n_heads: int,
+                attn_impl: str | None = None) -> LMParams:
+    """Megatron-LM TP over the model axis: blocks shard heads/features
+    (``tp_block``), ``wte`` shards vocab rows serving both the parallel
+    embedding and the tied parallel head, and the loss runs vocab-parallel
+    (``vp_xent``). ``wpe``/LN grads replicate (complete ``dx`` on every
+    shard, the ``_f_gate`` discipline); ``wte``/block grads are
+    shard-complete. Data replicated, as in ``train_transformer_tp``."""
+    require_axes(mesh, MODEL_AXIS)
+    n = mesh.shape[MODEL_AXIS]
+    h_local = _validate_tp(params.blocks, n_heads, n)
+    _validate_lm(batch_size, seq_len, model_size, n_heads, params)
+    if params.vocab % n:
+        raise ValueError(f"vocab={params.vocab} not divisible by "
+                         f"model-axis size {n}")
+    step = _make_tp_step(batch_size, model_size, seq_len, h_local,
+                         params.vocab, lr, resolve_attn(attn_impl))
     return launch(step, _shard(params, mesh, _lm_tp_specs()),
                   jnp.asarray(seeds), mesh, param_specs=_lm_tp_specs(),
                   seed_spec=P())
+
+
+def train_lm_hybrid(params: LMParams, seeds, batch_size: int,
+                    model_size: int, mesh, lr: float = LR, *, seq_len: int,
+                    n_heads: int, attn_impl: str | None = None) -> LMParams:
+    """Hybrid DDP x vocab-parallel TP on a 2-D ``(data, model)`` mesh:
+    TP's per-block and vocab collectives ride the ``"model"`` axis inside
+    each replica, DDP's weight-grad psum rides the orthogonal ``"data"``
+    axis once per step (strided seeds, SUM, unscaled LR —
+    ``train_ffns.py:182, :165`` semantics)."""
+    require_axes(mesh, DATA_AXIS, MODEL_AXIS)
+    n = mesh.shape[MODEL_AXIS]
+    h_local = _validate_tp(params.blocks, n_heads, n)
+    _validate_lm(batch_size, seq_len, model_size, n_heads, params)
+    if params.vocab % n:
+        raise ValueError(f"vocab={params.vocab} not divisible by "
+                         f"model-axis size {n}")
+    step = _make_tp_step(batch_size, model_size, seq_len, h_local,
+                         params.vocab, lr, resolve_attn(attn_impl),
+                         data_axes=(DATA_AXIS,))
+    return launch_strided(step, _shard(params, mesh, _lm_tp_specs()),
+                          seeds, mesh, DATA_AXIS, _lm_tp_specs())
+
+
+def train_lm_seq(params: LMParams, seeds, batch_size: int, model_size: int,
+                 mesh, lr: float = LR, *, seq_len: int, n_heads: int,
+                 seq_impl: str = "ring") -> LMParams:
+    """Long-context LM training: the sequence dim sharded over the
+    ``"seq"`` axis, attention crossing shards via the hand-written ring
+    (or Ulysses), the real objective computed per token block.
+
+    Everything token-pointwise — embedding lookup, positions, LNs,
+    projections, FFN, the tied head, and the cross-entropy itself — runs
+    on the shard's own ``T/n`` tokens. The global loss is the mean over
+    all tokens, i.e. the mean of the (equal-sized) shard means scaled by
+    ``1/n``; scaling each shard's local loss by ``1/n`` before ``psum``-ing
+    the weight grads reproduces the single-device gradient exactly
+    (pinned by the differential test). On a 2-D ``(data, seq)`` mesh the
+    seed schedule additionally shards strided over ``data`` and the same
+    psum rides both axes."""
+    from .sequence import resolve_seq_attn
+    require_axes(mesh, SEQ_AXIS)
+    n = mesh.shape[SEQ_AXIS]
+    dp = dict(mesh.shape).get(DATA_AXIS, 1)
+    _validate_lm(batch_size, seq_len, model_size, n_heads, params)
+    attn = resolve_seq_attn(seq_impl, n, n_heads, seq_len)
+    t_local = seq_len // n
+    b = batch_size // seq_len
+    vocab = params.vocab
+
+    def step(params: LMParams, seed) -> LMParams:
+        tokens, targets = lm_batch_from_seed(seed, b, seq_len, vocab)
+        r = axis_index(SEQ_AXIS)
+        # this shard's token block (full batch regenerated from the seed,
+        # so ring causality over global positions stays exact)
+        tokens, targets = (
+            lax.dynamic_slice_in_dim(t, r * t_local, t_local, 1)
+            for t in (tokens, targets))
+
+        def loss_fn(p: LMParams):
+            x = p.wte[tokens] + lax.dynamic_slice_in_dim(
+                p.wpe, r * t_local, t_local, 0)
+            x = transformer_fwd(p.blocks, x, n_heads, causal=True,
+                                attn=attn)
+            h = layernorm(p.ln_f, x)
+            logits = h @ p.wte.T
+            # local mean / n == this shard's share of the global mean
+            return xent_loss(logits.reshape(-1, vocab),
+                             targets.reshape(-1)) / n
+
+        grads = jax.grad(loss_fn)(params)
+        axes = (SEQ_AXIS, DATA_AXIS) if dp > 1 else (SEQ_AXIS,)
+        grads = jax.tree_util.tree_map(
+            lambda g: grad_reduce(g, axes), grads)
+        return sgd(params, grads, lr)
+
+    if dp > 1:
+        return launch_strided(step, clone_params(params), seeds, mesh,
+                              DATA_AXIS, P())
+    return launch(step, clone_params(params), jnp.asarray(seeds), mesh,
+                  param_specs=P(), seed_spec=P())

@@ -161,6 +161,32 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return _ring_attention(q, k, v, axis_name, causal)
 
 
+def resolve_seq_attn(seq_impl: str, n: int, n_heads: int, seq_len: int,
+                     axis: str = SEQ_AXIS):
+    """Shared dispatch for the sequence-parallel trainers (transformer and
+    LM families): validates shard divisibility and returns the multi-head
+    attention op (``[H, T_local, dh]`` per batch element) whose
+    cross-shard traffic is the hand-written ring (KV rotating over
+    ``ppermute``) or Ulysses (two ``all_to_all``s)."""
+    if seq_len % n:
+        raise ValueError(f"seq_len={seq_len} not divisible by seq-axis "
+                         f"size {n}")
+    if seq_impl == "ring":
+        def attn(q, k, v, causal):  # ring per head
+            return jax.vmap(
+                lambda q, k, v: ring_attention(q, k, v, axis, causal)
+            )(q, k, v)
+        return attn
+    if seq_impl == "ulysses":
+        if n_heads % n:
+            raise ValueError(f"n_heads={n_heads} not divisible by "
+                             f"seq-axis size {n} (Ulysses scatters heads)")
+        return lambda q, k, v, causal: ulysses_attention(q, k, v, axis,
+                                                         causal)
+    raise ValueError(f"unknown seq_impl {seq_impl!r} "
+                     "(expected 'ring' or 'ulysses')")
+
+
 def sequence_parallel_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                                 mesh, causal: bool = True) -> jax.Array:
     """Launcher: shard ``[T, d]`` tensors over the ``"seq"`` axis, run ring

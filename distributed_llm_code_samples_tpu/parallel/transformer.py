@@ -380,28 +380,12 @@ def train_transformer_seq(params: TransformerParams, seeds,
     seq-only == ``train_transformer_single``; data x seq ==
     ``train_transformer_ddp`` over the data axis alone.
     """
-    from .sequence import ring_attention, ulysses_attention
+    from .sequence import resolve_seq_attn
     require_axes(mesh, SEQ_AXIS)
     n = mesh.shape[SEQ_AXIS]
     dp = dict(mesh.shape).get(DATA_AXIS, 1)
     _validate_shapes(batch_size, seq_len, model_size, n_heads)
-    if seq_len % n:
-        raise ValueError(f"seq_len={seq_len} not divisible by seq-axis "
-                         f"size {n}")
-    if seq_impl == "ring":
-        def attn(q, k, v, causal):  # [H, T_local, dh]: ring per head
-            return jax.vmap(
-                lambda q, k, v: ring_attention(q, k, v, SEQ_AXIS, causal)
-            )(q, k, v)
-    elif seq_impl == "ulysses":
-        if n_heads % n:
-            raise ValueError(f"n_heads={n_heads} not divisible by "
-                             f"seq-axis size {n} (Ulysses scatters heads)")
-        def attn(q, k, v, causal):
-            return ulysses_attention(q, k, v, SEQ_AXIS, causal)
-    else:
-        raise ValueError(f"unknown seq_impl {seq_impl!r} "
-                         "(expected 'ring' or 'ulysses')")
+    attn = resolve_seq_attn(seq_impl, n, n_heads, seq_len)
     t_local = seq_len // n
 
     def step(params: TransformerParams, seed) -> TransformerParams:

@@ -114,6 +114,28 @@ def test_round_trip_nonalphabetical_fields(tmp_path, backend):
                 np.asarray(getattr(p, field)), err_msg=field)
 
 
+@pytest.mark.parametrize("backend", ["npz", "orbax", "native"])
+def test_round_trip_nested_tree(tmp_path, backend):
+    """The LM family's params NEST (TransformerParams inside LMParams):
+    path-based leaf names and targeted restores must round-trip the nested
+    structure with every leaf in its own field."""
+    if backend == "orbax":
+        pytest.importorskip("orbax.checkpoint")
+    from distributed_llm_code_samples_tpu.models import init_lm
+    p = init_lm(jax.random.PRNGKey(2), 16, 8, 2, 8)
+    d = str(tmp_path / f"lm_{backend}")
+    save_checkpoint(d, p, 1, backend=backend)
+    got, _, _ = restore_checkpoint(d, p)
+    flat_got = jax.tree_util.tree_flatten_with_path(got)[0]
+    flat_want = jax.tree_util.tree_flatten_with_path(p)[0]
+    assert [jax.tree_util.keystr(k) for k, _ in flat_got] == \
+        [jax.tree_util.keystr(k) for k, _ in flat_want]
+    for (path, a), (_, b) in zip(flat_got, flat_want):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b),
+            err_msg=jax.tree_util.keystr(path))
+
+
 def test_checkpoint_every_divisor_validated(tmp_path, params):
     """A bad --checkpoint_every fails up front with a clear error, not as a
     divisibility assert deep inside the strategy after segment 1."""

@@ -159,6 +159,65 @@ def test_lm_training_reduces_loss():
     assert after < before - 0.1
 
 
+def test_lm_hybrid_matches_ddp(mesh4x2):
+    """Hybrid(data=4 x model=2) == DDP(4): vocab-parallel TP is an exact
+    decomposition, so only the data axis affects the math."""
+    from distributed_llm_code_samples_tpu.data import make_seed_schedule
+    from distributed_llm_code_samples_tpu.parallel import (
+        make_mesh, DATA_AXIS, train_lm_hybrid)
+    params = small_lm(seed=2)
+    seeds = make_seed_schedule(8, random_seed=13)
+    kw = dict(seq_len=SEQ, n_heads=HEADS)
+    hyb = train_lm_hybrid(params, seeds, 2 * SEQ, D, mesh4x2, **kw)
+    ddp = train_lm_ddp(params, seeds, 2 * SEQ, D,
+                       make_mesh({DATA_AXIS: 4}), **kw)
+    for got, want in zip(jax.tree_util.tree_leaves(hyb),
+                         jax.tree_util.tree_leaves(ddp)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   **tolerances())
+
+
+def test_lm_seq_composes_with_data_parallel():
+    """2-D data x seq: each data replica trains its strided seed column
+    with its sequence ring-sharded — must equal DDP over the data axis
+    alone (the seq decomposition is exact)."""
+    from distributed_llm_code_samples_tpu.data import make_seed_schedule
+    from distributed_llm_code_samples_tpu.parallel import (
+        make_mesh, DATA_AXIS, SEQ_AXIS, train_lm_seq)
+    params = small_lm(seed=8)
+    seeds = make_seed_schedule(4, random_seed=19)
+    kw = dict(seq_len=SEQ, n_heads=HEADS)
+    mesh2d = make_mesh({DATA_AXIS: 2, SEQ_AXIS: 4})
+    seq2d = train_lm_seq(params, seeds, 2 * SEQ, D, mesh2d, **kw)
+    ddp = train_lm_ddp(params, seeds, 2 * SEQ, D,
+                       make_mesh({DATA_AXIS: 2}), **kw)
+    for got, want in zip(jax.tree_util.tree_leaves(seq2d),
+                         jax.tree_util.tree_leaves(ddp)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   **tolerances())
+
+
+def test_lm_seq_matches_single():
+    """Long-context LM over the seq axis (ring attention + 1/n-scaled
+    local losses) == the single-device oracle on the same seeds, for both
+    seq impls."""
+    from distributed_llm_code_samples_tpu.data import make_seed_schedule
+    from distributed_llm_code_samples_tpu.parallel import (
+        make_mesh, SEQ_AXIS, train_lm_seq)
+    params = small_lm(seed=3)
+    seeds = make_seed_schedule(3, random_seed=17)
+    kw = dict(seq_len=SEQ, n_heads=HEADS)
+    single = train_lm_single(params, seeds, 2 * SEQ, D, **kw)
+    mesh = make_mesh({SEQ_AXIS: 4})
+    for impl in ("ring", "ulysses"):
+        seq = train_lm_seq(params, seeds, 2 * SEQ, D, mesh,
+                           seq_impl=impl, **kw)
+        for got, want in zip(jax.tree_util.tree_leaves(seq),
+                             jax.tree_util.tree_leaves(single)):
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       err_msg=impl, **tolerances())
+
+
 # --- vocab-parallel pieces in isolation ------------------------------------
 
 
