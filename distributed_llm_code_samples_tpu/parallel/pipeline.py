@@ -111,20 +111,45 @@ def _vary_tree(tree, vary_axes):
     return jax.tree_util.tree_map(lambda t: _vary_to(t, vary_axes), tree)
 
 
-def _gpipe_step(params: FFNStackParams, x_mb, dy_mb, s, M: int, S: int,
-                axis: str, vary_axes, block_fwd, block_bwd):
-    """GPipe: forward wavefront, fence, backward wavefront."""
-    mb, d = x_mb.shape[1:]
-    dtype = x_mb.dtype
+def _acts_struct(stage_fwd, params, x0):
+    """Shape/dtype of one microbatch's stashed residuals (trace-only)."""
+    return jax.eval_shape(lambda p, x: stage_fwd(p, x)[1], params, x0)
+
+
+def _grad_zeros(params, vary_axes):
+    """Per-leaf gradient accumulators typed over ``vary_axes`` UNION the
+    leaf's own vma: a model-sharded leaf's grads vary over the model axis
+    even when the schedule carries (activation stream) deliberately do not
+    (tp_block requires a model-invariant stream — see
+    ``make_transformer_pp_step``)."""
+    return jax.tree_util.tree_map(
+        lambda l: _vary_to(jnp.zeros_like(l),
+                           tuple(vary_axes) + tuple(jax.typeof(l).vma)),
+        params)
+
+
+def _gpipe_step(params, x_mb, dy_mb, s, M: int, S: int,
+                axis: str, vary_axes, stage_fwd, stage_bwd):
+    """GPipe: forward wavefront, fence, backward wavefront.
+
+    Generic over the stage compute: ``stage_fwd(params, x) -> (y, acts)``
+    and ``stage_bwd(dy, params, acts) -> (dx, grads)`` where ``params`` /
+    ``grads`` are any matching pytree and ``acts`` is a stashable array
+    pytree (the FFN stack stashes block inputs, the transformer stack
+    block inputs of its blocks — both recompute internals in backward)."""
+    x_shape, dtype = x_mb.shape[1:], x_mb.dtype
     ticks = M + S - 1
-    n_local = params.w1.shape[0]
 
     def vary(tree):
         return _vary_tree(tree, vary_axes)
 
+    def stash_zeros(struct):
+        return jax.tree_util.tree_map(
+            lambda l: _vzeros((M,) + l.shape, l.dtype, vary_axes), struct)
+
     # ---- forward wavefront: activation streams +1 around the ring ----
-    state = _vzeros((mb, d), dtype, vary_axes)
-    stash = _vzeros((M, n_local, mb, d), dtype, vary_axes)
+    state = _vzeros(x_shape, dtype, vary_axes)
+    stash = stash_zeros(_acts_struct(stage_fwd, params, x_mb[0]))
     for t in range(ticks):
         m = t - s  # this stage's microbatch this tick (traced: s varies)
         valid = (m >= 0) & (m < M)
@@ -133,12 +158,13 @@ def _gpipe_step(params: FFNStackParams, x_mb, dy_mb, s, M: int, S: int,
         inp = jnp.where(s == 0, x_mb[min(t, M - 1)], state)
 
         def fwd_branch(stash):
-            y, acts = stack_fwd(params.w1, params.w2, inp,
-                                block_fwd=block_fwd)
-            return vary((stash.at[mc].set(acts), y))
+            y, acts = stage_fwd(params, inp)
+            stash = jax.tree_util.tree_map(
+                lambda st, a: st.at[mc].set(a), stash, acts)
+            return vary((stash, y))
 
         def fwd_idle(stash):
-            return stash, _vzeros((mb, d), dtype, vary_axes)
+            return stash, _vzeros(x_shape, dtype, vary_axes)
 
         # bubble ticks skip the block compute entirely (idle branch), they
         # don't compute-and-mask
@@ -151,52 +177,51 @@ def _gpipe_step(params: FFNStackParams, x_mb, dy_mb, s, M: int, S: int,
     stash = barrier(stash, axis)
 
     # ---- backward wavefront: grads stream -1 around the ring ----
-    dstate = _vzeros((mb, d), dtype, vary_axes)
-    g1 = _vzeros(params.w1.shape, params.w1.dtype, vary_axes)
-    g2 = _vzeros(params.w2.shape, params.w2.dtype, vary_axes)
+    dstate = _vzeros(x_shape, dtype, vary_axes)
+    grads = _grad_zeros(params, vary_axes)
     for u in range(ticks):
         m = u - (S - 1) + s  # stage s backward-processes microbatch m
         valid = (m >= 0) & (m < M)
         mc = jnp.clip(m, 0, M - 1)
         dy_in = jnp.where(s == S - 1, dy_mb[min(u, M - 1)], dstate)
 
-        def bwd_branch(carry):
-            g1, g2 = carry
-            dx, (dg1, dg2) = stack_bwd(dy_in, params.w1, params.w2,
-                                       stash[mc], block_bwd=block_bwd)
-            return vary(((g1 + dg1, g2 + dg2), dx))
+        def bwd_branch(grads):
+            dx, dg = stage_bwd(
+                dy_in, params,
+                jax.tree_util.tree_map(lambda st: st[mc], stash))
+            return vary((jax.tree_util.tree_map(jnp.add, grads, dg), dx))
 
-        def bwd_idle(carry):
-            return carry, _vzeros((mb, d), dtype, vary_axes)
+        def bwd_idle(grads):
+            return grads, _vzeros(x_shape, dtype, vary_axes)
 
-        (g1, g2), dx = lax.cond(valid, bwd_branch, bwd_idle, (g1, g2))
+        grads, dx = lax.cond(valid, bwd_branch, bwd_idle, grads)
         dstate = ring_shift(dx, axis, shift=-1)
 
-    return g1, g2
+    return grads
 
 
-def _1f1b_step(params: FFNStackParams, x_mb, dy_mb, s, M: int, S: int,
-               axis: str, vary_axes, block_fwd, block_bwd):
+def _1f1b_step(params, x_mb, dy_mb, s, M: int, S: int,
+               axis: str, vary_axes, stage_fwd, stage_bwd):
     """1F1B: one slot stream; stage ``s`` forwards microbatch ``m`` at slot
     ``s + 2m`` and backwards it at slot ``2S - 1 - s + 2m``. The two land
     on opposite slot parities per stage, so every slot is exactly one of
     {forward, backward, bubble} — picked by ``lax.switch``. The circular
     stash never clobbers a live entry: slot ``m % K``'s next write
     (forward of ``m + K``) happens at ``s + 2m + 2K >= s + 2m + 2S``,
-    after its read (backward of ``m``) at ``2S - 1 - s + 2m``."""
-    mb, d = x_mb.shape[1:]
-    dtype = x_mb.dtype
-    n_local = params.w1.shape[0]
+    after its read (backward of ``m``) at ``2S - 1 - s + 2m``. Generic
+    over the stage compute (see ``_gpipe_step``)."""
+    x_shape, dtype = x_mb.shape[1:], x_mb.dtype
     K = min(S, M)  # in-flight microbatches per stage — the 1F1B bound
 
     def vary(tree):
         return _vary_tree(tree, vary_axes)
 
-    state_f = _vzeros((mb, d), dtype, vary_axes)  # activation from s-1
-    state_b = _vzeros((mb, d), dtype, vary_axes)  # gradient from s+1
-    stash = _vzeros((K, n_local, mb, d), dtype, vary_axes)
-    g1 = _vzeros(params.w1.shape, params.w1.dtype, vary_axes)
-    g2 = _vzeros(params.w2.shape, params.w2.dtype, vary_axes)
+    state_f = _vzeros(x_shape, dtype, vary_axes)  # activation from s-1
+    state_b = _vzeros(x_shape, dtype, vary_axes)  # gradient from s+1
+    stash = jax.tree_util.tree_map(
+        lambda l: _vzeros((K,) + l.shape, l.dtype, vary_axes),
+        _acts_struct(stage_fwd, params, x_mb[0]))
+    grads = _grad_zeros(params, vary_axes)
 
     for tau in range(2 * (M + S - 1)):
         mf = (tau - s) // 2  # fwd microbatch, live when (tau - s) is even
@@ -210,31 +235,32 @@ def _1f1b_step(params: FFNStackParams, x_mb, dy_mb, s, M: int, S: int,
         dy_in = jnp.where(s == S - 1, dy_mb[mbc], state_b)
 
         def idle(carry):
-            stash, g1, g2 = carry
-            z = _vzeros((mb, d), dtype, vary_axes)
-            return stash, g1, g2, z, z
+            stash, grads = carry
+            z = _vzeros(x_shape, dtype, vary_axes)
+            return stash, grads, z, z
 
         def fwd_branch(carry):
-            stash, g1, g2 = carry
-            y, acts = stack_fwd(params.w1, params.w2, inp,
-                                block_fwd=block_fwd)
-            return vary((stash.at[mfc % K].set(acts), g1, g2, y,
-                         jnp.zeros((mb, d), dtype)))
+            stash, grads = carry
+            y, acts = stage_fwd(params, inp)
+            stash = jax.tree_util.tree_map(
+                lambda st, a: st.at[mfc % K].set(a), stash, acts)
+            return vary((stash, grads, y, jnp.zeros(x_shape, dtype)))
 
         def bwd_branch(carry):
-            stash, g1, g2 = carry
-            dx, (dg1, dg2) = stack_bwd(dy_in, params.w1, params.w2,
-                                       stash[mbc % K], block_bwd=block_bwd)
-            return vary((stash, g1 + dg1, g2 + dg2,
-                         jnp.zeros((mb, d), dtype), dx))
+            stash, grads = carry
+            dx, dg = stage_bwd(
+                dy_in, params,
+                jax.tree_util.tree_map(lambda st: st[mbc % K], stash))
+            return vary((stash, jax.tree_util.tree_map(jnp.add, grads, dg),
+                         jnp.zeros(x_shape, dtype), dx))
 
         which = jnp.where(f_valid, 1, jnp.where(b_valid, 2, 0))
-        stash, g1, g2, y, dx = lax.switch(
-            which, (idle, fwd_branch, bwd_branch), (stash, g1, g2))
+        stash, grads, y, dx = lax.switch(
+            which, (idle, fwd_branch, bwd_branch), (stash, grads))
         state_f = ring_shift(y, axis, shift=1)
         state_b = ring_shift(dx, axis, shift=-1)
 
-    return g1, g2
+    return grads
 
 
 def make_step(batch_size: int, model_size: int, n_stages: int,
@@ -270,23 +296,176 @@ def make_step(batch_size: int, model_size: int, n_stages: int,
             dx, grads = ffn_bwd(dy, w1_shard, w2_shard, x)
             return all_reduce(dx, model_axis), grads
 
+    def stage_fwd(p: FFNStackParams, x):
+        return stack_fwd(p.w1, p.w2, x, block_fwd=block_fwd)
+
+    def stage_bwd(dy, p: FFNStackParams, acts):
+        dx, (g1, g2) = stack_bwd(dy, p.w1, p.w2, acts,
+                                 block_bwd=block_bwd)
+        return dx, FFNStackParams(g1, g2)
+
     def step(params: FFNStackParams, seed) -> FFNStackParams:
         s = axis_index(axis)
         x, dloss_dx = batch_from_seed(seed, batch_size, model_size,
                                       params.w1.dtype)
         x_mb = x.reshape(M, mb, model_size)
         dy_mb = dloss_dx.reshape(M, mb, model_size)
-        g1, g2 = sched(params, x_mb, dy_mb, s, M, S, axis, vary_axes,
-                       block_fwd, block_bwd)
+        grads = sched(params, x_mb, dy_mb, s, M, S, axis, vary_axes,
+                      stage_fwd, stage_bwd)
         if data_axis is not None:
             # DDP reduction across pipeline replicas (SUM, unscaled LR,
             # train_ffns.py:165 semantics)
-            g1 = all_reduce(g1, data_axis)
-            g2 = all_reduce(g2, data_axis)
+            grads = jax.tree_util.tree_map(
+                lambda g: all_reduce(g, data_axis), grads)
         # per-stage SGD on the stage's own layers (and model shard)
-        return sgd(params, FFNStackParams(g1, g2), lr)
+        return sgd(params, grads, lr)
 
     return step
+
+
+def make_transformer_pp_step(batch_size: int, model_size: int,
+                             seq_len: int, n_heads: int, n_stages: int,
+                             n_microbatches: int, lr: float = LR,
+                             axis: str = PIPE_AXIS,
+                             schedule: str = "gpipe",
+                             data_axis: str | None = None,
+                             model_axis: str | None = None,
+                             causal: bool = True, attn=None):
+    """One transformer-PP step for one stage: the same two schedules over
+    stages of pre-LN blocks (``[L/S]`` blocks per stage, activations
+    ``[mb, T, d]``). The stash keeps each block's *input* only; the
+    backward recomputes block internals via ``jax.vjp`` of the block at
+    the stashed input — the framework's recompute policy
+    (``train_ffns.py:63``) transplanted to the transformer stage. With a
+    ``model_axis``, each stage's blocks run Megatron-sharded (``tp_block``:
+    heads column-, wo/w2 row-parallel, psums riding the orthogonal model
+    axis inside the stage compute)."""
+    from ..models.transformer import TransformerParams, transformer_block
+    from .transformer import tp_block
+    S, M = n_stages, n_microbatches
+    b = batch_size // seq_len
+    if batch_size % seq_len:
+        raise ValueError(f"tokens {batch_size} not divisible by "
+                         f"seq_len {seq_len}")
+    if b % M:
+        raise ValueError(f"batch {b} not divisible by {M} microbatches")
+    if schedule not in SCHEDULES:
+        raise ValueError(f"unknown pipeline schedule {schedule!r} "
+                         f"(expected one of {SCHEDULES})")
+    mb = b // M
+    sched = _gpipe_step if schedule == "gpipe" else _1f1b_step
+    # The model axis is deliberately NOT in the carry typing: tp_block's
+    # f-gate discipline (psum exactly the pending cotangents) requires the
+    # activation stream typed invariant over the model axis — its psums
+    # and residual adds preserve that; force-casting the stream varying
+    # makes complete cotangents look partial and over-reduces (measured:
+    # every grad off by O(1) at tp=2). Sharded param grads still type
+    # model-varying via _grad_zeros' per-leaf union.
+    vary_axes = tuple(a for a in (axis, data_axis) if a)
+
+    if model_axis is None:
+        def block(leaves, x):
+            return transformer_block(*leaves, x, n_heads, causal, attn)
+    else:
+        def block(leaves, x):
+            return tp_block(*leaves, x, n_heads, axis=model_axis,
+                            causal=causal, attn=attn)
+
+    def stage_fwd(p: TransformerParams, x):
+        acts = []
+        for l in range(p.ln1.shape[0]):
+            acts.append(x)
+            x = block(tuple(leaf[l] for leaf in p), x)
+        return x, jnp.stack(acts)          # [L/S, mb, T, d] block inputs
+
+    def stage_bwd(dy, p: TransformerParams, acts):
+        grads = jax.tree_util.tree_map(jnp.zeros_like, p)
+        for l in reversed(range(p.ln1.shape[0])):
+            leaves = tuple(leaf[l] for leaf in p)
+            _, vjp = jax.vjp(block, leaves, acts[l])
+            dleaves, dy = vjp(dy)
+            grads = TransformerParams(*(
+                g.at[l].set(dg) for g, dg in zip(grads, dleaves)))
+        return dy, grads
+
+    def step(params: TransformerParams, seed) -> TransformerParams:
+        from .transformer import _reshape_batch
+        s = axis_index(axis)
+        x, dloss_dx = _reshape_batch(seed, batch_size, seq_len, model_size,
+                                     params.ln1.dtype)
+        x_mb = x.reshape(M, mb, seq_len, model_size)
+        dy_mb = dloss_dx.reshape(M, mb, seq_len, model_size)
+        # Type the params varying over every schedule axis BEFORE the
+        # block vjps: the attention projections are plain ops, and
+        # against data-invariant params their transposes auto-insert a
+        # psum over the data axis (the pvary transpose) — which the
+        # explicit all_reduce below would double-count. Varying-typed
+        # params keep every weight cotangent partial, exactly like the
+        # custom_vjp rules' (grad_reduce doctrine, collectives.py), so
+        # the explicit reductions below are the only ones.
+        grads = sched(_vary_tree(params, vary_axes), x_mb, dy_mb, s, M, S,
+                      axis, vary_axes, stage_fwd, stage_bwd)
+        # LN-gain grads need no model-axis collective: the stream typing
+        # keeps them invariant (complete, identical on every model shard);
+        # if that ever regressed, the scan-carry typecheck fails at trace.
+        if data_axis is not None:
+            grads = jax.tree_util.tree_map(
+                lambda g: all_reduce(g, data_axis), grads)
+        return sgd(params, grads, lr)
+
+    return step
+
+
+def train_transformer_pp(params, seeds, batch_size: int, model_size: int,
+                         mesh, lr: float = LR, *, seq_len: int,
+                         n_heads: int, n_microbatches: int | None = None,
+                         schedule: str = "gpipe", causal: bool = True,
+                         attn_impl: str | None = None):
+    """Pipeline the transformer family over the ``"pipe"`` ring, with the
+    same mesh compositions as the FFN path: ``data`` replicates the
+    pipeline (strided seeds, one grad psum), ``model`` Megatron-shards
+    each stage's blocks — ``data x pipe x model`` on one mesh. A pure
+    pipe mesh equals the single-device transformer run (microbatch grads
+    sum to the full-batch grad); every composition is differential-tested.
+    Microbatching splits the *batch* dim (sequences stay whole — attention
+    needs them)."""
+    from jax.sharding import PartitionSpec as P  # noqa: F811 (local reuse)
+    from ..models.transformer import TransformerParams
+    from .transformer import _validate_shapes, _validate_tp, resolve_attn
+    require_axes(mesh, PIPE_AXIS)
+    shape = dict(mesh.shape)
+    S = shape[PIPE_AXIS]
+    dp = shape.get(DATA_AXIS, 1)
+    tp_n = shape.get(MODEL_AXIS, 1)
+    _validate_shapes(batch_size, seq_len, model_size, n_heads)
+    if params.ln1.shape[0] % S:
+        raise ValueError(f"{params.ln1.shape[0]} layers not divisible "
+                         f"into {S} pipeline stages")
+    h_eff = n_heads
+    if tp_n > 1:
+        h_eff = _validate_tp(params, n_heads, tp_n)
+    M = S if n_microbatches is None else n_microbatches
+
+    col = P(PIPE_AXIS, MODEL_AXIS, None) if tp_n > 1 \
+        else P(PIPE_AXIS, None, None)
+    row = P(PIPE_AXIS, None, MODEL_AXIS) if tp_n > 1 \
+        else P(PIPE_AXIS, None, None)
+    specs = TransformerParams(
+        ln1=P(PIPE_AXIS, None), wq=col, wk=col, wv=col, wo=row,
+        ln2=P(PIPE_AXIS, None), w1=col, w2=row)
+    sharded = reshard_copy(params, jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec), specs,
+        is_leaf=lambda v: isinstance(v, P)))
+    step = make_transformer_pp_step(
+        batch_size, model_size, seq_len, h_eff, S, M, lr,
+        schedule=schedule, data_axis=DATA_AXIS if dp > 1 else None,
+        model_axis=MODEL_AXIS if tp_n > 1 else None, causal=causal,
+        attn=resolve_attn(attn_impl))
+
+    if dp > 1:
+        return launch_strided(step, sharded, seeds, mesh, DATA_AXIS, specs)
+    return launch(step, sharded, jnp.asarray(seeds), mesh,
+                  param_specs=specs, seed_spec=P())
 
 
 def train_pp(params: FFNStackParams, seeds, batch_size: int,

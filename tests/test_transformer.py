@@ -371,6 +371,49 @@ def test_tp_sequence_parallel_rejects_indivisible_seq(params):
                              n_heads=H, sequence_parallel=True)
 
 
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_transformer_pp_matches_single(schedule):
+    """Transformer pipeline (stages of pre-LN blocks over the ppermute
+    ring) == the single-device transformer: microbatch grads sum to the
+    full-batch grad under both schedules, M<S and M>S."""
+    from distributed_llm_code_samples_tpu.parallel import (
+        PIPE_AXIS, train_transformer_pp)
+    p4 = init_transformer(jax.random.PRNGKey(5), D, 4)
+    b = 8  # batch elements; microbatched over the pipe schedules
+    seeds = make_seed_schedule(2, random_seed=41)
+    single = train_transformer_single(p4, seeds, b * T, D, lr=0.05,
+                                      seq_len=T, n_heads=H)
+    mesh = make_mesh({PIPE_AXIS: 4})
+    for m in (2, 8):
+        got = train_transformer_pp(p4, seeds, b * T, D, mesh, lr=0.05,
+                                   seq_len=T, n_heads=H,
+                                   n_microbatches=m, schedule=schedule)
+        for name, a, b_ in zip(TransformerParams._fields, got, single):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=2e-4, atol=1e-5,
+                                       err_msg=f"{name} M={m}")
+
+
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_transformer_pp_composes_3d(params, schedule):
+    """data x pipe x model on the transformer: equals DDP over the data
+    axis alone (pipe and Megatron decompositions are exact) — under both
+    schedules, since the model-axis carry typing is the subtle part."""
+    from distributed_llm_code_samples_tpu.parallel import (
+        PIPE_AXIS, train_transformer_pp)
+    seeds = make_seed_schedule(4, random_seed=43)
+    b = 4
+    ddp = train_transformer_ddp(params, seeds, b * T, D,
+                                make_mesh({DATA_AXIS: 2}), lr=0.05,
+                                seq_len=T, n_heads=H)
+    mesh3d = make_mesh({DATA_AXIS: 2, PIPE_AXIS: 2, MODEL_AXIS: 2})
+    got = train_transformer_pp(params, seeds, b * T, D, mesh3d, lr=0.05,
+                               seq_len=T, n_heads=H, schedule=schedule)
+    for name, a, b_ in zip(TransformerParams._fields, got, ddp):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-4, atol=1e-5, err_msg=name)
+
+
 @pytest.mark.parametrize("seq_impl", ["ring", "ulysses"])
 def test_seq_parallel_composes_with_data_parallel(params, seq_impl):
     """2-D data x seq mesh: each data replica trains its own strided
