@@ -54,10 +54,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="pipeline schedule for --method 6: gpipe (two "
                         "wavefronts, stash of M microbatches) or 1f1b "
                         "(interleaved, stash bounded by stage depth)")
+    p.add_argument("--pp_family", choices=["ffn", "transformer"],
+                   default="ffn",
+                   help="model family for --method 6: the reference's FFN "
+                        "stack or pre-LN transformer blocks (--heads; "
+                        "microbatches split the batch dim)")
     p.add_argument("--experts", type=int, default=8,
                    help="expert count for --method 7/10 (MoE)")
     p.add_argument("--heads", type=int, default=4,
-                   help="attention heads for --method 8/10/11")
+                   help="attention heads for --method 8/10/11 and "
+                        "--method 6 with --pp_family transformer")
     p.add_argument("--vocab", type=int, default=256,
                    help="vocabulary size for --method 11 (the LM family; "
                         "must be divisible by the model-axis size)")
@@ -159,6 +165,11 @@ def main(argv=None) -> int:
     if args.zero1 and args.method != 2:
         print("error: --zero1 applies to --method 2 only", file=sys.stderr)
         return 2
+    if args.pp_family != "ffn" and args.method != 6:
+        # methods 0/9 verify PP against the FFN single-device oracle
+        print("error: --pp_family applies to --method 6 only",
+              file=sys.stderr)
+        return 2
     if args.optimizer != "sgd" and args.method not in (2, 3):
         # methods 0/9 cross-check against strategies that would still run
         # inline SGD — a guaranteed spurious differential failure
@@ -188,6 +199,8 @@ def main(argv=None) -> int:
     key = jax.random.PRNGKey(args.random_seed)
 
     def family_of(method: int) -> str:
+        if method == 6 and args.pp_family == "transformer":
+            return "transformer"
         return {7: "moe", 8: "transformer",
                 10: "moe_transformer", 11: "lm"}.get(method, "ffn")
 
@@ -280,6 +293,10 @@ def main(argv=None) -> int:
             kwargs = dict(lr=lr, schedule=args.pp_schedule)
             if args.microbatches:
                 kwargs["n_microbatches"] = args.microbatches
+            if args.pp_family == "transformer":
+                from .parallel import train_transformer_pp
+                name, fn = "train_transformer_pp", train_transformer_pp
+                kwargs.update(seq_len=args.seq_len, n_heads=args.heads)
         if m == 7:
             kwargs = dict(lr=lr)  # EP's expert loop has its own structure
         if m in (8, 10, 11):

@@ -429,7 +429,6 @@ def train_transformer_pp(params, seeds, batch_size: int, model_size: int,
     sum to the full-batch grad); every composition is differential-tested.
     Microbatching splits the *batch* dim (sequences stay whole — attention
     needs them)."""
-    from jax.sharding import PartitionSpec as P  # noqa: F811 (local reuse)
     from ..models.transformer import TransformerParams
     from .transformer import _validate_shapes, _validate_tp, resolve_attn
     require_axes(mesh, PIPE_AXIS)

@@ -51,6 +51,23 @@ def test_cli_method9_verifies_every_strategy():
 
 
 @pytest.mark.slow
+def test_cli_transformer_pipeline_method():
+    r = _run_cli("-s", "2", "-bs", "8", "-n", "8", "-l", "4", "-d", "32",
+                 "-m", "6", "-r", "3", "--fake_devices", "4",
+                 "--pp_family", "transformer", "--heads", "4",
+                 "--pp_schedule", "1f1b", "--lr", "0.1")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "train_transformer_pp takes" in r.stdout
+
+
+def test_cli_pp_family_guard():
+    r = _run_cli("-s", "2", "-m", "9", "--pp_family", "transformer",
+                 "--fake_devices", "4")
+    assert r.returncode == 2
+    assert "--pp_family applies to --method 6" in r.stderr
+
+
+@pytest.mark.slow
 def test_cli_lm_method():
     r = _run_cli("-s", "4", "-bs", "4", "-n", "8", "-l", "2", "-d", "32",
                  "-m", "11", "-r", "3", "--fake_devices", "4", "--tp", "4",
