@@ -69,12 +69,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "must be divisible by the model-axis size)")
     p.add_argument("--lr", type=float, default=None,
                    help="override LR (default 1e-5, train_ffns.py:29)")
-    p.add_argument("--optimizer", choices=["sgd", "momentum", "adam"],
+    p.add_argument("--optimizer",
+                   choices=["sgd", "momentum", "adam", "adamw"],
                    default="sgd",
                    help="update rule for --method 2 (DDP) or 3 (FSDP, "
                         "state sharded with the params): sgd is the "
                         "reference's stateless inline update; momentum/"
-                        "adam carry hand-written optimizer state")
+                        "adam/adamw carry hand-written optimizer state")
+    p.add_argument("--clip_norm", type=float, default=0.0,
+                   help="with --method 2 or 3: clip gradients to this "
+                        "global L2 norm before the optimizer update "
+                        "(0 = off)")
     p.add_argument("--tp_sp", action="store_true",
                    help="with --method 4 or 8: Megatron sequence-parallel "
                         "TP (token-sharded activations; all_gather + "
@@ -174,6 +179,14 @@ def main(argv=None) -> int:
         # methods 0/9 cross-check against strategies that would still run
         # inline SGD — a guaranteed spurious differential failure
         print("error: --optimizer applies to --method 2 or 3 only",
+              file=sys.stderr)
+        return 2
+    if args.clip_norm and args.method not in (2, 3):
+        print("error: --clip_norm applies to --method 2 or 3 only",
+              file=sys.stderr)
+        return 2
+    if args.clip_norm < 0:
+        print(f"error: --clip_norm must be >= 0 (got {args.clip_norm})",
               file=sys.stderr)
         return 2
     if (args.zero1 and args.optimizer != "sgd" and args.checkpoint_dir
@@ -280,9 +293,17 @@ def main(argv=None) -> int:
         kwargs = dict(lr=lr, unroll=unroll)
         if m in (1, 2) and args.accum > 1:
             kwargs["accum"] = args.accum  # train_ddp_zero1 accepts it too
-        if m in (2, 3) and (args.optimizer != "sgd" or args.zero1):
-            from .optim import OPTIMIZERS
-            kwargs["optimizer"] = OPTIMIZERS[args.optimizer]()
+        if m in (2, 3) and (args.optimizer != "sgd" or args.zero1
+                            or args.clip_norm):
+            from .optim import OPTIMIZERS, clipped
+            opt = OPTIMIZERS[args.optimizer]()
+            if args.clip_norm:
+                # FSDP and ZeRO-1 run the update on gradient shards; the
+                # true global norm needs a psum over the sharding axis
+                sharded_update = m == 3 or args.zero1
+                opt = clipped(opt, args.clip_norm,
+                              axis=DATA_AXIS if sharded_update else None)
+            kwargs["optimizer"] = opt
             if args.zero1:
                 from .parallel import train_ddp_zero1
                 name, fn = "train_ddp_zero1", train_ddp_zero1

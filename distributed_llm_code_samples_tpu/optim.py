@@ -108,6 +108,65 @@ def adam(b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8) -> Optimizer:
                      name=f"adam({b1},{b2},{eps})")
 
 
+def adamw(b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
+          weight_decay: float = 1e-2) -> Optimizer:
+    """AdamW (Loshchilov & Hutter): Adam with *decoupled* weight decay —
+    the decay applies directly to the params (``p -= lr * wd * p``),
+    never entering the moment estimates (the difference from L2-in-loss
+    that makes it "decoupled")."""
+    base = adam(b1, b2, eps)
+
+    def update(grads, state, params, lr):
+        params = jax.tree_util.tree_map(
+            lambda p: p * (1.0 - lr * weight_decay), params)
+        return base.update(grads, state, params, lr)
+
+    return Optimizer(init=base.init, update=update,
+                     name=f"adamw({b1},{b2},{eps},{weight_decay})")
+
+
+def _sum_squares(grads) -> jax.Array:
+    return sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+               for g in jax.tree_util.tree_leaves(grads))
+
+
+def global_norm(grads) -> jax.Array:
+    """L2 norm over every leaf of a gradient pytree, written out."""
+    return jnp.sqrt(_sum_squares(grads))
+
+
+def clipped(opt: Optimizer, max_norm: float,
+            axis: str | tuple | None = None) -> Optimizer:
+    """Wrap an optimizer with global-norm gradient clipping: grads are
+    scaled by ``min(1, max_norm / ||g||)`` before the inner update — the
+    standard LLM-training stabilizer, stateless, composing with any
+    strategy that threads optimizer state.
+
+    ``axis``: when the *update itself* runs on a gradient shard (FSDP's
+    param shards, ZeRO-1's layer shards), the local leaf norm is not the
+    global norm — pass the mesh axis the grads are sharded over and the
+    squared norm is ``psum``-med across it before the scale is computed,
+    so every shard clips by the same, true global norm. Leave ``None``
+    when the update sees full gradients (single device, DDP post-psum).
+    """
+    if max_norm <= 0:
+        raise ValueError(f"max_norm must be > 0, got {max_norm}")
+
+    def update(grads, state, params, lr):
+        sq = _sum_squares(grads)
+        if axis is not None:
+            sq = jax.lax.psum(sq, axis)
+        norm = jnp.sqrt(sq)
+        scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-16))
+        grads = jax.tree_util.tree_map(
+            lambda g: g * scale.astype(g.dtype), grads)
+        return opt.update(grads, state, params, lr)
+
+    return Optimizer(init=opt.init, update=update,
+                     name=f"clipped({opt.name},{max_norm},{axis})",
+                     stateless=opt.stateless)
+
+
 def warmup_cosine(peak_lr: float, warmup_steps: int, total_steps: int,
                   min_lr: float = 0.0):
     """The standard LLM-training schedule, written out: linear warmup from
@@ -157,4 +216,5 @@ OPTIMIZERS = {
     "sgd": sgd_optimizer,
     "momentum": momentum,
     "adam": adam,
+    "adamw": adamw,
 }

@@ -80,6 +80,71 @@ def test_momentum_matches_optax(setup):
                                rtol=1e-6, atol=1e-7)
 
 
+def test_adamw_matches_optax(setup):
+    import optax
+    from distributed_llm_code_samples_tpu.optim import adamw
+    params, _ = setup
+    gs = _grads_seq(params)
+    ours = _run_opt(adamw(weight_decay=0.05), params, gs, 1e-2)
+    ref = _optax_trajectory(optax.adamw(1e-2, weight_decay=0.05), params,
+                            gs, 1e-2)
+    np.testing.assert_allclose(np.asarray(ours.w1), np.asarray(ref.w1),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(ours.w2), np.asarray(ref.w2),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_clipped_matches_optax_chain(setup):
+    import optax
+    from distributed_llm_code_samples_tpu.optim import clipped
+    params, _ = setup
+    # large grads so the clip actually engages
+    gs = [type(params)(w1=10.0 * g.w1, w2=10.0 * g.w2)
+          for g in _grads_seq(params)]
+    ours = _run_opt(clipped(sgd_optimizer(), 1.0), params, gs, 1e-2)
+    ref = _optax_trajectory(
+        optax.chain(optax.clip_by_global_norm(1.0), optax.sgd(1e-2)),
+        params, gs, 1e-2)
+    np.testing.assert_allclose(np.asarray(ours.w1), np.asarray(ref.w1),
+                               rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(ours.w2), np.asarray(ref.w2),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_clipped_is_identity_below_threshold(setup):
+    from distributed_llm_code_samples_tpu.optim import clipped, global_norm
+    params, _ = setup
+    gs = _grads_seq(params, n=1)
+    assert float(global_norm(gs[0])) < 1e4
+    ours = _run_opt(clipped(sgd_optimizer(), 1e4), params, gs, 1e-2)
+    plain = _run_opt(sgd_optimizer(), params, gs, 1e-2)
+    np.testing.assert_array_equal(np.asarray(ours.w1), np.asarray(plain.w1))
+
+
+def test_clipped_sharded_update_matches_ddp(setup, mesh4):
+    """Clipping under a *sharded* update (FSDP param shards, ZeRO-1 layer
+    shards) must clip by the true global norm (psum of the shard norms,
+    ``axis=``), equaling DDP whose update sees the full gradient. A
+    local-leaf norm would scale each shard differently and silently
+    diverge — this differential is the guard."""
+    from distributed_llm_code_samples_tpu.optim import adam, clipped
+    from distributed_llm_code_samples_tpu.parallel import train_fsdp
+    params, seeds = setup
+    # tight threshold so the clip engages every step
+    ddp = train_ddp(params, seeds, B, D, mesh4, lr=LR_TEST,
+                    optimizer=clipped(adam(), 1e-3))
+    fsdp = train_fsdp(params, seeds, B, D, mesh4, lr=LR_TEST,
+                      optimizer=clipped(adam(), 1e-3, axis=DATA_AXIS))
+    zero1 = train_ddp_zero1(params, seeds, B, D, mesh4, lr=LR_TEST,
+                            optimizer=clipped(adam(), 1e-3,
+                                              axis=DATA_AXIS))
+    for label, got in (("fsdp", fsdp), ("zero1", zero1)):
+        np.testing.assert_allclose(np.asarray(got.w1), np.asarray(ddp.w1),
+                                   rtol=1e-5, atol=1e-6, err_msg=label)
+        np.testing.assert_allclose(np.asarray(got.w2), np.asarray(ddp.w2),
+                                   rtol=1e-5, atol=1e-6, err_msg=label)
+
+
 def test_sgd_optimizer_equals_inline_sgd(setup):
     from distributed_llm_code_samples_tpu.optim import sgd
     params, _ = setup
