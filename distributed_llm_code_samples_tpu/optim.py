@@ -46,6 +46,13 @@ class Optimizer(NamedTuple):
     stateless: bool = False
 
 
+def check_state_args(optimizer, opt_state, return_state) -> None:
+    """The stateful-trainer surface contract, shared by every launcher
+    that threads optimizer state: state in/out requires an optimizer."""
+    if optimizer is None and (return_state or opt_state is not None):
+        raise ValueError("opt_state/return_state need an optimizer")
+
+
 def sgd_optimizer() -> Optimizer:
     """The reference's stateless SGD as an ``Optimizer`` (empty state), so
     every strategy that takes an optimizer degrades to exact reference

@@ -28,7 +28,7 @@ from jax.sharding import PartitionSpec as P
 from .. import LR
 from ..data import batch_from_seed
 from ..models.ffn_stack import FFNStackParams, clone_params
-from ..optim import Optimizer, sgd
+from ..optim import Optimizer, check_state_args, sgd
 from ..ops.stack import accumulated_grads, stack_fwd, stack_bwd
 from .collectives import all_reduce
 from .launcher import launch, launch_strided
@@ -123,9 +123,8 @@ def train_ddp(params: FFNStackParams, seeds, batch_size: int,
     step = make_step(batch_size, model_size, lr, unroll,
                      optimizer=optimizer, accum=accum)
 
+    check_state_args(optimizer, opt_state, return_state)
     if optimizer is None:
-        if return_state or opt_state is not None:
-            raise ValueError("opt_state/return_state need an optimizer")
         return launch_strided(step, clone_params(params), seeds, mesh,
                               DATA_AXIS, P())
     state = optimizer.init(params) if opt_state is None else opt_state

@@ -38,7 +38,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from .. import LR
 from ..data import batch_from_seed
 from ..models.ffn_stack import FFNStackParams, reshard_copy
-from ..optim import Optimizer, sgd
+from ..optim import Optimizer, check_state_args, sgd
 from ..ops.ffn import ffn_fwd, ffn_bwd
 from ..ops.stack import stack_fwd, stack_bwd
 from .collectives import all_gather, reduce_scatter
@@ -161,9 +161,8 @@ def train_fsdp(params: FFNStackParams, seeds, batch_size: int,
     step = make_step(batch_size, model_size, lr, unroll,
                      optimizer=optimizer)
 
+    check_state_args(optimizer, opt_state, return_state)
     if optimizer is None:
-        if return_state or opt_state is not None:
-            raise ValueError("opt_state/return_state need an optimizer")
         return launch_strided(step, params, seeds, mesh, DATA_AXIS,
                               PARAM_SPECS)
     # zeros_like of the sharded params keeps their sharding, so the state

@@ -38,7 +38,7 @@ from ..models.lm import LMParams, lm_loss
 from ..models.transformer import transformer_block, transformer_fwd
 from ..ops.norm import layernorm
 from ..ops.xent import xent_loss
-from ..optim import sgd
+from ..optim import check_state_args, sgd
 from .collectives import all_gather, all_reduce, axis_index, grad_reduce
 from .launcher import launch, launch_strided
 from .mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS, require_axes
@@ -65,49 +65,85 @@ def _validate_lm(batch_size: int, seq_len: int, model_size: int,
 
 
 def _make_step(batch_size: int, model_size: int, seq_len: int,
-               n_heads: int, lr: float, attn=None, reduce_axes=()):
-    """One SGD step on the real LM objective; ``batch_size`` is tokens/step
-    (seq folded, CLI convention ``train_ffns.py:379``)."""
+               n_heads: int, lr: float, attn=None, reduce_axes=(),
+               optimizer=None):
+    """One update step on the real LM objective; ``batch_size`` is
+    tokens/step (seq folded, CLI convention ``train_ffns.py:379``).
+    Without ``optimizer`` it's the reference's stateless inline SGD
+    (``(params, seed) -> params``); with one, the carry is ``(params,
+    opt_state)`` — the full LLM loop (AdamW + clipping + schedules all
+    compose through ``optim.py``)."""
     b = batch_size // seq_len
 
-    def step(params: LMParams, seed) -> LMParams:
+    def grads_of(params, seed):
         tokens, targets = lm_batch_from_seed(seed, b, seq_len, params.vocab)
         grads = jax.grad(lm_loss)(params, tokens, targets, n_heads, attn)
         if reduce_axes:
             grads = jax.tree_util.tree_map(
                 lambda g: grad_reduce(g, reduce_axes), grads)
-        return sgd(params, grads, lr)
+        return grads
 
-    return step
+    def step(params: LMParams, seed) -> LMParams:
+        return sgd(params, grads_of(params, seed), lr)
+
+    def step_opt(carry, seed):
+        params, state = carry
+        return optimizer.update(grads_of(params, seed), state, params, lr)
+
+    return step if optimizer is None else step_opt
 
 
 def train_lm_single(params: LMParams, seeds, batch_size: int,
                     model_size: int, mesh=None, lr: float = LR, *,
                     seq_len: int, n_heads: int,
-                    attn_impl: str | None = None) -> LMParams:
+                    attn_impl: str | None = None, optimizer=None,
+                    opt_state=None, return_state: bool = False):
     """Single-device LM trainer — the oracle the parallel forms are pinned
-    to."""
+    to. ``optimizer``/``opt_state``/``return_state`` follow the DDP
+    contract (``ddp.py``): stateful rules thread ``(params, state)``
+    through the scan and segments resume exactly."""
     _validate_lm(batch_size, seq_len, model_size, n_heads, params)
+    check_state_args(optimizer, opt_state, return_state)
     step = _make_step(batch_size, model_size, seq_len, n_heads, lr,
-                      resolve_attn(attn_impl))
+                      resolve_attn(attn_impl), optimizer=optimizer)
+
+    if optimizer is None:
+        @jax.jit
+        def run(params, seeds):
+            return lax.scan(lambda p, s: (step(p, s), None), params,
+                            seeds)[0]
+
+        return run(clone_params(params), jnp.asarray(seeds))
+
+    state = optimizer.init(params) if opt_state is None else opt_state
 
     @jax.jit
-    def run(params, seeds):
-        return lax.scan(lambda p, s: (step(p, s), None), params, seeds)[0]
+    def run_opt(carry, seeds):
+        return lax.scan(lambda c, s: (step(c, s), None), carry, seeds)[0]
 
-    return run(clone_params(params), jnp.asarray(seeds))
+    out, state = run_opt((clone_params(params), state), jnp.asarray(seeds))
+    return (out, state) if return_state else out
 
 
 def train_lm_ddp(params: LMParams, seeds, batch_size: int, model_size: int,
                  mesh, lr: float = LR, *, seq_len: int, n_heads: int,
-                 attn_impl: str | None = None) -> LMParams:
-    """DDP: replicated params, strided seeds, grads summed per step."""
+                 attn_impl: str | None = None, optimizer=None,
+                 opt_state=None, return_state: bool = False):
+    """DDP: replicated params, strided seeds, grads summed per step.
+    ``optimizer`` threads replicated state (the ``ddp.py`` contract)."""
     require_axes(mesh, DATA_AXIS)
     _validate_lm(batch_size, seq_len, model_size, n_heads, params)
+    check_state_args(optimizer, opt_state, return_state)
     step = _make_step(batch_size, model_size, seq_len, n_heads, lr,
-                      resolve_attn(attn_impl), reduce_axes=(DATA_AXIS,))
+                      resolve_attn(attn_impl), reduce_axes=(DATA_AXIS,),
+                      optimizer=optimizer)
+    if optimizer is None:
+        return launch_strided(step, clone_params(params), seeds, mesh,
+                              DATA_AXIS, P())
+    state = optimizer.init(params) if opt_state is None else opt_state
     return launch_strided(step, clone_params(params), seeds, mesh,
-                          DATA_AXIS, P())
+                          DATA_AXIS, P(), state=state, state_specs=P(),
+                          return_state=return_state)
 
 
 def train_lm_fsdp(params: LMParams, seeds, batch_size: int, model_size: int,

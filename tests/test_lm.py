@@ -218,6 +218,37 @@ def test_lm_seq_matches_single():
                                        err_msg=impl, **tolerances())
 
 
+def test_lm_stateful_optimizer_threads_state(mesh4):
+    """The full LLM loop on the real objective: clipped AdamW through the
+    single and DDP LM trainers. A segmented run — optimizer state
+    threaded across the boundary — equals an uninterrupted one: the
+    exact-resume contract (``ddp.py``) on the LM family."""
+    from distributed_llm_code_samples_tpu.data import make_seed_schedule
+    from distributed_llm_code_samples_tpu.optim import adamw, clipped
+    params = small_lm(seed=9)
+    opt = clipped(adamw(weight_decay=0.01), 1.0)
+    seeds = make_seed_schedule(8, random_seed=23)
+    kw = dict(seq_len=SEQ, n_heads=HEADS, lr=1e-2, optimizer=opt)
+    whole = train_lm_ddp(params, seeds, 2 * SEQ, D, mesh4, **kw)
+    # segmented: 4 steps, carry state, 4 more
+    p1, s1 = train_lm_ddp(params, seeds[:4], 2 * SEQ, D, mesh4,
+                          return_state=True, **kw)
+    p2 = train_lm_ddp(p1, seeds[4:], 2 * SEQ, D, mesh4, opt_state=s1, **kw)
+    for got, want in zip(jax.tree_util.tree_leaves(p2),
+                         jax.tree_util.tree_leaves(whole)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-7)
+    # and the single-device stateful path agrees with itself segmented
+    w_single = train_lm_single(params, seeds, 2 * SEQ, D, **kw)
+    q1, t1 = train_lm_single(params, seeds[:4], 2 * SEQ, D,
+                             return_state=True, **kw)
+    q2 = train_lm_single(q1, seeds[4:], 2 * SEQ, D, opt_state=t1, **kw)
+    for got, want in zip(jax.tree_util.tree_leaves(q2),
+                         jax.tree_util.tree_leaves(w_single)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-7)
+
+
 # --- vocab-parallel pieces in isolation ------------------------------------
 
 
