@@ -1,0 +1,183 @@
+#!/usr/bin/env python
+"""Scaling evidence for the BASELINE north star without multi-chip hardware.
+
+The north star (BASELINE.md) is >=90% of ideal linear scaling for
+DDP/FSDP/TP on a v5e-32 slice. One real chip can't measure that, so this
+harness produces the strongest evidence available short of the slice:
+
+1. **Real multi-chip codegen**: each strategy's step is AOT-compiled
+   against genuine v5e topology descriptors (8 chips = ``v5e:2x4``,
+   32 = ``v5e:4x8``) — the same XLA:TPU backend the slice would run —
+   and the compiled HLO is checked for the expected collectives and for
+   async start/done splits (XLA's latency-hiding scheduler CAN overlap
+   them with compute).
+
+2. **An analytic roofline**: per-chip collective bytes per step are known
+   in closed form for each strategy (ring all-reduce moves
+   ``2*(n-1)/n * bytes``, all-gather/reduce-scatter ``(n-1)/n * bytes``),
+   and per-step compute time is anchored to the *measured* single-chip
+   benchmark (BENCH r2: 0.92 MFU of the 197 Tflop/s bf16 peak). From
+   those, the ICI bandwidth required to hit 90% scaling follows directly:
+   with overlap, comm must fit inside compute/0.9; a fully-sequential
+   bound (no overlap at all) needs comm <= compute/9.
+
+Emits one JSON line per (strategy, chips) scenario with the HLO evidence
+and the roofline numbers, then a summary line. Run on any host:
+``JAX_PLATFORMS=cpu python bench_scaling.py`` (needs libtpu AOT support,
+present in this image; no TPU attached).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import jax
+
+# AOT compilation needs no accelerator; the config update (not the env
+# var, which the axon sitecustomize overrides) selects the host backend.
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.sharding import Mesh, PartitionSpec as P  # noqa: E402
+
+# Measured anchor (BENCH_r02 on the real chip): the framework's step runs
+# at this fraction of the chip's bf16 peak at the BASELINE config-5 shape.
+MEASURED_MFU = float(os.environ.get("SCALING_MFU", 0.92))
+PEAK_FLOPS = 197e12  # v5e bf16 peak (public spec)
+
+
+def _mesh(axes: dict, n_chips: int) -> Mesh:
+    from jax.experimental import topologies
+    name = {8: "v5e:2x4", 32: "v5e:4x8"}[n_chips]
+    topo = topologies.get_topology_desc(platform="tpu", topology_name=name)
+    devs = np.array(topo.devices).reshape(tuple(axes.values()))
+    return Mesh(devs, tuple(axes))
+
+
+def _struct(tree):
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype), tree)
+
+
+def _compile_hlo(step, mesh, param_specs, params):
+    f = jax.jit(jax.shard_map(step, mesh=mesh,
+                              in_specs=(param_specs, P()),
+                              out_specs=param_specs))
+    return f.lower(_struct(params),
+                   jax.ShapeDtypeStruct((), jnp.int32)).compile().as_text()
+
+
+def _scenarios():
+    """(name, chips, builder) for the BASELINE configs that scale.
+
+    Each builder returns ``(step, mesh, param_specs, params,
+    flops_per_step_per_chip, comm_bytes_per_chip)``.
+    """
+    from distributed_llm_code_samples_tpu.models import init_ffn_stack
+    from distributed_llm_code_samples_tpu.parallel import (ddp, fsdp, tp)
+
+    def ffn_flops(tokens, d, layers):  # recompute-policy matmul FLOPs
+        return 14 * tokens * d * (4 * d) * layers
+
+    def ddp_like(d, layers, tokens, chips, fsdp_mode):
+        from distributed_llm_code_samples_tpu.parallel.mesh import DATA_AXIS
+        params = init_ffn_stack(jax.random.PRNGKey(0), d, layers)
+        pbytes = 4 * params.num_params()
+        n = chips
+        if fsdp_mode:
+            step = fsdp.make_step(tokens, d, 0.1)
+            specs = fsdp.PARAM_SPECS
+            # fwd gather + bwd gather + grad reduce-scatter, (n-1)/n each
+            comm = 3 * (n - 1) / n * pbytes
+        else:
+            step = ddp.make_step(tokens, d, 0.1)
+            specs = P()  # DDP params replicate
+            # ring all-reduce of the full grads
+            comm = 2 * (n - 1) / n * pbytes
+        mesh = _mesh({DATA_AXIS: chips}, chips)
+        # DDP/FSDP shard the *steps* (strided seeds): per-chip compute is
+        # the full per-step batch — scaling shows up as steps/sec * n
+        return step, mesh, specs, params, ffn_flops(tokens, d, layers), comm
+
+    def tp_case(d, layers, tokens, chips):
+        from distributed_llm_code_samples_tpu.parallel.mesh import MODEL_AXIS
+        params = init_ffn_stack(jax.random.PRNGKey(0), d, layers)
+        step = tp.make_step(tokens, d, 0.1)
+        mesh = _mesh({MODEL_AXIS: chips}, chips)
+        n = chips
+        # one activation all-reduce per layer per direction:
+        # 2 dirs * 2(n-1)/n * tokens*d*4 bytes * layers
+        comm = 2 * layers * 2 * (n - 1) / n * tokens * d * 4
+        return (step, mesh, tp.PARAM_SPECS, params,
+                ffn_flops(tokens, d, layers) / n, comm)
+
+    toks = 8 * 1024
+    return [
+        # BASELINE config 2: FSDP, 8-layer d=2048, 8 devices
+        ("fsdp_d2048_L8", 8,
+         lambda: ddp_like(2048, 8, toks, 8, fsdp_mode=True)),
+        # BASELINE config 5 (north star): GPT-2-small-width FFN stack,
+        # FSDP on v5e-32
+        ("fsdp_d768_L24", 32,
+         lambda: ddp_like(768, 24, toks, 32, fsdp_mode=True)),
+        ("ddp_d768_L24", 8,
+         lambda: ddp_like(768, 24, toks, 8, fsdp_mode=False)),
+        ("ddp_d768_L24", 32,
+         lambda: ddp_like(768, 24, toks, 32, fsdp_mode=False)),
+        # BASELINE config 3 spirit: MP/TP split across chips
+        ("tp_d2048_L8", 8, lambda: tp_case(2048, 8, toks, 8)),
+    ]
+
+
+def _count_hlo_collectives(hlo: str) -> dict:
+    """Substring counts of each collective in optimized TPU HLO — the
+    op list is utils.hlo's (hyphen-spelled here: backend HLO opcodes),
+    substring-matched because TPU codegen wraps collectives in async
+    fusions whose defining line spells the op inside a custom-call."""
+    from distributed_llm_code_samples_tpu.utils.hlo import COLLECTIVE_OPS
+    return {op.replace("_", "-"): hlo.count(op.replace("_", "-"))
+            for op in COLLECTIVE_OPS}
+
+
+def main() -> int:
+    from distributed_llm_code_samples_tpu.utils import count_async_pairs
+    ok = True
+    for name, chips, build in _scenarios():
+        try:
+            step, mesh, specs, params, flops, comm_bytes = build()
+            hlo = _compile_hlo(step, mesh, specs, params)
+        except Exception as e:  # noqa: BLE001
+            print(json.dumps({"scenario": name, "chips": chips,
+                              "error": str(e)[:300]}))
+            ok = False
+            continue
+        counts = {k: v for k, v in _count_hlo_collectives(hlo).items() if v}
+        pairs = {k: v for k, v in dict(count_async_pairs(hlo)).items() if v}
+        compute_s = flops / (MEASURED_MFU * PEAK_FLOPS)
+        # >=90% scaling: overlapped comm must fit in compute/0.9;
+        # a no-overlap schedule needs comm <= compute/9
+        req_overlap = comm_bytes / (compute_s / 0.9) / 1e9
+        req_seq = comm_bytes / (compute_s / 9.0) / 1e9
+        print(json.dumps({
+            "scenario": name, "chips": chips,
+            "collectives": counts,
+            "async_pairs": pairs,
+            "comm_gb_per_step_per_chip": round(comm_bytes / 1e9, 4),
+            "compute_ms_per_step": round(compute_s * 1e3, 3),
+            "required_gbps_90pct_overlapped": round(req_overlap, 2),
+            "required_gbps_90pct_sequential": round(req_seq, 2),
+        }))
+    # v5e ICI: 2D torus, hundreds of GB/s per chip (public spec sheets
+    # quote 1600 Gbps aggregate). The requirement column shows how far
+    # under that each strategy sits.
+    print(json.dumps({"summary": "aot_v5e_codegen",
+                      "anchor_mfu": MEASURED_MFU,
+                      "ok": ok}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

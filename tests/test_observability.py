@@ -240,6 +240,28 @@ def test_fsdp_async_overlap_aot_v5e8(params):
     assert hlo.count("reduce-scatter") > 0
 
 
+def test_bench_scaling_scenario_compiles():
+    """The scaling harness's first scenario (FSDP on v5e-8) AOT-compiles
+    and reports the expected collective classes + roofline fields — keeps
+    bench_scaling.py from rotting. Only missing AOT support skips; any
+    other failure is a real regression and must fail."""
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    _v5e8_mesh({DATA_AXIS: 8})  # probe: skips if no TPU AOT support
+    import bench_scaling
+    name, chips, build = bench_scaling._scenarios()[0]
+    step, mesh, specs, params, flops, comm = build()
+    hlo = bench_scaling._compile_hlo(step, mesh, specs, params)
+    counts = bench_scaling._count_hlo_collectives(hlo)
+    from distributed_llm_code_samples_tpu.utils import count_async_pairs
+    pairs = count_async_pairs(hlo)
+    assert (counts["all-gather"] + pairs["async_collective"]
+            + pairs["all_gather"]) > 0
+    assert counts["reduce-scatter"] > 0  # substring: async forms included
+    assert flops > 0 and comm > 0
+
+
 def test_ring_ppermute_aot_v5e8():
     """Ring attention's rotation lowers to collective-permute on the v5e
     ICI ring (both the forward and the hand-written backward ring)."""
