@@ -29,17 +29,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-l", "--layers", type=int, default=1)
     p.add_argument("-d", "--model_size", type=int, default=4)
     p.add_argument("-m", "--method", type=int, default=0,
-                   choices=range(12),
+                   choices=range(13),
                    help="0=all(1-4), 1=single, 2=DDP, 3=FSDP, 4=TP, "
                         "5=hybrid DDP x TP, 6=pipeline (ppermute send/recv), "
                         "7=MoE expert parallelism (all_to_all), "
                         "8=transformer blocks (Megatron TP; --heads), "
-                        "9=all(1-8,10,11) with every strategy cross-verified "
-                        "against its oracle, 10=MoE transformer (GShard: "
-                        "data-parallel attention + expert-parallel FFN), "
-                        "11=language model on the real cross-entropy "
-                        "objective (vocab-parallel Megatron TP; --vocab "
-                        "--heads)")
+                        "9=all(1-8,10-12) with every strategy "
+                        "cross-verified against its oracle, 10=MoE "
+                        "transformer (GShard: data-parallel attention + "
+                        "expert-parallel FFN), 11=language model on the "
+                        "real cross-entropy objective (vocab-parallel "
+                        "Megatron TP; --vocab --heads), 12=MoE language "
+                        "model (GShard blocks + real loss + router aux; "
+                        "--experts --vocab --heads)")
     p.add_argument("-r", "--random_seed", type=int, default=0,
                    help="!=0 makes runs reproducible (train_ffns.py:350)")
     # TPU-build extensions
@@ -60,13 +62,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "stack or pre-LN transformer blocks (--heads; "
                         "microbatches split the batch dim)")
     p.add_argument("--experts", type=int, default=8,
-                   help="expert count for --method 7/10 (MoE)")
+                   help="expert count for --method 7/10/12 (MoE)")
     p.add_argument("--heads", type=int, default=4,
-                   help="attention heads for --method 8/10/11 and "
+                   help="attention heads for --method 8/10/11/12 and "
                         "--method 6 with --pp_family transformer")
     p.add_argument("--vocab", type=int, default=256,
-                   help="vocabulary size for --method 11 (the LM family; "
-                        "must be divisible by the model-axis size)")
+                   help="vocabulary size for --method 11/12 (the LM "
+                        "families; method 11 needs it divisible by the "
+                        "model-axis size)")
     p.add_argument("--lr", type=float, default=None,
                    help="override LR (default 1e-5, train_ffns.py:29)")
     p.add_argument("--optimizer",
@@ -214,8 +217,8 @@ def main(argv=None) -> int:
     def family_of(method: int) -> str:
         if method == 6 and args.pp_family == "transformer":
             return "transformer"
-        return {7: "moe", 8: "transformer",
-                10: "moe_transformer", 11: "lm"}.get(method, "ffn")
+        return {7: "moe", 8: "transformer", 10: "moe_transformer",
+                11: "lm", 12: "moe_lm"}.get(method, "ffn")
 
     _family_params = {}
 
@@ -239,6 +242,11 @@ def main(argv=None) -> int:
                 _family_params[fam] = init_lm(
                     key, args.vocab, args.model_size, args.layers,
                     max_seq_len=args.seq_len, dtype=dtype)
+            elif fam == "moe_lm":
+                from .models import init_moe_lm
+                _family_params[fam] = init_moe_lm(
+                    key, args.vocab, args.model_size, args.layers,
+                    args.experts, max_seq_len=args.seq_len, dtype=dtype)
             else:
                 _family_params[fam] = init_ffn_stack(
                     key, args.model_size, args.layers, dtype=dtype)
@@ -247,7 +255,7 @@ def main(argv=None) -> int:
     params = params_for(args.method if args.method != 9 else 1)
     print(f"PARAMS: {params.num_params():_} "
           f"(size {params_size_gb(params)} GB)\n\n")
-    corner = ((lambda w: w[0, 0]) if args.method in (7, 10)
+    corner = ((lambda w: w[0, 0]) if args.method in (7, 10, 12)
               else (lambda w: w[0]))
     print("initial layers_params[0]", params.w1[0].shape, params.w2[0].shape)
     print("initial layers_params[0]", corner(params.w1)[:5, :5],
@@ -266,7 +274,7 @@ def main(argv=None) -> int:
             return make_mesh({MODEL_AXIS: n_dev})
         if method == 6:
             return make_mesh({PIPE_AXIS: n_dev})
-        if method in (7, 10):
+        if method in (7, 10, 12):
             return make_mesh({EXPERT_AXIS: n_dev})
         if method in (8, 11):
             # model axis sized by --tp (like method 5): all-devices would
@@ -282,7 +290,7 @@ def main(argv=None) -> int:
     if args.method == 0:
         selected = [1, 2, 3, 4]
     elif args.method == 9:
-        selected = [1, 2, 3, 4, 5, 6, 7, 8, 10, 11]
+        selected = [1, 2, 3, 4, 5, 6, 7, 8, 10, 11, 12]
     else:
         selected = [args.method]
     results = {}
@@ -320,7 +328,7 @@ def main(argv=None) -> int:
                 kwargs.update(seq_len=args.seq_len, n_heads=args.heads)
         if m == 7:
             kwargs = dict(lr=lr)  # EP's expert loop has its own structure
-        if m in (8, 10, 11):
+        if m in (8, 10, 11, 12):
             kwargs = dict(lr=lr, seq_len=args.seq_len, n_heads=args.heads)
             if args.tp_sp and m == 8:
                 kwargs["sequence_parallel"] = True
@@ -371,7 +379,7 @@ def main(argv=None) -> int:
         jax.block_until_ready(out)
         t1 = time.time()
         results[m] = out
-        corner_m = ((lambda w: w[0, 0]) if m in (7, 10)
+        corner_m = ((lambda w: w[0, 0]) if m in (7, 10, 12)
                     else (lambda w: w[0]))
         print(f"\n{name} takes {t1 - t0} seconds")
         print(f"final {name} layers_params[0]", out.w1[0].shape,
@@ -434,6 +442,13 @@ def main(argv=None) -> int:
                 seq_len=args.seq_len, n_heads=args.heads)
             checks.append(("lm_tp", "lm_1dev", results[11], lm_single,
                            1e-4, 1e-5))
+            # GShard MoE-LM == its dense grouped oracle (real loss + aux)
+            from .parallel import train_moe_lm_dense
+            moe_lm_dense = train_moe_lm_dense(
+                params_for(12), seeds, tokens, args.model_size, lr=lr,
+                seq_len=args.seq_len, n_heads=args.heads, n_groups=n_dev)
+            checks.append(("moe_lm_ep", "moe_lm_dense", results[12],
+                           moe_lm_dense, 1e-4, 1e-5))
         for la, lb, a, b, rt, at in checks:
             # leaves-with-paths rather than _fields: the LM family's params
             # nest (blocks is a NamedTuple inside LMParams)

@@ -12,6 +12,7 @@ from .transformer import (TransformerParams, init_transformer,
                           transformer_fwd)
 from .lm import (LMParams, init_lm, lm_logits, lm_loss, KVCache,
                  init_cache, decode_step, generate, sample)
+from .moe_lm import MoELMParams, init_moe_lm, moe_lm_loss_aux
 
 __all__ = ["FFNStackParams", "init_ffn_stack", "clone_params",
            "params_size_gb", "attention", "mha",
@@ -20,4 +21,5 @@ __all__ = ["FFNStackParams", "init_ffn_stack", "clone_params",
            "moe_transformer_fwd_aux",
            "TransformerParams", "init_transformer", "transformer_fwd",
            "LMParams", "init_lm", "lm_logits", "lm_loss", "KVCache",
-           "init_cache", "decode_step", "generate", "sample"]
+           "init_cache", "decode_step", "generate", "sample",
+           "MoELMParams", "init_moe_lm", "moe_lm_loss_aux"]

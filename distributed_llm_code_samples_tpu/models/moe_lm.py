@@ -1,0 +1,95 @@
+"""MoE language model — GShard blocks under a real LM objective.
+
+Composes the two newest families: ``models.lm``'s embedding / tied-head /
+hand-VJP cross-entropy shell around ``models.moe_transformer``'s pre-LN
+attention + Mixture-of-Experts FFN blocks. The reference has none of
+these pieces (``README.md:6``); this family exists so expert parallelism
+composes with the *real* training objective — router, capacity dispatch,
+load-balancing auxiliary loss and all — not just the mocked upstream
+gradient.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.norm import layernorm
+from ..ops.xent import xent_loss
+from .moe_transformer import (MoETransformerParams, init_moe_transformer,
+                              moe_transformer_fwd_aux)
+
+
+class MoELMParams(NamedTuple):
+    """``wte [V, d]`` tied token embedding; ``wpe [T_max, d]`` positions;
+    ``blocks`` the MoE-transformer stack; ``ln_f [d]`` final LN gain."""
+    wte: jax.Array
+    wpe: jax.Array
+    blocks: MoETransformerParams
+    ln_f: jax.Array
+
+    @property
+    def vocab(self) -> int:
+        return self.wte.shape[0]
+
+    @property
+    def d_model(self) -> int:
+        return self.wte.shape[1]
+
+    @property
+    def max_seq_len(self) -> int:
+        return self.wpe.shape[0]
+
+    @property
+    def n_experts(self) -> int:
+        return self.blocks.n_experts
+
+    def num_params(self) -> int:
+        return (self.wte.size + self.wpe.size + self.ln_f.size +
+                self.blocks.num_params())
+
+    # CLI uniform per-layer report (train_ffns.py:370-371): the expert
+    # FFN pair, like the MoE families
+    @property
+    def w1(self) -> jax.Array:
+        return self.blocks.w1
+
+    @property
+    def w2(self) -> jax.Array:
+        return self.blocks.w2
+
+
+def init_moe_lm(key: jax.Array, vocab: int, d_model: int, n_layers: int,
+                n_experts: int, max_seq_len: int,
+                ffn_dim: int | None = None, scale: float = 2e-2,
+                dtype=jnp.float32) -> MoELMParams:
+    ke, kp, kb = jax.random.split(key, 3)
+    return MoELMParams(
+        wte=scale * jax.random.normal(ke, (vocab, d_model), dtype),
+        wpe=scale * jax.random.normal(kp, (max_seq_len, d_model), dtype),
+        blocks=init_moe_transformer(kb, d_model, n_layers, n_experts,
+                                    ffn_dim, scale, dtype),
+        ln_f=jnp.ones((d_model,), dtype))
+
+
+def moe_lm_loss_aux(params: MoELMParams, tokens: jax.Array,
+                    targets: jax.Array, n_heads: int, causal: bool = True,
+                    capacity_factor: float | None = None,
+                    k: int | None = None, capacity: int | None = None,
+                    moe_fn=None, attn=None):
+    """Mean next-token cross-entropy + the stack's summed router aux loss.
+    ``tokens, targets [B, T]`` int. ``moe_fn`` swaps the MoE sublayer
+    core (the EP trainer passes its all_to_all form); see
+    ``moe_transformer_fwd_aux``."""
+    t = tokens.shape[1]
+    x = params.wte[tokens] + params.wpe[:t]
+    x, aux = moe_transformer_fwd_aux(params.blocks, x, n_heads, causal,
+                                     capacity_factor, k, capacity,
+                                     moe_fn, attn)
+    h = layernorm(params.ln_f, x)
+    logits = h @ params.wte.T
+    loss = xent_loss(logits.reshape(-1, params.wte.shape[0]),
+                     targets.reshape(-1))
+    return loss, aux

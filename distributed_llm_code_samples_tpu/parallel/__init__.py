@@ -31,6 +31,7 @@ from .transformer import (train_transformer_single, train_transformer_ddp,
                           train_transformer_hybrid, train_transformer_seq)
 from .lm import (train_lm_single, train_lm_ddp, train_lm_fsdp, train_lm_tp,
                  train_lm_hybrid, train_lm_seq, vp_embed, vp_xent)
+from .moe_lm import train_moe_lm_ep, train_moe_lm_dense
 
 # Method-number parity with the reference CLI (train_ffns.py:6, :373):
 # 1=single, 2=DDP, 3=FSDP, 4=TP; 5+ extend with the hybrid mesh and the
@@ -46,6 +47,7 @@ STRATEGIES = {
     8: ("train_transformer_tp", train_transformer_tp),
     10: ("train_moe_transformer_ep", train_moe_transformer_ep),
     11: ("train_lm_tp", train_lm_tp),
+    12: ("train_moe_lm_ep", train_moe_lm_ep),
 }
 
 __all__ = [
@@ -64,5 +66,6 @@ __all__ = [
     "ulysses_attention", "ulysses_parallel_attention",
     "train_lm_single", "train_lm_ddp", "train_lm_fsdp", "train_lm_tp",
     "train_lm_hybrid", "train_lm_seq", "vp_embed", "vp_xent",
+    "train_moe_lm_ep", "train_moe_lm_dense",
     "STRATEGIES",
 ]

@@ -260,14 +260,16 @@ vp_xent.defvjp(_vp_xent_fwd, _vp_xent_bwd)
 
 def _make_tp_step(batch_size: int, model_size: int, seq_len: int,
                   h_local: int, vocab: int, lr: float, attn=None,
-                  data_axes=()):
+                  data_axes=(), optimizer=None):
     """One vocab-parallel TP step for one model shard; ``data_axes`` adds
     the orthogonal DDP reduction for the hybrid 2-D mesh (every leaf is a
     partial sum over those axes; LN/positions additionally over the model
-    axis — one fused psum per leaf, ``grad_reduce`` on an axis tuple)."""
+    axis — one fused psum per leaf, ``grad_reduce`` on an axis tuple).
+    With ``optimizer``, the carry is ``(params, opt_state)`` and the state
+    shards exactly like the params (elementwise update — no collective)."""
     b = batch_size // seq_len
 
-    def step(params: LMParams, seed) -> LMParams:
+    def grads_of(params: LMParams, seed):
         tokens, targets = lm_batch_from_seed(seed, b, seq_len, vocab)
         f = _f_gate(MODEL_AXIS)
 
@@ -298,32 +300,72 @@ def _make_tp_step(batch_size: int, model_size: int, seq_len: int,
         if data_axes:
             grads = jax.tree_util.tree_map(
                 lambda g: grad_reduce(g, data_axes), grads)
-        return sgd(params, grads, lr)
+        return grads
 
-    return step
+    def step(params: LMParams, seed) -> LMParams:
+        return sgd(params, grads_of(params, seed), lr)
+
+    def step_opt(carry, seed):
+        params, state = carry
+        return optimizer.update(grads_of(params, seed), state, params, lr)
+
+    return step if optimizer is None else step_opt
 
 
 def train_lm_tp(params: LMParams, seeds, batch_size: int, model_size: int,
                 mesh, lr: float = LR, *, seq_len: int, n_heads: int,
-                attn_impl: str | None = None) -> LMParams:
+                attn_impl: str | None = None, optimizer=None,
+                opt_state=None, return_state: bool = False):
     """Megatron-LM TP over the model axis: blocks shard heads/features
     (``tp_block``), ``wte`` shards vocab rows serving both the parallel
     embedding and the tied parallel head, and the loss runs vocab-parallel
     (``vp_xent``). ``wpe``/LN grads replicate (complete ``dx`` on every
     shard, the ``_f_gate`` discipline); ``wte``/block grads are
-    shard-complete. Data replicated, as in ``train_transformer_tp``."""
+    shard-complete. Data replicated, as in ``train_transformer_tp``.
+
+    ``optimizer`` threads state sharded exactly like the params
+    (``zeros_like`` of the sharded leaves; the elementwise update needs
+    no collective) — Megatron's optimizer layout."""
     require_axes(mesh, MODEL_AXIS)
     n = mesh.shape[MODEL_AXIS]
     h_local = _validate_tp(params.blocks, n_heads, n)
     _validate_lm(batch_size, seq_len, model_size, n_heads, params)
+    check_state_args(optimizer, opt_state, return_state)
     if params.vocab % n:
         raise ValueError(f"vocab={params.vocab} not divisible by "
                          f"model-axis size {n}")
     step = _make_tp_step(batch_size, model_size, seq_len, h_local,
-                         params.vocab, lr, resolve_attn(attn_impl))
-    return launch(step, _shard(params, mesh, _lm_tp_specs()),
-                  jnp.asarray(seeds), mesh, param_specs=_lm_tp_specs(),
-                  seed_spec=P())
+                         params.vocab, lr, resolve_attn(attn_impl),
+                         optimizer=optimizer)
+    sharded = _shard(params, mesh, _lm_tp_specs())
+    if optimizer is None:
+        return launch(step, sharded, jnp.asarray(seeds), mesh,
+                      param_specs=_lm_tp_specs(), seed_spec=P())
+    # zeros_like of sharded params keeps their shardings; scalar
+    # bookkeeping (step counts) replicates
+    state = optimizer.init(sharded) if opt_state is None else opt_state
+    return launch(step, sharded, jnp.asarray(seeds), mesh,
+                  param_specs=_lm_tp_specs(), seed_spec=P(),
+                  state=state, state_specs=_lm_state_specs(state),
+                  return_state=return_state)
+
+
+def _lm_state_specs(state):
+    """Optimizer-state specs for the TP layout: param-shaped subtrees
+    (momentum velocities, Adam moments — ``LMParams`` instances) shard
+    like the params; scalar bookkeeping (step counters) replicates."""
+    specs = _lm_tp_specs()
+
+    def rec(s):
+        if isinstance(s, LMParams):
+            return specs
+        if hasattr(s, "_fields"):                 # e.g. AdamState
+            return type(s)(*(rec(x) for x in s))
+        if isinstance(s, tuple):                  # scheduled-wrapper pairs
+            return tuple(rec(x) for x in s)
+        return P()
+
+    return rec(state)
 
 
 def train_lm_hybrid(params: LMParams, seeds, batch_size: int,

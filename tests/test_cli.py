@@ -45,9 +45,19 @@ def test_cli_method9_verifies_every_strategy():
     for name in ("train_single", "train_ddp", "train_fsdp", "train_tp",
                  "train_hybrid", "train_pp", "train_moe_ep",
                  "train_transformer_tp", "train_moe_transformer_ep",
-                 "train_lm_tp"):
+                 "train_lm_tp", "train_moe_lm_ep"):
         assert f"{name} takes" in r.stdout
     assert "SoftAssertionError" not in r.stdout
+
+
+@pytest.mark.slow
+def test_cli_moe_lm_method():
+    r = _run_cli("-s", "4", "-bs", "8", "-n", "8", "-l", "2", "-d", "32",
+                 "-m", "12", "-r", "3", "--fake_devices", "4",
+                 "--experts", "8", "--heads", "4", "--vocab", "64",
+                 "--lr", "0.1")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "train_moe_lm_ep takes" in r.stdout
 
 
 @pytest.mark.slow

@@ -249,6 +249,33 @@ def test_lm_stateful_optimizer_threads_state(mesh4):
                                    rtol=1e-5, atol=1e-7)
 
 
+def test_lm_tp_stateful_matches_single(mesh_model4):
+    """Megatron optimizer layout: Adam state sharded with the TP params;
+    segmented TP run (state threaded) == uninterrupted single-device run
+    with the same optimizer."""
+    from distributed_llm_code_samples_tpu.data import make_seed_schedule
+    from distributed_llm_code_samples_tpu.optim import adam
+    params = small_lm(seed=11)
+    seeds = make_seed_schedule(4, random_seed=27)
+    kw = dict(seq_len=SEQ, n_heads=HEADS, lr=1e-2)
+    single = train_lm_single(params, seeds, 2 * SEQ, D, optimizer=adam(),
+                             **kw)
+    tp = train_lm_tp(params, seeds, 2 * SEQ, D, mesh_model4,
+                     optimizer=adam(), **kw)
+    for got, want in zip(jax.tree_util.tree_leaves(tp),
+                         jax.tree_util.tree_leaves(single)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   **tolerances())
+    p1, s1 = train_lm_tp(params, seeds[:2], 2 * SEQ, D, mesh_model4,
+                         optimizer=adam(), return_state=True, **kw)
+    p2 = train_lm_tp(p1, seeds[2:], 2 * SEQ, D, mesh_model4,
+                     optimizer=adam(), opt_state=s1, **kw)
+    for got, want in zip(jax.tree_util.tree_leaves(p2),
+                         jax.tree_util.tree_leaves(tp)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-7)
+
+
 # --- vocab-parallel pieces in isolation ------------------------------------
 
 
