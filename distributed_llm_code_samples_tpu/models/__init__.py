@@ -12,7 +12,8 @@ from .transformer import (TransformerParams, init_transformer,
                           transformer_fwd)
 from .lm import (LMParams, init_lm, lm_logits, lm_loss, KVCache,
                  init_cache, decode_step, generate, sample)
-from .moe_lm import MoELMParams, init_moe_lm, moe_lm_loss_aux
+from .moe_lm import (MoELMParams, init_moe_lm, moe_lm_loss_aux,
+                     moe_lm_logits, moe_generate)
 
 __all__ = ["FFNStackParams", "init_ffn_stack", "clone_params",
            "params_size_gb", "attention", "mha",
@@ -22,4 +23,5 @@ __all__ = ["FFNStackParams", "init_ffn_stack", "clone_params",
            "TransformerParams", "init_transformer", "transformer_fwd",
            "LMParams", "init_lm", "lm_logits", "lm_loss", "KVCache",
            "init_cache", "decode_step", "generate", "sample",
-           "MoELMParams", "init_moe_lm", "moe_lm_loss_aux"]
+           "MoELMParams", "init_moe_lm", "moe_lm_loss_aux",
+           "moe_lm_logits", "moe_generate"]

@@ -175,37 +175,47 @@ def decode_step(params: LMParams, cache: KVCache, token: jax.Array,
     return h @ params.wte.T, KVCache(new_k, new_v)
 
 
-def _decode_loop(params: LMParams, prompt: jax.Array, n_new: int,
-                 n_heads: int, pick) -> jax.Array:
-    """Shared prefill+generate scan. ``pick(logits [B, V], pos) -> [B]``
-    chooses the next token (argmax for greedy, a categorical draw for
-    sampling). One ``lax.scan`` covers prefill and generation: step ``t``
-    feeds the prompt token while ``t < T0`` (teacher-forced prefill filling
-    the cache) and the previous pick after — so the compiled program is
-    independent of where the prompt ends, and a whole batch decodes in one
-    dispatch."""
+def decode_loop(step_fn, cache, prompt: jax.Array, n_new: int,
+                max_seq_len: int, pick) -> jax.Array:
+    """Shared prefill+generate scan for any cached decoder.
+    ``step_fn(cache, token [B], pos) -> (logits [B, V], cache)`` runs one
+    token through the stack; ``pick(logits, pos) -> [B]`` chooses the next
+    token (argmax for greedy, a categorical draw for sampling). One
+    ``lax.scan`` covers prefill and generation: step ``t`` feeds the
+    prompt token while ``t < T0`` (teacher-forced prefill filling the
+    cache) and the previous pick after — so the compiled program is
+    independent of where the prompt ends, and a whole batch decodes in
+    one dispatch."""
     b, t0 = prompt.shape
     total = t0 + n_new
-    if total > params.max_seq_len:
+    if total > max_seq_len:
         raise ValueError(f"prompt {t0} + n_new {n_new} exceeds "
-                         f"max_seq_len {params.max_seq_len}")
+                         f"max_seq_len {max_seq_len}")
     padded = jnp.concatenate(
         [prompt, jnp.zeros((b, n_new), prompt.dtype)], axis=1)
 
     def step(carry, pos):
         cache, toks, prev = carry
         token = jnp.where(pos < t0, toks[:, pos], prev)
-        logits, cache = decode_step(params, cache, token, pos, n_heads)
+        logits, cache = step_fn(cache, token, pos)
         nxt = pick(logits, pos).astype(toks.dtype)
         toks = lax.dynamic_update_slice(
             toks, jnp.where(pos + 1 < t0, toks[:, pos + 1], nxt)[:, None],
             (0, pos + 1))
         return (cache, toks, nxt), None
 
-    cache = init_cache(params, b, n_heads)
     init = (cache, padded, padded[:, 0])
     (_, toks, _), _ = lax.scan(step, init, jnp.arange(total - 1))
     return toks
+
+
+def _decode_loop(params: LMParams, prompt: jax.Array, n_new: int,
+                 n_heads: int, pick) -> jax.Array:
+    return decode_loop(
+        lambda cache, token, pos: decode_step(params, cache, token, pos,
+                                              n_heads),
+        init_cache(params, prompt.shape[0], n_heads), prompt, n_new,
+        params.max_seq_len, pick)
 
 
 def generate(params: LMParams, prompt: jax.Array, n_new: int,

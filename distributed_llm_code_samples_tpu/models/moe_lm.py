@@ -46,6 +46,10 @@ class MoELMParams(NamedTuple):
     def n_experts(self) -> int:
         return self.blocks.n_experts
 
+    @property
+    def n_layers(self) -> int:
+        return self.blocks.n_layers
+
     def num_params(self) -> int:
         return (self.wte.size + self.wpe.size + self.ln_f.size +
                 self.blocks.num_params())
@@ -74,6 +78,34 @@ def init_moe_lm(key: jax.Array, vocab: int, d_model: int, n_layers: int,
         ln_f=jnp.ones((d_model,), dtype))
 
 
+def moe_lm_hidden_aux(params: MoELMParams, tokens: jax.Array,
+                      n_heads: int, causal: bool = True,
+                      capacity_factor: float | None = None,
+                      k: int | None = None, capacity: int | None = None,
+                      moe_fn=None, attn=None):
+    """Embed + MoE blocks + final LN: ``tokens [B, T]`` ->
+    ``(h [B, T, d], aux)`` — the shared forward under both the logits
+    and the loss (the ``lm_hidden`` convention)."""
+    t = tokens.shape[1]
+    x = params.wte[tokens] + params.wpe[:t]
+    x, aux = moe_transformer_fwd_aux(params.blocks, x, n_heads, causal,
+                                     capacity_factor, k, capacity,
+                                     moe_fn, attn)
+    return layernorm(params.ln_f, x), aux
+
+
+def moe_lm_logits(params: MoELMParams, tokens: jax.Array, n_heads: int,
+                  causal: bool = True,
+                  capacity_factor: float | None = None,
+                  k: int | None = None,
+                  capacity: int | None = None) -> jax.Array:
+    """``tokens [B, T]`` -> logits ``[B, T, V]`` (teacher-forced full
+    forward through the MoE stack; the decode oracle)."""
+    h, _ = moe_lm_hidden_aux(params, tokens, n_heads, causal,
+                             capacity_factor, k, capacity)
+    return h @ params.wte.T
+
+
 def moe_lm_loss_aux(params: MoELMParams, tokens: jax.Array,
                     targets: jax.Array, n_heads: int, causal: bool = True,
                     capacity_factor: float | None = None,
@@ -83,13 +115,70 @@ def moe_lm_loss_aux(params: MoELMParams, tokens: jax.Array,
     ``tokens, targets [B, T]`` int. ``moe_fn`` swaps the MoE sublayer
     core (the EP trainer passes its all_to_all form); see
     ``moe_transformer_fwd_aux``."""
-    t = tokens.shape[1]
-    x = params.wte[tokens] + params.wpe[:t]
-    x, aux = moe_transformer_fwd_aux(params.blocks, x, n_heads, causal,
-                                     capacity_factor, k, capacity,
-                                     moe_fn, attn)
-    h = layernorm(params.ln_f, x)
+    h, aux = moe_lm_hidden_aux(params, tokens, n_heads, causal,
+                               capacity_factor, k, capacity, moe_fn, attn)
     logits = h @ params.wte.T
     loss = xent_loss(logits.reshape(-1, params.wte.shape[0]),
                      targets.reshape(-1))
     return loss, aux
+
+
+# ---------------------------------------------------------------------------
+# Decode: per-token top-k routing over the KV-cache loop. Capacity is a
+# training-time batching artifact (tokens competing for expert slots);
+# at decode each position routes independently, so with enough capacity
+# the teacher-forced full forward and the cached decode agree exactly
+# (pinned in tests/test_moe_lm.py).
+
+
+def moe_decode_step(params: MoELMParams, cache, token: jax.Array,
+                    pos, n_heads: int, k: int = 1):
+    """One token through the MoE stack at ``pos``. ``token [B]`` ->
+    ``(logits [B, V], cache')``. Expert weights for each token's top-k
+    choices are gathered (``[B, k, ffn, d]``) and the gate-weighted FFNs
+    computed directly — no dispatch tensor at batch-of-one-position
+    scale."""
+    from ..ops.moe import route_topk
+    from .lm import KVCache, _decode_attn
+    b = token.shape[0]
+    blk = params.blocks
+    d = params.d_model
+    dh = d // n_heads
+    x = params.wte[token] + params.wpe[pos]
+    new_k, new_v = cache.k, cache.v
+    for l in range(blk.n_layers):
+        a = layernorm(blk.ln1[l], x)
+        q, kk, vv = (
+            (a @ w[l].T).reshape(b, n_heads, dh)
+            for w in (blk.wq, blk.wk, blk.wv))
+        new_k = jax.lax.dynamic_update_slice(
+            new_k, kk[None, :, :, None, :], (l, 0, 0, pos, 0))
+        new_v = jax.lax.dynamic_update_slice(
+            new_v, vv[None, :, :, None, :], (l, 0, 0, pos, 0))
+        y = _decode_attn(q, new_k[l], new_v[l], pos)
+        x = x + y.reshape(b, d) @ blk.wo[l].T
+        h = layernorm(blk.ln2[l], x)
+        # per-token routing, the training router's exact semantics
+        # (k=1: raw top-1 probability gate; k>1: renormalized top-k)
+        idx, gates = route_topk(blk.wg[l], h, k, renormalize=k > 1)
+        w1s = blk.w1[l][idx]                       # [B, k, ffn, d]
+        w2s = blk.w2[l][idx]                       # [B, k, d, ffn]
+        ff = jnp.maximum(jnp.einsum("bd,bkfd->bkf", h, w1s), 0.0)
+        y = jnp.einsum("bkf,bkdf->bkd", ff, w2s)
+        x = x + jnp.einsum("bk,bkd->bd", gates, y)
+    h = layernorm(params.ln_f, x)
+    return h @ params.wte.T, KVCache(new_k, new_v)
+
+
+def moe_generate(params: MoELMParams, prompt: jax.Array, n_new: int,
+                 n_heads: int, k: int = 1) -> jax.Array:
+    """Greedy decode through the MoE stack: ``prompt [B, T0]`` ->
+    ``[B, T0 + n_new]`` (one jitted scan, static shapes — the
+    ``models.lm.decode_loop`` contract)."""
+    from .lm import decode_loop, init_cache
+    cache = init_cache(params, prompt.shape[0], n_heads)
+    return decode_loop(
+        lambda cache, token, pos: moe_decode_step(params, cache, token,
+                                                  pos, n_heads, k),
+        cache, prompt, n_new, params.max_seq_len,
+        lambda z, pos: jnp.argmax(z, axis=-1))

@@ -66,6 +66,30 @@ def test_moe_lm_aux_loss_changes_training(params):
                            np.asarray(with_aux.blocks.wg))
 
 
+@pytest.mark.parametrize("k", [1, 2])
+def test_moe_generate_matches_full_forward_argmax(params, k):
+    """Cached MoE decode == re-running the teacher-forced full forward per
+    position and taking the last row's argmax. Capacity must not bind
+    (per-position routing is capacity-free), so the oracle runs with
+    capacity >= tokens — with that, routing per token is independent of
+    the batch and the two paths agree exactly."""
+    from distributed_llm_code_samples_tpu.models import (moe_generate,
+                                                         moe_lm_logits)
+    prompt = jax.random.randint(jax.random.PRNGKey(13), (2, 3), 0, V)
+    n_new = 4
+    got = moe_generate(params, prompt, n_new, HEADS, k=k)
+    toks = np.asarray(prompt)
+    for _ in range(n_new):
+        # capacity >= every token the oracle forward could route
+        # (B * (T0 + n_new) = 14 here): the no-drop regime the
+        # per-position decode lives in
+        logits = moe_lm_logits(params, jnp.asarray(toks), HEADS, k=k,
+                               capacity=2 * SEQ)
+        nxt = np.asarray(jnp.argmax(logits[:, -1], axis=-1))
+        toks = np.concatenate([toks, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(got), toks)
+
+
 def test_moe_lm_validates_max_seq(params):
     seeds = make_seed_schedule(1, random_seed=1)
     with pytest.raises(ValueError, match="max_seq_len"):
