@@ -145,6 +145,29 @@ def _decode_attn(q, ck, cv, pos):
     return jnp.einsum("bht,bhtd->bhd", p, cv)
 
 
+def cached_attn_step(ln1_l, wq_l, wk_l, wv_l, wo_l, cache_k, cache_v,
+                     layer: int, x: jax.Array, pos):
+    """One decode attention sublayer, shared by the dense, MoE, and TP
+    decode paths: LN, QKV projection of this path's (possibly
+    head-sharded) weights, cache write at ``pos``, single-query attention
+    over the cache, output projection. Returns ``(y_proj, cache_k,
+    cache_v)`` with the residual add (and, under TP, the psum) left to
+    the caller — ``y_proj`` may be a partial sum over sharded heads.
+    Head count and head dim come from the weight/cache shapes."""
+    b = x.shape[0]
+    dh = cache_k.shape[-1]
+    h_loc = wq_l.shape[0] // dh
+    a = layernorm(ln1_l, x)
+    q, k, v = ((a @ w.T).reshape(b, h_loc, dh)
+               for w in (wq_l, wk_l, wv_l))
+    cache_k = lax.dynamic_update_slice(
+        cache_k, k[None, :, :, None, :], (layer, 0, 0, pos, 0))
+    cache_v = lax.dynamic_update_slice(
+        cache_v, v[None, :, :, None, :], (layer, 0, 0, pos, 0))
+    y = _decode_attn(q, cache_k[layer], cache_v[layer], pos)
+    return y.reshape(b, h_loc * dh) @ wo_l.T, cache_k, cache_v
+
+
 def decode_step(params: LMParams, cache: KVCache, token: jax.Array,
                 pos: jax.Array, n_heads: int):
     """One token through the stack at position ``pos`` (traced scalar).
@@ -153,22 +176,14 @@ def decode_step(params: LMParams, cache: KVCache, token: jax.Array,
     throughout: the cache is written at ``pos`` via
     ``dynamic_update_slice``, attention masks the unwritten tail.
     """
-    b = token.shape[0]
     p = params.blocks
-    dh = params.d_model // n_heads
     x = params.wte[token] + params.wpe[pos]                  # [B, d]
     new_k, new_v = cache.k, cache.v
     for l in range(p.n_layers):
-        a = layernorm(p.ln1[l], x)
-        q, k, v = (
-            (a @ w[l].T).reshape(b, n_heads, dh)
-            for w in (p.wq, p.wk, p.wv))
-        new_k = lax.dynamic_update_slice(
-            new_k, k[None, :, :, None, :], (l, 0, 0, pos, 0))
-        new_v = lax.dynamic_update_slice(
-            new_v, v[None, :, :, None, :], (l, 0, 0, pos, 0))
-        y = _decode_attn(q, new_k[l], new_v[l], pos)
-        x = x + y.reshape(b, params.d_model) @ p.wo[l].T
+        y, new_k, new_v = cached_attn_step(
+            p.ln1[l], p.wq[l], p.wk[l], p.wv[l], p.wo[l],
+            new_k, new_v, l, x, pos)
+        x = x + y
         h = layernorm(p.ln2[l], x)
         x = x + jnp.maximum(h @ p.w1[l].T, 0.0) @ p.w2[l].T
     h = layernorm(params.ln_f, x)

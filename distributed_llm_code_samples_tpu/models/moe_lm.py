@@ -139,24 +139,15 @@ def moe_decode_step(params: MoELMParams, cache, token: jax.Array,
     computed directly — no dispatch tensor at batch-of-one-position
     scale."""
     from ..ops.moe import route_topk
-    from .lm import KVCache, _decode_attn
-    b = token.shape[0]
+    from .lm import KVCache, cached_attn_step
     blk = params.blocks
-    d = params.d_model
-    dh = d // n_heads
     x = params.wte[token] + params.wpe[pos]
     new_k, new_v = cache.k, cache.v
     for l in range(blk.n_layers):
-        a = layernorm(blk.ln1[l], x)
-        q, kk, vv = (
-            (a @ w[l].T).reshape(b, n_heads, dh)
-            for w in (blk.wq, blk.wk, blk.wv))
-        new_k = jax.lax.dynamic_update_slice(
-            new_k, kk[None, :, :, None, :], (l, 0, 0, pos, 0))
-        new_v = jax.lax.dynamic_update_slice(
-            new_v, vv[None, :, :, None, :], (l, 0, 0, pos, 0))
-        y = _decode_attn(q, new_k[l], new_v[l], pos)
-        x = x + y.reshape(b, d) @ blk.wo[l].T
+        y, new_k, new_v = cached_attn_step(
+            blk.ln1[l], blk.wq[l], blk.wk[l], blk.wv[l], blk.wo[l],
+            new_k, new_v, l, x, pos)
+        x = x + y
         h = layernorm(blk.ln2[l], x)
         # per-token routing, the training router's exact semantics
         # (k=1: raw top-1 probability gate; k>1: renormalized top-k)

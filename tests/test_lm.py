@@ -372,6 +372,18 @@ def test_sample_validates_arguments():
         sample(params, prompt, 2, HEADS, top_k=V + 1)
 
 
+def test_tp_generate_matches_single_device(mesh_model4):
+    """Megatron-sharded decode (head-sharded cache, vocab-parallel head,
+    gathered argmax) == the single-device greedy decode, token for
+    token."""
+    from distributed_llm_code_samples_tpu.parallel import tp_generate
+    params = small_lm(seed=12)
+    prompt = jax.random.randint(jax.random.PRNGKey(14), (2, 3), 0, V)
+    want = generate(params, prompt, 5, HEADS)
+    got = tp_generate(params, prompt, 5, mesh_model4, n_heads=HEADS)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
 def test_generate_is_prompt_length_oblivious():
     """One compiled program serves any prompt split of the same total:
     feeding a longer prompt whose extra tokens are exactly the greedy
