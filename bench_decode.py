@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Decode throughput on the real chip: tokens/sec for the KV-cache loop.
+
+Shape: a GPT-2-small-proportioned LM (d=768, L=12, H=12, vocab=50304)
+decoding NEW tokens greedily from a short prompt, whole batch in one
+jitted scan (``models.lm.generate``). Prints one JSON line:
+``{"metric": "lm_decode_tokens_per_sec", "value": ..., ...}`` where
+``value`` counts generated tokens x batch per second (prefill positions
+excluded from the numerator, included in the measured time — the honest
+end-to-end number).
+
+Not driver-run (the round benchmark is bench.py); run manually:
+``python bench_decode.py`` (real TPU) or ``BENCH_PLATFORM=cpu`` with
+smaller env shapes for a smoke test.
+"""
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+if os.environ.get("BENCH_PLATFORM"):
+    jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
+D = int(os.environ.get("BENCH_D", 768))
+L = int(os.environ.get("BENCH_LAYERS", 12))
+H = int(os.environ.get("BENCH_HEADS", 12))
+V = int(os.environ.get("BENCH_VOCAB", 50304))
+B = int(os.environ.get("BENCH_BATCH", 8))
+T0 = int(os.environ.get("BENCH_PROMPT", 16))
+NEW = int(os.environ.get("BENCH_NEW", 240))
+REPS = int(os.environ.get("BENCH_REPS", 3))
+
+
+def main() -> int:
+    from distributed_llm_code_samples_tpu.models import generate, init_lm
+
+    params = init_lm(jax.random.PRNGKey(0), V, D, L, T0 + NEW)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (B, T0), 0, V)
+
+    run = jax.jit(lambda p, prompt: generate(p, prompt, NEW, H))
+
+    def sync(out) -> int:
+        # the axon relay does not make block_until_ready wait for chained
+        # dispatches (bench.py methodology): force completion through a
+        # dependent scalar readback
+        return int(jnp.sum(out))
+
+    out = run(params, prompt)           # compile + warm
+    sync(out)
+    best = 0.0
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        sync(run(params, prompt))
+        best = max(best, B * NEW / (time.perf_counter() - t0))
+    print(json.dumps({
+        "metric": "lm_decode_tokens_per_sec",
+        "value": round(best, 1),
+        "unit": "tokens/s",
+        "shape": f"d{D}_L{L}_H{H}_V{V}_B{B}_prompt{T0}_new{NEW}",
+        "device_kind": jax.devices()[0].device_kind,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -177,6 +177,10 @@ def decode_step(params: LMParams, cache: KVCache, token: jax.Array,
     ``dynamic_update_slice``, attention masks the unwritten tail.
     """
     p = params.blocks
+    if cache.k.shape[-1] * n_heads != params.d_model:
+        raise ValueError(
+            f"cache head dim {cache.k.shape[-1]} inconsistent with "
+            f"n_heads={n_heads} at d_model={params.d_model}")
     x = params.wte[token] + params.wpe[pos]                  # [B, d]
     new_k, new_v = cache.k, cache.v
     for l in range(p.n_layers):

@@ -141,6 +141,10 @@ def moe_decode_step(params: MoELMParams, cache, token: jax.Array,
     from ..ops.moe import route_topk
     from .lm import KVCache, cached_attn_step
     blk = params.blocks
+    if cache.k.shape[-1] * n_heads != params.d_model:
+        raise ValueError(
+            f"cache head dim {cache.k.shape[-1]} inconsistent with "
+            f"n_heads={n_heads} at d_model={params.d_model}")
     x = params.wte[token] + params.wpe[pos]
     new_k, new_v = cache.k, cache.v
     for l in range(blk.n_layers):

@@ -20,7 +20,7 @@ from .zero1 import train_ddp_zero1
 from .fsdp import train_fsdp
 from .tp import train_tp, train_tp_sp
 from .hybrid import train_hybrid
-from .pipeline import train_pp, train_transformer_pp
+from .pipeline import train_pp, train_transformer_pp, train_lm_pp
 from .sequence import (ring_attention, sequence_parallel_attention,
                        ulysses_attention, ulysses_parallel_attention)
 from .expert import train_moe_ep, train_moe_dense, moe_layer_ep
@@ -57,7 +57,7 @@ __all__ = [
     "collectives",
     "train_single", "train_ddp", "train_ddp_zero1", "train_fsdp",
     "train_tp", "train_tp_sp", "train_hybrid",
-    "train_pp", "train_transformer_pp",
+    "train_pp", "train_transformer_pp", "train_lm_pp",
     "train_moe_ep", "train_moe_dense", "moe_layer_ep",
     "train_moe_transformer_ep", "train_moe_transformer_dense",
     "train_transformer_single", "train_transformer_ddp",

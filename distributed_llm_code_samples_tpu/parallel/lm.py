@@ -396,15 +396,16 @@ def tp_generate(params: LMParams, prompt, n_new: int, mesh, *,
     def pick_global(logits_local):
         """argmax over the sharded vocab: each shard offers its local
         ``(max value, global index)`` pair, packed into ONE tiny
-        ``[2, B]`` all_gather per position (the index rides as a float —
-        exact while vocab < 2^24)."""
+        ``[2, B]`` all_gather per position. The pack rides in f32
+        regardless of the params' dtype: a bf16 lane would round the
+        index (8-bit mantissa); f32 is exact while vocab < 2^24."""
         local_best = jnp.argmax(logits_local, axis=-1)       # [B]
         local_val = jnp.take_along_axis(
             logits_local, local_best[:, None], axis=-1)[:, 0]
         offset = axis_index(MODEL_AXIS) * v_local
         packed = jnp.stack([
-            local_val,
-            (local_best + offset).astype(local_val.dtype)])  # [2, B]
+            local_val.astype(jnp.float32),
+            (local_best + offset).astype(jnp.float32)])      # [2, B]
         g = all_gather(packed[None], MODEL_AXIS, dim=0)      # [n, 2, B]
         win = jnp.argmax(g[:, 0, :], axis=0)                 # [B]
         return jnp.take_along_axis(

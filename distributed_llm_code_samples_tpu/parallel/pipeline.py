@@ -133,7 +133,9 @@ def _gpipe_step(params, x_mb, dy_mb, s, M: int, S: int,
     """GPipe: forward wavefront, fence, backward wavefront.
 
     Generic over the stage compute: ``stage_fwd(params, x) -> (y, acts)``
-    and ``stage_bwd(dy, params, acts) -> (dx, grads)`` where ``params`` /
+    and ``stage_bwd(dy, params, acts, m) -> (dx, grads)`` (``m`` = the
+    microbatch index, for stages whose backward needs per-microbatch data
+    — the LM head recomputes its targets from it) where ``params`` /
     ``grads`` are any matching pytree and ``acts`` is a stashable array
     pytree (the FFN stack stashes block inputs, the transformer stack
     block inputs of its blocks — both recompute internals in backward)."""
@@ -188,7 +190,7 @@ def _gpipe_step(params, x_mb, dy_mb, s, M: int, S: int,
         def bwd_branch(grads):
             dx, dg = stage_bwd(
                 dy_in, params,
-                jax.tree_util.tree_map(lambda st: st[mc], stash))
+                jax.tree_util.tree_map(lambda st: st[mc], stash), mc)
             return vary((jax.tree_util.tree_map(jnp.add, grads, dg), dx))
 
         def bwd_idle(grads):
@@ -250,7 +252,8 @@ def _1f1b_step(params, x_mb, dy_mb, s, M: int, S: int,
             stash, grads = carry
             dx, dg = stage_bwd(
                 dy_in, params,
-                jax.tree_util.tree_map(lambda st: st[mbc % K], stash))
+                jax.tree_util.tree_map(lambda st: st[mbc % K], stash),
+                mbc)
             return vary((stash, jax.tree_util.tree_map(jnp.add, grads, dg),
                          jnp.zeros(x_shape, dtype), dx))
 
@@ -299,7 +302,7 @@ def make_step(batch_size: int, model_size: int, n_stages: int,
     def stage_fwd(p: FFNStackParams, x):
         return stack_fwd(p.w1, p.w2, x, block_fwd=block_fwd)
 
-    def stage_bwd(dy, p: FFNStackParams, acts):
+    def stage_bwd(dy, p: FFNStackParams, acts, m):
         dx, (g1, g2) = stack_bwd(dy, p.w1, p.w2, acts,
                                  block_bwd=block_bwd)
         return dx, FFNStackParams(g1, g2)
@@ -378,7 +381,7 @@ def make_transformer_pp_step(batch_size: int, model_size: int,
             x = block(tuple(leaf[l] for leaf in p), x)
         return x, jnp.stack(acts)          # [L/S, mb, T, d] block inputs
 
-    def stage_bwd(dy, p: TransformerParams, acts):
+    def stage_bwd(dy, p: TransformerParams, acts, m):
         grads = jax.tree_util.tree_map(jnp.zeros_like, p)
         for l in reversed(range(p.ln1.shape[0])):
             leaves = tuple(leaf[l] for leaf in p)
@@ -461,6 +464,176 @@ def train_transformer_pp(params, seeds, batch_size: int, model_size: int,
         model_axis=MODEL_AXIS if tp_n > 1 else None, causal=causal,
         attn=resolve_attn(attn_impl))
 
+    if dp > 1:
+        return launch_strided(step, sharded, seeds, mesh, DATA_AXIS, specs)
+    return launch(step, sharded, jnp.asarray(seeds), mesh,
+                  param_specs=specs, seed_spec=P())
+
+
+def make_lm_pp_step(batch_size: int, model_size: int, seq_len: int,
+                    n_heads: int, vocab: int, n_stages: int,
+                    n_microbatches: int, lr: float = LR,
+                    axis: str = PIPE_AXIS, schedule: str = "gpipe",
+                    data_axis: str | None = None):
+    """One LM-PP step for one stage: the full language model pipelined —
+    embedding on stage 0, transformer-block stages along the ring, tied
+    head + REAL cross-entropy on the last stage. Runs under both
+    schedules: the stage roles are runtime-gated on ``axis_index`` inside
+    the uniform SPMD stage functions (``lax.cond`` on a shard-varying
+    stage id, the schedules' bubble-skipping mechanism):
+
+    - every stage stashes its block inputs AND its output, so the last
+      stage's backward can start from the loss: it recomputes its
+      microbatch's targets from the step seed (``m`` passed by the
+      schedules), takes the head+xent vjp at the stashed output
+      (1/M-scaled — microbatch means sum to the full-batch mean), and
+      feeds the result into its block walk in place of the ring ``dy``;
+    - stage 0's backward folds the embedding vjp of its final ``dx``
+      into the gradient tree.
+
+    Embedding/head/final-LN grads are per-stage partials (zero on
+    non-owner stages) completed by one ``psum`` over the pipe axis; block
+    grads stay stage-local. ``data_axis`` composes DDP exactly as the
+    other PP families."""
+    from ..data import lm_batch_from_seed
+    from ..models.lm import LMParams
+    from ..models.transformer import transformer_block
+    from ..ops.norm import layernorm
+    from ..ops.xent import xent_loss
+    S, M = n_stages, n_microbatches
+    if batch_size % seq_len:
+        raise ValueError(f"tokens {batch_size} not divisible by "
+                         f"seq_len {seq_len}")
+    b = batch_size // seq_len
+    if b % M:
+        raise ValueError(f"batch {b} not divisible by {M} microbatches")
+    if schedule not in SCHEDULES:
+        raise ValueError(f"unknown pipeline schedule {schedule!r} "
+                         f"(expected one of {SCHEDULES})")
+    mb = b // M
+    sched = _gpipe_step if schedule == "gpipe" else _1f1b_step
+    vary_axes = tuple(a for a in (axis, data_axis) if a)
+
+    def blocks_walk_fwd(p: LMParams, x):
+        acts = []
+        for l in range(p.blocks.ln1.shape[0]):
+            acts.append(x)
+            x = transformer_block(
+                *(leaf[l] for leaf in p.blocks), x, n_heads)
+        return x, (jnp.stack(acts), x)   # block inputs + stage output
+
+    def step(params: LMParams, seed) -> LMParams:
+        s = axis_index(axis)
+        tokens, targets = lm_batch_from_seed(seed, b, seq_len, vocab)
+        x = params.wte[tokens] + params.wpe[:seq_len]   # replicated embed
+        x_mb = x.reshape(M, mb, seq_len, model_size)
+        dy_mb = jnp.zeros_like(x_mb)  # unused: the head replaces it
+
+        def vary(tree):
+            return _vary_tree(tree, vary_axes)
+
+        def stage_bwd(dy_in, p: LMParams, acts, m):
+            block_inputs, y_out = acts
+            tok_mb = lax.dynamic_slice_in_dim(tokens, m * mb, mb, 0)
+            tgt_mb = lax.dynamic_slice_in_dim(targets, m * mb, mb, 0)
+
+            def head_branch(_):
+                def head_loss(ln_f, wte, h):
+                    hh = layernorm(ln_f, h).reshape(-1, model_size)
+                    return xent_loss(hh @ wte.T,
+                                     tgt_mb.reshape(-1)) / M
+                dln_f, dwte, dy = jax.grad(head_loss, argnums=(0, 1, 2))(
+                    p.ln_f, p.wte, y_out)
+                return vary((dy, dln_f, dwte))
+
+            def ring_branch(_):
+                return vary((dy_in, jnp.zeros_like(p.ln_f),
+                             jnp.zeros_like(p.wte)))
+
+            dy_eff, g_lnf, g_wte = lax.cond(s == S - 1, head_branch,
+                                            ring_branch, None)
+
+            # block walk (recompute internals at the stashed inputs)
+            bgrads = jax.tree_util.tree_map(jnp.zeros_like, p.blocks)
+            dy = dy_eff
+            for l in reversed(range(p.blocks.ln1.shape[0])):
+                leaves = tuple(leaf[l] for leaf in p.blocks)
+                _, vjp = jax.vjp(
+                    lambda lv, xx: transformer_block(*lv, xx, n_heads),
+                    leaves, block_inputs[l])
+                dleaves, dy = vjp(dy)
+                bgrads = type(p.blocks)(*(
+                    g.at[l].set(dg) for g, dg in zip(bgrads, dleaves)))
+
+            def embed_branch(_):
+                def embed(wte, wpe):
+                    return (wte[tok_mb]
+                            + lax.dynamic_slice_in_dim(wpe, 0, seq_len, 0))
+                _, evjp = jax.vjp(embed, p.wte, p.wpe)
+                return vary(tuple(evjp(dy)))
+
+            def no_embed(_):
+                return vary((jnp.zeros_like(p.wte),
+                             jnp.zeros_like(p.wpe)))
+
+            g_wte_e, g_wpe = lax.cond(s == 0, embed_branch, no_embed,
+                                      None)
+            grads = LMParams(wte=g_wte + g_wte_e, wpe=g_wpe,
+                             blocks=bgrads, ln_f=g_lnf)
+            return dy, grads
+
+        grads = sched(_vary_tree(params, vary_axes), x_mb, dy_mb, s, M, S,
+                      axis, vary_axes, blocks_walk_fwd, stage_bwd)
+        # embedding/head/final-LN grads live on 1-2 stages; the psum over
+        # the pipe ring completes them (others contributed zeros)
+        grads = grads._replace(wte=all_reduce(grads.wte, axis),
+                               wpe=all_reduce(grads.wpe, axis),
+                               ln_f=all_reduce(grads.ln_f, axis))
+        if data_axis is not None:
+            grads = jax.tree_util.tree_map(
+                lambda g: all_reduce(g, data_axis), grads)
+        return sgd(params, grads, lr)
+
+    return step
+
+
+def train_lm_pp(params, seeds, batch_size: int, model_size: int, mesh,
+                lr: float = LR, *, seq_len: int, n_heads: int,
+                n_microbatches: int | None = None,
+                schedule: str = "gpipe"):
+    """Pipeline the full LM over the ``"pipe"`` ring (embedding on stage
+    0, blocks staged, tied head + real loss on the last stage); a
+    ``data`` axis composes DDP. Pipe-only equals the single-device LM
+    trainer (microbatch mean-losses are 1/M-scaled so their grads sum to
+    the full-batch mean's); differential-tested under both schedules."""
+    from ..models.lm import LMParams
+    require_axes(mesh, PIPE_AXIS)
+    shape = dict(mesh.shape)
+    S = shape[PIPE_AXIS]
+    dp = shape.get(DATA_AXIS, 1)
+    if model_size % n_heads:
+        raise ValueError(f"model_size={model_size} not divisible by "
+                         f"n_heads={n_heads}")
+    if seq_len > params.max_seq_len:
+        raise ValueError(f"seq_len={seq_len} exceeds max_seq_len="
+                         f"{params.max_seq_len}")
+    if params.blocks.ln1.shape[0] % S:
+        raise ValueError(f"{params.blocks.ln1.shape[0]} layers not "
+                         f"divisible into {S} pipeline stages")
+    M = S if n_microbatches is None else n_microbatches
+    blk = P(PIPE_AXIS, None, None)
+    specs = LMParams(
+        wte=P(), wpe=P(),
+        blocks=type(params.blocks)(
+            ln1=P(PIPE_AXIS, None), wq=blk, wk=blk, wv=blk, wo=blk,
+            ln2=P(PIPE_AXIS, None), w1=blk, w2=blk),
+        ln_f=P())
+    sharded = reshard_copy(params, jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec), specs,
+        is_leaf=lambda v: isinstance(v, P)))
+    step = make_lm_pp_step(batch_size, model_size, seq_len, n_heads,
+                           params.vocab, S, M, lr, schedule=schedule,
+                           data_axis=DATA_AXIS if dp > 1 else None)
     if dp > 1:
         return launch_strided(step, sharded, seeds, mesh, DATA_AXIS, specs)
     return launch(step, sharded, jnp.asarray(seeds), mesh,

@@ -10,6 +10,7 @@ single-device oracle on identical seed schedules.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import PartitionSpec as P
 
 from distributed_llm_code_samples_tpu.data import lm_batch_from_seed
@@ -370,6 +371,52 @@ def test_sample_validates_arguments():
         sample(params, prompt, 2, HEADS, temperature=0.0)
     with pytest.raises(ValueError, match="top_k"):
         sample(params, prompt, 2, HEADS, top_k=V + 1)
+
+
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_lm_pp_matches_single(schedule):
+    """The full LM pipelined (embed stage 0, blocks staged, head + real
+    loss on the last stage) == the single-device LM trainer, both
+    schedules, M<S and M>S."""
+    from distributed_llm_code_samples_tpu.data import make_seed_schedule
+    from distributed_llm_code_samples_tpu.parallel import (
+        PIPE_AXIS, make_mesh, train_lm_pp)
+    params = init_lm(jax.random.PRNGKey(15), V, D, 4, TMAX)
+    seeds = make_seed_schedule(2, random_seed=33)
+    b = 8  # M=8 > S=4 exercises the deep-microbatch regime (and 1F1B's
+    # circular stash reuse); M=2 < S the bubble-heavy one
+    single = train_lm_single(params, seeds, b * SEQ, D, lr=0.05,
+                             seq_len=SEQ, n_heads=HEADS)
+    mesh = make_mesh({PIPE_AXIS: 4})
+    for m in (2, 8):
+        got = train_lm_pp(params, seeds, b * SEQ, D, mesh, lr=0.05,
+                          seq_len=SEQ, n_heads=HEADS, n_microbatches=m,
+                          schedule=schedule)
+        for a, w in zip(jax.tree_util.tree_leaves(got),
+                        jax.tree_util.tree_leaves(single)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(w),
+                                       rtol=2e-4, atol=1e-5,
+                                       err_msg=f"M={m}")
+
+
+def test_lm_pp_composes_with_data(mesh4):
+    """data x pipe on the LM == LM DDP over the data axis alone."""
+    from distributed_llm_code_samples_tpu.data import make_seed_schedule
+    from distributed_llm_code_samples_tpu.parallel import (
+        DATA_AXIS, PIPE_AXIS, make_mesh, train_lm_pp)
+    params = small_lm(seed=16)
+    seeds = make_seed_schedule(4, random_seed=35)
+    b = 4
+    ddp = train_lm_ddp(params, seeds, b * SEQ, D,
+                       make_mesh({DATA_AXIS: 2}), lr=0.05,
+                       seq_len=SEQ, n_heads=HEADS)
+    mesh2d = make_mesh({DATA_AXIS: 2, PIPE_AXIS: 2})
+    got = train_lm_pp(params, seeds, b * SEQ, D, mesh2d, lr=0.05,
+                      seq_len=SEQ, n_heads=HEADS)
+    for a, w in zip(jax.tree_util.tree_leaves(got),
+                    jax.tree_util.tree_leaves(ddp)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(w),
+                                   rtol=2e-4, atol=1e-5)
 
 
 def test_tp_generate_matches_single_device(mesh_model4):
