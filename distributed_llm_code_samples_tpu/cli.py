@@ -56,11 +56,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="pipeline schedule for --method 6: gpipe (two "
                         "wavefronts, stash of M microbatches) or 1f1b "
                         "(interleaved, stash bounded by stage depth)")
-    p.add_argument("--pp_family", choices=["ffn", "transformer"],
+    p.add_argument("--pp_family", choices=["ffn", "transformer", "lm"],
                    default="ffn",
                    help="model family for --method 6: the reference's FFN "
-                        "stack or pre-LN transformer blocks (--heads; "
-                        "microbatches split the batch dim)")
+                        "stack, pre-LN transformer blocks, or the full "
+                        "LM (embed/head staged, real loss; --vocab) "
+                        "(--heads; microbatches split the batch dim)")
     p.add_argument("--experts", type=int, default=8,
                    help="expert count for --method 7/10/12 (MoE)")
     p.add_argument("--heads", type=int, default=4,
@@ -215,8 +216,8 @@ def main(argv=None) -> int:
     key = jax.random.PRNGKey(args.random_seed)
 
     def family_of(method: int) -> str:
-        if method == 6 and args.pp_family == "transformer":
-            return "transformer"
+        if method == 6 and args.pp_family != "ffn":
+            return args.pp_family  # transformer or lm
         return {7: "moe", 8: "transformer", 10: "moe_transformer",
                 11: "lm", 12: "moe_lm"}.get(method, "ffn")
 
@@ -325,6 +326,10 @@ def main(argv=None) -> int:
             if args.pp_family == "transformer":
                 from .parallel import train_transformer_pp
                 name, fn = "train_transformer_pp", train_transformer_pp
+                kwargs.update(seq_len=args.seq_len, n_heads=args.heads)
+            elif args.pp_family == "lm":
+                from .parallel import train_lm_pp
+                name, fn = "train_lm_pp", train_lm_pp
                 kwargs.update(seq_len=args.seq_len, n_heads=args.heads)
         if m == 7:
             kwargs = dict(lr=lr)  # EP's expert loop has its own structure

@@ -70,6 +70,16 @@ def test_cli_transformer_pipeline_method():
     assert "train_transformer_pp takes" in r.stdout
 
 
+@pytest.mark.slow
+def test_cli_lm_pipeline_method():
+    r = _run_cli("-s", "2", "-bs", "8", "-n", "8", "-l", "4", "-d", "32",
+                 "-m", "6", "-r", "3", "--fake_devices", "4",
+                 "--pp_family", "lm", "--heads", "4", "--vocab", "64",
+                 "--lr", "0.1")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "train_lm_pp takes" in r.stdout
+
+
 def test_cli_pp_family_guard():
     r = _run_cli("-s", "2", "-m", "9", "--pp_family", "transformer",
                  "--fake_devices", "4")
