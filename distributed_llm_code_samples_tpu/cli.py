@@ -66,11 +66,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="expert count for --method 7/10/12 (MoE)")
     p.add_argument("--heads", type=int, default=4,
                    help="attention heads for --method 8/10/11/12 and "
-                        "--method 6 with --pp_family transformer")
+                        "--method 6 with --pp_family transformer/lm")
     p.add_argument("--vocab", type=int, default=256,
-                   help="vocabulary size for --method 11/12 (the LM "
-                        "families; method 11 needs it divisible by the "
-                        "model-axis size)")
+                   help="vocabulary size for --method 11/12 and "
+                        "--method 6 with --pp_family lm (method 11 needs "
+                        "it divisible by the model-axis size)")
     p.add_argument("--lr", type=float, default=None,
                    help="override LR (default 1e-5, train_ffns.py:29)")
     p.add_argument("--optimizer",
