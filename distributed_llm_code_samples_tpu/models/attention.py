@@ -93,3 +93,20 @@ def mha(q: jax.Array, k: jax.Array, v: jax.Array,
     """Multi-head convenience: vmap ``attention`` over a leading heads axis
     (``[H, T, d] -> [H, T, d]``)."""
     return jax.vmap(lambda q, k, v: attention(q, k, v, causal))(q, k, v)
+
+
+def gqa(q: jax.Array, k: jax.Array, v: jax.Array,
+        causal: bool = True) -> jax.Array:
+    """Grouped-query attention: ``q [H, T, dh]``, ``k/v [H_kv, T, dh]``
+    with ``H % H_kv == 0`` — each KV head serves ``H/H_kv`` query heads
+    (the decode-memory optimization: KV-cache bytes drop by the group
+    factor). Runs the same hand-VJP ``attention`` kernel per (kv-head,
+    group) pair; ``H_kv == H`` reduces exactly to ``mha``."""
+    hq, hkv = q.shape[0], k.shape[0]
+    if hq % hkv:
+        raise ValueError(f"query heads {hq} not divisible by kv heads "
+                         f"{hkv}")
+    qg = q.reshape(hkv, hq // hkv, *q.shape[1:])
+    y = jax.vmap(lambda qs, k1, v1: jax.vmap(
+        lambda q1: attention(q1, k1, v1, causal))(qs))(qg, k, v)
+    return y.reshape(hq, *q.shape[1:])

@@ -77,15 +77,35 @@ class LMParams(NamedTuple):
 
 def init_lm(key: jax.Array, vocab: int, d_model: int, n_layers: int,
             max_seq_len: int, ffn_dim: int | None = None,
-            scale: float = 2e-2, dtype=jnp.float32) -> LMParams:
+            scale: float = 2e-2, dtype=jnp.float32,
+            n_heads: int | None = None,
+            n_kv_heads: int | None = None) -> LMParams:
     """Same init family as the rest of the framework: ``scale * normal``
-    (``train_ffns.py:35-36``), LN gains at 1."""
+    (``train_ffns.py:35-36``), LN gains at 1.
+
+    ``n_kv_heads`` (with ``n_heads``) initializes grouped-query attention
+    weights: wk/wv project to ``n_kv_heads * head_dim`` dims, shrinking
+    the KV cache by ``n_heads/n_kv_heads`` — the forward/decode paths
+    pick up the grouping from the shapes alone."""
+    kv_dim = None
+    if n_heads is not None and d_model % n_heads:
+        raise ValueError(f"d_model={d_model} not divisible by "
+                         f"n_heads={n_heads}")
+    if n_kv_heads is not None:
+        if n_heads is None:
+            raise ValueError("n_kv_heads needs n_heads (head_dim = "
+                             "d_model / n_heads)")
+        if n_heads % n_kv_heads:
+            raise ValueError(
+                f"n_heads={n_heads} not divisible by "
+                f"n_kv_heads={n_kv_heads}")
+        kv_dim = (d_model // n_heads) * n_kv_heads
     ke, kp, kb = jax.random.split(key, 3)
     return LMParams(
         wte=scale * jax.random.normal(ke, (vocab, d_model), dtype),
         wpe=scale * jax.random.normal(kp, (max_seq_len, d_model), dtype),
         blocks=init_transformer(kb, d_model, n_layers, ffn_dim, scale,
-                                dtype),
+                                dtype, kv_dim=kv_dim),
         ln_f=jnp.ones((d_model,), dtype))
 
 
@@ -126,23 +146,30 @@ class KVCache(NamedTuple):
 
 def init_cache(params: LMParams, batch: int, n_heads: int,
                dtype=None) -> KVCache:
-    shape = (params.n_layers, batch, n_heads, params.max_seq_len,
-             params.d_model // n_heads)
+    """Cache sized by the model's KV head count (``wk``'s output dim over
+    the head dim) — under GQA that is ``n_kv_heads``, so cache bytes
+    shrink by the group factor with no other change."""
+    dh = params.d_model // n_heads
+    kv_heads = params.blocks.wk.shape[1] // dh
+    shape = (params.n_layers, batch, kv_heads, params.max_seq_len, dh)
     dtype = params.wte.dtype if dtype is None else dtype
     return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
 
 
 def _decode_attn(q, ck, cv, pos):
     """Single-query attention over the cache. ``q [B, H, dh]``,
-    ``ck/cv [B, H, T_max, dh]``; positions ``> pos`` are masked (the cache
-    beyond the write head is zeros, never probability mass)."""
-    dh = q.shape[-1]
-    s = jnp.einsum("bhd,bhtd->bht", q, ck) / jnp.sqrt(
+    ``ck/cv [B, H_kv, T_max, dh]`` with ``H % H_kv == 0`` (GQA groups;
+    ``H_kv == H`` is plain MHA); positions ``> pos`` are masked (the
+    cache beyond the write head is zeros, never probability mass)."""
+    b, h, dh = q.shape
+    hkv = ck.shape[1]
+    qg = q.reshape(b, hkv, h // hkv, dh)
+    s = jnp.einsum("bkgd,bktd->bkgt", qg, ck) / jnp.sqrt(
         jnp.asarray(dh, q.dtype))
     mask = jnp.arange(ck.shape[2]) <= pos
     s = jnp.where(mask, s, jnp.asarray(-1e30, s.dtype))
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bht,bhtd->bhd", p, cv)
+    return jnp.einsum("bkgt,bktd->bkgd", p, cv).reshape(b, h, dh)
 
 
 def cached_attn_step(ln1_l, wq_l, wk_l, wv_l, wo_l, cache_k, cache_v,
@@ -153,13 +180,16 @@ def cached_attn_step(ln1_l, wq_l, wk_l, wv_l, wo_l, cache_k, cache_v,
     over the cache, output projection. Returns ``(y_proj, cache_k,
     cache_v)`` with the residual add (and, under TP, the psum) left to
     the caller — ``y_proj`` may be a partial sum over sharded heads.
-    Head count and head dim come from the weight/cache shapes."""
+    Head counts (query AND kv — GQA falls out) and head dim come from
+    the weight/cache shapes."""
     b = x.shape[0]
     dh = cache_k.shape[-1]
     h_loc = wq_l.shape[0] // dh
+    kv_loc = wk_l.shape[0] // dh
     a = layernorm(ln1_l, x)
-    q, k, v = ((a @ w.T).reshape(b, h_loc, dh)
-               for w in (wq_l, wk_l, wv_l))
+    q = (a @ wq_l.T).reshape(b, h_loc, dh)
+    k = (a @ wk_l.T).reshape(b, kv_loc, dh)
+    v = (a @ wv_l.T).reshape(b, kv_loc, dh)
     cache_k = lax.dynamic_update_slice(
         cache_k, k[None, :, :, None, :], (layer, 0, 0, pos, 0))
     cache_v = lax.dynamic_update_slice(

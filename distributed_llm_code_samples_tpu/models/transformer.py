@@ -25,7 +25,7 @@ import jax.numpy as jnp
 from ..ops.linear import init_linear
 from ..ops.ffn import ffn_block
 from ..ops.norm import layernorm
-from .attention import mha
+from .attention import gqa, mha
 
 
 class TransformerParams(NamedTuple):
@@ -58,10 +58,13 @@ class TransformerParams(NamedTuple):
 
 def init_transformer(key: jax.Array, d_model: int, n_layers: int,
                      ffn_dim: int | None = None, scale: float = 2e-2,
-                     dtype=jnp.float32) -> TransformerParams:
+                     dtype=jnp.float32,
+                     kv_dim: int | None = None) -> TransformerParams:
     """Init all stacks; ``ffn_dim`` defaults to ``4 * d_model``. LN gains
-    start at 1."""
+    start at 1. ``kv_dim`` (default ``d_model``) sets the wk/wv output
+    dim — pass ``n_kv_heads * head_dim`` for grouped-query attention."""
     ffn_dim = 4 * d_model if ffn_dim is None else ffn_dim
+    kv_dim = d_model if kv_dim is None else kv_dim
     keys = jax.random.split(key, 6 * n_layers)
 
     def stack(off, m, n):
@@ -70,8 +73,8 @@ def init_transformer(key: jax.Array, d_model: int, n_layers: int,
 
     ones = jnp.ones((n_layers, d_model), dtype)
     return TransformerParams(
-        ln1=ones, wq=stack(0, d_model, d_model), wk=stack(1, d_model, d_model),
-        wv=stack(2, d_model, d_model), wo=stack(3, d_model, d_model),
+        ln1=ones, wq=stack(0, d_model, d_model), wk=stack(1, d_model, kv_dim),
+        wv=stack(2, d_model, kv_dim), wo=stack(3, d_model, d_model),
         ln2=ones, w1=stack(4, d_model, ffn_dim), w2=stack(5, ffn_dim, d_model))
 
 
@@ -93,12 +96,29 @@ def attn_sublayer(wq, wk, wv, wo, a: jax.Array, n_heads: int,
     weights ``[d_out, d]`` (``d_out`` may be a head-sharded slice under
     TP — heads live on the leading output dim).
 
+    Grouped-query attention falls out of the shapes: the KV head count
+    is ``wk``'s output dim over the head dim (``wq``'s output dim over
+    ``n_heads``), so models initialized with a smaller ``kv_dim``
+    (``init_transformer``/``init_lm``) run GQA with no flag — ``mha``
+    when the counts match, the grouped kernel otherwise.
+
     ``attn`` swaps the per-batch multi-head attention op
     (``(q, k, v, causal) -> y`` on ``[H, T, dh]``); None uses the
-    quadratic hand-VJP oracle ``mha``, trainers pass the fused Pallas
-    ``flash_mha`` via ``attn_impl="flash"``."""
-    q, k, v = (split_heads(a @ w.T, n_heads) for w in (wq, wk, wv))
-    op = mha if attn is None else attn
+    quadratic hand-VJP oracles (``mha``/``gqa``), trainers pass the fused
+    Pallas ``flash_mha`` via ``attn_impl="flash"`` (full-MHA shapes
+    only)."""
+    dh = wq.shape[0] // n_heads
+    n_kv = wk.shape[0] // dh
+    q = split_heads(a @ wq.T, n_heads)
+    k = split_heads(a @ wk.T, n_kv)
+    v = split_heads(a @ wv.T, n_kv)
+    if attn is None:
+        op = mha if n_kv == n_heads else gqa
+    elif n_kv != n_heads:
+        raise ValueError("custom attn impls expect full-MHA shapes; "
+                         f"got {n_heads} query vs {n_kv} kv heads")
+    else:
+        op = attn
     y = jax.vmap(lambda q, k, v: op(q, k, v, causal))(q, k, v)
     return merge_heads(y) @ wo.T
 

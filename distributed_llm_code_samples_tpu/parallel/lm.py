@@ -368,6 +368,12 @@ def tp_generate(params: LMParams, prompt, n_new: int, mesh, *,
     if params.vocab % n:
         raise ValueError(f"vocab={params.vocab} not divisible by "
                          f"model-axis size {n}")
+    if params.blocks.wk.shape[1] != params.d_model:
+        # the sharded cache here is sized by query heads; a grouped
+        # (GQA) cache would mis-slot the kv writes — decode GQA models
+        # single-device (models.lm.generate) for now
+        raise ValueError("tp_generate supports full-MHA models only; "
+                         "GQA models decode via generate()")
     prompt = jnp.asarray(prompt)
     b = prompt.shape[0]
     d = params.d_model

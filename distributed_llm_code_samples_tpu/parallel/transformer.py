@@ -266,6 +266,11 @@ def _validate_tp(params, n_heads: int, n: int) -> int:
     if n_heads % n:
         raise ValueError(f"n_heads={n_heads} not divisible by model-axis "
                          f"size {n}")
+    dh = params.wq.shape[1] // n_heads
+    kv_heads = params.wk.shape[1] // dh
+    if kv_heads % n:
+        raise ValueError(f"n_kv_heads={kv_heads} (GQA) not divisible by "
+                         f"model-axis size {n}")
     ffn_dim = params.w1.shape[1]
     if ffn_dim % n:
         raise ValueError(f"ffn_dim={ffn_dim} not divisible by model-axis "

@@ -318,6 +318,117 @@ def test_vp_xent_matches_dense_fwd_and_bwd(mesh_model4):
                                atol=1e-7)
 
 
+# --- grouped-query attention ------------------------------------------------
+
+
+def test_gqa_reduces_to_mha_when_counts_match():
+    """gqa with H_kv == H is bit-identical to mha (same kernel, same
+    order)."""
+    from distributed_llm_code_samples_tpu.models.attention import gqa, mha
+    key = jax.random.PRNGKey(21)
+    q, k, v = (jax.random.normal(jax.random.fold_in(key, i), (4, 8, 8))
+               for i in range(3))
+    np.testing.assert_array_equal(np.asarray(gqa(q, k, v, True)),
+                                  np.asarray(mha(q, k, v, True)))
+
+
+def test_gqa_matches_repeated_kv_oracle():
+    """GQA == plain MHA with each KV head explicitly repeated over its
+    group — forward and gradients."""
+    from distributed_llm_code_samples_tpu.models.attention import gqa, mha
+    key = jax.random.PRNGKey(22)
+    q = jax.random.normal(jax.random.fold_in(key, 0), (4, 8, 8))
+    k = jax.random.normal(jax.random.fold_in(key, 1), (2, 8, 8))
+    v = jax.random.normal(jax.random.fold_in(key, 2), (2, 8, 8))
+
+    def repeated(q, k, v):
+        kr = jnp.repeat(k, 2, axis=0)
+        vr = jnp.repeat(v, 2, axis=0)
+        return mha(q, kr, vr, True)
+
+    np.testing.assert_allclose(np.asarray(gqa(q, k, v, True)),
+                               np.asarray(repeated(q, k, v)), rtol=1e-6)
+    g1 = jax.grad(lambda q, k, v: jnp.sum(gqa(q, k, v, True) ** 2),
+                  argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda q, k, v: jnp.sum(repeated(q, k, v) ** 2),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def gqa_lm(seed=0):
+    return init_lm(jax.random.PRNGKey(seed), V, D, L, TMAX,
+                   n_heads=HEADS, n_kv_heads=2)
+
+
+def test_gqa_lm_trains_and_matches_across_strategies(mesh8):
+    """The GQA LM (kv heads = H/2, cache and wk/wv half-size) trains
+    under DDP == FSDP and memorizes a repeated batch — the grouping
+    changes shapes, not the differential contracts."""
+    from distributed_llm_code_samples_tpu.data import make_seed_schedule
+    params = gqa_lm(seed=21)
+    assert params.blocks.wk.shape[1] == D // 2
+    seeds = make_seed_schedule(8, random_seed=41)
+    kw = dict(seq_len=SEQ, n_heads=HEADS)
+    ddp = train_lm_ddp(params, seeds, 2 * SEQ, D, mesh8, **kw)
+    fsdp = train_lm_fsdp(params, seeds, 2 * SEQ, D, mesh8, **kw)
+    for got, want in zip(jax.tree_util.tree_leaves(fsdp),
+                         jax.tree_util.tree_leaves(ddp)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   **tolerances())
+    tokens, targets = lm_batch_from_seed(jnp.int32(99), 4, SEQ, V)
+    before = float(lm_loss(params, tokens, targets, HEADS))
+    trained = train_lm_single(params, jnp.full((32,), 99, jnp.int32),
+                              4 * SEQ, D, lr=0.5, **kw)
+    assert float(lm_loss(trained, tokens, targets, HEADS)) < before - 0.1
+
+
+def test_gqa_tp_training_works_when_divisible(mesh_model4):
+    """TP training of a GQA model works when kv heads divide the model
+    axis (here kv=4 over 4 shards == MHA-per-shard grouping preserved);
+    an indivisible kv count and the TP decode path reject clearly."""
+    from distributed_llm_code_samples_tpu.data import make_seed_schedule
+    from distributed_llm_code_samples_tpu.parallel import (make_mesh,
+                                                           MODEL_AXIS,
+                                                           tp_generate)
+    params2 = gqa_lm(seed=25)     # kv=2: not divisible by 4
+    seeds = make_seed_schedule(2, random_seed=43)
+    with pytest.raises(ValueError, match="n_kv_heads=2"):
+        train_lm_tp(params2, seeds, 2 * SEQ, D, mesh_model4,
+                    seq_len=SEQ, n_heads=HEADS)
+    with pytest.raises(ValueError, match="full-MHA"):
+        tp_generate(params2, jnp.zeros((1, 2), jnp.int32), 2,
+                    make_mesh({MODEL_AXIS: 2}), n_heads=HEADS)
+    # kv=2 over 2 shards: one kv head per shard, groups preserved
+    mesh2 = make_mesh({MODEL_AXIS: 2})
+    single = train_lm_single(params2, seeds, 2 * SEQ, D, seq_len=SEQ,
+                             n_heads=HEADS)
+    tp = train_lm_tp(params2, seeds, 2 * SEQ, D, mesh2, seq_len=SEQ,
+                     n_heads=HEADS)
+    for got, want in zip(jax.tree_util.tree_leaves(tp),
+                         jax.tree_util.tree_leaves(single)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   **tolerances())
+
+
+def test_gqa_decode_matches_full_forward_and_shrinks_cache():
+    """GQA decode == teacher-forced argmax, with the KV cache half the
+    MHA size."""
+    from distributed_llm_code_samples_tpu.models import init_cache
+    params = gqa_lm(seed=23)
+    cache = init_cache(params, 2, HEADS)
+    assert cache.k.shape[2] == 2  # kv heads, not query heads
+    prompt = jax.random.randint(jax.random.PRNGKey(24), (2, 3), 0, V)
+    got = generate(params, prompt, 5, HEADS)
+    toks = np.asarray(prompt)
+    for _ in range(5):
+        logits = lm_logits(params, jnp.asarray(toks), HEADS)
+        nxt = np.asarray(jnp.argmax(logits[:, -1], axis=-1))
+        toks = np.concatenate([toks, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(got), toks)
+
+
 # --- decode ----------------------------------------------------------------
 
 
