@@ -71,6 +71,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="vocabulary size for --method 11/12 and "
                         "--method 6 with --pp_family lm (method 11 needs "
                         "it divisible by the model-axis size)")
+    p.add_argument("--kv_heads", type=int, default=0,
+                   help="with --method 11: grouped-query attention with "
+                        "this many KV heads (0 = full MHA; wk/wv and the "
+                        "KV cache shrink by heads/kv_heads; must divide "
+                        "--heads and the model-axis size must divide it)")
     p.add_argument("--lr", type=float, default=None,
                    help="override LR (default 1e-5, train_ffns.py:29)")
     p.add_argument("--optimizer",
@@ -193,6 +198,17 @@ def main(argv=None) -> int:
         print(f"error: --clip_norm must be >= 0 (got {args.clip_norm})",
               file=sys.stderr)
         return 2
+    if args.kv_heads < 0:
+        print(f"error: --kv_heads must be >= 0 (got {args.kv_heads})",
+              file=sys.stderr)
+        return 2
+    if args.kv_heads and not (
+            args.method in (9, 11)
+            or (args.method == 6 and args.pp_family == "lm")):
+        print("error: --kv_heads applies to the LM family only "
+              "(--method 11, 9, or 6 with --pp_family lm)",
+              file=sys.stderr)
+        return 2
     if (args.zero1 and args.optimizer != "sgd" and args.checkpoint_dir
             and args.checkpoint_every):
         # ZeRO-1's per-rank state shards have no opt_state surface yet;
@@ -242,7 +258,9 @@ def main(argv=None) -> int:
                 from .models import init_lm
                 _family_params[fam] = init_lm(
                     key, args.vocab, args.model_size, args.layers,
-                    max_seq_len=args.seq_len, dtype=dtype)
+                    max_seq_len=args.seq_len, dtype=dtype,
+                    n_heads=args.heads,
+                    n_kv_heads=args.kv_heads or None)
             elif fam == "moe_lm":
                 from .models import init_moe_lm
                 _family_params[fam] = init_moe_lm(

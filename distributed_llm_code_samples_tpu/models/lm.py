@@ -95,6 +95,8 @@ def init_lm(key: jax.Array, vocab: int, d_model: int, n_layers: int,
         if n_heads is None:
             raise ValueError("n_kv_heads needs n_heads (head_dim = "
                              "d_model / n_heads)")
+        if n_kv_heads < 1:
+            raise ValueError(f"n_kv_heads must be >= 1, got {n_kv_heads}")
         if n_heads % n_kv_heads:
             raise ValueError(
                 f"n_heads={n_heads} not divisible by "
