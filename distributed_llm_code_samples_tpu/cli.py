@@ -72,10 +72,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "--method 6 with --pp_family lm (method 11 needs "
                         "it divisible by the model-axis size)")
     p.add_argument("--kv_heads", type=int, default=0,
-                   help="with --method 11: grouped-query attention with "
-                        "this many KV heads (0 = full MHA; wk/wv and the "
-                        "KV cache shrink by heads/kv_heads; must divide "
-                        "--heads and the model-axis size must divide it)")
+                   help="with --method 11, 9, or 6 + --pp_family lm: "
+                        "grouped-query attention with this many KV heads "
+                        "(0 = full MHA; wk/wv and the KV cache shrink by "
+                        "heads/kv_heads; must divide --heads and the "
+                        "model-axis size must divide it)")
     p.add_argument("--lr", type=float, default=None,
                    help="override LR (default 1e-5, train_ffns.py:29)")
     p.add_argument("--optimizer",
@@ -208,6 +209,10 @@ def main(argv=None) -> int:
         print("error: --kv_heads applies to the LM family only "
               "(--method 11, 9, or 6 with --pp_family lm)",
               file=sys.stderr)
+        return 2
+    if args.kv_heads and args.heads % args.kv_heads:
+        print(f"error: --heads {args.heads} not divisible by "
+              f"--kv_heads {args.kv_heads}", file=sys.stderr)
         return 2
     if (args.zero1 and args.optimizer != "sgd" and args.checkpoint_dir
             and args.checkpoint_every):

@@ -19,10 +19,7 @@ with unscaled LR (``:165``, ``optim.py``).
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
-from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from .. import LR
