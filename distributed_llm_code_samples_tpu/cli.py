@@ -211,6 +211,9 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
     if args.kv_heads and args.heads % args.kv_heads:
+        # mirrors init_lm's n_heads % n_kv_heads check — repeated here
+        # only so an arg-only mistake exits 2 with a clean message
+        # instead of that ValueError's traceback
         print(f"error: --heads {args.heads} not divisible by "
               f"--kv_heads {args.kv_heads}", file=sys.stderr)
         return 2

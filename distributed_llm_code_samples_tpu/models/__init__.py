@@ -13,7 +13,7 @@ from .transformer import (TransformerParams, init_transformer,
 from .lm import (LMParams, init_lm, lm_logits, lm_loss, KVCache,
                  init_cache, decode_step, generate, sample)
 from .moe_lm import (MoELMParams, init_moe_lm, moe_lm_loss_aux,
-                     moe_lm_logits, moe_generate)
+                     moe_lm_logits, moe_generate, moe_sample)
 
 __all__ = ["FFNStackParams", "init_ffn_stack", "clone_params",
            "params_size_gb", "attention", "mha",
@@ -24,4 +24,4 @@ __all__ = ["FFNStackParams", "init_ffn_stack", "clone_params",
            "LMParams", "init_lm", "lm_logits", "lm_loss", "KVCache",
            "init_cache", "decode_step", "generate", "sample",
            "MoELMParams", "init_moe_lm", "moe_lm_loss_aux",
-           "moe_lm_logits", "moe_generate"]
+           "moe_lm_logits", "moe_generate", "moe_sample"]

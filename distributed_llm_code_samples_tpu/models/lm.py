@@ -276,23 +276,19 @@ def generate(params: LMParams, prompt: jax.Array, n_new: int,
                         lambda z, pos: jnp.argmax(z, axis=-1))
 
 
-def sample(params: LMParams, prompt: jax.Array, n_new: int, n_heads: int,
-           *, temperature: float = 1.0, top_k: int = 0,
-           seed: int = 0) -> jax.Array:
-    """Stochastic decode: temperature-scaled, optionally top-k-truncated
-    categorical draws. Deterministic given ``seed`` — the per-position key
-    is ``fold_in(fold_in(base, seed), pos)``, the same counter-RNG contract
+def sample_pick(temperature: float, top_k: int, vocab: int, seed: int):
+    """Build the stochastic ``pick(logits, pos)`` for ``decode_loop``:
+    temperature-scaled, optionally top-k-truncated categorical draws.
+    Deterministic given ``seed`` — the per-position key is
+    ``fold_in(fold_in(base, seed), pos)``, the same counter-RNG contract
     as the data layer, so a sampled continuation is reproducible without
-    any carried RNG state.
-
-    ``top_k=0`` samples the full distribution; ``top_k=1`` degenerates to
-    greedy. ``temperature`` must be > 0 (use ``generate`` for the argmax
-    limit)."""
+    any carried RNG state. Shared by the dense and MoE samplers."""
     if temperature <= 0:
         raise ValueError(f"temperature must be > 0, got {temperature} "
-                         "(use generate() for greedy)")
-    if top_k < 0 or top_k > params.vocab:
-        raise ValueError(f"top_k={top_k} outside [0, vocab={params.vocab}]")
+                         "(use the greedy decoder — generate/"
+                         "moe_generate — for the argmax limit)")
+    if top_k < 0 or top_k > vocab:
+        raise ValueError(f"top_k={top_k} outside [0, vocab={vocab}]")
     base = jax.random.fold_in(jax.random.PRNGKey(0x5A3), seed)
 
     def pick(logits, pos):
@@ -303,4 +299,14 @@ def sample(params: LMParams, prompt: jax.Array, n_new: int, n_heads: int,
         return jax.random.categorical(jax.random.fold_in(base, pos), z,
                                       axis=-1)
 
-    return _decode_loop(params, prompt, n_new, n_heads, pick)
+    return pick
+
+
+def sample(params: LMParams, prompt: jax.Array, n_new: int, n_heads: int,
+           *, temperature: float = 1.0, top_k: int = 0,
+           seed: int = 0) -> jax.Array:
+    """Stochastic decode (see ``sample_pick``). ``top_k=0`` samples the
+    full distribution; ``top_k=1`` degenerates to greedy."""
+    return _decode_loop(params, prompt, n_new, n_heads,
+                        sample_pick(temperature, top_k, params.vocab,
+                                    seed))

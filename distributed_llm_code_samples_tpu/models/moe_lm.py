@@ -165,15 +165,31 @@ def moe_decode_step(params: MoELMParams, cache, token: jax.Array,
     return h @ params.wte.T, KVCache(new_k, new_v)
 
 
-def moe_generate(params: MoELMParams, prompt: jax.Array, n_new: int,
-                 n_heads: int, k: int = 1) -> jax.Array:
-    """Greedy decode through the MoE stack: ``prompt [B, T0]`` ->
-    ``[B, T0 + n_new]`` (one jitted scan, static shapes — the
-    ``models.lm.decode_loop`` contract)."""
+def _moe_decode(params: MoELMParams, prompt, n_new: int, n_heads: int,
+                k: int, pick):
     from .lm import decode_loop, init_cache
     cache = init_cache(params, prompt.shape[0], n_heads)
     return decode_loop(
         lambda cache, token, pos: moe_decode_step(params, cache, token,
                                                   pos, n_heads, k),
-        cache, prompt, n_new, params.max_seq_len,
-        lambda z, pos: jnp.argmax(z, axis=-1))
+        cache, prompt, n_new, params.max_seq_len, pick)
+
+
+def moe_generate(params: MoELMParams, prompt: jax.Array, n_new: int,
+                 n_heads: int, k: int = 1) -> jax.Array:
+    """Greedy decode through the MoE stack: ``prompt [B, T0]`` ->
+    ``[B, T0 + n_new]`` (one jitted scan, static shapes — the
+    ``models.lm.decode_loop`` contract)."""
+    return _moe_decode(params, prompt, n_new, n_heads, k,
+                       lambda z, pos: jnp.argmax(z, axis=-1))
+
+
+def moe_sample(params: MoELMParams, prompt: jax.Array, n_new: int,
+               n_heads: int, k: int = 1, *, temperature: float = 1.0,
+               top_k: int = 0, seed: int = 0) -> jax.Array:
+    """Stochastic decode through the MoE stack — the dense sampler's
+    exact contract (``models.lm.sample_pick``) over the routed stack."""
+    from .lm import sample_pick
+    return _moe_decode(params, prompt, n_new, n_heads, k,
+                       sample_pick(temperature, top_k, params.vocab,
+                                   seed))

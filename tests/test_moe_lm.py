@@ -90,6 +90,21 @@ def test_moe_generate_matches_full_forward_argmax(params, k):
     np.testing.assert_array_equal(np.asarray(got), toks)
 
 
+def test_moe_sample_topk1_is_greedy(params):
+    """MoE sampling with top_k=1 == the greedy MoE decode; same seed ->
+    same continuation (the dense sampler's counter-RNG contract)."""
+    from distributed_llm_code_samples_tpu.models import (moe_generate,
+                                                         moe_sample)
+    prompt = jax.random.randint(jax.random.PRNGKey(17), (2, 3), 0, V)
+    greedy = moe_generate(params, prompt, 4, HEADS, k=2)
+    sampled = moe_sample(params, prompt, 4, HEADS, k=2, temperature=3.0,
+                         top_k=1, seed=5)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(sampled))
+    a = moe_sample(params, prompt, 4, HEADS, temperature=5.0, seed=6)
+    b = moe_sample(params, prompt, 4, HEADS, temperature=5.0, seed=6)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_moe_lm_validates_max_seq(params):
     seeds = make_seed_schedule(1, random_seed=1)
     with pytest.raises(ValueError, match="max_seq_len"):
