@@ -148,15 +148,22 @@ def train_lm_ddp(params: LMParams, seeds, batch_size: int, model_size: int,
 
 def train_lm_fsdp(params: LMParams, seeds, batch_size: int, model_size: int,
                   mesh, lr: float = LR, *, seq_len: int, n_heads: int,
-                  attn_impl: str | None = None) -> LMParams:
+                  attn_impl: str | None = None, optimizer=None,
+                  opt_state=None, return_state: bool = False):
     """FSDP/ZeRO-3 over the whole LM surface: block stacks gathered layer
     by layer (the transformer FSDP loop), the embedding/head table and
     positions gathered once per step — transiently, so peak param memory
     stays ``O(|params|/n + one layer)``. All grads come back pre-scattered
-    through the gathers' ``psum_scatter`` transposes; sharded SGD."""
+    through the gathers' ``psum_scatter`` transposes; sharded update.
+
+    With ``optimizer``, its state is created from — and lives as — the
+    LOCAL param shards: full ZeRO-3 on the LM (params, grads, AND
+    optimizer state all 1/n per device; the elementwise update needs no
+    collective)."""
     require_axes(mesh, DATA_AXIS)
     n = mesh.shape[DATA_AXIS]
     _validate_lm(batch_size, seq_len, model_size, n_heads, params)
+    check_state_args(optimizer, opt_state, return_state)
     for name, leaf in [("wte", params.wte), ("wpe", params.wpe),
                        ("ln_f", params.ln_f)]:
         if leaf.shape[0] % n:
@@ -170,7 +177,7 @@ def train_lm_fsdp(params: LMParams, seeds, batch_size: int, model_size: int,
     b = batch_size // seq_len
     vocab = params.vocab  # the global count — p.wte is a shard inside step
 
-    def step(params: LMParams, seed) -> LMParams:
+    def grads_of(params: LMParams, seed):
         tokens, targets = lm_batch_from_seed(seed, b, seq_len, vocab)
 
         def loss_fn(p: LMParams):
@@ -188,11 +195,27 @@ def train_lm_fsdp(params: LMParams, seeds, batch_size: int, model_size: int,
             return xent_loss(logits.reshape(-1, wte.shape[0]),
                              targets.reshape(-1))
 
-        grads = jax.grad(loss_fn)(params)
-        return sgd(params, grads, lr)
+        return jax.grad(loss_fn)(params)
 
-    return launch_strided(step, _shard(params, mesh, _lm_fsdp_specs()),
-                          seeds, mesh, DATA_AXIS, _lm_fsdp_specs())
+    def step(params: LMParams, seed) -> LMParams:
+        return sgd(params, grads_of(params, seed), lr)
+
+    def step_opt(carry, seed):
+        params, state = carry
+        return optimizer.update(grads_of(params, seed), state, params, lr)
+
+    sharded = _shard(params, mesh, _lm_fsdp_specs())
+    if optimizer is None:
+        return launch_strided(step, sharded, seeds, mesh, DATA_AXIS,
+                              _lm_fsdp_specs())
+    # zeros_like of the sharded params keeps their shardings: the state
+    # enters shard_map already 1/n per device; scalars replicate
+    state = optimizer.init(sharded) if opt_state is None else opt_state
+    return launch_strided(step_opt, sharded, seeds, mesh, DATA_AXIS,
+                          _lm_fsdp_specs(), state=state,
+                          state_specs=_lm_state_specs(
+                              state, _lm_fsdp_specs()),
+                          return_state=return_state)
 
 
 # ---------------------------------------------------------------------------
@@ -346,7 +369,8 @@ def train_lm_tp(params: LMParams, seeds, batch_size: int, model_size: int,
     state = optimizer.init(sharded) if opt_state is None else opt_state
     return launch(step, sharded, jnp.asarray(seeds), mesh,
                   param_specs=_lm_tp_specs(), seed_spec=P(),
-                  state=state, state_specs=_lm_state_specs(state),
+                  state=state,
+                  state_specs=_lm_state_specs(state, _lm_tp_specs()),
                   return_state=return_state)
 
 
@@ -434,11 +458,11 @@ def tp_generate(params: LMParams, prompt, n_new: int, mesh, *,
         check_vma=False))(sharded, prompt)
 
 
-def _lm_state_specs(state):
-    """Optimizer-state specs for the TP layout: param-shaped subtrees
-    (momentum velocities, Adam moments — ``LMParams`` instances) shard
-    like the params; scalar bookkeeping (step counters) replicates."""
-    specs = _lm_tp_specs()
+def _lm_state_specs(state, specs):
+    """Optimizer-state specs for a sharded-param layout: param-shaped
+    subtrees (momentum velocities, Adam moments — ``LMParams`` instances)
+    shard like the params (``specs`` — pass the caller's own layout);
+    scalar bookkeeping (step counters) replicates."""
 
     def rec(s):
         if isinstance(s, LMParams):

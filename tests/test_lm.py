@@ -250,6 +250,31 @@ def test_lm_stateful_optimizer_threads_state(mesh4):
                                    rtol=1e-5, atol=1e-7)
 
 
+def test_lm_fsdp_stateful_matches_ddp(mesh4):
+    """Full ZeRO-3 on the LM: Adam state sharded with the param shards ==
+    DDP with replicated state (the partition must not change the math),
+    and a segmented run threads the sharded state exactly."""
+    from distributed_llm_code_samples_tpu.data import make_seed_schedule
+    from distributed_llm_code_samples_tpu.optim import adam
+    params = small_lm(seed=10)
+    seeds = make_seed_schedule(8, random_seed=25)
+    kw = dict(seq_len=SEQ, n_heads=HEADS, lr=1e-2, optimizer=adam())
+    ddp = train_lm_ddp(params, seeds, 2 * SEQ, D, mesh4, **kw)
+    fsdp = train_lm_fsdp(params, seeds, 2 * SEQ, D, mesh4, **kw)
+    for got, want in zip(jax.tree_util.tree_leaves(fsdp),
+                         jax.tree_util.tree_leaves(ddp)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   **tolerances())
+    p1, s1 = train_lm_fsdp(params, seeds[:4], 2 * SEQ, D, mesh4,
+                           return_state=True, **kw)
+    p2 = train_lm_fsdp(p1, seeds[4:], 2 * SEQ, D, mesh4, opt_state=s1,
+                       **kw)
+    for got, want in zip(jax.tree_util.tree_leaves(p2),
+                         jax.tree_util.tree_leaves(fsdp)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-7)
+
+
 def test_lm_tp_stateful_matches_single(mesh_model4):
     """Megatron optimizer layout: Adam state sharded with the TP params;
     segmented TP run (state threaded) == uninterrupted single-device run
