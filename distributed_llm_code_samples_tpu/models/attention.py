@@ -95,6 +95,43 @@ def mha(q: jax.Array, k: jax.Array, v: jax.Array,
     return jax.vmap(lambda q, k, v: attention(q, k, v, causal))(q, k, v)
 
 
+def rope(x: jax.Array, positions: jax.Array,
+         base: float = 10000.0) -> jax.Array:
+    """Rotary position embedding (Su et al.): rotate each head-dim pair
+    ``(x_i, x_{i+dh/2})`` by ``pos * base^(-2i/dh)`` — attention scores
+    then depend only on *relative* position. ``x [..., T, dh]`` (``dh``
+    even), ``positions [T]`` (absolute indices; decode passes the single
+    write position). Linear in ``x``, so ``jax.vjp``'s exact transpose
+    (the inverse rotation) differentiates it — the framework's stance for
+    linear ops."""
+    dh = x.shape[-1]
+    if dh % 2:
+        raise ValueError(f"rope needs an even head dim, got {dh}")
+    half = dh // 2
+    freqs = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    ang = positions[..., :, None].astype(jnp.float32) * freqs   # [T, half]
+    cos = jnp.cos(ang).astype(x.dtype)
+    sin = jnp.sin(ang).astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin,
+                            x1 * sin + x2 * cos], axis=-1)
+
+
+def rope_mha(q: jax.Array, k: jax.Array, v: jax.Array,
+             causal: bool = True) -> jax.Array:
+    """Multi-head attention with rotary positions: rotates q and k by
+    their in-window indices (``0..T-1``) before the hand-VJP kernel.
+    Plugs into the trainers' ``attn`` hook (``attn_impl="rope"``); GQA
+    shapes (fewer k heads) compose — the rotation is per-head-pair."""
+    t = q.shape[-2]
+    pos = jnp.arange(t)
+    op = mha if q.shape[0] == k.shape[0] else gqa
+    return op(rope(q, pos), rope(k, pos), v, causal)
+
+
+rope_mha.supports_gqa = True  # handles fewer k heads (see attn_sublayer)
+
+
 def gqa(q: jax.Array, k: jax.Array, v: jax.Array,
         causal: bool = True) -> jax.Array:
     """Grouped-query attention: ``q [H, T, dh]``, ``k/v [H_kv, T, dh]``

@@ -175,7 +175,8 @@ def _decode_attn(q, ck, cv, pos):
 
 
 def cached_attn_step(ln1_l, wq_l, wk_l, wv_l, wo_l, cache_k, cache_v,
-                     layer: int, x: jax.Array, pos):
+                     layer: int, x: jax.Array, pos,
+                     use_rope: bool = False):
     """One decode attention sublayer, shared by the dense, MoE, and TP
     decode paths: LN, QKV projection of this path's (possibly
     head-sharded) weights, cache write at ``pos``, single-query attention
@@ -183,7 +184,10 @@ def cached_attn_step(ln1_l, wq_l, wk_l, wv_l, wo_l, cache_k, cache_v,
     cache_v)`` with the residual add (and, under TP, the psum) left to
     the caller — ``y_proj`` may be a partial sum over sharded heads.
     Head counts (query AND kv — GQA falls out) and head dim come from
-    the weight/cache shapes."""
+    the weight/cache shapes. ``use_rope`` rotates q and the new k by
+    ``pos`` before the cache write — the cache then stores rotated keys,
+    exactly matching training under ``attn_impl="rope"``."""
+    from .attention import rope
     b = x.shape[0]
     dh = cache_k.shape[-1]
     h_loc = wq_l.shape[0] // dh
@@ -192,6 +196,10 @@ def cached_attn_step(ln1_l, wq_l, wk_l, wv_l, wo_l, cache_k, cache_v,
     q = (a @ wq_l.T).reshape(b, h_loc, dh)
     k = (a @ wk_l.T).reshape(b, kv_loc, dh)
     v = (a @ wv_l.T).reshape(b, kv_loc, dh)
+    if use_rope:
+        p1 = jnp.asarray(pos)[None]
+        q = rope(q[:, :, None, :], p1)[:, :, 0, :]
+        k = rope(k[:, :, None, :], p1)[:, :, 0, :]
     cache_k = lax.dynamic_update_slice(
         cache_k, k[None, :, :, None, :], (layer, 0, 0, pos, 0))
     cache_v = lax.dynamic_update_slice(
@@ -201,7 +209,7 @@ def cached_attn_step(ln1_l, wq_l, wk_l, wv_l, wo_l, cache_k, cache_v,
 
 
 def decode_step(params: LMParams, cache: KVCache, token: jax.Array,
-                pos: jax.Array, n_heads: int):
+                pos: jax.Array, n_heads: int, use_rope: bool = False):
     """One token through the stack at position ``pos`` (traced scalar).
 
     ``token [B]`` int -> ``(logits [B, V], cache')``. Static shapes
@@ -218,7 +226,7 @@ def decode_step(params: LMParams, cache: KVCache, token: jax.Array,
     for l in range(p.n_layers):
         y, new_k, new_v = cached_attn_step(
             p.ln1[l], p.wq[l], p.wk[l], p.wv[l], p.wo[l],
-            new_k, new_v, l, x, pos)
+            new_k, new_v, l, x, pos, use_rope)
         x = x + y
         h = layernorm(p.ln2[l], x)
         x = x + jnp.maximum(h @ p.w1[l].T, 0.0) @ p.w2[l].T
@@ -261,19 +269,21 @@ def decode_loop(step_fn, cache, prompt: jax.Array, n_new: int,
 
 
 def _decode_loop(params: LMParams, prompt: jax.Array, n_new: int,
-                 n_heads: int, pick) -> jax.Array:
+                 n_heads: int, pick, use_rope: bool = False) -> jax.Array:
     return decode_loop(
         lambda cache, token, pos: decode_step(params, cache, token, pos,
-                                              n_heads),
+                                              n_heads, use_rope),
         init_cache(params, prompt.shape[0], n_heads), prompt, n_new,
         params.max_seq_len, pick)
 
 
 def generate(params: LMParams, prompt: jax.Array, n_new: int,
-             n_heads: int) -> jax.Array:
-    """Greedy decode: ``prompt [B, T0]`` -> ``[B, T0 + n_new]``."""
+             n_heads: int, use_rope: bool = False) -> jax.Array:
+    """Greedy decode: ``prompt [B, T0]`` -> ``[B, T0 + n_new]``.
+    ``use_rope`` must match how the model was trained
+    (``attn_impl="rope"``)."""
     return _decode_loop(params, prompt, n_new, n_heads,
-                        lambda z, pos: jnp.argmax(z, axis=-1))
+                        lambda z, pos: jnp.argmax(z, axis=-1), use_rope)
 
 
 def sample_pick(temperature: float, top_k: int, vocab: int, seed: int):
@@ -304,9 +314,9 @@ def sample_pick(temperature: float, top_k: int, vocab: int, seed: int):
 
 def sample(params: LMParams, prompt: jax.Array, n_new: int, n_heads: int,
            *, temperature: float = 1.0, top_k: int = 0,
-           seed: int = 0) -> jax.Array:
+           seed: int = 0, use_rope: bool = False) -> jax.Array:
     """Stochastic decode (see ``sample_pick``). ``top_k=0`` samples the
     full distribution; ``top_k=1`` degenerates to greedy."""
     return _decode_loop(params, prompt, n_new, n_heads,
                         sample_pick(temperature, top_k, params.vocab,
-                                    seed))
+                                    seed), use_rope)

@@ -132,7 +132,8 @@ def moe_lm_loss_aux(params: MoELMParams, tokens: jax.Array,
 
 
 def moe_decode_step(params: MoELMParams, cache, token: jax.Array,
-                    pos, n_heads: int, k: int = 1):
+                    pos, n_heads: int, k: int = 1,
+                    use_rope: bool = False):
     """One token through the MoE stack at ``pos``. ``token [B]`` ->
     ``(logits [B, V], cache')``. Expert weights for each token's top-k
     choices are gathered (``[B, k, ffn, d]``) and the gate-weighted FFNs
@@ -150,7 +151,7 @@ def moe_decode_step(params: MoELMParams, cache, token: jax.Array,
     for l in range(blk.n_layers):
         y, new_k, new_v = cached_attn_step(
             blk.ln1[l], blk.wq[l], blk.wk[l], blk.wv[l], blk.wo[l],
-            new_k, new_v, l, x, pos)
+            new_k, new_v, l, x, pos, use_rope)
         x = x + y
         h = layernorm(blk.ln2[l], x)
         # per-token routing, the training router's exact semantics
@@ -166,30 +167,34 @@ def moe_decode_step(params: MoELMParams, cache, token: jax.Array,
 
 
 def _moe_decode(params: MoELMParams, prompt, n_new: int, n_heads: int,
-                k: int, pick):
+                k: int, pick, use_rope: bool = False):
     from .lm import decode_loop, init_cache
     cache = init_cache(params, prompt.shape[0], n_heads)
     return decode_loop(
         lambda cache, token, pos: moe_decode_step(params, cache, token,
-                                                  pos, n_heads, k),
+                                                  pos, n_heads, k,
+                                                  use_rope),
         cache, prompt, n_new, params.max_seq_len, pick)
 
 
 def moe_generate(params: MoELMParams, prompt: jax.Array, n_new: int,
-                 n_heads: int, k: int = 1) -> jax.Array:
+                 n_heads: int, k: int = 1,
+                 use_rope: bool = False) -> jax.Array:
     """Greedy decode through the MoE stack: ``prompt [B, T0]`` ->
     ``[B, T0 + n_new]`` (one jitted scan, static shapes — the
-    ``models.lm.decode_loop`` contract)."""
+    ``models.lm.decode_loop`` contract). ``use_rope`` must match the
+    training ``attn_impl``."""
     return _moe_decode(params, prompt, n_new, n_heads, k,
-                       lambda z, pos: jnp.argmax(z, axis=-1))
+                       lambda z, pos: jnp.argmax(z, axis=-1), use_rope)
 
 
 def moe_sample(params: MoELMParams, prompt: jax.Array, n_new: int,
                n_heads: int, k: int = 1, *, temperature: float = 1.0,
-               top_k: int = 0, seed: int = 0) -> jax.Array:
+               top_k: int = 0, seed: int = 0,
+               use_rope: bool = False) -> jax.Array:
     """Stochastic decode through the MoE stack — the dense sampler's
     exact contract (``models.lm.sample_pick``) over the routed stack."""
     from .lm import sample_pick
     return _moe_decode(params, prompt, n_new, n_heads, k,
                        sample_pick(temperature, top_k, params.vocab,
-                                   seed))
+                                   seed), use_rope)

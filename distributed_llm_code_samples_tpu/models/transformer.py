@@ -114,8 +114,8 @@ def attn_sublayer(wq, wk, wv, wo, a: jax.Array, n_heads: int,
     v = split_heads(a @ wv.T, n_kv)
     if attn is None:
         op = mha if n_kv == n_heads else gqa
-    elif n_kv != n_heads:
-        raise ValueError("custom attn impls expect full-MHA shapes; "
+    elif n_kv != n_heads and not getattr(attn, "supports_gqa", False):
+        raise ValueError("this attn impl expects full-MHA shapes; "
                          f"got {n_heads} query vs {n_kv} kv heads")
     else:
         op = attn

@@ -375,7 +375,7 @@ def train_lm_tp(params: LMParams, seeds, batch_size: int, model_size: int,
 
 
 def tp_generate(params: LMParams, prompt, n_new: int, mesh, *,
-                n_heads: int) -> jax.Array:
+                n_heads: int, use_rope: bool = False) -> jax.Array:
     """Megatron-sharded greedy decode: the KV cache shards over **heads**
     on the model axis (each shard caches and attends its own ``H/n``
     heads — the inference memory win: cache bytes per chip drop 1/n),
@@ -413,7 +413,7 @@ def tp_generate(params: LMParams, prompt, n_new: int, mesh, *,
         for l in range(blk.w1.shape[0]):
             y, new_k, new_v = cached_attn_step(
                 blk.ln1[l], blk.wq[l], blk.wk[l], blk.wv[l], blk.wo[l],
-                new_k, new_v, l, x, pos)                    # local heads
+                new_k, new_v, l, x, pos, use_rope)          # local heads
             x = x + all_reduce(y, MODEL_AXIS)                # Megatron g
             h = layernorm(blk.ln2[l], x)
             x = x + all_reduce(

@@ -110,15 +110,20 @@ def resolve_attn(attn_impl: str | None):
     """Map an ``attn_impl`` name to the multi-head attention op the model
     plugs in (``models.transformer.attn_sublayer``): None/"oracle" = the
     quadratic hand-VJP ``mha``; "flash" = the fused Pallas kernels
-    (interpret mode automatically off-TPU), custom-VJP'd end to end."""
+    (interpret mode automatically off-TPU), custom-VJP'd end to end;
+    "rope" = rotary positions applied to q/k before the hand-VJP kernel
+    (GQA shapes compose)."""
     if attn_impl in (None, "oracle"):
         return None
     if attn_impl == "flash":
         from ..ops.pallas_attention import flash_mha
         interpret = jax.default_backend() != "tpu"
         return lambda q, k, v, causal: flash_mha(q, k, v, causal, interpret)
+    if attn_impl == "rope":
+        from ..models.attention import rope_mha
+        return rope_mha
     raise ValueError(f"unknown attn_impl {attn_impl!r} "
-                     "(expected 'oracle' or 'flash')")
+                     "(expected 'oracle', 'flash', or 'rope')")
 
 
 def _make_single_step(tokens: int, model_size: int, seq_len: int,

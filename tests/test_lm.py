@@ -454,6 +454,79 @@ def test_gqa_decode_matches_full_forward_and_shrinks_cache():
     np.testing.assert_array_equal(np.asarray(got), toks)
 
 
+# --- rotary positions -------------------------------------------------------
+
+
+def test_rope_scores_are_relative():
+    """RoPE's defining property: shifting every absolute position by a
+    constant leaves the attention output unchanged (scores depend only on
+    position differences)."""
+    from distributed_llm_code_samples_tpu.models.attention import mha, rope
+    key = jax.random.PRNGKey(31)
+    q, k, v = (jax.random.normal(jax.random.fold_in(key, i), (2, 8, 8))
+               for i in range(3))
+    pos = jnp.arange(8)
+    base_out = mha(rope(q, pos), rope(k, pos), v, True)
+    shifted = mha(rope(q, pos + 17), rope(k, pos + 17), v, True)
+    np.testing.assert_allclose(np.asarray(base_out), np.asarray(shifted),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_rope_training_and_decode_agree():
+    """An LM trained with attn_impl='rope' decodes (use_rope=True)
+    exactly like its teacher-forced argmax — the cache stores rotated
+    keys matching the training rotation. Also composes with GQA."""
+    from distributed_llm_code_samples_tpu.models.attention import rope_mha
+    params = init_lm(jax.random.PRNGKey(33), V, D, L, TMAX,
+                     n_heads=HEADS, n_kv_heads=2)
+    seeds = jnp.full((8,), 55, jnp.int32)
+    trained = train_lm_single(params, seeds, 2 * SEQ, D, lr=0.3,
+                              seq_len=SEQ, n_heads=HEADS,
+                              attn_impl="rope")
+    # training moved the params on the rope path
+    assert not np.allclose(np.asarray(trained.blocks.wq),
+                           np.asarray(params.blocks.wq))
+    prompt = jax.random.randint(jax.random.PRNGKey(34), (2, 3), 0, V)
+    got = generate(trained, prompt, 4, HEADS, use_rope=True)
+    toks = np.asarray(prompt)
+    for _ in range(4):
+        logits = lm_logits(trained, jnp.asarray(toks), HEADS,
+                           attn=rope_mha)
+        nxt = np.asarray(jnp.argmax(logits[:, -1], axis=-1))
+        toks = np.concatenate([toks, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(got), toks)
+
+
+def test_rope_tp_decode_matches_dense(mesh_model4):
+    """tp_generate(use_rope=True) on a rope-trained full-MHA model ==
+    the dense rope decode, token for token."""
+    from distributed_llm_code_samples_tpu.parallel import tp_generate
+    params = small_lm(seed=14)
+    seeds = jnp.full((4,), 77, jnp.int32)
+    trained = train_lm_single(params, seeds, 2 * SEQ, D, lr=0.3,
+                              seq_len=SEQ, n_heads=HEADS,
+                              attn_impl="rope")
+    prompt = jax.random.randint(jax.random.PRNGKey(35), (2, 3), 0, V)
+    want = generate(trained, prompt, 4, HEADS, use_rope=True)
+    got = tp_generate(trained, prompt, 4, mesh_model4, n_heads=HEADS,
+                      use_rope=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_rope_changes_the_math():
+    """rope vs learned-only positions give different trainings (the
+    rotation actually applies)."""
+    from distributed_llm_code_samples_tpu.data import make_seed_schedule
+    params = small_lm(seed=13)
+    seeds = make_seed_schedule(2, random_seed=45)
+    kw = dict(seq_len=SEQ, n_heads=HEADS, lr=0.1)
+    plain = train_lm_single(params, seeds, 2 * SEQ, D, **kw)
+    roped = train_lm_single(params, seeds, 2 * SEQ, D,
+                            attn_impl="rope", **kw)
+    assert not np.allclose(np.asarray(plain.blocks.wq),
+                           np.asarray(roped.blocks.wq))
+
+
 # --- decode ----------------------------------------------------------------
 
 
