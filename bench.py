@@ -237,7 +237,10 @@ def main():
     warm = make_seed_schedule(TIMED_STEPS, random_seed=1)
     timed = make_seed_schedule(TIMED_STEPS, random_seed=2)
 
-    reps = int(os.environ.get("BENCH_REPS", 3))
+    # best-of-5: the relay's run-to-run jitter is ~±1.5%, comparable to
+    # the true ours-vs-naive gap at this MXU-saturated shape — more reps
+    # tighten both bests toward their real ceilings
+    reps = int(os.environ.get("BENCH_REPS", 5))
 
     def measure(run_fn, p0):
         out = run_fn(p0, warm)  # compile + warm

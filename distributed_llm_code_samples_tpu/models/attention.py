@@ -122,7 +122,12 @@ def rope_mha(q: jax.Array, k: jax.Array, v: jax.Array,
     """Multi-head attention with rotary positions: rotates q and k by
     their in-window indices (``0..T-1``) before the hand-VJP kernel.
     Plugs into the trainers' ``attn`` hook (``attn_impl="rope"``); GQA
-    shapes (fewer k heads) compose — the rotation is per-head-pair."""
+    shapes (fewer k heads) compose — the rotation is per-head-pair.
+
+    Note: the relative-position property holds for this op; the LM
+    family still adds its learned absolute embeddings (``wpe``) to the
+    residual stream, so a rope-trained LM is rotary-IN-ATTENTION layered
+    on learned positions, not relative-only."""
     t = q.shape[-2]
     pos = jnp.arange(t)
     op = mha if q.shape[0] == k.shape[0] else gqa

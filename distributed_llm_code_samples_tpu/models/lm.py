@@ -278,7 +278,7 @@ def _decode_loop(params: LMParams, prompt: jax.Array, n_new: int,
 
 
 def generate(params: LMParams, prompt: jax.Array, n_new: int,
-             n_heads: int, use_rope: bool = False) -> jax.Array:
+             n_heads: int, *, use_rope: bool = False) -> jax.Array:
     """Greedy decode: ``prompt [B, T0]`` -> ``[B, T0 + n_new]``.
     ``use_rope`` must match how the model was trained
     (``attn_impl="rope"``)."""

@@ -98,11 +98,12 @@ def moe_lm_logits(params: MoELMParams, tokens: jax.Array, n_heads: int,
                   causal: bool = True,
                   capacity_factor: float | None = None,
                   k: int | None = None,
-                  capacity: int | None = None) -> jax.Array:
+                  capacity: int | None = None, attn=None) -> jax.Array:
     """``tokens [B, T]`` -> logits ``[B, T, V]`` (teacher-forced full
-    forward through the MoE stack; the decode oracle)."""
+    forward through the MoE stack; the decode oracle). ``attn`` swaps
+    the attention op (e.g. ``rope_mha``)."""
     h, _ = moe_lm_hidden_aux(params, tokens, n_heads, causal,
-                             capacity_factor, k, capacity)
+                             capacity_factor, k, capacity, attn=attn)
     return h @ params.wte.T
 
 
@@ -178,7 +179,7 @@ def _moe_decode(params: MoELMParams, prompt, n_new: int, n_heads: int,
 
 
 def moe_generate(params: MoELMParams, prompt: jax.Array, n_new: int,
-                 n_heads: int, k: int = 1,
+                 n_heads: int, k: int = 1, *,
                  use_rope: bool = False) -> jax.Array:
     """Greedy decode through the MoE stack: ``prompt [B, T0]`` ->
     ``[B, T0 + n_new]`` (one jitted scan, static shapes — the

@@ -105,6 +105,28 @@ def test_moe_sample_topk1_is_greedy(params):
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_moe_rope_decode_matches_teacher_forced(params):
+    """A rope-trained MoE LM decodes (use_rope=True) exactly like its
+    teacher-forced argmax — pins the MoE use_rope plumbing the dense/TP
+    paths already pin for theirs."""
+    from distributed_llm_code_samples_tpu.models import (moe_generate,
+                                                         moe_lm_logits)
+    from distributed_llm_code_samples_tpu.models.attention import rope_mha
+    seeds = jnp.full((4,), 88, jnp.int32)
+    trained = train_moe_lm_dense(params, seeds, 2 * SEQ, D, lr=0.3,
+                                 seq_len=SEQ, n_heads=HEADS,
+                                 attn_impl="rope")
+    prompt = jax.random.randint(jax.random.PRNGKey(19), (2, 3), 0, V)
+    got = moe_generate(trained, prompt, 4, HEADS, use_rope=True)
+    toks = np.asarray(prompt)
+    for _ in range(4):
+        logits = moe_lm_logits(trained, jnp.asarray(toks), HEADS,
+                               capacity=2 * SEQ, attn=rope_mha)
+        nxt = np.asarray(jnp.argmax(logits[:, -1], axis=-1))
+        toks = np.concatenate([toks, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(got), toks)
+
+
 def test_moe_lm_validates_max_seq(params):
     seeds = make_seed_schedule(1, random_seed=1)
     with pytest.raises(ValueError, match="max_seq_len"):
