@@ -217,6 +217,17 @@ def main(argv=None) -> int:
         print(f"error: --heads {args.heads} not divisible by "
               f"--kv_heads {args.kv_heads}", file=sys.stderr)
         return 2
+    if args.kv_heads and args.method in (9, 11):
+        # the companion constraint the help text promises ("the model-axis
+        # size must divide it"): mirrored up front so e.g. MQA
+        # (--kv_heads 1) with the default --tp 2 exits 2 cleanly instead
+        # of dying mid-run in _validate_tp's ValueError traceback
+        tp_n = min(args.tp, jax.device_count())
+        if tp_n > 1 and args.kv_heads % tp_n:
+            print(f"error: --kv_heads {args.kv_heads} not divisible by "
+                  f"the model-axis size {tp_n} (min(--tp, devices)) "
+                  f"required by --method {args.method}", file=sys.stderr)
+            return 2
     if (args.zero1 and args.optimizer != "sgd" and args.checkpoint_dir
             and args.checkpoint_every):
         # ZeRO-1's per-rank state shards have no opt_state surface yet;

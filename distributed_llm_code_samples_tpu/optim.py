@@ -116,16 +116,40 @@ def adam(b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8) -> Optimizer:
 
 
 def adamw(b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
-          weight_decay: float = 1e-2) -> Optimizer:
+          weight_decay: float = 1e-2, decay_mask=None) -> Optimizer:
     """AdamW (Loshchilov & Hutter): Adam with *decoupled* weight decay —
     the decay applies directly to the params (``p -= lr * wd * p``),
     never entering the moment estimates (the difference from L2-in-loss
-    that makes it "decoupled")."""
+    that makes it "decoupled").
+
+    ``decay_mask`` selects which leaves decay (``leaf -> bool``). The
+    default is the standard LLM recipe: matmul weights and embedding
+    tables decay; LayerNorm gains (initialized at 1) and biases do not —
+    decaying norm gains toward 0 degrades training at scale. Because this
+    framework stacks per-layer leaves with a leading layer dim (a block's
+    ``ln1`` gain is ``[L, d]``, 2-D), a pure ndim test can't see gains:
+    the default mask is *path-aware* — a leaf decays iff ``ndim >= 2``
+    AND its field name doesn't mark it as a norm gain or bias
+    (``ln*``/``bias``/``gain``/``scale``). Pass
+    ``decay_mask=lambda p: True`` for uniform decay (optax's unmasked
+    ``adamw``), or any custom per-leaf predicate."""
     base = adam(b1, b2, eps)
 
+    def _default_decays(path, p) -> bool:
+        entry = path[-1] if path else None
+        name = str(getattr(entry, "name", getattr(entry, "key", "")))
+        return (p.ndim >= 2 and not name.startswith("ln")
+                and name not in ("bias", "gain", "scale"))
+
     def update(grads, state, params, lr):
-        params = jax.tree_util.tree_map(
-            lambda p: p * (1.0 - lr * weight_decay), params)
+        factor = 1.0 - lr * weight_decay
+        if decay_mask is None:
+            params = jax.tree_util.tree_map_with_path(
+                lambda path, p: p * factor if _default_decays(path, p)
+                else p, params)
+        else:
+            params = jax.tree_util.tree_map(
+                lambda p: p * factor if decay_mask(p) else p, params)
         return base.update(grads, state, params, lr)
 
     return Optimizer(init=base.init, update=update,

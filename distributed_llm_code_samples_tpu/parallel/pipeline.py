@@ -474,7 +474,7 @@ def make_lm_pp_step(batch_size: int, model_size: int, seq_len: int,
                     n_heads: int, vocab: int, n_stages: int,
                     n_microbatches: int, lr: float = LR,
                     axis: str = PIPE_AXIS, schedule: str = "gpipe",
-                    data_axis: str | None = None):
+                    data_axis: str | None = None, attn=None):
     """One LM-PP step for one stage: the full language model pipelined —
     embedding on stage 0, transformer-block stages along the ring, tied
     head + REAL cross-entropy on the last stage. Runs under both
@@ -519,7 +519,7 @@ def make_lm_pp_step(batch_size: int, model_size: int, seq_len: int,
         for l in range(p.blocks.ln1.shape[0]):
             acts.append(x)
             x = transformer_block(
-                *(leaf[l] for leaf in p.blocks), x, n_heads)
+                *(leaf[l] for leaf in p.blocks), x, n_heads, attn=attn)
         return x, (jnp.stack(acts), x)   # block inputs + stage output
 
     def step(params: LMParams, seed) -> LMParams:
@@ -559,7 +559,8 @@ def make_lm_pp_step(batch_size: int, model_size: int, seq_len: int,
             for l in reversed(range(p.blocks.ln1.shape[0])):
                 leaves = tuple(leaf[l] for leaf in p.blocks)
                 _, vjp = jax.vjp(
-                    lambda lv, xx: transformer_block(*lv, xx, n_heads),
+                    lambda lv, xx: transformer_block(*lv, xx, n_heads,
+                                                     attn=attn),
                     leaves, block_inputs[l])
                 dleaves, dy = vjp(dy)
                 bgrads = type(p.blocks)(*(
@@ -600,7 +601,7 @@ def make_lm_pp_step(batch_size: int, model_size: int, seq_len: int,
 def train_lm_pp(params, seeds, batch_size: int, model_size: int, mesh,
                 lr: float = LR, *, seq_len: int, n_heads: int,
                 n_microbatches: int | None = None,
-                schedule: str = "gpipe"):
+                schedule: str = "gpipe", attn_impl: str | None = None):
     """Pipeline the full LM over the ``"pipe"`` ring (embedding on stage
     0, blocks staged, tied head + real loss on the last stage); a
     ``data`` axis composes DDP. Pipe-only equals the single-device LM
@@ -631,9 +632,11 @@ def train_lm_pp(params, seeds, batch_size: int, model_size: int, mesh,
     sharded = reshard_copy(params, jax.tree_util.tree_map(
         lambda spec: NamedSharding(mesh, spec), specs,
         is_leaf=lambda v: isinstance(v, P)))
+    from .transformer import resolve_attn
     step = make_lm_pp_step(batch_size, model_size, seq_len, n_heads,
                            params.vocab, S, M, lr, schedule=schedule,
-                           data_axis=DATA_AXIS if dp > 1 else None)
+                           data_axis=DATA_AXIS if dp > 1 else None,
+                           attn=resolve_attn(attn_impl))
     if dp > 1:
         return launch_strided(step, sharded, seeds, mesh, DATA_AXIS, specs)
     return launch(step, sharded, jnp.asarray(seeds), mesh,

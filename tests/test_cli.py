@@ -61,6 +61,12 @@ def test_cli_lm_gqa():
     r = _run_cli("-s", "2", "-m", "2", "--kv_heads", "2",
                  "--fake_devices", "4")
     assert r.returncode == 2 and "--kv_heads" in r.stderr
+    # MQA (--kv_heads 1) with a >1 model axis: clean exit-2 arg error up
+    # front, not _validate_tp's mid-run ValueError traceback
+    r = _run_cli("-s", "2", "-m", "11", "--kv_heads", "1", "--heads", "4",
+                 "--tp", "2", "--fake_devices", "4", "--vocab", "64")
+    assert r.returncode == 2 and "model-axis" in r.stderr
+    assert "Traceback" not in r.stderr
 
 
 @pytest.mark.slow

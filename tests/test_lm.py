@@ -608,6 +608,34 @@ def test_lm_pp_matches_single(schedule):
                                        err_msg=f"M={m}")
 
 
+def test_lm_pp_attn_impl_matches_single():
+    """attn_impl threads through the LM pipeline path (every other LM
+    trainer already accepts it): PP with rope == single with rope — a
+    rope-trained LM can be continued/reproduced under PP."""
+    from distributed_llm_code_samples_tpu.data import make_seed_schedule
+    from distributed_llm_code_samples_tpu.parallel import (
+        PIPE_AXIS, make_mesh, train_lm_pp)
+    params = init_lm(jax.random.PRNGKey(21), V, D, 2, TMAX)
+    seeds = make_seed_schedule(2, random_seed=37)
+    b = 4
+    single = train_lm_single(params, seeds, b * SEQ, D, lr=0.05,
+                             seq_len=SEQ, n_heads=HEADS,
+                             attn_impl="rope")
+    got = train_lm_pp(params, seeds, b * SEQ, D,
+                      make_mesh({PIPE_AXIS: 2}), lr=0.05, seq_len=SEQ,
+                      n_heads=HEADS, attn_impl="rope")
+    for a, w in zip(jax.tree_util.tree_leaves(got),
+                    jax.tree_util.tree_leaves(single)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(w),
+                                   rtol=2e-4, atol=1e-5)
+    # and it really is rope: differs from the oracle-attention PP run
+    plain = train_lm_pp(params, seeds, b * SEQ, D,
+                        make_mesh({PIPE_AXIS: 2}), lr=0.05, seq_len=SEQ,
+                        n_heads=HEADS)
+    assert not np.allclose(np.asarray(got.blocks.wq),
+                           np.asarray(plain.blocks.wq))
+
+
 def test_lm_pp_composes_with_data(mesh4):
     """data x pipe on the LM == LM DDP over the data axis alone."""
     from distributed_llm_code_samples_tpu.data import make_seed_schedule

@@ -94,6 +94,59 @@ def test_adamw_matches_optax(setup):
                                rtol=1e-5, atol=1e-6)
 
 
+def test_adamw_decay_mask_matches_optax_masked(setup):
+    # the default mask (ndim >= 2) skips 1-D leaves — LayerNorm gains and
+    # biases — exactly optax.adamw with the same mask
+    import optax
+    from distributed_llm_code_samples_tpu.optim import adamw
+    params = {"w": jax.random.normal(jax.random.PRNGKey(0), (8, 8)),
+              "gain": jnp.ones((8,))}
+    ks = jax.random.split(jax.random.PRNGKey(5), 6)
+    gs = [{"w": jax.random.normal(ks[2 * i], (8, 8)),
+           "gain": jax.random.normal(ks[2 * i + 1], (8,))}
+          for i in range(3)]
+    ours = _run_opt(adamw(weight_decay=0.05), params, gs, 1e-2)
+    ref = _optax_trajectory(
+        optax.adamw(1e-2, weight_decay=0.05,
+                    mask=lambda tree: jax.tree_util.tree_map(
+                        lambda p: p.ndim >= 2, tree)),
+        params, gs, 1e-2)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(ours[k]), np.asarray(ref[k]),
+                                   rtol=1e-5, atol=1e-6)
+    # and the gain leaf really is decay-free: it differs from a uniform
+    # decay run
+    uniform = _run_opt(adamw(weight_decay=0.05,
+                             decay_mask=lambda p: True), params, gs, 1e-2)
+    assert not np.allclose(np.asarray(ours["gain"]),
+                           np.asarray(uniform["gain"]))
+
+
+def test_adamw_stacked_norm_gains_not_decayed():
+    """The framework stacks per-layer leaves ([L, d] norm gains — 2-D!):
+    the default mask must exempt them by field name, not ndim. Pinned on
+    a real TransformerParams tree against optax with the same named
+    mask."""
+    import optax
+    from distributed_llm_code_samples_tpu.models import init_transformer
+    from distributed_llm_code_samples_tpu.optim import adamw
+    params = init_transformer(jax.random.PRNGKey(2), 16, 2)
+    ks = jax.random.split(jax.random.PRNGKey(9), 3)
+    gs = [jax.tree_util.tree_map(
+        lambda p, i=i: jax.random.normal(
+            jax.random.fold_in(ks[i], p.size), p.shape), params)
+        for i in range(3)]
+    ours = _run_opt(adamw(weight_decay=0.05), params, gs, 1e-2)
+    mask = type(params)(ln1=False, wq=True, wk=True, wv=True, wo=True,
+                        ln2=False, w1=True, w2=True)
+    ref = _optax_trajectory(
+        optax.adamw(1e-2, weight_decay=0.05, mask=mask), params, gs, 1e-2)
+    for a, b in zip(jax.tree_util.tree_leaves(ours),
+                    jax.tree_util.tree_leaves(ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
 def test_clipped_matches_optax_chain(setup):
     import optax
     from distributed_llm_code_samples_tpu.optim import clipped
