@@ -51,11 +51,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="model-axis size for --method 5 and 8")
     p.add_argument("--microbatches", type=int, default=0,
                    help="pipeline microbatches for --method 6 (0 = n_stages)")
-    p.add_argument("--pp_schedule", choices=["gpipe", "1f1b"],
+    p.add_argument("--pp_schedule",
+                   choices=["gpipe", "1f1b", "interleaved"],
                    default="gpipe",
                    help="pipeline schedule for --method 6: gpipe (two "
-                        "wavefronts, stash of M microbatches) or 1f1b "
-                        "(interleaved, stash bounded by stage depth)")
+                        "wavefronts, stash of M microbatches), 1f1b "
+                        "(f/b interleave, stash bounded by stage depth), "
+                        "or interleaved (Megatron virtual stages: "
+                        "--pp_chunks non-contiguous layer chunks per "
+                        "device, bubble cut by 1/chunks)")
+    p.add_argument("--pp_chunks", type=int, default=0,
+                   help="virtual-stage chunks per device for "
+                        "--pp_schedule interleaved (0 = 2; stages x "
+                        "chunks must divide --layers)")
     p.add_argument("--pp_family", choices=["ffn", "transformer", "lm"],
                    default="ffn",
                    help="model family for --method 6: the reference's FFN "
@@ -180,6 +188,25 @@ def main(argv=None) -> int:
     if args.zero1 and args.method != 2:
         print("error: --zero1 applies to --method 2 only", file=sys.stderr)
         return 2
+    if args.pp_chunks and not (args.method == 6
+                               and args.pp_schedule == "interleaved"):
+        print("error: --pp_chunks applies to --method 6 with "
+              "--pp_schedule interleaved only", file=sys.stderr)
+        return 2
+    if args.pp_chunks < 0:
+        print(f"error: --pp_chunks must be >= 0 (got {args.pp_chunks})",
+              file=sys.stderr)
+        return 2
+    if args.method == 6 and args.pp_schedule == "interleaved":
+        # mirror train_pp's chunking check up front: exit 2 with a clean
+        # message instead of the trainer's ValueError traceback
+        chunks = args.pp_chunks or 2
+        stages = jax.device_count()
+        if args.layers % (stages * chunks):
+            print(f"error: --layers {args.layers} not divisible into "
+                  f"{stages} stages x {chunks} chunks "
+                  f"(--pp_schedule interleaved)", file=sys.stderr)
+            return 2
     if args.pp_family != "ffn" and args.method != 6:
         # methods 0/9 verify PP against the FFN single-device oracle
         print("error: --pp_family applies to --method 6 only",
@@ -358,6 +385,8 @@ def main(argv=None) -> int:
             name, fn = "train_tp_sp", train_tp_sp
         if m == 6:
             kwargs = dict(lr=lr, schedule=args.pp_schedule)
+            if args.pp_schedule == "interleaved":
+                kwargs["interleave"] = args.pp_chunks or 2
             if args.microbatches:
                 kwargs["n_microbatches"] = args.microbatches
             if args.pp_family == "transformer":

@@ -10,7 +10,7 @@ way: one SPMD program over a ``("pipe",)`` mesh axis where every stage
 runs the same code and neighbor transfer is ``lax.ppermute`` over the ICI
 ring (``collectives.ring_shift``) — the XLA lowering of NCCL send/recv.
 
-Two schedules, selected by ``schedule=``:
+Three schedules, selected by ``schedule=``:
 
 **"gpipe"** (default): all ``M`` forwards wave through the ring
 (``M + S - 1`` ticks), then all backwards in reverse. At tick ``t`` stage
@@ -30,6 +30,17 @@ live ``2(S - s) - 1`` slots, so the stash is a circular buffer of depth
 not the microbatch count, which is the whole point of 1F1B: with
 ``M >> S`` the GPipe stash grows linearly while this one is constant
 (pinned by a structural test on the traced program's buffer shapes).
+
+**"interleaved"**: Megatron virtual stages — each device holds
+``interleave`` non-contiguous layer chunks placed round-robin
+(virtual stage ``q = c*S + d`` on device ``d``), so every
+virtual-stage hop is ``+1`` on the ring and a wavefront over all
+``v*S`` virtual stages packs with NO per-chunk conflicts. The fill
+costs ``(S-1)/v`` of a stage's work instead of ``S-1``: bubble
+fraction ``(S-1)/(v*M + S - 1)``, the ~1/v Megatron reduction
+(see ``_interleaved_step``). This schedule buys bubble; "1f1b" buys
+memory. All three families (FFN / transformer / LM) run it, with the
+LM's embed/head roles gated on *virtual* stage ends.
 
 Every slot moves both streams: activation ``+1`` and gradient ``-1``
 ring shifts. Stage 0 injects inputs, the last stage injects
@@ -82,7 +93,7 @@ PARAM_SPECS = FFNStackParams(w1=P(PIPE_AXIS, None, None),
 PARAM_SPECS_TP = FFNStackParams(w1=P(PIPE_AXIS, MODEL_AXIS, None),
                                 w2=P(PIPE_AXIS, None, MODEL_AXIS))
 
-SCHEDULES = ("gpipe", "1f1b")
+SCHEDULES = ("gpipe", "1f1b", "interleaved")
 
 
 def shard_params(params: FFNStackParams, mesh,
@@ -266,16 +277,194 @@ def _1f1b_step(params, x_mb, dy_mb, s, M: int, S: int,
     return grads
 
 
+def interleave_perm(n_layers: int, n_stages: int, v: int) -> list:
+    """Device-major layer order for the interleaved schedule: canonical
+    layer ``l`` lives in virtual stage ``q = l // Lc`` (chunk ``c = q //
+    S`` of device ``d = q % S``). The returned ``perm`` satisfies
+    ``new[j] = old[perm[j]]`` and groups each device's ``v``
+    non-contiguous chunks contiguously (``[S, v, Lc]`` order), so the
+    standard contiguous ``P(PIPE_AXIS, ...)`` sharding lands chunk ``c``
+    of device ``d`` exactly where the schedule's ``[v, Lc]`` local view
+    expects it. ``argsort(perm)`` inverts it."""
+    lc = n_layers // (n_stages * v)
+    perm = []
+    for d in range(n_stages):
+        for c in range(v):
+            q = c * n_stages + d
+            perm.extend(range(q * lc, (q + 1) * lc))
+    return perm
+
+
+def _interleave_apply(tree, n_layers: int, S: int, V: int):
+    """Validate the chunking and permute ``tree``'s stacked leaves into
+    device-major order; returns ``(permuted_tree, perm)``. Shared by all
+    three family trainers so the checks/permutation can't drift."""
+    if V < 1:
+        raise ValueError(f"interleave must be >= 1, got {V}")
+    if n_layers % (S * V):
+        raise ValueError(f"{n_layers} layers not divisible into {S} "
+                         f"stages x {V} virtual chunks")
+    idx = jnp.asarray(interleave_perm(n_layers, S, V))
+    return jax.tree_util.tree_map(lambda w: w[idx], tree), idx
+
+
+def _interleave_restore(tree, perm):
+    """Invert ``_interleave_apply`` on the trained output."""
+    inv = jnp.argsort(perm)
+    return jax.tree_util.tree_map(lambda w: w[inv], tree)
+
+
+def _interleaved_step(params, x_mb, dy_mb, s, M: int, S: int, V: int,
+                      axis: str, vary_axes, chunk_fwd, chunk_bwd,
+                      is_static=None):
+    """Megatron-style interleaved virtual stages: each device holds ``V``
+    non-contiguous layer chunks (virtual stage ``q = c*S + d`` on device
+    ``d``), so the round-robin placement makes EVERY virtual-stage
+    transition a ``+1`` ring hop — including the wrap from device
+    ``S-1``'s chunk ``c`` to device 0's chunk ``c+1``. A wavefront over
+    the ``V*S`` virtual stages then packs perfectly: device ``d``
+    forwards microbatch ``m = g*S + r`` through chunk ``c`` at slot
+    ``t = g*V*S + c*S + d + r`` — one chunk compute per slot, busy for
+    ``V*S`` consecutive slots per microbatch group of ``S``. The fill
+    cost is ``S - 1`` *chunk*-slots (each ``1/V`` of a stage's work)
+    instead of GPipe's ``S - 1`` stage-slots: the pipeline bubble
+    shrinks by ``1/V`` — fraction ``(S-1)/(V*M + S - 1)`` versus GPipe's
+    ``(S-1)/(M + S - 1)`` (Megatron-LM's interleaved-schedule result,
+    Narayanan et al. 2021, built lockstep/SPMD here instead of with
+    per-rank NCCL streams).
+
+    The backward phase mirrors it exactly (reversed chain, ``-1`` hops):
+    bwd of chunk ``c`` on device ``d`` at slot ``g*V*S + (V-1-c)*S +
+    (S-1-d) + r``. Memory: the stash holds all ``[V, M]`` chunk
+    activations (= GPipe's M stage-activations); this schedule buys
+    bubble, ``"1f1b"`` buys memory — both compose with data/model axes.
+    Weight grads accumulate per chunk (``.at[c].add``) and never cross
+    stages (``train_ffns.py:311-312`` locality).
+
+    ``is_static(path) -> bool`` marks leaves that are NOT layer-stacked
+    (the LM's ``wte``/``wpe``/``ln_f``): they pass to every chunk whole,
+    and their grads accumulate unchunked. Chunk-role gating (the LM's
+    head on the last virtual stage, embed on the first) lives in the
+    family's ``chunk_bwd`` via its 5th argument — the chunk index."""
+    x_shape, dtype = x_mb.shape[1:], x_mb.dtype
+    P_ = V * S
+    # last valid forward slot: microbatch M-1 (group g0, offset r0)
+    # through the last virtual stage (c = V-1, d = S-1)
+    g0, r0 = (M - 1) // S, (M - 1) % S
+    ticks = g0 * P_ + (V - 1) * S + (S - 1) + r0 + 1
+    static = is_static if is_static is not None else (lambda path: False)
+    tmap = jax.tree_util.tree_map_with_path
+
+    def vary(tree):
+        return _vary_tree(tree, vary_axes)
+
+    # local chunked view of the device-major layer axis: [V*Lc] -> [V, Lc]
+    cparams = tmap(
+        lambda p, w: w if static(p)
+        else w.reshape((V, w.shape[0] // V) + w.shape[1:]), params)
+
+    def chunk_at(c):
+        return tmap(lambda p, w: w if static(p) else w[c], cparams)
+
+    def fwd_coords(t):
+        k = t - s  # traced: s = axis_index; jnp //,% are floor/Python-mod,
+        g, rem = k // P_, k % P_  # so k < 0 yields m < 0 => invalid
+        c, r = rem // S, rem % S
+        m = g * S + r
+        valid = (k >= 0) & (m >= 0) & (m < M)
+        return valid, jnp.clip(c, 0, V - 1), jnp.clip(m, 0, M - 1)
+
+    def bwd_coords(u):
+        k = u - (S - 1 - s)  # mirrored chain: chunk V-1-ch, device S-1-d
+        g, rem = k // P_, k % P_
+        ch, r = rem // S, rem % S
+        m = g * S + r
+        valid = (k >= 0) & (m >= 0) & (m < M)
+        return valid, jnp.clip(V - 1 - ch, 0, V - 1), jnp.clip(m, 0, M - 1)
+
+    acts_struct = jax.eval_shape(lambda p, x: chunk_fwd(p, x)[1],
+                                 chunk_at(0), x_mb[0])
+    stash = jax.tree_util.tree_map(
+        lambda l: _vzeros((V, M) + l.shape, l.dtype, vary_axes),
+        acts_struct)
+
+    # ---- forward wavefront over the V*S virtual stages ----
+    state = _vzeros(x_shape, dtype, vary_axes)
+    for t in range(ticks):
+        valid, c, m = fwd_coords(t)
+        # virtual stage 0 (chunk 0 of device 0) injects fresh microbatches
+        inp = jnp.where((s == 0) & (c == 0), x_mb[m], state)
+
+        def fwd_branch(stash):
+            y, acts = chunk_fwd(chunk_at(c), inp)
+            stash = jax.tree_util.tree_map(
+                lambda st, a: st.at[c, m].set(a), stash, acts)
+            return vary((stash, y))
+
+        def fwd_idle(stash):
+            return stash, _vzeros(x_shape, dtype, vary_axes)
+
+        stash, y = lax.cond(valid, fwd_branch, fwd_idle, stash)
+        state = ring_shift(y, axis, shift=1)
+
+    stash = barrier(stash, axis)  # the inter-phase fence (as in GPipe)
+
+    # ---- backward wavefront: mirrored chain, grads stream -1 ----
+    dstate = _vzeros(x_shape, dtype, vary_axes)
+    grads = _grad_zeros(cparams, vary_axes)
+    for u in range(ticks):
+        valid, c, m = bwd_coords(u)
+        # the LAST virtual stage (chunk V-1 of device S-1) injects dloss
+        dy_in = jnp.where((s == S - 1) & (c == V - 1), dy_mb[m], dstate)
+
+        def bwd_branch(grads):
+            dx, dg = chunk_bwd(
+                dy_in, chunk_at(c),
+                jax.tree_util.tree_map(lambda st: st[c, m], stash), m, c)
+            grads = tmap(
+                lambda p, acc, g: acc + g if static(p)
+                else acc.at[c].add(g), grads, dg)
+            return vary((grads, dx))
+
+        def bwd_idle(grads):
+            return grads, _vzeros(x_shape, dtype, vary_axes)
+
+        grads, dx = lax.cond(valid, bwd_branch, bwd_idle, grads)
+        dstate = ring_shift(dx, axis, shift=-1)
+
+    # back to the flat (device-major) local layer axis
+    return tmap(
+        lambda p, g: g if static(p)
+        else g.reshape((g.shape[0] * g.shape[1],) + g.shape[2:]), grads)
+
+
+def _make_sched(schedule: str, interleave: int, is_static=None):
+    """Uniform schedule dispatch: every schedule is called as
+    ``sched(params, x_mb, dy_mb, s, M, S, axis, vary_axes, sf, sb)``.
+    ``sb`` takes ``(dy, params, acts, m)`` plus, under the interleaved
+    schedule, the chunk index as a 5th argument."""
+    if schedule == "interleaved":
+        def sched(params, x_mb, dy_mb, s, M, S, axis, vary_axes, sf, sb):
+            return _interleaved_step(params, x_mb, dy_mb, s, M, S,
+                                     interleave, axis, vary_axes, sf, sb,
+                                     is_static=is_static)
+        return sched
+    return _gpipe_step if schedule == "gpipe" else _1f1b_step
+
+
 def make_step(batch_size: int, model_size: int, n_stages: int,
               n_microbatches: int, lr: float = LR, axis: str = PIPE_AXIS,
               schedule: str = "gpipe", data_axis: str | None = None,
-              model_axis: str | None = None):
+              model_axis: str | None = None, interleave: int = 2):
     """One PP step for one stage (local views: ``w1 [L/S, ffn(/n), d]``).
 
     ``data_axis`` strides the batch DDP-style (the seed arriving here is
     already this replica's column) and psums weight grads; ``model_axis``
     runs each block Megatron-sharded with one ``psum`` per layer per
-    direction inside the stage (``tp.py`` semantics on the pipe ring)."""
+    direction inside the stage (``tp.py`` semantics on the pipe ring).
+    ``interleave`` (schedule="interleaved" only) is the virtual-stage
+    count per device; the caller must hand params in ``interleave_perm``
+    device-major layer order."""
     S, M = n_stages, n_microbatches
     if batch_size % M:
         raise ValueError(f"tokens {batch_size} not divisible by "
@@ -284,7 +473,7 @@ def make_step(batch_size: int, model_size: int, n_stages: int,
         raise ValueError(f"unknown pipeline schedule {schedule!r} "
                          f"(expected one of {SCHEDULES})")
     mb = batch_size // M
-    sched = _gpipe_step if schedule == "gpipe" else _1f1b_step
+    sched = _make_sched(schedule, interleave)
     vary_axes = tuple(a for a in (axis, data_axis, model_axis) if a)
 
     if model_axis is None:
@@ -302,7 +491,7 @@ def make_step(batch_size: int, model_size: int, n_stages: int,
     def stage_fwd(p: FFNStackParams, x):
         return stack_fwd(p.w1, p.w2, x, block_fwd=block_fwd)
 
-    def stage_bwd(dy, p: FFNStackParams, acts, m):
+    def stage_bwd(dy, p: FFNStackParams, acts, m, chunk=0):
         dx, (g1, g2) = stack_bwd(dy, p.w1, p.w2, acts,
                                  block_bwd=block_bwd)
         return dx, FFNStackParams(g1, g2)
@@ -333,7 +522,8 @@ def make_transformer_pp_step(batch_size: int, model_size: int,
                              schedule: str = "gpipe",
                              data_axis: str | None = None,
                              model_axis: str | None = None,
-                             causal: bool = True, attn=None):
+                             causal: bool = True, attn=None,
+                             interleave: int = 2):
     """One transformer-PP step for one stage: the same two schedules over
     stages of pre-LN blocks (``[L/S]`` blocks per stage, activations
     ``[mb, T, d]``). The stash keeps each block's *input* only; the
@@ -356,7 +546,7 @@ def make_transformer_pp_step(batch_size: int, model_size: int,
         raise ValueError(f"unknown pipeline schedule {schedule!r} "
                          f"(expected one of {SCHEDULES})")
     mb = b // M
-    sched = _gpipe_step if schedule == "gpipe" else _1f1b_step
+    sched = _make_sched(schedule, interleave)
     # The model axis is deliberately NOT in the carry typing: tp_block's
     # f-gate discipline (psum exactly the pending cotangents) requires the
     # activation stream typed invariant over the model axis — its psums
@@ -381,7 +571,7 @@ def make_transformer_pp_step(batch_size: int, model_size: int,
             x = block(tuple(leaf[l] for leaf in p), x)
         return x, jnp.stack(acts)          # [L/S, mb, T, d] block inputs
 
-    def stage_bwd(dy, p: TransformerParams, acts, m):
+    def stage_bwd(dy, p: TransformerParams, acts, m, chunk=0):
         grads = jax.tree_util.tree_map(jnp.zeros_like, p)
         for l in reversed(range(p.ln1.shape[0])):
             leaves = tuple(leaf[l] for leaf in p)
@@ -423,7 +613,8 @@ def train_transformer_pp(params, seeds, batch_size: int, model_size: int,
                          mesh, lr: float = LR, *, seq_len: int,
                          n_heads: int, n_microbatches: int | None = None,
                          schedule: str = "gpipe", causal: bool = True,
-                         attn_impl: str | None = None):
+                         attn_impl: str | None = None,
+                         interleave: int = 2):
     """Pipeline the transformer family over the ``"pipe"`` ring, with the
     same mesh compositions as the FFN path: ``data`` replicates the
     pipeline (strided seeds, one grad psum), ``model`` Megatron-shards
@@ -443,6 +634,10 @@ def train_transformer_pp(params, seeds, batch_size: int, model_size: int,
     if params.ln1.shape[0] % S:
         raise ValueError(f"{params.ln1.shape[0]} layers not divisible "
                          f"into {S} pipeline stages")
+    perm = None
+    if schedule == "interleaved":
+        params, perm = _interleave_apply(params, params.ln1.shape[0], S,
+                                         interleave)
     h_eff = n_heads
     if tp_n > 1:
         h_eff = _validate_tp(params, n_heads, tp_n)
@@ -462,19 +657,24 @@ def train_transformer_pp(params, seeds, batch_size: int, model_size: int,
         batch_size, model_size, seq_len, h_eff, S, M, lr,
         schedule=schedule, data_axis=DATA_AXIS if dp > 1 else None,
         model_axis=MODEL_AXIS if tp_n > 1 else None, causal=causal,
-        attn=resolve_attn(attn_impl))
+        attn=resolve_attn(attn_impl), interleave=interleave)
 
     if dp > 1:
-        return launch_strided(step, sharded, seeds, mesh, DATA_AXIS, specs)
-    return launch(step, sharded, jnp.asarray(seeds), mesh,
-                  param_specs=specs, seed_spec=P())
+        out = launch_strided(step, sharded, seeds, mesh, DATA_AXIS, specs)
+    else:
+        out = launch(step, sharded, jnp.asarray(seeds), mesh,
+                     param_specs=specs, seed_spec=P())
+    if perm is not None:
+        out = _interleave_restore(out, perm)
+    return out
 
 
 def make_lm_pp_step(batch_size: int, model_size: int, seq_len: int,
                     n_heads: int, vocab: int, n_stages: int,
                     n_microbatches: int, lr: float = LR,
                     axis: str = PIPE_AXIS, schedule: str = "gpipe",
-                    data_axis: str | None = None, attn=None):
+                    data_axis: str | None = None, attn=None,
+                    interleave: int = 2):
     """One LM-PP step for one stage: the full language model pipelined —
     embedding on stage 0, transformer-block stages along the ring, tied
     head + REAL cross-entropy on the last stage. Runs under both
@@ -511,7 +711,10 @@ def make_lm_pp_step(batch_size: int, model_size: int, seq_len: int,
         raise ValueError(f"unknown pipeline schedule {schedule!r} "
                          f"(expected one of {SCHEDULES})")
     mb = b // M
-    sched = _gpipe_step if schedule == "gpipe" else _1f1b_step
+    V = interleave if schedule == "interleaved" else 1
+    # the LM's unstacked leaves ride every chunk whole; blocks chunk
+    sched = _make_sched(schedule, V,
+                        is_static=lambda path: path[0].name != "blocks")
     vary_axes = tuple(a for a in (axis, data_axis) if a)
 
     def blocks_walk_fwd(p: LMParams, x):
@@ -532,10 +735,16 @@ def make_lm_pp_step(batch_size: int, model_size: int, seq_len: int,
         def vary(tree):
             return _vary_tree(tree, vary_axes)
 
-        def stage_bwd(dy_in, p: LMParams, acts, m):
+        def stage_bwd(dy_in, p: LMParams, acts, m, chunk=0):
             block_inputs, y_out = acts
             tok_mb = lax.dynamic_slice_in_dim(tokens, m * mb, mb, 0)
             tgt_mb = lax.dynamic_slice_in_dim(targets, m * mb, mb, 0)
+            # role gates: the head lives after the LAST virtual stage
+            # (chunk V-1 of the last device), the embedding before the
+            # first (chunk 0 of device 0); for gpipe/1f1b V == 1 and
+            # these reduce to the plain stage conditions
+            is_head = (s == S - 1) & jnp.equal(chunk, V - 1)
+            is_embed = (s == 0) & jnp.equal(chunk, 0)
 
             def head_branch(_):
                 def head_loss(ln_f, wte, h):
@@ -550,7 +759,7 @@ def make_lm_pp_step(batch_size: int, model_size: int, seq_len: int,
                 return vary((dy_in, jnp.zeros_like(p.ln_f),
                              jnp.zeros_like(p.wte)))
 
-            dy_eff, g_lnf, g_wte = lax.cond(s == S - 1, head_branch,
+            dy_eff, g_lnf, g_wte = lax.cond(is_head, head_branch,
                                             ring_branch, None)
 
             # block walk (recompute internals at the stashed inputs)
@@ -577,7 +786,7 @@ def make_lm_pp_step(batch_size: int, model_size: int, seq_len: int,
                 return vary((jnp.zeros_like(p.wte),
                              jnp.zeros_like(p.wpe)))
 
-            g_wte_e, g_wpe = lax.cond(s == 0, embed_branch, no_embed,
+            g_wte_e, g_wpe = lax.cond(is_embed, embed_branch, no_embed,
                                       None)
             grads = LMParams(wte=g_wte + g_wte_e, wpe=g_wpe,
                              blocks=bgrads, ln_f=g_lnf)
@@ -601,7 +810,8 @@ def make_lm_pp_step(batch_size: int, model_size: int, seq_len: int,
 def train_lm_pp(params, seeds, batch_size: int, model_size: int, mesh,
                 lr: float = LR, *, seq_len: int, n_heads: int,
                 n_microbatches: int | None = None,
-                schedule: str = "gpipe", attn_impl: str | None = None):
+                schedule: str = "gpipe", attn_impl: str | None = None,
+                interleave: int = 2):
     """Pipeline the full LM over the ``"pipe"`` ring (embedding on stage
     0, blocks staged, tied head + real loss on the last stage); a
     ``data`` axis composes DDP. Pipe-only equals the single-device LM
@@ -621,6 +831,11 @@ def train_lm_pp(params, seeds, batch_size: int, model_size: int, mesh,
     if params.blocks.ln1.shape[0] % S:
         raise ValueError(f"{params.blocks.ln1.shape[0]} layers not "
                          f"divisible into {S} pipeline stages")
+    perm = None
+    if schedule == "interleaved":
+        blocks, perm = _interleave_apply(
+            params.blocks, params.blocks.ln1.shape[0], S, interleave)
+        params = params._replace(blocks=blocks)
     M = S if n_microbatches is None else n_microbatches
     blk = P(PIPE_AXIS, None, None)
     specs = LMParams(
@@ -636,43 +851,63 @@ def train_lm_pp(params, seeds, batch_size: int, model_size: int, mesh,
     step = make_lm_pp_step(batch_size, model_size, seq_len, n_heads,
                            params.vocab, S, M, lr, schedule=schedule,
                            data_axis=DATA_AXIS if dp > 1 else None,
-                           attn=resolve_attn(attn_impl))
+                           attn=resolve_attn(attn_impl),
+                           interleave=interleave)
     if dp > 1:
-        return launch_strided(step, sharded, seeds, mesh, DATA_AXIS, specs)
-    return launch(step, sharded, jnp.asarray(seeds), mesh,
-                  param_specs=specs, seed_spec=P())
+        out = launch_strided(step, sharded, seeds, mesh, DATA_AXIS, specs)
+    else:
+        out = launch(step, sharded, jnp.asarray(seeds), mesh,
+                     param_specs=specs, seed_spec=P())
+    if perm is not None:
+        out = out._replace(blocks=_interleave_restore(out.blocks, perm))
+    return out
 
 
 def train_pp(params: FFNStackParams, seeds, batch_size: int,
              model_size: int, mesh, lr: float = LR,
              n_microbatches: int | None = None,
-             schedule: str = "gpipe") -> FFNStackParams:
+             schedule: str = "gpipe",
+             interleave: int = 2) -> FFNStackParams:
     """Run the full PP schedule over ``mesh``. A pure ``("pipe",)`` mesh
     replicates the data (every stage regenerates the step's batch and
     consumes its own slice of the wavefront), so PP equals the
     single-device run. Adding ``"data"`` and/or ``"model"`` axes gives
     dp x pp x tp — 3-D parallelism — which equals DDP over the data axis
-    alone (differential tests pin every composition)."""
+    alone (differential tests pin every composition).
+
+    ``schedule="interleaved"`` places ``interleave`` non-contiguous layer
+    chunks per device (Megatron virtual stages) to cut the pipeline
+    bubble by ``1/interleave``: layers are re-ordered device-major
+    (``interleave_perm``) before sharding and restored after, so the
+    caller's canonical layer order is preserved end to end."""
     require_axes(mesh, PIPE_AXIS)
     shape = dict(mesh.shape)
     S = shape[PIPE_AXIS]
     dp = shape.get(DATA_AXIS, 1)
     tp_n = shape.get(MODEL_AXIS, 1)
-    if params.w1.shape[0] % S:
-        raise ValueError(f"{params.w1.shape[0]} layers not divisible into "
+    L = params.w1.shape[0]
+    if L % S:
+        raise ValueError(f"{L} layers not divisible into "
                          f"{S} pipeline stages")
     if params.w1.shape[1] % tp_n:
         raise ValueError(f"ffn_dim {params.w1.shape[1]} not divisible by "
                          f"{tp_n} model shards")
+    perm = None
+    if schedule == "interleaved":
+        params, perm = _interleave_apply(params, L, S, interleave)
     M = S if n_microbatches is None else n_microbatches
     specs = PARAM_SPECS_TP if tp_n > 1 else PARAM_SPECS
     params = shard_params(params, mesh, specs)
     step = make_step(batch_size, model_size, S, M, lr, schedule=schedule,
                      data_axis=DATA_AXIS if dp > 1 else None,
-                     model_axis=MODEL_AXIS if tp_n > 1 else None)
+                     model_axis=MODEL_AXIS if tp_n > 1 else None,
+                     interleave=interleave)
 
     if dp > 1:
-        return launch_strided(step, params, seeds, mesh, DATA_AXIS,
-                              specs)
-    return launch(step, params, jnp.asarray(seeds), mesh,
-                  param_specs=specs, seed_spec=P())
+        out = launch_strided(step, params, seeds, mesh, DATA_AXIS, specs)
+    else:
+        out = launch(step, params, jnp.asarray(seeds), mesh,
+                     param_specs=specs, seed_spec=P())
+    if perm is not None:
+        out = _interleave_restore(out, perm)
+    return out

@@ -70,6 +70,24 @@ def test_cli_lm_gqa():
 
 
 @pytest.mark.slow
+def test_cli_pp_interleaved():
+    r = _run_cli("-s", "2", "-bs", "8", "-n", "8", "-l", "8", "-d", "32",
+                 "-m", "6", "-r", "3", "--fake_devices", "4",
+                 "--pp_schedule", "interleaved", "--lr", "0.1")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "train_pp takes" in r.stdout
+    # flag discipline: --pp_chunks outside interleaved exits 2; bad
+    # chunking exits 2 up front (no trainer traceback)
+    r = _run_cli("-s", "2", "-m", "6", "-l", "8", "--fake_devices", "4",
+                 "--pp_schedule", "gpipe", "--pp_chunks", "4")
+    assert r.returncode == 2 and "--pp_chunks" in r.stderr
+    r = _run_cli("-s", "2", "-m", "6", "-l", "6", "--fake_devices", "4",
+                 "--pp_schedule", "interleaved", "--pp_chunks", "2")
+    assert r.returncode == 2 and "chunks" in r.stderr
+    assert "Traceback" not in r.stderr
+
+
+@pytest.mark.slow
 def test_cli_moe_lm_method():
     r = _run_cli("-s", "4", "-bs", "8", "-n", "8", "-l", "2", "-d", "32",
                  "-m", "12", "-r", "3", "--fake_devices", "4",

@@ -608,6 +608,44 @@ def test_lm_pp_matches_single(schedule):
                                        err_msg=f"M={m}")
 
 
+def test_lm_pp_interleaved_matches_single():
+    """The full LM under interleaved virtual stages: embedding before
+    virtual stage 0 (chunk 0 of device 0), head + real loss after the
+    LAST virtual stage (chunk V-1 of the last device) — the chunk-gated
+    roles. == single-device LM, M == S and M > S, plus the data
+    composition."""
+    from distributed_llm_code_samples_tpu.data import make_seed_schedule
+    from distributed_llm_code_samples_tpu.parallel import (
+        DATA_AXIS, PIPE_AXIS, make_mesh, train_lm_pp)
+    params = init_lm(jax.random.PRNGKey(19), V, D, 4, TMAX)
+    seeds = make_seed_schedule(2, random_seed=39)
+    b = 4
+    single = train_lm_single(params, seeds, b * SEQ, D, lr=0.05,
+                             seq_len=SEQ, n_heads=HEADS)
+    mesh = make_mesh({PIPE_AXIS: 2})
+    for m in (2, 4):
+        got = train_lm_pp(params, seeds, b * SEQ, D, mesh, lr=0.05,
+                          seq_len=SEQ, n_heads=HEADS, n_microbatches=m,
+                          schedule="interleaved", interleave=2)
+        for a, w in zip(jax.tree_util.tree_leaves(got),
+                        jax.tree_util.tree_leaves(single)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(w),
+                                       rtol=2e-4, atol=1e-5,
+                                       err_msg=f"M={m}")
+    seeds4 = make_seed_schedule(4, random_seed=40)
+    ddp = train_lm_ddp(params, seeds4, b * SEQ, D,
+                       make_mesh({DATA_AXIS: 2}), lr=0.05, seq_len=SEQ,
+                       n_heads=HEADS)
+    got = train_lm_pp(params, seeds4, b * SEQ, D,
+                      make_mesh({DATA_AXIS: 2, PIPE_AXIS: 2}), lr=0.05,
+                      seq_len=SEQ, n_heads=HEADS, n_microbatches=2,
+                      schedule="interleaved", interleave=2)
+    for a, w in zip(jax.tree_util.tree_leaves(got),
+                    jax.tree_util.tree_leaves(ddp)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(w),
+                                   rtol=2e-4, atol=1e-5)
+
+
 def test_lm_pp_attn_impl_matches_single():
     """attn_impl threads through the LM pipeline path (every other LM
     trainer already accepts it): PP with rope == single with rope — a

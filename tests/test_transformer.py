@@ -394,6 +394,50 @@ def test_transformer_pp_matches_single(schedule):
                                        err_msg=f"{name} M={m}")
 
 
+def test_transformer_pp_interleaved_matches_single():
+    """Interleaved virtual stages on the transformer pipeline (v=2
+    non-contiguous block chunks per device, layers permuted device-major
+    and restored) == single device, M < S and M > S."""
+    from distributed_llm_code_samples_tpu.parallel import (
+        PIPE_AXIS, train_transformer_pp)
+    p8 = init_transformer(jax.random.PRNGKey(6), D, 8)
+    b = 8
+    seeds = make_seed_schedule(2, random_seed=47)
+    single = train_transformer_single(p8, seeds, b * T, D, lr=0.05,
+                                      seq_len=T, n_heads=H)
+    mesh = make_mesh({PIPE_AXIS: 4})
+    for m in (2, 8):
+        got = train_transformer_pp(p8, seeds, b * T, D, mesh, lr=0.05,
+                                   seq_len=T, n_heads=H,
+                                   n_microbatches=m,
+                                   schedule="interleaved", interleave=2)
+        for name, a, b_ in zip(TransformerParams._fields, got, single):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=2e-4, atol=1e-5,
+                                       err_msg=f"{name} M={m}")
+
+
+def test_transformer_pp_interleaved_composes_3d():
+    """data x pipe x model with interleaved virtual stages == DDP over
+    the data axis alone (chunked Megatron shards inside each chunk
+    compute; model-axis carry typing is the subtle part)."""
+    from distributed_llm_code_samples_tpu.parallel import (
+        PIPE_AXIS, train_transformer_pp)
+    p4 = init_transformer(jax.random.PRNGKey(7), D, 4)
+    seeds = make_seed_schedule(4, random_seed=53)
+    b = 4
+    ddp = train_transformer_ddp(p4, seeds, b * T, D,
+                                make_mesh({DATA_AXIS: 2}), lr=0.05,
+                                seq_len=T, n_heads=H)
+    mesh3d = make_mesh({DATA_AXIS: 2, PIPE_AXIS: 2, MODEL_AXIS: 2})
+    got = train_transformer_pp(p4, seeds, b * T, D, mesh3d, lr=0.05,
+                               seq_len=T, n_heads=H,
+                               schedule="interleaved", interleave=2)
+    for name, a, b_ in zip(TransformerParams._fields, got, ddp):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-4, atol=1e-5, err_msg=name)
+
+
 @pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
 def test_transformer_pp_composes_3d(params, schedule):
     """data x pipe x model on the transformer: equals DDP over the data
