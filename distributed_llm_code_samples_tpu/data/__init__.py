@@ -65,6 +65,46 @@ def lm_batch_from_seed(seed: jax.Array, batch: int, seq_len: int,
     return toks[:, :-1], toks[:, 1:]
 
 
+_CORPUS = None
+
+
+def load_text_corpus() -> np.ndarray:
+    """The embedded REAL-text corpus as a ``uint8`` byte array (~237 KB of
+    English prose: the concatenated license texts shipped with every
+    Debian image under ``/usr/share/common-licenses`` — freely
+    redistributable verbatim, vendored at
+    ``data_assets/corpus.txt``). Byte-level vocab (256): every byte is a
+    token, so no tokenizer is needed and the LM family trains on real
+    text end to end (the capability synthetic seeds can't demonstrate)."""
+    global _CORPUS
+    if _CORPUS is None:
+        import os
+        path = os.path.join(os.path.dirname(__file__), "..",
+                            "data_assets", "corpus.txt")
+        with open(path, "rb") as f:
+            _CORPUS = np.frombuffer(f.read(), dtype=np.uint8)
+    return _CORPUS
+
+
+def text_batch_from_seed(seed: jax.Array, batch: int, seq_len: int,
+                         corpus=None):
+    """One real-text LM step from its integer seed: ``batch`` random
+    windows of ``seq_len + 1`` bytes gathered from the corpus, split
+    next-token style like ``lm_batch_from_seed``. Same counter-RNG
+    contract (``fold_in`` on the seed), so it is deterministic, traceable
+    (works inside ``lax.scan`` over a seed schedule), and identical on
+    every rank — real text slots into the seeds-as-dataset design
+    unchanged. ``corpus`` defaults to the embedded one; pass any 1-D
+    ``uint8``/int array to train on other bytes."""
+    data = jnp.asarray(load_text_corpus() if corpus is None else corpus)
+    key = jax.random.fold_in(jax.random.PRNGKey(_DATA_KEY), seed)
+    starts = jax.random.randint(key, (batch,), 0,
+                                data.shape[0] - seq_len - 1)
+    idx = starts[:, None] + jnp.arange(seq_len + 1)[None, :]
+    seqs = data[idx].astype(jnp.int32)
+    return seqs[:, :-1], seqs[:, 1:]
+
+
 def make_seed_schedule(num_steps: int, random_seed: int = 0) -> jnp.ndarray:
     """``num_steps`` integer seeds in ``[0, 100_000)`` (``train_ffns.py:360``).
 

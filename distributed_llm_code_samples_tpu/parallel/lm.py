@@ -66,17 +66,21 @@ def _validate_lm(batch_size: int, seq_len: int, model_size: int,
 
 def _make_step(batch_size: int, model_size: int, seq_len: int,
                n_heads: int, lr: float, attn=None, reduce_axes=(),
-               optimizer=None):
+               optimizer=None, batch_fn=None):
     """One update step on the real LM objective; ``batch_size`` is
     tokens/step (seq folded, CLI convention ``train_ffns.py:379``).
     Without ``optimizer`` it's the reference's stateless inline SGD
     (``(params, seed) -> params``); with one, the carry is ``(params,
     opt_state)`` — the full LLM loop (AdamW + clipping + schedules all
-    compose through ``optim.py``)."""
+    compose through ``optim.py``). ``batch_fn(seed) -> (tokens,
+    targets)`` overrides the synthetic seeds-as-dataset source — the hook
+    real-text training plugs into (``data.text_batch_from_seed``)."""
     b = batch_size // seq_len
 
     def grads_of(params, seed):
-        tokens, targets = lm_batch_from_seed(seed, b, seq_len, params.vocab)
+        tokens, targets = (batch_fn(seed) if batch_fn is not None else
+                           lm_batch_from_seed(seed, b, seq_len,
+                                              params.vocab))
         grads = jax.grad(lm_loss)(params, tokens, targets, n_heads, attn)
         if reduce_axes:
             grads = jax.tree_util.tree_map(
@@ -97,32 +101,52 @@ def train_lm_single(params: LMParams, seeds, batch_size: int,
                     model_size: int, mesh=None, lr: float = LR, *,
                     seq_len: int, n_heads: int,
                     attn_impl: str | None = None, optimizer=None,
-                    opt_state=None, return_state: bool = False):
+                    opt_state=None, return_state: bool = False,
+                    batch_fn=None):
     """Single-device LM trainer — the oracle the parallel forms are pinned
     to. ``optimizer``/``opt_state``/``return_state`` follow the DDP
     contract (``ddp.py``): stateful rules thread ``(params, state)``
-    through the scan and segments resume exactly."""
+    through the scan and segments resume exactly. ``batch_fn(seed) ->
+    (tokens, targets)`` swaps the synthetic data source for a real one
+    (e.g. ``data.text_batch_from_seed`` windows over the embedded
+    corpus)."""
     _validate_lm(batch_size, seq_len, model_size, n_heads, params)
     check_state_args(optimizer, opt_state, return_state)
-    step = _make_step(batch_size, model_size, seq_len, n_heads, lr,
-                      resolve_attn(attn_impl), optimizer=optimizer)
 
     if optimizer is None:
-        @jax.jit
-        def run(params, seeds):
-            return lax.scan(lambda p, s: (step(p, s), None), params,
-                            seeds)[0]
-
-        return run(clone_params(params), jnp.asarray(seeds))
+        return _run_lm_single(clone_params(params), jnp.asarray(seeds),
+                              batch_size, model_size, lr, seq_len,
+                              n_heads, attn_impl, batch_fn)
 
     state = optimizer.init(params) if opt_state is None else opt_state
-
-    @jax.jit
-    def run_opt(carry, seeds):
-        return lax.scan(lambda c, s: (step(c, s), None), carry, seeds)[0]
-
-    out, state = run_opt((clone_params(params), state), jnp.asarray(seeds))
+    out, state = _run_lm_single_opt(
+        (clone_params(params), state), jnp.asarray(seeds), batch_size,
+        model_size, lr, seq_len, n_heads, attn_impl, optimizer, batch_fn)
     return (out, state) if return_state else out
+
+
+@functools.partial(jax.jit, static_argnums=tuple(range(2, 9)),
+                   donate_argnums=0)
+def _run_lm_single(params, seeds, batch_size, model_size, lr, seq_len,
+                   n_heads, attn_impl, batch_fn):
+    """Module-level jit (the ``single.py`` pattern): repeat calls with
+    the same static config — including the same ``optimizer``/``batch_fn``
+    *objects*, which hash by identity — reuse the compiled program.
+    Segmented runs (checkpointing, bench best-of-N loops,
+    ``train_real_text.py``) pay one compile instead of one per call."""
+    step = _make_step(batch_size, model_size, seq_len, n_heads, lr,
+                      resolve_attn(attn_impl), batch_fn=batch_fn)
+    return lax.scan(lambda p, s: (step(p, s), None), params, seeds)[0]
+
+
+@functools.partial(jax.jit, static_argnums=tuple(range(2, 10)))
+def _run_lm_single_opt(carry, seeds, batch_size, model_size, lr, seq_len,
+                       n_heads, attn_impl, optimizer, batch_fn):
+    # no donation: callers may hold/reuse the opt_state they passed in
+    step = _make_step(batch_size, model_size, seq_len, n_heads, lr,
+                      resolve_attn(attn_impl), optimizer=optimizer,
+                      batch_fn=batch_fn)
+    return lax.scan(lambda c, s: (step(c, s), None), carry, seeds)[0]
 
 
 def train_lm_ddp(params: LMParams, seeds, batch_size: int, model_size: int,
@@ -384,8 +408,8 @@ def tp_generate(params: LMParams, prompt, n_new: int, mesh, *,
     ``all_gather`` of per-shard ``(max, index)`` pairs per position.
     One jitted ``shard_map`` scan decodes the whole batch; the result is
     replicated. Differential-pinned to the single-device ``generate``.
-    """
-    from ..models.lm import KVCache, decode_loop
+    The compiled program is cached on the static decode config
+    (``_tp_decode_program``), so repeat decodes don't re-trace."""
     require_axes(mesh, MODEL_AXIS)
     n = mesh.shape[MODEL_AXIS]
     h_local = _validate_tp(params.blocks, n_heads, n)
@@ -399,11 +423,23 @@ def tp_generate(params: LMParams, prompt, n_new: int, mesh, *,
         raise ValueError("tp_generate supports full-MHA models only; "
                          "GQA models decode via generate()")
     prompt = jnp.asarray(prompt)
-    b = prompt.shape[0]
-    d = params.d_model
-    dh = d // n_heads
-    max_t = params.max_seq_len
-    v_local = params.vocab // n
+    fn = _tp_decode_program(mesh, n_new, n_heads, h_local,
+                            params.vocab // n,
+                            params.max_seq_len,
+                            params.d_model // n_heads, use_rope)
+    sharded = _shard(params, mesh, _lm_tp_specs())
+    return fn(sharded, prompt)
+
+
+@functools.lru_cache(maxsize=16)
+def _tp_decode_program(mesh, n_new: int, n_heads: int, h_local: int,
+                       v_local: int, max_t: int, dh: int,
+                       use_rope: bool):
+    """Build (once per static decode config) the jitted shard_map decode
+    program ``(sharded_params, prompt) -> tokens``. jax.jit's own cache
+    then handles shape-polymorphic re-traces; callers timing repeat
+    decodes (bench_decode) hit the compiled program directly."""
+    from ..models.lm import KVCache, decode_loop
 
     def decode_step_tp(p: LMParams, cache: KVCache, token, pos):
         from ..models.lm import cached_attn_step
@@ -442,6 +478,7 @@ def tp_generate(params: LMParams, prompt, n_new: int, mesh, *,
             g[:, 1, :], win[None], axis=0)[0].astype(jnp.int32)
 
     def run(p: LMParams, prompt):
+        b = prompt.shape[0]
         cache = KVCache(
             k=jnp.zeros((p.blocks.w1.shape[0], b, h_local, max_t, dh),
                         p.wpe.dtype),
@@ -452,10 +489,9 @@ def tp_generate(params: LMParams, prompt, n_new: int, mesh, *,
             cache, prompt, n_new, max_t,
             lambda z, pos: pick_global(z))
 
-    sharded = _shard(params, mesh, _lm_tp_specs())
     return jax.jit(jax.shard_map(
         run, mesh=mesh, in_specs=(_lm_tp_specs(), P()), out_specs=P(),
-        check_vma=False))(sharded, prompt)
+        check_vma=False))
 
 
 def _lm_state_specs(state, specs):

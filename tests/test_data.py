@@ -65,3 +65,74 @@ def test_strided_shard_layout():
 def test_strided_shard_divisibility_error():
     with pytest.raises(ValueError):
         shard_seeds_strided(jnp.arange(10), 4)
+
+
+def test_text_corpus_loads_real_bytes():
+    from distributed_llm_code_samples_tpu.data import load_text_corpus
+    corpus = load_text_corpus()
+    assert corpus.dtype == np.uint8
+    assert corpus.shape[0] > 100_000  # "a few hundred KB" of real text
+    text = corpus.tobytes().decode("utf-8")
+    # real English prose, not noise
+    for phrase in ("License", "copyright", "distribute"):
+        assert phrase in text
+
+
+def test_text_batch_windows_and_determinism():
+    from distributed_llm_code_samples_tpu.data import (load_text_corpus,
+                                                       text_batch_from_seed)
+    corpus = load_text_corpus()
+    tok, tgt = text_batch_from_seed(jnp.int32(5), 4, 32)
+    assert tok.shape == (4, 32) and tgt.shape == (4, 32)
+    # targets are the next byte (windows are contiguous corpus slices)
+    np.testing.assert_array_equal(np.asarray(tok[:, 1:]),
+                                  np.asarray(tgt[:, :-1]))
+    # every window is a verbatim corpus slice
+    blob = corpus.tobytes()
+    for row in np.asarray(tok, dtype=np.uint8):
+        assert row.tobytes() in blob
+    # counter-RNG contract: same seed == same batch, different seed differs
+    tok2, _ = text_batch_from_seed(jnp.int32(5), 4, 32)
+    np.testing.assert_array_equal(np.asarray(tok), np.asarray(tok2))
+    tok3, _ = text_batch_from_seed(jnp.int32(6), 4, 32)
+    assert not np.array_equal(np.asarray(tok), np.asarray(tok3))
+
+
+def test_text_batch_traces_in_scan():
+    # the seed may be a traced scalar: real text keeps the
+    # seeds-as-dataset design (works under lax.scan like the synthetic
+    # sources)
+    from distributed_llm_code_samples_tpu.data import text_batch_from_seed
+    import jax
+
+    def body(c, s):
+        tok, tgt = text_batch_from_seed(s, 2, 16)
+        return c + tok.sum() + tgt.sum(), None
+
+    total, _ = jax.jit(
+        lambda seeds: jax.lax.scan(body, jnp.int32(0), seeds))(
+            jnp.arange(3, dtype=jnp.int32))
+    assert int(total) > 0
+
+
+def test_real_text_training_loss_falls():
+    """End to end on real bytes: a tiny LM trained through the batch_fn
+    hook must beat its initial eval loss decisively (the capability
+    synthetic seeds can't prove)."""
+    from distributed_llm_code_samples_tpu.data import text_batch_from_seed
+    from distributed_llm_code_samples_tpu.models import init_lm
+    from distributed_llm_code_samples_tpu.models.lm import lm_loss
+    from distributed_llm_code_samples_tpu.optim import adamw
+    from distributed_llm_code_samples_tpu.parallel import train_lm_single
+    import jax
+    B, T, D_, H_ = 8, 32, 32, 4
+    params = init_lm(jax.random.PRNGKey(0), 256, D_, 2, max_seq_len=T)
+    etok, etgt = text_batch_from_seed(jnp.int32(999_983), B, T)
+    loss0 = float(lm_loss(params, etok, etgt, H_))
+    params, _ = train_lm_single(
+        params, jnp.arange(30, dtype=jnp.int32), B * T, D_, lr=3e-3,
+        seq_len=T, n_heads=H_, optimizer=adamw(weight_decay=0.01),
+        return_state=True,
+        batch_fn=lambda s: text_batch_from_seed(s, B, T))
+    loss1 = float(lm_loss(params, etok, etgt, H_))
+    assert loss1 < loss0 - 0.5, (loss0, loss1)

@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Train the LM family on REAL text end to end and record the loss curve.
+
+The reference never trains on data at all (its loss is a mocked upstream
+gradient, ``train_ffns.py:149-150``); this script demonstrates the one
+capability a "language model family" headline implies that synthetic
+seeds can't: measurably falling next-byte cross-entropy on real English
+prose, plus a sampled continuation from the trained model.
+
+Corpus: ~237 KB of embedded real text (``data.load_text_corpus`` — the
+Debian common-licenses set, freely redistributable verbatim), byte-level
+vocab (256). Model: ``models/lm.py`` exactly as the framework ships it
+(pre-LN transformer, tied head, hand-VJP cross-entropy), trained with
+the hand-written AdamW + warmup-cosine from ``optim.py`` through
+``train_lm_single``'s ``batch_fn`` hook — the same step the differential
+suite pins, pointed at real bytes.
+
+Emits one JSON line per eval segment ``{"step": N, "loss": X}``, then a
+final line with the full curve, a sampled continuation, and throughput;
+also written to ``TEXTLM_r03.json`` (override: ``TEXTLM_ARTIFACT``).
+
+Run on the real chip: ``python train_real_text.py``. Smoke test:
+``BENCH_PLATFORM=cpu TEXTLM_STEPS=40 TEXTLM_SEGMENTS=4 python
+train_real_text.py``. Timing uses the bench.py methodology (scalar
+readback forces completion; the axon relay doesn't honor
+block_until_ready for chained dispatches).
+"""
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+if os.environ.get("BENCH_PLATFORM"):
+    jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
+D = int(os.environ.get("TEXTLM_D", 256))
+L = int(os.environ.get("TEXTLM_LAYERS", 4))
+H = int(os.environ.get("TEXTLM_HEADS", 8))
+T = int(os.environ.get("TEXTLM_SEQ", 256))
+B = int(os.environ.get("TEXTLM_BATCH", 32))
+STEPS = int(os.environ.get("TEXTLM_STEPS", 1000))
+SEGMENTS = int(os.environ.get("TEXTLM_SEGMENTS", 10))
+PEAK_LR = float(os.environ.get("TEXTLM_LR", 1e-3))
+VOCAB = 256
+ARTIFACT = os.environ.get("TEXTLM_ARTIFACT", "TEXTLM_r03.json")
+
+
+def main() -> int:
+    from distributed_llm_code_samples_tpu.data import (load_text_corpus,
+                                                       text_batch_from_seed)
+    from distributed_llm_code_samples_tpu.models import init_lm
+    from distributed_llm_code_samples_tpu.models.lm import lm_loss, sample
+    from distributed_llm_code_samples_tpu.optim import (adamw, clipped,
+                                                        scheduled,
+                                                        warmup_cosine)
+    from distributed_llm_code_samples_tpu.parallel import train_lm_single
+
+    corpus = load_text_corpus()
+    params = init_lm(jax.random.PRNGKey(0), VOCAB, D, L, max_seq_len=T)
+    opt = scheduled(
+        clipped(adamw(weight_decay=0.01), 1.0),
+        warmup_cosine(PEAK_LR, max(STEPS // 20, 1), STEPS))
+
+    def batch_fn(seed):
+        return text_batch_from_seed(seed, B, T)
+
+    # fixed eval batch (seed outside the training schedule's fold range)
+    eval_tok, eval_tgt = text_batch_from_seed(jnp.int32(999_983), B, T)
+    eval_loss = jax.jit(
+        lambda p: lm_loss(p, eval_tok, eval_tgt, H))
+
+    steps_per_seg = STEPS // SEGMENTS
+    # a deterministic non-random schedule: the seed IS the step index, so
+    # every step draws fresh windows (text_batch_from_seed folds it)
+    state = None
+    curve = [{"step": 0, "loss": round(float(eval_loss(params)), 4)}]
+    print(json.dumps(curve[0]))
+    sys.stdout.flush()
+    t0 = time.perf_counter()
+    for seg in range(SEGMENTS):
+        seeds = jnp.arange(seg * steps_per_seg,
+                           (seg + 1) * steps_per_seg, dtype=jnp.int32)
+        params, state = train_lm_single(
+            params, seeds, B * T, D, lr=PEAK_LR, seq_len=T, n_heads=H,
+            optimizer=opt, opt_state=state, return_state=True,
+            batch_fn=batch_fn)
+        point = {"step": (seg + 1) * steps_per_seg,
+                 "loss": round(float(eval_loss(params)), 4)}
+        curve.append(point)
+        print(json.dumps(point))
+        sys.stdout.flush()
+    train_s = time.perf_counter() - t0  # eval readbacks fence each segment
+
+    prompt_text = "  GNU GENERAL PUBLIC LICENSE\n"
+    prompt = jnp.frombuffer(prompt_text.encode(), dtype=jnp.uint8)
+    prompt = prompt.astype(jnp.int32)[None, :]
+    n_new = min(200, T - prompt.shape[1])  # cache is sized by max_seq_len
+    out = sample(params, prompt, n_new, H, temperature=0.8, top_k=40,
+                 seed=7)
+    continuation = bytes(
+        int(b) for b in jax.device_get(out[0])).decode(
+            "utf-8", errors="replace")
+
+    payload = {
+        "metric": "real_text_lm_final_eval_loss",
+        "value": curve[-1]["loss"],
+        "unit": "nats/byte",
+        "initial_loss": curve[0]["loss"],
+        "uniform_loss": round(float(jnp.log(float(VOCAB))), 4),
+        "loss_curve": curve,
+        "corpus_bytes": int(corpus.shape[0]),
+        "shape": f"d{D}_L{L}_H{H}_T{T}_B{B}_steps{STEPS}",
+        "tokens_per_sec": round(STEPS * B * T / train_s, 1),
+        "train_seconds": round(train_s, 2),
+        "sample": continuation,
+        "device_kind": jax.devices()[0].device_kind,
+    }
+    print(json.dumps(payload))
+    with open(ARTIFACT, "w") as f:
+        json.dump(payload, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
