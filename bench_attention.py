@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Flash attention vs the quadratic XLA oracle on the real chip, long T.
+
+The FFN Pallas kernels lost to XLA at the bench shape and said so
+(``ops/pallas_ffn.py`` measured verdict). Attention is where hand fusion
+has a real chance: the quadratic oracle (``models.attention.mha``)
+materializes the ``[T, T]`` scores in HBM, so at long T it is
+HBM-bandwidth-bound; the flash kernels (``ops/pallas_attention.py``)
+keep score tiles in VMEM. This bench runs BOTH through a full
+fwd+bwd step (the training-relevant direction: the flash backward
+recomputes score tiles from ``q, k, lse``) at T in {1k, 4k, 8k} and
+reports the per-T ratio.
+
+Emits one JSON line:
+``{"metric": "attn_pallas_vs_xla", ..., "per_T": {"1024": r, ...}}``
+(ratio > 1.0: flash wins). Written to ``ATTENTION_r03.json`` when
+``ATTN_ARTIFACT`` is set. Timing: whole grad step under jit, REPS
+best-of, scalar-readback fencing (bench.py methodology).
+
+Run: ``python bench_attention.py`` (real TPU). Smoke:
+``BENCH_PLATFORM=cpu ATTN_TS=128 python bench_attention.py``
+(interpret-mode Pallas — slow, correctness only).
+"""
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+if os.environ.get("BENCH_PLATFORM"):
+    jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
+H = int(os.environ.get("ATTN_HEADS", 8))
+DH = int(os.environ.get("ATTN_DH", 64))
+TS = tuple(int(t) for t in
+           os.environ.get("ATTN_TS", "1024,4096,8192").split(","))
+REPS = int(os.environ.get("ATTN_REPS", 5))
+CAUSAL = os.environ.get("ATTN_CAUSAL", "1") != "0"
+
+
+def _flops(t: int) -> float:
+    # matmul FLOPs of one attention fwd+bwd at seq len t: fwd QK^T + AV =
+    # 2 * 2*t^2*dh per head; bwd ~2x fwd (dS, dQ, dK, dV recompute
+    # included for flash — report against the MODEL's 3x accounting,
+    # same numerator for both paths so the ratio is apples-to-apples)
+    factor = 0.5 if CAUSAL else 1.0  # causal halves the useful tiles
+    return 3 * 2 * 2 * t * t * DH * H * factor
+
+
+def main() -> int:
+    from distributed_llm_code_samples_tpu.models.attention import mha
+    from distributed_llm_code_samples_tpu.ops.pallas_attention import (
+        flash_mha)
+
+    interpret = jax.default_backend() != "tpu"
+    per_t, per_t_detail = {}, {}
+
+    def step_time(fn, q, k, v):
+        # sum-of-outputs loss, differentiated wrt ALL of q/k/v — grad wrt
+        # q alone would let XLA dead-code-eliminate the dK/dV backward
+        # matmuls and time a partial backward. Summing the three
+        # cotangents into one scalar fences the whole program with one
+        # readback (relay methodology, utils/benchtime.py).
+        g = jax.jit(jax.grad(
+            lambda qkv: jnp.sum(fn(*qkv)), argnums=0))
+        out = g((q, k, v))
+        float(sum(o[0, 0, 0] for o in out))  # compile + fence
+        best = None
+        for _ in range(REPS):
+            t0 = time.perf_counter()
+            out = g((q, k, v))
+            float(sum(o[0, 0, 0] for o in out))
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        return best
+
+    for t in TS:
+        kq, kk, kv = jax.random.split(jax.random.PRNGKey(t), 3)
+        q = jax.random.normal(kq, (H, t, DH), jnp.float32)
+        k = jax.random.normal(kk, (H, t, DH), jnp.float32)
+        v = jax.random.normal(kv, (H, t, DH), jnp.float32)
+        try:
+            t_xla = step_time(lambda q, k, v: mha(q, k, v, CAUSAL),
+                              q, k, v)
+            t_flash = step_time(
+                lambda q, k, v: flash_mha(q, k, v, CAUSAL, interpret),
+                q, k, v)
+            per_t[str(t)] = round(t_xla / t_flash, 4)
+            per_t_detail[str(t)] = {
+                "xla_ms": round(t_xla * 1e3, 3),
+                "flash_ms": round(t_flash * 1e3, 3),
+                "flash_tflops": round(_flops(t) / t_flash / 1e12, 2),
+            }
+        except Exception as exc:  # noqa: BLE001
+            per_t[str(t)] = f"error: {type(exc).__name__}: {str(exc)[:160]}"
+
+    numeric = [v for v in per_t.values() if isinstance(v, float)]
+    payload = {
+        "metric": "attn_pallas_vs_xla",
+        "value": max(numeric) if numeric else 0.0,
+        "unit": "x (flash speedup over quadratic XLA, fwd+bwd)",
+        "per_T": per_t,
+        "detail": per_t_detail,
+        "shape": f"H{H}_dh{DH}_causal{int(CAUSAL)}",
+        "device_kind": jax.devices()[0].device_kind,
+    }
+    print(json.dumps(payload))
+    artifact = os.environ.get("ATTN_ARTIFACT")
+    if artifact:
+        with open(artifact, "w") as f:
+            json.dump(payload, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

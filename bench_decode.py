@@ -1,13 +1,23 @@
 #!/usr/bin/env python
-"""Decode throughput on the real chip: tokens/sec for the KV-cache loop.
+"""Decode throughput on the real chip: tokens/sec for the KV-cache loops.
 
-Shape: a GPT-2-small-proportioned LM (d=768, L=12, H=12, vocab=50304)
-decoding NEW tokens greedily from a short prompt, whole batch in one
-jitted scan (``models.lm.generate``). Prints one JSON line:
-``{"metric": "lm_decode_tokens_per_sec", "value": ..., ...}`` where
+Covers the three decode paths the framework ships:
+
+- ``lm``: GPT-2-small-proportioned LM (d=768, L=12, H=12, vocab=50304)
+  decoding greedily from a short prompt, whole batch in one jitted scan
+  (``models.lm.generate``).
+- ``tp``: the Megatron-sharded decode (``parallel.tp_generate``:
+  head-sharded KV cache, vocab-parallel tied head, gathered argmax) on a
+  1-axis model mesh over the available chips (size 1 on the single bench
+  chip — same program structure, collectives degenerate).
+- ``moe``: top-k routed decode through the GShard MoE stack
+  (``models.moe_generate``) at a smaller shape.
+
 ``value`` counts generated tokens x batch per second (prefill positions
 excluded from the numerator, included in the measured time — the honest
-end-to-end number).
+end-to-end number). Emits ONE JSON line with all paths; written to
+``DECODE_r03.json`` when ``DECODE_ARTIFACT`` is set (the round runs it
+as ``DECODE_ARTIFACT=DECODE_r03.json python bench_decode.py``).
 
 Not driver-run (the round benchmark is bench.py); run manually:
 ``python bench_decode.py`` (real TPU) or ``BENCH_PLATFORM=cpu`` with
@@ -33,36 +43,92 @@ B = int(os.environ.get("BENCH_BATCH", 8))
 T0 = int(os.environ.get("BENCH_PROMPT", 16))
 NEW = int(os.environ.get("BENCH_NEW", 240))
 REPS = int(os.environ.get("BENCH_REPS", 3))
+# MoE path shape (routing is the point, not width)
+MOE_D = int(os.environ.get("BENCH_MOE_D", 512))
+MOE_L = int(os.environ.get("BENCH_MOE_LAYERS", 6))
+MOE_E = int(os.environ.get("BENCH_MOE_EXPERTS", 8))
 
 
-def main() -> int:
-    from distributed_llm_code_samples_tpu.models import generate, init_lm
-
-    params = init_lm(jax.random.PRNGKey(0), V, D, L, T0 + NEW)
-    prompt = jax.random.randint(jax.random.PRNGKey(1), (B, T0), 0, V)
-
-    run = jax.jit(lambda p, prompt: generate(p, prompt, NEW, H))
-
-    def sync(out) -> int:
-        # the axon relay does not make block_until_ready wait for chained
-        # dispatches (bench.py methodology): force completion through a
-        # dependent scalar readback
-        return int(jnp.sum(out))
-
-    out = run(params, prompt)           # compile + warm
+def _throughput(run, *args) -> float:
+    from distributed_llm_code_samples_tpu.utils.benchtime import sync
+    out = run(*args)            # compile + warm
     sync(out)
     best = 0.0
     for _ in range(REPS):
         t0 = time.perf_counter()
-        sync(run(params, prompt))
+        sync(run(*args))
         best = max(best, B * NEW / (time.perf_counter() - t0))
-    print(json.dumps({
+    return best
+
+
+def main() -> int:
+    from distributed_llm_code_samples_tpu.models import (generate, init_lm,
+                                                         init_moe_lm,
+                                                         moe_generate)
+    from distributed_llm_code_samples_tpu.parallel import (MODEL_AXIS,
+                                                           make_mesh,
+                                                           tp_generate)
+
+    params = init_lm(jax.random.PRNGKey(0), V, D, L, T0 + NEW)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (B, T0), 0, V)
+    paths = {}
+
+    def guarded(key, fn):
+        # one path's failure must not lose the others' measurements
+        try:
+            fn()
+        except Exception as exc:  # noqa: BLE001
+            paths[key] = f"error: {type(exc).__name__}: {str(exc)[:160]}"
+
+    def lm_path():
+        run = jax.jit(lambda p, pr: generate(p, pr, NEW, H))
+        paths["lm_tokens_per_sec"] = round(
+            _throughput(run, params, prompt), 1)
+
+    guarded("lm_tokens_per_sec", lm_path)
+
+    def tp_path():
+        # Megatron-sharded decode over the largest chip count that
+        # divides heads and vocab (n=1 on the bench chip: same sharded
+        # program, collectives degenerate). tp_generate's compiled
+        # program is cached on the decode config, so the timed reps
+        # measure decoding, not re-tracing.
+        dev = jax.device_count()
+        n = max(k for k in range(1, dev + 1)
+                if dev % k == 0 and H % k == 0 and V % k == 0)
+        mesh = make_mesh({MODEL_AXIS: n})
+        paths["tp_tokens_per_sec"] = round(_throughput(
+            lambda p, pr: tp_generate(p, pr, NEW, mesh, n_heads=H),
+            params, prompt), 1)
+        paths["tp_mesh"] = n
+
+    guarded("tp_tokens_per_sec", tp_path)
+
+    def moe_path():
+        moe = init_moe_lm(jax.random.PRNGKey(2), V, MOE_D, MOE_L, MOE_E,
+                          T0 + NEW)
+        run = jax.jit(lambda p, pr: moe_generate(p, pr, NEW, 8, k=2))
+        paths["moe_tokens_per_sec"] = round(
+            _throughput(run, moe, prompt), 1)
+        paths["moe_shape"] = f"d{MOE_D}_L{MOE_L}_E{MOE_E}_k2"
+
+    guarded("moe_tokens_per_sec", moe_path)
+
+    lm_tps = paths.get("lm_tokens_per_sec")
+    payload = {
         "metric": "lm_decode_tokens_per_sec",
-        "value": round(best, 1),
+        # numeric contract: error strings stay in the per-path fields
+        "value": lm_tps if isinstance(lm_tps, float) else 0.0,
         "unit": "tokens/s",
         "shape": f"d{D}_L{L}_H{H}_V{V}_B{B}_prompt{T0}_new{NEW}",
         "device_kind": jax.devices()[0].device_kind,
-    }))
+        **paths,
+    }
+    print(json.dumps(payload))
+    artifact = os.environ.get("DECODE_ARTIFACT")
+    if artifact:
+        with open(artifact, "w") as f:
+            json.dump(payload, f, indent=1)
     return 0
 
 

@@ -117,31 +117,42 @@ def _scenarios():
         return (step, mesh, tp.PARAM_SPECS, params,
                 ffn_flops(tokens, d, layers) / n, comm)
 
-    def pp_case(d, layers, tokens, chips, m):
+    def pp_case(d, layers, tokens, chips, m, v=1):
         # BASELINE config 3's literal ask: the send/recv + barrier path —
-        # layers staged on the ppermute ring, activations streaming
+        # layers staged on the ppermute ring, activations streaming.
+        # v > 1 selects the interleaved virtual-stage schedule: v
+        # non-contiguous chunks per device, fill cost (S-1)/v.
         from distributed_llm_code_samples_tpu.parallel import pipeline
         from distributed_llm_code_samples_tpu.parallel.mesh import PIPE_AXIS
         params = init_ffn_stack(jax.random.PRNGKey(0), d, layers)
-        step = pipeline.make_step(tokens, d, chips, m, 0.1)
+        if v > 1:
+            step = pipeline.make_step(tokens, d, chips, m, 0.1,
+                                      schedule="interleaved",
+                                      interleave=v)
+        else:
+            step = pipeline.make_step(tokens, d, chips, m, 0.1)
         mesh = _mesh({PIPE_AXIS: chips}, chips)
-        # per tick one activation hop each direction: 2 schedules' worth
+        # per tick one activation hop each direction: 2 phases' worth
         # of ticks * microbatch activation bytes (fwd y + bwd dx)
         mb = tokens // m
-        ticks = m + chips - 1
+        ticks = v * m + chips - 1  # v=1: the GPipe M + S - 1
         comm = 2 * ticks * mb * d * 4
         # per-chip compute: each stage runs layers/chips of every
-        # microbatch. The GPipe bubble — (S-1)/(M+S-1) idle ticks per
+        # microbatch. The schedule bubble — (S-1)/ticks idle slots per
         # stage — caps scaling regardless of ICI, so the pp row's
         # bandwidth headroom is comm-only evidence; the bubble fields
-        # report the schedule-side ceiling (raise M to amortize).
+        # report the schedule-side ceiling. GPipe amortizes with more
+        # microbatches; the interleaved schedule divides the fill by v
+        # on top (bubble (S-1)/(vM+S-1) at the SAME M).
         extra = {
             "bubble_fraction": round((chips - 1) / ticks, 4),
-            "max_scaling_from_bubble": round(m / ticks, 4),
-            "note": "headroom is comm-only; the GPipe bubble caps "
+            "max_scaling_from_bubble": round(v * m / ticks, 4),
+            "note": "headroom is comm-only; the schedule bubble caps "
                     "scaling at max_scaling_from_bubble — raise "
-                    "microbatches to amortize",
+                    "microbatches (or interleave chunks) to amortize",
         }
+        if v > 1:
+            extra["interleave"] = v
         return (step, mesh, pipeline.PARAM_SPECS, params,
                 ffn_flops(tokens, d, layers) / chips, comm, extra)
 
@@ -181,6 +192,11 @@ def _scenarios():
         # M=8; the per-chip roofline uses the actual M)
         ("tp_d2048_L8", 8, lambda: tp_case(2048, 8, toks, 8)),
         ("pp_d2048_L8_M2", 8, lambda: pp_case(2048, 8, toks, 8, 2)),
+        # the interleaved virtual-stage schedule at the same M: 2 chunks
+        # per device (16 layers so each holds 2), fill cost halved —
+        # the bubble row the gpipe line is compared against
+        ("pp_d2048_L16_M2_interleaved", 8,
+         lambda: pp_case(2048, 16, toks, 8, 2, v=2)),
         # BASELINE config 4: hybrid DDP(4) x MP(2), 12 layers
         ("hybrid_d2048_L12_dp4tp2", 8,
          lambda: hybrid_case(2048, 12, toks, 4, 2)),
@@ -200,7 +216,22 @@ def _count_hlo_collectives(hlo: str) -> dict:
 def main() -> int:
     from distributed_llm_code_samples_tpu.utils import count_async_pairs
     ok = True
+    rows = []
+    only = os.environ.get("SCALING_SCENARIOS")  # comma-separated filter
+    wanted = set(only.split(",")) if only else None
+    if wanted is not None:
+        known = {name for name, _, _ in _scenarios()}
+        unknown = wanted - known
+        if unknown:
+            # fail loud: a typo'd filter must not produce an empty-but-
+            # "ok" artifact
+            print(json.dumps({"error": "unknown SCALING_SCENARIOS",
+                              "unknown": sorted(unknown),
+                              "known": sorted(known)}))
+            return 1
     for name, chips, build in _scenarios():
+        if wanted is not None and name not in wanted:
+            continue
         try:
             built = build()
             step, mesh, specs, params, flops, comm_bytes = built[:6]
@@ -220,7 +251,7 @@ def main() -> int:
         # spec's 1600 Gbps aggregate = 200 GB/s).
         req_overlap = comm_bytes / (compute_s / 0.9) / 1e9
         req_seq = comm_bytes / (compute_s / 9.0) / 1e9
-        print(json.dumps({
+        row = {
             "scenario": name, "chips": chips,
             "collectives": counts,
             "async_pairs": pairs,
@@ -230,11 +261,18 @@ def main() -> int:
             "required_GBps_90pct_sequential": round(req_seq, 2),
             "headroom_x_overlapped": round(V5E_ICI_GBPS / req_overlap, 1),
             **extra,
-        }))
-    print(json.dumps({"summary": "aot_v5e_codegen",
-                      "anchor_mfu": MEASURED_MFU,
-                      "v5e_ici_GBps": V5E_ICI_GBPS,
-                      "ok": ok}))
+        }
+        rows.append(row)
+        print(json.dumps(row))
+    summary = {"summary": "aot_v5e_codegen",
+               "anchor_mfu": MEASURED_MFU,
+               "v5e_ici_GBps": V5E_ICI_GBPS,
+               "ok": ok}
+    print(json.dumps(summary))
+    artifact = os.environ.get("SCALING_ARTIFACT")
+    if artifact:
+        with open(artifact, "w") as f:
+            json.dump({"rows": rows, **summary}, f, indent=1)
     return 0 if ok else 1
 
 

@@ -102,6 +102,62 @@ def dispatch_tensor_topk(idx: jax.Array, n_experts: int, capacity: int,
     return disp.reshape(k, t, n_experts, capacity)
 
 
+def _slot_positions(idx_flat: jax.Array, n_experts: int, capacity: int):
+    """Per-(token, choice) slot bookkeeping without the ``[N, E, C]``
+    tensor: position of each flat choice within its chosen expert
+    (first-come-first-served in flat order — identical semantics to
+    ``dispatch_tensor``'s cumsum) and the capacity keep-mask. O(N*E)
+    elementwise work, no O(N*E*C) anything."""
+    onehot = jax.nn.one_hot(idx_flat, n_experts, dtype=jnp.float32)
+    pos = jnp.sum(jnp.cumsum(onehot, axis=0) * onehot - onehot,
+                  axis=-1)                                     # [N]
+    keep = pos < capacity
+    return pos.astype(jnp.int32), keep
+
+
+def moe_layer_scatter(wg: jax.Array, w1: jax.Array, w2: jax.Array,
+                      x: jax.Array, capacity_factor: float = 2.0,
+                      k: int = 1, capacity: int | None = None
+                      ) -> jax.Array:
+    """``moe_layer`` with scatter/gather dispatch — same routing, same
+    capacity drops, same GShard choice-major priority, bitwise-same
+    top-k/gates — but the token movement is O(T*d) scatter-add into the
+    ``[E*C, d]`` expert buffer and an O(T*d) gather back, instead of the
+    dense one-hot einsums' O(T*E*C*d) MXU work (``T*E*C = k*T^2 *
+    capacity_factor``: QUADRATIC in tokens at fixed capacity factor,
+    which at bench scale dwarfs the expert FFN compute itself).
+
+    Every shape is static: dropped choices scatter into a dummy row
+    (``E*C``) that is sliced off before the expert compute. All moves
+    are linear (scatter-add / gather), so ``jax.vjp`` differentiates
+    them exactly, and the router gradient still flows through the gate
+    scale — the framework's linear-op stance unchanged. Differential-
+    pinned leaf-for-leaf against ``moe_layer`` (tests/test_moe.py)."""
+    n_experts = w1.shape[0]
+    t, d = x.shape
+    cap = (expert_capacity(t, n_experts, capacity_factor)
+           if capacity is None else capacity)
+    if k == 1:
+        idx, gates = route_top1(wg, x)
+        idx_flat, gates = idx, gates[:, None]
+    else:
+        idx2, gates = route_topk(wg, x, k)                     # [T, k]
+        idx_flat = idx2.T.reshape(-1)                          # choice-major
+    pos, keep = _slot_positions(idx_flat, n_experts, cap)      # [k*T]
+    dest = jnp.where(keep, idx_flat * cap + pos, n_experts * cap)
+    # scatter tokens into expert slots (each kept dest is unique; the
+    # dummy row absorbs drops). Token t appears once per kept choice.
+    tok = jnp.tile(jnp.arange(t), idx_flat.shape[0] // t)      # [k*T]
+    xe = jnp.zeros((n_experts * cap + 1, d), x.dtype).at[dest].add(x[tok])
+    ye = jax.vmap(ffn_block)(w1, w2,
+                             xe[:-1].reshape(n_experts, cap, d))
+    padded = jnp.concatenate([ye.reshape(n_experts * cap, d),
+                              jnp.zeros((1, d), ye.dtype)])
+    y_choice = padded[dest] * keep[:, None].astype(x.dtype)    # [k*T, d]
+    y_choice = y_choice.reshape(-1, t, d)                      # [k, T, d]
+    return jnp.einsum("ktd,tk->td", y_choice, gates.astype(x.dtype))
+
+
 def router_aux_loss(wg: jax.Array, x: jax.Array) -> jax.Array:
     """Switch load-balancing loss ``E * sum_e f_e * P_e`` on one layer's
     input tokens. ``f_e`` uses the (non-differentiable) top-1 assignment;
@@ -149,26 +205,34 @@ def moe_layer(wg: jax.Array, w1: jax.Array, w2: jax.Array, x: jax.Array,
 
 
 def moe_stack_fwd_aux(params, x: jax.Array, capacity_factor: float = 2.0,
-                      k: int = 1, capacity: int | None = None):
+                      k: int = 1, capacity: int | None = None,
+                      dispatch: str = "dense"):
     """Stack of MoE layers (``MoEStackParams``) with a residual around each
     layer (Switch semantics: a capacity-dropped token passes through
     unchanged rather than zeroing for the rest of the stack). Returns
     ``(y, aux)`` where ``aux`` is the total ``router_aux_loss``, each
     layer scored on its own residual-chained input — one walk computes
     both, so trainers can take a single ``vjp`` with cotangents
-    ``(dloss_dx, aux_coef)``."""
+    ``(dloss_dx, aux_coef)``. ``dispatch`` selects the token movement:
+    ``"dense"`` one-hot einsums or ``"scatter"``
+    (``moe_layer_scatter`` — same math, O(T*d) movement)."""
+    if dispatch not in ("dense", "scatter"):
+        raise ValueError(f"unknown dispatch {dispatch!r}")
+    layer = moe_layer if dispatch == "dense" else moe_layer_scatter
     aux = jnp.asarray(0.0, jnp.float32)
     for l in range(params.w1.shape[0]):
         aux = aux + router_aux_loss(params.wg[l], x)
-        x = x + moe_layer(params.wg[l], params.w1[l], params.w2[l], x,
-                          capacity_factor, k, capacity)
+        x = x + layer(params.wg[l], params.w1[l], params.w2[l], x,
+                      capacity_factor, k, capacity)
     return x, aux
 
 
 def moe_stack_fwd(params, x: jax.Array, capacity_factor: float = 2.0,
-                  k: int = 1, capacity: int | None = None) -> jax.Array:
+                  k: int = 1, capacity: int | None = None,
+                  dispatch: str = "dense") -> jax.Array:
     """Output half of ``moe_stack_fwd_aux``."""
-    return moe_stack_fwd_aux(params, x, capacity_factor, k, capacity)[0]
+    return moe_stack_fwd_aux(params, x, capacity_factor, k, capacity,
+                             dispatch)[0]
 
 
 def moe_stack_aux(params, x: jax.Array, capacity_factor: float = 2.0,

@@ -196,7 +196,8 @@ def train_moe_dense(params: MoEStackParams, seeds, batch_size: int,
                     model_size: int, lr: float = LR,
                     capacity_factor: float = 2.0, k: int = 1,
                     aux_coef: float = 0.0, n_groups: int = 1,
-                    capacity_groups: int | None = None) -> MoEStackParams:
+                    capacity_groups: int | None = None,
+                    dispatch: str = "dense") -> MoEStackParams:
     """Single-device dense MoE trainer with EP's exact semantics — no mesh,
     no collectives; the user-facing oracle for ``train_moe_ep``.
 
@@ -210,6 +211,10 @@ def train_moe_dense(params: MoEStackParams, seeds, batch_size: int,
     ``train_moe_ep(p, seeds, B, d, mesh_n) ==
     train_moe_dense(p, seeds, B, d, n_groups=n)`` is the --method 7
     differential check, runnable without a device mesh.
+
+    ``dispatch``: ``"dense"`` one-hot einsum movement or ``"scatter"``
+    (``ops.moe.moe_layer_scatter`` — same math, O(T*d) movement; see
+    bench_moe.py for the measured verdict).
     """
     if batch_size % n_groups:
         raise ValueError(f"batch_size={batch_size} not divisible by "
@@ -226,7 +231,8 @@ def train_moe_dense(params: MoEStackParams, seeds, batch_size: int,
 
     def fwd_aux(p, xs):  # xs [n_groups, t_local, d]
         y, aux = jax.vmap(
-            lambda x: moe_stack_fwd_aux(p, x, capacity_factor, k, cap))(xs)
+            lambda x: moe_stack_fwd_aux(p, x, capacity_factor, k, cap,
+                                        dispatch))(xs)
         return y, jnp.sum(aux)
 
     def step(p, row):

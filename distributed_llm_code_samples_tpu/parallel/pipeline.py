@@ -359,9 +359,16 @@ def _interleaved_step(params, x_mb, dy_mb, s, M: int, S: int, V: int,
         return _vary_tree(tree, vary_axes)
 
     # local chunked view of the device-major layer axis: [V*Lc] -> [V, Lc]
-    cparams = tmap(
-        lambda p, w: w if static(p)
-        else w.reshape((V, w.shape[0] // V) + w.shape[1:]), params)
+    def _chunked(w):
+        if w.shape[0] % V:
+            # direct make_*_step callers bypass the trainers'
+            # _interleave_apply check — fail clean at trace, not with an
+            # opaque reshape error
+            raise ValueError(f"local layer dim {w.shape[0]} not "
+                             f"divisible by interleave={V}")
+        return w.reshape((V, w.shape[0] // V) + w.shape[1:])
+
+    cparams = tmap(lambda p, w: w if static(p) else _chunked(w), params)
 
     def chunk_at(c):
         return tmap(lambda p, w: w if static(p) else w[c], cparams)

@@ -1,0 +1,40 @@
+"""Shared on-chip timing discipline for the bench scripts.
+
+Load-bearing on this hardware (measured, round 2): the axon relay does
+NOT make ``block_until_ready`` wait for chained per-step dispatches, so
+every bench (a) runs its whole schedule as ONE compiled program
+(``lax.scan`` over steps) and (b) forces completion through a dependent
+scalar readback. All outputs of a program materialize together, so
+reading any ONE leaf fences the program — and one readback keeps the
+~70ms relay round-trip out of the comparison. This module is the single
+home of that methodology so bench.py / bench_moe.py / bench_decode.py
+cannot drift apart.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+
+
+def sync(tree) -> float:
+    """Force completion of ``tree``'s program via ONE scalar readback."""
+    return float(jax.tree_util.tree_leaves(tree)[0].sum())
+
+
+def steps_per_sec(run_fn, p0, warm, timed, reps: int, steps: int) -> float:
+    """Best-of-``reps`` steps/sec of ``run_fn(params, seeds)``: one warm
+    call (compile) on the ``warm`` schedule, then ``reps`` timed calls on
+    ``timed`` (same length — the jitted run caches on the scan trip
+    count), each fenced by ``sync``. Best-of because the relay adds
+    run-to-run jitter (~±1.5%)."""
+    out = run_fn(p0, warm)
+    sync(out)
+    best = 0.0
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = run_fn(out, timed)
+        sync(out)
+        best = max(best, steps / (time.perf_counter() - t0))
+    return best

@@ -410,3 +410,43 @@ def test_tp_sp_aot_v5e8():
     assert hlo.count("all-gather") > 0
     assert hlo.count("reduce-scatter") > 0
     assert hlo.count("-start") > 0  # async splits for overlap
+
+
+@pytest.mark.slow
+def test_scaling_harness_headroom_and_bubble():
+    """The round's scaling evidence, asserted so regressions break CI:
+    run bench_scaling.py (subprocess, real v5e AOT codegen + roofline) on
+    a representative subset and require (a) the north-star FSDP config's
+    overlapped-ICI headroom >= 1 at v5e-32, (b) DDP headroom >= 1 at 8
+    chips, (c) the pp rows carry bubble fields with the interleaved
+    schedule's bubble strictly below GPipe's at the same M."""
+    import json as _json
+    import subprocess
+    import sys as _sys
+    env = dict(os.environ)
+    env["SCALING_SCENARIOS"] = ("fsdp_d768_L24,ddp_d768_L24,"
+                                "pp_d2048_L8_M2,"
+                                "pp_d2048_L16_M2_interleaved")
+    env.pop("JAX_PLATFORMS", None)
+    r = subprocess.run([_sys.executable, "bench_scaling.py"],
+                       capture_output=True, text=True, env=env,
+                       cwd=os.path.dirname(os.path.dirname(__file__)),
+                       timeout=1200)
+    assert r.returncode == 0, r.stdout + r.stderr
+    rows = [_json.loads(line) for line in r.stdout.splitlines()
+            if line.startswith("{")]
+    by_name = {}
+    for row in rows:
+        if "scenario" in row:
+            by_name.setdefault(row["scenario"], []).append(row)
+    fsdp32 = [r_ for r_ in by_name["fsdp_d768_L24"] if r_["chips"] == 32]
+    assert fsdp32 and fsdp32[0]["headroom_x_overlapped"] >= 1, fsdp32
+    ddp8 = [r_ for r_ in by_name["ddp_d768_L24"] if r_["chips"] == 8]
+    assert ddp8 and ddp8[0]["headroom_x_overlapped"] >= 1, ddp8
+    gpipe = by_name["pp_d2048_L8_M2"][0]
+    inter = by_name["pp_d2048_L16_M2_interleaved"][0]
+    assert 0 < inter["bubble_fraction"] < gpipe["bubble_fraction"]
+    assert (inter["max_scaling_from_bubble"]
+            > gpipe["max_scaling_from_bubble"])
+    # the codegen really contains the ring (collective-permute) path
+    assert any("collective-permute" in k for k in gpipe["collectives"])
