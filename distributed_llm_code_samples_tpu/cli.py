@@ -85,6 +85,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "(0 = full MHA; wk/wv and the KV cache shrink by "
                         "heads/kv_heads; must divide --heads and the "
                         "model-axis size must divide it)")
+    p.add_argument("--attn", choices=["oracle", "rope", "flash"],
+                   default="oracle",
+                   help="attention implementation for the transformer/LM "
+                        "methods (8, 11, and 6 with --pp_family "
+                        "transformer/lm): the quadratic hand-VJP oracle, "
+                        "rotary positions, or the fused Pallas flash "
+                        "kernels (interpret mode off-TPU)")
     p.add_argument("--lr", type=float, default=None,
                    help="override LR (default 1e-5, train_ffns.py:29)")
     p.add_argument("--optimizer",
@@ -212,6 +219,13 @@ def main(argv=None) -> int:
         print("error: --pp_family applies to --method 6 only",
               file=sys.stderr)
         return 2
+    if args.attn != "oracle" and not (
+            args.method in (8, 11)
+            or (args.method == 6 and args.pp_family in ("transformer",
+                                                        "lm"))):
+        print("error: --attn applies to --method 8, 11, or 6 with "
+              "--pp_family transformer/lm", file=sys.stderr)
+        return 2
     if args.optimizer != "sgd" and args.method not in (2, 3):
         # methods 0/9 cross-check against strategies that would still run
         # inline SGD — a guaranteed spurious differential failure
@@ -243,6 +257,13 @@ def main(argv=None) -> int:
         # instead of that ValueError's traceback
         print(f"error: --heads {args.heads} not divisible by "
               f"--kv_heads {args.kv_heads}", file=sys.stderr)
+        return 2
+    if args.kv_heads and args.attn == "flash":
+        # the flash kernels expect full-MHA shapes (no supports_gqa);
+        # exit 2 up front instead of the model-level ValueError traceback
+        print("error: --attn flash does not support grouped-query "
+              "attention (--kv_heads); use --attn oracle or rope",
+              file=sys.stderr)
         return 2
     if args.kv_heads and args.method in (9, 11):
         # the companion constraint the help text promises ("the model-axis
@@ -397,12 +418,16 @@ def main(argv=None) -> int:
                 from .parallel import train_lm_pp
                 name, fn = "train_lm_pp", train_lm_pp
                 kwargs.update(seq_len=args.seq_len, n_heads=args.heads)
+            if args.pp_family != "ffn" and args.attn != "oracle":
+                kwargs["attn_impl"] = args.attn
         if m == 7:
             kwargs = dict(lr=lr)  # EP's expert loop has its own structure
         if m in (8, 10, 11, 12):
             kwargs = dict(lr=lr, seq_len=args.seq_len, n_heads=args.heads)
             if args.tp_sp and m == 8:
                 kwargs["sequence_parallel"] = True
+            if m in (8, 11) and args.attn != "oracle":
+                kwargs["attn_impl"] = args.attn
         if m == 1 and args.pallas:
             kwargs["use_pallas"] = True
             kwargs["interpret"] = jax.default_backend() != "tpu"

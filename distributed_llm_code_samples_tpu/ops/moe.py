@@ -115,6 +115,47 @@ def _slot_positions(idx_flat: jax.Array, n_experts: int, capacity: int):
     return pos.astype(jnp.int32), keep
 
 
+def route_flat(wg: jax.Array, x: jax.Array, k: int):
+    """Routing in the scatter paths' flat choice-major layout:
+    ``(idx_flat [k*T], gates [T, k])`` — rank-0 choices of all tokens
+    precede any rank-1 choice, the GShard priority order."""
+    if k == 1:
+        idx, gates = route_top1(wg, x)
+        return idx, gates[:, None]
+    idx2, gates = route_topk(wg, x, k)
+    return idx2.T.reshape(-1), gates
+
+
+def scatter_dispatch(idx_flat: jax.Array, x: jax.Array, n_experts: int,
+                     capacity: int):
+    """Scatter tokens into the ``[E, C, d]`` expert-slot buffer:
+    O(N*d) movement, dropped choices land in a dummy row that is sliced
+    off. Returns ``(xe [E, C, d], dest [N], keep [N])`` — ``dest`` and
+    ``keep`` feed ``scatter_combine``. Shared by the single-device and
+    EP scatter paths so the slot bookkeeping cannot drift."""
+    t, d = x.shape
+    pos, keep = _slot_positions(idx_flat, n_experts, capacity)
+    dest = jnp.where(keep, idx_flat * capacity + pos,
+                     n_experts * capacity)
+    tok = jnp.tile(jnp.arange(t), idx_flat.shape[0] // t)
+    xe = jnp.zeros((n_experts * capacity + 1, d),
+                   x.dtype).at[dest].add(x[tok])
+    return xe[:-1].reshape(n_experts, capacity, d), dest, keep
+
+
+def scatter_combine(ye: jax.Array, dest: jax.Array, keep: jax.Array,
+                    gates: jax.Array, t: int) -> jax.Array:
+    """Gather expert outputs back to their tokens and apply the gate
+    scale: ``ye [E, C, d]`` -> ``[t, d]`` (dropped choices contribute
+    zero via the dummy row)."""
+    ec, d = ye.shape[0] * ye.shape[1], ye.shape[-1]
+    padded = jnp.concatenate([ye.reshape(ec, d),
+                              jnp.zeros((1, d), ye.dtype)])
+    y_choice = padded[dest] * keep[:, None].astype(ye.dtype)
+    return jnp.einsum("ktd,tk->td", y_choice.reshape(-1, t, d),
+                      gates.astype(ye.dtype))
+
+
 def moe_layer_scatter(wg: jax.Array, w1: jax.Array, w2: jax.Array,
                       x: jax.Array, capacity_factor: float = 2.0,
                       k: int = 1, capacity: int | None = None
@@ -134,28 +175,13 @@ def moe_layer_scatter(wg: jax.Array, w1: jax.Array, w2: jax.Array,
     scale — the framework's linear-op stance unchanged. Differential-
     pinned leaf-for-leaf against ``moe_layer`` (tests/test_moe.py)."""
     n_experts = w1.shape[0]
-    t, d = x.shape
+    t = x.shape[0]
     cap = (expert_capacity(t, n_experts, capacity_factor)
            if capacity is None else capacity)
-    if k == 1:
-        idx, gates = route_top1(wg, x)
-        idx_flat, gates = idx, gates[:, None]
-    else:
-        idx2, gates = route_topk(wg, x, k)                     # [T, k]
-        idx_flat = idx2.T.reshape(-1)                          # choice-major
-    pos, keep = _slot_positions(idx_flat, n_experts, cap)      # [k*T]
-    dest = jnp.where(keep, idx_flat * cap + pos, n_experts * cap)
-    # scatter tokens into expert slots (each kept dest is unique; the
-    # dummy row absorbs drops). Token t appears once per kept choice.
-    tok = jnp.tile(jnp.arange(t), idx_flat.shape[0] // t)      # [k*T]
-    xe = jnp.zeros((n_experts * cap + 1, d), x.dtype).at[dest].add(x[tok])
-    ye = jax.vmap(ffn_block)(w1, w2,
-                             xe[:-1].reshape(n_experts, cap, d))
-    padded = jnp.concatenate([ye.reshape(n_experts * cap, d),
-                              jnp.zeros((1, d), ye.dtype)])
-    y_choice = padded[dest] * keep[:, None].astype(x.dtype)    # [k*T, d]
-    y_choice = y_choice.reshape(-1, t, d)                      # [k, T, d]
-    return jnp.einsum("ktd,tk->td", y_choice, gates.astype(x.dtype))
+    idx_flat, gates = route_flat(wg, x, k)
+    xe, dest, keep = scatter_dispatch(idx_flat, x, n_experts, cap)
+    ye = jax.vmap(ffn_block)(w1, w2, xe)
+    return scatter_combine(ye, dest, keep, gates, t)
 
 
 def router_aux_loss(wg: jax.Array, x: jax.Array) -> jax.Array:

@@ -51,8 +51,9 @@ from ..models.moe import MoEStackParams
 from ..models.ffn_stack import clone_params
 from ..ops.ffn import ffn_block
 from ..ops.moe import (dispatch_tensor, dispatch_tensor_topk,
-                       expert_capacity, moe_stack_fwd_aux, route_top1,
-                       route_topk, router_aux_loss)
+                       expert_capacity, moe_stack_fwd_aux, route_flat,
+                       route_top1, route_topk, router_aux_loss,
+                       scatter_combine, scatter_dispatch)
 from ..optim import sgd
 from .collectives import all_to_all, grad_reduce
 from .launcher import launch, launch_strided
@@ -69,16 +70,31 @@ def _local_capacity(t_local: int, n_shards: int, n_experts: int,
 
 
 def moe_layer_ep(wg, w1_local, w2_local, x, capacity_factor: float = 2.0,
-                 axis: str = EXPERT_AXIS, k: int = 1):
+                 axis: str = EXPERT_AXIS, k: int = 1,
+                 dispatch: str = "dense"):
     """One expert-parallel MoE layer, per-shard view (no residual here —
     the step adds it).
 
     ``wg [E, d]`` (replicated), ``w1_local [E/n, ffn, d]``,
-    ``w2_local [E/n, d, ffn]``, ``x [T_local, d]``.
-    """
+    ``w2_local [E/n, d, ffn]``, ``x [T_local, d]``. ``dispatch``:
+    ``"dense"`` one-hot einsum movement or ``"scatter"`` (O(T*d)
+    scatter/gather around the same pair of ``all_to_all``s — identical
+    routing/capacity/priority semantics, differential-pinned)."""
     n_experts = wg.shape[0]
-    cap = _local_capacity(x.shape[0], lax.axis_size(axis), n_experts,
+    t = x.shape[0]
+    cap = _local_capacity(t, lax.axis_size(axis), n_experts,
                           capacity_factor)
+    if dispatch == "scatter":
+        # O(T*d) movement form — the ops.moe scatter helpers (shared
+        # slot bookkeeping) around the SAME pair of all_to_alls
+        idx_flat, gates = route_flat(wg, x, k)
+        xe, dest, keep = scatter_dispatch(idx_flat, x, n_experts, cap)
+        xe = all_to_all(xe, axis, split_dim=0, concat_dim=1)
+        ye = jax.vmap(ffn_block)(w1_local, w2_local, xe)
+        ye = all_to_all(ye, axis, split_dim=1, concat_dim=0)
+        return scatter_combine(ye, dest, keep, gates, t)
+    if dispatch != "dense":
+        raise ValueError(f"unknown dispatch {dispatch!r}")
     if k == 1:
         idx, gate = route_top1(wg, x)
         disp = dispatch_tensor(idx, n_experts, cap, x.dtype)  # [T_loc, E, C]
@@ -100,7 +116,7 @@ def moe_layer_ep(wg, w1_local, w2_local, x, capacity_factor: float = 2.0,
 def make_step(batch_size: int, model_size: int, lr: float = LR,
               capacity_factor: float = 2.0, axis: str = EXPERT_AXIS,
               k: int = 1, aux_coef: float = 0.0,
-              data_axis: str | None = None):
+              data_axis: str | None = None, dispatch: str = "dense"):
     """One EP step for one shard: local fwd (residual per layer),
     ``jax.vjp``-composed backward over the hand-written rules, optional
     load-balancing aux term, explicit router-grad psum, local SGD.
@@ -116,7 +132,7 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
         for l in range(params.w1.shape[0]):
             aux = aux + router_aux_loss(params.wg[l], x)
             x = x + moe_layer_ep(params.wg[l], params.w1[l], params.w2[l],
-                                 x, capacity_factor, axis, k)
+                                 x, capacity_factor, axis, k, dispatch)
         return x, aux
 
     def step(params: MoEStackParams, seed) -> MoEStackParams:
@@ -148,7 +164,8 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
 def train_moe_ep(params: MoEStackParams, seeds, batch_size: int,
                  model_size: int, mesh, lr: float = LR,
                  capacity_factor: float = 2.0, k: int = 1,
-                 aux_coef: float = 0.0) -> MoEStackParams:
+                 aux_coef: float = 0.0,
+                 dispatch: str = "dense") -> MoEStackParams:
     """Run the EP schedule; returns fully-assembled final params.
 
     ``batch_size`` is the *global token count per EP group* per step; each
@@ -175,7 +192,8 @@ def train_moe_ep(params: MoEStackParams, seeds, batch_size: int,
                          f"expert-axis size {n}")
     step = make_step(batch_size // n, model_size, lr, capacity_factor,
                      k=k, aux_coef=aux_coef,
-                     data_axis=DATA_AXIS if dp > 1 else None)
+                     data_axis=DATA_AXIS if dp > 1 else None,
+                     dispatch=dispatch)
     specs = MoEStackParams(wg=P(), w1=P(None, EXPERT_AXIS),
                            w2=P(None, EXPERT_AXIS))
     if dp > 1:

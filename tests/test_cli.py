@@ -88,6 +88,18 @@ def test_cli_pp_interleaved():
 
 
 @pytest.mark.slow
+def test_cli_attn_flag():
+    r = _run_cli("-s", "2", "-bs", "2", "-n", "8", "-l", "2", "-d", "32",
+                 "-m", "11", "-r", "3", "--fake_devices", "4", "--tp",
+                 "2", "--vocab", "64", "--heads", "4", "--attn", "rope",
+                 "--lr", "0.1")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "train_lm_tp takes" in r.stdout
+    r = _run_cli("-s", "2", "-m", "1", "--attn", "rope")
+    assert r.returncode == 2 and "--attn" in r.stderr
+
+
+@pytest.mark.slow
 def test_cli_moe_lm_method():
     r = _run_cli("-s", "4", "-bs", "8", "-n", "8", "-l", "2", "-d", "32",
                  "-m", "12", "-r", "3", "--fake_devices", "4",

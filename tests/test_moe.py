@@ -386,3 +386,22 @@ def test_scatter_dispatch_through_stack(params):
     np.testing.assert_allclose(float(auxs), float(auxd), rtol=1e-6)
     with pytest.raises(ValueError, match="dispatch"):
         moe_stack_fwd_aux(params, x, dispatch="magic")
+
+
+def test_ep_scatter_dispatch_matches_dense(params, mesh_ep4):
+    """EP with scatter dispatch == EP with dense dispatch == the grouped
+    dense oracle: the movement form changes nothing about routing,
+    grouped capacity, drops, or gradients — the all_to_all pair and the
+    rest of the step are shared."""
+    seeds = make_seed_schedule(8, random_seed=21)
+    dense = train_moe_ep(params, seeds, 4 * T, D, mesh_ep4, lr=0.1, k=2,
+                         aux_coef=0.01)
+    scat = train_moe_ep(params, seeds, 4 * T, D, mesh_ep4, lr=0.1, k=2,
+                        aux_coef=0.01, dispatch="scatter")
+    for a, b in zip(jax.tree_util.tree_leaves(scat),
+                    jax.tree_util.tree_leaves(dense)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
+    with pytest.raises(ValueError, match="dispatch"):
+        train_moe_ep(params, seeds, 4 * T, D, mesh_ep4, lr=0.1,
+                     dispatch="magic")
