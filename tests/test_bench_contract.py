@@ -1,0 +1,66 @@
+"""The driver contract: bench.py must print ONE parseable JSON line with
+the agreed fields, whatever the platform, and the auxiliary benches must
+keep their numeric-value contract. Run at smoke shapes on CPU — a
+regression here means the round ends with no BENCH_r{N}.json."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script, env_extra, timeout=600):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.update({"BENCH_PLATFORM": "cpu"}, **env_extra)
+    r = subprocess.run([sys.executable, script], capture_output=True,
+                       text=True, env=env, cwd=REPO, timeout=timeout)
+    assert r.returncode == 0, r.stdout + r.stderr
+    lines = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
+    assert lines, r.stdout + r.stderr
+    return json.loads(lines[-1])
+
+
+@pytest.mark.slow
+def test_bench_emits_driver_contract():
+    payload = _run("bench.py", {
+        "BENCH_D": "32", "BENCH_LAYERS": "2", "BENCH_TOKENS": "64",
+        "BENCH_STEPS": "4", "BENCH_REPS": "1", "BENCH_PALLAS": "0",
+        "BENCH_FAM_D": "32", "BENCH_FAM_LAYERS": "1",
+        "BENCH_FAM_HEADS": "2", "BENCH_FAM_SEQ": "8",
+        "BENCH_FAM_BATCH": "2", "BENCH_FAM_VOCAB": "64"})
+    for field in ("metric", "value", "unit", "vs_baseline", "mfu",
+                  "policy", "model_tflops"):
+        assert field in payload, field
+    assert isinstance(payload["value"], float) and payload["value"] > 0
+    # the honest-MFU contract: value * model_tflops / peak == mfu
+    recomputed = (payload["value"] * payload["model_tflops"]
+                  / payload["peak_bf16_tflops"])
+    assert abs(recomputed - payload["mfu"]) < 5e-4, (recomputed, payload)
+    # extras present (smoke shapes): breakdown components + families
+    assert isinstance(payload.get("gap_breakdown"), dict)
+    fams = payload.get("families")
+    assert isinstance(fams, dict) and "transformer" in fams and "lm" in fams
+
+
+@pytest.mark.slow
+def test_bench_moe_verdict_contract():
+    payload = _run("bench_moe.py", {
+        "MOE_TOKENS": "128", "MOE_D": "32", "MOE_LAYERS": "1",
+        "MOE_STEPS": "2", "MOE_REPS": "1", "MOE_LM": "0"})
+    assert isinstance(payload["value"], float)
+    assert isinstance(payload["dense_steps_per_sec"], float)
+    assert isinstance(payload["scatter_steps_per_sec"], float)
+    assert "verdict" in payload
+
+
+@pytest.mark.slow
+def test_bench_attention_contract():
+    payload = _run("bench_attention.py",
+                   {"ATTN_TS": "64", "ATTN_REPS": "1", "ATTN_HEADS": "2"})
+    assert payload["metric"] == "attn_pallas_vs_xla"
+    assert "64" in payload["per_T"]
