@@ -259,6 +259,22 @@ def test_pp_interleaved_deep_chunks_and_compositions(setup):
     _assert_params_close(p_single, got)
 
 
+def test_pp_interleaved_partial_groups(setup):
+    """M not a multiple of S (the schedule packs microbatch groups of S;
+    the last group is partial and its missing offsets idle): M < S
+    non-divisor and M > S non-multiple both stay exact."""
+    params = init_ffn_stack(jax.random.PRNGKey(42), D, 8)
+    _, seeds = setup
+    tokens = 48
+    single = train_single(params, seeds, tokens, D, lr=LR_TEST)
+    mesh = make_mesh({PIPE_AXIS: 4})
+    for m in (3, 6):
+        got = train_pp(params, seeds, tokens, D, mesh, lr=LR_TEST,
+                       n_microbatches=m, schedule="interleaved",
+                       interleave=2)
+        _assert_params_close(single, got)
+
+
 def test_pp_interleaved_rejects_bad_chunking(setup):
     _, seeds = setup
     with pytest.raises(ValueError, match="virtual chunks"):
