@@ -63,7 +63,8 @@ def train_moe_lm_ep(params: MoELMParams, seeds, batch_size: int,
                     seq_len: int, n_heads: int, causal: bool = True,
                     capacity_factor: float = 2.0, k: int = 1,
                     aux_coef: float = 0.0,
-                    attn_impl: str | None = None) -> MoELMParams:
+                    attn_impl: str | None = None,
+                    dispatch: str = "dense") -> MoELMParams:
     """Run the GShard-LM schedule; ``batch_size`` is global tokens per
     step (each shard trains ``batch_size/n`` tokens of its own strided
     seed column)."""
@@ -78,7 +79,7 @@ def train_moe_lm_ep(params: MoELMParams, seeds, batch_size: int,
 
     def moe_fn(wg, w1_local, w2_local, h):
         return moe_layer_ep(wg, w1_local, w2_local, h, capacity_factor,
-                            EXPERT_AXIS, k)
+                            EXPERT_AXIS, k, dispatch)
 
     def step(params: MoELMParams, seed) -> MoELMParams:
         tokens, targets = lm_batch_from_seed(seed, b_local, seq_len, vocab)

@@ -70,7 +70,8 @@ def train_moe_transformer_ep(params: MoETransformerParams, seeds,
                              causal: bool = True,
                              capacity_factor: float = 2.0, k: int = 1,
                              aux_coef: float = 0.0,
-                             attn_impl: str | None = None
+                             attn_impl: str | None = None,
+                             dispatch: str = "dense"
                              ) -> MoETransformerParams:
     """Run the GShard schedule; ``batch_size`` is global tokens per step
     (each shard trains ``batch_size/n`` tokens of its own strided seed
@@ -87,7 +88,7 @@ def train_moe_transformer_ep(params: MoETransformerParams, seeds,
 
     def moe_fn(wg, w1_local, w2_local, h):
         return moe_layer_ep(wg, w1_local, w2_local, h, capacity_factor,
-                            EXPERT_AXIS, k)
+                            EXPERT_AXIS, k, dispatch)
 
     def step(params: MoETransformerParams, seed) -> MoETransformerParams:
         x, dloss_dx = batch_from_seed(seed, t_local, model_size,

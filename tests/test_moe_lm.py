@@ -132,3 +132,20 @@ def test_moe_lm_validates_max_seq(params):
     with pytest.raises(ValueError, match="max_seq_len"):
         train_moe_lm_dense(params, seeds, 2 * 2 * SEQ, D,
                            seq_len=2 * SEQ, n_heads=HEADS)
+
+
+def test_moe_lm_ep_scatter_dispatch_matches_dense(mesh4_expert):
+    """The GShard-LM step is dispatch-agnostic: scatter == dense through
+    the full objective (xent + router aux)."""
+    params = init_moe_lm(jax.random.PRNGKey(4), V, D, L, E, SEQ)
+    seeds = make_seed_schedule(4, random_seed=9)
+    dense = train_moe_lm_ep(params, seeds, 4 * SEQ * 4, D, mesh4_expert,
+                            lr=0.1, seq_len=SEQ, n_heads=HEADS, k=2,
+                            aux_coef=0.01)
+    scat = train_moe_lm_ep(params, seeds, 4 * SEQ * 4, D, mesh4_expert,
+                           lr=0.1, seq_len=SEQ, n_heads=HEADS, k=2,
+                           aux_coef=0.01, dispatch="scatter")
+    for a, b in zip(jax.tree_util.tree_leaves(scat),
+                    jax.tree_util.tree_leaves(dense)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
