@@ -408,23 +408,19 @@ def tp_generate(params: LMParams, prompt, n_new: int, mesh, *,
     ``all_gather`` of per-shard ``(max, index)`` pairs per position.
     One jitted ``shard_map`` scan decodes the whole batch; the result is
     replicated. Differential-pinned to the single-device ``generate``.
-    The compiled program is cached on the static decode config
-    (``_tp_decode_program``), so repeat decodes don't re-trace."""
+    GQA models compose: the cache is sized by each shard's LOCAL kv
+    heads (``KV % n`` validated), so the inference memory win multiplies
+    with the group factor. The compiled program is cached on the static
+    decode config (``_tp_decode_program``), so repeat decodes don't
+    re-trace."""
     require_axes(mesh, MODEL_AXIS)
     n = mesh.shape[MODEL_AXIS]
     h_local = _validate_tp(params.blocks, n_heads, n)
     if params.vocab % n:
         raise ValueError(f"vocab={params.vocab} not divisible by "
                          f"model-axis size {n}")
-    if params.blocks.wk.shape[1] != params.d_model:
-        # the sharded cache here is sized by query heads; a grouped
-        # (GQA) cache would mis-slot the kv writes — decode GQA models
-        # single-device (models.lm.generate) for now
-        raise ValueError("tp_generate supports full-MHA models only; "
-                         "GQA models decode via generate()")
     prompt = jnp.asarray(prompt)
-    fn = _tp_decode_program(mesh, n_new, n_heads, h_local,
-                            params.vocab // n,
+    fn = _tp_decode_program(mesh, n_new, n_heads, params.vocab // n,
                             params.max_seq_len,
                             params.d_model // n_heads, use_rope)
     sharded = _shard(params, mesh, _lm_tp_specs())
@@ -432,9 +428,8 @@ def tp_generate(params: LMParams, prompt, n_new: int, mesh, *,
 
 
 @functools.lru_cache(maxsize=16)
-def _tp_decode_program(mesh, n_new: int, n_heads: int, h_local: int,
-                       v_local: int, max_t: int, dh: int,
-                       use_rope: bool):
+def _tp_decode_program(mesh, n_new: int, n_heads: int, v_local: int,
+                       max_t: int, dh: int, use_rope: bool):
     """Build (once per static decode config) the jitted shard_map decode
     program ``(sharded_params, prompt) -> tokens``. jax.jit's own cache
     then handles shape-polymorphic re-traces; callers timing repeat
@@ -479,10 +474,16 @@ def _tp_decode_program(mesh, n_new: int, n_heads: int, h_local: int,
 
     def run(p: LMParams, prompt):
         b = prompt.shape[0]
+        # cache sized by the shard's LOCAL kv heads (wk's sharded row
+        # count / dh): GQA shrinks it by the group factor, exactly as in
+        # the single-device decode; contiguous head sharding keeps each
+        # shard's q heads grouped with its own kv heads (KV % n == 0,
+        # validated by _validate_tp)
+        kv_local = p.blocks.wk.shape[1] // dh
         cache = KVCache(
-            k=jnp.zeros((p.blocks.w1.shape[0], b, h_local, max_t, dh),
+            k=jnp.zeros((p.blocks.w1.shape[0], b, kv_local, max_t, dh),
                         p.wpe.dtype),
-            v=jnp.zeros((p.blocks.w1.shape[0], b, h_local, max_t, dh),
+            v=jnp.zeros((p.blocks.w1.shape[0], b, kv_local, max_t, dh),
                         p.wpe.dtype))
         return decode_loop(
             lambda cache, token, pos: decode_step_tp(p, cache, token, pos),

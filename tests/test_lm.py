@@ -422,11 +422,17 @@ def test_gqa_tp_training_works_when_divisible(mesh_model4):
     with pytest.raises(ValueError, match="n_kv_heads=2"):
         train_lm_tp(params2, seeds, 2 * SEQ, D, mesh_model4,
                     seq_len=SEQ, n_heads=HEADS)
-    with pytest.raises(ValueError, match="full-MHA"):
+    with pytest.raises(ValueError, match="n_kv_heads=2"):
         tp_generate(params2, jnp.zeros((1, 2), jnp.int32), 2,
-                    make_mesh({MODEL_AXIS: 2}), n_heads=HEADS)
+                    mesh_model4, n_heads=HEADS)
     # kv=2 over 2 shards: one kv head per shard, groups preserved
     mesh2 = make_mesh({MODEL_AXIS: 2})
+    # GQA decode with the head-sharded cache sized by LOCAL kv heads
+    # (1 per shard) == the single-device decode
+    prompt = jnp.asarray([[3, 1, 4, 1], [2, 7, 1, 8]], jnp.int32)
+    want = generate(params2, prompt, 3, HEADS)
+    got = tp_generate(params2, prompt, 3, mesh2, n_heads=HEADS)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
     single = train_lm_single(params2, seeds, 2 * SEQ, D, seq_len=SEQ,
                              n_heads=HEADS)
     tp = train_lm_tp(params2, seeds, 2 * SEQ, D, mesh2, seq_len=SEQ,
