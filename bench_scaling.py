@@ -213,22 +213,28 @@ def _count_hlo_collectives(hlo: str) -> dict:
             for op in COLLECTIVE_OPS}
 
 
-def main() -> int:
+class UnknownScenarios(ValueError):
+    """A typo'd SCALING_SCENARIOS filter — never a silent empty run."""
+
+
+def collect(wanted=None, emit=None):
+    """Compile + score the scenarios; returns ``(rows, ok)``. Importable
+    so the CI test can run it IN-PROCESS: libtpu's AOT lockfile is held
+    for the life of a process that has compiled, so a pytest process
+    that already ran its own AOT tests cannot delegate this to a
+    subprocess. ``emit`` (e.g. print-a-json-line) streams progress."""
     from distributed_llm_code_samples_tpu.utils import count_async_pairs
     ok = True
     rows = []
-    only = os.environ.get("SCALING_SCENARIOS")  # comma-separated filter
-    wanted = set(only.split(",")) if only else None
     if wanted is not None:
         known = {name for name, _, _ in _scenarios()}
-        unknown = wanted - known
+        unknown = set(wanted) - known
         if unknown:
             # fail loud: a typo'd filter must not produce an empty-but-
             # "ok" artifact
-            print(json.dumps({"error": "unknown SCALING_SCENARIOS",
-                              "unknown": sorted(unknown),
-                              "known": sorted(known)}))
-            return 1
+            raise UnknownScenarios(
+                f"unknown SCALING_SCENARIOS {sorted(unknown)} "
+                f"(known: {sorted(known)})")
     for name, chips, build in _scenarios():
         if wanted is not None and name not in wanted:
             continue
@@ -238,8 +244,11 @@ def main() -> int:
             extra = built[6] if len(built) > 6 else {}
             hlo = _compile_hlo(step, mesh, specs, params)
         except Exception as e:  # noqa: BLE001
-            print(json.dumps({"scenario": name, "chips": chips,
-                              "error": str(e)[:300]}))
+            row = {"scenario": name, "chips": chips,
+                   "error": str(e)[:300]}
+            rows.append(row)
+            if emit:
+                emit(row)
             ok = False
             continue
         counts = {k: v for k, v in _count_hlo_collectives(hlo).items() if v}
@@ -263,7 +272,19 @@ def main() -> int:
             **extra,
         }
         rows.append(row)
-        print(json.dumps(row))
+        if emit:
+            emit(row)
+    return rows, ok
+
+
+def main() -> int:
+    only = os.environ.get("SCALING_SCENARIOS")  # comma-separated filter
+    wanted = set(only.split(",")) if only else None
+    try:
+        rows, ok = collect(wanted, emit=lambda r: print(json.dumps(r)))
+    except UnknownScenarios as e:
+        print(json.dumps({"error": str(e)[:300]}))
+        return 1
     summary = {"summary": "aot_v5e_codegen",
                "anchor_mfu": MEASURED_MFU,
                "v5e_ici_GBps": V5E_ICI_GBPS,

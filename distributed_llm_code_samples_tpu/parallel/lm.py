@@ -415,7 +415,7 @@ def tp_generate(params: LMParams, prompt, n_new: int, mesh, *,
     re-trace."""
     require_axes(mesh, MODEL_AXIS)
     n = mesh.shape[MODEL_AXIS]
-    h_local = _validate_tp(params.blocks, n_heads, n)
+    _validate_tp(params.blocks, n_heads, n)  # heads/kv/ffn divisibility
     if params.vocab % n:
         raise ValueError(f"vocab={params.vocab} not divisible by "
                          f"model-axis size {n}")

@@ -415,30 +415,40 @@ def test_tp_sp_aot_v5e8():
 @pytest.mark.slow
 def test_scaling_harness_headroom_and_bubble():
     """The round's scaling evidence, asserted so regressions break CI:
-    run bench_scaling.py (subprocess, real v5e AOT codegen + roofline) on
+    run bench_scaling's collection (real v5e AOT codegen + roofline) on
     a representative subset and require (a) the north-star FSDP config's
     overlapped-ICI headroom >= 1 at v5e-32, (b) DDP headroom >= 1 at 8
     chips, (c) the pp rows carry bubble fields with the interleaved
-    schedule's bubble strictly below GPipe's at the same M."""
-    import json as _json
-    import subprocess
-    import sys as _sys
-    env = dict(os.environ)
-    env["SCALING_SCENARIOS"] = ("fsdp_d768_L24,ddp_d768_L24,"
-                                "pp_d2048_L8_M2,"
-                                "pp_d2048_L16_M2_interleaved")
-    env.pop("JAX_PLATFORMS", None)
-    r = subprocess.run([_sys.executable, "bench_scaling.py"],
-                       capture_output=True, text=True, env=env,
-                       cwd=os.path.dirname(os.path.dirname(__file__)),
-                       timeout=1200)
-    assert r.returncode == 0, r.stdout + r.stderr
-    rows = [_json.loads(line) for line in r.stdout.splitlines()
-            if line.startswith("{")]
+    schedule's bubble strictly below GPipe's at the same M. Runs
+    IN-PROCESS: libtpu's AOT lockfile is held for the life of a process
+    that compiled, so after this suite's own AOT tests a subprocess
+    would ABORT on the lockfile."""
+    import signal
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench_scaling
+
+    # in-process run loses the old subprocess timeout: bound it so a
+    # hung AOT compile fails this test instead of stalling the suite
+    # (no pytest-timeout plugin in this image; SIGALRM on the main
+    # thread does the job)
+    def _alarm(signum, frame):
+        raise TimeoutError("scaling collect exceeded 1200s")
+
+    old = signal.signal(signal.SIGALRM, _alarm)
+    signal.alarm(1200)
+    try:
+        rows, ok = bench_scaling.collect(wanted={
+            "fsdp_d768_L24", "ddp_d768_L24", "pp_d2048_L8_M2",
+            "pp_d2048_L16_M2_interleaved"})
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+    assert ok, rows
     by_name = {}
     for row in rows:
-        if "scenario" in row:
-            by_name.setdefault(row["scenario"], []).append(row)
+        by_name.setdefault(row["scenario"], []).append(row)
     fsdp32 = [r_ for r_ in by_name["fsdp_d768_L24"] if r_["chips"] == 32]
     assert fsdp32 and fsdp32[0]["headroom_x_overlapped"] >= 1, fsdp32
     ddp8 = [r_ for r_ in by_name["ddp_d768_L24"] if r_["chips"] == 8]
