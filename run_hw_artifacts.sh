@@ -1,0 +1,18 @@
+#!/bin/bash
+# One-shot collection of the round's real-TPU artifacts (run when the
+# axon relay is healthy). Each bench guards its own failures; artifacts
+# land at the repo root for the judge.
+set -u
+cd "$(dirname "$0")"
+echo "== probe =="
+timeout 120 python -c "import jax; print(jax.devices())" || {
+  echo "relay down; aborting"; exit 1; }
+echo "== decode =="
+DECODE_ARTIFACT=DECODE_r03.json timeout 1800 python bench_decode.py
+echo "== attention =="
+ATTN_ARTIFACT=ATTENTION_r03.json timeout 2400 python bench_attention.py
+echo "== moe =="
+MOE_ARTIFACT=MOE_r03.json timeout 2400 python bench_moe.py
+echo "== bench (headline + families + breakdown + pallas) =="
+timeout 3600 python bench.py | tee /tmp/bench_r03_local.json
+echo "== done =="
