@@ -413,27 +413,62 @@ def tp_generate(params: LMParams, prompt, n_new: int, mesh, *,
     with the group factor. The compiled program is cached on the static
     decode config (``_tp_decode_program``), so repeat decodes don't
     re-trace."""
+    return _tp_decode(params, prompt, n_new, mesh, n_heads, use_rope,
+                      temperature=0.0, seed=0)
+
+
+def tp_sample(params: LMParams, prompt, n_new: int, mesh, *,
+              n_heads: int, temperature: float = 1.0, seed: int = 0,
+              use_rope: bool = False) -> jax.Array:
+    """Stochastic Megatron-sharded decode: ``tp_generate``'s program with
+    the pick swapped for a Gumbel-max categorical draw from
+    ``softmax(logits / temperature)`` — an EXACT sample computed without
+    ever materializing softmax probabilities across the vocab-parallel
+    shards (each shard perturbs its local logits with iid Gumbel noise
+    keyed on ``(seed, position, shard)``; the greedy path's tiny
+    ``(max, index)`` all_gather completes the draw). Deterministic given
+    ``seed``; draws differ from the single-device ``sample``'s (a
+    different noise stream), but the DISTRIBUTION is identical."""
+    if temperature <= 0:
+        raise ValueError(f"temperature must be > 0, got {temperature} "
+                         "(use tp_generate for greedy decode)")
+    return _tp_decode(params, prompt, n_new, mesh, n_heads, use_rope,
+                      temperature=float(temperature), seed=seed)
+
+
+def _tp_decode(params, prompt, n_new, mesh, n_heads, use_rope,
+               temperature, seed):
+    """Shared validate-and-launch for the TP decode pair; the seed is a
+    RUNTIME operand (new seeds draw new continuations from the SAME
+    compiled program — no retrace, no cache thrash)."""
     require_axes(mesh, MODEL_AXIS)
     n = mesh.shape[MODEL_AXIS]
     _validate_tp(params.blocks, n_heads, n)  # heads/kv/ffn divisibility
     if params.vocab % n:
         raise ValueError(f"vocab={params.vocab} not divisible by "
                          f"model-axis size {n}")
-    prompt = jnp.asarray(prompt)
     fn = _tp_decode_program(mesh, n_new, n_heads, params.vocab // n,
                             params.max_seq_len,
-                            params.d_model // n_heads, use_rope)
+                            params.d_model // n_heads, use_rope,
+                            temperature=temperature)
     sharded = _shard(params, mesh, _lm_tp_specs())
-    return fn(sharded, prompt)
+    return fn(sharded, jnp.asarray(prompt), jnp.int32(seed))
 
 
 @functools.lru_cache(maxsize=16)
 def _tp_decode_program(mesh, n_new: int, n_heads: int, v_local: int,
-                       max_t: int, dh: int, use_rope: bool):
+                       max_t: int, dh: int, use_rope: bool,
+                       temperature: float = 0.0):
     """Build (once per static decode config) the jitted shard_map decode
     program ``(sharded_params, prompt) -> tokens``. jax.jit's own cache
     then handles shape-polymorphic re-traces; callers timing repeat
-    decodes (bench_decode) hit the compiled program directly."""
+    decodes (bench_decode) hit the compiled program directly.
+    ``temperature > 0`` switches the pick from greedy to an EXACT
+    categorical sample via the Gumbel-max trick: each shard perturbs its
+    local ``logits/T`` with iid Gumbel noise (key folded on
+    ``(seed, position, shard)``) and the SAME tiny ``(max, index)``
+    all_gather that completes the greedy argmax then completes the
+    sample — softmax probabilities never materialize, sharded or not."""
     from ..models.lm import KVCache, decode_loop
 
     def decode_step_tp(p: LMParams, cache: KVCache, token, pos):
@@ -454,15 +489,25 @@ def _tp_decode_program(mesh, n_new: int, n_heads: int, v_local: int,
         logits_local = h @ p.wte.T                           # [B, V/n]
         return logits_local, KVCache(new_k, new_v)
 
-    def pick_global(logits_local):
+    def pick_global(logits_local, pos, seed):
         """argmax over the sharded vocab: each shard offers its local
         ``(max value, global index)`` pair, packed into ONE tiny
         ``[2, B]`` all_gather per position. The pack rides in f32
         regardless of the params' dtype: a bf16 lane would round the
-        index (8-bit mantissa); f32 is exact while vocab < 2^24."""
-        local_best = jnp.argmax(logits_local, axis=-1)       # [B]
+        index (8-bit mantissa); f32 is exact while vocab < 2^24.
+        With ``temperature > 0`` the local values are Gumbel-perturbed
+        first (iid per global vocab index: the key folds in the shard),
+        so the global argmax IS a categorical draw from softmax(z/T)."""
+        z = logits_local
+        if temperature > 0.0:
+            key = jax.random.fold_in(
+                jax.random.fold_in(jax.random.PRNGKey(seed), pos),
+                axis_index(MODEL_AXIS))
+            z = (z.astype(jnp.float32) / temperature
+                 + jax.random.gumbel(key, z.shape, jnp.float32))
+        local_best = jnp.argmax(z, axis=-1)                  # [B]
         local_val = jnp.take_along_axis(
-            logits_local, local_best[:, None], axis=-1)[:, 0]
+            z, local_best[:, None], axis=-1)[:, 0]
         offset = axis_index(MODEL_AXIS) * v_local
         packed = jnp.stack([
             local_val.astype(jnp.float32),
@@ -472,7 +517,7 @@ def _tp_decode_program(mesh, n_new: int, n_heads: int, v_local: int,
         return jnp.take_along_axis(
             g[:, 1, :], win[None], axis=0)[0].astype(jnp.int32)
 
-    def run(p: LMParams, prompt):
+    def run(p: LMParams, prompt, seed):
         b = prompt.shape[0]
         # cache sized by the shard's LOCAL kv heads (wk's sharded row
         # count / dh): GQA shrinks it by the group factor, exactly as in
@@ -488,11 +533,11 @@ def _tp_decode_program(mesh, n_new: int, n_heads: int, v_local: int,
         return decode_loop(
             lambda cache, token, pos: decode_step_tp(p, cache, token, pos),
             cache, prompt, n_new, max_t,
-            lambda z, pos: pick_global(z))
+            lambda z, pos: pick_global(z, pos, seed))
 
     return jax.jit(jax.shard_map(
-        run, mesh=mesh, in_specs=(_lm_tp_specs(), P()), out_specs=P(),
-        check_vma=False))
+        run, mesh=mesh, in_specs=(_lm_tp_specs(), P(), P()),
+        out_specs=P(), check_vma=False))
 
 
 def _lm_state_specs(state, specs):
