@@ -31,6 +31,18 @@ not the microbatch count, which is the whole point of 1F1B: with
 ``M >> S`` the GPipe stash grows linearly while this one is constant
 (pinned by a structural test on the traced program's buffer shapes).
 
+(Why no "interleaved-1f1b" combining both wins: in this lockstep
+uniform-slot model a 1F1B interleave must dilate the slot stream so
+forward and backward land on opposite parities — which doubles the
+fill cost. Worked through: the dilated interleaved schedule runs
+``~2vM + 2vS - S`` chunk-slots with stash ``~S(v+1)/v`` stage-units —
+i.e. 1F1B-class memory at 1F1B-class bubble ``S/(M+S)``, strictly
+worse in time than "interleaved"'s ``2(vM + S - 1)`` and no better in
+bubble than "1f1b". The asynchronous per-rank form Megatron runs does
+beat both simultaneously, but only because its slots are not uniform —
+outside what one SPMD lockstep program expresses. Hence the menu below:
+pick memory OR bubble.)
+
 **"interleaved"**: Megatron virtual stages — each device holds
 ``interleave`` non-contiguous layer chunks placed round-robin
 (virtual stage ``q = c*S + d`` on device ``d``), so every
