@@ -77,3 +77,21 @@ def test_bench_attention_contract():
     assert payload["metric"] == "attn_pallas_vs_xla"
     # numeric, not an error string: a broken flash path must not ship
     assert isinstance(payload["per_T"].get("64"), float), payload
+
+
+@pytest.mark.slow
+def test_train_real_text_contract(tmp_path):
+    """The real-text trainer must emit a falling loss curve, a sampled
+    continuation, and the artifact file — the round's end-to-end
+    capability demo cannot rot silently."""
+    art = str(tmp_path / "textlm.json")
+    payload = _run("train_real_text.py", {
+        "TEXTLM_STEPS": "20", "TEXTLM_SEGMENTS": "2", "TEXTLM_D": "32",
+        "TEXTLM_LAYERS": "1", "TEXTLM_HEADS": "2", "TEXTLM_SEQ": "32",
+        "TEXTLM_BATCH": "4", "TEXTLM_ARTIFACT": art}, timeout=900)
+    assert payload["metric"] == "real_text_lm_final_eval_loss"
+    curve = payload["loss_curve"]
+    assert curve[0]["step"] == 0 and curve[-1]["step"] == 20
+    assert payload["value"] < payload["initial_loss"], curve
+    assert isinstance(payload["sample"], str) and len(payload["sample"])
+    assert os.path.exists(art)
