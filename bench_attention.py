@@ -47,6 +47,8 @@ def _flops(t: int) -> float:
     # included for flash — report against the MODEL's 3x accounting,
     # same numerator for both paths so the ratio is apples-to-apples)
     factor = 0.5 if CAUSAL else 1.0  # causal halves the useful tiles
+    # (bench.py's `families` section uses the SAME causal convention —
+    # 2T^2d score FLOPs, not 4T^2d — so MFU numbers compare directly)
     return 3 * 2 * 2 * t * t * DH * H * factor
 
 

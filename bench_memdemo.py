@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""The reference's headline capability demo, made quantitative: FSDP fits
+where DDP OOMs (``/root/reference/train_ffns.py:8-10`` — ~4.3B params
+fp32 at d=8192, L=8, 8k tokens: trains sharded on 4x24GB GPUs, OOMs
+replicated).
+
+Two pieces of evidence, each guarded so one's failure can't cost the
+other:
+
+1. **v5e-8 AOT verdict** (no chips needed — real TPU compiler against a
+   topology description): the FSDP step's per-chip argument+temp+output
+   bytes fit the 16 GB HBM budget; the SAME compiler refuses the
+   replicated DDP step with RESOURCE_EXHAUSTED, and we parse the "Used
+   X of Y hbm" numbers out of the error — both memory numbers, from the
+   compiler that would run the program.
+2. **On-chip OOM** (real TPU attached): the replicated single-chip step
+   at the same scale actually fails with RESOURCE_EXHAUSTED on the
+   hardware — upgrading the compiler's prediction to an observed fact.
+   (FSDP cannot be shown fitting on ONE chip — 1/8th of 4.3B params is
+   the whole point — so the fitting side stays the AOT number.)
+
+Emits ONE JSON line; written to ``MEMDEMO_ARTIFACT`` when set. libtpu's
+AOT lockfile (/tmp/libtpu_lockfile) is process-wide: do not run this
+concurrently with the test suite's AOT tests.
+
+Smoke-test: ``MEMDEMO_ONCHIP=0 python bench_memdemo.py`` (AOT part only;
+skips cleanly where libtpu AOT is unsupported).
+"""
+
+import json
+import os
+import re
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+# The reference's demo scale (train_ffns.py:8-10): ~4.3B params fp32.
+D_BIG = int(os.environ.get("MEMDEMO_D", 8192))
+L_BIG = int(os.environ.get("MEMDEMO_LAYERS", 8))
+TOKENS = int(os.environ.get("MEMDEMO_TOKENS", 8 * 1024))
+HBM_BYTES = 16 * 2**30  # v5e: 16 GB HBM per chip
+
+
+def _shapes():
+    from distributed_llm_code_samples_tpu.models.ffn_stack import (
+        FFNStackParams)
+    return FFNStackParams(
+        w1=jax.ShapeDtypeStruct((L_BIG, 4 * D_BIG, D_BIG), jnp.float32),
+        w2=jax.ShapeDtypeStruct((L_BIG, D_BIG, 4 * D_BIG), jnp.float32))
+
+
+def _aot_verdict(payload):
+    """v5e-8 AOT: FSDP memory_analysis vs DDP's RESOURCE_EXHAUSTED."""
+    from jax.experimental import topologies
+    from distributed_llm_code_samples_tpu.parallel import (DATA_AXIS, ddp,
+                                                           fsdp)
+    topo = topologies.get_topology_desc(platform="tpu",
+                                        topology_name="v5e:2x4")
+    mesh = Mesh(np.array(topo.devices).reshape(8), (DATA_AXIS,))
+    sp, seed = _shapes(), jax.ShapeDtypeStruct((), jnp.int32)
+
+    f = jax.jit(jax.shard_map(fsdp.make_step(TOKENS, D_BIG, 0.1),
+                              mesh=mesh,
+                              in_specs=(fsdp.PARAM_SPECS, P()),
+                              out_specs=fsdp.PARAM_SPECS))
+    m = f.lower(sp, seed).compile().memory_analysis()
+    fsdp_bytes = (m.argument_size_in_bytes + m.temp_size_in_bytes
+                  + m.output_size_in_bytes)
+    payload["fsdp_v5e8_bytes_per_chip"] = int(fsdp_bytes)
+    payload["fsdp_v5e8_gb_per_chip"] = round(fsdp_bytes / 2**30, 2)
+    payload["fsdp_fits"] = bool(fsdp_bytes <= HBM_BYTES)
+
+    g = jax.jit(jax.shard_map(ddp.make_step(TOKENS, D_BIG, 0.1),
+                              mesh=mesh, in_specs=(P(), P()),
+                              out_specs=P()))
+    try:
+        g.lower(sp, seed).compile()
+        payload["ddp_aot"] = "unexpectedly compiled (no OOM?)"
+    except Exception as exc:  # noqa: BLE001 — RESOURCE_EXHAUSTED expected
+        msg = str(exc)
+        payload["ddp_aot"] = "RESOURCE_EXHAUSTED"
+        used = re.search(r"[Uu]sed ([\d.]+)([GM]) of ([\d.]+)([GM])", msg)
+        if used:
+            scale = {"G": 1.0, "M": 1 / 1024}
+            payload["ddp_used_gb"] = round(
+                float(used.group(1)) * scale[used.group(2)], 2)
+            payload["ddp_budget_gb"] = round(
+                float(used.group(3)) * scale[used.group(4)], 2)
+        else:
+            payload["ddp_error_tail"] = msg[-300:]
+
+
+def _onchip_oom(payload):
+    """Observed single-chip OOM of the replicated step at demo scale."""
+    if jax.devices()[0].platform != "tpu":
+        payload["onchip"] = "skipped: no TPU attached"
+        return
+    from distributed_llm_code_samples_tpu.parallel.single import make_step
+    sp, seed = _shapes(), jax.ShapeDtypeStruct((), jnp.int32)
+    f = jax.jit(make_step(TOKENS, D_BIG, 0.1))
+    try:
+        # compile alone decides: 4.3B params + grads fp32 >> 16 GB HBM
+        f.lower(sp, seed).compile()
+        payload["onchip"] = "unexpectedly compiled (no OOM?)"
+    except Exception as exc:  # noqa: BLE001
+        msg = str(exc)
+        ok = "RESOURCE_EXHAUSTED" in msg or "hbm" in msg.lower()
+        payload["onchip"] = ("RESOURCE_EXHAUSTED observed" if ok
+                             else f"error: {msg[-200:]}")
+
+
+def main() -> int:
+    payload = {
+        "metric": "memdemo_fsdp_fits_where_ddp_ooms",
+        "unit": "bool",
+        "shape": f"d{D_BIG}_L{L_BIG}_tok{TOKENS}_fp32",
+        # w1 [L,4d,d] + w2 [L,d,4d] = 8*L*d^2 floats, 4 bytes each
+        "params_gb": round(8 * L_BIG * D_BIG**2 * 4 / 2**30, 2),
+        "hbm_budget_gb": 16.0,
+    }
+    try:
+        _aot_verdict(payload)
+    except Exception as exc:  # noqa: BLE001 — no libtpu AOT support here
+        payload["aot"] = f"error: {type(exc).__name__}: {str(exc)[:200]}"
+    if os.environ.get("MEMDEMO_ONCHIP", "1") != "0":
+        try:
+            _onchip_oom(payload)
+        except Exception as exc:  # noqa: BLE001
+            payload["onchip"] = f"error: {str(exc)[:200]}"
+    payload["value"] = 1.0 if (payload.get("fsdp_fits")
+                               and payload.get("ddp_aot")
+                               == "RESOURCE_EXHAUSTED") else 0.0
+    print(json.dumps(payload))
+    artifact = os.environ.get("MEMDEMO_ARTIFACT")
+    if artifact:
+        with open(artifact, "w") as f:
+            json.dump(payload, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""Hardware overlap trace: capture a real Perfetto/chrome trace of the
+FSDP training step and verify comm/compute overlap from the observed
+spans — the thing the reference's stream experiment died trying to see
+(``/root/reference/test_torch_cuda_stream.py:31-37``).
+
+What runs: the FSDP step (``parallel/fsdp.make_step``) over a mesh of
+every attached device, traced with ``jax.profiler.trace`` (the CLI
+``--profile_dir`` machinery, ``utils/profiling.py``). The chrome-trace
+JSON is then parsed: spans whose names match collective/DMA activity
+(all-gather / reduce-scatter / copy-start / dma) are intersected against
+compute spans (fusion / convolution / dot) **per device lane** — a
+nonempty intersection is observed overlap, upgrading the AOT
+async-pair proof (``tests/test_observability.py``) to measured behavior.
+
+Caveat recorded in the artifact: on a SINGLE chip the mesh has one
+device, XLA degenerates the collectives, and no collective spans can
+exist — the artifact then reports ``collectives_absent_single_chip`` and
+the compute-span inventory instead (still a real trace from the real
+chip). On any multi-chip attachment the overlap verdict is live.
+
+Emits ONE JSON line; trace directory + artifact written to
+``TRACE_ARTIFACT_DIR`` (default ``trace_artifact``) and
+``TRACE_ARTIFACT`` (default ``TRACE.json`` inside the dir).
+
+Smoke-test: ``BENCH_PLATFORM=cpu TRACE_D=64 TRACE_LAYERS=2
+TRACE_TOKENS=128 python bench_trace.py`` (8 fake devices are set up
+automatically off-TPU so the collectives are real).
+"""
+
+import glob
+import gzip
+import json
+import os
+import sys
+
+if os.environ.get("BENCH_PLATFORM"):
+    # off-TPU smoke: a fake multi-device CPU mesh so collectives exist
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+if os.environ.get("BENCH_PLATFORM"):
+    jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
+D = int(os.environ.get("TRACE_D", 2048))
+L = int(os.environ.get("TRACE_LAYERS", 8))
+TOKENS = int(os.environ.get("TRACE_TOKENS", 4096))
+STEPS = int(os.environ.get("TRACE_STEPS", 8))
+
+_COMM = ("all-gather", "all_gather", "reduce-scatter", "reduce_scatter",
+         "all-reduce", "all_reduce", "copy-start", "collective-permute",
+         "dma")
+_COMPUTE = ("fusion", "dot", "convolution", "matmul")
+
+
+def _spans(trace_dir):
+    """All complete events from the newest chrome trace under trace_dir."""
+    files = sorted(glob.glob(os.path.join(
+        trace_dir, "**", "*.trace.json.gz"), recursive=True),
+        key=os.path.getmtime)
+    if not files:
+        return None, []
+    with gzip.open(files[-1], "rt") as f:
+        events = json.load(f).get("traceEvents", [])
+    return files[-1], [e for e in events
+                       if e.get("ph") == "X" and e.get("name")]
+
+
+def _overlap(spans):
+    """Per-lane comm-vs-compute interval intersection."""
+    comm, compute = [], []
+    for e in spans:
+        name = e["name"].lower()
+        iv = (e.get("pid"), e["ts"], e["ts"] + e.get("dur", 0))
+        if any(k in name for k in _COMM):
+            comm.append(iv)
+        elif any(k in name for k in _COMPUTE):
+            compute.append(iv)
+    overlap_us = 0.0
+    for pid, c0, c1 in comm:
+        for qid, f0, f1 in compute:
+            if pid == qid:
+                overlap_us += max(0.0, min(c1, f1) - max(c0, f0))
+    return len(comm), len(compute), overlap_us
+
+
+def main() -> int:
+    from distributed_llm_code_samples_tpu.data import make_seed_schedule
+    from distributed_llm_code_samples_tpu.models import init_ffn_stack
+    from distributed_llm_code_samples_tpu.parallel import (DATA_AXIS,
+                                                           fsdp, make_mesh)
+    from distributed_llm_code_samples_tpu.utils.benchtime import sync
+
+    out_dir = os.environ.get("TRACE_ARTIFACT_DIR", "trace_artifact")
+    os.makedirs(out_dir, exist_ok=True)
+    n = jax.device_count()
+    mesh = make_mesh({DATA_AXIS: n}) if n > 1 else None
+
+    params = init_ffn_stack(jax.random.PRNGKey(0), D, L)
+    seeds = make_seed_schedule(STEPS, random_seed=1)
+
+    if mesh is not None:
+        sp = fsdp.shard_params(params, mesh)
+        step = fsdp.make_step(TOKENS // n, D, 0.1)
+        run = jax.jit(jax.shard_map(
+            lambda p, ss: lax.scan(lambda c, s: (step(c, s), None),
+                                   p, ss)[0],
+            mesh=mesh, in_specs=(fsdp.PARAM_SPECS, P()),
+            out_specs=fsdp.PARAM_SPECS))
+    else:
+        from distributed_llm_code_samples_tpu.parallel import train_single
+        sp, run = params, (lambda p, ss: train_single(p, ss, TOKENS, D,
+                                                      lr=0.1))
+    sync(run(sp, seeds))  # compile + warm OUTSIDE the trace
+
+    with jax.profiler.trace(out_dir, create_perfetto_trace=True):
+        sync(run(sp, seeds))
+
+    trace_file, spans = _spans(out_dir)
+    n_comm, n_compute, overlap_us = _overlap(spans)
+    payload = {
+        "metric": "fsdp_comm_compute_overlap_us",
+        "value": round(overlap_us, 1),
+        "unit": "us",
+        "devices": n,
+        "shape": f"d{D}_L{L}_tok{TOKENS}_steps{STEPS}",
+        "trace_file": trace_file,
+        "n_spans": len(spans),
+        "comm_spans": n_comm,
+        "compute_spans": n_compute,
+        "device_kind": jax.devices()[0].device_kind,
+    }
+    if n == 1:
+        payload["collectives_absent_single_chip"] = True
+        payload["note"] = ("one attached chip: XLA degenerates the "
+                           "collectives, so overlap cannot be observed; "
+                           "the trace still records the compute lanes")
+    print(json.dumps(payload))
+    artifact = os.environ.get("TRACE_ARTIFACT",
+                              os.path.join(out_dir, "TRACE.json"))
+    with open(artifact, "w") as f:
+        json.dump(payload, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

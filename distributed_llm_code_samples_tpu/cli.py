@@ -115,6 +115,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "all_gather instead of all_reduce)")
     p.add_argument("--dtype", choices=["float32", "bfloat16"],
                    default="float32")
+    p.add_argument("--mixed", action="store_true",
+                   help="bf16 mixed precision for the FFN methods "
+                        "(1/2/3/4/5, incl. --zero1/--tp_sp): bf16 matmul "
+                        "inputs on the MXU, f32 params/grads/accumulation; "
+                        "FSDP additionally gathers its param shards in "
+                        "bf16 (half the collective bytes). Distinct from "
+                        "--dtype bfloat16, which stores the params "
+                        "themselves in bf16")
     p.add_argument("--scan", action="store_true",
                    help="lax.scan over layers instead of unrolling")
     p.add_argument("--accum", type=int, default=1,
@@ -155,7 +163,14 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
+    p = build_parser()
+    args = p.parse_args(argv)
+    if args.mixed and args.pallas:
+        # train_single would raise the same deep in the run; fail at the
+        # flag surface instead (the Pallas block has its own precision
+        # story inside the kernel)
+        p.error("--mixed cannot combine with --pallas: the fused Pallas "
+                "block carries its own residual/precision policy")
 
     if args.fake_devices:
         flags = os.environ.get("XLA_FLAGS", "")
@@ -385,6 +400,8 @@ def main(argv=None) -> int:
         params = params_for(m)
         mesh = mesh_for(m)
         kwargs = dict(lr=lr, unroll=unroll)
+        if m in (1, 2, 3, 4, 5) and args.mixed:
+            kwargs["mixed"] = True  # zero1/tp_sp swaps below keep it
         if m in (1, 2) and args.accum > 1:
             kwargs["accum"] = args.accum  # train_ddp_zero1 accepts it too
         if m in (2, 3) and (args.optimizer != "sgd" or args.zero1
@@ -488,8 +505,11 @@ def main(argv=None) -> int:
         # the reference compares DDP vs FSDP (:386-391); we also pin TP to
         # the single-device oracle (same data schedule). The Pallas kernels'
         # tiled f32 accumulation order differs from plain XLA, so loosen
-        # the tolerance when they computed method 1.
-        rtol, atol = (1e-4, 1e-5) if args.pallas else (1e-5, 1e-7)
+        # the tolerance when they computed method 1; likewise --mixed,
+        # where TP's bf16 contraction is split across shards (the psum
+        # order composes with bf16 rounding).
+        rtol, atol = ((1e-4, 1e-5) if args.pallas else
+                      (2e-2, 1e-4) if args.mixed else (1e-5, 1e-7))
         checks = [("ddp", "fsdp", results[2], results[3], rtol, atol),
                   ("1dev", "tp", results[1], results[4], rtol, atol)]
         if args.method == 9:

@@ -99,7 +99,9 @@ def text_batch_from_seed(seed: jax.Array, batch: int, seq_len: int,
     data = jnp.asarray(load_text_corpus() if corpus is None else corpus)
     key = jax.random.fold_in(jax.random.PRNGKey(_DATA_KEY), seed)
     starts = jax.random.randint(key, (batch,), 0,
-                                data.shape[0] - seq_len - 1)
+                                data.shape[0] - seq_len)  # exclusive: the
+    # last valid window start is len - seq_len - 1, so every seq_len+1
+    # window (incl. the corpus's final byte as a target) is reachable
     idx = starts[:, None] + jnp.arange(seq_len + 1)[None, :]
     seqs = data[idx].astype(jnp.int32)
     return seqs[:, :-1], seqs[:, 1:]

@@ -26,6 +26,7 @@ from .. import LR
 from ..data import batch_from_seed
 from ..models.ffn_stack import FFNStackParams, clone_params
 from ..optim import Optimizer, check_state_args, sgd
+from ..ops.ffn import ffn_bwd_mixed, ffn_fwd_mixed
 from ..ops.stack import accumulated_grads, stack_fwd, stack_bwd
 from .collectives import all_reduce
 from .launcher import launch, launch_strided
@@ -33,18 +34,23 @@ from .mesh import DATA_AXIS, require_axes
 
 
 def grads_for_batch(params: FFNStackParams, x, dy, unroll: bool = True,
-                    grad_hook=None) -> FFNStackParams:
+                    grad_hook=None, mixed: bool = False) -> FFNStackParams:
     """One fwd/bwd over given data — the compute shared by DDP, ZeRO-1,
-    and the gradient-accumulation chunks."""
-    _, acts = stack_fwd(params.w1, params.w2, x, unroll=unroll)
+    and the gradient-accumulation chunks. ``mixed`` swaps the per-block
+    math for the bf16-MXU/f32-accumulate rule (``ops.ffn.ffn_*_mixed``);
+    grads come out f32 either way, so the reduction semantics (SUM,
+    unscaled LR) are unchanged."""
+    kw = ({"block_fwd": ffn_fwd_mixed} if mixed else {})
+    bkw = ({"block_bwd": ffn_bwd_mixed} if mixed else {})
+    _, acts = stack_fwd(params.w1, params.w2, x, unroll=unroll, **kw)
     _, (g1, g2) = stack_bwd(dy, params.w1, params.w2, acts,
-                            grad_hook=grad_hook, unroll=unroll)
+                            grad_hook=grad_hook, unroll=unroll, **bkw)
     return FFNStackParams(g1, g2)
 
 
 def local_grads(params: FFNStackParams, seed, batch_size: int,
                 model_size: int, unroll: bool = True, grad_hook=None,
-                accum: int = 1):
+                accum: int = 1, mixed: bool = False):
     """One shard's step grads from its seed (see ``grads_for_batch``).
 
     ``accum > 1`` sums over token chunks (``ops.stack.accumulated_grads``)
@@ -54,15 +60,17 @@ def local_grads(params: FFNStackParams, seed, batch_size: int,
     x, dloss_dx = batch_from_seed(seed, batch_size, model_size,
                                   params.w1.dtype)
     if accum == 1:
-        return grads_for_batch(params, x, dloss_dx, unroll, grad_hook)
+        return grads_for_batch(params, x, dloss_dx, unroll, grad_hook,
+                               mixed)
     return accumulated_grads(
-        lambda x, dy: grads_for_batch(params, x, dy, unroll),
+        lambda x, dy: grads_for_batch(params, x, dy, unroll, mixed=mixed),
         x, dloss_dx, accum)
 
 
 def make_step(batch_size: int, model_size: int, lr: float = LR,
               unroll: bool = True, axis: str = DATA_AXIS,
-              optimizer: Optimizer | None = None, accum: int = 1):
+              optimizer: Optimizer | None = None, accum: int = 1,
+              mixed: bool = False):
     """One DDP step for one shard: local fwd/bwd with per-layer grad psum.
 
     Without ``optimizer`` the step is the reference's stateless inline SGD
@@ -83,9 +91,9 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
     def grads_of(params, seed):
         if accum == 1:
             return local_grads(params, seed, batch_size, model_size,
-                               unroll, grad_hook)
+                               unroll, grad_hook, mixed=mixed)
         total = local_grads(params, seed, batch_size, model_size, unroll,
-                            accum=accum)
+                            accum=accum, mixed=mixed)
         return jax.tree_util.tree_map(lambda g: all_reduce(g, axis), total)
 
     def step(params: FFNStackParams, seed) -> FFNStackParams:
@@ -101,7 +109,8 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
 def train_ddp(params: FFNStackParams, seeds, batch_size: int,
               model_size: int, mesh, lr: float = LR, unroll: bool = True,
               optimizer: Optimizer | None = None, accum: int = 1,
-              opt_state=None, return_state: bool = False):
+              opt_state=None, return_state: bool = False,
+              mixed: bool = False):
     """Run the full DDP schedule; returns the (replicated) final params.
 
     ``seeds`` is the *global* schedule; the strided split across ranks
@@ -115,10 +124,15 @@ def train_ddp(params: FFNStackParams, seeds, batch_size: int,
     program boundary: a resumed segment continues Adam's statistics
     exactly where a previous segment's returned state left them (the
     checkpoint subsystem's stateful-resume path).
+
+    ``mixed`` runs every block in the bf16-MXU/f32-accumulate policy
+    (``ops.ffn.ffn_fwd_mixed``/``ffn_bwd_mixed``); params, grads, and the
+    psum stay f32, so DDP(mixed) == FSDP(mixed) differentials keep their
+    power.
     """
     require_axes(mesh, DATA_AXIS)
     step = make_step(batch_size, model_size, lr, unroll,
-                     optimizer=optimizer, accum=accum)
+                     optimizer=optimizer, accum=accum, mixed=mixed)
 
     check_state_args(optimizer, opt_state, return_state)
     if optimizer is None:

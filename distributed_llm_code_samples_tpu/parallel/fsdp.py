@@ -33,13 +33,14 @@ model per shard. Verified by compiled memory analysis in the test suite.
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import LR
 from ..data import batch_from_seed
 from ..models.ffn_stack import FFNStackParams, reshard_copy
 from ..optim import Optimizer, check_state_args, sgd
-from ..ops.ffn import ffn_fwd, ffn_bwd
+from ..ops.ffn import ffn_bwd, ffn_bwd_mixed, ffn_fwd, ffn_fwd_mixed
 from ..ops.stack import stack_fwd, stack_bwd
 from .collectives import all_gather, reduce_scatter
 from .launcher import launch_strided
@@ -85,29 +86,45 @@ def checkpoint_shardings(params: FFNStackParams, optimizer: Optimizer,
 
 def make_step(batch_size: int, model_size: int, lr: float = LR,
               unroll: bool = True, axis: str = DATA_AXIS,
-              optimizer: Optimizer | None = None):
+              optimizer: Optimizer | None = None, mixed: bool = False):
     """One FSDP step for one shard (operates on local shard views).
 
     With ``optimizer``, its state is created from — and lives as — the
     LOCAL param shards: ZeRO-3's full story (params, grads, AND
     optimizer state all 1/n per device; the state never needs a
-    collective because the sharded update is elementwise)."""
+    collective because the sharded update is elementwise).
+
+    ``mixed`` is FSDP's best-case precision policy: the per-layer shard
+    gathers ride the wire in **bf16** — HALF the all_gather bytes of the
+    f32 path, on the collective that dominates FSDP's comm volume — and
+    the block math is the bf16-MXU/f32-accumulate rule. Casting before
+    the gather is value-identical to gathering then casting (the cast is
+    elementwise), master shards and the grad reduce_scatter stay f32, so
+    FSDP(mixed) == DDP(mixed) leaf for leaf."""
 
     def gather(w1_shard, w2_shard):
         # train_ffns.py:200-225 — async all_gather of both params of a layer;
-        # tiled concat matches the torch.cat re-assembly (:209).
+        # tiled concat matches the torch.cat re-assembly (:209). Under
+        # `mixed` the shards are cast bf16 BEFORE the gather: half the
+        # bytes on the wire, same gathered values.
+        if mixed:
+            w1_shard = w1_shard.astype(jnp.bfloat16)
+            w2_shard = w2_shard.astype(jnp.bfloat16)
         return (all_gather(w1_shard, axis, dim=0),
                 all_gather(w2_shard, axis, dim=0))
 
+    fwd = ffn_fwd_mixed if mixed else ffn_fwd
+    bwd = ffn_bwd_mixed if mixed else ffn_bwd
+
     def block_fwd(w1_shard, w2_shard, x):
         w1, w2 = gather(w1_shard, w2_shard)
-        return ffn_fwd(w1, w2, x)
+        return fwd(w1, w2, x)
 
     def block_bwd(dy, w1_shard, w2_shard, x):
         # Backward re-gathers the layer (train_ffns.py:245-249); the gathered
         # full params are transient, never stored.
         w1, w2 = gather(w1_shard, w2_shard)
-        return ffn_bwd(dy, w1, w2, x)
+        return bwd(dy, w1, w2, x)
 
     def grad_hook(dw1, dw2):
         # The VJP of all_gather is reduce_scatter: full grads -> summed shard
@@ -140,7 +157,7 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
 def train_fsdp(params: FFNStackParams, seeds, batch_size: int,
                model_size: int, mesh, lr: float = LR, unroll: bool = True,
                optimizer: Optimizer | None = None, opt_state=None,
-               return_state: bool = False):
+               return_state: bool = False, mixed: bool = False):
     """Run the full FSDP schedule; returns final params as a global array
     (re-assembly is implicit in the output sharding — no host-side concat
     like ``train_ffns.py:284-287`` is needed). ``optimizer`` runs a
@@ -159,7 +176,7 @@ def train_fsdp(params: FFNStackParams, seeds, batch_size: int,
             "implicit requirement)")
     params = shard_params(params, mesh)
     step = make_step(batch_size, model_size, lr, unroll,
-                     optimizer=optimizer)
+                     optimizer=optimizer, mixed=mixed)
 
     check_state_args(optimizer, opt_state, return_state)
     if optimizer is None:

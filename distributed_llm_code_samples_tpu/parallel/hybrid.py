@@ -23,7 +23,7 @@ from .. import LR
 from ..data import batch_from_seed
 from ..models.ffn_stack import FFNStackParams, reshard_copy
 from ..optim import sgd
-from ..ops.ffn import ffn_fwd, ffn_bwd
+from ..ops.ffn import ffn_bwd, ffn_bwd_mixed, ffn_fwd, ffn_fwd_mixed
 from ..ops.stack import stack_fwd, stack_bwd
 from .collectives import all_reduce
 from .launcher import launch_strided
@@ -40,12 +40,15 @@ def shard_params(params: FFNStackParams, mesh) -> FFNStackParams:
 
 
 def make_step(batch_size: int, model_size: int, lr: float = LR,
-              unroll: bool = True):
+              unroll: bool = True, mixed: bool = False):
+    fwd = ffn_fwd_mixed if mixed else ffn_fwd
+    bwd = ffn_bwd_mixed if mixed else ffn_bwd
+
     def block_fwd(w1_shard, w2_shard, x):
-        return all_reduce(ffn_fwd(w1_shard, w2_shard, x), MODEL_AXIS)
+        return all_reduce(fwd(w1_shard, w2_shard, x), MODEL_AXIS)
 
     def block_bwd(dy, w1_shard, w2_shard, x):
-        dx, grads = ffn_bwd(dy, w1_shard, w2_shard, x)
+        dx, grads = bwd(dy, w1_shard, w2_shard, x)
         return all_reduce(dx, MODEL_AXIS), grads
 
     def grad_hook(dw1, dw2):
@@ -67,16 +70,17 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
 
 def train_hybrid(params: FFNStackParams, seeds, batch_size: int,
                  model_size: int, mesh, lr: float = LR,
-                 unroll: bool = True) -> FFNStackParams:
+                 unroll: bool = True, mixed: bool = False) -> FFNStackParams:
     """Run the full hybrid schedule on a mesh with ``"data"`` and ``"model"``
-    axes. Seeds are strided across ``"data"`` only."""
+    axes. Seeds are strided across ``"data"`` only. ``mixed`` selects the
+    bf16-MXU block rule on both axes' composition."""
     require_axes(mesh, DATA_AXIS, MODEL_AXIS)
     tp = mesh.shape[MODEL_AXIS]
     if params.w1.shape[1] % tp:
         raise ValueError(f"ffn_dim {params.w1.shape[1]} not divisible by "
                          f"{tp} model shards")
     params = shard_params(params, mesh)
-    step = make_step(batch_size, model_size, lr, unroll)
+    step = make_step(batch_size, model_size, lr, unroll, mixed=mixed)
 
     return launch_strided(step, params, seeds, mesh, DATA_AXIS,
                           PARAM_SPECS)

@@ -29,7 +29,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import LR
 from ..data import lm_batch_from_seed
@@ -109,7 +109,13 @@ def train_lm_single(params: LMParams, seeds, batch_size: int,
     through the scan and segments resume exactly. ``batch_fn(seed) ->
     (tokens, targets)`` swaps the synthetic data source for a real one
     (e.g. ``data.text_batch_from_seed`` windows over the embedded
-    corpus)."""
+    corpus).
+
+    Compile-cache caveat: ``optimizer`` and ``batch_fn`` are STATIC jit
+    arguments hashed by identity — reuse the SAME objects across calls
+    (segmented runs, checkpoint resume, bench loops). A fresh lambda or
+    optimizer per call silently recompiles every call and grows the jit
+    cache."""
     _validate_lm(batch_size, seq_len, model_size, n_heads, params)
     check_state_args(optimizer, opt_state, return_state)
 
@@ -436,11 +442,33 @@ def tp_sample(params: LMParams, prompt, n_new: int, mesh, *,
                       temperature=float(temperature), seed=seed)
 
 
+def tp_shard_params(params: LMParams, mesh) -> LMParams:
+    """Lay the LM params out in the Megatron decode layout (vocab/head
+    sharded) ONCE. ``tp_generate``/``tp_sample`` detect the layout and
+    skip their per-call reshard copy, so repeat decodes (serving loops,
+    ``bench_decode``) pay neither a retrace (the program is cached) nor
+    a per-call host-side param copy."""
+    require_axes(mesh, MODEL_AXIS)
+    return _shard(params, mesh, _lm_tp_specs())
+
+
+def _tp_sharded_already(params: LMParams, mesh) -> bool:
+    """True iff every param leaf already carries the exact decode
+    NamedSharding (as produced by ``tp_shard_params``)."""
+    specs = jax.tree_util.tree_leaves(
+        _lm_tp_specs(), is_leaf=lambda v: isinstance(v, P))
+    leaves = jax.tree_util.tree_leaves(params)
+    return len(leaves) == len(specs) and all(
+        getattr(a, "sharding", None) == NamedSharding(mesh, s)
+        for a, s in zip(leaves, specs))
+
+
 def _tp_decode(params, prompt, n_new, mesh, n_heads, use_rope,
                temperature, seed):
     """Shared validate-and-launch for the TP decode pair; the seed is a
     RUNTIME operand (new seeds draw new continuations from the SAME
-    compiled program — no retrace, no cache thrash)."""
+    compiled program — no retrace, no cache thrash). Params already in
+    the ``tp_shard_params`` layout skip the reshard copy."""
     require_axes(mesh, MODEL_AXIS)
     n = mesh.shape[MODEL_AXIS]
     _validate_tp(params.blocks, n_heads, n)  # heads/kv/ffn divisibility
@@ -451,7 +479,8 @@ def _tp_decode(params, prompt, n_new, mesh, n_heads, use_rope,
                             params.max_seq_len,
                             params.d_model // n_heads, use_rope,
                             temperature=temperature)
-    sharded = _shard(params, mesh, _lm_tp_specs())
+    sharded = (params if _tp_sharded_already(params, mesh)
+               else _shard(params, mesh, _lm_tp_specs()))
     return fn(sharded, jnp.asarray(prompt), jnp.int32(seed))
 
 

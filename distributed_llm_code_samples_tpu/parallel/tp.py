@@ -27,7 +27,7 @@ from .. import LR
 from ..data import batch_from_seed
 from ..models.ffn_stack import FFNStackParams, reshard_copy
 from ..optim import sgd
-from ..ops.ffn import ffn_fwd, ffn_bwd
+from ..ops.ffn import ffn_bwd, ffn_bwd_mixed, ffn_fwd, ffn_fwd_mixed
 from ..ops.stack import stack_fwd, stack_bwd
 from .collectives import all_gather, all_reduce, axis_index, reduce_scatter
 from .launcher import launch
@@ -46,16 +46,24 @@ def shard_params(params: FFNStackParams, mesh) -> FFNStackParams:
 
 
 def make_step(batch_size: int, model_size: int, lr: float = LR,
-              unroll: bool = True, axis: str = MODEL_AXIS):
+              unroll: bool = True, axis: str = MODEL_AXIS,
+              mixed: bool = False):
+    # `mixed` swaps the local block math for the bf16-MXU/f32-accumulate
+    # rule; the per-layer psums carry f32 partials (each rank's
+    # contraction slice accumulates f32), so the Megatron reduction
+    # semantics are unchanged.
+    fwd = ffn_fwd_mixed if mixed else ffn_fwd
+    bwd = ffn_bwd_mixed if mixed else ffn_bwd
+
     def block_fwd(w1_shard, w2_shard, x):
         # Partial y per rank, then sync all_reduce(SUM) — train_ffns.py:302-303.
-        return all_reduce(ffn_fwd(w1_shard, w2_shard, x), axis)
+        return all_reduce(fwd(w1_shard, w2_shard, x), axis)
 
     def block_bwd(dy, w1_shard, w2_shard, x):
         # Local VJP on the shard, then all_reduce the input grad — :308-309.
         # The recompute of the (local slice of the) pre-activation happens
-        # inside ffn_bwd, same as the reference's per-rank recompute.
-        dx, grads = ffn_bwd(dy, w1_shard, w2_shard, x)
+        # inside the block bwd, same as the reference's per-rank recompute.
+        dx, grads = bwd(dy, w1_shard, w2_shard, x)
         return all_reduce(dx, axis), grads
 
     def step(params: FFNStackParams, seed) -> FFNStackParams:
@@ -73,7 +81,7 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
 
 def make_sp_step(batch_size: int, model_size: int, n_shards: int,
                  lr: float = LR, unroll: bool = True,
-                 axis: str = MODEL_AXIS):
+                 axis: str = MODEL_AXIS, mixed: bool = False):
     """Megatron *sequence-parallel* TP (Korthikanti et al.): between
     blocks the activation stream lives **token-sharded** (``[T/n, d]``
     per rank) instead of replicated, and each per-layer-per-direction
@@ -94,16 +102,18 @@ def make_sp_step(batch_size: int, model_size: int, n_shards: int,
                          f"{n_shards} model shards (sequence-parallel TP "
                          "shards the token dim between blocks)")
     t_local = batch_size // n_shards
+    fwd = ffn_fwd_mixed if mixed else ffn_fwd
+    bwd = ffn_bwd_mixed if mixed else ffn_bwd
 
     def block_fwd(w1_shard, w2_shard, x_s):
         full = all_gather(x_s, axis, dim=0)              # [T, d]
-        part = ffn_fwd(w1_shard, w2_shard, full)         # partial over ffn
+        part = fwd(w1_shard, w2_shard, full)             # partial over ffn
         return reduce_scatter(part, axis, dim=0)         # [T/n, d], summed
 
     def block_bwd(dy_s, w1_shard, w2_shard, x_s):
         full = all_gather(x_s, axis, dim=0)      # recomputed, not saved
         dy_full = all_gather(dy_s, axis, dim=0)  # reduce_scatter transpose
-        dx_full, grads = ffn_bwd(dy_full, w1_shard, w2_shard, full)
+        dx_full, grads = bwd(dy_full, w1_shard, w2_shard, full)
         # all_gather transpose: scatter AND sum the rank-partial dx
         return reduce_scatter(dx_full, axis, dim=0), grads
 
@@ -126,7 +136,7 @@ def make_sp_step(batch_size: int, model_size: int, n_shards: int,
 
 def train_tp_sp(params: FFNStackParams, seeds, batch_size: int,
                 model_size: int, mesh, lr: float = LR,
-                unroll: bool = True) -> FFNStackParams:
+                unroll: bool = True, mixed: bool = False) -> FFNStackParams:
     """Sequence-parallel Megatron TP (see ``make_sp_step``). Data is
     replicated like plain TP (each rank regenerates the step's batch and
     slices its token block), so ``train_tp_sp == train_tp == single`` —
@@ -137,7 +147,7 @@ def train_tp_sp(params: FFNStackParams, seeds, batch_size: int,
         raise ValueError(f"ffn_dim {params.w1.shape[1]} not divisible by "
                          f"{n} model shards")
     params = shard_params(params, mesh)
-    step = make_sp_step(batch_size, model_size, n, lr, unroll)
+    step = make_sp_step(batch_size, model_size, n, lr, unroll, mixed=mixed)
 
     # check_vma off: reduce_scatter of a varying partial and the final
     # replicated-params claim mirror zero1's situation (launcher.launch)
@@ -148,11 +158,13 @@ def train_tp_sp(params: FFNStackParams, seeds, batch_size: int,
 
 def train_tp(params: FFNStackParams, seeds, batch_size: int,
              model_size: int, mesh, lr: float = LR,
-             unroll: bool = True) -> FFNStackParams:
+             unroll: bool = True, mixed: bool = False) -> FFNStackParams:
     """Run the full TP schedule. Data (seeds) is replicated to all shards
     (``train_ffns.py:324``), so TP consumes the *same* steps as the
     single-device run — they must agree numerically (a differential test
-    the reference never asserted)."""
+    the reference never asserted). ``mixed`` runs the bf16-MXU block rule
+    (to tolerance vs the f32 path: the contraction is split across
+    shards, so bf16 rounding composes with the psum order)."""
     import jax.numpy as jnp
 
     require_axes(mesh, MODEL_AXIS)
@@ -161,7 +173,7 @@ def train_tp(params: FFNStackParams, seeds, batch_size: int,
         raise ValueError(f"ffn_dim {params.w1.shape[1]} not divisible by "
                          f"{n} model shards")
     params = shard_params(params, mesh)
-    step = make_step(batch_size, model_size, lr, unroll)
+    step = make_step(batch_size, model_size, lr, unroll, mixed=mixed)
 
     return launch(step, params, jnp.asarray(seeds), mesh,
                   param_specs=PARAM_SPECS, seed_spec=P())

@@ -50,7 +50,8 @@ from .mesh import DATA_AXIS, require_axes
 
 def make_step(batch_size: int, model_size: int, n_shards: int,
               lr: float = LR, unroll: bool = True, axis: str = DATA_AXIS,
-              optimizer: Optimizer | None = None, accum: int = 1):
+              optimizer: Optimizer | None = None, accum: int = 1,
+              mixed: bool = False):
     """One ZeRO-1 step for one shard: ``((params, state), seed) ->
     (params, state)`` with ``state`` covering only this rank's layers.
     ``accum`` gradient-accumulates local grads over token chunks before
@@ -68,7 +69,7 @@ def make_step(batch_size: int, model_size: int, n_shards: int,
     def step(carry, seed):
         params, state = carry
         grads = local_grads(params, seed, batch_size, model_size, unroll,
-                            accum=accum)
+                            accum=accum, mixed=mixed)
         # SUM-reduce AND partition in one collective: rank r receives the
         # summed grads of its own layers only (train_ffns.py:165 SUM
         # semantics; ZeRO's reduce-scatter observation)
@@ -87,7 +88,8 @@ def train_ddp_zero1(params: FFNStackParams, seeds, batch_size: int,
                     model_size: int, mesh, lr: float = LR,
                     unroll: bool = True,
                     optimizer: Optimizer | None = None,
-                    accum: int = 1) -> FFNStackParams:
+                    accum: int = 1,
+                    mixed: bool = False) -> FFNStackParams:
     """Run the ZeRO-1 schedule; returns the (replicated) final params.
 
     ``optimizer`` defaults to ``optim.adam()`` — the state-heavy case
@@ -103,7 +105,8 @@ def train_ddp_zero1(params: FFNStackParams, seeds, batch_size: int,
             f"{n_layers} layers not divisible across {n} ranks: ZeRO-1 "
             "partitions optimizer state in whole-layer units")
     step, shard_of, opt = make_step(batch_size, model_size, n, lr, unroll,
-                                    optimizer=optimizer, accum=accum)
+                                    optimizer=optimizer, accum=accum,
+                                    mixed=mixed)
 
     # check_vma off: the re-assembled params are replicated by construction
     # (every rank all_gathers the same disjoint slices) but typed varying —

@@ -1,18 +1,24 @@
 #!/bin/bash
 # One-shot collection of the round's real-TPU artifacts (run when the
 # axon relay is healthy). Each bench guards its own failures; artifacts
-# land at the repo root for the judge.
+# land at the repo root for the judge. ROUND env picks the artifact
+# suffix (default r04).
 set -u
 cd "$(dirname "$0")"
+R="${ROUND:-r04}"
 echo "== probe =="
 timeout 120 python -c "import jax; print(jax.devices())" || {
   echo "relay down; aborting"; exit 1; }
 echo "== decode =="
-DECODE_ARTIFACT=DECODE_r03.json timeout 1800 python bench_decode.py
+DECODE_ARTIFACT=DECODE_${R}.json timeout 1800 python bench_decode.py
 echo "== attention =="
-ATTN_ARTIFACT=ATTENTION_r03.json timeout 2400 python bench_attention.py
+ATTN_ARTIFACT=ATTENTION_${R}.json timeout 2400 python bench_attention.py
 echo "== moe =="
-MOE_ARTIFACT=MOE_r03.json timeout 2400 python bench_moe.py
+MOE_ARTIFACT=MOE_${R}.json timeout 2400 python bench_moe.py
+echo "== memory demo =="
+MEMDEMO_ARTIFACT=MEMDEMO_${R}.json timeout 1800 python bench_memdemo.py || true
+echo "== overlap trace =="
+TRACE_ARTIFACT_DIR=trace_${R} timeout 1800 python bench_trace.py || true
 echo "== bench (headline + families + breakdown + pallas) =="
-timeout 3600 python bench.py | tee /tmp/bench_r03_local.json
+timeout 3600 python bench.py | tee /tmp/bench_${R}_local.json
 echo "== done =="

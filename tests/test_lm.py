@@ -712,6 +712,24 @@ def test_tp_generate_matches_single_device(mesh_model4):
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+def test_tp_generate_presharded_skips_copy_and_matches(mesh_model4):
+    """tp_shard_params once + tp_generate = the same tokens as handing
+    tp_generate unsharded params, and the presharded layout is detected
+    (no per-call reshard copy — the ADVICE r3 bench_decode fix)."""
+    from distributed_llm_code_samples_tpu.parallel import (tp_generate,
+                                                           tp_shard_params)
+    from distributed_llm_code_samples_tpu.parallel.lm import (
+        _tp_sharded_already)
+    params = small_lm(seed=12)
+    prompt = jax.random.randint(jax.random.PRNGKey(14), (2, 3), 0, V)
+    want = tp_generate(params, prompt, 5, mesh_model4, n_heads=HEADS)
+    sharded = tp_shard_params(params, mesh_model4)
+    assert _tp_sharded_already(sharded, mesh_model4)
+    assert not _tp_sharded_already(params, mesh_model4)
+    got = tp_generate(sharded, prompt, 5, mesh_model4, n_heads=HEADS)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
 def test_generate_is_prompt_length_oblivious():
     """One compiled program serves any prompt split of the same total:
     feeding a longer prompt whose extra tokens are exactly the greedy

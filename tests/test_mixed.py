@@ -1,0 +1,191 @@
+"""bf16 mixed precision through the distributed strategies (VERDICT r3 #3).
+
+The policy (``ops.ffn.ffn_fwd_mixed``/``ffn_bwd_mixed``): bf16 matmul
+inputs on the MXU, f32 params/grads/accumulation, recompute-style
+backward. Because grads come out f32 and the reductions are unchanged,
+the distributed differentials keep their power in mixed mode:
+
+- DDP(mixed) == FSDP(mixed) — the reference's --method 0 assert
+  (``train_ffns.py:386-391``) holds under the bf16 policy too;
+- TP(mixed) == single(mixed) to reduction-order tolerance (the bf16
+  products are identical value-for-value; only the f32 partial-sum
+  order differs between one full contraction and per-shard psum);
+- FSDP's shard gathers ride the wire in bf16 — HALF the collective
+  bytes — asserted structurally in the lowered HLO.
+"""
+
+import re
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distributed_llm_code_samples_tpu.data import make_seed_schedule
+from distributed_llm_code_samples_tpu.models import init_ffn_stack
+from distributed_llm_code_samples_tpu.ops.ffn import (ffn_block_mixed,
+                                                      ffn_bwd_mixed,
+                                                      ffn_fwd_mixed)
+from distributed_llm_code_samples_tpu.parallel import (
+    make_mesh, train_single, train_ddp, train_ddp_zero1, train_fsdp,
+    train_tp, train_tp_sp, train_hybrid, DATA_AXIS, MODEL_AXIS)
+from distributed_llm_code_samples_tpu.parallel import fsdp
+from distributed_llm_code_samples_tpu.utils.hlo import lowered_text
+
+D, L, B, S = 64, 3, 32, 8
+LR_TEST = 0.1
+
+
+@pytest.fixture(scope="module")
+def setup():
+    params = init_ffn_stack(jax.random.PRNGKey(42), D, L)
+    seeds = make_seed_schedule(S, random_seed=7)
+    return params, seeds
+
+
+def _close(a, b, rtol, atol):
+    np.testing.assert_allclose(np.asarray(a.w1), np.asarray(b.w1),
+                               rtol=rtol, atol=atol)
+    np.testing.assert_allclose(np.asarray(a.w2), np.asarray(b.w2),
+                               rtol=rtol, atol=atol)
+
+
+def test_pair_form_matches_custom_vjp_block():
+    """ffn_fwd_mixed/ffn_bwd_mixed (the hook-surface dialect) produce
+    bit-identical outputs and grads to ffn_block_mixed (the custom_vjp
+    form the single-device trainer uses) — one math, two dialects."""
+    k = jax.random.PRNGKey(0)
+    w1 = jax.random.normal(jax.random.fold_in(k, 0), (4 * D, D)) * 0.02
+    w2 = jax.random.normal(jax.random.fold_in(k, 1), (D, 4 * D)) * 0.02
+    x = jax.random.normal(jax.random.fold_in(k, 2), (B, D))
+    dy = jax.random.normal(jax.random.fold_in(k, 3), (B, D))
+
+    y_pair = ffn_fwd_mixed(w1, w2, x)
+    dx_pair, (dw1_pair, dw2_pair) = ffn_bwd_mixed(dy, w1, w2, x)
+
+    y_blk, vjp = jax.vjp(ffn_block_mixed, w1, w2, x)
+    dw1_blk, dw2_blk, dx_blk = vjp(dy)
+
+    np.testing.assert_array_equal(np.asarray(y_pair), np.asarray(y_blk))
+    np.testing.assert_array_equal(np.asarray(dx_pair), np.asarray(dx_blk))
+    np.testing.assert_array_equal(np.asarray(dw1_pair), np.asarray(dw1_blk))
+    np.testing.assert_array_equal(np.asarray(dw2_pair), np.asarray(dw2_blk))
+
+
+def test_mixed_close_to_f32_but_distinct(setup):
+    """Sanity bracket: the bf16 policy tracks the f32 oracle (same math,
+    lower precision) but actually runs in bf16 — the results must differ
+    beyond f32 tolerance, or `mixed` silently fell back to f32."""
+    params, seeds = setup
+    f32 = train_single(params, seeds, B, D, lr=LR_TEST)
+    mx = train_single(params, seeds, B, D, lr=LR_TEST, mixed=True)
+    _close(f32, mx, rtol=0.1, atol=1e-3)
+    assert not np.allclose(np.asarray(f32.w1), np.asarray(mx.w1),
+                           rtol=1e-6, atol=1e-8)
+
+
+def test_ddp_mixed_matches_fsdp_mixed(setup, mesh4):
+    """The reference's core differential under the bf16 policy: per-rank
+    grads are identical f32 values, DDP all_reduces them where FSDP
+    reduce_scatters — same sums, same updates."""
+    params, seeds = setup
+    p_ddp = train_ddp(params, seeds, B, D, mesh4, lr=LR_TEST, mixed=True)
+    p_fsdp = train_fsdp(params, seeds, B, D, mesh4, lr=LR_TEST, mixed=True)
+    _close(p_ddp, p_fsdp, rtol=1e-5, atol=1e-7)
+
+
+def test_tp_mixed_matches_single_mixed(setup, mesh_model4):
+    """TP(mixed) == single(mixed) to reduction-order tolerance: every
+    bf16 product is value-identical (w1 is column-parallel, so each
+    shard's h slice is the full-d contraction; the bf16 casts commute
+    with slicing); only the f32 accumulation order of the row-parallel
+    w2 contraction differs (per-shard sums + psum vs one dot)."""
+    params, seeds = setup
+    single = train_single(params, seeds, B, D, lr=LR_TEST, mixed=True)
+    p_tp = train_tp(params, seeds, B, D, mesh_model4, lr=LR_TEST,
+                    mixed=True)
+    _close(single, p_tp, rtol=1e-4, atol=1e-6)
+
+
+def test_tp_sp_mixed_matches_single_mixed(setup, mesh_model4):
+    """Sequence-parallel TP under the bf16 policy: the gather/scatter
+    decomposition changes comms and memory shape, never the math."""
+    params, seeds = setup
+    single = train_single(params, seeds, B, D, lr=LR_TEST, mixed=True)
+    sp = train_tp_sp(params, seeds, B, D, mesh_model4, lr=LR_TEST,
+                     mixed=True)
+    _close(single, sp, rtol=1e-4, atol=1e-6)
+
+
+def test_hybrid_mixed_matches_ddp_mixed(setup, mesh4x2):
+    """hybrid(4x2, mixed) == DDP(4, mixed): TP is an exact decomposition
+    modulo f32 reduction order, so only the data axis affects the math."""
+    params, seeds = setup
+    mesh_ddp = make_mesh({DATA_AXIS: 4})
+    p_ddp = train_ddp(params, seeds, B, D, mesh_ddp, lr=LR_TEST,
+                      mixed=True)
+    p_hy = train_hybrid(params, seeds, B, D, mesh4x2, lr=LR_TEST,
+                        mixed=True)
+    _close(p_ddp, p_hy, rtol=1e-4, atol=1e-6)
+
+
+def test_zero1_mixed_matches_ddp_mixed(setup, mesh4):
+    """ZeRO-1's state sharding is orthogonal to the precision policy."""
+    from distributed_llm_code_samples_tpu.optim import momentum
+    _, seeds = setup
+    # ZeRO-1 partitions whole layers: L must divide the rank count
+    params = init_ffn_stack(jax.random.PRNGKey(43), D, 4)
+    p_ddp = train_ddp(params, seeds, B, D, mesh4, lr=LR_TEST,
+                      optimizer=momentum(), mixed=True)
+    p_z1 = train_ddp_zero1(params, seeds, B, D, mesh4, lr=LR_TEST,
+                           optimizer=momentum(), mixed=True)
+    _close(p_ddp, p_z1, rtol=1e-5, atol=1e-7)
+
+
+def test_ddp_mixed_accum_matches_unchunked(setup, mesh4):
+    """Gradient accumulation under the bf16 policy: per-row bf16 math is
+    chunk-invariant (rows are independent), so only the f32 token-sum
+    order differs."""
+    params, seeds = setup
+    one = train_ddp(params, seeds, B, D, mesh4, lr=LR_TEST, mixed=True)
+    two = train_ddp(params, seeds, B, D, mesh4, lr=LR_TEST, mixed=True,
+                    accum=2)
+    _close(one, two, rtol=1e-5, atol=1e-7)
+
+
+def test_fsdp_mixed_gathers_in_bf16(mesh4):
+    """The comm win, asserted structurally: every all_gather in the mixed
+    FSDP step moves bf16 — half the bytes of the f32 path's gathers."""
+    params = init_ffn_stack(jax.random.PRNGKey(0), D, L)
+    sp = fsdp.shard_params(params, mesh4)
+    f = jax.shard_map(fsdp.make_step(B, D, 0.1, mixed=True), mesh=mesh4,
+                      in_specs=(fsdp.PARAM_SPECS, P()),
+                      out_specs=fsdp.PARAM_SPECS)
+    text = lowered_text(f, sp, jax.numpy.int32(3))
+    gather_lines = [ln for ln in text.splitlines()
+                    if re.search(r"all_gather", ln)]
+    assert gather_lines, "no all_gather in the mixed FSDP step?"
+    for ln in gather_lines:
+        assert "bf16" in ln, f"f32 gather survived in mixed mode: {ln}"
+    # and the grad reduce_scatters stay f32 (master-grad exactness) —
+    # the op's result type sits on a continuation line, so check the
+    # whole text: a bf16 reduce_scatter anywhere would mean the grads
+    # were demoted
+    assert "reduce_scatter" in text
+    assert not re.search(r"reduce_scatter.{0,400}?bf16", text, re.S)
+
+
+def test_cli_mixed_flag_verifies(tmp_path):
+    """--method 0 --mixed --strict on the fake 8-device mesh: all four
+    core strategies run the bf16 policy and still cross-verify."""
+    import os
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "-m", "distributed_llm_code_samples_tpu.cli",
+         "-m", "0", "-s", "8", "-bs", "4", "-n", "8", "-l", "2", "-d",
+         "32", "--mixed", "--strict", "--fake_devices", "8"],
+        capture_output=True, text=True, timeout=600, cwd=repo)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "SoftAssertionError" not in r.stdout
