@@ -6,20 +6,22 @@ TPU-native counterpart of the reference's ops layer (``train_ffns.py:33-94``).
 from .linear import init_linear, linear_fwd, linear_bwd
 from .activations import relu_fwd, relu_bwd
 from .ffn import (ffn_fwd, ffn_bwd, ffn_block, ffn_bwd_saved,
-                  ffn_block_saved, ffn_block_mixed)
+                  ffn_block_saved, ffn_block_mixed, ffn_fwd_mixed,
+                  ffn_bwd_mixed)
 from .stack import stack_fwd, stack_bwd, stack_grads
 from .moe import (expert_capacity, route_top1, dispatch_tensor, moe_layer,
                   moe_stack_fwd)
 from .norm import ln_fwd, ln_bwd, layernorm
 from .xent import xent_fwd, xent_bwd, xent_loss
-# Pallas modules (pallas_ffn, pallas_attention) stay off the eager import
-# path — import them at call sites like parallel/single.py does.
+# Pallas modules (pallas_ffn, pallas_attention, pallas_ring) stay off the
+# eager import path — import them at call sites like parallel/single.py
+# does.
 
 __all__ = [
     "init_linear", "linear_fwd", "linear_bwd",
     "relu_fwd", "relu_bwd",
     "ffn_fwd", "ffn_bwd", "ffn_block", "ffn_bwd_saved", "ffn_block_saved",
-    "ffn_block_mixed",
+    "ffn_block_mixed", "ffn_fwd_mixed", "ffn_bwd_mixed",
     "stack_fwd", "stack_bwd", "stack_grads",
     "expert_capacity", "route_top1", "dispatch_tensor", "moe_layer",
     "moe_stack_fwd",

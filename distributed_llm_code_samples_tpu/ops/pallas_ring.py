@@ -1,0 +1,283 @@
+"""Hand-scheduled ICI collectives on Pallas remote DMA — the explicit-
+control escape hatch (SURVEY.md §2.7, last ledger row).
+
+Everywhere else this framework lets XLA schedule communication: the
+strategies emit ``psum``/``all_gather``/``ppermute`` and the compiler's
+latency-hiding scheduler splits them into async pairs (proven in
+``tests/test_observability.py``). That recovers what the reference
+hand-builds with ``async_op=True`` + ``handle.wait()``
+(``train_ffns.py:164-172``) — but it is trust-the-compiler control. This
+module is the OTHER answer, the one the reference's stream experiment
+(``test_torch_cuda_stream.py:31-37``) was reaching for: communication as
+explicitly issued, explicitly awaited inter-chip DMA, scheduled by us.
+
+Two levels:
+
+- ``ppermute_dma``: one ring hop — each device RDMAs its block to its
+  right neighbor (``pltpu.make_async_remote_copy``), with the neighbor
+  barrier that makes a raw remote write safe. The primitive is
+  equality-pinned against ``lax.ppermute``.
+- ``ring_all_reduce``: the full classic 2(n-1)-step ring — reduce-
+  scatter phase then all-gather phase — inside ONE kernel launch:
+  double-buffered communication slots, DMA-completion semaphores,
+  explicit capacity handshaking (a receiver frees a slot back to its
+  sender), and a pairwise phase handoff. Each step's accumulate overlaps
+  the next chunk's DMA — the comm/compute overlap the reference wanted,
+  hand-scheduled. Equality-pinned against ``lax.psum`` (identical
+  summation order per chunk: partials accumulate in ring order on both
+  paths only if n is the ring size — values agree to f32 reduction-order
+  tolerance).
+
+Algorithm notes (device ``r`` of ``n``, chunks = leading-dim n-split):
+
+- reduce-scatter step ``s``: send chunk ``(r - s) % n`` right, receive
+  chunk ``(r - s - 1) % n`` from the left into comm slot ``s % 2``, add
+  it to the local copy. After ``n-1`` steps device ``r`` owns the fully
+  reduced chunk ``(r + 1) % n``.
+- all-gather step ``s``: send chunk ``(r + 1 - s) % n`` right, directly
+  into the receiver's output at the SAME global chunk index (all-gather
+  writes chunk c to slot c everywhere); receive chunk ``(r - s) % n``.
+  Every received chunk is immediately the next step's send — the ring
+  dependency is the only synchronization needed.
+- hazards handled explicitly: slot-reuse backpressure (capacity
+  semaphore, signaled sender-ward on consumption), phase handoff (a
+  device may only write a neighbor's output region after that neighbor
+  left the reduce-scatter phase — pairwise REGULAR semaphore, no global
+  barrier), and kernel-entry (neighbor barrier semaphore: no DMA may
+  target a chip that has not entered the kernel).
+
+Off-TPU the kernels run under the Mosaic TPU *interpreter*
+(``pltpu.InterpretParams`` — NOT the generic ``interpret=True``, which
+has no remote-DMA model), so the 8-device CPU mesh exercises the real
+semaphore/DMA semantics. On-chip compilation is pinned by the v5e-8 AOT
+codegen test (the Mosaic custom call replaces the XLA collective in the
+lowered module).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _interpret_arg(interpret: bool):
+    # the TPU interpreter models semaphores + remote DMA; the generic
+    # pallas interpreter does not
+    return pltpu.InterpretParams() if interpret else False
+
+
+def _neighbor_barrier(axis_name: str, n: int):
+    """No remote write may target a chip still outside the kernel."""
+    r = lax.axis_index(axis_name)
+    barrier = pltpu.get_barrier_semaphore()
+    left = lax.rem(r - 1 + n, n)
+    right = lax.rem(r + 1, n)
+    pltpu.semaphore_signal(barrier, inc=1, device_id=left,
+                           device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_signal(barrier, inc=1, device_id=right,
+                           device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_wait(barrier, 2)
+
+
+def ppermute_dma(x: jax.Array, axis_name: str, *,
+                 interpret: bool = False) -> jax.Array:
+    """One ring hop by explicit RDMA: device r's block lands on device
+    ``(r+1) % n`` — ``lax.ppermute(x, perm=[(i, (i+1)%n)])`` with the
+    transport hand-issued. Call inside ``shard_map``."""
+    n = lax.psum(1, axis_name)
+    if n == 1:
+        return x
+    shape = x.shape
+    x2 = x.reshape(shape[0], -1) if x.ndim != 2 else x
+
+    def kernel(x_ref, o_ref, send_sem, recv_sem):
+        _neighbor_barrier(axis_name, n)
+        r = lax.axis_index(axis_name)
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=x_ref, dst_ref=o_ref,
+            send_sem=send_sem, recv_sem=recv_sem,
+            device_id=lax.rem(r + 1, n),
+            device_id_type=pltpu.DeviceIdType.LOGICAL)
+        rdma.start()
+        rdma.wait()
+
+    out = pl.pallas_call(
+        kernel,
+        # vma: the landed blocks differ per device (shard-varying under
+        # shard_map's vma typing — DESIGN.md §4)
+        out_shape=jax.ShapeDtypeStruct(x2.shape, x2.dtype,
+                                       vma=frozenset({axis_name})),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA(()),
+                        pltpu.SemaphoreType.DMA(())],
+        compiler_params=pltpu.CompilerParams(has_side_effects=True,
+                                             collective_id=7),
+        interpret=_interpret_arg(interpret),
+    )(x2)
+    return out.reshape(shape)
+
+
+def ring_all_reduce(x: jax.Array, axis_name: str, *,
+                    interpret: bool = False) -> jax.Array:
+    """``lax.psum(x, axis_name)`` as a hand-scheduled 2-phase ring of
+    ``pltpu.make_async_remote_copy`` hops. Call inside ``shard_map``;
+    ``x.shape[0]`` must divide by the axis size (the chunk unit)."""
+    n = lax.psum(1, axis_name)
+    if n == 1:
+        return x
+    shape = x.shape
+    if shape[0] % n:
+        raise ValueError(f"leading dim {shape[0]} not divisible by ring "
+                         f"size {n} (chunk unit of the ring)")
+    x2 = x.reshape(shape[0], -1) if x.ndim != 2 else x
+    rows, cols = x2.shape
+    rc = rows // n  # rows per chunk
+
+    def chunk(ref, idx):
+        return ref.at[pl.ds(idx * rc, rc), :]
+
+    def kernel(x_ref, o_ref, comm_buf, send_sem, recv_sem, capacity,
+               phase_sem):
+        _neighbor_barrier(axis_name, n)
+        r = lax.axis_index(axis_name)
+        left = lax.rem(r - 1 + n, n)
+        right = lax.rem(r + 1, n)
+        o_ref[...] = x_ref[...]
+
+        # ---- phase 1: reduce-scatter (n-1 steps) --------------------
+        def rs_step(s, _):
+            slot = lax.rem(s, 2)
+            send_idx = lax.rem(r - s + n, n)
+            recv_idx = lax.rem(r - s - 1 + n, n)
+            # backpressure: slot reused every 2 steps — wait until the
+            # right neighbor freed it (it signals on consumption)
+            @pl.when(s >= 2)
+            def _():
+                pltpu.semaphore_wait(capacity.at[slot], 1)
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=chunk(o_ref, send_idx),
+                dst_ref=comm_buf.at[slot],
+                send_sem=send_sem.at[slot], recv_sem=recv_sem.at[slot],
+                device_id=right,
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+            rdma.start()
+            rdma.wait_recv()  # left's chunk for this step has landed
+            o_ref[pl.ds(recv_idx * rc, rc), :] += comm_buf[slot]
+            # slot consumed: hand it back to its writer (left neighbor)
+            pltpu.semaphore_signal(
+                capacity.at[slot], inc=1, device_id=left,
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+            rdma.wait_send()
+            return 0
+
+        lax.fori_loop(0, n - 1, rs_step, 0)
+
+        # ---- drain phase 1's capacity leftovers ---------------------
+        # The last two steps' consumption signals are never waited (no
+        # step n/n+1 reuses those slots): +1 leftover per slot. Phase 2
+        # REUSES the capacity semaphore — a stale count would satisfy
+        # its first backpressure wait without any real consumption,
+        # re-opening the ≥2-step-skew DMA/semaphore aliasing race (this
+        # exact bug corrupted chunks at n=8). Drain to zero here, so
+        # phase 2's waits can only be satisfied by phase-2 signals.
+        # (Also the ledger discipline: leftover counts would poison the
+        # next kernel sharing the physical semaphores.)
+        for slot_id in (0, 1):
+            sig = len([s for s in range(n - 1) if s % 2 == slot_id])
+            wai = len([s for s in range(2, n - 1) if s % 2 == slot_id])
+            if sig - wai:
+                pltpu.semaphore_wait(capacity.at[slot_id], sig - wai)
+
+        # ---- phase handoff ------------------------------------------
+        # Phase 2 writes straight into the RIGHT neighbor's output; that
+        # is only safe once the neighbor is out of phase 1. Pairwise
+        # signal leftward ("I am done reading what you may overwrite"),
+        # wait for the right neighbor's.
+        pltpu.semaphore_signal(phase_sem, inc=1, device_id=left,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_wait(phase_sem, 1)
+
+        # ---- phase 2: all-gather (n-1 steps) ------------------------
+        # The same ≤2-step skew bound phase 1 gets from its capacity
+        # handshake is REQUIRED here too: without backpressure a sender
+        # can run ≥2 steps ahead of its receiver, two of its DMAs alias
+        # the same mod-2 semaphore slot, and DMA completion order is not
+        # guaranteed — the receiver's wait can be satisfied by the LATER
+        # chunk's arrival (observed as corrupted chunks at n=8 in the
+        # Mosaic interpreter). Signal-after-wait_recv bounds the skew.
+        def ag_step(s, _):
+            slot = lax.rem(s, 2)
+            send_idx = lax.rem(r + 1 - s + n, n)  # global chunk id; the
+            # receiver stores chunk c at slot c, so src and dst slices
+            # coincide — every received chunk is the next step's send
+            @pl.when(s >= 2)
+            def _():
+                pltpu.semaphore_wait(capacity.at[slot], 1)
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=chunk(o_ref, send_idx),
+                dst_ref=chunk(o_ref, send_idx),
+                send_sem=send_sem.at[slot], recv_sem=recv_sem.at[slot],
+                device_id=right,
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+            rdma.start()
+            rdma.wait_recv()  # chunk (r - s) % n landed in place
+            pltpu.semaphore_signal(
+                capacity.at[slot], inc=1, device_id=left,
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+            rdma.wait_send()
+            return 0
+
+        lax.fori_loop(0, n - 1, ag_step, 0)
+
+        # ---- drain phase 2's leftovers (same accounting) ------------
+        for slot_id in (0, 1):
+            sig = len([s for s in range(n - 1) if s % 2 == slot_id])
+            wai = len([s for s in range(2, n - 1) if s % 2 == slot_id])
+            if sig - wai:
+                pltpu.semaphore_wait(capacity.at[slot_id], sig - wai)
+
+    out = pl.pallas_call(
+        kernel,
+        # typed shard-varying: the SUM is value-replicated but produced
+        # independently per device; callers needing invariant typing
+        # pcast (same situation as zero1's re-assembled params)
+        out_shape=jax.ShapeDtypeStruct((rows, cols), x2.dtype,
+                                       vma=frozenset({axis_name})),
+        # VMEM: the kernel reads/accumulates the operand directly (ANY/
+        # HBM refs are DMA-only), and resident operands are what lets
+        # each step's accumulate overlap the next chunk's DMA
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2, rc, cols), x2.dtype),   # double-buffered slots
+            pltpu.SemaphoreType.DMA((2,)),         # send completion
+            pltpu.SemaphoreType.DMA((2,)),         # recv completion
+            pltpu.SemaphoreType.REGULAR((2,)),     # slot backpressure
+            pltpu.SemaphoreType.REGULAR,           # phase handoff
+        ],
+        compiler_params=pltpu.CompilerParams(has_side_effects=True,
+                                             collective_id=8),
+        interpret=_interpret_arg(interpret),
+    )(x2)
+    return out.reshape(shape)
+
+
+def ring_all_reduce_spmd(x: jax.Array, mesh, axis_name: str, *,
+                         interpret: bool = False) -> jax.Array:
+    """Convenience launcher: shard a global ``[n*rows, cols]`` array over
+    the axis, ring-all-reduce the per-device blocks, return the stacked
+    per-device results (each block is the full sum — the differential-
+    test harness shape, comparable leaf-for-leaf against the same
+    ``shard_map`` wrapping ``lax.psum``)."""
+    from jax.sharding import PartitionSpec as P
+    f = jax.shard_map(
+        functools.partial(ring_all_reduce, axis_name=axis_name,
+                          interpret=interpret),
+        mesh=mesh, in_specs=P(axis_name, None), out_specs=P(axis_name, None))
+    return f(x)
