@@ -611,7 +611,8 @@ def train_lm_hybrid(params: LMParams, seeds, batch_size: int,
 
 def train_lm_seq(params: LMParams, seeds, batch_size: int, model_size: int,
                  mesh, lr: float = LR, *, seq_len: int, n_heads: int,
-                 seq_impl: str = "ring") -> LMParams:
+                 seq_impl: str = "ring",
+                 attn_impl: str | None = None) -> LMParams:
     """Long-context LM training: the sequence dim sharded over the
     ``"seq"`` axis, attention crossing shards via the hand-written ring
     (or Ulysses), the real objective computed per token block.
@@ -624,13 +625,20 @@ def train_lm_seq(params: LMParams, seeds, batch_size: int, model_size: int,
     the weight grads reproduces the single-device gradient exactly
     (pinned by the differential test). On a 2-D ``(data, seq)`` mesh the
     seed schedule additionally shards strided over ``data`` and the same
-    psum rides both axes."""
+    psum rides both axes.
+
+    ``attn_impl="flash"`` fuses the block compute (per ring hop / per
+    Ulysses-local head) onto the Pallas flash kernels — the long-context
+    path end to end: ICI ring across chips, online-softmax tiling in
+    VMEM within each."""
     from .sequence import resolve_seq_attn
     require_axes(mesh, SEQ_AXIS)
     n = mesh.shape[SEQ_AXIS]
     dp = dict(mesh.shape).get(DATA_AXIS, 1)
     _validate_lm(batch_size, seq_len, model_size, n_heads, params)
-    attn = resolve_seq_attn(seq_impl, n, n_heads, seq_len)
+    attn = resolve_seq_attn(seq_impl, n, n_heads, seq_len,
+                            attn_impl=attn_impl,
+                            interpret=jax.default_backend() != "tpu")
     t_local = seq_len // n
     b = batch_size // seq_len
     vocab = params.vocab
@@ -661,8 +669,13 @@ def train_lm_seq(params: LMParams, seeds, batch_size: int, model_size: int,
             lambda g: grad_reduce(g, axes), grads)
         return sgd(params, grads, lr)
 
+    # the Pallas interpreter's vma propagation is incomplete (jax's own
+    # error suggests check_vma=False); on-TPU the flash path compiles
+    # under full checking
+    check = not (attn_impl == "flash"
+                 and jax.default_backend() != "tpu")
     if dp > 1:
         return launch_strided(step, clone_params(params), seeds, mesh,
-                              DATA_AXIS, P())
+                              DATA_AXIS, P(), check_vma=check)
     return launch(step, clone_params(params), jnp.asarray(seeds), mesh,
-                  param_specs=P(), seed_spec=P())
+                  param_specs=P(), seed_spec=P(), check_vma=check)

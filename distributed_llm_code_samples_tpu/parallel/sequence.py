@@ -91,17 +91,150 @@ def _ring_fwd_core(q, k, v, axis_name: str, causal: bool):
     return (acc / l[:, None]).astype(q.dtype), m + jnp.log(l)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _ring_attention(q, k, v, axis_name: str, causal: bool):
+def _hop_case(i, rank, n, causal):
+    """Which of the three per-hop programs runs for the held block ``src =
+    (rank - i) % n``: 0 = fully allowed (src strictly earlier), 1 = the
+    diagonal block (standard causal masking), 2 = fully masked (skip —
+    the flash FLOP saving at ring granularity)."""
+    src = (rank - i) % n
+    if not causal:
+        return jnp.int32(0), src
+    return jnp.where(src == rank, 1,
+                     jnp.where(src < rank, 0, 2)).astype(jnp.int32), src
+
+
+def _ring_fwd_flash(q, k, v, axis_name: str, causal: bool,
+                    interpret: bool):
+    """VERDICT r3 stretch: the ring's per-hop block compute FUSED — each
+    held KV block goes through the Pallas flash kernel (online-softmax
+    tiling in VMEM, no ``[T_local, T_local]`` probability matrix in HBM),
+    and the per-hop ``(y_j, lse_j)`` partials merge by stable logsumexp:
+    the same math as the plain ring's (m, l, acc) fold, carried in
+    normalized-plus-lse form because that is what the kernel returns.
+    The three hop cases map onto the kernel's own modes: earlier block →
+    non-causal call, diagonal block → causal call (equal offsets make
+    local causal == global causal), later block → skipped entirely."""
+    from ..ops.pallas_attention import flash_attention_fwd
+    n = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    t_local, d = q.shape
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def hop_full(args):
+        qh, kb, vb = args
+        return flash_attention_fwd(qh, kb, vb, causal=False,
+                                   interpret=interpret)
+
+    def hop_diag(args):
+        qh, kb, vb = args
+        return flash_attention_fwd(qh, kb, vb, causal=True,
+                                   interpret=interpret)
+
+    def hop_skip(args):
+        qh = args[0]
+        return (jnp.zeros_like(qh),
+                jnp.full((t_local,), _NEG, jnp.float32)
+                + jnp.zeros_like(qh[:, 0], jnp.float32))  # carries q's vma
+
+    def step(i, carry):
+        k_blk, v_blk, y_run, lse_run = carry
+        case, _ = _hop_case(i, rank, n, causal)
+        y_j, lse_j = lax.switch(case, [hop_full, hop_diag, hop_skip],
+                                (q, k_blk, v_blk))
+        # stable two-way merge of normalized partials: weights <= 1
+        m = jnp.maximum(lse_run, lse_j)
+        w_run = jnp.exp(lse_run - m)
+        w_j = jnp.exp(lse_j - m)
+        denom = w_run + w_j
+        y_run = ((y_run.astype(jnp.float32) * w_run[:, None]
+                  + y_j.astype(jnp.float32) * w_j[:, None])
+                 / denom[:, None]).astype(q.dtype)
+        lse_run = m + jnp.log(denom)
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return k_blk, v_blk, y_run, lse_run
+
+    y0 = _varying_like(jnp.zeros_like(q), q, axis_name)
+    lse0 = _varying_like(jnp.full((t_local,), _NEG, jnp.float32), q,
+                         axis_name)
+    *_, y, lse = lax.fori_loop(0, n, step, (k, v, y0, lse0))
+    return y, lse
+
+
+def _ring_bwd_flash(q, k, v, y, lse, dy, axis_name: str, causal: bool,
+                    interpret: bool):
+    """Backward ring with the flash backward kernels as the per-hop block
+    compute. Same rotation structure as the plain backward (``(k, v, dk,
+    dv)`` travel together; ``dq`` accumulates at home) — the kernels
+    recompute each hop's probability tiles from the GLOBAL ``lse`` (and
+    the global ``D = rowsum(dy*y)``), which is exactly the plain ring's
+    ``p = exp(s - lse)`` / ``ds = p (dp - delta)`` math, tiled in VMEM."""
+    from ..ops.pallas_attention import flash_attention_bwd
+    n = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    t_local, d = q.shape
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def hop_full(args):
+        kb, vb = args
+        return flash_attention_bwd(dy, q, kb, vb, y, lse, causal=False,
+                                   interpret=interpret)
+
+    def hop_diag(args):
+        kb, vb = args
+        return flash_attention_bwd(dy, q, kb, vb, y, lse, causal=True,
+                                   interpret=interpret)
+
+    def hop_skip(args):
+        kb, vb = args
+        z = jnp.zeros_like(q)
+        return z, jnp.zeros_like(kb), jnp.zeros_like(vb)
+
+    def step(i, carry):
+        k_blk, v_blk, dk, dv, dq = carry
+        case, _ = _hop_case(i, rank, n, causal)
+        dq_j, dk_j, dv_j = lax.switch(
+            case, [hop_full, hop_diag, hop_skip], (k_blk, v_blk))
+        dq = dq + dq_j.astype(jnp.float32)
+        dk = dk + dk_j.astype(jnp.float32)
+        dv = dv + dv_j.astype(jnp.float32)
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        dk = lax.ppermute(dk, axis_name, perm)
+        dv = lax.ppermute(dv, axis_name, perm)
+        return k_blk, v_blk, dk, dv, dq
+
+    zeros = _varying_like(jnp.zeros((t_local, d), jnp.float32), q, axis_name)
+    *_, dk, dv, dq = lax.fori_loop(0, n, step, (k, v, zeros, zeros, zeros))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_attention(q, k, v, axis_name: str, causal: bool,
+                    impl: str | None = None, interpret: bool = False):
+    if impl == "flash":
+        return _ring_fwd_flash(q, k, v, axis_name, causal, interpret)[0]
     y, _ = _ring_fwd_core(q, k, v, axis_name, causal)
     return y
 
 
-def _ring_attention_fwd(q, k, v, axis_name, causal):
-    y, lse = _ring_fwd_core(q, k, v, axis_name, causal)
+def _ring_attention_fwd(q, k, v, axis_name, causal, impl, interpret):
+    if impl == "flash":
+        y, lse = _ring_fwd_flash(q, k, v, axis_name, causal, interpret)
+    else:
+        y, lse = _ring_fwd_core(q, k, v, axis_name, causal)
     # residuals are O(T_local * d): own blocks + output + one softmax stat.
     # No rotating block is saved — the backward re-runs the ring.
     return y, (q, k, v, y, lse)
+
+
+def _ring_attention_bwd_dispatch(axis_name, causal, impl, interpret, res,
+                                 dy):
+    if impl == "flash":
+        q, k, v, y, lse = res
+        return _ring_bwd_flash(q, k, v, y, lse, dy, axis_name, causal,
+                               interpret)
+    return _ring_attention_bwd(axis_name, causal, res, dy)
 
 
 def _ring_attention_bwd(axis_name, causal, res, dy):
@@ -147,42 +280,59 @@ def _ring_attention_bwd(axis_name, causal, res, dy):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-_ring_attention.defvjp(_ring_attention_fwd, _ring_attention_bwd)
+_ring_attention.defvjp(_ring_attention_fwd, _ring_attention_bwd_dispatch)
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                   axis_name: str = SEQ_AXIS, causal: bool = True):
+                   axis_name: str = SEQ_AXIS, causal: bool = True,
+                   attn_impl: str | None = None,
+                   interpret: bool = False):
     """Ring attention for one shard (call under ``shard_map``).
 
     ``q, k, v: [T_local, d]`` — this shard's sequence block. Returns the
     ``[T_local, d]`` attention output as if computed over the full
     sequence. Differentiation runs the hand-written backward ring above.
-    """
-    return _ring_attention(q, k, v, axis_name, causal)
+
+    ``attn_impl="flash"`` fuses the per-hop block compute end to end:
+    every held KV block runs through the Pallas flash kernels (forward
+    AND backward), so the long-context path never materializes a
+    ``[T_local, T_local]`` probability block in HBM — cross-chip ring
+    over ICI, within-chip online-softmax tiling in VMEM. ``interpret``
+    runs the kernels in interpreter mode off-TPU."""
+    return _ring_attention(q, k, v, axis_name, causal, attn_impl,
+                           interpret)
 
 
 def resolve_seq_attn(seq_impl: str, n: int, n_heads: int, seq_len: int,
-                     axis: str = SEQ_AXIS):
+                     axis: str = SEQ_AXIS, attn_impl: str | None = None,
+                     interpret: bool = False):
     """Shared dispatch for the sequence-parallel trainers (transformer and
     LM families): validates shard divisibility and returns the multi-head
     attention op (``[H, T_local, dh]`` per batch element) whose
     cross-shard traffic is the hand-written ring (KV rotating over
-    ``ppermute``) or Ulysses (two ``all_to_all``s)."""
+    ``ppermute``) or Ulysses (two ``all_to_all``s). ``attn_impl="flash"``
+    runs the per-hop (ring) / local (Ulysses) block compute on the fused
+    Pallas kernels."""
     if seq_len % n:
         raise ValueError(f"seq_len={seq_len} not divisible by seq-axis "
                          f"size {n}")
     if seq_impl == "ring":
         def attn(q, k, v, causal):  # ring per head
             return jax.vmap(
-                lambda q, k, v: ring_attention(q, k, v, axis, causal)
+                lambda q, k, v: ring_attention(q, k, v, axis, causal,
+                                               attn_impl=attn_impl,
+                                               interpret=interpret)
             )(q, k, v)
         return attn
     if seq_impl == "ulysses":
+        from .transformer import resolve_attn
         if n_heads % n:
             raise ValueError(f"n_heads={n_heads} not divisible by "
                              f"seq-axis size {n} (Ulysses scatters heads)")
+        local_op = resolve_attn(attn_impl)
         return lambda q, k, v, causal: ulysses_attention(q, k, v, axis,
-                                                         causal)
+                                                         causal,
+                                                         attn=local_op)
     raise ValueError(f"unknown seq_impl {seq_impl!r} "
                      "(expected 'ring' or 'ulysses')")
 

@@ -19,6 +19,8 @@ echo "== memory demo =="
 MEMDEMO_ARTIFACT=MEMDEMO_${R}.json timeout 1800 python bench_memdemo.py || true
 echo "== overlap trace =="
 TRACE_ARTIFACT_DIR=trace_${R} timeout 1800 python bench_trace.py || true
+echo "== real-text LM (train + held-out curves) =="
+TEXTLM_ARTIFACT=TEXTLM_${R}.json timeout 2400 python train_real_text.py || true
 echo "== bench (headline + families + breakdown + pallas) =="
 timeout 3600 python bench.py | tee /tmp/bench_${R}_local.json
 echo "== done =="

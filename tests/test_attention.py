@@ -258,3 +258,88 @@ def test_ulysses_rejects_indivisible_heads(qkv_heads):
     mesh = make_mesh({SEQ_AXIS: 8})
     with pytest.raises(ValueError, match="head count"):
         ulysses_parallel_attention(q[:6], k[:6], v[:6], mesh)
+
+
+# --- Flash-within-ring: the fused long-context path (VERDICT r3 #8) ------
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_ring_matches_oracle_fwd_and_bwd(causal):
+    """ring_attention(attn_impl="flash"): per-hop Pallas flash block
+    compute inside the cross-chip ring == the full-sequence quadratic
+    oracle, forward and all three gradients. The three hop programs
+    (earlier block = non-causal kernel, diagonal = causal kernel, later
+    = skipped) and the stable logsumexp merge are all on this path.
+    check_vma=False: the Pallas interpreter's vma propagation is
+    incomplete (jax's own error suggests exactly this workaround); the
+    real-TPU path compiles with full checking."""
+    from jax.sharding import PartitionSpec as P
+    key = jax.random.PRNGKey(11)
+    q, k, v = (jax.random.normal(kk, (T, D)) for kk in jax.random.split(key, 3))
+    mesh = make_mesh({SEQ_AXIS: 4})
+    spec = P(SEQ_AXIS, None)
+    f = jax.shard_map(
+        lambda q, k, v: ring_attention(q, k, v, SEQ_AXIS, causal,
+                                       attn_impl="flash", interpret=True),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    np.testing.assert_allclose(np.asarray(f(q, k, v)),
+                               _plain(q, k, v, causal),
+                               rtol=2e-5, atol=2e-5)
+    cot = jax.random.normal(jax.random.PRNGKey(9), (T, D))
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v) * cot)
+
+    g_got = jax.grad(loss(f), argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss(lambda q, k, v: _plain(q, k, v, causal)),
+                     argnums=(0, 1, 2))(q, k, v)
+    for got, ref, name in zip(g_got, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5, err_msg=name)
+
+
+def test_flash_ring_matches_plain_ring_8_shards():
+    """Fused and plain rings agree shard-for-shard at ring size 8 (odd
+    skip/diagonal splits per rank)."""
+    from jax.sharding import PartitionSpec as P
+    key = jax.random.PRNGKey(13)
+    q, k, v = (jax.random.normal(kk, (T, D)) for kk in jax.random.split(key, 3))
+    mesh = make_mesh({SEQ_AXIS: 8})
+    spec = P(SEQ_AXIS, None)
+
+    def run(impl):
+        return jax.shard_map(
+            lambda q, k, v: ring_attention(q, k, v, SEQ_AXIS, True,
+                                           attn_impl=impl,
+                                           interpret=impl == "flash"),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=impl is None)(q, k, v)
+
+    np.testing.assert_allclose(np.asarray(run("flash")),
+                               np.asarray(run(None)),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_ring_aot_v5e8_codegen():
+    """The fused ring AOT-compiles for a real v5e-8 ring: the lowered
+    module carries BOTH the ICI hop (collective-permute) and the Mosaic
+    flash kernels (tpu custom call) — cross-chip ring + in-chip fusion
+    in one program."""
+    import functools
+    from jax.experimental import topologies
+    from jax.sharding import Mesh, PartitionSpec as P
+    try:
+        topo = topologies.get_topology_desc(platform="tpu",
+                                            topology_name="v5e:2x4")
+    except Exception as e:
+        pytest.skip(f"no TPU AOT topology support: {e}")
+    mesh = Mesh(np.array(topo.devices).reshape(8), (SEQ_AXIS,))
+    spec = P(SEQ_AXIS, None)
+    f = jax.jit(jax.shard_map(
+        functools.partial(ring_attention, axis_name=SEQ_AXIS, causal=True,
+                          attn_impl="flash"),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec))
+    x = jax.ShapeDtypeStruct((8 * 128, 128), jnp.float32)
+    hlo = f.lower(x, x, x).compile().as_text()
+    assert "collective-permute" in hlo
+    assert "custom-call" in hlo

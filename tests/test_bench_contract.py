@@ -90,17 +90,25 @@ def test_bench_attention_contract():
 
 @pytest.mark.slow
 def test_train_real_text_contract(tmp_path):
-    """The real-text trainer must emit a falling loss curve, a sampled
-    continuation, and the artifact file — the round's end-to-end
-    capability demo cannot rot silently."""
+    """The real-text trainer must emit falling train AND held-out loss
+    curves (the VERDICT r3 honest-eval split), a sampled continuation,
+    and the artifact file — the round's end-to-end capability demo
+    cannot rot silently."""
     art = str(tmp_path / "textlm.json")
     payload = _run("train_real_text.py", {
         "TEXTLM_STEPS": "20", "TEXTLM_SEGMENTS": "2", "TEXTLM_D": "32",
         "TEXTLM_LAYERS": "1", "TEXTLM_HEADS": "2", "TEXTLM_SEQ": "32",
         "TEXTLM_BATCH": "4", "TEXTLM_ARTIFACT": art}, timeout=900)
-    assert payload["metric"] == "real_text_lm_final_eval_loss"
+    assert payload["metric"] == "real_text_lm_final_holdout_loss"
     curve = payload["loss_curve"]
     assert curve[0]["step"] == 0 and curve[-1]["step"] == 20
-    assert payload["value"] < payload["initial_loss"], curve
+    # the headline is the HELD-OUT loss; both curves must fall
+    assert payload["value"] < payload["initial_holdout_loss"], curve
+    assert curve[-1]["train_loss"] < curve[0]["train_loss"], curve
+    # the gap field keeps the memorization question visible
+    assert "generalization_gap" in payload
+    # the held-out tail is never sampled by training windows
+    assert payload["train_bytes"] + payload["holdout_bytes"] \
+        == payload["corpus_bytes"]
     assert isinstance(payload["sample"], str) and len(payload["sample"])
     assert os.path.exists(art)
