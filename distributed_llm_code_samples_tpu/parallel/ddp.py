@@ -70,7 +70,7 @@ def local_grads(params: FFNStackParams, seed, batch_size: int,
 def make_step(batch_size: int, model_size: int, lr: float = LR,
               unroll: bool = True, axis: str = DATA_AXIS,
               optimizer: Optimizer | None = None, accum: int = 1,
-              mixed: bool = False):
+              mixed: bool = False, comm: str = "psum"):
     """One DDP step for one shard: local fwd/bwd with per-layer grad psum.
 
     Without ``optimizer`` the step is the reference's stateless inline SGD
@@ -83,10 +83,28 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
     (``ops.stack.accumulated_grads``): local grads sum across chunks
     unreduced, then ONE tree-wide psum replaces the per-layer-per-chunk
     hooks — same math, 1/accum the collectives and ~1/accum the
-    activation memory."""
+    activation memory.
+
+    ``comm`` selects the gradient-reduction transport: ``"psum"`` (XLA
+    collectives, async-split by the latency-hiding scheduler — the
+    default) or ``"pallas_ring"`` (the hand-scheduled
+    ``make_async_remote_copy`` ring of ``ops/pallas_ring.py`` — the
+    explicit-control path, load-bearing in a real strategy; same sums,
+    ring accumulation order)."""
+    if comm not in ("psum", "pallas_ring"):
+        raise ValueError(f"unknown comm {comm!r} "
+                         "(expected 'psum' or 'pallas_ring')")
+    if comm == "pallas_ring":
+        import jax as _jax
+        from ..ops.pallas_ring import ring_all_reduce
+        interp = _jax.default_backend() != "tpu"
+        reduce = lambda g: ring_all_reduce(g, axis,  # noqa: E731
+                                           interpret=interp)
+    else:
+        reduce = lambda g: all_reduce(g, axis)  # noqa: E731
 
     def grad_hook(dw1, dw2):  # fires per layer, like train_ffns.py:164-165
-        return all_reduce(dw1, axis), all_reduce(dw2, axis)
+        return reduce(dw1), reduce(dw2)
 
     def grads_of(params, seed):
         if accum == 1:
@@ -94,7 +112,7 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
                                unroll, grad_hook, mixed=mixed)
         total = local_grads(params, seed, batch_size, model_size, unroll,
                             accum=accum, mixed=mixed)
-        return jax.tree_util.tree_map(lambda g: all_reduce(g, axis), total)
+        return jax.tree_util.tree_map(reduce, total)
 
     def step(params: FFNStackParams, seed) -> FFNStackParams:
         return sgd(params, grads_of(params, seed), lr)
@@ -110,7 +128,7 @@ def train_ddp(params: FFNStackParams, seeds, batch_size: int,
               model_size: int, mesh, lr: float = LR, unroll: bool = True,
               optimizer: Optimizer | None = None, accum: int = 1,
               opt_state=None, return_state: bool = False,
-              mixed: bool = False):
+              mixed: bool = False, comm: str = "psum"):
     """Run the full DDP schedule; returns the (replicated) final params.
 
     ``seeds`` is the *global* schedule; the strided split across ranks
@@ -129,16 +147,25 @@ def train_ddp(params: FFNStackParams, seeds, batch_size: int,
     (``ops.ffn.ffn_fwd_mixed``/``ffn_bwd_mixed``); params, grads, and the
     psum stay f32, so DDP(mixed) == FSDP(mixed) differentials keep their
     power.
+
+    ``comm="pallas_ring"`` swaps every gradient reduction for the
+    hand-scheduled ICI ring kernel (see ``make_step``) — same sums in
+    ring order, pinned against the psum path.
     """
     require_axes(mesh, DATA_AXIS)
     step = make_step(batch_size, model_size, lr, unroll,
-                     optimizer=optimizer, accum=accum, mixed=mixed)
+                     optimizer=optimizer, accum=accum, mixed=mixed,
+                     comm=comm)
 
+    # the ring kernel's outputs are typed shard-varying (value-replicated
+    # by construction, like zero1's re-assembled params) — vma checking
+    # cannot prove the replicated out_specs
+    check = comm == "psum"
     check_state_args(optimizer, opt_state, return_state)
     if optimizer is None:
         return launch_strided(step, clone_params(params), seeds, mesh,
-                              DATA_AXIS, P())
+                              DATA_AXIS, P(), check_vma=check)
     state = optimizer.init(params) if opt_state is None else opt_state
     return launch_strided(step, clone_params(params), seeds, mesh,
                           DATA_AXIS, P(), state=state, state_specs=P(),
-                          return_state=return_state)
+                          return_state=return_state, check_vma=check)

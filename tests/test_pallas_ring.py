@@ -140,3 +140,32 @@ def test_ppermute_dma_aot_v5e8_mosaic_codegen():
     hlo = lowered.compile().as_text()
     assert "custom-call" in hlo
     assert "collective-permute" not in hlo
+
+
+def test_ddp_with_pallas_ring_comm_matches_psum(mesh4):
+    """The escape hatch load-bearing in a real strategy: train_ddp with
+    comm="pallas_ring" (per-layer grad reduction through the
+    hand-scheduled RDMA ring) == the psum path, to ring-order
+    tolerance."""
+    from distributed_llm_code_samples_tpu.data import make_seed_schedule
+    from distributed_llm_code_samples_tpu.models import init_ffn_stack
+    from distributed_llm_code_samples_tpu.parallel import train_ddp
+    params = init_ffn_stack(jax.random.PRNGKey(42), 64, 3)
+    seeds = make_seed_schedule(8, random_seed=7)
+    want = train_ddp(params, seeds, 32, 64, mesh4, lr=0.1)
+    got = train_ddp(params, seeds, 32, 64, mesh4, lr=0.1,
+                    comm="pallas_ring")
+    np.testing.assert_allclose(np.asarray(got.w1), np.asarray(want.w1),
+                               rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(got.w2), np.asarray(want.w2),
+                               rtol=1e-5, atol=1e-7)
+
+
+def test_ddp_rejects_unknown_comm(mesh4):
+    from distributed_llm_code_samples_tpu.data import make_seed_schedule
+    from distributed_llm_code_samples_tpu.models import init_ffn_stack
+    from distributed_llm_code_samples_tpu.parallel import train_ddp
+    params = init_ffn_stack(jax.random.PRNGKey(0), 64, 2)
+    with pytest.raises(ValueError, match="unknown comm"):
+        train_ddp(params, make_seed_schedule(4, random_seed=1), 32, 64,
+                  mesh4, lr=0.1, comm="nccl")
