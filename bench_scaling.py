@@ -85,16 +85,19 @@ def _scenarios():
     def ffn_flops(tokens, d, layers):  # recompute-policy matmul FLOPs
         return 14 * tokens * d * (4 * d) * layers
 
-    def ddp_like(d, layers, tokens, chips, fsdp_mode):
+    def ddp_like(d, layers, tokens, chips, fsdp_mode, mixed=False):
         from distributed_llm_code_samples_tpu.parallel.mesh import DATA_AXIS
         params = init_ffn_stack(jax.random.PRNGKey(0), d, layers)
         pbytes = 4 * params.num_params()
         n = chips
         if fsdp_mode:
-            step = fsdp.make_step(tokens, d, 0.1)
+            step = fsdp.make_step(tokens, d, 0.1, mixed=mixed)
             specs = fsdp.PARAM_SPECS
-            # fwd gather + bwd gather + grad reduce-scatter, (n-1)/n each
-            comm = 3 * (n - 1) / n * pbytes
+            # fwd gather + bwd gather + grad reduce-scatter, (n-1)/n each;
+            # under the bf16 policy both gathers ride the wire half-width
+            # (the reduce-scatter stays f32 for master-grad exactness)
+            gather_w = 0.5 if mixed else 1.0
+            comm = (2 * gather_w + 1) * (n - 1) / n * pbytes
         else:
             step = ddp.make_step(tokens, d, 0.1)
             specs = P()  # DDP params replicate
@@ -178,6 +181,10 @@ def _scenarios():
         # BASELINE config 2: FSDP, 8-layer d=2048, 8 devices
         ("fsdp_d2048_L8", 8,
          lambda: ddp_like(2048, 8, toks, 8, fsdp_mode=True)),
+        # the bf16 mixed-precision FSDP: param gathers at half width —
+        # comm drops 3x->2x param bytes, headroom row shows the gain
+        ("fsdp_d2048_L8_bf16gather", 8,
+         lambda: ddp_like(2048, 8, toks, 8, fsdp_mode=True, mixed=True)),
         # BASELINE config 5 (north star): GPT-2-small-width FFN stack,
         # FSDP on v5e-32
         ("fsdp_d768_L24", 32,

@@ -109,6 +109,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="with --method 4 or 8: Megatron sequence-parallel "
                         "TP (token-sharded activations; all_gather + "
                         "reduce_scatter instead of all_reduce)")
+    p.add_argument("--comm", choices=["psum", "pallas_ring"],
+                   default="psum",
+                   help="with --method 2 (DDP): gradient-reduction "
+                        "transport — psum (XLA collectives, async-split "
+                        "by the scheduler) or pallas_ring (the hand-"
+                        "scheduled make_async_remote_copy ring kernel)")
     p.add_argument("--zero1", action="store_true",
                    help="with --method 2: shard the optimizer state "
                         "across the data axis (ZeRO-1; reduce_scatter + "
@@ -402,6 +408,8 @@ def main(argv=None) -> int:
         kwargs = dict(lr=lr, unroll=unroll)
         if m in (1, 2, 3, 4, 5) and args.mixed:
             kwargs["mixed"] = True  # zero1/tp_sp swaps below keep it
+        if m == 2 and args.comm != "psum" and not args.zero1:
+            kwargs["comm"] = args.comm
         if m in (1, 2) and args.accum > 1:
             kwargs["accum"] = args.accum  # train_ddp_zero1 accepts it too
         if m in (2, 3) and (args.optimizer != "sgd" or args.zero1

@@ -227,3 +227,14 @@ def test_cli_moe_transformer_method():
                  "8", "--heads", "4", "--lr", "0.1")
     assert r.returncode == 0, r.stdout + r.stderr
     assert "train_moe_transformer_ep takes" in r.stdout
+
+
+@pytest.mark.slow
+def test_cli_comm_pallas_ring():
+    """--method 2 --comm pallas_ring: DDP's gradient reduction through
+    the hand-scheduled RDMA ring kernel, end to end from the flag
+    surface."""
+    r = _run_cli("-m", "2", "-s", "8", "-bs", "4", "-n", "8", "-l", "2",
+                 "-d", "32", "--comm", "pallas_ring",
+                 "--fake_devices", "8")
+    assert r.returncode == 0, r.stdout + r.stderr
