@@ -109,3 +109,24 @@ def test_attention_random_shapes(t, dh, causal):
     for got, want in zip(vjp(dy), vjp_ref(dy)):
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("t,d", _shapes(CASES, lo=2, hi=17))
+def test_mixed_pair_form_random_shapes(t, d):
+    """The bf16 pair-form rules (the strategies' hook dialect) match the
+    custom_vjp block bit-for-bit across random shapes — the shared-core
+    guarantee holds off the happy path too."""
+    from distributed_llm_code_samples_tpu.ops.ffn import (
+        ffn_block_mixed, ffn_bwd_mixed, ffn_fwd_mixed)
+    key = jax.random.fold_in(jax.random.PRNGKey(8), t * 100 + d)
+    w1 = jax.random.normal(jax.random.fold_in(key, 0), (4 * d, d)) * 0.1
+    w2 = jax.random.normal(jax.random.fold_in(key, 1), (d, 4 * d)) * 0.1
+    x = jax.random.normal(jax.random.fold_in(key, 2), (t, d))
+    dy = jax.random.normal(jax.random.fold_in(key, 3), (t, d))
+    y_pair = ffn_fwd_mixed(w1, w2, x)
+    dx, (dw1, dw2) = ffn_bwd_mixed(dy, w1, w2, x)
+    y_blk, vjp = jax.vjp(ffn_block_mixed, w1, w2, x)
+    dw1_b, dw2_b, dx_b = vjp(dy)
+    for got, want in ((y_pair, y_blk), (dx, dx_b), (dw1, dw1_b),
+                      (dw2, dw2_b)):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))

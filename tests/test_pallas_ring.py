@@ -169,3 +169,19 @@ def test_ddp_rejects_unknown_comm(mesh4):
     with pytest.raises(ValueError, match="unknown comm"):
         train_ddp(params, make_seed_schedule(4, random_seed=1), 32, 64,
                   mesh4, lr=0.1, comm="nccl")
+
+
+@pytest.mark.parametrize("n", [2, 4])
+def test_ring_all_reduce_small_rings(n):
+    """Edge ring sizes: n=2 has a single step per phase (no capacity
+    waits at all — the drain accounting must still zero the semaphores);
+    n=4 covers the odd leftover split."""
+    from jax.sharding import Mesh
+    mesh = Mesh(np.array(jax.devices()[:n]), (DATA_AXIS,))
+    x = jax.random.normal(jax.random.PRNGKey(3), (n * 2 * n, 8))
+    got = _sm(mesh, functools.partial(ring_all_reduce,
+                                      axis_name=DATA_AXIS,
+                                      interpret=True))(x)
+    want = _sm(mesh, lambda v: lax.psum(v, DATA_AXIS))(x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6, atol=1e-6)
