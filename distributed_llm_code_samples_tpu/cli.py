@@ -29,19 +29,22 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-l", "--layers", type=int, default=1)
     p.add_argument("-d", "--model_size", type=int, default=4)
     p.add_argument("-m", "--method", type=int, default=0,
-                   choices=range(13),
+                   choices=range(14),
                    help="0=all(1-4), 1=single, 2=DDP, 3=FSDP, 4=TP, "
                         "5=hybrid DDP x TP, 6=pipeline (ppermute send/recv), "
                         "7=MoE expert parallelism (all_to_all), "
                         "8=transformer blocks (Megatron TP; --heads), "
-                        "9=all(1-8,10-12) with every strategy "
+                        "9=all(1-8,10-13) with every strategy "
                         "cross-verified against its oracle, 10=MoE "
                         "transformer (GShard: data-parallel attention + "
                         "expert-parallel FFN), 11=language model on the "
                         "real cross-entropy objective (vocab-parallel "
                         "Megatron TP; --vocab --heads), 12=MoE language "
                         "model (GShard blocks + real loss + router aux; "
-                        "--experts --vocab --heads)")
+                        "--experts --vocab --heads), 13=long-context LM "
+                        "(sequence dim sharded over the seq axis: ring "
+                        "attention or Ulysses via --seq_impl; "
+                        "--attn flash fuses the per-hop block compute)")
     p.add_argument("-r", "--random_seed", type=int, default=0,
                    help="!=0 makes runs reproducible (train_ffns.py:350)")
     # TPU-build extensions
@@ -105,6 +108,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="with --method 2 or 3: clip gradients to this "
                         "global L2 norm before the optimizer update "
                         "(0 = off)")
+    p.add_argument("--seq_impl", choices=["ring", "ulysses"],
+                   default="ring",
+                   help="with --method 13: the cross-shard attention "
+                        "scheme — ring (KV blocks rotating over "
+                        "ppermute) or ulysses (two all_to_alls re-shard "
+                        "heads<->sequence)")
     p.add_argument("--tp_sp", action="store_true",
                    help="with --method 4 or 8: Megatron sequence-parallel "
                         "TP (token-sharded activations; all_gather + "
@@ -195,7 +204,19 @@ def main(argv=None) -> int:
     from .models import (init_ffn_stack, init_moe_stack, init_transformer,
                          params_size_gb)
     from .parallel import (make_mesh, guard_multi_device, STRATEGIES,
-                           DATA_AXIS, MODEL_AXIS, PIPE_AXIS, EXPERT_AXIS)
+                           DATA_AXIS, MODEL_AXIS, PIPE_AXIS, EXPERT_AXIS,
+                           SEQ_AXIS)
+
+    if args.method == 13 and args.kv_heads:
+        print("error: --method 13 (sequence-parallel LM) supports full "
+              "MHA only (no --kv_heads): the ring vmaps equal q/kv "
+              "heads", file=sys.stderr)
+        return 2
+    if args.method == 13 and args.attn == "rope":
+        print("error: --attn rope is not supported by --method 13 "
+              "(the ring's per-hop programs take oracle or flash)",
+              file=sys.stderr)
+        return 2
 
     if args.accum < 1:
         print(f"error: --accum must be >= 1 (got {args.accum})",
@@ -241,10 +262,10 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
     if args.attn != "oracle" and not (
-            args.method in (8, 11)
+            args.method in (8, 11, 13)
             or (args.method == 6 and args.pp_family in ("transformer",
                                                         "lm"))):
-        print("error: --attn applies to --method 8, 11, or 6 with "
+        print("error: --attn applies to --method 8, 11, 13, or 6 with "
               "--pp_family transformer/lm", file=sys.stderr)
         return 2
     if args.optimizer != "sgd" and args.method not in (2, 3):
@@ -323,7 +344,7 @@ def main(argv=None) -> int:
         if method == 6 and args.pp_family != "ffn":
             return args.pp_family  # transformer or lm
         return {7: "moe", 8: "transformer", 10: "moe_transformer",
-                11: "lm", 12: "moe_lm"}.get(method, "ffn")
+                11: "lm", 12: "moe_lm", 13: "lm"}.get(method, "ffn")
 
     _family_params = {}
 
@@ -387,6 +408,13 @@ def main(argv=None) -> int:
             # model axis sized by --tp (like method 5): all-devices would
             # demand n_heads divisible by every possible device count
             return make_mesh({MODEL_AXIS: min(args.tp, n_dev)})
+        if method == 13:
+            # seq axis over the largest device count dividing seq_len
+            # (and, for Ulysses, the head count it scatters)
+            n = max(k for k in range(1, n_dev + 1)
+                    if n_dev % k == 0 and args.seq_len % k == 0
+                    and (args.seq_impl == "ring" or args.heads % k == 0))
+            return make_mesh({SEQ_AXIS: n})
         return make_mesh({DATA_AXIS: hybrid_dp(), MODEL_AXIS: args.tp})
 
     def hybrid_dp() -> int:
@@ -397,7 +425,7 @@ def main(argv=None) -> int:
     if args.method == 0:
         selected = [1, 2, 3, 4]
     elif args.method == 9:
-        selected = [1, 2, 3, 4, 5, 6, 7, 8, 10, 11, 12]
+        selected = [1, 2, 3, 4, 5, 6, 7, 8, 10, 11, 12, 13]
     else:
         selected = [args.method]
     results = {}
@@ -453,6 +481,11 @@ def main(argv=None) -> int:
                 kwargs["sequence_parallel"] = True
             if m in (8, 11) and args.attn != "oracle":
                 kwargs["attn_impl"] = args.attn
+        if m == 13:
+            kwargs = dict(lr=lr, seq_len=args.seq_len,
+                          n_heads=args.heads, seq_impl=args.seq_impl)
+            if args.attn == "flash":
+                kwargs["attn_impl"] = "flash"
         if m == 1 and args.pallas:
             kwargs["use_pallas"] = True
             kwargs["interpret"] = jax.default_backend() != "tpu"
@@ -565,6 +598,11 @@ def main(argv=None) -> int:
                 params_for(11), seeds, tokens, args.model_size, lr=lr,
                 seq_len=args.seq_len, n_heads=args.heads)
             checks.append(("lm_tp", "lm_1dev", results[11], lm_single,
+                           1e-4, 1e-5))
+            # sequence-parallel LM replicates the data too (each shard
+            # regenerates the batch and takes its token block) => equals
+            # the same single-device oracle
+            checks.append(("lm_seq", "lm_1dev", results[13], lm_single,
                            1e-4, 1e-5))
             # GShard MoE-LM == its dense grouped oracle (real loss + aux)
             from .parallel import train_moe_lm_dense

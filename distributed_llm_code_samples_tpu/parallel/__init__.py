@@ -50,6 +50,7 @@ STRATEGIES = {
     10: ("train_moe_transformer_ep", train_moe_transformer_ep),
     11: ("train_lm_tp", train_lm_tp),
     12: ("train_moe_lm_ep", train_moe_lm_ep),
+    13: ("train_lm_seq", train_lm_seq),
 }
 
 __all__ = [

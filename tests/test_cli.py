@@ -45,7 +45,7 @@ def test_cli_method9_verifies_every_strategy():
     for name in ("train_single", "train_ddp", "train_fsdp", "train_tp",
                  "train_hybrid", "train_pp", "train_moe_ep",
                  "train_transformer_tp", "train_moe_transformer_ep",
-                 "train_lm_tp", "train_moe_lm_ep"):
+                 "train_lm_tp", "train_moe_lm_ep", "train_lm_seq"):
         assert f"{name} takes" in r.stdout
     assert "SoftAssertionError" not in r.stdout
 
@@ -238,3 +238,21 @@ def test_cli_comm_pallas_ring():
                  "-d", "32", "--comm", "pallas_ring",
                  "--fake_devices", "8")
     assert r.returncode == 0, r.stdout + r.stderr
+
+
+@pytest.mark.slow
+def test_cli_method13_seq_parallel_lm():
+    """--method 13: the long-context LM over the seq axis from the flag
+    surface — ring (default), ulysses, and the flash-fused ring."""
+    for extra in ((), ("--seq_impl", "ulysses"), ("--attn", "flash")):
+        r = _run_cli("-m", "13", "-s", "4", "-bs", "2", "-n", "32", "-l",
+                     "2", "-d", "32", "--heads", "4",
+                     "--fake_devices", "8", *extra)
+        assert r.returncode == 0, (extra, r.stdout + r.stderr)
+    # guards: rope unsupported, GQA unsupported
+    r = _run_cli("-m", "13", "-s", "2", "-n", "32", "--attn", "rope",
+                 "--fake_devices", "8")
+    assert r.returncode == 2 and "not supported by --method 13" in r.stderr
+    r = _run_cli("-m", "13", "-s", "2", "-n", "32", "--heads", "4",
+                 "--kv_heads", "2", "--fake_devices", "8")
+    assert r.returncode == 2 and "full MHA only" in r.stderr
