@@ -120,10 +120,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "reduce_scatter instead of all_reduce)")
     p.add_argument("--comm", choices=["psum", "pallas_ring"],
                    default="psum",
-                   help="with --method 2 (DDP): gradient-reduction "
+                   help="with --method 2 (DDP) or 3 (FSDP): collective "
                         "transport — psum (XLA collectives, async-split "
                         "by the scheduler) or pallas_ring (the hand-"
-                        "scheduled make_async_remote_copy ring kernel)")
+                        "scheduled make_async_remote_copy ring kernels: "
+                        "DDP grad all-reduce; FSDP param all-gathers + "
+                        "grad reduce-scatters)")
     p.add_argument("--zero1", action="store_true",
                    help="with --method 2: shard the optimizer state "
                         "across the data axis (ZeRO-1; reduce_scatter + "
@@ -436,7 +438,7 @@ def main(argv=None) -> int:
         kwargs = dict(lr=lr, unroll=unroll)
         if m in (1, 2, 3, 4, 5) and args.mixed:
             kwargs["mixed"] = True  # zero1/tp_sp swaps below keep it
-        if m == 2 and args.comm != "psum" and not args.zero1:
+        if m in (2, 3) and args.comm != "psum" and not args.zero1:
             kwargs["comm"] = args.comm
         if m in (1, 2) and args.accum > 1:
             kwargs["accum"] = args.accum  # train_ddp_zero1 accepts it too

@@ -71,6 +71,25 @@ def _interpret_arg(interpret: bool):
     return pltpu.InterpretParams() if interpret else False
 
 
+_LANES = 128
+
+
+def _legalize_2d(x2, n: int):
+    """Mosaic slices a 2-D VMEM ref along dim 0 only if dim 1 is
+    lane-aligned (128). A narrow operand (e.g. FSDP's per-layer
+    ``[rows, 64]`` shards) is re-flattened so each ring CHUNK becomes
+    ``[elems/128, 128]`` — pure reshape, chunk boundaries preserved
+    (chunks are contiguous in row-major), values untouched. Returns the
+    legalized array; the caller reshapes the result back."""
+    rows, cols = x2.shape
+    if cols % _LANES == 0:
+        return x2
+    elems = (rows // n) * cols  # per chunk
+    if elems % _LANES == 0:
+        return x2.reshape(n * (elems // _LANES), _LANES)
+    return x2  # narrow fallback: fine in interpret; Mosaic may reject
+
+
 def _neighbor_barrier(axis_name: str, n: int):
     """No remote write may target a chip still outside the kernel."""
     r = lax.axis_index(axis_name)
@@ -136,6 +155,7 @@ def ring_all_reduce(x: jax.Array, axis_name: str, *,
         raise ValueError(f"leading dim {shape[0]} not divisible by ring "
                          f"size {n} (chunk unit of the ring)")
     x2 = x.reshape(shape[0], -1) if x.ndim != 2 else x
+    x2 = _legalize_2d(x2, n)
     rows, cols = x2.shape
     rc = rows // n  # rows per chunk
 
@@ -266,6 +286,158 @@ def ring_all_reduce(x: jax.Array, axis_name: str, *,
         interpret=_interpret_arg(interpret),
     )(x2)
     return out.reshape(shape)
+
+
+def ring_reduce_scatter(x: jax.Array, axis_name: str, *,
+                        interpret: bool = False) -> jax.Array:
+    """``collectives.reduce_scatter(x, axis, dim=0)`` hand-scheduled:
+    the reduce-scatter phase of the ring alone. ``x [n*rc, ...]`` per
+    device; device ``r`` returns the summed chunk ``r`` (``[rc, ...]``).
+
+    Same protocol as ``ring_all_reduce``'s phase 1 with the ring pattern
+    shifted one hop (virtual rank ``r-1``), so the finally-owned chunk is
+    ``r`` — the ``lax.psum_scatter(tiled=True)`` convention the XLA path
+    implements. Accumulation happens on a scratch copy of the input;
+    only the owned chunk is written out."""
+    n = lax.psum(1, axis_name)
+    shape = x.shape
+    if shape[0] % n:
+        raise ValueError(f"leading dim {shape[0]} not divisible by ring "
+                         f"size {n} (chunk unit of the ring)")
+    if n == 1:
+        return x
+    x2 = x.reshape(shape[0], -1) if x.ndim != 2 else x
+    x2 = _legalize_2d(x2, n)
+    rc = x2.shape[0] // n
+    cols = x2.shape[1]
+
+    def kernel(x_ref, o_ref, acc, comm_buf, send_sem, recv_sem, capacity):
+        _neighbor_barrier(axis_name, n)
+        r = lax.axis_index(axis_name)
+        left = lax.rem(r - 1 + n, n)
+        right = lax.rem(r + 1, n)
+        acc[...] = x_ref[...]
+        rv = lax.rem(r - 1 + n, n)  # virtual rank: owned chunk = rv+1 = r
+
+        def rs_step(s, _):
+            slot = lax.rem(s, 2)
+            send_idx = lax.rem(rv - s + n, n)
+            recv_idx = lax.rem(rv - s - 1 + n, n)
+            @pl.when(s >= 2)
+            def _():
+                pltpu.semaphore_wait(capacity.at[slot], 1)
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=acc.at[pl.ds(send_idx * rc, rc), :],
+                dst_ref=comm_buf.at[slot],
+                send_sem=send_sem.at[slot], recv_sem=recv_sem.at[slot],
+                device_id=right,
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+            rdma.start()
+            rdma.wait_recv()
+            acc[pl.ds(recv_idx * rc, rc), :] += comm_buf[slot]
+            pltpu.semaphore_signal(
+                capacity.at[slot], inc=1, device_id=left,
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+            rdma.wait_send()
+            return 0
+
+        lax.fori_loop(0, n - 1, rs_step, 0)
+        o_ref[...] = acc[pl.ds(lax.rem(rv + 1, n) * rc, rc), :]
+        # drain the never-waited capacity leftovers (ledger discipline)
+        for slot_id in (0, 1):
+            sig = len([s for s in range(n - 1) if s % 2 == slot_id])
+            wai = len([s for s in range(2, n - 1) if s % 2 == slot_id])
+            if sig - wai:
+                pltpu.semaphore_wait(capacity.at[slot_id], sig - wai)
+
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((rc, cols), x2.dtype,
+                                       vma=frozenset({axis_name})),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((n * rc, cols), x2.dtype),  # accumulator copy
+            pltpu.VMEM((2, rc, cols), x2.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR((2,)),
+        ],
+        compiler_params=pltpu.CompilerParams(has_side_effects=True,
+                                             collective_id=9),
+        interpret=_interpret_arg(interpret),
+    )(x2)
+    return out.reshape((shape[0] // n,) + shape[1:])
+
+
+def ring_all_gather(x: jax.Array, axis_name: str, *,
+                    interpret: bool = False) -> jax.Array:
+    """``collectives.all_gather(x, axis, dim=0)`` hand-scheduled: the
+    all-gather phase of the ring alone. ``x [rows, ...]`` per device;
+    returns ``[n*rows, ...]`` with chunk ``i`` = device ``i``'s block —
+    ``ring_all_reduce``'s phase 2 with the output seeded from the local
+    block instead of reduced chunks (owner of chunk ``r`` is ``r``, so
+    the send pattern starts one hop later: ``send_idx = (r - s) % n``)."""
+    n = lax.psum(1, axis_name)
+    if n == 1:
+        return x
+    shape = x.shape
+    x2 = x.reshape(shape[0], -1) if x.ndim != 2 else x
+    x2 = _legalize_2d(x2, 1)  # the chunk unit is the WHOLE local block
+    rc, cols = x2.shape
+
+    def kernel(x_ref, o_ref, send_sem, recv_sem, capacity):
+        _neighbor_barrier(axis_name, n)
+        r = lax.axis_index(axis_name)
+        left = lax.rem(r - 1 + n, n)
+        right = lax.rem(r + 1, n)
+        o_ref[pl.ds(r * rc, rc), :] = x_ref[...]
+
+        def ag_step(s, _):
+            slot = lax.rem(s, 2)
+            send_idx = lax.rem(r - s + n, n)  # own block at s=0, then
+            # each received chunk is the next step's send (the ring
+            # dependency); receiver stores chunk c at slot c
+            @pl.when(s >= 2)
+            def _():
+                pltpu.semaphore_wait(capacity.at[slot], 1)
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=o_ref.at[pl.ds(send_idx * rc, rc), :],
+                dst_ref=o_ref.at[pl.ds(send_idx * rc, rc), :],
+                send_sem=send_sem.at[slot], recv_sem=recv_sem.at[slot],
+                device_id=right,
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+            rdma.start()
+            rdma.wait_recv()  # chunk (r - s - 1) % n landed in place
+            pltpu.semaphore_signal(
+                capacity.at[slot], inc=1, device_id=left,
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+            rdma.wait_send()
+            return 0
+
+        lax.fori_loop(0, n - 1, ag_step, 0)
+        for slot_id in (0, 1):
+            sig = len([s for s in range(n - 1) if s % 2 == slot_id])
+            wai = len([s for s in range(2, n - 1) if s % 2 == slot_id])
+            if sig - wai:
+                pltpu.semaphore_wait(capacity.at[slot_id], sig - wai)
+
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((n * rc, cols), x2.dtype,
+                                       vma=frozenset({axis_name})),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR((2,)),
+        ],
+        compiler_params=pltpu.CompilerParams(has_side_effects=True,
+                                             collective_id=10),
+        interpret=_interpret_arg(interpret),
+    )(x2)
+    return out.reshape((n * shape[0],) + shape[1:])
 
 
 def ring_all_reduce_spmd(x: jax.Array, mesh, axis_name: str, *,

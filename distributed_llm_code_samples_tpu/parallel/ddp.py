@@ -70,7 +70,8 @@ def local_grads(params: FFNStackParams, seed, batch_size: int,
 def make_step(batch_size: int, model_size: int, lr: float = LR,
               unroll: bool = True, axis: str = DATA_AXIS,
               optimizer: Optimizer | None = None, accum: int = 1,
-              mixed: bool = False, comm: str = "psum"):
+              mixed: bool = False, comm: str = "psum",
+              ring_interpret: bool | None = None):
     """One DDP step for one shard: local fwd/bwd with per-layer grad psum.
 
     Without ``optimizer`` the step is the reference's stateless inline SGD
@@ -97,7 +98,8 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
     if comm == "pallas_ring":
         import jax as _jax
         from ..ops.pallas_ring import ring_all_reduce
-        interp = _jax.default_backend() != "tpu"
+        interp = (_jax.default_backend() != "tpu"
+                  if ring_interpret is None else ring_interpret)
         reduce = lambda g: ring_all_reduce(g, axis,  # noqa: E731
                                            interpret=interp)
     else:

@@ -86,7 +86,8 @@ def checkpoint_shardings(params: FFNStackParams, optimizer: Optimizer,
 
 def make_step(batch_size: int, model_size: int, lr: float = LR,
               unroll: bool = True, axis: str = DATA_AXIS,
-              optimizer: Optimizer | None = None, mixed: bool = False):
+              optimizer: Optimizer | None = None, mixed: bool = False,
+              comm: str = "psum", ring_interpret: bool | None = None):
     """One FSDP step for one shard (operates on local shard views).
 
     With ``optimizer``, its state is created from — and lives as — the
@@ -100,7 +101,30 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
     the block math is the bf16-MXU/f32-accumulate rule. Casting before
     the gather is value-identical to gathering then casting (the cast is
     elementwise), master shards and the grad reduce_scatter stay f32, so
-    FSDP(mixed) == DDP(mixed) leaf for leaf."""
+    FSDP(mixed) == DDP(mixed) leaf for leaf.
+
+    ``comm="pallas_ring"`` swaps BOTH collectives for the hand-scheduled
+    RDMA ring kernels (``ops/pallas_ring.py``): the per-layer param
+    gathers ride ``ring_all_gather`` and the grad hook rides
+    ``ring_reduce_scatter`` — the full FSDP comm pattern under explicit
+    control, pinned == the XLA path."""
+    if comm not in ("psum", "pallas_ring"):
+        raise ValueError(f"unknown comm {comm!r} "
+                         "(expected 'psum' or 'pallas_ring')")
+    if comm == "pallas_ring":
+        from ..ops.pallas_ring import ring_all_gather, ring_reduce_scatter
+        # default: interpreter off-TPU (the CPU test mesh), Mosaic on
+        # chip; AOT codegen callers pass ring_interpret=False explicitly
+        # (no TPU attached, but the kernels must compile for one)
+        interp = (jax.default_backend() != "tpu"
+                  if ring_interpret is None else ring_interpret)
+        _ag = lambda t: ring_all_gather(t, axis,  # noqa: E731
+                                        interpret=interp)
+        _rs = lambda t: ring_reduce_scatter(t, axis,  # noqa: E731
+                                            interpret=interp)
+    else:
+        _ag = lambda t: all_gather(t, axis, dim=0)  # noqa: E731
+        _rs = lambda t: reduce_scatter(t, axis, dim=0)  # noqa: E731
 
     def gather(w1_shard, w2_shard):
         # train_ffns.py:200-225 — async all_gather of both params of a layer;
@@ -110,8 +134,7 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
         if mixed:
             w1_shard = w1_shard.astype(jnp.bfloat16)
             w2_shard = w2_shard.astype(jnp.bfloat16)
-        return (all_gather(w1_shard, axis, dim=0),
-                all_gather(w2_shard, axis, dim=0))
+        return _ag(w1_shard), _ag(w2_shard)
 
     fwd = ffn_fwd_mixed if mixed else ffn_fwd
     bwd = ffn_bwd_mixed if mixed else ffn_bwd
@@ -129,8 +152,7 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
     def grad_hook(dw1, dw2):
         # The VJP of all_gather is reduce_scatter: full grads -> summed shard
         # (train_ffns.py:255-256), SUM semantics, unscaled LR.
-        return (reduce_scatter(dw1, axis, dim=0),
-                reduce_scatter(dw2, axis, dim=0))
+        return _rs(dw1), _rs(dw2)
 
     def local_grads_of(params, seed):
         x, dloss_dx = batch_from_seed(seed, batch_size, model_size,
@@ -157,7 +179,8 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
 def train_fsdp(params: FFNStackParams, seeds, batch_size: int,
                model_size: int, mesh, lr: float = LR, unroll: bool = True,
                optimizer: Optimizer | None = None, opt_state=None,
-               return_state: bool = False, mixed: bool = False):
+               return_state: bool = False, mixed: bool = False,
+               comm: str = "psum"):
     """Run the full FSDP schedule; returns final params as a global array
     (re-assembly is implicit in the output sharding — no host-side concat
     like ``train_ffns.py:284-287`` is needed). ``optimizer`` runs a
@@ -176,12 +199,14 @@ def train_fsdp(params: FFNStackParams, seeds, batch_size: int,
             "implicit requirement)")
     params = shard_params(params, mesh)
     step = make_step(batch_size, model_size, lr, unroll,
-                     optimizer=optimizer, mixed=mixed)
+                     optimizer=optimizer, mixed=mixed, comm=comm)
 
+    # ring-kernel outputs are typed shard-varying (see ddp.train_ddp)
+    check = comm == "psum"
     check_state_args(optimizer, opt_state, return_state)
     if optimizer is None:
         return launch_strided(step, params, seeds, mesh, DATA_AXIS,
-                              PARAM_SPECS)
+                              PARAM_SPECS, check_vma=check)
     # zeros_like of the sharded params keeps their sharding, so the state
     # enters shard_map already 1/n per device; scalar leaves replicate
     state = optimizer.init(params) if opt_state is None else opt_state
@@ -189,4 +214,4 @@ def train_fsdp(params: FFNStackParams, seeds, batch_size: int,
     return launch_strided(step, params, seeds, mesh, DATA_AXIS,
                           PARAM_SPECS, state=state,
                           state_specs=state_specs,
-                          return_state=return_state)
+                          return_state=return_state, check_vma=check)

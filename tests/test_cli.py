@@ -256,3 +256,13 @@ def test_cli_method13_seq_parallel_lm():
     r = _run_cli("-m", "13", "-s", "2", "-n", "32", "--heads", "4",
                  "--kv_heads", "2", "--fake_devices", "8")
     assert r.returncode == 2 and "full MHA only" in r.stderr
+
+
+@pytest.mark.slow
+def test_cli_comm_pallas_ring_fsdp():
+    """--method 3 --comm pallas_ring: FSDP's gathers AND reduce-scatters
+    through the hand-scheduled ring kernels from the flag surface."""
+    r = _run_cli("-m", "3", "-s", "8", "-bs", "4", "-n", "8", "-l", "2",
+                 "-d", "64", "--comm", "pallas_ring",
+                 "--fake_devices", "8")
+    assert r.returncode == 0, r.stdout + r.stderr
