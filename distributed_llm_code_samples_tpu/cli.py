@@ -209,6 +209,15 @@ def main(argv=None) -> int:
                            DATA_AXIS, MODEL_AXIS, PIPE_AXIS, EXPERT_AXIS,
                            SEQ_AXIS)
 
+    if args.comm != "psum" and args.zero1:
+        print("error: --comm pallas_ring does not apply to --zero1 "
+              "(ZeRO-1's reduce_scatter/all_gather pair keeps the XLA "
+              "transport); drop one of the flags", file=sys.stderr)
+        return 2
+    if args.comm != "psum" and args.method not in (0, 2, 3, 9):
+        print("error: --comm applies to --method 2 (DDP) or 3 (FSDP)",
+              file=sys.stderr)
+        return 2
     if args.method == 13 and args.kv_heads:
         print("error: --method 13 (sequence-parallel LM) supports full "
               "MHA only (no --kv_heads): the ring vmaps equal q/kv "

@@ -65,9 +65,12 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def _interpret_arg(interpret: bool):
+def _interpret_arg(interpret: bool | None):
     # the TPU interpreter models semaphores + remote DMA; the generic
-    # pallas interpreter does not
+    # pallas interpreter does not. None = auto: interpreter off-TPU,
+    # Mosaic on chip (AOT codegen callers pass False explicitly).
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
     return pltpu.InterpretParams() if interpret else False
 
 
@@ -90,6 +93,20 @@ def _legalize_2d(x2, n: int):
     return x2  # narrow fallback: fine in interpret; Mosaic may reject
 
 
+def _drain_capacity(capacity, n: int):
+    """Zero the capacity semaphore's never-waited leftovers (the last
+    two steps' consumption signals have no reusing step). SAFETY-
+    CRITICAL ledger: a stale count satisfies a later backpressure wait
+    without any real consumption and re-opens the ≥2-step-skew DMA/
+    semaphore aliasing race (the n=8 corruption bug) — one accounting,
+    shared by every phase of every ring kernel."""
+    for slot_id in (0, 1):
+        sig = len([s for s in range(n - 1) if s % 2 == slot_id])
+        wai = len([s for s in range(2, n - 1) if s % 2 == slot_id])
+        if sig - wai:
+            pltpu.semaphore_wait(capacity.at[slot_id], sig - wai)
+
+
 def _neighbor_barrier(axis_name: str, n: int):
     """No remote write may target a chip still outside the kernel."""
     r = lax.axis_index(axis_name)
@@ -104,7 +121,7 @@ def _neighbor_barrier(axis_name: str, n: int):
 
 
 def ppermute_dma(x: jax.Array, axis_name: str, *,
-                 interpret: bool = False) -> jax.Array:
+                 interpret: bool | None = None) -> jax.Array:
     """One ring hop by explicit RDMA: device r's block lands on device
     ``(r+1) % n`` — ``lax.ppermute(x, perm=[(i, (i+1)%n)])`` with the
     transport hand-issued. Call inside ``shard_map``."""
@@ -143,7 +160,7 @@ def ppermute_dma(x: jax.Array, axis_name: str, *,
 
 
 def ring_all_reduce(x: jax.Array, axis_name: str, *,
-                    interpret: bool = False) -> jax.Array:
+                    interpret: bool | None = None) -> jax.Array:
     """``lax.psum(x, axis_name)`` as a hand-scheduled 2-phase ring of
     ``pltpu.make_async_remote_copy`` hops. Call inside ``shard_map``;
     ``x.shape[0]`` must divide by the axis size (the chunk unit)."""
@@ -208,11 +225,7 @@ def ring_all_reduce(x: jax.Array, axis_name: str, *,
         # phase 2's waits can only be satisfied by phase-2 signals.
         # (Also the ledger discipline: leftover counts would poison the
         # next kernel sharing the physical semaphores.)
-        for slot_id in (0, 1):
-            sig = len([s for s in range(n - 1) if s % 2 == slot_id])
-            wai = len([s for s in range(2, n - 1) if s % 2 == slot_id])
-            if sig - wai:
-                pltpu.semaphore_wait(capacity.at[slot_id], sig - wai)
+        _drain_capacity(capacity, n)
 
         # ---- phase handoff ------------------------------------------
         # Phase 2 writes straight into the RIGHT neighbor's output; that
@@ -256,11 +269,7 @@ def ring_all_reduce(x: jax.Array, axis_name: str, *,
         lax.fori_loop(0, n - 1, ag_step, 0)
 
         # ---- drain phase 2's leftovers (same accounting) ------------
-        for slot_id in (0, 1):
-            sig = len([s for s in range(n - 1) if s % 2 == slot_id])
-            wai = len([s for s in range(2, n - 1) if s % 2 == slot_id])
-            if sig - wai:
-                pltpu.semaphore_wait(capacity.at[slot_id], sig - wai)
+        _drain_capacity(capacity, n)
 
     out = pl.pallas_call(
         kernel,
@@ -289,7 +298,7 @@ def ring_all_reduce(x: jax.Array, axis_name: str, *,
 
 
 def ring_reduce_scatter(x: jax.Array, axis_name: str, *,
-                        interpret: bool = False) -> jax.Array:
+                        interpret: bool | None = None) -> jax.Array:
     """``collectives.reduce_scatter(x, axis, dim=0)`` hand-scheduled:
     the reduce-scatter phase of the ring alone. ``x [n*rc, ...]`` per
     device; device ``r`` returns the summed chunk ``r`` (``[rc, ...]``).
@@ -344,11 +353,7 @@ def ring_reduce_scatter(x: jax.Array, axis_name: str, *,
         lax.fori_loop(0, n - 1, rs_step, 0)
         o_ref[...] = acc[pl.ds(lax.rem(rv + 1, n) * rc, rc), :]
         # drain the never-waited capacity leftovers (ledger discipline)
-        for slot_id in (0, 1):
-            sig = len([s for s in range(n - 1) if s % 2 == slot_id])
-            wai = len([s for s in range(2, n - 1) if s % 2 == slot_id])
-            if sig - wai:
-                pltpu.semaphore_wait(capacity.at[slot_id], sig - wai)
+        _drain_capacity(capacity, n)
 
     out = pl.pallas_call(
         kernel,
@@ -371,7 +376,7 @@ def ring_reduce_scatter(x: jax.Array, axis_name: str, *,
 
 
 def ring_all_gather(x: jax.Array, axis_name: str, *,
-                    interpret: bool = False) -> jax.Array:
+                    interpret: bool | None = None) -> jax.Array:
     """``collectives.all_gather(x, axis, dim=0)`` hand-scheduled: the
     all-gather phase of the ring alone. ``x [rows, ...]`` per device;
     returns ``[n*rows, ...]`` with chunk ``i`` = device ``i``'s block —
@@ -416,11 +421,7 @@ def ring_all_gather(x: jax.Array, axis_name: str, *,
             return 0
 
         lax.fori_loop(0, n - 1, ag_step, 0)
-        for slot_id in (0, 1):
-            sig = len([s for s in range(n - 1) if s % 2 == slot_id])
-            wai = len([s for s in range(2, n - 1) if s % 2 == slot_id])
-            if sig - wai:
-                pltpu.semaphore_wait(capacity.at[slot_id], sig - wai)
+        _drain_capacity(capacity, n)
 
     out = pl.pallas_call(
         kernel,

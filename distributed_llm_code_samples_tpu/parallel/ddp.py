@@ -96,12 +96,11 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
         raise ValueError(f"unknown comm {comm!r} "
                          "(expected 'psum' or 'pallas_ring')")
     if comm == "pallas_ring":
-        import jax as _jax
         from ..ops.pallas_ring import ring_all_reduce
-        interp = (_jax.default_backend() != "tpu"
-                  if ring_interpret is None else ring_interpret)
-        reduce = lambda g: ring_all_reduce(g, axis,  # noqa: E731
-                                           interpret=interp)
+        # interpret=None lets the kernel auto-detect (interpreter
+        # off-TPU, Mosaic on chip); AOT codegen callers pass False
+        reduce = lambda g: ring_all_reduce(  # noqa: E731
+            g, axis, interpret=ring_interpret)
     else:
         reduce = lambda g: all_reduce(g, axis)  # noqa: E731
 

@@ -113,15 +113,12 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
                          "(expected 'psum' or 'pallas_ring')")
     if comm == "pallas_ring":
         from ..ops.pallas_ring import ring_all_gather, ring_reduce_scatter
-        # default: interpreter off-TPU (the CPU test mesh), Mosaic on
-        # chip; AOT codegen callers pass ring_interpret=False explicitly
-        # (no TPU attached, but the kernels must compile for one)
-        interp = (jax.default_backend() != "tpu"
-                  if ring_interpret is None else ring_interpret)
-        _ag = lambda t: ring_all_gather(t, axis,  # noqa: E731
-                                        interpret=interp)
-        _rs = lambda t: ring_reduce_scatter(t, axis,  # noqa: E731
-                                            interpret=interp)
+        # interpret=None lets the kernels auto-detect (interpreter
+        # off-TPU, Mosaic on chip); AOT codegen callers pass False
+        _ag = lambda t: ring_all_gather(  # noqa: E731
+            t, axis, interpret=ring_interpret)
+        _rs = lambda t: ring_reduce_scatter(  # noqa: E731
+            t, axis, interpret=ring_interpret)
     else:
         _ag = lambda t: all_gather(t, axis, dim=0)  # noqa: E731
         _rs = lambda t: reduce_scatter(t, axis, dim=0)  # noqa: E731

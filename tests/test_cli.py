@@ -266,3 +266,14 @@ def test_cli_comm_pallas_ring_fsdp():
                  "-d", "64", "--comm", "pallas_ring",
                  "--fake_devices", "8")
     assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_cli_comm_flag_guards():
+    """--comm pallas_ring outside methods 2/3 (or with --zero1) is a
+    clean exit-2 arg error, never a silent psum fallback."""
+    r = _run_cli("-s", "2", "-m", "2", "--zero1", "--comm", "pallas_ring",
+                 "--fake_devices", "4")
+    assert r.returncode == 2 and "--zero1" in r.stderr
+    r = _run_cli("-s", "2", "-m", "4", "--comm", "pallas_ring",
+                 "--fake_devices", "4")
+    assert r.returncode == 2 and "--comm applies" in r.stderr

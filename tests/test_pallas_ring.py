@@ -112,7 +112,8 @@ def test_ring_all_reduce_aot_v5e8_mosaic_codegen():
     semantics half)."""
     mesh = _v5e8_mesh()
     f = jax.jit(jax.shard_map(
-        functools.partial(ring_all_reduce, axis_name=DATA_AXIS),
+        functools.partial(ring_all_reduce, axis_name=DATA_AXIS,
+                          interpret=False),
         mesh=mesh, in_specs=P(DATA_AXIS, None),
         out_specs=P(DATA_AXIS, None), check_vma=False))
     x = jax.ShapeDtypeStruct((8 * 8, 128), jnp.float32)
@@ -131,7 +132,8 @@ def test_ppermute_dma_aot_v5e8_mosaic_codegen():
     """Same for the single-hop primitive vs collective-permute."""
     mesh = _v5e8_mesh()
     f = jax.jit(jax.shard_map(
-        functools.partial(ppermute_dma, axis_name=DATA_AXIS),
+        functools.partial(ppermute_dma, axis_name=DATA_AXIS,
+                          interpret=False),
         mesh=mesh, in_specs=P(DATA_AXIS, None),
         out_specs=P(DATA_AXIS, None), check_vma=False))
     x = jax.ShapeDtypeStruct((8 * 8, 128), jnp.float32)
