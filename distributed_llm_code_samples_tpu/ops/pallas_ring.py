@@ -11,12 +11,14 @@ module is the OTHER answer, the one the reference's stream experiment
 (``test_torch_cuda_stream.py:31-37``) was reaching for: communication as
 explicitly issued, explicitly awaited inter-chip DMA, scheduled by us.
 
-Two levels:
+The COMPLETE collective family — every op in SURVEY §2.7's ledger — as
+hand-scheduled kernels, each pinned against its XLA counterpart and
+AOT-compiled for v5e-8:
 
 - ``ppermute_dma``: one ring hop — each device RDMAs its block to its
   right neighbor (``pltpu.make_async_remote_copy``), with the neighbor
-  barrier that makes a raw remote write safe. The primitive is
-  equality-pinned against ``lax.ppermute``.
+  barrier that makes a raw remote write safe. Equality-pinned against
+  ``lax.ppermute``.
 - ``ring_all_reduce``: the full classic 2(n-1)-step ring — reduce-
   scatter phase then all-gather phase — inside ONE kernel launch:
   double-buffered communication slots, DMA-completion semaphores,
@@ -27,6 +29,14 @@ Two levels:
   summation order per chunk: partials accumulate in ring order on both
   paths only if n is the ring size — values agree to f32 reduction-order
   tolerance).
+- ``ring_reduce_scatter`` / ``ring_all_gather``: the two phases as
+  standalone kernels in the ``psum_scatter``/``all_gather`` conventions
+  — the exact pattern FSDP consumes (``train_fsdp(comm="pallas_ring")``
+  runs its whole comm schedule through them).
+- ``all_to_all_dma``: the dense peer fan-out (EP-dispatch / Ulysses
+  transport) — every (src, dst) block pair is a direct RDMA with
+  per-peer semaphore slots; all n-1 transfers in flight at once, no
+  slot reuse, no backpressure needed.
 
 Algorithm notes (device ``r`` of ``n``, chunks = leading-dim n-split):
 
@@ -439,6 +449,104 @@ def ring_all_gather(x: jax.Array, axis_name: str, *,
         interpret=_interpret_arg(interpret),
     )(x2)
     return out.reshape((n * shape[0],) + shape[1:])
+
+
+def _all_peer_barrier(axis_name: str, n: int):
+    """All-to-all targets every peer, so kernel-entry safety needs the
+    FULL barrier (the neighbor form only covers ring topologies)."""
+    r = lax.axis_index(axis_name)
+    barrier = pltpu.get_barrier_semaphore()
+
+    def signal(j, _):
+        @pl.when(j != r)
+        def _():
+            pltpu.semaphore_signal(
+                barrier, inc=1, device_id=j,
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+        return 0
+
+    lax.fori_loop(0, n, signal, 0)
+    pltpu.semaphore_wait(barrier, n - 1)
+
+
+def all_to_all_dma(x: jax.Array, axis_name: str, *,
+                   interpret: bool | None = None) -> jax.Array:
+    """``collectives.all_to_all(x, axis, split_dim=0, concat_dim=0)``
+    hand-scheduled: chunk ``j`` of every device's block RDMAs DIRECTLY to
+    device ``j`` (no ring — the dense peer fan-out the EP dispatch and
+    Ulysses re-shards ride), landing at chunk position ``r`` of the
+    receiver. All ``n-1`` outgoing transfers start before any wait (full
+    overlap); per-peer semaphore slots make completion order irrelevant
+    (each (src, dst) pair is unique — no slot reuse, no backpressure
+    needed, unlike the ring kernels)."""
+    n = lax.psum(1, axis_name)
+    if n == 1:
+        return x
+    shape = x.shape
+    if shape[0] % n:
+        raise ValueError(f"leading dim {shape[0]} not divisible by "
+                         f"{n} peers (the split unit of all_to_all)")
+    x2 = x.reshape(shape[0], -1) if x.ndim != 2 else x
+    x2 = _legalize_2d(x2, n)
+    rows, cols = x2.shape
+    rc = rows // n
+
+    def kernel(x_ref, o_ref, send_sem, recv_sem):
+        _all_peer_barrier(axis_name, n)
+        r = lax.axis_index(axis_name)
+        o_ref[pl.ds(r * rc, rc), :] = x_ref[pl.ds(r * rc, rc), :]
+
+        def out_desc(j):
+            # outgoing r->j: my chunk j lands at the receiver's chunk r;
+            # the remote signal slot is MY index (so the receiver can
+            # tell sources apart), my send slot is the peer index
+            return pltpu.make_async_remote_copy(
+                src_ref=x_ref.at[pl.ds(j * rc, rc), :],
+                dst_ref=o_ref.at[pl.ds(r * rc, rc), :],
+                send_sem=send_sem.at[j], recv_sem=recv_sem.at[r],
+                device_id=j,
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+        def start(j, _):
+            @pl.when(j != r)
+            def _():
+                out_desc(j).start()
+            return 0
+
+        lax.fori_loop(0, n, start, 0)
+
+        def wait(j, _):
+            @pl.when(j != r)
+            def _():
+                # incoming from peer j: wrote my chunk j, signals MY
+                # recv slot j — a descriptor with the matching refs
+                # (same transfer size) and slot performs the wait
+                pltpu.make_async_remote_copy(
+                    src_ref=x_ref.at[pl.ds(r * rc, rc), :],
+                    dst_ref=o_ref.at[pl.ds(j * rc, rc), :],
+                    send_sem=send_sem.at[j], recv_sem=recv_sem.at[j],
+                    device_id=j,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL).wait_recv()
+                out_desc(j).wait_send()
+            return 0
+
+        lax.fori_loop(0, n, wait, 0)
+
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((rows, cols), x2.dtype,
+                                       vma=frozenset({axis_name})),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((n,)),   # per-peer send completion
+            pltpu.SemaphoreType.DMA((n,)),   # per-source recv completion
+        ],
+        compiler_params=pltpu.CompilerParams(has_side_effects=True,
+                                             collective_id=11),
+        interpret=_interpret_arg(interpret),
+    )(x2)
+    return out.reshape(shape)
 
 
 def ring_all_reduce_spmd(x: jax.Array, mesh, axis_name: str, *,
