@@ -549,6 +549,41 @@ def all_to_all_dma(x: jax.Array, axis_name: str, *,
     return out.reshape(shape)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def all_to_all_dma_dims(x: jax.Array, axis_name: str, split_dim: int,
+                        concat_dim: int,
+                        interpret: bool | None = None) -> jax.Array:
+    """``collectives.all_to_all(x, axis, split_dim=s, concat_dim=c)``
+    (tiled) over the ``all_to_all_dma`` kernel: the split dim moves to
+    the front for the dim-0 exchange, and the received blocks
+    concatenate back along ``concat_dim`` — the Ulysses re-shard shapes
+    (``[H, T, dh]``, 0<->1) ride this form. Differentiable: the VJP of a
+    tiled all_to_all is the all_to_all with the dims swapped (the
+    exchange is a linear permutation of blocks), so autodiff through a
+    strategy's a2a transport runs the transport kernel both ways."""
+    return _a2a_dims_fwd(x, axis_name, split_dim, concat_dim,
+                         interpret)[0]
+
+
+def _a2a_dims_fwd(x, axis_name, split_dim, concat_dim, interpret):
+    n = lax.psum(1, axis_name)
+    if n == 1:
+        return x, None
+    xm = jnp.moveaxis(x, split_dim, 0)
+    k = all_to_all_dma(xm, axis_name, interpret=interpret)
+    kb = k.reshape(n, xm.shape[0] // n, *xm.shape[1:])
+    blocks = [jnp.moveaxis(kb[j], 0, split_dim) for j in range(n)]
+    return jnp.concatenate(blocks, axis=concat_dim), None
+
+
+def _a2a_dims_bwd(axis_name, split_dim, concat_dim, interpret, _, dy):
+    return (all_to_all_dma_dims(dy, axis_name, concat_dim, split_dim,
+                                interpret),)
+
+
+all_to_all_dma_dims.defvjp(_a2a_dims_fwd, _a2a_dims_bwd)
+
+
 def ring_all_reduce_spmd(x: jax.Array, mesh, axis_name: str, *,
                          interpret: bool = False) -> jax.Array:
     """Convenience launcher: shard a global ``[n*rows, cols]`` array over

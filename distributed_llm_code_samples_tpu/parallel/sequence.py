@@ -376,7 +376,7 @@ def _ring_fn(mesh, causal: bool):
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       axis_name: str = SEQ_AXIS, causal: bool = True,
-                      attn=None):
+                      attn=None, comm: str = "psum"):
     """Ulysses attention for one shard (call under ``shard_map``).
 
     ``q, k, v: [H, T_local, dh]`` — this shard's sequence block of every
@@ -384,17 +384,28 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     exact full-sequence attention. ``attn`` swaps the local multi-head op
     (None = quadratic hand-VJP ``mha``; pass the fused Pallas ``flash_mha``
     — the a2a re-shard hands each shard FULL sequences of ``H/n`` heads,
-    exactly the shape the flash kernels tile best).
+    exactly the shape the flash kernels tile best). ``comm="pallas_a2a"``
+    runs BOTH re-shards (and, via the custom VJP, their backward
+    transposes) through the hand-scheduled peer fan-out kernel
+    (``ops.pallas_ring.all_to_all_dma``) instead of XLA's all_to_all.
     """
     from ..models.attention import mha
     from .collectives import all_to_all
 
-    def scatter_heads(t):  # [H, T_local, dh] -> [H/n, T, dh]
-        return all_to_all(t, axis_name, split_dim=0, concat_dim=1)
+    if comm == "pallas_a2a":
+        from ..ops.pallas_ring import all_to_all_dma_dims
+        a2a = lambda t, s, c: all_to_all_dma_dims(  # noqa: E731
+            t, axis_name, s, c, None)
+    elif comm == "psum":
+        a2a = lambda t, s, c: all_to_all(t, axis_name,  # noqa: E731
+                                         split_dim=s, concat_dim=c)
+    else:
+        raise ValueError(f"unknown comm {comm!r} "
+                         "(expected 'psum' or 'pallas_a2a')")
 
     op = mha if attn is None else attn
-    y = op(*map(scatter_heads, (q, k, v)), causal)
-    return all_to_all(y, axis_name, split_dim=1, concat_dim=0)
+    y = op(*(a2a(t, 0, 1) for t in (q, k, v)), causal)
+    return a2a(y, 1, 0)
 
 
 def ulysses_parallel_attention(q: jax.Array, k: jax.Array, v: jax.Array,

@@ -343,3 +343,36 @@ def test_flash_ring_aot_v5e8_codegen():
     hlo = f.lower(x, x, x).compile().as_text()
     assert "collective-permute" in hlo
     assert "custom-call" in hlo
+
+
+def test_ulysses_pallas_a2a_transport(qkv_heads):
+    """Ulysses with comm="pallas_a2a": both re-shards (and their VJP
+    transposes) through the hand-scheduled peer fan-out kernel == the
+    XLA all_to_all path, forward and gradients."""
+    import functools
+    from jax.sharding import PartitionSpec as P
+    from distributed_llm_code_samples_tpu.parallel.sequence import (
+        ulysses_attention)
+    q, k, v = qkv_heads
+    mesh = make_mesh({SEQ_AXIS: 4})
+    spec = P(None, SEQ_AXIS, None)
+
+    def run(comm):
+        return jax.shard_map(
+            functools.partial(ulysses_attention, axis_name=SEQ_AXIS,
+                              comm=comm),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=comm == "psum")
+
+    np.testing.assert_allclose(
+        np.asarray(run("pallas_a2a")(q, k, v)),
+        np.asarray(run("psum")(q, k, v)), rtol=1e-6, atol=1e-6)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v) ** 2)
+
+    g_dma = jax.grad(loss(run("pallas_a2a")), argnums=(0, 1, 2))(q, k, v)
+    g_xla = jax.grad(loss(run("psum")), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_dma, g_xla):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-6)
