@@ -71,7 +71,7 @@ def _local_capacity(t_local: int, n_shards: int, n_experts: int,
 
 def moe_layer_ep(wg, w1_local, w2_local, x, capacity_factor: float = 2.0,
                  axis: str = EXPERT_AXIS, k: int = 1,
-                 dispatch: str = "dense"):
+                 dispatch: str = "dense", comm: str = "psum"):
     """One expert-parallel MoE layer, per-shard view (no residual here —
     the step adds it).
 
@@ -79,7 +79,20 @@ def moe_layer_ep(wg, w1_local, w2_local, x, capacity_factor: float = 2.0,
     ``w2_local [E/n, d, ffn]``, ``x [T_local, d]``. ``dispatch``:
     ``"dense"`` one-hot einsum movement or ``"scatter"`` (O(T*d)
     scatter/gather around the same pair of ``all_to_all``s — identical
-    routing/capacity/priority semantics, differential-pinned)."""
+    routing/capacity/priority semantics, differential-pinned).
+    ``comm="pallas_a2a"`` carries both exchanges (and their backward
+    transposes) on the hand-scheduled peer fan-out kernel
+    (``ops.pallas_ring.all_to_all_dma_dims``)."""
+    if comm == "pallas_a2a":
+        from ..ops.pallas_ring import all_to_all_dma_dims
+        a2a = lambda t, sd, cd: all_to_all_dma_dims(  # noqa: E731
+            t, axis, sd, cd, None)
+    elif comm == "psum":
+        a2a = lambda t, sd, cd: all_to_all(t, axis, split_dim=sd,  # noqa: E731
+                                           concat_dim=cd)
+    else:
+        raise ValueError(f"unknown comm {comm!r} "
+                         "(expected 'psum' or 'pallas_a2a')")
     n_experts = wg.shape[0]
     t = x.shape[0]
     cap = _local_capacity(t, lax.axis_size(axis), n_experts,
@@ -89,9 +102,9 @@ def moe_layer_ep(wg, w1_local, w2_local, x, capacity_factor: float = 2.0,
         # slot bookkeeping) around the SAME pair of all_to_alls
         idx_flat, gates = route_flat(wg, x, k)
         xe, dest, keep = scatter_dispatch(idx_flat, x, n_experts, cap)
-        xe = all_to_all(xe, axis, split_dim=0, concat_dim=1)
+        xe = a2a(xe, 0, 1)
         ye = jax.vmap(ffn_block)(w1_local, w2_local, xe)
-        ye = all_to_all(ye, axis, split_dim=1, concat_dim=0)
+        ye = a2a(ye, 1, 0)
         return scatter_combine(ye, dest, keep, gates, t)
     if dispatch != "dense":
         raise ValueError(f"unknown dispatch {dispatch!r}")
@@ -106,33 +119,46 @@ def moe_layer_ep(wg, w1_local, w2_local, x, capacity_factor: float = 2.0,
         comb = jnp.einsum("ktec,tk->tec", disp_k, gates)
     xe = jnp.einsum("tec,td->ecd", disp, x)              # [E, C, d]
     # experts -> their owners; slots from all shards stack on the cap axis
-    xe = all_to_all(xe, axis, split_dim=0, concat_dim=1)  # [E/n, n*C, d]
+    xe = a2a(xe, 0, 1)                                    # [E/n, n*C, d]
     ye = jax.vmap(ffn_block)(w1_local, w2_local, xe)      # [E/n, n*C, d]
     # results return to the tokens' home shards
-    ye = all_to_all(ye, axis, split_dim=1, concat_dim=0)  # [E, C, d]
+    ye = a2a(ye, 1, 0)                                    # [E, C, d]
     return jnp.einsum("tec,ecd->td", comb, ye)
 
 
 def make_step(batch_size: int, model_size: int, lr: float = LR,
               capacity_factor: float = 2.0, axis: str = EXPERT_AXIS,
               k: int = 1, aux_coef: float = 0.0,
-              data_axis: str | None = None, dispatch: str = "dense"):
+              data_axis: str | None = None, dispatch: str = "dense",
+              comm: str = "psum"):
     """One EP step for one shard: local fwd (residual per layer),
     ``jax.vjp``-composed backward over the hand-written rules, optional
     load-balancing aux term, explicit router-grad psum, local SGD.
 
     Fwd and aux come from ONE stack walk returning ``(y, aux)``; the
     combined gradient is a single vjp with cotangents
-    ``(dloss_dx, aux_coef)`` — no second forward, no duplicated a2a."""
+    ``(dloss_dx, aux_coef)`` — no second forward, no duplicated a2a.
+
+    ``comm="pallas_a2a"`` implies the launcher runs ``check_vma=False``
+    (the Mosaic interpreter's vma propagation is incomplete), which
+    erases the provenance signal ``grad_reduce`` keys on — so this path
+    reduces the router (and 2-D data-axis) grads with an UNCONDITIONAL
+    psum: without vma, no transpose auto-reduces, every such cotangent
+    arrives partial (verified empirically: the psum path under
+    check_vma=False shows the exact same under-reduction this corrects).
+    """
 
     axes = (axis,) if data_axis is None else (axis, data_axis)
+    reducer = (grad_reduce if comm == "psum"
+               else (lambda g, ax: lax.psum(g, ax)))
 
     def fwd_aux(params: MoEStackParams, x):
         aux = jnp.asarray(0.0, jnp.float32)
         for l in range(params.w1.shape[0]):
             aux = aux + router_aux_loss(params.wg[l], x)
             x = x + moe_layer_ep(params.wg[l], params.w1[l], params.w2[l],
-                                 x, capacity_factor, axis, k, dispatch)
+                                 x, capacity_factor, axis, k, dispatch,
+                                 comm)
         return x, aux
 
     def step(params: MoEStackParams, seed) -> MoEStackParams:
@@ -151,11 +177,11 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
         # shard within an EP group; the data axis replicates the groups,
         # so they too sum over data (grad_reduce is vma-aware: it never
         # touches the expert axis for them).
-        grads = grads._replace(wg=grad_reduce(grads.wg, axes))
+        grads = grads._replace(wg=reducer(grads.wg, axes))
         if data_axis is not None:
             grads = grads._replace(
-                w1=grad_reduce(grads.w1, data_axis),
-                w2=grad_reduce(grads.w2, data_axis))
+                w1=reducer(grads.w1, data_axis),
+                w2=reducer(grads.w2, data_axis))
         return sgd(params, grads, lr)
 
     return step
@@ -165,7 +191,8 @@ def train_moe_ep(params: MoEStackParams, seeds, batch_size: int,
                  model_size: int, mesh, lr: float = LR,
                  capacity_factor: float = 2.0, k: int = 1,
                  aux_coef: float = 0.0,
-                 dispatch: str = "dense") -> MoEStackParams:
+                 dispatch: str = "dense",
+                 comm: str = "psum") -> MoEStackParams:
     """Run the EP schedule; returns fully-assembled final params.
 
     ``batch_size`` is the *global token count per EP group* per step; each
@@ -193,9 +220,11 @@ def train_moe_ep(params: MoEStackParams, seeds, batch_size: int,
     step = make_step(batch_size // n, model_size, lr, capacity_factor,
                      k=k, aux_coef=aux_coef,
                      data_axis=DATA_AXIS if dp > 1 else None,
-                     dispatch=dispatch)
+                     dispatch=dispatch, comm=comm)
     specs = MoEStackParams(wg=P(), w1=P(None, EXPERT_AXIS),
                            w2=P(None, EXPERT_AXIS))
+    # a2a-kernel outputs are typed shard-varying (see ddp.train_ddp)
+    check = comm == "psum"
     if dp > 1:
         # 2-D data x expert: the seed schedule strides over BOTH axes —
         # shard (d, e) of step t consumes seeds[t*dp*n + d*n + e], the
@@ -205,9 +234,10 @@ def train_moe_ep(params: MoEStackParams, seeds, batch_size: int,
         return launch(step, clone_params(params), cols, mesh,
                       param_specs=specs,
                       seed_spec=P(None, DATA_AXIS, EXPERT_AXIS),
-                      select_local=lambda s: s[:, 0, 0])
+                      select_local=lambda s: s[:, 0, 0],
+                      check_vma=check)
     return launch_strided(step, clone_params(params), seeds, mesh,
-                          EXPERT_AXIS, specs)
+                          EXPERT_AXIS, specs, check_vma=check)
 
 
 def train_moe_dense(params: MoEStackParams, seeds, batch_size: int,

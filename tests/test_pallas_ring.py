@@ -310,3 +310,26 @@ def test_all_to_all_dma_aot_v5e8_codegen():
     hlo = lowered.compile().as_text()
     assert "custom-call" in hlo
     assert "all-to-all" not in hlo
+
+
+def test_moe_ep_with_pallas_a2a_matches_psum(mesh4_expert):
+    """Expert parallelism with comm="pallas_a2a": both dispatch/return
+    exchanges (and their autodiff transposes inside the step's vjp)
+    through the peer fan-out kernel == the XLA all_to_all path, for both
+    dispatch forms."""
+    from distributed_llm_code_samples_tpu.data import make_seed_schedule
+    from distributed_llm_code_samples_tpu.models import init_moe_stack
+    from distributed_llm_code_samples_tpu.parallel import train_moe_ep
+    params = init_moe_stack(jax.random.PRNGKey(0), 32, 2, 8)
+    seeds = make_seed_schedule(8, random_seed=5)
+    for dispatch in ("dense", "scatter"):
+        want = train_moe_ep(params, seeds, 64, 32, mesh4_expert, lr=0.1,
+                            k=2, aux_coef=0.01, dispatch=dispatch)
+        got = train_moe_ep(params, seeds, 64, 32, mesh4_expert, lr=0.1,
+                           k=2, aux_coef=0.01, dispatch=dispatch,
+                           comm="pallas_a2a")
+        for a, b in zip(jax.tree_util.tree_leaves(got),
+                        jax.tree_util.tree_leaves(want)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-7,
+                                       err_msg=dispatch)
