@@ -143,9 +143,12 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
     (the Mosaic interpreter's vma propagation is incomplete), which
     erases the provenance signal ``grad_reduce`` keys on — so this path
     reduces the router (and 2-D data-axis) grads with an UNCONDITIONAL
-    psum: without vma, no transpose auto-reduces, every such cotangent
-    arrives partial (verified empirically: the psum path under
-    check_vma=False shows the exact same under-reduction this corrects).
+    psum. Empirically pinned both ways: the pure-XLA psum path run under
+    ``check_vma=False`` reproduces the exact under-reduction this
+    corrects (EP's router cotangents arrive partial there — they flow
+    through custom_vjp rules, which vma-off leaves unreduced), and the
+    corrected path equals the vma-on psum path leaf for leaf
+    (``tests/test_pallas_ring.py``) — i.e. no double reduction either.
     """
 
     axes = (axis,) if data_axis is None else (axis, data_axis)
