@@ -14,8 +14,18 @@ reports the per-T ratio.
 Emits one JSON line:
 ``{"metric": "attn_pallas_vs_xla", ..., "per_T": {"1024": r, ...}}``
 (ratio > 1.0: flash wins). Written to ``ATTENTION_r03.json`` when
-``ATTN_ARTIFACT`` is set. Timing: whole grad step under jit, REPS
-best-of, scalar-readback fencing (bench.py methodology).
+``ATTN_ARTIFACT`` is set.
+
+Timing: the first on-chip collection (r04, 03:47 UTC) exposed a ~90 ms
+per-dispatch relay floor — a single fwd+bwd at T=1024 is ~1 ms of
+kernel work, so one-dispatch-per-rep timing measured the tunnel, not
+the kernels (xla_ms was flat 87->102 ms across a 64x FLOP range).
+This version times K grad-steps chained inside ONE jitted
+``lax.scan`` program (each step's inputs perturbed by the previous
+step's gradients, so the chain is sequentially dependent and cannot be
+DCE'd or reordered), auto-calibrates K per (path, T) so the timed
+program runs ~ATTN_TARGET_S seconds, measures the relay floor with a
+null program, and reports floor-subtracted per-step times.
 
 Run: ``python bench_attention.py`` (real TPU). Smoke:
 ``BENCH_PLATFORM=cpu ATTN_TS=128 python bench_attention.py``
@@ -39,6 +49,11 @@ TS = tuple(int(t) for t in
            os.environ.get("ATTN_TS", "1024,4096,8192").split(","))
 REPS = int(os.environ.get("ATTN_REPS", 5))
 CAUSAL = os.environ.get("ATTN_CAUSAL", "1") != "0"
+# target wall-clock of each timed program; K inner steps are calibrated
+# to hit it so the relay floor stays a small fraction of the timing
+TARGET_S = float(os.environ.get("ATTN_TARGET_S", 1.2))
+# fixed inner step count (skips calibration) — for CPU smoke runs
+INNER = int(os.environ.get("ATTN_INNER", 0))
 
 
 def _flops(t: int) -> float:
@@ -60,24 +75,68 @@ def main() -> int:
     interpret = jax.default_backend() != "tpu"
     per_t, per_t_detail = {}, {}
 
-    def step_time(fn, q, k, v):
-        # sum-of-outputs loss, differentiated wrt ALL of q/k/v — grad wrt
-        # q alone would let XLA dead-code-eliminate the dK/dV backward
-        # matmuls and time a partial backward. Summing the three
-        # cotangents into one scalar fences the whole program with one
-        # readback (relay methodology, utils/benchtime.py).
-        g = jax.jit(jax.grad(
-            lambda qkv: jnp.sum(fn(*qkv)), argnums=0))
-        out = g((q, k, v))
-        float(sum(o[0, 0, 0] for o in out))  # compile + fence
+    # Relay/dispatch floor: best-of timing of a null program (one scalar
+    # in, one scalar readback). Subtracted from every program timing.
+    # The operand is staged to the device BEFORE the loop so each rep
+    # pays exactly the one round-trip the timed programs pay — a
+    # host-side jnp.float32(...) per rep would add a device_put and
+    # bias the floor high (and the subtracted times low).
+    null = jax.jit(lambda x: x + 1.0)
+    one = jax.device_put(jnp.float32(1.0))
+    float(null(one))
+    floor = None
+    for _ in range(max(REPS, 5)):
+        t0 = time.perf_counter()
+        float(null(one))
+        dt = time.perf_counter() - t0
+        floor = dt if floor is None else min(floor, dt)
+
+    def make_prog(fn, n):
+        # One jitted program of n sequentially-dependent grad steps.
+        # Loss sums over ALL of q/k/v cotangents — grad wrt q alone
+        # would let XLA DCE the dK/dV backward matmuls. Each step feeds
+        # eps*grads back into the next step's inputs, so the scan chain
+        # is a true data dependence (no reordering, no elision); the
+        # perturbation is numerically irrelevant and the elementwise
+        # cost is negligible vs the attention matmuls at T >= 1024.
+        g = jax.grad(lambda qkv: jnp.sum(fn(*qkv)))
+
+        def body(c, _):
+            dq, dk, dv = g(c)
+            q, k, v = c
+            return (q + 1e-30 * dq, k + 1e-30 * dk, v + 1e-30 * dv), ()
+
+        def prog(qkv):
+            c, _ = jax.lax.scan(body, qkv, None, length=n)
+            return c[0][0, 0, 0] + c[1][0, 0, 0] + c[2][0, 0, 0]
+
+        return jax.jit(prog)
+
+    def prog_time(p, qkv):
+        float(p(qkv))  # compile + fence
         best = None
         for _ in range(REPS):
             t0 = time.perf_counter()
-            out = g((q, k, v))
-            float(sum(o[0, 0, 0] for o in out))
+            float(p(qkv))
             dt = time.perf_counter() - t0
             best = dt if best is None else min(best, dt)
         return best
+
+    def step_time(fn, q, k, v):
+        """Floor-subtracted seconds per fwd+bwd step, plus the K used
+        and the achieved program duration (so a capped K — where the
+        floor stays a visible fraction of the window — is
+        distinguishable in the artifact from a converged one)."""
+        qkv = (q, k, v)
+        if INNER:
+            n = INNER
+        else:
+            n0 = 8
+            t0 = prog_time(make_prog(fn, n0), qkv)
+            per = max((t0 - floor) / n0, 1e-7)
+            n = int(max(8, min(4096, round(TARGET_S / per))))
+        tn = prog_time(make_prog(fn, n), qkv)
+        return max(tn - floor, 1e-9) / n, n, tn
 
     for t in TS:
         kq, kk, kv = jax.random.split(jax.random.PRNGKey(t), 3)
@@ -85,16 +144,22 @@ def main() -> int:
         k = jax.random.normal(kk, (H, t, DH), jnp.float32)
         v = jax.random.normal(kv, (H, t, DH), jnp.float32)
         try:
-            t_xla = step_time(lambda q, k, v: mha(q, k, v, CAUSAL),
-                              q, k, v)
-            t_flash = step_time(
+            t_xla, n_xla, s_xla = step_time(
+                lambda q, k, v: mha(q, k, v, CAUSAL), q, k, v)
+            t_flash, n_flash, s_flash = step_time(
                 lambda q, k, v: flash_mha(q, k, v, CAUSAL, interpret),
                 q, k, v)
             per_t[str(t)] = round(t_xla / t_flash, 4)
             per_t_detail[str(t)] = {
                 "xla_ms": round(t_xla * 1e3, 3),
                 "flash_ms": round(t_flash * 1e3, 3),
+                "xla_tflops": round(_flops(t) / t_xla / 1e12, 2),
                 "flash_tflops": round(_flops(t) / t_flash / 1e12, 2),
+                "inner_steps": {"xla": n_xla, "flash": n_flash},
+                "program_s": {"xla": round(s_xla, 3),
+                              "flash": round(s_flash, 3)},
+                "floor_frac": {"xla": round(floor / s_xla, 3),
+                               "flash": round(floor / s_flash, 3)},
             }
         except Exception as exc:  # noqa: BLE001
             per_t[str(t)] = f"error: {type(exc).__name__}: {str(exc)[:160]}"
@@ -106,6 +171,9 @@ def main() -> int:
         "unit": "x (flash speedup over quadratic XLA, fwd+bwd)",
         "per_T": per_t,
         "detail": per_t_detail,
+        "relay_floor_ms": round(floor * 1e3, 3),
+        "timing": ("scanned dependent grad-steps per program, "
+                   "floor-subtracted, best-of-REPS"),
         "shape": f"H{H}_dh{DH}_causal{int(CAUSAL)}",
         "device_kind": jax.devices()[0].device_kind,
     }

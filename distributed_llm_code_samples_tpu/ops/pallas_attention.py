@@ -24,6 +24,7 @@ row would otherwise poison the accumulator). All kernels run under
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -35,6 +36,46 @@ from .pallas_ffn import _pick_block
 _NEG = -1e30
 _LANES = 128
 _Q_QUANTUM = 8
+# Default tile sizes, env-overridable for on-chip sweeps. r04 swept on
+# the v5e chip (T=8192, H8, dh64): 128x128 tiles ran the whole step at
+# ~7 TFLOP/s — the online-softmax VPU work (exp, rescale, stats) per
+# tile was unamortized against dh=64 matmuls. 1024x1024 forward tiles
+# reach 49.6 TF/s; the backward peaks near 512x512 (53.6 TF/s) and
+# larger tiles only add VMEM pressure (2048x1024 fails to compile).
+# `_pick_block` caps every block at the actual T, so small/test shapes
+# are unaffected.
+_DEF_BQ = int(os.environ.get("FLASH_BLOCK_Q", 1024))
+_DEF_BK = int(os.environ.get("FLASH_BLOCK_K", 1024))
+_DEF_BWD_BQ = int(os.environ.get("FLASH_BWD_BLOCK_Q", 512))
+_DEF_BWD_BK = int(os.environ.get("FLASH_BWD_BLOCK_K", 512))
+
+
+def _mxu(x, mxu_bf16: bool):
+    """Cast an MXU operand to bf16 when the bf16-MXU policy is on.
+
+    Mosaic lowers an f32xf32 dot to a multi-pass MXU operation; the XLA
+    oracle (``models.attention.mha``) runs JAX's default f32 matmul
+    precision, which on TPU is a SINGLE bf16 pass. Casting the kernel's
+    matmul operands (never the f32 accumulators or the softmax stats)
+    puts both paths in the same numerics class and was worth ~3x on the
+    r04 chip measurements."""
+    return x.astype(jnp.bfloat16) if mxu_bf16 else x
+
+
+def _resolve_mxu_bf16(mxu_bf16, interpret: bool) -> bool:
+    """Default the bf16-MXU policy: on for the compiled TPU path (the
+    numerics class of the XLA oracle under JAX's default f32 matmul
+    precision), off in interpret mode (the CPU suite's exact
+    differentials). Callers who train flash under a full-f32 precision
+    requirement pass ``mxu_bf16=False`` explicitly (or set
+    ``FLASH_MXU_BF16=0``) — the policy is a parameter, not a hardwired
+    consequence of running on hardware."""
+    env = os.environ.get("FLASH_MXU_BF16")
+    if mxu_bf16 is not None:
+        return bool(mxu_bf16)
+    if env is not None:
+        return env != "0"
+    return not interpret
 
 
 def _sds(shape, dtype, like):
@@ -63,7 +104,7 @@ def _tile_needed(i, j, bq, bk, causal):
 
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, y_ref, lse_ref, m_ref, l_ref,
-                      acc_ref, *, scale, causal, bq, bk):
+                      acc_ref, *, scale, causal, bq, bk, mxu_bf16):
     i, j = pl.program_id(0), pl.program_id(1)
 
     @pl.when(j == 0)
@@ -74,7 +115,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, y_ref, lse_ref, m_ref, l_ref,
 
     @pl.when(_tile_needed(i, j, bq, bk, causal))
     def _():
-        s = jnp.dot(q_ref[:], k_ref[:].T,
+        s = jnp.dot(_mxu(q_ref[:], mxu_bf16), _mxu(k_ref[:], mxu_bf16).T,
                     preferred_element_type=jnp.float32) * scale  # [bq, bk]
         if causal:
             q_pos, k_pos = _positions(i, j, bq, bk)
@@ -88,8 +129,9 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, y_ref, lse_ref, m_ref, l_ref,
         if causal:
             p = jnp.where(mask, p, 0.0)  # a masked-out row would give p == 1
         l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        pv_dtype = jnp.bfloat16 if mxu_bf16 else v_ref.dtype
         acc_ref[:] = acc_ref[:] * alpha + jnp.dot(
-            p.astype(v_ref.dtype), v_ref[:],
+            p.astype(pv_dtype), v_ref[:].astype(pv_dtype),
             preferred_element_type=jnp.float32)
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
@@ -103,18 +145,21 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, y_ref, lse_ref, m_ref, l_ref,
 
 
 def flash_attention_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *,
-                        causal: bool = True, block_q: int = 128,
-                        block_k: int = 128, interpret: bool = False):
+                        causal: bool = True, block_q: int | None = None,
+                        block_k: int | None = None,
+                        interpret: bool = False,
+                        mxu_bf16: bool | None = None):
     """Fused attention forward. ``q, k, v [T, dh]`` -> ``(y [T, dh],
     lse [T])`` with only the log-sum-exp saved for the backward."""
     T, dh = q.shape
     scale = 1.0 / (dh ** 0.5)
-    bq = _pick_block(T, block_q, _Q_QUANTUM)
-    bk = _pick_block(k.shape[0], block_k, _Q_QUANTUM)
+    _mxu_bf16 = _resolve_mxu_bf16(mxu_bf16, interpret)
+    bq = _pick_block(T, block_q or _DEF_BQ, _Q_QUANTUM)
+    bk = _pick_block(k.shape[0], block_k or _DEF_BK, _Q_QUANTUM)
     grid = (T // bq, k.shape[0] // bk)
     y, lse = pl.pallas_call(
         functools.partial(_flash_fwd_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk),
+                          bq=bq, bk=bk, mxu_bf16=_mxu_bf16),
         grid=grid,
         in_specs=[
             pl.BlockSpec((bq, dh), lambda i, j: (i, 0)),
@@ -138,23 +183,25 @@ def flash_attention_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *,
 
 
 def _recompute_p_ds(q_ref, k_ref, v_ref, dy_ref, lse_ref, d_ref, i, j,
-                    scale, causal):
+                    scale, causal, mxu_bf16):
     """Shared backward tile math: probability tile from the saved lse,
     ``p = exp(q k^T * scale - lse)`` (zeroed where causally masked), and
     the softmax-VJP tile ``ds = p * (dy v^T - D)``."""
-    s = jnp.dot(q_ref[:], k_ref[:].T,
+    s = jnp.dot(_mxu(q_ref[:], mxu_bf16), _mxu(k_ref[:], mxu_bf16).T,
                 preferred_element_type=jnp.float32) * scale
     p = jnp.exp(s - lse_ref[0, :][:, None])
     if causal:
         q_pos, k_pos = _positions(i, j, *s.shape)
         p = jnp.where(q_pos >= k_pos, p, 0.0)
-    dp = jnp.dot(dy_ref[:], v_ref[:].T, preferred_element_type=jnp.float32)
+    dp = jnp.dot(_mxu(dy_ref[:], mxu_bf16), _mxu(v_ref[:], mxu_bf16).T,
+                 preferred_element_type=jnp.float32)
     ds = p * (dp - d_ref[0, :][:, None])
     return p, ds
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, dy_ref, lse_ref, d_ref,
-                         dq_ref, acc_ref, *, scale, causal, bq, bk):
+                         dq_ref, acc_ref, *, scale, causal, bq, bk,
+                         mxu_bf16):
     i, j = pl.program_id(0), pl.program_id(1)
 
     @pl.when(j == 0)
@@ -164,8 +211,9 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, dy_ref, lse_ref, d_ref,
     @pl.when(_tile_needed(i, j, bq, bk, causal))
     def _():
         _, ds = _recompute_p_ds(q_ref, k_ref, v_ref, dy_ref, lse_ref, d_ref,
-                                i, j, scale, causal)
-        acc_ref[:] += jnp.dot(ds.astype(k_ref.dtype), k_ref[:],
+                                i, j, scale, causal, mxu_bf16)
+        ds_dtype = jnp.bfloat16 if mxu_bf16 else k_ref.dtype
+        acc_ref[:] += jnp.dot(ds.astype(ds_dtype), _mxu(k_ref[:], mxu_bf16),
                               preferred_element_type=jnp.float32) * scale
 
     @pl.when(j == pl.num_programs(1) - 1)
@@ -175,7 +223,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, dy_ref, lse_ref, d_ref,
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, dy_ref, lse_ref, d_ref,
                           dk_ref, dv_ref, acck_ref, accv_ref, *, scale,
-                          causal, bq, bk):
+                          causal, bq, bk, mxu_bf16):
     jblk, t = pl.program_id(0), pl.program_id(1)
 
     @pl.when(t == 0)
@@ -186,10 +234,13 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, dy_ref, lse_ref, d_ref,
     @pl.when(_tile_needed(t, jblk, bq, bk, causal))
     def _():
         p, ds = _recompute_p_ds(q_ref, k_ref, v_ref, dy_ref, lse_ref, d_ref,
-                                t, jblk, scale, causal)
-        accv_ref[:] += jnp.dot(p.T.astype(dy_ref.dtype), dy_ref[:],
+                                t, jblk, scale, causal, mxu_bf16)
+        lhs_dtype = jnp.bfloat16 if mxu_bf16 else dy_ref.dtype
+        accv_ref[:] += jnp.dot(p.T.astype(lhs_dtype),
+                               _mxu(dy_ref[:], mxu_bf16),
                                preferred_element_type=jnp.float32)
-        acck_ref[:] += jnp.dot(ds.T.astype(q_ref.dtype), q_ref[:],
+        acck_ref[:] += jnp.dot(ds.T.astype(lhs_dtype),
+                               _mxu(q_ref[:], mxu_bf16),
                                preferred_element_type=jnp.float32) * scale
 
     @pl.when(t == pl.num_programs(1) - 1)
@@ -199,15 +250,18 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, dy_ref, lse_ref, d_ref,
 
 
 def flash_attention_bwd(dy: jax.Array, q, k, v, y, lse, *,
-                        causal: bool = True, block_q: int = 128,
-                        block_k: int = 128, interpret: bool = False):
+                        causal: bool = True, block_q: int | None = None,
+                        block_k: int | None = None,
+                        interpret: bool = False,
+                        mxu_bf16: bool | None = None):
     """Flash backward from ``(q, k, v, y, lse)`` — score tiles recomputed,
     never stored. Returns ``(dq, dk, dv)``."""
     T, dh = q.shape
     Tk = k.shape[0]
     scale = 1.0 / (dh ** 0.5)
-    bq = _pick_block(T, block_q, _Q_QUANTUM)
-    bk = _pick_block(Tk, block_k, _Q_QUANTUM)
+    _mxu_bf16 = _resolve_mxu_bf16(mxu_bf16, interpret)
+    bq = _pick_block(T, block_q or _DEF_BWD_BQ, _Q_QUANTUM)
+    bk = _pick_block(Tk, block_k or _DEF_BWD_BK, _Q_QUANTUM)
     # D_i = rowsum(dy * y): the only softmax statistic the tiles can't
     # rebuild locally; elementwise, computed once outside the kernels
     d = jnp.sum(dy.astype(jnp.float32) * y.astype(jnp.float32),
@@ -216,7 +270,7 @@ def flash_attention_bwd(dy: jax.Array, q, k, v, y, lse, *,
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk),
+                          bq=bq, bk=bk, mxu_bf16=_mxu_bf16),
         grid=(T // bq, Tk // bk),
         in_specs=[
             pl.BlockSpec((bq, dh), lambda i, j: (i, 0)),   # q
@@ -236,7 +290,7 @@ def flash_attention_bwd(dy: jax.Array, q, k, v, y, lse, *,
 
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk),
+                          bq=bq, bk=bk, mxu_bf16=_mxu_bf16),
         grid=(Tk // bk, T // bq),
         in_specs=[
             pl.BlockSpec((bq, dh), lambda j, t: (t, 0)),   # q
@@ -261,31 +315,34 @@ def flash_attention_bwd(dy: jax.Array, q, k, v, y, lse, *,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def flash_attention(q, k, v, causal=True, interpret=False):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention(q, k, v, causal=True, interpret=False, mxu_bf16=None):
     """Attention computed by the fused kernels and differentiated by them
     (flash residuals: ``y`` + ``lse`` only). Single head ``[T, dh]``;
     multi-head/batch via ``jax.vmap``, like ``models.attention.mha``."""
-    y, _ = flash_attention_fwd(q, k, v, causal=causal, interpret=interpret)
+    y, _ = flash_attention_fwd(q, k, v, causal=causal, interpret=interpret,
+                               mxu_bf16=mxu_bf16)
     return y
 
 
-def _flash_fwd_rule(q, k, v, causal, interpret):
-    y, lse = flash_attention_fwd(q, k, v, causal=causal, interpret=interpret)
+def _flash_fwd_rule(q, k, v, causal, interpret, mxu_bf16):
+    y, lse = flash_attention_fwd(q, k, v, causal=causal, interpret=interpret,
+                                 mxu_bf16=mxu_bf16)
     return y, (q, k, v, y, lse)
 
 
-def _flash_bwd_rule(causal, interpret, res, dy):
+def _flash_bwd_rule(causal, interpret, mxu_bf16, res, dy):
     q, k, v, y, lse = res
     return flash_attention_bwd(dy, q, k, v, y, lse, causal=causal,
-                               interpret=interpret)
+                               interpret=interpret, mxu_bf16=mxu_bf16)
 
 
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
-def flash_mha(q, k, v, causal: bool = True, interpret: bool = False):
+def flash_mha(q, k, v, causal: bool = True, interpret: bool = False,
+              mxu_bf16: bool | None = None):
     """Multi-head convenience: vmap over a leading heads axis
     (``[H, T, dh] -> [H, T, dh]``)."""
-    return jax.vmap(lambda q, k, v: flash_attention(q, k, v, causal,
-                                                    interpret))(q, k, v)
+    return jax.vmap(lambda q, k, v: flash_attention(
+        q, k, v, causal, interpret, mxu_bf16))(q, k, v)
