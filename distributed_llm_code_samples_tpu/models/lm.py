@@ -128,8 +128,19 @@ def lm_logits(params: LMParams, tokens: jax.Array, n_heads: int,
 
 
 def lm_loss(params: LMParams, tokens: jax.Array, targets: jax.Array,
-            n_heads: int, attn=None) -> jax.Array:
-    """Mean next-token cross-entropy. ``tokens, targets [B, T]`` int."""
+            n_heads: int, attn=None, head=None) -> jax.Array:
+    """Mean next-token cross-entropy. ``tokens, targets [B, T]`` int.
+
+    ``head`` swaps the tied-head + loss computation: None materializes
+    ``[N, V]`` logits and runs the hand-VJP xent (the oracle);
+    a callable ``(h [N, d], wte [V, d], targets [N]) -> scalar`` takes
+    the trunk output directly — the fused Pallas head
+    (``ops.pallas_xent.head_xent`` via ``parallel.lm.resolve_head``)
+    never builds the logits at all."""
+    if head is not None:
+        h = lm_hidden(params, tokens, n_heads, attn)
+        return head(h.reshape(-1, h.shape[-1]), params.wte,
+                    targets.reshape(-1))
     logits = lm_logits(params, tokens, n_heads, attn)
     v = logits.shape[-1]
     return xent_loss(logits.reshape(-1, v), targets.reshape(-1))

@@ -1,0 +1,264 @@
+"""Fused LM-head + softmax-cross-entropy Pallas kernels.
+
+The oracle path (``models.lm.lm_loss``) materializes ``[N, V]`` logits
+in HBM, and the hand-VJP xent (``ops/xent.py``) additionally saves the
+full ``[N, V]`` softmax as its residual — at the bench family shape
+(N=8192 tokens, V=50304) that is ~1.65 GB per tensor per direction of
+pure HBM traffic around a head matmul whose FLOPs are cheap. The fused
+kernels apply the flash-attention treatment to the vocabulary axis:
+tile ``z = h @ W_chunk^T`` in VMEM, reduce it into online logsumexp
+statistics, and pick the target logit with an iota==targets match — no
+``[N, V]`` array ever reaches HBM, in either direction.
+
+Forward residuals are ``(h, w, targets, lse)`` — O(N*d + V*d + N) —
+and the backward recomputes logit tiles exactly like the flash
+backward recomputes score tiles (the framework's
+checkpoint-block-inputs recompute stance, ``train_ffns.py:63``,
+applied to the head). Backward math, hand-derived as in ``ops/xent.py``:
+``dz = (softmax(z) - onehot(t)) * dy / N``, split into a dh pass
+(``dz @ W``) and a dw pass (``dz^T @ h``).
+
+MXU operands follow the same bf16 single-pass policy as the flash
+kernels (``pallas_attention._resolve_mxu_bf16``): on by default on the
+compiled TPU path — the numerics class of the XLA oracle's
+default-precision matmuls — full f32 in interpret mode so the CPU
+suite's differentials vs ``xent_loss(h @ w.T, t)`` stay tight. The
+f32 softmax statistics and accumulators are never cast.
+
+Reference capability covered: the reference has no loss at all
+(``train_ffns.py:12,:30`` mock it); this is the LM family's real
+objective made TPU-first.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .pallas_attention import _LANES, _NEG, _mxu, _resolve_mxu_bf16, _sds
+from .pallas_ffn import _pick_block
+
+_N_QUANTUM = 8
+
+
+def _round_up(x: int, q: int) -> int:
+    return -(-x // q) * q
+
+
+def _blocks(n: int, v: int, block_n, block_v):
+    """Token blocks must divide N (``_pick_block``); the vocab axis
+    instead always gets its PREFERRED lane-aligned block and the weight
+    matrix is zero-padded up to a multiple of it — real vocabularies
+    (GPT-2's 50257 is prime) rarely have a lane-multiple divisor, and
+    falling back to ``bv = V`` would put the whole ``[V, d]`` matrix in
+    one VMEM block. Padded columns are neutralized in-kernel by the
+    ``cols < V`` mask (logits -> -inf forward, dz -> 0 backward)."""
+    bn = _pick_block(n, block_n or 256, _N_QUANTUM)
+    bv = min(block_v or 512, _round_up(v, _LANES))
+    return bn, bv, _round_up(v, bv)
+
+
+def _fwd_kernel(h_ref, w_ref, t_ref, lse_ref, tz_ref, m_ref, se_ref,
+                tzacc_ref, *, bn, bv, v_total, mxu_bf16):
+    i, j = pl.program_id(0), pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        m_ref[:] = jnp.full_like(m_ref, _NEG)
+        se_ref[:] = jnp.zeros_like(se_ref)
+        tzacc_ref[:] = jnp.zeros_like(tzacc_ref)
+
+    z = jnp.dot(_mxu(h_ref[:], mxu_bf16), _mxu(w_ref[:], mxu_bf16).T,
+                preferred_element_type=jnp.float32)          # [bn, bv]
+    cols = j * bv + jax.lax.broadcasted_iota(jnp.int32, (bn, bv), 1)
+    z = jnp.where(cols < v_total, z, _NEG)  # padded vocab columns
+    match = cols == t_ref[0, :][:, None]
+    m_prev = m_ref[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(z, axis=1, keepdims=True))
+    se_new = (se_ref[:, :1] * jnp.exp(m_prev - m_new)
+              + jnp.sum(jnp.exp(z - m_new), axis=1, keepdims=True))
+    # the target column appears in exactly one vocab tile; accumulate its
+    # raw logit (no rescale — it is a value, not an exp-sum)
+    tzacc_ref[:] += jnp.broadcast_to(
+        jnp.sum(jnp.where(match, z, 0.0), axis=1, keepdims=True),
+        tzacc_ref.shape)
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+    se_ref[:] = jnp.broadcast_to(se_new, se_ref.shape)
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _():
+        lse = (m_ref[:, :1] + jnp.log(se_ref[:, :1])).T       # [1, bn]
+        lse_ref[:] = lse
+        tz_ref[:] = tzacc_ref[:, :1].T
+
+
+def _bwd_dh_kernel(h_ref, w_ref, t_ref, lse_ref, dh_ref,
+                   acc_ref, *, bn, bv, v_total, inv_n, mxu_bf16):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    z = jnp.dot(_mxu(h_ref[:], mxu_bf16), _mxu(w_ref[:], mxu_bf16).T,
+                preferred_element_type=jnp.float32)
+    p = jnp.exp(z - lse_ref[0, :][:, None])
+    cols = j * bv + jax.lax.broadcasted_iota(jnp.int32, (bn, bv), 1)
+    dz = (p - jnp.where(cols == t_ref[0, :][:, None], 1.0, 0.0))
+    dz = jnp.where(cols < v_total, dz, 0.0) * inv_n
+    dz_dtype = jnp.bfloat16 if mxu_bf16 else w_ref.dtype
+    acc_ref[:] += jnp.dot(dz.astype(dz_dtype), _mxu(w_ref[:], mxu_bf16),
+                          preferred_element_type=jnp.float32)
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _():
+        dh_ref[:] = acc_ref[:].astype(dh_ref.dtype)
+
+
+def _bwd_dw_kernel(h_ref, w_ref, t_ref, lse_ref, dw_ref,
+                   acc_ref, *, bn, bv, v_total, inv_n, mxu_bf16):
+    jblk, t = pl.program_id(0), pl.program_id(1)
+
+    @pl.when(t == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    z = jnp.dot(_mxu(h_ref[:], mxu_bf16), _mxu(w_ref[:], mxu_bf16).T,
+                preferred_element_type=jnp.float32)
+    p = jnp.exp(z - lse_ref[0, :][:, None])
+    cols = jblk * bv + jax.lax.broadcasted_iota(jnp.int32, (bn, bv), 1)
+    dz = (p - jnp.where(cols == t_ref[0, :][:, None], 1.0, 0.0))
+    dz = jnp.where(cols < v_total, dz, 0.0) * inv_n
+    dz_dtype = jnp.bfloat16 if mxu_bf16 else h_ref.dtype
+    acc_ref[:] += jnp.dot(dz.T.astype(dz_dtype), _mxu(h_ref[:], mxu_bf16),
+                          preferred_element_type=jnp.float32)
+
+    @pl.when(t == pl.num_programs(1) - 1)
+    def _():
+        dw_ref[:] = acc_ref[:].astype(dw_ref.dtype)
+
+
+def head_xent_fwd(h: jax.Array, w: jax.Array, targets: jax.Array, *,
+                  block_n: int | None = None, block_v: int | None = None,
+                  interpret: bool = False, mxu_bf16: bool | None = None):
+    """Fused ``mean_i(logsumexp(h_i W^T) - (h_i W^T)[t_i])``.
+
+    ``h [N, d]`` float, ``w [V, d]`` float, ``targets [N]`` int.
+    Returns ``(loss, lse [N])`` — lse is the backward's only softmax
+    residual."""
+    N, d = h.shape
+    V = w.shape[0]
+    mx = _resolve_mxu_bf16(mxu_bf16, interpret)
+    bn, bv, vp = _blocks(N, V, block_n, block_v)
+    if vp != V:
+        w = jnp.pad(w, ((0, vp - V), (0, 0)))
+    t2 = targets.astype(jnp.int32)[None, :]                   # [1, N]
+    lse, tz = pl.pallas_call(
+        functools.partial(_fwd_kernel, bn=bn, bv=bv, v_total=V,
+                          mxu_bf16=mx),
+        grid=(N // bn, vp // bv),
+        in_specs=[
+            pl.BlockSpec((bn, d), lambda i, j: (i, 0)),       # h
+            pl.BlockSpec((bv, d), lambda i, j: (j, 0)),       # w
+            pl.BlockSpec((1, bn), lambda i, j: (0, i)),       # targets
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bn), lambda i, j: (0, i)),       # lse
+            pl.BlockSpec((1, bn), lambda i, j: (0, i)),       # target z
+        ],
+        out_shape=[_sds((1, N), jnp.float32, h),
+                   _sds((1, N), jnp.float32, h)],
+        scratch_shapes=[pltpu.VMEM((bn, _LANES), jnp.float32),
+                        pltpu.VMEM((bn, _LANES), jnp.float32),
+                        pltpu.VMEM((bn, _LANES), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(h, w, t2)
+    return jnp.mean(lse[0] - tz[0]), lse[0]
+
+
+def head_xent_bwd(dy: jax.Array, h, w, targets, lse, *,
+                  block_n: int | None = None, block_v: int | None = None,
+                  interpret: bool = False, mxu_bf16: bool | None = None):
+    """Hand backward from ``(h, w, targets, lse)`` — logit tiles
+    recomputed, never stored. Returns ``(dh, dw)``."""
+    N, d = h.shape
+    V = w.shape[0]
+    mx = _resolve_mxu_bf16(mxu_bf16, interpret)
+    bn, bv, vp = _blocks(N, V, block_n, block_v)
+    if vp != V:
+        w = jnp.pad(w, ((0, vp - V), (0, 0)))
+    t2 = targets.astype(jnp.int32)[None, :]
+    lse2 = lse[None, :]
+
+    # dz is linear in the scalar cotangent dy, so the kernels bake in the
+    # static 1/N mean factor and dy multiplies the outputs outside (an
+    # elementwise scale XLA fuses into the surrounding graph) — no
+    # scalar operand plumbing needed.
+    dh = pl.pallas_call(
+        functools.partial(_bwd_dh_kernel, bn=bn, bv=bv, v_total=V,
+                          inv_n=1.0 / N, mxu_bf16=mx),
+        grid=(N // bn, vp // bv),
+        in_specs=[
+            pl.BlockSpec((bn, d), lambda i, j: (i, 0)),       # h
+            pl.BlockSpec((bv, d), lambda i, j: (j, 0)),       # w
+            pl.BlockSpec((1, bn), lambda i, j: (0, i)),       # targets
+            pl.BlockSpec((1, bn), lambda i, j: (0, i)),       # lse
+        ],
+        out_specs=pl.BlockSpec((bn, d), lambda i, j: (i, 0)),
+        out_shape=_sds((N, d), h.dtype, h),
+        scratch_shapes=[pltpu.VMEM((bn, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(h, w, t2, lse2)
+
+    dw = pl.pallas_call(
+        functools.partial(_bwd_dw_kernel, bn=bn, bv=bv, v_total=V,
+                          inv_n=1.0 / N, mxu_bf16=mx),
+        grid=(vp // bv, N // bn),
+        in_specs=[
+            pl.BlockSpec((bn, d), lambda j, t: (t, 0)),       # h
+            pl.BlockSpec((bv, d), lambda j, t: (j, 0)),       # w
+            pl.BlockSpec((1, bn), lambda j, t: (0, t)),       # targets
+            pl.BlockSpec((1, bn), lambda j, t: (0, t)),       # lse
+        ],
+        out_specs=pl.BlockSpec((bv, d), lambda j, t: (j, 0)),
+        out_shape=_sds((vp, d), w.dtype, w),
+        scratch_shapes=[pltpu.VMEM((bv, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(h, w, t2, lse2)
+    return dy * dh, dy * dw[:V]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def head_xent(h, w, targets, interpret=False, mxu_bf16=None):
+    """Row-mean cross-entropy of the tied LM head, computed and
+    differentiated by the fused kernels. ``targets`` is
+    non-differentiable."""
+    loss, _ = head_xent_fwd(h, w, targets, interpret=interpret,
+                            mxu_bf16=mxu_bf16)
+    return loss
+
+
+def _head_xent_fwd_rule(h, w, targets, interpret, mxu_bf16):
+    loss, lse = head_xent_fwd(h, w, targets, interpret=interpret,
+                              mxu_bf16=mxu_bf16)
+    return loss, (h, w, targets, lse)
+
+
+def _head_xent_bwd_rule(interpret, mxu_bf16, res, dy):
+    h, w, targets, lse = res
+    dh, dw = head_xent_bwd(dy, h, w, targets, lse, interpret=interpret,
+                           mxu_bf16=mxu_bf16)
+    return dh, dw, None
+
+
+head_xent.defvjp(_head_xent_fwd_rule, _head_xent_bwd_rule)

@@ -64,9 +64,26 @@ def _validate_lm(batch_size: int, seq_len: int, model_size: int,
                          f"max_seq_len={params.max_seq_len}")
 
 
+def resolve_head(head_impl: str | None):
+    """Map a ``head_impl`` name to the LM head+loss op ``models.lm.lm_loss``
+    plugs in: None/"oracle" = materialized logits + hand-VJP xent
+    (``ops/xent.py``); "fused" = the fused Pallas head
+    (``ops.pallas_xent.head_xent`` — online logsumexp over vocab tiles,
+    no ``[N, V]`` array in either direction; interpret mode
+    automatically off-TPU)."""
+    if head_impl in (None, "oracle"):
+        return None
+    if head_impl == "fused":
+        from ..ops.pallas_xent import head_xent
+        interpret = jax.default_backend() != "tpu"
+        return lambda h, w, t: head_xent(h, w, t, interpret)
+    raise ValueError(f"unknown head_impl {head_impl!r} "
+                     "(expected 'oracle' or 'fused')")
+
+
 def _make_step(batch_size: int, model_size: int, seq_len: int,
                n_heads: int, lr: float, attn=None, reduce_axes=(),
-               optimizer=None, batch_fn=None):
+               optimizer=None, batch_fn=None, head=None):
     """One update step on the real LM objective; ``batch_size`` is
     tokens/step (seq folded, CLI convention ``train_ffns.py:379``).
     Without ``optimizer`` it's the reference's stateless inline SGD
@@ -81,7 +98,8 @@ def _make_step(batch_size: int, model_size: int, seq_len: int,
         tokens, targets = (batch_fn(seed) if batch_fn is not None else
                            lm_batch_from_seed(seed, b, seq_len,
                                               params.vocab))
-        grads = jax.grad(lm_loss)(params, tokens, targets, n_heads, attn)
+        grads = jax.grad(lm_loss)(params, tokens, targets, n_heads, attn,
+                                  head)
         if reduce_axes:
             grads = jax.tree_util.tree_map(
                 lambda g: grad_reduce(g, reduce_axes), grads)
@@ -102,7 +120,7 @@ def train_lm_single(params: LMParams, seeds, batch_size: int,
                     seq_len: int, n_heads: int,
                     attn_impl: str | None = None, optimizer=None,
                     opt_state=None, return_state: bool = False,
-                    batch_fn=None):
+                    batch_fn=None, head_impl: str | None = None):
     """Single-device LM trainer — the oracle the parallel forms are pinned
     to. ``optimizer``/``opt_state``/``return_state`` follow the DDP
     contract (``ddp.py``): stateful rules thread ``(params, state)``
@@ -122,36 +140,38 @@ def train_lm_single(params: LMParams, seeds, batch_size: int,
     if optimizer is None:
         return _run_lm_single(clone_params(params), jnp.asarray(seeds),
                               batch_size, model_size, lr, seq_len,
-                              n_heads, attn_impl, batch_fn)
+                              n_heads, attn_impl, batch_fn, head_impl)
 
     state = optimizer.init(params) if opt_state is None else opt_state
     out, state = _run_lm_single_opt(
         (clone_params(params), state), jnp.asarray(seeds), batch_size,
-        model_size, lr, seq_len, n_heads, attn_impl, optimizer, batch_fn)
+        model_size, lr, seq_len, n_heads, attn_impl, optimizer, batch_fn,
+        head_impl)
     return (out, state) if return_state else out
 
 
-@functools.partial(jax.jit, static_argnums=tuple(range(2, 9)),
+@functools.partial(jax.jit, static_argnums=tuple(range(2, 10)),
                    donate_argnums=0)
 def _run_lm_single(params, seeds, batch_size, model_size, lr, seq_len,
-                   n_heads, attn_impl, batch_fn):
+                   n_heads, attn_impl, batch_fn, head_impl):
     """Module-level jit (the ``single.py`` pattern): repeat calls with
     the same static config — including the same ``optimizer``/``batch_fn``
     *objects*, which hash by identity — reuse the compiled program.
     Segmented runs (checkpointing, bench best-of-N loops,
     ``train_real_text.py``) pay one compile instead of one per call."""
     step = _make_step(batch_size, model_size, seq_len, n_heads, lr,
-                      resolve_attn(attn_impl), batch_fn=batch_fn)
+                      resolve_attn(attn_impl), batch_fn=batch_fn,
+                      head=resolve_head(head_impl))
     return lax.scan(lambda p, s: (step(p, s), None), params, seeds)[0]
 
 
-@functools.partial(jax.jit, static_argnums=tuple(range(2, 10)))
+@functools.partial(jax.jit, static_argnums=tuple(range(2, 11)))
 def _run_lm_single_opt(carry, seeds, batch_size, model_size, lr, seq_len,
-                       n_heads, attn_impl, optimizer, batch_fn):
+                       n_heads, attn_impl, optimizer, batch_fn, head_impl):
     # no donation: callers may hold/reuse the opt_state they passed in
     step = _make_step(batch_size, model_size, seq_len, n_heads, lr,
                       resolve_attn(attn_impl), optimizer=optimizer,
-                      batch_fn=batch_fn)
+                      batch_fn=batch_fn, head=resolve_head(head_impl))
     return lax.scan(lambda c, s: (step(c, s), None), carry, seeds)[0]
 
 
