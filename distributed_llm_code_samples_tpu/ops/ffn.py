@@ -167,6 +167,31 @@ def _ffn_block_mixed_bwd(res, dy):
 ffn_block_mixed.defvjp(_ffn_block_mixed_fwd, _ffn_block_mixed_bwd)
 
 
+@jax.custom_vjp
+def ffn_block_mixed_remat(w1: jax.Array, w2: jax.Array,
+                          x: jax.Array) -> jax.Array:
+    """``ffn_block_mixed``'s math under the remat residual policy: the
+    backward recomputes the pre-activation from the BLOCK INPUT (the
+    reference's checkpoint stance, ``train_ffns.py:63``) and the stashed
+    input is bf16 — the saved-bytes half of the mixed policy applied to
+    the recompute policy's only residual. On an MXU-saturated shape the
+    matmul time is identical to f32 (default-precision f32 matmuls are
+    single bf16 passes anyway); the bf16 stash is the one lever that can
+    move the single-chip headline."""
+    y, _ = _ffn_block_mixed_remat_fwd(w1, w2, x)
+    return y
+
+
+def _ffn_block_mixed_remat_fwd(w1, w2, x):
+    return ffn_fwd_mixed(w1, w2, x), (w1, w2, x.astype(jnp.bfloat16))
+
+
+def _ffn_block_mixed_remat_bwd(res, dy):
+    w1, w2, xb = res
+    dx, (dw1, dw2) = ffn_bwd_mixed(dy, w1, w2, xb)
+    return dw1, dw2, dx
+
+
 # --- Pair-form mixed blocks: the hook-surface dialect ---------------------
 #
 # The distributed strategies (ddp/fsdp/tp/hybrid) inject collectives
@@ -200,3 +225,7 @@ def ffn_bwd_mixed(dy: jax.Array, w1: jax.Array, w2: jax.Array,
     ab = jnp.maximum(h, 0.0).astype(bf)
     dx, dw1, dw2 = _mixed_bwd_core(dy, w1b, w2b, xb, ab)
     return dx, (dw1, dw2)
+
+
+ffn_block_mixed_remat.defvjp(_ffn_block_mixed_remat_fwd,
+                             _ffn_block_mixed_remat_bwd)

@@ -96,6 +96,20 @@ def _fwd_kernel(h_ref, w_ref, t_ref, lse_ref, tz_ref, m_ref, se_ref,
         tz_ref[:] = tzacc_ref[:, :1].T
 
 
+def _recompute_dz(h_ref, w_ref, t_ref, lse_ref, vblk, bn, bv, v_total,
+                  inv_n, mxu_bf16):
+    """The one copy of the backward tile math (the _mixed_bwd_core
+    pattern): recompute the logit tile for vocab block ``vblk``, then
+    ``dz = (softmax - onehot) * 1/N`` with padded columns zeroed. Shared
+    by the dh and dw kernels so the two passes cannot desynchronize."""
+    z = jnp.dot(_mxu(h_ref[:], mxu_bf16), _mxu(w_ref[:], mxu_bf16).T,
+                preferred_element_type=jnp.float32)
+    p = jnp.exp(z - lse_ref[0, :][:, None])
+    cols = vblk * bv + jax.lax.broadcasted_iota(jnp.int32, (bn, bv), 1)
+    dz = (p - jnp.where(cols == t_ref[0, :][:, None], 1.0, 0.0))
+    return jnp.where(cols < v_total, dz, 0.0) * inv_n
+
+
 def _bwd_dh_kernel(h_ref, w_ref, t_ref, lse_ref, dh_ref,
                    acc_ref, *, bn, bv, v_total, inv_n, mxu_bf16):
     j = pl.program_id(1)
@@ -104,12 +118,8 @@ def _bwd_dh_kernel(h_ref, w_ref, t_ref, lse_ref, dh_ref,
     def _():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    z = jnp.dot(_mxu(h_ref[:], mxu_bf16), _mxu(w_ref[:], mxu_bf16).T,
-                preferred_element_type=jnp.float32)
-    p = jnp.exp(z - lse_ref[0, :][:, None])
-    cols = j * bv + jax.lax.broadcasted_iota(jnp.int32, (bn, bv), 1)
-    dz = (p - jnp.where(cols == t_ref[0, :][:, None], 1.0, 0.0))
-    dz = jnp.where(cols < v_total, dz, 0.0) * inv_n
+    dz = _recompute_dz(h_ref, w_ref, t_ref, lse_ref, j, bn, bv, v_total,
+                       inv_n, mxu_bf16)
     dz_dtype = jnp.bfloat16 if mxu_bf16 else w_ref.dtype
     acc_ref[:] += jnp.dot(dz.astype(dz_dtype), _mxu(w_ref[:], mxu_bf16),
                           preferred_element_type=jnp.float32)
@@ -127,12 +137,8 @@ def _bwd_dw_kernel(h_ref, w_ref, t_ref, lse_ref, dw_ref,
     def _():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    z = jnp.dot(_mxu(h_ref[:], mxu_bf16), _mxu(w_ref[:], mxu_bf16).T,
-                preferred_element_type=jnp.float32)
-    p = jnp.exp(z - lse_ref[0, :][:, None])
-    cols = jblk * bv + jax.lax.broadcasted_iota(jnp.int32, (bn, bv), 1)
-    dz = (p - jnp.where(cols == t_ref[0, :][:, None], 1.0, 0.0))
-    dz = jnp.where(cols < v_total, dz, 0.0) * inv_n
+    dz = _recompute_dz(h_ref, w_ref, t_ref, lse_ref, jblk, bn, bv,
+                       v_total, inv_n, mxu_bf16)
     dz_dtype = jnp.bfloat16 if mxu_bf16 else h_ref.dtype
     acc_ref[:] += jnp.dot(dz.T.astype(dz_dtype), _mxu(h_ref[:], mxu_bf16),
                           preferred_element_type=jnp.float32)

@@ -52,20 +52,25 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
     matmul-issue-bound either way), so the default keeps the reference's
     memory-lean recompute policy (``train_ffns.py:63``).
 
-    ``mixed`` selects the TPU-first precision policy
-    (``ops.ffn.ffn_block_mixed``): bf16 matmul inputs on the MXU, fp32
-    params/gradients/accumulation, bf16 residuals. On this bench chip the
-    default f32 matmul already lowers to bf16 MXU passes, so this is a
-    numerics-layout option, not a speed lever.
+    ``mixed`` selects the TPU-first precision policy: bf16 matmul
+    inputs on the MXU, fp32 params/gradients/accumulation, bf16
+    residuals. Composes with the residual policy (same default as f32 —
+    the reference's recompute stance): ``remat=True``/None recomputes
+    the pre-activation from a bf16-stashed block input
+    (``ops.ffn.ffn_block_mixed_remat``); ``remat=False`` saves the bf16
+    post-ReLU (``ops.ffn.ffn_block_mixed``). The MXU time is identical to
+    f32 either way (default-precision f32 matmuls are single bf16
+    passes); the halved stash bytes are the single-chip lever, and
+    bench.py measures which residual policy wins.
 
     ``accum`` splits the step's tokens into that many gradient-
     accumulation chunks (``lax.scan``, summed grads, one update): peak
     activation memory drops ~1/accum while the math is exactly the
     full-batch step (grads are linear in the batch; the mock loss has no
     mean to rescale — SUM semantics throughout, ``train_ffns.py:165``)."""
-    if mixed and (use_pallas or remat is not None or manual_loop):
+    if mixed and (use_pallas or manual_loop):
         raise ValueError("mixed=True is its own block implementation; it "
-                         "cannot combine with use_pallas/remat/manual_loop")
+                         "cannot combine with use_pallas/manual_loop")
     if use_pallas and remat is False:
         raise ValueError("the Pallas block has its own residual policy; "
                          "remat=False cannot combine with use_pallas")
@@ -105,7 +110,10 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
         block = lambda w1, w2, x: pallas_ffn_block(  # noqa: E731
             w1, w2, x, interpret)
     elif mixed:
-        from ..ops.ffn import ffn_block_mixed as block
+        if remat:
+            from ..ops.ffn import ffn_block_mixed_remat as block
+        else:
+            from ..ops.ffn import ffn_block_mixed as block
     elif remat:
         from ..ops.ffn import ffn_block as block
     else:

@@ -72,6 +72,38 @@ def test_pair_form_matches_custom_vjp_block():
     np.testing.assert_array_equal(np.asarray(dw2_pair), np.asarray(dw2_blk))
 
 
+def test_mixed_remat_block_matches_saved_block():
+    """ffn_block_mixed_remat (bf16-stashed block input, pre-activation
+    recomputed) is the SAME math as ffn_block_mixed (saved bf16
+    post-ReLU) — outputs and all three grads bit-identical, since the
+    recompute reproduces the exact bf16 activation the saved rule
+    stashed."""
+    from distributed_llm_code_samples_tpu.ops.ffn import (
+        ffn_block_mixed_remat)
+    k = jax.random.PRNGKey(5)
+    w1 = jax.random.normal(jax.random.fold_in(k, 0), (4 * D, D)) * 0.02
+    w2 = jax.random.normal(jax.random.fold_in(k, 1), (D, 4 * D)) * 0.02
+    x = jax.random.normal(jax.random.fold_in(k, 2), (B, D))
+    dy = jax.random.normal(jax.random.fold_in(k, 3), (B, D))
+
+    y_s, vjp_s = jax.vjp(ffn_block_mixed, w1, w2, x)
+    y_r, vjp_r = jax.vjp(ffn_block_mixed_remat, w1, w2, x)
+    np.testing.assert_array_equal(np.asarray(y_s), np.asarray(y_r))
+    for a, b in zip(vjp_s(dy), vjp_r(dy)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_single_mixed_remat_matches_saved(setup):
+    """train_single(mixed=True) composes with the residual policy flag:
+    remat (the new default, matching f32) == remat=False (saved) on
+    final params."""
+    params, seeds = setup
+    out_r = train_single(params, seeds, B, D, lr=LR_TEST, mixed=True)
+    out_s = train_single(params, seeds, B, D, lr=LR_TEST, mixed=True,
+                         remat=False)
+    _close(out_r, out_s, rtol=1e-6, atol=1e-7)
+
+
 def test_mixed_close_to_f32_but_distinct(setup):
     """Sanity bracket: the bf16 policy tracks the f32 oracle (same math,
     lower precision) but actually runs in bf16 — the results must differ
