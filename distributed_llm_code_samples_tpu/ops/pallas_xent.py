@@ -39,10 +39,41 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .pallas_attention import _LANES, _NEG, _mxu, _resolve_mxu_bf16, _sds
+from .pallas_attention import _LANES, _NEG, _mxu, _resolve_mxu_bf16
 from .pallas_ffn import _pick_block
 
 _N_QUANTUM = 8
+
+
+def _vma_of(x):
+    return getattr(jax.typeof(x), "vma", None) or frozenset()
+
+
+def _pvary_group(*xs):
+    """Promote every operand to the JOIN of the group's varying manual
+    axes (``lax.pvary``) — inside ``shard_map`` a kernel mixing a
+    data-varying ``h`` with a replicated ``wte`` in one dot needs the
+    replicated side explicitly marked varying, the promotion JAX inserts
+    automatically for ordinary primitives but not across a
+    ``pallas_call`` boundary."""
+    join = frozenset().union(*[_vma_of(x) for x in xs])
+    if not join:
+        return xs
+    return tuple(
+        jax.lax.pcast(x, tuple(sorted(join - _vma_of(x))), to="varying")
+        if join - _vma_of(x) else x for x in xs)
+
+
+def _sds_join(shape, dtype, *likes):
+    """ShapeDtypeStruct whose varying-manual-axes type is the JOIN of
+    the inputs' vmas. ``_sds`` takes one exemplar, which is wrong here:
+    under DDP the wte operand is replicated (empty vma) while ``h``
+    varies over the data axis — every kernel output depends on both, so
+    its vma is the union. Empty union (no shard_map) stays untyped."""
+    vma = frozenset().union(*[_vma_of(x) for x in likes])
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
 
 
 def _round_up(x: int, q: int) -> int:
@@ -163,6 +194,7 @@ def head_xent_fwd(h: jax.Array, w: jax.Array, targets: jax.Array, *,
     if vp != V:
         w = jnp.pad(w, ((0, vp - V), (0, 0)))
     t2 = targets.astype(jnp.int32)[None, :]                   # [1, N]
+    h, w, t2 = _pvary_group(h, w, t2)
     lse, tz = pl.pallas_call(
         functools.partial(_fwd_kernel, bn=bn, bv=bv, v_total=V,
                           mxu_bf16=mx),
@@ -176,8 +208,8 @@ def head_xent_fwd(h: jax.Array, w: jax.Array, targets: jax.Array, *,
             pl.BlockSpec((1, bn), lambda i, j: (0, i)),       # lse
             pl.BlockSpec((1, bn), lambda i, j: (0, i)),       # target z
         ],
-        out_shape=[_sds((1, N), jnp.float32, h),
-                   _sds((1, N), jnp.float32, h)],
+        out_shape=[_sds_join((1, N), jnp.float32, h, w, targets),
+                   _sds_join((1, N), jnp.float32, h, w, targets)],
         scratch_shapes=[pltpu.VMEM((bn, _LANES), jnp.float32),
                         pltpu.VMEM((bn, _LANES), jnp.float32),
                         pltpu.VMEM((bn, _LANES), jnp.float32)],
@@ -201,6 +233,7 @@ def head_xent_bwd(dy: jax.Array, h, w, targets, lse, *,
         w = jnp.pad(w, ((0, vp - V), (0, 0)))
     t2 = targets.astype(jnp.int32)[None, :]
     lse2 = lse[None, :]
+    h, w, t2, lse2 = _pvary_group(h, w, t2, lse2)
 
     # dz is linear in the scalar cotangent dy, so the kernels bake in the
     # static 1/N mean factor and dy multiplies the outputs outside (an
@@ -217,7 +250,7 @@ def head_xent_bwd(dy: jax.Array, h, w, targets, lse, *,
             pl.BlockSpec((1, bn), lambda i, j: (0, i)),       # lse
         ],
         out_specs=pl.BlockSpec((bn, d), lambda i, j: (i, 0)),
-        out_shape=_sds((N, d), h.dtype, h),
+        out_shape=_sds_join((N, d), h.dtype, h, w, targets, lse),
         scratch_shapes=[pltpu.VMEM((bn, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
@@ -235,7 +268,7 @@ def head_xent_bwd(dy: jax.Array, h, w, targets, lse, *,
             pl.BlockSpec((1, bn), lambda j, t: (0, t)),       # lse
         ],
         out_specs=pl.BlockSpec((bv, d), lambda j, t: (j, 0)),
-        out_shape=_sds((vp, d), w.dtype, w),
+        out_shape=_sds_join((vp, d), w.dtype, h, w, targets, lse),
         scratch_shapes=[pltpu.VMEM((bv, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),

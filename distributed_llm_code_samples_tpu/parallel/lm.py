@@ -83,7 +83,8 @@ def resolve_head(head_impl: str | None):
 
 def _make_step(batch_size: int, model_size: int, seq_len: int,
                n_heads: int, lr: float, attn=None, reduce_axes=(),
-               optimizer=None, batch_fn=None, head=None):
+               optimizer=None, batch_fn=None, head=None,
+               force_reduce: bool = False):
     """One update step on the real LM objective; ``batch_size`` is
     tokens/step (seq folded, CLI convention ``train_ffns.py:379``).
     Without ``optimizer`` it's the reference's stateless inline SGD
@@ -101,8 +102,16 @@ def _make_step(batch_size: int, model_size: int, seq_len: int,
         grads = jax.grad(lm_loss)(params, tokens, targets, n_heads, attn,
                                   head)
         if reduce_axes:
-            grads = jax.tree_util.tree_map(
-                lambda g: grad_reduce(g, reduce_axes), grads)
+            # force_reduce: the launcher runs check_vma=False (interpret-
+            # mode multi-tile Pallas kernels can't type-check), which
+            # erases the provenance signal grad_reduce keys on AND stops
+            # the transpose machinery's auto-psum — cotangents of
+            # replicated params arrive partial. Unconditional psum is
+            # then the correct (single) reduction — the expert.py
+            # pallas_a2a contract, pinned there both ways.
+            red = ((lambda g: lax.psum(g, reduce_axes)) if force_reduce
+                   else (lambda g: grad_reduce(g, reduce_axes)))
+            grads = jax.tree_util.tree_map(red, grads)
         return grads
 
     def step(params: LMParams, seed) -> LMParams:
@@ -175,31 +184,48 @@ def _run_lm_single_opt(carry, seeds, batch_size, model_size, lr, seq_len,
     return lax.scan(lambda c, s: (step(c, s), None), carry, seeds)[0]
 
 
+def _vma_check(attn_impl, head_impl=None) -> bool:
+    """The Pallas interpreter's vma propagation is incomplete (jax's own
+    error suggests check_vma=False), so interpret-mode kernels (CPU
+    suite) run with the typing off; on-TPU the compiled kernels pass
+    full checking (the AOT tests pin it)."""
+    return not ((attn_impl == "flash" or head_impl == "fused")
+                and jax.default_backend() != "tpu")
+
+
 def train_lm_ddp(params: LMParams, seeds, batch_size: int, model_size: int,
                  mesh, lr: float = LR, *, seq_len: int, n_heads: int,
                  attn_impl: str | None = None, optimizer=None,
-                 opt_state=None, return_state: bool = False):
+                 opt_state=None, return_state: bool = False,
+                 head_impl: str | None = None):
     """DDP: replicated params, strided seeds, grads summed per step.
-    ``optimizer`` threads replicated state (the ``ddp.py`` contract)."""
+    ``optimizer`` threads replicated state (the ``ddp.py`` contract).
+    ``head_impl="fused"`` swaps the tied head + xent for the fused
+    Pallas kernels (``ops/pallas_xent.py``) per shard."""
     require_axes(mesh, DATA_AXIS)
     _validate_lm(batch_size, seq_len, model_size, n_heads, params)
     check_state_args(optimizer, opt_state, return_state)
+    check = _vma_check(attn_impl, head_impl)
+    # force_reduce under vma-off: the unconditional-psum reduction
+    # contract (see _make_step)
     step = _make_step(batch_size, model_size, seq_len, n_heads, lr,
                       resolve_attn(attn_impl), reduce_axes=(DATA_AXIS,),
-                      optimizer=optimizer)
+                      optimizer=optimizer, head=resolve_head(head_impl),
+                      force_reduce=not check)
     if optimizer is None:
         return launch_strided(step, clone_params(params), seeds, mesh,
-                              DATA_AXIS, P())
+                              DATA_AXIS, P(), check_vma=check)
     state = optimizer.init(params) if opt_state is None else opt_state
     return launch_strided(step, clone_params(params), seeds, mesh,
                           DATA_AXIS, P(), state=state, state_specs=P(),
-                          return_state=return_state)
+                          return_state=return_state, check_vma=check)
 
 
 def train_lm_fsdp(params: LMParams, seeds, batch_size: int, model_size: int,
                   mesh, lr: float = LR, *, seq_len: int, n_heads: int,
                   attn_impl: str | None = None, optimizer=None,
-                  opt_state=None, return_state: bool = False):
+                  opt_state=None, return_state: bool = False,
+                  head_impl: str | None = None):
     """FSDP/ZeRO-3 over the whole LM surface: block stacks gathered layer
     by layer (the transformer FSDP loop), the embedding/head table and
     positions gathered once per step — transiently, so peak param memory
@@ -224,6 +250,7 @@ def train_lm_fsdp(params: LMParams, seeds, batch_size: int, model_size: int,
             raise ValueError(f"blocks.{name} dim {leaf.shape[1]} not "
                              f"divisible by {n} shards")
     attn = resolve_attn(attn_impl)
+    head = resolve_head(head_impl)
     b = batch_size // seq_len
     vocab = params.vocab  # the global count — p.wte is a shard inside step
 
@@ -241,6 +268,9 @@ def train_lm_fsdp(params: LMParams, seeds, batch_size: int, model_size: int,
                 x = transformer_block(*full, x, n_heads, causal=True,
                                       attn=attn)
             h = layernorm(ln_f, x)
+            if head is not None:
+                return head(h.reshape(-1, h.shape[-1]), wte,
+                            targets.reshape(-1))
             logits = h @ wte.T
             return xent_loss(logits.reshape(-1, wte.shape[0]),
                              targets.reshape(-1))
@@ -255,9 +285,10 @@ def train_lm_fsdp(params: LMParams, seeds, batch_size: int, model_size: int,
         return optimizer.update(grads_of(params, seed), state, params, lr)
 
     sharded = _shard(params, mesh, _lm_fsdp_specs())
+    check = _vma_check(attn_impl, head_impl)
     if optimizer is None:
         return launch_strided(step, sharded, seeds, mesh, DATA_AXIS,
-                              _lm_fsdp_specs())
+                              _lm_fsdp_specs(), check_vma=check)
     # zeros_like of the sharded params keeps their shardings: the state
     # enters shard_map already 1/n per device; scalars replicate
     state = optimizer.init(sharded) if opt_state is None else opt_state
@@ -265,7 +296,7 @@ def train_lm_fsdp(params: LMParams, seeds, batch_size: int, model_size: int,
                           _lm_fsdp_specs(), state=state,
                           state_specs=_lm_state_specs(
                               state, _lm_fsdp_specs()),
-                          return_state=return_state)
+                          return_state=return_state, check_vma=check)
 
 
 # ---------------------------------------------------------------------------
@@ -662,6 +693,7 @@ def train_lm_seq(params: LMParams, seeds, batch_size: int, model_size: int,
     t_local = seq_len // n
     b = batch_size // seq_len
     vocab = params.vocab
+    check = _vma_check(attn_impl)
 
     def step(params: LMParams, seed) -> LMParams:
         tokens, targets = lm_batch_from_seed(seed, b, seq_len, vocab)
@@ -685,15 +717,13 @@ def train_lm_seq(params: LMParams, seeds, batch_size: int, model_size: int,
 
         grads = jax.grad(loss_fn)(params)
         axes = (SEQ_AXIS, DATA_AXIS) if dp > 1 else (SEQ_AXIS,)
-        grads = jax.tree_util.tree_map(
-            lambda g: grad_reduce(g, axes), grads)
+        # vma-off (interpret-mode flash): unconditional psum — see
+        # _make_step's force_reduce note; grad_reduce would silently
+        # no-op on the partial cotangents there
+        red = ((lambda g: lax.psum(g, axes)) if not check
+               else (lambda g: grad_reduce(g, axes)))
+        grads = jax.tree_util.tree_map(red, grads)
         return sgd(params, grads, lr)
-
-    # the Pallas interpreter's vma propagation is incomplete (jax's own
-    # error suggests check_vma=False); on-TPU the flash path compiles
-    # under full checking
-    check = not (attn_impl == "flash"
-                 and jax.default_backend() != "tpu")
     if dp > 1:
         return launch_strided(step, clone_params(params), seeds, mesh,
                               DATA_AXIS, P(), check_vma=check)

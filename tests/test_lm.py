@@ -229,7 +229,11 @@ def test_lm_seq_flash_matches_single():
         make_mesh, SEQ_AXIS, train_lm_seq)
     params = small_lm(seed=3)
     seeds = make_seed_schedule(2, random_seed=17)
-    kw = dict(seq_len=SEQ, n_heads=HEADS)
+    # lr=0.1, NOT the 1e-5 default: the flash path runs check_vma=False
+    # on CPU, where a silent grad under-reduction once hid below the
+    # default-lr update size (~1e-7 < atol) — an observable lr keeps
+    # this differential's power against exactly that failure mode
+    kw = dict(seq_len=SEQ, n_heads=HEADS, lr=0.1)
     single = train_lm_single(params, seeds, 2 * SEQ, D, **kw)
     mesh = make_mesh({SEQ_AXIS: 4})
     for impl in ("ring", "ulysses"):

@@ -117,6 +117,33 @@ def test_train_lm_single_fused_head_matches_oracle():
                                    rtol=2e-4, atol=1e-6)
 
 
+def test_lm_ddp_fsdp_fused_head_match_oracle():
+    """head_impl='fused' through the DISTRIBUTED LM trainers on the
+    8-device mesh: DDP and FSDP (where the fused kernel consumes the
+    all-gathered wte inside shard_map and dw flows back through the
+    gather's psum_scatter transpose) both reproduce their oracle-head
+    runs."""
+    from distributed_llm_code_samples_tpu.data import make_seed_schedule
+    from distributed_llm_code_samples_tpu.models import init_lm
+    from distributed_llm_code_samples_tpu.parallel import (
+        DATA_AXIS, make_mesh)
+    from distributed_llm_code_samples_tpu.parallel.lm import (
+        train_lm_ddp, train_lm_fsdp)
+
+    params = init_lm(jax.random.PRNGKey(0), 384, 32, 2, 64, n_heads=2)
+    seeds = make_seed_schedule(4, random_seed=7)
+    mesh = make_mesh({DATA_AXIS: 4})
+    for fn in (train_lm_ddp, train_lm_fsdp):
+        outs = [fn(params, seeds, 4 * 64, 32, mesh, lr=0.1, seq_len=64,
+                   n_heads=2, head_impl=impl)
+                for impl in (None, "fused")]
+        for a, b in zip(jax.tree_util.tree_leaves(outs[0]),
+                        jax.tree_util.tree_leaves(outs[1])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=1e-6,
+                                       err_msg=fn.__name__)
+
+
 def test_resolve_head_rejects_unknown():
     from distributed_llm_code_samples_tpu.parallel.lm import resolve_head
     with pytest.raises(ValueError, match="unknown head_impl"):
