@@ -311,13 +311,6 @@ def main(argv=None) -> int:
         print(f"error: --heads {args.heads} not divisible by "
               f"--kv_heads {args.kv_heads}", file=sys.stderr)
         return 2
-    if args.kv_heads and args.attn == "flash":
-        # the flash kernels expect full-MHA shapes (no supports_gqa);
-        # exit 2 up front instead of the model-level ValueError traceback
-        print("error: --attn flash does not support grouped-query "
-              "attention (--kv_heads); use --attn oracle or rope",
-              file=sys.stderr)
-        return 2
     if args.kv_heads and args.method in (9, 11):
         # the companion constraint the help text promises ("the model-axis
         # size must divide it"): mirrored up front so e.g. MQA

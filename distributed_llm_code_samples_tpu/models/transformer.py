@@ -105,8 +105,8 @@ def attn_sublayer(wq, wk, wv, wo, a: jax.Array, n_heads: int,
     ``attn`` swaps the per-batch multi-head attention op
     (``(q, k, v, causal) -> y`` on ``[H, T, dh]``); None uses the
     quadratic hand-VJP oracles (``mha``/``gqa``), trainers pass the fused
-    Pallas ``flash_mha`` via ``attn_impl="flash"`` (full-MHA shapes
-    only)."""
+    Pallas ``flash_mha`` via ``attn_impl="flash"`` (GQA shapes via its
+    repeat-KV fan-out)."""
     dh = wq.shape[0] // n_heads
     n_kv = wk.shape[0] // dh
     q = split_heads(a @ wq.T, n_heads)

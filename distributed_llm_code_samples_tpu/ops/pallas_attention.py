@@ -343,6 +343,21 @@ flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 def flash_mha(q, k, v, causal: bool = True, interpret: bool = False,
               mxu_bf16: bool | None = None):
     """Multi-head convenience: vmap over a leading heads axis
-    (``[H, T, dh] -> [H, T, dh]``)."""
+    (``[H, T, dh] -> [H, T, dh]``). Grouped-query shapes (``k/v
+    [H_kv, T, dh]`` with ``H % H_kv == 0``, ``models.attention.gqa``)
+    fan each KV head out to its query group — the kernel streams K/V
+    blocks per query head either way, so the repeat adds no extra HBM
+    traffic inside the kernel (one [H, T, dh] staging copy outside
+    it)."""
+    hq, hkv = q.shape[0], k.shape[0]
+    if hq != hkv:
+        if hq % hkv:
+            raise ValueError(f"query heads {hq} not divisible by kv "
+                             f"heads {hkv}")
+        k = jnp.repeat(k, hq // hkv, axis=0)
+        v = jnp.repeat(v, hq // hkv, axis=0)
     return jax.vmap(lambda q, k, v: flash_attention(
         q, k, v, causal, interpret, mxu_bf16))(q, k, v)
+
+
+flash_mha.supports_gqa = True  # repeat-KV fan-out (see docstring)

@@ -112,15 +112,17 @@ def resolve_attn(attn_impl: str | None):
     """Map an ``attn_impl`` name to the multi-head attention op the model
     plugs in (``models.transformer.attn_sublayer``): None/"oracle" = the
     quadratic hand-VJP ``mha``; "flash" = the fused Pallas kernels
-    (interpret mode automatically off-TPU), custom-VJP'd end to end;
-    "rope" = rotary positions applied to q/k before the hand-VJP kernel
-    (GQA shapes compose)."""
+    (interpret mode automatically off-TPU), custom-VJP'd end to end,
+    GQA shapes via repeat-KV fan-out; "rope" = rotary positions applied
+    to q/k before the hand-VJP kernel (GQA shapes compose)."""
     if attn_impl in (None, "oracle"):
         return None
     if attn_impl == "flash":
         from ..ops.pallas_attention import flash_mha
         interpret = jax.default_backend() != "tpu"
-        return lambda q, k, v, causal: flash_mha(q, k, v, causal, interpret)
+        fn = lambda q, k, v, causal: flash_mha(q, k, v, causal, interpret)
+        fn.supports_gqa = flash_mha.supports_gqa  # single declaration
+        return fn
     if attn_impl == "rope":
         from ..models.attention import rope_mha
         return rope_mha

@@ -118,8 +118,8 @@ def test_train_lm_single_fused_head_matches_oracle():
 
 
 def test_lm_ddp_fsdp_fused_head_match_oracle():
-    """head_impl='fused' through the DISTRIBUTED LM trainers on the
-    8-device mesh: DDP and FSDP (where the fused kernel consumes the
+    """head_impl='fused' through the DISTRIBUTED LM trainers on a
+    4-device data mesh: DDP and FSDP (where the fused kernel consumes the
     all-gathered wte inside shard_map and dw flows back through the
     gather's psum_scatter transpose) both reproduce their oracle-head
     runs."""
