@@ -663,7 +663,8 @@ def train_lm_hybrid(params: LMParams, seeds, batch_size: int,
 def train_lm_seq(params: LMParams, seeds, batch_size: int, model_size: int,
                  mesh, lr: float = LR, *, seq_len: int, n_heads: int,
                  seq_impl: str = "ring",
-                 attn_impl: str | None = None) -> LMParams:
+                 attn_impl: str | None = None,
+                 head_impl: str | None = None) -> LMParams:
     """Long-context LM training: the sequence dim sharded over the
     ``"seq"`` axis, attention crossing shards via the hand-written ring
     (or Ulysses), the real objective computed per token block.
@@ -681,7 +682,9 @@ def train_lm_seq(params: LMParams, seeds, batch_size: int, model_size: int,
     ``attn_impl="flash"`` fuses the block compute (per ring hop / per
     Ulysses-local head) onto the Pallas flash kernels — the long-context
     path end to end: ICI ring across chips, online-softmax tiling in
-    VMEM within each."""
+    VMEM within each. ``head_impl="fused"`` does the same for the tied
+    head + xent on the shard's own token block
+    (``ops/pallas_xent.py``)."""
     from .sequence import resolve_seq_attn
     require_axes(mesh, SEQ_AXIS)
     n = mesh.shape[SEQ_AXIS]
@@ -693,7 +696,8 @@ def train_lm_seq(params: LMParams, seeds, batch_size: int, model_size: int,
     t_local = seq_len // n
     b = batch_size // seq_len
     vocab = params.vocab
-    check = _vma_check(attn_impl)
+    head = resolve_head(head_impl)
+    check = _vma_check(attn_impl, head_impl)
 
     def step(params: LMParams, seed) -> LMParams:
         tokens, targets = lm_batch_from_seed(seed, b, seq_len, vocab)
@@ -710,6 +714,10 @@ def train_lm_seq(params: LMParams, seeds, batch_size: int, model_size: int,
             x = transformer_fwd(p.blocks, x, n_heads, causal=True,
                                 attn=attn)
             h = layernorm(p.ln_f, x)
+            if head is not None:
+                # local mean / n == this shard's share of the global mean
+                return head(h.reshape(-1, h.shape[-1]), p.wte,
+                            targets.reshape(-1)) / n
             logits = h @ p.wte.T
             # local mean / n == this shard's share of the global mean
             return xent_loss(logits.reshape(-1, vocab),

@@ -796,3 +796,26 @@ def test_tp_sample_gumbel_decode(mesh_model4):
     with pytest.raises(ValueError, match="temperature"):
         tp_sample(params, prompt, 2, mesh_model4, n_heads=HEADS,
                   temperature=0.0)
+
+
+def test_lm_seq_fused_head_matches_single():
+    """train_lm_seq(head_impl='fused'): the fused Pallas head + xent on
+    each shard's token block (1/n-scaled, psum-reduced) still equals the
+    single-device oracle — composed with flash ring attention, the fully
+    fused long-context step."""
+    from distributed_llm_code_samples_tpu.data import make_seed_schedule
+    from distributed_llm_code_samples_tpu.parallel import (
+        make_mesh, SEQ_AXIS, train_lm_seq)
+    params = small_lm(seed=5)
+    seeds = make_seed_schedule(2, random_seed=19)
+    kw = dict(seq_len=SEQ, n_heads=HEADS, lr=0.1)
+    single = train_lm_single(params, seeds, 2 * SEQ, D, **kw)
+    mesh = make_mesh({SEQ_AXIS: 4})
+    for attn in (None, "flash"):
+        seq = train_lm_seq(params, seeds, 2 * SEQ, D, mesh,
+                           seq_impl="ring", attn_impl=attn,
+                           head_impl="fused", **kw)
+        for got, want in zip(jax.tree_util.tree_leaves(seq),
+                             jax.tree_util.tree_leaves(single)):
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       err_msg=str(attn), **tolerances())
