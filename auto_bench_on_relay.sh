@@ -1,0 +1,23 @@
+#!/bin/bash
+# Watch the axon relay; whenever it answers, collect the updated
+# headline bench (families attn x head grid + bf16 policy grid). Keeps
+# watching until a bench run lands with BOTH grids present (a
+# watchdog-truncated payload or a CPU-fallback run does not count).
+set -u
+cd "$(dirname "$0")"
+while true; do
+  if timeout 90 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" >/dev/null 2>&1; then
+    echo "relay up $(date -u +%H:%M:%S); running bench" >> /tmp/auto_bench.log
+    timeout 3600 python bench.py > /tmp/bench_r04_v2.json 2>/tmp/bench_r04_v2.err
+    if tail -1 /tmp/bench_r04_v2.json 2>/dev/null \
+        | grep -q '"by_policy"' \
+       && tail -1 /tmp/bench_r04_v2.json | grep -q '"bf16_policy"'; then
+      tail -1 /tmp/bench_r04_v2.json > BENCH_r04_local.json
+      echo "bench done $(date -u +%H:%M:%S)" >> /tmp/auto_bench.log
+      break
+    fi
+    echo "bench incomplete/failed $(date -u +%H:%M:%S); rewatching" \
+      >> /tmp/auto_bench.log
+  fi
+  sleep 240
+done

@@ -57,6 +57,18 @@ def test_bench_emits_driver_contract():
     assert isinstance(payload.get("gap_breakdown"), dict)
     fams = payload.get("families")
     assert isinstance(fams, dict) and "transformer" in fams and "lm" in fams
+    # the measured policy grids must ship: transformer oracle-vs-flash,
+    # LM 2x2 attn x head (winner + full grid recorded)
+    assert fams["transformer"]["attn"] in ("oracle", "flash")
+    assert isinstance(fams["transformer"]["flash_steps_per_sec"], float)
+    assert set(fams["lm"]["by_policy"]) == {
+        "oracle+oracle", "oracle+fused", "flash+oracle", "flash+fused"}
+    assert fams["lm"]["policy"] in fams["lm"]["by_policy"]
+    # bf16 residual-policy grid (remat vs saved, winner ships);
+    # `, payload` keeps the recorded error string visible on failure
+    assert payload.get("bf16_policy") in ("remat", "saved"), payload
+    assert isinstance(payload.get("bf16_remat_steps_per_sec"), float), payload
+    assert isinstance(payload.get("bf16_saved_steps_per_sec"), float), payload
     # bf16 mixed-precision field (VERDICT r3 #3): numeric, with its own
     # MFU on the same model-FLOPs numerator and bf16-peak denominator
     assert isinstance(payload.get("bf16_vs_f32"), float), payload
