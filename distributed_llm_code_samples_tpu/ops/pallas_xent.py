@@ -106,8 +106,12 @@ def _fwd_kernel(h_ref, w_ref, t_ref, lse_ref, tz_ref, m_ref, se_ref,
     z = jnp.dot(_mxu(h_ref[:], mxu_bf16), _mxu(w_ref[:], mxu_bf16).T,
                 preferred_element_type=jnp.float32)          # [bn, bv]
     cols = j * bv + jax.lax.broadcasted_iota(jnp.int32, (bn, bv), 1)
-    z = jnp.where(cols < v_total, z, _NEG)  # padded vocab columns
-    match = cols == t_ref[0, :][:, None]
+    valid = cols < v_total
+    z = jnp.where(valid, z, _NEG)  # padded vocab columns
+    # a target index landing in the padded range [V, vp) (possible for
+    # vp_head_xent's shifted out-of-slice targets) must NOT pick up the
+    # -1e30 sentinel — only true vocab columns can match
+    match = (cols == t_ref[0, :][:, None]) & valid
     m_prev = m_ref[:, :1]
     m_new = jnp.maximum(m_prev, jnp.max(z, axis=1, keepdims=True))
     se_new = (se_ref[:, :1] * jnp.exp(m_prev - m_new)
@@ -179,14 +183,18 @@ def _bwd_dw_kernel(h_ref, w_ref, t_ref, lse_ref, dw_ref,
         dw_ref[:] = acc_ref[:].astype(dw_ref.dtype)
 
 
-def head_xent_fwd(h: jax.Array, w: jax.Array, targets: jax.Array, *,
-                  block_n: int | None = None, block_v: int | None = None,
-                  interpret: bool = False, mxu_bf16: bool | None = None):
-    """Fused ``mean_i(logsumexp(h_i W^T) - (h_i W^T)[t_i])``.
-
-    ``h [N, d]`` float, ``w [V, d]`` float, ``targets [N]`` int.
-    Returns ``(loss, lse [N])`` — lse is the backward's only softmax
-    residual."""
+def head_xent_stats(h: jax.Array, w: jax.Array, targets: jax.Array, *,
+                    block_n: int | None = None,
+                    block_v: int | None = None,
+                    interpret: bool = False,
+                    mxu_bf16: bool | None = None):
+    """The fused forward's raw per-slice statistics:
+    ``(lse [N], tz [N])`` where ``lse = logsumexp(h W^T)`` over THIS
+    ``w``'s rows and ``tz`` is the target logit if the (0-based) target
+    falls in ``[0, V)``, else 0. This is the merge-ready form the
+    vocab-parallel head (``parallel.lm.vp_head_xent``) combines across
+    model shards — out-of-slice targets (negative or >= V after the
+    caller's offset shift) simply match no column."""
     N, d = h.shape
     V = w.shape[0]
     mx = _resolve_mxu_bf16(mxu_bf16, interpret)
@@ -217,7 +225,21 @@ def head_xent_fwd(h: jax.Array, w: jax.Array, targets: jax.Array, *,
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(h, w, t2)
-    return jnp.mean(lse[0] - tz[0]), lse[0]
+    return lse[0], tz[0]
+
+
+def head_xent_fwd(h: jax.Array, w: jax.Array, targets: jax.Array, *,
+                  block_n: int | None = None, block_v: int | None = None,
+                  interpret: bool = False, mxu_bf16: bool | None = None):
+    """Fused ``mean_i(logsumexp(h_i W^T) - (h_i W^T)[t_i])``.
+
+    ``h [N, d]`` float, ``w [V, d]`` float, ``targets [N]`` int.
+    Returns ``(loss, lse [N])`` — lse is the backward's only softmax
+    residual."""
+    lse, tz = head_xent_stats(h, w, targets, block_n=block_n,
+                              block_v=block_v, interpret=interpret,
+                              mxu_bf16=mxu_bf16)
+    return jnp.mean(lse - tz), lse
 
 
 def head_xent_bwd(dy: jax.Array, h, w, targets, lse, *,

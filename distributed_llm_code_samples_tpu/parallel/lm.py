@@ -109,9 +109,9 @@ def _make_step(batch_size: int, model_size: int, seq_len: int,
             # replicated params arrive partial. Unconditional psum is
             # then the correct (single) reduction — the expert.py
             # pallas_a2a contract, pinned there both ways.
-            red = ((lambda g: lax.psum(g, reduce_axes)) if force_reduce
-                   else (lambda g: grad_reduce(g, reduce_axes)))
-            grads = jax.tree_util.tree_map(red, grads)
+            grads = jax.tree_util.tree_map(
+                lambda g: grad_reduce(g, reduce_axes,
+                                      force=force_reduce), grads)
         return grads
 
     def step(params: LMParams, seed) -> LMParams:
@@ -362,9 +362,58 @@ def _vp_xent_bwd(axis, res, dy):
 vp_xent.defvjp(_vp_xent_fwd, _vp_xent_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def vp_head_xent(h: jax.Array, wte_local: jax.Array, targets: jax.Array,
+                 axis: str = MODEL_AXIS,
+                 interpret: bool = False) -> jax.Array:
+    """Vocab-parallel FUSED head + cross-entropy: ``vp_xent``'s
+    collective structure with ``ops.pallas_xent``'s kernels underneath —
+    no shard ever materializes even its LOCAL ``[N, V/n]`` logits (the
+    oracle path builds and residual-saves them; ~400 MB/shard at the
+    bench family shape). Each shard's kernel pass produces merge-ready
+    ``(lse_local, tz_local)`` statistics over its own vocab rows; one
+    ``pmax`` + two ``psum``s complete the row max, normalizer, and
+    target pick — the same three collectives as ``vp_xent``. Backward
+    recomputes logit tiles per shard: ``dw`` is shard-complete (its own
+    vocab rows), ``dh`` comes back PARTIAL over the model axis — the
+    caller's ``_f_gate`` completes it, exactly like the materialized
+    path's ``h @ wte_local.T`` transpose."""
+    loss, _ = _vp_head_xent_fwd(h, wte_local, targets, axis, interpret)
+    return loss
+
+
+def _vp_head_xent_fwd(h, wte_local, targets, axis, interpret):
+    from ..ops.pallas_xent import head_xent_stats
+    v_local = wte_local.shape[0]
+    t_local = targets - axis_index(axis) * v_local
+    lse_l, tz_l = head_xent_stats(h, wte_local, t_local,
+                                  interpret=interpret)
+    # stable cross-shard logsumexp merge: lse_g = M + log(sum exp(lse-M))
+    m = lax.pmax(lse_l, axis)
+    lse_g = m + jnp.log(all_reduce(jnp.exp(lse_l - m), axis))
+    z_t = all_reduce(tz_l, axis)  # the target lives in exactly one slice
+    loss = jnp.mean(lse_g - z_t)
+    return loss, (h, wte_local, t_local, lse_g)
+
+
+def _vp_head_xent_bwd(axis, interpret, res, dy):
+    from ..ops.pallas_xent import head_xent_bwd
+    h, wte_local, t_local, lse_g = res
+    # the kernels compute dz = (exp(z - lse_g) - onehot) / N on this
+    # shard's slice: dw complete for its rows, dh a partial sum
+    dh, dw = head_xent_bwd(dy, h, wte_local, t_local, lse_g,
+                           interpret=interpret)
+    return dh, dw, None
+
+
+vp_head_xent.defvjp(_vp_head_xent_fwd, _vp_head_xent_bwd)
+
+
 def _make_tp_step(batch_size: int, model_size: int, seq_len: int,
                   h_local: int, vocab: int, lr: float, attn=None,
-                  data_axes=(), optimizer=None):
+                  data_axes=(), optimizer=None,
+                  head_impl: str | None = None,
+                  force_reduce: bool = False):
     """One vocab-parallel TP step for one model shard; ``data_axes`` adds
     the orthogonal DDP reduction for the hybrid 2-D mesh (every leaf is a
     partial sum over those axes; LN/positions additionally over the model
@@ -385,6 +434,11 @@ def _make_tp_step(batch_size: int, model_size: int, seq_len: int,
                              blk.wo[l], blk.ln2[l], blk.w1[l], blk.w2[l],
                              x, h_local, causal=True, attn=attn)
             h = f(layernorm(p.ln_f, x))       # dx from the head: psum
+            if head_impl == "fused":
+                return vp_head_xent(
+                    h.reshape(-1, model_size), p.wte,
+                    targets.reshape(-1), MODEL_AXIS,
+                    jax.default_backend() != "tpu")
             logits_local = h.reshape(-1, model_size) @ p.wte.T
             return vp_xent(logits_local, targets.reshape(-1))
 
@@ -393,17 +447,31 @@ def _make_tp_step(batch_size: int, model_size: int, seq_len: int,
         # cotangents produced inside the hand-written rules come back
         # typed varying; grad_reduce psums exactly the pending ones.
         # Head/projection/FFN grads are shard-complete on the model axis
-        # and reduce only over the data axes (hybrid).
+        # and reduce only over the data axes (hybrid). force_reduce:
+        # vma-off launch (interpret-mode fused head) — unconditional
+        # psum, the _make_step contract.
         model_and_data = (MODEL_AXIS,) + data_axes
         grads = grads._replace(
-            wpe=grad_reduce(grads.wpe, model_and_data),
-            ln_f=grad_reduce(grads.ln_f, model_and_data),
+            wpe=grad_reduce(grads.wpe, model_and_data, force=force_reduce),
+            ln_f=grad_reduce(grads.ln_f, model_and_data,
+                             force=force_reduce),
             blocks=grads.blocks._replace(
-                ln1=grad_reduce(grads.blocks.ln1, model_and_data),
-                ln2=grad_reduce(grads.blocks.ln2, model_and_data)))
+                ln1=grad_reduce(grads.blocks.ln1, model_and_data,
+                                force=force_reduce),
+                ln2=grad_reduce(grads.blocks.ln2, model_and_data,
+                                force=force_reduce)))
         if data_axes:
+            # the four leaves above are already fully reduced (their
+            # psum covered the data axes too); under force their second
+            # psum would NOT no-op — restore them after the sweep
+            done = (grads.wpe, grads.ln_f, grads.blocks.ln1,
+                    grads.blocks.ln2)
             grads = jax.tree_util.tree_map(
-                lambda g: grad_reduce(g, data_axes), grads)
+                lambda g: grad_reduce(g, data_axes, force=force_reduce),
+                grads)
+            grads = grads._replace(
+                wpe=done[0], ln_f=done[1],
+                blocks=grads.blocks._replace(ln1=done[2], ln2=done[3]))
         return grads
 
     def step(params: LMParams, seed) -> LMParams:
@@ -419,7 +487,8 @@ def _make_tp_step(batch_size: int, model_size: int, seq_len: int,
 def train_lm_tp(params: LMParams, seeds, batch_size: int, model_size: int,
                 mesh, lr: float = LR, *, seq_len: int, n_heads: int,
                 attn_impl: str | None = None, optimizer=None,
-                opt_state=None, return_state: bool = False):
+                opt_state=None, return_state: bool = False,
+                head_impl: str | None = None):
     """Megatron-LM TP over the model axis: blocks shard heads/features
     (``tp_block``), ``wte`` shards vocab rows serving both the parallel
     embedding and the tied parallel head, and the loss runs vocab-parallel
@@ -438,13 +507,17 @@ def train_lm_tp(params: LMParams, seeds, batch_size: int, model_size: int,
     if params.vocab % n:
         raise ValueError(f"vocab={params.vocab} not divisible by "
                          f"model-axis size {n}")
+    resolve_head(head_impl)  # shared validation (one accepted set)
+    check = _vma_check(attn_impl, head_impl)
     step = _make_tp_step(batch_size, model_size, seq_len, h_local,
                          params.vocab, lr, resolve_attn(attn_impl),
-                         optimizer=optimizer)
+                         optimizer=optimizer, head_impl=head_impl,
+                         force_reduce=not check)
     sharded = _shard(params, mesh, _lm_tp_specs())
     if optimizer is None:
         return launch(step, sharded, jnp.asarray(seeds), mesh,
-                      param_specs=_lm_tp_specs(), seed_spec=P())
+                      param_specs=_lm_tp_specs(), seed_spec=P(),
+                      check_vma=check)
     # zeros_like of sharded params keeps their shardings; scalar
     # bookkeeping (step counts) replicates
     state = optimizer.init(sharded) if opt_state is None else opt_state
@@ -452,7 +525,7 @@ def train_lm_tp(params: LMParams, seeds, batch_size: int, model_size: int,
                   param_specs=_lm_tp_specs(), seed_spec=P(),
                   state=state,
                   state_specs=_lm_state_specs(state, _lm_tp_specs()),
-                  return_state=return_state)
+                  return_state=return_state, check_vma=check)
 
 
 def tp_generate(params: LMParams, prompt, n_new: int, mesh, *,
@@ -725,12 +798,10 @@ def train_lm_seq(params: LMParams, seeds, batch_size: int, model_size: int,
 
         grads = jax.grad(loss_fn)(params)
         axes = (SEQ_AXIS, DATA_AXIS) if dp > 1 else (SEQ_AXIS,)
-        # vma-off (interpret-mode flash): unconditional psum — see
-        # _make_step's force_reduce note; grad_reduce would silently
-        # no-op on the partial cotangents there
-        red = ((lambda g: lax.psum(g, axes)) if not check
-               else (lambda g: grad_reduce(g, axes)))
-        grads = jax.tree_util.tree_map(red, grads)
+        # vma-off (interpret-mode flash/fused head): force the psum —
+        # grad_reduce would silently no-op on the partial cotangents
+        grads = jax.tree_util.tree_map(
+            lambda g: grad_reduce(g, axes, force=not check), grads)
         return sgd(params, grads, lr)
     if dp > 1:
         return launch_strided(step, clone_params(params), seeds, mesh,
