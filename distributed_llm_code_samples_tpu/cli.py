@@ -95,6 +95,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "transformer/lm): the quadratic hand-VJP oracle, "
                         "rotary positions, or the fused Pallas flash "
                         "kernels (interpret mode off-TPU)")
+    p.add_argument("--head", choices=["oracle", "fused"],
+                   default="oracle",
+                   help="LM head+loss implementation for --method 11/13: "
+                        "the materialized-logits hand-VJP xent, or the "
+                        "fused Pallas head (ops/pallas_xent.py - no "
+                        "[N, V] logits in HBM; vocab-parallel merge "
+                        "under method 11)")
     p.add_argument("--lr", type=float, default=None,
                    help="override LR (default 1e-5, train_ffns.py:29)")
     p.add_argument("--optimizer",
@@ -485,11 +492,15 @@ def main(argv=None) -> int:
                 kwargs["sequence_parallel"] = True
             if m in (8, 11) and args.attn != "oracle":
                 kwargs["attn_impl"] = args.attn
+            if m == 11 and args.head != "oracle":
+                kwargs["head_impl"] = args.head
         if m == 13:
             kwargs = dict(lr=lr, seq_len=args.seq_len,
                           n_heads=args.heads, seq_impl=args.seq_impl)
             if args.attn == "flash":
                 kwargs["attn_impl"] = "flash"
+            if args.head != "oracle":
+                kwargs["head_impl"] = args.head
         if m == 1 and args.pallas:
             kwargs["use_pallas"] = True
             kwargs["interpret"] = jax.default_backend() != "tpu"

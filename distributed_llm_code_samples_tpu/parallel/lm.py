@@ -413,7 +413,8 @@ def _make_tp_step(batch_size: int, model_size: int, seq_len: int,
                   h_local: int, vocab: int, lr: float, attn=None,
                   data_axes=(), optimizer=None,
                   head_impl: str | None = None,
-                  force_reduce: bool = False):
+                  force_reduce: bool = False,
+                  interpret: bool | None = None):
     """One vocab-parallel TP step for one model shard; ``data_axes`` adds
     the orthogonal DDP reduction for the hybrid 2-D mesh (every leaf is a
     partial sum over those axes; LN/positions additionally over the model
@@ -435,10 +436,11 @@ def _make_tp_step(batch_size: int, model_size: int, seq_len: int,
                              x, h_local, causal=True, attn=attn)
             h = f(layernorm(p.ln_f, x))       # dx from the head: psum
             if head_impl == "fused":
+                interp = (jax.default_backend() != "tpu"
+                          if interpret is None else interpret)
                 return vp_head_xent(
                     h.reshape(-1, model_size), p.wte,
-                    targets.reshape(-1), MODEL_AXIS,
-                    jax.default_backend() != "tpu")
+                    targets.reshape(-1), MODEL_AXIS, interp)
             logits_local = h.reshape(-1, model_size) @ p.wte.T
             return vp_xent(logits_local, targets.reshape(-1))
 
@@ -509,10 +511,12 @@ def train_lm_tp(params: LMParams, seeds, batch_size: int, model_size: int,
                          f"model-axis size {n}")
     resolve_head(head_impl)  # shared validation (one accepted set)
     check = _vma_check(attn_impl, head_impl)
+    # interpret == the same decision check_vma/force_reduce derive from:
+    # one backend-interpret policy, one plumbed flag
     step = _make_tp_step(batch_size, model_size, seq_len, h_local,
                          params.vocab, lr, resolve_attn(attn_impl),
                          optimizer=optimizer, head_impl=head_impl,
-                         force_reduce=not check)
+                         force_reduce=not check, interpret=not check)
     sharded = _shard(params, mesh, _lm_tp_specs())
     if optimizer is None:
         return launch(step, sharded, jnp.asarray(seeds), mesh,

@@ -277,3 +277,19 @@ def test_cli_comm_flag_guards():
     r = _run_cli("-s", "2", "-m", "4", "--comm", "pallas_ring",
                  "--fake_devices", "4")
     assert r.returncode == 2 and "--comm applies" in r.stderr
+
+
+def test_cli_head_flag():
+    """--head fused swaps the LM head for the fused Pallas kernels on
+    method 11 (vocab-parallel merge) and method 13 (per-shard blocks);
+    both run end to end on the fake mesh."""
+    r = _run_cli("-s", "2", "-bs", "2", "-n", "8", "-l", "2", "-d", "32",
+                 "-m", "11", "-r", "3", "--fake_devices", "4", "--tp",
+                 "2", "--vocab", "64", "--heads", "4", "--head", "fused",
+                 "--lr", "0.1")
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = _run_cli("-s", "2", "-bs", "2", "-n", "16", "-l", "2", "-d", "32",
+                 "-m", "13", "-r", "3", "--fake_devices", "4", "--vocab",
+                 "64", "--heads", "4", "--head", "fused", "--attn",
+                 "flash", "--lr", "0.1")
+    assert r.returncode == 0, r.stdout + r.stderr
