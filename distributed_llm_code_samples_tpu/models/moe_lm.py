@@ -111,13 +111,18 @@ def moe_lm_loss_aux(params: MoELMParams, tokens: jax.Array,
                     targets: jax.Array, n_heads: int, causal: bool = True,
                     capacity_factor: float | None = None,
                     k: int | None = None, capacity: int | None = None,
-                    moe_fn=None, attn=None):
+                    moe_fn=None, attn=None, head=None):
     """Mean next-token cross-entropy + the stack's summed router aux loss.
     ``tokens, targets [B, T]`` int. ``moe_fn`` swaps the MoE sublayer
     core (the EP trainer passes its all_to_all form); see
-    ``moe_transformer_fwd_aux``."""
+    ``moe_transformer_fwd_aux``. ``head`` swaps the tied-head + xent
+    computation for the fused Pallas kernels (``models.lm.lm_loss``
+    contract)."""
     h, aux = moe_lm_hidden_aux(params, tokens, n_heads, causal,
                                capacity_factor, k, capacity, moe_fn, attn)
+    if head is not None:
+        return head(h.reshape(-1, h.shape[-1]), params.wte,
+                    targets.reshape(-1)), aux
     logits = h @ params.wte.T
     loss = xent_loss(logits.reshape(-1, params.wte.shape[0]),
                      targets.reshape(-1))

@@ -185,11 +185,25 @@ def _run_lm_single_opt(carry, seeds, batch_size, model_size, lr, seq_len,
 
 
 def _vma_check(attn_impl, head_impl=None) -> bool:
-    """The Pallas interpreter's vma propagation is incomplete (jax's own
-    error suggests check_vma=False), so interpret-mode kernels (CPU
-    suite) run with the typing off; on-TPU the compiled kernels pass
-    full checking (the AOT tests pin it)."""
-    return not ((attn_impl == "flash" or head_impl == "fused")
+    """Whether the launcher may run shard_map's vma typing.
+
+    Flash attention: off only in interpret mode (the Pallas
+    interpreter's vma propagation is incomplete — jax's own error
+    suggests check_vma=False); the compiled TPU kernels pass full
+    checking (the AOT tests pin it).
+
+    The fused head: off on EVERY backend. Under vma-on, the tied
+    ``wte``'s cotangent has MIXED provenance — the embedding-gather
+    contribution arrives auto-psummed (plain-op transpose) while the
+    kernel's hand-written ``dw`` arrives partial — and their sum is
+    typed varying, so any downstream psum double-counts the
+    already-reduced embedding part (scaled by the axis size). The
+    vma-off force-reduce contract (``grad_reduce(force=True)``) keeps
+    every cotangent partial and reduces exactly once; the oracle head
+    never hits this because both of its wte uses are plain ops."""
+    if head_impl == "fused":
+        return False
+    return not (attn_impl == "flash"
                 and jax.default_backend() != "tpu")
 
 

@@ -45,15 +45,19 @@ def _validate_lm(params: MoELMParams, batch_size: int, seq_len: int,
     return t_local
 
 
-def _reduce_replicated(grads: MoELMParams) -> MoELMParams:
+def _reduce_replicated(grads: MoELMParams,
+                       force: bool = False) -> MoELMParams:
     """psum the per-shard partials of every replicated leaf (vma-aware:
-    leaves whose plain-op transposes already auto-reduced are skipped)."""
+    leaves whose plain-op transposes already auto-reduced are skipped;
+    ``force`` applies the vma-off unconditional-psum contract,
+    ``collectives.grad_reduce``)."""
     grads = grads._replace(
-        wte=grad_reduce(grads.wte, EXPERT_AXIS),
-        wpe=grad_reduce(grads.wpe, EXPERT_AXIS),
-        ln_f=grad_reduce(grads.ln_f, EXPERT_AXIS),
+        wte=grad_reduce(grads.wte, EXPERT_AXIS, force=force),
+        wpe=grad_reduce(grads.wpe, EXPERT_AXIS, force=force),
+        ln_f=grad_reduce(grads.ln_f, EXPERT_AXIS, force=force),
         blocks=grads.blocks._replace(**{
-            f: grad_reduce(getattr(grads.blocks, f), EXPERT_AXIS)
+            f: grad_reduce(getattr(grads.blocks, f), EXPERT_AXIS,
+                           force=force)
             for f in _REPLICATED}))
     return grads
 
@@ -64,10 +68,14 @@ def train_moe_lm_ep(params: MoELMParams, seeds, batch_size: int,
                     capacity_factor: float = 2.0, k: int = 1,
                     aux_coef: float = 0.0,
                     attn_impl: str | None = None,
-                    dispatch: str = "dense") -> MoELMParams:
+                    dispatch: str = "dense",
+                    head_impl: str | None = None) -> MoELMParams:
     """Run the GShard-LM schedule; ``batch_size`` is global tokens per
     step (each shard trains ``batch_size/n`` tokens of its own strided
-    seed column)."""
+    seed column). ``head_impl="fused"`` swaps the tied head + xent for
+    the fused Pallas kernels per shard (``parallel.lm.resolve_head``;
+    the launcher then runs the vma-off reduction contract on CPU)."""
+    from .lm import _vma_check, resolve_head
     from .transformer import resolve_attn
     require_axes(mesh, EXPERT_AXIS)
     n = mesh.shape[EXPERT_AXIS]
@@ -76,6 +84,8 @@ def train_moe_lm_ep(params: MoELMParams, seeds, batch_size: int,
     b_local = t_local // seq_len
     vocab = params.vocab
     attn = resolve_attn(attn_impl)
+    head = resolve_head(head_impl)
+    check = _vma_check(attn_impl, head_impl)
 
     def moe_fn(wg, w1_local, w2_local, h):
         return moe_layer_ep(wg, w1_local, w2_local, h, capacity_factor,
@@ -86,14 +96,15 @@ def train_moe_lm_ep(params: MoELMParams, seeds, batch_size: int,
 
         def loss_fn(p):
             loss, aux = moe_lm_loss_aux(p, tokens, targets, n_heads,
-                                        causal, moe_fn=moe_fn, attn=attn)
+                                        causal, moe_fn=moe_fn, attn=attn,
+                                        head=head)
             return loss + aux_coef * aux.astype(loss.dtype)
 
         grads = jax.grad(loss_fn)(params)
-        return sgd(params, _reduce_replicated(grads), lr)
+        return sgd(params, _reduce_replicated(grads, force=not check), lr)
 
     return launch_strided(step, clone_params(params), seeds, mesh,
-                          EXPERT_AXIS, EP_LM_SPECS)
+                          EXPERT_AXIS, EP_LM_SPECS, check_vma=check)
 
 
 def train_moe_lm_dense(params: MoELMParams, seeds, batch_size: int,

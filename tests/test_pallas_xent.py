@@ -256,3 +256,39 @@ def test_vp_fused_loss_value_with_pad_range_targets():
     loss = float(f(h, w, t))  # P(MODEL_AXIS) slices 50 rows per shard
     ref = float(xent_loss(h @ w.T, t))
     np.testing.assert_allclose(loss, ref, rtol=1e-6)
+
+
+def test_moe_lm_ep_fused_head_matches_oracle():
+    """head_impl='fused' through the expert-parallel MoE-LM trainer ==
+    its oracle-head run on the 4-way expert mesh (router aux and the
+    vma-off forced reduction included)."""
+    from distributed_llm_code_samples_tpu.data import make_seed_schedule
+    from distributed_llm_code_samples_tpu.models import init_moe_lm
+    from distributed_llm_code_samples_tpu.parallel import (
+        EXPERT_AXIS, make_mesh, train_moe_lm_ep)
+
+    params = init_moe_lm(jax.random.PRNGKey(0), 384, 32, 2, 4, 64)
+    seeds = make_seed_schedule(4, random_seed=7)
+    mesh = make_mesh({EXPERT_AXIS: 4})
+    outs = [train_moe_lm_ep(params, seeds, 4 * 64, 32, mesh, lr=0.1,
+                            seq_len=64, n_heads=4, k=2, aux_coef=0.01,
+                            head_impl=impl)
+            for impl in (None, "fused")]
+    for a, b in zip(jax.tree_util.tree_leaves(outs[0]),
+                    jax.tree_util.tree_leaves(outs[1])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=1e-6)
+
+
+def test_vma_check_contract():
+    """The fused head must run the vma-off force-reduce contract on
+    EVERY backend: under vma-on the tied wte's cotangent mixes an
+    auto-psummed embedding-gather part with the kernel's partial dw, and
+    a downstream psum would double-count the former (scaled by the axis
+    size). Flash alone keeps full checking on TPU."""
+    from distributed_llm_code_samples_tpu.parallel.lm import _vma_check
+    assert _vma_check(None, "fused") is False
+    assert _vma_check("flash", "fused") is False
+    # flash-only: off here exactly when interpreting (CPU suite)
+    assert _vma_check("flash", None) == (jax.default_backend() == "tpu")
+    assert _vma_check(None, None) is True
