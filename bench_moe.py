@@ -97,10 +97,21 @@ def main() -> int:
             b = max(TOKENS // SEQ, 1)
             lm = init_moe_lm(jax.random.PRNGKey(1), VOCAB, D, L, E, SEQ)
             mesh = make_mesh({EXPERT_AXIS: jax.device_count()})
-            sps = measure(lambda p, s: train_moe_lm_ep(
-                p, s, b * SEQ, D, mesh, lr=0.1, seq_len=SEQ,
-                n_heads=max(D // 64, 1), k=K, aux_coef=0.01), lm)
-            payload["moe_lm_steps_per_sec"] = round(sps, 4)
+            # head policy measured (bench.py families convention):
+            # oracle materializes [N, V] logits + softmax residual,
+            # fused keeps logit tiles in VMEM (ops/pallas_xent.py)
+            by_head = {}
+            for h_impl in (None, "fused"):
+                by_head[h_impl or "oracle"] = measure(
+                    lambda p, s, _h=h_impl: train_moe_lm_ep(
+                        p, s, b * SEQ, D, mesh, lr=0.1, seq_len=SEQ,
+                        n_heads=max(D // 64, 1), k=K, aux_coef=0.01,
+                        head_impl=_h), lm)
+            win = max(by_head, key=by_head.get)
+            payload["moe_lm_steps_per_sec"] = round(by_head[win], 4)
+            payload["moe_lm_head"] = win
+            payload["moe_lm_by_head"] = {k2: round(v, 4)
+                                         for k2, v in by_head.items()}
             payload["moe_lm_shape"] = (f"d{D}_L{L}_E{E}_k{K}_T{SEQ}"
                                        f"_B{b}_V{VOCAB}")
         except Exception as exc:  # noqa: BLE001
