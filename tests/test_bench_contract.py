@@ -84,11 +84,16 @@ def test_bench_emits_driver_contract():
 def test_bench_moe_verdict_contract():
     payload = _run("bench_moe.py", {
         "MOE_TOKENS": "128", "MOE_D": "32", "MOE_LAYERS": "1",
-        "MOE_STEPS": "2", "MOE_REPS": "1", "MOE_LM": "0"})
+        "MOE_STEPS": "8", "MOE_REPS": "1", "MOE_SEQ": "16",
+        "MOE_VOCAB": "64"})  # 8 steps: divisible by the fake mesh
     assert isinstance(payload["value"], float)
     assert isinstance(payload["dense_steps_per_sec"], float)
     assert isinstance(payload["scatter_steps_per_sec"], float)
     assert "verdict" in payload
+    # the MoE-LM family ships its measured head-policy grid
+    assert isinstance(payload.get("moe_lm_steps_per_sec"), float), payload
+    assert payload.get("moe_lm_head") in ("oracle", "fused"), payload
+    assert set(payload["moe_lm_by_head"]) == {"oracle", "fused"}
 
 
 @pytest.mark.slow
