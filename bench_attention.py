@@ -134,7 +134,10 @@ def main() -> int:
             n0 = 8
             t0 = prog_time(make_prog(fn, n0), qkv)
             per = max((t0 - floor) / n0, 1e-7)
-            n = int(max(8, min(4096, round(TARGET_S / per))))
+            # cap high enough that small-T points still reach the
+            # target window (T=1024 steps are ~0.1 ms; the old 4096 cap
+            # left the floor at ~16% of the timing there)
+            n = int(max(8, min(65536, round(TARGET_S / per))))
         tn = prog_time(make_prog(fn, n), qkv)
         return max(tn - floor, 1e-9) / n, n, tn
 
