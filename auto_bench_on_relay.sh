@@ -1,23 +1,27 @@
 #!/bin/bash
-# Watch the axon relay; whenever it answers, collect the updated
-# headline bench (families attn x head grid + bf16 policy grid). Keeps
-# watching until a bench run lands with BOTH grids present (a
-# watchdog-truncated payload or a CPU-fallback run does not count).
+# Watch the axon relay; whenever it answers, collect the full round-5
+# hardware artifact sweep (run_hw_artifacts.sh, headline bench FIRST).
+# Keeps watching until a bench run lands with BOTH policy grids present
+# and NO provenance field (a fallback-emitted payload or a CPU run does
+# not count as a measured r05 artifact).
 set -u
 cd "$(dirname "$0")"
+R="${ROUND:-r05}"
+LOG=/tmp/auto_bench_${R}.log
 while true; do
   if timeout 90 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" >/dev/null 2>&1; then
-    echo "relay up $(date -u +%H:%M:%S); running bench" >> /tmp/auto_bench.log
-    timeout 3600 python bench.py > /tmp/bench_r04_v2.json 2>/tmp/bench_r04_v2.err
-    if tail -1 /tmp/bench_r04_v2.json 2>/dev/null \
-        | grep -q '"by_policy"' \
-       && tail -1 /tmp/bench_r04_v2.json | grep -q '"bf16_policy"'; then
-      tail -1 /tmp/bench_r04_v2.json > BENCH_r04_local.json
-      echo "bench done $(date -u +%H:%M:%S)" >> /tmp/auto_bench.log
+    echo "relay up $(date -u +%H:%M:%S); running artifact sweep" >> "$LOG"
+    ROUND=$R BENCH_WAIT_BUDGET=600 ./run_hw_artifacts.sh >> "$LOG" 2>&1 || true
+    # accept on THIS run's tee output, not the persistent artifact — a
+    # stale accepted file from an earlier sweep must not end the watch
+    if [ -s /tmp/bench_${R}_run.json ] \
+       && grep -q '"by_policy"' /tmp/bench_${R}_run.json \
+       && grep -q '"bf16_policy"' /tmp/bench_${R}_run.json \
+       && ! grep -q '"provenance"' /tmp/bench_${R}_run.json; then
+      echo "bench accepted $(date -u +%H:%M:%S)" >> "$LOG"
       break
     fi
-    echo "bench incomplete/failed $(date -u +%H:%M:%S); rewatching" \
-      >> /tmp/auto_bench.log
+    echo "bench incomplete/failed $(date -u +%H:%M:%S); rewatching" >> "$LOG"
   fi
   sleep 240
 done

@@ -97,11 +97,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "kernels (interpret mode off-TPU)")
     p.add_argument("--head", choices=["oracle", "fused"],
                    default="oracle",
-                   help="LM head+loss implementation for --method 11/13: "
-                        "the materialized-logits hand-VJP xent, or the "
-                        "fused Pallas head (ops/pallas_xent.py - no "
-                        "[N, V] logits in HBM; vocab-parallel merge "
-                        "under method 11)")
+                   help="LM head+loss implementation for --method "
+                        "11/12/13: the materialized-logits hand-VJP "
+                        "xent, or the fused Pallas head "
+                        "(ops/pallas_xent.py - no [N, V] logits in HBM; "
+                        "vocab-parallel merge under method 11)")
     p.add_argument("--lr", type=float, default=None,
                    help="override LR (default 1e-5, train_ffns.py:29)")
     p.add_argument("--optimizer",
@@ -223,6 +223,13 @@ def main(argv=None) -> int:
         return 2
     if args.comm != "psum" and args.method not in (0, 2, 3, 9):
         print("error: --comm applies to --method 2 (DDP) or 3 (FSDP)",
+              file=sys.stderr)
+        return 2
+    if args.head != "oracle" and args.method not in (9, 11, 12, 13):
+        # same pattern as the --comm guard: inapplicable flags exit 2
+        # instead of silently running the oracle head (ADVICE r4)
+        print("error: --head fused applies to --method 11 (LM TP), "
+              "12 (MoE LM EP), or 13 (sequence-parallel LM)",
               file=sys.stderr)
         return 2
     if args.method == 13 and args.kv_heads:
@@ -492,7 +499,7 @@ def main(argv=None) -> int:
                 kwargs["sequence_parallel"] = True
             if m in (8, 11) and args.attn != "oracle":
                 kwargs["attn_impl"] = args.attn
-            if m == 11 and args.head != "oracle":
+            if m in (11, 12) and args.head != "oracle":
                 kwargs["head_impl"] = args.head
         if m == 13:
             kwargs = dict(lr=lr, seq_len=args.seq_len,

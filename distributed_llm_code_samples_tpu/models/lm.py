@@ -128,7 +128,8 @@ def lm_logits(params: LMParams, tokens: jax.Array, n_heads: int,
 
 
 def lm_loss(params: LMParams, tokens: jax.Array, targets: jax.Array,
-            n_heads: int, attn=None, head=None) -> jax.Array:
+            n_heads: int, attn=None, head=None,
+            mixed: bool = False) -> jax.Array:
     """Mean next-token cross-entropy. ``tokens, targets [B, T]`` int.
 
     ``head`` swaps the tied-head + loss computation: None materializes
@@ -136,7 +137,27 @@ def lm_loss(params: LMParams, tokens: jax.Array, targets: jax.Array,
     a callable ``(h [N, d], wte [V, d], targets [N]) -> scalar`` takes
     the trunk output directly — the fused Pallas head
     (``ops.pallas_xent.head_xent`` via ``parallel.lm.resolve_head``)
-    never builds the logits at all."""
+    never builds the logits at all.
+
+    ``mixed`` is the LM family's bf16 policy (the ``train_single(
+    mixed=True)`` stance extended over the transformer trunk): the
+    TRUNK — embedding gather, blocks, final LN — runs on a bf16 cast of
+    the params with a bf16 residual stream in HBM (half the activation
+    traffic; MXU time is unchanged since default-precision f32 matmuls
+    are single bf16 passes anyway), while the head + cross-entropy stay
+    f32 on the f32 master ``wte``. Params, grads, and the update remain
+    f32 end to end — the embedding contribution to ``wte``'s gradient
+    arrives through the bf16 cast's transpose (a cast back to f32),
+    summing with the head's f32 contribution."""
+    if mixed:
+        trunk = jax.tree_util.tree_map(
+            lambda a: a.astype(jnp.bfloat16), params)
+        h = lm_hidden(trunk, tokens, n_heads, attn)
+        h = h.reshape(-1, h.shape[-1]).astype(jnp.float32)
+        if head is not None:
+            return head(h, params.wte, targets.reshape(-1))
+        logits = h @ params.wte.T
+        return xent_loss(logits, targets.reshape(-1))
     if head is not None:
         h = lm_hidden(params, tokens, n_heads, attn)
         return head(h.reshape(-1, h.shape[-1]), params.wte,

@@ -34,6 +34,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .ffn import ffn_block
 
@@ -156,6 +157,137 @@ def scatter_combine(ye: jax.Array, dest: jax.Array, keep: jax.Array,
                       gates.astype(ye.dtype))
 
 
+def gather_metadata(idx_flat: jax.Array, t: int, n_experts: int,
+                    capacity: int):
+    """Routing metadata for the gather dispatch: ``dest [N]`` (each flat
+    choice's slot, dummy ``E*C`` when dropped), ``slot_tok [E*C]`` (the
+    token filling each slot, dummy ``t`` when unclaimed), ``slot_choice
+    [E*C]`` (the flat choice claiming each slot, dummy ``N``), ``keep
+    [N]``. The only scatters in the whole gather path live here, and
+    they move O(N) int32 elements — not O(N*d) rows."""
+    n = idx_flat.shape[0]
+    pos, keep = _slot_positions(idx_flat, n_experts, capacity)
+    dest = jnp.where(keep, idx_flat * capacity + pos,
+                     n_experts * capacity)
+    tok = jnp.tile(jnp.arange(t, dtype=jnp.int32), n // t)
+    slots = n_experts * capacity
+    slot_tok = jnp.full((slots + 1,), t, jnp.int32).at[dest].set(tok)
+    slot_choice = jnp.full((slots + 1,), n, jnp.int32).at[dest].set(
+        jnp.arange(n, dtype=jnp.int32))
+    return dest, slot_tok[:-1], slot_choice[:-1], keep
+
+
+@jax.custom_vjp
+def permute_to_slots(x: jax.Array, dest: jax.Array, slot_tok: jax.Array):
+    """Dispatch as a PERMUTATION GATHER: ``xe[s] = x[slot_tok[s]]``
+    (zero row for unclaimed slots). The kept (token, choice) -> slot map
+    is a bijection, so the VJP is ALSO a gather — ``dx[t] = sum_k
+    dxe[dest[k*T + t]]`` — instead of the scatter-add ``jax.vjp`` would
+    derive from a forward scatter. On TPU gathers vectorize while
+    scatter serializes; this removes every O(N*d) scatter from the
+    dispatch path, both directions."""
+    xp = jnp.concatenate([x, jnp.zeros((1, x.shape[1]), x.dtype)])
+    return xp[slot_tok]                                   # [E*C, d]
+
+
+def _pts_fwd(x, dest, slot_tok):
+    return permute_to_slots(x, dest, slot_tok), (x.shape[0], dest)
+
+
+def _pts_bwd(res, dxe):
+    t, dest = res
+    dxp = jnp.concatenate([dxe, jnp.zeros((1, dxe.shape[1]), dxe.dtype)])
+    dx = jnp.sum(dxp[dest].reshape(-1, t, dxe.shape[1]), axis=0)
+    f0 = lambda a: np.zeros(a.shape, jax.dtypes.float0)  # noqa: E731
+    return dx, f0(dest), f0(jnp.zeros(dxe.shape[0], jnp.int32))
+
+
+def _combine_gather(ye_flat, dest, keep, gates, t):
+    """Shared fwd math: gather each choice's slot row, gate-scale, sum
+    over choices. ``ye_flat [E*C, d]``."""
+    d = ye_flat.shape[-1]
+    padded = jnp.concatenate([ye_flat, jnp.zeros((1, d), ye_flat.dtype)])
+    y_choice = padded[dest] * keep[:, None].astype(ye_flat.dtype)
+    return jnp.einsum("ktd,tk->td", y_choice.reshape(-1, t, d),
+                      gates.astype(ye_flat.dtype)), y_choice
+
+
+@jax.custom_vjp
+def combine_from_slots(ye: jax.Array, gates: jax.Array, dest: jax.Array,
+                       slot_tok: jax.Array, slot_choice: jax.Array,
+                       keep: jax.Array):
+    """Combine with a gather-only VJP. Forward is ``scatter_combine``'s
+    math exactly (gather slot rows by ``dest``, gate-scale, sum over
+    choices); the backward uses the slot->token/choice inverse maps so
+    ``dye[s] = gate[slot_choice[s]] * dy[slot_tok[s]]`` is a gather too
+    — where autodiff's transpose of the forward gather would be an
+    O(N*d) scatter-add."""
+    ye_flat = ye.reshape(-1, ye.shape[-1])
+    t = gates.shape[0]
+    y, _ = _combine_gather(ye_flat, dest, keep, gates, t)
+    return y
+
+
+def _cfs_fwd(ye, gates, dest, slot_tok, slot_choice, keep):
+    ye_flat = ye.reshape(-1, ye.shape[-1])
+    t = gates.shape[0]
+    y, y_choice = _combine_gather(ye_flat, dest, keep, gates, t)
+    return y, (y_choice, gates, dest, slot_tok, slot_choice, keep,
+               ye.shape)
+
+
+def _cfs_bwd(res, dy):
+    y_choice, gates, dest, slot_tok, slot_choice, keep, ye_shape = res
+    t, k = gates.shape
+    d = dy.shape[-1]
+    # dye[s]: the gate of the choice that claimed s, times dy of the
+    # token that claimed s — dummy rows of the padded operands make
+    # unclaimed slots come out exactly zero
+    gates_flat = (gates.T.reshape(-1)
+                  * keep.astype(gates.dtype))            # [k*T] choice-major
+    gates_pad = jnp.concatenate([gates_flat,
+                                 jnp.zeros((1,), gates.dtype)])
+    dy_pad = jnp.concatenate([dy, jnp.zeros((1, d), dy.dtype)])
+    dye = (gates_pad[slot_choice][:, None].astype(dy.dtype)
+           * dy_pad[slot_tok]).reshape(ye_shape)
+    # dgates[t, k] = <dy[t], y_choice[k, t]> (y_choice already carries
+    # the keep mask; it is the UN-gated slot row gathered in fwd)
+    dgates = jnp.einsum("td,ktd->tk",
+                        dy, y_choice.reshape(k, t, d)).astype(gates.dtype)
+    f0 = lambda a: np.zeros(a.shape, jax.dtypes.float0)  # noqa: E731
+    return (dye, dgates, f0(dest), f0(slot_tok), f0(slot_choice),
+            np.zeros(keep.shape, jax.dtypes.float0))
+
+
+permute_to_slots.defvjp(_pts_fwd, _pts_bwd)
+combine_from_slots.defvjp(_cfs_fwd, _cfs_bwd)
+
+
+def moe_layer_gather(wg: jax.Array, w1: jax.Array, w2: jax.Array,
+                     x: jax.Array, capacity_factor: float = 2.0,
+                     k: int = 1, capacity: int | None = None
+                     ) -> jax.Array:
+    """``moe_layer`` with the gather dispatch: identical routing,
+    capacity drops, and GShard choice-major priority — but every
+    O(T*d) data movement in BOTH directions is a gather
+    (``permute_to_slots`` / ``combine_from_slots``), with only O(k*T)
+    int32 scatters for the slot bookkeeping. The third dispatch
+    formulation next to ``moe_layer`` (one-hot einsums, O(k*T^2*cf*d)
+    MXU work) and ``moe_layer_scatter`` (scatter-add rows, serialized
+    on TPU); bench_moe.py records which one the chip defends."""
+    n_experts = w1.shape[0]
+    t = x.shape[0]
+    cap = (expert_capacity(t, n_experts, capacity_factor)
+           if capacity is None else capacity)
+    idx_flat, gates = route_flat(wg, x, k)
+    dest, slot_tok, slot_choice, keep = gather_metadata(
+        idx_flat, t, n_experts, cap)
+    xe = permute_to_slots(x, dest, slot_tok).reshape(n_experts, cap, -1)
+    ye = jax.vmap(ffn_block)(w1, w2, xe)
+    return combine_from_slots(ye, gates, dest, slot_tok, slot_choice,
+                              keep)
+
+
 def moe_layer_scatter(wg: jax.Array, w1: jax.Array, w2: jax.Array,
                       x: jax.Array, capacity_factor: float = 2.0,
                       k: int = 1, capacity: int | None = None
@@ -240,11 +372,14 @@ def moe_stack_fwd_aux(params, x: jax.Array, capacity_factor: float = 2.0,
     layer scored on its own residual-chained input — one walk computes
     both, so trainers can take a single ``vjp`` with cotangents
     ``(dloss_dx, aux_coef)``. ``dispatch`` selects the token movement:
-    ``"dense"`` one-hot einsums or ``"scatter"``
-    (``moe_layer_scatter`` — same math, O(T*d) movement)."""
-    if dispatch not in ("dense", "scatter"):
+    ``"dense"`` one-hot einsums, ``"scatter"`` (``moe_layer_scatter`` —
+    same math, O(T*d) scatter-add movement), or ``"gather"``
+    (``moe_layer_gather`` — gather-only movement both directions)."""
+    layers = {"dense": moe_layer, "scatter": moe_layer_scatter,
+              "gather": moe_layer_gather}
+    if dispatch not in layers:
         raise ValueError(f"unknown dispatch {dispatch!r}")
-    layer = moe_layer if dispatch == "dense" else moe_layer_scatter
+    layer = layers[dispatch]
     aux = jnp.asarray(0.0, jnp.float32)
     for l in range(params.w1.shape[0]):
         aux = aux + router_aux_loss(params.wg[l], x)

@@ -27,22 +27,50 @@ the fused kernels compile and run (26.4 vs 16.0 steps/s, ratio ~0.60)
 but cannot win: the 3-kernel VJP split recomputes ``h`` and ``dy·w2`` in
 both backward kernels (18·T·d·f total matmul FLOPs vs the XLA path's
 14·T·d·f), and a fused dx+dw kernel is blocked by conflicting reduction
-axes (dx reduces over ffn, dw over tokens — holding both accumulator
-sets in VMEM at once exceeds the 16 MB budget at this d). With XLA at
-92% of the MXU peak there is no headroom for the extra FLOPs to hide.
-These kernels remain the first-principles escape hatch and the
-hand-scheduling teaching path; ``bench.py`` records the live
-``pallas_vs_xla`` ratio every round.
+axes (dx reduces over ffn, dw over tokens — an output block revisited
+non-consecutively across the grid cannot accumulate in VMEM). With XLA
+at 92% of the MXU peak there is no headroom for the extra FLOPs to
+hide.
+
+**Round-5: the flash recipe applied** (the exact fix that took the
+flash kernels 7→41 TF/s on chip in r4): every MXU operand is cast to
+bf16 by default on the compiled path (``mxu_bf16`` — f32 operands make
+Mosaic emit multi-pass dots, ~3x the single bf16 pass XLA's default f32
+precision lowers to), and the block sizes are sweepable
+(``bench.py``'s ``BENCH_PALLAS_SWEEP=1`` tries the tile grid on chip
+and reports the best). The 18-vs-14 FLOP structure is inherent to the
+3-kernel split, so the arithmetic ceiling is 14/18 ≈ 0.78 of an
+equally-efficient XLA — ``pallas_vs_xla`` ≥ 0.9 is only reachable if
+the kernels beat XLA's per-FLOP efficiency; ``bench.py`` records the
+live ratio and this docstring carries the measured verdict either way.
 """
 
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+
+def _mxu(x, mxu_bf16: bool):
+    """Cast an MXU operand to bf16 when the bf16-MXU policy is on (the
+    flash recipe; same helper as ``pallas_attention._mxu`` — defined
+    here too because that module imports ``_pick_block`` from this
+    one)."""
+    return x.astype(jnp.bfloat16) if mxu_bf16 else x
+
+
+def _resolve_mxu_bf16(mxu_bf16, interpret: bool) -> bool:
+    """Default the bf16-MXU policy: on for the compiled TPU path, off
+    under the interpreter (the CPU suite then checks exact f32 math
+    against the oracle)."""
+    if mxu_bf16 is not None:
+        return bool(mxu_bf16)
+    return not interpret
 
 
 def _pick_block(size: int, preferred: int, quantum: int) -> int:
@@ -61,16 +89,28 @@ _TOKEN_QUANTUM = 8
 _FFN_QUANTUM = 128
 
 
-def _fwd_kernel(x_ref, w1_ref, w2_ref, y_ref, acc_ref):
+def _env_block(name: str, default: int) -> int:
+    """Tile-size default, env-overridable so bench.py's on-chip sweep
+    can tune without replumbing the trainers (the sweep calls
+    ``jax.clear_caches()`` between points — the envs are read at trace
+    time)."""
+    v = os.environ.get(name)
+    return int(v) if v else default
+
+
+def _fwd_kernel(x_ref, w1_ref, w2_ref, y_ref, acc_ref, *, mxu_bf16):
     k = pl.program_id(1)
 
     @pl.when(k == 0)
     def _():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    h = jnp.dot(x_ref[:], w1_ref[:].T, preferred_element_type=jnp.float32)
-    a = jnp.maximum(h, 0.0).astype(x_ref.dtype)
-    acc_ref[:] += jnp.dot(a, w2_ref[:].T, preferred_element_type=jnp.float32)
+    h = jnp.dot(_mxu(x_ref[:], mxu_bf16), _mxu(w1_ref[:], mxu_bf16).T,
+                preferred_element_type=jnp.float32)
+    a_dtype = jnp.bfloat16 if mxu_bf16 else x_ref.dtype
+    a = jnp.maximum(h, 0.0).astype(a_dtype)
+    acc_ref[:] += jnp.dot(a, _mxu(w2_ref[:], mxu_bf16).T,
+                          preferred_element_type=jnp.float32)
 
     @pl.when(k == pl.num_programs(1) - 1)
     def _():
@@ -78,17 +118,24 @@ def _fwd_kernel(x_ref, w1_ref, w2_ref, y_ref, acc_ref):
 
 
 def ffn_fwd_pallas(w1: jax.Array, w2: jax.Array, x: jax.Array, *,
-                   block_t: int = 256, block_f: int = 512,
-                   interpret: bool = False) -> jax.Array:
+                   block_t: int | None = None,
+                   block_f: int | None = None,
+                   interpret: bool = False,
+                   mxu_bf16: bool | None = None) -> jax.Array:
     """Fused linear->ReLU->linear forward. ``w1 [ffn, d]``, ``w2 [d, ffn]``,
-    ``x [T, d]`` -> ``[T, d]``; hidden tiles stay in VMEM."""
+    ``x [T, d]`` -> ``[T, d]``; hidden tiles stay in VMEM. ``mxu_bf16``
+    defaults on for the compiled TPU path (the flash recipe — f32
+    accumulation throughout)."""
     T, d = x.shape
     ffn = w1.shape[0]
-    bt = _pick_block(T, block_t, _TOKEN_QUANTUM)
-    bf = _pick_block(ffn, block_f, _FFN_QUANTUM)
+    bt = _pick_block(T, block_t or _env_block("PALLAS_FFN_BT", 256),
+                     _TOKEN_QUANTUM)
+    bf = _pick_block(ffn, block_f or _env_block("PALLAS_FFN_BF", 512),
+                     _FFN_QUANTUM)
     grid = (T // bt, ffn // bf)
     return pl.pallas_call(
-        _fwd_kernel,
+        functools.partial(_fwd_kernel,
+                          mxu_bf16=_resolve_mxu_bf16(mxu_bf16, interpret)),
         grid=grid,
         in_specs=[
             pl.BlockSpec((bt, d), lambda i, k: (i, 0)),   # x tile
@@ -108,7 +155,8 @@ def ffn_fwd_pallas(w1: jax.Array, w2: jax.Array, x: jax.Array, *,
     )(x, w1, w2)
 
 
-def _bwd_dx_kernel(x_ref, dy_ref, w1_ref, w2_ref, dx_ref, acc_ref):
+def _bwd_dx_kernel(x_ref, dy_ref, w1_ref, w2_ref, dx_ref, acc_ref, *,
+                   mxu_bf16):
     k = pl.program_id(1)
 
     @pl.when(k == 0)
@@ -116,10 +164,14 @@ def _bwd_dx_kernel(x_ref, dy_ref, w1_ref, w2_ref, dx_ref, acc_ref):
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     # recompute the pre-activation slice (checkpoint-block-inputs-only)
-    h = jnp.dot(x_ref[:], w1_ref[:].T, preferred_element_type=jnp.float32)
-    da = jnp.dot(dy_ref[:], w2_ref[:], preferred_element_type=jnp.float32)
-    dh = jnp.where(h <= 0.0, 0.0, da).astype(x_ref.dtype)
-    acc_ref[:] += jnp.dot(dh, w1_ref[:], preferred_element_type=jnp.float32)
+    h = jnp.dot(_mxu(x_ref[:], mxu_bf16), _mxu(w1_ref[:], mxu_bf16).T,
+                preferred_element_type=jnp.float32)
+    da = jnp.dot(_mxu(dy_ref[:], mxu_bf16), _mxu(w2_ref[:], mxu_bf16),
+                 preferred_element_type=jnp.float32)
+    dh_dtype = jnp.bfloat16 if mxu_bf16 else x_ref.dtype
+    dh = jnp.where(h <= 0.0, 0.0, da).astype(dh_dtype)
+    acc_ref[:] += jnp.dot(dh, _mxu(w1_ref[:], mxu_bf16),
+                          preferred_element_type=jnp.float32)
 
     @pl.when(k == pl.num_programs(1) - 1)
     def _():
@@ -127,17 +179,21 @@ def _bwd_dx_kernel(x_ref, dy_ref, w1_ref, w2_ref, dx_ref, acc_ref):
 
 
 def ffn_bwd_dx_pallas(dy: jax.Array, w1: jax.Array, w2: jax.Array,
-                      x: jax.Array, *, block_t: int = 256,
-                      block_f: int = 512,
-                      interpret: bool = False) -> jax.Array:
+                      x: jax.Array, *, block_t: int | None = None,
+                      block_f: int | None = None,
+                      interpret: bool = False,
+                      mxu_bf16: bool | None = None) -> jax.Array:
     """Input gradient ``dx = (relu'(x w1^T) * (dy w2)) w1`` fused."""
     T, d = x.shape
     ffn = w1.shape[0]
-    bt = _pick_block(T, block_t, _TOKEN_QUANTUM)
-    bf = _pick_block(ffn, block_f, _FFN_QUANTUM)
+    bt = _pick_block(T, block_t or _env_block("PALLAS_FFN_BT", 256),
+                     _TOKEN_QUANTUM)
+    bf = _pick_block(ffn, block_f or _env_block("PALLAS_FFN_BF", 512),
+                     _FFN_QUANTUM)
     grid = (T // bt, ffn // bf)
     return pl.pallas_call(
-        _bwd_dx_kernel,
+        functools.partial(_bwd_dx_kernel,
+                          mxu_bf16=_resolve_mxu_bf16(mxu_bf16, interpret)),
         grid=grid,
         in_specs=[
             pl.BlockSpec((bt, d), lambda i, k: (i, 0)),   # x tile
@@ -155,7 +211,7 @@ def ffn_bwd_dx_pallas(dy: jax.Array, w1: jax.Array, w2: jax.Array,
 
 
 def _bwd_dw_kernel(x_ref, dy_ref, w1_ref, w2_ref, dw1_ref, dw2_ref,
-                   acc1_ref, acc2_ref):
+                   acc1_ref, acc2_ref, *, mxu_bf16):
     t = pl.program_id(1)
 
     @pl.when(t == 0)
@@ -163,13 +219,18 @@ def _bwd_dw_kernel(x_ref, dy_ref, w1_ref, w2_ref, dw1_ref, dw2_ref,
         acc1_ref[:] = jnp.zeros_like(acc1_ref)
         acc2_ref[:] = jnp.zeros_like(acc2_ref)
 
-    h = jnp.dot(x_ref[:], w1_ref[:].T, preferred_element_type=jnp.float32)
-    a = jnp.maximum(h, 0.0).astype(x_ref.dtype)
-    da = jnp.dot(dy_ref[:], w2_ref[:], preferred_element_type=jnp.float32)
-    dh = jnp.where(h <= 0.0, 0.0, da).astype(x_ref.dtype)
+    x_m = _mxu(x_ref[:], mxu_bf16)
+    dy_m = _mxu(dy_ref[:], mxu_bf16)
+    h = jnp.dot(x_m, _mxu(w1_ref[:], mxu_bf16).T,
+                preferred_element_type=jnp.float32)
+    op_dtype = jnp.bfloat16 if mxu_bf16 else x_ref.dtype
+    a = jnp.maximum(h, 0.0).astype(op_dtype)
+    da = jnp.dot(dy_m, _mxu(w2_ref[:], mxu_bf16),
+                 preferred_element_type=jnp.float32)
+    dh = jnp.where(h <= 0.0, 0.0, da).astype(op_dtype)
     # dw1 slice [bf, d] = dh^T x ; dw2 slice [d, bf] = dy^T a
-    acc1_ref[:] += jnp.dot(dh.T, x_ref[:], preferred_element_type=jnp.float32)
-    acc2_ref[:] += jnp.dot(dy_ref[:].T, a, preferred_element_type=jnp.float32)
+    acc1_ref[:] += jnp.dot(dh.T, x_m, preferred_element_type=jnp.float32)
+    acc2_ref[:] += jnp.dot(dy_m.T, a, preferred_element_type=jnp.float32)
 
     @pl.when(t == pl.num_programs(1) - 1)
     def _():
@@ -178,8 +239,10 @@ def _bwd_dw_kernel(x_ref, dy_ref, w1_ref, w2_ref, dw1_ref, dw2_ref,
 
 
 def ffn_bwd_dw_pallas(dy: jax.Array, w1: jax.Array, w2: jax.Array,
-                      x: jax.Array, *, block_t: int = 256,
-                      block_f: int = 256, interpret: bool = False):
+                      x: jax.Array, *, block_t: int | None = None,
+                      block_f: int | None = None,
+                      interpret: bool = False,
+                      mxu_bf16: bool | None = None):
     """Both weight gradients, fused, reducing over token tiles:
     ``dw1 = (relu'(h) * (dy w2))^T x``, ``dw2 = dy^T relu(h)``.
 
@@ -190,11 +253,14 @@ def ffn_bwd_dw_pallas(dy: jax.Array, w1: jax.Array, w2: jax.Array,
     256 compiles and runs)."""
     T, d = x.shape
     ffn = w1.shape[0]
-    bt = _pick_block(T, block_t, _TOKEN_QUANTUM)
-    bf = _pick_block(ffn, block_f, _FFN_QUANTUM)
+    bt = _pick_block(T, block_t or _env_block("PALLAS_FFN_BT", 256),
+                     _TOKEN_QUANTUM)
+    bf = _pick_block(ffn, block_f or _env_block("PALLAS_FFN_DW_BF", 256),
+                     _FFN_QUANTUM)
     grid = (ffn // bf, T // bt)  # token axis is the reduction
     return pl.pallas_call(
-        _bwd_dw_kernel,
+        functools.partial(_bwd_dw_kernel,
+                          mxu_bf16=_resolve_mxu_bf16(mxu_bf16, interpret)),
         grid=grid,
         in_specs=[
             pl.BlockSpec((bt, d), lambda j, t: (t, 0)),   # x tile

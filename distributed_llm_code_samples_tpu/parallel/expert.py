@@ -106,6 +106,21 @@ def moe_layer_ep(wg, w1_local, w2_local, x, capacity_factor: float = 2.0,
         ye = jax.vmap(ffn_block)(w1_local, w2_local, xe)
         ye = a2a(ye, 1, 0)
         return scatter_combine(ye, dest, keep, gates, t)
+    if dispatch == "gather":
+        # gather-only movement (ops.moe custom-VJP permutation gathers,
+        # same slot bookkeeping) around the SAME pair of all_to_alls
+        from ..ops.moe import (combine_from_slots, gather_metadata,
+                               permute_to_slots)
+        idx_flat, gates = route_flat(wg, x, k)
+        dest, slot_tok, slot_choice, keep = gather_metadata(
+            idx_flat, t, n_experts, cap)
+        xe = permute_to_slots(x, dest, slot_tok).reshape(
+            n_experts, cap, -1)
+        xe = a2a(xe, 0, 1)
+        ye = jax.vmap(ffn_block)(w1_local, w2_local, xe)
+        ye = a2a(ye, 1, 0)
+        return combine_from_slots(ye, gates, dest, slot_tok,
+                                  slot_choice, keep)
     if dispatch != "dense":
         raise ValueError(f"unknown dispatch {dispatch!r}")
     if k == 1:
@@ -263,9 +278,11 @@ def train_moe_dense(params: MoEStackParams, seeds, batch_size: int,
     train_moe_dense(p, seeds, B, d, n_groups=n)`` is the --method 7
     differential check, runnable without a device mesh.
 
-    ``dispatch``: ``"dense"`` one-hot einsum movement or ``"scatter"``
-    (``ops.moe.moe_layer_scatter`` — same math, O(T*d) movement; see
-    bench_moe.py for the measured verdict).
+    ``dispatch``: ``"dense"`` one-hot einsum movement, ``"scatter"``
+    (``ops.moe.moe_layer_scatter`` — same math, O(T*d) scatter-add
+    movement), or ``"gather"`` (``ops.moe.moe_layer_gather`` —
+    gather-only movement in both directions; see bench_moe.py for the
+    measured verdict).
     """
     if batch_size % n_groups:
         raise ValueError(f"batch_size={batch_size} not divisible by "

@@ -84,7 +84,7 @@ def resolve_head(head_impl: str | None):
 def _make_step(batch_size: int, model_size: int, seq_len: int,
                n_heads: int, lr: float, attn=None, reduce_axes=(),
                optimizer=None, batch_fn=None, head=None,
-               force_reduce: bool = False):
+               force_reduce: bool = False, mixed: bool = False):
     """One update step on the real LM objective; ``batch_size`` is
     tokens/step (seq folded, CLI convention ``train_ffns.py:379``).
     Without ``optimizer`` it's the reference's stateless inline SGD
@@ -100,7 +100,7 @@ def _make_step(batch_size: int, model_size: int, seq_len: int,
                            lm_batch_from_seed(seed, b, seq_len,
                                               params.vocab))
         grads = jax.grad(lm_loss)(params, tokens, targets, n_heads, attn,
-                                  head)
+                                  head, mixed)
         if reduce_axes:
             # force_reduce: the launcher runs check_vma=False (interpret-
             # mode multi-tile Pallas kernels can't type-check), which
@@ -129,14 +129,16 @@ def train_lm_single(params: LMParams, seeds, batch_size: int,
                     seq_len: int, n_heads: int,
                     attn_impl: str | None = None, optimizer=None,
                     opt_state=None, return_state: bool = False,
-                    batch_fn=None, head_impl: str | None = None):
+                    batch_fn=None, head_impl: str | None = None,
+                    mixed: bool = False):
     """Single-device LM trainer — the oracle the parallel forms are pinned
     to. ``optimizer``/``opt_state``/``return_state`` follow the DDP
     contract (``ddp.py``): stateful rules thread ``(params, state)``
     through the scan and segments resume exactly. ``batch_fn(seed) ->
     (tokens, targets)`` swaps the synthetic data source for a real one
     (e.g. ``data.text_batch_from_seed`` windows over the embedded
-    corpus).
+    corpus). ``mixed`` runs the bf16-trunk / f32-head-and-master policy
+    (``models.lm.lm_loss(mixed=True)``).
 
     Compile-cache caveat: ``optimizer`` and ``batch_fn`` are STATIC jit
     arguments hashed by identity — reuse the SAME objects across calls
@@ -149,20 +151,22 @@ def train_lm_single(params: LMParams, seeds, batch_size: int,
     if optimizer is None:
         return _run_lm_single(clone_params(params), jnp.asarray(seeds),
                               batch_size, model_size, lr, seq_len,
-                              n_heads, attn_impl, batch_fn, head_impl)
+                              n_heads, attn_impl, batch_fn, head_impl,
+                              mixed)
 
     state = optimizer.init(params) if opt_state is None else opt_state
     out, state = _run_lm_single_opt(
         (clone_params(params), state), jnp.asarray(seeds), batch_size,
         model_size, lr, seq_len, n_heads, attn_impl, optimizer, batch_fn,
-        head_impl)
+        head_impl, mixed)
     return (out, state) if return_state else out
 
 
-@functools.partial(jax.jit, static_argnums=tuple(range(2, 10)),
+@functools.partial(jax.jit, static_argnums=tuple(range(2, 11)),
                    donate_argnums=0)
 def _run_lm_single(params, seeds, batch_size, model_size, lr, seq_len,
-                   n_heads, attn_impl, batch_fn, head_impl):
+                   n_heads, attn_impl, batch_fn, head_impl,
+                   mixed=False):
     """Module-level jit (the ``single.py`` pattern): repeat calls with
     the same static config — including the same ``optimizer``/``batch_fn``
     *objects*, which hash by identity — reuse the compiled program.
@@ -170,17 +174,19 @@ def _run_lm_single(params, seeds, batch_size, model_size, lr, seq_len,
     ``train_real_text.py``) pay one compile instead of one per call."""
     step = _make_step(batch_size, model_size, seq_len, n_heads, lr,
                       resolve_attn(attn_impl), batch_fn=batch_fn,
-                      head=resolve_head(head_impl))
+                      head=resolve_head(head_impl), mixed=mixed)
     return lax.scan(lambda p, s: (step(p, s), None), params, seeds)[0]
 
 
-@functools.partial(jax.jit, static_argnums=tuple(range(2, 11)))
+@functools.partial(jax.jit, static_argnums=tuple(range(2, 12)))
 def _run_lm_single_opt(carry, seeds, batch_size, model_size, lr, seq_len,
-                       n_heads, attn_impl, optimizer, batch_fn, head_impl):
+                       n_heads, attn_impl, optimizer, batch_fn, head_impl,
+                       mixed=False):
     # no donation: callers may hold/reuse the opt_state they passed in
     step = _make_step(batch_size, model_size, seq_len, n_heads, lr,
                       resolve_attn(attn_impl), optimizer=optimizer,
-                      batch_fn=batch_fn, head=resolve_head(head_impl))
+                      batch_fn=batch_fn, head=resolve_head(head_impl),
+                      mixed=mixed)
     return lax.scan(lambda c, s: (step(c, s), None), carry, seeds)[0]
 
 
@@ -525,12 +531,16 @@ def train_lm_tp(params: LMParams, seeds, batch_size: int, model_size: int,
                          f"model-axis size {n}")
     resolve_head(head_impl)  # shared validation (one accepted set)
     check = _vma_check(attn_impl, head_impl)
-    # interpret == the same decision check_vma/force_reduce derive from:
-    # one backend-interpret policy, one plumbed flag
+    # check_vma/force_reduce follow _vma_check (the fused head runs the
+    # vma-off reduction contract on EVERY backend); interpret is a
+    # separate, backend-only decision — the fused head must still run
+    # the COMPILED kernels on TPU. interpret=None lets _make_tp_step's
+    # backend fallback decide (ADVICE r4: tying it to `not check` ran
+    # the Pallas head in interpret mode on real TPU).
     step = _make_tp_step(batch_size, model_size, seq_len, h_local,
                          params.vocab, lr, resolve_attn(attn_impl),
                          optimizer=optimizer, head_impl=head_impl,
-                         force_reduce=not check, interpret=not check)
+                         force_reduce=not check, interpret=None)
     sharded = _shard(params, mesh, _lm_tp_specs())
     if optimizer is None:
         return launch(step, sharded, jnp.asarray(seeds), mesh,

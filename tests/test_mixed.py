@@ -221,3 +221,42 @@ def test_cli_mixed_flag_verifies(tmp_path):
         capture_output=True, text=True, timeout=600, cwd=repo)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "SoftAssertionError" not in r.stdout
+
+
+def test_lm_mixed_close_to_f32_but_distinct():
+    """The LM family's bf16 policy (bf16 trunk + residual stream, f32
+    head/master/update — models.lm.lm_loss(mixed=True)): tracks the f32
+    oracle at bf16 tolerance, differs beyond f32 tolerance (i.e. the
+    trunk really ran in bf16), and the params stay f32."""
+    from distributed_llm_code_samples_tpu.models import init_lm
+    from distributed_llm_code_samples_tpu.parallel import train_lm_single
+    params = init_lm(jax.random.PRNGKey(0), 128, 32, 2, 16, n_heads=4)
+    seeds = make_seed_schedule(4, random_seed=9)
+    kw = dict(lr=0.1, seq_len=16, n_heads=4)
+    f32 = train_lm_single(params, seeds, 2 * 16, 32, **kw)
+    mx = train_lm_single(params, seeds, 2 * 16, 32, mixed=True, **kw)
+    assert mx.wte.dtype == np.float32
+    for a, b in zip(jax.tree_util.tree_leaves(mx),
+                    jax.tree_util.tree_leaves(f32)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=0.1, atol=2e-3)
+    assert not np.allclose(np.asarray(f32.blocks.w1),
+                           np.asarray(mx.blocks.w1),
+                           rtol=1e-6, atol=1e-8)
+
+
+def test_lm_mixed_composes_with_fused_head():
+    """mixed=True + head_impl='fused': the bf16 trunk hands an f32 ``h``
+    to the Pallas head, which must agree with the mixed oracle head."""
+    from distributed_llm_code_samples_tpu.models import init_lm
+    from distributed_llm_code_samples_tpu.parallel import train_lm_single
+    params = init_lm(jax.random.PRNGKey(1), 128, 32, 2, 16, n_heads=4)
+    seeds = make_seed_schedule(3, random_seed=11)
+    kw = dict(lr=0.1, seq_len=16, n_heads=4, mixed=True)
+    oracle = train_lm_single(params, seeds, 2 * 16, 32, **kw)
+    fused = train_lm_single(params, seeds, 2 * 16, 32,
+                            head_impl="fused", **kw)
+    for a, b in zip(jax.tree_util.tree_leaves(fused),
+                    jax.tree_util.tree_leaves(oracle)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=1e-5)

@@ -388,6 +388,67 @@ def test_scatter_dispatch_through_stack(params):
         moe_stack_fwd_aux(params, x, dispatch="magic")
 
 
+@pytest.mark.parametrize("k,cf", [(1, 2.0), (2, 2.0), (1, 0.25), (2, 0.5)])
+def test_gather_dispatch_matches_dense(k, cf):
+    """moe_layer_gather == moe_layer to float tolerance: same routing,
+    same capacity drops (including heavy-overflow regimes), same GShard
+    choice-major priority — the movement is gather-only in BOTH
+    directions (the custom VJPs replace autodiff's scatter transposes
+    with inverse-permutation gathers). Gradients checked against the
+    dense path's, which test_moe_grads_flow_to_router pins to the
+    framework's hand-VJP stance."""
+    from distributed_llm_code_samples_tpu.ops.moe import moe_layer_gather
+    key = jax.random.split(jax.random.PRNGKey(3), 4)
+    wg = jax.random.normal(key[0], (E, D))
+    w1 = 0.1 * jax.random.normal(key[1], (E, 4 * D, D))
+    w2 = 0.1 * jax.random.normal(key[2], (E, D, 4 * D))
+    x = jax.random.normal(key[3], (T, D))
+    dense = moe_layer(wg, w1, w2, x, capacity_factor=cf, k=k)
+    gath = moe_layer_gather(wg, w1, w2, x, capacity_factor=cf, k=k)
+    np.testing.assert_allclose(np.asarray(gath), np.asarray(dense),
+                               rtol=2e-5, atol=2e-6)
+
+    def loss_dense(args):
+        return jnp.sum(jnp.sin(moe_layer(*args, capacity_factor=cf, k=k)))
+
+    def loss_gath(args):
+        return jnp.sum(jnp.sin(
+            moe_layer_gather(*args, capacity_factor=cf, k=k)))
+
+    gd = jax.grad(loss_dense)((wg, w1, w2, x))
+    gg = jax.grad(loss_gath)((wg, w1, w2, x))
+    for a, b in zip(gg, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-5, atol=5e-6)
+
+
+def test_gather_dispatch_through_stack(params):
+    """The stack walk accepts dispatch="gather" (residual + aux
+    unchanged)."""
+    from distributed_llm_code_samples_tpu.ops.moe import moe_stack_fwd_aux
+    x, _ = batch_from_seed(jnp.int32(5), T, D)
+    yd, auxd = moe_stack_fwd_aux(params, x, k=2)
+    yg, auxg = moe_stack_fwd_aux(params, x, k=2, dispatch="gather")
+    np.testing.assert_allclose(np.asarray(yg), np.asarray(yd),
+                               rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(float(auxg), float(auxd), rtol=1e-6)
+
+
+def test_ep_gather_dispatch_matches_dense(params, mesh_ep4):
+    """EP with gather dispatch == EP with dense dispatch, final params,
+    including router grads through the aux loss — the a2a pair and the
+    rest of the step are shared with the other dispatch forms."""
+    seeds = make_seed_schedule(8, random_seed=23)
+    dense = train_moe_ep(params, seeds, 4 * T, D, mesh_ep4, lr=0.1, k=2,
+                         aux_coef=0.01)
+    gath = train_moe_ep(params, seeds, 4 * T, D, mesh_ep4, lr=0.1, k=2,
+                        aux_coef=0.01, dispatch="gather")
+    for a, b in zip(jax.tree_util.tree_leaves(gath),
+                    jax.tree_util.tree_leaves(dense)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
+
+
 def test_ep_scatter_dispatch_matches_dense(params, mesh_ep4):
     """EP with scatter dispatch == EP with dense dispatch == the grouped
     dense oracle: the movement form changes nothing about routing,

@@ -183,6 +183,37 @@ def test_head_xent_aot_v5e_codegen():
     assert "custom-call" in hlo  # Mosaic kernels present
 
 
+def test_train_lm_tp_fused_head_leaves_interpret_to_backend(monkeypatch):
+    """Regression (ADVICE r4): ``train_lm_tp`` tied ``interpret`` to the
+    vma decision (``not _vma_check(...)``), so ``head_impl='fused'`` —
+    which runs vma-off on EVERY backend — forced the Pallas head into
+    interpret mode on real TPU too, defeating the compiled kernels the
+    AOT test pins. The trainer must pass ``interpret=None`` (the
+    backend fallback inside ``_make_tp_step`` decides) while keeping
+    ``force_reduce`` tied to the vma contract."""
+    from distributed_llm_code_samples_tpu.data import make_seed_schedule
+    from distributed_llm_code_samples_tpu.models import init_lm
+    from distributed_llm_code_samples_tpu.parallel import (
+        MODEL_AXIS, make_mesh)
+    import distributed_llm_code_samples_tpu.parallel.lm as lm_mod
+
+    seen = {}
+    real = lm_mod._make_tp_step
+
+    def spy(*a, **kw):
+        seen.update(kw)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(lm_mod, "_make_tp_step", spy)
+    params = init_lm(jax.random.PRNGKey(0), 384, 32, 1, 64, n_heads=4)
+    seeds = make_seed_schedule(1, random_seed=7)
+    lm_mod.train_lm_tp(params, seeds, 2 * 64, 32,
+                       make_mesh({MODEL_AXIS: 4}), lr=0.1,
+                       seq_len=64, n_heads=4, head_impl="fused")
+    assert seen["interpret"] is None
+    assert seen["force_reduce"] is True
+
+
 def test_vp_fused_head_matches_vp_oracle():
     """Vocab-parallel TP with the FUSED head (vp_head_xent: kernels per
     shard + the same pmax/psum merge as vp_xent, no local logits

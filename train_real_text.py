@@ -19,9 +19,18 @@ cross-entropy), trained with the hand-written AdamW + warmup-cosine from
 ``optim.py`` through ``train_lm_single``'s ``batch_fn`` hook — the same
 step the differential suite pins, pointed at real bytes.
 
+Best-holdout checkpointing (VERDICT r4 #6): every eval segment whose
+held-out loss improves saves a checkpoint through the framework's own
+``checkpoint.py`` (async native backend); the headline ``value`` is the
+BEST held-out loss, and the sampled continuation comes from the
+restored best-checkpoint params — the overfit tail of the curve is
+reported (``final_holdout_loss``) but no longer quoted as the result.
+This is the optimizer + checkpoint subsystems composing on the real
+objective, not just in their unit tests.
+
 Emits one JSON line per eval segment ``{"step": N, "train_loss": X,
 "holdout_loss": Y}``, then a final line with the full curve, a sampled
-continuation, and throughput; also written to ``TEXTLM_r04.json``
+continuation, and throughput; also written to ``TEXTLM_r05.json``
 (override: ``TEXTLM_ARTIFACT``).
 
 Run on the real chip: ``python train_real_text.py``. Smoke test:
@@ -33,7 +42,9 @@ block_until_ready for chained dispatches).
 
 import json
 import os
+import shutil
 import sys
+import tempfile
 import time
 
 import jax
@@ -52,7 +63,7 @@ SEGMENTS = int(os.environ.get("TEXTLM_SEGMENTS", 10))
 PEAK_LR = float(os.environ.get("TEXTLM_LR", 1e-3))
 HOLDOUT_FRAC = float(os.environ.get("TEXTLM_HOLDOUT", 0.10))
 VOCAB = 256
-ARTIFACT = os.environ.get("TEXTLM_ARTIFACT", "TEXTLM_r04.json")
+ARTIFACT = os.environ.get("TEXTLM_ARTIFACT", "TEXTLM_r05.json")
 
 
 def main() -> int:
@@ -107,6 +118,21 @@ def main() -> int:
     curve = [eval_point(0)]
     print(json.dumps(curve[0]))
     sys.stdout.flush()
+    # best-holdout checkpointing through the framework's own subsystem
+    # (async native backend: the save overlaps the next segment's
+    # training; wait_pending before restore)
+    from distributed_llm_code_samples_tpu.checkpoint import (
+        restore_checkpoint, save_checkpoint, wait_pending)
+    user_dir = os.environ.get("TEXTLM_CKPT_DIR")
+    if user_dir:
+        # user-provided: never delete their directory (it may hold
+        # other checkpoints); this run's saves land/overwrite by step
+        # number and the best checkpoint is KEPT after the run
+        ckpt_dir = user_dir
+    else:
+        # scratch default: fresh per-run dir, removed at the end
+        ckpt_dir = tempfile.mkdtemp(prefix="textlm_best_ckpt_")
+    best = {"holdout_loss": float("inf"), "step": 0}
     t0 = time.perf_counter()
     for seg in range(SEGMENTS):
         seeds = jnp.arange(seg * steps_per_seg,
@@ -119,34 +145,50 @@ def main() -> int:
         curve.append(point)
         print(json.dumps(point))
         sys.stdout.flush()
+        if point["holdout_loss"] < best["holdout_loss"]:
+            best = dict(point)
+            save_checkpoint(ckpt_dir, params, step=point["step"],
+                            backend="native",
+                            meta={"holdout_loss": point["holdout_loss"]})
     train_s = time.perf_counter() - t0  # eval readbacks fence each segment
+
+    # the model that ships is the BEST-holdout one, restored through the
+    # checkpoint subsystem (early stopping realized after the fact)
+    wait_pending()
+    best_params, best_step, _ = restore_checkpoint(
+        ckpt_dir, params, step=best["step"])
 
     prompt_text = "  GNU GENERAL PUBLIC LICENSE\n"
     prompt = jnp.frombuffer(prompt_text.encode(), dtype=jnp.uint8)
     prompt = prompt.astype(jnp.int32)[None, :]
     n_new = min(200, T - prompt.shape[1])  # cache is sized by max_seq_len
-    out = sample(params, prompt, n_new, H, temperature=0.8, top_k=40,
+    out = sample(best_params, prompt, n_new, H, temperature=0.8, top_k=40,
                  seed=7)
     continuation = bytes(
         int(b) for b in jax.device_get(out[0])).decode(
             "utf-8", errors="replace")
 
     payload = {
-        "metric": "real_text_lm_final_holdout_loss",
-        # the HONEST headline: next-byte loss on bytes the training
-        # windows never touched (the train-distribution number and the
-        # gap are alongside, not hidden)
-        "value": curve[-1]["holdout_loss"],
+        "metric": "real_text_lm_best_holdout_loss",
+        # the headline: next-byte loss on bytes the training windows
+        # never touched, at the best-holdout checkpoint the run KEPT
+        # (the final/overfit numbers are alongside, not hidden)
+        "value": best["holdout_loss"],
         "unit": "nats/byte",
+        "best_step": int(best_step),
+        "best_train_loss": best["train_loss"],
+        "final_holdout_loss": curve[-1]["holdout_loss"],
         "final_train_loss": curve[-1]["train_loss"],
-        "generalization_gap": round(curve[-1]["holdout_loss"]
-                                    - curve[-1]["train_loss"], 4),
+        "generalization_gap": round(best["holdout_loss"]
+                                    - best["train_loss"], 4),
         "initial_holdout_loss": curve[0]["holdout_loss"],
         "uniform_loss": round(float(jnp.log(float(VOCAB))), 4),
         "loss_curve": curve,
         "corpus_bytes": int(corpus.shape[0]),
         "train_bytes": int(train_corpus.shape[0]),
         "holdout_bytes": int(holdout_corpus.shape[0]),
+        "schedule": f"warmup_cosine(peak={PEAK_LR}, "
+                    f"warmup={max(STEPS // 20, 1)}, total={STEPS})",
         "shape": f"d{D}_L{L}_H{H}_T{T}_B{B}_steps{STEPS}",
         "tokens_per_sec": round(STEPS * B * T / train_s, 1),
         "train_seconds": round(train_s, 2),
@@ -156,6 +198,8 @@ def main() -> int:
     print(json.dumps(payload))
     with open(ARTIFACT, "w") as f:
         json.dump(payload, f, indent=1)
+    if not user_dir:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
     return 0
 
 
