@@ -640,6 +640,13 @@ def main():
                     n_heads=fam_H, attn_impl=_i), tf)
         attn_win = max(by_attn, key=by_attn.get)
         sps = by_attn[attn_win]
+        # the transformer bf16 policy at the winning attn impl (the
+        # same precision axis the LM family measures)
+        tf_mixed_sps = measure(
+            lambda p, s: train_transformer_single(
+                p, s, toks, fam_d, lr=LR, seq_len=fam_T, n_heads=fam_H,
+                attn_impl=None if attn_win == "oracle" else attn_win,
+                mixed=True), tf)
         fams["transformer"] = {
             "steps_per_sec": round(sps, 4),
             "mfu": round(sps * block_flops / peak, 4),
@@ -647,8 +654,15 @@ def main():
             "attn": attn_win,
             "oracle_steps_per_sec": round(by_attn["oracle"], 4),
             "flash_steps_per_sec": round(by_attn["flash"], 4),
+            "mixed_steps_per_sec": round(tf_mixed_sps, 4),
+            "mixed_vs_f32": round(tf_mixed_sps / sps, 4),
             "shape": f"d{fam_d}_L{fam_L}_H{fam_H}_T{fam_T}_B{fam_B}",
         }
+        if tf_mixed_sps > sps:
+            fams["transformer"]["steps_per_sec"] = round(tf_mixed_sps, 4)
+            fams["transformer"]["mfu"] = round(
+                tf_mixed_sps * block_flops / peak, 4)
+            fams["transformer"]["attn"] = attn_win + "+mixed"
         del tf
 
         # The LM adds a second measured policy axis: the tied head.

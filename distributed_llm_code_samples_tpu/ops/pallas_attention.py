@@ -44,10 +44,28 @@ _Q_QUANTUM = 8
 # larger tiles only add VMEM pressure (2048x1024 fails to compile).
 # `_pick_block` caps every block at the actual T, so small/test shapes
 # are unaffected.
-_DEF_BQ = int(os.environ.get("FLASH_BLOCK_Q", 1024))
-_DEF_BK = int(os.environ.get("FLASH_BLOCK_K", 1024))
-_DEF_BWD_BQ = int(os.environ.get("FLASH_BWD_BLOCK_Q", 512))
-_DEF_BWD_BK = int(os.environ.get("FLASH_BWD_BLOCK_K", 512))
+def _env_block(name: str, default: int) -> int:
+    """Tile default read at TRACE time (not import), so an on-chip
+    sweep can vary the env between `jax.clear_caches()` points without
+    re-execing the process (the pallas_ffn sweep pattern)."""
+    v = os.environ.get(name)
+    return int(v) if v else default
+
+
+def _DEF_BQ():
+    return _env_block("FLASH_BLOCK_Q", 1024)
+
+
+def _DEF_BK():
+    return _env_block("FLASH_BLOCK_K", 1024)
+
+
+def _DEF_BWD_BQ():
+    return _env_block("FLASH_BWD_BLOCK_Q", 512)
+
+
+def _DEF_BWD_BK():
+    return _env_block("FLASH_BWD_BLOCK_K", 512)
 
 
 def _mxu(x, mxu_bf16: bool):
@@ -154,8 +172,8 @@ def flash_attention_fwd(q: jax.Array, k: jax.Array, v: jax.Array, *,
     T, dh = q.shape
     scale = 1.0 / (dh ** 0.5)
     _mxu_bf16 = _resolve_mxu_bf16(mxu_bf16, interpret)
-    bq = _pick_block(T, block_q or _DEF_BQ, _Q_QUANTUM)
-    bk = _pick_block(k.shape[0], block_k or _DEF_BK, _Q_QUANTUM)
+    bq = _pick_block(T, block_q or _DEF_BQ(), _Q_QUANTUM)
+    bk = _pick_block(k.shape[0], block_k or _DEF_BK(), _Q_QUANTUM)
     grid = (T // bq, k.shape[0] // bk)
     y, lse = pl.pallas_call(
         functools.partial(_flash_fwd_kernel, scale=scale, causal=causal,
@@ -260,8 +278,8 @@ def flash_attention_bwd(dy: jax.Array, q, k, v, y, lse, *,
     Tk = k.shape[0]
     scale = 1.0 / (dh ** 0.5)
     _mxu_bf16 = _resolve_mxu_bf16(mxu_bf16, interpret)
-    bq = _pick_block(T, block_q or _DEF_BWD_BQ, _Q_QUANTUM)
-    bk = _pick_block(Tk, block_k or _DEF_BWD_BK, _Q_QUANTUM)
+    bq = _pick_block(T, block_q or _DEF_BWD_BQ(), _Q_QUANTUM)
+    bk = _pick_block(Tk, block_k or _DEF_BWD_BK(), _Q_QUANTUM)
     # D_i = rowsum(dy * y): the only softmax statistic the tiles can't
     # rebuild locally; elementwise, computed once outside the kernels
     d = jnp.sum(dy.astype(jnp.float32) * y.astype(jnp.float32),

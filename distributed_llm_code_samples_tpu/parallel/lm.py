@@ -217,11 +217,14 @@ def train_lm_ddp(params: LMParams, seeds, batch_size: int, model_size: int,
                  mesh, lr: float = LR, *, seq_len: int, n_heads: int,
                  attn_impl: str | None = None, optimizer=None,
                  opt_state=None, return_state: bool = False,
-                 head_impl: str | None = None):
+                 head_impl: str | None = None, mixed: bool = False):
     """DDP: replicated params, strided seeds, grads summed per step.
     ``optimizer`` threads replicated state (the ``ddp.py`` contract).
     ``head_impl="fused"`` swaps the tied head + xent for the fused
-    Pallas kernels (``ops/pallas_xent.py``) per shard."""
+    Pallas kernels (``ops/pallas_xent.py``) per shard. ``mixed`` runs
+    each shard's step under the LM bf16 policy (bf16 trunk, f32
+    head/grads — grads stay f32, so the psum semantics are unchanged
+    and the DDP==FSDP==single differentials hold in mixed mode)."""
     require_axes(mesh, DATA_AXIS)
     _validate_lm(batch_size, seq_len, model_size, n_heads, params)
     check_state_args(optimizer, opt_state, return_state)
@@ -231,7 +234,7 @@ def train_lm_ddp(params: LMParams, seeds, batch_size: int, model_size: int,
     step = _make_step(batch_size, model_size, seq_len, n_heads, lr,
                       resolve_attn(attn_impl), reduce_axes=(DATA_AXIS,),
                       optimizer=optimizer, head=resolve_head(head_impl),
-                      force_reduce=not check)
+                      force_reduce=not check, mixed=mixed)
     if optimizer is None:
         return launch_strided(step, clone_params(params), seeds, mesh,
                               DATA_AXIS, P(), check_vma=check)
@@ -245,7 +248,7 @@ def train_lm_fsdp(params: LMParams, seeds, batch_size: int, model_size: int,
                   mesh, lr: float = LR, *, seq_len: int, n_heads: int,
                   attn_impl: str | None = None, optimizer=None,
                   opt_state=None, return_state: bool = False,
-                  head_impl: str | None = None):
+                  head_impl: str | None = None, mixed: bool = False):
     """FSDP/ZeRO-3 over the whole LM surface: block stacks gathered layer
     by layer (the transformer FSDP loop), the embedding/head table and
     positions gathered once per step — transiently, so peak param memory
@@ -255,7 +258,14 @@ def train_lm_fsdp(params: LMParams, seeds, batch_size: int, model_size: int,
     With ``optimizer``, its state is created from — and lives as — the
     LOCAL param shards: full ZeRO-3 on the LM (params, grads, AND
     optimizer state all 1/n per device; the elementwise update needs no
-    collective)."""
+    collective).
+
+    ``mixed`` (the LM bf16 policy): block shards are cast to bf16
+    BEFORE their per-layer gathers — half the collective bytes, the
+    FFN-FSDP mixed stance — and the trunk runs bf16; ``wte`` gathers
+    once in f32 (it serves the f32 head) with the embedding lookup cast
+    after, so the math matches ``lm_loss(mixed=True)`` leaf for leaf
+    and the FSDP==DDP==single differentials keep their power."""
     require_axes(mesh, DATA_AXIS)
     n = mesh.shape[DATA_AXIS]
     _validate_lm(batch_size, seq_len, model_size, n_heads, params)
@@ -278,20 +288,35 @@ def train_lm_fsdp(params: LMParams, seeds, batch_size: int, model_size: int,
         tokens, targets = lm_batch_from_seed(seed, b, seq_len, vocab)
 
         def loss_fn(p: LMParams):
+            bf16 = jnp.bfloat16
             wte = all_gather(p.wte, DATA_AXIS, dim=0)
             wpe = all_gather(p.wpe, DATA_AXIS, dim=0)
             ln_f = all_gather(p.ln_f, DATA_AXIS, dim=0)
-            x = wte[tokens] + wpe[:seq_len]
+            if mixed:
+                # trunk in bf16 (embedding lookup + positions cast
+                # after the f32 wte gather — wte also serves the f32
+                # head); ln_f cast matches lm_loss(mixed=True)
+                x = wte.astype(bf16)[tokens] + wpe[:seq_len].astype(bf16)
+                ln_f = ln_f.astype(bf16)
+            else:
+                x = wte[tokens] + wpe[:seq_len]
             for l in range(p.blocks.w1.shape[0]):
-                full = (all_gather(leaf[l], DATA_AXIS, dim=0)
+                # mixed: shards cast BEFORE the gather — half the
+                # collective bytes (the FFN-FSDP mixed stance); cast of
+                # the shard then concat == concat then cast, so the
+                # values equal the single-device bf16 trunk's
+                full = (all_gather(leaf[l].astype(bf16) if mixed
+                                   else leaf[l], DATA_AXIS, dim=0)
                         for leaf in p.blocks)
                 x = transformer_block(*full, x, n_heads, causal=True,
                                       attn=attn)
             h = layernorm(ln_f, x)
+            if mixed:
+                h = h.astype(jnp.float32)
             if head is not None:
                 return head(h.reshape(-1, h.shape[-1]), wte,
                             targets.reshape(-1))
-            logits = h @ wte.T
+            logits = h.reshape(-1, h.shape[-1]) @ wte.T
             return xent_loss(logits.reshape(-1, wte.shape[0]),
                              targets.reshape(-1))
 

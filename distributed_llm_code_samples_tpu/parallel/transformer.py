@@ -132,10 +132,26 @@ def resolve_attn(attn_impl: str | None):
 
 def _make_single_step(tokens: int, model_size: int, seq_len: int,
                       n_heads: int, lr: float, causal: bool = True,
-                      attn=None):
+                      attn=None, mixed: bool = False):
     def step(params: TransformerParams, seed) -> TransformerParams:
         x, dloss_dx = _reshape_batch(seed, tokens, seq_len, model_size,
                                      params.w1.dtype)
+        if mixed:
+            # the LM family's bf16 stance (models.lm.lm_loss(mixed=)),
+            # head-less: bf16 params + activations through the blocks,
+            # f32 master params/grads/update — the cotangent enters in
+            # bf16 (the fwd output's dtype) and the grads come back f32
+            # through the cast transposes
+            xm = x.astype(jnp.bfloat16)
+
+            def fwd(p):
+                pc = jax.tree_util.tree_map(
+                    lambda a: a.astype(jnp.bfloat16), p)
+                return transformer_fwd(pc, xm, n_heads, causal, attn)
+
+            _, vjp = jax.vjp(fwd, params)
+            return sgd(params,
+                       vjp(dloss_dx.astype(jnp.bfloat16))[0], lr)
         _, vjp = jax.vjp(
             lambda p: transformer_fwd(p, x, n_heads, causal, attn), params)
         return sgd(params, vjp(dloss_dx)[0], lr)
@@ -143,14 +159,14 @@ def _make_single_step(tokens: int, model_size: int, seq_len: int,
     return step
 
 
-@partial(jax.jit, static_argnums=tuple(range(2, 9)), donate_argnums=0)
+@partial(jax.jit, static_argnums=tuple(range(2, 10)), donate_argnums=0)
 def _run_single(params, seeds, batch_size, model_size, lr, seq_len,
-                n_heads, causal, attn_impl):
+                n_heads, causal, attn_impl, mixed=False):
     """Module-level jit (the ``single.py`` pattern): repeat calls with the
     same static config reuse the compiled program instead of re-tracing —
     load-bearing for the bench's best-of-N timing loops."""
     step = _make_single_step(batch_size, model_size, seq_len, n_heads, lr,
-                             causal, resolve_attn(attn_impl))
+                             causal, resolve_attn(attn_impl), mixed)
     return lax.scan(lambda p, s: (step(p, s), None), params, seeds)[0]
 
 
@@ -158,15 +174,17 @@ def train_transformer_single(params: TransformerParams, seeds,
                              batch_size: int, model_size: int, mesh=None,
                              lr: float = LR, *, seq_len: int, n_heads: int,
                              causal: bool = True,
-                             attn_impl: str | None = None
+                             attn_impl: str | None = None,
+                             mixed: bool = False
                              ) -> TransformerParams:
     """Single-device trainer; ``batch_size`` is tokens/step (seq folded,
     CLI convention ``train_ffns.py:379``), unfolded to
-    ``[batch_size/seq_len, seq_len, d]`` for attention."""
+    ``[batch_size/seq_len, seq_len, d]`` for attention. ``mixed`` runs
+    the blocks in bf16 with f32 master params/grads/update."""
     _validate_shapes(batch_size, seq_len, model_size, n_heads)
     return _run_single(clone_params(params), jnp.asarray(seeds),
                        batch_size, model_size, lr, seq_len, n_heads,
-                       causal, attn_impl)
+                       causal, attn_impl, mixed)
 
 
 def train_transformer_ddp(params: TransformerParams, seeds, batch_size: int,

@@ -59,8 +59,10 @@ def test_bench_emits_driver_contract():
     assert isinstance(fams, dict) and "transformer" in fams and "lm" in fams
     # the measured policy grids must ship: transformer oracle-vs-flash,
     # LM 2x2 attn x head (winner + full grid recorded)
-    assert fams["transformer"]["attn"] in ("oracle", "flash")
+    assert fams["transformer"]["attn"].removesuffix("+mixed") in (
+        "oracle", "flash")
     assert isinstance(fams["transformer"]["flash_steps_per_sec"], float)
+    assert isinstance(fams["transformer"]["mixed_vs_f32"], float)
     assert set(fams["lm"]["by_policy"]) == {
         "oracle+oracle", "oracle+fused", "flash+oracle", "flash+fused"}
     assert (fams["lm"]["policy"] in fams["lm"]["by_policy"]
