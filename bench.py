@@ -84,19 +84,34 @@ import glob
 import json
 import os
 import signal
-import subprocess
 import sys
+import tempfile
 import threading
 import time
 import traceback
 
 # --- PYTHONPATH scrub: MUST precede `import jax` (see module docstring).
-# A populated PYTHONPATH shadows the axon TPU plugin discovery; the
-# environment the driver runs us under may set it even though local runs
-# don't. Re-exec with the cleaned environment so the interpreter's
-# already-built sys.path is rebuilt too.
-if os.environ.pop("PYTHONPATH", None) is not None:
-    os.execve(sys.executable, [sys.executable] + sys.argv, os.environ)
+# SURGICAL, not wholesale (VERDICT r5 "What's missing" #2): only the repo
+# root is dropped — PYTHONPATH=/root/repo shadows the axon TPU plugin
+# discovery, but OTHER entries may be what registers the plugin in the
+# first place (the sitecustomize path), and the r5 wholesale scrub is the
+# prime suspect for de-registering the backend for a full round. Re-exec
+# with the cleaned environment so the interpreter's already-built
+# sys.path is rebuilt too. Anything beyond this one known-bad entry is
+# the env-matrix probe's job (runtime/backend_probe.py), decided by
+# evidence, not assumption.
+_pp = os.environ.get("PYTHONPATH")
+if _pp is not None:
+    _repo = os.path.dirname(os.path.abspath(__file__))
+    _scrubbed = os.pathsep.join(
+        e for e in _pp.split(os.pathsep)
+        if e and os.path.abspath(e) != _repo)
+    if _scrubbed != _pp:
+        if _scrubbed:
+            os.environ["PYTHONPATH"] = _scrubbed
+        else:
+            del os.environ["PYTHONPATH"]
+        os.execve(sys.executable, [sys.executable] + sys.argv, os.environ)
 
 import jax
 import jax.numpy as jnp
@@ -119,6 +134,15 @@ _DEADLINE_VAR = "BENCH_DEADLINE"
 if _DEADLINE_VAR not in os.environ:
     os.environ[_DEADLINE_VAR] = str(time.time() + WAIT_BUDGET)
 _DEADLINE = float(os.environ[_DEADLINE_VAR])
+# Env-matrix probe bookkeeping (VERDICT r5 #1): every probe round's full
+# (env_shape, exception_head) matrix is persisted here so it survives
+# re-execs and can be embedded in whatever artifact this run emits. The
+# winning shape's name rides along in BENCH_ENV_SHAPE.
+_PROBE_LOG_VAR = "BENCH_PROBE_LOG"
+_ENV_SHAPE_VAR = "BENCH_ENV_SHAPE"
+if _PROBE_LOG_VAR not in os.environ:
+    os.environ[_PROBE_LOG_VAR] = os.path.join(
+        tempfile.gettempdir(), f"bench_probe_matrix_{os.getpid()}.json")
 
 if os.environ.get("BENCH_PLATFORM"):
     jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
@@ -194,22 +218,34 @@ def _last_measured():
 
 def _fallback_payload(reason: str):
     """Never-0.0 diagnostic: last measured values + provenance, or the
-    bare 0.0 diagnostic only when no measured artifact exists at all."""
+    bare 0.0 diagnostic only when no measured artifact exists at all.
+    Either way the payload embeds the final env-matrix probe round
+    (``probe_matrix``: one ``(shape, ok, error-head)`` record per env
+    shape) so the NEXT outage is diagnosable from the JSON alone —
+    four identical heads = relay dead; one shape fine = we broke our
+    own env, and the matrix names the fix (VERDICT r5 #1)."""
     found = _last_measured()
     if found is None:
-        return {
+        payload = {
             "metric": _metric_name(),
             "value": 0.0,
             "unit": "steps/s",
             "vs_baseline": 0.0,
             "error": reason,
         }
-    name, data = found
-    payload = dict(data)
-    payload["provenance"] = (
-        f"relay outage during this run; values are the last measured "
-        f"on-chip artifact ({name}, committed in-repo)")
-    payload["error"] = reason
+    else:
+        name, data = found
+        payload = dict(data)
+        payload["provenance"] = (
+            f"relay outage during this run; values are the last measured "
+            f"on-chip artifact ({name}, committed in-repo)")
+        payload["error"] = reason
+    doc = _probe_doc()
+    payload["probe_matrix"] = doc.get("last_matrix", [])
+    if doc:
+        payload["probe_rounds"] = doc.get("rounds")
+    if os.environ.get(_ENV_SHAPE_VAR):
+        payload["env_shape"] = os.environ[_ENV_SHAPE_VAR]
     return payload
 
 
@@ -235,51 +271,96 @@ def _install_kill_hedge():
             pass
 
 
-def _probe_backend_subprocess(timeout_s: float = 150) -> bool:
-    """Ask a FRESH interpreter whether the backend answers — a hung or
-    failed init there cannot poison this process's jax state. Unless
+def _probe_module():
+    """Lazy import: bench.py sits in the repo root, so the package
+    resolves via sys.path[0] without PYTHONPATH."""
+    from distributed_llm_code_samples_tpu.runtime import backend_probe
+    return backend_probe
+
+
+def _record_probe_round(winner, matrix) -> None:
+    path = os.environ[_PROBE_LOG_VAR]
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except Exception:  # noqa: BLE001 — first round or torn file
+        doc = {}
+    doc["rounds"] = doc.get("rounds", 0) + 1
+    doc["winner"] = winner
+    doc["last_matrix"] = matrix
+    try:
+        with open(path, "w") as f:
+            json.dump(doc, f)
+    except OSError:
+        pass  # diagnosis bookkeeping must never kill the bench
+
+
+def _probe_doc() -> dict:
+    try:
+        with open(os.environ[_PROBE_LOG_VAR]) as f:
+            return json.load(f)
+    except Exception:  # noqa: BLE001
+        return {}
+
+
+def _probe_env_matrix():
+    """One env-matrix probe round (runtime/backend_probe.py): ask a
+    FRESH interpreter per env shape whether the backend answers — a hung
+    or failed init there cannot poison this process's jax state. Unless
     BENCH_PLATFORM overrides (smoke tests), the probe demands a real
     TPU: a CPU-fallback success here would re-exec into a CPU
-    measurement recorded as hardware."""
-    if os.environ.get("BENCH_PLATFORM"):
-        code = ("import jax; d = jax.devices(); "
-                "import sys; sys.exit(0 if d else 1)")
-    else:
-        code = ("import jax; "
-                "assert jax.devices()[0].platform == 'tpu'")
-    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", code], env=env, timeout=timeout_s,
-            cwd=os.path.dirname(os.path.abspath(__file__)),
-            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
-        return r.returncode == 0
-    except Exception:  # noqa: BLE001
-        return False
+    measurement recorded as hardware. Returns the winning shape name or
+    None; the full per-shape (env_shape, exception_head) matrix is
+    persisted for artifact embedding either way."""
+    probe = _probe_module()
+    require = "any" if os.environ.get("BENCH_PLATFORM") else "tpu"
+    timeout = float(os.environ.get("BENCH_PROBE_SHAPE_TIMEOUT", 150))
+    winner, matrix = probe.probe_matrix(timeout_s=timeout, require=require)
+    _record_probe_round(winner, matrix)
+    for rec in matrix:
+        status = (f"OK ({rec['platform']})" if rec["ok"]
+                  else rec["error"])
+        print(f"bench: probe[{rec['shape']}]: {status}", file=sys.stderr)
+    sys.stderr.flush()
+    return winner
 
 
 def _wait_for_relay_then_reexec(context: str):
     """The outage path: keep the process alive on cheap subprocess
-    probes until the relay answers, then re-exec for a fresh backend.
+    probe rounds until SOME env shape yields a working backend, then
+    re-exec INTO that shape's environment for a fresh backend. At least
+    one full matrix always runs before the deadline check, so even a
+    spent-budget fallback artifact carries every shape's exception head.
     Exits with the fallback payload when the deadline passes."""
     while True:
+        winner = _probe_env_matrix()
         remaining = _DEADLINE - time.time()
+        if winner is not None:
+            if remaining <= 0:
+                # a FLAPPING relay (probe green, init dead, repeat) must
+                # not loop past the budget: the deadline rides the env
+                # across re-execs, so it gates the re-exec too
+                _bail_with_fallback(
+                    f"relay flapping outlasted BENCH_WAIT_BUDGET "
+                    f"({WAIT_BUDGET:.0f}s): probe shape '{winner}' "
+                    f"answers but measurement keeps dying (matrix "
+                    f"embedded): {context}")
+            print(f"bench: env shape '{winner}' answered; re-execing "
+                  "into it for a fresh backend", file=sys.stderr)
+            sys.stderr.flush()
+            env = _probe_module().build_env(winner)
+            env.pop(_ATTEMPT_VAR, None)  # fresh attempt budget
+            env[_ENV_SHAPE_VAR] = winner
+            os.execve(sys.executable, [sys.executable] + sys.argv, env)
         if remaining <= 0:
             _bail_with_fallback(
                 f"relay outage outlasted BENCH_WAIT_BUDGET "
-                f"({WAIT_BUDGET:.0f}s): {context}")
+                f"({WAIT_BUDGET:.0f}s); every probed env shape failed "
+                f"(matrix embedded): {context}")
         print(f"bench: waiting for relay ({context}); probing every "
               f"{PROBE_INTERVAL:.0f}s, {remaining / 60:.0f} min of budget "
               f"left", file=sys.stderr)
         sys.stderr.flush()
-        if _probe_backend_subprocess():
-            print("bench: relay answered; re-execing for a fresh backend",
-                  file=sys.stderr)
-            sys.stderr.flush()
-            os.environ.pop(_ATTEMPT_VAR, None)  # fresh attempt budget
-            env = {k: v for k, v in os.environ.items()
-                   if k != "PYTHONPATH"}
-            os.execve(sys.executable, [sys.executable] + sys.argv, env)
         time.sleep(min(PROBE_INTERVAL, max(remaining, 1)))
 
 
@@ -471,6 +552,10 @@ def main():
     }
     if peak_assumed:
         payload["peak_assumed"] = True
+    if os.environ.get(_ENV_SHAPE_VAR):
+        # this measurement only exists because the probe matrix found a
+        # working env shape mid-outage — record which one
+        payload["env_shape"] = os.environ[_ENV_SHAPE_VAR]
 
     run_guard.cancel()
 

@@ -16,6 +16,15 @@ atomic: staged into ``step_{N}.tmp`` and ``os.rename``d, so ``latest_step``
 never sees a torn checkpoint (a crash mid-write leaves only a ``.tmp``
 directory, which restore ignores and the next save overwrites).
 
+The publish path is crash-safe beyond rename atomicity (the CheckFreq
+posture): array payloads are fsync'd and carry per-file CRC-32 checksums
+in ``meta.json`` (npz: the container file; native: each leaf's ``.raw``
+bytes), the staging dir and parent are fsync'd around the rename, and a
+restore with ``step=None`` falls back to the NEWEST checkpoint that
+*verifies* (``latest_verified_step``) — a truncated or bit-rotted latest
+step costs one segment of recompute, never the run. ``keep_last`` bounds
+the directory to the most recent k published steps.
+
 Sharding-aware: ``save_checkpoint`` accepts arrays living on any
 single-process sharding (``np.asarray`` assembles fully-addressable shards);
 ``restore_checkpoint`` takes an optional ``shardings`` pytree and
@@ -44,12 +53,22 @@ import json
 import os
 import re
 import shutil
+import sys
+import zlib
 from typing import Any
 
 import jax
 import numpy as np
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+class CorruptCheckpointError(RuntimeError):
+    """An explicitly-requested checkpoint failed integrity verification."""
+
+
+class NonFiniteParamsError(RuntimeError):
+    """A training segment produced non-finite params (poisoned step)."""
 
 _ASYNC_WRITER = None
 _ERRORS_SEEN = 0  # errors already reported by a previous wait_pending
@@ -101,17 +120,21 @@ def _sync(tag: str) -> None:
 
 
 def _agreed_latest_step(ckpt_dir: str) -> int | None:
-    """``latest_step`` as decided by the primary and broadcast, so every
-    process takes the same resume-vs-restart branch. A divergent local
-    view (per-host disk, NFS attribute-cache lag) would otherwise send
-    processes to mismatched ``_sync`` barriers — a hang, not an error."""
-    step = latest_step(ckpt_dir)
+    """Latest *verified* step as decided by the primary and broadcast, so
+    every process takes the same resume-vs-restart branch. A divergent
+    local view (per-host disk, NFS attribute-cache lag) would otherwise
+    send processes to mismatched ``_sync`` barriers — a hang, not an
+    error. Verification on the primary keeps the agreement anchored on a
+    checkpoint everyone can actually restore."""
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
+        # only the primary pays the CRC scan; broadcast discards every
+        # other process's answer anyway, so peers contribute a placeholder
+        step = latest_verified_step(ckpt_dir) if _primary() else None
         step = int(multihost_utils.broadcast_one_to_all(
             np.int32(-1 if step is None else step)))
-        step = None if step < 0 else step
-    return step
+        return None if step < 0 else step
+    return latest_verified_step(ckpt_dir)
 
 
 def _np_dtype(name: str) -> np.dtype:
@@ -165,6 +188,74 @@ def _flatten(tree):
     return names, leaves, treedef
 
 
+def _crc_file(path: str, chunk: int = 1 << 20) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while block := f.read(chunk):
+            crc = zlib.crc32(block, crc)
+    return crc
+
+
+def _fsync_file(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY | getattr(os, "O_DIRECTORY", 0))
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass  # some filesystems refuse directory fsync; rename still atomic
+    finally:
+        os.close(fd)
+
+
+def verify_checkpoint(path: str) -> tuple[bool, str]:
+    """Integrity-check one published ``step_{N}`` dir: ``meta.json``
+    parses and every checksummed payload file matches its recorded
+    CRC-32. Checkpoints written before checksums existed (no
+    ``checksums`` key) verify by file presence alone."""
+    try:
+        with open(os.path.join(path, "meta.json")) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return False, f"meta.json unreadable: {type(e).__name__}: {e}"
+    for fname, want in doc.get("checksums", {}).items():
+        fpath = os.path.join(path, fname)
+        if not os.path.exists(fpath):
+            return False, f"{fname} missing"
+        got = _crc_file(fpath)
+        if got != want:
+            return False, (f"{fname} checksum mismatch "
+                           f"(crc32 {got:#010x} != recorded {want:#010x})")
+    if doc.get("backend", "npz") == "npz" and "checksums" not in doc \
+            and not os.path.exists(os.path.join(path, "arrays.npz")):
+        return False, "arrays.npz missing"
+    return True, "ok"
+
+
+def latest_verified_step(ckpt_dir: str) -> int | None:
+    """Highest published step that passes ``verify_checkpoint`` — the
+    resume anchor. Corrupt steps are skipped (with a stderr note naming
+    the damage) instead of failing the restore: recovery falls back to
+    the newest checkpoint that still verifies."""
+    if not os.path.isdir(ckpt_dir):
+        return None
+    steps = sorted((int(m.group(1)) for name in os.listdir(ckpt_dir)
+                    if (m := _STEP_RE.match(name))), reverse=True)
+    for step in steps:
+        ok, reason = verify_checkpoint(os.path.join(ckpt_dir, f"step_{step}"))
+        if ok:
+            return step
+        print(f"checkpoint: step_{step} failed verification ({reason}); "
+              "falling back to an earlier step", file=sys.stderr)
+    return None
+
+
 def save_checkpoint(ckpt_dir: str, params: Any, step: int, seeds=None,
                     meta: dict | None = None, backend: str = "npz") -> str:
     """Write ``step_{step}/`` atomically; returns the final path.
@@ -207,6 +298,9 @@ def save_checkpoint(ckpt_dir: str, params: Any, step: int, seeds=None,
         os.makedirs(tmp)
     _sync(f"staged-{step}")  # tmp dir visible to all before collective I/O
 
+    checksums = None  # per-file CRC-32 (primary-only; orbax opts out —
+    #                   its own format carries internal integrity state)
+    host_bufs = None
     if backend == "orbax":
         import orbax.checkpoint as ocp
         ckptr = ocp.PyTreeCheckpointer()
@@ -214,21 +308,35 @@ def save_checkpoint(ckpt_dir: str, params: Any, step: int, seeds=None,
         ckptr.save(os.path.join(os.path.abspath(tmp), "arrays"),
                    jax.tree_util.tree_map(_ensure_global_fn(), params))
     elif backend != "native" and _primary():
-        np.savez(os.path.join(tmp, "arrays.npz"),
-                 **{n: _to_numpy(l) for n, l in zip(names, leaves)})
+        npz_path = os.path.join(tmp, "arrays.npz")
+        np.savez(npz_path, **{n: _to_numpy(l)
+                              for n, l in zip(names, leaves)})
+        _fsync_file(npz_path)  # durable BEFORE the publishing rename
+        checksums = {"arrays.npz": _crc_file(npz_path)}
+    elif backend == "native" and _primary():
+        # checksum the buffers the async worker will write: the bytes on
+        # disk must equal these or the restore-side verify rejects them
+        host_bufs = [np.ascontiguousarray(_to_numpy(l)) for l in leaves]
+        checksums = {n + ".raw": zlib.crc32(b.tobytes())
+                     for n, b in zip(names, host_bufs)}
     # metadata from array attributes only — no host fetch (multi-host arrays
     # are not fully addressable; orbax handles their device I/O above)
     doc = {"step": int(step), "backend": backend, "leaf_names": names,
            "leaf_shapes": [list(np.shape(l)) for l in leaves],
            "leaf_dtypes": [np.dtype(getattr(l, "dtype", type(l))).name
                            for l in leaves]}
+    if checksums is not None:
+        doc["checksums"] = checksums
     if seeds is not None:
         doc["seeds"] = np.asarray(seeds).tolist()
     if meta:
         doc["meta"] = meta
     if _primary():
-        with open(os.path.join(tmp, "meta.json"), "w") as f:
+        meta_path = os.path.join(tmp, "meta.json")
+        with open(meta_path, "w") as f:
             json.dump(doc, f)
+            f.flush()
+            os.fsync(f.fileno())
         if backend == "native":
             # async: the native worker pool copies the buffers now, writes
             # the .raw leaves and atomically renames tmp -> final off this
@@ -237,13 +345,13 @@ def save_checkpoint(ckpt_dir: str, params: Any, step: int, seeds=None,
             # (brief no-version window; distinct steps are unaffected).
             if os.path.exists(final):
                 shutil.rmtree(final)
-            _writer().submit(tmp, final, names,
-                             [_to_numpy(l) for l in leaves])
+            _writer().submit(tmp, final, names, host_bufs)
             if jax.process_count() > 1:
                 # peers read the step right after the barrier; asynchrony
                 # is a single-host feature
                 wait_pending()
         else:
+            _fsync_dir(tmp)  # entries durable before they become visible
             old = None
             if os.path.exists(final):
                 # keep the previous version valid until the new one is
@@ -254,6 +362,7 @@ def save_checkpoint(ckpt_dir: str, params: Any, step: int, seeds=None,
                     shutil.rmtree(old)
                 os.rename(final, old)
             os.rename(tmp, final)  # atomic publish
+            _fsync_dir(ckpt_dir)   # the rename itself survives a crash
             if old is not None:
                 shutil.rmtree(old)
     _sync(f"published-{step}")  # no process proceeds past an unpublished step
@@ -270,20 +379,34 @@ def latest_step(ckpt_dir: str) -> int | None:
 
 
 def restore_checkpoint(ckpt_dir: str, target: Any, step: int | None = None,
-                       shardings: Any = None):
+                       shardings: Any = None, verify: bool = True):
     """Restore ``(params, step, seeds)``.
 
     ``target`` is an example pytree (same structure/dtypes as saved — e.g.
     the freshly-initialized params) used to rebuild the tree. ``shardings``,
     if given, is a matching pytree (or single sharding) of placements; each
-    leaf is ``device_put`` directly onto it.
+    leaf is ``device_put`` directly onto it. ``verify=False`` skips the
+    CRC pass for a step the caller has ALREADY verified (the resume path:
+    ``latest_verified_step`` just read every payload byte — re-reading a
+    multi-GB checkpoint to re-checksum it doubles the restore I/O, and on
+    multi-host it would re-run per-host verification of a step the
+    primary's broadcast already anchored).
     """
     wait_pending()  # a native-backend save from this process may be in flight
     if step is None:
-        step = latest_step(ckpt_dir)
+        # fall back to the newest checkpoint that VERIFIES: a torn or
+        # bit-rotted latest step must cost a segment, not the run
+        step = latest_verified_step(ckpt_dir)
         if step is None:
-            raise FileNotFoundError(f"no checkpoint under {ckpt_dir}")
+            raise FileNotFoundError(
+                f"no verified checkpoint under {ckpt_dir}")
+        verify = False  # just verified, byte for byte
     path = os.path.join(ckpt_dir, f"step_{step}")
+    if verify:
+        ok, reason = verify_checkpoint(path)
+        if not ok:
+            # an explicitly-requested step never falls back silently
+            raise CorruptCheckpointError(f"{path}: {reason}")
     with open(os.path.join(path, "meta.json")) as f:
         doc = json.load(f)
 
@@ -349,12 +472,51 @@ def restore_checkpoint(ckpt_dir: str, target: Any, step: int | None = None,
     return params, int(doc["step"]), seeds
 
 
+def _leaf_finite(leaf) -> bool:
+    if hasattr(leaf, "is_fully_addressable") and not leaf.is_fully_addressable:
+        # multi-host shard: np.asarray would raise — each process checks
+        # the shards it can see; the guard still catches the poison
+        # wherever it lives (non-finite grads replicate through psums)
+        return all(_leaf_finite(s.data) for s in leaf.addressable_shards)
+    arr = np.asarray(leaf)
+    if arr.dtype.kind in "iub":
+        return True  # integer state (Adam counts, seeds) is always finite
+    if arr.dtype.kind not in "fc":  # ml_dtypes extension types (bf16, fp8)
+        arr = np.asarray(leaf, np.float32)
+    return bool(np.all(np.isfinite(arr)))
+
+
+def tree_finite(tree) -> bool:
+    """True iff every floating leaf of the pytree is free of NaN/Inf."""
+    return all(_leaf_finite(l) for l in jax.tree_util.tree_leaves(tree))
+
+
+def _emit_event(on_event, payload: dict) -> None:
+    if on_event is not None:
+        try:
+            on_event(payload)
+        except Exception:  # noqa: BLE001 — observability never kills a run
+            pass
+
+
+def _prune_old_steps(ckpt_dir: str, keep_last: int) -> None:
+    """Drop all but the newest ``keep_last`` published steps (primary
+    only; callers barrier afterwards in multi-host runs)."""
+    steps = sorted(int(m.group(1)) for name in os.listdir(ckpt_dir)
+                   if (m := _STEP_RE.match(name)))
+    for step in steps[:-keep_last] if keep_last > 0 else []:
+        shutil.rmtree(os.path.join(ckpt_dir, f"step_{step}"),
+                      ignore_errors=True)
+
+
 def run_with_checkpointing(train_fn, params, seeds, *args,
                            ckpt_dir: str, every: int = 0, resume: bool = True,
                            backend: str = "npz", seeds_divisor: int = 1,
                            stateful: bool = False, optimizer=None,
                            thread_state: bool | None = None,
-                           restore_shardings=None, **kwargs):
+                           restore_shardings=None, chaos=None,
+                           nonfinite: str | None = None, keep_last: int = 0,
+                           on_event=None, **kwargs):
     """Drive any strategy launcher (uniform L4 signature,
     ``fn(params, seeds, batch, d, **kw)``) with periodic checkpointing.
 
@@ -374,6 +536,18 @@ def run_with_checkpointing(train_fn, params, seeds, *args,
     ``train_ffns.py:182`` semantics) — pass it as ``seeds_divisor`` so a
     bad value fails *here*, up front, instead of as a divisibility assert
     deep inside the strategy (possibly after a restore mid-run).
+
+    Resilience hooks (``runtime/chaos.py`` + ``runtime/failure.py``):
+    ``chaos`` is a ``FaultPlan`` whose in-segment faults wrap ``train_fn``
+    and whose publish faults fire after each ``save_checkpoint``;
+    ``nonfinite`` arms the poisoned-step guard — ``"skip"`` reverts to the
+    pre-segment state and advances past the segment WITHOUT checkpointing
+    the non-finite params (a later restart may legitimately retrain those
+    steps from the last checkpoint — if the poison was transient they
+    then apply cleanly), ``"raise"`` raises ``NonFiniteParamsError`` for a
+    supervisor to turn into a restart; ``keep_last`` keeps only the
+    newest k published steps (0 = keep all); ``on_event`` receives one
+    dict per noteworthy recovery event (structured logging).
     """
     seeds = np.asarray(seeds)
     if seeds_divisor > 1:
@@ -418,8 +592,13 @@ def run_with_checkpointing(train_fn, params, seeds, *args,
         # mesh layout (FSDP's 1/n shards, fsdp.checkpoint_shardings) —
         # without it a big resume materializes params + full Adam state
         # replicated on one device, the spike FSDP exists to avoid
+        # verify=False: the agreed step was verified by
+        # _agreed_latest_step (on the primary, whose broadcast anchors
+        # every process) — re-checksumming here would double the restore
+        # I/O and re-introduce per-host verification divergence
         tree, start, saved = restore_checkpoint(
-            ckpt_dir, tree, step=agreed, shardings=restore_shardings)
+            ckpt_dir, tree, step=agreed, shardings=restore_shardings,
+            verify=False)
         if optimizer is not None:
             params, opt_state = tree
         else:
@@ -443,21 +622,72 @@ def run_with_checkpointing(train_fn, params, seeds, *args,
         save_checkpoint(ckpt_dir, tree, 0, seeds, backend=backend)
     total = len(seeds)
     chunk = every if every > 0 else total
+    if chaos is not None:
+        # publish faults only fire ON a publish boundary; an off-boundary
+        # step would silently never inject (the operator would believe
+        # torn-checkpoint recovery was exercised when nothing happened)
+        for f in getattr(chaos, "faults", ()):
+            if f.kind in ("corrupt_ckpt", "kill") and (
+                    f.step > total
+                    or (f.step % chunk and f.step != total)):
+                raise ValueError(
+                    f"--chaos {f.kind}@{f.step} can never fire: publish "
+                    f"faults key on checkpoint publishes, which happen "
+                    f"at steps {chunk}, {2 * chunk}, ... {total} "
+                    f"(every={every}, {total} steps)")
     while start < total:
         n = min(chunk, total - start)
+        fn = train_fn
+        if chaos is not None:
+            chaos.begin_segment(start, n)
+            fn = chaos.wrap(train_fn)
         if optimizer is not None:
-            params, opt_state = train_fn(
+            new_params, new_opt = fn(
                 params, seeds[start:start + n], *args, optimizer=optimizer,
                 opt_state=opt_state, return_state=True, **kwargs)
-            tree = (params, opt_state)
+            tree = (new_params, new_opt)
         else:
-            params = train_fn(params, seeds[start:start + n], *args,
-                              **kwargs)
-            tree = params
+            new_params = fn(params, seeds[start:start + n], *args,
+                            **kwargs)
+            new_opt = None
+            tree = new_params
         jax.block_until_ready(tree)
+        if nonfinite and not tree_finite(tree):
+            if nonfinite == "raise":
+                raise NonFiniteParamsError(
+                    f"non-finite params after steps "
+                    f"{start + 1}..{start + n}")
+            # skip: the poisoned step is never checkpointed; params stay
+            # at the pre-segment state and the schedule advances past it
+            print(f"checkpoint: non-finite params after steps "
+                  f"{start + 1}..{start + n}; skipping the poisoned "
+                  "segment (not checkpointed)", file=sys.stderr)
+            _emit_event(on_event, {"event": "nonfinite_skip",
+                                   "steps": [start + 1, start + n]})
+            start += n
+            continue
+        params = new_params
+        if optimizer is not None:
+            opt_state = new_opt
         start += n
         # with backend="native" this returns immediately (buffers copied);
         # the next segment's training overlaps the disk write
-        save_checkpoint(ckpt_dir, tree, start, seeds, backend=backend)
+        path = save_checkpoint(ckpt_dir, tree, start, seeds,
+                               backend=backend)
+        # one event per published segment: structured progress for the
+        # supervisor's log AND its hang-detector re-arm (failure.py)
+        _emit_event(on_event, {"event": "published", "step": start,
+                               "steps": [start - n + 1, start]})
+        if keep_last > 0:
+            if _primary():
+                _prune_old_steps(ckpt_dir, keep_last)
+            _sync(f"pruned-{start}")
+        if chaos is not None:
+            wait_pending()  # publish faults need the async write landed
+            if _primary():
+                # one process owns the injected damage, like every other
+                # filesystem mutation — P processes each truncating the
+                # same file would compound frac and fire P audit events
+                chaos.after_publish(start, path)
     wait_pending()  # durable-on-return contract for the native backend
     return params

@@ -183,6 +183,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "pick N divisible by the sharding-axis size")
     p.add_argument("--no_resume", action="store_true",
                    help="ignore existing checkpoints (restart from step 0)")
+    p.add_argument("--chaos", default=None, metavar="SPEC",
+                   help="run the strategy under deterministic fault load "
+                        "(runtime/chaos.py): comma-separated "
+                        "KIND@STEP[:ARG] entries plus optional seed=N, "
+                        "KIND in {nan_grad, inf_grad, hang, kill, "
+                        "corrupt_ckpt}. The run goes through the failure "
+                        "supervisor (restart + verified-checkpoint "
+                        "recovery); requires --checkpoint_dir and a "
+                        "single --method")
+    p.add_argument("--max_restarts", type=int, default=3,
+                   help="with --chaos: the supervisor's restart budget")
     return p
 
 
@@ -216,6 +227,23 @@ def main(argv=None) -> int:
                            DATA_AXIS, MODEL_AXIS, PIPE_AXIS, EXPERT_AXIS,
                            SEQ_AXIS)
 
+    chaos_plan = None
+    if args.chaos:
+        if not args.checkpoint_dir:
+            print("error: --chaos requires --checkpoint_dir (recovery "
+                  "resumes from published checkpoints)", file=sys.stderr)
+            return 2
+        if args.method in (0, 9):
+            print("error: --chaos applies to a single --method (not 0/9):"
+                  " restarts would desync the cross-strategy verification",
+                  file=sys.stderr)
+            return 2
+        from .runtime.chaos import FaultPlan
+        try:
+            chaos_plan = FaultPlan.parse(args.chaos)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
     if args.comm != "psum" and args.zero1:
         print("error: --comm pallas_ring does not apply to --zero1 "
               "(ZeRO-1's reduce_scatter/all_gather pair keeps the XLA "
@@ -539,7 +567,17 @@ def main(argv=None) -> int:
                 # materialize full params + Adam moments on one device
                 from .parallel.fsdp import checkpoint_shardings
                 restore_shardings = checkpoint_shardings(params, opt, mesh)
-            out = run_with_checkpointing(
+            runner = run_with_checkpointing
+            if chaos_plan is not None:
+                # fault load goes through the failure supervisor: a
+                # raised fault (nonfinite="raise") costs one restart and
+                # the next attempt resumes from the last VERIFIED
+                # checkpoint; kill@s takes the whole process, so its
+                # recovery is the next invocation of this same command
+                from .runtime.failure import supervise as runner
+                ck_kwargs.update(max_restarts=args.max_restarts,
+                                 chaos=chaos_plan, nonfinite="raise")
+            out = runner(
                 fn, params, seeds, tokens, args.model_size,
                 ckpt_dir=os.path.join(args.checkpoint_dir, name),
                 every=args.checkpoint_every, resume=not args.no_resume,

@@ -24,13 +24,38 @@ scheduler moves independent compute between them — the role the reference's
 
 from __future__ import annotations
 
+import functools
+
 import jax
 from jax import lax
 
 
-def all_reduce(x, axis_name: str):
-    """Sum across the mesh axis — NCCL ``all_reduce(SUM)`` / ``dist.all_reduce``."""
-    return lax.psum(x, axis_name)
+def vma_erased() -> bool:
+    """True when this process runs the pre-vma jax compat layer (package
+    ``__init__``): no varying-manual-axes typing exists, so every launch
+    must take its vma-off path — ``check_vma=False`` semantics, explicit
+    ``force=True`` reductions — exactly the contract the interpret-mode
+    Pallas launches already exercise on modern jax."""
+    return getattr(jax.typeof, "erased_vma", False)
+
+
+if vma_erased():
+    # Pre-vma jax transposes psum to ANOTHER psum: a cotangent crossing
+    # an all_reduce differentiated through (vp_embed's row completion)
+    # comes back scaled by the axis size. Modern jax — in both the vma-on
+    # and vma-off regimes — transposes psum to an identity pbroadcast,
+    # and the strategies are written against that contract. Restore it
+    # with a hand-written VJP (sum forward, pass-through backward).
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+    def all_reduce(x, axis_name: str):
+        return lax.psum(x, axis_name)
+
+    all_reduce.defvjp(lambda x, a: (lax.psum(x, a), None),
+                      lambda a, _, dy: (dy,))
+else:
+    def all_reduce(x, axis_name: str):
+        """Sum across the mesh axis — NCCL ``all_reduce(SUM)`` / ``dist.all_reduce``."""
+        return lax.psum(x, axis_name)
 
 
 def all_gather(x, axis_name: str, *, dim: int = 0):
@@ -64,10 +89,14 @@ def grad_reduce(g, axis_name, force: bool = False):
     """
     axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
     if force:
-        # the vma-off contract (launcher ran check_vma=False, e.g. for
-        # interpret-mode multi-tile Pallas kernels): typing is erased,
-        # transposes do NOT auto-psum, every cotangent arrives partial —
-        # the unconditional psum is then the correct single reduction
+        # the vma-off contract (launcher ran check_vma=False — the
+        # interpret-mode Pallas launches on modern jax, or EVERY launch
+        # under the pre-vma compat layer, see vma_erased): typing is
+        # erased, transposes do NOT auto-psum, every cotangent arrives
+        # partial — the unconditional psum is then the correct single
+        # reduction. Non-forced calls no-op in that regime (empty vma),
+        # which is also part of the contract: the gates stand down and
+        # each strategy's explicit force sweep reduces each leaf once.
         return lax.psum(g, axes)
     pending = tuple(a for a in axes if a in jax.typeof(g).vma)
     return lax.psum(g, pending) if pending else g

@@ -55,7 +55,7 @@ from ..ops.moe import (dispatch_tensor, dispatch_tensor_topk,
                        route_top1, route_topk, router_aux_loss,
                        scatter_combine, scatter_dispatch)
 from ..optim import sgd
-from .collectives import all_to_all, grad_reduce
+from .collectives import all_to_all, grad_reduce, vma_erased
 from .launcher import launch, launch_strided
 from .mesh import DATA_AXIS, EXPERT_AXIS, require_axes
 
@@ -167,7 +167,7 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
     """
 
     axes = (axis,) if data_axis is None else (axis, data_axis)
-    reducer = (grad_reduce if comm == "psum"
+    reducer = (grad_reduce if comm == "psum" and not vma_erased()
                else (lambda g, ax: lax.psum(g, ax)))
 
     def fwd_aux(params: MoEStackParams, x):

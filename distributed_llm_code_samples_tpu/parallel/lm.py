@@ -39,7 +39,8 @@ from ..models.transformer import transformer_block, transformer_fwd
 from ..ops.norm import layernorm
 from ..ops.xent import xent_loss
 from ..optim import check_state_args, sgd
-from .collectives import all_gather, all_reduce, axis_index, grad_reduce
+from .collectives import (all_gather, all_reduce, axis_index,
+                          grad_reduce, vma_erased)
 from .launcher import launch, launch_strided
 from .mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS, require_axes
 from .transformer import (TP_SPECS, _f_gate, _shard, _validate_shapes,
@@ -206,7 +207,12 @@ def _vma_check(attn_impl, head_impl=None) -> bool:
     already-reduced embedding part (scaled by the axis size). The
     vma-off force-reduce contract (``grad_reduce(force=True)``) keeps
     every cotangent partial and reduces exactly once; the oracle head
-    never hits this because both of its wte uses are plain ops."""
+    never hits this because both of its wte uses are plain ops.
+
+    Under the pre-vma jax compat layer there is no vma typing at all,
+    so EVERY launch takes the vma-off path (``collectives.vma_erased``)."""
+    if vma_erased():
+        return False
     if head_impl == "fused":
         return False
     return not (attn_impl == "flash"

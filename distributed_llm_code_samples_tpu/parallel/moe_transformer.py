@@ -33,7 +33,7 @@ from ..models.moe_transformer import (MoETransformerParams,
                                       moe_transformer_fwd_aux)
 from ..optim import sgd
 from .expert import _local_capacity, moe_layer_ep
-from .collectives import grad_reduce
+from .collectives import grad_reduce, vma_erased
 from .launcher import launch_strided
 from .mesh import EXPERT_AXIS, require_axes
 
@@ -103,7 +103,8 @@ def train_moe_transformer_ep(params: MoETransformerParams, seeds,
                          to="varying")
         grads = vjp((dloss_dx, coef))[0]
         grads = grads._replace(**{
-            f: grad_reduce(getattr(grads, f), EXPERT_AXIS)
+            f: grad_reduce(getattr(grads, f), EXPERT_AXIS,
+                           force=vma_erased())
             for f in _REPLICATED})
         return sgd(params, grads, lr)
 

@@ -60,16 +60,19 @@ def _ring_fwd_core(q, k, v, axis_name: str, causal: bool):
     per-row logsumexp of the full (masked) score matrix — the only softmax
     statistic the hand-written backward needs."""
     n = lax.axis_size(axis_name)
-    rank = lax.axis_index(axis_name)
+    # rank feeds only the causal mask offsets; a non-causal ring must not
+    # emit it at all — a dead axis_index lowers to a PartitionId op that
+    # older jax leaves outside the manual region, which SPMD rejects
+    rank = lax.axis_index(axis_name) if causal else None
     t_local, d = q.shape
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def step(i, carry):
         k_blk, v_blk, m, l, acc = carry
-        src = (rank - i) % n  # whose KV block we hold at this step
         s = (q @ k_blk.T).astype(jnp.float32) * scale  # [T, T] scores
         if causal:
+            src = (rank - i) % n  # whose KV block we hold at this step
             # global positions: this shard's Q block vs the held KV block
             allowed = causal_mask(t_local, t_local, rank * t_local,
                                   src * t_local)
@@ -96,9 +99,9 @@ def _hop_case(i, rank, n, causal):
     (rank - i) % n``: 0 = fully allowed (src strictly earlier), 1 = the
     diagonal block (standard causal masking), 2 = fully masked (skip —
     the flash FLOP saving at ring granularity)."""
-    src = (rank - i) % n
     if not causal:
-        return jnp.int32(0), src
+        return jnp.int32(0), None  # rank may be None: no mask, no src
+    src = (rank - i) % n
     return jnp.where(src == rank, 1,
                      jnp.where(src < rank, 0, 2)).astype(jnp.int32), src
 
@@ -116,7 +119,7 @@ def _ring_fwd_flash(q, k, v, axis_name: str, causal: bool,
     local causal == global causal), later block → skipped entirely."""
     from ..ops.pallas_attention import flash_attention_fwd
     n = lax.axis_size(axis_name)
-    rank = lax.axis_index(axis_name)
+    rank = lax.axis_index(axis_name) if causal else None  # see _ring_fwd_core
     t_local, d = q.shape
     perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -171,7 +174,7 @@ def _ring_bwd_flash(q, k, v, y, lse, dy, axis_name: str, causal: bool,
     ``p = exp(s - lse)`` / ``ds = p (dp - delta)`` math, tiled in VMEM."""
     from ..ops.pallas_attention import flash_attention_bwd
     n = lax.axis_size(axis_name)
-    rank = lax.axis_index(axis_name)
+    rank = lax.axis_index(axis_name) if causal else None  # see _ring_fwd_core
     t_local, d = q.shape
     perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -246,7 +249,7 @@ def _ring_attention_bwd(axis_name, causal, res, dy):
     after n hops every KV block is home with its gradient complete."""
     q, k, v, y, lse = res
     n = lax.axis_size(axis_name)
-    rank = lax.axis_index(axis_name)
+    rank = lax.axis_index(axis_name) if causal else None  # see _ring_fwd_core
     t_local, d = q.shape
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -256,9 +259,9 @@ def _ring_attention_bwd(axis_name, causal, res, dy):
 
     def step(i, carry):
         k_blk, v_blk, dk, dv, dq = carry
-        src = (rank - i) % n
         s = (q @ k_blk.T).astype(jnp.float32) * scale
         if causal:
+            src = (rank - i) % n
             allowed = causal_mask(t_local, t_local, rank * t_local,
                                   src * t_local)
             s = jnp.where(allowed, s, _NEG)

@@ -43,7 +43,7 @@ from ..ops.ffn import ffn_block
 from ..ops.norm import layernorm
 from ..optim import sgd
 from .collectives import (all_gather, all_reduce, axis_index, grad_reduce,
-                          reduce_scatter)
+                          reduce_scatter, vma_erased)
 from .launcher import launch, launch_strided
 from .mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS, require_axes
 
@@ -87,7 +87,8 @@ def _f_gate(axis: str):
     def f(x):
         return x
 
-    f.defvjp(lambda x: (x, None), lambda _, dy: (grad_reduce(dy, axis),))
+    f.defvjp(lambda x: (x, None),
+             lambda _, dy: (grad_reduce(dy, axis, force=vma_erased()),))
     return f
 
 
@@ -204,7 +205,7 @@ def train_transformer_ddp(params: TransformerParams, seeds, batch_size: int,
             lambda p: transformer_fwd(p, x, n_heads, causal, attn), params)
         grads = vjp(dloss_dx)[0]
         grads = jax.tree_util.tree_map(
-            lambda g: grad_reduce(g, DATA_AXIS), grads)
+            lambda g: grad_reduce(g, DATA_AXIS, force=vma_erased()), grads)
         return sgd(params, grads, lr)
 
     return launch_strided(step, clone_params(params), seeds, mesh,
@@ -377,8 +378,10 @@ def make_tp_step(batch_size: int, model_size: int, seq_len: int,
             # axis. Everything else saw full (gathered) tokens and is
             # complete per shard.
             grads = grads._replace(
-                ln1=grad_reduce(grads.ln1, MODEL_AXIS),
-                ln2=grad_reduce(grads.ln2, MODEL_AXIS))
+                ln1=grad_reduce(grads.ln1, MODEL_AXIS,
+                                force=vma_erased()),
+                ln2=grad_reduce(grads.ln2, MODEL_AXIS,
+                                force=vma_erased()))
         # projection/FFN grads are shard-local (each shard owns its heads/
         # features); in the plain form LN grads replicate — data and dx
         # are identical on all shards after the f-gate psums
@@ -443,7 +446,7 @@ def train_transformer_seq(params: TransformerParams, seeds,
         # fused psum over both axes per leaf, not one per axis.
         axes = (SEQ_AXIS, DATA_AXIS) if dp > 1 else (SEQ_AXIS,)
         grads = jax.tree_util.tree_map(
-            lambda g: grad_reduce(g, axes), grads)
+            lambda g: grad_reduce(g, axes, force=vma_erased()), grads)
         return sgd(params, grads, lr)
 
     if dp > 1:
@@ -489,7 +492,7 @@ def train_transformer_hybrid(params: TransformerParams, seeds,
         # axis still needs the DDP reduction (orthogonal psums, the 2-D
         # mesh composition)
         grads = jax.tree_util.tree_map(
-            lambda g: grad_reduce(g, DATA_AXIS), grads)
+            lambda g: grad_reduce(g, DATA_AXIS, force=vma_erased()), grads)
         return sgd(params, grads, lr)
 
     # params: sharded over model, replicated over data; seeds: one strided

@@ -6,12 +6,17 @@ The TPU-native replacement for the reference's L0/L4 runtime surface
 (``jax.distributed``); ``native`` binds the in-tree C++ engines (host ring
 collectives, prefetching data loader, TCP rendezvous/barrier with timeout,
 watchdog, XLA FFI custom calls); ``failure`` adds hang/peer/device failure
-detection and checkpoint-based elastic recovery.
+detection and checkpoint-based elastic recovery; ``chaos`` injects
+deterministic faults so that story is continuously tested; and
+``backend_probe`` walks an env-shape matrix to tell a dead accelerator
+relay from a self-broken environment (the round-5 outage).
 """
 
-from . import native
+from . import backend_probe, chaos, native
+from .chaos import FaultPlan
 from .failure import (HealthCheckError, device_healthcheck, supervise)
 from .init import initialize, runtime_info, DEFAULT_COORDINATOR
 
-__all__ = ["native", "initialize", "runtime_info", "DEFAULT_COORDINATOR",
+__all__ = ["backend_probe", "chaos", "native", "initialize",
+           "runtime_info", "DEFAULT_COORDINATOR", "FaultPlan",
            "HealthCheckError", "device_healthcheck", "supervise"]

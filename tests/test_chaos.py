@@ -1,0 +1,254 @@
+"""Deterministic fault-injection tests (runtime/chaos.py + the recovery
+stack it exercises).
+
+The acceptance bar (ISSUE r6): on CPU, an injected NaN-grad step, a
+killed worker process, and a truncated checkpoint each recover under
+``supervise`` to BIT-IDENTICAL final params vs an uninterrupted run with
+the same segmentation — recovery must cost wall-clock, never math.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from conftest import load_scaled_timeout
+
+from distributed_llm_code_samples_tpu.checkpoint import (
+    CorruptCheckpointError, latest_verified_step, restore_checkpoint,
+    run_with_checkpointing, tree_finite)
+from distributed_llm_code_samples_tpu.data import make_seed_schedule
+from distributed_llm_code_samples_tpu.models import init_ffn_stack
+from distributed_llm_code_samples_tpu.parallel import train_single
+from distributed_llm_code_samples_tpu.runtime.chaos import (
+    FaultPlan, truncate_checkpoint)
+from distributed_llm_code_samples_tpu.runtime.failure import supervise
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def params():
+    return init_ffn_stack(jax.random.PRNGKey(0), 16, 2)
+
+
+def _ref_run(params, seeds, tmp_path, name="ref"):
+    """The uninterrupted oracle at the SAME segmentation (every=2) and
+    through the same checkpoint layer, so bit-identity is the honest
+    claim: identical compiled programs, identical segment boundaries."""
+    return run_with_checkpointing(train_single, params, seeds, 32, 16,
+                                  ckpt_dir=str(tmp_path / name), every=2,
+                                  lr=0.1)
+
+
+def _read_log(ckpt_dir):
+    with open(os.path.join(ckpt_dir, "supervise.jsonl")) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+# ------------------------------------------------------------- spec grammar
+
+def test_fault_plan_parse_grammar():
+    plan = FaultPlan.parse("nan_grad@3,hang@5:0.5,corrupt_ckpt@4:0.25,"
+                           "kill@7,seed=11")
+    assert [(f.kind, f.step, f.arg) for f in plan.faults] == [
+        ("nan_grad", 3, None), ("hang", 5, 0.5),
+        ("corrupt_ckpt", 4, 0.25), ("kill", 7, None)]
+    assert plan.seed == 11
+
+
+@pytest.mark.parametrize("spec,msg", [
+    ("bogus@1", "known kinds"),
+    ("nan_grad@0", ">= 1"),
+    ("nan_grad", "KIND@STEP"),
+    ("seed=3", "empty"),
+    ("nan_grad@x", "1-based"),
+])
+def test_fault_plan_parse_rejects(spec, msg):
+    with pytest.raises(ValueError, match=msg):
+        FaultPlan.parse(spec)
+
+
+# ------------------------------------------------- NaN/Inf gradient faults
+
+def test_nan_grad_recovers_bit_identical(tmp_path, params):
+    """nonfinite="raise": the poisoned segment costs one restart, the
+    retry resumes from the last verified checkpoint, and the final
+    params equal the uninterrupted run EXACTLY."""
+    seeds = make_seed_schedule(8, random_seed=3)
+    ref = _ref_run(params, seeds, tmp_path)
+    plan = FaultPlan.parse("nan_grad@3")
+    failures = []
+    ck = str(tmp_path / "chaos")
+    out = supervise(train_single, params, seeds, 32, 16, ckpt_dir=ck,
+                    every=2, max_restarts=2, chaos=plan,
+                    nonfinite="raise", backoff_base_s=0.0,
+                    on_failure=lambda n, e: failures.append(str(e)),
+                    lr=0.1)
+    assert len(failures) == 1 and "non-finite" in failures[0]
+    assert [e["kind"] for e in plan.events] == ["nan_grad"]
+    np.testing.assert_array_equal(np.asarray(out.w1), np.asarray(ref.w1))
+    np.testing.assert_array_equal(np.asarray(out.w2), np.asarray(ref.w2))
+    assert latest_verified_step(ck) == 8
+    # the structured log carries the whole story: one failed attempt
+    # (the poisoned segment), one completed
+    events = [r["event"] for r in _read_log(ck)]
+    assert events.count("attempt_failed") == 1
+    assert events.count("completed") == 1
+
+
+def test_inf_grad_skip_never_persists_poison(tmp_path, params):
+    """nonfinite="skip" (supervise's default): the poisoned segment is
+    dropped — never checkpointed, never a restart — and every published
+    checkpoint stays finite."""
+    seeds = make_seed_schedule(8, random_seed=3)
+    plan = FaultPlan.parse("inf_grad@3")
+    failures = []
+    ck = str(tmp_path / "skip")
+    out = supervise(train_single, params, seeds, 32, 16, ckpt_dir=ck,
+                    every=2, chaos=plan, backoff_base_s=0.0,
+                    on_failure=lambda n, e: failures.append(str(e)),
+                    lr=0.1)
+    assert failures == []  # a skip is not a restart
+    assert tree_finite(out)
+    # the poisoned step_4 was never published; the run still finished
+    steps = sorted(int(n.split("_")[1]) for n in os.listdir(ck)
+                   if n.startswith("step_"))
+    assert steps == [0, 2, 6, 8]
+    for step in steps:
+        got, _, _ = restore_checkpoint(ck, params, step=step)
+        assert tree_finite(got), f"step_{step} carries non-finite params"
+    assert any(r["event"] == "nonfinite_skip" for r in _read_log(ck))
+
+
+# ------------------------------------------------------ corrupt checkpoint
+
+def test_corrupt_ckpt_falls_back_to_verified(tmp_path, params):
+    """The CheckFreq scenario: the freshly-published step_4 is torn
+    mid-file, a crash follows, and the restart must fall back to step_2
+    (the newest checkpoint that VERIFIES), retrain, and land
+    bit-identical to the uninterrupted run."""
+    seeds = make_seed_schedule(8, random_seed=3)
+    ref = _ref_run(params, seeds, tmp_path)
+    plan = FaultPlan.parse("corrupt_ckpt@4")
+    calls = {"n": 0}
+
+    def flaky(p, s, *a, **kw):
+        calls["n"] += 1
+        if calls["n"] == 3:  # after step_4 published (and torn)
+            raise RuntimeError("injected crash")
+        return train_single(p, s, *a, **kw)
+
+    ck = str(tmp_path / "chaos")
+    out = supervise(flaky, params, seeds, 32, 16, ckpt_dir=ck, every=2,
+                    max_restarts=2, chaos=plan, backoff_base_s=0.0,
+                    lr=0.1)
+    # attempt 1: segments 1,2 (step_4 torn on publish), crash on 3;
+    # attempt 2: falls back to step_2, retrains segments 2,3,4
+    assert calls["n"] == 6
+    assert [e["kind"] for e in plan.events] == ["corrupt_ckpt"]
+    np.testing.assert_array_equal(np.asarray(out.w1), np.asarray(ref.w1))
+    np.testing.assert_array_equal(np.asarray(out.w2), np.asarray(ref.w2))
+    assert latest_verified_step(ck) == 8  # step_4 was re-published clean
+
+
+def test_truncate_checkpoint_helper_targets_array_file(tmp_path, params):
+    from distributed_llm_code_samples_tpu.checkpoint import save_checkpoint
+    path = save_checkpoint(str(tmp_path), params, 1)
+    damaged = truncate_checkpoint(path)
+    assert damaged.endswith("arrays.npz")
+    with pytest.raises(CorruptCheckpointError, match="checksum"):
+        restore_checkpoint(str(tmp_path), params, step=1)
+
+
+# ---------------------------------------------------- killed worker process
+
+@pytest.mark.serial
+def test_kill_fault_recovers_bit_identical_via_cli(tmp_path, params):
+    """kill@4 SIGKILLs the worker right after step_4 publishes — no
+    in-process supervisor can catch that, so recovery is the next
+    invocation of the same command (the external restart loop). The
+    resumed run must finish and the final checkpoint must equal the
+    uninterrupted oracle bit-for-bit. Also the end-to-end test of the
+    CLI --chaos wiring (cli.py -> supervise -> FaultPlan)."""
+    ck = str(tmp_path / "ck")
+    args = [sys.executable, os.path.join(REPO, "train_ffns.py"),
+            "-s", "8", "-bs", "2", "-n", "16", "-l", "2", "-d", "16",
+            "-m", "1", "-r", "3", "--lr", "0.1",
+            "--checkpoint_dir", ck, "--checkpoint_every", "2",
+            "--chaos", "kill@4"]
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    r1 = subprocess.run(args, capture_output=True, text=True, env=env,
+                        cwd=REPO, timeout=load_scaled_timeout(300))
+    assert r1.returncode == -signal.SIGKILL, r1.stdout + r1.stderr
+    sub = os.path.join(ck, "train_single")
+    assert latest_verified_step(sub) == 4  # died right after publishing
+    # the restart: same command; kill@4 keys on the PUBLISH of step_4,
+    # which a resumed run never repeats — the fault cannot re-fire
+    r2 = subprocess.run(args, capture_output=True, text=True, env=env,
+                        cwd=REPO, timeout=load_scaled_timeout(300))
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    assert latest_verified_step(sub) == 8
+    # oracle: the same workload uninterrupted, in-process (CLI semantics:
+    # seeds from -r 3, params from PRNGKey(3), tokens = bs * seq)
+    oracle_params = init_ffn_stack(jax.random.PRNGKey(3), 16, 2)
+    seeds = make_seed_schedule(8, random_seed=3)
+    ref = run_with_checkpointing(
+        train_single, oracle_params, seeds, 2 * 16, 16,
+        ckpt_dir=str(tmp_path / "oracle"), every=2, lr=0.1)
+    got, step, _ = restore_checkpoint(sub, oracle_params)
+    assert step == 8
+    np.testing.assert_array_equal(np.asarray(got.w1), np.asarray(ref.w1))
+    np.testing.assert_array_equal(np.asarray(got.w2), np.asarray(ref.w2))
+
+
+# ------------------------------------------------------- hung collective
+
+def test_hang_fault_latches_watchdog_evidence(tmp_path, params):
+    """hang@3:1.2 stalls one segment past the 400ms watchdog; the run
+    still completes (a hang is detected, not fatal, at this layer) and
+    the structured log records watchdog_expired=true — the evidence a
+    real hung collective leaves behind."""
+    seeds = make_seed_schedule(8, random_seed=3)
+    _ref_run(params, seeds, tmp_path)  # pre-compile the segment programs
+    plan = FaultPlan.parse("hang@3:1.2")
+    ck = str(tmp_path / "hang")
+    supervise(train_single, params, seeds, 32, 16, ckpt_dir=ck, every=2,
+              chaos=plan, watchdog_ms=400, backoff_base_s=0.0, lr=0.1)
+    assert plan.events and plan.events[0]["kind"] == "hang"
+    log = _read_log(ck)
+    completed = [r for r in log if r["event"] == "completed"]
+    assert completed and completed[0]["watchdog_expired"] is True
+
+
+def test_no_hang_leaves_watchdog_clean(tmp_path, params):
+    seeds = make_seed_schedule(4, random_seed=3)
+    ck = str(tmp_path / "clean")
+    supervise(train_single, params, seeds, 32, 16, ckpt_dir=ck, every=2,
+              watchdog_ms=60_000, backoff_base_s=0.0, lr=0.1)
+    completed = [r for r in _read_log(ck) if r["event"] == "completed"]
+    assert completed and completed[0]["watchdog_expired"] is False
+
+
+# -------------------------------------------------------- CLI flag guards
+
+def test_cli_chaos_flag_guards(capsys):
+    from distributed_llm_code_samples_tpu.cli import main
+    # --chaos without --checkpoint_dir: recovery has nothing to resume from
+    assert main(["-s", "2", "--chaos", "nan_grad@1"]) == 2
+    assert "--checkpoint_dir" in capsys.readouterr().err
+    # --chaos with the multi-strategy methods: restarts would desync the
+    # cross-strategy verification
+    assert main(["-s", "2", "-m", "9", "--chaos", "nan_grad@1",
+                 "--checkpoint_dir", "/tmp/x"]) == 2
+    assert "single --method" in capsys.readouterr().err
+    # a bad spec fails at the flag surface, not mid-run
+    assert main(["-s", "2", "-m", "1", "--chaos", "explode@1",
+                 "--checkpoint_dir", "/tmp/x"]) == 2
+    assert "known kinds" in capsys.readouterr().err

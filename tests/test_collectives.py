@@ -115,7 +115,12 @@ def test_grad_reduce_both_regimes(mesh8):
     """grad_reduce must sum exactly once whether the cotangent was already
     auto-reduced (plain-op transpose) or arrives partial (custom_vjp rule).
     Both losses below are mathematically identical: sum over shards of
-    w . x_shard, so dw = sum(x) in both cases."""
+    w . x_shard, so dw = sum(x) in both cases.
+
+    Under the pre-vma compat layer (``coll.vma_erased()``) there is no
+    auto-reduction at all — EVERY cotangent arrives partial, non-forced
+    grad_reduce no-ops by contract, and the explicit force is the one
+    correct reduction for both paths."""
     x = np.random.default_rng(7).normal(size=(N, 4)).astype(np.float32)
     w = np.random.default_rng(8).normal(size=(4,)).astype(np.float32)
 
@@ -129,7 +134,7 @@ def test_grad_reduce_both_regimes(mesh8):
     def make_loss(dot):
         def body(w, xs):  # w replicated, xs one shard row
             g = jax.grad(lambda w: dot(w, xs[0]))(w)
-            return coll.grad_reduce(g, DATA_AXIS)
+            return coll.grad_reduce(g, DATA_AXIS, force=coll.vma_erased())
 
         return jax.jit(jax.shard_map(body, mesh=mesh8,
                                      in_specs=(P(), P(DATA_AXIS)),

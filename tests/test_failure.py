@@ -153,7 +153,67 @@ def test_supervise_gives_up_after_max_restarts(tmp_path):
 
     with pytest.raises(RuntimeError, match="after 2 restarts"):
         supervise(always_crash, params, seeds, 32, 16,
-                  ckpt_dir=str(tmp_path), every=2, max_restarts=2)
+                  ckpt_dir=str(tmp_path), every=2, max_restarts=2,
+                  backoff_base_s=0.0)
+
+
+def test_supervise_exhaustion_reports_full_history(tmp_path):
+    """The round-5 outage was a FLAPPING failure whose signature changed
+    across attempts; the exhausted supervisor's RuntimeError must carry
+    every attempt's exception head (not just the last), and
+    ``on_failure`` must fire exactly ``max_restarts`` times — once
+    before each restart, never after the final attempt."""
+    params = init_ffn_stack(jax.random.PRNGKey(0), 16, 2)
+    seeds = make_seed_schedule(4, random_seed=3)
+    attempts = {"n": 0}
+    on_failure_calls = []
+
+    def flapping(*a, **kw):
+        attempts["n"] += 1
+        kind = (ValueError, OSError, RuntimeError, TypeError)[
+            (attempts["n"] - 1) % 4]
+        raise kind(f"signature {attempts['n']}")
+
+    with pytest.raises(RuntimeError) as ei:
+        supervise(flapping, params, seeds, 32, 16,
+                  ckpt_dir=str(tmp_path), every=2, max_restarts=3,
+                  backoff_base_s=0.0,
+                  on_failure=lambda n, e: on_failure_calls.append(n))
+    msg = str(ei.value)
+    assert "after 3 restarts" in msg
+    # all four attempts' heads, in order, with their (changing) types
+    for i, kind in enumerate(("ValueError", "OSError", "RuntimeError",
+                              "TypeError")):
+        assert f"attempt {i}: {kind}: signature {i + 1}" in msg, msg
+    assert on_failure_calls == [0, 1, 2]  # exactly max_restarts times
+    assert ei.value.__cause__ is not None  # chained to the last error
+
+
+def test_supervise_structured_log_and_backoff(tmp_path):
+    """One JSON line per attempt in supervise.jsonl, carrying the
+    exception head, restarts left, and the (deterministic, exponential)
+    backoff the supervisor chose; the exhausted attempt logs
+    backoff_s=None because no restart follows it."""
+    import json
+    params = init_ffn_stack(jax.random.PRNGKey(0), 16, 2)
+    seeds = make_seed_schedule(4, random_seed=3)
+
+    def always_crash(*a, **kw):
+        raise RuntimeError("hardware on fire")
+
+    with pytest.raises(RuntimeError):
+        supervise(always_crash, params, seeds, 32, 16,
+                  ckpt_dir=str(tmp_path), every=2, max_restarts=2,
+                  backoff_base_s=0.001, backoff_jitter=0.0)
+    with open(tmp_path / "supervise.jsonl") as f:
+        records = [json.loads(ln) for ln in f if ln.strip()]
+    failed = [r for r in records if r["event"] == "attempt_failed"]
+    assert [r["attempt"] for r in failed] == [0, 1, 2]
+    for r in failed:
+        assert r["error"].startswith("RuntimeError: hardware on fire")
+        assert r["restarts_left"] == 2 - r["attempt"]
+    # jitter 0: exact 2^n exponential; the final attempt never backs off
+    assert [r["backoff_s"] for r in failed] == [0.001, 0.002, None]
 
 
 def test_supervise_healthcheck_path(tmp_path):

@@ -99,8 +99,10 @@ def test_flash_attention_aot_v5e_at_bench_shapes():
     replicated shard_map — the same program a 1-chip run executes.)"""
     import functools
     import numpy as onp
+    from conftest import require_aot_topology
     from jax.sharding import Mesh, PartitionSpec as P
     from jax.experimental import topologies
+    require_aot_topology()  # bounded probe: a hung discovery skips fast
     try:
         topo = topologies.get_topology_desc(platform="tpu",
                                             topology_name="v5e:2x4")

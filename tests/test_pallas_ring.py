@@ -18,10 +18,17 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from distributed_llm_code_samples_tpu.ops.pallas_ring import (
-    ppermute_dma, ring_all_reduce)
+    interpret_collectives_supported, ppermute_dma, ring_all_reduce)
 from distributed_llm_code_samples_tpu.parallel import DATA_AXIS
 
-pytestmark = pytest.mark.usefixtures()
+# graceful degradation, not a crash: off-TPU these kernels need the
+# dedicated TPU interpreter's remote-DMA/semaphore model, which this
+# jax may not have (ops/pallas_ring.interpret_collectives_supported)
+pytestmark = pytest.mark.skipif(
+    not interpret_collectives_supported()
+    and jax.default_backend() != "tpu",
+    reason="pallas interpreter lacks remote DMA/semaphore discharge "
+           "rules on this jax; Mosaic collectives are chip-only here")
 
 
 def _sm(mesh, fn):
@@ -94,8 +101,10 @@ def test_ring_identifying_contributions(mesh8):
 
 
 def _v5e8_mesh():
+    from conftest import require_aot_topology
     from jax.experimental import topologies
     from jax.sharding import Mesh
+    require_aot_topology()  # bounded probe: a hung discovery skips fast
     try:
         topo = topologies.get_topology_desc(platform="tpu",
                                             topology_name="v5e:2x4")
