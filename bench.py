@@ -157,23 +157,53 @@ FFN = 4 * D_MODEL
 _MODEL_FLOPS = 12 * TOKENS * D_MODEL * FFN * N_LAYERS
 _REMAT_EXEC_FLOPS = 14 * TOKENS * D_MODEL * FFN * N_LAYERS
 
-# bf16 peak matmul FLOP/s by chip generation (public spec sheets). The
-# default f32 jnp matmul on TPU lowers to single-pass bf16 MXU ops, so
-# this is the ceiling the step actually runs against.
-_PEAK_BF16 = {
-    "v2": 45e12, "v3": 123e12, "v4": 275e12,
-    "v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12, "v5": 459e12,
-    "v6 lite": 918e12, "v6e": 918e12,
-}
-
-
 def _peak_flops(device_kind: str):
-    kind = device_kind.lower()
-    # match the most specific key first ("v5 lite" before "v5")
-    for key in sorted(_PEAK_BF16, key=len, reverse=True):
-        if key in kind:
-            return _PEAK_BF16[key], False
-    return 197e12, True  # assume v5e-class if unrecognized
+    """bf16 peak FLOP/s — the shared table now lives in
+    ``runtime/telemetry.py`` (one accounting for the bench, the CLI
+    metrics stream, and the report tool); the bench keeps its historical
+    assume-v5e fallback for unrecognized chips."""
+    from distributed_llm_code_samples_tpu.runtime.telemetry import (
+        peak_flops)
+    peak = peak_flops(device_kind)
+    return (197e12, True) if peak is None else (peak, False)
+
+
+_METRICS_WRITER = None
+
+
+def _bench_writer():
+    """The unified telemetry writer (``runtime/telemetry.py``), shared
+    with the CLI metrics stream: with ``BENCH_METRICS_DIR`` set, every
+    labeled measurement lands as one schema-versioned ``bench`` record
+    in that dir's ``metrics.jsonl`` (the report tool folds them), and
+    the final payload rides the same stream — replacing bench-private
+    dict plumbing as the only record of per-measurement rows."""
+    global _METRICS_WRITER
+    mdir = os.environ.get("BENCH_METRICS_DIR")
+    if not mdir:
+        return None
+    if _METRICS_WRITER is None:
+        try:
+            from distributed_llm_code_samples_tpu.runtime.telemetry \
+                import TelemetryWriter
+            _METRICS_WRITER = TelemetryWriter(mdir, meta={
+                "source": "bench.py",
+                "shape": f"d{D_MODEL}_L{N_LAYERS}_tok{TOKENS}"
+                         f"_steps{TIMED_STEPS}"})
+        except Exception:  # noqa: BLE001 — telemetry never breaks the bench
+            return None
+    return _METRICS_WRITER
+
+
+def _bench_row(label: str, value: float, **extra) -> None:
+    w = _bench_writer()
+    if w is None:
+        return
+    try:
+        w.bench({"metric": label, "value": round(float(value), 4),
+                 "unit": "steps/s", **extra})
+    except Exception:  # noqa: BLE001
+        pass
 
 
 def _metric_name():
@@ -181,6 +211,13 @@ def _metric_name():
 
 
 def _emit(payload):
+    w = _bench_writer()
+    if w is not None:
+        try:
+            w.bench(dict(payload))
+            w.close()
+        except Exception:  # noqa: BLE001
+            pass
     print(json.dumps(payload))
     sys.stdout.flush()
 
@@ -497,8 +534,11 @@ def main():
     from distributed_llm_code_samples_tpu.utils.benchtime import (
         steps_per_sec)
 
-    def measure(run_fn, p0):
-        return steps_per_sec(run_fn, p0, warm, timed, reps, TIMED_STEPS)
+    def measure(run_fn, p0, label=None):
+        sps = steps_per_sec(run_fn, p0, warm, timed, reps, TIMED_STEPS)
+        if label:
+            _bench_row(label, sps)
+        return sps
 
     try:
         # both residual policies are first-class framework paths: remat is
@@ -509,11 +549,13 @@ def main():
         # MXU-saturated — saved 29.4; saved wins ~2% in time and ~5% over
         # the naive port by spending it on fewer FLOPs).
         remat_sps = measure(
-            lambda p, s: train_single(p, s, TOKENS, D_MODEL, lr=LR), params)
+            lambda p, s: train_single(p, s, TOKENS, D_MODEL, lr=LR), params,
+            label="single_remat")
         saved_sps = measure(
             lambda p, s: train_single(p, s, TOKENS, D_MODEL, lr=LR,
-                                      remat=False), params)
-        naive_sps = measure(_naive_run(), params)
+                                      remat=False), params,
+            label="single_saved")
+        naive_sps = measure(_naive_run(), params, label="naive_port")
     except Exception as exc:  # noqa: BLE001
         _retry_or_bail(exc)
         return
@@ -722,7 +764,8 @@ def main():
             by_attn[impl or "oracle"] = measure(
                 lambda p, s, _i=impl: train_transformer_single(
                     p, s, toks, fam_d, lr=LR, seq_len=fam_T,
-                    n_heads=fam_H, attn_impl=_i), tf)
+                    n_heads=fam_H, attn_impl=_i), tf,
+                label=f"transformer_{impl or 'oracle'}")
         attn_win = max(by_attn, key=by_attn.get)
         sps = by_attn[attn_win]
         # the transformer bf16 policy at the winning attn impl (the
@@ -731,7 +774,7 @@ def main():
             lambda p, s: train_transformer_single(
                 p, s, toks, fam_d, lr=LR, seq_len=fam_T, n_heads=fam_H,
                 attn_impl=None if attn_win == "oracle" else attn_win,
-                mixed=True), tf)
+                mixed=True), tf, label="transformer_mixed")
         fams["transformer"] = {
             "steps_per_sec": round(sps, 4),
             "mfu": round(sps * block_flops / peak, 4),
@@ -764,7 +807,8 @@ def main():
                 by_policy[key] = measure(
                     lambda p, s, _a=a_impl, _h=h_impl: train_lm_single(
                         p, s, toks, fam_d, lr=LR, seq_len=fam_T,
-                        n_heads=fam_H, attn_impl=_a, head_impl=_h), lm)
+                        n_heads=fam_H, attn_impl=_a, head_impl=_h), lm,
+                    label=f"lm_{key}")
         win = max(by_policy, key=by_policy.get)
         sps = by_policy[win]
         # the LM bf16 policy (bf16 trunk/residuals, f32 head+master) at
@@ -776,7 +820,7 @@ def main():
                 p, s, toks, fam_d, lr=LR, seq_len=fam_T, n_heads=fam_H,
                 attn_impl=None if win_a == "oracle" else win_a,
                 head_impl=None if win_h == "oracle" else win_h,
-                mixed=True), lm)
+                mixed=True), lm, label="lm_mixed")
         fams["lm"] = {
             "steps_per_sec": round(sps, 4),
             "mfu": round(sps * (block_flops + head_flops) / peak, 4),
@@ -853,7 +897,7 @@ def main():
             by_pol[pol] = measure(
                 lambda p, s, _r=flag: train_single(
                     p, s, TOKENS, D_MODEL, lr=LR, mixed=True, remat=_r),
-                params)
+                params, label=f"bf16_{pol}")
         pol = max(by_pol, key=by_pol.get)
         bf16_sps = by_pol[pol]
         payload["bf16_steps_per_sec"] = round(bf16_sps, 4)
@@ -880,7 +924,8 @@ def main():
             return measure(
                 lambda p, s: train_single(p, s, TOKENS, D_MODEL, lr=LR,
                                           use_pallas=True,
-                                          interpret=interp), params)
+                                          interpret=interp), params,
+                label="pallas_ffn")
 
         if os.environ.get("BENCH_PALLAS_SWEEP", "0") == "1":
             combos = [(256, 512, 256), (512, 512, 256),

@@ -28,8 +28,6 @@ TRACE_TOKENS=128 python bench_trace.py`` (8 fake devices are set up
 automatically off-TPU so the collectives are real).
 """
 
-import glob
-import gzip
 import json
 import os
 import sys
@@ -40,7 +38,6 @@ if os.environ.get("BENCH_PLATFORM"):
                                + " --xla_force_host_platform_device_count=8")
 
 import jax
-import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
@@ -52,41 +49,11 @@ L = int(os.environ.get("TRACE_LAYERS", 8))
 TOKENS = int(os.environ.get("TRACE_TOKENS", 4096))
 STEPS = int(os.environ.get("TRACE_STEPS", 8))
 
-_COMM = ("all-gather", "all_gather", "reduce-scatter", "reduce_scatter",
-         "all-reduce", "all_reduce", "copy-start", "collective-permute",
-         "dma")
-_COMPUTE = ("fusion", "dot", "convolution", "matmul")
-
-
-def _spans(trace_dir):
-    """All complete events from the newest chrome trace under trace_dir."""
-    files = sorted(glob.glob(os.path.join(
-        trace_dir, "**", "*.trace.json.gz"), recursive=True),
-        key=os.path.getmtime)
-    if not files:
-        return None, []
-    with gzip.open(files[-1], "rt") as f:
-        events = json.load(f).get("traceEvents", [])
-    return files[-1], [e for e in events
-                       if e.get("ph") == "X" and e.get("name")]
-
-
-def _overlap(spans):
-    """Per-lane comm-vs-compute interval intersection."""
-    comm, compute = [], []
-    for e in spans:
-        name = e["name"].lower()
-        iv = (e.get("pid"), e["ts"], e["ts"] + e.get("dur", 0))
-        if any(k in name for k in _COMM):
-            comm.append(iv)
-        elif any(k in name for k in _COMPUTE):
-            compute.append(iv)
-    overlap_us = 0.0
-    for pid, c0, c1 in comm:
-        for qid, f0, f1 in compute:
-            if pid == qid:
-                overlap_us += max(0.0, min(c1, f1) - max(c0, f0))
-    return len(comm), len(compute), overlap_us
+# span parsing/classification now lives in the importable library
+# (utils/trace_analysis.py — the run-report tool folds the same
+# analysis); this script keeps only the capture + artifact shaping
+from distributed_llm_code_samples_tpu.utils.trace_analysis import (
+    load_spans as _spans, overlap_payload, scope_totals)
 
 
 def main() -> int:
@@ -122,17 +89,20 @@ def main() -> int:
         sync(run(sp, seeds))
 
     trace_file, spans = _spans(out_dir)
-    n_comm, n_compute, overlap_us = _overlap(spans)
+    fold = overlap_payload(spans, trace_file)
+    region_us = {k: round(v, 1)
+                 for k, v in scope_totals(spans, "fsdp").items() if v}
     payload = {
         "metric": "fsdp_comm_compute_overlap_us",
-        "value": round(overlap_us, 1),
+        "value": fold["overlap_us"],
         "unit": "us",
         "devices": n,
         "shape": f"d{D}_L{L}_tok{TOKENS}_steps{STEPS}",
-        "trace_file": trace_file,
-        "n_spans": len(spans),
-        "comm_spans": n_comm,
-        "compute_spans": n_compute,
+        **fold,
+        # named-scope region fold (empty off-hardware: CPU traces don't
+        # carry op metadata into span names; on chip the fsdp/{fwd,bwd,
+        # comm,optim} regions land here)
+        "scope_totals_us": region_us,
         "device_kind": jax.devices()[0].device_kind,
     }
     if n == 1:

@@ -49,6 +49,7 @@ backends.
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import re
@@ -58,6 +59,7 @@ import zlib
 from typing import Any
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
@@ -463,13 +465,50 @@ def restore_checkpoint(ckpt_dir: str, target: Any, step: int | None = None,
                 f"shardings tree has {len(sh_leaves)} leaves but params "
                 f"tree has {len(new_leaves)} — pass one sharding per leaf "
                 "(or a single sharding for all)")
-        new_leaves = [jax.device_put(l, s)
+        new_leaves = [_owned_leaf(l, s)
                       for l, s in zip(new_leaves, sh_leaves)]
     else:
-        new_leaves = [jax.device_put(np.asarray(l)) for l in new_leaves]
+        new_leaves = [_owned_leaf(np.asarray(l)) for l in new_leaves]
     params = jax.tree_util.tree_unflatten(treedef, new_leaves)
     seeds = np.asarray(doc["seeds"], np.int32) if "seeds" in doc else None
     return params, int(doc["step"]), seeds
+
+
+def _owned_leaf(arr, sharding=None):
+    """Place a restored host array on device as FRESH, exclusively-owned
+    buffers — a jitted copy, never a bare ``device_put``.
+
+    ``device_put`` of a host array may zero-copy alias the numpy buffer
+    on CPU, and a replicating sharding can back several device views
+    with shared memory. Trainers DONATE restored leaves into their step
+    programs (``run_with_checkpointing`` threads ``(params, opt_state)``
+    straight into ``launch(donate_argnums=...)``), and donating a
+    shared/aliased buffer lets XLA reuse memory that another view still
+    reads — the rare wrong-resume race this exact test pinned:
+    ``tests/test_checkpoint.py::test_stateful_fsdp_checkpoint_resume_is_
+    exact`` flaked under non-alphabetical orderings with 100%-divergent
+    resumes. jit outputs never alias non-donated inputs (the
+    ``models.ffn_stack.clone_params`` guarantee), so the copy below is
+    the same ownership contract every launcher already applies to params
+    — extended to everything a restore produces."""
+    if sharding is not None:
+        # no host round-trip: multi-host (orbax) restores hand over
+        # global arrays that are not fully addressable — the jitted copy
+        # reshards them on device, numpy inputs upload as before
+        return _sharded_copy_fn(sharding)(arr)
+    return _owned_copy_fn()(np.asarray(arr))
+
+
+@functools.lru_cache(maxsize=256)
+def _sharded_copy_fn(sharding):
+    """One cached jit per target sharding: a per-leaf fresh ``jax.jit``
+    would re-trace (and re-compile) every leaf of every restore."""
+    return jax.jit(jnp.copy, out_shardings=sharding)
+
+
+@functools.lru_cache(maxsize=1)
+def _owned_copy_fn():
+    return jax.jit(jnp.copy)
 
 
 def _leaf_finite(leaf) -> bool:

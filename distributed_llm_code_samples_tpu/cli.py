@@ -194,10 +194,36 @@ def build_parser() -> argparse.ArgumentParser:
                         "single --method")
     p.add_argument("--max_restarts", type=int, default=3,
                    help="with --chaos: the supervisor's restart budget")
+    p.add_argument("--metrics_dir", default=None,
+                   help="write the unified telemetry stream here "
+                        "(runtime/telemetry.py): one schema-versioned "
+                        "JSONL record per logged step (loss/grad-norm "
+                        "where the family defines them, tokens/s, step "
+                        "wall-time, MFU from the hand FLOP count, "
+                        "per-device HBM high-water) plus every "
+                        "recovery/chaos event; fold it into a "
+                        "human-readable report with the `report` "
+                        "subcommand")
+    p.add_argument("--log_every", type=int, default=0,
+                   help="with --metrics_dir: emit one metrics record "
+                        "every N steps by driving the run in N-step "
+                        "programs (0 = one record for the whole run); "
+                        "steps inside a chunk stay dispatch-only — "
+                        "device readbacks batch at this cadence. With "
+                        "--checkpoint_dir the records follow the "
+                        "checkpoint segments instead (--checkpoint_every)")
     return p
 
 
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "report":
+        # subcommand dispatch ahead of the flag parser: fold a
+        # --metrics_dir run (+ supervise attempt log + optional profile
+        # dir) into one human-readable run report
+        from .report import report_main
+        return report_main(argv[1:])
     p = build_parser()
     args = p.parse_args(argv)
     if args.mixed and args.pallas:
@@ -468,6 +494,70 @@ def main(argv=None) -> int:
         # verification oracle — they must never drift apart
         return args.dp or max(1, n_dev // args.tp)
 
+    if args.log_every < 0:
+        print(f"error: --log_every must be >= 0 (got {args.log_every})",
+              file=sys.stderr)
+        return 2
+    if args.log_every and not args.metrics_dir:
+        print("error: --log_every requires --metrics_dir",
+              file=sys.stderr)
+        return 2
+    metrics = None
+    peak = None
+    if args.metrics_dir:
+        from .runtime.telemetry import (TelemetryWriter,
+                                        hand_flops_per_step,
+                                        hbm_high_water, peak_flops)
+        device_kind = jax.devices()[0].device_kind
+        peak = peak_flops(device_kind)
+        metrics = TelemetryWriter(args.metrics_dir, meta={
+            "argv": list(argv),
+            "num_steps": args.num_steps, "batch_size": args.batch_size,
+            "seq_len": args.seq_len, "model_size": args.model_size,
+            "layers": args.layers, "method": args.method,
+            "tokens_per_step": tokens, "log_every": args.log_every,
+            "device_kind": device_kind, "n_devices": n_dev,
+            "chaos": args.chaos,
+            "checkpoint_dir": args.checkpoint_dir})
+
+    def make_probe(fam):
+        """Logged-step loss/grad-norm probe: one extra jitted fwd(+bwd)
+        at the LOGGING cadence only (never per step). Families without a
+        scalar objective report null loss; families without a probe
+        report both null."""
+        import jax.numpy as jnp
+
+        def gnorm_of(grads):
+            return jnp.sqrt(sum(
+                jnp.vdot(g, g).real
+                for g in jax.tree_util.tree_leaves(grads)))
+
+        if fam == "ffn":
+            from .data import batch_from_seed
+            from .parallel.ddp import grads_for_batch
+
+            @jax.jit
+            def probe(p, seed):
+                x, dy = batch_from_seed(seed, tokens, args.model_size,
+                                        p.w1.dtype)
+                return None, gnorm_of(grads_for_batch(p, x, dy))
+
+            return probe
+        if fam == "lm":
+            from .data import lm_batch_from_seed
+            from .models.lm import lm_loss
+
+            @jax.jit
+            def probe(p, seed):
+                tok, tgt = lm_batch_from_seed(seed, args.batch_size,
+                                              args.seq_len, p.vocab)
+                loss, grads = jax.value_and_grad(lm_loss)(
+                    p, tok, tgt, args.heads)
+                return loss, gnorm_of(grads)
+
+            return probe
+        return None
+
     if args.method == 0:
         selected = [1, 2, 3, 4]
     elif args.method == 9:
@@ -547,17 +637,39 @@ def main(argv=None) -> int:
             # timestamped trace run in the same directory)
             from .utils.profiling import profile_rank_0
             fn = profile_rank_0(os.path.join(args.profile_dir, name))(fn)
+        probe = model_flops = None
+        if metrics is not None:
+            fam = family_of(m)
+            model_flops = hand_flops_per_step(
+                fam, tokens=tokens, model_size=args.model_size,
+                n_layers=args.layers, seq_len=args.seq_len,
+                vocab=args.vocab)
+            attempt_log = None
+            if chaos_plan is not None:
+                # supervise's per-attempt JSONL (failure.py default
+                # path) — recorded ABSOLUTE so `report` folds it from
+                # any working directory without being told
+                attempt_log = os.path.abspath(os.path.join(
+                    args.checkpoint_dir, name, "supervise.jsonl"))
+            metrics.meta({"strategy": name, "family": fam,
+                          "model_flops_per_step": model_flops,
+                          "attempt_log": attempt_log,
+                          "note": "first logged chunk includes compile"})
+            probe = make_probe(fam)
+
+        # strategies that split seeds strided across a data-ish axis
+        # (data or expert; model/pipe axes replicate seeds) need every
+        # chunk length divisible by it — ONE derivation shared by the
+        # checkpoint segmenting and the metrics chunking below, so the
+        # two can never drift
+        seed_stride = 1
+        if mesh is not None:
+            seed_stride = (mesh.shape.get(DATA_AXIS, 1)
+                           * mesh.shape.get(EXPERT_AXIS, 1))
+
         t0 = time.time()
         if args.checkpoint_dir:
             from .checkpoint import run_with_checkpointing
-            # strategies that split seeds strided across a data-ish axis
-            # (data or expert; model/pipe axes replicate seeds) need
-            # every/len(seeds) divisible by it — validated up front.
-            # Derived from the mesh so new strategies can't drift past it.
-            divisor = 1
-            if mesh is not None:
-                divisor = (mesh.shape.get(DATA_AXIS, 1)
-                           * mesh.shape.get(EXPERT_AXIS, 1))
             ck_kwargs = dict(kwargs)
             opt = ck_kwargs.pop("optimizer", None)
             stateful_opt = opt is not None and not opt.stateless
@@ -567,6 +679,26 @@ def main(argv=None) -> int:
                 # materialize full params + Adam moments on one device
                 from .parallel.fsdp import checkpoint_shardings
                 restore_shardings = checkpoint_shardings(params, opt, mesh)
+            if metrics is not None:
+                # bridge checkpoint/supervise events into the telemetry
+                # stream AND synthesize one step record per published
+                # segment (wall-time between publishes / segment length;
+                # readbacks only at this cadence)
+                last_pub = {"t": time.perf_counter()}
+
+                def on_event(rec, _name=name, _flops=model_flops):
+                    metrics.event(dict(rec, strategy=_name))
+                    if rec.get("event") != "published":
+                        return
+                    now = time.perf_counter()
+                    a, b = rec.get("steps", (rec["step"], rec["step"]))
+                    dt, last_pub["t"] = now - last_pub["t"], now
+                    metrics.step(step=int(rec["step"]), strategy=_name,
+                                 step_time_s=dt / max(1, b - a + 1),
+                                 tokens=tokens, model_flops=_flops,
+                                 peak=peak, hbm=hbm_high_water())
+
+                ck_kwargs["on_event"] = on_event
             runner = run_with_checkpointing
             if chaos_plan is not None:
                 # fault load goes through the failure supervisor: a
@@ -581,13 +713,65 @@ def main(argv=None) -> int:
                 fn, params, seeds, tokens, args.model_size,
                 ckpt_dir=os.path.join(args.checkpoint_dir, name),
                 every=args.checkpoint_every, resume=not args.no_resume,
-                seeds_divisor=divisor, backend=args.checkpoint_backend,
+                seeds_divisor=seed_stride,
+                backend=args.checkpoint_backend,
                 optimizer=opt,
                 # train_ddp threads (params, opt_state) through segments;
                 # ZeRO-1's sharded state has no such surface yet
                 thread_state=stateful_opt and not args.zero1,
                 stateful=stateful_opt and args.zero1,
                 restore_shardings=restore_shardings, **ck_kwargs)
+        elif metrics is not None:
+            # metrics-chunked driving: the schedule runs as log_every-step
+            # compiled programs; steps inside a chunk stay dispatch-only
+            # and every readback (wall-clock fence, probe, HBM stats)
+            # batches at the chunk boundary — the logged step.
+            chunk = args.log_every if args.log_every > 0 else len(seeds)
+            opt = kwargs.get("optimizer")
+            if opt is not None and not getattr(opt, "stateless", False):
+                # stateful optimizers carry state INSIDE each trainer
+                # call; chunked calls would re-init it and change the
+                # math — fall back to one whole-run record
+                print(f"metrics: --log_every ignored for {name} with a "
+                      "stateful optimizer (state is per-call; chunked "
+                      "driving would re-initialize it); logging one "
+                      "whole-run record", file=sys.stderr)
+                chunk = len(seeds)
+            elif chunk % seed_stride or (len(seeds) % chunk) % seed_stride:
+                # every chunk (including the final partial one) must
+                # divide across the strided seed split, exactly like
+                # --checkpoint_every (run_with_checkpointing validates
+                # the same invariant)
+                print(f"metrics: --log_every {chunk} does not tile "
+                      f"{len(seeds)} steps across the {seed_stride}-way "
+                      f"seed stride of {name}; logging one whole-run "
+                      "record", file=sys.stderr)
+                chunk = len(seeds)
+            out = params
+            done = 0
+            while done < len(seeds):
+                n_chunk = int(min(chunk, len(seeds) - done))
+                tc = time.perf_counter()
+                out = fn(out, seeds[done:done + n_chunk], tokens,
+                         args.model_size, **kwargs)
+                jax.block_until_ready(out)
+                dt = time.perf_counter() - tc
+                done += n_chunk
+                loss = gnorm = None
+                if probe is not None:
+                    try:
+                        loss, gnorm = probe(
+                            out, seeds[min(done, len(seeds) - 1)])
+                    except Exception as e:  # noqa: BLE001 — never kill the run
+                        print(f"metrics: probe disabled for {name} "
+                              f"({type(e).__name__}: {str(e)[:120]})",
+                              file=sys.stderr)
+                        probe = None
+                metrics.step(step=done, strategy=name, loss=loss,
+                             grad_norm=gnorm,
+                             step_time_s=dt / n_chunk, tokens=tokens,
+                             model_flops=model_flops, peak=peak,
+                             hbm=hbm_high_water())
         else:
             out = fn(params, seeds, tokens, args.model_size, **kwargs)
         jax.block_until_ready(out)
@@ -683,6 +867,8 @@ def main(argv=None) -> int:
                     print(f"SoftAssertionError: {la}{field} vs "
                           f"{lb}{field} max|diff|={np.abs(pa - pb).max()}")
                     failed = True
+    if metrics is not None:
+        metrics.close()  # drain the writer: records are on disk on exit
     return 1 if (failed and args.strict) else 0
 
 

@@ -54,20 +54,24 @@ def stack_fwd(w1s: jax.Array, w2s: jax.Array, x: jax.Array, *,
     responsible for any gathering.
     """
     n_layers = w1s.shape[0]
-    if unroll:
-        acts = []
-        y = x
-        for l in range(n_layers):
-            acts.append(y)
-            y = block_fwd(w1s[l], w2s[l], y)
-        return y, jnp.stack(acts)
+    # the "fwd" named-scope region: every strategy's forward walk carries
+    # it (nested under the strategy's own scope — utils/trace_analysis.py
+    # documents the naming map; HLO metadata and profiler spans key on it)
+    with jax.named_scope("fwd"):
+        if unroll:
+            acts = []
+            y = x
+            for l in range(n_layers):
+                acts.append(y)
+                y = block_fwd(w1s[l], w2s[l], y)
+            return y, jnp.stack(acts)
 
-    def body(y, layer):
-        w1, w2 = layer
-        return block_fwd(w1, w2, y), y
+        def body(y, layer):
+            w1, w2 = layer
+            return block_fwd(w1, w2, y), y
 
-    y, acts = lax.scan(body, x, (w1s, w2s))
-    return y, acts
+        y, acts = lax.scan(body, x, (w1s, w2s))
+        return y, acts
 
 
 def stack_bwd(dy: jax.Array, w1s: jax.Array, w2s: jax.Array,
@@ -83,24 +87,28 @@ def stack_bwd(dy: jax.Array, w1s: jax.Array, w2s: jax.Array,
     injection point.
     """
     n_layers = acts.shape[0]
-    if unroll:
-        g1, g2 = [None] * n_layers, [None] * n_layers
-        for l in reversed(range(n_layers)):
-            dy, (dw1, dw2) = block_bwd(dy, w1s[l], w2s[l], acts[l])
+    # the "bwd" named-scope region — the hook's collectives nest inside
+    # it (e.g. DDP's grad psum shows as .../bwd/comm)
+    with jax.named_scope("bwd"):
+        if unroll:
+            g1, g2 = [None] * n_layers, [None] * n_layers
+            for l in reversed(range(n_layers)):
+                dy, (dw1, dw2) = block_bwd(dy, w1s[l], w2s[l], acts[l])
+                if grad_hook is not None:
+                    dw1, dw2 = grad_hook(dw1, dw2)
+                g1[l], g2[l] = dw1, dw2
+            return dy, (jnp.stack(g1), jnp.stack(g2))
+
+        def body(dy, xs):
+            w1, w2, act = xs
+            dy, (dw1, dw2) = block_bwd(dy, w1, w2, act)
             if grad_hook is not None:
                 dw1, dw2 = grad_hook(dw1, dw2)
-            g1[l], g2[l] = dw1, dw2
-        return dy, (jnp.stack(g1), jnp.stack(g2))
+            return dy, (dw1, dw2)
 
-    def body(dy, xs):
-        w1, w2, act = xs
-        dy, (dw1, dw2) = block_bwd(dy, w1, w2, act)
-        if grad_hook is not None:
-            dw1, dw2 = grad_hook(dw1, dw2)
-        return dy, (dw1, dw2)
-
-    dx, (g1s, g2s) = lax.scan(body, dy, (w1s, w2s, acts), reverse=True)
-    return dx, (g1s, g2s)
+        dx, (g1s, g2s) = lax.scan(body, dy, (w1s, w2s, acts),
+                                  reverse=True)
+        return dx, (g1s, g2s)
 
 
 def accumulated_grads(grad_fn, x: jax.Array, dy: jax.Array, accum: int):
@@ -166,6 +174,11 @@ def stack_grads(w1s: jax.Array, w2s: jax.Array, x: jax.Array,
         return lax.scan(lambda y, wp: (block(wp[0], wp[1], y), None),
                         x, (w1s, w2s))[0]
 
-    y, vjp = jax.vjp(fwd, w1s, w2s)
-    g1s, g2s = vjp(dy)
+    # fwd/bwd named-scope regions: jax.vjp traces the forward here, and
+    # calling the vjp traces the transpose — so the two phases carry
+    # distinct scope names even though autograd composes the chain
+    with jax.named_scope("fwd"):
+        y, vjp = jax.vjp(fwd, w1s, w2s)
+    with jax.named_scope("bwd"):
+        g1s, g2s = vjp(dy)
     return y, (g1s, g2s)

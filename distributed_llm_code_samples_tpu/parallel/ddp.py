@@ -105,7 +105,8 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
         reduce = lambda g: all_reduce(g, axis)  # noqa: E731
 
     def grad_hook(dw1, dw2):  # fires per layer, like train_ffns.py:164-165
-        return reduce(dw1), reduce(dw2)
+        with jax.named_scope("comm"):  # -> ddp/bwd/comm in traces/HLO
+            return reduce(dw1), reduce(dw2)
 
     def grads_of(params, seed):
         if accum == 1:
@@ -113,14 +114,23 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
                                unroll, grad_hook, mixed=mixed)
         total = local_grads(params, seed, batch_size, model_size, unroll,
                             accum=accum, mixed=mixed)
-        return jax.tree_util.tree_map(reduce, total)
+        with jax.named_scope("comm"):  # one tree-wide reduction
+            return jax.tree_util.tree_map(reduce, total)
 
     def step(params: FFNStackParams, seed) -> FFNStackParams:
-        return sgd(params, grads_of(params, seed), lr)
+        # named-scope regions (ddp/fwd, ddp/bwd, ddp/bwd/comm, ddp/optim)
+        # — the naming map lives in utils/trace_analysis.SCOPES
+        with jax.named_scope("ddp"):
+            grads = grads_of(params, seed)
+            with jax.named_scope("optim"):
+                return sgd(params, grads, lr)
 
     def step_opt(carry, seed):
         params, state = carry
-        return optimizer.update(grads_of(params, seed), state, params, lr)
+        with jax.named_scope("ddp"):
+            grads = grads_of(params, seed)
+            with jax.named_scope("optim"):
+                return optimizer.update(grads, state, params, lr)
 
     return step if optimizer is None else step_opt
 

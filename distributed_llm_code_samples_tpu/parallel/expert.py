@@ -85,14 +85,18 @@ def moe_layer_ep(wg, w1_local, w2_local, x, capacity_factor: float = 2.0,
     (``ops.pallas_ring.all_to_all_dma_dims``)."""
     if comm == "pallas_a2a":
         from ..ops.pallas_ring import all_to_all_dma_dims
-        a2a = lambda t, sd, cd: all_to_all_dma_dims(  # noqa: E731
+        _a2a = lambda t, sd, cd: all_to_all_dma_dims(  # noqa: E731
             t, axis, sd, cd, None)
     elif comm == "psum":
-        a2a = lambda t, sd, cd: all_to_all(t, axis, split_dim=sd,  # noqa: E731
-                                           concat_dim=cd)
+        _a2a = lambda t, sd, cd: all_to_all(t, axis, split_dim=sd,  # noqa: E731
+                                            concat_dim=cd)
     else:
         raise ValueError(f"unknown comm {comm!r} "
                          "(expected 'psum' or 'pallas_a2a')")
+
+    def a2a(t, sd, cd):
+        with jax.named_scope("comm"):  # dispatch/return -> ep/.../comm
+            return _a2a(t, sd, cd)
     n_experts = wg.shape[0]
     t = x.shape[0]
     cap = _local_capacity(t, lax.axis_size(axis), n_experts,
@@ -180,27 +184,35 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
         return x, aux
 
     def step(params: MoEStackParams, seed) -> MoEStackParams:
-        x, dloss_dx = batch_from_seed(seed, batch_size, model_size,
-                                      params.w1.dtype)
-        _, vjp = jax.vjp(lambda p: fwd_aux(p, x), params)
-        # the aux output is shard-varying under shard_map; its cotangent
-        # (the constant aux coefficient) must be cast to match — over
-        # every axis the aux varies on (a 2-D mesh adds "data")
-        coef = lax.pcast(jnp.asarray(aux_coef, jnp.float32), axes,
-                         to="varying")
-        grads = vjp((dloss_dx, coef))[0]
-        # router is replicated; its per-shard partial grads sum across the
-        # expert axis (train_ffns.py:165 semantics) — and across the data
-        # axis on a 2-D mesh. Expert grads are complete on their owner
-        # shard within an EP group; the data axis replicates the groups,
-        # so they too sum over data (grad_reduce is vma-aware: it never
-        # touches the expert axis for them).
-        grads = grads._replace(wg=reducer(grads.wg, axes))
-        if data_axis is not None:
-            grads = grads._replace(
-                w1=reducer(grads.w1, data_axis),
-                w2=reducer(grads.w2, data_axis))
-        return sgd(params, grads, lr)
+        # named-scope regions (ep/fwd, ep/bwd, nested comm on the a2a
+        # pair and the router psum, ep/optim)
+        with jax.named_scope("ep"):
+            x, dloss_dx = batch_from_seed(seed, batch_size, model_size,
+                                          params.w1.dtype)
+            with jax.named_scope("fwd"):
+                _, vjp = jax.vjp(lambda p: fwd_aux(p, x), params)
+            # the aux output is shard-varying under shard_map; its cotangent
+            # (the constant aux coefficient) must be cast to match — over
+            # every axis the aux varies on (a 2-D mesh adds "data")
+            coef = lax.pcast(jnp.asarray(aux_coef, jnp.float32), axes,
+                             to="varying")
+            with jax.named_scope("bwd"):
+                grads = vjp((dloss_dx, coef))[0]
+            with jax.named_scope("comm"):
+                # router is replicated; its per-shard partial grads sum
+                # across the expert axis (train_ffns.py:165 semantics) —
+                # and across the data axis on a 2-D mesh. Expert grads
+                # are complete on their owner shard within an EP group;
+                # the data axis replicates the groups, so they too sum
+                # over data (grad_reduce is vma-aware: it never touches
+                # the expert axis for them).
+                grads = grads._replace(wg=reducer(grads.wg, axes))
+                if data_axis is not None:
+                    grads = grads._replace(
+                        w1=reducer(grads.w1, data_axis),
+                        w2=reducer(grads.w2, data_axis))
+            with jax.named_scope("optim"):
+                return sgd(params, grads, lr)
 
     return step
 

@@ -128,10 +128,11 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
         # tiled concat matches the torch.cat re-assembly (:209). Under
         # `mixed` the shards are cast bf16 BEFORE the gather: half the
         # bytes on the wire, same gathered values.
-        if mixed:
-            w1_shard = w1_shard.astype(jnp.bfloat16)
-            w2_shard = w2_shard.astype(jnp.bfloat16)
-        return _ag(w1_shard), _ag(w2_shard)
+        with jax.named_scope("comm"):  # -> fsdp/{fwd,bwd}/comm
+            if mixed:
+                w1_shard = w1_shard.astype(jnp.bfloat16)
+                w2_shard = w2_shard.astype(jnp.bfloat16)
+            return _ag(w1_shard), _ag(w2_shard)
 
     fwd = ffn_fwd_mixed if mixed else ffn_fwd
     bwd = ffn_bwd_mixed if mixed else ffn_bwd
@@ -149,7 +150,8 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
     def grad_hook(dw1, dw2):
         # The VJP of all_gather is reduce_scatter: full grads -> summed shard
         # (train_ffns.py:255-256), SUM semantics, unscaled LR.
-        return _rs(dw1), _rs(dw2)
+        with jax.named_scope("comm"):
+            return _rs(dw1), _rs(dw2)
 
     def local_grads_of(params, seed):
         x, dloss_dx = batch_from_seed(seed, batch_size, model_size,
@@ -162,13 +164,20 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
         return FFNStackParams(g1, g2)
 
     def step(params: FFNStackParams, seed) -> FFNStackParams:
-        # Sharded SGD on the local chunk only (train_ffns.py:258-259).
-        return sgd(params, local_grads_of(params, seed), lr)
+        # named-scope regions (fsdp/fwd, fsdp/bwd, nested comm on every
+        # gather/scatter, fsdp/optim) — utils/trace_analysis.SCOPES
+        with jax.named_scope("fsdp"):
+            grads = local_grads_of(params, seed)
+            with jax.named_scope("optim"):
+                # Sharded SGD on the local chunk only (train_ffns.py:258-259).
+                return sgd(params, grads, lr)
 
     def step_opt(carry, seed):
         params, state = carry
-        return optimizer.update(local_grads_of(params, seed), state,
-                                params, lr)
+        with jax.named_scope("fsdp"):
+            grads = local_grads_of(params, seed)
+            with jax.named_scope("optim"):
+                return optimizer.update(grads, state, params, lr)
 
     return step if optimizer is None else step_opt
 

@@ -17,6 +17,7 @@ differential tests assert both degeneracies plus DDP(d) == hybrid(d x m).
 
 from __future__ import annotations
 
+import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import LR
@@ -45,25 +46,33 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
     bwd = ffn_bwd_mixed if mixed else ffn_bwd
 
     def block_fwd(w1_shard, w2_shard, x):
-        return all_reduce(fwd(w1_shard, w2_shard, x), MODEL_AXIS)
+        y = fwd(w1_shard, w2_shard, x)
+        with jax.named_scope("comm"):  # TP psum -> hybrid/fwd/comm
+            return all_reduce(y, MODEL_AXIS)
 
     def block_bwd(dy, w1_shard, w2_shard, x):
         dx, grads = bwd(dy, w1_shard, w2_shard, x)
-        return all_reduce(dx, MODEL_AXIS), grads
+        with jax.named_scope("comm"):
+            return all_reduce(dx, MODEL_AXIS), grads
 
     def grad_hook(dw1, dw2):
         # DDP reduction of the TP-local weight-grad shards across replicas.
-        return (all_reduce(dw1, DATA_AXIS), all_reduce(dw2, DATA_AXIS))
+        with jax.named_scope("comm"):
+            return (all_reduce(dw1, DATA_AXIS), all_reduce(dw2, DATA_AXIS))
 
     def step(params: FFNStackParams, seed) -> FFNStackParams:
-        x, dloss_dx = batch_from_seed(seed, batch_size, model_size,
-                                      params.w1.dtype)
-        _, acts = stack_fwd(params.w1, params.w2, x, block_fwd=block_fwd,
-                            unroll=unroll)
-        _, (g1, g2) = stack_bwd(dloss_dx, params.w1, params.w2, acts,
-                                block_bwd=block_bwd, grad_hook=grad_hook,
-                                unroll=unroll)
-        return sgd(params, FFNStackParams(g1, g2), lr)
+        # named-scope regions (hybrid/fwd, hybrid/bwd, nested comm on
+        # both axes' collectives, hybrid/optim)
+        with jax.named_scope("hybrid"):
+            x, dloss_dx = batch_from_seed(seed, batch_size, model_size,
+                                          params.w1.dtype)
+            _, acts = stack_fwd(params.w1, params.w2, x,
+                                block_fwd=block_fwd, unroll=unroll)
+            _, (g1, g2) = stack_bwd(dloss_dx, params.w1, params.w2, acts,
+                                    block_bwd=block_bwd,
+                                    grad_hook=grad_hook, unroll=unroll)
+            with jax.named_scope("optim"):
+                return sgd(params, FFNStackParams(g1, g2), lr)
 
     return step
 

@@ -14,6 +14,19 @@ from typing import Callable
 import jax
 from jax import lax
 
+# Test/introspection hook: when a list is installed here, every launch
+# also lowers+compiles its program AOT and appends the optimized HLO
+# text (the named-scope presence contract is asserted against the REAL
+# launched program, not a reconstruction — tests/test_telemetry.py).
+# None (the default) costs nothing.
+CAPTURE_COMPILED: list | None = None
+
+
+def _maybe_capture(jitted, *args) -> None:
+    if CAPTURE_COMPILED is not None:
+        CAPTURE_COMPILED.append(
+            jitted.lower(*args).compile().as_text())
+
 
 def launch(step: Callable, params, seeds_arr, mesh, param_specs, seed_spec,
            select_local: Callable = lambda s: s,
@@ -57,8 +70,9 @@ def launch(step: Callable, params, seeds_arr, mesh, param_specs, seed_spec,
             run_state, mesh=mesh,
             in_specs=(param_specs, state_specs, seed_spec),
             out_specs=out_specs, check_vma=check_vma)
-        return jax.jit(run_sharded, donate_argnums=(0, 1))(params, state,
-                                                           seeds_arr)
+        jitted = jax.jit(run_sharded, donate_argnums=(0, 1))
+        _maybe_capture(jitted, params, state, seeds_arr)
+        return jitted(params, state, seeds_arr)
 
     def run(params, seeds):
         local = select_local(seeds)
@@ -70,7 +84,9 @@ def launch(step: Callable, params, seeds_arr, mesh, param_specs, seed_spec,
                                 in_specs=(param_specs, seed_spec),
                                 out_specs=param_specs,
                                 check_vma=check_vma)
-    return jax.jit(run_sharded, donate_argnums=0)(params, seeds_arr)
+    jitted = jax.jit(run_sharded, donate_argnums=0)
+    _maybe_capture(jitted, params, seeds_arr)
+    return jitted(params, seeds_arr)
 
 
 def launch_strided(step: Callable, params, seeds, mesh, axis: str,

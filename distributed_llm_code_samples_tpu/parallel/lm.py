@@ -100,27 +100,40 @@ def _make_step(batch_size: int, model_size: int, seq_len: int,
         tokens, targets = (batch_fn(seed) if batch_fn is not None else
                            lm_batch_from_seed(seed, b, seq_len,
                                               params.vocab))
-        grads = jax.grad(lm_loss)(params, tokens, targets, n_heads, attn,
-                                  head, mixed)
+        with jax.named_scope("fwd"):
+            # autodiff strategy: jax.grad traces forward and transpose in
+            # one call, so the "fwd" region also tags the backward ops
+            # (the naming-map caveat, utils/trace_analysis.py)
+            grads = jax.grad(lm_loss)(params, tokens, targets, n_heads,
+                                      attn, head, mixed)
         if reduce_axes:
-            # force_reduce: the launcher runs check_vma=False (interpret-
-            # mode multi-tile Pallas kernels can't type-check), which
-            # erases the provenance signal grad_reduce keys on AND stops
-            # the transpose machinery's auto-psum — cotangents of
-            # replicated params arrive partial. Unconditional psum is
-            # then the correct (single) reduction — the expert.py
-            # pallas_a2a contract, pinned there both ways.
-            grads = jax.tree_util.tree_map(
-                lambda g: grad_reduce(g, reduce_axes,
-                                      force=force_reduce), grads)
+            with jax.named_scope("comm"):
+                # force_reduce: the launcher runs check_vma=False
+                # (interpret-mode multi-tile Pallas kernels can't
+                # type-check), which erases the provenance signal
+                # grad_reduce keys on AND stops the transpose machinery's
+                # auto-psum — cotangents of replicated params arrive
+                # partial. Unconditional psum is then the correct (single)
+                # reduction — the expert.py pallas_a2a contract, pinned
+                # there both ways.
+                grads = jax.tree_util.tree_map(
+                    lambda g: grad_reduce(g, reduce_axes,
+                                          force=force_reduce), grads)
         return grads
 
     def step(params: LMParams, seed) -> LMParams:
-        return sgd(params, grads_of(params, seed), lr)
+        # named-scope regions (lm/fwd, lm/comm on DDP meshes, lm/optim)
+        with jax.named_scope("lm"):
+            grads = grads_of(params, seed)
+            with jax.named_scope("optim"):
+                return sgd(params, grads, lr)
 
     def step_opt(carry, seed):
         params, state = carry
-        return optimizer.update(grads_of(params, seed), state, params, lr)
+        with jax.named_scope("lm"):
+            grads = grads_of(params, seed)
+            with jax.named_scope("optim"):
+                return optimizer.update(grads, state, params, lr)
 
     return step if optimizer is None else step_opt
 
@@ -295,9 +308,10 @@ def train_lm_fsdp(params: LMParams, seeds, batch_size: int, model_size: int,
 
         def loss_fn(p: LMParams):
             bf16 = jnp.bfloat16
-            wte = all_gather(p.wte, DATA_AXIS, dim=0)
-            wpe = all_gather(p.wpe, DATA_AXIS, dim=0)
-            ln_f = all_gather(p.ln_f, DATA_AXIS, dim=0)
+            with jax.named_scope("comm"):
+                wte = all_gather(p.wte, DATA_AXIS, dim=0)
+                wpe = all_gather(p.wpe, DATA_AXIS, dim=0)
+                ln_f = all_gather(p.ln_f, DATA_AXIS, dim=0)
             if mixed:
                 # trunk in bf16 (embedding lookup + positions cast
                 # after the f32 wte gather — wte also serves the f32
@@ -311,9 +325,10 @@ def train_lm_fsdp(params: LMParams, seeds, batch_size: int, model_size: int,
                 # collective bytes (the FFN-FSDP mixed stance); cast of
                 # the shard then concat == concat then cast, so the
                 # values equal the single-device bf16 trunk's
-                full = (all_gather(leaf[l].astype(bf16) if mixed
-                                   else leaf[l], DATA_AXIS, dim=0)
-                        for leaf in p.blocks)
+                with jax.named_scope("comm"):
+                    full = [all_gather(leaf[l].astype(bf16) if mixed
+                                       else leaf[l], DATA_AXIS, dim=0)
+                            for leaf in p.blocks]
                 x = transformer_block(*full, x, n_heads, causal=True,
                                       attn=attn)
             h = layernorm(ln_f, x)
@@ -326,14 +341,21 @@ def train_lm_fsdp(params: LMParams, seeds, batch_size: int, model_size: int,
             return xent_loss(logits.reshape(-1, wte.shape[0]),
                              targets.reshape(-1))
 
-        return jax.grad(loss_fn)(params)
+        with jax.named_scope("fwd"):
+            return jax.grad(loss_fn)(params)
 
     def step(params: LMParams, seed) -> LMParams:
-        return sgd(params, grads_of(params, seed), lr)
+        with jax.named_scope("lm"):
+            grads = grads_of(params, seed)
+            with jax.named_scope("optim"):
+                return sgd(params, grads, lr)
 
     def step_opt(carry, seed):
         params, state = carry
-        return optimizer.update(grads_of(params, seed), state, params, lr)
+        with jax.named_scope("lm"):
+            grads = grads_of(params, seed)
+            with jax.named_scope("optim"):
+                return optimizer.update(grads, state, params, lr)
 
     sharded = _shard(params, mesh, _lm_fsdp_specs())
     check = _vma_check(attn_impl, head_impl)
@@ -495,44 +517,55 @@ def _make_tp_step(batch_size: int, model_size: int, seq_len: int,
             logits_local = h.reshape(-1, model_size) @ p.wte.T
             return vp_xent(logits_local, targets.reshape(-1))
 
-        grads = jax.grad(loss_fn)(params)
-        # wpe and the LN gains saw complete, replicated dx — but the
-        # cotangents produced inside the hand-written rules come back
-        # typed varying; grad_reduce psums exactly the pending ones.
-        # Head/projection/FFN grads are shard-complete on the model axis
-        # and reduce only over the data axes (hybrid). force_reduce:
-        # vma-off launch (interpret-mode fused head) — unconditional
-        # psum, the _make_step contract.
-        model_and_data = (MODEL_AXIS,) + data_axes
-        grads = grads._replace(
-            wpe=grad_reduce(grads.wpe, model_and_data, force=force_reduce),
-            ln_f=grad_reduce(grads.ln_f, model_and_data,
-                             force=force_reduce),
-            blocks=grads.blocks._replace(
-                ln1=grad_reduce(grads.blocks.ln1, model_and_data,
-                                force=force_reduce),
-                ln2=grad_reduce(grads.blocks.ln2, model_and_data,
-                                force=force_reduce)))
-        if data_axes:
-            # the four leaves above are already fully reduced (their
-            # psum covered the data axes too); under force their second
-            # psum would NOT no-op — restore them after the sweep
-            done = (grads.wpe, grads.ln_f, grads.blocks.ln1,
-                    grads.blocks.ln2)
-            grads = jax.tree_util.tree_map(
-                lambda g: grad_reduce(g, data_axes, force=force_reduce),
-                grads)
+        with jax.named_scope("fwd"):
+            grads = jax.grad(loss_fn)(params)
+        with jax.named_scope("comm"):
+            # wpe and the LN gains saw complete, replicated dx — but the
+            # cotangents produced inside the hand-written rules come back
+            # typed varying; grad_reduce psums exactly the pending ones.
+            # Head/projection/FFN grads are shard-complete on the model
+            # axis and reduce only over the data axes (hybrid).
+            # force_reduce: vma-off launch (interpret-mode fused head) —
+            # unconditional psum, the _make_step contract.
+            model_and_data = (MODEL_AXIS,) + data_axes
             grads = grads._replace(
-                wpe=done[0], ln_f=done[1],
-                blocks=grads.blocks._replace(ln1=done[2], ln2=done[3]))
+                wpe=grad_reduce(grads.wpe, model_and_data,
+                                force=force_reduce),
+                ln_f=grad_reduce(grads.ln_f, model_and_data,
+                                 force=force_reduce),
+                blocks=grads.blocks._replace(
+                    ln1=grad_reduce(grads.blocks.ln1, model_and_data,
+                                    force=force_reduce),
+                    ln2=grad_reduce(grads.blocks.ln2, model_and_data,
+                                    force=force_reduce)))
+            if data_axes:
+                # the four leaves above are already fully reduced (their
+                # psum covered the data axes too); under force their
+                # second psum would NOT no-op — restore them after the
+                # sweep
+                done = (grads.wpe, grads.ln_f, grads.blocks.ln1,
+                        grads.blocks.ln2)
+                grads = jax.tree_util.tree_map(
+                    lambda g: grad_reduce(g, data_axes,
+                                          force=force_reduce), grads)
+                grads = grads._replace(
+                    wpe=done[0], ln_f=done[1],
+                    blocks=grads.blocks._replace(ln1=done[2],
+                                                 ln2=done[3]))
         return grads
 
     def step(params: LMParams, seed) -> LMParams:
-        return sgd(params, grads_of(params, seed), lr)
+        with jax.named_scope("lm"):
+            grads = grads_of(params, seed)
+            with jax.named_scope("optim"):
+                return sgd(params, grads, lr)
 
     def step_opt(carry, seed):
         params, state = carry
-        return optimizer.update(grads_of(params, seed), state, params, lr)
+        with jax.named_scope("lm"):
+            grads = grads_of(params, seed)
+            with jax.named_scope("optim"):
+                return optimizer.update(grads, state, params, lr)
 
     return step if optimizer is None else step_opt
 
@@ -855,13 +888,18 @@ def train_lm_seq(params: LMParams, seeds, batch_size: int, model_size: int,
             return xent_loss(logits.reshape(-1, vocab),
                              targets.reshape(-1)) / n
 
-        grads = jax.grad(loss_fn)(params)
-        axes = (SEQ_AXIS, DATA_AXIS) if dp > 1 else (SEQ_AXIS,)
-        # vma-off (interpret-mode flash/fused head): force the psum —
-        # grad_reduce would silently no-op on the partial cotangents
-        grads = jax.tree_util.tree_map(
-            lambda g: grad_reduce(g, axes, force=not check), grads)
-        return sgd(params, grads, lr)
+        with jax.named_scope("lm"):
+            with jax.named_scope("fwd"):
+                grads = jax.grad(loss_fn)(params)
+            axes = (SEQ_AXIS, DATA_AXIS) if dp > 1 else (SEQ_AXIS,)
+            with jax.named_scope("comm"):
+                # vma-off (interpret-mode flash/fused head): force the
+                # psum — grad_reduce would silently no-op on the partial
+                # cotangents
+                grads = jax.tree_util.tree_map(
+                    lambda g: grad_reduce(g, axes, force=not check), grads)
+            with jax.named_scope("optim"):
+                return sgd(params, grads, lr)
     if dp > 1:
         return launch_strided(step, clone_params(params), seeds, mesh,
                               DATA_AXIS, P(), check_vma=check)

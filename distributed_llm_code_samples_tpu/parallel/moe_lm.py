@@ -100,8 +100,15 @@ def train_moe_lm_ep(params: MoELMParams, seeds, batch_size: int,
                                         head=head)
             return loss + aux_coef * aux.astype(loss.dtype)
 
-        grads = jax.grad(loss_fn)(params)
-        return sgd(params, _reduce_replicated(grads, force=not check), lr)
+        # named-scope regions (moe_lm/fwd, moe_lm/comm, moe_lm/optim;
+        # the a2a dispatch inside moe_layer_ep adds nested comm scopes)
+        with jax.named_scope("moe_lm"):
+            with jax.named_scope("fwd"):
+                grads = jax.grad(loss_fn)(params)
+            with jax.named_scope("comm"):
+                grads = _reduce_replicated(grads, force=not check)
+            with jax.named_scope("optim"):
+                return sgd(params, grads, lr)
 
     return launch_strided(step, clone_params(params), seeds, mesh,
                           EXPERT_AXIS, EP_LM_SPECS, check_vma=check)

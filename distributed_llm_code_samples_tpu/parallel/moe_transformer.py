@@ -95,18 +95,25 @@ def train_moe_transformer_ep(params: MoETransformerParams, seeds,
                                       params.w1.dtype)
         x = x.reshape(b_local, seq_len, model_size)
         dloss_dx = dloss_dx.reshape(b_local, seq_len, model_size)
-        _, vjp = jax.vjp(
-            lambda p: moe_transformer_fwd_aux(p, x, n_heads, causal,
-                                              moe_fn=moe_fn, attn=attn),
-            params)
-        coef = lax.pcast(jnp.asarray(aux_coef, jnp.float32), EXPERT_AXIS,
-                         to="varying")
-        grads = vjp((dloss_dx, coef))[0]
-        grads = grads._replace(**{
-            f: grad_reduce(getattr(grads, f), EXPERT_AXIS,
-                           force=vma_erased())
-            for f in _REPLICATED})
-        return sgd(params, grads, lr)
+        # named-scope regions (moe_tf/fwd, moe_tf/bwd, moe_tf/comm,
+        # moe_tf/optim; the a2a pair adds nested comm scopes)
+        with jax.named_scope("moe_tf"):
+            with jax.named_scope("fwd"):
+                _, vjp = jax.vjp(
+                    lambda p: moe_transformer_fwd_aux(
+                        p, x, n_heads, causal, moe_fn=moe_fn, attn=attn),
+                    params)
+            coef = lax.pcast(jnp.asarray(aux_coef, jnp.float32),
+                             EXPERT_AXIS, to="varying")
+            with jax.named_scope("bwd"):
+                grads = vjp((dloss_dx, coef))[0]
+            with jax.named_scope("comm"):
+                grads = grads._replace(**{
+                    f: grad_reduce(getattr(grads, f), EXPERT_AXIS,
+                                   force=vma_erased())
+                    for f in _REPLICATED})
+            with jax.named_scope("optim"):
+                return sgd(params, grads, lr)
 
     return launch_strided(step, clone_params(params), seeds, mesh,
                           EXPERT_AXIS, EP_SPECS)

@@ -97,6 +97,16 @@ from .collectives import all_reduce, ring_shift, axis_index, barrier
 from .launcher import launch, launch_strided
 from .mesh import DATA_AXIS, MODEL_AXIS, PIPE_AXIS, require_axes
 
+
+def _send(x, axis: str, shift: int):
+    """One inter-stage activation/grad transfer (``ring_shift``) under
+    the "comm" named scope — the pipeline's p2p traffic folds into the
+    pp strategy's comm region in traces and HLO
+    (utils/trace_analysis.SCOPES)."""
+    with jax.named_scope("comm"):
+        return ring_shift(x, axis, shift=shift)
+
+
 # Layers are staged: stacked layer axis sharded across the pipe ring.
 PARAM_SPECS = FFNStackParams(w1=P(PIPE_AXIS, None, None),
                              w2=P(PIPE_AXIS, None, None))
@@ -194,7 +204,7 @@ def _gpipe_step(params, x_mb, dy_mb, s, M: int, S: int,
         # bubble ticks skip the block compute entirely (idle branch), they
         # don't compute-and-mask
         stash, y = lax.cond(valid, fwd_branch, fwd_idle, stash)
-        state = ring_shift(y, axis, shift=1)
+        state = _send(y, axis, 1)
 
     # the reference's host-side Barrier between phases
     # (test_mp_barrier_gpus.py:32-34) becomes an in-program fence on
@@ -220,7 +230,7 @@ def _gpipe_step(params, x_mb, dy_mb, s, M: int, S: int,
             return grads, _vzeros(x_shape, dtype, vary_axes)
 
         grads, dx = lax.cond(valid, bwd_branch, bwd_idle, grads)
-        dstate = ring_shift(dx, axis, shift=-1)
+        dstate = _send(dx, axis, -1)
 
     return grads
 
@@ -283,8 +293,8 @@ def _1f1b_step(params, x_mb, dy_mb, s, M: int, S: int,
         which = jnp.where(f_valid, 1, jnp.where(b_valid, 2, 0))
         stash, grads, y, dx = lax.switch(
             which, (idle, fwd_branch, bwd_branch), (stash, grads))
-        state_f = ring_shift(y, axis, shift=1)
-        state_b = ring_shift(dx, axis, shift=-1)
+        state_f = _send(y, axis, 1)
+        state_b = _send(dx, axis, -1)
 
     return grads
 
@@ -424,7 +434,7 @@ def _interleaved_step(params, x_mb, dy_mb, s, M: int, S: int, V: int,
             return stash, _vzeros(x_shape, dtype, vary_axes)
 
         stash, y = lax.cond(valid, fwd_branch, fwd_idle, stash)
-        state = ring_shift(y, axis, shift=1)
+        state = _send(y, axis, 1)
 
     stash = barrier(stash, axis)  # the inter-phase fence (as in GPipe)
 
@@ -449,7 +459,7 @@ def _interleaved_step(params, x_mb, dy_mb, s, M: int, S: int, V: int,
             return grads, _vzeros(x_shape, dtype, vary_axes)
 
         grads, dx = lax.cond(valid, bwd_branch, bwd_idle, grads)
-        dstate = ring_shift(dx, axis, shift=-1)
+        dstate = _send(dx, axis, -1)
 
     # back to the flat (device-major) local layer axis
     return tmap(
@@ -516,20 +526,25 @@ def make_step(batch_size: int, model_size: int, n_stages: int,
         return dx, FFNStackParams(g1, g2)
 
     def step(params: FFNStackParams, seed) -> FFNStackParams:
-        s = axis_index(axis)
-        x, dloss_dx = batch_from_seed(seed, batch_size, model_size,
-                                      params.w1.dtype)
-        x_mb = x.reshape(M, mb, model_size)
-        dy_mb = dloss_dx.reshape(M, mb, model_size)
-        grads = sched(params, x_mb, dy_mb, s, M, S, axis, vary_axes,
-                      stage_fwd, stage_bwd)
-        if data_axis is not None:
-            # DDP reduction across pipeline replicas (SUM, unscaled LR,
-            # train_ffns.py:165 semantics)
-            grads = jax.tree_util.tree_map(
-                lambda g: all_reduce(g, data_axis), grads)
-        # per-stage SGD on the stage's own layers (and model shard)
-        return sgd(params, grads, lr)
+        # named-scope regions (pp/fwd, pp/bwd via the stage walks,
+        # pp/comm on the ring transfers + DDP psum, pp/optim)
+        with jax.named_scope("pp"):
+            s = axis_index(axis)
+            x, dloss_dx = batch_from_seed(seed, batch_size, model_size,
+                                          params.w1.dtype)
+            x_mb = x.reshape(M, mb, model_size)
+            dy_mb = dloss_dx.reshape(M, mb, model_size)
+            grads = sched(params, x_mb, dy_mb, s, M, S, axis, vary_axes,
+                          stage_fwd, stage_bwd)
+            if data_axis is not None:
+                with jax.named_scope("comm"):
+                    # DDP reduction across pipeline replicas (SUM,
+                    # unscaled LR, train_ffns.py:165 semantics)
+                    grads = jax.tree_util.tree_map(
+                        lambda g: all_reduce(g, data_axis), grads)
+            with jax.named_scope("optim"):
+                # per-stage SGD on the stage's own layers (and model shard)
+                return sgd(params, grads, lr)
 
     return step
 
@@ -584,21 +599,23 @@ def make_transformer_pp_step(batch_size: int, model_size: int,
                             causal=causal, attn=attn)
 
     def stage_fwd(p: TransformerParams, x):
-        acts = []
-        for l in range(p.ln1.shape[0]):
-            acts.append(x)
-            x = block(tuple(leaf[l] for leaf in p), x)
-        return x, jnp.stack(acts)          # [L/S, mb, T, d] block inputs
+        with jax.named_scope("fwd"):
+            acts = []
+            for l in range(p.ln1.shape[0]):
+                acts.append(x)
+                x = block(tuple(leaf[l] for leaf in p), x)
+            return x, jnp.stack(acts)      # [L/S, mb, T, d] block inputs
 
     def stage_bwd(dy, p: TransformerParams, acts, m, chunk=0):
-        grads = jax.tree_util.tree_map(jnp.zeros_like, p)
-        for l in reversed(range(p.ln1.shape[0])):
-            leaves = tuple(leaf[l] for leaf in p)
-            _, vjp = jax.vjp(block, leaves, acts[l])
-            dleaves, dy = vjp(dy)
-            grads = TransformerParams(*(
-                g.at[l].set(dg) for g, dg in zip(grads, dleaves)))
-        return dy, grads
+        with jax.named_scope("bwd"):
+            grads = jax.tree_util.tree_map(jnp.zeros_like, p)
+            for l in reversed(range(p.ln1.shape[0])):
+                leaves = tuple(leaf[l] for leaf in p)
+                _, vjp = jax.vjp(block, leaves, acts[l])
+                dleaves, dy = vjp(dy)
+                grads = TransformerParams(*(
+                    g.at[l].set(dg) for g, dg in zip(grads, dleaves)))
+            return dy, grads
 
     def step(params: TransformerParams, seed) -> TransformerParams:
         from .transformer import _reshape_batch
@@ -615,15 +632,19 @@ def make_transformer_pp_step(batch_size: int, model_size: int,
         # params keep every weight cotangent partial, exactly like the
         # custom_vjp rules' (grad_reduce doctrine, collectives.py), so
         # the explicit reductions below are the only ones.
-        grads = sched(_vary_tree(params, vary_axes), x_mb, dy_mb, s, M, S,
-                      axis, vary_axes, stage_fwd, stage_bwd)
-        # LN-gain grads need no model-axis collective: the stream typing
-        # keeps them invariant (complete, identical on every model shard);
-        # if that ever regressed, the scan-carry typecheck fails at trace.
-        if data_axis is not None:
-            grads = jax.tree_util.tree_map(
-                lambda g: all_reduce(g, data_axis), grads)
-        return sgd(params, grads, lr)
+        with jax.named_scope("pp"):
+            grads = sched(_vary_tree(params, vary_axes), x_mb, dy_mb, s,
+                          M, S, axis, vary_axes, stage_fwd, stage_bwd)
+            # LN-gain grads need no model-axis collective: the stream
+            # typing keeps them invariant (complete, identical on every
+            # model shard); if that ever regressed, the scan-carry
+            # typecheck fails at trace.
+            if data_axis is not None:
+                with jax.named_scope("comm"):
+                    grads = jax.tree_util.tree_map(
+                        lambda g: all_reduce(g, data_axis), grads)
+            with jax.named_scope("optim"):
+                return sgd(params, grads, lr)
 
     return step
 
@@ -737,12 +758,14 @@ def make_lm_pp_step(batch_size: int, model_size: int, seq_len: int,
     vary_axes = tuple(a for a in (axis, data_axis) if a)
 
     def blocks_walk_fwd(p: LMParams, x):
-        acts = []
-        for l in range(p.blocks.ln1.shape[0]):
-            acts.append(x)
-            x = transformer_block(
-                *(leaf[l] for leaf in p.blocks), x, n_heads, attn=attn)
-        return x, (jnp.stack(acts), x)   # block inputs + stage output
+        with jax.named_scope("fwd"):
+            acts = []
+            for l in range(p.blocks.ln1.shape[0]):
+                acts.append(x)
+                x = transformer_block(
+                    *(leaf[l] for leaf in p.blocks), x, n_heads,
+                    attn=attn)
+            return x, (jnp.stack(acts), x)  # block inputs + stage output
 
     def step(params: LMParams, seed) -> LMParams:
         s = axis_index(axis)
@@ -811,17 +834,26 @@ def make_lm_pp_step(batch_size: int, model_size: int, seq_len: int,
                              blocks=bgrads, ln_f=g_lnf)
             return dy, grads
 
-        grads = sched(_vary_tree(params, vary_axes), x_mb, dy_mb, s, M, S,
-                      axis, vary_axes, blocks_walk_fwd, stage_bwd)
-        # embedding/head/final-LN grads live on 1-2 stages; the psum over
-        # the pipe ring completes them (others contributed zeros)
-        grads = grads._replace(wte=all_reduce(grads.wte, axis),
-                               wpe=all_reduce(grads.wpe, axis),
-                               ln_f=all_reduce(grads.ln_f, axis))
-        if data_axis is not None:
-            grads = jax.tree_util.tree_map(
-                lambda g: all_reduce(g, data_axis), grads)
-        return sgd(params, grads, lr)
+        def stage_bwd_scoped(*a, **kw):
+            with jax.named_scope("bwd"):
+                return stage_bwd(*a, **kw)
+
+        with jax.named_scope("pp"):
+            grads = sched(_vary_tree(params, vary_axes), x_mb, dy_mb, s,
+                          M, S, axis, vary_axes, blocks_walk_fwd,
+                          stage_bwd_scoped)
+            with jax.named_scope("comm"):
+                # embedding/head/final-LN grads live on 1-2 stages; the
+                # psum over the pipe ring completes them (others
+                # contributed zeros)
+                grads = grads._replace(wte=all_reduce(grads.wte, axis),
+                                       wpe=all_reduce(grads.wpe, axis),
+                                       ln_f=all_reduce(grads.ln_f, axis))
+                if data_axis is not None:
+                    grads = jax.tree_util.tree_map(
+                        lambda g: all_reduce(g, data_axis), grads)
+            with jax.named_scope("optim"):
+                return sgd(params, grads, lr)
 
     return step
 

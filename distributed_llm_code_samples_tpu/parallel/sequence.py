@@ -42,6 +42,14 @@ from .mesh import SEQ_AXIS, require_axes
 _NEG = -1e30  # finite -inf stand-in: keeps the online-softmax updates NaN-free
 
 
+def _hop(t, axis_name: str, perm):
+    """One ring hop (``lax.ppermute``) under the "comm" named scope —
+    so ring traffic folds into the seq strategy's comm region in traces
+    and HLO (utils/trace_analysis.SCOPES)."""
+    with jax.named_scope("comm"):
+        return lax.ppermute(t, axis_name, perm)
+
+
 def _varying_like(t, ref, axis_name: str):
     """Type ``t`` as shard-varying over every axis ``ref`` varies on plus
     the ring axis — so fori_loop carries typecheck under shard_map's vma
@@ -83,8 +91,8 @@ def _ring_fwd_core(q, k, v, axis_name: str, causal: bool):
         l = l * alpha + jnp.sum(p, axis=-1)
         acc = acc * alpha[:, None] + p @ v_blk.astype(jnp.float32)
         # pass the KV block around the ring for the next step
-        k_blk = lax.ppermute(k_blk, axis_name, perm)
-        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        k_blk = _hop(k_blk, axis_name, perm)
+        v_blk = _hop(v_blk, axis_name, perm)
         return k_blk, v_blk, m_new, l, acc
 
     m0 = _varying_like(jnp.full((t_local,), _NEG, jnp.float32), q, axis_name)
@@ -153,8 +161,8 @@ def _ring_fwd_flash(q, k, v, axis_name: str, causal: bool,
                   + y_j.astype(jnp.float32) * w_j[:, None])
                  / denom[:, None]).astype(q.dtype)
         lse_run = m + jnp.log(denom)
-        k_blk = lax.ppermute(k_blk, axis_name, perm)
-        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        k_blk = _hop(k_blk, axis_name, perm)
+        v_blk = _hop(v_blk, axis_name, perm)
         return k_blk, v_blk, y_run, lse_run
 
     y0 = _varying_like(jnp.zeros_like(q), q, axis_name)
@@ -201,10 +209,10 @@ def _ring_bwd_flash(q, k, v, y, lse, dy, axis_name: str, causal: bool,
         dq = dq + dq_j.astype(jnp.float32)
         dk = dk + dk_j.astype(jnp.float32)
         dv = dv + dv_j.astype(jnp.float32)
-        k_blk = lax.ppermute(k_blk, axis_name, perm)
-        v_blk = lax.ppermute(v_blk, axis_name, perm)
-        dk = lax.ppermute(dk, axis_name, perm)
-        dv = lax.ppermute(dv, axis_name, perm)
+        k_blk = _hop(k_blk, axis_name, perm)
+        v_blk = _hop(v_blk, axis_name, perm)
+        dk = _hop(dk, axis_name, perm)
+        dv = _hop(dv, axis_name, perm)
         return k_blk, v_blk, dk, dv, dq
 
     zeros = _varying_like(jnp.zeros((t_local, d), jnp.float32), q, axis_name)
@@ -271,10 +279,10 @@ def _ring_attention_bwd(axis_name, causal, res, dy):
         ds = p * (dp - delta[:, None])
         dq = dq + (ds @ k_blk.astype(jnp.float32)) * scale
         dk = dk + (ds.T @ q32) * scale
-        k_blk = lax.ppermute(k_blk, axis_name, perm)
-        v_blk = lax.ppermute(v_blk, axis_name, perm)
-        dk = lax.ppermute(dk, axis_name, perm)
-        dv = lax.ppermute(dv, axis_name, perm)
+        k_blk = _hop(k_blk, axis_name, perm)
+        v_blk = _hop(v_blk, axis_name, perm)
+        dk = _hop(dk, axis_name, perm)
+        dv = _hop(dv, axis_name, perm)
         return k_blk, v_blk, dk, dv, dq
 
     zeros = _varying_like(jnp.zeros((t_local, d), jnp.float32), q, axis_name)
@@ -397,14 +405,18 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     if comm == "pallas_a2a":
         from ..ops.pallas_ring import all_to_all_dma_dims
-        a2a = lambda t, s, c: all_to_all_dma_dims(  # noqa: E731
+        _a2a = lambda t, s, c: all_to_all_dma_dims(  # noqa: E731
             t, axis_name, s, c, None)
     elif comm == "psum":
-        a2a = lambda t, s, c: all_to_all(t, axis_name,  # noqa: E731
-                                         split_dim=s, concat_dim=c)
+        _a2a = lambda t, s, c: all_to_all(t, axis_name,  # noqa: E731
+                                          split_dim=s, concat_dim=c)
     else:
         raise ValueError(f"unknown comm {comm!r} "
                          "(expected 'psum' or 'pallas_a2a')")
+
+    def a2a(t, s, c):
+        with jax.named_scope("comm"):  # the heads<->sequence re-shards
+            return _a2a(t, s, c)
 
     op = mha if attn is None else attn
     y = op(*(a2a(t, 0, 1) for t in (q, k, v)), causal)

@@ -91,17 +91,23 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
             block_fwd, block_bwd = ffn_fwd, ffn_bwd
 
         def step(params: FFNStackParams, seed) -> FFNStackParams:
-            x, dloss_dx = batch_from_seed(seed, batch_size, model_size,
-                                          params.w1.dtype)
+            # named-scope regions (single/fwd, single/bwd, single/optim):
+            # stable trace/HLO names, utils/trace_analysis.SCOPES
+            with jax.named_scope("single"):
+                x, dloss_dx = batch_from_seed(seed, batch_size, model_size,
+                                              params.w1.dtype)
 
-            def grad_fn(x, dy):
-                _, acts = stack_fwd(params.w1, params.w2, x,
-                                    block_fwd=block_fwd, unroll=unroll)
-                _, (g1, g2) = stack_bwd(dy, params.w1, params.w2, acts,
-                                        block_bwd=block_bwd, unroll=unroll)
-                return FFNStackParams(g1, g2)
+                def grad_fn(x, dy):
+                    _, acts = stack_fwd(params.w1, params.w2, x,
+                                        block_fwd=block_fwd, unroll=unroll)
+                    _, (g1, g2) = stack_bwd(dy, params.w1, params.w2, acts,
+                                            block_bwd=block_bwd,
+                                            unroll=unroll)
+                    return FFNStackParams(g1, g2)
 
-            return sgd(params, accumulate(grad_fn, x, dloss_dx), lr)
+                grads = accumulate(grad_fn, x, dloss_dx)
+                with jax.named_scope("optim"):
+                    return sgd(params, grads, lr)
 
         return step
 
@@ -120,14 +126,18 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
         from ..ops.ffn import ffn_block_saved as block
 
     def step(params: FFNStackParams, seed) -> FFNStackParams:
-        x, dloss_dx = batch_from_seed(seed, batch_size, model_size,
-                                      params.w1.dtype)
+        with jax.named_scope("single"):
+            x, dloss_dx = batch_from_seed(seed, batch_size, model_size,
+                                          params.w1.dtype)
 
-        def grad_fn(x, dy):
-            return FFNStackParams(*stack_grads(params.w1, params.w2, x, dy,
-                                               block=block, unroll=unroll)[1])
+            def grad_fn(x, dy):
+                return FFNStackParams(*stack_grads(
+                    params.w1, params.w2, x, dy, block=block,
+                    unroll=unroll)[1])
 
-        return sgd(params, accumulate(grad_fn, x, dloss_dx), lr)
+            grads = accumulate(grad_fn, x, dloss_dx)
+            with jax.named_scope("optim"):
+                return sgd(params, grads, lr)
 
     return step
 

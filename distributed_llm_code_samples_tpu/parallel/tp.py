@@ -18,6 +18,7 @@ surface the other strategies use (``ops.stack``).
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -57,24 +58,31 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
 
     def block_fwd(w1_shard, w2_shard, x):
         # Partial y per rank, then sync all_reduce(SUM) — train_ffns.py:302-303.
-        return all_reduce(fwd(w1_shard, w2_shard, x), axis)
+        y = fwd(w1_shard, w2_shard, x)
+        with jax.named_scope("comm"):  # Megatron g -> tp/fwd/comm
+            return all_reduce(y, axis)
 
     def block_bwd(dy, w1_shard, w2_shard, x):
         # Local VJP on the shard, then all_reduce the input grad — :308-309.
         # The recompute of the (local slice of the) pre-activation happens
         # inside the block bwd, same as the reference's per-rank recompute.
         dx, grads = bwd(dy, w1_shard, w2_shard, x)
-        return all_reduce(dx, axis), grads
+        with jax.named_scope("comm"):
+            return all_reduce(dx, axis), grads
 
     def step(params: FFNStackParams, seed) -> FFNStackParams:
-        x, dloss_dx = batch_from_seed(seed, batch_size, model_size,
-                                      params.w1.dtype)
-        _, acts = stack_fwd(params.w1, params.w2, x, block_fwd=block_fwd,
-                            unroll=unroll)
-        _, (g1, g2) = stack_bwd(dloss_dx, params.w1, params.w2, acts,
-                                block_bwd=block_bwd, unroll=unroll)
-        # Weight grads are local to the shard; local SGD (:311-312).
-        return sgd(params, FFNStackParams(g1, g2), lr)
+        # named-scope regions (tp/fwd, tp/bwd, nested comm psums,
+        # tp/optim) — utils/trace_analysis.SCOPES
+        with jax.named_scope("tp"):
+            x, dloss_dx = batch_from_seed(seed, batch_size, model_size,
+                                          params.w1.dtype)
+            _, acts = stack_fwd(params.w1, params.w2, x,
+                                block_fwd=block_fwd, unroll=unroll)
+            _, (g1, g2) = stack_bwd(dloss_dx, params.w1, params.w2, acts,
+                                    block_bwd=block_bwd, unroll=unroll)
+            with jax.named_scope("optim"):
+                # Weight grads are local to the shard; local SGD (:311-312).
+                return sgd(params, FFNStackParams(g1, g2), lr)
 
     return step
 
@@ -106,30 +114,37 @@ def make_sp_step(batch_size: int, model_size: int, n_shards: int,
     bwd = ffn_bwd_mixed if mixed else ffn_bwd
 
     def block_fwd(w1_shard, w2_shard, x_s):
-        full = all_gather(x_s, axis, dim=0)              # [T, d]
+        with jax.named_scope("comm"):
+            full = all_gather(x_s, axis, dim=0)          # [T, d]
         part = fwd(w1_shard, w2_shard, full)             # partial over ffn
-        return reduce_scatter(part, axis, dim=0)         # [T/n, d], summed
+        with jax.named_scope("comm"):
+            return reduce_scatter(part, axis, dim=0)     # [T/n, d], summed
 
     def block_bwd(dy_s, w1_shard, w2_shard, x_s):
-        full = all_gather(x_s, axis, dim=0)      # recomputed, not saved
-        dy_full = all_gather(dy_s, axis, dim=0)  # reduce_scatter transpose
+        with jax.named_scope("comm"):
+            full = all_gather(x_s, axis, dim=0)    # recomputed, not saved
+            dy_full = all_gather(dy_s, axis, dim=0)  # rs transpose
         dx_full, grads = bwd(dy_full, w1_shard, w2_shard, full)
-        # all_gather transpose: scatter AND sum the rank-partial dx
-        return reduce_scatter(dx_full, axis, dim=0), grads
+        with jax.named_scope("comm"):
+            # all_gather transpose: scatter AND sum the rank-partial dx
+            return reduce_scatter(dx_full, axis, dim=0), grads
 
     def step(params: FFNStackParams, seed) -> FFNStackParams:
-        x, dloss_dx = batch_from_seed(seed, batch_size, model_size,
-                                      params.w1.dtype)
-        r = axis_index(axis)
-        x_s, dy_s = (lax.dynamic_slice_in_dim(t, r * t_local, t_local, 0)
-                     for t in (x, dloss_dx))
-        # acts holds the SHARDED block inputs — [L, T/n, d], the 1/n
-        # activation-memory claim (structurally asserted in tests)
-        _, acts = stack_fwd(params.w1, params.w2, x_s, block_fwd=block_fwd,
-                            unroll=unroll)
-        _, (g1, g2) = stack_bwd(dy_s, params.w1, params.w2, acts,
-                                block_bwd=block_bwd, unroll=unroll)
-        return sgd(params, FFNStackParams(g1, g2), lr)
+        with jax.named_scope("tp"):
+            x, dloss_dx = batch_from_seed(seed, batch_size, model_size,
+                                          params.w1.dtype)
+            r = axis_index(axis)
+            x_s, dy_s = (lax.dynamic_slice_in_dim(t, r * t_local,
+                                                  t_local, 0)
+                         for t in (x, dloss_dx))
+            # acts holds the SHARDED block inputs — [L, T/n, d], the 1/n
+            # activation-memory claim (structurally asserted in tests)
+            _, acts = stack_fwd(params.w1, params.w2, x_s,
+                                block_fwd=block_fwd, unroll=unroll)
+            _, (g1, g2) = stack_bwd(dy_s, params.w1, params.w2, acts,
+                                    block_bwd=block_bwd, unroll=unroll)
+            with jax.named_scope("optim"):
+                return sgd(params, FFNStackParams(g1, g2), lr)
 
     return step
 

@@ -135,27 +135,37 @@ def _make_single_step(tokens: int, model_size: int, seq_len: int,
                       n_heads: int, lr: float, causal: bool = True,
                       attn=None, mixed: bool = False):
     def step(params: TransformerParams, seed) -> TransformerParams:
-        x, dloss_dx = _reshape_batch(seed, tokens, seq_len, model_size,
-                                     params.w1.dtype)
-        if mixed:
-            # the LM family's bf16 stance (models.lm.lm_loss(mixed=)),
-            # head-less: bf16 params + activations through the blocks,
-            # f32 master params/grads/update — the cotangent enters in
-            # bf16 (the fwd output's dtype) and the grads come back f32
-            # through the cast transposes
-            xm = x.astype(jnp.bfloat16)
+        # named-scope regions (tf/fwd, tf/bwd, tf/optim) — the naming
+        # map lives in utils/trace_analysis.SCOPES
+        with jax.named_scope("tf"):
+            x, dloss_dx = _reshape_batch(seed, tokens, seq_len,
+                                         model_size, params.w1.dtype)
+            if mixed:
+                # the LM family's bf16 stance (models.lm.lm_loss(mixed=)),
+                # head-less: bf16 params + activations through the blocks,
+                # f32 master params/grads/update — the cotangent enters in
+                # bf16 (the fwd output's dtype) and the grads come back f32
+                # through the cast transposes
+                xm = x.astype(jnp.bfloat16)
 
-            def fwd(p):
-                pc = jax.tree_util.tree_map(
-                    lambda a: a.astype(jnp.bfloat16), p)
-                return transformer_fwd(pc, xm, n_heads, causal, attn)
+                def fwd(p):
+                    pc = jax.tree_util.tree_map(
+                        lambda a: a.astype(jnp.bfloat16), p)
+                    return transformer_fwd(pc, xm, n_heads, causal, attn)
 
-            _, vjp = jax.vjp(fwd, params)
-            return sgd(params,
-                       vjp(dloss_dx.astype(jnp.bfloat16))[0], lr)
-        _, vjp = jax.vjp(
-            lambda p: transformer_fwd(p, x, n_heads, causal, attn), params)
-        return sgd(params, vjp(dloss_dx)[0], lr)
+                with jax.named_scope("fwd"):
+                    _, vjp = jax.vjp(fwd, params)
+                with jax.named_scope("bwd"):
+                    grads = vjp(dloss_dx.astype(jnp.bfloat16))[0]
+            else:
+                with jax.named_scope("fwd"):
+                    _, vjp = jax.vjp(
+                        lambda p: transformer_fwd(p, x, n_heads, causal,
+                                                  attn), params)
+                with jax.named_scope("bwd"):
+                    grads = vjp(dloss_dx)[0]
+            with jax.named_scope("optim"):
+                return sgd(params, grads, lr)
 
     return step
 
@@ -199,14 +209,21 @@ def train_transformer_ddp(params: TransformerParams, seeds, batch_size: int,
     attn = resolve_attn(attn_impl)
 
     def step(params: TransformerParams, seed) -> TransformerParams:
-        x, dloss_dx = _reshape_batch(seed, batch_size, seq_len, model_size,
-                                     params.w1.dtype)
-        _, vjp = jax.vjp(
-            lambda p: transformer_fwd(p, x, n_heads, causal, attn), params)
-        grads = vjp(dloss_dx)[0]
-        grads = jax.tree_util.tree_map(
-            lambda g: grad_reduce(g, DATA_AXIS, force=vma_erased()), grads)
-        return sgd(params, grads, lr)
+        with jax.named_scope("tf"):
+            x, dloss_dx = _reshape_batch(seed, batch_size, seq_len,
+                                         model_size, params.w1.dtype)
+            with jax.named_scope("fwd"):
+                _, vjp = jax.vjp(
+                    lambda p: transformer_fwd(p, x, n_heads, causal,
+                                              attn), params)
+            with jax.named_scope("bwd"):
+                grads = vjp(dloss_dx)[0]
+            with jax.named_scope("comm"):
+                grads = jax.tree_util.tree_map(
+                    lambda g: grad_reduce(g, DATA_AXIS,
+                                          force=vma_erased()), grads)
+            with jax.named_scope("optim"):
+                return sgd(params, grads, lr)
 
     return launch_strided(step, clone_params(params), seeds, mesh,
                           DATA_AXIS, P())
@@ -246,13 +263,20 @@ def train_transformer_fsdp(params: TransformerParams, seeds,
             for l in range(p.w1.shape[0]):
                 # gather this layer's full params (transient, never stored)
                 # and run the exact single-device block on them
-                full = (all_gather(leaf[l], DATA_AXIS, dim=0) for leaf in p)
+                with jax.named_scope("comm"):
+                    full = [all_gather(leaf[l], DATA_AXIS, dim=0)
+                            for leaf in p]
                 y = transformer_block(*full, y, n_heads, causal, attn)
             return y
 
-        _, vjp = jax.vjp(fwd, params)
-        grads = vjp(dloss_dx)[0]  # psum_scatter'd by the gather transpose
-        return sgd(params, grads, lr)
+        with jax.named_scope("tf"):
+            with jax.named_scope("fwd"):
+                _, vjp = jax.vjp(fwd, params)
+            with jax.named_scope("bwd"):
+                # psum_scatter'd by the gather transpose
+                grads = vjp(dloss_dx)[0]
+            with jax.named_scope("optim"):
+                return sgd(params, grads, lr)
 
     return launch_strided(step, _shard(params, mesh, FSDP_SPECS), seeds,
                           mesh, DATA_AXIS, FSDP_SPECS)
@@ -262,12 +286,17 @@ def tp_block(ln1, wq, wk, wv, wo, ln2, w1, w2, x, n_heads_local: int,
              axis: str = MODEL_AXIS, causal: bool = True, attn=None):
     """One TP transformer block, per-shard view (local weights)."""
     f = _f_gate(axis)
+
+    def g(t):  # Megatron g: the forward psum, named for trace analysis
+        with jax.named_scope("comm"):
+            return all_reduce(t, axis)
+
     b, s, d = x.shape
     a = f(layernorm(ln1, x))
-    x = x + all_reduce(                                    # Megatron g
-        attn_sublayer(wq, wk, wv, wo, a, n_heads_local, causal, attn), axis)
+    x = x + g(attn_sublayer(wq, wk, wv, wo, a, n_heads_local, causal,
+                            attn))
     h = f(layernorm(ln2, x)).reshape(b * s, d)
-    y = all_reduce(ffn_block(w1, w2, h), axis)             # Megatron g
+    y = g(ffn_block(w1, w2, h))
     return x + y.reshape(b, s, d)
 
 
@@ -284,8 +313,14 @@ def sp_block(ln1, wq, wk, wv, wo, ln2, w1, w2, x_s, n_heads_local: int,
     hand-written sublayer rules; the ``_f_gate`` is subsumed — the
     backward's ``reduce_scatter`` already sums the column-parallel
     projections' partial input-grads."""
-    g = lambda t: all_gather(t, axis, dim=1)           # noqa: E731
-    rs = lambda t: reduce_scatter(t, axis, dim=1)      # noqa: E731
+    def g(t):
+        with jax.named_scope("comm"):
+            return all_gather(t, axis, dim=1)
+
+    def rs(t):
+        with jax.named_scope("comm"):
+            return reduce_scatter(t, axis, dim=1)
+
     b, s_local, d = x_s.shape
     a = g(layernorm(ln1, x_s))                          # [b, s, d] full
     x_s = x_s + rs(
@@ -371,21 +406,27 @@ def make_tp_step(batch_size: int, model_size: int, seq_len: int,
                           causal=causal, attn=attn)
             return y
 
-        _, vjp = jax.vjp(fwd, params)
-        grads = vjp(dloss_dx)[0]
-        if sequence_parallel:
-            # LN gains saw only this shard's tokens: sum over the model
-            # axis. Everything else saw full (gathered) tokens and is
-            # complete per shard.
-            grads = grads._replace(
-                ln1=grad_reduce(grads.ln1, MODEL_AXIS,
-                                force=vma_erased()),
-                ln2=grad_reduce(grads.ln2, MODEL_AXIS,
-                                force=vma_erased()))
-        # projection/FFN grads are shard-local (each shard owns its heads/
-        # features); in the plain form LN grads replicate — data and dx
-        # are identical on all shards after the f-gate psums
-        return sgd(params, grads, lr)
+        with jax.named_scope("tf"):
+            with jax.named_scope("fwd"):
+                _, vjp = jax.vjp(fwd, params)
+            with jax.named_scope("bwd"):
+                grads = vjp(dloss_dx)[0]
+            if sequence_parallel:
+                with jax.named_scope("comm"):
+                    # LN gains saw only this shard's tokens: sum over the
+                    # model axis. Everything else saw full (gathered)
+                    # tokens and is complete per shard.
+                    grads = grads._replace(
+                        ln1=grad_reduce(grads.ln1, MODEL_AXIS,
+                                        force=vma_erased()),
+                        ln2=grad_reduce(grads.ln2, MODEL_AXIS,
+                                        force=vma_erased()))
+            # projection/FFN grads are shard-local (each shard owns its
+            # heads/features); in the plain form LN grads replicate —
+            # data and dx are identical on all shards after the f-gate
+            # psums
+            with jax.named_scope("optim"):
+                return sgd(params, grads, lr)
 
     return step
 
@@ -438,16 +479,24 @@ def train_transformer_seq(params: TransformerParams, seeds,
         x, dloss_dx = (lax.dynamic_slice_in_dim(t, r * t_local, t_local, 1)
                        for t in (x, dloss_dx))
 
-        _, vjp = jax.vjp(
-            lambda p: transformer_fwd(p, x, n_heads, causal, attn), params)
-        grads = vjp(dloss_dx)[0]
-        # weight grads are partial sums over this shard's tokens — and,
-        # on a 2-D mesh, over the data replicas (DDP semantics). One
-        # fused psum over both axes per leaf, not one per axis.
-        axes = (SEQ_AXIS, DATA_AXIS) if dp > 1 else (SEQ_AXIS,)
-        grads = jax.tree_util.tree_map(
-            lambda g: grad_reduce(g, axes, force=vma_erased()), grads)
-        return sgd(params, grads, lr)
+        with jax.named_scope("seq"):
+            with jax.named_scope("fwd"):
+                _, vjp = jax.vjp(
+                    lambda p: transformer_fwd(p, x, n_heads, causal,
+                                              attn), params)
+            with jax.named_scope("bwd"):
+                grads = vjp(dloss_dx)[0]
+            with jax.named_scope("comm"):
+                # weight grads are partial sums over this shard's tokens
+                # — and, on a 2-D mesh, over the data replicas (DDP
+                # semantics). One fused psum over both axes per leaf,
+                # not one per axis.
+                axes = (SEQ_AXIS, DATA_AXIS) if dp > 1 else (SEQ_AXIS,)
+                grads = jax.tree_util.tree_map(
+                    lambda g: grad_reduce(g, axes, force=vma_erased()),
+                    grads)
+            with jax.named_scope("optim"):
+                return sgd(params, grads, lr)
 
     if dp > 1:
         return launch_strided(step, clone_params(params), seeds, mesh,
@@ -486,14 +535,20 @@ def train_transformer_hybrid(params: TransformerParams, seeds,
                              causal=causal, attn=attn)
             return y
 
-        _, vjp = jax.vjp(fwd, params)
-        grads = vjp(dloss_dx)[0]
-        # TP leaves weight grads complete within a model shard; the data
-        # axis still needs the DDP reduction (orthogonal psums, the 2-D
-        # mesh composition)
-        grads = jax.tree_util.tree_map(
-            lambda g: grad_reduce(g, DATA_AXIS, force=vma_erased()), grads)
-        return sgd(params, grads, lr)
+        with jax.named_scope("tf"):
+            with jax.named_scope("fwd"):
+                _, vjp = jax.vjp(fwd, params)
+            with jax.named_scope("bwd"):
+                grads = vjp(dloss_dx)[0]
+            with jax.named_scope("comm"):
+                # TP leaves weight grads complete within a model shard;
+                # the data axis still needs the DDP reduction (orthogonal
+                # psums, the 2-D mesh composition)
+                grads = jax.tree_util.tree_map(
+                    lambda g: grad_reduce(g, DATA_AXIS,
+                                          force=vma_erased()), grads)
+            with jax.named_scope("optim"):
+                return sgd(params, grads, lr)
 
     # params: sharded over model, replicated over data; seeds: one strided
     # column per data shard, same column for every model shard

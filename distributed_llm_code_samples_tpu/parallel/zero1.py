@@ -67,19 +67,27 @@ def make_step(batch_size: int, model_size: int, n_shards: int,
             tree)
 
     def step(carry, seed):
-        params, state = carry
-        grads = local_grads(params, seed, batch_size, model_size, unroll,
-                            accum=accum, mixed=mixed)
-        # SUM-reduce AND partition in one collective: rank r receives the
-        # summed grads of its own layers only (train_ffns.py:165 SUM
-        # semantics; ZeRO's reduce-scatter observation)
-        gshard = jax.tree_util.tree_map(
-            lambda g: reduce_scatter(g, axis, dim=0), grads)
-        pshard, state = opt.update(gshard, state, shard_of(params), lr)
-        # re-assemble replicated params for the next forward
-        params = jax.tree_util.tree_map(
-            lambda p: all_gather(p, axis, dim=0), pshard)
-        return params, state
+        # named-scope regions (zero1/fwd, zero1/bwd, zero1/comm,
+        # zero1/optim) — utils/trace_analysis.SCOPES
+        with jax.named_scope("zero1"):
+            params, state = carry
+            grads = local_grads(params, seed, batch_size, model_size,
+                                unroll, accum=accum, mixed=mixed)
+            with jax.named_scope("comm"):
+                # SUM-reduce AND partition in one collective: rank r
+                # receives the summed grads of its own layers only
+                # (train_ffns.py:165 SUM semantics; ZeRO's
+                # reduce-scatter observation)
+                gshard = jax.tree_util.tree_map(
+                    lambda g: reduce_scatter(g, axis, dim=0), grads)
+            with jax.named_scope("optim"):
+                pshard, state = opt.update(gshard, state,
+                                           shard_of(params), lr)
+            with jax.named_scope("comm"):
+                # re-assemble replicated params for the next forward
+                params = jax.tree_util.tree_map(
+                    lambda p: all_gather(p, axis, dim=0), pshard)
+            return params, state
 
     return step, shard_of, opt
 

@@ -1,0 +1,339 @@
+"""`report` — fold a run's telemetry streams into one human-readable
+run report.
+
+Inputs (all optional except the metrics dir):
+
+- the metrics JSONL a ``--metrics_dir`` run wrote
+  (``runtime/telemetry.py`` schema: per-step records + recovery/chaos
+  events + run meta),
+- supervise's per-attempt JSONL (``runtime/failure.py``) — passed with
+  ``--attempt_log`` or auto-discovered from the run's meta records,
+- a profile directory (``--profile_dir``) captured with
+  ``--profile_dir`` / ``jax.profiler.trace`` — folded through
+  ``utils/trace_analysis`` into comm/compute overlap and per-named-scope
+  region totals.
+
+Output: step-time percentiles, throughput, MFU, HBM high-water, and ONE
+merged timeline carrying training progress, faults, recovery attempts,
+and post-recovery steps in wall-clock order — the "what happened to this
+run" view the reference answered with scattered prints
+(``train_ffns.py:378-382``).
+
+Exit codes: 0 = report rendered (schema problems are listed, not
+fatal); 2 = no usable metrics stream.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+from .runtime.telemetry import METRICS_FILENAME, read_metrics
+
+
+def _fmt_bytes(n: int | None) -> str:
+    if n is None:
+        return "n/a"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024:
+            return f"{n:.1f} {unit}"
+        n /= 1024
+    return f"{n:.1f} PiB"
+
+
+def _fmt_t(t: float, t0: float) -> str:
+    return f"+{t - t0:8.2f}s"
+
+
+def _load_attempt_log(path: str) -> list[dict]:
+    records = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    records.append(json.loads(line))
+                except ValueError:
+                    pass  # torn line — the stream survives a crash
+    except OSError:
+        return []
+    return records
+
+
+def _describe_step(rec: dict) -> str:
+    bits = [f"step {rec['step']}"]
+    if rec.get("strategy"):
+        bits[0] = f"{rec['strategy']} {bits[0]}"
+    if rec.get("loss") is not None:
+        bits.append(f"loss {rec['loss']:.4f}")
+    if rec.get("grad_norm") is not None:
+        bits.append(f"|g| {rec['grad_norm']:.4f}")
+    if rec.get("step_time_s") is not None:
+        bits.append(f"{rec['step_time_s'] * 1e3:.1f} ms/step")
+    if rec.get("tokens_per_sec") is not None:
+        bits.append(f"{rec['tokens_per_sec']:.0f} tok/s")
+    if rec.get("mfu") is not None:
+        bits.append(f"mfu {rec['mfu']:.3f}")
+    return "  ".join(bits)
+
+
+def _describe_event(rec: dict) -> str:
+    ev = rec.get("event", "?")
+    if ev == "published":
+        a, b = rec.get("steps", (None, None))
+        return f"checkpoint published @ step {rec.get('step')} " \
+               f"(steps {a}..{b})"
+    if ev == "nonfinite_skip":
+        a, b = rec.get("steps", (None, None))
+        return f"NON-FINITE params after steps {a}..{b} — segment " \
+               "skipped, not checkpointed"
+    if ev == "attempt_failed":
+        extra = " [watchdog expired]" if rec.get("watchdog_expired") else ""
+        return (f"FAULT: attempt {rec.get('attempt')} failed after "
+                f"{rec.get('elapsed_s')}s — {rec.get('error')}"
+                f"{extra}; {rec.get('restarts_left')} restart(s) left, "
+                f"backoff {rec.get('backoff_s')}s")
+    if ev == "completed":
+        return (f"RECOVERED: attempt {rec.get('attempt')} completed "
+                f"after {rec.get('elapsed_s')}s")
+    if ev == "chaos_corrupt_ckpt":
+        return (f"CHAOS: checkpoint corruption injected at "
+                f"step {rec.get('step')}")
+    return f"{ev}: " + ", ".join(
+        f"{k}={v}" for k, v in rec.items()
+        if k not in ("event", "t", "kind", "schema"))
+
+
+def report_main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="report",
+        description="Fold a --metrics_dir run (+ supervise attempt log "
+                    "+ optional profile dir) into one run report")
+    p.add_argument("metrics_dir",
+                   help="the run's --metrics_dir (holds metrics.jsonl)")
+    p.add_argument("--attempt_log", default=None,
+                   help="supervise's per-attempt JSONL (default: "
+                        "discovered from the run's meta records)")
+    p.add_argument("--profile_dir", default=None,
+                   help="a trace directory captured with --profile_dir; "
+                        "adds comm/compute overlap + per-named-scope "
+                        "totals")
+    p.add_argument("--json", action="store_true",
+                   help="emit the folded report as one JSON object "
+                        "instead of text")
+    args = p.parse_args(argv)
+
+    path = args.metrics_dir
+    if os.path.isdir(path):
+        path = os.path.join(path, METRICS_FILENAME)
+    if not os.path.exists(path):
+        print(f"report: no metrics stream at {path}", file=sys.stderr)
+        return 2
+    records, problems = read_metrics(path)
+    if not records:
+        print(f"report: {path} holds no schema-valid records "
+              f"({len(problems)} problem(s))", file=sys.stderr)
+        for prob in problems:
+            print(f"report:   {prob}", file=sys.stderr)
+        return 2
+
+    metas = [r for r in records if r["kind"] == "meta"]
+    steps = [r for r in records if r["kind"] == "step"]
+    events = [r for r in records if r["kind"] == "event"]
+    benches = [r for r in records if r["kind"] == "bench"]
+
+    # attempt log: flag wins; else the newest meta that names one
+    attempt_path = args.attempt_log
+    if attempt_path is None:
+        for m in reversed(metas):
+            if m.get("attempt_log"):
+                attempt_path = m["attempt_log"]
+                break
+    attempts = _load_attempt_log(attempt_path) if attempt_path else []
+    if attempt_path and not attempts and not os.path.exists(attempt_path):
+        problems.append(f"attempt log {attempt_path} unreadable — "
+                        "recovery events missing from the timeline")
+
+    doc: dict = {"metrics_path": path, "n_records": len(records),
+                 "problems": problems}
+
+    # ---- run header --------------------------------------------------
+    header = {}
+    for m in metas:  # later metas refine earlier ones
+        header.update({k: v for k, v in m.items()
+                       if k not in ("kind", "t", "schema")})
+    doc["run"] = header
+
+    # ---- step statistics, grouped per strategy ----------------------
+    # multi-method runs (-m 0 / -m 9) interleave strategies in one
+    # stream; pooled percentiles would describe no actual run
+    def _stats_of(group):
+        times = [s["step_time_s"] for s in group
+                 if s.get("step_time_s") is not None]
+        # the first logged chunk usually carries compile time; report
+        # steady-state percentiles over the rest when there is a rest
+        steady = times[1:] if len(times) > 1 else times
+        tps = [s["tokens_per_sec"] for s in group
+               if s.get("tokens_per_sec") is not None]
+        mfus = [s["mfu"] for s in group if s.get("mfu") is not None]
+        losses = [s["loss"] for s in group if s.get("loss") is not None]
+        hbm = [max(s["hbm_high_water_bytes"].values())
+               for s in group if s.get("hbm_high_water_bytes")]
+        stats = {
+            "logged_steps": len(group),
+            "first_step": group[0]["step"],
+            "last_step": group[-1]["step"],
+        }
+        if steady:
+            q = np.percentile(np.asarray(steady, np.float64),
+                              [50, 90, 99])
+            stats["step_time_p50_ms"] = round(float(q[0]) * 1e3, 3)
+            stats["step_time_p90_ms"] = round(float(q[1]) * 1e3, 3)
+            stats["step_time_p99_ms"] = round(float(q[2]) * 1e3, 3)
+        if tps:
+            stats["tokens_per_sec_mean"] = round(float(np.mean(tps)), 1)
+            stats["tokens_per_sec_best"] = round(float(np.max(tps)), 1)
+        if mfus:
+            stats["mfu_mean"] = round(float(np.mean(mfus)), 4)
+            stats["mfu_best"] = round(float(np.max(mfus)), 4)
+        if losses:
+            stats["first_loss"] = round(losses[0], 4)
+            stats["last_loss"] = round(losses[-1], 4)
+        if hbm:
+            stats["hbm_high_water_bytes"] = int(max(hbm))
+        return stats
+
+    if steps:
+        by_strategy: dict = {}
+        for s in steps:
+            by_strategy.setdefault(s.get("strategy") or "run", []).append(s)
+        doc["steps"] = {k: _stats_of(v) for k, v in by_strategy.items()}
+
+    # ---- recovery / chaos summary -----------------------------------
+    fails = [a for a in attempts if a.get("event") == "attempt_failed"]
+    doc["recovery"] = {
+        "attempt_log": attempt_path,
+        "attempts_failed": len(fails),
+        "completed": any(a.get("event") == "completed" for a in attempts),
+        "nonfinite_skips": sum(1 for e in events
+                               if e.get("event") == "nonfinite_skip"),
+        "publishes": sum(1 for e in events
+                         if e.get("event") == "published"),
+    }
+
+    # ---- one merged timeline ----------------------------------------
+    timeline = []
+    for s in steps:
+        timeline.append((s["t"], "step", _describe_step(s)))
+    seen_events = {(e.get("t"), e.get("event")) for e in events}
+    for e in events:
+        timeline.append((e["t"], "event", _describe_event(e)))
+    for a in attempts:
+        # supervise forwards checkpoint-layer events to its log too;
+        # drop exact duplicates of what the metrics stream already has
+        if (a.get("t"), a.get("event")) in seen_events:
+            continue
+        timeline.append((a.get("t", 0.0), "attempt", _describe_event(a)))
+    timeline.sort(key=lambda x: x[0])
+    doc["timeline"] = [{"t": t, "source": src, "what": what}
+                       for t, src, what in timeline]
+
+    # ---- profile folding --------------------------------------------
+    if args.profile_dir:
+        from .utils.trace_analysis import (load_spans, overlap_payload,
+                                           scope_totals,
+                                           strategy_scope_key)
+        # one gunzip+parse feeds both analyses (hardware traces run to
+        # hundreds of MB — never load twice)
+        trace_file, spans = load_spans(args.profile_dir)
+        prof = overlap_payload(spans, trace_file)
+        # fold per-region totals under the RUN's strategy when the meta
+        # records name one; unknown strategies fall back to the
+        # prefixed-regions union (scope_totals documents why)
+        scope_key = strategy_scope_key(header.get("strategy"))
+        prof["scope_totals_us"] = {
+            k: round(v, 1)
+            for k, v in scope_totals(spans, scope_key).items() if v}
+        doc["profile"] = prof
+
+    if benches:
+        doc["bench_rows"] = len(benches)
+
+    if args.json:
+        print(json.dumps(doc, indent=1))
+        return 0
+
+    # ---- render ------------------------------------------------------
+    out = []
+    out.append("=" * 72)
+    out.append(f"RUN REPORT — {path}")
+    out.append("=" * 72)
+    if header:
+        out.append("run config:")
+        for k, v in header.items():
+            out.append(f"  {k}: {v}")
+    for strat, st in doc.get("steps", {}).items():
+        out.append("")
+        out.append(f"steps [{strat}]: {st['logged_steps']} logged "
+                   f"record(s), steps {st['first_step']}.."
+                   f"{st['last_step']}")
+        if "step_time_p50_ms" in st:
+            out.append(f"  step time   p50 {st['step_time_p50_ms']} ms  "
+                       f"p90 {st['step_time_p90_ms']} ms  "
+                       f"p99 {st['step_time_p99_ms']} ms "
+                       "(steady-state: first logged chunk excluded)")
+        if "tokens_per_sec_mean" in st:
+            out.append(f"  throughput  mean {st['tokens_per_sec_mean']} "
+                       f"tok/s  best {st['tokens_per_sec_best']} tok/s")
+        if "mfu_mean" in st:
+            out.append(f"  MFU         mean {st['mfu_mean']}  "
+                       f"best {st['mfu_best']}")
+        if "first_loss" in st:
+            out.append(f"  loss        {st['first_loss']} -> "
+                       f"{st['last_loss']}")
+        if "hbm_high_water_bytes" in st:
+            out.append("  HBM high-water  "
+                       + _fmt_bytes(st["hbm_high_water_bytes"]))
+    rec = doc["recovery"]
+    if rec["attempts_failed"] or rec["nonfinite_skips"] or attempts:
+        out.append("")
+        out.append(f"recovery: {rec['attempts_failed']} failed "
+                   f"attempt(s), {rec['nonfinite_skips']} non-finite "
+                   f"skip(s), {rec['publishes']} checkpoint "
+                   f"publish(es), run "
+                   + ("COMPLETED" if rec["completed"] else
+                      "did not record completion"))
+    if timeline:
+        t0 = timeline[0][0]
+        out.append("")
+        out.append("timeline:")
+        for t, src, what in timeline:
+            out.append(f"  {_fmt_t(t, t0)}  [{src:7s}] {what}")
+    if "profile" in doc:
+        pr = doc["profile"]
+        out.append("")
+        out.append(f"profile: {pr['trace_file']}")
+        out.append(f"  {pr['comm_spans']} comm / {pr['compute_spans']} "
+                   f"compute span(s), overlap {pr['overlap_us']} us")
+        if pr.get("scope_totals_us"):
+            out.append("  per-region span totals (us):")
+            for k, v in sorted(pr["scope_totals_us"].items(),
+                               key=lambda kv: -kv[1]):
+                out.append(f"    {k:16s} {v}")
+    if problems:
+        out.append("")
+        out.append(f"schema problems ({len(problems)}):")
+        for prob in problems:
+            out.append(f"  {prob}")
+    print("\n".join(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(report_main())

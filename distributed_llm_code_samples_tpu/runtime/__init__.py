@@ -9,14 +9,18 @@ watchdog, XLA FFI custom calls); ``failure`` adds hang/peer/device failure
 detection and checkpoint-based elastic recovery; ``chaos`` injects
 deterministic faults so that story is continuously tested; and
 ``backend_probe`` walks an env-shape matrix to tell a dead accelerator
-relay from a self-broken environment (the round-5 outage).
+relay from a self-broken environment (the round-5 outage); ``telemetry``
+is the unified metrics stream (schema-versioned per-step JSONL records +
+the ``StepReport`` static fold) every run/bench/report shares.
 """
 
-from . import backend_probe, chaos, native
+from . import backend_probe, chaos, native, telemetry
 from .chaos import FaultPlan
 from .failure import (HealthCheckError, device_healthcheck, supervise)
 from .init import initialize, runtime_info, DEFAULT_COORDINATOR
+from .telemetry import StepReport, TelemetryWriter
 
-__all__ = ["backend_probe", "chaos", "native", "initialize",
-           "runtime_info", "DEFAULT_COORDINATOR", "FaultPlan",
-           "HealthCheckError", "device_healthcheck", "supervise"]
+__all__ = ["backend_probe", "chaos", "native", "telemetry",
+           "initialize", "runtime_info", "DEFAULT_COORDINATOR",
+           "FaultPlan", "HealthCheckError", "device_healthcheck",
+           "supervise", "StepReport", "TelemetryWriter"]

@@ -1,0 +1,442 @@
+"""Unified run telemetry: the per-step metrics stream every subsystem
+shares.
+
+The reference's observability surface is a rank-0 chrome trace plus
+ad-hoc wall-clock prints (``train_ffns.py:129-141, :378-382``). This
+repo had grown real instrumentation — collective counting
+(``utils/hlo.py``), trace span analysis (``bench_trace.py``),
+supervise's per-attempt JSONL (``runtime/failure.py``) — but each piece
+was an island with its own format. This module is the common spine
+(MegaScale's in-depth per-step observability stance): one
+schema-versioned JSONL stream, one writer, one FLOP/peak accounting,
+and a static ``StepReport`` that folds the compiler's own numbers
+(``cost_analysis`` + collective counts + compiled memory) into a single
+cross-checked object.
+
+Design rules:
+
+- **Non-blocking**: ``TelemetryWriter`` enqueues records (values may be
+  live device scalars) and a daemon thread does the ``float()``
+  readbacks + file appends — the training loop never blocks on
+  telemetry I/O, and device readbacks happen at the logging cadence,
+  never per step.
+- **Schema-stable**: every record carries ``schema`` =
+  ``SCHEMA_VERSION``; ``STEP_KEYS`` is the step-record contract and the
+  schema-contract test (tests/test_telemetry.py) pins it — changing the
+  key set without bumping the version fails the suite.
+- **Crash-safe enough**: one JSON object per line, flushed per record;
+  a torn final line is skipped by ``read_metrics``, never fatal.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import numpy as np
+
+SCHEMA_VERSION = 1
+
+METRICS_FILENAME = "metrics.jsonl"
+
+# The step-record contract: every "step" record carries exactly these
+# keys (values may be null when a source can't measure them — a CPU run
+# has no HBM stats, the FFN family has no scalar loss). Adding/removing
+# a key REQUIRES a SCHEMA_VERSION bump; tests/test_telemetry.py pins
+# the (version, key-set) pair.
+STEP_KEYS = (
+    "schema", "kind", "t", "step", "strategy", "loss", "grad_norm",
+    "tokens_per_sec", "step_time_s", "mfu", "hbm_high_water_bytes",
+)
+
+# Non-step record kinds the stream also carries: run headers ("meta"),
+# recovery/chaos/checkpoint events ("event"), and bench measurement rows
+# ("bench" — bench.py's per-measurement plumbing rides the same writer).
+RECORD_KINDS = ("step", "meta", "event", "bench")
+
+# bf16 peak matmul FLOP/s by chip generation (public spec sheets; the
+# default f32 jnp matmul on TPU lowers to single-pass bf16 MXU ops, so
+# bf16 peak is the honest MFU denominator — bench.py's convention, now
+# shared). Unknown kinds (CPU, new chips) return None: an honest null
+# MFU beats a guessed one in a persistent artifact.
+PEAK_BF16_FLOPS = {
+    "v2": 45e12, "v3": 123e12, "v4": 275e12,
+    "v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12, "v5": 459e12,
+    "v6 lite": 918e12, "v6e": 918e12,
+}
+
+
+def peak_flops(device_kind: str) -> float | None:
+    """bf16 peak FLOP/s for a ``device_kind`` string, or None when the
+    chip generation is unrecognized (CPU hosts, future TPUs)."""
+    kind = (device_kind or "").lower()
+    for key in sorted(PEAK_BF16_FLOPS, key=len, reverse=True):
+        if key in kind:
+            return PEAK_BF16_FLOPS[key]
+    return None
+
+
+def ffn_model_flops(tokens: int, model_size: int, n_layers: int,
+                    ffn_dim: int | None = None) -> int:
+    """Hand-counted model matmul FLOPs of ONE training step of the
+    reference FFN stack: fwd 2 matmuls = 4Tdf, bwd 4 matmuls = 8Tdf per
+    layer (bench.py's 12Tdf convention — the recompute policy's extra
+    executed matmul is HFU, never MFU)."""
+    f = 4 * model_size if ffn_dim is None else ffn_dim
+    return 12 * tokens * model_size * f * n_layers
+
+
+def transformer_model_flops(tokens: int, model_size: int, n_layers: int,
+                            seq_len: int) -> int:
+    """Per-step model FLOPs of the pre-LN transformer family (bench.py's
+    families convention): attention projections 8Td^2, scores+AV 2T^2d
+    (causal halving is applied by bench_attention's convention only for
+    its causal benchmark — the trainer accounting here matches
+    bench.py's families section), FFN 16Td^2; fwd 1x + bwd 2x."""
+    b = tokens // seq_len
+    per_layer = (8 * seq_len * model_size ** 2
+                 + 2 * seq_len ** 2 * model_size
+                 + 16 * model_size ** 2 * seq_len)
+    return 3 * b * n_layers * per_layer
+
+
+def lm_model_flops(tokens: int, model_size: int, n_layers: int,
+                   seq_len: int, vocab: int) -> int:
+    """Transformer blocks + the tied LM head (2TdV, fwd 1x + bwd 2x)."""
+    return (transformer_model_flops(tokens, model_size, n_layers, seq_len)
+            + 3 * 2 * tokens * model_size * vocab)
+
+
+def hand_flops_per_step(family: str, *, tokens: int, model_size: int,
+                        n_layers: int, seq_len: int = 0,
+                        vocab: int = 0) -> int | None:
+    """The hand FLOP count for a CLI model family, or None for families
+    without an agreed accounting yet (MoE variants: routed FLOPs depend
+    on capacity/dropping, so a static count would be dishonest)."""
+    if family == "ffn":
+        return ffn_model_flops(tokens, model_size, n_layers)
+    if family == "transformer" and seq_len:
+        return transformer_model_flops(tokens, model_size, n_layers,
+                                       seq_len)
+    if family == "lm" and seq_len and vocab:
+        return lm_model_flops(tokens, model_size, n_layers, seq_len, vocab)
+    return None
+
+
+def hbm_high_water() -> dict[str, int] | None:
+    """Per-device HBM high-water (``peak_bytes_in_use``) from
+    ``memory_stats()``, or None where the backend doesn't track it
+    (CPU). Keys are device ids as strings (JSON object keys)."""
+    import jax
+    stats = {}
+    for d in jax.devices():
+        try:
+            m = d.memory_stats()
+        except Exception:  # noqa: BLE001 — per-backend API surface
+            m = None
+        if not m:
+            continue
+        peak = m.get("peak_bytes_in_use", m.get("bytes_in_use"))
+        if peak is not None:
+            stats[str(d.id)] = int(peak)
+    return stats or None
+
+
+def _json_default(o):
+    """Last-resort JSON coercion for event payloads from other
+    subsystems: numpy scalars/arrays become numbers/lists, anything
+    else its repr — a stringly-typed field beats a dropped record."""
+    if isinstance(o, np.integer):
+        return int(o)
+    if isinstance(o, np.floating):
+        return float(o)
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    return repr(o)
+
+
+def _scalar(v) -> float | None:
+    """Host float of a (possibly device) scalar — the readback the
+    writer thread performs OFF the training thread."""
+    if v is None:
+        return None
+    try:
+        # NaN/Inf pass through deliberately: a poisoned loss is exactly
+        # what a chaos-run record should show (json round-trips them)
+        return float(np.asarray(v))
+    except (TypeError, ValueError):
+        return None
+
+
+class TelemetryWriter:
+    """Non-blocking JSONL metrics writer.
+
+    ``step()``/``event()``/``bench()`` enqueue and return immediately;
+    a daemon thread performs device readbacks (``float()`` of any jax
+    scalar in the record) and the file append. ``close()`` drains the
+    queue — records enqueued before close are never lost (the flush is
+    the batched host sync, at call sites that already sync).
+
+    One writer owns one ``metrics.jsonl``; a fresh writer APPENDS (a
+    supervised run restarts the process mid-stream — the record stream
+    spans attempts, which is exactly what the report tool wants).
+    """
+
+    def __init__(self, metrics_dir: str, meta: dict | None = None,
+                 filename: str = METRICS_FILENAME):
+        os.makedirs(metrics_dir, exist_ok=True)
+        self.path = os.path.join(metrics_dir, filename)
+        self._q: queue.Queue = queue.Queue()
+        self._err: str | None = None
+        self._closed = False
+        self._thread = threading.Thread(target=self._drain, daemon=True)
+        self._thread.start()
+        # a run that dies mid-stream (supervise exhausting its restarts,
+        # an uncaught trainer error) must not lose its tail — the final
+        # fault evidence is exactly what the report tool folds. close()
+        # is idempotent, so the normal explicit close stays cheap.
+        import atexit
+        atexit.register(self.close)
+        if meta is not None:
+            self.meta(meta)
+
+    # -- producers (training thread; never block on I/O or readbacks) --
+
+    def step(self, step: int, *, strategy=None, loss=None, grad_norm=None,
+             step_time_s=None, tokens=None, model_flops=None,
+             peak=None, hbm=None, t=None) -> None:
+        """Enqueue one per-logged-step record. ``strategy`` names the
+        trainer the step belongs to (multi-method CLI runs share one
+        stream); ``loss``/``grad_norm`` may be live device scalars (read
+        back on the writer thread); ``tokens``/``model_flops`` are
+        per-step counts from which throughput and MFU are derived;
+        ``hbm`` is a pre-collected ``hbm_high_water()`` dict (collect it
+        at the logging cadence — it is itself a host call)."""
+        self._put({"kind": "step", "t": time.time() if t is None else t,
+                   "step": int(step), "strategy": strategy, "loss": loss,
+                   "grad_norm": grad_norm,
+                   "step_time_s": step_time_s, "_tokens": tokens,
+                   "_model_flops": model_flops, "_peak": peak,
+                   "hbm_high_water_bytes": hbm})
+
+    def event(self, record: dict) -> None:
+        """Enqueue a recovery/chaos/checkpoint event record (the
+        supervise/checkpoint ``on_event`` stream, verbatim plus the
+        schema envelope)."""
+        rec = dict(record)
+        rec.setdefault("t", time.time())
+        rec["kind"] = "event"
+        self._put(rec)
+
+    def bench(self, record: dict) -> None:
+        """Enqueue one bench measurement row (bench.py's per-measurement
+        plumbing — metric name, value, unit, shape)."""
+        rec = dict(record)
+        rec.setdefault("t", time.time())
+        rec["kind"] = "bench"
+        self._put(rec)
+
+    def meta(self, record: dict) -> None:
+        """Enqueue a run-header record (shapes, strategy, flags, paths
+        to sibling logs — the report tool reads these to fold streams)."""
+        rec = dict(record)
+        rec.setdefault("t", time.time())
+        rec["kind"] = "meta"
+        self._put(rec)
+
+    # -- lifecycle --
+
+    def flush(self) -> None:
+        """Block until every enqueued record is on disk."""
+        self._q.join()
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self.flush()
+        self._q.put(None)
+        self._thread.join(timeout=10)
+        if self._err is not None:
+            # telemetry never kills a run, but a lossy stream must not
+            # stay silent either: name the last drop on the way out
+            import sys
+            print(f"telemetry: record(s) dropped while writing "
+                  f"{self.path} (last error: {self._err})",
+                  file=sys.stderr)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- writer thread --
+
+    def _put(self, rec: dict) -> None:
+        if self._closed:
+            raise RuntimeError("TelemetryWriter is closed")
+        rec["schema"] = SCHEMA_VERSION
+        self._q.put(rec)
+
+    def _finalize(self, rec: dict) -> dict:
+        """Readbacks + derived fields — runs on the writer thread."""
+        if rec.get("kind") == "step":
+            rec["loss"] = _scalar(rec.get("loss"))
+            rec["grad_norm"] = _scalar(rec.get("grad_norm"))
+            rec["step_time_s"] = _scalar(rec.get("step_time_s"))
+            tokens = rec.pop("_tokens", None)
+            flops = rec.pop("_model_flops", None)
+            peak = rec.pop("_peak", None)
+            dt = rec["step_time_s"]
+            rec["tokens_per_sec"] = (
+                round(tokens / dt, 2) if tokens and dt else None)
+            rec["mfu"] = (round(flops / dt / peak, 4)
+                          if flops and dt and peak else None)
+            # contract: a step record carries exactly STEP_KEYS
+            rec = {k: rec.get(k) for k in STEP_KEYS}
+        return rec
+
+    def _drain(self) -> None:
+        while True:
+            rec = self._q.get()
+            if rec is None:
+                self._q.task_done()
+                return
+            try:
+                # default=: event payloads originate in other subsystems
+                # (checkpoint/supervise) and may carry numpy scalars —
+                # coerce instead of dropping the record
+                line = json.dumps(self._finalize(rec),
+                                  default=_json_default)
+                with open(self.path, "a") as f:
+                    f.write(line + "\n")
+            except Exception as e:  # noqa: BLE001 — telemetry never kills a run
+                self._err = f"{type(e).__name__}: {e}"
+            finally:
+                self._q.task_done()
+
+
+def validate_record(rec: Any) -> tuple[bool, str]:
+    """Schema check for one parsed record: the envelope (``schema``,
+    ``kind``, ``t``) on every record, plus the full ``STEP_KEYS``
+    contract on step records."""
+    if not isinstance(rec, dict):
+        return False, "record is not a JSON object"
+    if rec.get("schema") != SCHEMA_VERSION:
+        return False, (f"schema {rec.get('schema')!r} != "
+                       f"{SCHEMA_VERSION} (version mismatch)")
+    kind = rec.get("kind")
+    if kind not in RECORD_KINDS:
+        return False, f"unknown kind {kind!r}"
+    if "t" not in rec:
+        return False, "missing timestamp 't'"
+    if kind == "step":
+        missing = [k for k in STEP_KEYS if k not in rec]
+        if missing:
+            return False, f"step record missing keys {missing}"
+        if not isinstance(rec["step"], int):
+            return False, f"step is {type(rec['step']).__name__}, not int"
+    return True, "ok"
+
+
+def read_metrics(path: str) -> tuple[list[dict], list[str]]:
+    """Parse a metrics JSONL: ``(records, problems)``. A torn final
+    line (crash mid-append) is reported, not fatal; schema-invalid
+    records are reported and skipped — the report tool renders what
+    verifies and names what doesn't."""
+    records, problems = [], []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                problems.append(f"line {i}: unparseable JSON "
+                                "(torn write?)")
+                continue
+            ok, reason = validate_record(rec)
+            if not ok:
+                problems.append(f"line {i}: {reason}")
+                continue
+            records.append(rec)
+    return records, problems
+
+
+@dataclass(frozen=True)
+class StepReport:
+    """Static (compile-time) report of one training step program: the
+    compiler's own cost/memory numbers and the lowered collective
+    schedule in one object, cross-checked against the hand FLOP count.
+
+    ``flops`` is XLA's ``cost_analysis()["flops"]`` (None where the
+    backend doesn't report it); ``hand_flops`` is the model's
+    hand-counted matmul FLOPs (the MFU numerator); ``flops_vs_hand``
+    is their ratio — ~1x for saved-activation policies, >1x for
+    recompute policies (executed > model FLOPs), and a number far from
+    either flags a broken accounting before a single step runs."""
+
+    collectives: dict[str, int] = field(default_factory=dict)
+    flops: float | None = None
+    bytes_accessed: float | None = None
+    memory: dict[str, Any] | None = None
+    hand_flops: int | None = None
+    flops_vs_hand: float | None = None
+
+    @classmethod
+    def of(cls, fn: Callable, *args, hand_flops: int | None = None,
+           **kwargs) -> "StepReport":
+        """Lower + compile ``fn`` for ``args`` and fold the static
+        analyses. One lowering feeds both the collective count and the
+        compile (the ``utils/hlo.py`` helpers re-lower per call — this
+        path does the work once)."""
+        import jax
+
+        from ..utils.hlo import count_collectives_text
+
+        lowered = jax.jit(fn).lower(*args, **kwargs)
+        collectives = {op: n for op, n
+                       in count_collectives_text(lowered.as_text()).items()
+                       if n}
+        compiled = lowered.compile()
+        flops = bytes_accessed = None
+        try:
+            cost = compiled.cost_analysis()
+            # older jax returns a list of dicts (one per program)
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else {}
+            if cost:
+                flops = float(cost.get("flops", 0)) or None
+                bytes_accessed = float(cost.get("bytes accessed", 0)) or None
+        except Exception:  # noqa: BLE001 — per-backend API surface
+            pass
+        memory = None
+        try:
+            m = compiled.memory_analysis()
+            if m is not None:
+                memory = {
+                    "argument_bytes": m.argument_size_in_bytes,
+                    "output_bytes": m.output_size_in_bytes,
+                    "temp_bytes": m.temp_size_in_bytes,
+                    "peak_bytes": getattr(m, "peak_memory_in_bytes", None),
+                }
+        except Exception:  # noqa: BLE001
+            pass
+        ratio = (round(flops / hand_flops, 4)
+                 if flops and hand_flops else None)
+        return cls(collectives=collectives, flops=flops,
+                   bytes_accessed=bytes_accessed, memory=memory,
+                   hand_flops=hand_flops, flops_vs_hand=ratio)
+
+    def as_dict(self) -> dict:
+        return {"collectives": dict(self.collectives), "flops": self.flops,
+                "bytes_accessed": self.bytes_accessed,
+                "memory": self.memory, "hand_flops": self.hand_flops,
+                "flops_vs_hand": self.flops_vs_hand}

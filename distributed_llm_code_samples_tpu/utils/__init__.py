@@ -1,15 +1,21 @@
 """Cross-cutting utilities: profiling/tracing, HLO comms introspection,
-per-device memory accounting."""
+per-device memory accounting, chrome-trace span analysis keyed on the
+framework's named scopes."""
 
 from .profiling import trace, profile_rank_0, timed
-from .hlo import (lowered_text, count_collectives, compiled_text,
-                  async_collective_pairs, count_async_pairs,
+from .hlo import (lowered_text, count_collectives, count_collectives_text,
+                  compiled_text, async_collective_pairs, count_async_pairs,
                   COLLECTIVE_OPS)
 from .memory import compiled_memory, params_bytes_per_device
+from .trace_analysis import (SCOPES, comm_compute_overlap, load_spans,
+                             overlap_payload, scope_totals)
 
 __all__ = [
     "trace", "profile_rank_0", "timed",
-    "lowered_text", "count_collectives", "compiled_text",
-    "async_collective_pairs", "count_async_pairs", "COLLECTIVE_OPS",
+    "lowered_text", "count_collectives", "count_collectives_text",
+    "compiled_text", "async_collective_pairs", "count_async_pairs",
+    "COLLECTIVE_OPS",
     "compiled_memory", "params_bytes_per_device",
+    "SCOPES", "comm_compute_overlap", "load_spans", "overlap_payload",
+    "scope_totals",
 ]

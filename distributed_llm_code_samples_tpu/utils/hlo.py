@@ -30,11 +30,18 @@ def lowered_text(fn, *args, **kwargs) -> str:
     return jax.jit(fn).lower(*args, **kwargs).as_text()
 
 
-def count_collectives(fn, *args, **kwargs) -> Counter:
-    """Occurrences of each collective op in the lowered StableHLO."""
-    text = lowered_text(fn, *args, **kwargs)
+def count_collectives_text(text: str) -> Counter:
+    """Occurrences of each collective op in already-lowered StableHLO
+    text — the text-level core of ``count_collectives``, shared with
+    callers that hold a lowering already (``runtime.telemetry.StepReport``
+    lowers once and feeds both this count and the compile)."""
     return Counter({op: len(re.findall(rf"stablehlo\.{op}\b|\"{op}", text))
                     for op in COLLECTIVE_OPS})
+
+
+def count_collectives(fn, *args, **kwargs) -> Counter:
+    """Occurrences of each collective op in the lowered StableHLO."""
+    return count_collectives_text(lowered_text(fn, *args, **kwargs))
 
 
 def compiled_text(fn, *args, **kwargs) -> str:

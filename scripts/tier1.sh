@@ -1,6 +1,7 @@
 #!/bin/bash
-# Tier-1 verify — the ROADMAP.md command, verbatim. This is the gate
-# every PR must keep no worse than the seed; run it before pushing.
+# Tier-1 verify — the ROADMAP.md command, verbatim, preceded by the
+# telemetry smoke. This is the gate every PR must keep no worse than the
+# seed; run it before pushing.
 #
 # Scope notes:
 # - `-m 'not slow'` keeps it CPU-fast; the chaos/probe/recovery tests
@@ -10,5 +11,41 @@
 #   injection and the env-matrix probe are exercised on every tier-1 run.
 # - DOTS_PASSED counts progress dots so a collection-error run can't
 #   masquerade as a pass.
+# - The telemetry smoke drives a tiny CPU run with --metrics_dir,
+#   asserts the stream holds >= 1 schema-valid record, and requires the
+#   `report` subcommand to exit 0 on it — the observability surface is
+#   gated like any other subsystem (runtime/telemetry.py).
 cd "$(dirname "$0")/.."
+
+echo "=== telemetry smoke ==="
+SMOKE_DIR=$(mktemp -d /tmp/tier1_telemetry.XXXXXX)
+if ! timeout -k 10 240 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli -m 2 -s 4 -bs 2 -n 8 -d 8 -l 2 \
+    --fake_devices 4 --metrics_dir "$SMOKE_DIR/metrics" --log_every 4 \
+    > /dev/null; then
+  echo "TELEMETRY_SMOKE=FAIL (run)"; rm -rf "$SMOKE_DIR"; exit 1
+fi
+if ! timeout -k 10 60 env JAX_PLATFORMS=cpu python - "$SMOKE_DIR/metrics" <<'EOF'
+import sys
+from distributed_llm_code_samples_tpu.runtime.telemetry import (
+    METRICS_FILENAME, read_metrics)
+import os
+records, problems = read_metrics(
+    os.path.join(sys.argv[1], METRICS_FILENAME))
+steps = [r for r in records if r["kind"] == "step"]
+assert steps, "no schema-valid step record in the smoke stream"
+assert not problems, problems
+EOF
+then
+  echo "TELEMETRY_SMOKE=FAIL (schema)"; rm -rf "$SMOKE_DIR"; exit 1
+fi
+if ! timeout -k 10 60 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli report "$SMOKE_DIR/metrics" \
+    > /dev/null; then
+  echo "TELEMETRY_SMOKE=FAIL (report)"; rm -rf "$SMOKE_DIR"; exit 1
+fi
+rm -rf "$SMOKE_DIR"
+echo "TELEMETRY_SMOKE=OK"
+
+echo "=== tier-1 pytest ==="
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
