@@ -72,6 +72,20 @@ class CorruptCheckpointError(RuntimeError):
 class NonFiniteParamsError(RuntimeError):
     """A training segment produced non-finite params (poisoned step)."""
 
+
+class LossSpikeError(RuntimeError):
+    """A training segment's param update jumped far beyond the previous
+    segment's — the loss-spike signature (PaLM's rewind-on-spike
+    scenario). Recoverable: the supervisor's rollback rung rewinds to
+    the last verified checkpoint in-process (``runtime/failure.py``).
+    Carries ``baseline`` (the pre-spike update norm) so the retry keeps
+    the reference scale — a PERSISTENT spike re-fires on the retrained
+    segment instead of slipping past a reset baseline."""
+
+    def __init__(self, msg: str, baseline: float | None = None):
+        super().__init__(msg)
+        self.baseline = baseline
+
 _ASYNC_WRITER = None
 _ERRORS_SEEN = 0  # errors already reported by a previous wait_pending
 _TMP_SEQ = 0      # unique tmp-dir suffixes for async staging
@@ -380,6 +394,18 @@ def latest_step(ckpt_dir: str) -> int | None:
     return max(steps) if steps else None
 
 
+def read_meta(ckpt_dir: str, step: int) -> dict:
+    """The user ``meta`` dict saved with ``step_{step}`` (empty when the
+    checkpoint predates it or carries none) — the elastic-resume path
+    reads the save-time ``data_shards`` from here."""
+    try:
+        with open(os.path.join(ckpt_dir, f"step_{step}",
+                               "meta.json")) as f:
+            return json.load(f).get("meta", {}) or {}
+    except (OSError, ValueError):
+        return {}
+
+
 def restore_checkpoint(ckpt_dir: str, target: Any, step: int | None = None,
                        shardings: Any = None, verify: bool = True):
     """Restore ``(params, step, seeds)``.
@@ -555,7 +581,12 @@ def run_with_checkpointing(train_fn, params, seeds, *args,
                            thread_state: bool | None = None,
                            restore_shardings=None, chaos=None,
                            nonfinite: str | None = None, keep_last: int = 0,
-                           on_event=None, **kwargs):
+                           on_event=None, guard=None, guard_state=None,
+                           spike_factor: float = 0.0,
+                           spike_baseline: float | None = None,
+                           elastic: bool = True,
+                           in_graph_chaos: bool = False,
+                           **kwargs):
     """Drive any strategy launcher (uniform L4 signature,
     ``fn(params, seeds, batch, d, **kw)``) with periodic checkpointing.
 
@@ -587,6 +618,38 @@ def run_with_checkpointing(train_fn, params, seeds, *args,
     supervisor to turn into a restart; ``keep_last`` keeps only the
     newest k published steps (0 = keep all); ``on_event`` receives one
     dict per noteworthy recovery event (structured logging).
+
+    Self-healing surface (round 8, DESIGN.md section 14):
+
+    - ``guard`` (a ``runtime.guardrails.GuardrailConfig``) threads the
+      in-graph guardrail through every segment: the trainer is called
+      with ``guard``/``guard_state``/``return_guard=True`` (the
+      single/ddp/fsdp/lm surface), the returned ``GuardState``
+      (skip/overflow counters, live loss scale) carries across segments,
+      and each segment whose counters advanced emits one ``anomaly``
+      event — the per-chunk counter flow the telemetry stream records.
+      With ``in_graph_chaos=True`` (an explicit opt-in for data
+      families whose seeds carry the poison into a float gradient —
+      the FFN family; cli passes it), chaos nan/inf faults are injected
+      IN-GRAPH via seed poisoning
+      (``FaultPlan.poison_segment_seeds``) so they exercise the
+      guardrail, not the segment-level ``nonfinite`` readback.
+    - ``spike_factor > 0`` arms the segment-delta spike guard: after
+      each finite segment, the global L2 norm of the params update is
+      compared against the previous segment's; a jump beyond
+      ``spike_factor``x raises ``LossSpikeError`` BEFORE the segment is
+      checkpointed — the supervisor's rollback rung rewinds to the last
+      verified step and retrains (transient spikes retrain cleanly).
+    - ``elastic`` (default on): a resume whose checkpoint was saved
+      under a different data-shard count N than the current
+      ``seeds_divisor`` M re-strides the remaining schedule to preserve
+      the save-time global batch — scale-DOWN (M | N) passes
+      ``seed_accum = N/M`` to the trainer (each survivor
+      gradient-accumulates the lost ranks' seeds; the update sequence,
+      and hence the loss trajectory, matches the uninterrupted N-device
+      run), scale-UP (N | M) continues with the new M-seed global batch
+      (deterministic batch order, new math — logged, not hidden). Any
+      other N/M pair fails loudly.
     """
     seeds = np.asarray(seeds)
     if seeds_divisor > 1:
@@ -649,6 +712,57 @@ def run_with_checkpointing(train_fn, params, seeds, *args,
                 seeds = np.concatenate([saved, seeds[len(saved):]])
             else:
                 seeds = saved  # saved schedule is authoritative on resume
+        # ---- topology-elastic resume (docstring): the saved data-shard
+        # count is authoritative for the remaining schedule's striding
+        saved_shards = read_meta(ckpt_dir, agreed).get("data_shards")
+        divisor = max(1, seeds_divisor)
+        if saved_shards and saved_shards != divisor:
+            if not elastic:
+                raise ValueError(
+                    f"checkpoint step_{agreed} was saved under "
+                    f"{saved_shards} data shards but this run has "
+                    f"{divisor} (elastic=False)")
+            if saved_shards % divisor == 0:
+                accum = saved_shards // divisor
+                import inspect
+                try:
+                    ps = inspect.signature(train_fn).parameters
+                    has_surface = ("seed_accum" in ps or any(
+                        p.kind is inspect.Parameter.VAR_KEYWORD
+                        for p in ps.values()))
+                except (TypeError, ValueError):
+                    has_surface = True
+                if not has_surface:
+                    raise ValueError(
+                        f"elastic resume from {saved_shards} shards onto "
+                        f"{divisor} needs {accum}-way seed accumulation, "
+                        f"but {getattr(train_fn, '__name__', train_fn)} "
+                        "has no seed_accum surface (ddp/fsdp have one)")
+                kwargs["seed_accum"] = accum
+                seeds_divisor = saved_shards  # global batch preserved
+            elif divisor % saved_shards == 0:
+                accum = 1  # scale-up: the NEW global batch takes over
+                seeds_divisor = divisor
+            else:
+                raise ValueError(
+                    f"elastic resume needs the save-time shard count "
+                    f"({saved_shards}) and the current one ({divisor}) "
+                    "to divide one another (M|N or N|M)")
+            if every > 0 and every % seeds_divisor:
+                raise ValueError(
+                    f"checkpoint every={every} does not tile the "
+                    f"{seeds_divisor}-seed global batch preserved by "
+                    "the elastic resume")
+            if len(seeds) % seeds_divisor:
+                raise ValueError(
+                    f"{len(seeds)} seeds do not divide across the "
+                    f"{seeds_divisor}-seed elastic global batch")
+            _emit_event(on_event, {
+                "event": "elastic_resume", "step": start,
+                "saved_shards": int(saved_shards),
+                "current_shards": int(divisor),
+                "seed_accum": int(accum),
+                "n_devices": jax.device_count()})
     else:
         if _primary() and os.path.isdir(ckpt_dir):
             # restart: drop stale step_* dirs so a later resume can't pick
@@ -658,7 +772,26 @@ def run_with_checkpointing(train_fn, params, seeds, *args,
                     shutil.rmtree(os.path.join(ckpt_dir, name))
         _sync("restart-cleared")
         # publish step_0 so the schedule survives a crash in segment 1
-        save_checkpoint(ckpt_dir, tree, 0, seeds, backend=backend)
+        save_checkpoint(ckpt_dir, tree, 0, seeds, backend=backend,
+                        meta={"data_shards": int(max(1, seeds_divisor)),
+                              "n_devices": jax.device_count()})
+    # every published step records the EFFECTIVE data-shard count (the
+    # global batch in seeds) — the anchor a later elastic resume restrides
+    # the remaining schedule against
+    ckpt_meta = {"data_shards": int(max(1, seeds_divisor)),
+                 "n_devices": jax.device_count()}
+    gstate = None
+    g_seen = None
+    if guard is not None:
+        from .runtime.guardrails import host_state, summarize
+        gstate = host_state(guard_state, guard)
+        g_seen = summarize(gstate)
+        kwargs = dict(kwargs, guard=guard)
+    # spike-guard baseline: fresh runs baseline on their first segment;
+    # a rollback/restart retry passes the PRE-SPIKE baseline back in
+    # (LossSpikeError.baseline via the supervisor) so a persistent spike
+    # re-fires on the retrained segment instead of re-baselining on it
+    prev_delta = spike_baseline
     total = len(seeds)
     chunk = every if every > 0 else total
     if chaos is not None:
@@ -677,25 +810,58 @@ def run_with_checkpointing(train_fn, params, seeds, *args,
     while start < total:
         n = min(chunk, total - start)
         fn = train_fn
+        seg_seeds = seeds[start:start + n]
         if chaos is not None:
-            chaos.begin_segment(start, n)
+            # in_graph_chaos=True routes nan/inf faults through seed
+            # poisoning into the compiled chunk (the guardrail must
+            # catch them). It is an EXPLICIT opt-in for callers who
+            # know the data family carries the poison into a float
+            # gradient (cli does, for the FFN family): families whose
+            # data layer strips the bits (the LM's integer token draws)
+            # would consume the fault without ever firing it — a chaos
+            # drill that vacuously passes. Default: host-level poison,
+            # which fires everywhere (guardrails or not).
+            chaos.begin_segment(start, n,
+                                in_graph=bool(in_graph_chaos)
+                                and guard is not None)
             fn = chaos.wrap(train_fn)
+            seg_seeds = chaos.poison_segment_seeds(seg_seeds)
+        gkw = ({} if guard is None
+               else {"guard_state": gstate, "return_guard": True})
         if optimizer is not None:
-            new_params, new_opt = fn(
-                params, seeds[start:start + n], *args, optimizer=optimizer,
-                opt_state=opt_state, return_state=True, **kwargs)
+            out = fn(params, seg_seeds, *args, optimizer=optimizer,
+                     opt_state=opt_state, return_state=True, **gkw,
+                     **kwargs)
+        else:
+            out = fn(params, seg_seeds, *args, **gkw, **kwargs)
+        if guard is not None:
+            out, gstate = out
+        if optimizer is not None:
+            new_params, new_opt = out
             tree = (new_params, new_opt)
         else:
-            new_params = fn(params, seeds[start:start + n], *args,
-                            **kwargs)
+            new_params = out
             new_opt = None
             tree = new_params
         jax.block_until_ready(tree)
+        if guard is not None:
+            from .runtime.guardrails import anomaly_delta, summarize
+            cur = summarize(gstate)
+            delta = anomaly_delta(g_seen, cur, start + n,
+                                  [start + 1, start + n])
+            if delta is not None:
+                _emit_event(on_event, dict(delta, event="anomaly"))
+            g_seen = cur
         if nonfinite and not tree_finite(tree):
             if nonfinite == "raise":
-                raise NonFiniteParamsError(
+                err = NonFiniteParamsError(
                     f"non-finite params after steps "
                     f"{start + 1}..{start + n}")
+                # the live guard state survives the rollback rung: the
+                # supervisor threads it back in so the dynamic loss
+                # scale / counters don't reset on an in-process rewind
+                err.guard_state = gstate
+                raise err
             # skip: the poisoned step is never checkpointed; params stay
             # at the pre-segment state and the schedule advances past it
             print(f"checkpoint: non-finite params after steps "
@@ -705,6 +871,29 @@ def run_with_checkpointing(train_fn, params, seeds, *args,
                                    "steps": [start + 1, start + n]})
             start += n
             continue
+        if spike_factor > 0:
+            # segment-delta spike guard (docstring): a finite but wildly
+            # out-of-scale update is the loss-spike signature — refuse
+            # to checkpoint it and let the supervisor's rollback rung
+            # rewind to the last verified step
+            from .runtime.guardrails import delta_norm
+            delta = delta_norm(params, new_params)
+            if (prev_delta is not None and prev_delta > 0
+                    and delta > spike_factor * prev_delta):
+                _emit_event(on_event, {
+                    "event": "loss_spike",
+                    "steps": [start + 1, start + n],
+                    "delta": round(delta, 6),
+                    "baseline": round(prev_delta, 6),
+                    "factor": spike_factor})
+                err = LossSpikeError(
+                    f"update norm {delta:.4g} after steps "
+                    f"{start + 1}..{start + n} exceeds {spike_factor}x "
+                    f"the previous segment's {prev_delta:.4g} — "
+                    "loss-spike rollback", baseline=prev_delta)
+                err.guard_state = gstate  # see the nonfinite raise above
+                raise err
+            prev_delta = delta
         params = new_params
         if optimizer is not None:
             opt_state = new_opt
@@ -712,7 +901,7 @@ def run_with_checkpointing(train_fn, params, seeds, *args,
         # with backend="native" this returns immediately (buffers copied);
         # the next segment's training overlaps the disk write
         path = save_checkpoint(ckpt_dir, tree, start, seeds,
-                               backend=backend)
+                               backend=backend, meta=ckpt_meta)
         # one event per published segment: structured progress for the
         # supervisor's log AND its hang-detector re-arm (failure.py)
         _emit_event(on_event, {"event": "published", "step": start,

@@ -193,7 +193,36 @@ def build_parser() -> argparse.ArgumentParser:
                         "recovery); requires --checkpoint_dir and a "
                         "single --method")
     p.add_argument("--max_restarts", type=int, default=3,
-                   help="with --chaos: the supervisor's restart budget")
+                   help="with --chaos or --spike_factor: the "
+                        "supervisor's restart budget")
+    p.add_argument("--guardrails", action="store_true",
+                   help="compile the in-graph anomaly guardrail into the "
+                        "training step (runtime/guardrails.py, methods "
+                        "1/2/3/11): a non-finite update is jnp.where-"
+                        "skipped inside the compiled chunk — params and "
+                        "optimizer state untouched, zero restarts — and "
+                        "per-chunk skip counters flow to --metrics_dir "
+                        "as `anomaly` records. With --mixed (methods "
+                        "2/3) adds dynamic loss scaling")
+    p.add_argument("--loss_scale", type=float, default=0.0,
+                   help="with --guardrails --mixed (methods 2/3): "
+                        "initial dynamic loss scale (0 = auto 2^15; "
+                        "grows 2x per 200 clean steps, halves on "
+                        "overflow)")
+    p.add_argument("--spike_factor", type=float, default=0.0,
+                   help="with --checkpoint_dir: arm the loss-spike "
+                        "guard — a segment whose param-update norm "
+                        "exceeds this multiple of the previous "
+                        "segment's raises for the supervisor's "
+                        "in-process rollback rung instead of being "
+                        "checkpointed (0 = off; the PaLM rewind-on-"
+                        "spike practice)")
+    p.add_argument("--max_rollbacks", type=int, default=2,
+                   help="with --chaos or --spike_factor: budget for the "
+                        "supervisor's "
+                        "in-process rollback rung (rewind to the last "
+                        "verified checkpoint without a restart) before "
+                        "escalating to full restarts")
     p.add_argument("--metrics_dir", default=None,
                    help="write the unified telemetry stream here "
                         "(runtime/telemetry.py): one schema-versioned "
@@ -270,6 +299,55 @@ def main(argv=None) -> int:
         except ValueError as e:
             print(f"error: {e}", file=sys.stderr)
             return 2
+    if args.guardrails and args.method not in (0, 1, 2, 3, 9, 11):
+        # 0/9 sweeps are allowed: the per-method loop arms the guard on
+        # the strategies with the surface (1/2/3/11) and the guard is
+        # value-transparent on clean runs, so the cross-strategy
+        # differentials keep their power
+        print("error: --guardrails applies to --method 1, 2, 3, or 11 "
+              "(or the 0/9 sweeps, which guard those strategies)",
+              file=sys.stderr)
+        return 2
+    if args.guardrails and args.zero1:
+        print("error: --guardrails does not support --zero1: "
+              "train_ddp_zero1 has no guard surface (its re-assembled "
+              "params are typed shard-varying)", file=sys.stderr)
+        return 2
+    if args.loss_scale < 0:
+        print(f"error: --loss_scale must be >= 0 (got {args.loss_scale})",
+              file=sys.stderr)
+        return 2
+    if args.loss_scale > 0 and not (args.guardrails and args.mixed
+                                    and args.method in (0, 2, 3, 9)):
+        # 0/9 sweeps allowed like --guardrails itself: the per-method
+        # loop applies the scale to the methods that scale (2/3)
+        print("error: --loss_scale applies with --guardrails --mixed on "
+              "--method 2 or 3 (or the 0/9 sweeps; dynamic scaling "
+              "protects the bf16 backward)", file=sys.stderr)
+        return 2
+    if args.spike_factor < 0:
+        print(f"error: --spike_factor must be >= 0 "
+              f"(got {args.spike_factor})", file=sys.stderr)
+        return 2
+    if args.spike_factor and not args.checkpoint_dir:
+        print("error: --spike_factor requires --checkpoint_dir (the "
+              "spike guard compares checkpoint-segment deltas and the "
+              "rollback rung rewinds to a published checkpoint)",
+              file=sys.stderr)
+        return 2
+    if args.spike_factor and not args.checkpoint_every:
+        # with the default (whole-run) segmentation there is only one
+        # segment: no baseline ever forms and the guard NEVER fires —
+        # refusing beats silently-unarmed spike protection
+        print("error: --spike_factor requires --checkpoint_every > 0: "
+              "the spike guard compares successive segment deltas, and "
+              "one whole-run segment has nothing to compare",
+              file=sys.stderr)
+        return 2
+    if args.max_rollbacks < 0:
+        print(f"error: --max_rollbacks must be >= 0 "
+              f"(got {args.max_rollbacks})", file=sys.stderr)
+        return 2
     if args.comm != "psum" and args.zero1:
         print("error: --comm pallas_ring does not apply to --zero1 "
               "(ZeRO-1's reduce_scatter/all_gather pair keeps the XLA "
@@ -629,6 +707,15 @@ def main(argv=None) -> int:
         if m == 1 and args.pallas:
             kwargs["use_pallas"] = True
             kwargs["interpret"] = jax.default_backend() != "tpu"
+        if args.guardrails and m in (1, 2, 3, 11):
+            from .runtime.guardrails import GuardrailConfig
+            scale0 = 0.0
+            if args.mixed and m in (2, 3):
+                # dynamic loss scaling protects the bf16 backward; 2^15
+                # is the conventional warm start (halves on overflow)
+                scale0 = (args.loss_scale if args.loss_scale > 0
+                          else 2.0 ** 15)
+            kwargs["guard"] = GuardrailConfig(loss_scale=scale0)
         if mesh is not None:
             kwargs["mesh"] = mesh
         if args.profile_dir:
@@ -645,7 +732,7 @@ def main(argv=None) -> int:
                 n_layers=args.layers, seq_len=args.seq_len,
                 vocab=args.vocab)
             attempt_log = None
-            if chaos_plan is not None:
+            if chaos_plan is not None or args.spike_factor > 0:
                 # supervise's per-attempt JSONL (failure.py default
                 # path) — recorded ABSOLUTE so `report` folds it from
                 # any working directory without being told
@@ -672,6 +759,9 @@ def main(argv=None) -> int:
             from .checkpoint import run_with_checkpointing
             ck_kwargs = dict(kwargs)
             opt = ck_kwargs.pop("optimizer", None)
+            # guard threads per segment at the checkpoint layer (counter
+            # continuity + anomaly events), not per trainer call
+            guard_cfg = ck_kwargs.pop("guard", None)
             stateful_opt = opt is not None and not opt.stateless
             restore_shardings = None
             if m == 3 and stateful_opt and mesh is not None:
@@ -687,8 +777,18 @@ def main(argv=None) -> int:
                 last_pub = {"t": time.perf_counter()}
 
                 def on_event(rec, _name=name, _flops=model_flops):
+                    ev = rec.get("event")
+                    if ev == "anomaly":
+                        # schema v2 kinds get their own record stream
+                        # (guardrail counters / ladder rungs), not the
+                        # generic event envelope
+                        metrics.anomaly(dict(rec, strategy=_name))
+                        return
+                    if ev == "rollback":
+                        metrics.rollback(dict(rec, strategy=_name))
+                        return
                     metrics.event(dict(rec, strategy=_name))
-                    if rec.get("event") != "published":
+                    if ev != "published":
                         return
                     now = time.perf_counter()
                     a, b = rec.get("steps", (rec["step"], rec["step"]))
@@ -700,15 +800,20 @@ def main(argv=None) -> int:
 
                 ck_kwargs["on_event"] = on_event
             runner = run_with_checkpointing
-            if chaos_plan is not None:
-                # fault load goes through the failure supervisor: a
-                # raised fault (nonfinite="raise") costs one restart and
-                # the next attempt resumes from the last VERIFIED
+            if chaos_plan is not None or args.spike_factor > 0:
+                # fault load (and any armed spike guard — its remedy IS
+                # the supervisor's rollback rung, so a real spike in a
+                # chaos-free run must not escape as a raw traceback)
+                # goes through the failure supervisor: a raised fault
+                # rolls back in-process or costs one restart, and the
+                # next attempt resumes from the last VERIFIED
                 # checkpoint; kill@s takes the whole process, so its
                 # recovery is the next invocation of this same command
                 from .runtime.failure import supervise as runner
                 ck_kwargs.update(max_restarts=args.max_restarts,
-                                 chaos=chaos_plan, nonfinite="raise")
+                                 max_rollbacks=args.max_rollbacks)
+                if chaos_plan is not None:
+                    ck_kwargs.update(chaos=chaos_plan, nonfinite="raise")
             out = runner(
                 fn, params, seeds, tokens, args.model_size,
                 ckpt_dir=os.path.join(args.checkpoint_dir, name),
@@ -720,6 +825,13 @@ def main(argv=None) -> int:
                 # ZeRO-1's sharded state has no such surface yet
                 thread_state=stateful_opt and not args.zero1,
                 stateful=stateful_opt and args.zero1,
+                guard=guard_cfg, spike_factor=args.spike_factor,
+                # seed-poison injection only works where the data layer
+                # carries it into a float gradient (the FFN family);
+                # integer-token families keep the host-level poison so
+                # the fault actually fires (rollback rung, not skip)
+                in_graph_chaos=(guard_cfg is not None
+                                and family_of(m) == "ffn"),
                 restore_shardings=restore_shardings, **ck_kwargs)
         elif metrics is not None:
             # metrics-chunked driving: the schedule runs as log_every-step
@@ -747,16 +859,36 @@ def main(argv=None) -> int:
                       f"seed stride of {name}; logging one whole-run "
                       "record", file=sys.stderr)
                 chunk = len(seeds)
+            g_cfg = kwargs.get("guard")
+            gstate = None
+            g_prev = {"skipped": 0, "overflows": 0}
             out = params
             done = 0
             while done < len(seeds):
                 n_chunk = int(min(chunk, len(seeds) - done))
                 tc = time.perf_counter()
-                out = fn(out, seeds[done:done + n_chunk], tokens,
-                         args.model_size, **kwargs)
+                if g_cfg is not None:
+                    # thread the guard state across chunks (scale and
+                    # counters persist) and surface per-chunk deltas
+                    out, gstate = fn(out, seeds[done:done + n_chunk],
+                                     tokens, args.model_size,
+                                     guard_state=gstate,
+                                     return_guard=True, **kwargs)
+                else:
+                    out = fn(out, seeds[done:done + n_chunk], tokens,
+                             args.model_size, **kwargs)
                 jax.block_until_ready(out)
                 dt = time.perf_counter() - tc
                 done += n_chunk
+                if g_cfg is not None:
+                    from .runtime.guardrails import (anomaly_delta,
+                                                     summarize)
+                    g_cur = summarize(gstate)
+                    delta = anomaly_delta(g_prev, g_cur, done,
+                                          [done - n_chunk + 1, done])
+                    if delta is not None:
+                        metrics.anomaly(dict(delta, strategy=name))
+                    g_prev = g_cur
                 loss = gnorm = None
                 if probe is not None:
                     try:

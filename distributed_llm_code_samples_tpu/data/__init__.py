@@ -25,6 +25,22 @@ from .. import DLOSS_DX_COEF
 # torch.Generator per step (train_ffns.py:145-148).
 _DATA_KEY = 0
 
+# In-graph fault-injection flags (runtime/chaos.py with guardrails on):
+# the seed IS the dataset, so a fault that must fire INSIDE a compiled
+# multi-step chunk rides the seed value itself — the chaos layer sets a
+# high bit on the target step's seed and `batch_from_seed` turns it into
+# a poisoned upstream gradient via `jnp.where`, deterministically, on
+# every strategy, with no per-strategy plumbing. Schedule seeds live in
+# [0, 100_000) (make_seed_schedule), so bits 28/29 are always free.
+POISON_NAN_BIT = 1 << 29
+POISON_INF_BIT = 1 << 28
+_POISON_MASK = POISON_NAN_BIT | POISON_INF_BIT
+
+
+def strip_poison(seed):
+    """The underlying schedule seed, poison flags cleared (traced-safe)."""
+    return jnp.bitwise_and(jnp.asarray(seed), jnp.int32(~_POISON_MASK))
+
 
 def batch_from_seed(seed: jax.Array, batch_size: int, model_size: int,
                     dtype=jnp.float32):
@@ -34,12 +50,26 @@ def batch_from_seed(seed: jax.Array, batch_size: int, model_size: int,
     gradient ``dloss_dx = 0.1 * normal([batch, d])`` "coming from the right"
     (``train_ffns.py:12, :30, :149-150``). ``seed`` may be a traced scalar —
     this works inside ``lax.scan`` over a seed schedule.
+
+    A seed carrying a poison flag (``POISON_NAN_BIT``/``POISON_INF_BIT``,
+    set by ``runtime.chaos`` for in-graph fault injection) produces the
+    *same* ``x`` as its base seed but a NaN/Inf ``dloss_dx`` — the
+    poisoned-gradient step the in-graph guardrails
+    (``runtime/guardrails.py``) must catch and skip.
     """
-    key = jax.random.fold_in(jax.random.PRNGKey(_DATA_KEY), seed)
+    seed = jnp.asarray(seed)
+    base = strip_poison(seed)
+    key = jax.random.fold_in(jax.random.PRNGKey(_DATA_KEY), base)
     kx, kd = jax.random.split(key)
     x = jax.random.normal(kx, (batch_size, model_size)).astype(dtype)
     dloss_dx = (DLOSS_DX_COEF *
                 jax.random.normal(kd, (batch_size, model_size))).astype(dtype)
+    nan_p = jnp.bitwise_and(seed, jnp.int32(POISON_NAN_BIT)) != 0
+    inf_p = jnp.bitwise_and(seed, jnp.int32(POISON_INF_BIT)) != 0
+    dloss_dx = jnp.where(nan_p, jnp.asarray(jnp.nan, dloss_dx.dtype),
+                         dloss_dx)
+    dloss_dx = jnp.where(inf_p, jnp.asarray(jnp.inf, dloss_dx.dtype),
+                         dloss_dx)
     return x, dloss_dx
 
 
@@ -59,7 +89,10 @@ def lm_batch_from_seed(seed: jax.Array, batch: int, seq_len: int,
     contract as ``batch_from_seed`` — bit-identical on every rank, traced
     or eager — so the LM strategies keep the framework's seeds-as-dataset
     differential-testing story."""
-    key = jax.random.fold_in(jax.random.PRNGKey(_DATA_KEY), seed)
+    # poison flags are an FFN-family (float-gradient) injection; integer
+    # token draws strip them so a poisoned schedule stays deterministic
+    key = jax.random.fold_in(jax.random.PRNGKey(_DATA_KEY),
+                             strip_poison(seed))
     toks = jax.random.randint(key, (batch, seq_len + 1), 0, vocab,
                               dtype=jnp.int32)
     return toks[:, :-1], toks[:, 1:]
@@ -137,3 +170,32 @@ def shard_seeds_strided(seeds, n_ranks: int) -> jnp.ndarray:
             f"num_steps={seeds.shape[0]} not divisible by n_ranks={n_ranks} "
             "(reference asserts the same, train_ffns.py:175)")
     return seeds.reshape(-1, n_ranks)
+
+
+def shard_seeds_elastic(seeds, n_ranks: int, accum: int) -> jnp.ndarray:
+    """Global-batch-preserving re-stride for topology-elastic resume:
+    ``[T] -> [T / (accum * n_ranks), accum, n_ranks]`` where slot
+    ``[t, j, r]`` is global seed ``seeds[t*N + j*n_ranks + r]`` with
+    ``N = accum * n_ranks`` — the original device count at save time.
+
+    Rank ``r``'s optimizer update ``t`` gradient-accumulates over its
+    ``accum`` seeds, so the union of seeds per update is exactly
+    ``seeds[t*N : (t+1)*N]`` — the same global batch the N-device run
+    consumed (``shard_seeds_strided`` semantics). A checkpoint saved
+    under N devices therefore resumes onto ``n_ranks = N/accum``
+    survivors with the SAME update sequence: the post-resume batch order
+    is deterministic and the loss trajectory matches the uninterrupted
+    N-device run (tests/test_elastic.py pins it).
+
+    ``accum=1`` degrades to ``shard_seeds_strided`` with an extra
+    singleton axis."""
+    seeds = jnp.asarray(seeds)
+    if accum < 1:
+        raise ValueError(f"accum must be >= 1, got {accum}")
+    n = accum * n_ranks
+    if seeds.shape[0] % n != 0:
+        raise ValueError(
+            f"num_steps={seeds.shape[0]} not divisible by the "
+            f"{n}-seed global batch ({accum} accum x {n_ranks} ranks) "
+            "— elastic resume preserves the save-time global batch")
+    return seeds.reshape(-1, accum, n_ranks)

@@ -11,8 +11,8 @@ needs real sequence structure), so generic consumers of ``STRATEGIES``
 must pass those for them.
 """
 
-from .mesh import (make_mesh, guard_multi_device, DATA_AXIS, MODEL_AXIS,
-                   SEQ_AXIS, PIPE_AXIS, EXPERT_AXIS)
+from .mesh import (make_mesh, elastic_mesh, guard_multi_device, DATA_AXIS,
+                   MODEL_AXIS, SEQ_AXIS, PIPE_AXIS, EXPERT_AXIS)
 from . import collectives
 from .single import train_single
 from .ddp import train_ddp
@@ -54,7 +54,7 @@ STRATEGIES = {
 }
 
 __all__ = [
-    "make_mesh", "guard_multi_device",
+    "make_mesh", "elastic_mesh", "guard_multi_device",
     "DATA_AXIS", "MODEL_AXIS", "SEQ_AXIS", "PIPE_AXIS", "EXPERT_AXIS",
     "collectives",
     "train_single", "train_ddp", "train_ddp_zero1", "train_fsdp",

@@ -20,6 +20,7 @@ with unscaled LR (``:165``, ``optim.py``).
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from .. import LR
@@ -50,15 +51,22 @@ def grads_for_batch(params: FFNStackParams, x, dy, unroll: bool = True,
 
 def local_grads(params: FFNStackParams, seed, batch_size: int,
                 model_size: int, unroll: bool = True, grad_hook=None,
-                accum: int = 1, mixed: bool = False):
+                accum: int = 1, mixed: bool = False, dy_scale=None):
     """One shard's step grads from its seed (see ``grads_for_batch``).
 
     ``accum > 1`` sums over token chunks (``ops.stack.accumulated_grads``)
     — UNREDUCED: the hook does not apply on this path, so the caller
     reduces the summed grads once (DDP all_reduce / ZeRO-1 reduce_scatter).
+
+    ``dy_scale`` multiplies the upstream gradient before the backward —
+    the dynamic-loss-scaling hook (``runtime/guardrails.py``): under
+    ``mixed`` the scaled ``dy`` rides the bf16 blocks, and the caller
+    unscales the f32 grads after its reduction.
     """
     x, dloss_dx = batch_from_seed(seed, batch_size, model_size,
                                   params.w1.dtype)
+    if dy_scale is not None:
+        dloss_dx = (dloss_dx * dy_scale.astype(dloss_dx.dtype))
     if accum == 1:
         return grads_for_batch(params, x, dloss_dx, unroll, grad_hook,
                                mixed)
@@ -71,7 +79,8 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
               unroll: bool = True, axis: str = DATA_AXIS,
               optimizer: Optimizer | None = None, accum: int = 1,
               mixed: bool = False, comm: str = "psum",
-              ring_interpret: bool | None = None):
+              ring_interpret: bool | None = None, guard=None,
+              seed_accum: int = 1):
     """One DDP step for one shard: local fwd/bwd with per-layer grad psum.
 
     Without ``optimizer`` the step is the reference's stateless inline SGD
@@ -91,7 +100,21 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
     default) or ``"pallas_ring"`` (the hand-scheduled
     ``make_async_remote_copy`` ring of ``ops/pallas_ring.py`` — the
     explicit-control path, load-bearing in a real strategy; same sums,
-    ring accumulation order)."""
+    ring accumulation order).
+
+    ``seed_accum > 1`` is the topology-elastic surface: the step takes
+    a ``[seed_accum]`` seed VECTOR, sums the per-seed grads locally,
+    and reduces once — preserving the save-time global batch when a
+    checkpoint resumes onto fewer devices (``data.shard_seeds_elastic``).
+
+    ``guard`` (a ``GuardrailConfig``) arms the in-graph hooks that live
+    INSIDE the step math: dynamic loss scaling under ``mixed`` (the
+    step then takes ``(carry, seed, loss_scale)`` — the launcher's
+    ``guard_scale`` contract) and global-norm clipping
+    (``guard.clip_norm``) on the stateless-SGD path. The skip-select
+    and counters live in the launcher wrap (``guardrails.py``)."""
+    from ..runtime.guardrails import finalize_grads, require_mixed_for_scaling
+    require_mixed_for_scaling(guard, mixed)
     if comm not in ("psum", "pallas_ring"):
         raise ValueError(f"unknown comm {comm!r} "
                          "(expected 'psum' or 'pallas_ring')")
@@ -108,27 +131,46 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
         with jax.named_scope("comm"):  # -> ddp/bwd/comm in traces/HLO
             return reduce(dw1), reduce(dw2)
 
-    def grads_of(params, seed):
-        if accum == 1:
-            return local_grads(params, seed, batch_size, model_size,
-                               unroll, grad_hook, mixed=mixed)
-        total = local_grads(params, seed, batch_size, model_size, unroll,
-                            accum=accum, mixed=mixed)
-        with jax.named_scope("comm"):  # one tree-wide reduction
-            return jax.tree_util.tree_map(reduce, total)
+    def grads_of(params, seed, scale=None):
+        if seed_accum > 1:
+            # elastic resume: `seed` is a [seed_accum] vector — sum the
+            # per-seed grads locally (the grads of the lost ranks), then
+            # reduce ONCE, like the token-accum path
+            total = local_grads(params, seed[0], batch_size, model_size,
+                                unroll, accum=accum, mixed=mixed,
+                                dy_scale=scale)
+            for j in range(1, seed_accum):
+                total = jax.tree_util.tree_map(
+                    jnp.add, total,
+                    local_grads(params, seed[j], batch_size, model_size,
+                                unroll, accum=accum, mixed=mixed,
+                                dy_scale=scale))
+            with jax.named_scope("comm"):
+                grads = jax.tree_util.tree_map(reduce, total)
+        elif accum == 1:
+            grads = local_grads(params, seed, batch_size, model_size,
+                                unroll, grad_hook, mixed=mixed,
+                                dy_scale=scale)
+        else:
+            total = local_grads(params, seed, batch_size, model_size,
+                                unroll, accum=accum, mixed=mixed,
+                                dy_scale=scale)
+            with jax.named_scope("comm"):  # one tree-wide reduction
+                grads = jax.tree_util.tree_map(reduce, total)
+        return finalize_grads(grads, scale, guard)
 
-    def step(params: FFNStackParams, seed) -> FFNStackParams:
+    def step(params: FFNStackParams, seed, scale=None) -> FFNStackParams:
         # named-scope regions (ddp/fwd, ddp/bwd, ddp/bwd/comm, ddp/optim)
         # — the naming map lives in utils/trace_analysis.SCOPES
         with jax.named_scope("ddp"):
-            grads = grads_of(params, seed)
+            grads = grads_of(params, seed, scale)
             with jax.named_scope("optim"):
                 return sgd(params, grads, lr)
 
-    def step_opt(carry, seed):
+    def step_opt(carry, seed, scale=None):
         params, state = carry
         with jax.named_scope("ddp"):
-            grads = grads_of(params, seed)
+            grads = grads_of(params, seed, scale)
             with jax.named_scope("optim"):
                 return optimizer.update(grads, state, params, lr)
 
@@ -139,7 +181,9 @@ def train_ddp(params: FFNStackParams, seeds, batch_size: int,
               model_size: int, mesh, lr: float = LR, unroll: bool = True,
               optimizer: Optimizer | None = None, accum: int = 1,
               opt_state=None, return_state: bool = False,
-              mixed: bool = False, comm: str = "psum"):
+              mixed: bool = False, comm: str = "psum",
+              guard=None, guard_state=None, return_guard: bool = False,
+              seed_accum: int = 1):
     """Run the full DDP schedule; returns the (replicated) final params.
 
     ``seeds`` is the *global* schedule; the strided split across ranks
@@ -162,21 +206,42 @@ def train_ddp(params: FFNStackParams, seeds, batch_size: int,
     ``comm="pallas_ring"`` swaps every gradient reduction for the
     hand-scheduled ICI ring kernel (see ``make_step``) — same sums in
     ring order, pinned against the psum path.
+
+    ``guard``/``guard_state``/``return_guard`` arm the in-graph anomaly
+    guardrail (``runtime/guardrails.py``): a non-finite update is
+    skipped inside the compiled scan (params and optimizer state
+    untouched) and the skip/overflow counters (+ the live loss scale,
+    dynamic under ``mixed``) return alongside the result when
+    ``return_guard``. ``seed_accum`` is the topology-elastic surface
+    (see ``make_step``).
     """
     require_axes(mesh, DATA_AXIS)
+    from ..runtime.guardrails import check_guard_args
+    check_guard_args(guard, guard_state, return_guard)
     step = make_step(batch_size, model_size, lr, unroll,
                      optimizer=optimizer, accum=accum, mixed=mixed,
-                     comm=comm)
+                     comm=comm, guard=guard, seed_accum=seed_accum)
 
     # the ring kernel's outputs are typed shard-varying (value-replicated
     # by construction, like zero1's re-assembled params) — vma checking
     # cannot prove the replicated out_specs
     check = comm == "psum"
     check_state_args(optimizer, opt_state, return_state)
+    gkw = {}
+    if guard is not None:
+        gkw = dict(guard=guard, guard_state=guard_state,
+                   guard_scale=guard.scaling)
     if optimizer is None:
-        return launch_strided(step, clone_params(params), seeds, mesh,
-                              DATA_AXIS, P(), check_vma=check)
-    state = optimizer.init(params) if opt_state is None else opt_state
-    return launch_strided(step, clone_params(params), seeds, mesh,
-                          DATA_AXIS, P(), state=state, state_specs=P(),
-                          return_state=return_state, check_vma=check)
+        out = launch_strided(step, clone_params(params), seeds, mesh,
+                             DATA_AXIS, P(), accum=seed_accum,
+                             check_vma=check, **gkw)
+    else:
+        state = optimizer.init(params) if opt_state is None else opt_state
+        out = launch_strided(step, clone_params(params), seeds, mesh,
+                             DATA_AXIS, P(), accum=seed_accum,
+                             state=state, state_specs=P(),
+                             return_state=return_state, check_vma=check,
+                             **gkw)
+    if guard is not None and not return_guard:
+        out = out[0]
+    return out

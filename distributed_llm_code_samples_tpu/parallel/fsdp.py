@@ -87,7 +87,8 @@ def checkpoint_shardings(params: FFNStackParams, optimizer: Optimizer,
 def make_step(batch_size: int, model_size: int, lr: float = LR,
               unroll: bool = True, axis: str = DATA_AXIS,
               optimizer: Optimizer | None = None, mixed: bool = False,
-              comm: str = "psum", ring_interpret: bool | None = None):
+              comm: str = "psum", ring_interpret: bool | None = None,
+              guard=None, seed_accum: int = 1):
     """One FSDP step for one shard (operates on local shard views).
 
     With ``optimizer``, its state is created from — and lives as — the
@@ -107,7 +108,22 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
     RDMA ring kernels (``ops/pallas_ring.py``): the per-layer param
     gathers ride ``ring_all_gather`` and the grad hook rides
     ``ring_reduce_scatter`` — the full FSDP comm pattern under explicit
-    control, pinned == the XLA path."""
+    control, pinned == the XLA path.
+
+    ``seed_accum > 1`` (topology-elastic resume): the step takes a
+    ``[seed_accum]`` seed vector and sums the per-seed SHARD grads —
+    the reduce_scatter runs per seed, and the shard sums equal the
+    shard of the summed global batch (SUM commutes), preserving the
+    save-time update sequence on fewer devices.
+
+    ``guard``: the in-graph hooks living inside the step math — dynamic
+    loss scaling under ``mixed`` (the scaled upstream gradient rides the
+    bf16 gathers/blocks; grads unscale in f32 after the
+    reduce_scatter) and global-norm clipping with the squared norm
+    ``psum``-med over the data axis (the grads the update sees are 1/n
+    shards). Skip-select + counters live in the launcher wrap."""
+    from ..runtime.guardrails import finalize_grads, require_mixed_for_scaling
+    require_mixed_for_scaling(guard, mixed)
     if comm not in ("psum", "pallas_ring"):
         raise ValueError(f"unknown comm {comm!r} "
                          "(expected 'psum' or 'pallas_ring')")
@@ -153,9 +169,11 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
         with jax.named_scope("comm"):
             return _rs(dw1), _rs(dw2)
 
-    def local_grads_of(params, seed):
+    def local_grads_of(params, seed, scale=None):
         x, dloss_dx = batch_from_seed(seed, batch_size, model_size,
                                       params.w1.dtype)
+        if scale is not None:
+            dloss_dx = dloss_dx * scale.astype(dloss_dx.dtype)
         _, acts = stack_fwd(params.w1, params.w2, x, block_fwd=block_fwd,
                             unroll=unroll)
         _, (g1, g2) = stack_bwd(dloss_dx, params.w1, params.w2, acts,
@@ -163,19 +181,33 @@ def make_step(batch_size: int, model_size: int, lr: float = LR,
                                 unroll=unroll)
         return FFNStackParams(g1, g2)
 
-    def step(params: FFNStackParams, seed) -> FFNStackParams:
+    def grads_of(params, seed, scale=None):
+        if seed_accum > 1:
+            # elastic: per-seed shard grads sum to the shard of the
+            # summed global batch (reduce_scatter is linear)
+            grads = local_grads_of(params, seed[0], scale)
+            for j in range(1, seed_accum):
+                grads = jax.tree_util.tree_map(
+                    jnp.add, grads, local_grads_of(params, seed[j], scale))
+        else:
+            grads = local_grads_of(params, seed, scale)
+        # the update sees 1/n grad shards: the true global norm needs
+        # the squared norm psum-med over the shard axis
+        return finalize_grads(grads, scale, guard, axis=axis)
+
+    def step(params: FFNStackParams, seed, scale=None) -> FFNStackParams:
         # named-scope regions (fsdp/fwd, fsdp/bwd, nested comm on every
         # gather/scatter, fsdp/optim) — utils/trace_analysis.SCOPES
         with jax.named_scope("fsdp"):
-            grads = local_grads_of(params, seed)
+            grads = grads_of(params, seed, scale)
             with jax.named_scope("optim"):
                 # Sharded SGD on the local chunk only (train_ffns.py:258-259).
                 return sgd(params, grads, lr)
 
-    def step_opt(carry, seed):
+    def step_opt(carry, seed, scale=None):
         params, state = carry
         with jax.named_scope("fsdp"):
-            grads = local_grads_of(params, seed)
+            grads = grads_of(params, seed, scale)
             with jax.named_scope("optim"):
                 return optimizer.update(grads, state, params, lr)
 
@@ -186,7 +218,8 @@ def train_fsdp(params: FFNStackParams, seeds, batch_size: int,
                model_size: int, mesh, lr: float = LR, unroll: bool = True,
                optimizer: Optimizer | None = None, opt_state=None,
                return_state: bool = False, mixed: bool = False,
-               comm: str = "psum"):
+               comm: str = "psum", guard=None, guard_state=None,
+               return_guard: bool = False, seed_accum: int = 1):
     """Run the full FSDP schedule; returns final params as a global array
     (re-assembly is implicit in the output sharding — no host-side concat
     like ``train_ffns.py:284-287`` is needed). ``optimizer`` runs a
@@ -197,6 +230,8 @@ def train_fsdp(params: FFNStackParams, seeds, batch_size: int,
     take the param sharding) or scalars (replicated) — true of every
     optimizer in ``optim.py``."""
     require_axes(mesh, DATA_AXIS)
+    from ..runtime.guardrails import check_guard_args
+    check_guard_args(guard, guard_state, return_guard)
     n = mesh.shape[DATA_AXIS]
     if params.w1.shape[1] % n or params.w2.shape[1] % n:
         raise ValueError(
@@ -205,19 +240,31 @@ def train_fsdp(params: FFNStackParams, seeds, batch_size: int,
             "implicit requirement)")
     params = shard_params(params, mesh)
     step = make_step(batch_size, model_size, lr, unroll,
-                     optimizer=optimizer, mixed=mixed, comm=comm)
+                     optimizer=optimizer, mixed=mixed, comm=comm,
+                     guard=guard, seed_accum=seed_accum)
 
     # ring-kernel outputs are typed shard-varying (see ddp.train_ddp)
     check = comm == "psum"
     check_state_args(optimizer, opt_state, return_state)
+    gkw = {}
+    if guard is not None:
+        gkw = dict(guard=guard, guard_state=guard_state,
+                   guard_scale=guard.scaling)
     if optimizer is None:
-        return launch_strided(step, params, seeds, mesh, DATA_AXIS,
-                              PARAM_SPECS, check_vma=check)
-    # zeros_like of the sharded params keeps their sharding, so the state
-    # enters shard_map already 1/n per device; scalar leaves replicate
-    state = optimizer.init(params) if opt_state is None else opt_state
-    state_specs = jax.tree_util.tree_map(state_spec, state)
-    return launch_strided(step, params, seeds, mesh, DATA_AXIS,
-                          PARAM_SPECS, state=state,
-                          state_specs=state_specs,
-                          return_state=return_state, check_vma=check)
+        out = launch_strided(step, params, seeds, mesh, DATA_AXIS,
+                             PARAM_SPECS, accum=seed_accum,
+                             check_vma=check, **gkw)
+    else:
+        # zeros_like of the sharded params keeps their sharding, so the
+        # state enters shard_map already 1/n per device; scalar leaves
+        # replicate
+        state = optimizer.init(params) if opt_state is None else opt_state
+        state_specs = jax.tree_util.tree_map(state_spec, state)
+        out = launch_strided(step, params, seeds, mesh, DATA_AXIS,
+                             PARAM_SPECS, accum=seed_accum, state=state,
+                             state_specs=state_specs,
+                             return_state=return_state, check_vma=check,
+                             **gkw)
+    if guard is not None and not return_guard:
+        out = out[0]
+    return out

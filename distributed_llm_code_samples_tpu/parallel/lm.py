@@ -236,17 +236,22 @@ def train_lm_ddp(params: LMParams, seeds, batch_size: int, model_size: int,
                  mesh, lr: float = LR, *, seq_len: int, n_heads: int,
                  attn_impl: str | None = None, optimizer=None,
                  opt_state=None, return_state: bool = False,
-                 head_impl: str | None = None, mixed: bool = False):
+                 head_impl: str | None = None, mixed: bool = False,
+                 guard=None, guard_state=None, return_guard: bool = False):
     """DDP: replicated params, strided seeds, grads summed per step.
     ``optimizer`` threads replicated state (the ``ddp.py`` contract).
     ``head_impl="fused"`` swaps the tied head + xent for the fused
     Pallas kernels (``ops/pallas_xent.py``) per shard. ``mixed`` runs
     each shard's step under the LM bf16 policy (bf16 trunk, f32
     head/grads — grads stay f32, so the psum semantics are unchanged
-    and the DDP==FSDP==single differentials hold in mixed mode)."""
+    and the DDP==FSDP==single differentials hold in mixed mode).
+    ``guard``/``guard_state``/``return_guard``: the launcher-level
+    in-graph skip-step guardrail (``runtime/guardrails.py``)."""
     require_axes(mesh, DATA_AXIS)
     _validate_lm(batch_size, seq_len, model_size, n_heads, params)
     check_state_args(optimizer, opt_state, return_state)
+    from ..runtime.guardrails import check_guard_args
+    check_guard_args(guard, guard_state, return_guard)
     check = _vma_check(attn_impl, head_impl)
     # force_reduce under vma-off: the unconditional-psum reduction
     # contract (see _make_step)
@@ -254,13 +259,20 @@ def train_lm_ddp(params: LMParams, seeds, batch_size: int, model_size: int,
                       resolve_attn(attn_impl), reduce_axes=(DATA_AXIS,),
                       optimizer=optimizer, head=resolve_head(head_impl),
                       force_reduce=not check, mixed=mixed)
+    gkw = ({} if guard is None
+           else dict(guard=guard, guard_state=guard_state))
     if optimizer is None:
-        return launch_strided(step, clone_params(params), seeds, mesh,
-                              DATA_AXIS, P(), check_vma=check)
-    state = optimizer.init(params) if opt_state is None else opt_state
-    return launch_strided(step, clone_params(params), seeds, mesh,
-                          DATA_AXIS, P(), state=state, state_specs=P(),
-                          return_state=return_state, check_vma=check)
+        out = launch_strided(step, clone_params(params), seeds, mesh,
+                             DATA_AXIS, P(), check_vma=check, **gkw)
+    else:
+        state = optimizer.init(params) if opt_state is None else opt_state
+        out = launch_strided(step, clone_params(params), seeds, mesh,
+                             DATA_AXIS, P(), state=state, state_specs=P(),
+                             return_state=return_state, check_vma=check,
+                             **gkw)
+    if guard is not None and not return_guard:
+        out = out[0]
+    return out
 
 
 def train_lm_fsdp(params: LMParams, seeds, batch_size: int, model_size: int,
@@ -574,7 +586,8 @@ def train_lm_tp(params: LMParams, seeds, batch_size: int, model_size: int,
                 mesh, lr: float = LR, *, seq_len: int, n_heads: int,
                 attn_impl: str | None = None, optimizer=None,
                 opt_state=None, return_state: bool = False,
-                head_impl: str | None = None):
+                head_impl: str | None = None, guard=None,
+                guard_state=None, return_guard: bool = False):
     """Megatron-LM TP over the model axis: blocks shard heads/features
     (``tp_block``), ``wte`` shards vocab rows serving both the parallel
     embedding and the tied parallel head, and the loss runs vocab-parallel
@@ -605,19 +618,27 @@ def train_lm_tp(params: LMParams, seeds, batch_size: int, model_size: int,
                          params.vocab, lr, resolve_attn(attn_impl),
                          optimizer=optimizer, head_impl=head_impl,
                          force_reduce=not check, interpret=None)
+    from ..runtime.guardrails import check_guard_args
+    check_guard_args(guard, guard_state, return_guard)
+    gkw = ({} if guard is None
+           else dict(guard=guard, guard_state=guard_state))
     sharded = _shard(params, mesh, _lm_tp_specs())
     if optimizer is None:
-        return launch(step, sharded, jnp.asarray(seeds), mesh,
-                      param_specs=_lm_tp_specs(), seed_spec=P(),
-                      check_vma=check)
-    # zeros_like of sharded params keeps their shardings; scalar
-    # bookkeeping (step counts) replicates
-    state = optimizer.init(sharded) if opt_state is None else opt_state
-    return launch(step, sharded, jnp.asarray(seeds), mesh,
-                  param_specs=_lm_tp_specs(), seed_spec=P(),
-                  state=state,
-                  state_specs=_lm_state_specs(state, _lm_tp_specs()),
-                  return_state=return_state, check_vma=check)
+        out = launch(step, sharded, jnp.asarray(seeds), mesh,
+                     param_specs=_lm_tp_specs(), seed_spec=P(),
+                     check_vma=check, **gkw)
+    else:
+        # zeros_like of sharded params keeps their shardings; scalar
+        # bookkeeping (step counts) replicates
+        state = optimizer.init(sharded) if opt_state is None else opt_state
+        out = launch(step, sharded, jnp.asarray(seeds), mesh,
+                     param_specs=_lm_tp_specs(), seed_spec=P(),
+                     state=state,
+                     state_specs=_lm_state_specs(state, _lm_tp_specs()),
+                     return_state=return_state, check_vma=check, **gkw)
+    if guard is not None and not return_guard:
+        out = out[0]
+    return out
 
 
 def tp_generate(params: LMParams, prompt, n_new: int, mesh, *,

@@ -65,6 +65,35 @@ def require_axes(mesh: Mesh, *axes: str) -> None:
             + "': n, '".join(axes) + "': n})")
 
 
+def elastic_mesh(axes: Mapping[str, int],
+                 devices: Sequence[jax.Device] | None = None) -> Mesh:
+    """Rebuild a mesh over the devices that survived — the degraded-mode
+    path for ``runtime.failure.device_healthcheck(allow_degraded=True)``
+    reporting fewer devices than the mesh was built with.
+
+    The ``"data"`` axis is the elastic one: it shrinks (or grows) to
+    whatever the survivors support, while every other axis (model, pipe,
+    seq, expert — all of which shard *structure*, not batch) keeps its
+    requested size; a survivor count that can't host the rigid axes
+    fails loudly. Resuming a checkpoint on the shrunken mesh is the
+    checkpoint layer's elastic-resume contract
+    (``checkpoint.run_with_checkpointing``): the remaining seed schedule
+    is restrided so the save-time global batch — and hence the loss
+    trajectory — is preserved.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    rigid = math.prod(n for a, n in axes.items() if a != DATA_AXIS)
+    if DATA_AXIS not in axes:
+        return make_mesh(axes, devices)
+    data = len(devices) // rigid
+    if data < 1:
+        raise ValueError(
+            f"{len(devices)} surviving device(s) cannot host the rigid "
+            f"axes {[(a, n) for a, n in axes.items() if a != DATA_AXIS]} "
+            f"(need {rigid} per data shard)")
+    return make_mesh({**axes, DATA_AXIS: data}, devices)
+
+
 def guard_multi_device(min_devices: int = 2) -> None:
     """Startup guard mirroring the reference's 1-GPU refusal
     (``train_ffns.py:25-27``) — but also guarding 0, which it didn't."""

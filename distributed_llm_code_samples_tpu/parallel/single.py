@@ -150,13 +150,52 @@ def _run(params, seeds, batch_size, model_size, lr, unroll, use_pallas,
     return lax.scan(lambda p, s: (step(p, s), None), params, seeds)[0]
 
 
+@partial(jax.jit, static_argnums=tuple(range(3, 14)), donate_argnums=0)
+def _run_guarded(params, gstate, seeds, batch_size, model_size, lr,
+                 unroll, use_pallas, interpret, manual_loop, remat, mixed,
+                 accum, guard):
+    """The guarded scan: every step's candidate params pass the in-graph
+    finite check and a bad step is ``jnp.where``-skipped — params
+    untouched, skip counter advanced (``runtime/guardrails.py``).
+    ``guard`` is a frozen (hashable) config, so it rides the static-args
+    cache like the rest of the step configuration."""
+    from ..runtime.guardrails import guarded_scan_step
+    step = make_step(batch_size, model_size, lr, unroll, use_pallas,
+                     interpret, manual_loop, remat, mixed, accum)
+    gstep = guarded_scan_step(step, guard)
+    return lax.scan(lambda c, s: (gstep(c, s), None), (params, gstate),
+                    seeds)[0]
+
+
 def train_single(params: FFNStackParams, seeds, batch_size: int,
                  model_size: int, mesh=None, lr: float = LR,
                  unroll: bool = True, use_pallas: bool = False,
                  interpret: bool = False, manual_loop: bool = False,
                  remat: bool | None = None, mixed: bool = False,
-                 accum: int = 1) -> FFNStackParams:
-    """Uniform launcher signature (SURVEY.md L4); ``mesh`` ignored."""
-    return _run(clone_params(params), jnp.asarray(seeds), batch_size,
-                model_size, lr, unroll, use_pallas, interpret, manual_loop,
-                remat, mixed, accum)
+                 accum: int = 1, guard=None, guard_state=None,
+                 return_guard: bool = False) -> FFNStackParams:
+    """Uniform launcher signature (SURVEY.md L4); ``mesh`` ignored.
+
+    ``guard`` (a ``runtime.guardrails.GuardrailConfig``) compiles the
+    in-graph skip-step guardrail into the scan; with ``return_guard``
+    the final ``GuardState`` (skip counters) returns alongside the
+    params. The single-device path carries no collectives, so the
+    finite flag needs no reduction; loss scaling is a mixed-strategy
+    (DDP/FSDP) surface."""
+    from ..runtime.guardrails import check_guard_args, host_state
+    check_guard_args(guard, guard_state, return_guard)
+    if guard is not None and guard.scaling:
+        raise ValueError(
+            "guard.loss_scale > 0 but train_single has no loss-scale "
+            "hook: dynamic scaling is a mixed-precision DDP/FSDP "
+            "surface — pass loss_scale=0 here")
+    if guard is None:
+        return _run(clone_params(params), jnp.asarray(seeds), batch_size,
+                    model_size, lr, unroll, use_pallas, interpret,
+                    manual_loop, remat, mixed, accum)
+    out, g = _run_guarded(clone_params(params), host_state(guard_state,
+                                                           guard),
+                          jnp.asarray(seeds), batch_size, model_size, lr,
+                          unroll, use_pallas, interpret, manual_loop,
+                          remat, mixed, accum, guard)
+    return (out, g) if return_guard else out

@@ -93,6 +93,27 @@ def _describe_event(rec: dict) -> str:
         a, b = rec.get("steps", (None, None))
         return f"NON-FINITE params after steps {a}..{b} — segment " \
                "skipped, not checkpointed"
+    if ev == "anomaly" or rec.get("kind") == "anomaly":
+        a, b = rec.get("steps", (None, None))
+        return (f"ANOMALY: {rec.get('skipped')} step(s) skipped "
+                f"in-graph in {a}..{b} (total "
+                f"{rec.get('total_skipped')}, loss scale "
+                f"{rec.get('loss_scale')})")
+    if ev == "loss_spike":
+        a, b = rec.get("steps", (None, None))
+        return (f"LOSS SPIKE: update norm {rec.get('delta')} after "
+                f"steps {a}..{b} vs baseline {rec.get('baseline')} "
+                f"(> {rec.get('factor')}x) — segment not checkpointed")
+    if ev == "rollback" or rec.get("kind") == "rollback":
+        return (f"ROLLBACK #{rec.get('rollback')}: rewound to verified "
+                f"step {rec.get('resume_step')} in-process — "
+                f"{rec.get('error')} ({rec.get('max_rollbacks')} max)")
+    if ev == "elastic_resume":
+        return (f"ELASTIC RESUME @ step {rec.get('step')}: "
+                f"{rec.get('saved_shards')} -> "
+                f"{rec.get('current_shards')} data shard(s), "
+                f"seed_accum {rec.get('seed_accum')} "
+                f"({rec.get('n_devices')} device(s))")
     if ev == "attempt_failed":
         extra = " [watchdog expired]" if rec.get("watchdog_expired") else ""
         return (f"FAULT: attempt {rec.get('attempt')} failed after "
@@ -101,7 +122,9 @@ def _describe_event(rec: dict) -> str:
                 f"backoff {rec.get('backoff_s')}s")
     if ev == "completed":
         return (f"RECOVERED: attempt {rec.get('attempt')} completed "
-                f"after {rec.get('elapsed_s')}s")
+                f"after {rec.get('elapsed_s')}s"
+                + (f" ({rec.get('rollbacks')} rollback(s))"
+                   if rec.get("rollbacks") else ""))
     if ev == "chaos_corrupt_ckpt":
         return (f"CHAOS: checkpoint corruption injected at "
                 f"step {rec.get('step')}")
@@ -147,6 +170,8 @@ def report_main(argv=None) -> int:
     steps = [r for r in records if r["kind"] == "step"]
     events = [r for r in records if r["kind"] == "event"]
     benches = [r for r in records if r["kind"] == "bench"]
+    anomalies = [r for r in records if r["kind"] == "anomaly"]
+    rollbacks = [r for r in records if r["kind"] == "rollback"]
 
     # attempt log: flag wins; else the newest meta that names one
     attempt_path = args.attempt_log
@@ -225,6 +250,12 @@ def report_main(argv=None) -> int:
                                if e.get("event") == "nonfinite_skip"),
         "publishes": sum(1 for e in events
                          if e.get("event") == "published"),
+        # the self-healing ladder's cheap rungs (schema v2 kinds)
+        "in_graph_skips": sum(int(a.get("skipped") or 0)
+                              for a in anomalies),
+        "rollbacks": len(rollbacks),
+        "loss_spikes": sum(1 for e in events
+                           if e.get("event") == "loss_spike"),
     }
 
     # ---- one merged timeline ----------------------------------------
@@ -234,6 +265,12 @@ def report_main(argv=None) -> int:
     seen_events = {(e.get("t"), e.get("event")) for e in events}
     for e in events:
         timeline.append((e["t"], "event", _describe_event(e)))
+    for a in anomalies:
+        timeline.append((a["t"], "anomaly", _describe_event(a)))
+        seen_events.add((a.get("t"), "anomaly"))
+    for r in rollbacks:
+        timeline.append((r["t"], "rollbck", _describe_event(r)))
+        seen_events.add((r.get("t"), "rollback"))
     for a in attempts:
         # supervise forwards checkpoint-layer events to its log too;
         # drop exact duplicates of what the metrics stream already has
@@ -301,9 +338,13 @@ def report_main(argv=None) -> int:
             out.append("  HBM high-water  "
                        + _fmt_bytes(st["hbm_high_water_bytes"]))
     rec = doc["recovery"]
-    if rec["attempts_failed"] or rec["nonfinite_skips"] or attempts:
+    if (rec["attempts_failed"] or rec["nonfinite_skips"] or attempts
+            or rec["in_graph_skips"] or rec["rollbacks"]):
         out.append("")
-        out.append(f"recovery: {rec['attempts_failed']} failed "
+        out.append(f"recovery: {rec['in_graph_skips']} in-graph "
+                   f"skip(s), {rec['rollbacks']} rollback(s), "
+                   f"{rec['loss_spikes']} loss spike(s), "
+                   f"{rec['attempts_failed']} failed "
                    f"attempt(s), {rec['nonfinite_skips']} non-finite "
                    f"skip(s), {rec['publishes']} checkpoint "
                    f"publish(es), run "

@@ -16,12 +16,28 @@ dirs use), parsed from the CLI ``--chaos`` spec grammar::
 
     spec  := fault ("," fault)* ("," "seed=" INT)?
     fault := KIND "@" STEP (":" ARG)?
-    KIND  := nan_grad | inf_grad | hang | kill | corrupt_ckpt
+    KIND  := nan_grad | inf_grad | loss_spike | slow_step | hang
+           | kill | corrupt_ckpt
 
-- ``nan_grad@s`` / ``inf_grad@s`` — the segment that trains step ``s``
-  returns params poisoned with NaN/Inf (a poisoned gradient update);
-  caught by the supervisor's non-finite guard, which refuses to
-  checkpoint it.
+- ``nan_grad@s`` / ``inf_grad@s`` — step ``s`` trains on a poisoned
+  (NaN/Inf) upstream gradient. With in-graph guardrails armed
+  (``begin_segment(in_graph=True)``) the poison rides the STEP'S OWN
+  SEED (``data.POISON_NAN_BIT``) so it fires *inside* the compiled
+  chunk — the skip-step guardrail must neutralize exactly that step.
+  Without guardrails the segment's returned params are poisoned
+  post-hoc, and the supervisor's non-finite guard refuses to
+  checkpoint them (the PR 1 behavior, unchanged).
+- ``loss_spike@s:mult`` — the PaLM-scenario loss spike: the segment
+  that trains step ``s`` returns params whose update is scaled by
+  ``mult`` (default 100) — finite, so no finite-check rung catches it;
+  the checkpoint layer's segment-delta spike guard
+  (``run_with_checkpointing(spike_factor=...)``) must detect it and
+  the supervisor's rollback rung must rewind to the last verified
+  checkpoint.
+- ``slow_step@s[:secs]`` — a straggler, not a hang: the segment sleeps
+  ``secs`` (default 1.0) and then completes normally. Deterministic
+  trigger for step-time anomalies in the telemetry stream (and for the
+  watchdog, when armed with a shorter deadline).
 - ``hang@s[:secs]`` — a hung collective: the segment sleeps ``secs``
   (default 0.25) without returning, long enough to latch a native
   ``Watchdog`` armed by the supervisor.
@@ -53,7 +69,8 @@ import jax
 import jax.numpy as jnp
 
 
-IN_SEGMENT_KINDS = ("nan_grad", "inf_grad", "hang")
+IN_SEGMENT_KINDS = ("nan_grad", "inf_grad", "loss_spike", "slow_step",
+                    "hang")
 PUBLISH_KINDS = ("corrupt_ckpt", "kill")
 KINDS = IN_SEGMENT_KINDS + PUBLISH_KINDS
 
@@ -101,7 +118,13 @@ class FaultPlan:
             if step < 1:
                 raise ValueError(
                     f"bad --chaos step {step} in {entry!r}: must be >= 1")
-            arg = float(arg_s) if arg_s else None
+            try:
+                arg = float(arg_s) if arg_s else None
+            except ValueError:
+                raise ValueError(
+                    f"bad --chaos arg {arg_s!r} in {entry!r}: the "
+                    "optional :ARG is a number (seconds, multiplier, "
+                    "fraction)") from None
             faults.append(Fault(kind, step, arg))
         if not faults:
             raise ValueError(f"empty --chaos spec {spec!r}")
@@ -113,20 +136,53 @@ class FaultPlan:
                             "t": time.time(), **extra})
 
     # ---------------------------------------------- segment integration
-    def begin_segment(self, start: int, n: int) -> None:
+    def begin_segment(self, start: int, n: int,
+                      in_graph: bool = False) -> None:
         """Arm the in-segment faults whose step the upcoming segment
-        ``(start, start+n]`` trains (0-based ``start``, 1-based steps)."""
+        ``(start, start+n]`` trains (0-based ``start``, 1-based steps).
+        ``in_graph=True`` (set when the run compiles guardrails into its
+        steps) routes nan/inf faults through seed poisoning
+        (``poison_segment_seeds``) instead of the post-hoc params
+        poison — the fault then fires at its exact step INSIDE the
+        compiled chunk, which is what the in-graph skip must catch."""
+        self._start = start
+        self._in_graph = in_graph
         self._armed = [f for f in self.faults
                        if f.kind in IN_SEGMENT_KINDS and not f.fired
                        and start < f.step <= start + n]
+
+    def poison_segment_seeds(self, seg_seeds):
+        """Apply armed nan/inf faults to the segment's seed slice (the
+        in-graph injection path; no-op unless ``begin_segment`` armed
+        with ``in_graph=True``). Returns the (possibly modified) seeds;
+        poisoned faults are consumed here so ``wrap`` won't re-fire
+        them."""
+        if not getattr(self, "_in_graph", False):
+            return seg_seeds
+        from ..data import POISON_INF_BIT, POISON_NAN_BIT
+        import numpy as np
+        seeds = None
+        for f in list(self._armed):
+            if f.kind not in ("nan_grad", "inf_grad"):
+                continue
+            if seeds is None:
+                seeds = np.array(seg_seeds)
+            idx = f.step - self._start - 1
+            bit = (POISON_NAN_BIT if f.kind == "nan_grad"
+                   else POISON_INF_BIT)
+            seeds[idx] = int(seeds[idx]) | bit
+            self._note(f, mode="in_graph")
+            self._armed.remove(f)
+        return seg_seeds if seeds is None else jnp.asarray(seeds)
 
     def wrap(self, train_fn):
         """A train_fn that injects this plan's armed in-segment faults
         around the real one. ``begin_segment`` must be called first."""
         def chaotic(params, seeds, *args, **kwargs):
             for f in list(self._armed):
-                if f.kind == "hang":
-                    secs = 0.25 if f.arg is None else f.arg
+                if f.kind in ("hang", "slow_step"):
+                    default = 0.25 if f.kind == "hang" else 1.0
+                    secs = default if f.arg is None else f.arg
                     self._note(f, sleep_s=secs)
                     time.sleep(secs)
             out = train_fn(params, seeds, *args, **kwargs)
@@ -136,6 +192,19 @@ class FaultPlan:
                     self._note(f)
                     leaves, treedef = jax.tree_util.tree_flatten(out)
                     leaves[0] = jnp.full_like(leaves[0], poison)
+                    out = jax.tree_util.tree_unflatten(treedef, leaves)
+                elif f.kind == "loss_spike":
+                    mult = 100.0 if f.arg is None else f.arg
+                    self._note(f, mult=mult)
+                    # scale the PARAMS update: new = old + mult*(new-old).
+                    # With a threaded optimizer `out` is (params, state)
+                    # and `params` is the params alone — the params
+                    # leaves come first in the flatten order, so pair
+                    # the input leaves against the output's prefix.
+                    in_leaves = jax.tree_util.tree_leaves(params)
+                    leaves, treedef = jax.tree_util.tree_flatten(out)
+                    for i, old in enumerate(in_leaves):
+                        leaves[i] = old + mult * (leaves[i] - old)
                     out = jax.tree_util.tree_unflatten(treedef, leaves)
             self._armed = []
             return out

@@ -26,7 +26,15 @@ from typing import Callable
 import jax
 import numpy as np
 
-from ..checkpoint import run_with_checkpointing
+from ..checkpoint import (LossSpikeError, NonFiniteParamsError,
+                          latest_verified_step, run_with_checkpointing)
+
+# The ladder's cheap rung catches exactly the failures whose remedy is
+# "rewind to the last verified checkpoint and retrain": a poisoned
+# segment (nonfinite="raise") and a loss spike (spike_factor). Anything
+# else — real crashes, hung collectives, backend deaths — goes to the
+# restart rung with backoff + healthcheck.
+RECOVERABLE = (NonFiniteParamsError, LossSpikeError)
 
 
 def _head(exc: BaseException) -> str:
@@ -39,33 +47,50 @@ class HealthCheckError(RuntimeError):
     """A device failed the liveness probe."""
 
 
-def device_healthcheck(devices=None, timeout_s: float = 30.0) -> list:
+def device_healthcheck(devices=None, timeout_s: float = 30.0,
+                       allow_degraded: bool = False) -> list:
     """Prove each device still compiles and executes: run ``x + 1`` on a
     tiny buffer per device and check the result. Returns the healthy
     devices; raises ``HealthCheckError`` naming the first failure.
+
+    ``allow_degraded=True`` is the topology-elastic posture: failing
+    devices are *recorded and skipped* instead of fatal, and the
+    surviving list comes back (raising only when NOTHING survives) —
+    feed it to ``parallel.mesh.elastic_mesh`` to rebuild a smaller mesh
+    and resume from the last checkpoint (``checkpoint.py``'s elastic
+    resume restrides the schedule automatically).
 
     (A hung device surfaces as the jit call blocking — pair the probe with
     a ``Watchdog`` when that matters; XLA offers no portable async cancel.)
     """
     devices = list(devices if devices is not None else jax.devices())
-    healthy = []
+    healthy, dead = [], []
     for d in devices:
         t0 = time.monotonic()
+        reason = None
         try:
             y = jax.device_put(np.ones((8,), np.float32), d) + 1.0
-            ok = bool(np.all(np.asarray(y) == 2.0))
+            if not bool(np.all(np.asarray(y) == 2.0)):
+                reason = f"device {d} returned wrong result"
+            elif time.monotonic() - t0 > timeout_s:
+                reason = f"device {d} probe exceeded {timeout_s}s"
         except Exception as e:  # noqa: BLE001 — any backend error is a failure
-            raise HealthCheckError(f"device {d} failed liveness probe: {e}")
-        if not ok:
-            raise HealthCheckError(f"device {d} returned wrong result")
-        if time.monotonic() - t0 > timeout_s:
-            raise HealthCheckError(f"device {d} probe exceeded {timeout_s}s")
-        healthy.append(d)
+            reason = f"device {d} failed liveness probe: {e}"
+        if reason is None:
+            healthy.append(d)
+        elif allow_degraded:
+            dead.append(reason)
+        else:
+            raise HealthCheckError(reason)
+    if not healthy:
+        raise HealthCheckError(
+            "no healthy devices survived the probe: " + "; ".join(dead))
     return healthy
 
 
 def supervise(train_fn: Callable, params, seeds, *args,
               ckpt_dir: str, every: int, max_restarts: int = 3,
+              max_rollbacks: int = 2,
               on_failure: Callable[[int, BaseException], None] | None = None,
               healthcheck: bool = False,
               backoff_base_s: float = 0.5, backoff_max_s: float = 30.0,
@@ -74,6 +99,32 @@ def supervise(train_fn: Callable, params, seeds, *args,
               nonfinite: str | None = "skip", watchdog_ms: int = 0,
               **kwargs):
     """Run a strategy launcher under failure supervision.
+
+    Remedies escalate up the **rollback ladder** (round 8, DESIGN.md
+    section 14) — each rung strictly cheaper than the next:
+
+    1. **in-graph skip** (``guard=GuardrailConfig()`` in ``kwargs``):
+       a non-finite step is ``jnp.where``-skipped inside the compiled
+       chunk — costs one update, the supervisor never sees it (it shows
+       up as an ``anomaly`` event);
+    2. **loss-scale shrink** (``mixed`` runs with dynamic scaling): an
+       overflowed step simultaneously skips and shrinks the scale,
+       still in-graph;
+    3. **in-process rollback** (this function, ``max_rollbacks``): a
+       *recoverable* failure — ``NonFiniteParamsError`` from the
+       segment guard, ``LossSpikeError`` from the spike guard — rewinds
+       to ``latest_verified_step`` and re-enters immediately: same
+       process, no backoff, no restart budget burned, and the jitted
+       step programs are reused from the compile cache (same shapes →
+       no recompile);
+    4. **full restart** (the PR 1 path): everything else — real
+       crashes, hung collectives — costs a restart with jittered
+       backoff, optional device healthcheck, and the attempt log.
+
+    Every rung is logged to the attempt JSONL (``rollback`` /
+    ``attempt_failed`` records carry a ``rung`` field) and forwarded to
+    the caller's ``on_event`` — the telemetry stream renders the whole
+    ladder on one ``report`` timeline.
 
     Each attempt drives ``run_with_checkpointing`` (segment size ``every``);
     a raised exception costs one restart, optionally re-probes the devices,
@@ -136,7 +187,9 @@ def supervise(train_fn: Callable, params, seeds, *args,
         except OSError:
             pass  # logging must never take down the supervised run
 
-    for attempt in range(max_restarts + 1):
+    attempt = 0
+    rollbacks = 0
+    while attempt <= max_restarts:
         t0 = time.monotonic()
         dog = None
         hang_latched = False
@@ -172,14 +225,42 @@ def supervise(train_fn: Callable, params, seeds, *args,
             if dog is not None:
                 expired = bool(dog.expired) or hang_latched
             log({"event": "completed", "attempt": attempt,
+                 "rollbacks": rollbacks,
                  "elapsed_s": round(time.monotonic() - t0, 3),
                  "watchdog_expired": expired})
             return out
         except Exception as e:  # noqa: BLE001 — supervisor catches all
-            history.append(e)
             if dog is not None:
                 expired = bool(dog.expired) or hang_latched
-            record = {"event": "attempt_failed", "attempt": attempt,
+            if isinstance(e, RECOVERABLE) and rollbacks < max_rollbacks:
+                # rung 3: in-process rollback — rewind to the last
+                # verified checkpoint and re-enter NOW. No backoff (the
+                # failure is a math anomaly, not contention), no restart
+                # budget burned, no process death; the next entry's
+                # restore lands on latest_verified_step and the jitted
+                # step programs come straight from the compile cache.
+                rollbacks += 1
+                if isinstance(e, LossSpikeError) and e.baseline:
+                    # the retry must keep the pre-spike reference scale:
+                    # a persistent spike re-fires on the retrained
+                    # segment instead of re-baselining on it
+                    kwargs["spike_baseline"] = e.baseline
+                if getattr(e, "guard_state", None) is not None:
+                    # likewise the in-graph guard state: the dynamic
+                    # loss scale and skip counters survive the rewind
+                    # instead of snapping back to their initial values
+                    kwargs["guard_state"] = e.guard_state
+                emit({"event": "rollback", "rung": "rollback",
+                      "rollback": rollbacks,
+                      "max_rollbacks": max_rollbacks,
+                      "attempt": attempt, "error": _head(e),
+                      "resume_step": latest_verified_step(ckpt_dir),
+                      "elapsed_s": round(time.monotonic() - t0, 3),
+                      "watchdog_expired": expired})
+                continue
+            history.append(e)
+            record = {"event": "attempt_failed", "rung": "restart",
+                      "attempt": attempt,
                       "error": _head(e),
                       "elapsed_s": round(time.monotonic() - t0, 3),
                       "watchdog_expired": expired,
@@ -198,6 +279,7 @@ def supervise(train_fn: Callable, params, seeds, *args,
                 device_healthcheck()
             if backoff > 0:
                 time.sleep(backoff)
+            attempt += 1
         finally:
             if dog is not None:
                 dog.close()

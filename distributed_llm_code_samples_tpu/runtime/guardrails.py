@@ -1,0 +1,285 @@
+"""In-graph anomaly guardrails: skip-step, dynamic loss scaling, clipping.
+
+Rung 1 of the self-healing ladder (DESIGN.md section 14). PR 1's only
+remedy for a poisoned step was segment-granular: the checkpoint layer's
+``tree_finite`` readback either dropped a whole ``every``-step segment
+(``nonfinite="skip"``) or raised for a restart — re-paying restore and
+up to ``every - 1`` good steps for one bad gradient. Production stacks
+(PaLM's spike handling, every serious mixed-precision recipe) treat the
+single bad step inside the compiled program: check the update for
+NaN/Inf *in-graph* and ``jnp.where``-select the previous state, so a
+poisoned step costs exactly one update and zero host round-trips.
+
+The machinery is strategy-agnostic: ``guarded_scan_step`` wraps any
+``(carry, seed) -> carry`` scan step (the shape every trainer in
+``parallel/`` already has). The wrapped step
+
+- computes the candidate carry,
+- derives one scalar *all-finite* flag over its float leaves (reduced
+  with a ``psum`` across the mesh axes so every shard takes the SAME
+  branch — a per-shard decision would silently fork replicated params),
+- ``jnp.where``-selects candidate vs previous carry leaf-by-leaf: a bad
+  step leaves params AND optimizer state untouched,
+- advances a tiny ``GuardState`` (skip/overflow counters, the dynamic
+  loss scale) that rides the scan carry and comes back to the host only
+  at the chunk boundary — steady-state steps stay dispatch-only, per
+  PR 2's ``log_every`` chunking contract.
+
+``mixed=True`` paths additionally get **dynamic loss scaling**
+(``loss_scale > 0``): the upstream gradient is multiplied by the scale
+before the bf16 backward, grads are unscaled in f32 after the
+reduction, and the scale grows ``scale_growth``x after
+``growth_interval`` consecutive finite steps / shrinks ``scale_backoff``x
+on overflow — the standard grow/shrink recipe, expressed in-graph so an
+overflowed step is simultaneously skipped and re-scaled. Optional
+global-norm clipping (``clip_norm``) rides the same hook for trainers
+that run the stateless inline SGD (stateful optimizers already compose
+clipping via ``optim.clipped``).
+
+Counters flow to the telemetry stream as ``anomaly`` records
+(``runtime/telemetry.py`` schema v2) via the chunk drivers
+(``checkpoint.run_with_checkpointing``, ``cli``'s metrics loop).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+@dataclass(frozen=True)
+class GuardrailConfig:
+    """Static guardrail knobs. Frozen/hashable on purpose: trainers pass
+    it as a static jit argument (``parallel/single.py``), so two runs
+    with the same config share one compiled program.
+
+    ``loss_scale`` is the *initial* dynamic loss scale (0 = scaling
+    off); ``clip_norm`` clips gradients to that global L2 norm before
+    the update (0 = off). The remaining fields parameterize the
+    grow/shrink schedule."""
+
+    clip_norm: float = 0.0
+    loss_scale: float = 0.0
+    scale_growth: float = 2.0
+    scale_backoff: float = 0.5
+    growth_interval: int = 200
+    min_scale: float = 1.0
+
+    @property
+    def scaling(self) -> bool:
+        return self.loss_scale > 0
+
+
+class GuardState(NamedTuple):
+    """The in-graph guardrail carry: three counters and the live scale.
+    All scalars — it rides every scan step for the cost of a handful of
+    registers, and is read back on the host only at chunk boundaries."""
+
+    skipped: jax.Array      # i32: updates dropped by the finite check
+    overflows: jax.Array    # i32: skips while loss scaling was active
+    loss_scale: jax.Array   # f32: current dynamic scale (1.0 when off)
+    good_steps: jax.Array   # i32: consecutive finite steps since shrink
+
+
+def init_state(cfg: GuardrailConfig) -> GuardState:
+    return GuardState(
+        skipped=jnp.zeros((), jnp.int32),
+        overflows=jnp.zeros((), jnp.int32),
+        loss_scale=jnp.asarray(cfg.loss_scale if cfg.scaling else 1.0,
+                               jnp.float32),
+        good_steps=jnp.zeros((), jnp.int32))
+
+
+def summarize(state: GuardState) -> dict:
+    """Host-side view of a ``GuardState`` (one readback per field —
+    call at chunk/segment cadence only, never per step)."""
+    return {"skipped": int(state.skipped),
+            "overflows": int(state.overflows),
+            "loss_scale": float(state.loss_scale),
+            "good_steps": int(state.good_steps)}
+
+
+def finite_flag(tree: Any) -> jax.Array:
+    """One scalar bool: every float/complex leaf of ``tree`` is free of
+    NaN/Inf. Integer leaves (Adam counts, seeds) are always finite and
+    skipped — same rule as ``checkpoint._leaf_finite``, but in-graph."""
+    flags = [jnp.all(jnp.isfinite(leaf))
+             for leaf in jax.tree_util.tree_leaves(tree)
+             if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.inexact)]
+    ok = jnp.asarray(True)
+    for f in flags:
+        ok = jnp.logical_and(ok, f)
+    return ok
+
+
+def advance(cfg: GuardrailConfig, state: GuardState,
+            ok: jax.Array) -> GuardState:
+    """Fold one step's finite flag into the guard state: count the skip
+    and (with scaling on) run the grow/shrink schedule."""
+    ok_i = ok.astype(jnp.int32)
+    skipped = state.skipped + (1 - ok_i)
+    if not cfg.scaling:
+        return state._replace(skipped=skipped)
+    overflows = state.overflows + (1 - ok_i)
+    good = jnp.where(ok, state.good_steps + 1, 0)
+    grown = jnp.logical_and(ok, good >= cfg.growth_interval)
+    scale = jnp.where(
+        ok,
+        jnp.where(grown, state.loss_scale * cfg.scale_growth,
+                  state.loss_scale),
+        jnp.maximum(state.loss_scale * cfg.scale_backoff, cfg.min_scale))
+    good = jnp.where(grown, jnp.zeros_like(good), good)
+    return GuardState(skipped=skipped, overflows=overflows,
+                      loss_scale=scale, good_steps=good)
+
+
+def unscale_grads(grads: Any, scale: jax.Array) -> Any:
+    """Divide every grad leaf by the live loss scale — in f32, after the
+    reduction (grads leave the mixed blocks f32 already)."""
+    inv = (1.0 / scale).astype(jnp.float32)
+    return jax.tree_util.tree_map(
+        lambda g: (g * inv.astype(g.dtype)), grads)
+
+
+def clip_by_global_norm(grads: Any, max_norm: float,
+                        axis: str | tuple | None = None) -> Any:
+    """Global-norm clipping for the stateless-SGD paths (the stateful
+    ones compose ``optim.clipped``). ``axis``: pass the mesh axis the
+    grads are *sharded* over (FSDP) so the squared norm is ``psum``-med
+    into the true global norm before the scale is computed."""
+    sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+             for g in jax.tree_util.tree_leaves(grads))
+    if axis is not None:
+        sq = lax.psum(sq, axis)
+    norm = jnp.sqrt(sq)
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-16))
+    return jax.tree_util.tree_map(lambda g: g * scale.astype(g.dtype),
+                                  grads)
+
+
+def anomaly_delta(prev: dict, cur: dict, step: int,
+                  steps: list) -> dict | None:
+    """The one place the ``anomaly`` record shape is built (telemetry
+    ``ANOMALY_REQUIRED`` contract): compare two ``summarize`` snapshots
+    and return the per-chunk record, or None when nothing advanced.
+    ``skipped``/``overflows`` are per-chunk DELTAS; the running totals
+    travel as ``total_*`` — both chunk drivers (cli's metrics loop and
+    ``checkpoint.run_with_checkpointing``) emit through here, so the
+    record shape cannot fork."""
+    if (cur["skipped"] <= prev["skipped"]
+            and cur["overflows"] <= prev["overflows"]):
+        return None
+    return {"step": int(step), "steps": list(steps),
+            "skipped": cur["skipped"] - prev["skipped"],
+            "total_skipped": cur["skipped"],
+            "overflows": cur["overflows"] - prev["overflows"],
+            "total_overflows": cur["overflows"],
+            "loss_scale": cur["loss_scale"]}
+
+
+def finalize_grads(grads: Any, scale, guard: GuardrailConfig | None,
+                   axis: str | tuple | None = None) -> Any:
+    """The shared post-reduction epilogue of a guarded strategy step:
+    unscale by the live loss scale (when scaling ran), then clip to the
+    configured global norm. ``axis`` is the mesh axis the grads are
+    SHARDED over (FSDP), so the clip computes the true global norm —
+    one implementation for every strategy, so the DDP/FSDP
+    differentials can't drift on the scaling recipe."""
+    if scale is not None:
+        grads = unscale_grads(grads, scale)
+    if guard is not None and guard.clip_norm > 0:
+        grads = clip_by_global_norm(grads, guard.clip_norm, axis=axis)
+    return grads
+
+
+def require_mixed_for_scaling(guard, mixed: bool) -> None:
+    """Dynamic loss scaling protects a narrow-precision backward; the
+    f32 paths have none — shared precondition of every strategy that
+    takes the ``(guard, mixed)`` pair."""
+    if guard is not None and guard.scaling and not mixed:
+        raise ValueError("dynamic loss scaling (guard.loss_scale > 0) "
+                         "applies to the mixed=True path: the f32 path "
+                         "has no narrow-precision backward to protect")
+
+
+def guarded_scan_step(step: Callable, cfg: GuardrailConfig,
+                      axis_names: tuple = (), world: int = 1,
+                      takes_scale: bool = False) -> Callable:
+    """Wrap a scan step ``(carry, seed) -> carry`` into
+    ``((carry, GuardState), seed) -> (carry, GuardState)`` implementing
+    the in-graph skip (module docstring).
+
+    ``axis_names``/``world``: the shard_map mesh axes to ``psum`` the
+    finite flag over (every shard must take the same branch; the summed
+    flag equals ``world`` iff every shard saw finite leaves — replicated
+    leaves sum their identical flags, sharded leaves each contribute
+    their own view). ``takes_scale=True`` calls
+    ``step(carry, seed, loss_scale)`` — the hook the mixed-precision
+    strategies use to scale the upstream gradient in-graph."""
+
+    def gstep(carry_g, seed):
+        carry, g = carry_g
+        with jax.named_scope("guardrails"):
+            new = (step(carry, seed, g.loss_scale) if takes_scale
+                   else step(carry, seed))
+            ok = finite_flag(new)
+            if axis_names:
+                ok = lax.psum(ok.astype(jnp.int32), axis_names) == world
+            sel = jax.tree_util.tree_map(
+                lambda n, o: jnp.where(ok, n, o), new, carry)
+            return sel, advance(cfg, g, ok)
+
+    return gstep
+
+
+def mesh_world(mesh) -> tuple[tuple, int]:
+    """``(axis_names, total shards)`` of a mesh — the reduction domain
+    for the finite flag under ``shard_map``."""
+    if mesh is None:
+        return (), 1
+    shape = dict(mesh.shape)
+    return tuple(shape.keys()), int(math.prod(shape.values())) or 1
+
+
+def check_guard_args(guard, guard_state, return_guard) -> None:
+    """The guarded-trainer surface contract (mirrors
+    ``optim.check_state_args``): guard state in/out needs a config."""
+    if guard is None and (return_guard or guard_state is not None):
+        raise ValueError("guard_state/return_guard need a guard config")
+    if guard is not None and not isinstance(guard, GuardrailConfig):
+        raise TypeError(f"guard must be a GuardrailConfig, got "
+                        f"{type(guard).__name__}")
+
+
+def host_state(state_or_none, cfg: GuardrailConfig) -> GuardState:
+    """Resolve the incoming guard state for a trainer call: a fresh
+    ``init_state(cfg)`` when None, else the caller's (threading the
+    scale/counters across chunked calls)."""
+    if state_or_none is None:
+        return init_state(cfg)
+    if isinstance(state_or_none, GuardState):
+        return state_or_none
+    # tolerate a plain tuple (e.g. round-tripped through numpy)
+    return GuardState(*[jnp.asarray(x) for x in state_or_none])
+
+
+def delta_norm(old_params, new_params) -> float:
+    """Host-side global L2 norm of a params update — the segment-level
+    spike signal (``checkpoint.run_with_checkpointing(spike_factor=)``).
+    Runs at segment cadence only; NaN-safe (a non-finite delta returns
+    inf so the caller's nonfinite guard keeps precedence)."""
+    sq = 0.0
+    for o, n in zip(jax.tree_util.tree_leaves(old_params),
+                    jax.tree_util.tree_leaves(new_params)):
+        a = np.asarray(o)
+        if a.dtype.kind in "iub":
+            continue
+        d = np.asarray(n, np.float64) - np.asarray(a, np.float64)
+        sq += float(np.sum(d * d))
+    return math.sqrt(sq) if np.isfinite(sq) else float("inf")

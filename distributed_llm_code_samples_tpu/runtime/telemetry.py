@@ -40,7 +40,10 @@ from typing import Any, Callable
 
 import numpy as np
 
-SCHEMA_VERSION = 1
+# v2 (round 8): adds the self-healing record kinds — "anomaly"
+# (in-graph guardrail counters per compiled chunk) and "rollback"
+# (supervisor ladder rungs) — with their own pinned key contracts.
+SCHEMA_VERSION = 2
 
 METRICS_FILENAME = "metrics.jsonl"
 
@@ -54,10 +57,21 @@ STEP_KEYS = (
     "tokens_per_sec", "step_time_s", "mfu", "hbm_high_water_bytes",
 )
 
+# The anomaly-record contract: keys every "anomaly" record MUST carry
+# (it may carry more — e.g. the [a, b] step window). Same version-bump
+# discipline as STEP_KEYS.
+ANOMALY_REQUIRED = ("step", "skipped", "loss_scale")
+
+# The rollback-record contract: "rung" names the ladder rung taken
+# (rollback / restart), "resume_step" the verified checkpoint it
+# rewound to (null when none existed yet).
+ROLLBACK_REQUIRED = ("rung", "resume_step")
+
 # Non-step record kinds the stream also carries: run headers ("meta"),
-# recovery/chaos/checkpoint events ("event"), and bench measurement rows
-# ("bench" — bench.py's per-measurement plumbing rides the same writer).
-RECORD_KINDS = ("step", "meta", "event", "bench")
+# recovery/chaos/checkpoint events ("event"), bench measurement rows
+# ("bench" — bench.py's per-measurement plumbing rides the same
+# writer), plus the self-healing kinds ("anomaly", "rollback").
+RECORD_KINDS = ("step", "meta", "event", "bench", "anomaly", "rollback")
 
 # bf16 peak matmul FLOP/s by chip generation (public spec sheets; the
 # default f32 jnp matmul on TPU lowers to single-pass bf16 MXU ops, so
@@ -241,6 +255,24 @@ class TelemetryWriter:
         rec["kind"] = "bench"
         self._put(rec)
 
+    def anomaly(self, record: dict) -> None:
+        """Enqueue one in-graph guardrail anomaly record: the per-chunk
+        skip/overflow counters + live loss scale
+        (``runtime/guardrails.py``; ``ANOMALY_REQUIRED`` contract)."""
+        rec = dict(record)
+        rec.setdefault("t", time.time())
+        rec["kind"] = "anomaly"
+        self._put(rec)
+
+    def rollback(self, record: dict) -> None:
+        """Enqueue one supervisor ladder record (a rollback or restart
+        rung, ``runtime/failure.py``; ``ROLLBACK_REQUIRED`` contract)."""
+        rec = dict(record)
+        rec.setdefault("t", time.time())
+        rec.setdefault("resume_step", None)
+        rec["kind"] = "rollback"
+        self._put(rec)
+
     def meta(self, record: dict) -> None:
         """Enqueue a run-header record (shapes, strategy, flags, paths
         to sibling logs — the report tool reads these to fold streams)."""
@@ -342,6 +374,14 @@ def validate_record(rec: Any) -> tuple[bool, str]:
             return False, f"step record missing keys {missing}"
         if not isinstance(rec["step"], int):
             return False, f"step is {type(rec['step']).__name__}, not int"
+    if kind == "anomaly":
+        missing = [k for k in ANOMALY_REQUIRED if k not in rec]
+        if missing:
+            return False, f"anomaly record missing keys {missing}"
+    if kind == "rollback":
+        missing = [k for k in ROLLBACK_REQUIRED if k not in rec]
+        if missing:
+            return False, f"rollback record missing keys {missing}"
     return True, "ok"
 
 

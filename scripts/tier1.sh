@@ -47,5 +47,46 @@ fi
 rm -rf "$SMOKE_DIR"
 echo "TELEMETRY_SMOKE=OK"
 
+echo "=== self-healing smoke ==="
+# A CPU chaos run injecting nan_grad@2 under --guardrails must finish
+# with ZERO process restarts (--max_restarts 0 makes any restart fatal:
+# the in-graph skip is the only acceptable remedy) and leave >= 1
+# schema-valid `anomaly` record in the metrics stream (schema v2,
+# runtime/guardrails.py + runtime/telemetry.py).
+HEAL_DIR=$(mktemp -d /tmp/tier1_selfheal.XXXXXX)
+if ! timeout -k 10 240 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli -m 1 -s 8 -bs 2 -n 8 -d 16 \
+    -l 2 -r 3 --lr 0.1 --checkpoint_dir "$HEAL_DIR/ck" \
+    --checkpoint_every 2 --chaos nan_grad@2 --guardrails \
+    --max_restarts 0 --metrics_dir "$HEAL_DIR/metrics" \
+    > /dev/null; then
+  echo "SELFHEAL_SMOKE=FAIL (run survived zero-restart budget?)"
+  rm -rf "$HEAL_DIR"; exit 1
+fi
+if ! timeout -k 10 60 env JAX_PLATFORMS=cpu python - "$HEAL_DIR" <<'EOF'
+import json, os, sys
+from distributed_llm_code_samples_tpu.runtime.telemetry import (
+    METRICS_FILENAME, read_metrics, validate_record)
+base = sys.argv[1]
+records, problems = read_metrics(
+    os.path.join(base, "metrics", METRICS_FILENAME))
+assert not problems, problems
+anomalies = [r for r in records if r["kind"] == "anomaly"]
+assert anomalies, "no schema-valid anomaly record in the smoke stream"
+assert all(validate_record(a)[0] for a in anomalies)
+with open(os.path.join(base, "ck", "train_single",
+                       "supervise.jsonl")) as f:
+    log = [json.loads(ln) for ln in f if ln.strip()]
+restarts = [r for r in log if r.get("event") == "attempt_failed"]
+assert not restarts, f"self-healing run restarted: {restarts}"
+assert any(r.get("event") == "completed" for r in log)
+EOF
+then
+  echo "SELFHEAL_SMOKE=FAIL (schema/restart check)"
+  rm -rf "$HEAL_DIR"; exit 1
+fi
+rm -rf "$HEAL_DIR"
+echo "SELFHEAL_SMOKE=OK"
+
 echo "=== tier-1 pytest ==="
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -62,12 +62,26 @@ def test_fault_plan_parse_grammar():
     assert plan.seed == 11
 
 
+def test_fault_plan_parse_selfheal_kinds():
+    """The round-8 kinds: loss_spike@STEP:MULT and slow_step@STEP:SECS
+    (deterministic triggers for the rollback rung and for step-time
+    anomalies)."""
+    plan = FaultPlan.parse("loss_spike@5:100,slow_step@3:0.5,slow_step@7")
+    assert [(f.kind, f.step, f.arg) for f in plan.faults] == [
+        ("loss_spike", 5, 100.0), ("slow_step", 3, 0.5),
+        ("slow_step", 7, None)]
+
+
 @pytest.mark.parametrize("spec,msg", [
     ("bogus@1", "known kinds"),
     ("nan_grad@0", ">= 1"),
     ("nan_grad", "KIND@STEP"),
     ("seed=3", "empty"),
     ("nan_grad@x", "1-based"),
+    ("loss_spike@0:100", ">= 1"),
+    ("loss_spike", "KIND@STEP"),
+    ("slow_step@x", "1-based"),
+    ("loss_spike@2:abc", "ARG is a number"),
 ])
 def test_fault_plan_parse_rejects(spec, msg):
     with pytest.raises(ValueError, match=msg):
@@ -77,9 +91,11 @@ def test_fault_plan_parse_rejects(spec, msg):
 # ------------------------------------------------- NaN/Inf gradient faults
 
 def test_nan_grad_recovers_bit_identical(tmp_path, params):
-    """nonfinite="raise": the poisoned segment costs one restart, the
-    retry resumes from the last verified checkpoint, and the final
-    params equal the uninterrupted run EXACTLY."""
+    """nonfinite="raise" under the ladder (round 8): the poisoned
+    segment takes the cheap ROLLBACK rung — an in-process rewind to the
+    last verified checkpoint with NO restart burned (on_failure never
+    fires) — and the final params equal the uninterrupted run
+    EXACTLY."""
     seeds = make_seed_schedule(8, random_seed=3)
     ref = _ref_run(params, seeds, tmp_path)
     plan = FaultPlan.parse("nan_grad@3")
@@ -90,16 +106,42 @@ def test_nan_grad_recovers_bit_identical(tmp_path, params):
                     nonfinite="raise", backoff_base_s=0.0,
                     on_failure=lambda n, e: failures.append(str(e)),
                     lr=0.1)
-    assert len(failures) == 1 and "non-finite" in failures[0]
+    assert failures == []  # a rollback is not a restart
     assert [e["kind"] for e in plan.events] == ["nan_grad"]
     np.testing.assert_array_equal(np.asarray(out.w1), np.asarray(ref.w1))
     np.testing.assert_array_equal(np.asarray(out.w2), np.asarray(ref.w2))
     assert latest_verified_step(ck) == 8
-    # the structured log carries the whole story: one failed attempt
-    # (the poisoned segment), one completed
+    # the structured log carries the whole ladder story: one rollback
+    # rung (naming the resume step), zero restarts, one completion
+    log = _read_log(ck)
+    events = [r["event"] for r in log]
+    assert events.count("rollback") == 1
+    assert events.count("attempt_failed") == 0
+    assert events.count("completed") == 1
+    rb = next(r for r in log if r["event"] == "rollback")
+    assert rb["rung"] == "rollback" and rb["resume_step"] == 2
+    assert "non-finite" in rb["error"]
+
+
+def test_nan_grad_restart_rung_when_rollbacks_exhausted(tmp_path, params):
+    """max_rollbacks=0 collapses the ladder to PR 1's behavior: the
+    poisoned segment escalates straight to the restart rung (backoff,
+    on_failure, restart budget) and still recovers bit-identical."""
+    seeds = make_seed_schedule(8, random_seed=3)
+    ref = _ref_run(params, seeds, tmp_path)
+    plan = FaultPlan.parse("nan_grad@3")
+    failures = []
+    ck = str(tmp_path / "chaos0")
+    out = supervise(train_single, params, seeds, 32, 16, ckpt_dir=ck,
+                    every=2, max_restarts=2, max_rollbacks=0, chaos=plan,
+                    nonfinite="raise", backoff_base_s=0.0,
+                    on_failure=lambda n, e: failures.append(str(e)),
+                    lr=0.1)
+    assert len(failures) == 1 and "non-finite" in failures[0]
+    np.testing.assert_array_equal(np.asarray(out.w1), np.asarray(ref.w1))
     events = [r["event"] for r in _read_log(ck)]
     assert events.count("attempt_failed") == 1
-    assert events.count("completed") == 1
+    assert events.count("rollback") == 0
 
 
 def test_inf_grad_skip_never_persists_poison(tmp_path, params):
@@ -236,7 +278,237 @@ def test_no_hang_leaves_watchdog_clean(tmp_path, params):
     assert completed and completed[0]["watchdog_expired"] is False
 
 
+# ------------------------------------------------- loss spike -> rollback
+
+def test_loss_spike_rolls_back_in_process(tmp_path, params):
+    """loss_spike@5:100 scales the segment's param update 100x — finite,
+    so no finite check fires; the spike guard (spike_factor) refuses to
+    checkpoint it and the supervisor's ROLLBACK rung rewinds to the
+    last verified step in-process: zero restarts, final params equal
+    the uninterrupted run exactly (the spike fires once)."""
+    seeds = make_seed_schedule(8, random_seed=3)
+    ref = _ref_run(params, seeds, tmp_path)
+    plan = FaultPlan.parse("loss_spike@5:100")
+    failures = []
+    ck = str(tmp_path / "spike")
+    out = supervise(train_single, params, seeds, 32, 16, ckpt_dir=ck,
+                    every=2, max_restarts=0, chaos=plan,
+                    spike_factor=4.0, backoff_base_s=0.0,
+                    on_failure=lambda n, e: failures.append(str(e)),
+                    lr=0.1)
+    assert failures == []  # max_restarts=0: any restart would have died
+    assert [e["kind"] for e in plan.events] == ["loss_spike"]
+    np.testing.assert_array_equal(np.asarray(out.w1), np.asarray(ref.w1))
+    np.testing.assert_array_equal(np.asarray(out.w2), np.asarray(ref.w2))
+    log = _read_log(ck)
+    events = [r["event"] for r in log]
+    assert events.count("loss_spike") == 1  # the guard's evidence
+    assert events.count("rollback") == 1
+    assert events.count("attempt_failed") == 0
+    rb = next(r for r in log if r["event"] == "rollback")
+    assert rb["resume_step"] == 4 and "LossSpikeError" in rb["error"]
+
+
+def test_slow_step_records_straggler_evidence(tmp_path, params):
+    """slow_step@3:0.6 stalls one segment ~0.6s but completes: the run
+    finishes with zero failures, the audit trail records the sleep, and
+    a 300ms watchdog latches the straggler as evidence."""
+    seeds = make_seed_schedule(8, random_seed=3)
+    _ref_run(params, seeds, tmp_path)  # pre-compile the segment programs
+    plan = FaultPlan.parse("slow_step@3:0.6")
+    ck = str(tmp_path / "slow")
+    supervise(train_single, params, seeds, 32, 16, ckpt_dir=ck, every=2,
+              chaos=plan, watchdog_ms=300, backoff_base_s=0.0, lr=0.1)
+    assert len(plan.events) == 1
+    assert plan.events[0]["kind"] == "slow_step"
+    assert plan.events[0]["sleep_s"] == 0.6
+    completed = [r for r in _read_log(ck) if r["event"] == "completed"]
+    assert completed and completed[0]["watchdog_expired"] is True
+
+
+def test_rollback_budget_exhaustion_escalates(tmp_path, params):
+    """A PERSISTENT spike (fires again on every retrain via a spiky
+    train_fn, not a one-shot chaos fault) burns the rollback budget,
+    escalates to restarts, and finally exhausts with the full history."""
+    seeds = make_seed_schedule(4, random_seed=3)
+    target = int(np.asarray(seeds)[2])  # segment 2's first seed
+
+    def spikes_on_segment2(p, s, *a, **kw):
+        out = train_single(p, s, *a, **kw)
+        if int(np.asarray(s)[0]) != target:
+            return out
+        import jax.tree_util as jtu
+        leaves, treedef = jtu.tree_flatten(out)
+        in_leaves = jtu.tree_leaves(p)
+        leaves = [o + 1000.0 * (n - o) for o, n in zip(in_leaves, leaves)]
+        return jtu.tree_unflatten(treedef, leaves)
+
+    with pytest.raises(RuntimeError, match="LossSpikeError"):
+        supervise(spikes_on_segment2, params, seeds, 32, 16,
+                  ckpt_dir=str(tmp_path / "persist"), every=2,
+                  max_restarts=1, max_rollbacks=2, spike_factor=4.0,
+                  backoff_base_s=0.0, lr=0.1)
+    log = _read_log(str(tmp_path / "persist"))
+    events = [r["event"] for r in log]
+    assert events.count("rollback") == 2      # the budget
+    assert events.count("attempt_failed") == 2  # then the restart rung
+
+
+# ---------------------------------------- the self-healing acceptance run
+
+def test_selfheal_acceptance_cli_zero_restarts(tmp_path, capsys):
+    """The ISSUE r8 acceptance bar, end to end through the CLI: a CPU
+    chaos run injecting nan_grad@2 AND loss_spike@5:100 under
+    --guardrails completes with ZERO process restarts (max_restarts=0
+    enforces it), its metrics stream carries schema-valid anomaly and
+    rollback records, the `report` timeline shows the in-graph skip and
+    the rollback together, and the final params equal a clean run on
+    the same seeds after skip accounting (the poisoned step's seed
+    removed)."""
+    import distributed_llm_code_samples_tpu.cli as cli
+    from distributed_llm_code_samples_tpu.report import report_main
+    from distributed_llm_code_samples_tpu.runtime.guardrails import (
+        GuardrailConfig)
+    from distributed_llm_code_samples_tpu.runtime.telemetry import (
+        METRICS_FILENAME, read_metrics)
+
+    ck = str(tmp_path / "ck")
+    mdir = str(tmp_path / "metrics")
+    rc = cli.main(["-s", "8", "-bs", "2", "-n", "16", "-l", "2", "-d",
+                   "16", "-m", "1", "-r", "3", "--lr", "0.1",
+                   "--checkpoint_dir", ck, "--checkpoint_every", "2",
+                   "--chaos", "nan_grad@2,loss_spike@5:100",
+                   "--guardrails", "--spike_factor", "4",
+                   "--max_restarts", "0", "--metrics_dir", mdir])
+    assert rc == 0
+    sub = os.path.join(ck, "train_single")
+    log = _read_log(sub)
+    events = [r["event"] for r in log]
+    assert events.count("attempt_failed") == 0  # zero restarts
+    assert events.count("anomaly") == 1
+    assert events.count("rollback") == 1
+    # the metrics stream: schema-valid anomaly + rollback records
+    records, problems = read_metrics(os.path.join(mdir,
+                                                  METRICS_FILENAME))
+    assert problems == [], problems
+    anomalies = [r for r in records if r["kind"] == "anomaly"]
+    rollbacks = [r for r in records if r["kind"] == "rollback"]
+    assert len(anomalies) == 1 and anomalies[0]["skipped"] == 1
+    assert len(rollbacks) == 1 and rollbacks[0]["rung"] == "rollback"
+    # one report timeline shows the skip AND the rollback
+    capsys.readouterr()
+    assert report_main([mdir]) == 0
+    out = capsys.readouterr().out
+    assert "ANOMALY" in out and "ROLLBACK" in out and "LOSS SPIKE" in out
+    assert "0 failed attempt(s)" in out
+    # final params == the skip-accounted clean run (CLI semantics: seeds
+    # from -r 3, params from PRNGKey(3), tokens = bs * seq)
+    oracle_params = init_ffn_stack(jax.random.PRNGKey(3), 16, 2)
+    seeds = np.asarray(make_seed_schedule(8, random_seed=3))
+    ref = run_with_checkpointing(
+        train_single, oracle_params, np.delete(seeds, 1), 2 * 16, 16,
+        ckpt_dir=str(tmp_path / "oracle"), every=2,
+        guard=GuardrailConfig(), lr=0.1)
+    got, step, _ = restore_checkpoint(sub, oracle_params)
+    assert step == 8
+    np.testing.assert_array_equal(np.asarray(got.w1), np.asarray(ref.w1))
+    np.testing.assert_array_equal(np.asarray(got.w2), np.asarray(ref.w2))
+
+
+def test_recoverable_errors_carry_guard_state(tmp_path, params):
+    """The rollback rung must not reset the in-graph guard state (the
+    dynamic loss scale would snap back): the recoverable exceptions
+    carry the live GuardState for the supervisor to thread back in."""
+    from distributed_llm_code_samples_tpu.checkpoint import (
+        NonFiniteParamsError)
+    from distributed_llm_code_samples_tpu.runtime.guardrails import (
+        GuardState, GuardrailConfig)
+    seeds = make_seed_schedule(4, random_seed=3)
+    plan = FaultPlan.parse("nan_grad@3")
+    # guard armed but in_graph_chaos OFF (the library default): the
+    # host-level poison fires and the raise carries the guard state
+    with pytest.raises(NonFiniteParamsError) as exc:
+        run_with_checkpointing(train_single, params, seeds, 32, 16,
+                               ckpt_dir=str(tmp_path / "gs"), every=2,
+                               chaos=plan, nonfinite="raise",
+                               guard=GuardrailConfig(), lr=0.1)
+    assert isinstance(exc.value.guard_state, GuardState)
+
+
+def test_lm_family_chaos_keeps_host_level_injection(tmp_path):
+    """Integer-token families (method 11) strip the seed poison bits, so
+    in-graph injection would be a silent no-op — the CLI must keep the
+    host-level poison there even under --guardrails: the fault FIRES
+    (proven by the rollback rung it triggers), zero restarts."""
+    import distributed_llm_code_samples_tpu.cli as cli
+    ck = str(tmp_path / "ck")
+    rc = cli.main(["-m", "11", "-s", "4", "-bs", "2", "-n", "8", "-d",
+                   "16", "--vocab", "32", "--heads", "4", "-r", "3",
+                   "--checkpoint_dir", ck, "--checkpoint_every", "2",
+                   "--chaos", "nan_grad@2", "--guardrails",
+                   "--max_restarts", "0"])
+    assert rc == 0
+    log = _read_log(os.path.join(ck, "train_lm_tp"))
+    events = [r["event"] for r in log]
+    assert events.count("rollback") == 1  # the fault fired, host-level
+    assert events.count("attempt_failed") == 0
+    assert events.count("completed") == 1
+
+
+def test_cli_spike_factor_without_chaos_uses_supervisor(tmp_path):
+    """--spike_factor alone must still run under the supervisor — a
+    REAL (non-injected) spike needs the rollback rung, not an uncaught
+    LossSpikeError traceback. The supervise attempt log existing proves
+    the wiring."""
+    import distributed_llm_code_samples_tpu.cli as cli
+    ck = str(tmp_path / "ck2")
+    rc = cli.main(["-m", "1", "-s", "4", "-bs", "2", "-n", "8", "-d",
+                   "16", "-r", "3", "--lr", "0.1",
+                   "--checkpoint_dir", ck, "--checkpoint_every", "2",
+                   "--spike_factor", "1000"])
+    assert rc == 0
+    log = _read_log(os.path.join(ck, "train_single"))
+    assert any(r["event"] == "completed" for r in log)
+
+
+def test_cli_sweep_with_guardrails_keeps_differentials(tmp_path):
+    """-m 0 with --guardrails: strategies with the guard surface run
+    guarded, the rest unguarded, and the cross-strategy differential
+    still holds (--strict makes a mismatch exit 1) — the guard is
+    value-transparent on clean runs."""
+    import distributed_llm_code_samples_tpu.cli as cli
+    rc = cli.main(["-m", "0", "-s", "8", "-bs", "2", "-n", "8", "-d",
+                   "16", "-l", "2", "-r", "3", "--guardrails",
+                   "--strict"])
+    assert rc == 0
+
+
 # -------------------------------------------------------- CLI flag guards
+
+def test_cli_selfheal_flag_guards(capsys):
+    from distributed_llm_code_samples_tpu.cli import main
+    # --guardrails needs a strategy with the guard surface
+    assert main(["-s", "2", "-m", "4", "--guardrails"]) == 2
+    assert "--guardrails" in capsys.readouterr().err
+    # --loss_scale needs --guardrails --mixed on methods 2/3
+    assert main(["-s", "2", "-m", "1", "--guardrails",
+                 "--loss_scale", "1024"]) == 2
+    assert "--loss_scale" in capsys.readouterr().err
+    # --spike_factor needs a checkpoint dir to rewind to
+    assert main(["-s", "2", "-m", "1", "--spike_factor", "4"]) == 2
+    assert "--spike_factor" in capsys.readouterr().err
+    # ... and a real segmentation: one whole-run segment never forms a
+    # baseline, so the guard would be silently unarmed
+    assert main(["-s", "2", "-m", "1", "--spike_factor", "4",
+                 "--checkpoint_dir", "/tmp/x"]) == 2
+    assert "--checkpoint_every" in capsys.readouterr().err
+    # negative budgets are nonsense
+    assert main(["-s", "2", "-m", "1", "--max_rollbacks", "-1"]) == 2
+    assert "--max_rollbacks" in capsys.readouterr().err
+    # zero1 has no guard surface — reject instead of a TypeError mid-run
+    assert main(["-s", "2", "-m", "2", "--zero1", "--guardrails"]) == 2
+    assert "--zero1" in capsys.readouterr().err
+
 
 def test_cli_chaos_flag_guards(capsys):
     from distributed_llm_code_samples_tpu.cli import main

@@ -1,0 +1,242 @@
+"""Topology-elastic resume tests (checkpoint.py + parallel/mesh.py +
+data.shard_seeds_elastic).
+
+The bar (ISSUE r8): a checkpoint saved under 8 fake devices resumes
+under 4 and under 2 — for DDP and FSDP — with a loss trajectory that
+matches the uninterrupted 8-device run: the restride preserves the
+save-time global batch (each survivor gradient-accumulates the lost
+ranks' seeds), so every optimizer update sums the SAME seed grads.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_llm_code_samples_tpu.checkpoint import (
+    read_meta, restore_checkpoint, run_with_checkpointing)
+from distributed_llm_code_samples_tpu.data import (
+    make_seed_schedule, shard_seeds_elastic, shard_seeds_strided)
+from distributed_llm_code_samples_tpu.models import init_ffn_stack
+from distributed_llm_code_samples_tpu.parallel import (
+    DATA_AXIS, MODEL_AXIS, elastic_mesh, make_mesh, train_ddp,
+    train_fsdp)
+from distributed_llm_code_samples_tpu.runtime.failure import (
+    HealthCheckError, device_healthcheck)
+
+BS, D, L = 32, 16, 2
+
+
+@pytest.fixture
+def params():
+    return init_ffn_stack(jax.random.PRNGKey(0), D, L)
+
+
+# ------------------------------------------------------------ seed restride
+
+def test_shard_seeds_elastic_mapping():
+    """Slot [t, j, r] = seeds[t*N + j*n_ranks + r]: the union per update
+    t is exactly the N-seed global batch the strided N-device split
+    consumed."""
+    seeds = np.arange(16, dtype=np.int32)
+    out = np.asarray(shard_seeds_elastic(seeds, 4, 2))
+    assert out.shape == (2, 2, 4)
+    np.testing.assert_array_equal(out[0].ravel(), np.arange(8))
+    np.testing.assert_array_equal(out[1].ravel(), np.arange(8, 16))
+    # accum=1 degrades to the strided split
+    one = np.asarray(shard_seeds_elastic(seeds, 8, 1))
+    np.testing.assert_array_equal(one[:, 0, :],
+                                  np.asarray(shard_seeds_strided(seeds, 8)))
+
+
+def test_shard_seeds_elastic_rejects():
+    with pytest.raises(ValueError, match="global batch"):
+        shard_seeds_elastic(np.arange(12), 4, 2)  # 12 % 8 != 0
+    with pytest.raises(ValueError, match=">= 1"):
+        shard_seeds_elastic(np.arange(8), 4, 0)
+
+
+# ------------------------------------------------------------- elastic mesh
+
+def test_elastic_mesh_shrinks_data_axis_only():
+    m = elastic_mesh({DATA_AXIS: 8}, jax.devices()[:4])
+    assert dict(m.shape) == {DATA_AXIS: 4}
+    hy = elastic_mesh({DATA_AXIS: 4, MODEL_AXIS: 2}, jax.devices()[:4])
+    assert dict(hy.shape) == {DATA_AXIS: 2, MODEL_AXIS: 2}
+
+
+def test_elastic_mesh_rejects_unhostable_rigid_axes():
+    with pytest.raises(ValueError, match="rigid"):
+        elastic_mesh({DATA_AXIS: 4, MODEL_AXIS: 8}, jax.devices()[:4])
+
+
+def test_device_healthcheck_degraded_mode():
+    """allow_degraded records a dead device and returns the survivors
+    (the input to elastic_mesh); the strict mode stays fatal."""
+    devices = list(jax.devices()[:3]) + ["not-a-device"]
+    healthy = device_healthcheck(devices=devices, allow_degraded=True)
+    assert healthy == list(jax.devices()[:3])
+    with pytest.raises(HealthCheckError, match="liveness"):
+        device_healthcheck(devices=devices)
+    with pytest.raises(HealthCheckError, match="no healthy"):
+        device_healthcheck(devices=["dead1", "dead2"],
+                           allow_degraded=True)
+
+
+# -------------------------------------------------- the resume trajectory pin
+
+def _interrupt_then_resume(trainer, params, seeds, ckpt, n_before,
+                           n_after, events=None):
+    """Save under 8 devices for the first segment(s), then resume the
+    FULL schedule under n_after devices from the same directory."""
+    mesh_n = make_mesh({DATA_AXIS: n_before})
+    run_with_checkpointing(trainer, params, seeds[:8], BS, D,
+                           ckpt_dir=ckpt, every=8,
+                           seeds_divisor=n_before, mesh=mesh_n, lr=0.1)
+    assert read_meta(ckpt, 8)["data_shards"] == n_before
+    mesh_m = make_mesh({DATA_AXIS: n_after},
+                       devices=jax.devices()[:n_after])
+    return run_with_checkpointing(
+        trainer, params, seeds, BS, D, ckpt_dir=ckpt, every=8,
+        seeds_divisor=n_after, mesh=mesh_m, lr=0.1,
+        on_event=events.append if events is not None else None)
+
+
+@pytest.mark.parametrize("trainer", [train_ddp, train_fsdp],
+                         ids=["ddp", "fsdp"])
+@pytest.mark.parametrize("survivors", [4, 2])
+def test_elastic_resume_matches_uninterrupted_run(tmp_path, params,
+                                                  trainer, survivors):
+    """The acceptance pin: save at step 8 under 8 devices, resume the
+    24-step schedule under `survivors` devices. Every post-resume
+    checkpoint (step 16, step 24) must match the uninterrupted 8-device
+    run — the restride preserved the update sequence."""
+    seeds = np.asarray(make_seed_schedule(24, 3))
+    ref_ck = str(tmp_path / "ref")
+    ref = run_with_checkpointing(
+        trainer, params, seeds, BS, D, ckpt_dir=ref_ck, every=8,
+        seeds_divisor=8, mesh=make_mesh({DATA_AXIS: 8}), lr=0.1)
+    events = []
+    ck = str(tmp_path / "elastic")
+    out = _interrupt_then_resume(trainer, params, seeds, ck, 8,
+                                 survivors, events)
+    kinds = [e.get("event") for e in events]
+    assert "elastic_resume" in kinds
+    ev = next(e for e in events if e["event"] == "elastic_resume")
+    assert ev["saved_shards"] == 8 and ev["current_shards"] == survivors
+    assert ev["seed_accum"] == 8 // survivors
+    np.testing.assert_allclose(np.asarray(out.w1), np.asarray(ref.w1),
+                               rtol=2e-5, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(out.w2), np.asarray(ref.w2),
+                               rtol=2e-5, atol=1e-7)
+    # the whole post-resume TRAJECTORY matches, not just the endpoint
+    for step in (16, 24):
+        got, _, _ = restore_checkpoint(ck, params, step=step)
+        want, _, _ = restore_checkpoint(ref_ck, params, step=step)
+        np.testing.assert_allclose(np.asarray(got.w1),
+                                   np.asarray(want.w1),
+                                   rtol=2e-5, atol=1e-7)
+    # post-resume checkpoints record the PRESERVED global batch, so a
+    # second shrink keeps compounding from the original 8
+    assert read_meta(ck, 24)["data_shards"] == 8
+
+
+def test_elastic_rescue_chain_8_to_4_to_2(tmp_path, params):
+    """Two successive degradations: 8 -> 4 -> 2. The preserved
+    data_shards meta keeps every resume anchored on the ORIGINAL global
+    batch (accum 2 then 4), so the final params still match the
+    8-device run."""
+    seeds = np.asarray(make_seed_schedule(24, 3))
+    ref = run_with_checkpointing(
+        train_ddp, params, seeds, BS, D,
+        ckpt_dir=str(tmp_path / "ref"), every=8, seeds_divisor=8,
+        mesh=make_mesh({DATA_AXIS: 8}), lr=0.1)
+    ck = str(tmp_path / "chain")
+    run_with_checkpointing(train_ddp, params, seeds[:8], BS, D,
+                           ckpt_dir=ck, every=8, seeds_divisor=8,
+                           mesh=make_mesh({DATA_AXIS: 8}), lr=0.1)
+    run_with_checkpointing(train_ddp, params, seeds[:16], BS, D,
+                           ckpt_dir=ck, every=8, seeds_divisor=4,
+                           mesh=make_mesh({DATA_AXIS: 4},
+                                          devices=jax.devices()[:4]),
+                           lr=0.1)
+    out = run_with_checkpointing(
+        train_ddp, params, seeds, BS, D, ckpt_dir=ck, every=8,
+        seeds_divisor=2,
+        mesh=make_mesh({DATA_AXIS: 2}, devices=jax.devices()[:2]),
+        lr=0.1)
+    np.testing.assert_allclose(np.asarray(out.w1), np.asarray(ref.w1),
+                               rtol=2e-5, atol=1e-7)
+
+
+def test_elastic_scale_up_resumes_with_new_batch(tmp_path, params):
+    """N | M (more devices on resume): the run continues on the NEW
+    global batch — deterministic, logged, but a different update
+    sequence (no fractional accumulation exists). The event says so."""
+    seeds = np.asarray(make_seed_schedule(24, 3))
+    ck = str(tmp_path / "up")
+    run_with_checkpointing(train_ddp, params, seeds[:8], BS, D,
+                           ckpt_dir=ck, every=8, seeds_divisor=4,
+                           mesh=make_mesh({DATA_AXIS: 4},
+                                          devices=jax.devices()[:4]),
+                           lr=0.1)
+    events = []
+    out = run_with_checkpointing(
+        train_ddp, params, seeds, BS, D, ckpt_dir=ck, every=8,
+        seeds_divisor=8, mesh=make_mesh({DATA_AXIS: 8}), lr=0.1,
+        on_event=events.append)
+    ev = next(e for e in events if e.get("event") == "elastic_resume")
+    assert ev["seed_accum"] == 1 and ev["current_shards"] == 8
+    assert np.all(np.isfinite(np.asarray(out.w1)))
+    assert read_meta(ck, 24)["data_shards"] == 8
+
+
+def test_elastic_rejects_incompatible_shard_counts(tmp_path, params):
+    seeds = np.asarray(make_seed_schedule(24, 3))
+    ck = str(tmp_path / "bad")
+    run_with_checkpointing(train_ddp, params, seeds[:8], BS, D,
+                           ckpt_dir=ck, every=8, seeds_divisor=8,
+                           mesh=make_mesh({DATA_AXIS: 8}), lr=0.1)
+    with pytest.raises(ValueError, match="divide one another"):
+        run_with_checkpointing(
+            train_ddp, params, seeds, BS, D, ckpt_dir=ck, every=0,
+            seeds_divisor=6,
+            mesh=make_mesh({DATA_AXIS: 6}, devices=jax.devices()[:6]),
+            lr=0.1)
+
+
+def test_elastic_off_fails_loudly(tmp_path, params):
+    seeds = np.asarray(make_seed_schedule(16, 3))
+    ck = str(tmp_path / "off")
+    run_with_checkpointing(train_ddp, params, seeds[:8], BS, D,
+                           ckpt_dir=ck, every=8, seeds_divisor=8,
+                           mesh=make_mesh({DATA_AXIS: 8}), lr=0.1)
+    with pytest.raises(ValueError, match="elastic=False"):
+        run_with_checkpointing(
+            train_ddp, params, seeds, BS, D, ckpt_dir=ck, every=8,
+            seeds_divisor=4, elastic=False,
+            mesh=make_mesh({DATA_AXIS: 4}, devices=jax.devices()[:4]),
+            lr=0.1)
+
+
+def test_elastic_requires_seed_accum_surface(tmp_path, params):
+    """A trainer without the seed_accum surface cannot honor a
+    scale-down resume — the error names the missing surface instead of
+    silently changing the math."""
+    def no_surface(params, seeds, batch_size, model_size, mesh=None,
+                   lr=0.1):
+        from distributed_llm_code_samples_tpu.parallel import train_ddp
+        return train_ddp(params, seeds, batch_size, model_size, mesh,
+                         lr=lr)
+
+    seeds = np.asarray(make_seed_schedule(16, 3))
+    ck = str(tmp_path / "nosurf")
+    mesh8 = make_mesh({DATA_AXIS: 8})
+    run_with_checkpointing(no_surface, params, seeds[:8], BS, D,
+                           ckpt_dir=ck, every=8, seeds_divisor=8,
+                           mesh=mesh8, lr=0.1)
+    with pytest.raises(ValueError, match="seed_accum"):
+        run_with_checkpointing(
+            no_surface, params, seeds, BS, D, ckpt_dir=ck, every=8,
+            seeds_divisor=4,
+            mesh=make_mesh({DATA_AXIS: 4}, devices=jax.devices()[:4]),
+            lr=0.1)

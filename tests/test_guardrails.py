@@ -1,0 +1,312 @@
+"""In-graph guardrail tests (runtime/guardrails.py + the launcher wrap).
+
+The bar (ISSUE r8): a poisoned step inside a compiled multi-step chunk
+is skipped IN-GRAPH — params and optimizer state untouched, zero host
+round-trips, zero restarts — on every strategy with the guard surface
+(single, DDP, FSDP, LM TP), with per-chunk counters that flow to the
+telemetry stream. Skip accounting is exact: the guarded run equals the
+same guarded trainer over the schedule with the poisoned step removed,
+bit for bit.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llm_code_samples_tpu.data import (
+    POISON_INF_BIT, POISON_NAN_BIT, batch_from_seed, make_seed_schedule)
+from distributed_llm_code_samples_tpu.models import init_ffn_stack
+from distributed_llm_code_samples_tpu.parallel import (
+    DATA_AXIS, make_mesh, train_ddp, train_fsdp, train_single)
+from distributed_llm_code_samples_tpu.runtime.guardrails import (
+    GuardState, GuardrailConfig, advance, check_guard_args,
+    clip_by_global_norm, finite_flag, init_state, summarize)
+
+BS, D, L = 32, 16, 2
+
+
+@pytest.fixture
+def params():
+    return init_ffn_stack(jax.random.PRNGKey(0), D, L)
+
+
+def _poison(seeds, idx, bit=POISON_NAN_BIT):
+    s = np.array(seeds)
+    s[idx] |= bit
+    return s
+
+
+# ------------------------------------------------------------------ units
+
+def test_finite_flag_over_mixed_trees():
+    ok = finite_flag({"a": jnp.ones(3), "n": jnp.arange(3)})
+    assert bool(ok)
+    bad = finite_flag((jnp.ones(3), jnp.asarray([1.0, jnp.nan])))
+    assert not bool(bad)
+    # integer leaves never poison the flag (Adam counts, seeds)
+    assert bool(finite_flag({"count": jnp.asarray(7, jnp.int32)}))
+
+
+def test_poison_bits_produce_poisoned_dy_same_x():
+    x0, dy0 = batch_from_seed(jnp.int32(123), 8, D)
+    x1, dy1 = batch_from_seed(jnp.int32(123 | POISON_NAN_BIT), 8, D)
+    np.testing.assert_array_equal(np.asarray(x0), np.asarray(x1))
+    assert np.all(np.isnan(np.asarray(dy1)))
+    _, dy2 = batch_from_seed(jnp.int32(123 | POISON_INF_BIT), 8, D)
+    assert np.all(np.isinf(np.asarray(dy2)))
+
+
+def test_advance_scale_schedule():
+    cfg = GuardrailConfig(loss_scale=1024.0, growth_interval=2,
+                          scale_backoff=0.5, min_scale=4.0)
+    g = init_state(cfg)
+    ok = jnp.asarray(True)
+    bad = jnp.asarray(False)
+    g = advance(cfg, g, ok)          # good step 1
+    assert summarize(g) == {"skipped": 0, "overflows": 0,
+                            "loss_scale": 1024.0, "good_steps": 1}
+    g = advance(cfg, g, ok)          # good step 2 -> grow, counter resets
+    assert summarize(g)["loss_scale"] == 2048.0
+    assert summarize(g)["good_steps"] == 0
+    g = advance(cfg, g, bad)         # overflow -> halve, count both ways
+    s = summarize(g)
+    assert s == {"skipped": 1, "overflows": 1, "loss_scale": 1024.0,
+                 "good_steps": 0}
+    for _ in range(12):              # shrink floor: min_scale holds
+        g = advance(cfg, g, bad)
+    assert summarize(g)["loss_scale"] == 4.0
+
+
+def test_check_guard_args_contract():
+    with pytest.raises(ValueError, match="guard config"):
+        check_guard_args(None, None, True)
+    with pytest.raises(TypeError, match="GuardrailConfig"):
+        check_guard_args({"clip_norm": 1.0}, None, False)
+    check_guard_args(GuardrailConfig(), None, True)  # fine
+
+
+# -------------------------------------------------- in-graph skip per strategy
+
+def test_single_skip_is_exact_and_counted(params):
+    """The headline contract: a NaN step inside one compiled scan is
+    where-skipped — the final params are BIT-IDENTICAL to the same
+    guarded program run without that step's seed."""
+    cfg = GuardrailConfig()
+    seeds = np.asarray(make_seed_schedule(8, 3))
+    out, g = train_single(params, _poison(seeds, 2), BS, D, lr=0.1,
+                          guard=cfg, return_guard=True)
+    assert summarize(g)["skipped"] == 1
+    oracle = train_single(params, np.delete(seeds, 2), BS, D, lr=0.1,
+                          guard=cfg)
+    np.testing.assert_array_equal(np.asarray(out.w1), np.asarray(oracle.w1))
+    np.testing.assert_array_equal(np.asarray(out.w2), np.asarray(oracle.w2))
+
+
+def test_single_clean_run_unaffected(params):
+    """guard on + no fault == guard off, bit for bit (the where-select
+    is value-transparent on finite steps)."""
+    seeds = make_seed_schedule(6, 3)
+    ref = train_single(params, seeds, BS, D, lr=0.1)
+    out, g = train_single(params, seeds, BS, D, lr=0.1,
+                          guard=GuardrailConfig(), return_guard=True)
+    assert summarize(g)["skipped"] == 0
+    np.testing.assert_array_equal(np.asarray(out.w1), np.asarray(ref.w1))
+
+
+def test_ddp_skip_drops_whole_update(params):
+    """One poisoned rank poisons the psum — the guarded DDP step skips
+    the WHOLE update on every shard (the psum'd finite flag keeps the
+    replicated params consistent), exactly equal to the run without
+    that update's 8-seed group."""
+    cfg = GuardrailConfig()
+    mesh = make_mesh({DATA_AXIS: 8})
+    seeds = np.asarray(make_seed_schedule(24, 3))
+    out, g = train_ddp(params, _poison(seeds, 9), BS, D, mesh, lr=0.1,
+                       guard=cfg, return_guard=True)
+    assert summarize(g)["skipped"] == 1
+    oracle = train_ddp(params, np.delete(seeds, slice(8, 16)), BS, D,
+                       mesh, lr=0.1, guard=cfg)
+    np.testing.assert_array_equal(np.asarray(out.w1), np.asarray(oracle.w1))
+    np.testing.assert_array_equal(np.asarray(out.w2), np.asarray(oracle.w2))
+
+
+def test_fsdp_skip_keeps_shards_consistent_with_optimizer(params):
+    """FSDP's finite flag is psum-reduced from per-shard views; a skip
+    must leave sharded params AND sharded Adam state untouched — the
+    poisoned update never perturbs the moments."""
+    from distributed_llm_code_samples_tpu.optim import adam
+    cfg = GuardrailConfig()
+    mesh = make_mesh({DATA_AXIS: 8})
+    seeds = np.asarray(make_seed_schedule(16, 3))
+    opt = adam()
+    (out, state), g = train_fsdp(params, _poison(seeds, 3), BS, D, mesh,
+                                 lr=0.1, optimizer=opt, return_state=True,
+                                 guard=cfg, guard_state=None,
+                                 return_guard=True)
+    assert summarize(g)["skipped"] == 1
+    (ref, ref_state) = train_fsdp(params, np.delete(seeds, slice(0, 8)),
+                                  BS, D, mesh, lr=0.1, optimizer=opt,
+                                  return_state=True, guard=cfg)
+    np.testing.assert_array_equal(np.asarray(out.w1), np.asarray(ref.w1))
+    np.testing.assert_array_equal(np.asarray(state.mu.w1),
+                                  np.asarray(ref_state.mu.w1))
+    # Adam's count must NOT have advanced on the skipped step
+    assert int(state.count) == int(ref_state.count) == 1
+
+
+def test_lm_tp_guard_surface():
+    """The launcher-level wrap reaches the LM family too: train_lm_tp
+    runs guarded (replicated data, model-axis mesh) and reports clean
+    counters on a clean run, same params as unguarded."""
+    from distributed_llm_code_samples_tpu.models import init_lm
+    from distributed_llm_code_samples_tpu.parallel import (MODEL_AXIS,
+                                                           train_lm_tp)
+    lm = init_lm(jax.random.PRNGKey(1), 32, D, 1, max_seq_len=8,
+                 n_heads=4)
+    mesh = make_mesh({MODEL_AXIS: 2})
+    seeds = make_seed_schedule(4, 3)
+    ref = train_lm_tp(lm, seeds, 2 * 8, D, mesh, lr=0.01, seq_len=8,
+                      n_heads=4)
+    out, g = train_lm_tp(lm, seeds, 2 * 8, D, mesh, lr=0.01, seq_len=8,
+                         n_heads=4, guard=GuardrailConfig(),
+                         return_guard=True)
+    assert summarize(g)["skipped"] == 0
+    np.testing.assert_array_equal(np.asarray(out.wte), np.asarray(ref.wte))
+
+
+# ------------------------------------------------- dynamic loss scaling
+
+def test_ddp_mixed_dynamic_scale_grows(params):
+    """Clean mixed run with growth_interval=1: every finite update
+    doubles the scale (2 updates on the 8-way mesh from 16 seeds)."""
+    mesh = make_mesh({DATA_AXIS: 8})
+    seeds = make_seed_schedule(16, 3)
+    cfg = GuardrailConfig(loss_scale=1024.0, growth_interval=1)
+    out, g = train_ddp(params, seeds, BS, D, mesh, lr=0.1, mixed=True,
+                       guard=cfg, return_guard=True)
+    s = summarize(g)
+    assert s["skipped"] == 0 and s["loss_scale"] == 4096.0
+    # scaling is exact in value: scale * dy backward / scale == dy backward
+    ref = train_ddp(params, seeds, BS, D, mesh, lr=0.1, mixed=True)
+    np.testing.assert_allclose(np.asarray(out.w1), np.asarray(ref.w1),
+                               rtol=2e-2, atol=1e-4)
+
+
+def test_ddp_mixed_overflow_shrinks_and_skips(params):
+    """The shrink half of the grow/shrink loop: two non-finite updates
+    (deterministic inf injections in distinct scan steps) each skip the
+    update AND halve the scale, and the surviving update sequence
+    equals the clean run without those two 8-seed groups."""
+    mesh = make_mesh({DATA_AXIS: 8})
+    seeds = np.asarray(make_seed_schedule(24, 3))
+    bad = _poison(_poison(seeds, 1, POISON_INF_BIT), 17, POISON_INF_BIT)
+    cfg = GuardrailConfig(loss_scale=1024.0, scale_backoff=0.5)
+    out, g = train_ddp(params, bad, BS, D, mesh, lr=0.1, mixed=True,
+                       guard=cfg, return_guard=True)
+    s = summarize(g)
+    assert s["skipped"] == 2 and s["overflows"] == 2
+    assert s["loss_scale"] == pytest.approx(256.0)
+    oracle = train_ddp(params, seeds[8:16], BS, D, mesh, lr=0.1,
+                       mixed=True, guard=cfg)
+    np.testing.assert_allclose(np.asarray(out.w1), np.asarray(oracle.w1),
+                               rtol=1e-6, atol=1e-8)
+
+
+def test_scaling_requires_mixed(params):
+    mesh = make_mesh({DATA_AXIS: 8})
+    with pytest.raises(ValueError, match="mixed"):
+        train_ddp(params, make_seed_schedule(8, 3), BS, D, mesh,
+                  guard=GuardrailConfig(loss_scale=128.0))
+    with pytest.raises(ValueError, match="mixed"):
+        train_fsdp(params, make_seed_schedule(8, 3), BS, D, mesh,
+                   guard=GuardrailConfig(loss_scale=128.0))
+
+
+def test_scaling_rejected_without_a_scale_hook(params):
+    """A scaling config on a strategy with no loss-scale hook would
+    never scale anything while the GuardState schedule still moved —
+    refuse it loudly everywhere the hook is missing."""
+    from distributed_llm_code_samples_tpu.models import init_lm
+    from distributed_llm_code_samples_tpu.parallel import (MODEL_AXIS,
+                                                           train_lm_tp)
+    cfg = GuardrailConfig(loss_scale=128.0)
+    with pytest.raises(ValueError, match="loss-scale hook"):
+        train_single(params, make_seed_schedule(4, 3), BS, D, lr=0.1,
+                     guard=cfg)
+    lm = init_lm(jax.random.PRNGKey(1), 32, D, 1, max_seq_len=8,
+                 n_heads=4)
+    with pytest.raises(ValueError, match="loss-scale hook"):
+        train_lm_tp(lm, make_seed_schedule(4, 3), 2 * 8, D,
+                    make_mesh({MODEL_AXIS: 2}), lr=0.01, seq_len=8,
+                    n_heads=4, guard=cfg)
+
+
+# ----------------------------------------------------------- clipping
+
+def test_guard_clip_matches_optimizer_clip(params):
+    """guardrails.clip_by_global_norm == optim.clipped on the same run:
+    the stateless-SGD guard clip and the optimizer-wrap clip implement
+    one formula."""
+    from distributed_llm_code_samples_tpu.optim import clipped, sgd_optimizer
+    mesh = make_mesh({DATA_AXIS: 8})
+    seeds = make_seed_schedule(8, 3)
+    via_opt = train_ddp(params, seeds, BS, D, mesh, lr=0.1,
+                        optimizer=clipped(sgd_optimizer(), 0.05))
+    via_guard = train_ddp(params, seeds, BS, D, mesh, lr=0.1,
+                          guard=GuardrailConfig(clip_norm=0.05))
+    np.testing.assert_allclose(np.asarray(via_opt.w1),
+                               np.asarray(via_guard.w1),
+                               rtol=1e-6, atol=1e-8)
+
+
+def test_clip_by_global_norm_scales_to_bound():
+    g = {"a": jnp.full((4,), 3.0), "b": jnp.full((4,), 4.0)}
+    clipped_tree = clip_by_global_norm(g, 1.0)
+    total = np.sqrt(sum(float(jnp.sum(jnp.square(v)))
+                        for v in clipped_tree.values()))
+    assert total == pytest.approx(1.0, rel=1e-5)
+
+
+# -------------------------------------------- state threading across chunks
+
+def test_guard_state_threads_across_chunked_calls(params):
+    """The log_every contract: chunked trainer calls thread the guard
+    state, so counters are cumulative and one poisoned chunk doesn't
+    reset another's scale."""
+    cfg = GuardrailConfig()
+    seeds = np.asarray(make_seed_schedule(8, 3))
+    bad = _poison(_poison(seeds, 1), 6)
+    out1, g1 = train_single(params, bad[:4], BS, D, lr=0.1, guard=cfg,
+                            return_guard=True)
+    out2, g2 = train_single(out1, bad[4:], BS, D, lr=0.1, guard=cfg,
+                            guard_state=g1, return_guard=True)
+    assert summarize(g1)["skipped"] == 1
+    assert summarize(g2)["skipped"] == 2
+    whole, gw = train_single(params, bad, BS, D, lr=0.1, guard=cfg,
+                             return_guard=True)
+    assert summarize(gw)["skipped"] == 2
+    np.testing.assert_array_equal(np.asarray(out2.w1), np.asarray(whole.w1))
+
+
+def test_anomaly_delta_builds_per_chunk_records():
+    """Both chunk drivers emit through anomaly_delta: deltas per chunk,
+    totals alongside, None (no record) when nothing advanced."""
+    from distributed_llm_code_samples_tpu.runtime.guardrails import (
+        anomaly_delta)
+    prev = {"skipped": 1, "overflows": 1, "loss_scale": 512.0,
+            "good_steps": 0}
+    cur = {"skipped": 3, "overflows": 1, "loss_scale": 512.0,
+           "good_steps": 4}
+    rec = anomaly_delta(prev, cur, 8, [5, 8])
+    assert rec == {"step": 8, "steps": [5, 8], "skipped": 2,
+                   "total_skipped": 3, "overflows": 0,
+                   "total_overflows": 1, "loss_scale": 512.0}
+    assert anomaly_delta(cur, cur, 12, [9, 12]) is None
+
+
+def test_guard_state_is_a_small_scalar_tree():
+    g = init_state(GuardrailConfig(loss_scale=2.0))
+    assert isinstance(g, GuardState)
+    assert all(np.asarray(leaf).ndim == 0
+               for leaf in jax.tree_util.tree_leaves(g))

@@ -27,21 +27,30 @@ from distributed_llm_code_samples_tpu.runtime.telemetry import (
 # schema contract
 
 
-# The pinned (version, step-key-set) pair. If you change STEP_KEYS you
-# MUST bump SCHEMA_VERSION and update this pin in the same commit —
-# that is the version-bump discipline this test enforces.
-_PINNED_VERSION = 1
+# The pinned (version, key-set) tuples. If you change STEP_KEYS or the
+# anomaly/rollback required sets you MUST bump SCHEMA_VERSION and update
+# these pins in the same commit — that is the version-bump discipline
+# this test enforces. v2 (round 8): the self-healing kinds landed —
+# "anomaly" (in-graph guardrail counters) and "rollback" (ladder rungs).
+_PINNED_VERSION = 2
 _PINNED_STEP_KEYS = frozenset({
     "schema", "kind", "t", "step", "strategy", "loss", "grad_norm",
     "tokens_per_sec", "step_time_s", "mfu", "hbm_high_water_bytes",
 })
+_PINNED_ANOMALY_REQUIRED = frozenset({"step", "skipped", "loss_scale"})
+_PINNED_ROLLBACK_REQUIRED = frozenset({"rung", "resume_step"})
 
 
 def test_schema_version_bump_discipline():
+    from distributed_llm_code_samples_tpu.runtime.telemetry import (
+        ANOMALY_REQUIRED, RECORD_KINDS, ROLLBACK_REQUIRED)
     assert SCHEMA_VERSION == _PINNED_VERSION and \
-        frozenset(STEP_KEYS) == _PINNED_STEP_KEYS, (
-            "telemetry step-record schema changed: bump SCHEMA_VERSION "
-            "and update the pinned pair here in the same commit")
+        frozenset(STEP_KEYS) == _PINNED_STEP_KEYS and \
+        frozenset(ANOMALY_REQUIRED) == _PINNED_ANOMALY_REQUIRED and \
+        frozenset(ROLLBACK_REQUIRED) == _PINNED_ROLLBACK_REQUIRED, (
+            "telemetry record schema changed: bump SCHEMA_VERSION "
+            "and update the pinned sets here in the same commit")
+    assert "anomaly" in RECORD_KINDS and "rollback" in RECORD_KINDS
 
 
 def test_step_record_round_trip(tmp_path):
@@ -78,6 +87,35 @@ def test_validate_record_rejects_drift():
     ok, _ = validate_record({"schema": SCHEMA_VERSION, "kind": "event",
                              "t": 0.0, "event": "published"})
     assert ok
+
+
+def test_anomaly_and_rollback_records_round_trip(tmp_path):
+    """The schema-v2 self-healing kinds: writer methods stamp the kind
+    + envelope, records validate, and missing contract keys reject."""
+    from distributed_llm_code_samples_tpu.runtime.telemetry import (
+        TelemetryWriter)
+    w = TelemetryWriter(str(tmp_path))
+    w.anomaly({"step": 4, "strategy": "train_ddp", "steps": [1, 4],
+               "skipped": 1, "total_skipped": 1, "overflows": 0,
+               "loss_scale": 32768.0})
+    w.rollback({"rung": "rollback", "rollback": 1, "resume_step": 2,
+                "error": "LossSpikeError: ..."})
+    w.close()
+    records, problems = read_metrics(os.path.join(str(tmp_path),
+                                                  METRICS_FILENAME))
+    assert problems == []
+    anom, roll = records
+    assert anom["kind"] == "anomaly" and anom["schema"] == SCHEMA_VERSION
+    assert anom["skipped"] == 1 and anom["loss_scale"] == 32768.0
+    assert roll["kind"] == "rollback" and roll["resume_step"] == 2
+    # contract: required keys reject when missing
+    ok, reason = validate_record({"schema": SCHEMA_VERSION,
+                                  "kind": "anomaly", "t": 0.0,
+                                  "step": 4})
+    assert not ok and "skipped" in reason
+    ok, reason = validate_record({"schema": SCHEMA_VERSION,
+                                  "kind": "rollback", "t": 0.0})
+    assert not ok and "rung" in reason
 
 
 def test_read_metrics_survives_torn_tail(tmp_path):
@@ -333,11 +371,13 @@ def test_chaos_run_report_timeline(tmp_path, capsys):
     rc = report_main([mdir])
     out = capsys.readouterr().out
     assert rc == 0
-    assert "FAULT" in out and "NonFiniteParamsError" in out
+    # the ladder (round 8): a poisoned segment takes the cheap rollback
+    # rung — the timeline shows the rewind, not a process restart
+    assert "ROLLBACK" in out and "NonFiniteParamsError" in out
     assert "RECOVERED" in out
     # ordering on the one timeline: fault -> recovery completion, with
     # the post-recovery step record present
-    assert out.index("FAULT") < out.index("RECOVERED")
+    assert out.index("ROLLBACK") < out.index("RECOVERED")
     assert "step 8" in out
 
 
