@@ -191,6 +191,10 @@ def main() -> int:
             k = jax.random.normal(kk, (H, t, DH), jnp.float32)
             v = jax.random.normal(kv, (H, t, DH), jnp.float32)
             grid = {}
+            # restore the caller's pre-sweep FLASH_BLOCK_* pins after
+            # the grid (the bench.py sweep discipline): popping them
+            # unconditionally would strip an operator's run-wide pin
+            saved_envs = {name: os.environ.get(name) for name in envs}
             for combo in combos:
                 if combo[0] > t:
                     continue  # _pick_block would clamp to the default
@@ -206,8 +210,11 @@ def main() -> int:
                 except Exception as exc:  # noqa: BLE001
                     grid["x".join(map(str, combo))] = (
                         f"error: {type(exc).__name__}: {str(exc)[:80]}")
-            for name in envs:
-                os.environ.pop(name, None)
+            for name, old in saved_envs.items():
+                if old is None:
+                    os.environ.pop(name, None)
+                else:
+                    os.environ[name] = old
             jax.clear_caches()
             nums = {k2: v for k2, v in grid.items()
                     if isinstance(v, float)}

@@ -166,6 +166,60 @@ def main() -> int:
     if not tp_only:
         guarded("moe_tokens_per_sec", moe_path)
 
+    # Decode-engine rows (decode/engine.py): the paged-KV continuous-
+    # batching serving loop across the KV dtype x batching-mode grid.
+    # "fixed" submits exactly B prompts into B slots (the lockstep
+    # workload on the engine's machinery); "continuous" oversubscribes
+    # the queue 2x so admission between steps — the occupancy lever —
+    # is actually exercised, and reports the measured mean occupancy.
+    def engine_rows():
+        import numpy as np
+
+        from distributed_llm_code_samples_tpu.decode import (
+            DecodeEngine, EngineConfig, kv_bytes_per_token)
+
+        dh = D // H
+        block = int(os.environ.get("BENCH_ENGINE_BLOCK", 16))
+        mbps = -(-(T0 + NEW) // block)
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(0, V, size=T0).tolist()
+                   for _ in range(2 * B)]
+
+        def run_engine(kv_dtype, n_prompts):
+            cfg = EngineConfig(
+                block_size=block, n_blocks=1 + B * mbps, max_slots=B,
+                max_blocks_per_seq=mbps, prefill_chunk=min(
+                    block, 1 << (T0.bit_length() - 1)),
+                kv_dtype=kv_dtype)
+            eng = DecodeEngine(params, H, cfg)
+            t0 = time.perf_counter()
+            eng.generate(prompts[:n_prompts], NEW)
+            dt = time.perf_counter() - t0
+            return eng.tokens_generated / dt, eng
+
+        # fixed batch, f32: the apples-to-apples row vs the lockstep
+        # lm_tokens_per_sec (same B sequences, same lengths)
+        tps, eng = run_engine("f32", B)
+        paths["engine_fixed_tokens_per_sec"] = round(tps, 1)
+        paths["engine_compiled_programs"] = eng.compile_count
+        for dt_name in ("f32", "bf16", "int8"):
+            tps, eng = run_engine(dt_name, 2 * B)
+            paths[f"engine_{dt_name}_tokens_per_sec"] = round(tps, 1)
+            if dt_name == "f32":
+                paths["engine_occupancy"] = round(eng.mean_occupancy(), 4)
+            paths[f"kv_bytes_per_token_{dt_name}"] = int(
+                kv_bytes_per_token(dt_name, L, params.blocks.wk.shape[1]
+                                   // dh, dh))
+        paths["engine_note"] = (
+            "engine rows decode 2*B queued prompts through B slots "
+            "(continuous batching; fixed = exactly B); per-step host "
+            "scheduling + per-slot block gathers trade peak lockstep "
+            "throughput for admission-between-steps and 1-4x smaller "
+            "KV traffic (kv_bytes_per_token_*)")
+
+    if not tp_only and os.environ.get("DECODE_ENGINE", "1") != "0":
+        guarded("engine_f32_tokens_per_sec", engine_rows)
+
     # TP decode scaling on the fake-8-device CPU mesh: subprocesses
     # (fresh backend each — the current process is pinned to its
     # platform) run ONLY the tp path at tiny shape over mesh 1/2/4/8.
@@ -216,6 +270,15 @@ def main() -> int:
     bw, bw_assumed = _hbm_bw(jax.devices()[0].device_kind)
     step_s_min = (param_bytes + B * kv_bytes_avg) / bw
     roofline = B / step_s_min
+    # the engine's KV-dtype lever against the same roofline: shrinking
+    # kv_bytes moves the B*kv term, params re-read unchanged — the
+    # ceiling the engine_{dtype} rows chase (int8 ignores the per-block
+    # scale bytes: 2 floats per block_size*dh*2 stored bytes)
+    roofline_by_kv = {}
+    for name, per_elt in (("f32", 4), ("bf16", 2), ("int8", 1)):
+        kvb = 2 * L * t_avg * D * per_elt
+        roofline_by_kv[name] = round(
+            B / ((param_bytes + B * kvb) / bw), 1)
 
     payload = {
         "metric": "lm_decode_tokens_per_sec",
@@ -230,6 +293,7 @@ def main() -> int:
         "roofline_note": ("HBM-bandwidth bound: B / ((param_bytes + "
                           "B * kv_bytes_avg) / hbm_bw); params re-read "
                           "every step, KV at its average length"),
+        "roofline_by_kv_dtype": roofline_by_kv,
         "param_bytes": param_bytes,
         "kv_bytes_avg_per_seq": int(kv_bytes_avg),
         "hbm_bw_gbps": round(bw / 1e9, 1),

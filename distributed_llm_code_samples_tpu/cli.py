@@ -253,6 +253,11 @@ def main(argv=None) -> int:
         # dir) into one human-readable run report
         from .report import report_main
         return report_main(argv[1:])
+    if argv and argv[0] == "generate":
+        # serving entrypoint: continuous-batching decode over the paged
+        # KV engine (decode/engine.py), same dispatch pattern as report
+        from .decode.generate_cli import generate_main
+        return generate_main(argv[1:])
     p = build_parser()
     args = p.parse_args(argv)
     if args.mixed and args.pallas:
@@ -361,8 +366,8 @@ def main(argv=None) -> int:
         # same pattern as the --comm guard: inapplicable flags exit 2
         # instead of silently running the oracle head (ADVICE r4)
         print("error: --head fused applies to --method 11 (LM TP), "
-              "12 (MoE LM EP), or 13 (sequence-parallel LM)",
-              file=sys.stderr)
+              "12 (MoE LM EP), 13 (sequence-parallel LM), or the "
+              "--method 9 sweep (which verifies them)", file=sys.stderr)
         return 2
     if args.method == 13 and args.kv_heads:
         print("error: --method 13 (sequence-parallel LM) supports full "

@@ -1,0 +1,16 @@
+"""High-throughput decode engine: paged KV cache, continuous batching,
+quantized KV, fused sampling (see ``decode/engine.py`` and DESIGN.md
+section 15)."""
+
+from .engine import DecodeEngine, EngineConfig
+from .paged import (KV_DTYPES, PagedKV, SCRATCH_BLOCK, gather_layer,
+                    init_pool, kv_bytes_per_token, write_chunk,
+                    write_rows)
+from .sampling import check_sampling, make_pick
+
+__all__ = [
+    "DecodeEngine", "EngineConfig",
+    "KV_DTYPES", "PagedKV", "SCRATCH_BLOCK", "gather_layer", "init_pool",
+    "kv_bytes_per_token", "write_chunk", "write_rows",
+    "check_sampling", "make_pick",
+]

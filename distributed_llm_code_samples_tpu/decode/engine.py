@@ -1,0 +1,581 @@
+"""High-throughput decode engine: paged KV + continuous batching.
+
+The lockstep decoder (``models.lm.generate``) is a fixed-batch program:
+every sequence enters together, decodes in step, and the batch ends
+when the longest member does — between requests the chip idles and
+short sequences pad out long ones. This engine is the Orca-style
+answer, hand-rolled in the repo's idiom (explicit state, no framework
+wrappers):
+
+- **Paged KV** (``decode/paged.py``): one static-shape block pool for
+  every sequence; a finished sequence frees its blocks with a host-side
+  table edit — no recompile, no pool reshape.
+- **Continuous batching**: a host scheduler admits queued prompts into
+  freed slots *between* compiled steps. The compiled surface is a small
+  static set — one decode program per power-of-two slot bucket, one
+  prefill program per power-of-two chunk bucket — so steady-state steps
+  are dispatch-only and the compile count is bounded by the bucket
+  count (the ``--log_every`` chunk discipline, recompile-guard-tested).
+- **Chunked prefill**: long prompts enter in bounded chunks
+  (``models.attention.chunk_attn`` over the gathered cache), so a new
+  long prompt costs one chunk per engine step instead of stalling every
+  running decode behind a full-prompt pass.
+- **Fused sampling** (``decode/sampling.py``): temperature / top-k /
+  top-p picked inside the compiled step, keyed on
+  ``(engine seed, sequence uid, position)`` — continuous-batching
+  output is token-identical to decoding each sequence alone.
+
+Strategies: ``mesh=None`` runs single-device (the ``lm`` family);
+passing a model-axis mesh runs the Megatron decode layout
+(``parallel.lm``): head-sharded KV pool (each shard caches its own
+``H/n`` heads), vocab-parallel tied head, and an in-graph logits
+gather feeding the same fused pick on every shard.
+
+Determinism contract: a sequence's output depends only on
+``(params, engine seed, uid, prompt, sampling config)`` — never on slot
+assignment, admission order, chunk interleaving, or pool layout
+(tests/test_decode_engine.py pins paged==contiguous bit-for-bit at f32
+and continuous==sequential token-for-token).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..models.attention import chunk_attn, rope
+from ..models.lm import LMParams, decode_attn
+from ..ops.norm import layernorm
+from .paged import (PagedKV, SCRATCH_BLOCK, gather_layer, init_pool,
+                    write_chunk, write_rows)
+from .sampling import check_sampling, make_pick
+
+
+def _buckets(limit: int) -> tuple[int, ...]:
+    """Power-of-two sizes up to ``limit`` (``limit`` itself appended
+    when it isn't one) — the static shape set for slots and chunks."""
+    out = []
+    b = 1
+    while b < limit:
+        out.append(b)
+        b *= 2
+    out.append(limit)
+    return tuple(out)
+
+
+def _bucket_for(n: int, buckets: tuple[int, ...]) -> int:
+    for b in buckets:
+        if b >= n:
+            return b
+    return buckets[-1]
+
+
+@dataclass(frozen=True)
+class EngineConfig:
+    """Static decode-engine configuration (one compiled program set per
+    config). ``block_size`` must be a power of two so power-of-two
+    prefill chunks never straddle a block boundary (``paged.write_chunk``).
+    ``n_blocks`` includes the reserved scratch block. ``temperature=0``
+    is greedy; ``top_k=0`` / ``top_p=0`` disable those truncations."""
+    block_size: int = 16
+    n_blocks: int = 65
+    max_slots: int = 4
+    max_blocks_per_seq: int = 8
+    prefill_chunk: int = 16
+    kv_dtype: str = "f32"
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 0.0
+    seed: int = 0
+    use_rope: bool = False
+
+    @property
+    def capacity(self) -> int:
+        """Max cached positions per sequence."""
+        return self.max_blocks_per_seq * self.block_size
+
+
+@dataclasses.dataclass
+class _Seq:
+    """Host-side per-sequence record (the scheduler's unit of state)."""
+    uid: int
+    prompt: list[int]
+    max_new: int
+    out: list[int] = field(default_factory=list)
+    prefilled: int = 0
+    blocks: list[int] = field(default_factory=list)
+
+    @property
+    def prompt_done(self) -> bool:
+        return self.prefilled >= len(self.prompt)
+
+    @property
+    def finished(self) -> bool:
+        return len(self.out) >= self.max_new
+
+
+class DecodeEngine:
+    """The serving loop. ``submit()`` queues prompts; ``step()`` runs one
+    scheduler iteration (admit -> at most one prefill chunk -> one decode
+    dispatch over every ready slot); ``run()`` drains everything and
+    returns ``{uid: full token list}``. See the module docstring for the
+    design; DESIGN.md section 15 for the state machine."""
+
+    def __init__(self, params: LMParams, n_heads: int,
+                 config: EngineConfig | None = None, mesh=None):
+        cfg = config or EngineConfig()
+        if cfg.block_size & (cfg.block_size - 1):
+            raise ValueError(f"block_size must be a power of two, got "
+                             f"{cfg.block_size}")
+        if cfg.max_slots < 1 or cfg.max_blocks_per_seq < 1:
+            raise ValueError("max_slots and max_blocks_per_seq must be "
+                             ">= 1")
+        if cfg.prefill_chunk < 1 or (cfg.prefill_chunk
+                                     & (cfg.prefill_chunk - 1)):
+            raise ValueError(
+                f"prefill_chunk must be a power of two >= 1, got "
+                f"{cfg.prefill_chunk} (power-of-two chunks are what "
+                "keeps a chunk inside one block — paged.write_chunk)")
+        check_sampling(cfg.temperature, cfg.top_k, cfg.top_p, params.vocab)
+        self.params = params
+        self.n_heads = n_heads
+        self.cfg = cfg
+        self.mesh = mesh
+        self.dh = params.d_model // n_heads
+        self.kv_heads = params.blocks.wk.shape[1] // self.dh
+        if mesh is not None:
+            from ..parallel.lm import tp_shard_params
+            from ..parallel.mesh import MODEL_AXIS, require_axes
+            from ..parallel.transformer import _validate_tp
+            require_axes(mesh, MODEL_AXIS)
+            n = mesh.shape[MODEL_AXIS]
+            _validate_tp(params.blocks, n_heads, n)
+            if params.vocab % n:
+                raise ValueError(f"vocab={params.vocab} not divisible by "
+                                 f"model-axis size {n}")
+            self.params = tp_shard_params(params, mesh)
+        self.pool = self._init_pool()
+        s, mb = cfg.max_slots, cfg.max_blocks_per_seq
+        self.tables = np.full((s, mb), SCRATCH_BLOCK, np.int32)
+        self.lengths = np.zeros((s,), np.int32)
+        self.next_token = np.zeros((s,), np.int32)
+        self.uids = np.zeros((s,), np.int32)
+        self.slots: list[_Seq | None] = [None] * s
+        self.waiting: collections.deque[_Seq] = collections.deque()
+        self.finished: dict[int, list[int]] = {}
+        self.free_blocks = list(range(1, cfg.n_blocks))
+        self.slot_buckets = _buckets(cfg.max_slots)
+        self.chunk_buckets = _buckets(cfg.prefill_chunk)
+        self._programs: dict = {}
+        self.compile_count = 0       # program builds (recompile guard)
+        self.dispatch_count = 0
+        self.steps = 0
+        self.tokens_generated = 0
+        self._occ_sum = 0.0
+        self._next_uid = 0
+
+    # -- pool ----------------------------------------------------------
+
+    def _init_pool(self) -> PagedKV:
+        cfg = self.cfg
+        pool = init_pool(self.params.n_layers, cfg.n_blocks,
+                         self.kv_heads, cfg.block_size, self.dh,
+                         cfg.kv_dtype)
+        if self.mesh is None:
+            return pool
+        from ..parallel.mesh import MODEL_AXIS
+        # head-sharded pool: each model shard caches its own KV heads
+        arr = P(None, None, MODEL_AXIS, None, None)
+        sc = None if pool.k_scale is None else P(None, None, MODEL_AXIS)
+        return PagedKV(*(None if x is None
+                         else jax.device_put(x, NamedSharding(self.mesh,
+                                                              spec))
+                         for x, spec in zip(pool, (arr, arr, sc, sc))))
+
+    def _pool_specs(self) -> PagedKV:
+        from ..parallel.mesh import MODEL_AXIS
+        arr = P(None, None, MODEL_AXIS, None, None)
+        sc = None if self.pool.k_scale is None else P(None, None,
+                                                      MODEL_AXIS)
+        return PagedKV(arr, arr, sc, sc)
+
+    # -- compiled programs (one per (kind, bucket); bounded) -----------
+
+    def _program(self, kind: str, bucket: int):
+        key = (kind, bucket)
+        fn = self._programs.get(key)
+        if fn is None:
+            self.compile_count += 1
+            fn = (self._build_decode(bucket) if kind == "decode"
+                  else self._build_prefill(bucket))
+            self._programs[key] = fn
+        self.dispatch_count += 1
+        return fn
+
+    def _attn_qkv(self, p: LMParams, l: int, a, positions):
+        """Shared q/k/v projection + rotary for one layer: ``a [N, d]``
+        -> ``q [N, h_loc, dh], k/v [N, kv_loc, dh]`` (local head counts
+        read off the — possibly sharded — weight shapes, the
+        ``cached_attn_step`` convention)."""
+        blk = p.blocks
+        dh = self.dh
+        h_loc = blk.wq.shape[1] // dh
+        kv_loc = blk.wk.shape[1] // dh
+        q = (a @ blk.wq[l].T).reshape(-1, h_loc, dh)
+        k = (a @ blk.wk[l].T).reshape(-1, kv_loc, dh)
+        v = (a @ blk.wv[l].T).reshape(-1, kv_loc, dh)
+        if self.cfg.use_rope:
+            rot = jax.vmap(lambda x, pos: rope(x[:, None, :],
+                                               pos[None])[:, 0, :])
+            q = rot(q, positions)
+            k = rot(k, positions)
+        return q, k, v
+
+    def _embed(self, p: LMParams, tokens, positions):
+        if self.mesh is not None:
+            from ..parallel.lm import vp_embed
+            return vp_embed(p.wte, tokens) + p.wpe[positions]
+        return p.wte[tokens] + p.wpe[positions]
+
+    def _trunk(self, p: LMParams, pool: PagedKV, x, positions,
+               write_attn):
+        """The shared per-layer forward both compiled programs run —
+        ONE definition, so prefill and decode numerics can never drift:
+        LN, q/k/v, then the caller's ``write_attn(l, pool, q, k, v) ->
+        (pool, y [N, h_loc, dh])`` (the only step where the two programs
+        differ: batched single-token writes + per-slot gathers vs one
+        slot's chunk write + chunk attention), output projection, FFN
+        — with the Megatron psums when a mesh is set."""
+        tp = self.mesh is not None
+        if tp:
+            from ..parallel.collectives import all_reduce
+            from ..parallel.mesh import MODEL_AXIS
+        blk = p.blocks
+        n = x.shape[0]
+        for l in range(p.n_layers):
+            a = layernorm(blk.ln1[l], x)
+            q, k, v = self._attn_qkv(p, l, a, positions)
+            pool, y = write_attn(l, pool, q, k, v)
+            y = y.reshape(n, -1) @ blk.wo[l].T
+            x = x + (all_reduce(y, MODEL_AXIS) if tp else y)
+            h = layernorm(blk.ln2[l], x)
+            f = jnp.maximum(h @ blk.w1[l].T, 0.0) @ blk.w2[l].T
+            x = x + (all_reduce(f, MODEL_AXIS) if tp else f)
+        return pool, x
+
+    def _logits(self, p: LMParams, h):
+        """Tied head; under TP each shard scores its V/n vocab rows and
+        the in-graph gather re-assembles the full row so the fused pick
+        (keys fold uid/position, never the shard) draws identically
+        everywhere — the output is replicated."""
+        logits = h @ p.wte.T
+        if self.mesh is not None:
+            from ..parallel.collectives import all_gather
+            from ..parallel.mesh import MODEL_AXIS
+            logits = all_gather(logits, MODEL_AXIS, dim=1)
+        return logits
+
+    def _jit(self, run):
+        """jit (or shard_map+jit under TP) with the pool donated: the
+        engine replaces ``self.pool`` with the returned pool after every
+        dispatch, so XLA may update the blocks in place instead of
+        copying the whole pool per step — without donation each decode
+        step would pay a full-pool allocate+copy, swamping the
+        kv_bytes roofline term this engine exists to shrink."""
+        if self.mesh is None:
+            return jax.jit(run, donate_argnums=(1,))
+        from ..parallel.lm import tp_decode_specs
+        return jax.jit(jax.shard_map(
+            run, mesh=self.mesh,
+            in_specs=(tp_decode_specs(), self._pool_specs(), P(), P(),
+                      P(), P()),
+            out_specs=(self._pool_specs(), P()), check_vma=False),
+            donate_argnums=(1,))
+
+    def _build_decode(self, b: int):
+        """One decode step for a ``b``-slot bucket: write each slot's
+        input token at its own position, attend over its gathered
+        blocks, pick the next token in-graph."""
+        cfg = self.cfg
+        pick = make_pick(cfg.temperature, cfg.top_k, cfg.top_p,
+                         self.params.vocab, cfg.seed)
+
+        def run(p: LMParams, pool: PagedKV, tables, lengths, tokens,
+                uids):
+            x = self._embed(p, tokens, lengths)             # [b, d]
+            slot_phys = lengths // cfg.block_size
+            off = lengths % cfg.block_size
+
+            def write_attn(l, pool, q, k, v):
+                phys = tables[jnp.arange(b), slot_phys]
+                pool = write_rows(pool, l, phys, off, k, v, cfg.kv_dtype)
+                ck, cv = jax.vmap(
+                    lambda t, _l=l, _pool=pool: gather_layer(_pool, _l, t)
+                )(tables)                       # [b, Hkv_loc, T_cap, dh]
+                return pool, decode_attn(q, ck, cv, lengths + 1)
+
+            pool, x = self._trunk(p, pool, x, lengths, write_attn)
+            logits = self._logits(p, layernorm(p.ln_f, x))
+            return pool, pick(logits, uids, lengths + 1)
+
+        return self._jit(run)
+
+    def _build_prefill(self, c: int):
+        """One prefill chunk for one slot: ``c`` prompt tokens enter the
+        cache through the block table; the chunk's own causal attention
+        runs against the gathered view (``models.attention.chunk_attn``).
+        Returns the in-graph pick from the final row — used by the host
+        only when the chunk completes the prompt."""
+        cfg = self.cfg
+        pick = make_pick(cfg.temperature, cfg.top_k, cfg.top_p,
+                         self.params.vocab, cfg.seed)
+
+        def run(p: LMParams, pool: PagedKV, table, pos0, tokens, uid):
+            positions = pos0 + jnp.arange(c)
+            x = self._embed(p, tokens, positions)           # [c, d]
+
+            def write_attn(l, pool, q, k, v):
+                pool = write_chunk(pool, l, table, pos0, k, v,
+                                   cfg.kv_dtype)
+                ck, cv = gather_layer(pool, l, table)
+                y = chunk_attn(q.transpose(1, 0, 2), ck, cv, pos0)
+                return pool, y.transpose(1, 0, 2)
+
+            pool, x = self._trunk(p, pool, x, positions, write_attn)
+            h = layernorm(p.ln_f, x[-1:])                   # last row
+            logits = self._logits(p, h)
+            nxt = pick(logits, uid[None], (pos0 + c)[None])
+            return pool, nxt[0]
+
+        return self._jit(run)
+
+    # -- scheduler -----------------------------------------------------
+
+    def submit(self, prompt, max_new: int, uid: int | None = None) -> int:
+        """Queue one request. ``prompt`` is a list of token ids; the
+        capacity checks run here so an impossible request fails at
+        submit time, never mid-serve."""
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max_new < 1:
+            raise ValueError(f"max_new must be >= 1, got {max_new}")
+        if any(not 0 <= t < self.params.vocab for t in prompt):
+            raise ValueError("prompt token out of vocab range")
+        # the final generated token is returned, never cached or embedded
+        # (_blocks_needed counts the same way), so a request may exactly
+        # fill its block reservation
+        cached = len(prompt) + max_new - 1
+        if cached > self.cfg.capacity:
+            raise ValueError(
+                f"prompt {len(prompt)} + max_new {max_new} needs "
+                f"{cached} cached positions, exceeding the per-sequence "
+                f"cache capacity {self.cfg.capacity} "
+                "(max_blocks_per_seq * block_size)")
+        if cached > self.params.max_seq_len:
+            raise ValueError(
+                f"prompt {len(prompt)} + max_new {max_new} needs "
+                f"{cached} cached positions, exceeding max_seq_len "
+                f"{self.params.max_seq_len}")
+        if self._blocks_needed(len(prompt), max_new) > self.cfg.n_blocks - 1:
+            raise ValueError("request needs more blocks than the pool "
+                             f"holds ({self.cfg.n_blocks - 1} usable)")
+        if uid is None:
+            uid = self._next_uid
+        elif (uid in self.finished
+              or any(s is not None and s.uid == uid for s in self.slots)
+              or any(s.uid == uid for s in self.waiting)):
+            # a duplicate uid would sample in lockstep with its twin
+            # (the key folds the uid) and overwrite its finished entry
+            raise ValueError(f"uid {uid} already in use")
+        self._next_uid = max(self._next_uid, uid) + 1
+        self.waiting.append(_Seq(uid=uid, prompt=prompt, max_new=max_new))
+        return uid
+
+    def _blocks_needed(self, t0: int, max_new: int) -> int:
+        # the final generated token is returned, never cached
+        positions = t0 + max_new - 1
+        return -(-positions // self.cfg.block_size)
+
+    def _admit(self) -> int:
+        """FCFS admission: move waiting requests into free slots while
+        both a slot and the request's full block reservation are
+        available (reserve-on-admit keeps serving preemption-free). A
+        head-of-line request that doesn't fit blocks the queue — strict
+        FCFS keeps admission deterministic."""
+        admitted = 0
+        while self.waiting:
+            seq = self.waiting[0]
+            need = self._blocks_needed(len(seq.prompt), seq.max_new)
+            free_slots = [i for i, s in enumerate(self.slots) if s is None]
+            if not free_slots or need > len(self.free_blocks):
+                break
+            self.waiting.popleft()
+            slot = free_slots[0]
+            seq.blocks = [self.free_blocks.pop(0) for _ in range(need)]
+            row = np.full((self.cfg.max_blocks_per_seq,), SCRATCH_BLOCK,
+                          np.int32)
+            row[:need] = seq.blocks
+            self.tables[slot] = row
+            self.lengths[slot] = 0
+            self.uids[slot] = seq.uid
+            self.slots[slot] = seq
+            admitted += 1
+        return admitted
+
+    def _release(self, slot: int) -> None:
+        seq = self.slots[slot]
+        self.finished[seq.uid] = seq.prompt + seq.out
+        self.free_blocks.extend(seq.blocks)
+        self.tables[slot] = SCRATCH_BLOCK
+        self.lengths[slot] = 0
+        self.next_token[slot] = 0
+        self.uids[slot] = 0
+        self.slots[slot] = None
+
+    def _prefill_step(self, slot: int) -> None:
+        seq = self.slots[slot]
+        remaining = len(seq.prompt) - seq.prefilled
+        # largest power-of-two bucket that fits the remaining prompt:
+        # chunk starts stay multiples of the chunk size, so no chunk
+        # ever straddles a block boundary (paged.write_chunk's contract)
+        c = max(b for b in self.chunk_buckets if b <= remaining)
+        fn = self._program("prefill", c)
+        chunk = np.asarray(seq.prompt[seq.prefilled:seq.prefilled + c],
+                           np.int32)
+        pool, nxt = fn(self.params, self.pool,
+                       jnp.asarray(self.tables[slot]),
+                       jnp.int32(seq.prefilled), jnp.asarray(chunk),
+                       jnp.int32(seq.uid))
+        self.pool = pool
+        seq.prefilled += c
+        if seq.prompt_done:
+            self.lengths[slot] = len(seq.prompt)
+            tok = int(nxt)
+            seq.out.append(tok)
+            self.next_token[slot] = tok
+            self.tokens_generated += 1
+            if seq.finished:
+                self._release(slot)
+
+    def _decode_step(self, ready: list[int]) -> None:
+        b = _bucket_for(len(ready), self.slot_buckets)
+        idx = ready + [0] * (b - len(ready))        # pad rows
+        tables = self.tables[idx].copy()
+        lengths = self.lengths[idx].copy()
+        tokens = self.next_token[idx].copy()
+        uids = self.uids[idx].copy()
+        for j in range(len(ready), b):              # pads -> scratch
+            tables[j] = SCRATCH_BLOCK
+            lengths[j] = 0
+            tokens[j] = 0
+            uids[j] = 0
+        fn = self._program("decode", b)
+        pool, picks = fn(self.params, self.pool, jnp.asarray(tables),
+                         jnp.asarray(lengths), jnp.asarray(tokens),
+                         jnp.asarray(uids))
+        self.pool = pool
+        picks = np.asarray(picks)
+        for j, slot in enumerate(ready):
+            seq = self.slots[slot]
+            tok = int(picks[j])
+            seq.out.append(tok)
+            self.lengths[slot] += 1
+            self.next_token[slot] = tok
+            self.tokens_generated += 1
+            if seq.finished:
+                self._release(slot)
+
+    def step(self) -> bool:
+        """One scheduler iteration: admit, at most ONE prefill chunk
+        (so a long prompt never stalls running decodes for more than a
+        chunk), then one decode dispatch over every ready slot. Returns
+        whether any work ran."""
+        self._admit()
+        did = False
+        pre = next((i for i, s in enumerate(self.slots)
+                    if s is not None and not s.prompt_done), None)
+        if pre is not None:
+            self._prefill_step(pre)
+            did = True
+        ready = [i for i, s in enumerate(self.slots)
+                 if s is not None and s.prompt_done]
+        if ready:
+            self._decode_step(ready)
+            did = True
+        if did:
+            self.steps += 1
+            active = sum(s is not None for s in self.slots)
+            self._occ_sum += active / self.cfg.max_slots
+        return did
+
+    @property
+    def active(self) -> int:
+        return sum(s is not None for s in self.slots)
+
+    def mean_occupancy(self) -> float:
+        return self._occ_sum / self.steps if self.steps else 0.0
+
+    def kv_pool_utilization(self) -> float:
+        usable = self.cfg.n_blocks - 1
+        return (usable - len(self.free_blocks)) / usable
+
+    def telemetry_record(self, tokens_per_sec=None) -> dict:
+        """One schema-v3 ``decode`` record (``runtime/telemetry.py``
+        ``DECODE_REQUIRED`` contract)."""
+        return {
+            "step": self.steps,
+            "tokens_per_sec": tokens_per_sec,
+            "batch_occupancy": round(self.active / self.cfg.max_slots, 4),
+            "kv_pool_utilization": round(self.kv_pool_utilization(), 4),
+            "active": self.active,
+            "waiting": len(self.waiting),
+            "tokens_generated": self.tokens_generated,
+            "kv_dtype": self.cfg.kv_dtype,
+            "compiled_programs": self.compile_count,
+        }
+
+    def run(self, metrics=None, log_every: int = 0) -> dict[int, list[int]]:
+        """Drain the queue: step until every submitted sequence
+        finished. ``metrics`` is a ``TelemetryWriter``; one ``decode``
+        record lands every ``log_every`` engine steps (0 = final only),
+        with throughput measured between records (host wall clock,
+        device-synced by the per-step readback of the picks)."""
+        last_t = time.perf_counter()
+        last_tokens = self.tokens_generated
+        last_step = self.steps
+        while self.waiting or self.active:
+            if not self.step():
+                raise RuntimeError("decode engine stalled: waiting "
+                                   "requests but no admissible work")
+            if (metrics is not None and log_every > 0
+                    and self.steps - last_step >= log_every):
+                now = time.perf_counter()
+                dt = max(now - last_t, 1e-9)
+                tps = (self.tokens_generated - last_tokens) / dt
+                metrics.decode(self.telemetry_record(round(tps, 2)))
+                last_t, last_tokens = now, self.tokens_generated
+                last_step = self.steps
+        if metrics is not None:
+            now = time.perf_counter()
+            dt = max(now - last_t, 1e-9)
+            tps = ((self.tokens_generated - last_tokens) / dt
+                   if self.tokens_generated > last_tokens else None)
+            metrics.decode(self.telemetry_record(
+                round(tps, 2) if tps is not None else None))
+        return dict(self.finished)
+
+    def generate(self, prompts, max_new: int, metrics=None,
+                 log_every: int = 0) -> list[list[int]]:
+        """Convenience batch API: submit every prompt, drain, return
+        full token lists in submission order."""
+        uids = [self.submit(p, max_new) for p in prompts]
+        done = self.run(metrics=metrics, log_every=log_every)
+        return [done[u] for u in uids]

@@ -1,0 +1,204 @@
+"""`generate` — the serving CLI: drive the decode engine end to end.
+
+Mirrors the training CLI's stance (``cli.py``): the model is the LM
+family at the flagged shape (``init_lm`` — random weights unless you
+wire your own; the engine is the demonstration target, not the
+checkpoint plumbing), prompts are either explicit token-id lists
+(``--prompts "3,1,4;9,2"``) or deterministic random draws
+(``--prompt_lens 5,9,13`` with ``--prompt_seed``), and the run prints
+ONE JSON line with every sequence's tokens plus the engine's
+throughput/occupancy stats. ``--metrics_dir`` streams schema-v3
+``decode`` records through the unified telemetry writer
+(``runtime/telemetry.py``) — ``report`` folds them like any other run.
+
+``--tp N`` runs the Megatron decode layout over an N-way model-axis
+mesh (``--fake_devices`` makes that work on CPU, as everywhere else).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def build_generate_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="generate",
+        description="Continuous-batching decode over the paged KV engine "
+                    "(decode/engine.py)")
+    # model shape (the cli.py -m 11 family surface)
+    p.add_argument("-d", "--model_size", type=int, default=64)
+    p.add_argument("-l", "--layers", type=int, default=2)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--kv_heads", type=int, default=0,
+                   help="GQA KV heads (0 = full MHA); shrinks the KV "
+                        "pool by heads/kv_heads")
+    p.add_argument("--vocab", type=int, default=256)
+    p.add_argument("--max_seq_len", type=int, default=256)
+    p.add_argument("-r", "--random_seed", type=int, default=0,
+                   help="model init seed (the cli.py convention)")
+    p.add_argument("--use_rope", action="store_true",
+                   help="rotary attention (must match training)")
+    # requests
+    p.add_argument("--prompts", default=None,
+                   help="semicolon-separated comma-lists of token ids, "
+                        'e.g. "3,1,4;9,2,6,5"')
+    p.add_argument("--prompt_lens", default=None,
+                   help="comma-separated lengths of random prompts "
+                        "(deterministic per --prompt_seed), e.g. 5,9,13")
+    p.add_argument("--prompt_seed", type=int, default=0)
+    p.add_argument("--max_new", type=int, default=16)
+    # sampling (fused, in-graph; decode/sampling.py)
+    p.add_argument("--temperature", type=float, default=0.0,
+                   help="0 = greedy argmax")
+    p.add_argument("--top_k", type=int, default=0)
+    p.add_argument("--top_p", type=float, default=0.0)
+    p.add_argument("--sample_seed", type=int, default=0)
+    # engine layout
+    p.add_argument("--kv_dtype", choices=["f32", "bf16", "int8"],
+                   default="f32")
+    p.add_argument("--block_size", type=int, default=16)
+    p.add_argument("--n_blocks", type=int, default=0,
+                   help="KV pool blocks incl. the scratch block "
+                        "(0 = sized for max_slots full sequences)")
+    p.add_argument("--max_slots", type=int, default=4)
+    p.add_argument("--max_blocks_per_seq", type=int, default=0,
+                   help="per-sequence table width (0 = cover "
+                        "max_seq_len)")
+    p.add_argument("--prefill_chunk", type=int, default=16)
+    # parallel strategy
+    p.add_argument("--tp", type=int, default=1,
+                   help="model-axis size for the Megatron decode layout "
+                        "(1 = single-device)")
+    p.add_argument("--fake_devices", type=int, default=0)
+    # observability
+    p.add_argument("--metrics_dir", default=None)
+    p.add_argument("--log_every", type=int, default=4,
+                   help="decode-record cadence in engine steps")
+    return p
+
+
+def generate_main(argv=None) -> int:
+    p = build_generate_parser()
+    args = p.parse_args(argv)
+
+    if args.fake_devices:
+        flags = os.environ.get("XLA_FLAGS", "")
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count="
+            f"{args.fake_devices}").strip()
+
+    import jax
+    if args.fake_devices:
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from ..models import init_lm
+    from .engine import DecodeEngine, EngineConfig
+
+    if (args.prompts is None) == (args.prompt_lens is None):
+        print("error: pass exactly one of --prompts / --prompt_lens",
+              file=sys.stderr)
+        return 2
+    if args.prompts is not None:
+        try:
+            prompts = [[int(t) for t in grp.split(",") if t.strip()]
+                       for grp in args.prompts.split(";") if grp.strip()]
+        except ValueError:
+            print(f"error: unparseable --prompts {args.prompts!r}",
+                  file=sys.stderr)
+            return 2
+    else:
+        try:
+            lens = [int(x) for x in args.prompt_lens.split(",")
+                    if x.strip()]
+        except ValueError:
+            print(f"error: unparseable --prompt_lens "
+                  f"{args.prompt_lens!r}", file=sys.stderr)
+            return 2
+        rng = np.random.default_rng(args.prompt_seed)
+        prompts = [rng.integers(0, args.vocab, size=n).tolist()
+                   for n in lens]
+    if not prompts or any(not pr for pr in prompts):
+        print("error: need at least one non-empty prompt",
+              file=sys.stderr)
+        return 2
+
+    longest = max(len(pr) for pr in prompts)
+    mbps = args.max_blocks_per_seq or -(
+        -min(args.max_seq_len, longest + args.max_new) // args.block_size)
+    n_blocks = args.n_blocks or 1 + args.max_slots * mbps
+    try:
+        cfg = EngineConfig(
+            block_size=args.block_size, n_blocks=n_blocks,
+            max_slots=args.max_slots, max_blocks_per_seq=mbps,
+            prefill_chunk=args.prefill_chunk, kv_dtype=args.kv_dtype,
+            temperature=args.temperature, top_k=args.top_k,
+            top_p=args.top_p, seed=args.sample_seed,
+            use_rope=args.use_rope)
+        params = init_lm(jax.random.PRNGKey(args.random_seed),
+                         args.vocab, args.model_size, args.layers,
+                         max_seq_len=args.max_seq_len,
+                         n_heads=args.heads,
+                         n_kv_heads=args.kv_heads or None)
+        mesh = None
+        tp = 1
+        if args.tp > 1:
+            from ..parallel import MODEL_AXIS, make_mesh
+            # the payload/meta report the EFFECTIVE mesh size, never the
+            # request — a clamped run must not masquerade as N-way TP
+            tp = min(args.tp, jax.device_count())
+            if tp < args.tp:
+                print(f"generate: --tp {args.tp} clamped to {tp} "
+                      f"({jax.device_count()} device(s) visible; use "
+                      "--fake_devices on CPU)", file=sys.stderr)
+            if tp > 1:
+                mesh = make_mesh({MODEL_AXIS: tp})
+        engine = DecodeEngine(params, args.heads, cfg, mesh=mesh)
+        uids = [engine.submit(pr, args.max_new) for pr in prompts]
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    metrics = None
+    if args.metrics_dir:
+        from ..runtime.telemetry import TelemetryWriter
+        metrics = TelemetryWriter(args.metrics_dir, meta={
+            "argv": list(argv or []), "subcommand": "generate",
+            "vocab": args.vocab, "model_size": args.model_size,
+            "layers": args.layers, "heads": args.heads,
+            "kv_dtype": args.kv_dtype, "max_slots": args.max_slots,
+            "block_size": args.block_size, "tp": tp,
+            "n_prompts": len(prompts), "max_new": args.max_new,
+            "device_kind": jax.devices()[0].device_kind})
+
+    t0 = time.perf_counter()
+    done = engine.run(metrics=metrics, log_every=args.log_every)
+    wall = time.perf_counter() - t0
+    if metrics is not None:
+        metrics.close()
+
+    payload = {
+        "sequences": [
+            {"uid": u, "prompt_len": len(pr),
+             "tokens": done[u]}
+            for u, pr in zip(uids, prompts)],
+        "tokens_generated": engine.tokens_generated,
+        "wall_s": round(wall, 4),
+        "tokens_per_sec": round(engine.tokens_generated / wall, 2),
+        "engine_steps": engine.steps,
+        "mean_occupancy": round(engine.mean_occupancy(), 4),
+        "compiled_programs": engine.compile_count,
+        "dispatches": engine.dispatch_count,
+        "kv_dtype": args.kv_dtype,
+        "tp": tp,
+    }
+    print(json.dumps(payload))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(generate_main())

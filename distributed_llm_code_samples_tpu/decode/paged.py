@@ -1,0 +1,257 @@
+"""Block/paged KV cache: the decode engine's memory layout.
+
+The lockstep decoder (``models.lm.generate``) allocates one contiguous
+``[T_max]`` cache lane per sequence, so a batch of mixed-length
+sequences pays for its longest member and freeing a finished sequence
+means rebuilding the batch (a recompile). This module is the
+PagedAttention-style answer in the repo's first-principles idiom: the
+cache is a static-shape **pool of fixed-size blocks**
+(``k/v [L, n_blocks, H_kv, block, dh]``) and each sequence names its
+blocks through a per-slot int32 **block table** — the KV read is a
+gather (``models.attention.gather_paged_kv``), the write is a scatter,
+and freeing a sequence is a host-side table edit. Shapes never depend
+on sequence length, so one compiled decode step serves every occupancy.
+
+Physical block 0 is reserved as the **scratch block**: unassigned table
+slots and padded bucket rows point at it, so padded writes land
+somewhere harmless instead of needing a masked scatter, and gathers of
+short sequences read bytes the causal mask then hides. Nothing is ever
+read from it unmasked.
+
+Quantization (``kv_dtype``):
+
+- ``"f32"`` — exact; the bit-for-bit baseline.
+- ``"bf16"`` — cast on write, upcast on read (exact mantissa truncation;
+  2x fewer KV bytes).
+- ``"int8"`` — symmetric per-(layer, block, kv-head) scales
+  (``k_scale/v_scale [L, n_blocks, H_kv]`` f32, ``scale = amax/127``).
+  A write re-quantizes the touched block over its *valid* rows only
+  (stale rows from a freed sequence never inflate the scale), which is
+  lossy but deterministic: a block's stored bytes depend only on its own
+  sequence's write history, so continuous batching stays token-identical
+  to sequential decode at any dtype (tests/test_decode_engine.py).
+
+All functions are pure jnp with static shapes; the layer index is a
+Python int (the engine unrolls layers at trace time, like
+``models.lm.decode_step``).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+KV_DTYPES = ("f32", "bf16", "int8")
+
+# physical block 0 is the scratch block (see module docstring)
+SCRATCH_BLOCK = 0
+
+
+class PagedKV(NamedTuple):
+    """The block pool. ``k/v [L, n_blocks, H_kv, block, dh]`` in the
+    storage dtype; ``k_scale/v_scale [L, n_blocks, H_kv]`` f32 per-block
+    dequantization scales (``None`` unless ``kv_dtype="int8"``)."""
+    k: jax.Array
+    v: jax.Array
+    k_scale: jax.Array | None
+    v_scale: jax.Array | None
+
+    @property
+    def n_blocks(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def block_size(self) -> int:
+        return self.k.shape[3]
+
+
+def storage_dtype(kv_dtype: str):
+    if kv_dtype not in KV_DTYPES:
+        raise ValueError(f"kv_dtype {kv_dtype!r} not in {KV_DTYPES}")
+    return {"f32": jnp.float32, "bf16": jnp.bfloat16,
+            "int8": jnp.int8}[kv_dtype]
+
+
+def kv_bytes_per_token(kv_dtype: str, n_layers: int, kv_heads: int,
+                       head_dim: int) -> float:
+    """Stored KV bytes per cached token position — the roofline's
+    ``kv_bytes`` knob. int8 adds the amortized per-block scale pair
+    (negligible; counted as 0 here, the bench reports block overheads
+    separately)."""
+    per_elt = {"f32": 4, "bf16": 2, "int8": 1}[kv_dtype]
+    return 2 * n_layers * kv_heads * head_dim * per_elt
+
+
+def init_pool(n_layers: int, n_blocks: int, kv_heads: int,
+              block_size: int, head_dim: int,
+              kv_dtype: str = "f32") -> PagedKV:
+    """Zero-filled pool. ``n_blocks`` includes the reserved scratch
+    block, so at least 2 are required for any real sequence."""
+    if n_blocks < 2:
+        raise ValueError(f"n_blocks must be >= 2 (block {SCRATCH_BLOCK} "
+                         f"is the reserved scratch block), got {n_blocks}")
+    shape = (n_layers, n_blocks, kv_heads, block_size, head_dim)
+    dt = storage_dtype(kv_dtype)
+
+    def scale():
+        # distinct arrays per field: the engine donates the whole pool
+        # into its compiled steps, and XLA rejects donating one buffer
+        # through two arguments
+        return (jnp.zeros((n_layers, n_blocks, kv_heads), jnp.float32)
+                if kv_dtype == "int8" else None)
+
+    return PagedKV(k=jnp.zeros(shape, dt), v=jnp.zeros(shape, dt),
+                   k_scale=scale(), v_scale=scale())
+
+
+def _quantize(x: jax.Array, valid: jax.Array):
+    """Symmetric int8 quantization of one (or a batch of) blocks.
+    ``x [..., block, dh]`` f32, ``valid [..., block]`` bool row mask.
+    Returns ``(q int8, scale [...])`` with ``scale = amax/127`` over the
+    valid rows; an all-invalid (or all-zero) block gets scale 0 and
+    zero codes."""
+    masked = jnp.where(valid[..., None], jnp.abs(x), 0.0)
+    amax = jnp.max(masked, axis=(-2, -1))
+    scale = amax / 127.0
+    safe = jnp.where(scale > 0, scale, 1.0)[..., None, None]
+    q = jnp.clip(jnp.round(x / safe), -127, 127).astype(jnp.int8)
+    q = jnp.where((scale > 0)[..., None, None], q, jnp.int8(0))
+    return q, scale
+
+
+def _dequantize(q: jax.Array, scale: jax.Array) -> jax.Array:
+    """``x_hat = q * scale``; ``q [..., block, dh]``, ``scale [...]``."""
+    return q.astype(jnp.float32) * scale[..., None, None]
+
+
+def write_rows(pool: PagedKV, layer: int, phys: jax.Array,
+               off: jax.Array, k_new: jax.Array, v_new: jax.Array,
+               kv_dtype: str) -> PagedKV:
+    """Scatter ``N`` new KV rows into the pool: row ``i`` lands at
+    ``(layer, phys[i], :, off[i], :)``. ``k_new/v_new [N, H_kv, dh]``
+    f32. For f32/bf16 this is one masked-free scatter; for int8 each
+    touched block is read back, dequantized, re-quantized over its valid
+    rows ``0..off[i]`` (blocks fill in order, so everything at or below
+    the newest offset is live) and written whole. Duplicate ``phys``
+    entries are only ever the scratch block (padded bucket rows) — last
+    writer wins there, and nothing reads it unmasked."""
+    hkv = pool.k.shape[2]
+    heads = jnp.arange(hkv)
+    if kv_dtype != "int8":
+        dt = pool.k.dtype
+        idx = (layer, phys[:, None], heads[None, :], off[:, None])
+        return pool._replace(
+            k=pool.k.at[idx].set(k_new.astype(dt)),
+            v=pool.v.at[idx].set(v_new.astype(dt)))
+    # int8: read-modify-requantize the touched blocks
+    blk = pool.block_size
+    rows = jnp.arange(blk)
+    valid = rows[None, :] <= off[:, None]               # [N, block]
+    valid = jnp.broadcast_to(valid[:, None, :], (off.shape[0], hkv, blk))
+
+    def requant(pool_side, scale_side, new):
+        old = _dequantize(pool_side[layer, phys],      # [N, Hkv, blk, dh]
+                          scale_side[layer, phys])
+        ins = rows[None, None, :, None] == off[:, None, None, None]
+        cur = jnp.where(ins, new[:, :, None, :], old)
+        q, scale = _quantize(cur, valid)
+        return (pool_side.at[layer, phys].set(q),
+                scale_side.at[layer, phys].set(scale))
+
+    k, ks = requant(pool.k, pool.k_scale, k_new)
+    v, vs = requant(pool.v, pool.v_scale, v_new)
+    return PagedKV(k=k, v=v, k_scale=ks, v_scale=vs)
+
+
+def write_chunk(pool: PagedKV, layer: int, table: jax.Array, pos0,
+                k_new: jax.Array, v_new: jax.Array,
+                kv_dtype: str) -> PagedKV:
+    """Write one sequence's prefill chunk: ``k_new/v_new [C, H_kv, dh]``
+    f32 at global positions ``pos0 .. pos0+C-1`` through ``table
+    [max_blocks]``. The engine's power-of-two chunk buckets never
+    straddle a block boundary (chunk starts are multiples of the chunk
+    size and ``block_size`` is a power of two >= or <= every bucket), so
+    a chunk either part-fills exactly one block (``C < block``) or
+    covers ``C/block`` whole blocks — the two static cases below."""
+    c = k_new.shape[0]
+    blk = pool.block_size
+    positions = pos0 + jnp.arange(c)
+    phys = table[positions // blk]
+    off = positions % blk
+    if kv_dtype != "int8" or c < blk:
+        # int8 c<blk touches ONE block; write_rows' per-row requant
+        # converges because every row shares (phys, valid-hi) — requant
+        # once with all rows inserted
+        if kv_dtype == "int8":
+            return _int8_partial_chunk(pool, layer, phys[0], off, k_new,
+                                       v_new)
+        return write_rows(pool, layer, phys, off, k_new, v_new, kv_dtype)
+    # int8, whole blocks: quantize each block outright (no old content)
+    if c % blk:
+        raise ValueError(f"chunk {c} > block {blk} must be a whole "
+                         "multiple (power-of-two buckets guarantee it)")
+    nb = c // blk
+    hkv = pool.k.shape[2]
+    dh = pool.k.shape[4]
+    blocks = table[pos0 // blk + jnp.arange(nb)]        # [nb]
+    valid = jnp.ones((nb, hkv, blk), bool)
+
+    def quant_whole(pool_side, scale_side, new):
+        shaped = new.reshape(nb, blk, hkv, dh).transpose(0, 2, 1, 3)
+        q, scale = _quantize(shaped, valid)
+        return (pool_side.at[layer, blocks].set(q),
+                scale_side.at[layer, blocks].set(scale))
+
+    k, ks = quant_whole(pool.k, pool.k_scale, k_new)
+    v, vs = quant_whole(pool.v, pool.v_scale, v_new)
+    return PagedKV(k=k, v=v, k_scale=ks, v_scale=vs)
+
+
+def _int8_partial_chunk(pool: PagedKV, layer: int, phys, off: jax.Array,
+                        k_new: jax.Array, v_new: jax.Array) -> PagedKV:
+    """int8 chunk write confined to ONE block (``C < block``): read the
+    block, dequantize, insert the ``C`` rows at ``off``, re-quantize
+    over rows ``0..max(off)``."""
+    blk = pool.block_size
+    hkv, dh = pool.k.shape[2], pool.k.shape[4]
+    rows = jnp.arange(blk)
+    valid_hi = off[-1]                                  # fills in order
+    valid = jnp.broadcast_to((rows <= valid_hi)[None, :], (hkv, blk))
+    hit = jnp.zeros((blk,), bool).at[off].set(True)
+
+    def requant(pool_side, scale_side, new):
+        old = _dequantize(pool_side[layer, phys],       # [Hkv, blk, dh]
+                          scale_side[layer, phys])
+        # insert row c at offset off[c] (offsets are distinct)
+        upd = jnp.zeros((blk, hkv, dh), new.dtype).at[off].set(new)
+        cur = jnp.where(hit[None, :, None], upd.transpose(1, 0, 2), old)
+        q, scale = _quantize(cur, valid)
+        return (pool_side.at[layer, phys].set(q),
+                scale_side.at[layer, phys].set(scale))
+
+    k, ks = requant(pool.k, pool.k_scale, k_new)
+    v, vs = requant(pool.v, pool.v_scale, v_new)
+    return PagedKV(k=k, v=v, k_scale=ks, v_scale=vs)
+
+
+def gather_layer(pool: PagedKV, layer: int, table: jax.Array):
+    """One sequence's dequantized contiguous KV view for one layer:
+    ``table [max_blocks]`` -> ``(k, v)`` each ``[H_kv, T_cap, dh]`` f32
+    (``T_cap = max_blocks * block``). The gather itself is
+    ``models.attention.gather_paged_kv`` — the attention read against a
+    block table; this wrapper only adds the dtype story."""
+    from ..models.attention import gather_paged_kv
+    k, v = gather_paged_kv(pool.k[layer], pool.v[layer], table)
+    if pool.k_scale is None:
+        if k.dtype != jnp.float32:
+            k = k.astype(jnp.float32)
+            v = v.astype(jnp.float32)
+        return k, v
+    blk = pool.block_size
+    # per-block scales -> per-position: [MB, Hkv] -> [Hkv, MB*blk]
+    ks = jnp.repeat(pool.k_scale[layer][table].T, blk, axis=1)
+    vs = jnp.repeat(pool.v_scale[layer][table].T, blk, axis=1)
+    return (k.astype(jnp.float32) * ks[..., None],
+            v.astype(jnp.float32) * vs[..., None])

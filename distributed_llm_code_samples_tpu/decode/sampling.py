@@ -1,0 +1,95 @@
+"""In-graph fused sampling for the decode engine.
+
+The lockstep decoders pick host-free already (``models.lm.sample_pick``),
+but their RNG folds only ``(seed, position)`` — fine when the whole
+batch is one request, wrong for continuous batching, where a slot's
+draw must not depend on *which* slot (or which neighbors) a sequence
+landed in. The engine's contract folds the **sequence uid** too:
+
+    key = fold_in(fold_in(fold_in(PRNGKey(0x5A3D), seed), uid), position)
+
+``position`` is the global index of the token being generated (prompt
+positions count from 0), so a sequence's continuation is a pure
+function of ``(engine seed, uid, its own tokens)`` — continuous-batching
+output is token-identical to decoding the same sequence alone, which is
+exactly what tests/test_decode_engine.py pins. Same counter-RNG stance
+as the data layer (``data.batch_from_seed``): no carried RNG state.
+
+The pick itself is fused into the compiled step: temperature scaling,
+top-k truncation, top-p (nucleus) truncation, then a Gumbel-max
+categorical draw (an exact sample from the truncated softmax). Greedy
+(``temperature == 0``) is a plain argmax — bit-compatible with
+``models.lm.generate``'s pick.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# the engine's sampling domain (distinct from sample_pick's 0x5A3)
+_BASE_KEY = 0x5A3D
+
+
+def check_sampling(temperature: float, top_k: int, top_p: float,
+                   vocab: int) -> None:
+    """Shared flag validation (engine + CLI): ``temperature == 0`` is
+    greedy; ``top_k == 0`` / ``top_p == 0`` disable those truncations."""
+    if temperature < 0:
+        raise ValueError(f"temperature must be >= 0 (0 = greedy), got "
+                         f"{temperature}")
+    if top_k < 0 or top_k > vocab:
+        raise ValueError(f"top_k={top_k} outside [0, vocab={vocab}]")
+    if not 0.0 <= top_p <= 1.0:
+        raise ValueError(f"top_p={top_p} outside [0, 1]")
+    if temperature == 0 and (top_k or top_p):
+        raise ValueError("top_k/top_p require temperature > 0 "
+                         "(greedy ignores them)")
+
+
+def _nucleus_mask(z: jax.Array, top_p: float) -> jax.Array:
+    """Mask logits outside the top-p nucleus: keep the smallest
+    descending-probability prefix whose mass reaches ``top_p`` (the
+    token that crosses the threshold is kept, so at least the argmax
+    always survives). ``z [S, V]`` -> ``z`` with -inf outside."""
+    s = z.shape[0]
+    order = jnp.argsort(-z, axis=-1)                    # descending
+    probs = jax.nn.softmax(z, axis=-1)
+    sorted_p = jnp.take_along_axis(probs, order, axis=-1)
+    before = jnp.cumsum(sorted_p, axis=-1) - sorted_p   # mass ahead of i
+    keep_sorted = before < top_p
+    keep = jnp.zeros_like(keep_sorted).at[
+        jnp.arange(s)[:, None], order].set(keep_sorted)
+    return jnp.where(keep, z, -jnp.inf)
+
+
+def make_pick(temperature: float, top_k: int, top_p: float, vocab: int,
+              seed: int):
+    """Build the fused ``pick(logits [S, V], uids [S], positions [S])
+    -> [S] int32`` for the engine's compiled steps. All arguments are
+    static (one pick per engine config); ``uids``/``positions`` are
+    runtime operands, so one compiled program serves every slot mix."""
+    check_sampling(temperature, top_k, top_p, vocab)
+    if temperature == 0:
+        return lambda z, uids, positions: jnp.argmax(
+            z, axis=-1).astype(jnp.int32)
+    base = jax.random.fold_in(jax.random.PRNGKey(_BASE_KEY), seed)
+
+    def pick(logits, uids, positions):
+        z = logits.astype(jnp.float32) / temperature
+        if top_k:
+            kth = lax.top_k(z, top_k)[0][:, -1:]
+            z = jnp.where(z < kth, -jnp.inf, z)
+        if top_p:
+            z = _nucleus_mask(z, top_p)
+
+        def draw(z_row, uid, pos):
+            key = jax.random.fold_in(jax.random.fold_in(base, uid), pos)
+            g = jax.random.gumbel(key, z_row.shape, jnp.float32)
+            # -inf + gumbel stays -inf: truncated tokens never win
+            return jnp.argmax(z_row + g)
+
+        return jax.vmap(draw)(z, uids, positions).astype(jnp.int32)
+
+    return pick

@@ -3,7 +3,7 @@ entire model surface); attention/long-context extensions live here too."""
 
 from .ffn_stack import (FFNStackParams, init_ffn_stack, clone_params,
                         params_size_gb)
-from .attention import attention, mha
+from .attention import (attention, chunk_attn, gather_paged_kv, mha)
 from .moe import MoEStackParams, init_moe_stack
 from .moe_transformer import (MoETransformerParams,
                               init_moe_transformer,
@@ -11,17 +11,19 @@ from .moe_transformer import (MoETransformerParams,
 from .transformer import (TransformerParams, init_transformer,
                           transformer_fwd)
 from .lm import (LMParams, init_lm, lm_logits, lm_loss, KVCache,
-                 init_cache, decode_step, generate, sample)
+                 decode_attn, init_cache, decode_step, generate, sample)
 from .moe_lm import (MoELMParams, init_moe_lm, moe_lm_loss_aux,
                      moe_lm_logits, moe_generate, moe_sample)
 
 __all__ = ["FFNStackParams", "init_ffn_stack", "clone_params",
-           "params_size_gb", "attention", "mha",
+           "params_size_gb", "attention", "chunk_attn",
+           "gather_paged_kv", "mha",
            "MoEStackParams", "init_moe_stack",
            "MoETransformerParams", "init_moe_transformer",
            "moe_transformer_fwd_aux",
            "TransformerParams", "init_transformer", "transformer_fwd",
            "LMParams", "init_lm", "lm_logits", "lm_loss", "KVCache",
-           "init_cache", "decode_step", "generate", "sample",
+           "decode_attn", "init_cache", "decode_step", "generate",
+           "sample",
            "MoELMParams", "init_moe_lm", "moe_lm_loss_aux",
            "moe_lm_logits", "moe_generate", "moe_sample"]

@@ -137,6 +137,59 @@ def rope_mha(q: jax.Array, k: jax.Array, v: jax.Array,
 rope_mha.supports_gqa = True  # handles fewer k heads (see attn_sublayer)
 
 
+# ---------------------------------------------------------------------------
+# Paged-KV reads (the decode engine's block-table layout, decode/paged.py):
+# the cache lives as a pool of fixed-size blocks and each sequence names
+# its blocks through an int32 table — the KV read is a gather, so
+# sequences of different lengths share one static-shape pool and freeing
+# a sequence is a table edit, never a recompile.
+
+
+def gather_paged_kv(pool_k: jax.Array, pool_v: jax.Array,
+                    table: jax.Array):
+    """Materialize one sequence's contiguous KV view from the block pool.
+
+    ``pool_k/pool_v [n_blocks, H_kv, block, dh]`` (one layer's pool),
+    ``table [max_blocks]`` int32 physical block ids, in sequence order.
+    Returns ``(k, v)`` each ``[H_kv, max_blocks * block, dh]`` — exactly
+    the contiguous cache layout ``_decode_attn`` reads, so downstream
+    attention is bit-identical to a contiguous cache holding the same
+    values (the gather only moves bytes). Positions beyond the sequence
+    length read whatever the table's tail blocks hold (the engine points
+    unassigned table slots at the reserved scratch block); callers mask
+    them, as with the zero tail of a contiguous cache."""
+    k = pool_k[table]                      # [MB, H_kv, block, dh]
+    v = pool_v[table]
+    mb, hkv, blk, dh = k.shape
+    k = k.transpose(1, 0, 2, 3).reshape(hkv, mb * blk, dh)
+    v = v.transpose(1, 0, 2, 3).reshape(hkv, mb * blk, dh)
+    return k, v
+
+
+def chunk_attn(q: jax.Array, ck: jax.Array, cv: jax.Array,
+               q_offset) -> jax.Array:
+    """Prefill-chunk attention of ``Tq`` queries against a (gathered)
+    cache that already holds the chunk's own keys: ``q [H, Tq, dh]``,
+    ``ck/cv [H_kv, T_cap, dh]`` with ``H % H_kv == 0`` (GQA groups).
+    The mask is the global causal rule via ``causal_mask(Tq, T_cap,
+    q_offset)`` — query ``i`` (global position ``q_offset + i``) sees
+    cache positions ``<= q_offset + i``, which also hides every
+    not-yet-written pool position. ``q_offset`` may be a traced scalar
+    (the chunked-prefill loop passes the running write head)."""
+    h, tq, dh = q.shape
+    hkv, tcap, _ = ck.shape
+    if h % hkv:
+        raise ValueError(f"query heads {h} not divisible by kv heads "
+                         f"{hkv}")
+    qg = q.reshape(hkv, h // hkv, tq, dh)
+    s = jnp.einsum("kgqd,ktd->kgqt", qg, ck) / jnp.sqrt(
+        jnp.asarray(dh, q.dtype))
+    mask = causal_mask(tq, tcap, q_offset=q_offset)
+    s = jnp.where(mask, s, jnp.asarray(-1e30, s.dtype))
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("kgqt,ktd->kgqd", p, cv).reshape(h, tq, dh)
+
+
 def gqa(q: jax.Array, k: jax.Array, v: jax.Array,
         causal: bool = True) -> jax.Array:
     """Grouped-query attention: ``q [H, T, dh]``, ``k/v [H_kv, T, dh]``

@@ -190,20 +190,32 @@ def init_cache(params: LMParams, batch: int, n_heads: int,
     return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
 
 
-def _decode_attn(q, ck, cv, pos):
+def decode_attn(q, ck, cv, lengths):
     """Single-query attention over the cache. ``q [B, H, dh]``,
     ``ck/cv [B, H_kv, T_max, dh]`` with ``H % H_kv == 0`` (GQA groups;
-    ``H_kv == H`` is plain MHA); positions ``> pos`` are masked (the
-    cache beyond the write head is zeros, never probability mass)."""
+    ``H_kv == H`` is plain MHA); positions ``>= lengths`` are masked
+    (the cache beyond the write head is zeros — or, under the decode
+    engine's block tables, stale bytes — never probability mass).
+    ``lengths`` is the per-sequence live-token count: a scalar for the
+    lockstep ``generate`` scan, or ``[B]`` for the decode engine's
+    continuously-batched slots, each at its own position."""
     b, h, dh = q.shape
     hkv = ck.shape[1]
     qg = q.reshape(b, hkv, h // hkv, dh)
     s = jnp.einsum("bkgd,bktd->bkgt", qg, ck) / jnp.sqrt(
         jnp.asarray(dh, q.dtype))
-    mask = jnp.arange(ck.shape[2]) <= pos
+    lengths = jnp.asarray(lengths)
+    mask = jnp.arange(ck.shape[2]) < lengths[..., None]  # [T] or [B, T]
+    if mask.ndim == 2:
+        mask = mask[:, None, None, :]                    # -> [B, 1, 1, T]
     s = jnp.where(mask, s, jnp.asarray(-1e30, s.dtype))
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bkgt,bktd->bkgd", p, cv).reshape(b, h, dh)
+
+
+def _decode_attn(q, ck, cv, pos):
+    """The lockstep form: every sequence at the same scalar ``pos``."""
+    return decode_attn(q, ck, cv, jnp.asarray(pos) + 1)
 
 
 def cached_attn_step(ln1_l, wq_l, wk_l, wv_l, wo_l, cache_k, cache_v,

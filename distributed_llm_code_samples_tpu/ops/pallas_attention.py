@@ -24,18 +24,24 @@ row would otherwise poison the accumulator). All kernels run under
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .pallas_ffn import _pick_block
+# the shared tile/precision helpers live in pallas_ffn (the canonical
+# module; imports flow attention -> ffn only, so there is no cycle) —
+# _env_block reads tile envs at TRACE time so on-chip sweeps can vary
+# them between jax.clear_caches() points without re-execing
+from .pallas_ffn import _env_block, _mxu, _pick_block
+from .pallas_ffn import _resolve_mxu_bf16 as _resolve_mxu_bf16_base
 
 _NEG = -1e30
 _LANES = 128
 _Q_QUANTUM = 8
+
+
 # Default tile sizes, env-overridable for on-chip sweeps. r04 swept on
 # the v5e chip (T=8192, H8, dh64): 128x128 tiles ran the whole step at
 # ~7 TFLOP/s — the online-softmax VPU work (exp, rescale, stats) per
@@ -44,14 +50,6 @@ _Q_QUANTUM = 8
 # larger tiles only add VMEM pressure (2048x1024 fails to compile).
 # `_pick_block` caps every block at the actual T, so small/test shapes
 # are unaffected.
-def _env_block(name: str, default: int) -> int:
-    """Tile default read at TRACE time (not import), so an on-chip
-    sweep can vary the env between `jax.clear_caches()` points without
-    re-execing the process (the pallas_ffn sweep pattern)."""
-    v = os.environ.get(name)
-    return int(v) if v else default
-
-
 def _DEF_BQ():
     return _env_block("FLASH_BLOCK_Q", 1024)
 
@@ -68,32 +66,18 @@ def _DEF_BWD_BK():
     return _env_block("FLASH_BWD_BLOCK_K", 512)
 
 
-def _mxu(x, mxu_bf16: bool):
-    """Cast an MXU operand to bf16 when the bf16-MXU policy is on.
-
-    Mosaic lowers an f32xf32 dot to a multi-pass MXU operation; the XLA
-    oracle (``models.attention.mha``) runs JAX's default f32 matmul
-    precision, which on TPU is a SINGLE bf16 pass. Casting the kernel's
-    matmul operands (never the f32 accumulators or the softmax stats)
-    puts both paths in the same numerics class and was worth ~3x on the
-    r04 chip measurements."""
-    return x.astype(jnp.bfloat16) if mxu_bf16 else x
-
-
 def _resolve_mxu_bf16(mxu_bf16, interpret: bool) -> bool:
-    """Default the bf16-MXU policy: on for the compiled TPU path (the
-    numerics class of the XLA oracle under JAX's default f32 matmul
-    precision), off in interpret mode (the CPU suite's exact
-    differentials). Callers who train flash under a full-f32 precision
+    """The flash kernels' bf16-MXU policy default: the shared rule
+    (``pallas_ffn._resolve_mxu_bf16``) bound to the ``FLASH_MXU_BF16``
+    env override. Callers who train flash under a full-f32 precision
     requirement pass ``mxu_bf16=False`` explicitly (or set
     ``FLASH_MXU_BF16=0``) — the policy is a parameter, not a hardwired
-    consequence of running on hardware."""
-    env = os.environ.get("FLASH_MXU_BF16")
-    if mxu_bf16 is not None:
-        return bool(mxu_bf16)
-    if env is not None:
-        return env != "0"
-    return not interpret
+    consequence of running on hardware. Casting matmul operands (never
+    the f32 accumulators or softmax stats) to bf16 puts the kernels in
+    the same numerics class as the XLA oracle's default-precision
+    matmuls and was worth ~3x on the r04 chip measurements."""
+    return _resolve_mxu_bf16_base(mxu_bf16, interpret,
+                                  env_var="FLASH_MXU_BF16")
 
 
 def _sds(shape, dtype, like):

@@ -58,18 +58,27 @@ from jax.experimental.pallas import tpu as pltpu
 
 def _mxu(x, mxu_bf16: bool):
     """Cast an MXU operand to bf16 when the bf16-MXU policy is on (the
-    flash recipe; same helper as ``pallas_attention._mxu`` — defined
-    here too because that module imports ``_pick_block`` from this
-    one)."""
+    flash recipe). THE canonical definition: ``pallas_attention`` (and,
+    through it, ``pallas_xent``) imports this — imports flow
+    attention -> ffn only, never back, so there is no cycle."""
     return x.astype(jnp.bfloat16) if mxu_bf16 else x
 
 
-def _resolve_mxu_bf16(mxu_bf16, interpret: bool) -> bool:
-    """Default the bf16-MXU policy: on for the compiled TPU path, off
-    under the interpreter (the CPU suite then checks exact f32 math
-    against the oracle)."""
+def _resolve_mxu_bf16(mxu_bf16, interpret: bool,
+                      env_var: str | None = None) -> bool:
+    """Default the bf16-MXU policy: on for the compiled TPU path (the
+    numerics class of the XLA oracle under JAX's default f32 matmul
+    precision), off under the interpreter (the CPU suite then checks
+    exact f32 math against the oracle). An explicit ``mxu_bf16`` always
+    wins; ``env_var`` names an optional env override between the two
+    (the flash kernels pass ``FLASH_MXU_BF16``). Canonical definition —
+    the other Pallas modules import it from here."""
     if mxu_bf16 is not None:
         return bool(mxu_bf16)
+    if env_var is not None:
+        env = os.environ.get(env_var)
+        if env is not None:
+            return env != "0"
     return not interpret
 
 

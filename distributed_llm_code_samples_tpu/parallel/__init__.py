@@ -31,7 +31,7 @@ from .transformer import (train_transformer_single, train_transformer_ddp,
                           train_transformer_hybrid, train_transformer_seq)
 from .lm import (train_lm_single, train_lm_ddp, train_lm_fsdp, train_lm_tp,
                  train_lm_hybrid, train_lm_seq, tp_generate, tp_sample,
-                 tp_shard_params, vp_embed,
+                 tp_decode_specs, tp_shard_params, vp_embed,
                  vp_xent)
 from .moe_lm import train_moe_lm_ep, train_moe_lm_dense
 
@@ -69,7 +69,7 @@ __all__ = [
     "ulysses_attention", "ulysses_parallel_attention",
     "train_lm_single", "train_lm_ddp", "train_lm_fsdp", "train_lm_tp",
     "train_lm_hybrid", "train_lm_seq", "tp_generate", "tp_sample",
-    "tp_shard_params", "vp_embed",
+    "tp_decode_specs", "tp_shard_params", "vp_embed",
     "vp_xent",
     "train_moe_lm_ep", "train_moe_lm_dense",
     "STRATEGIES",

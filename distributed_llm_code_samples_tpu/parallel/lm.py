@@ -679,13 +679,24 @@ def tp_sample(params: LMParams, prompt, n_new: int, mesh, *,
                       temperature=float(temperature), seed=seed)
 
 
+def tp_decode_specs() -> LMParams:
+    """The Megatron decode layout's partition specs (vocab-sharded
+    ``wte``, head-sharded blocks, replicated positions/LNs) — one
+    definition shared by ``tp_generate``/``tp_sample`` and the serving
+    engine (``decode/engine.py``), so the two decode paths can never
+    drift onto different layouts."""
+    return _lm_tp_specs()
+
+
 def tp_shard_params(params: LMParams, mesh) -> LMParams:
     """Lay the LM params out in the Megatron decode layout (vocab/head
-    sharded) ONCE. ``tp_generate``/``tp_sample`` detect the layout and
-    skip their per-call reshard copy, so repeat decodes (serving loops,
-    ``bench_decode``) pay neither a retrace (the program is cached) nor
-    a per-call host-side param copy."""
+    sharded) ONCE. ``tp_generate``/``tp_sample`` and the decode engine
+    detect the layout and skip their per-call reshard copy, so repeat
+    decodes (serving loops, ``bench_decode``) pay neither a retrace
+    (the program is cached) nor a per-call host-side param copy."""
     require_axes(mesh, MODEL_AXIS)
+    if _tp_sharded_already(params, mesh):
+        return params
     return _shard(params, mesh, _lm_tp_specs())
 
 

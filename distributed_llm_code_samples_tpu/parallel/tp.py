@@ -180,8 +180,6 @@ def train_tp(params: FFNStackParams, seeds, batch_size: int,
     the reference never asserted). ``mixed`` runs the bf16-MXU block rule
     (to tolerance vs the f32 path: the contraction is split across
     shards, so bf16 rounding composes with the psum order)."""
-    import jax.numpy as jnp
-
     require_axes(mesh, MODEL_AXIS)
     n = mesh.shape[MODEL_AXIS]
     if params.w1.shape[1] % n:

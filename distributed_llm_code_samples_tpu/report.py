@@ -172,6 +172,7 @@ def report_main(argv=None) -> int:
     benches = [r for r in records if r["kind"] == "bench"]
     anomalies = [r for r in records if r["kind"] == "anomaly"]
     rollbacks = [r for r in records if r["kind"] == "rollback"]
+    decodes = [r for r in records if r["kind"] == "decode"]
 
     # attempt log: flag wins; else the newest meta that names one
     attempt_path = args.attempt_log
@@ -240,6 +241,31 @@ def report_main(argv=None) -> int:
             by_strategy.setdefault(s.get("strategy") or "run", []).append(s)
         doc["steps"] = {k: _stats_of(v) for k, v in by_strategy.items()}
 
+    # ---- serving (decode engine) summary ----------------------------
+    if decodes:
+        tps = [d["tokens_per_sec"] for d in decodes
+               if d.get("tokens_per_sec") is not None]
+        occ = [d["batch_occupancy"] for d in decodes
+               if d.get("batch_occupancy") is not None]
+        util = [d["kv_pool_utilization"] for d in decodes
+                if d.get("kv_pool_utilization") is not None]
+        serving = {
+            "records": len(decodes),
+            "engine_steps": decodes[-1].get("step"),
+            "tokens_generated": decodes[-1].get("tokens_generated"),
+            "kv_dtype": decodes[-1].get("kv_dtype"),
+            "compiled_programs": decodes[-1].get("compiled_programs"),
+        }
+        if tps:
+            serving["tokens_per_sec_mean"] = round(float(np.mean(tps)), 1)
+            serving["tokens_per_sec_best"] = round(float(np.max(tps)), 1)
+        if occ:
+            serving["batch_occupancy_mean"] = round(float(np.mean(occ)), 4)
+        if util:
+            serving["kv_pool_utilization_max"] = round(float(np.max(util)),
+                                                       4)
+        doc["serving"] = serving
+
     # ---- recovery / chaos summary -----------------------------------
     fails = [a for a in attempts if a.get("event") == "attempt_failed"]
     doc["recovery"] = {
@@ -271,6 +297,17 @@ def report_main(argv=None) -> int:
     for r in rollbacks:
         timeline.append((r["t"], "rollbck", _describe_event(r)))
         seen_events.add((r.get("t"), "rollback"))
+    for d in decodes:
+        bits = [f"engine step {d.get('step')}"]
+        if d.get("tokens_per_sec") is not None:
+            bits.append(f"{d['tokens_per_sec']:.0f} tok/s")
+        if d.get("batch_occupancy") is not None:
+            bits.append(f"occ {d['batch_occupancy']:.2f}")
+        if d.get("kv_pool_utilization") is not None:
+            bits.append(f"kv {d['kv_pool_utilization']:.2f}")
+        if d.get("waiting"):
+            bits.append(f"{d['waiting']} waiting")
+        timeline.append((d["t"], "decode", "  ".join(bits)))
     for a in attempts:
         # supervise forwards checkpoint-layer events to its log too;
         # drop exact duplicates of what the metrics stream already has
@@ -337,6 +374,22 @@ def report_main(argv=None) -> int:
         if "hbm_high_water_bytes" in st:
             out.append("  HBM high-water  "
                        + _fmt_bytes(st["hbm_high_water_bytes"]))
+    if "serving" in doc:
+        sv = doc["serving"]
+        out.append("")
+        out.append(f"serving [{sv.get('kv_dtype')}]: "
+                   f"{sv['records']} decode record(s), "
+                   f"{sv.get('engine_steps')} engine step(s), "
+                   f"{sv.get('tokens_generated')} token(s), "
+                   f"{sv.get('compiled_programs')} compiled program(s)")
+        if "tokens_per_sec_mean" in sv:
+            out.append(f"  throughput  mean {sv['tokens_per_sec_mean']} "
+                       f"tok/s  best {sv['tokens_per_sec_best']} tok/s")
+        if "batch_occupancy_mean" in sv:
+            out.append(f"  occupancy   mean {sv['batch_occupancy_mean']}")
+        if "kv_pool_utilization_max" in sv:
+            out.append("  KV pool     max utilization "
+                       f"{sv['kv_pool_utilization_max']}")
     rec = doc["recovery"]
     if (rec["attempts_failed"] or rec["nonfinite_skips"] or attempts
             or rec["in_graph_skips"] or rec["rollbacks"]):

@@ -43,7 +43,10 @@ import numpy as np
 # v2 (round 8): adds the self-healing record kinds — "anomaly"
 # (in-graph guardrail counters per compiled chunk) and "rollback"
 # (supervisor ladder rungs) — with their own pinned key contracts.
-SCHEMA_VERSION = 2
+# v3 (round 9): adds the "decode" kind — the serving engine's per-cadence
+# throughput/occupancy/KV-pool record (decode/engine.py) with its own
+# pinned required-key contract (DECODE_REQUIRED).
+SCHEMA_VERSION = 3
 
 METRICS_FILENAME = "metrics.jsonl"
 
@@ -67,11 +70,22 @@ ANOMALY_REQUIRED = ("step", "skipped", "loss_scale")
 # rewound to (null when none existed yet).
 ROLLBACK_REQUIRED = ("rung", "resume_step")
 
+# The decode-record contract: keys every "decode" record MUST carry
+# (``tokens_per_sec`` may be null on a record with no throughput delta
+# — the null stance of STEP_KEYS). ``batch_occupancy`` is active slots
+# over max slots; ``kv_pool_utilization`` is allocated non-scratch
+# blocks over usable blocks (decode/engine.py). Same version-bump
+# discipline as STEP_KEYS.
+DECODE_REQUIRED = ("step", "tokens_per_sec", "batch_occupancy",
+                   "kv_pool_utilization")
+
 # Non-step record kinds the stream also carries: run headers ("meta"),
 # recovery/chaos/checkpoint events ("event"), bench measurement rows
 # ("bench" — bench.py's per-measurement plumbing rides the same
-# writer), plus the self-healing kinds ("anomaly", "rollback").
-RECORD_KINDS = ("step", "meta", "event", "bench", "anomaly", "rollback")
+# writer), the self-healing kinds ("anomaly", "rollback"), and the
+# serving engine's "decode" cadence records.
+RECORD_KINDS = ("step", "meta", "event", "bench", "anomaly", "rollback",
+                "decode")
 
 # bf16 peak matmul FLOP/s by chip generation (public spec sheets; the
 # default f32 jnp matmul on TPU lowers to single-pass bf16 MXU ops, so
@@ -273,6 +287,15 @@ class TelemetryWriter:
         rec["kind"] = "rollback"
         self._put(rec)
 
+    def decode(self, record: dict) -> None:
+        """Enqueue one serving-engine cadence record: tokens/s, batch
+        occupancy, KV-pool utilization (``decode/engine.py``;
+        ``DECODE_REQUIRED`` contract)."""
+        rec = dict(record)
+        rec.setdefault("t", time.time())
+        rec["kind"] = "decode"
+        self._put(rec)
+
     def meta(self, record: dict) -> None:
         """Enqueue a run-header record (shapes, strategy, flags, paths
         to sibling logs — the report tool reads these to fold streams)."""
@@ -382,6 +405,10 @@ def validate_record(rec: Any) -> tuple[bool, str]:
         missing = [k for k in ROLLBACK_REQUIRED if k not in rec]
         if missing:
             return False, f"rollback record missing keys {missing}"
+    if kind == "decode":
+        missing = [k for k in DECODE_REQUIRED if k not in rec]
+        if missing:
+            return False, f"decode record missing keys {missing}"
     return True, "ok"
 
 

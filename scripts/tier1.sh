@@ -88,5 +88,35 @@ fi
 rm -rf "$HEAL_DIR"
 echo "SELFHEAL_SMOKE=OK"
 
+echo "=== decode smoke ==="
+# A tiny CPU `generate` run: two staggered prompts through the
+# continuous-batching engine must exit 0 and leave >= 1 schema-valid
+# `decode` record (schema v3, decode/engine.py + runtime/telemetry.py).
+DEC_DIR=$(mktemp -d /tmp/tier1_decode.XXXXXX)
+if ! timeout -k 10 240 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli generate \
+    --prompt_lens 3,7 --max_new 5 -d 32 -l 2 --heads 4 --vocab 64 \
+    --max_seq_len 64 --block_size 8 --prefill_chunk 4 \
+    --metrics_dir "$DEC_DIR/metrics" --log_every 2 > /dev/null; then
+  echo "DECODE_SMOKE=FAIL (run)"; rm -rf "$DEC_DIR"; exit 1
+fi
+if ! timeout -k 10 60 env JAX_PLATFORMS=cpu python - "$DEC_DIR/metrics" <<'EOF'
+import os, sys
+from distributed_llm_code_samples_tpu.runtime.telemetry import (
+    METRICS_FILENAME, read_metrics, validate_record)
+records, problems = read_metrics(
+    os.path.join(sys.argv[1], METRICS_FILENAME))
+assert not problems, problems
+decs = [r for r in records if r["kind"] == "decode"]
+assert decs, "no schema-valid decode record in the smoke stream"
+assert all(validate_record(d)[0] for d in decs)
+assert decs[-1]["tokens_generated"] == 2 * 5, decs[-1]
+EOF
+then
+  echo "DECODE_SMOKE=FAIL (schema)"; rm -rf "$DEC_DIR"; exit 1
+fi
+rm -rf "$DEC_DIR"
+echo "DECODE_SMOKE=OK"
+
 echo "=== tier-1 pytest ==="
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

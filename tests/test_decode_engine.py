@@ -1,0 +1,432 @@
+"""Decode engine: paged-vs-contiguous bit-exactness, continuous-vs-
+sequential token identity, KV quantization bounds, scheduler
+admission/eviction, the recompile-count guard, and the telemetry
+``decode``-record schema contract (ISSUE 4 acceptance criteria).
+
+The proofs are CPU-exact by construction: the paged read gathers blocks
+into exactly the contiguous layout (``models.attention.gather_paged_kv``)
+before the same attention math, masked tail positions contribute exact
+zeros to the softmax, and sampling keys fold ``(seed, uid, position)`` —
+never the slot — so batching composition cannot move a single token.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llm_code_samples_tpu.decode import (DecodeEngine,
+                                                     EngineConfig,
+                                                     gather_layer,
+                                                     init_pool,
+                                                     write_rows)
+from distributed_llm_code_samples_tpu.decode.engine import _buckets
+from distributed_llm_code_samples_tpu.models import generate, init_lm
+
+V, D, L, H = 64, 32, 2, 4
+BASE = dict(block_size=8, n_blocks=33, max_slots=3, max_blocks_per_seq=6,
+            prefill_chunk=8)
+
+
+@pytest.fixture(scope="module")
+def lm_params():
+    return init_lm(jax.random.PRNGKey(0), V, D, L, max_seq_len=64)
+
+
+@pytest.fixture(scope="module")
+def prompts():
+    rng = np.random.default_rng(1)
+    return [rng.integers(0, V, size=n).tolist() for n in (5, 9, 13)]
+
+
+def _sequential(params, cfg_kw, prompts, max_new, heads=H, mesh=None,
+                **cfg_extra):
+    """One-sequence-at-a-time decode: a fresh 1-slot engine per prompt,
+    with the SAME uid each sequence had in the batched run (the sampling
+    contract keys on uid, not slot)."""
+    outs = []
+    for i, p in enumerate(prompts):
+        eng = DecodeEngine(params, heads,
+                           EngineConfig(**{**cfg_kw, "max_slots": 1},
+                                        **cfg_extra), mesh=mesh)
+        eng.submit(p, max_new, uid=i)
+        outs.append(eng.run()[i])
+    return outs
+
+
+# ---------------------------------------------------------------------------
+# paged pool units
+
+
+def test_write_rows_gather_round_trip():
+    pool = init_pool(1, 5, 2, 4, 8, "f32")
+    rng = np.random.default_rng(0)
+    k = jnp.asarray(rng.normal(size=(3, 2, 8)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(3, 2, 8)), jnp.float32)
+    # three rows into logical positions 0..2 of a table [2, 3, scratch]
+    table = jnp.asarray([2, 3, 0, 0], jnp.int32)
+    phys = table[jnp.asarray([0, 0, 0])]  # all in logical block 0
+    off = jnp.asarray([0, 1, 2], jnp.int32)
+    pool = write_rows(pool, 0, phys, off, k, v, "f32")
+    ck, cv = gather_layer(pool, 0, table)
+    assert ck.shape == (2, 16, 8)
+    np.testing.assert_array_equal(np.asarray(ck)[:, :3],
+                                  np.asarray(k).transpose(1, 0, 2))
+    np.testing.assert_array_equal(np.asarray(cv)[:, :3],
+                                  np.asarray(v).transpose(1, 0, 2))
+    # untouched positions stay zero
+    assert not np.asarray(ck)[:, 3:8].any()
+
+
+def test_int8_write_quantization_bound():
+    """Sequential decode-style writes (one row per dispatch, the way the
+    engine writes a block): each valid row stays within the per-(block,
+    head) scale of its f32 source. The bound allows one extra scale of
+    drift: a later write that grows the block's amax re-quantizes
+    earlier rows against the new scale (one more rounding)."""
+    pool = init_pool(1, 3, 2, 4, 8, "int8")
+    rng = np.random.default_rng(2)
+    k = jnp.asarray(rng.normal(size=(4, 2, 8)), jnp.float32)
+    table = jnp.asarray([1, 0], jnp.int32)
+    for i in range(4):
+        pool = write_rows(pool, 0, table[jnp.asarray([0])],
+                          jnp.asarray([i], jnp.int32), k[i:i + 1],
+                          k[i:i + 1], "int8")
+    ck, _ = gather_layer(pool, 0, table)
+    got = np.asarray(ck)[:, :4]                      # [Hkv, 4, dh]
+    want = np.asarray(k).transpose(1, 0, 2)
+    amax = np.abs(want).max(axis=(1, 2))
+    err = np.abs(got - want).max(axis=(1, 2))
+    assert (err <= 2 * amax / 127 + 1e-7).all(), (err, amax / 127)
+
+
+def test_engine_config_validation(lm_params):
+    with pytest.raises(ValueError, match="power of two"):
+        DecodeEngine(lm_params, H, EngineConfig(**{**BASE,
+                                                   "block_size": 6}))
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        DecodeEngine(lm_params, H, EngineConfig(**{**BASE,
+                                                   "prefill_chunk": 6}))
+    with pytest.raises(ValueError, match="temperature"):
+        DecodeEngine(lm_params, H, EngineConfig(**BASE, top_k=3))
+    with pytest.raises(ValueError, match="top_k"):
+        DecodeEngine(lm_params, H,
+                     EngineConfig(**BASE, temperature=1.0, top_k=V + 1))
+    with pytest.raises(ValueError, match="n_blocks"):
+        DecodeEngine(lm_params, H, EngineConfig(**{**BASE,
+                                                   "n_blocks": 1}))
+
+
+def test_submit_validation(lm_params):
+    eng = DecodeEngine(lm_params, H, EngineConfig(**BASE))
+    with pytest.raises(ValueError, match="empty"):
+        eng.submit([], 4)
+    with pytest.raises(ValueError, match="max_new"):
+        eng.submit([1, 2], 0)
+    with pytest.raises(ValueError, match="vocab"):
+        eng.submit([V + 7], 4)
+    with pytest.raises(ValueError, match="capacity"):
+        eng.submit([1] * 40, 20)         # 59 cached positions > 48
+    eng.submit([1, 2], 2, uid=5)
+    with pytest.raises(ValueError, match="already in use"):
+        eng.submit([3, 4], 2, uid=5)                 # duplicate uid
+
+
+def test_submit_accepts_exact_fit(lm_params):
+    """A request that exactly fills its block reservation is servable:
+    the final generated token is returned, never cached, so prompt +
+    max_new - 1 == capacity must be admitted and decode to completion."""
+    eng = DecodeEngine(lm_params, H, EngineConfig(**BASE))   # capacity 48
+    uid = eng.submit([1] * 40, 9)                    # 48 cached positions
+    done = eng.run()
+    assert len(done[uid]) == 49
+
+
+# ---------------------------------------------------------------------------
+# correctness: bit-exactness and token identity (the CPU proofs)
+
+
+def test_paged_bit_identical_to_contiguous_f32(lm_params, prompts):
+    """Acceptance: f32 paged KV must match the contiguous cache
+    bit-for-bit. The contiguous baseline is the same engine with ONE
+    block spanning the whole per-sequence capacity (the block table
+    degenerates to an identity map, i.e. a contiguous cache lane); the
+    paged run chops the same capacity into 8-token blocks. Caches are
+    compared position-by-position mid-run, before any release."""
+    paged = DecodeEngine(lm_params, H, EngineConfig(**BASE))
+    contig = DecodeEngine(lm_params, H, EngineConfig(
+        block_size=64, n_blocks=4, max_slots=3, max_blocks_per_seq=1,
+        prefill_chunk=8))
+    for eng in (paged, contig):
+        for i, p in enumerate(prompts):
+            eng.submit(p, 8, uid=i)
+        for _ in range(7):                       # mid-flight, no release
+            assert eng.step()       # (slot 0 would release at step 8)
+    for slot in range(3):
+        n = int(paged.lengths[slot])
+        assert n == int(contig.lengths[slot]) and n > 0
+        for layer in range(L):
+            pk, pv = gather_layer(paged.pool, layer,
+                                  jnp.asarray(paged.tables[slot]))
+            ck, cv = gather_layer(contig.pool, layer,
+                                  jnp.asarray(contig.tables[slot]))
+            np.testing.assert_array_equal(np.asarray(pk)[:, :n],
+                                          np.asarray(ck)[:, :n])
+            np.testing.assert_array_equal(np.asarray(pv)[:, :n],
+                                          np.asarray(cv)[:, :n])
+    # and the decoded tokens agree token-for-token
+    a = paged.run()
+    b = contig.run()
+    assert a == b
+
+
+def test_continuous_matches_sequential_greedy(lm_params, prompts):
+    """Acceptance: continuous-batching generate over >= 3 prompts with
+    staggered lengths is token-identical to one-sequence-at-a-time
+    decode — including a request admitted mid-flight."""
+    eng = DecodeEngine(lm_params, H, EngineConfig(**BASE))
+    eng.submit(prompts[0], 8, uid=0)
+    eng.submit(prompts[1], 8, uid=1)
+    for _ in range(3):
+        eng.step()                               # two decodes in flight
+    eng.submit(prompts[2], 8, uid=2)             # late arrival
+    batched = eng.run()
+    seq = _sequential(lm_params, BASE, prompts, 8)
+    assert [batched[i] for i in range(3)] == seq
+    # and both equal the lockstep reference decoder per sequence
+    for p, out in zip(prompts, seq):
+        ref = np.asarray(generate(lm_params, jnp.asarray([p]), 8,
+                                  H))[0].tolist()
+        assert out == ref
+
+
+def test_continuous_matches_sequential_sampled(lm_params, prompts):
+    sample_kw = dict(temperature=0.9, top_k=12, top_p=0.9, seed=7)
+    eng = DecodeEngine(lm_params, H, EngineConfig(**BASE, **sample_kw))
+    outs = eng.generate(prompts, 6)
+    seq = _sequential(lm_params, BASE, prompts, 6, **sample_kw)
+    assert outs == seq
+    # a different engine seed draws a different continuation
+    other = DecodeEngine(lm_params, H,
+                         EngineConfig(**BASE, **{**sample_kw,
+                                                 "seed": 8}))
+    assert other.generate(prompts, 6) != outs
+
+
+@pytest.mark.parametrize("kv_dtype", ["bf16", "int8"])
+def test_quantized_kv_tolerance_and_determinism(lm_params, prompts,
+                                                kv_dtype):
+    """bf16/int8 KV: cache values stay within the dtype's bound of the
+    f32 cache (bf16: 8-bit mantissa; int8: per-block scale), and
+    continuous batching remains token-identical to sequential decode —
+    quantization is deterministic per sequence."""
+    f32 = DecodeEngine(lm_params, H, EngineConfig(**BASE))
+    q = DecodeEngine(lm_params, H, EngineConfig(**BASE,
+                                                kv_dtype=kv_dtype))
+    for eng in (f32, q):
+        for i, p in enumerate(prompts):
+            eng.submit(p, 8, uid=i)
+        for _ in range(7):      # slot 0 would release at step 8
+            eng.step()
+    for slot in range(3):
+        n = int(f32.lengths[slot])
+        assert n > 0
+        # LAYER 0's PROMPT rows are cache-independent (projections of
+        # embeddings), so the dtype's own rounding bound applies
+        # exactly there; deeper layers attend over already-quantized
+        # values and the autoregressive feedback compounds, so the
+        # whole-cache check is a loose drift bound, with exactness
+        # delegated to the token-determinism assertions below.
+        n0 = min(n, len(prompts[slot]))
+        fk0, _ = gather_layer(f32.pool, 0, jnp.asarray(f32.tables[slot]))
+        qk0, _ = gather_layer(q.pool, 0, jnp.asarray(q.tables[slot]))
+        want0 = np.asarray(fk0)[:, :n0]
+        got0 = np.asarray(qk0)[:, :n0]
+        if kv_dtype == "bf16":
+            np.testing.assert_allclose(got0, want0, rtol=2 ** -8,
+                                       atol=2 ** -14)
+        else:
+            amax = np.abs(want0).max()
+            assert np.abs(got0 - want0).max() <= 2 * amax / 127
+        for layer in range(L):
+            fk, _ = gather_layer(f32.pool, layer,
+                                 jnp.asarray(f32.tables[slot]))
+            qk, _ = gather_layer(q.pool, layer,
+                                 jnp.asarray(q.tables[slot]))
+            want = np.asarray(fk)[:, :n]
+            got = np.asarray(qk)[:, :n]
+            amax = np.abs(want).max()
+            assert np.abs(got - want).max() <= 0.1 * amax, (
+                kv_dtype, slot, layer)
+    outs = q.run()
+    seq = _sequential(lm_params, BASE, prompts, 8, kv_dtype=kv_dtype)
+    assert [outs[i] for i in range(3)] == seq
+
+
+def test_gqa_and_rope_engine_match_lockstep(prompts):
+    """GQA (2 KV heads) and rotary attention run through the paged
+    engine and stay token-identical to the lockstep decoder."""
+    gqa = init_lm(jax.random.PRNGKey(3), V, D, L, max_seq_len=64,
+                  n_heads=H, n_kv_heads=2)
+    eng = DecodeEngine(gqa, H, EngineConfig(**BASE))
+    assert eng.kv_heads == 2                     # pool shrinks with GQA
+    outs = eng.generate(prompts, 6)
+    for p, out in zip(prompts, outs):
+        ref = np.asarray(generate(gqa, jnp.asarray([p]), 6,
+                                  H))[0].tolist()
+        assert out == ref
+    rope_eng = DecodeEngine(gqa, H, EngineConfig(**BASE, use_rope=True))
+    outs_r = rope_eng.generate(prompts, 6)
+    for p, out in zip(prompts, outs_r):
+        ref = np.asarray(generate(gqa, jnp.asarray([p]), 6, H,
+                                  use_rope=True))[0].tolist()
+        assert out == ref
+
+
+# ---------------------------------------------------------------------------
+# scheduler: admission, eviction, recompile guard
+
+
+def test_admission_waits_for_slots_and_blocks(lm_params):
+    cfg = EngineConfig(block_size=8, n_blocks=7, max_slots=2,
+                       max_blocks_per_seq=3, prefill_chunk=8)
+    eng = DecodeEngine(lm_params, H, cfg)                 # 6 usable blocks
+    for i in range(3):
+        eng.submit([1, 2, 3, 4, 5], 8, uid=i)             # 2 blocks each
+    eng.step()
+    # only two slots: the third request waits even though blocks remain
+    assert eng.active == 2 and len(eng.waiting) == 1
+    assert len(eng.free_blocks) == 2
+    while eng.active == 2 and len(eng.waiting) == 1:
+        eng.step()
+    # a finished sequence freed its slot AND blocks; the waiter admitted
+    assert len(eng.finished) >= 1
+    done = eng.run()
+    assert sorted(done) == [0, 1, 2]
+    # full eviction: every non-scratch block returned, tables scratched
+    assert sorted(eng.free_blocks) == list(range(1, cfg.n_blocks))
+    assert (eng.tables == 0).all()
+    assert eng.active == 0
+
+
+def test_admission_blocked_on_pool_not_slots(lm_params):
+    cfg = EngineConfig(block_size=8, n_blocks=4, max_slots=3,
+                       max_blocks_per_seq=4, prefill_chunk=8)
+    eng = DecodeEngine(lm_params, H, cfg)                 # 3 usable blocks
+    eng.submit([1] * 9, 8, uid=0)             # needs 2 blocks: 1 left
+    eng.submit([1] * 9, 8, uid=1)             # needs 2 > 1 free: waits
+    eng.step()
+    assert eng.active == 1 and len(eng.waiting) == 1
+    done = eng.run()
+    assert sorted(done) == [0, 1]
+
+
+def test_recompile_guard_bounded_by_buckets(lm_params):
+    """Acceptance: steady-state decode steps are dispatch-only — the
+    compiled-program count is bounded by the bucket count and STOPS
+    GROWING once every bucket has been seen, however much more traffic
+    flows (the --log_every chunk discipline applied to serving)."""
+    eng = DecodeEngine(lm_params, H, EngineConfig(**BASE))
+    bound = len(_buckets(BASE["max_slots"])) + len(
+        _buckets(BASE["prefill_chunk"]))
+    rng = np.random.default_rng(5)
+    first = [rng.integers(0, V, size=n).tolist()
+             for n in (1, 2, 3, 5, 8, 13)]
+    eng.generate(first, 5)
+    assert eng.compile_count <= bound, (eng.compile_count, bound)
+    warm = eng.compile_count
+    dispatches = eng.dispatch_count
+    more = [rng.integers(0, V, size=n).tolist() for n in (4, 7, 11, 2)]
+    eng.generate(more, 7)
+    assert eng.compile_count == warm            # zero new compiles
+    assert eng.dispatch_count > dispatches
+
+
+# ---------------------------------------------------------------------------
+# telemetry: the decode-record schema contract
+
+
+def test_decode_records_schema_valid(lm_params, prompts, tmp_path):
+    from distributed_llm_code_samples_tpu.runtime.telemetry import (
+        DECODE_REQUIRED, METRICS_FILENAME, SCHEMA_VERSION,
+        TelemetryWriter, read_metrics, validate_record)
+    mdir = str(tmp_path / "metrics")
+    with TelemetryWriter(mdir, meta={"subcommand": "generate"}) as w:
+        eng = DecodeEngine(lm_params, H, EngineConfig(**BASE))
+        eng.generate(prompts, 8, metrics=w, log_every=2)
+    records, problems = read_metrics(os.path.join(mdir,
+                                                  METRICS_FILENAME))
+    assert problems == []
+    decs = [r for r in records if r["kind"] == "decode"]
+    assert len(decs) >= 2                       # cadence + final record
+    for r in decs:
+        assert r["schema"] == SCHEMA_VERSION
+        for key in DECODE_REQUIRED:
+            assert key in r
+        assert 0.0 <= r["batch_occupancy"] <= 1.0
+        assert 0.0 <= r["kv_pool_utilization"] <= 1.0
+    assert decs[-1]["tokens_generated"] == 3 * 8
+    # the contract rejects a decode record missing a required key
+    bad = {k: v for k, v in decs[0].items()
+           if k != "kv_pool_utilization"}
+    ok, reason = validate_record(bad)
+    assert not ok and "kv_pool_utilization" in reason
+
+
+def test_generate_cli_end_to_end(tmp_path):
+    """The `generate` subcommand end to end in-process: two staggered
+    prompts, metrics stream, schema-valid decode records, rc 0 — the
+    tier1.sh decode smoke's in-suite twin."""
+    import distributed_llm_code_samples_tpu.cli as cli
+    from distributed_llm_code_samples_tpu.runtime.telemetry import (
+        METRICS_FILENAME, read_metrics)
+    mdir = str(tmp_path / "metrics")
+    rc = cli.main(["generate", "--prompt_lens", "3,7", "--max_new", "5",
+                   "-d", "32", "-l", "2", "--heads", "4", "--vocab",
+                   "64", "--max_seq_len", "64", "--block_size", "8",
+                   "--prefill_chunk", "4", "--metrics_dir", mdir,
+                   "--log_every", "2"])
+    assert rc == 0
+    records, problems = read_metrics(os.path.join(mdir,
+                                                  METRICS_FILENAME))
+    assert problems == []
+    assert [r for r in records if r["kind"] == "decode"]
+    assert any(r["kind"] == "meta" and r.get("subcommand") == "generate"
+               for r in records)
+
+
+def test_generate_cli_rejects_bad_flags(capsys):
+    import distributed_llm_code_samples_tpu.cli as cli
+    assert cli.main(["generate", "--max_new", "4"]) == 2      # no prompts
+    assert cli.main(["generate", "--prompts", "1,2", "--prompt_lens",
+                     "3"]) == 2                               # both
+    assert cli.main(["generate", "--prompt_lens", "x"]) == 2
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# TP strategy (Megatron decode layout on the fake mesh)
+
+
+def test_tp_engine_matches_single(lm_params, prompts, mesh_model4):
+    eng = DecodeEngine(lm_params, H, EngineConfig(**BASE),
+                       mesh=mesh_model4)
+    outs = eng.generate(prompts, 6)
+    ref = DecodeEngine(lm_params, H,
+                       EngineConfig(**BASE)).generate(prompts, 6)
+    assert outs == ref
+
+
+def test_tp_engine_sampled_matches_single(lm_params, prompts,
+                                          mesh_model4):
+    """The TP pick gathers the vocab-parallel logits in-graph and folds
+    (seed, uid, position) — never the shard — so sampled TP serving
+    draws the SAME tokens as the single-device engine."""
+    kw = dict(temperature=0.8, top_k=10, top_p=0.95, seed=11)
+    outs = DecodeEngine(lm_params, H, EngineConfig(**BASE, **kw),
+                        mesh=mesh_model4).generate(prompts, 5)
+    ref = DecodeEngine(lm_params, H,
+                       EngineConfig(**BASE, **kw)).generate(prompts, 5)
+    assert outs == ref

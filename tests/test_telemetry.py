@@ -28,29 +28,37 @@ from distributed_llm_code_samples_tpu.runtime.telemetry import (
 
 
 # The pinned (version, key-set) tuples. If you change STEP_KEYS or the
-# anomaly/rollback required sets you MUST bump SCHEMA_VERSION and update
-# these pins in the same commit — that is the version-bump discipline
-# this test enforces. v2 (round 8): the self-healing kinds landed —
+# anomaly/rollback/decode required sets you MUST bump SCHEMA_VERSION and
+# update these pins in the same commit — that is the version-bump
+# discipline this test enforces. v2 (round 8): the self-healing kinds —
 # "anomaly" (in-graph guardrail counters) and "rollback" (ladder rungs).
-_PINNED_VERSION = 2
+# v3 (round 9): the serving kind — "decode" (engine cadence records:
+# throughput, batch occupancy, KV-pool utilization; decode/engine.py).
+_PINNED_VERSION = 3
 _PINNED_STEP_KEYS = frozenset({
     "schema", "kind", "t", "step", "strategy", "loss", "grad_norm",
     "tokens_per_sec", "step_time_s", "mfu", "hbm_high_water_bytes",
 })
 _PINNED_ANOMALY_REQUIRED = frozenset({"step", "skipped", "loss_scale"})
 _PINNED_ROLLBACK_REQUIRED = frozenset({"rung", "resume_step"})
+_PINNED_DECODE_REQUIRED = frozenset({
+    "step", "tokens_per_sec", "batch_occupancy", "kv_pool_utilization",
+})
 
 
 def test_schema_version_bump_discipline():
     from distributed_llm_code_samples_tpu.runtime.telemetry import (
-        ANOMALY_REQUIRED, RECORD_KINDS, ROLLBACK_REQUIRED)
+        ANOMALY_REQUIRED, DECODE_REQUIRED, RECORD_KINDS,
+        ROLLBACK_REQUIRED)
     assert SCHEMA_VERSION == _PINNED_VERSION and \
         frozenset(STEP_KEYS) == _PINNED_STEP_KEYS and \
         frozenset(ANOMALY_REQUIRED) == _PINNED_ANOMALY_REQUIRED and \
-        frozenset(ROLLBACK_REQUIRED) == _PINNED_ROLLBACK_REQUIRED, (
+        frozenset(ROLLBACK_REQUIRED) == _PINNED_ROLLBACK_REQUIRED and \
+        frozenset(DECODE_REQUIRED) == _PINNED_DECODE_REQUIRED, (
             "telemetry record schema changed: bump SCHEMA_VERSION "
             "and update the pinned sets here in the same commit")
     assert "anomaly" in RECORD_KINDS and "rollback" in RECORD_KINDS
+    assert "decode" in RECORD_KINDS
 
 
 def test_step_record_round_trip(tmp_path):
