@@ -185,13 +185,14 @@ def main() -> int:
         prompts = [rng.integers(0, V, size=T0).tolist()
                    for _ in range(2 * B)]
 
-        def run_engine(kv_dtype, n_prompts):
+        def run_engine(kv_dtype, n_prompts, n_blocks=None, policy=None):
             cfg = EngineConfig(
-                block_size=block, n_blocks=1 + B * mbps, max_slots=B,
-                max_blocks_per_seq=mbps, prefill_chunk=min(
-                    block, 1 << (T0.bit_length() - 1)),
+                block_size=block,
+                n_blocks=(1 + B * mbps) if n_blocks is None else n_blocks,
+                max_slots=B, max_blocks_per_seq=mbps,
+                prefill_chunk=min(block, 1 << (T0.bit_length() - 1)),
                 kv_dtype=kv_dtype)
-            eng = DecodeEngine(params, H, cfg)
+            eng = DecodeEngine(params, H, cfg, policy=policy)
             t0 = time.perf_counter()
             eng.generate(prompts[:n_prompts], NEW)
             dt = time.perf_counter() - t0
@@ -216,6 +217,25 @@ def main() -> int:
             "scheduling + per-slot block gathers trade peak lockstep "
             "throughput for admission-between-steps and 1-4x smaller "
             "KV traffic (kv_bytes_per_token_*)")
+
+        # pool-pressure resilience row (round 10): the same 2*B queue
+        # through HALF the block pool with preemption armed — the
+        # scheduler evicts the youngest sequence to keep the head of
+        # line moving and replay-resumes it later (token-identically;
+        # tests/test_decode_reliability.py pins it), so serving stays
+        # live instead of wedging. Reports throughput under pressure
+        # and how many preemption cycles it cost.
+        from distributed_llm_code_samples_tpu.decode import ServePolicy
+        half_seqs = max(2, B // 2)
+        tps, eng = run_engine("f32", 2 * B,
+                              n_blocks=1 + half_seqs * mbps,
+                              policy=ServePolicy(preempt_after_steps=2))
+        paths["engine_pressure_tokens_per_sec"] = round(tps, 1)
+        paths["engine_pressure_preemptions"] = eng.preempted
+        paths["engine_pressure_note"] = (
+            f"2*B prompts through a {half_seqs}-sequence block pool "
+            "(preempt_after_steps=2): throughput cost of eviction + "
+            "replay-resume vs the full-pool engine_f32 row")
 
     if not tp_only and os.environ.get("DECODE_ENGINE", "1") != "0":
         guarded("engine_f32_tokens_per_sec", engine_rows)

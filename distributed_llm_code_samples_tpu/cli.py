@@ -298,11 +298,24 @@ def main(argv=None) -> int:
                   " restarts would desync the cross-strategy verification",
                   file=sys.stderr)
             return 2
-        from .runtime.chaos import FaultPlan
+        from .runtime.chaos import (FaultPlan, IN_SEGMENT_KINDS,
+                                    PUBLISH_KINDS)
         try:
             chaos_plan = FaultPlan.parse(args.chaos)
         except ValueError as e:
             print(f"error: {e}", file=sys.stderr)
+            return 2
+        train_kinds = IN_SEGMENT_KINDS + PUBLISH_KINDS
+        bad_kinds = [f.kind for f in chaos_plan.faults
+                     if f.kind not in train_kinds]
+        if bad_kinds:
+            # a decode fault would silently never fire in a training
+            # run — the same parse-rejection discipline as generate's
+            # validate_decode_plan, pointed the other way
+            print(f"error: --chaos kind(s) {bad_kinds} are decode "
+                  f"faults; the train CLI accepts {train_kinds} (use "
+                  "the generate subcommand for serving faults)",
+                  file=sys.stderr)
             return 2
     if args.guardrails and args.method not in (0, 1, 2, 3, 9, 11):
         # 0/9 sweeps are allowed: the per-method loop arms the guard on

@@ -1,16 +1,28 @@
 """High-throughput decode engine: paged KV cache, continuous batching,
-quantized KV, fused sampling (see ``decode/engine.py`` and DESIGN.md
-section 15)."""
+quantized KV, fused sampling (``decode/engine.py``, DESIGN.md section
+15) — plus the round-10 serving reliability layer: in-graph logits
+quarantine, pool-pressure preemption, snapshot-resume supervision, and
+request-level admission control (``decode/supervise.py``, DESIGN.md
+section 16)."""
 
-from .engine import DecodeEngine, EngineConfig
-from .paged import (KV_DTYPES, PagedKV, SCRATCH_BLOCK, gather_layer,
-                    init_pool, kv_bytes_per_token, write_chunk,
-                    write_rows)
+from .engine import (AdmissionError, DecodeEngine, EngineConfig,
+                     POISON_ALL, POISON_NONE, REQUEST_EVENTS,
+                     ServePolicy)
+from .paged import (KV_DTYPES, PagedKV, SCRATCH_BLOCK, corrupt_block,
+                    gather_layer, init_pool, kv_bytes_per_token,
+                    scrub_blocks, write_chunk, write_rows)
 from .sampling import check_sampling, make_pick
+from .supervise import (SNAPSHOT_FILENAME, load_snapshot,
+                        restore_engine_state, snapshot_state,
+                        supervise_decode, write_snapshot)
 
 __all__ = [
-    "DecodeEngine", "EngineConfig",
-    "KV_DTYPES", "PagedKV", "SCRATCH_BLOCK", "gather_layer", "init_pool",
-    "kv_bytes_per_token", "write_chunk", "write_rows",
+    "AdmissionError", "DecodeEngine", "EngineConfig", "POISON_ALL",
+    "POISON_NONE", "REQUEST_EVENTS", "ServePolicy",
+    "KV_DTYPES", "PagedKV", "SCRATCH_BLOCK", "corrupt_block",
+    "gather_layer", "init_pool", "kv_bytes_per_token", "scrub_blocks",
+    "write_chunk", "write_rows",
     "check_sampling", "make_pick",
+    "SNAPSHOT_FILENAME", "load_snapshot", "restore_engine_state",
+    "snapshot_state", "supervise_decode", "write_snapshot",
 ]

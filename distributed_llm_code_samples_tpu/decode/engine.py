@@ -36,6 +36,32 @@ Determinism contract: a sequence's output depends only on
 assignment, admission order, chunk interleaving, or pool layout
 (tests/test_decode_engine.py pins paged==contiguous bit-for-bit at f32
 and continuous==sequential token-for-token).
+
+Reliability layer (round 10, DESIGN.md section 16 — the serving
+counterpart of the self-healing training ladder):
+
+- **In-graph logits guardrail**: every compiled step returns a per-row
+  all-finite flag over the full-vocab logits
+  (``runtime.guardrails.rows_finite``) next to the picks; a non-finite
+  sequence is **quarantined** at that step — slot and blocks freed
+  (blocks scrubbed: NaN stale bytes are the one thing the masks can't
+  neutralize), uid reported FAILED with a reason, every other sequence
+  untouched. Because the sampling keys and the per-slot gathers never
+  reference the slot, survivors are bit-identical to a run that never
+  admitted the poisoned request.
+- **Per-request retry**: a quarantined request with budget left
+  (``ServePolicy.max_retries``) re-enters the queue and is replayed —
+  prompt re-prefilled, already-emitted tokens teacher-forced through
+  the decode path so the KV write history (and hence the int8
+  quantization history) is bit-identical to the uninterrupted run's.
+  The same replay mechanism serves **preemption** (pool-pressure
+  eviction of the youngest sequence back to WAITING) and the
+  supervisor's **snapshot-resume** (``decode/supervise.py``).
+- **Admission control**: bounded waiting queue (``queue_limit``,
+  reject-on-full with ``AdmissionError``), per-request TTL
+  (``deadline_steps``), and lifecycle telemetry — one schema-v4
+  ``request`` record per transition (admitted / preempted / retried /
+  quarantined / completed / rejected / expired).
 """
 
 from __future__ import annotations
@@ -53,9 +79,28 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..models.attention import chunk_attn, rope
 from ..models.lm import LMParams, decode_attn
 from ..ops.norm import layernorm
-from .paged import (PagedKV, SCRATCH_BLOCK, gather_layer, init_pool,
-                    write_chunk, write_rows)
+from ..runtime.guardrails import rows_finite
+from .paged import (PagedKV, SCRATCH_BLOCK, corrupt_block as
+                    _pool_corrupt_block, gather_layer, init_pool,
+                    scrub_blocks, write_chunk, write_rows)
 from .sampling import check_sampling, make_pick
+
+# poison operand values for the compiled steps (chaos nan_logits
+# injection rides a runtime operand, so arming a fault never recompiles)
+POISON_NONE = -1
+POISON_ALL = -2
+
+# the request-record event vocabulary (telemetry schema v4 ``request``
+# kind; runtime/telemetry.py REQUEST_REQUIRED pins the KEY set, this
+# names the transitions)
+REQUEST_EVENTS = ("admitted", "preempted", "retried", "quarantined",
+                  "completed", "rejected", "expired")
+
+
+class AdmissionError(RuntimeError):
+    """A request was shed at submit time (bounded queue full) — the
+    serving 503, distinct from the ValueError family (malformed
+    requests) so callers can tell load shedding from bad input."""
 
 
 def _buckets(limit: int) -> tuple[int, ...]:
@@ -102,23 +147,80 @@ class EngineConfig:
         return self.max_blocks_per_seq * self.block_size
 
 
+@dataclass(frozen=True)
+class ServePolicy:
+    """Host-side scheduling/reliability knobs — unlike ``EngineConfig``
+    these never touch a compiled program, so any policy mix shares the
+    same program set. All zeros (the default) reproduce the round-9
+    engine exactly.
+
+    - ``queue_limit``: bounded waiting queue; a submit past it raises
+      ``AdmissionError`` (reject-on-full, the serving 503). 0 = off.
+    - ``deadline_steps``: per-request TTL in engine steps from submit;
+      an unfinished request past it is failed with reason
+      ``deadline`` (waiting OR running — queue time counts). 0 = off.
+    - ``max_retries``: per-request budget for re-queuing a QUARANTINED
+      request (replayed from its prompt + already-emitted tokens);
+      budget exhausted -> reported FAILED. 0 = fail on first fault.
+    - ``preempt_after_steps``: pool-pressure preemption — when the
+      head-of-line request has a free slot but not its block
+      reservation for this many consecutive steps, the YOUNGEST running
+      sequence is evicted back to WAITING (resumed later, token-
+      identically, via replay). Two guards bound the churn: the wait
+      threshold is hysteresis (each eviction is preceded by that many
+      steps of decode), and the LAST running sequence is never evicted
+      — so the oldest resident always makes live progress and every
+      request eventually completes. 0 = off (strict reserve-on-admit
+      FCFS)."""
+
+    queue_limit: int = 0
+    deadline_steps: int = 0
+    max_retries: int = 0
+    preempt_after_steps: int = 0
+
+    def __post_init__(self):
+        for name in ("queue_limit", "deadline_steps", "max_retries",
+                     "preempt_after_steps"):
+            if getattr(self, name) < 0:
+                raise ValueError(f"{name} must be >= 0, got "
+                                 f"{getattr(self, name)}")
+
+
 @dataclasses.dataclass
 class _Seq:
-    """Host-side per-sequence record (the scheduler's unit of state)."""
+    """Host-side per-sequence record (the scheduler's unit of state).
+
+    ``emitted`` counts the ``out`` tokens already fed through the decode
+    path since the last (re)admission. ``emitted < len(out)`` is the
+    REPLAY state (after a retry / preemption / snapshot-resume): the
+    prompt re-prefills, then each recorded token is teacher-forced
+    through the decode step — the picks are discarded but the KV write
+    history is bit-identical to the uninterrupted run's, which is what
+    makes resume token-identical at every kv_dtype (int8 included: the
+    quantization history is the write history)."""
     uid: int
     prompt: list[int]
     max_new: int
     out: list[int] = field(default_factory=list)
     prefilled: int = 0
     blocks: list[int] = field(default_factory=list)
+    emitted: int = 0
+    retries: int = 0
+    submit_step: int = 0
+    admit_index: int = -1
+    t_submit: float = field(default_factory=time.time)
 
     @property
     def prompt_done(self) -> bool:
         return self.prefilled >= len(self.prompt)
 
     @property
+    def replaying(self) -> bool:
+        return self.emitted < len(self.out)
+
+    @property
     def finished(self) -> bool:
-        return len(self.out) >= self.max_new
+        return len(self.out) >= self.max_new and not self.replaying
 
 
 class DecodeEngine:
@@ -129,7 +231,8 @@ class DecodeEngine:
     design; DESIGN.md section 15 for the state machine."""
 
     def __init__(self, params: LMParams, n_heads: int,
-                 config: EngineConfig | None = None, mesh=None):
+                 config: EngineConfig | None = None, mesh=None,
+                 policy: ServePolicy | None = None, metrics=None):
         cfg = config or EngineConfig()
         if cfg.block_size & (cfg.block_size - 1):
             raise ValueError(f"block_size must be a power of two, got "
@@ -170,6 +273,8 @@ class DecodeEngine:
         self.slots: list[_Seq | None] = [None] * s
         self.waiting: collections.deque[_Seq] = collections.deque()
         self.finished: dict[int, list[int]] = {}
+        self.failed: dict[int, dict] = {}     # uid -> {reason, retries}
+        self.prompt_lens: dict[int, int] = {}  # uid -> len(prompt)
         self.free_blocks = list(range(1, cfg.n_blocks))
         self.slot_buckets = _buckets(cfg.max_slots)
         self.chunk_buckets = _buckets(cfg.prefill_chunk)
@@ -177,9 +282,27 @@ class DecodeEngine:
         self.compile_count = 0       # program builds (recompile guard)
         self.dispatch_count = 0
         self.steps = 0
+        self.step_base = 0        # snapshot-resume offset (global step)
         self.tokens_generated = 0
         self._occ_sum = 0.0
         self._next_uid = 0
+        self.policy = policy or ServePolicy()
+        self.metrics = metrics           # TelemetryWriter (or None)
+        # host-side audit ring (the durable trail is the telemetry
+        # stream; this is for in-process inspection, bounded so a
+        # long-lived engine can't grow it without limit)
+        self.request_events: collections.deque[dict] = \
+            collections.deque(maxlen=4096)
+        self._corrupted: set[int] = set()   # chaos-poisoned block ids
+        self.quarantined = 0
+        self.retried = 0
+        self.preempted = 0
+        self.rejected = 0
+        self.expired = 0
+        self._admit_counter = 0     # admission order (preempt youngest)
+        self._head_blocked = 0      # head-of-line pool-starved streak
+        self._head_blocked_uid: int | None = None  # whose streak it is
+        self._poison_uid = POISON_NONE   # armed for the NEXT step only
 
     # -- pool ----------------------------------------------------------
 
@@ -295,20 +418,26 @@ class DecodeEngine:
         return jax.jit(jax.shard_map(
             run, mesh=self.mesh,
             in_specs=(tp_decode_specs(), self._pool_specs(), P(), P(),
-                      P(), P()),
-            out_specs=(self._pool_specs(), P()), check_vma=False),
+                      P(), P(), P()),
+            out_specs=(self._pool_specs(), P(), P()), check_vma=False),
             donate_argnums=(1,))
 
     def _build_decode(self, b: int):
         """One decode step for a ``b``-slot bucket: write each slot's
         input token at its own position, attend over its gathered
-        blocks, pick the next token in-graph."""
+        blocks, pick the next token in-graph — and return each row's
+        all-finite logits flag (the serving guardrail: a poisoned
+        sequence is detected the step it happens, on the same readback
+        as the picks). ``poison`` is the chaos nan_logits operand: a
+        uid (or POISON_ALL) whose row's logits are NaN'd in-graph;
+        POISON_NONE leaves every row bit-identical (a false ``where``
+        selects the original value)."""
         cfg = self.cfg
         pick = make_pick(cfg.temperature, cfg.top_k, cfg.top_p,
                          self.params.vocab, cfg.seed)
 
         def run(p: LMParams, pool: PagedKV, tables, lengths, tokens,
-                uids):
+                uids, poison):
             x = self._embed(p, tokens, lengths)             # [b, d]
             slot_phys = lengths // cfg.block_size
             off = lengths % cfg.block_size
@@ -323,7 +452,11 @@ class DecodeEngine:
 
             pool, x = self._trunk(p, pool, x, lengths, write_attn)
             logits = self._logits(p, layernorm(p.ln_f, x))
-            return pool, pick(logits, uids, lengths + 1)
+            bad = jnp.logical_or(uids == poison, poison == POISON_ALL)
+            logits = jnp.where(bad[:, None],
+                               jnp.asarray(jnp.nan, logits.dtype), logits)
+            return pool, pick(logits, uids, lengths + 1), \
+                rows_finite(logits)
 
         return self._jit(run)
 
@@ -337,7 +470,8 @@ class DecodeEngine:
         pick = make_pick(cfg.temperature, cfg.top_k, cfg.top_p,
                          self.params.vocab, cfg.seed)
 
-        def run(p: LMParams, pool: PagedKV, table, pos0, tokens, uid):
+        def run(p: LMParams, pool: PagedKV, table, pos0, tokens, uid,
+                poison):
             positions = pos0 + jnp.arange(c)
             x = self._embed(p, tokens, positions)           # [c, d]
 
@@ -351,8 +485,11 @@ class DecodeEngine:
             pool, x = self._trunk(p, pool, x, positions, write_attn)
             h = layernorm(p.ln_f, x[-1:])                   # last row
             logits = self._logits(p, h)
+            bad = jnp.logical_or(uid == poison, poison == POISON_ALL)
+            logits = jnp.where(bad,
+                               jnp.asarray(jnp.nan, logits.dtype), logits)
             nxt = pick(logits, uid[None], (pos0 + c)[None])
-            return pool, nxt[0]
+            return pool, nxt[0], rows_finite(logits)[0]
 
         return self._jit(run)
 
@@ -387,36 +524,149 @@ class DecodeEngine:
         if self._blocks_needed(len(prompt), max_new) > self.cfg.n_blocks - 1:
             raise ValueError("request needs more blocks than the pool "
                              f"holds ({self.cfg.n_blocks - 1} usable)")
-        if uid is None:
+        auto_uid = uid is None
+        if auto_uid:
             uid = self._next_uid
-        elif (uid in self.finished
+        elif uid < 0:
+            # negative uids collide with the poison operand sentinels
+            # (POISON_NONE/POISON_ALL): uid -1 would match the idle
+            # poison comparison and be NaN'd every step
+            raise ValueError(f"uid must be >= 0, got {uid}")
+        elif (uid in self.finished or uid in self.failed
               or any(s is not None and s.uid == uid for s in self.slots)
               or any(s.uid == uid for s in self.waiting)):
             # a duplicate uid would sample in lockstep with its twin
             # (the key folds the uid) and overwrite its finished entry
             raise ValueError(f"uid {uid} already in use")
+        if (self.policy.queue_limit
+                and len(self.waiting) >= self.policy.queue_limit):
+            # reject-on-full: shed load at the door instead of growing
+            # an unbounded queue every waiter times out in. An
+            # auto-assigned uid is NOT consumed (_next_uid only
+            # advances on acceptance) — so its rejected record carries
+            # uid -1, not a number a LATER accepted request will reuse
+            # (aliasing two requests in the per-uid audit trail)
+            self.rejected += 1
+            self._event("rejected", -1 if auto_uid else uid,
+                        reason="queue_full",
+                        queue_len=len(self.waiting))
+            raise AdmissionError(
+                f"waiting queue full ({len(self.waiting)} >= "
+                f"queue_limit {self.policy.queue_limit}); request "
+                f"uid {uid} shed")
         self._next_uid = max(self._next_uid, uid) + 1
-        self.waiting.append(_Seq(uid=uid, prompt=prompt, max_new=max_new))
+        self.prompt_lens[uid] = len(prompt)
+        self.waiting.append(_Seq(uid=uid, prompt=prompt, max_new=max_new,
+                                 submit_step=self.global_step))
         return uid
+
+    def resume_request(self, uid: int, prompt, max_new: int, out=(),
+                       retries: int = 0, t_submit=None,
+                       submit_step=None) -> int:
+        """Re-enter a request from an engine snapshot
+        (``decode/supervise.py``): queued for replay-resume — prompt
+        re-prefilled, recorded ``out`` tokens teacher-forced, then live
+        generation continues token-identically (the sampling keys fold
+        ``(seed, uid, position)``, never the slot or the crash).
+        Bypasses ``queue_limit`` (the request was admitted once — a
+        crash must not shed it)."""
+        prompt = [int(t) for t in prompt]
+        out = [int(t) for t in out]
+        if uid < 0:
+            raise ValueError(f"uid must be >= 0, got {uid}")
+        if uid in self.finished or uid in self.failed \
+                or any(s is not None and s.uid == uid for s in self.slots) \
+                or any(s.uid == uid for s in self.waiting):
+            raise ValueError(f"uid {uid} already in use")
+        seq = _Seq(uid=int(uid), prompt=prompt, max_new=int(max_new),
+                   out=out, retries=int(retries),
+                   submit_step=(self.global_step if submit_step is None
+                                else int(submit_step)))
+        if t_submit is not None:
+            seq.t_submit = float(t_submit)
+        self._next_uid = max(self._next_uid, int(uid)) + 1
+        self.prompt_lens[seq.uid] = len(prompt)
+        self.waiting.append(seq)
+        return seq.uid
 
     def _blocks_needed(self, t0: int, max_new: int) -> int:
         # the final generated token is returned, never cached
         positions = t0 + max_new - 1
         return -(-positions // self.cfg.block_size)
 
+    # -- request lifecycle (telemetry schema v4 `request` records) -----
+
+    @property
+    def global_step(self) -> int:
+        """Engine steps across crash-resumes: ``step_base`` (the
+        snapshot step a resumed engine continues from) + in-process
+        steps — the index chaos schedules and request records use."""
+        return self.step_base + self.steps
+
+    def _event(self, event: str, uid: int, reason: str | None = None,
+               **extra) -> None:
+        rec = {"step": self.global_step, "uid": int(uid),
+               "event": event, "reason": reason, **extra}
+        self.request_events.append(rec)
+        if self.metrics is not None:
+            self.metrics.request(rec)
+
+    def arm_poison(self, uid: int) -> None:
+        """Arm the chaos nan_logits operand for the NEXT engine step:
+        ``uid``'s logits row (every row for ``POISON_ALL``) comes out
+        NaN, in-graph, zero recompiles. Consumed by that step."""
+        self._poison_uid = int(uid)
+
+    def corrupt_block(self, block: int) -> None:
+        """Chaos ``corrupt_block``: poison one physical pool block
+        (``paged.corrupt_block`` — NaN values, or NaN scales under
+        int8) host-side between steps. The id is tracked so ANY
+        release of the block (not just quarantine — a preemption or
+        deadline expiry can evict the owner before its next dispatch
+        flags the NaN) scrubs it instead of handing the poison to an
+        innocent successor."""
+        self.pool = _pool_corrupt_block(self.pool, block)
+        self._corrupted.add(int(block))
+
+    # -- scheduler (continued) -----------------------------------------
+
     def _admit(self) -> int:
         """FCFS admission: move waiting requests into free slots while
         both a slot and the request's full block reservation are
-        available (reserve-on-admit keeps serving preemption-free). A
-        head-of-line request that doesn't fit blocks the queue — strict
-        FCFS keeps admission deterministic."""
+        available (reserve-on-admit keeps steady-state serving
+        preemption-free). A head-of-line request that doesn't fit
+        blocks the queue — strict FCFS keeps admission deterministic.
+        With ``policy.preempt_after_steps > 0``, a head-of-line request
+        that has been pool-starved (free slot, not enough free blocks)
+        for that many consecutive steps evicts the YOUNGEST running
+        sequence back to WAITING (replay resumes it token-identically
+        later); the wait threshold is the anti-thrash hysteresis."""
         admitted = 0
+        bumped = False
         while self.waiting:
             seq = self.waiting[0]
             need = self._blocks_needed(len(seq.prompt), seq.max_new)
             free_slots = [i for i, s in enumerate(self.slots) if s is None]
-            if not free_slots or need > len(self.free_blocks):
+            if not free_slots:
                 break
+            if need > len(self.free_blocks):
+                pa = self.policy.preempt_after_steps
+                if pa > 0:
+                    if self._head_blocked_uid != seq.uid:
+                        # the streak belongs to ONE head: a new head
+                        # (old one admitted/expired/shed) must earn its
+                        # own hysteresis, not inherit the old streak
+                        self._head_blocked = 0
+                        self._head_blocked_uid = seq.uid
+                    if not bumped:      # one streak tick per step
+                        self._head_blocked += 1
+                        bumped = True
+                    if (self._head_blocked >= pa
+                            and self._preempt_youngest()):
+                        continue        # blocks freed: re-check the head
+                break
+            self._head_blocked = 0
+            self._head_blocked_uid = None
             self.waiting.popleft()
             slot = free_slots[0]
             seq.blocks = [self.free_blocks.pop(0) for _ in range(need)]
@@ -427,18 +677,165 @@ class DecodeEngine:
             self.lengths[slot] = 0
             self.uids[slot] = seq.uid
             self.slots[slot] = seq
+            seq.admit_index = self._admit_counter
+            self._admit_counter += 1
+            self._event("admitted", seq.uid,
+                        wait_steps=self.global_step - seq.submit_step,
+                        replay=len(seq.out))
             admitted += 1
         return admitted
 
-    def _release(self, slot: int) -> None:
+    def _evict(self, slot: int) -> _Seq:
+        """Take a sequence off its slot and return its blocks to the
+        pool (shared tail of release/quarantine/preempt/expire). Blocks
+        the chaos layer marked corrupted are scrubbed on the way out —
+        an eviction that precedes the owner's next dispatch would
+        otherwise hand the NaN to whoever reserves the block next."""
         seq = self.slots[slot]
-        self.finished[seq.uid] = seq.prompt + seq.out
+        bad = [b for b in seq.blocks if b in self._corrupted]
+        if bad:
+            self.pool = scrub_blocks(self.pool, bad)
+            self._corrupted.difference_update(bad)
         self.free_blocks.extend(seq.blocks)
+        seq.blocks = []
         self.tables[slot] = SCRATCH_BLOCK
         self.lengths[slot] = 0
         self.next_token[slot] = 0
         self.uids[slot] = 0
         self.slots[slot] = None
+        return seq
+
+    def _release(self, slot: int) -> None:
+        seq = self.slots[slot]
+        self.finished[seq.uid] = seq.prompt + seq.out
+        self._event("completed", seq.uid,
+                    latency_s=round(time.time() - seq.t_submit, 4),
+                    n_new=len(seq.out), retries=seq.retries)
+        self._evict(slot)
+
+    def _requeue(self, seq: _Seq) -> None:
+        """Send a live sequence back to WAITING for replay-resume:
+        prefill restarts from zero, recorded ``out`` tokens will be
+        teacher-forced (``_Seq.emitted``). ``submit_step`` is
+        deliberately NOT reset: the deadline TTL measures from the
+        ORIGINAL submission, so preemption/retry churn cannot extend a
+        request's life past its deadline."""
+        seq.prefilled = 0
+        seq.emitted = 0
+        self.waiting.append(seq)
+
+    def _preempt_youngest(self) -> bool:
+        """Evict the most recently admitted running sequence back to
+        WAITING (pool-pressure preemption). Never evicts the LAST
+        running sequence: with >= 2 residents the oldest is never the
+        victim and always makes live progress (termination guarantee);
+        evicting a lone resident would hand out replay-only windows in
+        which a long sequence never advances — the one true livelock
+        shape, excluded by construction. Returns False when no eviction
+        is allowed (the head then waits for a completion)."""
+        victims = [(s.admit_index, i) for i, s in enumerate(self.slots)
+                   if s is not None]
+        if len(victims) < 2:
+            return False
+        _, slot = max(victims)
+        seq = self._evict(slot)
+        self.preempted += 1
+        self._event("preempted", seq.uid, reason="pool_pressure",
+                    n_out=len(seq.out))
+        self._requeue(seq)
+        self._head_blocked = 0
+        return True
+
+    def _quarantine(self, slot: int, reason: str) -> None:
+        """The guardrail's remedy: free exactly this sequence's slot and
+        blocks — SCRUBBED, because a poisoned cache may hold NaN/Inf
+        the masks cannot neutralize — and either retry (budget left:
+        re-queue for replay-resume; the fault's garbage pick was never
+        appended, so the retried request re-generates that token
+        cleanly) or report the uid FAILED with the reason. Every other
+        running sequence is untouched: per-slot gathers and
+        (seed, uid, position) sampling keys make survivors bit-identical
+        to a run that never admitted this request."""
+        seq = self.slots[slot]
+        blocks = list(seq.blocks)
+        self._evict(slot)
+        # scrub the owned blocks AND the shared scratch block: every
+        # table pads with SCRATCH_BLOCK, so a corrupted scratch poisons
+        # every gather (0*nan==nan) — scrubbing it here is what turns
+        # "scratch corrupted" into one quarantine wave + clean retries
+        # instead of a permanent all-requests failure. Scratch is
+        # semantically all-zeros (only pad writes land there, always
+        # masked), so the scrub is always safe.
+        self.pool = scrub_blocks(self.pool, blocks + [SCRATCH_BLOCK])
+        self._corrupted.difference_update(blocks + [SCRATCH_BLOCK])
+        self.quarantined += 1
+        if seq.retries < self.policy.max_retries:
+            seq.retries += 1
+            self.retried += 1
+            self._event("quarantined", seq.uid, reason=reason,
+                        retrying=True)
+            self._event("retried", seq.uid, reason=reason,
+                        attempt=seq.retries,
+                        max_retries=self.policy.max_retries)
+            self._requeue(seq)
+            return
+        self._event("quarantined", seq.uid, reason=reason,
+                    retrying=False, retries=seq.retries)
+        self.failed[seq.uid] = {"reason": reason, "retries": seq.retries,
+                                "n_out": len(seq.out)}
+
+    def _expire_deadlines(self) -> None:
+        """Per-request TTL: fail any request (waiting or running) still
+        unfinished ``deadline_steps`` engine steps after submission —
+        graceful degradation under overload beats unbounded tail
+        latency. Runs before admission so an expired waiter never
+        takes a slot."""
+        dl = self.policy.deadline_steps
+        if dl <= 0:
+            return
+
+        def expire(seq: _Seq) -> None:
+            # the one place the deadline record/entry shape is built —
+            # waiting and running expiries cannot fork
+            self.expired += 1
+            self._event("expired", seq.uid, reason="deadline",
+                        n_out=len(seq.out))
+            self.failed[seq.uid] = {"reason": "deadline",
+                                    "retries": seq.retries,
+                                    "n_out": len(seq.out)}
+
+        def overdue(seq: _Seq) -> bool:
+            return self.global_step - seq.submit_step >= dl
+
+        for slot, seq in enumerate(self.slots):
+            if seq is not None and overdue(seq):
+                self._evict(slot)
+                expire(seq)
+        if any(overdue(seq) for seq in self.waiting):
+            keep = collections.deque()
+            for seq in self.waiting:
+                if overdue(seq):
+                    expire(seq)
+                else:
+                    keep.append(seq)
+            self.waiting = keep
+
+    def _emit(self, slot: int, pick: int) -> None:
+        """Fold one picked token into a slot: the live path appends the
+        pick; the REPLAY path discards it and teacher-forces the
+        recorded token instead (the picks match bit-for-bit on a
+        healthy replay — forcing just removes the need to assume it)."""
+        seq = self.slots[slot]
+        if seq.replaying:
+            tok = seq.out[seq.emitted]
+        else:
+            tok = pick
+            seq.out.append(tok)
+            self.tokens_generated += 1
+        seq.emitted += 1
+        self.next_token[slot] = tok
+        if seq.finished:
+            self._release(slot)
 
     def _prefill_step(self, slot: int) -> None:
         seq = self.slots[slot]
@@ -450,20 +847,19 @@ class DecodeEngine:
         fn = self._program("prefill", c)
         chunk = np.asarray(seq.prompt[seq.prefilled:seq.prefilled + c],
                            np.int32)
-        pool, nxt = fn(self.params, self.pool,
-                       jnp.asarray(self.tables[slot]),
-                       jnp.int32(seq.prefilled), jnp.asarray(chunk),
-                       jnp.int32(seq.uid))
+        pool, nxt, ok = fn(self.params, self.pool,
+                           jnp.asarray(self.tables[slot]),
+                           jnp.int32(seq.prefilled), jnp.asarray(chunk),
+                           jnp.int32(seq.uid),
+                           jnp.int32(self._poison_uid))
         self.pool = pool
+        if not bool(ok):
+            self._quarantine(slot, "nonfinite_logits")
+            return
         seq.prefilled += c
         if seq.prompt_done:
             self.lengths[slot] = len(seq.prompt)
-            tok = int(nxt)
-            seq.out.append(tok)
-            self.next_token[slot] = tok
-            self.tokens_generated += 1
-            if seq.finished:
-                self._release(slot)
+            self._emit(slot, int(nxt))
 
     def _decode_step(self, ready: list[int]) -> None:
         b = _bucket_for(len(ready), self.slot_buckets)
@@ -478,26 +874,28 @@ class DecodeEngine:
             tokens[j] = 0
             uids[j] = 0
         fn = self._program("decode", b)
-        pool, picks = fn(self.params, self.pool, jnp.asarray(tables),
-                         jnp.asarray(lengths), jnp.asarray(tokens),
-                         jnp.asarray(uids))
+        pool, picks, ok = fn(self.params, self.pool, jnp.asarray(tables),
+                             jnp.asarray(lengths), jnp.asarray(tokens),
+                             jnp.asarray(uids),
+                             jnp.int32(self._poison_uid))
         self.pool = pool
         picks = np.asarray(picks)
+        ok = np.asarray(ok)
         for j, slot in enumerate(ready):
-            seq = self.slots[slot]
-            tok = int(picks[j])
-            seq.out.append(tok)
+            if not bool(ok[j]):      # pad rows are never in `ready`
+                self._quarantine(slot, "nonfinite_logits")
+                continue
             self.lengths[slot] += 1
-            self.next_token[slot] = tok
-            self.tokens_generated += 1
-            if seq.finished:
-                self._release(slot)
+            self._emit(slot, int(picks[j]))
 
     def step(self) -> bool:
-        """One scheduler iteration: admit, at most ONE prefill chunk
+        """One scheduler iteration: expire deadlines, admit (with
+        pool-pressure preemption when armed), at most ONE prefill chunk
         (so a long prompt never stalls running decodes for more than a
         chunk), then one decode dispatch over every ready slot. Returns
-        whether any work ran."""
+        whether any work ran. An armed chaos poison operand applies to
+        exactly this step's dispatches."""
+        self._expire_deadlines()
         self._admit()
         did = False
         pre = next((i for i, s in enumerate(self.slots)
@@ -512,6 +910,7 @@ class DecodeEngine:
             did = True
         if did:
             self.steps += 1
+            self._poison_uid = POISON_NONE      # one-step fault window
             active = sum(s is not None for s in self.slots)
             self._occ_sum += active / self.cfg.max_slots
         return did
@@ -528,10 +927,11 @@ class DecodeEngine:
         return (usable - len(self.free_blocks)) / usable
 
     def telemetry_record(self, tokens_per_sec=None) -> dict:
-        """One schema-v3 ``decode`` record (``runtime/telemetry.py``
-        ``DECODE_REQUIRED`` contract)."""
+        """One schema-v4 ``decode`` record (``runtime/telemetry.py``
+        ``DECODE_REQUIRED`` contract; the reliability counters ride as
+        extra keys)."""
         return {
-            "step": self.steps,
+            "step": self.global_step,
             "tokens_per_sec": tokens_per_sec,
             "batch_occupancy": round(self.active / self.cfg.max_slots, 4),
             "kv_pool_utilization": round(self.kv_pool_utilization(), 4),
@@ -540,21 +940,50 @@ class DecodeEngine:
             "tokens_generated": self.tokens_generated,
             "kv_dtype": self.cfg.kv_dtype,
             "compiled_programs": self.compile_count,
+            "quarantined": self.quarantined,
+            "retried": self.retried,
+            "preempted": self.preempted,
+            "rejected": self.rejected,
+            "expired": self.expired,
         }
 
-    def run(self, metrics=None, log_every: int = 0) -> dict[int, list[int]]:
-        """Drain the queue: step until every submitted sequence
-        finished. ``metrics`` is a ``TelemetryWriter``; one ``decode``
-        record lands every ``log_every`` engine steps (0 = final only),
-        with throughput measured between records (host wall clock,
-        device-synced by the per-step readback of the picks)."""
+    def run(self, metrics=None, log_every: int = 0, before_step=None,
+            after_step=None) -> dict[int, list[int]]:
+        """Drain the queue: step until every submitted sequence finished
+        (or failed). ``metrics`` is a ``TelemetryWriter`` (defaults to
+        the constructor's — request lifecycle records flow there either
+        way); one ``decode`` record lands every ``log_every`` engine
+        steps (0 = final only), with throughput measured between records
+        (host wall clock, device-synced by the per-step readback of the
+        picks). ``before_step(next_local_step)`` /
+        ``after_step(local_step)`` are the supervisor's hooks
+        (``decode/supervise.py``): chaos injection before, watchdog +
+        snapshot + kill after — hook exceptions propagate (the
+        supervisor's restart ladder owns them)."""
+        if metrics is not None:
+            self.metrics = metrics
+        metrics = self.metrics
         last_t = time.perf_counter()
         last_tokens = self.tokens_generated
         last_step = self.steps
         while self.waiting or self.active:
+            if before_step is not None:
+                before_step(self.steps + 1)
             if not self.step():
-                raise RuntimeError("decode engine stalled: waiting "
-                                   "requests but no admissible work")
+                # a step may legitimately run no compiled work when it
+                # only expired/failed requests — re-check the loop
+                # condition before calling it a stall. The after_step
+                # hook still fires so the supervisor's final snapshot
+                # reflects the expiries (a stale snapshot would resume
+                # the dead uids and double-count their records).
+                if self.waiting or self.active:
+                    raise RuntimeError("decode engine stalled: waiting "
+                                       "requests but no admissible work")
+                if after_step is not None:
+                    after_step(self.steps)
+                break
+            if after_step is not None:
+                after_step(self.steps)
             if (metrics is not None and log_every > 0
                     and self.steps - last_step >= log_every):
                 now = time.perf_counter()
@@ -573,9 +1002,19 @@ class DecodeEngine:
         return dict(self.finished)
 
     def generate(self, prompts, max_new: int, metrics=None,
-                 log_every: int = 0) -> list[list[int]]:
+                 log_every: int = 0) -> list[list[int] | None]:
         """Convenience batch API: submit every prompt, drain, return
-        full token lists in submission order."""
-        uids = [self.submit(p, max_new) for p in prompts]
+        full token lists in submission order. A request that FAILED
+        terminally (quarantine budget exhausted, deadline expiry)
+        yields ``None`` in its position — the reason is in
+        ``self.failed[uid]`` — and so does one SHED at the door by
+        ``queue_limit`` (the ``rejected`` counter/event records it);
+        malformed prompts still raise ``ValueError``."""
+        uids = []
+        for p in prompts:
+            try:
+                uids.append(self.submit(p, max_new))
+            except AdmissionError:
+                uids.append(None)
         done = self.run(metrics=metrics, log_every=log_every)
-        return [done[u] for u in uids]
+        return [None if u is None else done.get(u) for u in uids]

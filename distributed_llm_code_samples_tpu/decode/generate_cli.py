@@ -7,12 +7,30 @@ checkpoint plumbing), prompts are either explicit token-id lists
 (``--prompts "3,1,4;9,2"``) or deterministic random draws
 (``--prompt_lens 5,9,13`` with ``--prompt_seed``), and the run prints
 ONE JSON line with every sequence's tokens plus the engine's
-throughput/occupancy stats. ``--metrics_dir`` streams schema-v3
-``decode`` records through the unified telemetry writer
-(``runtime/telemetry.py``) — ``report`` folds them like any other run.
+throughput/occupancy/reliability stats. ``--metrics_dir`` streams
+schema-v4 ``decode`` + ``request`` records through the unified
+telemetry writer (``runtime/telemetry.py``) — ``report`` folds them
+like any other run.
 
 ``--tp N`` runs the Megatron decode layout over an N-way model-axis
 mesh (``--fake_devices`` makes that work on CPU, as everywhere else).
+
+Reliability flags (round 10, DESIGN.md section 16):
+
+- ``--snapshot_dir`` runs under the engine supervisor
+  (``decode/supervise.py``): per-step atomic snapshots, in-process
+  restart ladder, and automatic resume — re-running the same command
+  after a crash continues from the snapshot, token-identically.
+- ``--chaos SPEC`` injects the decode fault grammar
+  (``nan_logits@STEP[:UID]``, ``hang_step@STEP[:SECS]``,
+  ``corrupt_block@STEP:BLOCK``, ``kill@STEP``; ``runtime/chaos.py``).
+  Requires ``--snapshot_dir`` — recovery resumes from snapshots, the
+  train CLI's ``--chaos``/``--checkpoint_dir`` coupling.
+- ``--max_retries`` / ``--deadline_steps`` / ``--queue_limit`` /
+  ``--preempt_after`` set the engine's ``ServePolicy`` (quarantine
+  retry budget, per-request TTL, reject-on-full admission,
+  pool-pressure preemption). Bad values are rejected cleanly (rc 2),
+  the train CLI's parse-rejection discipline.
 """
 
 from __future__ import annotations
@@ -74,6 +92,44 @@ def build_generate_parser() -> argparse.ArgumentParser:
                    help="model-axis size for the Megatron decode layout "
                         "(1 = single-device)")
     p.add_argument("--fake_devices", type=int, default=0)
+    # reliability (decode/supervise.py + engine ServePolicy)
+    p.add_argument("--snapshot_dir", default=None,
+                   help="run under the engine supervisor: per-step "
+                        "atomic snapshots + automatic crash-resume "
+                        "(re-run the same command to continue)")
+    p.add_argument("--chaos", default=None, metavar="SPEC",
+                   help="deterministic decode fault injection "
+                        "(runtime/chaos.py): comma-separated "
+                        "KIND@STEP[:ARG] with KIND in nan_logits/"
+                        "hang_step/corrupt_block/kill; requires "
+                        "--snapshot_dir")
+    p.add_argument("--max_retries", type=int, default=0,
+                   help="per-request retry budget for quarantined "
+                        "sequences (replay-resumed; 0 = fail on first "
+                        "fault)")
+    p.add_argument("--deadline_steps", type=int, default=0,
+                   help="per-request TTL in engine steps from submit "
+                        "(0 = none); expired requests are failed with "
+                        "reason 'deadline'")
+    p.add_argument("--queue_limit", type=int, default=0,
+                   help="bounded waiting queue: submissions past it are "
+                        "shed (rejected, not an error; 0 = unbounded)")
+    p.add_argument("--preempt_after", type=int, default=0,
+                   help="pool-pressure preemption: a head-of-line "
+                        "request starved of blocks for N steps evicts "
+                        "the youngest running sequence (0 = off)")
+    p.add_argument("--snapshot_every", type=int, default=1,
+                   help="engine-step cadence of the atomic snapshot "
+                        "(1 = every step, maximum recoverability; "
+                        "raise it to amortize the host-side "
+                        "json+fsync on throughput-critical serving — "
+                        "resume is equally correct from an older "
+                        "snapshot, it just replays more)")
+    p.add_argument("--watchdog_ms", type=int, default=0,
+                   help="hung-step watchdog deadline (0 = off); latches "
+                        "hung_step evidence in the attempt log")
+    p.add_argument("--max_restarts", type=int, default=3,
+                   help="in-process restart budget for the supervisor")
     # observability
     p.add_argument("--metrics_dir", default=None)
     p.add_argument("--log_every", type=int, default=4,
@@ -97,7 +153,8 @@ def generate_main(argv=None) -> int:
     import numpy as np
 
     from ..models import init_lm
-    from .engine import DecodeEngine, EngineConfig
+    from .engine import AdmissionError, DecodeEngine, EngineConfig, \
+        ServePolicy
 
     if (args.prompts is None) == (args.prompt_lens is None):
         print("error: pass exactly one of --prompts / --prompt_lens",
@@ -127,6 +184,38 @@ def generate_main(argv=None) -> int:
               file=sys.stderr)
         return 2
 
+    chaos_plan = None
+    if args.chaos:
+        if not args.snapshot_dir:
+            print("error: --chaos requires --snapshot_dir (recovery "
+                  "resumes from engine snapshots)", file=sys.stderr)
+            return 2
+        from ..runtime.chaos import FaultPlan, validate_decode_plan
+        try:
+            chaos_plan = FaultPlan.parse(args.chaos)
+            validate_decode_plan(chaos_plan)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+    if args.watchdog_ms and not args.snapshot_dir:
+        print("error: --watchdog_ms runs inside the supervisor: pass "
+              "--snapshot_dir", file=sys.stderr)
+        return 2
+    if args.snapshot_every < 1:
+        print(f"error: --snapshot_every must be >= 1, got "
+              f"{args.snapshot_every}", file=sys.stderr)
+        return 2
+    # the supervisor-only flags reject consistently instead of some
+    # silently no-opping: a user who set them expects supervision
+    if args.snapshot_every != 1 and not args.snapshot_dir:
+        print("error: --snapshot_every is the supervisor's snapshot "
+              "cadence: pass --snapshot_dir", file=sys.stderr)
+        return 2
+    if args.max_restarts != 3 and not args.snapshot_dir:
+        print("error: --max_restarts is the supervisor's restart "
+              "budget: pass --snapshot_dir", file=sys.stderr)
+        return 2
+
     longest = max(len(pr) for pr in prompts)
     mbps = args.max_blocks_per_seq or -(
         -min(args.max_seq_len, longest + args.max_new) // args.block_size)
@@ -139,6 +228,11 @@ def generate_main(argv=None) -> int:
             temperature=args.temperature, top_k=args.top_k,
             top_p=args.top_p, seed=args.sample_seed,
             use_rope=args.use_rope)
+        policy = ServePolicy(
+            queue_limit=args.queue_limit,
+            deadline_steps=args.deadline_steps,
+            max_retries=args.max_retries,
+            preempt_after_steps=args.preempt_after)
         params = init_lm(jax.random.PRNGKey(args.random_seed),
                          args.vocab, args.model_size, args.layers,
                          max_seq_len=args.max_seq_len,
@@ -157,45 +251,112 @@ def generate_main(argv=None) -> int:
                       "--fake_devices on CPU)", file=sys.stderr)
             if tp > 1:
                 mesh = make_mesh({MODEL_AXIS: tp})
-        engine = DecodeEngine(params, args.heads, cfg, mesh=mesh)
-        uids = [engine.submit(pr, args.max_new) for pr in prompts]
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
 
+    if chaos_plan is not None:
+        # the pool size is known here: a block id typo must reject rc 2
+        # instead of burning the supervisor's whole restart ladder on a
+        # deterministic ValueError at fire time
+        for f in chaos_plan.faults:
+            if f.kind == "corrupt_block" and int(f.arg) >= cfg.n_blocks:
+                print(f"error: corrupt_block block {int(f.arg)} outside "
+                      f"the pool ({cfg.n_blocks} block(s) incl. "
+                      "scratch)", file=sys.stderr)
+                return 2
+
     metrics = None
     if args.metrics_dir:
         from ..runtime.telemetry import TelemetryWriter
-        metrics = TelemetryWriter(args.metrics_dir, meta={
+        meta = {
             "argv": list(argv or []), "subcommand": "generate",
             "vocab": args.vocab, "model_size": args.model_size,
             "layers": args.layers, "heads": args.heads,
             "kv_dtype": args.kv_dtype, "max_slots": args.max_slots,
             "block_size": args.block_size, "tp": tp,
             "n_prompts": len(prompts), "max_new": args.max_new,
-            "device_kind": jax.devices()[0].device_kind})
+            "device_kind": jax.devices()[0].device_kind}
+        if args.snapshot_dir:
+            meta["snapshot_dir"] = args.snapshot_dir
+            meta["attempt_log"] = os.path.join(
+                args.snapshot_dir, "serve_supervise.jsonl")
+        metrics = TelemetryWriter(args.metrics_dir, meta=meta)
 
+    mesh_kw = dict(mesh=mesh, policy=policy)
+    shed = 0
+    prior_tokens = 0
+    resumed_from = None
     t0 = time.perf_counter()
-    done = engine.run(metrics=metrics, log_every=args.log_every)
+    try:
+        if args.snapshot_dir:
+            from .supervise import load_snapshot, supervise_decode
+            snap = load_snapshot(args.snapshot_dir)
+            if snap is not None:
+                resumed_from = int(snap["step"])
+                prior_tokens = int(
+                    snap["counters"]["tokens_generated"])
+                print(f"generate: resuming from snapshot step "
+                      f"{resumed_from} in {args.snapshot_dir} (prompt "
+                      "flags ignored — the snapshot is authoritative)",
+                      file=sys.stderr)
+            engine = supervise_decode(
+                lambda: DecodeEngine(params, args.heads, cfg, **mesh_kw),
+                [(pr, args.max_new) for pr in prompts],
+                snapshot_dir=args.snapshot_dir, chaos=chaos_plan,
+                watchdog_ms=args.watchdog_ms, metrics=metrics,
+                log_every=args.log_every,
+                snapshot_every=args.snapshot_every,
+                max_restarts=args.max_restarts)
+            shed = engine.rejected
+        else:
+            engine = DecodeEngine(params, args.heads, cfg,
+                                  metrics=metrics, **mesh_kw)
+            for pr in prompts:
+                try:
+                    engine.submit(pr, args.max_new)
+                except AdmissionError:
+                    shed += 1       # recorded as a `rejected` event
+            engine.run(metrics=metrics, log_every=args.log_every)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        if metrics is not None:
+            metrics.close()
+        return 2
     wall = time.perf_counter() - t0
     if metrics is not None:
         metrics.close()
 
+    new_tokens = engine.tokens_generated - prior_tokens
+    sequences = []
+    for u, toks in sorted(engine.finished.items()):
+        # prompt_len from the engine's own per-uid record (snapshot-
+        # persisted): immune to shed submissions skewing uid/index
+        # alignment and to a resume invoked with different flags
+        sequences.append({"uid": u, "tokens": toks,
+                          "prompt_len": engine.prompt_lens.get(u)})
     payload = {
-        "sequences": [
-            {"uid": u, "prompt_len": len(pr),
-             "tokens": done[u]}
-            for u, pr in zip(uids, prompts)],
+        "sequences": sequences,
+        "failed": {str(u): info
+                   for u, info in sorted(engine.failed.items())},
         "tokens_generated": engine.tokens_generated,
         "wall_s": round(wall, 4),
-        "tokens_per_sec": round(engine.tokens_generated / wall, 2),
-        "engine_steps": engine.steps,
+        "tokens_per_sec": round(new_tokens / wall, 2),
+        "engine_steps": engine.global_step,
         "mean_occupancy": round(engine.mean_occupancy(), 4),
         "compiled_programs": engine.compile_count,
         "dispatches": engine.dispatch_count,
         "kv_dtype": args.kv_dtype,
         "tp": tp,
+        "quarantined": engine.quarantined,
+        "retried": engine.retried,
+        "preempted": engine.preempted,
+        "rejected": engine.rejected,
+        "expired": engine.expired,
+        "shed": shed,
     }
+    if resumed_from is not None:
+        payload["resumed_from_step"] = resumed_from
     print(json.dumps(payload))
     return 0
 

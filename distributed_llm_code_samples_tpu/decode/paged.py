@@ -236,6 +236,55 @@ def _int8_partial_chunk(pool: PagedKV, layer: int, phys, off: jax.Array,
     return PagedKV(k=k, v=v, k_scale=ks, v_scale=vs)
 
 
+def scrub_blocks(pool: PagedKV, blocks) -> PagedKV:
+    """Zero the named physical blocks (values AND int8 scales) —
+    factory-fresh state, as if never written. The engine runs this when
+    a QUARANTINED sequence releases its blocks: a poisoned cache may
+    hold NaN/Inf, and a non-finite stale byte is the one thing the
+    length/causal mask cannot neutralize (``0.0 * nan == nan`` inside
+    the attention ``p @ v`` reduction — finite stale bytes contribute
+    exact zeros, non-finite ones poison the whole row). Scrubbing also
+    restores the int8 invariant that a block's bytes are a pure
+    function of its own sequence's write history, so a retried request
+    re-quantizes against the same zero state an uninterrupted run saw.
+    Normal releases (finished/preempted sequences) skip the scrub —
+    their stale bytes are finite and masked-exact — except for blocks
+    the chaos layer marked corrupted, which the engine scrubs on ANY
+    release (an eviction can precede the dispatch that would have
+    flagged the NaN)."""
+    blocks = jnp.asarray(blocks, jnp.int32)
+    z = jnp.zeros((), pool.k.dtype)
+    out = pool._replace(k=pool.k.at[:, blocks].set(z),
+                        v=pool.v.at[:, blocks].set(z))
+    if pool.k_scale is not None:
+        out = out._replace(k_scale=pool.k_scale.at[:, blocks].set(0.0),
+                          v_scale=pool.v_scale.at[:, blocks].set(0.0))
+    return out
+
+
+def corrupt_block(pool: PagedKV, block: int) -> PagedKV:
+    """Chaos injection (``corrupt_block@s:block``): poison one physical
+    block the way a flipped HBM page would — NaN values for the float
+    dtypes; NaN per-block SCALES under int8 (int8 codes have no NaN, so
+    corruption surfaces through the dequantize multiply). Any sequence
+    whose table names the block reads NaN through its gather and fails
+    the per-row logits guardrail at its next step — masked positions
+    offer no shelter (``0.0 * nan == nan`` in the attention ``p @ v``
+    reduction, the same arithmetic ``scrub_blocks`` exists for). A
+    corrupted FREE block is caught by the next request that reserves
+    it: quarantined once, scrubbed on release, clean on retry."""
+    if not 0 <= block < pool.n_blocks:
+        raise ValueError(f"block {block} outside pool "
+                         f"[0, {pool.n_blocks})")
+    if pool.k_scale is not None:
+        bad = jnp.asarray(jnp.nan, jnp.float32)
+        return pool._replace(k_scale=pool.k_scale.at[:, block].set(bad),
+                             v_scale=pool.v_scale.at[:, block].set(bad))
+    bad = jnp.asarray(jnp.nan, pool.k.dtype)
+    return pool._replace(k=pool.k.at[:, block].set(bad),
+                         v=pool.v.at[:, block].set(bad))
+
+
 def gather_layer(pool: PagedKV, layer: int, table: jax.Array):
     """One sequence's dequantized contiguous KV view for one layer:
     ``table [max_blocks]`` -> ``(k, v)`` each ``[H_kv, T_cap, dh]`` f32

@@ -128,6 +128,15 @@ def _describe_event(rec: dict) -> str:
     if ev == "chaos_corrupt_ckpt":
         return (f"CHAOS: checkpoint corruption injected at "
                 f"step {rec.get('step')}")
+    if ev == "hung_step":
+        return (f"HUNG STEP @ engine step {rec.get('step')} — watchdog "
+                f"{rec.get('watchdog_ms')}ms expired")
+    if ev == "resumed":
+        return (f"RESUMED from engine snapshot step {rec.get('step')} "
+                f"({rec.get('live_requests')} live request(s), "
+                f"{rec.get('finished')} already finished)")
+    if ev == "chaos_kill":
+        return f"CHAOS: SIGKILL after engine snapshot step {rec.get('step')}"
     return f"{ev}: " + ", ".join(
         f"{k}={v}" for k, v in rec.items()
         if k not in ("event", "t", "kind", "schema"))
@@ -173,6 +182,24 @@ def report_main(argv=None) -> int:
     anomalies = [r for r in records if r["kind"] == "anomaly"]
     rollbacks = [r for r in records if r["kind"] == "rollback"]
     decodes = [r for r in records if r["kind"] == "decode"]
+    # request records: drop exact replays — an in-process supervisor
+    # restart resumes from a snapshot that may PREDATE records already
+    # emitted, so the replayed steps re-emit identical (uid, event,
+    # step) transitions (the global step is stable across restarts).
+    # Legitimate repeats — a re-admission after preemption, a second
+    # quarantine — land at different global steps; anonymous rejected
+    # records (uid -1) are kept verbatim (distinct sheds can share a
+    # step). Same stance as the attempt-log dedup below.
+    requests = []
+    seen_req = set()
+    for r in records:
+        if r["kind"] != "request":
+            continue
+        key = (r.get("uid"), r.get("event"), r.get("step"))
+        if r.get("event") != "rejected" and key in seen_req:
+            continue
+        seen_req.add(key)
+        requests.append(r)
 
     # attempt log: flag wins; else the newest meta that names one
     attempt_path = args.attempt_log
@@ -266,6 +293,41 @@ def report_main(argv=None) -> int:
                                                        4)
         doc["serving"] = serving
 
+    # ---- serving reliability (request lifecycle records) ------------
+    if requests:
+        by_event: dict[str, int] = {}
+        for r in requests:
+            by_event[r["event"]] = by_event.get(r["event"], 0) + 1
+        rel = {
+            "records": len(requests),
+            "admitted": by_event.get("admitted", 0),
+            "completed": by_event.get("completed", 0),
+            "quarantined": by_event.get("quarantined", 0),
+            "retried": by_event.get("retried", 0),
+            "preempted": by_event.get("preempted", 0),
+            # shed = load the system refused or gave up on (admission
+            # rejects + deadline expiries) — the graceful-degradation
+            # counter
+            "shed": (by_event.get("rejected", 0)
+                     + by_event.get("expired", 0)),
+            "rejected": by_event.get("rejected", 0),
+            "expired": by_event.get("expired", 0),
+            "failed_uids": sorted({
+                r["uid"] for r in requests
+                if (r["event"] == "expired"
+                    or (r["event"] == "quarantined"
+                        and not r.get("retrying")))}),
+        }
+        lat = [r["latency_s"] for r in requests
+               if r["event"] == "completed"
+               and r.get("latency_s") is not None]
+        if lat:
+            q = np.percentile(np.asarray(lat, np.float64), [50, 90, 99])
+            rel["latency_p50_s"] = round(float(q[0]), 4)
+            rel["latency_p90_s"] = round(float(q[1]), 4)
+            rel["latency_p99_s"] = round(float(q[2]), 4)
+        doc["serving_reliability"] = rel
+
     # ---- recovery / chaos summary -----------------------------------
     fails = [a for a in attempts if a.get("event") == "attempt_failed"]
     doc["recovery"] = {
@@ -308,6 +370,24 @@ def report_main(argv=None) -> int:
         if d.get("waiting"):
             bits.append(f"{d['waiting']} waiting")
         timeline.append((d["t"], "decode", "  ".join(bits)))
+    for r in requests:
+        ev = r["event"]
+        bits = [f"request {r.get('uid')} {ev.upper()}"
+                + (f" ({r['reason']})" if r.get("reason") else "")
+                + f" @ engine step {r.get('step')}"]
+        if ev == "completed":
+            if r.get("latency_s") is not None:
+                bits.append(f"latency {r['latency_s']:.3f}s")
+            if r.get("n_new") is not None:
+                bits.append(f"{r['n_new']} token(s)")
+            if r.get("retries"):
+                bits.append(f"{r['retries']} retry(ies)")
+        elif ev == "retried":
+            bits.append(f"attempt {r.get('attempt')}/"
+                        f"{r.get('max_retries')}")
+        elif ev == "quarantined" and not r.get("retrying"):
+            bits.append("FAILED")
+        timeline.append((r["t"], "request", "  ".join(bits)))
     for a in attempts:
         # supervise forwards checkpoint-layer events to its log too;
         # drop exact duplicates of what the metrics stream already has
@@ -390,6 +470,23 @@ def report_main(argv=None) -> int:
         if "kv_pool_utilization_max" in sv:
             out.append("  KV pool     max utilization "
                        f"{sv['kv_pool_utilization_max']}")
+    if "serving_reliability" in doc:
+        rl = doc["serving_reliability"]
+        out.append("")
+        out.append(f"serving reliability: {rl['admitted']} admission(s), "
+                   f"{rl['completed']} completed, "
+                   f"{rl['quarantined']} quarantine(s), "
+                   f"{rl['retried']} retry(ies), "
+                   f"{rl['preempted']} preemption(s), "
+                   f"{rl['shed']} shed "
+                   f"({rl['rejected']} rejected / {rl['expired']} "
+                   "expired)")
+        if rl.get("failed_uids"):
+            out.append(f"  FAILED uids: {rl['failed_uids']}")
+        if "latency_p50_s" in rl:
+            out.append(f"  request latency  p50 {rl['latency_p50_s']}s  "
+                       f"p90 {rl['latency_p90_s']}s  "
+                       f"p99 {rl['latency_p99_s']}s")
     rec = doc["recovery"]
     if (rec["attempts_failed"] or rec["nonfinite_skips"] or attempts
             or rec["in_graph_skips"] or rec["rollbacks"]):

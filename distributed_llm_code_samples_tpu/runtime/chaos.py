@@ -18,6 +18,7 @@ dirs use), parsed from the CLI ``--chaos`` spec grammar::
     fault := KIND "@" STEP (":" ARG)?
     KIND  := nan_grad | inf_grad | loss_spike | slow_step | hang
            | kill | corrupt_ckpt
+           | nan_logits | hang_step | corrupt_block      # decode faults
 
 - ``nan_grad@s`` / ``inf_grad@s`` — step ``s`` trains on a poisoned
   (NaN/Inf) upstream gradient. With in-graph guardrails armed
@@ -56,6 +57,33 @@ In-segment faults (nan/inf/hang) fire once per process; publish faults
 (kill/corrupt) fire once per publish of their step. ``seed`` feeds an
 internal RNG reserved for randomized plans; the default plan is fully
 deterministic so test oracles can be exact.
+
+**Decode faults** (round 10 — the serving engine, ``decode/``). Steps
+are GLOBAL 1-based engine-step indices (``step_base + engine.steps``,
+the index the serving snapshot records), consumed by the engine
+supervisor (``decode/supervise.py``) around each ``DecodeEngine.step``:
+
+- ``nan_logits@s[:uid]`` — step ``s`` computes non-finite logits for
+  the sequence with uid ``uid`` (every active sequence when omitted),
+  injected IN-GRAPH through the compiled step's poison operand — the
+  per-row logits guardrail must quarantine exactly that sequence.
+- ``hang_step@s[:secs]`` — engine step ``s`` stalls ``secs`` (default
+  0.25) before dispatch: the supervisor's hung-step watchdog must latch.
+- ``corrupt_block@s:block`` — physical KV-pool block ``block`` is
+  poisoned (NaN values — or NaN scales under int8) before step ``s``,
+  simulating an HBM/DMA corruption. The sequence whose table names the
+  block reads NaN through its gather (masked positions included —
+  ``0 * nan`` is ``nan`` inside the attention reduction), fails the
+  logits guardrail, and is quarantined; its blocks are SCRUBBED on
+  release (``paged.scrub_blocks``), so a retry observes a
+  factory-fresh pool. A corrupted free block is caught by the next
+  request that reserves it — quarantined once, scrubbed, clean on
+  retry.
+- ``kill@s`` — SIGKILL right AFTER the engine snapshot for step ``s``
+  is persisted (the crash-between-steps failure mode). As with the
+  training-side kill, keying on the snapshot boundary makes the fault
+  deterministic across restarts: a resumed run starts past ``s`` and
+  never re-fires it (``mark_decode_fired_through``).
 """
 
 from __future__ import annotations
@@ -72,7 +100,11 @@ import jax.numpy as jnp
 IN_SEGMENT_KINDS = ("nan_grad", "inf_grad", "loss_spike", "slow_step",
                     "hang")
 PUBLISH_KINDS = ("corrupt_ckpt", "kill")
-KINDS = IN_SEGMENT_KINDS + PUBLISH_KINDS
+# serving-engine faults (kill is shared: publish boundary in training,
+# snapshot boundary in serving — decode/supervise.py)
+DECODE_KINDS = ("nan_logits", "hang_step", "corrupt_block", "kill")
+KINDS = IN_SEGMENT_KINDS + PUBLISH_KINDS + tuple(
+    k for k in DECODE_KINDS if k not in PUBLISH_KINDS)
 
 
 @dataclass
@@ -211,6 +243,30 @@ class FaultPlan:
 
         return chaotic
 
+    # ---------------------------------------------- decode integration
+    def decode_due(self, step: int) -> list:
+        """Unfired decode faults scheduled for GLOBAL engine step
+        ``step`` (the supervisor fires and ``_note``s them itself —
+        injection mechanics live in ``decode/supervise.py``)."""
+        return [f for f in self.faults
+                if f.kind in DECODE_KINDS and not f.fired
+                and f.step == step]
+
+    def mark_decode_fired_through(self, step: int) -> None:
+        """Resume bookkeeping: align every decode fault's fired flag
+        with a resume from engine snapshot ``step`` — faults at or
+        before it already happened (a freshly-parsed plan must not
+        re-fire them: the decode twin of kill's keyed-on-publish
+        determinism), and faults AFTER it must fire again on replay
+        (an in-process restart restores a snapshot that may predate a
+        fault it already injected once — leaving it marked fired would
+        silently skip it on the replayed step, diverging from both the
+        pre-crash history and a fresh-process resume). The events
+        audit trail keeps the original firing either way."""
+        for f in self.faults:
+            if f.kind in DECODE_KINDS:
+                f.fired = f.step <= step
+
     # ---------------------------------------------- publish integration
     def after_publish(self, step: int, path: str) -> None:
         """Fire publish-boundary faults for ``step`` on its freshly
@@ -227,6 +283,43 @@ class FaultPlan:
             elif f.kind == "kill":
                 self._note(f, path=path)
                 os.kill(os.getpid(), signal.SIGKILL)
+
+
+def validate_decode_plan(plan: FaultPlan) -> None:
+    """Reject a ``--chaos`` spec the SERVING path cannot honor: training
+    faults have no decode-step anchor, ``corrupt_block`` needs its
+    ``:BLOCK`` id, and uid/block args must be non-negative integers —
+    the generate CLI's parse-rejection discipline (mirrors the train
+    CLI's ``--chaos`` guards)."""
+    for f in plan.faults:
+        if f.kind not in DECODE_KINDS:
+            raise ValueError(
+                f"--chaos kind {f.kind!r} is a training fault; the "
+                f"decode engine accepts {DECODE_KINDS}")
+        if f.kind == "corrupt_block":
+            if f.arg is None:
+                raise ValueError(
+                    "corrupt_block requires :BLOCK (the physical pool "
+                    "block id to poison), e.g. corrupt_block@3:2")
+            if f.arg != int(f.arg) or f.arg < 0:
+                raise ValueError(
+                    f"corrupt_block arg {f.arg!r} must be a "
+                    "non-negative integer block id")
+        if f.kind == "nan_logits" and f.arg is not None and (
+                f.arg != int(f.arg) or f.arg < 0):
+            raise ValueError(
+                f"nan_logits arg {f.arg!r} must be a non-negative "
+                "integer sequence uid (omit it to poison every "
+                "active sequence)")
+        if f.kind == "hang_step" and f.arg is not None and f.arg < 0:
+            raise ValueError(
+                f"hang_step arg {f.arg!r} must be a non-negative "
+                "sleep in seconds")
+        if f.kind == "kill" and f.arg is not None:
+            raise ValueError(
+                f"kill takes no :ARG (got {f.arg!r}) — it SIGKILLs "
+                "after the step's snapshot; did you mean "
+                "corrupt_block@STEP:BLOCK?")
 
 
 def truncate_checkpoint(path: str, frac: float = 0.5) -> str:

@@ -43,6 +43,18 @@ def _head(exc: BaseException) -> str:
     return f"{type(exc).__name__}: {exc}".splitlines()[0][:300]
 
 
+def backoff_delay(attempt: int, base_s: float, max_s: float,
+                  jitter: float, rng: random.Random) -> float:
+    """Jittered exponential backoff: ``base_s * 2^attempt`` capped at
+    ``max_s``, scaled by ``uniform(1-j, 1+j)`` from the caller's seeded
+    RNG — deterministic in tests, thundering-herd-safe in fleets.
+    Shared by the training supervisor below and the serving supervisor
+    (``decode/supervise.py``) so the two restart ladders cannot drift
+    on the schedule."""
+    b = min(base_s * (2 ** attempt), max_s)
+    return b * (1.0 + jitter * (2.0 * rng.random() - 1.0))
+
+
 class HealthCheckError(RuntimeError):
     """A device failed the liveness probe."""
 
@@ -269,8 +281,8 @@ def supervise(train_fn: Callable, params, seeds, *args,
             if attempt == max_restarts:
                 log(record)
                 break  # exhausted: no restart follows, skip the probes
-            backoff = min(backoff_base_s * (2 ** attempt), backoff_max_s)
-            backoff *= 1.0 + backoff_jitter * (2.0 * rng.random() - 1.0)
+            backoff = backoff_delay(attempt, backoff_base_s,
+                                    backoff_max_s, backoff_jitter, rng)
             record["backoff_s"] = round(backoff, 3)
             log(record)
             if on_failure is not None:

@@ -118,6 +118,19 @@ def finite_flag(tree: Any) -> jax.Array:
     return ok
 
 
+def rows_finite(logits: jax.Array) -> jax.Array:
+    """Per-ROW all-finite flag over a logits block ``[..., V] -> [...]``
+    — the serving twin of ``finite_flag``: the decode engine computes it
+    inside every compiled step (``decode/engine.py``) so a poisoned
+    sequence is detected at the step it happens, per sequence, with
+    zero extra host round-trips (the flag rides the same readback as
+    the sampled picks). Under TP the flag is computed on the gathered
+    full-vocab logits, which are replicated — every shard sees the
+    same verdict by construction, the in-graph-skip psum stance
+    without needing the psum."""
+    return jnp.all(jnp.isfinite(logits), axis=-1)
+
+
 def advance(cfg: GuardrailConfig, state: GuardState,
             ok: jax.Array) -> GuardState:
     """Fold one step's finite flag into the guard state: count the skip

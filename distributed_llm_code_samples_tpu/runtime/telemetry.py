@@ -46,7 +46,11 @@ import numpy as np
 # v3 (round 9): adds the "decode" kind — the serving engine's per-cadence
 # throughput/occupancy/KV-pool record (decode/engine.py) with its own
 # pinned required-key contract (DECODE_REQUIRED).
-SCHEMA_VERSION = 3
+# v4 (round 10): adds the "request" kind — one record per serving
+# request lifecycle transition (admitted / preempted / retried /
+# quarantined / completed / rejected / expired, decode/engine.py) with
+# its own pinned required-key contract (REQUEST_REQUIRED).
+SCHEMA_VERSION = 4
 
 METRICS_FILENAME = "metrics.jsonl"
 
@@ -79,13 +83,25 @@ ROLLBACK_REQUIRED = ("rung", "resume_step")
 DECODE_REQUIRED = ("step", "tokens_per_sec", "batch_occupancy",
                    "kv_pool_utilization")
 
+# The request-record contract: one record per serving-request lifecycle
+# transition (``decode/engine.py``). ``step`` is the GLOBAL engine step
+# (snapshot ``step_base`` + in-process steps — stable across
+# crash-resume), ``uid`` the request's sequence uid, ``event`` the
+# transition (admitted / preempted / retried / quarantined / completed
+# / rejected / expired), ``reason`` why (null where the transition
+# needs none — e.g. admitted). Completed records additionally carry
+# ``latency_s`` (submit -> finish wall clock; the report tool's
+# per-request latency percentiles read it). Same version-bump
+# discipline as STEP_KEYS.
+REQUEST_REQUIRED = ("step", "uid", "event", "reason")
+
 # Non-step record kinds the stream also carries: run headers ("meta"),
 # recovery/chaos/checkpoint events ("event"), bench measurement rows
 # ("bench" — bench.py's per-measurement plumbing rides the same
 # writer), the self-healing kinds ("anomaly", "rollback"), and the
-# serving engine's "decode" cadence records.
+# serving engine's "decode" cadence + "request" lifecycle records.
 RECORD_KINDS = ("step", "meta", "event", "bench", "anomaly", "rollback",
-                "decode")
+                "decode", "request")
 
 # bf16 peak matmul FLOP/s by chip generation (public spec sheets; the
 # default f32 jnp matmul on TPU lowers to single-pass bf16 MXU ops, so
@@ -296,6 +312,16 @@ class TelemetryWriter:
         rec["kind"] = "decode"
         self._put(rec)
 
+    def request(self, record: dict) -> None:
+        """Enqueue one serving-request lifecycle record: admitted /
+        preempted / retried / quarantined / completed / rejected /
+        expired (``decode/engine.py``; ``REQUEST_REQUIRED`` contract)."""
+        rec = dict(record)
+        rec.setdefault("t", time.time())
+        rec.setdefault("reason", None)
+        rec["kind"] = "request"
+        self._put(rec)
+
     def meta(self, record: dict) -> None:
         """Enqueue a run-header record (shapes, strategy, flags, paths
         to sibling logs — the report tool reads these to fold streams)."""
@@ -409,6 +435,10 @@ def validate_record(rec: Any) -> tuple[bool, str]:
         missing = [k for k in DECODE_REQUIRED if k not in rec]
         if missing:
             return False, f"decode record missing keys {missing}"
+    if kind == "request":
+        missing = [k for k in REQUEST_REQUIRED if k not in rec]
+        if missing:
+            return False, f"request record missing keys {missing}"
     return True, "ok"
 
 

@@ -118,5 +118,61 @@ fi
 rm -rf "$DEC_DIR"
 echo "DECODE_SMOKE=OK"
 
+echo "=== serving-chaos smoke ==="
+# kill@4 mid-decode under the engine supervisor: run 1 SIGKILLs itself
+# right after the step-4 snapshot (rc 137); run 2 (same command) resumes
+# from the snapshot, completes rc 0, and its tokens are TOKEN-IDENTICAL
+# to an uninterrupted run — plus >= 1 schema-valid `request` record in
+# the metrics stream (schema v4, decode/supervise.py + runtime/telemetry).
+SRV_DIR=$(mktemp -d /tmp/tier1_servechaos.XXXXXX)
+GEN_ARGS="--prompt_lens 3,7 --max_new 5 -d 32 -l 2 --heads 4 --vocab 64
+  --max_seq_len 64 --block_size 8 --prefill_chunk 4 --log_every 2"
+if ! timeout -k 10 240 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli generate $GEN_ARGS \
+    > "$SRV_DIR/oracle.json"; then
+  echo "SERVING_CHAOS_SMOKE=FAIL (oracle)"; rm -rf "$SRV_DIR"; exit 1
+fi
+timeout -k 10 240 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli generate $GEN_ARGS \
+    --snapshot_dir "$SRV_DIR/snap" --metrics_dir "$SRV_DIR/metrics" \
+    --chaos kill@4 > /dev/null 2>&1
+rc=$?
+if [ "$rc" -ne 137 ]; then
+  echo "SERVING_CHAOS_SMOKE=FAIL (kill@4 rc=$rc, wanted 137)"
+  rm -rf "$SRV_DIR"; exit 1
+fi
+if ! timeout -k 10 240 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli generate $GEN_ARGS \
+    --snapshot_dir "$SRV_DIR/snap" --metrics_dir "$SRV_DIR/metrics" \
+    --chaos kill@4 > "$SRV_DIR/resumed.json" 2>/dev/null; then
+  echo "SERVING_CHAOS_SMOKE=FAIL (resume)"; rm -rf "$SRV_DIR"; exit 1
+fi
+if ! timeout -k 10 60 env JAX_PLATFORMS=cpu python - "$SRV_DIR" <<'EOF'
+import json, os, sys
+from distributed_llm_code_samples_tpu.runtime.telemetry import (
+    METRICS_FILENAME, read_metrics, validate_record)
+base = sys.argv[1]
+oracle = json.load(open(os.path.join(base, "oracle.json")))
+resumed = json.load(open(os.path.join(base, "resumed.json")))
+a = {s["uid"]: s["tokens"] for s in oracle["sequences"]}
+b = {s["uid"]: s["tokens"] for s in resumed["sequences"]}
+assert a == b, "resumed tokens != uninterrupted run"
+assert resumed["resumed_from_step"] == 4, resumed.get("resumed_from_step")
+assert not resumed["failed"], resumed["failed"]
+records, problems = read_metrics(
+    os.path.join(base, "metrics", METRICS_FILENAME))
+assert not problems, problems
+reqs = [r for r in records if r["kind"] == "request"]
+assert reqs, "no schema-valid request record in the smoke stream"
+assert all(validate_record(r)[0] for r in reqs)
+assert any(r["event"] == "completed" for r in reqs)
+EOF
+then
+  echo "SERVING_CHAOS_SMOKE=FAIL (token-identity/schema check)"
+  rm -rf "$SRV_DIR"; exit 1
+fi
+rm -rf "$SRV_DIR"
+echo "SERVING_CHAOS_SMOKE=OK"
+
 echo "=== tier-1 pytest ==="
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -249,6 +249,11 @@ def test_bench_decode_contract():
     assert 0.0 < payload["engine_occupancy"] <= 1.0
     rkv = payload["roofline_by_kv_dtype"]
     assert rkv["int8"] >= rkv["bf16"] >= rkv["f32"] > 0
+    # r10 pressure row: serving stays live through a half-size pool
+    # with preemption armed (decode/engine.py ServePolicy)
+    assert isinstance(payload["engine_pressure_tokens_per_sec"], float)
+    assert payload["engine_pressure_tokens_per_sec"] > 0
+    assert isinstance(payload["engine_pressure_preemptions"], int)
     # storage bytes halve/quarter exactly
     assert payload["kv_bytes_per_token_bf16"] * 2 == \
         payload["kv_bytes_per_token_f32"]

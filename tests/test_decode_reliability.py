@@ -1,0 +1,814 @@
+"""Serving reliability (ISSUE 5): decode chaos grammar, in-graph logits
+quarantine, per-request retry, pool-pressure preemption, snapshot-resume
+and the request-record telemetry contract.
+
+The acceptance bar: a run injecting ``nan_logits@k:uid`` plus a crash at
+step ``m`` quarantines exactly one request, resumes the rest from the
+host-side engine snapshot, and every surviving sequence's tokens are
+BIT-IDENTICAL to an uninterrupted run that never admitted the poisoned
+request — proven for f32, bf16, AND int8 KV (the replay mechanism
+re-runs the exact KV write history, so the int8 quantization history
+matches too), plus Megatron TP. The real-SIGKILL flavor runs once
+through the generate CLI (subprocess, f32); the dtype matrix runs the
+same scenario in-process against the same snapshot machinery.
+
+Model shapes deliberately match tests/test_decode_engine.py (same
+params seed, same BASE config) so the compiled programs land in the
+same XLA cache entries.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from conftest import load_scaled_timeout
+
+from distributed_llm_code_samples_tpu.decode import (
+    AdmissionError, DecodeEngine, EngineConfig, ServePolicy,
+    corrupt_block, gather_layer, init_pool, load_snapshot,
+    restore_engine_state, scrub_blocks, supervise_decode, write_snapshot)
+from distributed_llm_code_samples_tpu.models import init_lm
+from distributed_llm_code_samples_tpu.runtime.chaos import (
+    DECODE_KINDS, FaultPlan, validate_decode_plan)
+
+V, D, L, H = 64, 32, 2, 4
+BASE = dict(block_size=8, n_blocks=33, max_slots=3, max_blocks_per_seq=6,
+            prefill_chunk=8)
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+KV_DTYPES = ("f32", "bf16", "int8")
+
+
+@pytest.fixture(scope="module")
+def lm_params():
+    return init_lm(jax.random.PRNGKey(0), V, D, L, max_seq_len=64)
+
+
+@pytest.fixture(scope="module")
+def prompts():
+    rng = np.random.default_rng(1)
+    return [rng.integers(0, V, size=n).tolist() for n in (5, 9, 13)]
+
+
+def _drain(params, cfg, prompts_uids, max_new=8, mesh=None, policy=None):
+    """A fresh engine draining ``[(uid, prompt), ...]`` — the oracle
+    helper (uids chosen by the caller: the determinism contract keys on
+    uid, never on which other requests were admitted)."""
+    eng = DecodeEngine(params, H, cfg, mesh=mesh, policy=policy)
+    for uid, p in prompts_uids:
+        eng.submit(p, max_new, uid=uid)
+    return eng.run()
+
+
+# ------------------------------------------------------------ chaos grammar
+
+
+def test_decode_chaos_grammar_parse():
+    plan = FaultPlan.parse(
+        "nan_logits@3:1,hang_step@5:0.5,corrupt_block@4:2,kill@7")
+    assert [(f.kind, f.step, f.arg) for f in plan.faults] == [
+        ("nan_logits", 3, 1.0), ("hang_step", 5, 0.5),
+        ("corrupt_block", 4, 2.0), ("kill", 7, None)]
+    validate_decode_plan(plan)          # decode-legal spec passes
+    assert set(DECODE_KINDS) == {"nan_logits", "hang_step",
+                                 "corrupt_block", "kill"}
+
+
+@pytest.mark.parametrize("spec,msg", [
+    ("nan_grad@3", "training fault"),
+    ("loss_spike@2:10", "training fault"),
+    ("corrupt_block@3", "requires :BLOCK"),
+    ("corrupt_block@3:1.5", "non-negative integer"),
+    ("nan_logits@3:-2", "non-negative integer"),
+    ("hang_step@2:-1", "non-negative sleep"),
+    ("kill@4:2", "takes no :ARG"),
+])
+def test_decode_chaos_grammar_rejects(spec, msg):
+    with pytest.raises(ValueError, match=msg):
+        validate_decode_plan(FaultPlan.parse(spec))
+
+
+def test_decode_due_and_mark_fired():
+    plan = FaultPlan.parse("nan_logits@3:1,kill@5")
+    assert [f.kind for f in plan.decode_due(3)] == ["nan_logits"]
+    assert plan.decode_due(4) == []
+    plan.mark_decode_fired_through(5)   # a resume past both faults
+    assert plan.decode_due(3) == [] and plan.decode_due(5) == []
+    # alignment goes BOTH ways: an in-process restart may restore a
+    # snapshot OLDER than a fault it already injected once — the fault
+    # must fire again on the replayed step (skipping it would diverge
+    # from the pre-crash history)
+    plan.mark_decode_fired_through(2)
+    assert [f.kind for f in plan.decode_due(3)] == ["nan_logits"]
+    assert [f.kind for f in plan.decode_due(5)] == ["kill"]
+
+
+# ---------------------------------------------- quarantine (the guardrail)
+
+
+@pytest.mark.parametrize("kv_dtype", KV_DTYPES)
+def test_acceptance_quarantine_plus_crash_resume(tmp_path, lm_params,
+                                                 prompts, kv_dtype):
+    """THE acceptance scenario, per KV dtype: ``nan_logits@4:1`` plus a
+    crash after step 6 (process death simulated by abandoning the
+    engine — the subprocess SIGKILL flavor is
+    ``test_kill_resume_via_generate_cli``). Exactly uid 1 is
+    quarantined/FAILED; the crash resumes from the host-side snapshot;
+    every surviving sequence is token-identical to an uninterrupted run
+    that NEVER admitted the poisoned request."""
+    cfg = EngineConfig(**BASE, kv_dtype=kv_dtype)
+    oracle = _drain(lm_params, cfg,
+                    [(0, prompts[0]), (2, prompts[2])])
+    # chaos run, phase 1: poison at step 4, "die" after step 6
+    eng = DecodeEngine(lm_params, H, cfg)
+    for i, p in enumerate(prompts):
+        eng.submit(p, 8, uid=i)
+    snap_dir = str(tmp_path / "snap")
+    for step in range(1, 7):
+        if step == 4:
+            eng.arm_poison(1)
+        assert eng.step()
+        write_snapshot(eng, snap_dir)
+    assert set(eng.failed) == {1}
+    assert eng.failed[1]["reason"] == "nonfinite_logits"
+    # phase 2: a fresh process restores the snapshot and drains
+    eng2 = DecodeEngine(lm_params, H, cfg)
+    restore_engine_state(eng2, load_snapshot(snap_dir))
+    assert eng2.step_base == 6
+    done = eng2.run()
+    assert set(eng2.failed) == {1}           # failure survives the crash
+    assert done[0] == oracle[0] and done[2] == oracle[2]
+    assert sorted(done) == [0, 2]
+
+
+def test_quarantine_retry_recovers_clean_tokens(tmp_path, lm_params,
+                                                prompts):
+    """With retry budget, the quarantined request is replay-resumed and
+    its FINAL tokens equal the never-poisoned run's (the fault fires
+    once; the poisoned step's garbage pick was never appended)."""
+    cfg = EngineConfig(**BASE)
+    clean = _drain(lm_params, cfg, list(enumerate(prompts)))
+    plan = FaultPlan.parse("nan_logits@4:1")
+    eng = supervise_decode(
+        lambda: DecodeEngine(lm_params, H, cfg,
+                             policy=ServePolicy(max_retries=1)),
+        [(p, 8) for p in prompts], snapshot_dir=str(tmp_path / "s"),
+        chaos=plan)
+    assert eng.failed == {}
+    assert {u: t for u, t in eng.finished.items()} == clean
+    assert eng.quarantined == 1 and eng.retried == 1
+    events = [(e["event"], e["uid"]) for e in eng.request_events]
+    assert ("quarantined", 1) in events and ("retried", 1) in events
+    assert [f.kind for f in plan.faults if f.fired] == ["nan_logits"]
+
+
+def test_quarantine_tp_matches_single_device(tmp_path, lm_params,
+                                             prompts, mesh_model4):
+    """The guardrail under Megatron TP: the flag is computed on the
+    gathered (replicated) logits, so every shard quarantines the same
+    uid at the same step, and survivors match the single-device
+    engine bit-for-bit."""
+    cfg = EngineConfig(**BASE)
+    oracle = _drain(lm_params, cfg, [(0, prompts[0]), (2, prompts[2])])
+    plan = FaultPlan.parse("nan_logits@4:1")
+    eng = supervise_decode(
+        lambda: DecodeEngine(lm_params, H, cfg, mesh=mesh_model4),
+        [(p, 8) for p in prompts], snapshot_dir=str(tmp_path / "s"),
+        chaos=plan)
+    assert set(eng.failed) == {1}
+    assert eng.finished[0] == oracle[0]
+    assert eng.finished[2] == oracle[2]
+
+
+def test_corrupt_block_quarantines_owner_then_retry_recovers(
+        tmp_path, lm_params, prompts):
+    """corrupt_block@4:1 poisons uid 0's first block (FCFS admission
+    hands block 1 to the first request): uid 0 is quarantined, its
+    blocks are scrubbed, and the retry — now on a factory-fresh pool
+    region — completes with the clean run's exact tokens; survivors
+    never notice."""
+    cfg = EngineConfig(**BASE)
+    clean = _drain(lm_params, cfg, list(enumerate(prompts)))
+    plan = FaultPlan.parse("corrupt_block@4:1")
+    eng = supervise_decode(
+        lambda: DecodeEngine(lm_params, H, cfg,
+                             policy=ServePolicy(max_retries=1)),
+        [(p, 8) for p in prompts], snapshot_dir=str(tmp_path / "s"),
+        chaos=plan)
+    assert eng.failed == {}
+    assert {u: t for u, t in eng.finished.items()} == clean
+    assert eng.quarantined == 1
+    q = [e for e in eng.request_events if e["event"] == "quarantined"]
+    assert q and q[0]["uid"] == 0
+
+
+def test_corrupt_scratch_block_recovers_via_retry(tmp_path, lm_params,
+                                                  prompts):
+    """corrupt_block@4:0 poisons the SHARED scratch block every table
+    pads with — all active sequences quarantine in one wave. Because
+    quarantine scrubs the scratch block along with the owned blocks,
+    the retries run on a clean pool and every request completes with
+    the uninterrupted run's tokens (the regression was a permanent
+    all-requests failure: scratch was never in any seq.blocks, so no
+    scrub ever reached it)."""
+    cfg = EngineConfig(**BASE)
+    clean = _drain(lm_params, cfg, list(enumerate(prompts)))
+    plan = FaultPlan.parse("corrupt_block@4:0")
+    eng = supervise_decode(
+        lambda: DecodeEngine(lm_params, H, cfg,
+                             policy=ServePolicy(max_retries=1)),
+        [(p, 8) for p in prompts], snapshot_dir=str(tmp_path / "s"),
+        chaos=plan)
+    assert eng.failed == {}, eng.failed
+    assert {u: t for u, t in eng.finished.items()} == clean
+    assert eng.quarantined >= 1
+
+
+def test_resume_never_reissues_finished_uids(tmp_path, lm_params,
+                                             prompts):
+    """Auto-assigned uids after a snapshot resume must clear the
+    FINISHED/FAILED uids too, not just the live ones — a collision
+    would sample in lockstep with the finished twin and overwrite its
+    entry."""
+    cfg = EngineConfig(**BASE)
+    eng = DecodeEngine(lm_params, H, cfg)
+    # the FINISHED uid (5) is the largest — the live uids alone would
+    # leave _next_uid at 2, re-issuing 5 later in the resumed process
+    eng.submit(prompts[0], 3, uid=5)    # short + first: finishes first
+    eng.submit(prompts[1], 8, uid=0)
+    eng.submit(prompts[2], 8, uid=1)
+    while not eng.finished:
+        eng.step()
+    assert 5 in eng.finished
+    sd = str(tmp_path / "snap")
+    write_snapshot(eng, sd)
+    eng2 = DecodeEngine(lm_params, H, cfg)
+    restore_engine_state(eng2, load_snapshot(sd))
+    new_uid = eng2.submit(prompts[0], 2)        # auto uid
+    assert new_uid == 6                 # past the finished uid, not 2
+    done = eng2.run()
+    assert sorted(done) == [0, 1, 5, new_uid]
+
+
+def test_expiry_only_final_step_still_snapshots(tmp_path, lm_params,
+                                                prompts):
+    """A run whose LAST step only expires requests must still persist
+    the drained snapshot — a stale one would resume the dead uids and
+    double-count their request records."""
+    cfg = EngineConfig(**{**BASE, "max_slots": 1})
+    sd = str(tmp_path / "snap")
+    eng = supervise_decode(
+        lambda: DecodeEngine(lm_params, H, cfg,
+                             policy=ServePolicy(deadline_steps=4)),
+        [(p, 16) for p in prompts], snapshot_dir=sd)
+    assert eng.failed and all(i["reason"] == "deadline"
+                              for i in eng.failed.values())
+    snap = load_snapshot(sd)
+    assert snap["requests"] == []       # nothing listed as live
+    assert {int(u) for u in snap["failed"]} == set(eng.failed)
+
+
+def test_evicted_corrupted_block_scrubbed_before_reuse(lm_params,
+                                                       prompts):
+    """A corrupted block whose owner is EVICTED before its next
+    dispatch (preemption here; deadline expiry is the same path) must
+    be scrubbed on release — otherwise the NaN lands on whichever
+    innocent request reserves the block next and, with max_retries=0,
+    fails it terminally."""
+    clean = _drain(lm_params, EngineConfig(**BASE),
+                   list(enumerate(prompts)))
+    cfg = EngineConfig(block_size=8, n_blocks=7, max_slots=3,
+                       max_blocks_per_seq=3, prefill_chunk=8)
+    eng = DecodeEngine(lm_params, H, cfg,
+                       policy=ServePolicy(preempt_after_steps=1))
+    eng.submit(prompts[0], 8, uid=0)     # blocks 1,2
+    eng.submit(prompts[1], 8, uid=1)     # blocks 3,4 (the youngest)
+    eng.step()
+    eng.corrupt_block(3)                 # uid 1's block, between steps
+    # uid 2 (3 blocks > 2 free) starves the head: step 2 preempts uid 1
+    # BEFORE any dispatch could flag its poisoned block
+    eng.submit(prompts[2], 8, uid=2)
+    done = eng.run()
+    assert eng.preempted >= 1
+    assert eng.failed == {}, eng.failed  # nobody inherited the NaN
+    assert {u: t for u, t in done.items()} == clean
+    assert eng._corrupted == set()
+
+
+def test_generate_sheds_to_none_not_exception(lm_params, prompts):
+    """generate() under queue_limit: the shed prompt yields None in its
+    position; the accepted ones still drain (the regression raised
+    AdmissionError out of generate with the queue still loaded)."""
+    eng = DecodeEngine(lm_params, H, EngineConfig(**BASE),
+                       policy=ServePolicy(queue_limit=2))
+    outs = eng.generate(prompts, 4)
+    assert outs[2] is None and eng.rejected == 1
+    assert outs[0] is not None and outs[1] is not None
+    ref = _drain(lm_params, EngineConfig(**BASE),
+                 [(0, prompts[0]), (1, prompts[1])], max_new=4)
+    assert outs[0] == ref[0] and outs[1] == ref[1]
+
+
+@pytest.mark.parametrize("kv_dtype", ["f32", "int8"])
+def test_corrupt_and_scrub_pool_units(kv_dtype):
+    pool = init_pool(1, 4, 2, 4, 8, kv_dtype)
+    pool = corrupt_block(pool, 2)
+    table = jax.numpy.asarray([2, 0], jax.numpy.int32)
+    k, _ = gather_layer(pool, 0, table)
+    assert not np.isfinite(np.asarray(k)[:, :4]).all()
+    pool = scrub_blocks(pool, [2])
+    k, v = gather_layer(pool, 0, table)
+    assert (np.asarray(k) == 0).all() and (np.asarray(v) == 0).all()
+    if kv_dtype == "int8":
+        assert (np.asarray(pool.k_scale) == 0).all()
+    with pytest.raises(ValueError, match="outside pool"):
+        corrupt_block(pool, 4)
+
+
+# ------------------------------------------------- preemption / resume
+
+
+@pytest.mark.parametrize("kv_dtype", KV_DTYPES)
+def test_preempt_resume_token_identical(lm_params, prompts, kv_dtype):
+    """Pool-pressure preemption: a pool holding ~2 sequences serves 3 —
+    the youngest is evicted back to WAITING and later replay-resumed.
+    Evicted-then-resumed AND survivor sequences are token-identical to
+    the unconstrained engine at every KV dtype (replay re-runs the
+    exact write history — the int8 quantization story included)."""
+    clean = _drain(lm_params, EngineConfig(**BASE, kv_dtype=kv_dtype),
+                   list(enumerate(prompts)))
+    cfg_small = EngineConfig(block_size=8, n_blocks=7, max_slots=3,
+                             max_blocks_per_seq=3, prefill_chunk=8,
+                             kv_dtype=kv_dtype)
+    eng = DecodeEngine(lm_params, H, cfg_small,
+                       policy=ServePolicy(preempt_after_steps=1))
+    for i, p in enumerate(prompts):
+        eng.submit(p, 8, uid=i)
+    done = eng.run()
+    assert eng.preempted >= 1
+    assert {u: t for u, t in done.items()} == clean
+    events = [e["event"] for e in eng.request_events]
+    assert "preempted" in events
+
+
+def test_preempt_resume_sampled_token_identical(lm_params, prompts):
+    """The sampled flavor — Gumbel draws keyed on (seed, uid, position)
+    survive eviction + replay bit-for-bit (any numeric drift in the
+    replayed cache would flip some argmax of z + g)."""
+    kw = dict(temperature=0.9, top_k=12, top_p=0.9, seed=7)
+    clean = _drain(lm_params, EngineConfig(**BASE, **kw),
+                   list(enumerate(prompts)))
+    cfg_small = EngineConfig(block_size=8, n_blocks=7, max_slots=3,
+                             max_blocks_per_seq=3, prefill_chunk=8, **kw)
+    eng = DecodeEngine(lm_params, H, cfg_small,
+                       policy=ServePolicy(preempt_after_steps=1))
+    for i, p in enumerate(prompts):
+        eng.submit(p, 8, uid=i)
+    done = eng.run()
+    assert eng.preempted >= 1
+    assert {u: t for u, t in done.items()} == clean
+
+
+def test_preempt_resume_zero_new_compiles_after_first_cycle(lm_params,
+                                                            prompts):
+    """Recompile guard: preemption and replay-resume ride the SAME
+    bucket programs — after the first preempt/resume cycle the compile
+    count stops growing, however much more preempted traffic flows."""
+    cfg_small = EngineConfig(block_size=8, n_blocks=7, max_slots=3,
+                             max_blocks_per_seq=3, prefill_chunk=8)
+    eng = DecodeEngine(lm_params, H, cfg_small,
+                       policy=ServePolicy(preempt_after_steps=1))
+    for i, p in enumerate(prompts):
+        eng.submit(p, 8, uid=i)
+    eng.run()
+    assert eng.preempted >= 1           # the first preempt/resume cycle
+    warm = eng.compile_count
+    dispatches = eng.dispatch_count
+    # same LENGTH schedule as wave one (content is irrelevant to the
+    # scheduler), so any new compile could only come from the second
+    # preempt/resume cycle itself
+    rng = np.random.default_rng(9)
+    more = [rng.integers(0, V, size=n).tolist() for n in (5, 9, 13)]
+    for j, p in enumerate(more):
+        eng.submit(p, 8, uid=100 + j)
+    eng.run()
+    assert eng.preempted >= 2           # pressure persisted
+    assert eng.compile_count == warm    # zero new compiles
+    assert eng.dispatch_count > dispatches
+
+
+def test_head_streak_resets_when_head_changes(lm_params, prompts):
+    """The preemption hysteresis belongs to ONE head-of-line request:
+    when the starved head disappears (expired/shed), its successor must
+    earn its own preempt_after_steps — inheriting the old streak would
+    evict a victim after a single starved step."""
+    cfg = EngineConfig(block_size=8, n_blocks=7, max_slots=3,
+                       max_blocks_per_seq=3, prefill_chunk=8)
+    eng = DecodeEngine(lm_params, H, cfg,
+                       policy=ServePolicy(preempt_after_steps=3))
+    eng.submit(prompts[0], 8, uid=0)     # 2 blocks
+    eng.submit(prompts[1], 8, uid=1)     # 2 blocks -> 2 free
+    eng.submit(prompts[2], 8, uid=2)     # needs 3: starved head
+    eng.step()
+    eng.step()
+    assert eng._head_blocked == 2 and eng._head_blocked_uid == 2
+    eng.waiting.popleft()                # the starved head vanishes
+    eng.submit(prompts[2], 8, uid=3)     # a NEW starved head
+    eng.step()
+    assert eng._head_blocked == 1 and eng._head_blocked_uid == 3
+    assert eng.preempted == 0            # successor earned nothing yet
+
+
+def test_preemption_never_evicts_last_resident(lm_params, prompts):
+    """The termination guard: with one running sequence, the head of
+    line WAITS instead of evicting it (a lone resident's replay-only
+    window is the one livelock shape) — the run still completes."""
+    cfg = EngineConfig(block_size=8, n_blocks=4, max_slots=2,
+                       max_blocks_per_seq=3, prefill_chunk=8)
+    eng = DecodeEngine(lm_params, H, cfg,
+                       policy=ServePolicy(preempt_after_steps=1))
+    eng.submit(prompts[1], 8, uid=0)     # needs 2 of the 3 usable blocks
+    eng.submit(prompts[1], 8, uid=1)     # must WAIT, never evict uid 0
+    done = eng.run()
+    assert eng.preempted == 0
+    assert sorted(done) == [0, 1]
+
+
+# ------------------------------------------------- snapshot / resume
+
+
+@pytest.mark.parametrize("kv_dtype", KV_DTYPES)
+def test_snapshot_resume_matches_uninterrupted(tmp_path, lm_params,
+                                               prompts, kv_dtype):
+    """Crash-resume mid-flight at every KV dtype: snapshot after 5
+    steps, restore into a FRESH engine (new pool, new programs), drain —
+    finished tokens equal the uninterrupted run's exactly."""
+    cfg = EngineConfig(**BASE, kv_dtype=kv_dtype)
+    oracle = _drain(lm_params, cfg, list(enumerate(prompts)))
+    eng = DecodeEngine(lm_params, H, cfg)
+    for i, p in enumerate(prompts):
+        eng.submit(p, 8, uid=i)
+    for _ in range(5):
+        assert eng.step()
+    sd = str(tmp_path / "snap")
+    write_snapshot(eng, sd)
+    snap = load_snapshot(sd)
+    assert snap["step"] == 5 and snap["version"] == 1
+    running = [r for r in snap["requests"] if r["state"] == "RUNNING"]
+    assert running and all("block_table" in r and "position" in r
+                           for r in running)
+    if kv_dtype == "int8":
+        assert snap["int8_scales"]["shape"] == [L, BASE["n_blocks"], H]
+    eng2 = DecodeEngine(lm_params, H, cfg)
+    restore_engine_state(eng2, snap)
+    assert {u: t for u, t in eng2.run().items()} == oracle
+    assert eng2.global_step > 5
+
+
+def test_snapshot_restore_rejects_config_mismatch(tmp_path, lm_params,
+                                                  prompts):
+    eng = DecodeEngine(lm_params, H, EngineConfig(**BASE))
+    eng.submit(prompts[0], 8, uid=0)
+    eng.step()
+    sd = str(tmp_path / "snap")
+    write_snapshot(eng, sd)
+    other = DecodeEngine(lm_params, H,
+                         EngineConfig(**{**BASE, "kv_dtype": "bf16"}))
+    with pytest.raises(ValueError, match="snapshot config"):
+        restore_engine_state(other, load_snapshot(sd))
+    withpol = DecodeEngine(lm_params, H, EngineConfig(**BASE),
+                           policy=ServePolicy(max_retries=2))
+    with pytest.raises(ValueError, match="snapshot policy"):
+        restore_engine_state(withpol, load_snapshot(sd))
+    # a different MODEL (same shapes, different init seed) must be
+    # rejected too: resume replays recorded tokens through the current
+    # weights, so the token-identical contract needs the same params
+    other_params = init_lm(jax.random.PRNGKey(42), V, D, L,
+                           max_seq_len=64)
+    other_model = DecodeEngine(other_params, H, EngineConfig(**BASE))
+    with pytest.raises(ValueError, match="snapshot model"):
+        restore_engine_state(other_model, load_snapshot(sd))
+
+
+@pytest.mark.serial
+def test_kill_resume_via_generate_cli(tmp_path):
+    """The real-SIGKILL acceptance flavor: ``nan_logits@3:1,kill@6``
+    through the generate CLI. Run 1 quarantines uid 1 and dies by
+    SIGKILL right after the step-6 snapshot; run 2 (same command)
+    resumes, completes rc 0, reports uid 1 FAILED, and the survivors'
+    tokens equal an uninterrupted no-chaos run that never admitted the
+    poisoned prompt. The metrics stream spans both processes and stays
+    schema-valid."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    base_args = [sys.executable, "-m",
+                 "distributed_llm_code_samples_tpu.cli", "generate",
+                 "--max_new", "6", "-d", "32", "-l", "2", "--heads", "4",
+                 "--vocab", "64", "--max_seq_len", "64", "--block_size",
+                 "8", "--prefill_chunk", "4", "--log_every", "2"]
+    # oracle: the two SURVIVING prompts only, with the uids they carry
+    # in the chaos run (0 and 2 — the sampling keys fold the uid)
+    rng = np.random.default_rng(0)
+    lens = (3, 7, 5)
+    prompts3 = [rng.integers(0, 64, size=n).tolist() for n in lens]
+    oracle_args = base_args + [
+        "--prompts", ",".join(map(str, prompts3[0])) + ";"
+        + ",".join(map(str, prompts3[2]))]
+    r0 = subprocess.run(oracle_args, capture_output=True, text=True,
+                        env=env, cwd=REPO,
+                        timeout=load_scaled_timeout(300))
+    assert r0.returncode == 0, r0.stdout + r0.stderr
+    oracle = {s["uid"]: s["tokens"]
+              for s in json.loads(r0.stdout)["sequences"]}
+    # the chaos run: 3 prompts via --prompt_lens (seed 0 => prompts3)
+    args = base_args + [
+        "--prompt_lens", ",".join(map(str, lens)),
+        "--snapshot_dir", str(tmp_path / "snap"),
+        "--metrics_dir", str(tmp_path / "metrics"),
+        "--chaos", "nan_logits@3:1,kill@6"]
+    r1 = subprocess.run(args, capture_output=True, text=True, env=env,
+                        cwd=REPO, timeout=load_scaled_timeout(300))
+    assert r1.returncode == -signal.SIGKILL, r1.stdout + r1.stderr
+    assert os.path.exists(tmp_path / "snap" / "engine_snapshot.json")
+    r2 = subprocess.run(args, capture_output=True, text=True, env=env,
+                        cwd=REPO, timeout=load_scaled_timeout(300))
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    payload = json.loads(r2.stdout)
+    assert payload["resumed_from_step"] == 6
+    assert list(payload["failed"]) == ["1"]
+    assert payload["failed"]["1"]["reason"] == "nonfinite_logits"
+    got = {s["uid"]: s["tokens"] for s in payload["sequences"]}
+    # oracle ran uids 0,1 for the two prompts; map survivor uids
+    assert got[0] == oracle[0] and got[2] == oracle[1]
+    # prompt_len survives the resume (engine-side record, not a
+    # flag-derived guess)
+    plens = {s["uid"]: s["prompt_len"] for s in payload["sequences"]}
+    assert plens == {0: 3, 2: 5}
+    from distributed_llm_code_samples_tpu.runtime.telemetry import (
+        METRICS_FILENAME, read_metrics, validate_record)
+    records, problems = read_metrics(
+        str(tmp_path / "metrics" / METRICS_FILENAME))
+    assert problems == []
+    reqs = [r for r in records if r["kind"] == "request"]
+    assert reqs and all(validate_record(r)[0] for r in reqs)
+    assert {(r["event"], r["uid"]) for r in reqs} >= {
+        ("quarantined", 1), ("completed", 0), ("completed", 2)}
+
+
+# ------------------------------------------------- admission control
+
+
+def test_duplicate_inflight_and_failed_uid_rejected(lm_params, prompts):
+    """Satellite regression: a second submit with an in-flight uid (in
+    a SLOT, not just waiting) is rejected — a silent collision would
+    sample both sequences in lockstep (the key folds the uid) and
+    overwrite the finished entry. A FAILED uid stays reserved too."""
+    eng = DecodeEngine(lm_params, H, EngineConfig(**BASE))
+    # negative uids collide with the poison operand sentinels (-1/-2):
+    # uid -1 would match the idle poison comparison and NaN every step
+    with pytest.raises(ValueError, match="uid must be >= 0"):
+        eng.submit(prompts[0], 8, uid=-1)
+    with pytest.raises(ValueError, match="uid must be >= 0"):
+        eng.resume_request(-2, prompts[0], 8)
+    eng.submit(prompts[0], 8, uid=5)
+    eng.step()                                 # uid 5 now holds a slot
+    assert eng.active == 1
+    with pytest.raises(ValueError, match="already in use"):
+        eng.submit(prompts[1], 8, uid=5)
+    eng.arm_poison(5)
+    eng.step()                                 # quarantined -> FAILED
+    assert 5 in eng.failed
+    with pytest.raises(ValueError, match="already in use"):
+        eng.submit(prompts[1], 8, uid=5)
+
+
+def test_queue_limit_rejects_with_event(lm_params, prompts):
+    eng = DecodeEngine(lm_params, H, EngineConfig(**BASE),
+                       policy=ServePolicy(queue_limit=2))
+    eng.submit(prompts[0], 4)
+    eng.submit(prompts[1], 4)
+    with pytest.raises(AdmissionError, match="queue full"):
+        eng.submit(prompts[2], 4)
+    assert eng.rejected == 1
+    rej = [e for e in eng.request_events if e["event"] == "rejected"]
+    assert rej and rej[0]["reason"] == "queue_full"
+    # an auto-uid shed carries uid -1 in its record: the number was
+    # never consumed and WILL be reused by a later accepted request —
+    # recording it would alias two requests in the per-uid audit trail
+    assert rej[0]["uid"] == -1
+    assert sorted(eng.run()) == [0, 1]
+
+
+def test_deadline_expires_overdue_requests(lm_params, prompts):
+    """TTL: with one slot and a 4-step deadline, the queued request
+    (and the too-slow running one) fail with reason 'deadline' instead
+    of waiting forever — graceful degradation, reported per uid."""
+    eng = DecodeEngine(lm_params, H,
+                       EngineConfig(**{**BASE, "max_slots": 1}),
+                       policy=ServePolicy(deadline_steps=4))
+    u0 = eng.submit(prompts[0], 16)
+    u1 = eng.submit(prompts[1], 16)
+    done = eng.run()
+    assert done == {}
+    assert eng.failed[u0]["reason"] == "deadline"
+    assert eng.failed[u1]["reason"] == "deadline"
+    assert eng.expired == 2
+    exp = [e for e in eng.request_events if e["event"] == "expired"]
+    assert {e["uid"] for e in exp} == {u0, u1}
+
+
+def test_deadline_not_extended_by_preemption(lm_params, prompts):
+    """TTL measures from the ORIGINAL submission: preemption re-queues
+    must not reset the clock, or churn would keep a request alive (and
+    holding resources) unboundedly past its deadline."""
+    cfg_small = EngineConfig(block_size=8, n_blocks=7, max_slots=3,
+                            max_blocks_per_seq=3, prefill_chunk=8)
+    eng = DecodeEngine(lm_params, H, cfg_small,
+                       policy=ServePolicy(preempt_after_steps=1,
+                                          deadline_steps=6))
+    for i, p in enumerate(prompts):
+        eng.submit(p, 8, uid=i)
+    eng.run()
+    # under this pool pressure at least one request both got preempted
+    # and then ran out of TTL — the reset-on-requeue bug made this
+    # combination immortal instead
+    assert eng.preempted >= 1
+    assert eng.expired >= 1
+    assert all(info["reason"] == "deadline"
+               for info in eng.failed.values())
+    # generate()'s contract for failed requests: None, not KeyError
+    eng2 = DecodeEngine(lm_params, H, cfg_small,
+                        policy=ServePolicy(preempt_after_steps=1,
+                                           deadline_steps=6))
+    outs = eng2.generate(prompts, 8)
+    assert len(outs) == 3 and any(o is None for o in outs)
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError, match="queue_limit"):
+        ServePolicy(queue_limit=-1)
+    with pytest.raises(ValueError, match="max_retries"):
+        ServePolicy(max_retries=-2)
+
+
+# ------------------------------------------------- telemetry contract
+
+
+def test_request_records_schema_valid(tmp_path, lm_params, prompts):
+    from distributed_llm_code_samples_tpu.runtime.telemetry import (
+        METRICS_FILENAME, REQUEST_REQUIRED, SCHEMA_VERSION,
+        TelemetryWriter, read_metrics, validate_record)
+    mdir = str(tmp_path / "metrics")
+    with TelemetryWriter(mdir, meta={"subcommand": "generate"}) as w:
+        eng = DecodeEngine(lm_params, H, EngineConfig(**BASE),
+                           policy=ServePolicy(max_retries=1), metrics=w)
+        for i, p in enumerate(prompts):
+            eng.submit(p, 6, uid=i)
+        for _ in range(4):              # uid 1 finishes prefill at 4
+            eng.step()
+        eng.arm_poison(1)               # poisons run()'s first step
+        eng.run(log_every=2)
+    records, problems = read_metrics(os.path.join(mdir,
+                                                  METRICS_FILENAME))
+    assert problems == []
+    reqs = [r for r in records if r["kind"] == "request"]
+    assert reqs
+    for r in reqs:
+        assert r["schema"] == SCHEMA_VERSION
+        for key in REQUEST_REQUIRED:
+            assert key in r
+    events = {(r["event"], r["uid"]) for r in reqs}
+    assert {("admitted", 0), ("quarantined", 1), ("retried", 1),
+            ("completed", 0)} <= events
+    done = [r for r in reqs if r["event"] == "completed"]
+    assert all(r.get("latency_s") is not None for r in done)
+    # the contract rejects a request record missing a required key
+    bad = {k: v for k, v in reqs[0].items() if k != "reason"}
+    ok, reason = validate_record(bad)
+    assert not ok and "reason" in reason
+
+
+def test_report_renders_serving_reliability(tmp_path, lm_params,
+                                            prompts, capsys):
+    """report folds request records into the reliability summary +
+    latency percentiles + the one merged timeline."""
+    from distributed_llm_code_samples_tpu.report import report_main
+    from distributed_llm_code_samples_tpu.runtime.telemetry import (
+        TelemetryWriter)
+    mdir = str(tmp_path / "metrics")
+    with TelemetryWriter(mdir, meta={"subcommand": "generate"}) as w:
+        eng = DecodeEngine(lm_params, H, EngineConfig(**BASE),
+                           metrics=w)
+        for i, p in enumerate(prompts):
+            eng.submit(p, 6, uid=i)
+        for _ in range(7):              # uid 2 finishes prefill at 7
+            eng.step()
+        eng.arm_poison(2)               # poisons run()'s first step
+        eng.run(log_every=2)
+    assert report_main([mdir, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    rel = doc["serving_reliability"]
+    assert rel["admitted"] == 3 and rel["quarantined"] == 1
+    assert rel["completed"] == 2 and rel["failed_uids"] == [2]
+    assert "latency_p50_s" in rel
+    assert any(r["source"] == "request" and "QUARANTINED" in r["what"]
+               for r in doc["timeline"])
+    assert report_main([mdir]) == 0
+    text = capsys.readouterr().out
+    assert "serving reliability:" in text and "FAILED uids: [2]" in text
+
+
+# ------------------------------------------------- CLI flag guards
+
+
+def test_generate_cli_rejects_bad_reliability_flags(capsys):
+    import distributed_llm_code_samples_tpu.cli as cli
+    base = ["generate", "--prompt_lens", "3", "--max_new", "2"]
+    # --chaos without --snapshot_dir
+    assert cli.main(base + ["--chaos", "kill@3"]) == 2
+    assert "--snapshot_dir" in capsys.readouterr().err
+    # unparseable / training-kind / missing-arg specs
+    assert cli.main(base + ["--snapshot_dir", "/tmp/x",
+                            "--chaos", "bogus@1"]) == 2
+    assert cli.main(base + ["--snapshot_dir", "/tmp/x",
+                            "--chaos", "nan_grad@2"]) == 2
+    assert "training fault" in capsys.readouterr().err
+    assert cli.main(base + ["--snapshot_dir", "/tmp/x",
+                            "--chaos", "corrupt_block@2"]) == 2
+    assert "requires :BLOCK" in capsys.readouterr().err
+    # bad policy values reject cleanly (rc 2, no traceback)
+    assert cli.main(base + ["--max_retries", "-1"]) == 2
+    assert cli.main(base + ["--queue_limit", "-3"]) == 2
+    assert cli.main(base + ["--deadline_steps", "-2"]) == 2
+    # watchdog outside the supervisor
+    assert cli.main(base + ["--watchdog_ms", "100"]) == 2
+    # snapshot cadence must be >= 1
+    assert cli.main(base + ["--snapshot_dir", "/tmp/x",
+                            "--snapshot_every", "0"]) == 2
+    # supervisor-only flags reject consistently without --snapshot_dir
+    assert cli.main(base + ["--snapshot_every", "4"]) == 2
+    assert cli.main(base + ["--max_restarts", "0"]) == 2
+    # a corrupt_block id outside the configured pool rejects at parse
+    # time instead of burning the restart ladder at fire time
+    assert cli.main(base + ["--snapshot_dir", "/tmp/x",
+                            "--chaos", "corrupt_block@2:999"]) == 2
+    assert "outside the pool" in capsys.readouterr().err
+    capsys.readouterr()
+
+
+def test_train_cli_rejects_decode_chaos_kinds(tmp_path, capsys):
+    """The mirror guard: a decode fault in a TRAINING --chaos spec
+    would silently never fire — rejected rc 2 instead."""
+    import distributed_llm_code_samples_tpu.cli as cli
+    rc = cli.main(["-m", "1", "-s", "4", "-bs", "2", "-n", "4", "-d",
+                   "8", "-l", "1", "--checkpoint_dir",
+                   str(tmp_path / "ck"), "--checkpoint_every", "2",
+                   "--chaos", "nan_logits@2:1"])
+    assert rc == 2
+    assert "decode" in capsys.readouterr().err
+
+
+def test_generate_cli_queue_limit_sheds(tmp_path, capsys):
+    """--queue_limit 2 with 3 prompts: one request shed (rejected, not
+    an error), run exits 0, payload reports the shed count."""
+    import distributed_llm_code_samples_tpu.cli as cli
+    rc = cli.main(["generate", "--prompt_lens", "3,4,5", "--max_new",
+                   "3", "-d", "32", "-l", "2", "--heads", "4",
+                   "--vocab", "64", "--max_seq_len", "64",
+                   "--block_size", "8", "--prefill_chunk", "4",
+                   "--queue_limit", "2"])
+    assert rc == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["rejected"] == 1 and payload["shed"] == 1
+    assert len(payload["sequences"]) == 2
+
+
+# ------------------------------------------------- watchdog evidence
+
+
+def test_hang_step_latches_watchdog_evidence(tmp_path, lm_params,
+                                             prompts):
+    """hang_step@3:0.6 stalls one engine step past a 200ms watchdog:
+    the run completes (a hang is evidence, not fatal, at this layer)
+    and both the hung_step record and the completed record carry the
+    latch."""
+    plan = FaultPlan.parse("hang_step@3:0.6")
+    sd = str(tmp_path / "snap")
+    eng = supervise_decode(
+        lambda: DecodeEngine(lm_params, H, EngineConfig(**BASE)),
+        [(p, 6) for p in prompts], snapshot_dir=sd, chaos=plan,
+        watchdog_ms=200)
+    assert sorted(eng.finished) == [0, 1, 2]
+    with open(os.path.join(sd, "serve_supervise.jsonl")) as f:
+        log = [json.loads(ln) for ln in f if ln.strip()]
+    hung = [r for r in log if r.get("event") == "hung_step"]
+    assert hung and all(r["watchdog_expired"] for r in hung)
+    completed = [r for r in log if r.get("event") == "completed"]
+    assert completed and completed[0]["watchdog_expired"] is True
+    assert [f.kind for f in plan.faults if f.fired] == ["hang_step"]

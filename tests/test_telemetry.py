@@ -34,7 +34,10 @@ from distributed_llm_code_samples_tpu.runtime.telemetry import (
 # "anomaly" (in-graph guardrail counters) and "rollback" (ladder rungs).
 # v3 (round 9): the serving kind — "decode" (engine cadence records:
 # throughput, batch occupancy, KV-pool utilization; decode/engine.py).
-_PINNED_VERSION = 3
+# v4 (round 10): the serving-reliability kind — "request" (one record
+# per request lifecycle transition: admitted/preempted/retried/
+# quarantined/completed/rejected/expired; decode/engine.py).
+_PINNED_VERSION = 4
 _PINNED_STEP_KEYS = frozenset({
     "schema", "kind", "t", "step", "strategy", "loss", "grad_norm",
     "tokens_per_sec", "step_time_s", "mfu", "hbm_high_water_bytes",
@@ -44,20 +47,23 @@ _PINNED_ROLLBACK_REQUIRED = frozenset({"rung", "resume_step"})
 _PINNED_DECODE_REQUIRED = frozenset({
     "step", "tokens_per_sec", "batch_occupancy", "kv_pool_utilization",
 })
+_PINNED_REQUEST_REQUIRED = frozenset({"step", "uid", "event", "reason"})
 
 
 def test_schema_version_bump_discipline():
     from distributed_llm_code_samples_tpu.runtime.telemetry import (
         ANOMALY_REQUIRED, DECODE_REQUIRED, RECORD_KINDS,
-        ROLLBACK_REQUIRED)
+        REQUEST_REQUIRED, ROLLBACK_REQUIRED)
     assert SCHEMA_VERSION == _PINNED_VERSION and \
         frozenset(STEP_KEYS) == _PINNED_STEP_KEYS and \
         frozenset(ANOMALY_REQUIRED) == _PINNED_ANOMALY_REQUIRED and \
         frozenset(ROLLBACK_REQUIRED) == _PINNED_ROLLBACK_REQUIRED and \
-        frozenset(DECODE_REQUIRED) == _PINNED_DECODE_REQUIRED, (
+        frozenset(DECODE_REQUIRED) == _PINNED_DECODE_REQUIRED and \
+        frozenset(REQUEST_REQUIRED) == _PINNED_REQUEST_REQUIRED, (
             "telemetry record schema changed: bump SCHEMA_VERSION "
             "and update the pinned sets here in the same commit")
     assert "anomaly" in RECORD_KINDS and "rollback" in RECORD_KINDS
+    assert "request" in RECORD_KINDS
     assert "decode" in RECORD_KINDS
 
 
