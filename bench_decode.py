@@ -208,6 +208,14 @@ def main() -> int:
             paths[f"engine_{dt_name}_tokens_per_sec"] = round(tps, 1)
             if dt_name == "f32":
                 paths["engine_occupancy"] = round(eng.mean_occupancy(), 4)
+                # the schema-v5 KV-pool internals (drained-engine
+                # values; churn counters are the row's real content —
+                # allocs == frees on a clean drain by construction)
+                rec = eng.telemetry_record()
+                paths["engine_pool_telemetry"] = {
+                    k: rec[k] for k in (
+                        "block_allocs", "block_frees", "block_scrubs",
+                        "free_blocks_low_water", "kv_fragmentation")}
             paths[f"kv_bytes_per_token_{dt_name}"] = int(
                 kv_bytes_per_token(dt_name, L, params.blocks.wk.shape[1]
                                    // dh, dh))

@@ -6,22 +6,22 @@ request-level admission control (``decode/supervise.py``, DESIGN.md
 section 16)."""
 
 from .engine import (AdmissionError, DecodeEngine, EngineConfig,
-                     POISON_ALL, POISON_NONE, REQUEST_EVENTS,
-                     ServePolicy)
+                     FLIGHT_FILENAME, POISON_ALL, POISON_NONE,
+                     REQUEST_EVENTS, ServePolicy)
 from .paged import (KV_DTYPES, PagedKV, SCRATCH_BLOCK, corrupt_block,
                     gather_layer, init_pool, kv_bytes_per_token,
-                    scrub_blocks, write_chunk, write_rows)
+                    pool_bytes, scrub_blocks, write_chunk, write_rows)
 from .sampling import check_sampling, make_pick
 from .supervise import (SNAPSHOT_FILENAME, load_snapshot,
                         restore_engine_state, snapshot_state,
                         supervise_decode, write_snapshot)
 
 __all__ = [
-    "AdmissionError", "DecodeEngine", "EngineConfig", "POISON_ALL",
-    "POISON_NONE", "REQUEST_EVENTS", "ServePolicy",
+    "AdmissionError", "DecodeEngine", "EngineConfig", "FLIGHT_FILENAME",
+    "POISON_ALL", "POISON_NONE", "REQUEST_EVENTS", "ServePolicy",
     "KV_DTYPES", "PagedKV", "SCRATCH_BLOCK", "corrupt_block",
-    "gather_layer", "init_pool", "kv_bytes_per_token", "scrub_blocks",
-    "write_chunk", "write_rows",
+    "gather_layer", "init_pool", "kv_bytes_per_token", "pool_bytes",
+    "scrub_blocks", "write_chunk", "write_rows",
     "check_sampling", "make_pick",
     "SNAPSHOT_FILENAME", "load_snapshot", "restore_engine_state",
     "snapshot_state", "supervise_decode", "write_snapshot",

@@ -68,6 +68,8 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import json
+import os
 import time
 from dataclasses import dataclass, field
 
@@ -80,9 +82,12 @@ from ..models.attention import chunk_attn, rope
 from ..models.lm import LMParams, decode_attn
 from ..ops.norm import layernorm
 from ..runtime.guardrails import rows_finite
+from ..runtime.telemetry import FLIGHT_FILENAME
+from ..runtime.tracing import SpanTracer
 from .paged import (PagedKV, SCRATCH_BLOCK, corrupt_block as
                     _pool_corrupt_block, gather_layer, init_pool,
-                    scrub_blocks, write_chunk, write_rows)
+                    kv_bytes_per_token, pool_bytes, scrub_blocks,
+                    write_chunk, write_rows)
 from .sampling import check_sampling, make_pick
 
 # poison operand values for the compiled steps (chaos nan_logits
@@ -95,6 +100,17 @@ POISON_ALL = -2
 # names the transitions)
 REQUEST_EVENTS = ("admitted", "preempted", "retried", "quarantined",
                   "completed", "rejected", "expired")
+
+# flight recorder: bounded ring of per-step scheduler digests, dumped
+# atomically on quarantine / watchdog latch / chaos kill — the "what
+# was the engine doing in the steps before the fault" record a
+# post-mortem needs when the process (or the pool) is already gone.
+# 256 steps of digests is a few hundred KB at worst; the ring bounds a
+# long-lived engine by construction. The dump filename lives in
+# runtime/telemetry.py (FLIGHT_FILENAME, re-exported here) so
+# report --postmortem can discover the file without importing this
+# (jax-heavy) module.
+FLIGHT_RECORDER_STEPS = 256
 
 
 class AdmissionError(RuntimeError):
@@ -303,6 +319,28 @@ class DecodeEngine:
         self._head_blocked = 0      # head-of-line pool-starved streak
         self._head_blocked_uid: int | None = None  # whose streak it is
         self._poison_uid = POISON_NONE   # armed for the NEXT step only
+        # -- serving observability (round 11, DESIGN.md section 17) --
+        # per-request lifecycle spans; the writer is looked up lazily
+        # because run(metrics=...) re-binds it after construction
+        self.tracer = SpanTracer(lambda: self.metrics)
+        # KV-pool churn (cumulative; snapshot-persisted so they stay
+        # monotonic across crash-resume) + free-block watermark window
+        # (min/max since the last decode record)
+        self.block_allocs = 0
+        self.block_frees = 0
+        self.block_scrubs = 0
+        free0 = len(self.free_blocks)
+        self._free_lo = self._free_hi = free0
+        # flight recorder: per-step digests + the current step's
+        # request events / dispatch evidence feeding the next digest
+        self.flight: collections.deque[dict] = collections.deque(
+            maxlen=FLIGHT_RECORDER_STEPS)
+        self.flight_dir: str | None = None  # default: the metrics dir
+        self._step_events: list[str] = []
+        self._step_finite: list[bool] | None = None
+        self._step_prefill_uid: int | None = None
+        self._step_decode_uids: list[int] = []
+        self._dump_reason: str | None = None
 
     # -- pool ----------------------------------------------------------
 
@@ -405,6 +443,20 @@ class DecodeEngine:
             logits = all_gather(logits, MODEL_AXIS, dim=1)
         return logits
 
+    def _wrap(self, run):
+        """The (possibly shard_mapped) callable a compiled program is
+        built from — split from ``_jit`` so the static attribution path
+        (``decode_static_report``) can lower the SAME program without a
+        second donation annotation."""
+        if self.mesh is None:
+            return run
+        from ..parallel.lm import tp_decode_specs
+        return jax.shard_map(
+            run, mesh=self.mesh,
+            in_specs=(tp_decode_specs(), self._pool_specs(), P(), P(),
+                      P(), P(), P()),
+            out_specs=(self._pool_specs(), P(), P()), check_vma=False)
+
     def _jit(self, run):
         """jit (or shard_map+jit under TP) with the pool donated: the
         engine replaces ``self.pool`` with the returned pool after every
@@ -412,30 +464,32 @@ class DecodeEngine:
         copying the whole pool per step — without donation each decode
         step would pay a full-pool allocate+copy, swamping the
         kv_bytes roofline term this engine exists to shrink."""
-        if self.mesh is None:
-            return jax.jit(run, donate_argnums=(1,))
-        from ..parallel.lm import tp_decode_specs
-        return jax.jit(jax.shard_map(
-            run, mesh=self.mesh,
-            in_specs=(tp_decode_specs(), self._pool_specs(), P(), P(),
-                      P(), P(), P()),
-            out_specs=(self._pool_specs(), P(), P()), check_vma=False),
-            donate_argnums=(1,))
+        return jax.jit(self._wrap(run), donate_argnums=(1,))
 
-    def _build_decode(self, b: int):
-        """One decode step for a ``b``-slot bucket: write each slot's
-        input token at its own position, attend over its gathered
-        blocks, pick the next token in-graph — and return each row's
-        all-finite logits flag (the serving guardrail: a poisoned
-        sequence is detected the step it happens, on the same readback
-        as the picks). ``poison`` is the chaos nan_logits operand: a
-        uid (or POISON_ALL) whose row's logits are NaN'd in-graph;
-        POISON_NONE leaves every row bit-identical (a false ``where``
-        selects the original value)."""
+    def _decode_fn(self, b: int):
+        """The raw (un-jitted) decode-step body for a ``b``-slot bucket:
+        write each slot's input token at its own position, attend over
+        its gathered blocks, pick the next token in-graph — and return
+        each row's all-finite logits flag (the serving guardrail: a
+        poisoned sequence is detected the step it happens, on the same
+        readback as the picks). ``poison`` is the chaos nan_logits
+        operand: a uid (or POISON_ALL) whose row's logits are NaN'd
+        in-graph; POISON_NONE leaves every row bit-identical (a false
+        ``where`` selects the original value).
+
+        Cost-attribution scopes (utils/trace_analysis ``SCOPES``): the
+        body runs under ``decode/``, with ``gather``/``requant`` tagged
+        inside the paged pool ops, ``attn`` on the score+AV math,
+        ``head`` on the final LN + tied head (+ TP logits gather), and
+        ``sample`` on the fused pick — so a hardware trace (or an HLO
+        dump) splits one decode step's time by the roofline's own
+        terms. Scopes are metadata only: the compiled program set is
+        unchanged (the recompile guard pins it)."""
         cfg = self.cfg
         pick = make_pick(cfg.temperature, cfg.top_k, cfg.top_p,
                          self.params.vocab, cfg.seed)
 
+        @jax.named_scope("decode")
         def run(p: LMParams, pool: PagedKV, tables, lengths, tokens,
                 uids, poison):
             x = self._embed(p, tokens, lengths)             # [b, d]
@@ -448,28 +502,38 @@ class DecodeEngine:
                 ck, cv = jax.vmap(
                     lambda t, _l=l, _pool=pool: gather_layer(_pool, _l, t)
                 )(tables)                       # [b, Hkv_loc, T_cap, dh]
-                return pool, decode_attn(q, ck, cv, lengths + 1)
+                with jax.named_scope("attn"):
+                    y = decode_attn(q, ck, cv, lengths + 1)
+                return pool, y
 
             pool, x = self._trunk(p, pool, x, lengths, write_attn)
-            logits = self._logits(p, layernorm(p.ln_f, x))
+            with jax.named_scope("head"):
+                logits = self._logits(p, layernorm(p.ln_f, x))
             bad = jnp.logical_or(uids == poison, poison == POISON_ALL)
             logits = jnp.where(bad[:, None],
                                jnp.asarray(jnp.nan, logits.dtype), logits)
-            return pool, pick(logits, uids, lengths + 1), \
-                rows_finite(logits)
+            with jax.named_scope("sample"):
+                picks = pick(logits, uids, lengths + 1)
+            return pool, picks, rows_finite(logits)
 
-        return self._jit(run)
+        return run
 
-    def _build_prefill(self, c: int):
-        """One prefill chunk for one slot: ``c`` prompt tokens enter the
-        cache through the block table; the chunk's own causal attention
-        runs against the gathered view (``models.attention.chunk_attn``).
-        Returns the in-graph pick from the final row — used by the host
-        only when the chunk completes the prompt."""
+    def _build_decode(self, b: int):
+        return self._jit(self._decode_fn(b))
+
+    def _prefill_fn(self, c: int):
+        """The raw prefill-chunk body for one slot: ``c`` prompt tokens
+        enter the cache through the block table; the chunk's own causal
+        attention runs against the gathered view
+        (``models.attention.chunk_attn``). Returns the in-graph pick
+        from the final row — used by the host only when the chunk
+        completes the prompt. Same attribution scopes as the decode
+        body, under ``prefill/``."""
         cfg = self.cfg
         pick = make_pick(cfg.temperature, cfg.top_k, cfg.top_p,
                          self.params.vocab, cfg.seed)
 
+        @jax.named_scope("prefill")
         def run(p: LMParams, pool: PagedKV, table, pos0, tokens, uid,
                 poison):
             positions = pos0 + jnp.arange(c)
@@ -479,19 +543,25 @@ class DecodeEngine:
                 pool = write_chunk(pool, l, table, pos0, k, v,
                                    cfg.kv_dtype)
                 ck, cv = gather_layer(pool, l, table)
-                y = chunk_attn(q.transpose(1, 0, 2), ck, cv, pos0)
+                with jax.named_scope("attn"):
+                    y = chunk_attn(q.transpose(1, 0, 2), ck, cv, pos0)
                 return pool, y.transpose(1, 0, 2)
 
             pool, x = self._trunk(p, pool, x, positions, write_attn)
-            h = layernorm(p.ln_f, x[-1:])                   # last row
-            logits = self._logits(p, h)
+            with jax.named_scope("head"):
+                h = layernorm(p.ln_f, x[-1:])               # last row
+                logits = self._logits(p, h)
             bad = jnp.logical_or(uid == poison, poison == POISON_ALL)
             logits = jnp.where(bad,
                                jnp.asarray(jnp.nan, logits.dtype), logits)
-            nxt = pick(logits, uid[None], (pos0 + c)[None])
+            with jax.named_scope("sample"):
+                nxt = pick(logits, uid[None], (pos0 + c)[None])
             return pool, nxt[0], rows_finite(logits)[0]
 
-        return self._jit(run)
+        return run
+
+    def _build_prefill(self, c: int):
+        return self._jit(self._prefill_fn(c))
 
     # -- scheduler -----------------------------------------------------
 
@@ -556,8 +626,12 @@ class DecodeEngine:
                 f"uid {uid} shed")
         self._next_uid = max(self._next_uid, uid) + 1
         self.prompt_lens[uid] = len(prompt)
-        self.waiting.append(_Seq(uid=uid, prompt=prompt, max_new=max_new,
-                                 submit_step=self.global_step))
+        seq = _Seq(uid=uid, prompt=prompt, max_new=max_new,
+                   submit_step=self.global_step)
+        self.waiting.append(seq)
+        # the queued span opens at t_submit — the same clock latency_s
+        # measures from, so the waterfall's span sum reconciles with it
+        self.tracer.open(uid, "queued", self.global_step, t=seq.t_submit)
         return uid
 
     def resume_request(self, uid: int, prompt, max_new: int, out=(),
@@ -587,6 +661,10 @@ class DecodeEngine:
         self._next_uid = max(self._next_uid, int(uid)) + 1
         self.prompt_lens[seq.uid] = len(prompt)
         self.waiting.append(seq)
+        # a resumed request's span clock restarts NOW: the crash gap is
+        # deliberately unaccounted (the waterfall flags the request
+        # unreconciled instead of inventing a phase for dead time)
+        self.tracer.open(seq.uid, "queued", self.global_step)
         return seq.uid
 
     def _blocks_needed(self, t0: int, max_new: int) -> int:
@@ -608,6 +686,11 @@ class DecodeEngine:
         rec = {"step": self.global_step, "uid": int(uid),
                "event": event, "reason": reason, **extra}
         self.request_events.append(rec)
+        # the flight recorder's per-step decision line (compact: the
+        # digest ring is bounded memory, the durable trail is the
+        # telemetry stream)
+        self._step_events.append(
+            f"{event} uid {uid}" + (f" ({reason})" if reason else ""))
         if self.metrics is not None:
             self.metrics.request(rec)
 
@@ -670,6 +753,7 @@ class DecodeEngine:
             self.waiting.popleft()
             slot = free_slots[0]
             seq.blocks = [self.free_blocks.pop(0) for _ in range(need)]
+            self.block_allocs += need
             row = np.full((self.cfg.max_blocks_per_seq,), SCRATCH_BLOCK,
                           np.int32)
             row[:need] = seq.blocks
@@ -682,6 +766,9 @@ class DecodeEngine:
             self._event("admitted", seq.uid,
                         wait_steps=self.global_step - seq.submit_step,
                         replay=len(seq.out))
+            # admission closes whatever gap span the request sat in
+            # (queued / preempt_gap / quarantine) and starts prefill
+            self.tracer.transition(seq.uid, "prefill", self.global_step)
             admitted += 1
         return admitted
 
@@ -696,6 +783,8 @@ class DecodeEngine:
         if bad:
             self.pool = scrub_blocks(self.pool, bad)
             self._corrupted.difference_update(bad)
+            self.block_scrubs += len(bad)
+        self.block_frees += len(seq.blocks)
         self.free_blocks.extend(seq.blocks)
         seq.blocks = []
         self.tables[slot] = SCRATCH_BLOCK
@@ -708,9 +797,15 @@ class DecodeEngine:
     def _release(self, slot: int) -> None:
         seq = self.slots[slot]
         self.finished[seq.uid] = seq.prompt + seq.out
+        # ONE completion timestamp feeds both the latency record and
+        # the final span close — that identity is the reconciliation
+        # the report waterfall asserts
+        now = time.time()
         self._event("completed", seq.uid,
-                    latency_s=round(time.time() - seq.t_submit, 4),
+                    latency_s=round(now - seq.t_submit, 4),
                     n_new=len(seq.out), retries=seq.retries)
+        self.tracer.close(seq.uid, self.global_step, t=now,
+                          n_new=len(seq.out))
         self._evict(slot)
 
     def _requeue(self, seq: _Seq) -> None:
@@ -742,6 +837,8 @@ class DecodeEngine:
         self.preempted += 1
         self._event("preempted", seq.uid, reason="pool_pressure",
                     n_out=len(seq.out))
+        self.tracer.transition(seq.uid, "preempt_gap", self.global_step,
+                               reason="pool_pressure")
         self._requeue(seq)
         self._head_blocked = 0
         return True
@@ -758,6 +855,10 @@ class DecodeEngine:
         to a run that never admitted this request."""
         seq = self.slots[slot]
         blocks = list(seq.blocks)
+        # _evict scrubs-and-counts any chaos-marked blocks on its own;
+        # remember how many so the full quarantine scrub below doesn't
+        # count them twice in the schema-v5 churn counter
+        pre_scrubbed = sum(1 for b in blocks if b in self._corrupted)
         self._evict(slot)
         # scrub the owned blocks AND the shared scratch block: every
         # table pads with SCRATCH_BLOCK, so a corrupted scratch poisons
@@ -768,7 +869,13 @@ class DecodeEngine:
         # masked), so the scrub is always safe.
         self.pool = scrub_blocks(self.pool, blocks + [SCRATCH_BLOCK])
         self._corrupted.difference_update(blocks + [SCRATCH_BLOCK])
+        self.block_scrubs += len(blocks) + 1 - pre_scrubbed
         self.quarantined += 1
+        # dump the flight recorder at the END of this engine step (so
+        # the digest covering the quarantine itself is in the ring)
+        self._dump_reason = f"quarantine uid {seq.uid} ({reason})"
+        self.tracer.transition(seq.uid, "quarantine", self.global_step,
+                               reason=reason)
         if seq.retries < self.policy.max_retries:
             seq.retries += 1
             self.retried += 1
@@ -781,6 +888,7 @@ class DecodeEngine:
             return
         self._event("quarantined", seq.uid, reason=reason,
                     retrying=False, retries=seq.retries)
+        self.tracer.close(seq.uid, self.global_step, reason=reason)
         self.failed[seq.uid] = {"reason": reason, "retries": seq.retries,
                                 "n_out": len(seq.out)}
 
@@ -800,6 +908,8 @@ class DecodeEngine:
             self.expired += 1
             self._event("expired", seq.uid, reason="deadline",
                         n_out=len(seq.out))
+            self.tracer.close(seq.uid, self.global_step,
+                              reason="deadline")
             self.failed[seq.uid] = {"reason": "deadline",
                                     "retries": seq.retries,
                                     "n_out": len(seq.out)}
@@ -826,6 +936,7 @@ class DecodeEngine:
         recorded token instead (the picks match bit-for-bit on a
         healthy replay — forcing just removes the need to assume it)."""
         seq = self.slots[slot]
+        was_replaying = seq.replaying
         if seq.replaying:
             tok = seq.out[seq.emitted]
         else:
@@ -836,6 +947,37 @@ class DecodeEngine:
         self.next_token[slot] = tok
         if seq.finished:
             self._release(slot)
+        elif was_replaying and not seq.replaying:
+            # caught up: the teacher-forcing window ends, live decode
+            # begins (a new decode SEGMENT span)
+            self.tracer.transition(seq.uid, "decode", self.global_step,
+                                   replayed=len(seq.out))
+
+    @staticmethod
+    def _maybe_capture(fn, *args) -> None:
+        """The PR 2 capture hook, shared with the training launcher:
+        when ``parallel.launcher.CAPTURE_COMPILED`` is armed, append
+        this dispatch's optimized HLO so the named-scope attribution
+        contract is asserted against the REAL compiled serving program
+        (tests), not a reconstruction. None (the default) costs one
+        attribute read per dispatch.
+
+        The capture compile bypasses the persistent XLA cache: a
+        deserialized executable's ``as_text()`` drops op_name metadata
+        — exactly the scope names being asserted — and unlike the
+        shard_map'd training programs (which the cache can't serialize)
+        the single-device engine programs DO round-trip through it, so
+        a warm tier-1 cache would void the contract test."""
+        from ..parallel import launcher
+        if launcher.CAPTURE_COMPILED is None:
+            return
+        old = jax.config.jax_compilation_cache_dir
+        try:
+            jax.config.update("jax_compilation_cache_dir", None)
+            launcher.CAPTURE_COMPILED.append(
+                fn.lower(*args).compile().as_text())
+        finally:
+            jax.config.update("jax_compilation_cache_dir", old)
 
     def _prefill_step(self, slot: int) -> None:
         seq = self.slots[slot]
@@ -847,19 +989,32 @@ class DecodeEngine:
         fn = self._program("prefill", c)
         chunk = np.asarray(seq.prompt[seq.prefilled:seq.prefilled + c],
                            np.int32)
-        pool, nxt, ok = fn(self.params, self.pool,
-                           jnp.asarray(self.tables[slot]),
-                           jnp.int32(seq.prefilled), jnp.asarray(chunk),
-                           jnp.int32(seq.uid),
-                           jnp.int32(self._poison_uid))
+        args = (self.params, self.pool, jnp.asarray(self.tables[slot]),
+                jnp.int32(seq.prefilled), jnp.asarray(chunk),
+                jnp.int32(seq.uid), jnp.int32(self._poison_uid))
+        self._maybe_capture(fn, *args)
+        pool, nxt, ok = fn(*args)
         self.pool = pool
+        self._step_prefill_uid = seq.uid
+        self._step_finite = [bool(ok)]
         if not bool(ok):
             self._quarantine(slot, "nonfinite_logits")
             return
         seq.prefilled += c
         if seq.prompt_done:
             self.lengths[slot] = len(seq.prompt)
+            # the chunk that completes the prompt hands the span clock
+            # to the next phase BEFORE the emit below may release the
+            # sequence outright (max_new == 1)
+            self.tracer.transition(
+                seq.uid, "replay" if seq.replaying else "decode",
+                self.global_step, tokens=c)
             self._emit(slot, int(nxt))
+        else:
+            # one span per prefill chunk, telescoping across the engine
+            # steps spent on other slots in between
+            self.tracer.transition(seq.uid, "prefill", self.global_step,
+                                   tokens=c)
 
     def _decode_step(self, ready: list[int]) -> None:
         b = _bucket_for(len(ready), self.slot_buckets)
@@ -874,13 +1029,18 @@ class DecodeEngine:
             tokens[j] = 0
             uids[j] = 0
         fn = self._program("decode", b)
-        pool, picks, ok = fn(self.params, self.pool, jnp.asarray(tables),
-                             jnp.asarray(lengths), jnp.asarray(tokens),
-                             jnp.asarray(uids),
-                             jnp.int32(self._poison_uid))
+        args = (self.params, self.pool, jnp.asarray(tables),
+                jnp.asarray(lengths), jnp.asarray(tokens),
+                jnp.asarray(uids), jnp.int32(self._poison_uid))
+        self._maybe_capture(fn, *args)
+        pool, picks, ok = fn(*args)
         self.pool = pool
         picks = np.asarray(picks)
         ok = np.asarray(ok)
+        self._step_decode_uids = [self.slots[s].uid for s in ready]
+        flags = [bool(ok[j]) for j in range(len(ready))]
+        self._step_finite = (flags if self._step_finite is None
+                             else self._step_finite + flags)
         for j, slot in enumerate(ready):
             if not bool(ok[j]):      # pad rows are never in `ready`
                 self._quarantine(slot, "nonfinite_logits")
@@ -895,6 +1055,13 @@ class DecodeEngine:
         chunk), then one decode dispatch over every ready slot. Returns
         whether any work ran. An armed chaos poison operand applies to
         exactly this step's dispatches."""
+        # _step_events is NOT reset here: shed/rejected events from
+        # between-step submissions (and a prior dispatch-free step)
+        # belong to the next digest taken — resetting would drop them
+        # from the flight recorder entirely
+        self._step_finite = None
+        self._step_prefill_uid = None
+        self._step_decode_uids = []
         self._expire_deadlines()
         self._admit()
         did = False
@@ -913,6 +1080,19 @@ class DecodeEngine:
             self._poison_uid = POISON_NONE      # one-step fault window
             active = sum(s is not None for s in self.slots)
             self._occ_sum += active / self.cfg.max_slots
+            free = len(self.free_blocks)
+            self._free_lo = min(self._free_lo, free)
+            self._free_hi = max(self._free_hi, free)
+        if did or self._step_events:
+            # a dispatch-free step that only expired/shed requests is
+            # still a scheduler decision the post-mortem needs
+            self.flight.append(self._flight_digest())
+            self._step_events = []
+        if self._dump_reason is not None:
+            # a quarantine happened this step: dump now that the step's
+            # own digest is in the ring ("the steps UP TO the fault")
+            self.dump_flight_recorder(self._dump_reason)
+            self._dump_reason = None
         return did
 
     @property
@@ -926,15 +1106,58 @@ class DecodeEngine:
         usable = self.cfg.n_blocks - 1
         return (usable - len(self.free_blocks)) / usable
 
+    def live_tokens(self) -> int:
+        """Cached positions currently holding real KV, summed over
+        active slots. ``lengths[slot]`` only starts counting at prompt
+        completion (the decode path's position clock), so a
+        mid-prefill slot's written positions are its ``prefilled``
+        count — take the max of the two clocks."""
+        return sum(max(int(self.lengths[i]), s.prefilled)
+                   for i, s in enumerate(self.slots) if s is not None)
+
+    def kv_fragmentation(self) -> float:
+        """Unused fraction of RESERVED block capacity: reserve-on-admit
+        hands each request its whole block budget at admission, so a
+        freshly-admitted long request 'holds' capacity it hasn't
+        written yet. ``1 - live_tokens / (live_blocks * block_size)``;
+        0.0 with nothing resident."""
+        live_blocks = sum(len(s.blocks) for s in self.slots
+                          if s is not None)
+        if not live_blocks:
+            return 0.0
+        return 1.0 - self.live_tokens() / (live_blocks
+                                           * self.cfg.block_size)
+
+    def kv_bytes_stored(self) -> int:
+        """Live-token KV bytes at the engine's storage dtype — the
+        measured form of the roofline's ``B * kv_bytes`` term."""
+        return int(self.live_tokens() * kv_bytes_per_token(
+            self.cfg.kv_dtype, self.params.n_layers, self.kv_heads,
+            self.dh))
+
     def telemetry_record(self, tokens_per_sec=None) -> dict:
-        """One schema-v4 ``decode`` record (``runtime/telemetry.py``
+        """One schema-v5 ``decode`` record (``runtime/telemetry.py``
         ``DECODE_REQUIRED`` contract; the reliability counters ride as
-        extra keys)."""
+        extra keys). Reading a record CONSUMES the free-block watermark
+        window: low/high water describe the span since the previous
+        record (the cadence envelope), then reset to the instantaneous
+        value."""
+        free = len(self.free_blocks)
+        lo, hi = self._free_lo, self._free_hi
+        self._free_lo = self._free_hi = free
         return {
             "step": self.global_step,
             "tokens_per_sec": tokens_per_sec,
             "batch_occupancy": round(self.active / self.cfg.max_slots, 4),
             "kv_pool_utilization": round(self.kv_pool_utilization(), 4),
+            "free_blocks": free,
+            "free_blocks_low_water": lo,
+            "free_blocks_high_water": hi,
+            "block_allocs": self.block_allocs,
+            "block_frees": self.block_frees,
+            "block_scrubs": self.block_scrubs,
+            "kv_fragmentation": round(self.kv_fragmentation(), 4),
+            "kv_bytes_stored": self.kv_bytes_stored(),
             "active": self.active,
             "waiting": len(self.waiting),
             "tokens_generated": self.tokens_generated,
@@ -945,6 +1168,96 @@ class DecodeEngine:
             "preempted": self.preempted,
             "rejected": self.rejected,
             "expired": self.expired,
+        }
+
+    # -- flight recorder (DESIGN.md section 17) ------------------------
+
+    def _flight_digest(self) -> dict:
+        """One per-executed-step scheduler digest for the bounded ring:
+        what the scheduler decided (this step's request events), what
+        it dispatched (prefill uid / decode uids), what came back (the
+        per-row finite flags), and the pool pressure at step end."""
+        return {
+            "step": self.global_step,
+            "t": round(time.time(), 4),
+            "events": list(self._step_events),
+            "prefill_uid": self._step_prefill_uid,
+            "decode_uids": list(self._step_decode_uids),
+            "finite": self._step_finite,
+            "slots": [None if s is None else
+                      {"uid": s.uid, "pos": int(self.lengths[i]),
+                       "blocks": len(s.blocks)}
+                      for i, s in enumerate(self.slots)],
+            "occupancy": round(self.active / self.cfg.max_slots, 4),
+            "free_blocks": len(self.free_blocks),
+            "waiting": len(self.waiting),
+        }
+
+    def dump_flight_recorder(self, reason: str) -> str | None:
+        """Atomically persist the digest ring as ``flight_recorder.json``
+        next to the metrics stream (or ``self.flight_dir``): tmp +
+        fsync + rename, the checkpoint layer's publish discipline —
+        called on quarantine (engine), watchdog latch and chaos kill
+        (supervisor). Returns the path, or None when the engine has
+        nowhere to put it (no metrics dir, no explicit flight_dir)."""
+        out_dir = self.flight_dir
+        if out_dir is None and self.metrics is not None:
+            out_dir = os.path.dirname(self.metrics.path)
+        if out_dir is None:
+            return None
+        os.makedirs(out_dir, exist_ok=True)
+        path = os.path.join(out_dir, FLIGHT_FILENAME)
+        tmp = path + ".tmp"
+        doc = {"version": 1, "reason": reason,
+               "step": self.global_step, "t": time.time(),
+               "kv_dtype": self.cfg.kv_dtype,
+               "max_slots": self.cfg.max_slots,
+               "n_blocks": self.cfg.n_blocks,
+               "digests": list(self.flight)}
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return path
+
+    # -- static cost attribution (DESIGN.md section 17) ----------------
+
+    def decode_static_report(self, bucket: int | None = None) -> dict:
+        """Compile-time attribution of one decode-step program (the
+        largest slot bucket by default): a ``runtime.telemetry
+        StepReport`` (XLA cost_analysis + lowered collective counts +
+        compiled memory) over the REAL program body, cross-checked
+        against the hand-side KV accounting — ``kv_pool_bytes`` (the
+        device truth, ``paged.pool_bytes``) must equal
+        ``kv_bytes_per_token * n_blocks * block_size`` (the DECODE
+        roofline's per-dtype prediction) exactly, or the roofline
+        prices a layout the engine doesn't run. Lowering is AOT and
+        donation-free; the serving program set is untouched."""
+        from ..runtime.telemetry import StepReport
+        b = self.slot_buckets[-1] if bucket is None else bucket
+        if b not in self.slot_buckets:
+            raise ValueError(f"bucket {b} not in the engine's slot "
+                             f"buckets {self.slot_buckets}")
+        tables = jnp.full((b, self.cfg.max_blocks_per_seq),
+                          SCRATCH_BLOCK, jnp.int32)
+        z = jnp.zeros((b,), jnp.int32)
+        rep = StepReport.of(self._wrap(self._decode_fn(b)), self.params,
+                            self.pool, tables, z, z, z,
+                            jnp.int32(POISON_NONE))
+        per_tok = kv_bytes_per_token(self.cfg.kv_dtype,
+                                     self.params.n_layers,
+                                     self.kv_heads, self.dh)
+        kv_bytes, scale_bytes = pool_bytes(self.pool)
+        return {
+            "slot_bucket": b,
+            "kv_dtype": self.cfg.kv_dtype,
+            "step_report": rep.as_dict(),
+            "kv_bytes_per_token": int(per_tok),
+            "kv_pool_bytes": kv_bytes,
+            "kv_pool_bytes_predicted": int(
+                per_tok * self.cfg.n_blocks * self.cfg.block_size),
+            "kv_scale_bytes": scale_bytes,
         }
 
     def run(self, metrics=None, log_every: int = 0, before_step=None,

@@ -134,6 +134,11 @@ def build_generate_parser() -> argparse.ArgumentParser:
     p.add_argument("--metrics_dir", default=None)
     p.add_argument("--log_every", type=int, default=4,
                    help="decode-record cadence in engine steps")
+    p.add_argument("--engine_id", default=None,
+                   help="engine label stamped in the run's meta records "
+                        "(default: the metrics dir's basename); the "
+                        "multi-stream `report A B ...` merge keys "
+                        "per-engine percentiles on it")
     return p
 
 
@@ -267,10 +272,15 @@ def generate_main(argv=None) -> int:
                 return 2
 
     metrics = None
+    engine_id = args.engine_id
     if args.metrics_dir:
         from ..runtime.telemetry import TelemetryWriter
+        if engine_id is None:
+            engine_id = os.path.basename(
+                os.path.normpath(args.metrics_dir))
         meta = {
             "argv": list(argv or []), "subcommand": "generate",
+            "engine_id": engine_id,
             "vocab": args.vocab, "model_size": args.model_size,
             "layers": args.layers, "heads": args.heads,
             "kv_dtype": args.kv_dtype, "max_slots": args.max_slots,
@@ -357,6 +367,8 @@ def generate_main(argv=None) -> int:
     }
     if resumed_from is not None:
         payload["resumed_from_step"] = resumed_from
+    if engine_id is not None:
+        payload["engine_id"] = engine_id
     print(json.dumps(payload))
     return 0
 

@@ -84,6 +84,18 @@ def kv_bytes_per_token(kv_dtype: str, n_layers: int, kv_heads: int,
     return 2 * n_layers * kv_heads * head_dim * per_elt
 
 
+def pool_bytes(pool: PagedKV) -> tuple[int, int]:
+    """``(kv_bytes, scale_bytes)`` actually held by the pool arrays —
+    the device-side truth ``decode_static_report`` cross-checks against
+    the roofline's hand prediction (``kv_bytes_per_token * n_blocks *
+    block_size``; the two MUST agree exactly, or the roofline prices a
+    layout the engine doesn't run)."""
+    kv = int(pool.k.nbytes) + int(pool.v.nbytes)
+    sc = (0 if pool.k_scale is None
+          else int(pool.k_scale.nbytes) + int(pool.v_scale.nbytes))
+    return kv, sc
+
+
 def init_pool(n_layers: int, n_blocks: int, kv_heads: int,
               block_size: int, head_dim: int,
               kv_dtype: str = "f32") -> PagedKV:
@@ -139,12 +151,18 @@ def write_rows(pool: PagedKV, layer: int, phys: jax.Array,
     writer wins there, and nothing reads it unmasked."""
     hkv = pool.k.shape[2]
     heads = jnp.arange(hkv)
+    # "requant" tags the KV write in traces/HLO (utils/trace_analysis
+    # SCOPES: decode/requant, prefill/requant). At f32/bf16 the region
+    # is the plain scatter; the name stays "requant" because the int8
+    # read-modify-requantize is the cost the attribution exists to
+    # separate — the cheap dtypes show the region near zero.
     if kv_dtype != "int8":
         dt = pool.k.dtype
         idx = (layer, phys[:, None], heads[None, :], off[:, None])
-        return pool._replace(
-            k=pool.k.at[idx].set(k_new.astype(dt)),
-            v=pool.v.at[idx].set(v_new.astype(dt)))
+        with jax.named_scope("requant"):
+            return pool._replace(
+                k=pool.k.at[idx].set(k_new.astype(dt)),
+                v=pool.v.at[idx].set(v_new.astype(dt)))
     # int8: read-modify-requantize the touched blocks
     blk = pool.block_size
     rows = jnp.arange(blk)
@@ -160,8 +178,9 @@ def write_rows(pool: PagedKV, layer: int, phys: jax.Array,
         return (pool_side.at[layer, phys].set(q),
                 scale_side.at[layer, phys].set(scale))
 
-    k, ks = requant(pool.k, pool.k_scale, k_new)
-    v, vs = requant(pool.v, pool.v_scale, v_new)
+    with jax.named_scope("requant"):
+        k, ks = requant(pool.k, pool.k_scale, k_new)
+        v, vs = requant(pool.v, pool.v_scale, v_new)
     return PagedKV(k=k, v=v, k_scale=ks, v_scale=vs)
 
 
@@ -204,8 +223,9 @@ def write_chunk(pool: PagedKV, layer: int, table: jax.Array, pos0,
         return (pool_side.at[layer, blocks].set(q),
                 scale_side.at[layer, blocks].set(scale))
 
-    k, ks = quant_whole(pool.k, pool.k_scale, k_new)
-    v, vs = quant_whole(pool.v, pool.v_scale, v_new)
+    with jax.named_scope("requant"):
+        k, ks = quant_whole(pool.k, pool.k_scale, k_new)
+        v, vs = quant_whole(pool.v, pool.v_scale, v_new)
     return PagedKV(k=k, v=v, k_scale=ks, v_scale=vs)
 
 
@@ -231,8 +251,9 @@ def _int8_partial_chunk(pool: PagedKV, layer: int, phys, off: jax.Array,
         return (pool_side.at[layer, phys].set(q),
                 scale_side.at[layer, phys].set(scale))
 
-    k, ks = requant(pool.k, pool.k_scale, k_new)
-    v, vs = requant(pool.v, pool.v_scale, v_new)
+    with jax.named_scope("requant"):
+        k, ks = requant(pool.k, pool.k_scale, k_new)
+        v, vs = requant(pool.v, pool.v_scale, v_new)
     return PagedKV(k=k, v=v, k_scale=ks, v_scale=vs)
 
 
@@ -292,15 +313,19 @@ def gather_layer(pool: PagedKV, layer: int, table: jax.Array):
     ``models.attention.gather_paged_kv`` — the attention read against a
     block table; this wrapper only adds the dtype story."""
     from ..models.attention import gather_paged_kv
-    k, v = gather_paged_kv(pool.k[layer], pool.v[layer], table)
-    if pool.k_scale is None:
-        if k.dtype != jnp.float32:
-            k = k.astype(jnp.float32)
-            v = v.astype(jnp.float32)
-        return k, v
-    blk = pool.block_size
-    # per-block scales -> per-position: [MB, Hkv] -> [Hkv, MB*blk]
-    ks = jnp.repeat(pool.k_scale[layer][table].T, blk, axis=1)
-    vs = jnp.repeat(pool.v_scale[layer][table].T, blk, axis=1)
-    return (k.astype(jnp.float32) * ks[..., None],
-            v.astype(jnp.float32) * vs[..., None])
+    # "gather" tags the block-table read + dequant in traces/HLO
+    # (utils/trace_analysis SCOPES: decode/gather, prefill/gather) —
+    # the paged-KV traffic term the DECODE roofline prices
+    with jax.named_scope("gather"):
+        k, v = gather_paged_kv(pool.k[layer], pool.v[layer], table)
+        if pool.k_scale is None:
+            if k.dtype != jnp.float32:
+                k = k.astype(jnp.float32)
+                v = v.astype(jnp.float32)
+            return k, v
+        blk = pool.block_size
+        # per-block scales -> per-position: [MB, Hkv] -> [Hkv, MB*blk]
+        ks = jnp.repeat(pool.k_scale[layer][table].T, blk, axis=1)
+        vs = jnp.repeat(pool.v_scale[layer][table].T, blk, axis=1)
+        return (k.astype(jnp.float32) * ks[..., None],
+                v.astype(jnp.float32) * vs[..., None])

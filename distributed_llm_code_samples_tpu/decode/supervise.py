@@ -52,7 +52,10 @@ from ..runtime.failure import _head, backoff_delay
 from .engine import AdmissionError, DecodeEngine, POISON_ALL
 
 SNAPSHOT_FILENAME = "engine_snapshot.json"
-SNAPSHOT_VERSION = 1
+# v2 (round 11): counters grow the KV-pool churn trio (block_allocs /
+# block_frees / block_scrubs) so the schema-v5 decode records stay
+# monotonic across crash-resume
+SNAPSHOT_VERSION = 2
 
 
 # ---------------------------------------------------------------- snapshot
@@ -125,6 +128,9 @@ def snapshot_state(engine: DecodeEngine) -> dict:
             "preempted": engine.preempted,
             "rejected": engine.rejected,
             "expired": engine.expired,
+            "block_allocs": engine.block_allocs,
+            "block_frees": engine.block_frees,
+            "block_scrubs": engine.block_scrubs,
         },
     }
     if engine.pool.k_scale is not None:
@@ -222,6 +228,9 @@ def restore_engine_state(engine: DecodeEngine, snap: dict) -> None:
     engine.preempted = int(c["preempted"])
     engine.rejected = int(c["rejected"])
     engine.expired = int(c["expired"])
+    engine.block_allocs = int(c["block_allocs"])
+    engine.block_frees = int(c["block_frees"])
+    engine.block_scrubs = int(c["block_scrubs"])
     for req in snap["requests"]:
         engine.resume_request(req["uid"], req["prompt"], req["max_new"],
                               out=req["out"], retries=req["retries"],
@@ -348,6 +357,9 @@ def supervise_decode(make_engine, requests=(), *, snapshot_dir: str,
                     log(rec)
                     if metrics is not None:
                         metrics.event(rec)
+                    # what was the engine doing before it stalled —
+                    # the flight recorder is the watchdog's evidence
+                    _eng.dump_flight_recorder(f"watchdog step {g}")
                 _dog.kick()
             due_kill = (chaos is not None and any(
                 f.kind == "kill" for f in chaos.decode_due(g)))
@@ -360,6 +372,9 @@ def supervise_decode(make_engine, requests=(), *, snapshot_dir: str,
                     if f.kind == "kill":
                         chaos._note(f, snapshot_step=g)
                         log({"event": "chaos_kill", "step": g})
+                        # the post-mortem the dead process can't write
+                        # later: dump BEFORE the SIGKILL
+                        _eng.dump_flight_recorder(f"chaos_kill step {g}")
                         os.kill(os.getpid(), signal.SIGKILL)
 
         t0 = time.monotonic()

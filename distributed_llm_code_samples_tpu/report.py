@@ -1,26 +1,36 @@
-"""`report` — fold a run's telemetry streams into one human-readable
-run report.
+"""`report` — fold one or more runs' telemetry streams into one
+human-readable run report.
 
-Inputs (all optional except the metrics dir):
+Inputs (all optional except at least one metrics dir):
 
-- the metrics JSONL a ``--metrics_dir`` run wrote
-  (``runtime/telemetry.py`` schema: per-step records + recovery/chaos
-  events + run meta),
+- one or MORE metrics dirs (``report A B ...``): each is the JSONL a
+  ``--metrics_dir`` run wrote (``runtime/telemetry.py`` schema).
+  Serving runs stamp an ``engine_id`` in their meta records
+  (``generate --engine_id``); the multi-stream merge keys per-engine
+  stats on it (falling back to the dir basename) and folds every
+  stream's events onto ONE wall-clock timeline — the per-engine
+  latency/shed-percentile contract the fleet-scale router (ROADMAP
+  item 3) is measured against,
 - supervise's per-attempt JSONL (``runtime/failure.py``) — passed with
-  ``--attempt_log`` or auto-discovered from the run's meta records,
+  ``--attempt_log`` or auto-discovered from each run's meta records,
 - a profile directory (``--profile_dir``) captured with
-  ``--profile_dir`` / ``jax.profiler.trace`` — folded through
-  ``utils/trace_analysis`` into comm/compute overlap and per-named-scope
-  region totals.
+  ``jax.profiler.trace`` — folded through ``utils/trace_analysis``
+  into comm/compute overlap and per-named-scope region totals,
+- ``--postmortem``: render each stream's flight-recorder dump
+  (``decode/engine.py`` ``flight_recorder.json`` — the bounded ring of
+  per-step scheduler digests persisted on quarantine/watchdog/kill).
 
-Output: step-time percentiles, throughput, MFU, HBM high-water, and ONE
-merged timeline carrying training progress, faults, recovery attempts,
-and post-recovery steps in wall-clock order — the "what happened to this
-run" view the reference answered with scattered prints
-(``train_ffns.py:378-382``).
+Output: step-time percentiles, throughput, MFU, HBM high-water, the
+serving summary + reliability block per engine, a per-request
+**waterfall** (schema-v5 ``span`` records: queued / prefill / replay /
+decode / quarantine / preempt_gap, whose summed durations RECONCILE
+with each completed request's recorded ``latency_s``), and ONE merged
+timeline carrying every stream's progress, faults, and recoveries in
+wall-clock order.
 
 Exit codes: 0 = report rendered (schema problems are listed, not
-fatal); 2 = no usable metrics stream.
+fatal; a record-free stream renders an explicit "no records" summary);
+2 = no metrics stream exists at any given path.
 """
 
 from __future__ import annotations
@@ -32,7 +42,13 @@ import sys
 
 import numpy as np
 
-from .runtime.telemetry import METRICS_FILENAME, read_metrics
+from .runtime.telemetry import (FLIGHT_FILENAME, METRICS_FILENAME,
+                                read_metrics)
+
+# a completed request's span durations telescope to its latency by
+# construction (runtime/tracing.py); the tolerance only absorbs the
+# per-record rounding (latency 4 decimals, durations 6)
+RECONCILE_TOL_S = 0.01
 
 
 def _fmt_bytes(n: int | None) -> str:
@@ -142,146 +158,154 @@ def _describe_event(rec: dict) -> str:
         if k not in ("event", "t", "kind", "schema"))
 
 
-def report_main(argv=None) -> int:
-    p = argparse.ArgumentParser(
-        prog="report",
-        description="Fold a --metrics_dir run (+ supervise attempt log "
-                    "+ optional profile dir) into one run report")
-    p.add_argument("metrics_dir",
-                   help="the run's --metrics_dir (holds metrics.jsonl)")
-    p.add_argument("--attempt_log", default=None,
-                   help="supervise's per-attempt JSONL (default: "
-                        "discovered from the run's meta records)")
-    p.add_argument("--profile_dir", default=None,
-                   help="a trace directory captured with --profile_dir; "
-                        "adds comm/compute overlap + per-named-scope "
-                        "totals")
-    p.add_argument("--json", action="store_true",
-                   help="emit the folded report as one JSON object "
-                        "instead of text")
-    args = p.parse_args(argv)
+def _stats_of(group):
+    """Per-strategy step statistics (multi-method runs interleave
+    strategies in one stream; pooled percentiles would describe no
+    actual run)."""
+    times = [s["step_time_s"] for s in group
+             if s.get("step_time_s") is not None]
+    # the first logged chunk usually carries compile time; report
+    # steady-state percentiles over the rest when there is a rest
+    steady = times[1:] if len(times) > 1 else times
+    tps = [s["tokens_per_sec"] for s in group
+           if s.get("tokens_per_sec") is not None]
+    mfus = [s["mfu"] for s in group if s.get("mfu") is not None]
+    losses = [s["loss"] for s in group if s.get("loss") is not None]
+    hbm = [max(s["hbm_high_water_bytes"].values())
+           for s in group if s.get("hbm_high_water_bytes")]
+    stats = {
+        "logged_steps": len(group),
+        "first_step": group[0]["step"],
+        "last_step": group[-1]["step"],
+    }
+    if steady:
+        q = np.percentile(np.asarray(steady, np.float64), [50, 90, 99])
+        stats["step_time_p50_ms"] = round(float(q[0]) * 1e3, 3)
+        stats["step_time_p90_ms"] = round(float(q[1]) * 1e3, 3)
+        stats["step_time_p99_ms"] = round(float(q[2]) * 1e3, 3)
+    if tps:
+        stats["tokens_per_sec_mean"] = round(float(np.mean(tps)), 1)
+        stats["tokens_per_sec_best"] = round(float(np.max(tps)), 1)
+    if mfus:
+        stats["mfu_mean"] = round(float(np.mean(mfus)), 4)
+        stats["mfu_best"] = round(float(np.max(mfus)), 4)
+    if losses:
+        stats["first_loss"] = round(losses[0], 4)
+        stats["last_loss"] = round(losses[-1], 4)
+    if hbm:
+        stats["hbm_high_water_bytes"] = int(max(hbm))
+    return stats
 
-    path = args.metrics_dir
-    if os.path.isdir(path):
-        path = os.path.join(path, METRICS_FILENAME)
-    if not os.path.exists(path):
-        print(f"report: no metrics stream at {path}", file=sys.stderr)
-        return 2
-    records, problems = read_metrics(path)
-    if not records:
-        print(f"report: {path} holds no schema-valid records "
-              f"({len(problems)} problem(s))", file=sys.stderr)
-        for prob in problems:
-            print(f"report:   {prob}", file=sys.stderr)
-        return 2
 
-    metas = [r for r in records if r["kind"] == "meta"]
-    steps = [r for r in records if r["kind"] == "step"]
-    events = [r for r in records if r["kind"] == "event"]
-    benches = [r for r in records if r["kind"] == "bench"]
-    anomalies = [r for r in records if r["kind"] == "anomaly"]
-    rollbacks = [r for r in records if r["kind"] == "rollback"]
-    decodes = [r for r in records if r["kind"] == "decode"]
-    # request records: drop exact replays — an in-process supervisor
-    # restart resumes from a snapshot that may PREDATE records already
-    # emitted, so the replayed steps re-emit identical (uid, event,
-    # step) transitions (the global step is stable across restarts).
-    # Legitimate repeats — a re-admission after preemption, a second
-    # quarantine — land at different global steps; anonymous rejected
-    # records (uid -1) are kept verbatim (distinct sheds can share a
-    # step). Same stance as the attempt-log dedup below.
-    requests = []
-    seen_req = set()
-    for r in records:
-        if r["kind"] != "request":
-            continue
-        key = (r.get("uid"), r.get("event"), r.get("step"))
-        if r.get("event") != "rejected" and key in seen_req:
-            continue
-        seen_req.add(key)
-        requests.append(r)
+class _Stream:
+    """One metrics dir's parsed state + its folded report sections."""
 
-    # attempt log: flag wins; else the newest meta that names one
-    attempt_path = args.attempt_log
-    if attempt_path is None:
-        for m in reversed(metas):
-            if m.get("attempt_log"):
-                attempt_path = m["attempt_log"]
-                break
-    attempts = _load_attempt_log(attempt_path) if attempt_path else []
-    if attempt_path and not attempts and not os.path.exists(attempt_path):
-        problems.append(f"attempt log {attempt_path} unreadable — "
-                        "recovery events missing from the timeline")
+    def __init__(self, metrics_dir: str, attempt_log: str | None):
+        self.dir = metrics_dir
+        path = metrics_dir
+        if os.path.isdir(path):
+            path = os.path.join(path, METRICS_FILENAME)
+        self.path = path
+        self.exists = os.path.exists(path)
+        # an EXISTING dir with no metrics.jsonl is a run that wrote
+        # nothing — a record-free answer (rc 0), not a bad path (rc 2)
+        self.dir_exists = self.exists or os.path.isdir(metrics_dir)
+        self.records: list[dict] = []
+        self.problems: list[str] = []
+        if self.exists:
+            self.records, self.problems = read_metrics(path)
+        elif self.dir_exists:
+            self.problems.append(f"no {METRICS_FILENAME} in "
+                                 f"{metrics_dir} (empty metrics dir)")
+        by = {}
+        for r in self.records:
+            by.setdefault(r["kind"], []).append(r)
+        self.metas = by.get("meta", [])
+        self.steps = by.get("step", [])
+        self.events = by.get("event", [])
+        self.benches = by.get("bench", [])
+        self.anomalies = by.get("anomaly", [])
+        self.rollbacks = by.get("rollback", [])
+        self.decodes = by.get("decode", [])
+        # request records: drop exact replays — an in-process
+        # supervisor restart resumes from a snapshot that may PREDATE
+        # records already emitted, so the replayed steps re-emit
+        # identical (uid, event, step) transitions (the global step is
+        # stable across restarts). Legitimate repeats — a re-admission
+        # after preemption, a second quarantine — land at different
+        # global steps; anonymous rejected records (uid -1) are kept
+        # verbatim (distinct sheds can share a step). Same stance as
+        # the attempt-log dedup below.
+        self.requests = []
+        seen_req = set()
+        for r in by.get("request", []):
+            key = (r.get("uid"), r.get("event"), r.get("step"))
+            if r.get("event") != "rejected" and key in seen_req:
+                continue
+            seen_req.add(key)
+            self.requests.append(r)
+        # span records: the same replay-dedup, keyed on the span's full
+        # step window (two prefill-chunk spans can share a start_step —
+        # admission and the first chunk land in one engine step)
+        self.spans = []
+        seen_span = set()
+        for s in by.get("span", []):
+            key = (s.get("uid"), s.get("span"), s.get("start_step"),
+                   s.get("step"))
+            if key in seen_span:
+                continue
+            seen_span.add(key)
+            self.spans.append(s)
 
-    doc: dict = {"metrics_path": path, "n_records": len(records),
-                 "problems": problems}
+        # run header: later metas refine earlier ones
+        self.header = {}
+        for m in self.metas:
+            self.header.update({k: v for k, v in m.items()
+                                if k not in ("kind", "t", "schema")})
+        self.label = self.header.get("engine_id") or os.path.basename(
+            os.path.normpath(metrics_dir))
 
-    # ---- run header --------------------------------------------------
-    header = {}
-    for m in metas:  # later metas refine earlier ones
-        header.update({k: v for k, v in m.items()
-                       if k not in ("kind", "t", "schema")})
-    doc["run"] = header
+        # attempt log: flag wins; else the newest meta that names one
+        self.attempt_path = attempt_log
+        if self.attempt_path is None:
+            for m in reversed(self.metas):
+                if m.get("attempt_log"):
+                    self.attempt_path = m["attempt_log"]
+                    break
+        self.attempts = (_load_attempt_log(self.attempt_path)
+                         if self.attempt_path else [])
+        if self.attempt_path and not self.attempts \
+                and not os.path.exists(self.attempt_path):
+            self.problems.append(
+                f"attempt log {self.attempt_path} unreadable — "
+                "recovery events missing from the timeline")
 
-    # ---- step statistics, grouped per strategy ----------------------
-    # multi-method runs (-m 0 / -m 9) interleave strategies in one
-    # stream; pooled percentiles would describe no actual run
-    def _stats_of(group):
-        times = [s["step_time_s"] for s in group
-                 if s.get("step_time_s") is not None]
-        # the first logged chunk usually carries compile time; report
-        # steady-state percentiles over the rest when there is a rest
-        steady = times[1:] if len(times) > 1 else times
-        tps = [s["tokens_per_sec"] for s in group
-               if s.get("tokens_per_sec") is not None]
-        mfus = [s["mfu"] for s in group if s.get("mfu") is not None]
-        losses = [s["loss"] for s in group if s.get("loss") is not None]
-        hbm = [max(s["hbm_high_water_bytes"].values())
-               for s in group if s.get("hbm_high_water_bytes")]
-        stats = {
-            "logged_steps": len(group),
-            "first_step": group[0]["step"],
-            "last_step": group[-1]["step"],
-        }
-        if steady:
-            q = np.percentile(np.asarray(steady, np.float64),
-                              [50, 90, 99])
-            stats["step_time_p50_ms"] = round(float(q[0]) * 1e3, 3)
-            stats["step_time_p90_ms"] = round(float(q[1]) * 1e3, 3)
-            stats["step_time_p99_ms"] = round(float(q[2]) * 1e3, 3)
-        if tps:
-            stats["tokens_per_sec_mean"] = round(float(np.mean(tps)), 1)
-            stats["tokens_per_sec_best"] = round(float(np.max(tps)), 1)
-        if mfus:
-            stats["mfu_mean"] = round(float(np.mean(mfus)), 4)
-            stats["mfu_best"] = round(float(np.max(mfus)), 4)
-        if losses:
-            stats["first_loss"] = round(losses[0], 4)
-            stats["last_loss"] = round(losses[-1], 4)
-        if hbm:
-            stats["hbm_high_water_bytes"] = int(max(hbm))
-        return stats
+    # ---- folded sections -------------------------------------------
 
-    if steps:
+    def step_stats(self) -> dict:
         by_strategy: dict = {}
-        for s in steps:
-            by_strategy.setdefault(s.get("strategy") or "run", []).append(s)
-        doc["steps"] = {k: _stats_of(v) for k, v in by_strategy.items()}
+        for s in self.steps:
+            by_strategy.setdefault(s.get("strategy") or "run",
+                                   []).append(s)
+        return {k: _stats_of(v) for k, v in by_strategy.items()}
 
-    # ---- serving (decode engine) summary ----------------------------
-    if decodes:
+    def serving(self) -> dict | None:
+        decodes = self.decodes
+        if not decodes:
+            return None
         tps = [d["tokens_per_sec"] for d in decodes
                if d.get("tokens_per_sec") is not None]
         occ = [d["batch_occupancy"] for d in decodes
                if d.get("batch_occupancy") is not None]
         util = [d["kv_pool_utilization"] for d in decodes
                 if d.get("kv_pool_utilization") is not None]
+        last = decodes[-1]
         serving = {
             "records": len(decodes),
-            "engine_steps": decodes[-1].get("step"),
-            "tokens_generated": decodes[-1].get("tokens_generated"),
-            "kv_dtype": decodes[-1].get("kv_dtype"),
-            "compiled_programs": decodes[-1].get("compiled_programs"),
+            "engine_steps": last.get("step"),
+            "tokens_generated": last.get("tokens_generated"),
+            "kv_dtype": last.get("kv_dtype"),
+            "compiled_programs": last.get("compiled_programs"),
         }
         if tps:
             serving["tokens_per_sec_mean"] = round(float(np.mean(tps)), 1)
@@ -289,12 +313,32 @@ def report_main(argv=None) -> int:
         if occ:
             serving["batch_occupancy_mean"] = round(float(np.mean(occ)), 4)
         if util:
-            serving["kv_pool_utilization_max"] = round(float(np.max(util)),
-                                                       4)
-        doc["serving"] = serving
+            serving["kv_pool_utilization_max"] = round(
+                float(np.max(util)), 4)
+        # schema-v5 KV-pool internals (older v4-era streams fail schema
+        # validation wholesale, so presence here is all-or-nothing)
+        lows = [d["free_blocks_low_water"] for d in decodes
+                if d.get("free_blocks_low_water") is not None]
+        frags = [d["kv_fragmentation"] for d in decodes
+                 if d.get("kv_fragmentation") is not None]
+        stored = [d["kv_bytes_stored"] for d in decodes
+                  if d.get("kv_bytes_stored") is not None]
+        if lows:
+            serving["free_blocks_low_water"] = int(min(lows))
+        if frags:
+            serving["kv_fragmentation_max"] = round(float(np.max(frags)),
+                                                    4)
+        if stored:
+            serving["kv_bytes_stored_max"] = int(max(stored))
+        for key in ("block_allocs", "block_frees", "block_scrubs"):
+            if last.get(key) is not None:
+                serving[key] = last[key]
+        return serving
 
-    # ---- serving reliability (request lifecycle records) ------------
-    if requests:
+    def reliability(self) -> dict | None:
+        requests = self.requests
+        if not requests:
+            return None
         by_event: dict[str, int] = {}
         for r in requests:
             by_event[r["event"]] = by_event.get(r["event"], 0) + 1
@@ -326,111 +370,138 @@ def report_main(argv=None) -> int:
             rel["latency_p50_s"] = round(float(q[0]), 4)
             rel["latency_p90_s"] = round(float(q[1]), 4)
             rel["latency_p99_s"] = round(float(q[2]), 4)
-        doc["serving_reliability"] = rel
+        return rel
 
-    # ---- recovery / chaos summary -----------------------------------
-    fails = [a for a in attempts if a.get("event") == "attempt_failed"]
-    doc["recovery"] = {
-        "attempt_log": attempt_path,
-        "attempts_failed": len(fails),
-        "completed": any(a.get("event") == "completed" for a in attempts),
-        "nonfinite_skips": sum(1 for e in events
-                               if e.get("event") == "nonfinite_skip"),
-        "publishes": sum(1 for e in events
-                         if e.get("event") == "published"),
-        # the self-healing ladder's cheap rungs (schema v2 kinds)
-        "in_graph_skips": sum(int(a.get("skipped") or 0)
-                              for a in anomalies),
-        "rollbacks": len(rollbacks),
-        "loss_spikes": sum(1 for e in events
-                           if e.get("event") == "loss_spike"),
-    }
+    def recovery(self) -> dict:
+        fails = [a for a in self.attempts
+                 if a.get("event") == "attempt_failed"]
+        return {
+            "attempt_log": self.attempt_path,
+            "attempts_failed": len(fails),
+            "completed": any(a.get("event") == "completed"
+                             for a in self.attempts),
+            "nonfinite_skips": sum(1 for e in self.events
+                                   if e.get("event") == "nonfinite_skip"),
+            "publishes": sum(1 for e in self.events
+                             if e.get("event") == "published"),
+            # the self-healing ladder's cheap rungs (schema v2 kinds)
+            "in_graph_skips": sum(int(a.get("skipped") or 0)
+                                  for a in self.anomalies),
+            "rollbacks": len(self.rollbacks),
+            "loss_spikes": sum(1 for e in self.events
+                               if e.get("event") == "loss_spike"),
+        }
 
-    # ---- one merged timeline ----------------------------------------
-    timeline = []
-    for s in steps:
-        timeline.append((s["t"], "step", _describe_step(s)))
-    seen_events = {(e.get("t"), e.get("event")) for e in events}
-    for e in events:
-        timeline.append((e["t"], "event", _describe_event(e)))
-    for a in anomalies:
-        timeline.append((a["t"], "anomaly", _describe_event(a)))
-        seen_events.add((a.get("t"), "anomaly"))
-    for r in rollbacks:
-        timeline.append((r["t"], "rollbck", _describe_event(r)))
-        seen_events.add((r.get("t"), "rollback"))
-    for d in decodes:
-        bits = [f"engine step {d.get('step')}"]
-        if d.get("tokens_per_sec") is not None:
-            bits.append(f"{d['tokens_per_sec']:.0f} tok/s")
-        if d.get("batch_occupancy") is not None:
-            bits.append(f"occ {d['batch_occupancy']:.2f}")
-        if d.get("kv_pool_utilization") is not None:
-            bits.append(f"kv {d['kv_pool_utilization']:.2f}")
-        if d.get("waiting"):
-            bits.append(f"{d['waiting']} waiting")
-        timeline.append((d["t"], "decode", "  ".join(bits)))
-    for r in requests:
-        ev = r["event"]
-        bits = [f"request {r.get('uid')} {ev.upper()}"
-                + (f" ({r['reason']})" if r.get("reason") else "")
-                + f" @ engine step {r.get('step')}"]
-        if ev == "completed":
-            if r.get("latency_s") is not None:
-                bits.append(f"latency {r['latency_s']:.3f}s")
-            if r.get("n_new") is not None:
-                bits.append(f"{r['n_new']} token(s)")
-            if r.get("retries"):
-                bits.append(f"{r['retries']} retry(ies)")
-        elif ev == "retried":
-            bits.append(f"attempt {r.get('attempt')}/"
-                        f"{r.get('max_retries')}")
-        elif ev == "quarantined" and not r.get("retrying"):
-            bits.append("FAILED")
-        timeline.append((r["t"], "request", "  ".join(bits)))
-    for a in attempts:
-        # supervise forwards checkpoint-layer events to its log too;
-        # drop exact duplicates of what the metrics stream already has
-        if (a.get("t"), a.get("event")) in seen_events:
-            continue
-        timeline.append((a.get("t", 0.0), "attempt", _describe_event(a)))
-    timeline.sort(key=lambda x: x[0])
-    doc["timeline"] = [{"t": t, "source": src, "what": what}
-                       for t, src, what in timeline]
+    def waterfalls(self) -> dict:
+        """Per-uid span waterfall: phase breakdown + the span-sum vs
+        latency reconciliation (runtime/tracing.py's telescoping
+        contract — a completed request whose spans DON'T sum to its
+        latency had unaccounted wall time, e.g. a crash gap)."""
+        if not self.spans:
+            return {}
+        lat = {r["uid"]: r.get("latency_s") for r in self.requests
+               if r["event"] == "completed"}
+        by_uid: dict = {}
+        for s in self.spans:
+            by_uid.setdefault(s["uid"], []).append(s)
+        out = {}
+        for uid in sorted(by_uid):
+            ss = sorted(by_uid[uid],
+                        key=lambda s: (s.get("start_t") or 0.0,
+                                       s.get("t") or 0.0))
+            total = round(sum(s.get("duration_s") or 0.0 for s in ss), 4)
+            latency = lat.get(uid)
+            out[str(uid)] = {
+                "spans": [{
+                    "span": s["span"],
+                    "duration_s": s.get("duration_s"),
+                    "start_step": s.get("start_step"),
+                    "end_step": s.get("step"),
+                } for s in ss],
+                "span_sum_s": total,
+                "latency_s": latency,
+                "reconciled": (latency is not None
+                               and abs(total - latency)
+                               <= RECONCILE_TOL_S),
+            }
+        return out
 
-    # ---- profile folding --------------------------------------------
-    if args.profile_dir:
-        from .utils.trace_analysis import (load_spans, overlap_payload,
-                                           scope_totals,
-                                           strategy_scope_key)
-        # one gunzip+parse feeds both analyses (hardware traces run to
-        # hundreds of MB — never load twice)
-        trace_file, spans = load_spans(args.profile_dir)
-        prof = overlap_payload(spans, trace_file)
-        # fold per-region totals under the RUN's strategy when the meta
-        # records name one; unknown strategies fall back to the
-        # prefixed-regions union (scope_totals documents why)
-        scope_key = strategy_scope_key(header.get("strategy"))
-        prof["scope_totals_us"] = {
-            k: round(v, 1)
-            for k, v in scope_totals(spans, scope_key).items() if v}
-        doc["profile"] = prof
+    def flight_recorder(self) -> dict | None:
+        """The stream's flight-recorder dump, if one was persisted
+        (decode/engine.py dumps on quarantine; the supervisor on
+        watchdog latch and chaos kill)."""
+        path = os.path.join(os.path.dirname(self.path), FLIGHT_FILENAME)
+        if not os.path.exists(path):
+            return None
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except ValueError:
+            return {"error": f"unparseable flight recorder at {path}"}
+        doc["path"] = path
+        return doc
 
-    if benches:
-        doc["bench_rows"] = len(benches)
+    def timeline_entries(self) -> list[tuple[float, str, str]]:
+        timeline = []
+        for s in self.steps:
+            timeline.append((s["t"], "step", _describe_step(s)))
+        seen_events = {(e.get("t"), e.get("event")) for e in self.events}
+        for e in self.events:
+            timeline.append((e["t"], "event", _describe_event(e)))
+        for a in self.anomalies:
+            timeline.append((a["t"], "anomaly", _describe_event(a)))
+            seen_events.add((a.get("t"), "anomaly"))
+        for r in self.rollbacks:
+            timeline.append((r["t"], "rollbck", _describe_event(r)))
+            seen_events.add((r.get("t"), "rollback"))
+        for d in self.decodes:
+            bits = [f"engine step {d.get('step')}"]
+            if d.get("tokens_per_sec") is not None:
+                bits.append(f"{d['tokens_per_sec']:.0f} tok/s")
+            if d.get("batch_occupancy") is not None:
+                bits.append(f"occ {d['batch_occupancy']:.2f}")
+            if d.get("kv_pool_utilization") is not None:
+                bits.append(f"kv {d['kv_pool_utilization']:.2f}")
+            if d.get("kv_fragmentation"):
+                bits.append(f"frag {d['kv_fragmentation']:.2f}")
+            if d.get("waiting"):
+                bits.append(f"{d['waiting']} waiting")
+            timeline.append((d["t"], "decode", "  ".join(bits)))
+        for r in self.requests:
+            ev = r["event"]
+            bits = [f"request {r.get('uid')} {ev.upper()}"
+                    + (f" ({r['reason']})" if r.get("reason") else "")
+                    + f" @ engine step {r.get('step')}"]
+            if ev == "completed":
+                if r.get("latency_s") is not None:
+                    bits.append(f"latency {r['latency_s']:.3f}s")
+                if r.get("n_new") is not None:
+                    bits.append(f"{r['n_new']} token(s)")
+                if r.get("retries"):
+                    bits.append(f"{r['retries']} retry(ies)")
+            elif ev == "retried":
+                bits.append(f"attempt {r.get('attempt')}/"
+                            f"{r.get('max_retries')}")
+            elif ev == "quarantined" and not r.get("retrying"):
+                bits.append("FAILED")
+            timeline.append((r["t"], "request", "  ".join(bits)))
+        for a in self.attempts:
+            # supervise forwards checkpoint-layer events to its log
+            # too; drop exact duplicates of what the metrics stream
+            # already has
+            if (a.get("t"), a.get("event")) in seen_events:
+                continue
+            timeline.append((a.get("t", 0.0), "attempt",
+                             _describe_event(a)))
+        return timeline
 
-    if args.json:
-        print(json.dumps(doc, indent=1))
-        return 0
 
-    # ---- render ------------------------------------------------------
-    out = []
-    out.append("=" * 72)
-    out.append(f"RUN REPORT — {path}")
-    out.append("=" * 72)
-    if header:
+def _render_engine_sections(out: list, doc: dict) -> None:
+    """Text render of one stream's folded sections (appended to
+    ``out``) — shared between the single- and multi-stream layouts."""
+    if doc.get("run"):
         out.append("run config:")
-        for k, v in header.items():
+        for k, v in doc["run"].items():
             out.append(f"  {k}: {v}")
     for strat, st in doc.get("steps", {}).items():
         out.append("")
@@ -454,7 +525,7 @@ def report_main(argv=None) -> int:
         if "hbm_high_water_bytes" in st:
             out.append("  HBM high-water  "
                        + _fmt_bytes(st["hbm_high_water_bytes"]))
-    if "serving" in doc:
+    if doc.get("serving"):
         sv = doc["serving"]
         out.append("")
         out.append(f"serving [{sv.get('kv_dtype')}]: "
@@ -470,7 +541,17 @@ def report_main(argv=None) -> int:
         if "kv_pool_utilization_max" in sv:
             out.append("  KV pool     max utilization "
                        f"{sv['kv_pool_utilization_max']}")
-    if "serving_reliability" in doc:
+        if "free_blocks_low_water" in sv:
+            out.append(f"  KV pool     free-block low water "
+                       f"{sv['free_blocks_low_water']}, churn "
+                       f"{sv.get('block_allocs')} alloc(s) / "
+                       f"{sv.get('block_frees')} free(s) / "
+                       f"{sv.get('block_scrubs')} scrub(s)")
+        if "kv_fragmentation_max" in sv:
+            out.append(f"  KV pool     max fragmentation "
+                       f"{sv['kv_fragmentation_max']}  stored "
+                       + _fmt_bytes(sv.get("kv_bytes_stored_max")))
+    if doc.get("serving_reliability"):
         rl = doc["serving_reliability"]
         out.append("")
         out.append(f"serving reliability: {rl['admitted']} admission(s), "
@@ -487,9 +568,10 @@ def report_main(argv=None) -> int:
             out.append(f"  request latency  p50 {rl['latency_p50_s']}s  "
                        f"p90 {rl['latency_p90_s']}s  "
                        f"p99 {rl['latency_p99_s']}s")
-    rec = doc["recovery"]
-    if (rec["attempts_failed"] or rec["nonfinite_skips"] or attempts
-            or rec["in_graph_skips"] or rec["rollbacks"]):
+    rec = doc.get("recovery", {})
+    if (rec.get("attempts_failed") or rec.get("nonfinite_skips")
+            or rec.get("attempt_log")
+            or rec.get("in_graph_skips") or rec.get("rollbacks")):
         out.append("")
         out.append(f"recovery: {rec['in_graph_skips']} in-graph "
                    f"skip(s), {rec['rollbacks']} rollback(s), "
@@ -500,12 +582,236 @@ def report_main(argv=None) -> int:
                    f"publish(es), run "
                    + ("COMPLETED" if rec["completed"] else
                       "did not record completion"))
+
+
+def _render_waterfalls(out: list, label: str | None, wf: dict) -> None:
+    if not wf:
+        return
+    out.append("")
+    tag = f" [{label}]" if label else ""
+    out.append(f"per-request waterfalls{tag}:")
+    shown = 0
+    for uid, w in wf.items():
+        if shown >= 16:
+            out.append(f"  ... {len(wf) - shown} more request(s) "
+                       "(see --json for all)")
+            break
+        shown += 1
+        verdict = ("reconciled" if w["reconciled"] else
+                   ("no completion record" if w["latency_s"] is None
+                    else "NOT RECONCILED — unaccounted wall time"))
+        lat = ("" if w["latency_s"] is None
+               else f", latency {w['latency_s']}s")
+        out.append(f"  uid {uid} — {len(w['spans'])} span(s), "
+                   f"span sum {w['span_sum_s']}s{lat} ({verdict})")
+        for s in w["spans"]:
+            dur = s.get("duration_s")
+            out.append(f"    {s['span']:12s} "
+                       f"{dur if dur is not None else '?':>9}s  "
+                       f"steps {s.get('start_step')}.."
+                       f"{s.get('end_step')}")
+
+
+def _render_postmortem(out: list, label: str | None,
+                       fr: dict | None) -> None:
+    tag = f" [{label}]" if label else ""
+    out.append("")
+    if fr is None:
+        out.append(f"postmortem{tag}: no flight-recorder dump (the "
+                   "engine dumps on quarantine / watchdog / kill only)")
+        return
+    if fr.get("error"):
+        out.append(f"postmortem{tag}: {fr['error']}")
+        return
+    out.append(f"postmortem{tag}: {fr.get('reason')!r} @ engine step "
+               f"{fr.get('step')} — {len(fr.get('digests', []))} "
+               f"step digest(s) ({fr.get('path')})")
+    for d in fr.get("digests", []):
+        bits = [f"step {d.get('step'):>4}",
+                f"occ {d.get('occupancy'):.2f}",
+                f"free {d.get('free_blocks')}",
+                f"waiting {d.get('waiting')}"]
+        if d.get("prefill_uid") is not None:
+            bits.append(f"prefill uid {d['prefill_uid']}")
+        if d.get("decode_uids"):
+            bits.append(f"decode uids {d['decode_uids']}")
+        if d.get("finite") is not None and not all(d["finite"]):
+            bits.append(f"FINITE {d['finite']}")
+        line = "  " + "  ".join(bits)
+        if d.get("events"):
+            line += "  | " + "; ".join(d["events"])
+        out.append(line)
+
+
+def report_main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="report",
+        description="Fold one or more --metrics_dir runs (+ supervise "
+                    "attempt logs + optional profile dir) into one run "
+                    "report; multiple dirs merge onto one timeline "
+                    "with per-engine stats")
+    p.add_argument("metrics_dirs", nargs="+",
+                   help="the run's --metrics_dir (holds metrics.jsonl); "
+                        "pass several to merge engines onto one "
+                        "timeline")
+    p.add_argument("--attempt_log", default=None,
+                   help="supervise's per-attempt JSONL (default: "
+                        "discovered from each run's meta records)")
+    p.add_argument("--profile_dir", default=None,
+                   help="a trace directory captured with --profile_dir; "
+                        "adds comm/compute overlap + per-named-scope "
+                        "totals")
+    p.add_argument("--postmortem", action="store_true",
+                   help="render each stream's flight-recorder dump "
+                        "(per-step scheduler digests persisted on "
+                        "quarantine / watchdog / kill)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the folded report as one JSON object "
+                        "instead of text")
+    args = p.parse_args(argv)
+
+    # an explicit --attempt_log names ONE supervisor log: attach it to
+    # the first stream only — giving it to every stream would replay
+    # the same recovery events once per engine on the merged timeline
+    # (the other streams still auto-discover their own from meta)
+    streams = [_Stream(d, args.attempt_log if i == 0 else None)
+               for i, d in enumerate(args.metrics_dirs)]
+    # engine labels key the merge: disambiguate collisions (two dirs
+    # both named "metrics" with no engine_id stamped) instead of
+    # silently overwriting one stream's entire report
+    seen_labels: dict = {}
+    for s in streams:
+        n = seen_labels.get(s.label, 0)
+        seen_labels[s.label] = n + 1
+        if n:
+            s.label = f"{s.label}#{n + 1}"
+    missing = [s for s in streams if not s.dir_exists]
+    if missing:
+        for s in missing:
+            print(f"report: no metrics stream at {s.path}",
+                  file=sys.stderr)
+        return 2
+    multi = len(streams) > 1
+
+    if not any(s.records for s in streams):
+        # a record-free stream is an ANSWER (the run emitted nothing),
+        # not a tooling failure: rc 0 with an explicit summary naming
+        # whatever failed to validate
+        out = []
+        for s in streams:
+            out.append(f"report: no records — {s.path} holds no "
+                       f"schema-valid records "
+                       f"({len(s.problems)} problem(s))")
+            for prob in s.problems:
+                out.append(f"  {prob}")
+        if args.json:
+            print(json.dumps({
+                "no_records": True,
+                "streams": [{"metrics_path": s.path,
+                             "problems": s.problems}
+                            for s in streams]}, indent=1))
+        else:
+            print("\n".join(out))
+        return 0
+
+    # ---- fold every stream ------------------------------------------
+    doc: dict = {}
+    per_engine: dict = {}
+    timeline = []
+    waterfalls: dict = {}
+    for s in streams:
+        sub = {"metrics_path": s.path, "n_records": len(s.records),
+               "problems": s.problems, "run": s.header,
+               "steps": s.step_stats(), "recovery": s.recovery()}
+        serving = s.serving()
+        if serving:
+            sub["serving"] = serving
+        rel = s.reliability()
+        if rel:
+            sub["serving_reliability"] = rel
+        per_engine[s.label] = sub
+        wf = s.waterfalls()
+        if wf:
+            waterfalls[s.label] = wf
+        for t, src, what in s.timeline_entries():
+            timeline.append((t, src, what, s.label))
+    timeline.sort(key=lambda x: x[0])
+
+    if multi:
+        doc["engines"] = per_engine
+        doc["problems"] = [f"[{s.label}] {p}" for s in streams
+                           for p in s.problems]
+    else:
+        doc.update(per_engine[streams[0].label])
+    doc["timeline"] = [{"t": t, "source": src, "what": what,
+                        **({"engine": lab} if multi else {})}
+                       for t, src, what, lab in timeline]
+    if waterfalls:
+        doc["waterfalls"] = (waterfalls if multi
+                             else waterfalls[streams[0].label])
+
+    flights = {}
+    if args.postmortem:
+        flights = {s.label: s.flight_recorder() for s in streams}
+        doc["postmortem"] = (flights if multi
+                             else flights[streams[0].label])
+
+    # ---- profile folding (first stream's strategy names the scopes) --
+    if args.profile_dir:
+        from .utils.trace_analysis import (load_spans, overlap_payload,
+                                           scope_totals,
+                                           strategy_scope_key)
+        # one gunzip+parse feeds both analyses (hardware traces run to
+        # hundreds of MB — never load twice)
+        trace_file, spans = load_spans(args.profile_dir)
+        prof = overlap_payload(spans, trace_file)
+        # fold per-region totals under the RUN's strategy when the meta
+        # records name one; unknown strategies fall back to the
+        # prefixed-regions union (scope_totals documents why)
+        scope_key = strategy_scope_key(
+            streams[0].header.get("strategy"))
+        prof["scope_totals_us"] = {
+            k: round(v, 1)
+            for k, v in scope_totals(spans, scope_key).items() if v}
+        doc["profile"] = prof
+
+    if not multi and streams[0].benches:
+        doc["bench_rows"] = len(streams[0].benches)
+
+    if args.json:
+        print(json.dumps(doc, indent=1))
+        return 0
+
+    # ---- render ------------------------------------------------------
+    out = []
+    out.append("=" * 72)
+    if multi:
+        out.append(f"RUN REPORT — {len(streams)} merged stream(s): "
+                   + ", ".join(s.label for s in streams))
+    else:
+        out.append(f"RUN REPORT — {streams[0].path}")
+    out.append("=" * 72)
+    if multi:
+        for s in streams:
+            sub = per_engine[s.label]
+            out.append("")
+            out.append(f"--- engine [{s.label}] — {s.path} ---")
+            _render_engine_sections(out, sub)
+    else:
+        _render_engine_sections(out, doc)
+    for lab, wf in waterfalls.items():
+        _render_waterfalls(out, lab if multi else None, wf)
     if timeline:
         t0 = timeline[0][0]
         out.append("")
         out.append("timeline:")
-        for t, src, what in timeline:
-            out.append(f"  {_fmt_t(t, t0)}  [{src:7s}] {what}")
+        for t, src, what, lab in timeline:
+            tag = f"[{lab}] " if multi else ""
+            out.append(f"  {_fmt_t(t, t0)}  [{src:7s}] {tag}{what}")
+    if args.postmortem:
+        for s in streams:
+            _render_postmortem(out, s.label if multi else None,
+                               flights.get(s.label))
     if "profile" in doc:
         pr = doc["profile"]
         out.append("")
@@ -517,6 +823,8 @@ def report_main(argv=None) -> int:
             for k, v in sorted(pr["scope_totals_us"].items(),
                                key=lambda kv: -kv[1]):
                 out.append(f"    {k:16s} {v}")
+    problems = (doc.get("problems") if multi
+                else streams[0].problems) or []
     if problems:
         out.append("")
         out.append(f"schema problems ({len(problems)}):")

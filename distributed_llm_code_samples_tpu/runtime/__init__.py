@@ -11,16 +11,19 @@ deterministic faults so that story is continuously tested; and
 ``backend_probe`` walks an env-shape matrix to tell a dead accelerator
 relay from a self-broken environment (the round-5 outage); ``telemetry``
 is the unified metrics stream (schema-versioned per-step JSONL records +
-the ``StepReport`` static fold) every run/bench/report shares.
+the ``StepReport`` static fold) every run/bench/report shares;
+``tracing`` is the per-request span layer on top of it (the serving
+waterfall's telescoping clock).
 """
 
-from . import backend_probe, chaos, native, telemetry
+from . import backend_probe, chaos, native, telemetry, tracing
 from .chaos import FaultPlan
 from .failure import (HealthCheckError, device_healthcheck, supervise)
 from .init import initialize, runtime_info, DEFAULT_COORDINATOR
 from .telemetry import StepReport, TelemetryWriter
+from .tracing import SpanTracer
 
-__all__ = ["backend_probe", "chaos", "native", "telemetry",
+__all__ = ["backend_probe", "chaos", "native", "telemetry", "tracing",
            "initialize", "runtime_info", "DEFAULT_COORDINATOR",
            "FaultPlan", "HealthCheckError", "device_healthcheck",
-           "supervise", "StepReport", "TelemetryWriter"]
+           "supervise", "StepReport", "TelemetryWriter", "SpanTracer"]

@@ -50,9 +50,20 @@ import numpy as np
 # request lifecycle transition (admitted / preempted / retried /
 # quarantined / completed / rejected / expired, decode/engine.py) with
 # its own pinned required-key contract (REQUEST_REQUIRED).
-SCHEMA_VERSION = 4
+# v5 (round 11): adds the "span" kind — per-request lifecycle spans
+# (queued / prefill / replay / decode / quarantine / preempt_gap,
+# runtime/tracing.py) with pinned SPAN_REQUIRED — and grows the
+# "decode" contract with the KV-pool internals (free-block watermarks,
+# block churn, fragmentation, per-dtype stored-KV bytes).
+SCHEMA_VERSION = 5
 
 METRICS_FILENAME = "metrics.jsonl"
+
+# the flight-recorder dump the decode engine publishes next to the
+# metrics stream (decode/engine.py writes it; report --postmortem
+# discovers it) — defined here so the writer and the reader share one
+# name without the report tool importing the (jax-heavy) engine
+FLIGHT_FILENAME = "flight_recorder.json"
 
 # The step-record contract: every "step" record carries exactly these
 # keys (values may be null when a source can't measure them — a CPU run
@@ -80,8 +91,24 @@ ROLLBACK_REQUIRED = ("rung", "resume_step")
 # over max slots; ``kv_pool_utilization`` is allocated non-scratch
 # blocks over usable blocks (decode/engine.py). Same version-bump
 # discipline as STEP_KEYS.
+#
+# v5 KV-pool internals (decode/engine.py ``telemetry_record``):
+# ``free_blocks`` the instantaneous free count,
+# ``free_blocks_low_water``/``free_blocks_high_water`` the min/max free
+# count since the previous decode record (the pressure envelope a
+# cadence record would otherwise alias over), ``block_allocs`` /
+# ``block_frees`` / ``block_scrubs`` cumulative churn counters
+# (snapshot-persisted, so they stay monotonic across crash-resume),
+# ``kv_fragmentation`` the unused fraction of RESERVED block capacity
+# (1 - live tokens / (live blocks * block_size); reserve-on-admit means
+# a young sequence holds its whole reservation), and
+# ``kv_bytes_stored`` the live-token KV bytes at the engine's dtype
+# (``paged.kv_bytes_per_token`` — the roofline's kv_bytes numerator).
 DECODE_REQUIRED = ("step", "tokens_per_sec", "batch_occupancy",
-                   "kv_pool_utilization")
+                   "kv_pool_utilization", "free_blocks",
+                   "free_blocks_low_water", "free_blocks_high_water",
+                   "block_allocs", "block_frees", "block_scrubs",
+                   "kv_fragmentation", "kv_bytes_stored")
 
 # The request-record contract: one record per serving-request lifecycle
 # transition (``decode/engine.py``). ``step`` is the GLOBAL engine step
@@ -95,13 +122,43 @@ DECODE_REQUIRED = ("step", "tokens_per_sec", "batch_occupancy",
 # discipline as STEP_KEYS.
 REQUEST_REQUIRED = ("step", "uid", "event", "reason")
 
+# The span-record contract (``runtime/tracing.py``): one record per
+# CLOSED per-request lifecycle span. ``span`` names the phase (queued /
+# prefill / replay / decode / quarantine / preempt_gap), ``step`` the
+# GLOBAL engine step the span closed at, ``start_step`` where it
+# opened, ``duration_s`` its wall-clock length. Spans tile a request's
+# life (each opens exactly when its predecessor closes, the first at
+# submit time), so a completed request's span durations sum to its
+# ``latency_s`` — the reconciliation ``report``'s waterfall view pins.
+# Replayed spans after a snapshot-resume restart are deduplicated by
+# ``(uid, span, start_step, step)``, the request-record dedup stance.
+# Same version-bump discipline as STEP_KEYS.
+SPAN_REQUIRED = ("step", "uid", "span", "start_step", "duration_s")
+
+# The span vocabulary (runtime/tracing.py callers use these; report
+# renders any name, so a new phase is additive)
+SPAN_NAMES = ("queued", "prefill", "replay", "decode", "quarantine",
+              "preempt_gap")
+
 # Non-step record kinds the stream also carries: run headers ("meta"),
 # recovery/chaos/checkpoint events ("event"), bench measurement rows
 # ("bench" — bench.py's per-measurement plumbing rides the same
 # writer), the self-healing kinds ("anomaly", "rollback"), and the
-# serving engine's "decode" cadence + "request" lifecycle records.
+# serving engine's "decode" cadence + "request" lifecycle + "span"
+# per-request phase records.
 RECORD_KINDS = ("step", "meta", "event", "bench", "anomaly", "rollback",
-                "decode", "request")
+                "decode", "request", "span")
+
+# kind -> the pinned required-key set validate_record enforces (step
+# records additionally pin their FULL key set via STEP_KEYS)
+REQUIRED_KEYS = {
+    "step": STEP_KEYS,
+    "anomaly": ANOMALY_REQUIRED,
+    "rollback": ROLLBACK_REQUIRED,
+    "decode": DECODE_REQUIRED,
+    "request": REQUEST_REQUIRED,
+    "span": SPAN_REQUIRED,
+}
 
 # bf16 peak matmul FLOP/s by chip generation (public spec sheets; the
 # default f32 jnp matmul on TPU lowers to single-pass bf16 MXU ops, so
@@ -322,6 +379,17 @@ class TelemetryWriter:
         rec["kind"] = "request"
         self._put(rec)
 
+    def span(self, record: dict) -> None:
+        """Enqueue one per-request lifecycle span record (a CLOSED
+        phase: queued / prefill / replay / decode / quarantine /
+        preempt_gap; ``runtime/tracing.py``; ``SPAN_REQUIRED``
+        contract). Callers pass ``t`` explicitly (the span's close
+        time) so span sums reconcile with request latencies."""
+        rec = dict(record)
+        rec.setdefault("t", time.time())
+        rec["kind"] = "span"
+        self._put(rec)
+
     def meta(self, record: dict) -> None:
         """Enqueue a run-header record (shapes, strategy, flags, paths
         to sibling logs — the report tool reads these to fold streams)."""
@@ -405,40 +473,28 @@ class TelemetryWriter:
 
 def validate_record(rec: Any) -> tuple[bool, str]:
     """Schema check for one parsed record: the envelope (``schema``,
-    ``kind``, ``t``) on every record, plus the full ``STEP_KEYS``
-    contract on step records."""
+    ``kind``, ``t``) on every record, plus the kind's pinned
+    ``REQUIRED_KEYS`` contract. Every failure message is ONE line
+    naming the record kind and the offending/missing key — the problems
+    list a report renders must be actionable without opening the file."""
     if not isinstance(rec, dict):
         return False, "record is not a JSON object"
-    if rec.get("schema") != SCHEMA_VERSION:
-        return False, (f"schema {rec.get('schema')!r} != "
-                       f"{SCHEMA_VERSION} (version mismatch)")
     kind = rec.get("kind")
+    label = f"{kind} record" if kind in RECORD_KINDS else "record"
+    if rec.get("schema") != SCHEMA_VERSION:
+        return False, (f"{label}: key 'schema' is {rec.get('schema')!r}, "
+                       f"expected {SCHEMA_VERSION} (version mismatch)")
     if kind not in RECORD_KINDS:
-        return False, f"unknown kind {kind!r}"
+        return False, (f"record: key 'kind' is {kind!r}, not one of "
+                       f"{RECORD_KINDS}")
     if "t" not in rec:
-        return False, "missing timestamp 't'"
-    if kind == "step":
-        missing = [k for k in STEP_KEYS if k not in rec]
-        if missing:
-            return False, f"step record missing keys {missing}"
-        if not isinstance(rec["step"], int):
-            return False, f"step is {type(rec['step']).__name__}, not int"
-    if kind == "anomaly":
-        missing = [k for k in ANOMALY_REQUIRED if k not in rec]
-        if missing:
-            return False, f"anomaly record missing keys {missing}"
-    if kind == "rollback":
-        missing = [k for k in ROLLBACK_REQUIRED if k not in rec]
-        if missing:
-            return False, f"rollback record missing keys {missing}"
-    if kind == "decode":
-        missing = [k for k in DECODE_REQUIRED if k not in rec]
-        if missing:
-            return False, f"decode record missing keys {missing}"
-    if kind == "request":
-        missing = [k for k in REQUEST_REQUIRED if k not in rec]
-        if missing:
-            return False, f"request record missing keys {missing}"
+        return False, f"{label} missing key 't' (timestamp)"
+    missing = [k for k in REQUIRED_KEYS.get(kind, ()) if k not in rec]
+    if missing:
+        return False, f"{label} missing required key(s) {missing}"
+    if kind == "step" and not isinstance(rec["step"], int):
+        return False, (f"step record key 'step' is "
+                       f"{type(rec['step']).__name__}, not int")
     return True, "ok"
 
 

@@ -1,0 +1,113 @@
+"""Request span tracing: where one serving request's latency goes.
+
+PR 5's ``request`` records say WHAT happened to a request (admitted /
+quarantined / completed); nothing says where its wall-clock went —
+queue time vs prefill vs decode vs preemption churn. This module is the
+missing phase accounting: a ``SpanTracer`` tracks one OPEN span per
+uid and emits a schema-v5 ``span`` record every time the request
+changes phase, through the same ``TelemetryWriter`` every other record
+kind rides.
+
+The span vocabulary (``telemetry.SPAN_NAMES``):
+
+- ``queued`` — submit (or snapshot re-queue) -> admission,
+- ``prefill`` — one span PER PREFILL CHUNK (each starts where the
+  previous chunk's span ended, so a long prompt's chunk spans tile the
+  whole prefill phase, engine steps spent on other slots included),
+- ``replay`` — the teacher-forcing window after a re-admission
+  (recorded tokens re-fed to rebuild the KV write history),
+- ``decode`` — live token generation, one span per contiguous segment
+  (a preemption or quarantine ends the segment),
+- ``quarantine`` — quarantine -> re-admission (zero-length when the
+  retry budget is exhausted and the request fails terminally),
+- ``preempt_gap`` — pool-pressure eviction -> re-admission.
+
+**The telescoping-clock contract.** Every transition closes the open
+span and opens its successor at the SAME timestamp; the first span
+opens at the request's ``t_submit`` and the last closes at the
+completion timestamp the ``latency_s`` request record uses. Span
+durations therefore sum — exactly, up to rounding — to the request's
+recorded latency, which is what lets ``report``'s waterfall view
+RECONCILE the phase breakdown against the latency percentiles instead
+of presenting two unrelated numbers (the observability analogue of the
+repo's differential-testing stance).
+
+**Crash behavior.** Open spans are process state and die with it;
+emitted spans are already on disk. An in-process supervisor restart
+replays steps whose spans were already emitted — the replayed records
+are byte-identical in ``(uid, span, start_step, step)`` and ``report``
+dedups them exactly like replayed ``request`` records. A crash-resume
+opens a fresh ``queued`` span at resume time, so the crash gap itself
+is visibly unaccounted (the waterfall flags the request unreconciled
+rather than inventing a phase for dead time).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+
+class SpanTracer:
+    """Per-uid lifecycle span tracking (one open span per uid).
+
+    ``metrics_fn`` returns the live ``TelemetryWriter`` (or None) at
+    emit time — the engine re-binds its writer mid-life
+    (``DecodeEngine.run(metrics=...)``), so the tracer must not capture
+    it at construction. All methods are host-side and O(1); with no
+    writer attached the tracer still tracks phases (close/transition
+    stay cheap no-ops on the emit half).
+    """
+
+    def __init__(self, metrics_fn: Callable):
+        self._metrics_fn = metrics_fn
+        self._open: dict[int, dict] = {}   # uid -> open-span state
+
+    def open(self, uid: int, span: str, step: int,
+             t: float | None = None) -> None:
+        """Start ``uid``'s FIRST span (``queued``) at ``t`` (defaults
+        to now; pass the request's ``t_submit`` so queue time counts
+        from submission, not from bookkeeping)."""
+        self._open[int(uid)] = {"span": span, "start_step": int(step),
+                                "start_t": time.time() if t is None
+                                else float(t)}
+
+    def transition(self, uid: int, span: str, step: int,
+                   t: float | None = None, **extra) -> None:
+        """Close ``uid``'s open span at ``t`` (emitting its record,
+        ``extra`` attached) and open ``span`` at the same instant —
+        the telescoping handoff that makes span sums reconcile."""
+        uid = int(uid)
+        now = time.time() if t is None else float(t)
+        cur = self._open.get(uid)
+        if cur is not None:
+            self._emit(uid, cur, int(step), now, extra)
+        self._open[uid] = {"span": span, "start_step": int(step),
+                           "start_t": now}
+
+    def close(self, uid: int, step: int, t: float | None = None,
+              **extra) -> None:
+        """Close ``uid``'s open span with no successor (completion,
+        terminal failure, deadline expiry)."""
+        uid = int(uid)
+        cur = self._open.pop(uid, None)
+        if cur is None:
+            return
+        now = time.time() if t is None else float(t)
+        self._emit(uid, cur, int(step), now, extra)
+
+    def _emit(self, uid: int, cur: dict, end_step: int, end_t: float,
+              extra: dict) -> None:
+        metrics = self._metrics_fn()
+        if metrics is None:
+            return
+        metrics.span({
+            "uid": uid,
+            "span": cur["span"],
+            "start_step": cur["start_step"],
+            "step": end_step,
+            "start_t": cur["start_t"],
+            "t": end_t,
+            "duration_s": round(end_t - cur["start_t"], 6),
+            **extra,
+        })

@@ -53,7 +53,25 @@ SCOPES = {
     "moe_lm": ("moe_lm/fwd", "moe_lm/comm", "moe_lm/optim"),
     "moe_tf": ("moe_tf/fwd", "moe_tf/bwd", "moe_tf/comm",
                "moe_tf/optim"),
+    # serving cost attribution (round 11, decode/engine.py): the decode
+    # engine's two compiled program kinds, split by the DECODE
+    # roofline's own terms — "gather" the paged-KV block read (+int8
+    # dequant), "requant" the KV write (the int8 read-modify-requantize
+    # proper; at f32/bf16 it tags the plain scatter, so the region
+    # reads near zero there), "attn" the score+AV math, "head" the
+    # final LN + tied head (+ the TP logits all_gather), "sample" the
+    # fused in-graph pick. Serving steps have no optimizer, so these
+    # entries carry no "optim" region (the training-side four-role
+    # structure does not apply).
+    "decode": ("decode/gather", "decode/attn", "decode/head",
+               "decode/sample", "decode/requant"),
+    "prefill": ("prefill/gather", "prefill/attn", "prefill/head",
+                "prefill/sample", "prefill/requant"),
 }
+
+# the SCOPES keys that name SERVING programs (no optimizer region; the
+# per-strategy four-role contract below applies to the training keys)
+SERVING_SCOPES = ("decode", "prefill")
 
 # span-name keywords (lowercased substring match) — the bench_trace.py
 # classifiers, shared

@@ -15,7 +15,15 @@
 #   asserts the stream holds >= 1 schema-valid record, and requires the
 #   `report` subcommand to exit 0 on it — the observability surface is
 #   gated like any other subsystem (runtime/telemetry.py).
+# - Each phase prints PHASE_SECONDS so budget regressions against the
+#   870s pytest ceiling (and smoke creep) are visible in the log.
 cd "$(dirname "$0")/.."
+
+_phase_t0=$(date +%s)
+phase_done() {  # phase_done NAME — print the elapsed wall clock
+  echo "PHASE_SECONDS $1=$(( $(date +%s) - _phase_t0 ))"
+  _phase_t0=$(date +%s)
+}
 
 echo "=== telemetry smoke ==="
 SMOKE_DIR=$(mktemp -d /tmp/tier1_telemetry.XXXXXX)
@@ -46,6 +54,7 @@ if ! timeout -k 10 60 env JAX_PLATFORMS=cpu python -m \
 fi
 rm -rf "$SMOKE_DIR"
 echo "TELEMETRY_SMOKE=OK"
+phase_done telemetry_smoke
 
 echo "=== self-healing smoke ==="
 # A CPU chaos run injecting nan_grad@2 under --guardrails must finish
@@ -87,11 +96,14 @@ then
 fi
 rm -rf "$HEAL_DIR"
 echo "SELFHEAL_SMOKE=OK"
+phase_done selfheal_smoke
 
 echo "=== decode smoke ==="
 # A tiny CPU `generate` run: two staggered prompts through the
 # continuous-batching engine must exit 0 and leave >= 1 schema-valid
-# `decode` record (schema v3, decode/engine.py + runtime/telemetry.py).
+# `decode` record (decode/engine.py + runtime/telemetry.py) AND >= 1
+# schema-valid `span` record (schema v5, runtime/tracing.py — the
+# request-phase tracing layer is gated like the records it rides with).
 DEC_DIR=$(mktemp -d /tmp/tier1_decode.XXXXXX)
 if ! timeout -k 10 240 env JAX_PLATFORMS=cpu python -m \
     distributed_llm_code_samples_tpu.cli generate \
@@ -111,12 +123,16 @@ decs = [r for r in records if r["kind"] == "decode"]
 assert decs, "no schema-valid decode record in the smoke stream"
 assert all(validate_record(d)[0] for d in decs)
 assert decs[-1]["tokens_generated"] == 2 * 5, decs[-1]
+spans = [r for r in records if r["kind"] == "span"]
+assert spans, "no schema-valid span record in the smoke stream"
+assert all(validate_record(s)[0] for s in spans)
 EOF
 then
   echo "DECODE_SMOKE=FAIL (schema)"; rm -rf "$DEC_DIR"; exit 1
 fi
 rm -rf "$DEC_DIR"
 echo "DECODE_SMOKE=OK"
+phase_done decode_smoke
 
 echo "=== serving-chaos smoke ==="
 # kill@4 mid-decode under the engine supervisor: run 1 SIGKILLs itself
@@ -173,6 +189,76 @@ then
 fi
 rm -rf "$SRV_DIR"
 echo "SERVING_CHAOS_SMOKE=OK"
+phase_done serving_chaos_smoke
+
+echo "=== serving-observability smoke ==="
+# The ISSUE 7 acceptance drill end to end on CPU: engine A runs under
+# `--chaos nan_logits@3 --max_retries 1` (every active sequence is
+# quarantined at step 3, retried, replay-resumed, completed); engine B
+# is a clean run. `report A B` must yield (a) a per-request waterfall
+# for EVERY completed uid whose summed span durations reconcile with
+# its recorded latency_s, (b) a flight-recorder dump covering the steps
+# up to the quarantine, rendered by `report --postmortem`, and (c) one
+# merged two-engine timeline with per-engine latency percentiles.
+OBS_DIR=$(mktemp -d /tmp/tier1_obs.XXXXXX)
+OBS_ARGS="--max_new 5 -d 32 -l 2 --heads 4 --vocab 64
+  --max_seq_len 64 --block_size 8 --prefill_chunk 4 --log_every 2"
+if ! timeout -k 10 240 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli generate $OBS_ARGS \
+    --prompt_lens 3,7 --chaos nan_logits@3 --max_retries 1 \
+    --snapshot_dir "$OBS_DIR/snapA" --metrics_dir "$OBS_DIR/A" \
+    --engine_id A > /dev/null; then
+  echo "OBSERVABILITY_SMOKE=FAIL (engine A)"; rm -rf "$OBS_DIR"; exit 1
+fi
+if ! timeout -k 10 240 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli generate $OBS_ARGS \
+    --prompt_lens 4,6 --metrics_dir "$OBS_DIR/B" --engine_id B \
+    > /dev/null; then
+  echo "OBSERVABILITY_SMOKE=FAIL (engine B)"; rm -rf "$OBS_DIR"; exit 1
+fi
+if ! timeout -k 10 60 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli report "$OBS_DIR/A" \
+    "$OBS_DIR/B" --json > "$OBS_DIR/report.json"; then
+  echo "OBSERVABILITY_SMOKE=FAIL (merged report)"; rm -rf "$OBS_DIR"
+  exit 1
+fi
+if ! timeout -k 10 60 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli report "$OBS_DIR/A" \
+    --postmortem > "$OBS_DIR/postmortem.txt"; then
+  echo "OBSERVABILITY_SMOKE=FAIL (postmortem rc)"; rm -rf "$OBS_DIR"
+  exit 1
+fi
+if ! timeout -k 10 60 env JAX_PLATFORMS=cpu python - "$OBS_DIR" <<'EOF'
+import json, os, sys
+base = sys.argv[1]
+doc = json.load(open(os.path.join(base, "report.json")))
+assert set(doc["engines"]) == {"A", "B"}, doc.get("engines")
+for eng in ("A", "B"):
+    rel = doc["engines"][eng]["serving_reliability"]
+    assert rel["completed"] == 2, (eng, rel)
+    assert "latency_p50_s" in rel and "latency_p99_s" in rel, (eng, rel)
+    wf = doc["waterfalls"][eng]
+    assert len(wf) == 2, (eng, sorted(wf))
+    for uid, w in wf.items():
+        assert w["reconciled"], (eng, uid, w)
+assert doc["engines"]["A"]["serving_reliability"]["quarantined"] == 2
+assert {r["engine"] for r in doc["timeline"]} == {"A", "B"}
+ts = [r["t"] for r in doc["timeline"]]
+assert ts == sorted(ts), "merged timeline not in wall-clock order"
+post = open(os.path.join(base, "postmortem.txt")).read()
+assert "postmortem" in post and "quarantine" in post, post[-500:]
+assert "FINITE" in post, "postmortem lacks the non-finite evidence row"
+fr = json.load(open(os.path.join(base, "A", "flight_recorder.json")))
+steps = [d["step"] for d in fr["digests"]]
+assert steps and steps[-1] == fr["step"], (steps, fr["step"])
+EOF
+then
+  echo "OBSERVABILITY_SMOKE=FAIL (drill check)"; rm -rf "$OBS_DIR"
+  exit 1
+fi
+rm -rf "$OBS_DIR"
+echo "OBSERVABILITY_SMOKE=OK"
+phase_done observability_smoke
 
 echo "=== tier-1 pytest ==="
-set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); phase_done pytest; exit $rc

@@ -259,6 +259,11 @@ def test_bench_decode_contract():
         payload["kv_bytes_per_token_f32"]
     assert payload["kv_bytes_per_token_int8"] * 4 == \
         payload["kv_bytes_per_token_f32"]
+    # r11 pool-telemetry row (schema-v5 decode internals): a clean
+    # drain returns every allocated block
+    pool = payload["engine_pool_telemetry"]
+    assert pool["block_allocs"] == pool["block_frees"] > 0
+    assert pool["free_blocks_low_water"] >= 0
 
 
 @pytest.mark.slow

@@ -458,7 +458,11 @@ def test_snapshot_resume_matches_uninterrupted(tmp_path, lm_params,
     sd = str(tmp_path / "snap")
     write_snapshot(eng, sd)
     snap = load_snapshot(sd)
-    assert snap["step"] == 5 and snap["version"] == 1
+    assert snap["step"] == 5 and snap["version"] == 2
+    # v2: the KV-pool churn counters persist so schema-v5 decode
+    # records stay monotonic across crash-resume
+    assert snap["counters"]["block_allocs"] >= 1
+    assert "block_scrubs" in snap["counters"]
     running = [r for r in snap["requests"] if r["state"] == "RUNNING"]
     assert running and all("block_table" in r and "position" in r
                            for r in running)

@@ -28,16 +28,20 @@ from distributed_llm_code_samples_tpu.runtime.telemetry import (
 
 
 # The pinned (version, key-set) tuples. If you change STEP_KEYS or the
-# anomaly/rollback/decode required sets you MUST bump SCHEMA_VERSION and
-# update these pins in the same commit — that is the version-bump
-# discipline this test enforces. v2 (round 8): the self-healing kinds —
-# "anomaly" (in-graph guardrail counters) and "rollback" (ladder rungs).
-# v3 (round 9): the serving kind — "decode" (engine cadence records:
-# throughput, batch occupancy, KV-pool utilization; decode/engine.py).
-# v4 (round 10): the serving-reliability kind — "request" (one record
-# per request lifecycle transition: admitted/preempted/retried/
-# quarantined/completed/rejected/expired; decode/engine.py).
-_PINNED_VERSION = 4
+# anomaly/rollback/decode/request/span required sets you MUST bump
+# SCHEMA_VERSION and update these pins in the same commit — that is the
+# version-bump discipline this test enforces. v2 (round 8): the
+# self-healing kinds — "anomaly" (in-graph guardrail counters) and
+# "rollback" (ladder rungs). v3 (round 9): the serving kind — "decode"
+# (engine cadence records: throughput, batch occupancy, KV-pool
+# utilization; decode/engine.py). v4 (round 10): the serving-
+# reliability kind — "request" (one record per request lifecycle
+# transition: admitted/preempted/retried/quarantined/completed/
+# rejected/expired; decode/engine.py). v5 (round 11): the "span" kind
+# (per-request lifecycle phases, runtime/tracing.py) + the decode
+# contract's KV-pool internals (watermarks, churn, fragmentation,
+# stored bytes).
+_PINNED_VERSION = 5
 _PINNED_STEP_KEYS = frozenset({
     "schema", "kind", "t", "step", "strategy", "loss", "grad_norm",
     "tokens_per_sec", "step_time_s", "mfu", "hbm_high_water_bytes",
@@ -46,25 +50,40 @@ _PINNED_ANOMALY_REQUIRED = frozenset({"step", "skipped", "loss_scale"})
 _PINNED_ROLLBACK_REQUIRED = frozenset({"rung", "resume_step"})
 _PINNED_DECODE_REQUIRED = frozenset({
     "step", "tokens_per_sec", "batch_occupancy", "kv_pool_utilization",
+    "free_blocks", "free_blocks_low_water", "free_blocks_high_water",
+    "block_allocs", "block_frees", "block_scrubs", "kv_fragmentation",
+    "kv_bytes_stored",
 })
 _PINNED_REQUEST_REQUIRED = frozenset({"step", "uid", "event", "reason"})
+_PINNED_SPAN_REQUIRED = frozenset({
+    "step", "uid", "span", "start_step", "duration_s",
+})
 
 
 def test_schema_version_bump_discipline():
     from distributed_llm_code_samples_tpu.runtime.telemetry import (
         ANOMALY_REQUIRED, DECODE_REQUIRED, RECORD_KINDS,
-        REQUEST_REQUIRED, ROLLBACK_REQUIRED)
+        REQUEST_REQUIRED, REQUIRED_KEYS, ROLLBACK_REQUIRED,
+        SPAN_REQUIRED)
     assert SCHEMA_VERSION == _PINNED_VERSION and \
         frozenset(STEP_KEYS) == _PINNED_STEP_KEYS and \
         frozenset(ANOMALY_REQUIRED) == _PINNED_ANOMALY_REQUIRED and \
         frozenset(ROLLBACK_REQUIRED) == _PINNED_ROLLBACK_REQUIRED and \
         frozenset(DECODE_REQUIRED) == _PINNED_DECODE_REQUIRED and \
-        frozenset(REQUEST_REQUIRED) == _PINNED_REQUEST_REQUIRED, (
+        frozenset(REQUEST_REQUIRED) == _PINNED_REQUEST_REQUIRED and \
+        frozenset(SPAN_REQUIRED) == _PINNED_SPAN_REQUIRED, (
             "telemetry record schema changed: bump SCHEMA_VERSION "
             "and update the pinned sets here in the same commit")
     assert "anomaly" in RECORD_KINDS and "rollback" in RECORD_KINDS
     assert "request" in RECORD_KINDS
     assert "decode" in RECORD_KINDS
+    assert "span" in RECORD_KINDS
+    # every contract-carrying kind routes through the one table
+    # validate_record reads (a new kind that skips it validates
+    # envelope-only silently — this catches the drift)
+    for kind in ("step", "anomaly", "rollback", "decode", "request",
+                 "span"):
+        assert kind in REQUIRED_KEYS, kind
 
 
 def test_step_record_round_trip(tmp_path):
@@ -130,6 +149,72 @@ def test_anomaly_and_rollback_records_round_trip(tmp_path):
     ok, reason = validate_record({"schema": SCHEMA_VERSION,
                                   "kind": "rollback", "t": 0.0})
     assert not ok and "rung" in reason
+
+
+def test_span_record_round_trip_and_torn_tail(tmp_path):
+    """The schema-v5 span kind (runtime/tracing.py): writer method
+    stamps the kind + envelope, records validate, a torn tail after a
+    span write is reported-not-fatal, and a missing contract key
+    rejects with a one-line message naming kind and key."""
+    from distributed_llm_code_samples_tpu.runtime.tracing import (
+        SpanTracer)
+    w = TelemetryWriter(str(tmp_path))
+    tracer = SpanTracer(lambda: w)
+    tracer.open(3, "queued", 0, t=100.0)
+    tracer.transition(3, "prefill", 2, t=100.5)
+    tracer.close(3, 5, t=101.25, n_new=4)
+    w.close()
+    path = os.path.join(str(tmp_path), METRICS_FILENAME)
+    with open(path, "a") as f:
+        f.write('{"schema": 5, "kind": "sp')  # torn write
+    records, problems = read_metrics(path)
+    assert len(problems) == 1 and "torn" in problems[0]
+    assert [r["span"] for r in records] == ["queued", "prefill"]
+    for r in records:
+        assert r["schema"] == SCHEMA_VERSION
+        ok, reason = validate_record(r)
+        assert ok, reason
+    queued, prefill = records
+    # the telescoping contract: each span starts where its predecessor
+    # ended, and durations are end - start exactly
+    assert queued["start_t"] == 100.0 and queued["t"] == 100.5
+    assert queued["duration_s"] == pytest.approx(0.5)
+    assert prefill["start_t"] == 100.5 and prefill["t"] == 101.25
+    assert prefill["duration_s"] == pytest.approx(0.75)
+    assert queued["duration_s"] + prefill["duration_s"] == \
+        pytest.approx(101.25 - 100.0)
+    assert (queued["start_step"], queued["step"]) == (0, 2)
+    assert prefill["n_new"] == 4        # extras ride along
+    bad = {k: v for k, v in prefill.items() if k != "start_step"}
+    ok, reason = validate_record(bad)
+    assert not ok and "span record" in reason and "start_step" in reason
+
+
+@pytest.mark.parametrize("kind,required", [
+    ("step", _PINNED_STEP_KEYS - {"schema", "kind", "t"}),
+    ("anomaly", _PINNED_ANOMALY_REQUIRED),
+    ("rollback", _PINNED_ROLLBACK_REQUIRED),
+    ("decode", _PINNED_DECODE_REQUIRED),
+    ("request", _PINNED_REQUEST_REQUIRED),
+    ("span", _PINNED_SPAN_REQUIRED),
+])
+def test_validate_record_names_kind_and_key(kind, required):
+    """Satellite contract: every validate_record failure is ONE line
+    naming the record kind and the missing key — per kind, per key."""
+    base = {"schema": SCHEMA_VERSION, "kind": kind, "t": 0.0}
+    for key in sorted(required):
+        rec = dict(base)
+        for k in required:
+            rec.setdefault(k, 1)
+        del rec[key]
+        ok, reason = validate_record(rec)
+        assert not ok and f"{kind} record" in reason and key in reason, \
+            (kind, key, reason)
+        assert "\n" not in reason
+    # version mismatch names the kind too (was a generic string)
+    ok, reason = validate_record({"schema": SCHEMA_VERSION + 1,
+                                  "kind": kind, "t": 0.0})
+    assert not ok and f"{kind} record" in reason and "schema" in reason
 
 
 def test_read_metrics_survives_torn_tail(tmp_path):
@@ -396,13 +481,31 @@ def test_chaos_run_report_timeline(tmp_path, capsys):
 
 
 def test_report_handles_missing_and_empty(tmp_path, capsys):
+    """A NONEXISTENT path is rc 2 (typo protection); an existing-but-
+    empty or record-free metrics dir is rc 0 with an explicit "no
+    records" summary — the run wrote nothing, which is an answer, not
+    a tooling failure."""
     from distributed_llm_code_samples_tpu.report import report_main
     assert report_main([str(tmp_path / "nope")]) == 2
+    capsys.readouterr()
+    # empty dir: exists, no metrics.jsonl
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert report_main([str(empty)]) == 0
+    out = capsys.readouterr().out
+    assert "no records" in out and "empty metrics dir" in out
+    # record-free: metrics.jsonl exists but nothing validates — the
+    # summary names the problem instead of rendering an empty report
     bad = tmp_path / "m"
     bad.mkdir()
     (bad / METRICS_FILENAME).write_text('{"not": "valid"}\n')
-    assert report_main([str(bad)]) == 2
-    capsys.readouterr()
+    assert report_main([str(bad)]) == 0
+    out = capsys.readouterr().out
+    assert "no records" in out and "version mismatch" in out
+    # --json carries the same verdict machine-readably
+    assert report_main([str(bad), "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["no_records"] and doc["streams"][0]["problems"]
 
 
 def test_report_profile_folding(tmp_path, capsys):
@@ -456,6 +559,14 @@ def test_trace_analysis_overlap_and_scopes():
     assert classify_span("infeed") is None
     totals = scope_totals(spans, "fsdp")
     assert totals["fsdp/fwd/comm"] == pytest.approx(7.0)
-    # every strategy in the naming map carries the four-role structure
+    # every TRAINING strategy in the naming map carries the four-role
+    # structure; the serving entries (decode/prefill) have no optimizer
+    # and carry the decode-attribution roles instead
+    from distributed_llm_code_samples_tpu.utils.trace_analysis import (
+        SERVING_SCOPES)
     for strat, regions in SCOPES.items():
-        assert any("optim" in r for r in regions), strat
+        if strat in SERVING_SCOPES:
+            assert any("sample" in r for r in regions), strat
+            assert any("gather" in r for r in regions), strat
+        else:
+            assert any("optim" in r for r in regions), strat
