@@ -248,6 +248,120 @@ def main() -> int:
     if not tp_only and os.environ.get("DECODE_ENGINE", "1") != "0":
         guarded("engine_f32_tokens_per_sec", engine_rows)
 
+    # Speculative-decoding rows (round 12): the same engine with
+    # speculate=4 on a PROMPT-COPY workload (periodic prompts — the
+    # n-gram drafter's home turf; greedy decode on any model also
+    # falls into loops the drafter catches). Outputs are asserted
+    # byte-identical to the non-speculative engine (greedy verification
+    # — the whole design constraint), so the throughput delta is pure
+    # dispatch/scheduler amortization at equal tokens.
+    def spec_rows():
+        import numpy as np
+
+        from distributed_llm_code_samples_tpu.decode import (
+            DecodeEngine, EngineConfig)
+
+        block = int(os.environ.get("BENCH_ENGINE_BLOCK", 16))
+        mbps = -(-(T0 + NEW) // block)
+        rng = np.random.default_rng(7)
+        motifs = [rng.integers(0, V, size=8).tolist() for _ in range(B)]
+        spec_prompts = [(m * (-(-T0 // 8)))[:T0] for m in motifs]
+
+        def run(speculate):
+            cfg = EngineConfig(
+                block_size=block, n_blocks=1 + B * mbps, max_slots=B,
+                max_blocks_per_seq=mbps,
+                prefill_chunk=min(block, 1 << (T0.bit_length() - 1)),
+                kv_dtype="f32", speculate=speculate)
+            eng = DecodeEngine(params, H, cfg)
+            t0 = time.perf_counter()
+            outs = eng.generate(spec_prompts, NEW)
+            return outs, eng, eng.tokens_generated / (
+                time.perf_counter() - t0)
+
+        base_outs, _, base_tps = run(0)
+        outs, eng, tps = run(4)
+        if outs != base_outs:
+            raise RuntimeError("speculative output != greedy baseline "
+                               "(token-identity contract violated)")
+        paths["engine_spec_tokens_per_sec"] = round(tps, 1)
+        paths["engine_spec_vs_base"] = round(tps / base_tps, 3)
+        paths["spec_accept_rate"] = round(
+            eng.accepted_tokens / max(eng.drafted_tokens, 1), 4)
+        paths["spec_tokens_per_step"] = round(
+            eng.tokens_generated / max(eng.steps, 1), 2)
+        paths["spec_note"] = (
+            "speculate=4, n-gram prompt-copy drafter on periodic "
+            "prompts; outputs asserted byte-identical to the "
+            "non-speculative engine. The win is per-token dispatch/"
+            "scheduler amortization: expect > 1 where steps are "
+            "dispatch- or HBM-bound (real chips), < 1 on CPU where "
+            "the verify program's (k+1)x compute is not hidden — "
+            "chip numbers land with run_hw_artifacts.sh")
+
+    if not tp_only and os.environ.get("DECODE_ENGINE", "1") != "0":
+        guarded("engine_spec_tokens_per_sec", spec_rows)
+
+    # Fused-vs-gather kernel ratio (round 12): the same engine workload
+    # through EngineConfig(kernel=...) per KV dtype. Off-chip this runs
+    # the Pallas INTERPRETER (a correctness lane, orders of magnitude
+    # slower than compiled XLA — the ratio is honest but meaningless
+    # for perf); the real-chip ratio lands with run_hw_artifacts.sh
+    # (ROADMAP item 6). BENCH_FUSED_NEW bounds the interpret-lane cost.
+    def fused_rows():
+        import numpy as np
+
+        from distributed_llm_code_samples_tpu.decode import (
+            DecodeEngine, EngineConfig)
+        from distributed_llm_code_samples_tpu.ops.pallas_paged_attention \
+            import interpret_supported
+
+        on_tpu = jax.default_backend() == "tpu"
+        if not on_tpu and not interpret_supported():
+            paths["fused_vs_gather"] = ("skipped: no scalar-prefetch "
+                                        "pallas surface")
+            return
+        new = int(os.environ.get("BENCH_FUSED_NEW",
+                                 NEW if on_tpu else min(NEW, 24)))
+        n_seq = B if on_tpu else min(B, 2)
+        block = int(os.environ.get("BENCH_ENGINE_BLOCK", 16))
+        mbps = -(-(T0 + new) // block)
+        rng = np.random.default_rng(0)
+        fr_prompts = [rng.integers(0, V, size=T0).tolist()
+                      for _ in range(n_seq)]
+
+        def run(kv_dtype, kernel):
+            cfg = EngineConfig(
+                block_size=block, n_blocks=1 + n_seq * mbps,
+                max_slots=n_seq, max_blocks_per_seq=mbps,
+                prefill_chunk=min(block, 1 << (T0.bit_length() - 1)),
+                kv_dtype=kv_dtype, kernel=kernel)
+            eng = DecodeEngine(params, H, cfg)
+            t0 = time.perf_counter()
+            outs = eng.generate(fr_prompts, new)
+            return outs, eng.tokens_generated / (time.perf_counter()
+                                                - t0)
+
+        ratios = {}
+        for dt_name in ("f32", "bf16", "int8"):
+            outs_g, tps_g = run(dt_name, "gather")
+            outs_f, tps_f = run(dt_name, "fused")
+            if outs_f != outs_g:
+                raise RuntimeError(f"fused != gather tokens at "
+                                   f"{dt_name}")
+            ratios[dt_name] = round(tps_f / tps_g, 4)
+            paths[f"engine_fused_{dt_name}_tokens_per_sec"] = round(
+                tps_f, 1)
+        paths["fused_vs_gather"] = ratios
+        if not on_tpu:
+            paths["fused_vs_gather_note"] = (
+                "CPU interpret lane: fused runs the Pallas interpreter "
+                "(correctness only; expect << 1). Real-chip ratio is a "
+                "run_hw_artifacts.sh artifact (ROADMAP item 6).")
+
+    if not tp_only and os.environ.get("DECODE_FUSED", "1") != "0":
+        guarded("fused_vs_gather", fused_rows)
+
     # TP decode scaling on the fake-8-device CPU mesh: subprocesses
     # (fresh backend each — the current process is pinned to its
     # platform) run ONLY the tp path at tiny shape over mesh 1/2/4/8.
@@ -322,6 +436,13 @@ def main() -> int:
                           "B * kv_bytes_avg) / hbm_bw); params re-read "
                           "every step, KV at its average length"),
         "roofline_by_kv_dtype": roofline_by_kv,
+        "roofline_levers_note": (
+            "round-12 levers against the same ceiling: "
+            "spec_tokens_per_step multiplies tokens per dispatch at "
+            "equal outputs (engine_spec_* rows), and kernel='fused' "
+            "walks the pool at the storage dtype with no gathered-"
+            "layout round-trip (fused_vs_gather rows; kv int8 cuts "
+            "the streamed bytes 4x, not just the stored bytes)"),
         "param_bytes": param_bytes,
         "kv_bytes_avg_per_seq": int(kv_bytes_avg),
         "hbm_bw_gbps": round(bw / 1e9, 1),

@@ -5,13 +5,15 @@ quarantine, pool-pressure preemption, snapshot-resume supervision, and
 request-level admission control (``decode/supervise.py``, DESIGN.md
 section 16)."""
 
+from .draft import draft_tokens
 from .engine import (AdmissionError, DecodeEngine, EngineConfig,
                      FLIGHT_FILENAME, POISON_ALL, POISON_NONE,
                      REQUEST_EVENTS, ServePolicy)
 from .paged import (KV_DTYPES, PagedKV, SCRATCH_BLOCK, corrupt_block,
-                    gather_layer, init_pool, kv_bytes_per_token,
-                    pool_bytes, scrub_blocks, write_chunk, write_rows)
-from .sampling import check_sampling, make_pick
+                    fused_decode_attn, gather_layer, init_pool,
+                    kv_bytes_per_token, pool_bytes, scrub_blocks,
+                    write_chunk, write_rows)
+from .sampling import check_sampling, check_speculation, make_pick
 from .supervise import (SNAPSHOT_FILENAME, load_snapshot,
                         restore_engine_state, snapshot_state,
                         supervise_decode, write_snapshot)
@@ -20,9 +22,10 @@ __all__ = [
     "AdmissionError", "DecodeEngine", "EngineConfig", "FLIGHT_FILENAME",
     "POISON_ALL", "POISON_NONE", "REQUEST_EVENTS", "ServePolicy",
     "KV_DTYPES", "PagedKV", "SCRATCH_BLOCK", "corrupt_block",
-    "gather_layer", "init_pool", "kv_bytes_per_token", "pool_bytes",
+    "draft_tokens", "fused_decode_attn", "gather_layer", "init_pool",
+    "kv_bytes_per_token", "pool_bytes",
     "scrub_blocks", "write_chunk", "write_rows",
-    "check_sampling", "make_pick",
+    "check_sampling", "check_speculation", "make_pick",
     "SNAPSHOT_FILENAME", "load_snapshot", "restore_engine_state",
     "snapshot_state", "supervise_decode", "write_snapshot",
 ]

@@ -1,0 +1,56 @@
+"""n-gram / prompt-copy drafter for speculative decoding.
+
+The engine's speculative path (``decode/engine.py``, ``speculate=k``)
+needs a cheap proposal distribution: up to ``k`` guesses for the next
+tokens, verified in one batched compiled step. The first-principles
+answer — no second model, no learned parameters — is **prompt-copy
+n-gram lookup** (the "prompt lookup decoding" observation): real
+serving traffic is dominated by continuations that repeat something
+already in the context (quoted prompt spans, code identifiers, and —
+on this repo's tiny random-weight models — the constant/cyclic
+attractors greedy decode falls into), so the best free guess for "what
+comes next" is "what came after the last time this suffix appeared".
+
+Contract (the whole reliability story hangs on it): a draft is a PURE
+FUNCTION of the token history ``prompt + out`` — no carried state, no
+randomness, no clock. Quarantine-retry, preemption replay, and
+crash-resume therefore re-draft identically: a resumed engine at the
+same history proposes the same tokens, verifies them against the same
+greedy picks, and rebuilds the same KV write history
+(tests/test_spec_decode.py pins it at every kv_dtype).
+
+Scale note: the scan below is O(n·len(history)) per call — exactly
+right for the max_seq_len-bounded engine histories this repo serves.
+A production router would amortize it with a suffix automaton per
+sequence; that is an optimization of this function's contract, not a
+change to it.
+"""
+
+from __future__ import annotations
+
+
+def draft_tokens(history, k: int, max_n: int = 3) -> list[int]:
+    """Propose up to ``k`` continuation tokens for ``history``.
+
+    Finds the LONGEST suffix of ``history`` (length ``max_n`` down to
+    1) that occurred earlier, preferring the MOST RECENT earlier
+    occurrence (recency beats frequency for loop-shaped continuations),
+    and copies the tokens that followed it. Returns ``[]`` when no
+    token of the suffix ever occurred before — the verify step then
+    degenerates to a plain decode step (one token, nothing risked).
+    May return fewer than ``k`` tokens when the match sits near the
+    end of the history."""
+    if k <= 0:
+        return []
+    h = [int(t) for t in history]
+    n_h = len(h)
+    if n_h < 2:
+        return []
+    for n in range(min(max_n, n_h - 1), 0, -1):
+        suffix = h[n_h - n:]
+        # j is the END index of a candidate earlier occurrence; scan
+        # right-to-left so the first hit is the most recent one
+        for j in range(n_h - 2, n - 2, -1):
+            if h[j - n + 1:j + 1] == suffix:
+                return h[j + 1:j + 1 + k]
+    return []

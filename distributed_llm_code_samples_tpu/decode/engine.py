@@ -62,6 +62,34 @@ counterpart of the self-healing training ladder):
   (``deadline_steps``), and lifecycle telemetry — one schema-v4
   ``request`` record per transition (admitted / preempted / retried /
   quarantined / completed / rejected / expired).
+
+Raw-latency layer (round 12, DESIGN.md section 18 — two compounding
+attacks on per-token cost):
+
+- **Speculative decoding** (``EngineConfig(speculate=k)``): an n-gram
+  prompt-copy drafter (``decode/draft.py`` — no second model, state a
+  pure function of ``prompt + out``) proposes up to ``k`` tokens per
+  slot; ONE compiled verify dispatch chains ``k+1`` single-token
+  sub-steps (the decode body unrolled) and accepts the matched greedy
+  prefix, so a step emits ``1 + accepted`` tokens per sequence at one
+  dispatch's host/scheduler cost. Verification is greedy and the KV
+  write of a drafted row is MASKED by its own acceptance (a rejected
+  row's scatter is redirected to the scratch block — the existing pad
+  idiom), so the pool's write history contains exactly the rows the
+  non-speculative engine would have written: token identity holds
+  BIT-FOR-BIT at every kv_dtype, int8 requant history included, and
+  rollback of a rejected tail is literally nothing (the rows never
+  landed). Replay teacher-forces recorded tokens as drafts (all
+  accepted on a healthy replay), so quarantine/preempt/crash-resume
+  re-draft identically; teacher-forced tokens stay OUT of the
+  ``drafted_tokens``/``accepted_tokens`` telemetry pair, which scores
+  the live n-gram drafter only.
+- **Fused paged-attention kernel** (``EngineConfig(kernel="fused")``):
+  the decode/verify cache read runs the Pallas block-table walk
+  (``ops/pallas_paged_attention.py``) instead of the gather →
+  ``decode_attn`` two-pass — pool bytes cross the bus once, at the
+  storage dtype, int8 dequant folded in. The gather path stays the
+  differential oracle (bit-identical at f32 under jit).
 """
 
 from __future__ import annotations
@@ -84,11 +112,12 @@ from ..ops.norm import layernorm
 from ..runtime.guardrails import rows_finite
 from ..runtime.telemetry import FLIGHT_FILENAME
 from ..runtime.tracing import SpanTracer
+from .draft import draft_tokens
 from .paged import (PagedKV, SCRATCH_BLOCK, corrupt_block as
-                    _pool_corrupt_block, gather_layer, init_pool,
-                    kv_bytes_per_token, pool_bytes, scrub_blocks,
-                    write_chunk, write_rows)
-from .sampling import check_sampling, make_pick
+                    _pool_corrupt_block, fused_decode_attn, gather_layer,
+                    init_pool, kv_bytes_per_token, pool_bytes,
+                    scrub_blocks, write_chunk, write_rows)
+from .sampling import check_sampling, check_speculation, make_pick
 
 # poison operand values for the compiled steps (chaos nan_logits
 # injection rides a runtime operand, so arming a fault never recompiles)
@@ -144,7 +173,16 @@ class EngineConfig:
     config). ``block_size`` must be a power of two so power-of-two
     prefill chunks never straddle a block boundary (``paged.write_chunk``).
     ``n_blocks`` includes the reserved scratch block. ``temperature=0``
-    is greedy; ``top_k=0`` / ``top_p=0`` disable those truncations."""
+    is greedy; ``top_k=0`` / ``top_p=0`` disable those truncations.
+
+    ``speculate`` is the per-step draft budget (0 = off): each decode
+    dispatch becomes a ``speculate+1``-token verify program emitting
+    the accepted greedy prefix (requires ``temperature == 0``;
+    ``decode/draft.py``). ``kernel`` selects the cache-read path for
+    decode/verify steps: ``"gather"`` (two-pass oracle:
+    ``gather_paged_kv`` then ``decode_attn``) or ``"fused"`` (the
+    Pallas block-table walk, single-device only — prefill keeps its
+    chunked gather attention either way)."""
     block_size: int = 16
     n_blocks: int = 65
     max_slots: int = 4
@@ -156,6 +194,8 @@ class EngineConfig:
     top_p: float = 0.0
     seed: int = 0
     use_rope: bool = False
+    speculate: int = 0
+    kernel: str = "gather"
 
     @property
     def capacity(self) -> int:
@@ -263,6 +303,23 @@ class DecodeEngine:
                 f"{cfg.prefill_chunk} (power-of-two chunks are what "
                 "keeps a chunk inside one block — paged.write_chunk)")
         check_sampling(cfg.temperature, cfg.top_k, cfg.top_p, params.vocab)
+        check_speculation(cfg.speculate, cfg.temperature)
+        if cfg.kernel not in ("gather", "fused"):
+            raise ValueError(f"kernel must be 'gather' or 'fused', got "
+                             f"{cfg.kernel!r}")
+        if cfg.kernel == "fused":
+            if mesh is not None:
+                raise ValueError(
+                    "kernel='fused' is single-device (the head-sharded "
+                    "TP pool runs the gather path); pass mesh=None or "
+                    "kernel='gather'")
+            from ..ops.pallas_paged_attention import interpret_supported
+            if jax.default_backend() != "tpu" and not \
+                    interpret_supported():
+                raise ValueError(
+                    "kernel='fused' needs the scalar-prefetch pallas "
+                    "surface for its off-chip interpret mode; this jax "
+                    "lacks it — use kernel='gather'")
         self.params = params
         self.n_heads = n_heads
         self.cfg = cfg
@@ -329,6 +386,18 @@ class DecodeEngine:
         self.block_allocs = 0
         self.block_frees = 0
         self.block_scrubs = 0
+        # speculative-decoding counters (cumulative; snapshot-persisted
+        # like the churn trio): drafted = tokens proposed to verify
+        # steps, accepted = drafted tokens the greedy verify kept (the
+        # per-step bonus token is counted in tokens_generated, not here
+        # — accept_rate = accepted / drafted is the drafter's score)
+        self.drafted_tokens = 0
+        self.accepted_tokens = 0
+        # tokens emitted inside the CURRENT span per uid (decode/replay
+        # segments emit many tokens per step under speculation; the
+        # span record carries the count so a waterfall shows work, not
+        # just wall clock)
+        self._span_tokens: dict[int, int] = {}
         free0 = len(self.free_blocks)
         self._free_lo = self._free_hi = free0
         # flight recorder: per-step digests + the current step's
@@ -374,8 +443,10 @@ class DecodeEngine:
         fn = self._programs.get(key)
         if fn is None:
             self.compile_count += 1
-            fn = (self._build_decode(bucket) if kind == "decode"
-                  else self._build_prefill(bucket))
+            builder = {"decode": self._build_decode,
+                       "prefill": self._build_prefill,
+                       "verify": self._build_verify}[kind]
+            fn = builder(bucket)
             self._programs[key] = fn
         self.dispatch_count += 1
         return fn
@@ -443,28 +514,51 @@ class DecodeEngine:
             logits = all_gather(logits, MODEL_AXIS, dim=1)
         return logits
 
-    def _wrap(self, run):
+    def _wrap(self, run, n_aux: int = 5, n_out: int = 3):
         """The (possibly shard_mapped) callable a compiled program is
         built from — split from ``_jit`` so the static attribution path
         (``decode_static_report``) can lower the SAME program without a
-        second donation annotation."""
+        second donation annotation. ``n_aux`` counts the replicated
+        host operands after ``(params, pool)`` and ``n_out`` the
+        returned arrays (the verify program carries two extra operands
+        — drafts, draft lengths — and one extra output — the accepted
+        counts — over decode/prefill's 5/3)."""
         if self.mesh is None:
             return run
         from ..parallel.lm import tp_decode_specs
         return jax.shard_map(
             run, mesh=self.mesh,
-            in_specs=(tp_decode_specs(), self._pool_specs(), P(), P(),
-                      P(), P(), P()),
-            out_specs=(self._pool_specs(), P(), P()), check_vma=False)
+            in_specs=(tp_decode_specs(), self._pool_specs())
+            + (P(),) * n_aux,
+            out_specs=(self._pool_specs(),) + (P(),) * (n_out - 1),
+            check_vma=False)
 
-    def _jit(self, run):
+    def _jit(self, run, n_aux: int = 5, n_out: int = 3):
         """jit (or shard_map+jit under TP) with the pool donated: the
         engine replaces ``self.pool`` with the returned pool after every
         dispatch, so XLA may update the blocks in place instead of
         copying the whole pool per step — without donation each decode
         step would pay a full-pool allocate+copy, swamping the
         kv_bytes roofline term this engine exists to shrink."""
-        return jax.jit(self._wrap(run), donate_argnums=(1,))
+        return jax.jit(self._wrap(run, n_aux, n_out), donate_argnums=(1,))
+
+    def _cached_attn(self, pool: PagedKV, l: int, q, tables, n_attend):
+        """One single-query attention over the block-table cache — the
+        ``kernel=`` knob. ``gather``: materialize each slot's
+        contiguous view (``gather_layer``, the "gather" scope) and run
+        ``decode_attn`` — the differential oracle. ``fused``: the
+        Pallas block-table walk (``ops/pallas_paged_attention.py``),
+        dequant folded in, no gathered layout in HBM — bit-identical
+        to the oracle at f32 under jit. ``n_attend [b]`` is the
+        per-slot attendable-position count (always >= 1)."""
+        if self.cfg.kernel == "fused":
+            with jax.named_scope("attn"):
+                return fused_decode_attn(pool, l, q, tables, n_attend)
+        ck, cv = jax.vmap(
+            lambda t, _l=l, _pool=pool: gather_layer(_pool, _l, t)
+        )(tables)                           # [b, Hkv_loc, T_cap, dh]
+        with jax.named_scope("attn"):
+            return decode_attn(q, ck, cv, n_attend)
 
     def _decode_fn(self, b: int):
         """The raw (un-jitted) decode-step body for a ``b``-slot bucket:
@@ -499,12 +593,8 @@ class DecodeEngine:
             def write_attn(l, pool, q, k, v):
                 phys = tables[jnp.arange(b), slot_phys]
                 pool = write_rows(pool, l, phys, off, k, v, cfg.kv_dtype)
-                ck, cv = jax.vmap(
-                    lambda t, _l=l, _pool=pool: gather_layer(_pool, _l, t)
-                )(tables)                       # [b, Hkv_loc, T_cap, dh]
-                with jax.named_scope("attn"):
-                    y = decode_attn(q, ck, cv, lengths + 1)
-                return pool, y
+                return pool, self._cached_attn(pool, l, q, tables,
+                                               lengths + 1)
 
             pool, x = self._trunk(p, pool, x, lengths, write_attn)
             with jax.named_scope("head"):
@@ -520,6 +610,80 @@ class DecodeEngine:
 
     def _build_decode(self, b: int):
         return self._jit(self._decode_fn(b))
+
+    def _verify_fn(self, b: int):
+        """The speculative verify body for a ``b``-slot bucket:
+        ``speculate + 1`` single-token decode sub-steps UNROLLED into
+        one program — sub-step 0 feeds each slot's pending token, every
+        later sub-step feeds the next drafted token, and the in-graph
+        acceptance chain ``alive_i = alive_{i-1} and draft_i == pick_{i-1}``
+        masks each drafted row's KV WRITE by its own acceptance (a dead
+        row's scatter is redirected to the scratch block, the pad
+        idiom). The sub-steps are sequential on purpose: each one reads
+        the cache state its predecessor wrote — the same bytes the
+        non-speculative engine would have read at that position — which
+        is what makes speculative output bit-identical at every
+        kv_dtype (int8's cross-row requant coupling rules out a
+        position-parallel verify; the win here is one dispatch + one
+        scheduler pass per ``1 + accepted`` tokens, and the rejected
+        tail needs no rollback because it never landed).
+
+        Returns ``(pool, picks [b, k+1], accepted [b], finite
+        [b, k+1])``; the host emits ``picks[:, :accepted+1]`` and
+        advances lengths by the same count."""
+        cfg = self.cfg
+        k = cfg.speculate
+        pick = make_pick(cfg.temperature, cfg.top_k, cfg.top_p,
+                         self.params.vocab, cfg.seed)
+
+        @jax.named_scope("decode")
+        def run(p: LMParams, pool: PagedKV, tables, lengths, tokens,
+                uids, drafts, dlens, poison):
+            rows = jnp.arange(b)
+            alive = jnp.ones((b,), bool)
+            acc = jnp.zeros((b,), jnp.int32)
+            cur = tokens
+            picks_all, finite_all = [], []
+            for i in range(k + 1):
+                pos = lengths + i
+                x = self._embed(p, cur, pos)                 # [b, d]
+                slot_phys = pos // cfg.block_size
+                off = pos % cfg.block_size
+
+                def write_attn(l, pool, q, kk, vv, _off=off,
+                               _sp=slot_phys, _keep=alive, _i=i):
+                    phys = tables[rows, _sp]
+                    phys = jnp.where(_keep, phys, SCRATCH_BLOCK)
+                    pool = write_rows(pool, l, phys, _off, kk, vv,
+                                      cfg.kv_dtype)
+                    return pool, self._cached_attn(pool, l, q, tables,
+                                                   lengths + _i + 1)
+
+                pool, x = self._trunk(p, pool, x, pos, write_attn)
+                with jax.named_scope("head"):
+                    logits = self._logits(p, layernorm(p.ln_f, x))
+                bad = jnp.logical_or(uids == poison,
+                                     poison == POISON_ALL)
+                logits = jnp.where(bad[:, None],
+                                   jnp.asarray(jnp.nan, logits.dtype),
+                                   logits)
+                with jax.named_scope("sample"):
+                    pk = pick(logits, uids, pos + 1)
+                picks_all.append(pk)
+                finite_all.append(rows_finite(logits))
+                if i < k:
+                    d = drafts[:, i]
+                    alive = jnp.logical_and(
+                        alive, jnp.logical_and(i < dlens, d == pk))
+                    acc = acc + alive.astype(jnp.int32)
+                    cur = d
+            return (pool, jnp.stack(picks_all, 1), acc,
+                    jnp.stack(finite_all, 1))
+
+        return run
+
+    def _build_verify(self, b: int):
+        return self._jit(self._verify_fn(b), n_aux=7, n_out=4)
 
     def _prefill_fn(self, c: int):
         """The raw prefill-chunk body for one slot: ``c`` prompt tokens
@@ -805,7 +969,8 @@ class DecodeEngine:
                     latency_s=round(now - seq.t_submit, 4),
                     n_new=len(seq.out), retries=seq.retries)
         self.tracer.close(seq.uid, self.global_step, t=now,
-                          n_new=len(seq.out))
+                          n_new=len(seq.out),
+                          tokens=self._span_tokens.pop(seq.uid, 0))
         self._evict(slot)
 
     def _requeue(self, seq: _Seq) -> None:
@@ -838,7 +1003,8 @@ class DecodeEngine:
         self._event("preempted", seq.uid, reason="pool_pressure",
                     n_out=len(seq.out))
         self.tracer.transition(seq.uid, "preempt_gap", self.global_step,
-                               reason="pool_pressure")
+                               reason="pool_pressure",
+                               tokens=self._span_tokens.pop(seq.uid, 0))
         self._requeue(seq)
         self._head_blocked = 0
         return True
@@ -875,7 +1041,8 @@ class DecodeEngine:
         # the digest covering the quarantine itself is in the ring)
         self._dump_reason = f"quarantine uid {seq.uid} ({reason})"
         self.tracer.transition(seq.uid, "quarantine", self.global_step,
-                               reason=reason)
+                               reason=reason,
+                               tokens=self._span_tokens.pop(seq.uid, 0))
         if seq.retries < self.policy.max_retries:
             seq.retries += 1
             self.retried += 1
@@ -909,7 +1076,8 @@ class DecodeEngine:
             self._event("expired", seq.uid, reason="deadline",
                         n_out=len(seq.out))
             self.tracer.close(seq.uid, self.global_step,
-                              reason="deadline")
+                              reason="deadline",
+                              tokens=self._span_tokens.pop(seq.uid, 0))
             self.failed[seq.uid] = {"reason": "deadline",
                                     "retries": seq.retries,
                                     "n_out": len(seq.out)}
@@ -945,13 +1113,20 @@ class DecodeEngine:
             self.tokens_generated += 1
         seq.emitted += 1
         self.next_token[slot] = tok
+        # the emission belongs to the CURRENT span (replay or decode
+        # segment) — speculation makes steps multi-token, so span
+        # records carry the count, not just the wall clock
+        self._span_tokens[seq.uid] = self._span_tokens.get(seq.uid,
+                                                           0) + 1
         if seq.finished:
             self._release(slot)
         elif was_replaying and not seq.replaying:
             # caught up: the teacher-forcing window ends, live decode
             # begins (a new decode SEGMENT span)
             self.tracer.transition(seq.uid, "decode", self.global_step,
-                                   replayed=len(seq.out))
+                                   replayed=len(seq.out),
+                                   tokens=self._span_tokens.pop(
+                                       seq.uid, 0))
 
     @staticmethod
     def _maybe_capture(fn, *args) -> None:
@@ -1016,7 +1191,11 @@ class DecodeEngine:
             self.tracer.transition(seq.uid, "prefill", self.global_step,
                                    tokens=c)
 
-    def _decode_step(self, ready: list[int]) -> None:
+    def _marshal(self, ready: list[int]):
+        """Bucket-pad the dispatch operands for ``ready``: pad rows
+        point at the scratch block with zeroed length/token/uid, so
+        their writes land in the pad row's designated dump and their
+        idle uid never matches a poison operand."""
         b = _bucket_for(len(ready), self.slot_buckets)
         idx = ready + [0] * (b - len(ready))        # pad rows
         tables = self.tables[idx].copy()
@@ -1028,6 +1207,10 @@ class DecodeEngine:
             lengths[j] = 0
             tokens[j] = 0
             uids[j] = 0
+        return b, tables, lengths, tokens, uids
+
+    def _decode_step(self, ready: list[int]) -> None:
+        b, tables, lengths, tokens, uids = self._marshal(ready)
         fn = self._program("decode", b)
         args = (self.params, self.pool, jnp.asarray(tables),
                 jnp.asarray(lengths), jnp.asarray(tokens),
@@ -1047,6 +1230,87 @@ class DecodeEngine:
                 continue
             self.lengths[slot] += 1
             self._emit(slot, int(picks[j]))
+
+    # -- speculative decoding (DESIGN.md section 18) -------------------
+
+    def _draft_for(self, seq: _Seq, budget: int) -> tuple[list[int], int]:
+        """Up to ``budget`` draft tokens for one slot, plus how many of
+        them are teacher-forced REPLAY tokens. During replay the
+        recorded continuation IS the draft (teacher-forcing through
+        the verify path — all accepted on a healthy replay, so resume
+        re-speculates at full width); past the recorded window (and for
+        live sequences) the n-gram prompt-copy drafter proposes from
+        the full known history. Both sources are pure functions of
+        ``prompt + out`` — the re-draft-identically contract. The
+        replay count lets ``_verify_step`` keep teacher-forced tokens
+        out of ``drafted_tokens``/``accepted_tokens``: they are
+        accepted by construction, not by drafter skill, and a
+        crash-resume already restored them into the counters once."""
+        if budget <= 0:
+            return [], 0
+        rec = seq.out[seq.emitted:seq.emitted + budget]
+        if len(rec) < budget:
+            guess = draft_tokens(seq.prompt + seq.out,
+                                 budget - len(rec))
+            return rec + guess[:budget - len(rec)], len(rec)
+        return rec[:budget], budget
+
+    def _verify_step(self, ready: list[int]) -> None:
+        """The speculative decode dispatch: draft per slot (capped so
+        accepted emissions can never outrun ``max_new`` or the block
+        reservation — a verify step writes one KV row per emitted
+        token, the non-speculative 1:1), run the verify program once,
+        then emit each slot's ``1 + accepted`` greedy tokens. A
+        non-finite flag anywhere in a slot's USED window (sub-steps
+        ``0..accepted``) quarantines the whole step for that uid —
+        nothing is emitted, the drafted tail is rolled back whole
+        (its masked rows only ever landed in the uid's own blocks,
+        which quarantine frees and scrubs)."""
+        k = self.cfg.speculate
+        b, tables, lengths, tokens, uids = self._marshal(ready)
+        drafts = np.zeros((b, k), np.int32)
+        dlens = np.zeros((b,), np.int32)
+        replayed = np.zeros((b,), np.int32)
+        for j, slot in enumerate(ready):
+            seq = self.slots[slot]
+            # emissions this step <= max_new - emitted (the final
+            # token of a sequence is returned, never cached, so the
+            # row budget works out to exactly the capacity check
+            # submit() performed)
+            d, n_rec = self._draft_for(
+                seq, min(k, seq.max_new - seq.emitted - 1))
+            dlens[j] = len(d)
+            drafts[j, :len(d)] = d
+            replayed[j] = n_rec
+            self.drafted_tokens += len(d) - n_rec
+        fn = self._program("verify", b)
+        args = (self.params, self.pool, jnp.asarray(tables),
+                jnp.asarray(lengths), jnp.asarray(tokens),
+                jnp.asarray(uids), jnp.asarray(drafts),
+                jnp.asarray(dlens), jnp.int32(self._poison_uid))
+        self._maybe_capture(fn, *args)
+        pool, picks, acc, ok = fn(*args)
+        self.pool = pool
+        picks = np.asarray(picks)
+        acc = np.asarray(acc)
+        ok = np.asarray(ok)
+        self._step_decode_uids = [self.slots[s].uid for s in ready]
+        flags = []
+        for j, slot in enumerate(ready):
+            m = int(acc[j])
+            fine = bool(ok[j, :m + 1].all())
+            flags.append(fine)
+            if not fine:
+                self._quarantine(slot, "nonfinite_logits")
+                continue
+            self.accepted_tokens += max(0, m - int(replayed[j]))
+            self.lengths[slot] += m + 1
+            for t in range(m + 1):
+                if self.slots[slot] is None:
+                    break           # released at its final emission
+                self._emit(slot, int(picks[j, t]))
+        self._step_finite = (flags if self._step_finite is None
+                             else self._step_finite + flags)
 
     def step(self) -> bool:
         """One scheduler iteration: expire deadlines, admit (with
@@ -1073,7 +1337,14 @@ class DecodeEngine:
         ready = [i for i, s in enumerate(self.slots)
                  if s is not None and s.prompt_done]
         if ready:
-            self._decode_step(ready)
+            # speculation on -> every decode dispatch is a verify
+            # dispatch (one program kind per bucket; a zero-draft step
+            # degenerates to plain decode inside the same program, so
+            # the steady-state compile surface stays bounded)
+            if self.cfg.speculate:
+                self._verify_step(ready)
+            else:
+                self._decode_step(ready)
             did = True
         if did:
             self.steps += 1
@@ -1163,6 +1434,11 @@ class DecodeEngine:
             "tokens_generated": self.tokens_generated,
             "kv_dtype": self.cfg.kv_dtype,
             "compiled_programs": self.compile_count,
+            "drafted_tokens": self.drafted_tokens,
+            "accepted_tokens": self.accepted_tokens,
+            "accept_rate": (round(self.accepted_tokens
+                                  / self.drafted_tokens, 4)
+                            if self.drafted_tokens else None),
             "quarantined": self.quarantined,
             "retried": self.retried,
             "preempted": self.preempted,

@@ -87,6 +87,17 @@ def build_generate_parser() -> argparse.ArgumentParser:
                    help="per-sequence table width (0 = cover "
                         "max_seq_len)")
     p.add_argument("--prefill_chunk", type=int, default=16)
+    # raw-latency levers (round 12, DESIGN.md section 18)
+    p.add_argument("--speculate", type=int, default=0,
+                   help="speculative decoding: draft tokens per decode "
+                        "step from the n-gram prompt-copy drafter "
+                        "(greedy verification — requires temperature "
+                        "0; a step emits 1 + accepted tokens; 0 = off)")
+    p.add_argument("--kernel", choices=["gather", "fused"],
+                   default="gather",
+                   help="decode attention path: 'gather' (two-pass "
+                        "oracle) or 'fused' (Pallas block-table walk, "
+                        "single-device; ops/pallas_paged_attention.py)")
     # parallel strategy
     p.add_argument("--tp", type=int, default=1,
                    help="model-axis size for the Megatron decode layout "
@@ -232,7 +243,8 @@ def generate_main(argv=None) -> int:
             prefill_chunk=args.prefill_chunk, kv_dtype=args.kv_dtype,
             temperature=args.temperature, top_k=args.top_k,
             top_p=args.top_p, seed=args.sample_seed,
-            use_rope=args.use_rope)
+            use_rope=args.use_rope, speculate=args.speculate,
+            kernel=args.kernel)
         policy = ServePolicy(
             queue_limit=args.queue_limit,
             deadline_steps=args.deadline_steps,
@@ -285,6 +297,7 @@ def generate_main(argv=None) -> int:
             "layers": args.layers, "heads": args.heads,
             "kv_dtype": args.kv_dtype, "max_slots": args.max_slots,
             "block_size": args.block_size, "tp": tp,
+            "speculate": args.speculate, "kernel": args.kernel,
             "n_prompts": len(prompts), "max_new": args.max_new,
             "device_kind": jax.devices()[0].device_kind}
         if args.snapshot_dir:
@@ -358,6 +371,13 @@ def generate_main(argv=None) -> int:
         "dispatches": engine.dispatch_count,
         "kv_dtype": args.kv_dtype,
         "tp": tp,
+        "speculate": args.speculate,
+        "kernel": args.kernel,
+        "drafted_tokens": engine.drafted_tokens,
+        "accepted_tokens": engine.accepted_tokens,
+        "accept_rate": (round(engine.accepted_tokens
+                              / engine.drafted_tokens, 4)
+                        if engine.drafted_tokens else None),
         "quarantined": engine.quarantined,
         "retried": engine.retried,
         "preempted": engine.preempted,

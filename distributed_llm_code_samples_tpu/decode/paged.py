@@ -306,6 +306,25 @@ def corrupt_block(pool: PagedKV, block: int) -> PagedKV:
                          v=pool.v.at[:, block].set(bad))
 
 
+def fused_decode_attn(pool: PagedKV, layer: int, q: jax.Array,
+                      tables: jax.Array, lengths: jax.Array,
+                      interpret: bool | None = None) -> jax.Array:
+    """Single-query attention for one layer, fused over the block
+    tables (``ops/pallas_paged_attention.py``): the Pallas kernel walks
+    each slot's table directly and streams pool blocks through VMEM
+    with the int8 per-block dequant folded in — no gathered
+    ``[B, H_kv, T_cap, dh]`` layout ever reaches HBM. ``q [B, H, dh]``
+    f32, ``tables [B, MB]`` int32, ``lengths [B]`` attendable positions
+    (the engine passes ``lengths + 1``). Differential oracle:
+    ``decode_attn(q, *vmap(gather_layer), lengths)`` — bit-identical at
+    f32 under jit (tests/test_pallas_paged_attention.py)."""
+    from ..ops.pallas_paged_attention import paged_decode_attn
+    ks = None if pool.k_scale is None else pool.k_scale[layer]
+    vs = None if pool.v_scale is None else pool.v_scale[layer]
+    return paged_decode_attn(q, pool.k[layer], pool.v[layer], ks, vs,
+                             tables, lengths, interpret=interpret)
+
+
 def gather_layer(pool: PagedKV, layer: int, table: jax.Array):
     """One sequence's dequantized contiguous KV view for one layer:
     ``table [max_blocks]`` -> ``(k, v)`` each ``[H_kv, T_cap, dh]`` f32

@@ -48,6 +48,25 @@ def check_sampling(temperature: float, top_k: int, top_p: float,
                          "(greedy ignores them)")
 
 
+def check_speculation(speculate: int, temperature: float) -> None:
+    """Shared validation (engine + CLI) for the speculative-decoding
+    knob: verification is GREEDY — the verify step accepts a drafted
+    token iff it equals the argmax pick, which is what keeps the
+    engine's token-identity proofs intact (an accepted token IS the
+    token the non-speculative engine would have emitted). Sampled
+    decoding would need rejection sampling over the full distribution
+    (a different acceptance rule with a different identity story), so
+    ``speculate > 0`` requires ``temperature == 0``."""
+    if speculate < 0:
+        raise ValueError(f"speculate must be >= 0 (0 = off), got "
+                         f"{speculate}")
+    if speculate and temperature != 0:
+        raise ValueError(
+            "speculative decoding verifies greedily: speculate > 0 "
+            f"requires temperature == 0, got {temperature} (sampled "
+            "decoding runs non-speculatively)")
+
+
 def _nucleus_mask(z: jax.Array, top_p: float) -> jax.Array:
     """Mask logits outside the top-p nucleus: keep the smallest
     descending-probability prefix whose mass reaches ``top_p`` (the

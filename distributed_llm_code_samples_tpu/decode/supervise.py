@@ -55,7 +55,11 @@ SNAPSHOT_FILENAME = "engine_snapshot.json"
 # v2 (round 11): counters grow the KV-pool churn trio (block_allocs /
 # block_frees / block_scrubs) so the schema-v5 decode records stay
 # monotonic across crash-resume
-SNAPSHOT_VERSION = 2
+# v3 (round 12): counters grow the speculation pair (drafted_tokens /
+# accepted_tokens) — same monotonic-across-resume contract; the
+# drafter itself needs NO snapshot state (drafts are a pure function
+# of prompt + out, decode/draft.py)
+SNAPSHOT_VERSION = 3
 
 
 # ---------------------------------------------------------------- snapshot
@@ -131,6 +135,8 @@ def snapshot_state(engine: DecodeEngine) -> dict:
             "block_allocs": engine.block_allocs,
             "block_frees": engine.block_frees,
             "block_scrubs": engine.block_scrubs,
+            "drafted_tokens": engine.drafted_tokens,
+            "accepted_tokens": engine.accepted_tokens,
         },
     }
     if engine.pool.k_scale is not None:
@@ -231,6 +237,8 @@ def restore_engine_state(engine: DecodeEngine, snap: dict) -> None:
     engine.block_allocs = int(c["block_allocs"])
     engine.block_frees = int(c["block_frees"])
     engine.block_scrubs = int(c["block_scrubs"])
+    engine.drafted_tokens = int(c["drafted_tokens"])
+    engine.accepted_tokens = int(c["accepted_tokens"])
     for req in snap["requests"]:
         engine.resume_request(req["uid"], req["prompt"], req["max_new"],
                               out=req["out"], retries=req["retries"],

@@ -157,7 +157,15 @@ def gather_paged_kv(pool_k: jax.Array, pool_v: jax.Array,
     values (the gather only moves bytes). Positions beyond the sequence
     length read whatever the table's tail blocks hold (the engine points
     unassigned table slots at the reserved scratch block); callers mask
-    them, as with the zero tail of a contiguous cache."""
+    them, as with the zero tail of a contiguous cache.
+
+    This gather + ``decode_attn`` two-pass is the decode engine's
+    DIFFERENTIAL ORACLE for the fused Pallas block-walk kernel
+    (``ops/pallas_paged_attention.py``, ``EngineConfig(kernel=``): the
+    kernel streams the same blocks through VMEM without ever
+    materializing this layout in HBM, and must match this path
+    bit-for-bit at f32 under jit (tests/test_pallas_paged_attention.py
+    pins it)."""
     k = pool_k[table]                      # [MB, H_kv, block, dh]
     v = pool_v[table]
     mb, hkv, blk, dh = k.shape

@@ -1,0 +1,204 @@
+"""Fused Pallas paged-attention decode kernel: the block-table walk.
+
+The decode engine's hot loop (``decode/engine.py``) reads the KV cache
+in two passes: ``gather_paged_kv`` materializes each slot's contiguous
+``[H_kv, T_cap, dh]`` f32 view from the block pool (an HBM round-trip
+of the whole gathered layout, dequantized — 4x inflated under int8),
+then ``models.lm.decode_attn`` reads it again. This kernel fuses the
+two: the grid walks each slot's int32 block table directly (scalar
+prefetch drives the BlockSpec index maps, so every grid step DMAs
+exactly one physical KV block from the pool), streams the blocks
+through VMEM with the per-block int8 dequant folded in, and runs the
+single-query attention in-register — the gathered layout never exists
+in HBM, and the pool bytes cross the bus once, at the STORAGE dtype.
+That is the DECODE roofline's ``B * kv_bytes`` term taken at face
+value (decode is KV-bandwidth-bound; see bench_decode.py).
+
+Bit-exactness stance (the repo's differential discipline): the kernel
+is engine-selectable (``EngineConfig(kernel="fused")``) with the
+gather two-pass kept as the oracle, and at f32 the two are BIT
+IDENTICAL under jit by construction — the walk accumulates raw score
+tiles (and a running max, which is order-exact) into VMEM scratch, and
+the mask / softmax / AV ops on the assembled row replicate
+``decode_attn``'s exact op order (divide-by-sqrt, where-mask to -1e30,
+softmax, then PV). A streamed rescaling accumulator (the flash-style
+``alpha`` fold, ``ops/pallas_attention.py``) would reorder the f32
+adds and forfeit the oracle equality; at decode's T_cap (a few K
+positions), the assembled row fits VMEM comfortably, so exactness
+costs nothing. Blocks entirely past a slot's length are skipped —
+their score tiles are pinned to the mask value and their V tiles to
+zero, which contribute exactly what the oracle's masked positions
+contribute (an exp-underflow zero times a finite byte).
+
+Layout notes: grid is ``(slots, kv_heads, table_slots)`` with the
+block walk innermost (scratch accumulates across it); GQA rides as a
+``G = H / H_kv`` query-row dimension per kv head. Shapes here are the
+engine's test shapes — real-chip runs want lane-aligned ``dh`` and a
+length-sorted slot order, which is hardware-window tuning
+(``run_hw_artifacts.sh``), not a semantics change. All paths run under
+``interpret=True`` on CPU for the hardware-free suite
+(tests/test_pallas_paged_attention.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# the oracle's mask value (models.lm.decode_attn) — shared so the
+# masked tiles stay bit-identical between the two paths
+_NEG = -1e30
+
+
+def interpret_supported() -> bool:
+    """Can the kernel run OFF-chip (generic interpret mode) on this
+    jax? The block walk needs scalar-prefetch grid specs
+    (``pltpu.PrefetchScalarGridSpec``); the capability gate is the
+    ``pallas_ring`` stance — degrade to the gather path with a fast
+    skip instead of dying mid-suite on an older pallas surface."""
+    return hasattr(pltpu, "PrefetchScalarGridSpec")
+
+
+def _interpret_arg(interpret: bool | None) -> bool:
+    # None = auto: interpret off-TPU, Mosaic on chip
+    if interpret is None:
+        return jax.default_backend() != "tpu"
+    return bool(interpret)
+
+
+def _walk_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, y_ref,
+                 s_ref, v_scr, *, blk, mb, g, dh, tcap):
+    """f32/bf16 variant: no per-block scales. See ``_walk_kernel_q8``
+    for the int8 twin; the body is shared via ``_tile``."""
+    _tile(table_ref, len_ref, q_ref, k_ref, v_ref, y_ref, s_ref, v_scr,
+          None, None, blk=blk, mb=mb, g=g, dh=dh, tcap=tcap)
+
+
+def _walk_kernel_q8(table_ref, len_ref, ksc_ref, vsc_ref, q_ref, k_ref,
+                    v_ref, y_ref, s_ref, v_scr, *, blk, mb, g, dh, tcap):
+    _tile(table_ref, len_ref, q_ref, k_ref, v_ref, y_ref, s_ref, v_scr,
+          ksc_ref, vsc_ref, blk=blk, mb=mb, g=g, dh=dh, tcap=tcap)
+
+
+def _tile(table_ref, len_ref, q_ref, k_ref, v_ref, y_ref, s_ref, v_scr,
+          ksc_ref, vsc_ref, *, blk, mb, g, dh, tcap):
+    i, h, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    length = len_ref[i]
+    sl = pl.ds(j * blk, blk)
+
+    @pl.when(j * blk < length)
+    def _():
+        # one physical block, DMA'd straight off the table walk
+        # (the index map already selected pool[table[i, j], h]);
+        # dequant folds in here — the pool bytes crossed the bus at
+        # the storage dtype
+        kb = k_ref[0, 0].astype(jnp.float32)
+        vb = v_ref[0, 0].astype(jnp.float32)
+        if ksc_ref is not None:
+            kb = kb * ksc_ref[i, j, h]
+            vb = vb * vsc_ref[i, j, h]
+        v_scr[sl, :] = vb
+        # raw scores, the oracle's exact op order: dot then / sqrt(dh)
+        s_ref[:, sl] = jax.lax.dot_general(
+            q_ref[0, 0], kb, (((1,), (1,)), ((), ()))) / jnp.sqrt(
+                jnp.asarray(dh, jnp.float32))
+
+    @pl.when(j * blk >= length)
+    def _():
+        # a block entirely past the length: every position is masked,
+        # so pin the tiles to what the oracle's mask produces (score
+        # -> _NEG, V contribution -> exact zero) without reading it
+        v_scr[sl, :] = jnp.zeros((blk, dh), jnp.float32)
+        s_ref[:, sl] = jnp.full((g, blk), _NEG, jnp.float32)
+
+    @pl.when(j == mb - 1)
+    def _():
+        # the assembled row: decode_attn's ops verbatim, so fused ==
+        # gather+attn bit-for-bit at f32 (tests pin it)
+        mask = jax.lax.broadcasted_iota(jnp.int32, (g, tcap), 1) < length
+        s = jnp.where(mask, s_ref[:, :], jnp.asarray(_NEG, jnp.float32))
+        p = jax.nn.softmax(s, axis=-1)
+        y_ref[0, 0] = jax.lax.dot_general(p, v_scr[:, :],
+                                          (((1,), (0,)), ((), ())))
+
+
+def paged_decode_attn(q: jax.Array, pool_k: jax.Array, pool_v: jax.Array,
+                      k_scale: jax.Array | None,
+                      v_scale: jax.Array | None, tables: jax.Array,
+                      lengths: jax.Array, *,
+                      interpret: bool | None = None) -> jax.Array:
+    """Fused single-query attention against a paged KV pool.
+
+    ``q [B, H, dh]`` f32; ``pool_k/pool_v [n_blocks, H_kv, block, dh]``
+    (ONE layer's pool, storage dtype); ``k_scale/v_scale
+    [n_blocks, H_kv]`` f32 per-block int8 scales (None for f32/bf16);
+    ``tables [B, MB]`` int32 physical block ids; ``lengths [B]`` the
+    number of ATTENDABLE positions per slot (callers pass the decode
+    convention ``lengths + 1``; must be >= 1 — the engine guarantees
+    it, pad rows attend the scratch block's position 0). Returns
+    ``y [B, H, dh]`` f32, bit-identical under jit to
+    ``decode_attn(q, *gather_layer(...), lengths)``.
+
+    The per-block scales ride as scalar-prefetch operands, pre-gathered
+    to ``[B, MB, H_kv]`` outside the kernel — a few hundred f32s next
+    to the block payload the walk is there to keep off the bus."""
+    b, hq, dh = q.shape
+    nb, hkv, blk, dh2 = pool_k.shape
+    if dh2 != dh:
+        raise ValueError(f"q head dim {dh} != pool head dim {dh2}")
+    if hq % hkv:
+        raise ValueError(f"query heads {hq} not divisible by kv heads "
+                         f"{hkv}")
+    g = hq // hkv
+    mb = tables.shape[1]
+    tcap = mb * blk
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale/v_scale must both be set or both None")
+    run_interpret = _interpret_arg(interpret)
+    if run_interpret and not interpret_supported():
+        raise ValueError(
+            "fused paged attention needs pltpu.PrefetchScalarGridSpec "
+            "for its off-chip interpret mode; this jax has no scalar-"
+            "prefetch surface — use EngineConfig(kernel='gather')")
+    qg = q.reshape(b, hkv, g, dh)
+    scalar_args = [tables.astype(jnp.int32), lengths.astype(jnp.int32)]
+    if k_scale is not None:
+        scalar_args += [k_scale[tables], v_scale[tables]]  # [B, MB, Hkv]
+        kernel = functools.partial(_walk_kernel_q8, blk=blk, mb=mb, g=g,
+                                   dh=dh, tcap=tcap)
+    else:
+        kernel = functools.partial(_walk_kernel, blk=blk, mb=mb, g=g,
+                                   dh=dh, tcap=tcap)
+
+    def _pool_spec():
+        # the block walk: grid step (i, h, j) pulls pool[table[i,j], h]
+        return pl.BlockSpec((1, 1, blk, dh),
+                            lambda i, h, j, tr, *_: (tr[i, j], h, 0, 0))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=len(scalar_args),
+        grid=(b, hkv, mb),
+        in_specs=[
+            pl.BlockSpec((1, 1, g, dh),
+                         lambda i, h, j, *_: (i, h, 0, 0)),     # q
+            _pool_spec(),                                       # k
+            _pool_spec(),                                       # v
+        ],
+        out_specs=pl.BlockSpec((1, 1, g, dh),
+                               lambda i, h, j, *_: (i, h, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((g, tcap), jnp.float32),     # scores
+                        pltpu.VMEM((tcap, dh), jnp.float32)],   # V row
+    )
+    y = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, g, dh), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=run_interpret,
+    )(*scalar_args, qg, pool_k, pool_v)
+    return y.reshape(b, hq, dh)

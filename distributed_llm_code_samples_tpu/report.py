@@ -333,6 +333,17 @@ class _Stream:
         for key in ("block_allocs", "block_frees", "block_scrubs"):
             if last.get(key) is not None:
                 serving[key] = last[key]
+        # schema-v6 speculation keys: acceptance rate + measured
+        # tokens-per-step (generated tokens over engine steps — > 1
+        # exactly when verify dispatches emitted multi-token steps)
+        if last.get("drafted_tokens"):
+            serving["drafted_tokens"] = last["drafted_tokens"]
+            serving["accepted_tokens"] = last.get("accepted_tokens")
+            serving["accept_rate"] = last.get("accept_rate")
+            if last.get("step") and last.get("tokens_generated") \
+                    is not None:
+                serving["tokens_per_step"] = round(
+                    last["tokens_generated"] / last["step"], 3)
         return serving
 
     def reliability(self) -> dict | None:
@@ -538,6 +549,11 @@ def _render_engine_sections(out: list, doc: dict) -> None:
                        f"tok/s  best {sv['tokens_per_sec_best']} tok/s")
         if "batch_occupancy_mean" in sv:
             out.append(f"  occupancy   mean {sv['batch_occupancy_mean']}")
+        if "accept_rate" in sv:
+            out.append(f"  speculation accept rate {sv['accept_rate']}  "
+                       f"({sv.get('accepted_tokens')}/"
+                       f"{sv.get('drafted_tokens')} drafted; "
+                       f"{sv.get('tokens_per_step')} tokens/step)")
         if "kv_pool_utilization_max" in sv:
             out.append("  KV pool     max utilization "
                        f"{sv['kv_pool_utilization_max']}")

@@ -55,7 +55,12 @@ import numpy as np
 # runtime/tracing.py) with pinned SPAN_REQUIRED — and grows the
 # "decode" contract with the KV-pool internals (free-block watermarks,
 # block churn, fragmentation, per-dtype stored-KV bytes).
-SCHEMA_VERSION = 5
+# v6 (round 12): grows the "decode" contract with the speculative-
+# decoding trio — cumulative ``drafted_tokens`` / ``accepted_tokens``
+# and the derived ``accept_rate`` (decode/engine.py verify dispatches;
+# null-rate when nothing was drafted) — so a serving stream shows
+# tokens-per-step > 1 as measured data, not inference.
+SCHEMA_VERSION = 6
 
 METRICS_FILENAME = "metrics.jsonl"
 
@@ -104,11 +109,22 @@ ROLLBACK_REQUIRED = ("rung", "resume_step")
 # a young sequence holds its whole reservation), and
 # ``kv_bytes_stored`` the live-token KV bytes at the engine's dtype
 # (``paged.kv_bytes_per_token`` — the roofline's kv_bytes numerator).
+#
+# v6 speculation keys (decode/engine.py verify dispatches):
+# ``drafted_tokens`` / ``accepted_tokens`` cumulative (snapshot-
+# persisted, monotonic across crash-resume like the churn trio) and
+# ``accept_rate`` = accepted / drafted (null when nothing drafted —
+# speculation off, or no drafter hits yet). Both count the LIVE
+# n-gram drafter only: replay teacher-forced tokens are accepted by
+# construction, so counting them would inflate accept_rate toward
+# 1.0 on exactly the churn-heavy runs where the drafter's real score
+# matters (and double-count across a crash-resume).
 DECODE_REQUIRED = ("step", "tokens_per_sec", "batch_occupancy",
                    "kv_pool_utilization", "free_blocks",
                    "free_blocks_low_water", "free_blocks_high_water",
                    "block_allocs", "block_frees", "block_scrubs",
-                   "kv_fragmentation", "kv_bytes_stored")
+                   "kv_fragmentation", "kv_bytes_stored",
+                   "drafted_tokens", "accepted_tokens", "accept_rate")
 
 # The request-record contract: one record per serving-request lifecycle
 # transition (``decode/engine.py``). ``step`` is the GLOBAL engine step

@@ -17,7 +17,10 @@ The span vocabulary (``telemetry.SPAN_NAMES``):
 - ``replay`` — the teacher-forcing window after a re-admission
   (recorded tokens re-fed to rebuild the KV write history),
 - ``decode`` — live token generation, one span per contiguous segment
-  (a preemption or quarantine ends the segment),
+  (a preemption or quarantine ends the segment); segment-ending
+  records carry a ``tokens`` extra — under speculative decoding
+  (round 12) a segment's step count and its token count diverge, and
+  the span is where the per-segment yield lives,
 - ``quarantine`` — quarantine -> re-admission (zero-length when the
   retry budget is exhausted and the request fails terminally),
 - ``preempt_gap`` — pool-pressure eviction -> re-admission.

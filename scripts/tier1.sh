@@ -134,6 +134,56 @@ rm -rf "$DEC_DIR"
 echo "DECODE_SMOKE=OK"
 phase_done decode_smoke
 
+echo "=== speculative-decode smoke ==="
+# `generate --speculate 4` vs a `--speculate 0` run of the SAME
+# prompts: tokens must be BYTE-IDENTICAL (greedy verification is the
+# identity contract, decode/engine.py section 18), and the metrics
+# stream must hold >= 1 schema-v6 decode record whose cumulative
+# accepted_tokens exceeds its engine step count — multi-token steps as
+# recorded data, not inference.
+SPEC_DIR=$(mktemp -d /tmp/tier1_spec.XXXXXX)
+SPEC_ARGS="--prompt_lens 3,7 --max_new 24 -d 32 -l 2 --heads 4 --vocab 64
+  --max_seq_len 64 --block_size 8 --prefill_chunk 4 --log_every 4"
+if ! timeout -k 10 240 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli generate $SPEC_ARGS \
+    > "$SPEC_DIR/base.json"; then
+  echo "SPEC_SMOKE=FAIL (baseline run)"; rm -rf "$SPEC_DIR"; exit 1
+fi
+if ! timeout -k 10 240 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli generate $SPEC_ARGS \
+    --speculate 4 --metrics_dir "$SPEC_DIR/metrics" \
+    > "$SPEC_DIR/spec.json"; then
+  echo "SPEC_SMOKE=FAIL (speculative run)"; rm -rf "$SPEC_DIR"; exit 1
+fi
+if ! timeout -k 10 60 env JAX_PLATFORMS=cpu python - "$SPEC_DIR" <<'EOF'
+import json, os, sys
+from distributed_llm_code_samples_tpu.runtime.telemetry import (
+    METRICS_FILENAME, read_metrics, validate_record)
+base = sys.argv[1]
+plain = json.load(open(os.path.join(base, "base.json")))
+spec = json.load(open(os.path.join(base, "spec.json")))
+a = {s["uid"]: s["tokens"] for s in plain["sequences"]}
+b = {s["uid"]: s["tokens"] for s in spec["sequences"]}
+assert a == b, "speculative tokens != non-speculative run"
+assert spec["engine_steps"] < plain["engine_steps"], (
+    spec["engine_steps"], plain["engine_steps"])
+records, problems = read_metrics(
+    os.path.join(base, "metrics", METRICS_FILENAME))
+assert not problems, problems
+decs = [r for r in records if r["kind"] == "decode"]
+assert decs, "no schema-valid decode record in the smoke stream"
+assert all(validate_record(d)[0] for d in decs)
+assert any(d["accepted_tokens"] > d["step"] for d in decs), (
+    [(d["accepted_tokens"], d["step"]) for d in decs])
+EOF
+then
+  echo "SPEC_SMOKE=FAIL (identity/schema check)"; rm -rf "$SPEC_DIR"
+  exit 1
+fi
+rm -rf "$SPEC_DIR"
+echo "SPEC_SMOKE=OK"
+phase_done spec_smoke
+
 echo "=== serving-chaos smoke ==="
 # kill@4 mid-decode under the engine supervisor: run 1 SIGKILLs itself
 # right after the step-4 snapshot (rc 137); run 2 (same command) resumes

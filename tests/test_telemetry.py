@@ -40,8 +40,10 @@ from distributed_llm_code_samples_tpu.runtime.telemetry import (
 # rejected/expired; decode/engine.py). v5 (round 11): the "span" kind
 # (per-request lifecycle phases, runtime/tracing.py) + the decode
 # contract's KV-pool internals (watermarks, churn, fragmentation,
-# stored bytes).
-_PINNED_VERSION = 5
+# stored bytes). v6 (round 12): the decode contract's speculative-
+# decoding trio (drafted_tokens / accepted_tokens / accept_rate —
+# decode/engine.py verify dispatches).
+_PINNED_VERSION = 6
 _PINNED_STEP_KEYS = frozenset({
     "schema", "kind", "t", "step", "strategy", "loss", "grad_norm",
     "tokens_per_sec", "step_time_s", "mfu", "hbm_high_water_bytes",
@@ -52,7 +54,8 @@ _PINNED_DECODE_REQUIRED = frozenset({
     "step", "tokens_per_sec", "batch_occupancy", "kv_pool_utilization",
     "free_blocks", "free_blocks_low_water", "free_blocks_high_water",
     "block_allocs", "block_frees", "block_scrubs", "kv_fragmentation",
-    "kv_bytes_stored",
+    "kv_bytes_stored", "drafted_tokens", "accepted_tokens",
+    "accept_rate",
 })
 _PINNED_REQUEST_REQUIRED = frozenset({"step", "uid", "event", "reason"})
 _PINNED_SPAN_REQUIRED = frozenset({
