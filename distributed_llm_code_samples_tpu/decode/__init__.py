@@ -9,10 +9,11 @@ from .draft import draft_tokens
 from .engine import (AdmissionError, DecodeEngine, EngineConfig,
                      FLIGHT_FILENAME, POISON_ALL, POISON_NONE,
                      REQUEST_EVENTS, ServePolicy)
-from .paged import (KV_DTYPES, PagedKV, SCRATCH_BLOCK, corrupt_block,
-                    fused_decode_attn, gather_layer, init_pool,
-                    kv_bytes_per_token, pool_bytes, scrub_blocks,
-                    write_chunk, write_rows)
+from .paged import (KV_DTYPES, PagedKV, SCRATCH_BLOCK, copy_block,
+                    corrupt_block, fused_decode_attn, gather_layer,
+                    init_pool, kv_bytes_per_token, pool_bytes,
+                    scrub_blocks, write_chunk, write_rows)
+from .prefix import PrefixCache, PrefixNode
 from .sampling import check_sampling, check_speculation, make_pick
 from .supervise import (SNAPSHOT_FILENAME, load_snapshot,
                         restore_engine_state, snapshot_state,
@@ -21,9 +22,10 @@ from .supervise import (SNAPSHOT_FILENAME, load_snapshot,
 __all__ = [
     "AdmissionError", "DecodeEngine", "EngineConfig", "FLIGHT_FILENAME",
     "POISON_ALL", "POISON_NONE", "REQUEST_EVENTS", "ServePolicy",
-    "KV_DTYPES", "PagedKV", "SCRATCH_BLOCK", "corrupt_block",
-    "draft_tokens", "fused_decode_attn", "gather_layer", "init_pool",
-    "kv_bytes_per_token", "pool_bytes",
+    "KV_DTYPES", "PagedKV", "SCRATCH_BLOCK", "copy_block",
+    "corrupt_block", "draft_tokens", "fused_decode_attn",
+    "gather_layer", "init_pool", "kv_bytes_per_token", "pool_bytes",
+    "PrefixCache", "PrefixNode",
     "scrub_blocks", "write_chunk", "write_rows",
     "check_sampling", "check_speculation", "make_pick",
     "SNAPSHOT_FILENAME", "load_snapshot", "restore_engine_state",

@@ -90,6 +90,43 @@ attacks on per-token cost):
   ``decode_attn`` two-pass — pool bytes cross the bus once, at the
   storage dtype, int8 dequant folded in. The gather path stays the
   differential oracle (bit-identical at f32 under jit).
+
+Shared-prefix layer (round 13, DESIGN.md section 19 — the capacity
+multiplier: most requests share a long system prompt, so N admissions
+should pay ~1 prefill and ~1 copy of the shared KV, not N):
+
+- **Radix prefix cache** (``decode/prefix.py``,
+  ``EngineConfig(prefix_cache=True)``, the default): every fully
+  prefilled FULL block of a prompt is content-keyed into a host-side
+  radix tree (the edge is the block's token tuple); admission walks the
+  tree and maps every hit block straight into the new slot's table —
+  refcounted, zero recompiles (tables are data). A hit block's bytes
+  are bit-identical to what the sequence's own prefill would have
+  written (full-block content is a pure function of the token prefix
+  and the engine config — chunk boundaries inside full blocks are
+  position-determined, so even the int8 requant history matches), and
+  the walk always leaves >= 1 prompt token to prefill so the first
+  pick comes from the same prefill program the unshared engine ran:
+  prefix-cached output == unshared output token for token at every
+  kv_dtype.
+- **Copy-on-write**: a shared block is read-only. Structurally no
+  scheduler write ever aims at one (hits cover only fully-prefilled
+  prompt blocks; every write — decode, chunked prefill, spec-decode
+  verify, whose rejected rows redirect to scratch — lands at or past
+  the prefill frontier), and ``_cow_private`` ENFORCES it: any write
+  window that would touch a shared block first takes a bit-identical
+  private copy (``paged.copy_block``), leaving every sharer's bytes
+  untouched. ``cow_copies`` counts triggers (0 in steady state — the
+  invariant, pinned by tests).
+- **Reliability composition**: quarantine and preemption DECREF shared
+  blocks instead of scrubbing while sharers remain (a poisoned sharer
+  must not zero an innocent survivor's prefix); the last distrusted
+  release scrubs-and-detaches. Chaos-corrupted blocks are poisoned in
+  the tree immediately (no new sharer inherits the NaN). refs-0 cached
+  blocks are reclaimed LRU under pool pressure, so retention never
+  shrinks usable capacity. Snapshot v4 persists the tree + refcounts;
+  resume rebuilds the share graph through replay (the first replayed
+  sharer re-prefills and re-inserts, later ones hit).
 """
 
 from __future__ import annotations
@@ -113,10 +150,11 @@ from ..runtime.guardrails import rows_finite
 from ..runtime.telemetry import FLIGHT_FILENAME
 from ..runtime.tracing import SpanTracer
 from .draft import draft_tokens
-from .paged import (PagedKV, SCRATCH_BLOCK, corrupt_block as
+from .paged import (PagedKV, SCRATCH_BLOCK, copy_block, corrupt_block as
                     _pool_corrupt_block, fused_decode_attn, gather_layer,
                     init_pool, kv_bytes_per_token, pool_bytes,
                     scrub_blocks, write_chunk, write_rows)
+from .prefix import PrefixCache
 from .sampling import check_sampling, check_speculation, make_pick
 
 # poison operand values for the compiled steps (chaos nan_logits
@@ -182,7 +220,11 @@ class EngineConfig:
     decode/verify steps: ``"gather"`` (two-pass oracle:
     ``gather_paged_kv`` then ``decode_attn``) or ``"fused"`` (the
     Pallas block-table walk, single-device only — prefill keeps its
-    chunked gather attention either way)."""
+    chunked gather attention either way). ``prefix_cache`` enables the
+    shared-prefix radix cache (``decode/prefix.py``) — host-side only,
+    so the flag never changes a compiled program; it lives in the
+    config because snapshot-resume must restore onto the same sharing
+    policy."""
     block_size: int = 16
     n_blocks: int = 65
     max_slots: int = 4
@@ -196,6 +238,7 @@ class EngineConfig:
     use_rope: bool = False
     speculate: int = 0
     kernel: str = "gather"
+    prefix_cache: bool = True
 
     @property
     def capacity(self) -> int:
@@ -260,6 +303,14 @@ class _Seq:
     out: list[int] = field(default_factory=list)
     prefilled: int = 0
     blocks: list[int] = field(default_factory=list)
+    # nodes[i] is the PrefixNode backing blocks[i] when that leading
+    # block is shared through the radix cache (a prefix-hit at
+    # admission, or this sequence's own full prompt block transferred
+    # into the tree at prefill completion); None = private. The shared
+    # region is always a leading run of fully-prefilled prompt blocks,
+    # which is why no write ever aims at it (writes land at or past
+    # the prefill frontier).
+    nodes: list = field(default_factory=list)
     emitted: int = 0
     retries: int = 0
     submit_step: int = 0
@@ -393,6 +444,24 @@ class DecodeEngine:
         # — accept_rate = accepted / drafted is the drafter's score)
         self.drafted_tokens = 0
         self.accepted_tokens = 0
+        # -- shared-prefix KV reuse (round 13, DESIGN.md section 19) --
+        # the radix tree over full prompt blocks; None = sharing off
+        # (every block private, the round-9..12 engine exactly)
+        self.prefix = (PrefixCache(cfg.block_size) if cfg.prefix_cache
+                       else None)
+        # cumulative, snapshot-persisted (monotonic across crash-resume
+        # like the churn trio): hit blocks mapped at admission, prompt
+        # tokens those hits skipped, copy-on-write triggers (0 in
+        # steady state — the write-barrier invariant), and candidate
+        # full blocks walked (the hit-rate denominator)
+        self.prefix_hit_blocks = 0
+        self.prefill_tokens_saved = 0
+        self.cow_copies = 0
+        self.prefix_lookup_blocks = 0
+        # prefill program dispatches (the shared-prefix win is provable
+        # as a dispatch count: N sharers run ~1 prefill pass over the
+        # shared prefix, not N); snapshot-persisted
+        self.prefill_dispatches = 0
         # tokens emitted inside the CURRENT span per uid (decode/replay
         # segments emit many tokens per step under speculation; the
         # span record carries the count so a waterfall shows work, not
@@ -445,7 +514,8 @@ class DecodeEngine:
             self.compile_count += 1
             builder = {"decode": self._build_decode,
                        "prefill": self._build_prefill,
-                       "verify": self._build_verify}[kind]
+                       "verify": self._build_verify,
+                       "cow": self._build_cow}[kind]
             fn = builder(bucket)
             self._programs[key] = fn
         self.dispatch_count += 1
@@ -727,6 +797,16 @@ class DecodeEngine:
     def _build_prefill(self, c: int):
         return self._jit(self._prefill_fn(c))
 
+    def _build_cow(self, _bucket: int):
+        """The copy-on-write block copy (``paged.copy_block``) as one
+        compiled program for every (src, dst) pair — block ids are
+        traced operands, so privatizing never recompiles. Donated like
+        the step programs (the copy must not pay a whole-pool
+        allocate). Built lazily and only when a CoW actually fires,
+        which steady state never does — the recompile-guard tests keep
+        holding with the barrier armed."""
+        return jax.jit(copy_block, donate_argnums=(0,))
+
     # -- scheduler -----------------------------------------------------
 
     def submit(self, prompt, max_new: int, uid: int | None = None) -> int:
@@ -871,9 +951,18 @@ class DecodeEngine:
         release of the block (not just quarantine — a preemption or
         deadline expiry can evict the owner before its next dispatch
         flags the NaN) scrubs it instead of handing the poison to an
-        innocent successor."""
+        innocent successor. A block the radix cache holds is POISONED
+        in the tree immediately: no new sharer may match it (the fault
+        must not propagate into future admissions), while its bytes are
+        left alone until the last live sharer releases it (the
+        decref-not-scrub contract — current sharers' own dispatches
+        flag the NaN through the logits guardrail)."""
         self.pool = _pool_corrupt_block(self.pool, block)
         self._corrupted.add(int(block))
+        if self.prefix is not None:
+            node = self.prefix.node_for_block(int(block))
+            if node is not None:
+                node.poisoned = True
 
     # -- scheduler (continued) -----------------------------------------
 
@@ -887,7 +976,15 @@ class DecodeEngine:
         that has been pool-starved (free slot, not enough free blocks)
         for that many consecutive steps evicts the YOUNGEST running
         sequence back to WAITING (replay resumes it token-identically
-        later); the wait threshold is the anti-thrash hysteresis."""
+        later); the wait threshold is the anti-thrash hysteresis.
+
+        With the prefix cache on, admission first walks the radix tree:
+        every hit block is mapped into the table (locked, skipping its
+        prefill) and only the MISSED blocks draw on the free list —
+        refs-0 cached blocks are reclaimed LRU on demand, so retention
+        never starves admission (the "effective sequences" capacity
+        multiplier: N sharers of a k-block prefix reserve k + N * tail
+        blocks, not N * (k + tail))."""
         admitted = 0
         bumped = False
         while self.waiting:
@@ -896,7 +993,15 @@ class DecodeEngine:
             free_slots = [i for i, s in enumerate(self.slots) if s is None]
             if not free_slots:
                 break
-            if need > len(self.free_blocks):
+            hits = ([] if self.prefix is None
+                    else self.prefix.match(seq.prompt))
+            avail = len(self.free_blocks)
+            if self.prefix is not None:
+                # refs-0 cached blocks are reclaimable — minus the hit
+                # nodes themselves (about to be locked, not evicted)
+                avail += (self.prefix.evictable_blocks()
+                          - sum(1 for n in hits if n.refs == 0))
+            if need - len(hits) > avail:
                 pa = self.policy.preempt_after_steps
                 if pa > 0:
                     if self._head_blocked_uid != seq.uid:
@@ -916,7 +1021,27 @@ class DecodeEngine:
             self._head_blocked_uid = None
             self.waiting.popleft()
             slot = free_slots[0]
-            seq.blocks = [self.free_blocks.pop(0) for _ in range(need)]
+            need_priv = need - len(hits)
+            if hits:
+                # lock BEFORE any eviction so the matched path can't be
+                # reclaimed out from under its own admission
+                self.prefix.lock(hits, self.global_step)
+                self.prefix_hit_blocks += len(hits)
+                self.prefill_tokens_saved += (len(hits)
+                                              * self.cfg.block_size)
+            if self.prefix is not None:
+                self.prefix_lookup_blocks += self.prefix.match_cap(
+                    len(seq.prompt))
+                if need_priv > len(self.free_blocks):
+                    self._reclaim_cached(need_priv
+                                         - len(self.free_blocks))
+            seq.nodes = list(hits)
+            seq.blocks = [n.block for n in hits] + [
+                self.free_blocks.pop(0) for _ in range(need_priv)]
+            # the hit region is already prefilled CONTENT — the prefill
+            # clock starts past it (>= 1 token always remains, so the
+            # first pick still comes from the prefill program)
+            seq.prefilled = len(hits) * self.cfg.block_size
             self.block_allocs += need
             row = np.full((self.cfg.max_blocks_per_seq,), SCRATCH_BLOCK,
                           np.int32)
@@ -929,28 +1054,154 @@ class DecodeEngine:
             self._admit_counter += 1
             self._event("admitted", seq.uid,
                         wait_steps=self.global_step - seq.submit_step,
-                        replay=len(seq.out))
+                        replay=len(seq.out),
+                        prefix_hit_blocks=len(hits))
             # admission closes whatever gap span the request sat in
             # (queued / preempt_gap / quarantine) and starts prefill
             self.tracer.transition(seq.uid, "prefill", self.global_step)
             admitted += 1
         return admitted
 
-    def _evict(self, slot: int) -> _Seq:
-        """Take a sequence off its slot and return its blocks to the
-        pool (shared tail of release/quarantine/preempt/expire). Blocks
-        the chaos layer marked corrupted are scrubbed on the way out —
-        an eviction that precedes the owner's next dispatch would
-        otherwise hand the NaN to whoever reserves the block next."""
-        seq = self.slots[slot]
-        bad = [b for b in seq.blocks if b in self._corrupted]
+    def _reclaim_cached(self, n: int) -> None:
+        """Convert up to ``n`` refs-0 cached blocks back into free-list
+        blocks (LRU, ``prefix.evict_lru``) — the pool-pressure valve
+        that makes retention free: cached capacity is always
+        reclaimable capacity. A reclaimed block the chaos layer
+        corrupted is scrubbed on the way out (the ANY-release scrub
+        contract: a poisoned refs-0 cached block has no owner whose
+        eviction would otherwise scrub it)."""
+        got = self.prefix.evict_lru(n, self.global_step)
+        bad = [b for b in got if b in self._corrupted]
         if bad:
             self.pool = scrub_blocks(self.pool, bad)
             self._corrupted.difference_update(bad)
             self.block_scrubs += len(bad)
+        self.free_blocks.extend(got)
+
+    def _cache_full_blocks(self, slot: int) -> None:
+        """Transfer a slot's newly fully-prefilled FULL prompt blocks
+        into the radix tree (the insert side of the prefix cache; runs
+        after every prefill chunk). Only blocks whose every row came
+        from prompt tokens are cacheable — a partial block's remaining
+        rows will be decode writes, making its content a function of
+        the sampled continuation, not the prompt. The inserting
+        sequence keeps using the block and holds one ref (its table
+        entry). When ANOTHER sequence already cached this exact token
+        path (two sharers prefilled concurrently — neither admission
+        could see the other's blocks), the slot remaps onto the cached
+        block and frees its freshly-written duplicate: the bytes are
+        identical by the purity argument, so the remap is invisible to
+        the sequence and the pool just got one block richer."""
+        if self.prefix is None:
+            return
+        seq = self.slots[slot]
+        bs = self.cfg.block_size
+        full = min(seq.prefilled, len(seq.prompt)) // bs
+        step = self.global_step
+        while len(seq.nodes) < full:
+            i = len(seq.nodes)
+            node = self.prefix.insert(seq.prompt, i, seq.blocks[i],
+                                      step)
+            if node is None:
+                # parent path evicted/poisoned mid-prefill: the block
+                # simply stays private (correct, just unshared)
+                seq.nodes.append(None)
+                continue
+            if node.block != seq.blocks[i]:
+                # late dedup: remap onto the cached twin, free ours
+                self.free_blocks.append(seq.blocks[i])
+                self.block_frees += 1
+                self.block_allocs += 1      # the new shared mapping
+                seq.blocks[i] = node.block
+                self.tables[slot][i] = node.block
+            self.prefix.lock([node], step)
+            seq.nodes.append(node)
+
+    def _cow_private(self, slot: int, lo: int, hi: int) -> None:
+        """The copy-on-write barrier: before a dispatch whose KV write
+        window covers table indices ``lo..hi`` of ``slot``, any block
+        in that window still backed by a radix-tree node is privatized
+        — a bit-identical device copy (``paged.copy_block``) into a
+        fresh block, table remapped, node ref released — so no write
+        can ever land in a block another sequence (or the cache) still
+        reads. Structurally the scheduler never aims a write at a
+        shared block (hits and inserts cover only fully-prefilled
+        prompt blocks; every write lands at or past the prefill
+        frontier), so this is an ENFORCED invariant, not a hot path:
+        ``cow_copies`` stays 0 in steady state and the tests pin both
+        the zero and the barrier's correctness when triggered by
+        hand."""
+        seq = self.slots[slot]
+        if self.prefix is None or not seq.nodes:
+            return
+        for li in range(lo, min(hi + 1, len(seq.nodes))):
+            node = seq.nodes[li]
+            if node is None:
+                continue
+            if not self.free_blocks:
+                self._reclaim_cached(1)
+            if not self.free_blocks:
+                raise RuntimeError(
+                    "copy-on-write of a shared block needs a free "
+                    "block and the pool has none (refs-0 cache empty)")
+            dst = self.free_blocks.pop(0)
+            fn = self._program("cow", 0)
+            self.pool = fn(self.pool, jnp.int32(node.block),
+                           jnp.int32(dst))
+            self.prefix.release(node, self.global_step)
+            seq.nodes[li] = None
+            seq.blocks[li] = dst
+            self.tables[slot][li] = dst
+            self.block_allocs += 1          # the private replacement
+            self.block_frees += 1           # the released shared map
+            self.cow_copies += 1
+
+    def _evict(self, slot: int, drop_shared: bool = False) -> _Seq:
+        """Take a sequence off its slot and return its blocks (shared
+        tail of release/quarantine/preempt/expire).
+
+        Private blocks go back to the free list — scrubbed when the
+        chaos layer marked them corrupted (an eviction that precedes
+        the owner's next dispatch would otherwise hand the NaN to
+        whoever reserves the block next), or wholesale under
+        ``drop_shared`` (the quarantine stance: a poisoned run's
+        PRIVATE bytes are not trusted).
+
+        Shared blocks DECREF instead of free: while sharers remain,
+        the bytes — an innocent survivor's prefix — are untouched (the
+        decref-not-scrub contract). A clean last release leaves the
+        block CACHED (refs-0, LRU-evictable: the cross-request reuse).
+        A distrusted last release (``drop_shared`` or chaos-corrupted)
+        scrubs it and detaches it — with its now-unreachable cached
+        descendants — back to the free list. Released deepest-first so
+        refcounts stay monotone root-to-leaf throughout."""
+        seq = self.slots[slot]
+        step = self.global_step
+        to_free: list[int] = []
+        to_scrub: set[int] = set()
+        for li in reversed(range(len(seq.blocks))):
+            b = seq.blocks[li]
+            node = seq.nodes[li] if li < len(seq.nodes) else None
+            if node is not None:
+                self.prefix.release(node, step)
+                if node.refs == 0 and (drop_shared
+                                       or b in self._corrupted):
+                    sub = self.prefix.detach_subtree(node)
+                    to_scrub.update(x for x in sub
+                                    if x == b or x in self._corrupted)
+                    to_free.extend(sub)
+            else:
+                if drop_shared or b in self._corrupted:
+                    to_scrub.add(b)
+                to_free.append(b)
+        if to_scrub:
+            self.pool = scrub_blocks(self.pool, sorted(to_scrub))
+            self._corrupted.difference_update(to_scrub)
+            self.block_scrubs += len(to_scrub)
         self.block_frees += len(seq.blocks)
-        self.free_blocks.extend(seq.blocks)
+        self.free_blocks.extend(to_free)
         seq.blocks = []
+        seq.nodes = []
         self.tables[slot] = SCRATCH_BLOCK
         self.lengths[slot] = 0
         self.next_token[slot] = 0
@@ -1020,22 +1271,24 @@ class DecodeEngine:
         (seed, uid, position) sampling keys make survivors bit-identical
         to a run that never admitted this request."""
         seq = self.slots[slot]
-        blocks = list(seq.blocks)
-        # _evict scrubs-and-counts any chaos-marked blocks on its own;
-        # remember how many so the full quarantine scrub below doesn't
-        # count them twice in the schema-v5 churn counter
-        pre_scrubbed = sum(1 for b in blocks if b in self._corrupted)
-        self._evict(slot)
-        # scrub the owned blocks AND the shared scratch block: every
-        # table pads with SCRATCH_BLOCK, so a corrupted scratch poisons
-        # every gather (0*nan==nan) — scrubbing it here is what turns
-        # "scratch corrupted" into one quarantine wave + clean retries
-        # instead of a permanent all-requests failure. Scratch is
-        # semantically all-zeros (only pad writes land there, always
-        # masked), so the scrub is always safe.
-        self.pool = scrub_blocks(self.pool, blocks + [SCRATCH_BLOCK])
-        self._corrupted.difference_update(blocks + [SCRATCH_BLOCK])
-        self.block_scrubs += len(blocks) + 1 - pre_scrubbed
+        # drop_shared: the poisoned run's PRIVATE blocks are scrubbed
+        # wholesale (its bytes are not trusted), but blocks shared
+        # through the radix cache only DECREF while sharers remain —
+        # the bytes are an innocent survivor's prefix, pure functions
+        # of the shared tokens, and zeroing them would corrupt the
+        # survivor (the scrub-vs-decref contract; the last distrusted
+        # release detaches and scrubs inside _evict)
+        self._evict(slot, drop_shared=True)
+        # scrub the shared scratch block too: every table pads with
+        # SCRATCH_BLOCK, so a corrupted scratch poisons every gather
+        # (0*nan==nan) — scrubbing it here is what turns "scratch
+        # corrupted" into one quarantine wave + clean retries instead
+        # of a permanent all-requests failure. Scratch is semantically
+        # all-zeros (only pad writes land there, always masked), so
+        # the scrub is always safe.
+        self.pool = scrub_blocks(self.pool, [SCRATCH_BLOCK])
+        self._corrupted.discard(SCRATCH_BLOCK)
+        self.block_scrubs += 1
         self.quarantined += 1
         # dump the flight recorder at the END of this engine step (so
         # the digest covering the quarantine itself is in the ring)
@@ -1161,6 +1414,10 @@ class DecodeEngine:
         # chunk starts stay multiples of the chunk size, so no chunk
         # ever straddles a block boundary (paged.write_chunk's contract)
         c = max(b for b in self.chunk_buckets if b <= remaining)
+        bs = self.cfg.block_size
+        self._cow_private(slot, seq.prefilled // bs,
+                          (seq.prefilled + c - 1) // bs)
+        self.prefill_dispatches += 1
         fn = self._program("prefill", c)
         chunk = np.asarray(seq.prompt[seq.prefilled:seq.prefilled + c],
                            np.int32)
@@ -1176,6 +1433,7 @@ class DecodeEngine:
             self._quarantine(slot, "nonfinite_logits")
             return
         seq.prefilled += c
+        self._cache_full_blocks(slot)
         if seq.prompt_done:
             self.lengths[slot] = len(seq.prompt)
             # the chunk that completes the prompt hands the span clock
@@ -1210,6 +1468,10 @@ class DecodeEngine:
         return b, tables, lengths, tokens, uids
 
     def _decode_step(self, ready: list[int]) -> None:
+        bs = self.cfg.block_size
+        for slot in ready:                  # the CoW write barrier
+            self._cow_private(slot, int(self.lengths[slot]) // bs,
+                              int(self.lengths[slot]) // bs)
         b, tables, lengths, tokens, uids = self._marshal(ready)
         fn = self._program("decode", b)
         args = (self.params, self.pool, jnp.asarray(tables),
@@ -1267,6 +1529,14 @@ class DecodeEngine:
         (its masked rows only ever landed in the uid's own blocks,
         which quarantine frees and scrubs)."""
         k = self.cfg.speculate
+        bs = self.cfg.block_size
+        for slot in ready:
+            # the verify window writes positions lengths..lengths+k
+            # (rejected rows land on scratch, but the barrier guards
+            # the whole window — a masked write must never even AIM at
+            # a shared block)
+            self._cow_private(slot, int(self.lengths[slot]) // bs,
+                              (int(self.lengths[slot]) + k) // bs)
         b, tables, lengths, tokens, uids = self._marshal(ready)
         drafts = np.zeros((b, k), np.int32)
         dlens = np.zeros((b,), np.int32)
@@ -1374,8 +1644,21 @@ class DecodeEngine:
         return self._occ_sum / self.steps if self.steps else 0.0
 
     def kv_pool_utilization(self) -> float:
+        """Non-reclaimable fraction of the usable pool. refs-0 CACHED
+        blocks count as free: the radix cache retains them off the
+        free list, but admission reclaims them LRU on demand, so they
+        are admissible capacity — without the correction a long-lived
+        prefix-cached engine serving diverse prompts reads as
+        permanently exhausted once the pool has cycled through the
+        cache. The raw ``free_blocks`` keys keep their literal
+        free-list meaning (the watermark window and churn math depend
+        on it); ``prefix_evictable_blocks`` rides the record so the
+        two readings reconcile."""
         usable = self.cfg.n_blocks - 1
-        return (usable - len(self.free_blocks)) / usable
+        free = len(self.free_blocks)
+        if self.prefix is not None:
+            free += self.prefix.evictable_blocks()
+        return (usable - free) / usable
 
     def live_tokens(self) -> int:
         """Cached positions currently holding real KV, summed over
@@ -1439,6 +1722,31 @@ class DecodeEngine:
             "accept_rate": (round(self.accepted_tokens
                                   / self.drafted_tokens, 4)
                             if self.drafted_tokens else None),
+            # v7 shared-prefix keys: cumulative admission hits / prompt
+            # tokens skipped / CoW triggers (0 = the write-barrier
+            # invariant held), plus the INSTANTANEOUS count of blocks
+            # named by >= 2 live tables right now
+            "prefix_hit_blocks": self.prefix_hit_blocks,
+            "prefill_tokens_saved": self.prefill_tokens_saved,
+            "shared_blocks": (0 if self.prefix is None
+                              else self.prefix.shared_blocks()),
+            "cow_copies": self.cow_copies,
+            # extras (not required keys): the hit-rate pair's
+            # denominator, the cached-block inventory, and the prefill
+            # dispatch count the ~1-prefill property is proved on
+            "prefix_lookup_blocks": self.prefix_lookup_blocks,
+            "prefix_hit_rate": (round(self.prefix_hit_blocks
+                                      / self.prefix_lookup_blocks, 4)
+                                if self.prefix_lookup_blocks else None),
+            "prefix_cached_blocks": (0 if self.prefix is None
+                                     else len(self.prefix)),
+            # reclaimable retention right now — what reconciles the
+            # literal free_blocks keys with kv_pool_utilization's
+            # cached-blocks-are-free reading
+            "prefix_evictable_blocks": (0 if self.prefix is None
+                                        else
+                                        self.prefix.evictable_blocks()),
+            "prefill_dispatches": self.prefill_dispatches,
             "quarantined": self.quarantined,
             "retried": self.retried,
             "preempted": self.preempted,

@@ -98,6 +98,16 @@ def build_generate_parser() -> argparse.ArgumentParser:
                    help="decode attention path: 'gather' (two-pass "
                         "oracle) or 'fused' (Pallas block-table walk, "
                         "single-device; ops/pallas_paged_attention.py)")
+    # shared-prefix KV reuse (round 13, DESIGN.md section 19)
+    p.add_argument("--prefix_cache", default=True,
+                   action=argparse.BooleanOptionalAction,
+                   help="shared-prefix KV reuse (decode/prefix.py): "
+                        "requests sharing a prompt prefix map its "
+                        "cached full blocks instead of re-prefilling "
+                        "them, refcounted + copy-on-write; output "
+                        "stays byte-identical (default on; "
+                        "--no-prefix_cache restores the private-"
+                        "blocks-only engine)")
     # parallel strategy
     p.add_argument("--tp", type=int, default=1,
                    help="model-axis size for the Megatron decode layout "
@@ -244,7 +254,7 @@ def generate_main(argv=None) -> int:
             temperature=args.temperature, top_k=args.top_k,
             top_p=args.top_p, seed=args.sample_seed,
             use_rope=args.use_rope, speculate=args.speculate,
-            kernel=args.kernel)
+            kernel=args.kernel, prefix_cache=args.prefix_cache)
         policy = ServePolicy(
             queue_limit=args.queue_limit,
             deadline_steps=args.deadline_steps,
@@ -298,6 +308,7 @@ def generate_main(argv=None) -> int:
             "kv_dtype": args.kv_dtype, "max_slots": args.max_slots,
             "block_size": args.block_size, "tp": tp,
             "speculate": args.speculate, "kernel": args.kernel,
+            "prefix_cache": args.prefix_cache,
             "n_prompts": len(prompts), "max_new": args.max_new,
             "device_kind": jax.devices()[0].device_kind}
         if args.snapshot_dir:
@@ -378,6 +389,11 @@ def generate_main(argv=None) -> int:
         "accept_rate": (round(engine.accepted_tokens
                               / engine.drafted_tokens, 4)
                         if engine.drafted_tokens else None),
+        "prefix_cache": args.prefix_cache,
+        "prefix_hit_blocks": engine.prefix_hit_blocks,
+        "prefill_tokens_saved": engine.prefill_tokens_saved,
+        "prefill_dispatches": engine.prefill_dispatches,
+        "cow_copies": engine.cow_copies,
         "quarantined": engine.quarantined,
         "retried": engine.retried,
         "preempted": engine.preempted,

@@ -283,6 +283,25 @@ def scrub_blocks(pool: PagedKV, blocks) -> PagedKV:
     return out
 
 
+def copy_block(pool: PagedKV, src, dst) -> PagedKV:
+    """Copy one physical block's bytes (values AND int8 scales) from
+    ``src`` to ``dst`` across every layer — the device half of
+    copy-on-write (``decode/engine.py``): a sequence about to write
+    into a block it shares takes a private bit-identical copy first,
+    so the write history every sharer observes stays exactly the
+    unshared engine's. ``src``/``dst`` may be traced scalars (one
+    compiled copy program serves every block pair)."""
+    src = jnp.asarray(src, jnp.int32)
+    dst = jnp.asarray(dst, jnp.int32)
+    out = pool._replace(k=pool.k.at[:, dst].set(pool.k[:, src]),
+                        v=pool.v.at[:, dst].set(pool.v[:, src]))
+    if pool.k_scale is not None:
+        out = out._replace(
+            k_scale=pool.k_scale.at[:, dst].set(pool.k_scale[:, src]),
+            v_scale=pool.v_scale.at[:, dst].set(pool.v_scale[:, src]))
+    return out
+
+
 def corrupt_block(pool: PagedKV, block: int) -> PagedKV:
     """Chaos injection (``corrupt_block@s:block``): poison one physical
     block the way a flipped HBM page would — NaN values for the float

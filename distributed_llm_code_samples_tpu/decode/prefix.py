@@ -1,0 +1,266 @@
+"""Radix prefix cache: content-keyed, refcounted shared KV blocks.
+
+The serving reality behind "millions of users" is that most requests
+share a long system prompt, so N admissions pay N identical prefills
+and N copies of the same KV bytes. The paged pool (``decode/paged.py``)
+already indirects every KV read through per-slot int32 block tables, so
+sharing is a HOST-side bookkeeping problem: this module is the radix
+tree (RadixAttention, Zheng et al. 2023) the scheduler walks at
+admission, mapping every cached full block of the prompt straight into
+the new slot's table instead of re-prefilling it (PagedAttention's
+block indirection is what makes the mapping free, Kwon et al. 2023).
+
+Granularity and the identity argument:
+
+- A node caches exactly ONE full block of ``block_size`` tokens; its
+  key is the token path from the root (the radix edge is the block's
+  token tuple). A full prompt block's stored bytes are a pure function
+  of ``(tokens <= block end, EngineConfig)``: KV rows depend only on
+  causally-earlier tokens, chunk boundaries inside a full block are
+  position-determined (the engine's greedy power-of-two chunking is
+  block-aligned), and an int8 block's requant history is that fixed
+  chunk grouping — so a hit block holds BIT-IDENTICAL bytes to what the
+  admitting sequence's own prefill would have written, at every
+  kv_dtype. That is the whole bit-identity proof: sharing changes which
+  physical block a table names, never a byte the gather returns.
+- The walk is capped at ``(len(prompt) - 1) // block_size`` blocks so
+  at least one prompt token is ALWAYS prefilled — the engine's first
+  pick must come from the prefill program (the same program the
+  unshared engine used), never a numerically different path.
+
+Refcounts and lifetime:
+
+- ``refs`` counts LIVE sequences whose table names the node's block
+  (lock at admission, release on any evict). Because a sequence locks
+  its whole matched path, ``child.refs > 0`` implies ``parent.refs >
+  0`` — refcounts are monotone non-increasing root-to-leaf, so every
+  refs-0 node is eventually reclaimable leaf-by-leaf.
+- refs-0 nodes STAY cached (that is the cross-request reuse) until
+  pool pressure evicts them: ``evict_lru`` frees least-recently-used
+  refs-0 LEAVES (leaf-only keeps every cached path reachable).
+- ``poisoned`` marks a node whose block the chaos layer corrupted: it
+  is excluded from matching immediately (no new sharer inherits NaN)
+  but its bytes are left alone while sharers remain — a poisoned
+  sharer must never zero an innocent survivor's prefix. The engine
+  scrubs-and-detaches at refs == 0.
+
+Everything here is plain host Python (the device never sees the tree);
+the engine owns all pool writes and free-list edits.
+"""
+
+from __future__ import annotations
+
+import heapq
+
+
+class PrefixNode:
+    """One cached full block. ``edge`` is the block's token tuple (the
+    radix edge from ``parent``), ``block`` the physical pool block id,
+    ``refs`` the live-sequence lock count, ``last_use`` the engine step
+    of the last lock/insert (the LRU clock)."""
+
+    __slots__ = ("edge", "block", "refs", "last_use", "poisoned",
+                 "parent", "children")
+
+    def __init__(self, edge, block, parent, step):
+        self.edge = edge
+        self.block = int(block)
+        self.refs = 0
+        self.last_use = int(step)
+        self.poisoned = False
+        self.parent = parent
+        self.children: dict[tuple[int, ...], PrefixNode] = {}
+
+    def path_tokens(self) -> list[int]:
+        """The full token path from the root (tests/snapshots)."""
+        toks: list[int] = []
+        node = self
+        while node.parent is not None:
+            toks = list(node.edge) + toks
+            node = node.parent
+        return toks
+
+
+class PrefixCache:
+    """The host-side radix tree over full prompt blocks."""
+
+    def __init__(self, block_size: int):
+        self.block_size = int(block_size)
+        self.root = PrefixNode((), -1, None, 0)
+        self._by_block: dict[int, PrefixNode] = {}
+
+    # -- introspection --------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self._by_block)
+
+    def nodes(self):
+        """Every cached node, preorder (stable for snapshots/tests)."""
+        out, stack = [], [self.root]
+        while stack:
+            node = stack.pop()
+            if node is not self.root:
+                out.append(node)
+            # reversed-sorted push -> sorted preorder pop
+            for edge in sorted(node.children, reverse=True):
+                stack.append(node.children[edge])
+        return out
+
+    def evictable_blocks(self) -> int:
+        """refs-0 cached blocks — reclaimable capacity the admission
+        math adds to the free list (monotone refs make every refs-0
+        node reachable leaf-by-leaf)."""
+        return sum(1 for n in self._by_block.values() if n.refs == 0)
+
+    def shared_blocks(self) -> int:
+        """Blocks named by >= 2 live tables right now — the instantaneous
+        sharing the schema-v7 decode record reports."""
+        return sum(1 for n in self._by_block.values() if n.refs >= 2)
+
+    def node_for_block(self, block: int) -> PrefixNode | None:
+        return self._by_block.get(int(block))
+
+    # -- the radix walk -------------------------------------------------
+
+    def match_cap(self, prompt_len: int) -> int:
+        """Max hit blocks for a prompt: every full block EXCEPT the one
+        holding the final token — at least one token always prefills,
+        so the first pick comes from the same prefill program the
+        unshared engine ran."""
+        return max(0, (int(prompt_len) - 1) // self.block_size)
+
+    def match(self, prompt) -> list[PrefixNode]:
+        """Longest cached path of full prompt blocks (capped by
+        ``match_cap``), root-outward. Stops at the first miss or
+        poisoned node; does NOT lock — admission locks only once the
+        block reservation is certain."""
+        blk = self.block_size
+        node, out = self.root, []
+        for i in range(self.match_cap(len(prompt))):
+            child = node.children.get(tuple(prompt[i * blk:(i + 1) * blk]))
+            if child is None or child.poisoned:
+                break
+            out.append(child)
+            node = child
+        return out
+
+    def lock(self, nodes, step: int) -> None:
+        for n in nodes:
+            n.refs += 1
+            n.last_use = int(step)
+
+    def release(self, node: PrefixNode, step: int) -> None:
+        if node.refs <= 0:
+            raise RuntimeError(f"release of unlocked prefix block "
+                               f"{node.block}")
+        node.refs -= 1
+        node.last_use = int(step)
+
+    # -- insertion (prefill-complete transfer) --------------------------
+
+    def insert(self, prompt, block_index: int, block: int,
+               step: int) -> PrefixNode | None:
+        """Cache prompt block ``block_index`` (just fully prefilled into
+        physical ``block``). Returns the node now backing that logical
+        block: a NEW node owning ``block`` (caller keeps the block in
+        its table, holding one ref), or the EXISTING node when another
+        sequence already cached this exact path (late dedup — the
+        caller remaps its table onto the cached block and frees its
+        duplicate; the bytes are identical by the purity argument).
+        Returns None when the parent path is not cached (a parent was
+        evicted mid-prefill) — the block simply stays private."""
+        blk = self.block_size
+        node = self.root
+        for i in range(block_index):
+            node = node.children.get(tuple(prompt[i * blk:(i + 1) * blk]))
+            if node is None or node.poisoned:
+                return None
+        edge = tuple(int(t) for t in
+                     prompt[block_index * blk:(block_index + 1) * blk])
+        if len(edge) != blk:
+            raise ValueError(f"block {block_index} of a {len(prompt)}-"
+                             f"token prompt is not full (block {blk})")
+        child = node.children.get(edge)
+        if child is not None:
+            return child if not child.poisoned else None
+        child = PrefixNode(edge, block, node, step)
+        node.children[edge] = child
+        self._by_block[child.block] = child
+        return child
+
+    # -- eviction / detach ----------------------------------------------
+
+    def evict_lru(self, n_blocks: int, step: int) -> list[int]:
+        """Reclaim up to ``n_blocks`` physical blocks from refs-0 cached
+        LEAVES, least-recently-used first (pool pressure: cached-free
+        capacity converts back to free-list capacity on demand). Leaf-
+        only eviction keeps every remaining cached path reachable; a
+        parent becomes a leaf once its children are gone, so one call
+        drains whole cold paths oldest-outward. ONE scan builds the
+        candidate heap and each victim's parent is pushed as it turns
+        into an evictable leaf — O(cached + k log cached), not a
+        rescan per reclaimed block (this runs inside the admission/CoW
+        hot path)."""
+        heap = [(n.last_use, n.block, n) for n in self._by_block.values()
+                if n.refs == 0 and not n.children]
+        heapq.heapify(heap)
+        out: list[int] = []
+        while heap and len(out) < n_blocks:
+            _, _, victim = heapq.heappop(heap)
+            parent = victim.parent
+            self._detach(victim)
+            out.append(victim.block)
+            if (parent is not self.root and parent.refs == 0
+                    and not parent.children):
+                heapq.heappush(heap,
+                               (parent.last_use, parent.block, parent))
+        return out
+
+    def _detach(self, node: PrefixNode) -> None:
+        del node.parent.children[node.edge]
+        self._by_block.pop(node.block, None)
+        node.parent = None
+
+    def detach_subtree(self, node: PrefixNode) -> list[int]:
+        """Remove ``node`` and every descendant, returning their block
+        ids (all refs-0 by the monotone-refs invariant — callers only
+        detach at refs == 0). Used when a block can no longer be
+        trusted (quarantine with no sharers left, chaos corruption):
+        descendants stay physically clean but become unreachable once
+        the path through ``node`` is gone, so they return to the free
+        list with it."""
+        if node.refs != 0:
+            raise RuntimeError(f"detach of live prefix block "
+                               f"{node.block} (refs {node.refs})")
+        out: list[int] = []
+        stack = [node]
+        self._detach(node)
+        while stack:
+            cur = stack.pop()
+            out.append(cur.block)
+            self._by_block.pop(cur.block, None)
+            stack.extend(cur.children.values())
+            cur.children = {}
+        return out
+
+    # -- snapshot (decode/supervise.py, snapshot v4) --------------------
+
+    def snapshot(self) -> list[dict]:
+        """JSON-serializable preorder node list. Block CONTENT dies with
+        the process — a resumed engine's pool is zeros — so restore
+        drops the tree and lets replay rebuild the share graph
+        organically (the first replayed sharer re-prefills and
+        re-inserts, later ones hit: the ~1-prefill property survives
+        the crash). The persisted list is the share graph the snapshot
+        certifies; tests pin the rebuild against it."""
+        order = self.nodes()
+        index = {id(n): i for i, n in enumerate(order)}
+        return [{
+            "tokens": list(n.edge),
+            "block": n.block,
+            "refs": n.refs,
+            "last_use": n.last_use,
+            "poisoned": n.poisoned,
+            "parent": (None if n.parent is self.root
+                       else index[id(n.parent)]),
+        } for n in order]

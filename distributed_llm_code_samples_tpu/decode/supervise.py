@@ -59,7 +59,18 @@ SNAPSHOT_FILENAME = "engine_snapshot.json"
 # accepted_tokens) — same monotonic-across-resume contract; the
 # drafter itself needs NO snapshot state (drafts are a pure function
 # of prompt + out, decode/draft.py)
-SNAPSHOT_VERSION = 3
+# v4 (round 13): counters grow the shared-prefix set (prefix_hit_blocks
+# / prefill_tokens_saved / cow_copies / prefix_lookup_blocks /
+# prefill_dispatches) and the snapshot persists ``prefix_tree`` — the
+# radix share graph (``PrefixCache.snapshot()``: per-node token edge,
+# physical block, refcount, LRU clock, poison flag). Block CONTENT
+# dies with the process, so restore deliberately does NOT rebuild the
+# tree: replay re-prefills each live request and re-INSERTS its full
+# prompt blocks, so the share graph reassembles organically (the first
+# replayed sharer prefills, later ones hit — the ~1-prefill property
+# survives the crash) and the persisted tree is the certificate tests
+# pin the rebuild against.
+SNAPSHOT_VERSION = 4
 
 
 # ---------------------------------------------------------------- snapshot
@@ -137,7 +148,14 @@ def snapshot_state(engine: DecodeEngine) -> dict:
             "block_scrubs": engine.block_scrubs,
             "drafted_tokens": engine.drafted_tokens,
             "accepted_tokens": engine.accepted_tokens,
+            "prefix_hit_blocks": engine.prefix_hit_blocks,
+            "prefill_tokens_saved": engine.prefill_tokens_saved,
+            "cow_copies": engine.cow_copies,
+            "prefix_lookup_blocks": engine.prefix_lookup_blocks,
+            "prefill_dispatches": engine.prefill_dispatches,
         },
+        "prefix_tree": (None if engine.prefix is None
+                        else engine.prefix.snapshot()),
     }
     if engine.pool.k_scale is not None:
         # int8 scales metadata: shape/dtype of the per-block scale
@@ -239,6 +257,15 @@ def restore_engine_state(engine: DecodeEngine, snap: dict) -> None:
     engine.block_scrubs = int(c["block_scrubs"])
     engine.drafted_tokens = int(c["drafted_tokens"])
     engine.accepted_tokens = int(c["accepted_tokens"])
+    engine.prefix_hit_blocks = int(c["prefix_hit_blocks"])
+    engine.prefill_tokens_saved = int(c["prefill_tokens_saved"])
+    engine.cow_copies = int(c["cow_copies"])
+    engine.prefix_lookup_blocks = int(c["prefix_lookup_blocks"])
+    engine.prefill_dispatches = int(c["prefill_dispatches"])
+    # snap["prefix_tree"] is deliberately NOT loaded: the pool content
+    # it indexed died with the process, so a fresh engine's tree starts
+    # empty and replay re-inserts as it re-prefills — the persisted
+    # tree is the share-graph certificate, not restore input
     for req in snap["requests"]:
         engine.resume_request(req["uid"], req["prompt"], req["max_new"],
                               out=req["out"], retries=req["retries"],

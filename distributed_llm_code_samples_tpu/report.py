@@ -344,6 +344,22 @@ class _Stream:
                     is not None:
                 serving["tokens_per_step"] = round(
                     last["tokens_generated"] / last["step"], 3)
+        # schema-v7 shared-prefix keys: cumulative admission hits and
+        # the prompt tokens they skipped (the prefill the pool never
+        # paid), plus the CoW trigger count (0 = the write-barrier
+        # invariant held) and the peak instantaneous sharing
+        if last.get("prefix_hit_blocks"):
+            serving["prefix_hit_blocks"] = last["prefix_hit_blocks"]
+            serving["prefill_tokens_saved"] = last.get(
+                "prefill_tokens_saved")
+            if last.get("prefix_hit_rate") is not None:
+                serving["prefix_hit_rate"] = last["prefix_hit_rate"]
+            shared = [d["shared_blocks"] for d in decodes
+                      if d.get("shared_blocks") is not None]
+            if shared:
+                serving["shared_blocks_max"] = int(max(shared))
+        if last.get("cow_copies") is not None:
+            serving["cow_copies"] = last["cow_copies"]
         return serving
 
     def reliability(self) -> dict | None:
@@ -554,6 +570,15 @@ def _render_engine_sections(out: list, doc: dict) -> None:
                        f"({sv.get('accepted_tokens')}/"
                        f"{sv.get('drafted_tokens')} drafted; "
                        f"{sv.get('tokens_per_step')} tokens/step)")
+        if "prefix_hit_blocks" in sv:
+            rate = sv.get("prefix_hit_rate")
+            out.append(f"  prefix cache hit {sv['prefix_hit_blocks']} "
+                       f"block(s)"
+                       + (f" (rate {rate})" if rate is not None else "")
+                       + f", saved {sv.get('prefill_tokens_saved')} "
+                       f"prefill token(s), peak "
+                       f"{sv.get('shared_blocks_max')} shared block(s), "
+                       f"{sv.get('cow_copies')} CoW cop(ies)")
         if "kv_pool_utilization_max" in sv:
             out.append("  KV pool     max utilization "
                        f"{sv['kv_pool_utilization_max']}")

@@ -60,7 +60,15 @@ import numpy as np
 # and the derived ``accept_rate`` (decode/engine.py verify dispatches;
 # null-rate when nothing was drafted) — so a serving stream shows
 # tokens-per-step > 1 as measured data, not inference.
-SCHEMA_VERSION = 6
+# v7 (round 13): grows the "decode" contract with the shared-prefix
+# set — cumulative ``prefix_hit_blocks`` (radix-cache hit blocks
+# mapped at admission) / ``prefill_tokens_saved`` (prompt tokens those
+# hits skipped) / ``cow_copies`` (copy-on-write privatizations; 0 is
+# the write-barrier invariant) and the instantaneous
+# ``shared_blocks`` (physical blocks named by >= 2 live tables) — the
+# measured form of the prefix cache's capacity/throughput claim
+# (decode/prefix.py, DESIGN.md section 19).
+SCHEMA_VERSION = 7
 
 METRICS_FILENAME = "metrics.jsonl"
 
@@ -93,8 +101,11 @@ ROLLBACK_REQUIRED = ("rung", "resume_step")
 # The decode-record contract: keys every "decode" record MUST carry
 # (``tokens_per_sec`` may be null on a record with no throughput delta
 # — the null stance of STEP_KEYS). ``batch_occupancy`` is active slots
-# over max slots; ``kv_pool_utilization`` is allocated non-scratch
-# blocks over usable blocks (decode/engine.py). Same version-bump
+# over max slots; ``kv_pool_utilization`` is NON-RECLAIMABLE
+# non-scratch blocks over usable blocks (decode/engine.py) — refs-0
+# prefix-cached blocks count as free since v7 (admission reclaims them
+# on demand; the extra ``prefix_evictable_blocks`` key reconciles this
+# reading with the literal free-list keys below). Same version-bump
 # discipline as STEP_KEYS.
 #
 # v5 KV-pool internals (decode/engine.py ``telemetry_record``):
@@ -119,12 +130,21 @@ ROLLBACK_REQUIRED = ("rung", "resume_step")
 # construction, so counting them would inflate accept_rate toward
 # 1.0 on exactly the churn-heavy runs where the drafter's real score
 # matters (and double-count across a crash-resume).
+# v7 shared-prefix keys (decode/engine.py ``telemetry_record``):
+# ``prefix_hit_blocks`` / ``prefill_tokens_saved`` cumulative
+# (snapshot-persisted, monotonic across crash-resume like the churn
+# trio), ``shared_blocks`` the instantaneous >= 2-live-table block
+# count, ``cow_copies`` cumulative copy-on-write privatizations (the
+# tests pin 0 in steady state — no scheduler write ever aims at a
+# shared block).
 DECODE_REQUIRED = ("step", "tokens_per_sec", "batch_occupancy",
                    "kv_pool_utilization", "free_blocks",
                    "free_blocks_low_water", "free_blocks_high_water",
                    "block_allocs", "block_frees", "block_scrubs",
                    "kv_fragmentation", "kv_bytes_stored",
-                   "drafted_tokens", "accepted_tokens", "accept_rate")
+                   "drafted_tokens", "accepted_tokens", "accept_rate",
+                   "prefix_hit_blocks", "prefill_tokens_saved",
+                   "shared_blocks", "cow_copies")
 
 # The request-record contract: one record per serving-request lifecycle
 # transition (``decode/engine.py``). ``step`` is the GLOBAL engine step

@@ -184,6 +184,59 @@ rm -rf "$SPEC_DIR"
 echo "SPEC_SMOKE=OK"
 phase_done spec_smoke
 
+echo "=== prefix-cache smoke ==="
+# 3 requests sharing a 16-token system prompt (block 8 -> 2 shared full
+# blocks), serialized through ONE slot so later admissions walk a warm
+# radix cache: `--prefix_cache` (the default) must emit BYTE-IDENTICAL
+# tokens to `--no-prefix_cache` while paying FEWER prefill dispatches,
+# and the metrics stream must hold >= 1 schema-v7 decode record with
+# prefix_hit_blocks > 0 (decode/prefix.py, DESIGN.md section 19).
+PFX_DIR=$(mktemp -d /tmp/tier1_prefix.XXXXXX)
+PFX="1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16"
+PFX_ARGS="--prompts $PFX,20,21;$PFX,30,31;$PFX,40,41 --max_new 5
+  -d 32 -l 2 --heads 4 --vocab 64 --max_seq_len 64 --block_size 8
+  --prefill_chunk 4 --max_slots 1 --log_every 2"
+if ! timeout -k 10 240 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli generate $PFX_ARGS \
+    --metrics_dir "$PFX_DIR/metrics" > "$PFX_DIR/cached.json"; then
+  echo "PREFIX_SMOKE=FAIL (cached run)"; rm -rf "$PFX_DIR"; exit 1
+fi
+if ! timeout -k 10 240 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli generate $PFX_ARGS \
+    --no-prefix_cache > "$PFX_DIR/plain.json"; then
+  echo "PREFIX_SMOKE=FAIL (unshared run)"; rm -rf "$PFX_DIR"; exit 1
+fi
+if ! timeout -k 10 60 env JAX_PLATFORMS=cpu python - "$PFX_DIR" <<'EOF'
+import json, os, sys
+from distributed_llm_code_samples_tpu.runtime.telemetry import (
+    METRICS_FILENAME, read_metrics, validate_record)
+base = sys.argv[1]
+cached = json.load(open(os.path.join(base, "cached.json")))
+plain = json.load(open(os.path.join(base, "plain.json")))
+a = {s["uid"]: s["tokens"] for s in cached["sequences"]}
+b = {s["uid"]: s["tokens"] for s in plain["sequences"]}
+assert a == b, "prefix-cached tokens != unshared run"
+assert cached["prefill_dispatches"] < plain["prefill_dispatches"], (
+    cached["prefill_dispatches"], plain["prefill_dispatches"])
+assert cached["prefix_hit_blocks"] > 0, cached["prefix_hit_blocks"]
+assert cached["cow_copies"] == 0, cached["cow_copies"]
+records, problems = read_metrics(
+    os.path.join(base, "metrics", METRICS_FILENAME))
+assert not problems, problems
+decs = [r for r in records if r["kind"] == "decode"]
+assert decs, "no schema-valid decode record in the smoke stream"
+assert all(validate_record(d)[0] for d in decs)
+assert any(d["prefix_hit_blocks"] > 0 for d in decs), (
+    [d["prefix_hit_blocks"] for d in decs])
+EOF
+then
+  echo "PREFIX_SMOKE=FAIL (identity/schema check)"; rm -rf "$PFX_DIR"
+  exit 1
+fi
+rm -rf "$PFX_DIR"
+echo "PREFIX_SMOKE=OK"
+phase_done prefix_smoke
+
 echo "=== serving-chaos smoke ==="
 # kill@4 mid-decode under the engine supervisor: run 1 SIGKILLs itself
 # right after the step-4 snapshot (rc 137); run 2 (same command) resumes

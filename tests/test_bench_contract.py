@@ -264,6 +264,16 @@ def test_bench_decode_contract():
     pool = payload["engine_pool_telemetry"]
     assert pool["block_allocs"] == pool["block_frees"] > 0
     assert pool["free_blocks_low_water"] >= 0
+    # r13 prefix-cache rows (byte-identity vs the unshared engine is
+    # asserted INSIDE the bench): the shared-prompt wave hits the radix
+    # cache, skips prefill work, and fits more sequences per pool
+    assert payload["engine_prefix_cache_tokens_per_sec"] > 0
+    assert payload["engine_prefix_cache_hit_rate"] > 0
+    assert payload["engine_prefix_cache_tokens_saved"] > 0
+    assert payload["engine_prefix_cache_prefill_dispatches"] < \
+        payload["engine_prefix_cache_prefill_dispatches_unshared"]
+    assert payload["engine_prefix_cache_cow_copies"] == 0
+    assert payload["engine_prefix_cache_capacity_gain"] > 1.0
 
 
 @pytest.mark.slow

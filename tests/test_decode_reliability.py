@@ -458,7 +458,7 @@ def test_snapshot_resume_matches_uninterrupted(tmp_path, lm_params,
     sd = str(tmp_path / "snap")
     write_snapshot(eng, sd)
     snap = load_snapshot(sd)
-    assert snap["step"] == 5 and snap["version"] == 3
+    assert snap["step"] == 5 and snap["version"] == 4
     # v2: the KV-pool churn counters persist so schema-v5 decode
     # records stay monotonic across crash-resume
     assert snap["counters"]["block_allocs"] >= 1
@@ -468,6 +468,14 @@ def test_snapshot_resume_matches_uninterrupted(tmp_path, lm_params,
     # pinned, tests/test_spec_decode.py covers the live values)
     assert snap["counters"]["drafted_tokens"] == 0
     assert snap["counters"]["accepted_tokens"] == 0
+    # v4: the shared-prefix counters persist the same way, and the
+    # radix share graph ships as ``prefix_tree`` — these prompts share
+    # no prefix, so the tree holds the 9- and 13-token prompts' single
+    # full blocks, each locked by its own prefiller (the shared-refs
+    # pins are tests/test_prefix_cache.py's snapshot test)
+    assert snap["counters"]["cow_copies"] == 0
+    assert snap["counters"]["prefill_dispatches"] == 5
+    assert [n["refs"] for n in snap["prefix_tree"]] == [1, 1]
     running = [r for r in snap["requests"] if r["state"] == "RUNNING"]
     assert running and all("block_table" in r and "position" in r
                            for r in running)

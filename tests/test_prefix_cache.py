@@ -1,0 +1,593 @@
+"""Shared-prefix KV reuse (ISSUE 9): the radix prefix cache
+(``decode/prefix.py``), refcounted copy-on-write block tables, and
+their engine composition (``decode/engine.py``, DESIGN.md section 19).
+
+The acceptance spine:
+
+- **Dispatch-count-provable reuse**: N staggered requests sharing a
+  k-block prompt run ~1 prefill pass over the shared prefix, not N
+  (``prefill_dispatches`` pins it), with zero new compiles in steady
+  state — the radix tree is host-side data, never a compiled shape.
+- **Bit-identity everywhere**: prefix-cached output == unshared engine
+  == ``models.lm.generate`` token for token at f32/bf16/int8 — a hit
+  block's bytes are a pure function of the token prefix (full blocks
+  only; chunk boundaries inside a full block are position-determined,
+  so even the int8 requant history matches), and the CoW barrier keeps
+  every write out of shared blocks.
+- **Capacity is the product**: sharers reserve k + N*tail physical
+  blocks instead of N*(k + tail) — the "effective sequences"
+  multiplier the admission test measures directly.
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_llm_code_samples_tpu.decode import (DecodeEngine,
+                                                     EngineConfig,
+                                                     PrefixCache,
+                                                     ServePolicy,
+                                                     load_snapshot,
+                                                     restore_engine_state,
+                                                     supervise_decode,
+                                                     write_snapshot)
+from distributed_llm_code_samples_tpu.models import generate, init_lm
+from distributed_llm_code_samples_tpu.runtime.chaos import FaultPlan
+
+V, D, L, H = 64, 32, 2, 4
+BASE = dict(block_size=8, n_blocks=33, max_slots=3, max_blocks_per_seq=6,
+            prefill_chunk=8)
+
+
+@pytest.fixture(scope="module")
+def lm_params():
+    return init_lm(jax.random.PRNGKey(0), V, D, L, max_seq_len=64)
+
+
+@pytest.fixture(scope="module")
+def shared_prompts():
+    """Three prompts sharing a 19-token prefix (2 full 8-blocks + 3
+    tail tokens) and diverging on the final token — the canonical
+    system-prompt workload."""
+    rng = np.random.default_rng(7)
+    head = rng.integers(0, V, size=19).tolist()
+    return [head + [t] for t in (1, 2, 3)]
+
+
+def _staggered(params, cfg, prompts, max_new=6, steps_between=3,
+               uid0=0, mesh=None, engine=None, log_every=0):
+    """Submit each prompt ``steps_between`` engine steps after the
+    previous one — enough for the earlier sharer's full prompt blocks
+    to be prefilled and inserted, so later admissions exercise the
+    radix walk (concurrent admissions exercise late dedup instead)."""
+    eng = engine or DecodeEngine(params, H, cfg, mesh=mesh)
+    for i, p in enumerate(prompts):
+        eng.submit(p, max_new, uid=uid0 + i)
+        for _ in range(steps_between):
+            eng.step()
+    return eng, eng.run(log_every=log_every)
+
+
+# ---------------------------------------------------------------------------
+# radix tree units (pure host code, no compiled programs)
+
+
+def test_match_cap_leaves_one_token_to_prefill():
+    pc = PrefixCache(8)
+    # every full block EXCEPT the one holding the final token: the
+    # first pick must always come from a prefill program
+    assert [pc.match_cap(n) for n in (1, 8, 9, 16, 17, 24)] == \
+        [0, 0, 1, 1, 2, 2]
+
+
+def test_insert_match_refcounts_and_dedup():
+    pc = PrefixCache(4)
+    prompt = list(range(12))
+    a = pc.insert(prompt, 0, block=5, step=1)
+    b = pc.insert(prompt, 1, block=6, step=1)
+    assert a.block == 5 and b.block == 6 and b.parent is a
+    assert len(pc) == 2 and pc.evictable_blocks() == 2
+    # the walk returns the longest cached full-block path, capped
+    assert [n.block for n in pc.match(prompt)] == [5, 6]
+    assert [n.block for n in pc.match(prompt[:9])] == [5, 6]
+    assert [n.block for n in pc.match(prompt[:8])] == [5]
+    assert pc.match(list(range(1, 13))) == []          # diverges at 0
+    # locking: refs are monotone non-increasing root-to-leaf
+    hits = pc.match(prompt)
+    pc.lock(hits, step=2)
+    assert (a.refs, b.refs) == (1, 1) and pc.evictable_blocks() == 0
+    assert pc.shared_blocks() == 0
+    pc.lock(pc.match(prompt), step=3)
+    assert (a.refs, b.refs) == (2, 2) and pc.shared_blocks() == 2
+    # inserting an already-cached path dedups onto the existing node
+    assert pc.insert(prompt, 0, block=9, step=4) is a
+    pc.release(b, 5)
+    pc.release(b, 5)
+    with pytest.raises(RuntimeError, match="unlocked"):
+        pc.release(b, 5)
+    # a partial block refuses insertion (its remaining rows would be
+    # decode writes — content no longer a function of the prompt)
+    with pytest.raises(ValueError, match="not full"):
+        pc.insert(prompt[:10], 2, block=7, step=6)
+
+
+def test_evict_lru_is_leaf_only_and_lru_ordered():
+    pc = PrefixCache(2)
+    p1 = [0, 1, 2, 3]                   # path A: blocks 5 -> 6
+    p2 = [0, 1, 9, 9]                   # path B: blocks 5 -> 7
+    a = pc.insert(p1, 0, 5, step=1)
+    b = pc.insert(p1, 1, 6, step=2)
+    c = pc.insert(p2, 1, 7, step=9)     # touched later than b
+    assert a is c.parent
+    # leaf-only: the shared root block 5 survives while children exist;
+    # LRU: the older leaf (6) goes before the newer (7)
+    assert pc.evict_lru(1, step=10) == [6]
+    assert pc.evict_lru(10, step=11) == [7, 5]
+    assert len(pc) == 0 and pc.match(p1) == []
+    # a live node refuses detach (the monotone-refs safety rail)
+    n = pc.insert(p1, 0, 5, step=12)
+    pc.lock([n], step=12)
+    assert pc.evict_lru(1, step=13) == []
+    with pytest.raises(RuntimeError, match="live"):
+        pc.detach_subtree(n)
+    assert b.parent is None             # detached nodes are orphaned
+
+
+def test_poisoned_nodes_excluded_from_match_and_insert():
+    pc = PrefixCache(4)
+    prompt = list(range(8))
+    a = pc.insert(prompt, 0, 3, step=1)
+    b = pc.insert(prompt, 1, 4, step=1)
+    a.poisoned = True
+    assert pc.match(prompt + [9]) == []     # no new sharer inherits it
+    # an insert under a poisoned parent stays private (returns None),
+    # as does a dedup onto a poisoned twin
+    assert pc.insert(prompt, 1, 6, step=2) is None
+    assert pc.insert(prompt, 0, 6, step=2) is None
+    # detach at refs 0 reclaims the poisoned path and its descendants
+    assert sorted(pc.detach_subtree(a)) == [3, 4]
+    assert len(pc) == 0 and b.refs == 0
+
+
+def test_snapshot_is_preorder_with_parent_links():
+    pc = PrefixCache(2)
+    pc.lock([pc.insert([0, 1, 2, 3], 0, 5, step=1)], step=1)
+    pc.insert([0, 1, 2, 3], 1, 6, step=2)
+    snap = pc.snapshot()
+    assert [(n["block"], n["parent"], n["refs"]) for n in snap] == \
+        [(5, None, 1), (6, 0, 0)]
+    assert snap[0]["tokens"] == [0, 1] and snap[1]["tokens"] == [2, 3]
+    assert all(n["poisoned"] is False for n in snap)
+
+
+# ---------------------------------------------------------------------------
+# the tentpole: dispatch-count-provable reuse, bit-identical output
+
+
+def test_staggered_sharers_run_one_prefill_pass(lm_params,
+                                                shared_prompts):
+    """Acceptance: 3 staggered requests sharing a 2-block prompt run
+    the shared prefix's prefill ONCE (5 dispatches total: 3 chunks for
+    the first + one 4-token tail each, vs 9 unshared), stay
+    byte-identical to the unshared engine AND the lockstep oracle, and
+    compile nothing new once the buckets are warm."""
+    off, out_off = _staggered(lm_params, EngineConfig(
+        **BASE, prefix_cache=False), shared_prompts)
+    on, out_on = _staggered(lm_params, EngineConfig(**BASE),
+                            shared_prompts)
+    assert out_on == out_off
+    for i, p in enumerate(shared_prompts):
+        ref = np.asarray(generate(lm_params, jax.numpy.asarray([p]), 6,
+                                  H))[0].tolist()
+        assert out_on[i] == ref
+    assert off.prefill_dispatches == 9 and on.prefill_dispatches == 5
+    assert on.prefix_hit_blocks == 4            # 2 blocks x 2 sharers
+    assert on.prefill_tokens_saved == 32
+    assert on.cow_copies == 0                   # the barrier invariant
+    assert off.prefix_hit_blocks == 0 and off.prefix is None
+    # steady state: a second wave of sharers hits the (now refs-0)
+    # cached blocks with ZERO new compiles — the tree is data
+    warm = on.compile_count
+    _, out2 = _staggered(lm_params, None, shared_prompts, uid0=10,
+                         engine=on)
+    assert on.compile_count == warm
+    assert on.prefill_dispatches == 5 + 3       # one tail chunk each
+    assert on.prefix_hit_blocks == 4 + 6        # wave 2: ALL 3 hit
+    assert all(out2[10 + i] == out_off[i] for i in range(3))
+
+
+@pytest.mark.parametrize("kv_dtype", ["f32", "bf16", "int8"])
+def test_prefix_identity_across_kv_dtypes(lm_params, shared_prompts,
+                                          kv_dtype):
+    """Sharing changes which physical block a table names, never a byte
+    the gather returns: prefix-cached == unshared at every storage
+    dtype (int8 is the hard case — the requant history of a hit block
+    must equal the one the admitting sequence's own prefill would have
+    written)."""
+    cfg_on = EngineConfig(**BASE, kv_dtype=kv_dtype)
+    cfg_off = EngineConfig(**BASE, kv_dtype=kv_dtype,
+                           prefix_cache=False)
+    on, out_on = _staggered(lm_params, cfg_on, shared_prompts)
+    _, out_off = _staggered(lm_params, cfg_off, shared_prompts)
+    assert out_on == out_off
+    assert on.prefix_hit_blocks == 4 and on.cow_copies == 0
+
+
+def test_prefix_identity_sampled(lm_params, shared_prompts):
+    """Sampling keys fold (seed, uid, position) — never the slot or the
+    physical block — so sharing cannot move a sampled pick either."""
+    kw = dict(temperature=0.9, top_k=12, seed=3)
+    _, out_on = _staggered(lm_params, EngineConfig(**BASE, **kw),
+                           shared_prompts)
+    _, out_off = _staggered(lm_params, EngineConfig(
+        **BASE, prefix_cache=False, **kw), shared_prompts)
+    assert out_on == out_off
+
+
+def test_effective_capacity_gain(lm_params, shared_prompts):
+    """The pool-capacity multiplier, measured: three 4-block sharers
+    need 12 physical blocks unshared (a 9-block pool stalls the third)
+    but 8 shared (2 shared + 3 x 2 private tails) — all three resident
+    at once. This "effective sequences" gain is the admission currency
+    of the multi-engine router (ROADMAP item 3)."""
+    small = dict(BASE, n_blocks=10, max_blocks_per_seq=4)
+    on = DecodeEngine(lm_params, H, EngineConfig(**small))
+    off = DecodeEngine(lm_params, H, EngineConfig(**small,
+                                                  prefix_cache=False))
+    for eng in (on, off):
+        for i, p in enumerate(shared_prompts):
+            eng.submit(p, 8, uid=i)
+            eng.step()
+            eng.step()
+    assert on.active == 3 and not on.waiting        # all resident
+    assert off.active == 2 and len(off.waiting) == 1  # pool-blocked
+    assert on.prefix.shared_blocks() == 2
+    out_on, out_off = on.run(), off.run()
+    assert out_on == out_off                        # identity anyway
+
+
+def test_lru_reclaim_under_pool_pressure(lm_params, shared_prompts):
+    """refs-0 cached blocks convert back to free-list blocks on demand
+    (LRU), so retention never starves admission: a non-sharing request
+    that needs the whole pool still admits after the cache is warm."""
+    small = dict(BASE, n_blocks=8, max_blocks_per_seq=5)
+    eng = DecodeEngine(lm_params, H, EngineConfig(**small))
+    eng.submit(shared_prompts[0], 5, uid=0)         # 3 blocks, 2 cached
+    eng.run()
+    assert len(eng.prefix) == 2 and eng.prefix.evictable_blocks() == 2
+    assert len(eng.free_blocks) == 5
+    rng = np.random.default_rng(11)
+    eng.submit(rng.integers(0, V, size=33).tolist(), 7, uid=1)  # 5 blks
+    eng.step()
+    # 5 > 5 free? no — exactly fits; force the reclaim with a second
+    eng.submit(rng.integers(32, V, size=17).tolist(), 8, uid=2)  # 3 blks
+    done = eng.run()
+    assert sorted(done) == [0, 1, 2]
+    assert len(eng.prefix.nodes()) == len(eng.prefix)  # tree coherent
+
+
+# ---------------------------------------------------------------------------
+# copy-on-write: the enforced invariant
+
+
+def test_cow_privatizes_without_touching_the_sharer(lm_params,
+                                                    shared_prompts):
+    """Force the barrier by hand (no scheduler write ever aims at a
+    shared block, so the trigger must be synthetic): privatizing a
+    shared block copies its bytes bit-identically, remaps exactly one
+    table, drops exactly one ref — and the other sharer's output is
+    untouched, because its bytes are."""
+    eng = DecodeEngine(lm_params, H, EngineConfig(**BASE))
+    for i, p in enumerate(shared_prompts[:2]):
+        eng.submit(p, 8, uid=i)
+        for _ in range(3):
+            eng.step()
+    slot1 = next(i for i, s in enumerate(eng.slots)
+                 if s is not None and s.uid == 1)
+    seq1 = eng.slots[slot1]
+    node = seq1.nodes[0]
+    src = node.block
+    before = np.asarray(eng.pool.k[:, src]).copy()
+    assert node.refs == 2
+    eng._cow_private(slot1, 0, 0)
+    assert eng.cow_copies == 1 and seq1.nodes[0] is None
+    dst = seq1.blocks[0]
+    assert dst != src and eng.tables[slot1][0] == dst
+    assert node.refs == 1                       # the sharer's ref only
+    np.testing.assert_array_equal(np.asarray(eng.pool.k[:, dst]),
+                                  before)       # bit-identical copy
+    np.testing.assert_array_equal(np.asarray(eng.pool.k[:, src]),
+                                  before)       # sharer untouched
+    out = eng.run()
+    _, clean = _staggered(lm_params, EngineConfig(**BASE),
+                          shared_prompts[:2], max_new=8)
+    assert out == clean                         # CoW is invisible
+
+
+def test_cow_zero_across_mixed_traffic(lm_params, shared_prompts):
+    """The write-barrier invariant under everything at once: sharing +
+    speculation + int8 + a second wave never triggers a single CoW —
+    every write lands at or past the prefill frontier by construction,
+    and the counter pins it."""
+    cfg = EngineConfig(**BASE, kv_dtype="int8", speculate=3)
+    eng, out = _staggered(lm_params, cfg, shared_prompts)
+    _, out2 = _staggered(lm_params, None, shared_prompts, uid0=10,
+                         engine=eng)
+    assert eng.cow_copies == 0 and eng.prefix_hit_blocks == 10
+    _, out_off = _staggered(lm_params, EngineConfig(
+        **BASE, kv_dtype="int8", speculate=3, prefix_cache=False),
+        shared_prompts)
+    assert out == out_off
+    assert {u - 10: t for u, t in out2.items() if u >= 10} == out_off
+
+
+def test_int8_scales_frozen_while_shared(lm_params, shared_prompts):
+    """An int8 block's per-block scales freeze at share time: requant
+    only ever touches write-window blocks, and no write window covers
+    a fully-prefilled prompt block — so two sharers decoding to
+    completion never move the shared blocks' scales (a requant under a
+    sharer's foot would silently re-round the other's prefix)."""
+    eng = DecodeEngine(lm_params, H, EngineConfig(**BASE,
+                                                  kv_dtype="int8"))
+    for i, p in enumerate(shared_prompts):
+        eng.submit(p, 8, uid=i)
+        for _ in range(3):
+            eng.step()
+    blocks = [n.block for n in eng.prefix.nodes()]
+    assert len(blocks) == 2
+    k_sc = np.asarray(eng.pool.k_scale[:, blocks]).copy()
+    v_sc = np.asarray(eng.pool.v_scale[:, blocks]).copy()
+    vals = np.asarray(eng.pool.k[:, blocks]).copy()
+    eng.run()
+    np.testing.assert_array_equal(
+        np.asarray(eng.pool.k_scale[:, blocks]), k_sc)
+    np.testing.assert_array_equal(
+        np.asarray(eng.pool.v_scale[:, blocks]), v_sc)
+    np.testing.assert_array_equal(np.asarray(eng.pool.k[:, blocks]),
+                                  vals)
+
+
+# ---------------------------------------------------------------------------
+# telemetry v7 + TP composition + CLI flag
+
+
+def test_decode_record_v7_prefix_keys(lm_params, shared_prompts,
+                                      tmp_path):
+    from distributed_llm_code_samples_tpu.runtime.telemetry import (
+        METRICS_FILENAME, TelemetryWriter, read_metrics,
+        validate_record)
+    mdir = str(tmp_path / "m")
+    with TelemetryWriter(mdir) as w:
+        eng = DecodeEngine(lm_params, H, EngineConfig(**BASE),
+                           metrics=w)
+        _staggered(lm_params, None, shared_prompts, engine=eng,
+                   max_new=12, log_every=1)
+    records, problems = read_metrics(os.path.join(mdir,
+                                                  METRICS_FILENAME))
+    assert problems == []
+    decs = [r for r in records if r["kind"] == "decode"]
+    assert decs
+    for r in decs:
+        ok, reason = validate_record(r)
+        assert ok, reason
+    last = decs[-1]
+    assert last["prefix_hit_blocks"] == 4
+    assert last["prefill_tokens_saved"] == 32
+    assert last["cow_copies"] == 0
+    assert last["shared_blocks"] == 0           # drained: refs all 0
+    # while the sharers overlapped, some record saw both shared blocks
+    assert any(r["shared_blocks"] == 2 for r in decs)
+    # the first sharer's 2-block walk misses (cold tree), the other
+    # two hit: 4 / 6
+    assert last["prefix_hit_rate"] == round(4 / 6, 4)
+
+
+def test_tp_sharing_token_identical(lm_params, shared_prompts,
+                                    mesh_model4):
+    """--tp composes with sharing: the radix tree is one host-side
+    structure over a head-sharded pool, so every shard's table names
+    the same shared blocks and the picks stay identical to the
+    single-device prefix-cached engine."""
+    tp, out_tp = _staggered(lm_params, EngineConfig(**BASE),
+                            shared_prompts, max_new=4, mesh=mesh_model4)
+    sd, out_sd = _staggered(lm_params, EngineConfig(**BASE),
+                            shared_prompts, max_new=4)
+    assert out_tp == out_sd
+    assert tp.prefix_hit_blocks == sd.prefix_hit_blocks == 4
+
+
+# ---------------------------------------------------------------------------
+# reliability composition: quarantine, chaos corruption, preemption,
+# snapshot v4 kill -> resume
+
+
+def test_shared_block_quarantine_survivor_bit_identical(tmp_path,
+                                                        lm_params,
+                                                        shared_prompts):
+    """The scrub-vs-decref contract, end to end: poison the logits of a
+    sharer mid-decode — its quarantine DECREFS the shared prefix blocks
+    (the survivors' bytes) instead of scrubbing them, and every
+    survivor sharing the poisoned uid's prefix finishes bit-identical
+    to a run that never admitted it. The retry then heals on the still-
+    cached prefix."""
+    cfg = EngineConfig(**BASE)
+    oracle = DecodeEngine(lm_params, H, cfg)
+    oracle.submit(shared_prompts[0], 8, uid=0)
+    oracle.submit(shared_prompts[2], 8, uid=2)
+    clean = oracle.run()
+    eng = supervise_decode(
+        lambda: DecodeEngine(lm_params, H, cfg),
+        [(p, 8) for p in shared_prompts],
+        snapshot_dir=str(tmp_path / "s"),
+        chaos=FaultPlan.parse("nan_logits@6:1"))
+    assert set(eng.failed) == {1}
+    assert eng.finished[0] == clean[0]
+    assert eng.finished[2] == clean[2]
+    # the shared nodes survived the quarantine (refs 2 at fault time:
+    # decref, not scrub-and-detach) and drained to cached refs-0
+    assert len(eng.prefix) >= 2 and eng.prefix.evictable_blocks() >= 2
+    # with retry budget the poisoned sharer replays onto the cached
+    # prefix and lands the clean tokens
+    all_clean = _staggered(lm_params, cfg, shared_prompts, max_new=8,
+                           steps_between=0)[1]
+    eng2 = supervise_decode(
+        lambda: DecodeEngine(lm_params, H, cfg,
+                             policy=ServePolicy(max_retries=1)),
+        [(p, 8) for p in shared_prompts],
+        snapshot_dir=str(tmp_path / "s2"),
+        chaos=FaultPlan.parse("nan_logits@6:1"))
+    assert eng2.failed == {}
+    assert dict(eng2.finished) == all_clean
+
+
+def test_corrupt_shared_block_poisons_tree_then_heals(tmp_path,
+                                                      lm_params,
+                                                      shared_prompts):
+    """Chaos-corrupting a block the radix tree holds: the node is
+    poisoned immediately (no NEW sharer may match it), current sharers
+    quarantine as their dispatches flag the NaN, the LAST release
+    scrubs-and-detaches the path, and the retries — re-prefilling from
+    scratch on a clean pool — recover the uninterrupted run's exact
+    tokens. FCFS admission hands block 1 to the first request's first
+    prompt block, which is exactly the first shared node."""
+    cfg = EngineConfig(**BASE)
+    clean = _staggered(lm_params, cfg, shared_prompts, max_new=8,
+                       steps_between=0)[1]
+    eng = supervise_decode(
+        lambda: DecodeEngine(lm_params, H, cfg,
+                             policy=ServePolicy(max_retries=1)),
+        [(p, 8) for p in shared_prompts],
+        snapshot_dir=str(tmp_path / "s"),
+        chaos=FaultPlan.parse("corrupt_block@6:1"))
+    assert eng.failed == {}
+    assert dict(eng.finished) == clean
+    assert eng.quarantined >= 1
+    # the poisoned path was detached at last release: whatever the
+    # retries re-cached, no cached node names a corrupted block
+    assert not eng._corrupted
+    assert all(not n.poisoned for n in eng.prefix.nodes())
+
+
+def test_preemption_decrefs_shared_blocks(lm_params, shared_prompts):
+    """Pool-pressure preemption of a sharer releases its refs (decref,
+    never scrub) and the replay-resume re-walks the tree: tokens stay
+    identical to the unshared engine and the share graph stays
+    coherent through the churn."""
+    small = dict(BASE, n_blocks=9, max_blocks_per_seq=4)
+    policy = ServePolicy(preempt_after_steps=2)
+    eng = DecodeEngine(lm_params, H, EngineConfig(**small),
+                       policy=policy)
+    for i, p in enumerate(shared_prompts):
+        eng.submit(p, 8, uid=i)
+        eng.step()
+    out = eng.run()
+    _, out_off = _staggered(
+        lm_params, EngineConfig(**small, prefix_cache=False),
+        shared_prompts, max_new=8, steps_between=0)
+    del out_off  # pool too small to admit all three unshared —
+    # the identity oracle is the roomy unshared engine instead
+    _, roomy = _staggered(lm_params,
+                          EngineConfig(**BASE, prefix_cache=False),
+                          shared_prompts, max_new=8, steps_between=0)
+    assert out == roomy
+    assert eng.cow_copies == 0
+    # drained: every node refs-0, tree still coherent
+    assert all(n.refs == 0 for n in eng.prefix.nodes())
+
+
+def test_snapshot_v4_kill_resume_rebuilds_share_graph(tmp_path,
+                                                      lm_params,
+                                                      shared_prompts):
+    """Snapshot v4 persists the radix tree (the share-graph
+    certificate) + the prefix counters; a crash-resume deliberately
+    starts with an EMPTY tree (pool content died with the process) and
+    REBUILDS sharing through replay: the first replayed sharer
+    re-prefills and re-inserts, later ones hit — outputs bit-identical
+    to the uninterrupted run, counters monotonic, and the rebuilt tree
+    carries the same token paths as the certificate."""
+    cfg = EngineConfig(**BASE)
+    _, clean = _staggered(lm_params, cfg, shared_prompts,
+                          max_new=8, steps_between=0)
+    eng = DecodeEngine(lm_params, H, cfg)
+    for i, p in enumerate(shared_prompts[:2]):
+        eng.submit(p, 8, uid=i)
+        for _ in range(3):
+            eng.step()
+    eng.submit(shared_prompts[2], 8, uid=2)
+    eng.step()                      # uid 2 admits: refs climb to 3
+    sd = str(tmp_path / "snap")
+    write_snapshot(eng, sd)
+    snap = load_snapshot(sd)
+    assert snap["version"] == 4
+    tree = snap["prefix_tree"]
+    # the certificate: 2 shared nodes, every live sharer holding a ref
+    assert [n["refs"] for n in tree] == [3, 3]
+    assert tree[0]["parent"] is None and tree[1]["parent"] == 0
+    assert (tree[0]["tokens"] + tree[1]["tokens"]
+            == shared_prompts[0][:16])
+    assert snap["counters"]["prefill_tokens_saved"] > 0
+    pre_hits = eng.prefix_hit_blocks
+    # "crash": a fresh process restores — tree starts EMPTY, replay
+    # rebuilds it
+    eng2 = DecodeEngine(lm_params, H, cfg)
+    restore_engine_state(eng2, load_snapshot(sd))
+    assert len(eng2.prefix) == 0
+    assert eng2.prefix_hit_blocks == pre_hits        # counters restored
+    done = eng2.run()
+    assert done == clean
+    # all three replayed sharers re-admitted CONCURRENTLY (3 free
+    # slots, empty tree -> no admission hits): the share graph
+    # rebuilds through late DEDUP instead — each re-prefilled block
+    # remaps onto the first replayer's cached twin — and the hit
+    # counter stays exactly monotonic
+    assert eng2.prefix_hit_blocks == pre_hits
+    rebuilt = eng2.prefix.snapshot()
+    assert ([n["tokens"] for n in rebuilt]
+            == [n["tokens"] for n in tree])
+    assert all(n["refs"] == 0 for n in rebuilt)      # drained
+    # the rebuilt cache is HOT: a post-resume sharer hits at admission
+    eng2.submit(shared_prompts[0][:19] + [9], 4, uid=7)
+    out7 = eng2.run()[7]
+    assert eng2.prefix_hit_blocks == pre_hits + 2
+    assert out7 == np.asarray(generate(
+        lm_params, jax.numpy.asarray([shared_prompts[0][:19] + [9]]),
+        4, H))[0].tolist()
+    # resume rejects a sharing-policy mismatch like any config drift
+    with pytest.raises(ValueError, match="config"):
+        restore_engine_state(
+            DecodeEngine(lm_params, H,
+                         EngineConfig(**BASE, prefix_cache=False)),
+            load_snapshot(sd))
+
+
+def test_generate_cli_prefix_cache_flag(tmp_path, capsys):
+    """CLI surface: default on with payload accounting; the --no-
+    variant restores the private-blocks engine; parse discipline
+    rejects garbage."""
+    import json as _json
+
+    import distributed_llm_code_samples_tpu.cli as cli
+    args = ["generate", "--prompts", "3,1,4,1,5,9,2,6,5,3;"
+            "3,1,4,1,5,9,2,6,5,3", "--max_new", "4", "-d", "32", "-l",
+            "2", "--heads", "4", "--vocab", "64", "--max_seq_len",
+            "64", "--block_size", "4", "--prefill_chunk", "4",
+            "--max_slots", "1"]
+    assert cli.main(args) == 0
+    on = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert on["prefix_cache"] is True
+    # max_slots 1 serializes the two identical prompts: the second hits
+    assert on["prefix_hit_blocks"] == 2 and on["cow_copies"] == 0
+    assert on["prefill_tokens_saved"] == 8
+    assert cli.main(args + ["--no-prefix_cache"]) == 0
+    off = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert off["prefix_cache"] is False and off["prefix_hit_blocks"] == 0
+    assert [s["tokens"] for s in on["sequences"]] == \
+        [s["tokens"] for s in off["sequences"]]
+    assert on["prefill_dispatches"] < off["prefill_dispatches"]
+    # the boolean flag takes no value: argparse rejects one (rc 2)
+    with pytest.raises(SystemExit) as exc:
+        cli.main(args + ["--prefix_cache=maybe"])
+    assert exc.value.code == 2
+    capsys.readouterr()

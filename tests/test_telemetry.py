@@ -42,8 +42,11 @@ from distributed_llm_code_samples_tpu.runtime.telemetry import (
 # contract's KV-pool internals (watermarks, churn, fragmentation,
 # stored bytes). v6 (round 12): the decode contract's speculative-
 # decoding trio (drafted_tokens / accepted_tokens / accept_rate —
-# decode/engine.py verify dispatches).
-_PINNED_VERSION = 6
+# decode/engine.py verify dispatches). v7 (round 13): the decode
+# contract's shared-prefix set (prefix_hit_blocks /
+# prefill_tokens_saved / shared_blocks / cow_copies — the radix
+# prefix cache, decode/prefix.py).
+_PINNED_VERSION = 7
 _PINNED_STEP_KEYS = frozenset({
     "schema", "kind", "t", "step", "strategy", "loss", "grad_norm",
     "tokens_per_sec", "step_time_s", "mfu", "hbm_high_water_bytes",
@@ -55,7 +58,8 @@ _PINNED_DECODE_REQUIRED = frozenset({
     "free_blocks", "free_blocks_low_water", "free_blocks_high_water",
     "block_allocs", "block_frees", "block_scrubs", "kv_fragmentation",
     "kv_bytes_stored", "drafted_tokens", "accepted_tokens",
-    "accept_rate",
+    "accept_rate", "prefix_hit_blocks", "prefill_tokens_saved",
+    "shared_blocks", "cow_copies",
 })
 _PINNED_REQUEST_REQUIRED = frozenset({"step", "uid", "event", "reason"})
 _PINNED_SPAN_REQUIRED = frozenset({
