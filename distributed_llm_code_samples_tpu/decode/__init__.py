@@ -7,12 +7,14 @@ section 16)."""
 
 from .draft import draft_tokens
 from .engine import (AdmissionError, DecodeEngine, EngineConfig,
-                     FLIGHT_FILENAME, POISON_ALL, POISON_NONE,
-                     REQUEST_EVENTS, ServePolicy)
+                     FLIGHT_FILENAME, HANDOFF_VERSION, POISON_ALL,
+                     POISON_NONE, REQUEST_EVENTS, ServePolicy)
+from .fleet import EngineHandle, FleetRouter
 from .paged import (KV_DTYPES, PagedKV, SCRATCH_BLOCK, copy_block,
-                    corrupt_block, fused_decode_attn, gather_layer,
-                    init_pool, kv_bytes_per_token, pool_bytes,
-                    scrub_blocks, write_chunk, write_rows)
+                    corrupt_block, extract_blocks, fused_decode_attn,
+                    gather_layer, implant_block, init_pool,
+                    kv_bytes_per_token, pool_bytes, scrub_blocks,
+                    write_chunk, write_rows)
 from .prefix import PrefixCache, PrefixNode
 from .sampling import check_sampling, check_speculation, make_pick
 from .supervise import (SNAPSHOT_FILENAME, load_snapshot,
@@ -20,11 +22,14 @@ from .supervise import (SNAPSHOT_FILENAME, load_snapshot,
                         supervise_decode, write_snapshot)
 
 __all__ = [
-    "AdmissionError", "DecodeEngine", "EngineConfig", "FLIGHT_FILENAME",
+    "AdmissionError", "DecodeEngine", "EngineConfig", "EngineHandle",
+    "FLIGHT_FILENAME", "FleetRouter", "HANDOFF_VERSION",
     "POISON_ALL", "POISON_NONE", "REQUEST_EVENTS", "ServePolicy",
     "KV_DTYPES", "PagedKV", "SCRATCH_BLOCK", "copy_block",
-    "corrupt_block", "draft_tokens", "fused_decode_attn",
-    "gather_layer", "init_pool", "kv_bytes_per_token", "pool_bytes",
+    "corrupt_block", "draft_tokens", "extract_blocks",
+    "fused_decode_attn",
+    "gather_layer", "implant_block", "init_pool",
+    "kv_bytes_per_token", "pool_bytes",
     "PrefixCache", "PrefixNode",
     "scrub_blocks", "write_chunk", "write_rows",
     "check_sampling", "check_speculation", "make_pick",

@@ -151,7 +151,8 @@ from ..runtime.telemetry import FLIGHT_FILENAME
 from ..runtime.tracing import SpanTracer
 from .draft import draft_tokens
 from .paged import (PagedKV, SCRATCH_BLOCK, copy_block, corrupt_block as
-                    _pool_corrupt_block, fused_decode_attn, gather_layer,
+                    _pool_corrupt_block, extract_blocks,
+                    fused_decode_attn, gather_layer, implant_block,
                     init_pool, kv_bytes_per_token, pool_bytes,
                     scrub_blocks, write_chunk, write_rows)
 from .prefix import PrefixCache
@@ -164,9 +165,24 @@ POISON_ALL = -2
 
 # the request-record event vocabulary (telemetry schema v4 ``request``
 # kind; runtime/telemetry.py REQUEST_REQUIRED pins the KEY set, this
-# names the transitions)
+# names the transitions; "handoff" is the round-14 addition — a
+# sequence leaving this engine via the single-sequence KV handoff,
+# decode/fleet.py)
 REQUEST_EVENTS = ("admitted", "preempted", "retried", "quarantined",
-                  "completed", "rejected", "expired")
+                  "completed", "rejected", "expired", "handoff")
+
+# the single-sequence KV handoff wire format (export_sequence /
+# import_sequence): one uid's written blocks + int8 scales + position +
+# scheduler state, restored into a FOREIGN pool under that pool's block
+# numbering. v1 (round 14, DESIGN.md section 20).
+HANDOFF_VERSION = 1
+
+# EngineConfig keys two engines may legitimately disagree on and still
+# exchange sequences: pool SIZE is an engine-local capacity choice.
+# Every other key participates in the token-identity proof (sampling
+# keys, chunk grouping — hence int8 requant history — kernel and
+# speculation paths) and must match exactly.
+_HANDOFF_POOL_KEYS = ("n_blocks", "max_slots", "max_blocks_per_seq")
 
 # flight recorder: bounded ring of per-step scheduler digests, dumped
 # atomically on quarantine / watchdog latch / chaos kill — the "what
@@ -515,7 +531,8 @@ class DecodeEngine:
             builder = {"decode": self._build_decode,
                        "prefill": self._build_prefill,
                        "verify": self._build_verify,
-                       "cow": self._build_cow}[kind]
+                       "cow": self._build_cow,
+                       "implant": self._build_implant}[kind]
             fn = builder(bucket)
             self._programs[key] = fn
         self.dispatch_count += 1
@@ -806,6 +823,213 @@ class DecodeEngine:
         which steady state never does — the recompile-guard tests keep
         holding with the barrier armed."""
         return jax.jit(copy_block, donate_argnums=(0,))
+
+    def _build_implant(self, _bucket: int):
+        """The KV-handoff import copy (``paged.implant_block``) as one
+        compiled program for every destination block — the block id is
+        a traced operand, so importing a sequence never recompiles past
+        the first handoff. Donated like the step programs. Built lazily
+        on the first import (the "first migration wave" — the
+        zero-new-compiles-after contract starts there)."""
+        return jax.jit(implant_block, donate_argnums=(0,))
+
+    # -- model identity (snapshots + KV handoff pin it) ----------------
+
+    def model_meta(self) -> dict:
+        """Model identity the snapshot AND the KV handoff pin: resume
+        replays recorded tokens through the CURRENT weights, and an
+        imported sequence's KV was written by the SOURCE's weights —
+        either under different weights silently breaks the
+        token-identical contract. Shapes catch a changed architecture;
+        the embedding-row fingerprint catches a changed init seed at
+        the same shape (rounded coarsely so the float reduction order —
+        which legitimately varies across TP layouts — can't cause a
+        false mismatch)."""
+        p = self.params
+        return {
+            "vocab": int(p.vocab), "d_model": int(p.d_model),
+            "n_layers": int(p.n_layers),
+            "max_seq_len": int(p.max_seq_len),
+            "n_heads": int(self.n_heads),
+            "kv_heads": int(self.kv_heads),
+            "wte0_sum": round(float(jnp.sum(p.wte[0])), 2),
+        }
+
+    # -- single-sequence KV handoff (DESIGN.md section 20) -------------
+
+    def export_sequence(self, uid: int) -> dict:
+        """Export one RESIDENT fully-prefilled sequence as a handoff
+        document: scheduler state (prompt, emitted tokens, position,
+        pending next token) plus the WRITTEN blocks' bytes and int8
+        scales at the storage dtype — everything a foreign engine needs
+        to continue the sequence token-identically without replay. The
+        sequence leaves this engine on the way out: shared prefix
+        blocks DECREF (an innocent sharer's prefix is untouched — the
+        quarantine stance, without the distrust), private blocks return
+        to the free list clean. Generalizes the PR 5 snapshot from
+        whole-engine metadata to one sequence WITH its KV content."""
+        if self.mesh is not None:
+            raise ValueError(
+                "KV handoff is single-device (the fleet runs "
+                "single-device replicas; TP engines keep the "
+                "whole-engine snapshot path)")
+        slot = next((i for i, s in enumerate(self.slots)
+                     if s is not None and s.uid == uid), None)
+        if slot is None:
+            raise ValueError(f"uid {uid} is not resident on this engine "
+                             "(waiting/finished requests migrate by "
+                             "replay, not handoff)")
+        seq = self.slots[slot]
+        if not seq.prompt_done:
+            raise ValueError(
+                f"uid {uid} is mid-prefill ({seq.prefilled}/"
+                f"{len(seq.prompt)} tokens): handoff exports fully-"
+                "prefilled sequences; an unprefilled request migrates "
+                "by replay")
+        pos = int(self.lengths[slot])
+        nb_written = -(-pos // self.cfg.block_size)
+        phys = [int(b) for b in seq.blocks[:nb_written]]
+        bad = [b for b in phys if b in self._corrupted]
+        if bad:
+            raise ValueError(
+                f"uid {uid} holds chaos-corrupted block(s) {bad}: a "
+                "poisoned sequence must quarantine, not migrate the "
+                "poison to an innocent engine")
+        doc = {
+            "handoff_version": HANDOFF_VERSION,
+            "model": self.model_meta(),
+            "config": dataclasses.asdict(self.cfg),
+            "uid": int(seq.uid),
+            "prompt": list(seq.prompt),
+            "out": list(seq.out),
+            "max_new": int(seq.max_new),
+            "emitted": int(seq.emitted),
+            "retries": int(seq.retries),
+            "t_submit": float(seq.t_submit),
+            "position": pos,
+            "next_token": int(self.next_token[slot]),
+            "blocks_written": nb_written,
+            "source_blocks": phys,     # the renumbering certificate
+            **extract_blocks(self.pool, phys),
+        }
+        self._event("handoff", seq.uid, reason="exported",
+                    n_out=len(seq.out), position=pos)
+        self.tracer.close(seq.uid, self.global_step, reason="handoff",
+                          tokens=self._span_tokens.pop(seq.uid, 0))
+        self._evict(slot)
+        return doc
+
+    def import_sequence(self, doc: dict) -> int:
+        """Restore an ``export_sequence`` document into THIS engine's
+        pool under THIS pool's block numbering: allocate the full block
+        reservation, implant the written blocks' bytes (+ int8 scales,
+        bit-exactly — the content is copied at the storage dtype, never
+        round-tripped through f32), install the sequence into a free
+        slot at its exported position, and transfer its full prompt
+        blocks into the local radix tree so the NEXT local sharer hits
+        them (cross-engine prefix reuse). Decode continues on the very
+        next step — no replay, no prefill dispatch. Model fingerprint
+        and the numerics-relevant config keys must match the source's
+        (pool-size keys may differ; that is the point of renumbering)."""
+        if self.mesh is not None:
+            raise ValueError(
+                "KV handoff is single-device (the fleet runs "
+                "single-device replicas; TP engines keep the "
+                "whole-engine snapshot path)")
+        if doc.get("handoff_version") != HANDOFF_VERSION:
+            raise ValueError(f"handoff version "
+                             f"{doc.get('handoff_version')!r} != "
+                             f"{HANDOFF_VERSION}")
+        model = self.model_meta()
+        if doc["model"] != model:
+            diff = {k: (doc["model"].get(k), model.get(k))
+                    for k in set(model) | set(doc["model"])
+                    if doc["model"].get(k) != model.get(k)}
+            raise ValueError(
+                f"model != handoff model: {diff} — the imported KV was "
+                "written by the source's weights, so the identical "
+                "model (same shape AND same init) is required for the "
+                "token-identical contract")
+        cfg = dataclasses.asdict(self.cfg)
+        diff = {k: (doc["config"].get(k), cfg[k]) for k in cfg
+                if k not in _HANDOFF_POOL_KEYS
+                and doc["config"].get(k) != cfg[k]}
+        if diff:
+            raise ValueError(
+                f"engine config != handoff config: {diff} (pool-size "
+                f"keys {_HANDOFF_POOL_KEYS} may differ; every numerics "
+                "key must match for token identity)")
+        uid = int(doc["uid"])
+        prompt = [int(t) for t in doc["prompt"]]
+        max_new = int(doc["max_new"])
+        if uid in self.finished or uid in self.failed \
+                or any(s is not None and s.uid == uid for s in self.slots) \
+                or any(s.uid == uid for s in self.waiting):
+            raise ValueError(f"uid {uid} already in use")
+        need = self._blocks_needed(len(prompt), max_new)
+        if need > self.cfg.max_blocks_per_seq:
+            raise ValueError(
+                f"handoff needs {need} blocks, exceeding this engine's "
+                f"max_blocks_per_seq {self.cfg.max_blocks_per_seq}")
+        if len(prompt) + max_new - 1 > self.params.max_seq_len:
+            raise ValueError("handoff exceeds max_seq_len")
+        slot = next((i for i, s in enumerate(self.slots) if s is None),
+                    None)
+        if slot is None:
+            raise RuntimeError("no free slot for handoff import (the "
+                               "router checks capacity before "
+                               "dispatching a handoff)")
+        if need > len(self.free_blocks) and self.prefix is not None:
+            self._reclaim_cached(need - len(self.free_blocks))
+        if need > len(self.free_blocks):
+            raise RuntimeError(
+                f"handoff needs {need} blocks, {len(self.free_blocks)} "
+                "free (the router checks capacity before dispatching)")
+        blocks = [self.free_blocks.pop(0) for _ in range(need)]
+        nb = int(doc["blocks_written"])
+        fn_args = []
+        for i in range(nb):
+            args = [jnp.asarray(doc["k"][:, i]),
+                    jnp.asarray(doc["v"][:, i])]
+            if doc["k_scale"] is not None:
+                args += [jnp.asarray(doc["k_scale"][:, i]),
+                         jnp.asarray(doc["v_scale"][:, i])]
+            fn_args.append(args)
+        for i, args in enumerate(fn_args):
+            fn = self._program("implant", 0)
+            self.pool = fn(self.pool, jnp.int32(blocks[i]), *args)
+        seq = _Seq(uid=uid, prompt=prompt, max_new=max_new,
+                   out=[int(t) for t in doc["out"]],
+                   retries=int(doc["retries"]),
+                   submit_step=self.global_step)
+        seq.emitted = int(doc["emitted"])
+        seq.t_submit = float(doc["t_submit"])
+        seq.prefilled = len(prompt)
+        seq.blocks = blocks
+        self.prompt_lens[uid] = len(prompt)
+        row = np.full((self.cfg.max_blocks_per_seq,), SCRATCH_BLOCK,
+                      np.int32)
+        row[:need] = blocks
+        self.tables[slot] = row
+        self.lengths[slot] = int(doc["position"])
+        self.next_token[slot] = int(doc["next_token"])
+        self.uids[slot] = uid
+        self.slots[slot] = seq
+        seq.admit_index = self._admit_counter
+        self._admit_counter += 1
+        self.block_allocs += need
+        self._next_uid = max(self._next_uid, uid) + 1
+        self._event("admitted", uid, reason="handoff",
+                    position=int(doc["position"]), replay=0)
+        # the span clock restarts at import (the resume stance: the
+        # in-transit gap is visibly unaccounted rather than invented)
+        self.tracer.open(uid, "replay" if seq.replaying else "decode",
+                         self.global_step)
+        # cross-engine prefix reuse: the imported full prompt blocks
+        # enter THIS engine's radix tree (late dedup applies — a local
+        # twin already cached wins and the duplicate frees)
+        self._cache_full_blocks(slot)
+        return uid
 
     # -- scheduler -----------------------------------------------------
 
@@ -1582,13 +1806,21 @@ class DecodeEngine:
         self._step_finite = (flags if self._step_finite is None
                              else self._step_finite + flags)
 
-    def step(self) -> bool:
+    def step(self, prefill_only: bool = False) -> bool:
         """One scheduler iteration: expire deadlines, admit (with
         pool-pressure preemption when armed), at most ONE prefill chunk
         (so a long prompt never stalls running decodes for more than a
         chunk), then one decode dispatch over every ready slot. Returns
         whether any work ran. An armed chaos poison operand applies to
-        exactly this step's dispatches."""
+        exactly this step's dispatches.
+
+        ``prefill_only`` skips the decode dispatch — the fleet's
+        prefill tier (``decode/fleet.py``): a prompt that completes
+        emits its first pick from the prefill program and then PARKS
+        until the router ships it to a decode engine, so a
+        prefill-tier engine never compiles or dispatches a decode
+        program at all (the disaggregation dispatch proof, both
+        directions)."""
         # _step_events is NOT reset here: shed/rejected events from
         # between-step submissions (and a prior dispatch-free step)
         # belong to the next digest taken — resetting would drop them
@@ -1604,8 +1836,9 @@ class DecodeEngine:
         if pre is not None:
             self._prefill_step(pre)
             did = True
-        ready = [i for i, s in enumerate(self.slots)
-                 if s is not None and s.prompt_done]
+        ready = ([] if prefill_only else
+                 [i for i, s in enumerate(self.slots)
+                  if s is not None and s.prompt_done])
         if ready:
             # speculation on -> every decode dispatch is a verify
             # dispatch (one program kind per bucket; a zero-draft step

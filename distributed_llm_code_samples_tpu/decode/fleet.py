@@ -1,0 +1,600 @@
+"""Fleet-scale serving: a host-side router over N decode-engine
+replicas, with disaggregated prefill/decode and KV-handoff migration.
+
+One ``DecodeEngine`` is not "heavy traffic from millions of users":
+aggregate tokens/s scales only with what a single engine holds, and a
+long prefill still steals a step from every running decode on the same
+engine. This module is data parallelism one level up — the dp axis of
+the training meshes (SNIPPETS.md [3]'s dp x mp factorization) applied
+at the REQUEST level — plus the DistServe/Splitwise disaggregation
+argument: prefill is compute-bound and bursty, decode is memory-bound
+and steady, so co-locating them trades throughput for interference.
+
+The three moves, each riding machinery earlier rounds already built:
+
+- **Routing** (``FleetRouter.submit``): least-loaded admission over the
+  live per-engine state the schema-v5 telemetry already pins (queue
+  depth, occupancy, pool utilization), session affinity (a session's
+  requests stay on one engine), and **prefix affinity** — the router
+  probes every engine's radix tree (``PrefixCache.warm_blocks``; the
+  in-process form of a shadow index, with zero mirror drift) and sends
+  a sharer to the engine whose tree is warm, so PR 9's ~1-prefill
+  property holds FLEET-wide, not per-engine. A full target spills to
+  the next-best engine; all-full sheds at the door (the serving 503).
+
+- **Disaggregated prefill/decode** (``prefill_engines=M``): M dedicated
+  prefill engines run the chunked prefill; the moment a prompt
+  completes, the sequence ships to a decode engine via the
+  **single-sequence KV handoff** (``DecodeEngine.export_sequence`` /
+  ``import_sequence`` — PR 5's snapshot serialization generalized from
+  whole-engine metadata to one uid's written blocks + int8 scales +
+  position, restored under the foreign pool's block numbering). Decode
+  engines therefore execute ZERO prefill dispatches — a prompt burst
+  lands on the prefill tier and running decodes never stall behind it.
+
+- **Migration as the same primitive**: pool exhaustion moves the
+  youngest running sequence to a peer with capacity via the same
+  export/import (live, no replay); an engine KILL migrates its
+  in-flight requests to survivors from its last **snapshot**
+  (``supervise.snapshot_state`` — the in-memory form of PR 5's crash
+  document), where replay fills the gap since that snapshot and
+  continues token-identically. The sampling keys fold
+  ``(seed, uid, position)`` — never the slot OR the engine — so a
+  migrated sequence's remaining tokens match the un-migrated oracle
+  bit for bit at every kv_dtype.
+
+Every router decision emits one schema-v8 ``router`` record (routed /
+handoff / migrated / shed with source/target engine ids); ``report
+router eng0 eng1 ...`` folds them onto the merged timeline with a
+fleet-level latency/shed summary above the per-engine blocks.
+
+The router is deliberately HOST-side and in-process: engines are
+stepped round-robin (one fleet round steps every engine once), so on
+CPU the parallel-speedup claim is made as a dispatch/step-count proxy
+(aggregate tokens per fleet ROUND — what wall clock would show if the
+replicas ran on their own chips), never as fake wall-clock. Multi-host
+transport (the doc is one dict of numpy arrays — npz on a wire) is
+ROADMAP follow-up.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+
+from .engine import AdmissionError, DecodeEngine
+from .supervise import snapshot_state
+
+# engine-id prefixes: prefill tier "p", decode tier "e" (unified
+# engines are decode-tier — they can prefill too)
+DECODE_PREFIX = "e"
+PREFILL_PREFIX = "p"
+
+
+class EngineHandle:
+    """One fleet member: the engine, its role, and its liveness. A
+    killed handle drops its engine object outright — the in-process
+    simulation of a dead host — keeping only the last snapshot the
+    router migrates from."""
+
+    __slots__ = ("id", "engine", "role", "alive", "snapshot",
+                 "killed_at_round", "last_tokens", "last_t",
+                 "last_step_s")
+
+    def __init__(self, eid: str, engine: DecodeEngine, role: str):
+        self.id = eid
+        self.engine = engine
+        self.role = role                    # "prefill" | "decode"
+        self.alive = True
+        self.snapshot: dict | None = None   # last snapshot_state doc
+        self.killed_at_round: int | None = None
+        self.last_tokens = 0                # decode-record cadence state
+        self.last_t = time.perf_counter()
+        # wall time of THIS engine's slice of the last fleet round —
+        # the per-engine number the interference bench reads (the
+        # round-robin loop serializes engines in-process, so timing a
+        # whole round would charge every engine for its neighbors)
+        self.last_step_s = 0.0
+
+    @property
+    def has_work(self) -> bool:
+        return self.alive and bool(self.engine.waiting
+                                   or self.engine.active)
+
+
+class FleetRouter:
+    """N ``DecodeEngine`` replicas behind one admission point.
+
+    ``make_engine(engine_id)`` is a factory returning a FRESH
+    single-device engine per fleet member (attach a per-engine
+    ``TelemetryWriter`` inside it; the router never shares one). All
+    engines must share the numerics-relevant ``EngineConfig`` keys and
+    the model — the handoff's own fingerprint check enforces it at
+    migration time, and the router cross-checks fingerprints up front
+    so a mismatched fleet fails at construction, not mid-drill.
+
+    ``prefill_engines=M`` dedicates the first M members to prefill
+    (disaggregation); ``0`` runs every engine unified. ``n_engines``
+    may be 1 (the router degenerates to a pass-through — the honest
+    N=1 baseline for the bench scaling rows); the CLI requires >= 2.
+
+    ``snapshot_every`` is the in-memory snapshot cadence in fleet
+    rounds (the PR 5 discipline: a kill migrates from the LAST
+    snapshot and replay fills the gap since it).
+    """
+
+    def __init__(self, make_engine, n_engines: int,
+                 prefill_engines: int = 0, *, metrics=None,
+                 snapshot_every: int = 1, session_affinity: bool = True,
+                 prefix_affinity: bool = True):
+        if n_engines < 1:
+            raise ValueError(f"n_engines must be >= 1, got {n_engines}")
+        if not 0 <= prefill_engines < n_engines:
+            raise ValueError(
+                f"prefill_engines must leave >= 1 decode engine: got "
+                f"{prefill_engines} of {n_engines}")
+        if snapshot_every < 1:
+            raise ValueError(f"snapshot_every must be >= 1, got "
+                             f"{snapshot_every}")
+        self.handles: list[EngineHandle] = []
+        for i in range(prefill_engines):
+            eid = f"{PREFILL_PREFIX}{i}"
+            self.handles.append(EngineHandle(eid, make_engine(eid),
+                                             "prefill"))
+        for i in range(n_engines - prefill_engines):
+            eid = f"{DECODE_PREFIX}{i}"
+            self.handles.append(EngineHandle(eid, make_engine(eid),
+                                             "decode"))
+        metas = [h.engine.model_meta() for h in self.handles]
+        if any(m != metas[0] for m in metas[1:]):
+            raise ValueError("fleet engines disagree on model identity "
+                             f"({metas}) — every replica must serve the "
+                             "same weights")
+        for h in self.handles:
+            if h.engine.mesh is not None:
+                raise ValueError("fleet replicas are single-device "
+                                 "(KV handoff has no TP path)")
+        self.by_id = {h.id: h for h in self.handles}
+        self.metrics = metrics              # the ROUTER's own writer
+        self.snapshot_every = snapshot_every
+        self.session_affinity = session_affinity
+        self.prefix_affinity = prefix_affinity
+        self.rounds = 0                     # fleet scheduling rounds
+        self._next_uid = 0
+        self._sessions: dict = {}           # session -> engine id
+        # request book: what the router needs to place (and re-place)
+        # a request — NOT a mirror of engine progress (the snapshot is)
+        self.requests: dict[int, dict] = {}
+        self._kills: dict[int, list[str]] = collections.defaultdict(list)
+        # results carried off dead engines (their snapshot's finished/
+        # failed maps; survivors re-complete anything newer)
+        self._dead_finished: dict[int, list[int]] = {}
+        self._dead_failed: dict[int, dict] = {}
+        # decision counters (the payload/bench surface)
+        self.routed = 0
+        self.handoffs = 0
+        self.migrations = 0
+        self.sheds = 0
+        self.kills = 0
+        self.routed_by = {"least_loaded": 0, "session": 0, "prefix": 0}
+        self.prefix_routed_hit_blocks = 0
+
+    # -- introspection -------------------------------------------------
+
+    def alive_handles(self, role: str | None = None):
+        return [h for h in self.handles if h.alive
+                and (role is None or h.role == role)]
+
+    def engine(self, eid: str) -> DecodeEngine:
+        return self.by_id[eid].engine
+
+    # -- telemetry -----------------------------------------------------
+
+    def _record(self, event: str, uid: int, source=None, target=None,
+                reason=None, **extra) -> None:
+        if self.metrics is None:
+            return
+        self.metrics.router({"step": self.rounds, "uid": int(uid),
+                             "event": event, "source": source,
+                             "target": target, "reason": reason,
+                             **extra})
+
+    def _event(self, record: dict) -> None:
+        if self.metrics is not None:
+            self.metrics.event(record)
+
+    # -- routing -------------------------------------------------------
+
+    def _load_key(self, h: EngineHandle):
+        """Least-loaded ordering: queue depth first (waiting work is
+        the latency the next request inherits), then slot occupancy,
+        then pool pressure — engine id breaks ties deterministically."""
+        e = h.engine
+        return (len(e.waiting), e.active,
+                round(e.kv_pool_utilization(), 4), h.id)
+
+    def _has_capacity(self, h: EngineHandle, prompt_len: int,
+                      max_new: int) -> bool:
+        """Can ``h`` take a handoff IMPORT right now (free slot + full
+        block reservation)? Queue-based admission never needs this —
+        submit/resume queue and the engine admits when space frees."""
+        e = h.engine
+        if not any(s is None for s in e.slots):
+            return False
+        need = e._blocks_needed(prompt_len, max_new)
+        if need > e.cfg.max_blocks_per_seq:
+            return False
+        avail = len(e.free_blocks)
+        if e.prefix is not None:
+            avail += e.prefix.evictable_blocks()
+        return need <= avail
+
+    def _route(self, prompt, session):
+        """Pick the decode-tier engine for a fresh request. Precedence:
+        session affinity (stickiness beats balance — the session's KV
+        locality is on that engine), then prefix affinity (the engine
+        with the deepest warm radix path wins, load breaking ties),
+        then least-loaded."""
+        handles = self.alive_handles("decode")
+        if not handles:
+            raise RuntimeError("no alive decode engine in the fleet")
+        if self.session_affinity and session is not None:
+            eid = self._sessions.get(session)
+            if eid is not None and self.by_id[eid].alive:
+                return self.by_id[eid], "session", 0
+        if self.prefix_affinity:
+            warm = [(h.engine.prefix.warm_blocks(prompt), h)
+                    for h in handles if h.engine.prefix is not None]
+            best = max((w for w, _ in warm), default=0)
+            if best > 0:
+                tied = [h for w, h in warm if w == best]
+                return min(tied, key=self._load_key), "prefix", best
+        return min(handles, key=self._load_key), "least_loaded", 0
+
+    def submit(self, prompt, max_new: int, session=None) -> int:
+        """Route one request into the fleet; returns its fleet-global
+        uid. Disaggregated fleets admit through the least-loaded
+        PREFILL engine (the decode target is chosen at handoff time,
+        when the KV exists); unified fleets route by
+        session/prefix/load. A full target spills over to the next
+        engine by load; when every engine sheds, the request is shed
+        fleet-wide (``AdmissionError``, one ``shed`` router record)."""
+        # the uid is CONSUMED whether the request lands or sheds — a
+        # shed record must never carry a number a later accepted
+        # request reuses (the engine-side audit-trail discipline:
+        # aliasing two requests per uid breaks the per-uid timeline)
+        uid = self._next_uid
+        self._next_uid += 1
+        prompt = [int(t) for t in prompt]
+        reason, hit_blocks = None, 0
+        prefills = self.alive_handles("prefill")
+        if prefills:
+            order = sorted(prefills, key=self._load_key)
+            reason = "least_loaded"
+        else:
+            target, reason, hit_blocks = self._route(prompt, session)
+            others = sorted(
+                (h for h in self.alive_handles("decode")
+                 if h is not target), key=self._load_key)
+            order = [target] + others
+        shed_reasons = []
+        for h in order:
+            try:
+                h.engine.submit(prompt, max_new, uid=uid)
+            except AdmissionError as e:
+                shed_reasons.append(f"{h.id}: queue_full")
+                # spillover loses affinity — including the warm-block
+                # count probed for the ORIGINAL target (the next engine
+                # tried is cold; recording the stale count would credit
+                # it with blocks it doesn't hold)
+                reason, hit_blocks = "least_loaded", 0
+                continue
+            self.requests[uid] = {"prompt": prompt, "max_new": max_new,
+                                  "engine": h.id, "session": session}
+            if session is not None and h.role == "decode":
+                self._sessions[session] = h.id
+            self.routed += 1
+            self.routed_by[reason] = self.routed_by.get(reason, 0) + 1
+            if reason == "prefix":
+                self.prefix_routed_hit_blocks += hit_blocks
+            self._record("routed", uid, target=h.id, reason=reason,
+                         prefix_hit_blocks=hit_blocks)
+            # the step-0 snapshot discipline: a kill before the first
+            # cadence snapshot must still know this request exists.
+            # O(1) per submit: append the one new WAITING entry to the
+            # handle's existing snapshot instead of re-serializing the
+            # whole engine (a burst of n submissions must not pay
+            # O(n^2) host work on the admission path) — the cadence
+            # snapshot already lags by design, and kill-migration only
+            # needs the request LISTED (resume replays from `out`)
+            if h.snapshot is None:
+                h.snapshot = snapshot_state(h.engine)
+            else:
+                seq = next(s for s in reversed(h.engine.waiting)
+                           if s.uid == uid)
+                h.snapshot["requests"].append(
+                    {"uid": seq.uid, "prompt": seq.prompt,
+                     "out": seq.out, "max_new": seq.max_new,
+                     "retries": seq.retries, "t_submit": seq.t_submit,
+                     "submit_step": seq.submit_step,
+                     "state": "WAITING"})
+            return uid
+        self.sheds += 1
+        self._record("shed", uid, reason="queue_full")
+        raise AdmissionError(
+            f"every fleet engine shed request uid {uid}: "
+            f"[{'; '.join(shed_reasons)}]")
+
+    # -- the fleet round -----------------------------------------------
+
+    def step(self) -> bool:
+        """One fleet scheduling round: fire due kills (the chaos
+        clock), step every alive engine once, ship completed prefills
+        to the decode tier, relieve pool pressure by migration, then
+        refresh the in-memory snapshots on cadence. Returns whether any
+        engine ran work this round."""
+        killed = bool(self._kills.get(self.rounds))
+        for eid in self._kills.pop(self.rounds, ()):
+            self.kill_engine(eid)
+        did = killed
+        for h in self.handles:
+            if h.has_work:
+                t0 = time.perf_counter()
+                did = h.engine.step(prefill_only=(h.role == "prefill")) \
+                    or did
+                h.last_step_s = time.perf_counter() - t0
+        before = self.handoffs + self.migrations
+        self._handoff_completed_prefills()
+        self._migrate_pool_pressure()
+        did = did or (self.handoffs + self.migrations > before)
+        self.rounds += 1
+        if self.rounds % self.snapshot_every == 0:
+            for h in self.handles:
+                if h.alive:
+                    h.snapshot = snapshot_state(h.engine)
+        return did
+
+    def _placement_target(self, prompt_len: int, max_new: int,
+                          exclude=()) -> EngineHandle | None:
+        cands = [h for h in self.alive_handles("decode")
+                 if h.id not in exclude
+                 and self._has_capacity(h, prompt_len, max_new)]
+        return min(cands, key=self._load_key) if cands else None
+
+    def _handoff_completed_prefills(self) -> None:
+        """Ship every fully-prefilled sequence off the prefill tier.
+        No decode capacity right now -> the sequence PARKS (the
+        prefill tier steps with ``prefill_only=True``, so a parked
+        sequence makes no decode progress there) and the handoff is
+        retried next round; a burst larger than the decode tier's
+        total capacity surfaces as ``run()``'s fleet-stalled error
+        rather than silently decoding on the wrong tier — tier purity
+        is what the dispatch-count proof pins."""
+        for ph in self.alive_handles("prefill"):
+            ready = [s.uid for s in ph.engine.slots
+                     if s is not None and s.prompt_done]
+            for uid in ready:
+                req = self.requests[uid]
+                target = self._placement_target(len(req["prompt"]),
+                                                req["max_new"])
+                if target is None:
+                    continue
+                doc = ph.engine.export_sequence(uid)
+                target.engine.import_sequence(doc)
+                self.handoffs += 1
+                req["engine"] = target.id
+                if req["session"] is not None:
+                    self._sessions[req["session"]] = target.id
+                self._record("handoff", uid, source=ph.id,
+                             target=target.id, reason="prefill_done",
+                             position=doc["position"])
+                # refresh BOTH snapshots now: a kill before the next
+                # cadence snapshot must neither lose the moved request
+                # (target's snapshot predates it) nor resurrect it on
+                # the source (whose stale snapshot still lists it)
+                ph.snapshot = snapshot_state(ph.engine)
+                target.snapshot = snapshot_state(target.engine)
+
+    def _migrate_pool_pressure(self) -> None:
+        """A starved engine (head-of-line waiter has a free slot but
+        not its block reservation) moves its YOUNGEST fully-prefilled
+        running sequence to a peer with capacity — a LIVE handoff, no
+        replay. The same victim policy as the engine's own preemption
+        (the oldest resident keeps making progress), but the victim
+        keeps running instead of losing its KV."""
+        for h in self.alive_handles("decode"):
+            e = h.engine
+            if not e.waiting:
+                continue
+            head = e.waiting[0]
+            if not any(s is None for s in e.slots):
+                continue                    # slot-starved, not pool
+            need = e._blocks_needed(len(head.prompt), head.max_new)
+            avail = len(e.free_blocks)
+            if e.prefix is not None:
+                avail += e.prefix.evictable_blocks()
+            if need <= avail:
+                continue                    # admission will take it
+            victims = [(s.admit_index, s.uid, len(s.prompt), s.max_new)
+                       for s in e.slots
+                       if s is not None and s.prompt_done]
+            if not victims:
+                continue
+            _, uid, plen, mnew = max(victims)
+            target = self._placement_target(plen, mnew,
+                                            exclude=(h.id,))
+            if target is None:
+                continue
+            doc = e.export_sequence(uid)
+            target.engine.import_sequence(doc)
+            self.migrations += 1
+            self.requests[uid]["engine"] = target.id
+            self._record("migrated", uid, source=h.id,
+                         target=target.id, reason="pool_pressure",
+                         position=doc["position"])
+            # the handoff snapshot-refresh discipline (see above)
+            h.snapshot = snapshot_state(e)
+            target.snapshot = snapshot_state(target.engine)
+
+    # -- failure (the chaos drill's surface) ---------------------------
+
+    def schedule_kill(self, engine_id: str, at_round: int) -> None:
+        """Arm a deterministic engine kill at the START of fleet round
+        ``at_round`` (the round's snapshot cadence has NOT yet run —
+        the last snapshot honestly lags by up to ``snapshot_every``
+        rounds, and replay fills exactly that gap)."""
+        if engine_id not in self.by_id:
+            raise ValueError(f"unknown engine id {engine_id!r} "
+                             f"(fleet: {sorted(self.by_id)})")
+        if at_round < 0:
+            raise ValueError(f"kill round must be >= 0, got {at_round}")
+        self._kills[at_round].append(engine_id)
+
+    def kill_engine(self, engine_id: str) -> int:
+        """Kill one engine NOW and migrate its in-flight requests to
+        the survivors from its last snapshot: finished/failed results
+        ride over verbatim, every live request re-enters a survivor's
+        queue for replay-resume (``resume_request`` — prompt
+        re-prefilled, recorded tokens teacher-forced, so the rebuilt KV
+        write history and the remaining tokens are bit-identical to the
+        uninterrupted run's). Returns the number of migrated requests.
+        The engine object is dropped — its pool, like a dead host's
+        HBM, is unreachable."""
+        h = self.by_id.get(engine_id)
+        if h is None:
+            raise ValueError(f"unknown engine id {engine_id!r}")
+        if not h.alive:
+            return 0
+        snap = h.snapshot
+        h.alive = False
+        h.killed_at_round = self.rounds
+        h.engine = None
+        self.kills += 1
+        self._event({"event": "engine_killed", "engine": h.id,
+                     "round": self.rounds})
+        if snap is None:
+            return 0
+        self._dead_finished.update(
+            {int(u): list(t) for u, t in snap["finished"].items()})
+        self._dead_failed.update(
+            {int(u): dict(i) for u, i in snap["failed"].items()})
+        # a dead prefill engine's queue re-enters the prefill tier
+        # while one exists (tier purity survives the kill); decode
+        # requests always land on decode survivors
+        survivors = (self.alive_handles("prefill")
+                     if h.role == "prefill" else [])
+        survivors = survivors or self.alive_handles("decode")
+        if not survivors:
+            raise RuntimeError("last decode engine killed: the fleet "
+                               "has nowhere to migrate its requests")
+        moved = 0
+        for req in snap["requests"]:
+            target = min(survivors, key=self._load_key)
+            target.engine.resume_request(
+                req["uid"], req["prompt"], req["max_new"],
+                out=req["out"], retries=req["retries"],
+                t_submit=req.get("t_submit"))
+            self.requests[int(req["uid"])]["engine"] = target.id
+            self._record("migrated", req["uid"], source=h.id,
+                         target=target.id, reason="engine_killed",
+                         replay=len(req["out"]))
+            # a survivor dying right after must re-migrate this too
+            target.snapshot = snapshot_state(target.engine)
+            moved += 1
+        self.migrations += moved
+        return moved
+
+    # -- drain ---------------------------------------------------------
+
+    @property
+    def has_work(self) -> bool:
+        return any(h.has_work for h in self.handles)
+
+    def _pending_kills(self) -> bool:
+        return any(self.by_id[eid].alive for ids in self._kills.values()
+                   for eid in ids)
+
+    def run(self, log_every: int = 0) -> dict[int, list[int]]:
+        """Drain the fleet: round until every request finished or
+        failed (scheduled kills past the drain point are dropped — a
+        dead-on-arrival fault has nothing to kill). ``log_every``
+        emits one ``decode`` cadence record per engine through ITS OWN
+        writer every that-many rounds (the engines are stepped
+        manually, so the router owns the cadence ``DecodeEngine.run``
+        normally would)."""
+        while self.has_work:
+            did = self.step()
+            if log_every > 0 and self.rounds % log_every == 0:
+                self._emit_decode_records()
+            if not did and self.has_work and not self._pending_kills():
+                raise RuntimeError(
+                    "fleet stalled: waiting requests but no engine ran "
+                    "work and no kill is pending")
+        self._emit_decode_records()
+        return self.results()
+
+    def _emit_decode_records(self) -> None:
+        now = time.perf_counter()
+        for h in self.handles:
+            if not h.alive or h.engine.metrics is None:
+                continue
+            delta = h.engine.tokens_generated - h.last_tokens
+            dt = max(now - h.last_t, 1e-9)
+            tps = round(delta / dt, 2) if delta > 0 else None
+            h.engine.metrics.decode(h.engine.telemetry_record(tps))
+            h.last_tokens = h.engine.tokens_generated
+            h.last_t = now
+
+    def results(self) -> dict[int, list[int]]:
+        """Merged per-uid outcomes across the whole fleet, dead
+        engines' pre-kill completions included. A request completed on
+        a dead engine AFTER its last snapshot re-completes on a
+        survivor (replay is deterministic), so the merge can never see
+        two different answers for one uid."""
+        out = dict(self._dead_finished)
+        for h in self.handles:
+            if h.alive:
+                out.update(h.engine.finished)
+        return out
+
+    def failed(self) -> dict[int, dict]:
+        out = dict(self._dead_failed)
+        for h in self.handles:
+            if h.alive:
+                out.update(h.engine.failed)
+        return out
+
+    # -- the payload/bench surface -------------------------------------
+
+    def fleet_stats(self) -> dict:
+        """Fleet-level counters + per-engine summaries — the generate
+        CLI payload block and the bench rows' raw material."""
+        per_engine = {}
+        for h in self.handles:
+            if not h.alive:
+                per_engine[h.id] = {"alive": False,
+                                    "killed_at_round": h.killed_at_round}
+                continue
+            e = h.engine
+            per_engine[h.id] = {
+                "alive": True, "role": h.role,
+                "engine_steps": e.global_step,
+                "tokens_generated": e.tokens_generated,
+                "prefill_dispatches": e.prefill_dispatches,
+                "compiled_programs": e.compile_count,
+                "dispatches": e.dispatch_count,
+                "finished": len(e.finished),
+                "prefix_hit_blocks": e.prefix_hit_blocks,
+                "prefill_tokens_saved": e.prefill_tokens_saved,
+            }
+        return {
+            "engines": per_engine,
+            "rounds": self.rounds,
+            "routed": self.routed,
+            "routed_by": dict(self.routed_by),
+            "handoffs": self.handoffs,
+            "migrations": self.migrations,
+            "sheds": self.sheds,
+            "kills": self.kills,
+            "prefix_routed_hit_blocks": self.prefix_routed_hit_blocks,
+        }

@@ -151,6 +151,26 @@ def build_generate_parser() -> argparse.ArgumentParser:
                         "hung_step evidence in the attempt log")
     p.add_argument("--max_restarts", type=int, default=3,
                    help="in-process restart budget for the supervisor")
+    # fleet-scale serving (round 14, DESIGN.md section 20)
+    p.add_argument("--fleet", type=int, default=0,
+                   help="serve through a multi-engine router "
+                        "(decode/fleet.py): N single-device engine "
+                        "replicas behind least-loaded + session + "
+                        "prefix-affinity admission (N >= 2; 0 = the "
+                        "single-engine path, byte-identical to a run "
+                        "without fleet flags)")
+    p.add_argument("--prefill_engines", type=int, default=0,
+                   help="disaggregated prefill/decode: dedicate M of "
+                        "the --fleet engines to chunked prefill; "
+                        "finished prompts ship to the decode tier via "
+                        "the single-sequence KV handoff (requires "
+                        "--fleet, M < N)")
+    p.add_argument("--fleet_kill", default=None, metavar="ENGINE@ROUND",
+                   help="deterministic fleet chaos: kill engine id "
+                        "ENGINE (e.g. e1) at the start of fleet round "
+                        "ROUND; its in-flight requests migrate to the "
+                        "survivors and complete token-identically "
+                        "(requires --fleet)")
     # observability
     p.add_argument("--metrics_dir", default=None)
     p.add_argument("--log_every", type=int, default=4,
@@ -161,6 +181,95 @@ def build_generate_parser() -> argparse.ArgumentParser:
                         "multi-stream `report A B ...` merge keys "
                         "per-engine percentiles on it")
     return p
+
+
+def _fleet_main(args, prompts, cfg, policy, params, fleet_kill,
+                argv) -> int:
+    """The ``--fleet N`` run: N engine replicas behind the router
+    (``decode/fleet.py``), each with its own metrics stream under
+    ``--metrics_dir/<engine_id>`` plus a ``router`` stream for the
+    schema-v8 routing records — ``report m/router m/p0 m/e0 ...``
+    merges them onto one timeline. Prints the same one-line JSON
+    payload shape as the single-engine path, with a ``fleet`` block."""
+    import json as _json
+    import time as _time
+
+    import jax
+
+    from .engine import AdmissionError, DecodeEngine
+    from .fleet import FleetRouter
+
+    writers = []
+    router_metrics = None
+
+    def _writer(eid):
+        from ..runtime.telemetry import TelemetryWriter
+        w = TelemetryWriter(
+            os.path.join(args.metrics_dir, eid),
+            meta={"argv": list(argv or []), "subcommand": "generate",
+                  "engine_id": eid, "fleet": args.fleet,
+                  "prefill_engines": args.prefill_engines,
+                  "kv_dtype": args.kv_dtype,
+                  "n_prompts": len(prompts), "max_new": args.max_new,
+                  "device_kind": jax.devices()[0].device_kind})
+        writers.append(w)
+        return w
+
+    def make_engine(eid):
+        return DecodeEngine(params, args.heads, cfg, policy=policy,
+                            metrics=(_writer(eid) if args.metrics_dir
+                                     else None))
+
+    t0 = _time.perf_counter()
+    try:
+        if args.metrics_dir:
+            router_metrics = _writer("router")
+        router = FleetRouter(make_engine, args.fleet,
+                             args.prefill_engines,
+                             metrics=router_metrics)
+        if fleet_kill is not None:
+            router.schedule_kill(*fleet_kill)
+        shed = 0
+        for pr in prompts:
+            try:
+                router.submit(pr, args.max_new)
+            except AdmissionError:
+                shed += 1       # the router recorded the shed
+        router.run(log_every=args.log_every)
+    except (ValueError, RuntimeError) as e:
+        # RuntimeError covers the fleet's own liveness failures (last
+        # decode engine killed, fleet stalled) — a clean rc-2 error,
+        # not a traceback, with the buffered telemetry flushed
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    finally:
+        for w in writers:
+            w.close()
+    wall = _time.perf_counter() - t0
+
+    finished = router.results()
+    failed = router.failed()
+    sequences = [{"uid": u, "tokens": toks,
+                  "prompt_len": (len(router.requests[u]["prompt"])
+                                 if u in router.requests else None)}
+                 for u, toks in sorted(finished.items())]
+    new_tokens = sum(len(s["tokens"]) - (s["prompt_len"] or 0)
+                     for s in sequences)
+    stats = router.fleet_stats()
+    payload = {
+        "sequences": sequences,
+        "failed": {str(u): dict(info)
+                   for u, info in sorted(failed.items())},
+        "tokens_generated": new_tokens,
+        "wall_s": round(wall, 4),
+        "tokens_per_sec": round(new_tokens / wall, 2),
+        "kv_dtype": args.kv_dtype,
+        "fleet": stats,
+        "fleet_rounds": stats["rounds"],
+        "shed": shed,
+    }
+    print(_json.dumps(payload))
+    return 0
 
 
 def generate_main(argv=None) -> int:
@@ -242,6 +351,69 @@ def generate_main(argv=None) -> int:
               "budget: pass --snapshot_dir", file=sys.stderr)
         return 2
 
+    # fleet flags (round 14): reject cleanly up front — the train-CLI
+    # parse-rejection discipline. No --fleet means the single-engine
+    # code path below runs UNTOUCHED (byte-identical to a CLI without
+    # these flags).
+    if not args.fleet and (args.prefill_engines or args.fleet_kill):
+        print("error: --prefill_engines/--fleet_kill are fleet flags: "
+              "pass --fleet N (N >= 2)", file=sys.stderr)
+        return 2
+    fleet_kill = None
+    if args.fleet:
+        if args.fleet < 2:
+            print(f"error: --fleet needs >= 2 engines, got "
+                  f"{args.fleet} (a fleet of one is the default "
+                  "single-engine path — drop the flag)",
+                  file=sys.stderr)
+            return 2
+        if not 0 <= args.prefill_engines < args.fleet:
+            print(f"error: --prefill_engines must leave >= 1 decode "
+                  f"engine: got {args.prefill_engines} of "
+                  f"{args.fleet}", file=sys.stderr)
+            return 2
+        if args.tp > 1:
+            print("error: --fleet runs single-device replicas (the KV "
+                  "handoff has no TP path); drop --tp", file=sys.stderr)
+            return 2
+        if args.snapshot_dir or args.chaos or args.watchdog_ms:
+            print("error: --snapshot_dir/--chaos/--watchdog_ms drive "
+                  "the single-engine supervisor; the fleet owns "
+                  "failover in-process (fleet chaos: --fleet_kill "
+                  "ENGINE@ROUND)", file=sys.stderr)
+            return 2
+        if args.engine_id is not None:
+            # the fleet names its own streams (p0../e0../router);
+            # silently ignoring the flag would break a user scripting
+            # per-host labels — same discipline as the flags above
+            print("error: --engine_id names a single engine's stream; "
+                  "the fleet stamps its replicas p0../e0../router "
+                  "under --metrics_dir — drop the flag",
+                  file=sys.stderr)
+            return 2
+        if args.fleet_kill:
+            eng_id, sep, rnd = args.fleet_kill.partition("@")
+            try:
+                at_round = int(rnd)
+            except ValueError:
+                at_round = -1
+            if not eng_id or not sep or at_round < 0:
+                print(f"error: unparseable --fleet_kill "
+                      f"{args.fleet_kill!r} (want ENGINE@ROUND, e.g. "
+                      "e1@6)", file=sys.stderr)
+                return 2
+            if (args.fleet - args.prefill_engines == 1
+                    and eng_id == "e0"):
+                # knowable at parse time: killing the sole decode
+                # engine leaves the fleet nowhere to migrate
+                print("error: --fleet_kill e0 would kill the only "
+                      "decode engine in this fleet (the survivors "
+                      "have nowhere to migrate its requests) — add "
+                      "decode engines or kill a prefill engine",
+                      file=sys.stderr)
+                return 2
+            fleet_kill = (eng_id, at_round)
+
     longest = max(len(pr) for pr in prompts)
     mbps = args.max_blocks_per_seq or -(
         -min(args.max_seq_len, longest + args.max_new) // args.block_size)
@@ -292,6 +464,10 @@ def generate_main(argv=None) -> int:
                       f"the pool ({cfg.n_blocks} block(s) incl. "
                       "scratch)", file=sys.stderr)
                 return 2
+
+    if args.fleet:
+        return _fleet_main(args, prompts, cfg, policy, params,
+                           fleet_kill, argv)
 
     metrics = None
     engine_id = args.engine_id

@@ -302,6 +302,43 @@ def copy_block(pool: PagedKV, src, dst) -> PagedKV:
     return out
 
 
+def extract_blocks(pool: PagedKV, blocks) -> dict:
+    """Host-side copy of the named physical blocks' bytes — the export
+    half of the single-sequence KV handoff (``decode/fleet.py``):
+    ``k``/``v`` come back ``[L, n, H_kv, block, dh]`` numpy arrays AT
+    THE STORAGE DTYPE (int8 codes stay int8 — the import must not
+    round-trip through f32, or the bit-exactness contract dies at the
+    requantization boundary), ``k_scale``/``v_scale`` ``[L, n, H_kv]``
+    f32 (None unless int8). A plain eager gather + device->host
+    readback: export rides the host, never the compiled program set."""
+    import numpy as np
+    idx = np.asarray(blocks, np.int32)
+    out = {"k": np.asarray(pool.k[:, idx]),
+           "v": np.asarray(pool.v[:, idx]),
+           "k_scale": None, "v_scale": None}
+    if pool.k_scale is not None:
+        out["k_scale"] = np.asarray(pool.k_scale[:, idx])
+        out["v_scale"] = np.asarray(pool.v_scale[:, idx])
+    return out
+
+
+def implant_block(pool: PagedKV, dst, k_blk, v_blk,
+                  k_scale=None, v_scale=None) -> PagedKV:
+    """Write one imported block's bytes (values AND int8 scales) at
+    physical block ``dst`` across every layer — the import half of the
+    KV handoff. ``k_blk``/``v_blk`` are ``[L, H_kv, block, dh]`` in the
+    pool's storage dtype; ``dst`` may be a traced scalar, so ONE
+    compiled implant program (donated, like the step programs) serves
+    every destination block — importing never recompiles."""
+    dst = jnp.asarray(dst, jnp.int32)
+    out = pool._replace(k=pool.k.at[:, dst].set(k_blk),
+                        v=pool.v.at[:, dst].set(v_blk))
+    if pool.k_scale is not None:
+        out = out._replace(k_scale=pool.k_scale.at[:, dst].set(k_scale),
+                           v_scale=pool.v_scale.at[:, dst].set(v_scale))
+    return out
+
+
 def corrupt_block(pool: PagedKV, block: int) -> PagedKV:
     """Chaos injection (``corrupt_block@s:block``): poison one physical
     block the way a flipped HBM page would — NaN values for the float

@@ -144,6 +144,17 @@ class PrefixCache:
             node = child
         return out
 
+    def warm_blocks(self, prompt) -> int:
+        """How many leading full blocks of ``prompt`` this tree holds
+        right now — the fleet router's prefix-affinity score
+        (``decode/fleet.py``). Read-only (no lock, no LRU touch): the
+        router probes every engine's tree per admission, and a probe
+        must not perturb eviction order or pin anything. In-process the
+        router reads the live tree directly — this IS the shadow index,
+        with zero mirror drift; a multi-host deployment would mirror
+        inserts/evictions over the telemetry stream instead."""
+        return len(self.match(prompt))
+
     def lock(self, nodes, step: int) -> None:
         for n in nodes:
             n.refs += 1

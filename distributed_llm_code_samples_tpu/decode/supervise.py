@@ -46,8 +46,6 @@ import random
 import signal
 import time
 
-import jax.numpy as jnp
-
 from ..runtime.failure import _head, backoff_delay
 from .engine import AdmissionError, DecodeEngine, POISON_ALL
 
@@ -76,22 +74,11 @@ SNAPSHOT_VERSION = 4
 # ---------------------------------------------------------------- snapshot
 
 def _model_meta(engine: DecodeEngine) -> dict:
-    """Model identity the snapshot pins: resume replays recorded tokens
-    through the CURRENT weights, so resuming under a different model
-    would silently break the token-identical contract. Shapes catch a
-    changed architecture; the embedding-row fingerprint catches a
-    changed init seed at the same shape (rounded coarsely so the float
-    reduction order — which legitimately varies across TP layouts —
-    can't cause a false mismatch)."""
-    p = engine.params
-    return {
-        "vocab": int(p.vocab), "d_model": int(p.d_model),
-        "n_layers": int(p.n_layers),
-        "max_seq_len": int(p.max_seq_len),
-        "n_heads": int(engine.n_heads),
-        "kv_heads": int(engine.kv_heads),
-        "wte0_sum": round(float(jnp.sum(p.wte[0])), 2),
-    }
+    """Model identity the snapshot pins — shared with the KV handoff
+    (round 14): ``DecodeEngine.model_meta()`` is the one fingerprint
+    both resume-replay and cross-engine sequence import check, so the
+    two can never drift apart on what "the same model" means."""
+    return engine.model_meta()
 
 
 def snapshot_state(engine: DecodeEngine) -> dict:

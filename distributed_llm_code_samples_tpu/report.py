@@ -227,6 +227,9 @@ class _Stream:
         self.anomalies = by.get("anomaly", [])
         self.rollbacks = by.get("rollback", [])
         self.decodes = by.get("decode", [])
+        # schema-v8 fleet-router decision records (decode/fleet.py);
+        # the router process never resumes, so no replay dedup applies
+        self.routers = by.get("router", [])
         # request records: drop exact replays — an in-process
         # supervisor restart resumes from a snapshot that may PREDATE
         # records already emitted, so the replayed steps re-emit
@@ -494,6 +497,23 @@ class _Stream:
             if d.get("waiting"):
                 bits.append(f"{d['waiting']} waiting")
             timeline.append((d["t"], "decode", "  ".join(bits)))
+        for r in self.routers:
+            ev = r["event"]
+            arrow = ""
+            if r.get("source") is not None and r.get("target") is not None:
+                arrow = f" {r['source']} -> {r['target']}"
+            elif r.get("target") is not None:
+                arrow = f" -> {r['target']}"
+            elif r.get("source") is not None:
+                arrow = f" from {r['source']}"
+            bits = [f"request {r.get('uid')} {ev.upper()}{arrow}"
+                    + (f" ({r['reason']})" if r.get("reason") else "")
+                    + f" @ fleet round {r.get('step')}"]
+            if r.get("replay"):
+                bits.append(f"replay {r['replay']} token(s)")
+            if r.get("prefix_hit_blocks"):
+                bits.append(f"{r['prefix_hit_blocks']} warm block(s)")
+            timeline.append((r["t"], "router", "  ".join(bits)))
         for r in self.requests:
             ev = r["event"]
             bits = [f"request {r.get('uid')} {ev.upper()}"
@@ -778,6 +798,56 @@ def report_main(argv=None) -> int:
             timeline.append((t, src, what, s.label))
     timeline.sort(key=lambda x: x[0])
 
+    # ---- fleet summary (schema-v8 router records, decode/fleet.py) --
+    # the fleet-LEVEL read of the merged streams: routing decisions
+    # from any router stream + request outcomes from EVERY stream, so
+    # the latency percentiles describe what a caller of the fleet saw,
+    # not any one engine
+    router_recs = [r for s in streams for r in s.routers]
+    if router_recs:
+        by_ev: dict[str, int] = {}
+        for r in router_recs:
+            by_ev[r["event"]] = by_ev.get(r["event"], 0) + 1
+        mig_reasons: dict[str, int] = {}
+        for r in router_recs:
+            if r["event"] == "migrated":
+                key = r.get("reason") or "?"
+                mig_reasons[key] = mig_reasons.get(key, 0) + 1
+        # completions dedupe by uid across streams (a request completed
+        # on an engine after its last snapshot re-completes on a
+        # survivor when that engine dies — same tokens, two records;
+        # the caller saw the FIRST one), and the headline shed counts
+        # only CALLER-visible losses: the router's fleet-wide "shed"
+        # records plus deadline expiries — never per-engine "rejected"
+        # events, which a spillover leaves behind even when the request
+        # lands (and completes) on the next engine
+        comp_by_uid: dict = {}
+        for r in sorted((r for s in streams for r in s.requests
+                         if r["event"] == "completed"),
+                        key=lambda r: r.get("t", 0.0)):
+            comp_by_uid.setdefault(r["uid"], r)
+        completed = list(comp_by_uid.values())
+        expired_uids = {r["uid"] for s in streams for r in s.requests
+                        if r["event"] == "expired"}
+        fleet = {
+            "engines": len([s for s in streams if s.decodes]),
+            "routed": by_ev.get("routed", 0),
+            "handoffs": by_ev.get("handoff", 0),
+            "migrations": by_ev.get("migrated", 0),
+            "migrated_by_reason": mig_reasons,
+            "shed": by_ev.get("shed", 0) + len(expired_uids),
+            "shed_at_router": by_ev.get("shed", 0),
+            "completed": len(completed),
+        }
+        lat = [r["latency_s"] for r in completed
+               if r.get("latency_s") is not None]
+        if lat:
+            q = np.percentile(np.asarray(lat, np.float64), [50, 90, 99])
+            fleet["latency_p50_s"] = round(float(q[0]), 4)
+            fleet["latency_p90_s"] = round(float(q[1]), 4)
+            fleet["latency_p99_s"] = round(float(q[2]), 4)
+        doc["fleet"] = fleet
+
     if multi:
         doc["engines"] = per_engine
         doc["problems"] = [f"[{s.label}] {p}" for s in streams
@@ -832,6 +902,21 @@ def report_main(argv=None) -> int:
     else:
         out.append(f"RUN REPORT — {streams[0].path}")
     out.append("=" * 72)
+    if doc.get("fleet"):
+        # ABOVE the per-engine blocks: the caller-facing fleet view
+        fl = doc["fleet"]
+        out.append("")
+        out.append(f"fleet: {fl['routed']} routed, "
+                   f"{fl['handoffs']} prefill handoff(s), "
+                   f"{fl['migrations']} migration(s)"
+                   + (f" {fl['migrated_by_reason']}"
+                      if fl["migrated_by_reason"] else "")
+                   + f", {fl['shed']} shed, "
+                   f"{fl['completed']} completed")
+        if "latency_p50_s" in fl:
+            out.append(f"  fleet latency  p50 {fl['latency_p50_s']}s  "
+                       f"p90 {fl['latency_p90_s']}s  "
+                       f"p99 {fl['latency_p99_s']}s")
     if multi:
         for s in streams:
             sub = per_engine[s.label]

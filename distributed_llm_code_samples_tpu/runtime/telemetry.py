@@ -68,7 +68,12 @@ import numpy as np
 # ``shared_blocks`` (physical blocks named by >= 2 live tables) — the
 # measured form of the prefix cache's capacity/throughput claim
 # (decode/prefix.py, DESIGN.md section 19).
-SCHEMA_VERSION = 7
+# v8 (round 14): adds the "router" kind — one record per fleet-router
+# decision (routed / handoff / migrated / shed, decode/fleet.py) with
+# its own pinned required-key contract (ROUTER_REQUIRED); source and
+# target carry engine ids (null where the decision has none — a routed
+# request has no source engine, a shed request no target).
+SCHEMA_VERSION = 8
 
 METRICS_FILENAME = "metrics.jsonl"
 
@@ -176,6 +181,22 @@ SPAN_REQUIRED = ("step", "uid", "span", "start_step", "duration_s")
 SPAN_NAMES = ("queued", "prefill", "replay", "decode", "quarantine",
               "preempt_gap")
 
+# The router-record contract (``decode/fleet.py``): one record per
+# fleet-router decision. ``step`` is the ROUTER's step clock (fleet
+# scheduling rounds — each engine keeps its own engine-step clock),
+# ``uid`` the fleet-global request uid, ``event`` the decision
+# (routed / handoff / migrated / shed), ``source``/``target`` the
+# engine ids involved — null where the decision has none: a freshly
+# routed request has no source engine, a shed request no target.
+# ``reason`` rides as an extra key (least_loaded / session / prefix /
+# pool_pressure / engine_killed / queue_full). Same version-bump
+# discipline as STEP_KEYS.
+ROUTER_REQUIRED = ("step", "uid", "event", "source", "target")
+
+# The router decision vocabulary (decode/fleet.py emits these; report
+# renders any name, so a new decision kind is additive)
+ROUTER_EVENTS = ("routed", "handoff", "migrated", "shed")
+
 # Non-step record kinds the stream also carries: run headers ("meta"),
 # recovery/chaos/checkpoint events ("event"), bench measurement rows
 # ("bench" — bench.py's per-measurement plumbing rides the same
@@ -183,7 +204,7 @@ SPAN_NAMES = ("queued", "prefill", "replay", "decode", "quarantine",
 # serving engine's "decode" cadence + "request" lifecycle + "span"
 # per-request phase records.
 RECORD_KINDS = ("step", "meta", "event", "bench", "anomaly", "rollback",
-                "decode", "request", "span")
+                "decode", "request", "span", "router")
 
 # kind -> the pinned required-key set validate_record enforces (step
 # records additionally pin their FULL key set via STEP_KEYS)
@@ -194,6 +215,7 @@ REQUIRED_KEYS = {
     "decode": DECODE_REQUIRED,
     "request": REQUEST_REQUIRED,
     "span": SPAN_REQUIRED,
+    "router": ROUTER_REQUIRED,
 }
 
 # bf16 peak matmul FLOP/s by chip generation (public spec sheets; the
@@ -413,6 +435,18 @@ class TelemetryWriter:
         rec.setdefault("t", time.time())
         rec.setdefault("reason", None)
         rec["kind"] = "request"
+        self._put(rec)
+
+    def router(self, record: dict) -> None:
+        """Enqueue one fleet-router decision record: routed / handoff /
+        migrated / shed (``decode/fleet.py``; ``ROUTER_REQUIRED``
+        contract — source/target default to null so a caller only names
+        the engines the decision involves)."""
+        rec = dict(record)
+        rec.setdefault("t", time.time())
+        rec.setdefault("source", None)
+        rec.setdefault("target", None)
+        rec["kind"] = "router"
         self._put(rec)
 
     def span(self, record: dict) -> None:

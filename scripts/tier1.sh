@@ -363,5 +363,68 @@ rm -rf "$OBS_DIR"
 echo "OBSERVABILITY_SMOKE=OK"
 phase_done observability_smoke
 
+echo "=== fleet smoke ==="
+# The chaos drill a single engine cannot pass (DESIGN.md section 20):
+# 3 router-fronted engines, kill e1 at fleet round 4 mid-stream — every
+# in-flight request must complete TOKEN-IDENTICALLY to the unkilled
+# single-engine oracle (migration resumes them on the survivors), and
+# the merged `report router e0 e1 e2` must show the kill and the
+# migrations on one timeline with a fleet summary + schema-v8 router
+# records.
+FLEET_DIR=$(mktemp -d /tmp/tier1_fleet.XXXXXX)
+FLEET_ARGS="--prompt_lens 3,7,5 --max_new 8 -d 32 -l 2 --heads 4
+  --vocab 64 --max_seq_len 64 --block_size 8 --prefill_chunk 4
+  --log_every 2"
+if ! timeout -k 10 240 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli generate $FLEET_ARGS \
+    > "$FLEET_DIR/oracle.json"; then
+  echo "FLEET_SMOKE=FAIL (oracle)"; rm -rf "$FLEET_DIR"; exit 1
+fi
+if ! timeout -k 10 240 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli generate $FLEET_ARGS \
+    --fleet 3 --fleet_kill e1@4 --metrics_dir "$FLEET_DIR/m" \
+    > "$FLEET_DIR/fleet.json"; then
+  echo "FLEET_SMOKE=FAIL (fleet run)"; rm -rf "$FLEET_DIR"; exit 1
+fi
+if ! timeout -k 10 60 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli report "$FLEET_DIR/m/router" \
+    "$FLEET_DIR/m/e0" "$FLEET_DIR/m/e1" "$FLEET_DIR/m/e2" \
+    > "$FLEET_DIR/report.txt"; then
+  echo "FLEET_SMOKE=FAIL (merged report rc)"; rm -rf "$FLEET_DIR"
+  exit 1
+fi
+if ! timeout -k 10 60 env JAX_PLATFORMS=cpu python - "$FLEET_DIR" <<'EOF'
+import json, os, sys
+from distributed_llm_code_samples_tpu.runtime.telemetry import (
+    METRICS_FILENAME, read_metrics, validate_record)
+base = sys.argv[1]
+oracle = json.load(open(os.path.join(base, "oracle.json")))
+fleet = json.load(open(os.path.join(base, "fleet.json")))
+a = {s["uid"]: s["tokens"] for s in oracle["sequences"]}
+b = {s["uid"]: s["tokens"] for s in fleet["sequences"]}
+assert a == b, "fleet tokens != unkilled single-engine oracle"
+assert not fleet["failed"], fleet["failed"]
+st = fleet["fleet"]
+assert st["kills"] == 1 and st["migrations"] >= 1, st
+assert st["engines"]["e1"]["alive"] is False, st["engines"]["e1"]
+records, problems = read_metrics(
+    os.path.join(base, "m", "router", METRICS_FILENAME))
+assert not problems, problems
+routers = [r for r in records if r["kind"] == "router"]
+assert routers and all(validate_record(r)[0] for r in routers)
+assert any(r["event"] == "migrated" and r["source"] == "e1"
+           for r in routers), routers
+rep = open(os.path.join(base, "report.txt")).read()
+assert "fleet:" in rep and "migration" in rep, rep[:800]
+assert "engine_killed" in rep and "MIGRATED" in rep, rep[-2000:]
+EOF
+then
+  echo "FLEET_SMOKE=FAIL (token-identity/schema/report check)"
+  rm -rf "$FLEET_DIR"; exit 1
+fi
+rm -rf "$FLEET_DIR"
+echo "FLEET_SMOKE=OK"
+phase_done fleet_smoke
+
 echo "=== tier-1 pytest ==="
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); phase_done pytest; exit $rc

@@ -274,6 +274,27 @@ def test_bench_decode_contract():
         payload["engine_prefix_cache_prefill_dispatches_unshared"]
     assert payload["engine_prefix_cache_cow_copies"] == 0
     assert payload["engine_prefix_cache_capacity_gain"] > 1.0
+    # r14 fleet rows (decode/fleet.py; byte-identity across N and the
+    # >= 1.8x N=2 scaling are asserted INSIDE the bench — an error
+    # string here means a contract violation, not noise)
+    rel = payload["fleet_scaling_rel"]
+    assert rel["1"] == 1.0 and rel["2"] >= 1.8 and rel["3"] > rel["2"]
+    agg = payload["fleet_tokens_per_round"]
+    assert all(isinstance(agg[k], float) and agg[k] > 0
+               for k in ("1", "2", "3"))
+    inter = payload["fleet_prefill_interference"]
+    assert inter["colocated_p90_ms"] > 0
+    assert inter["disaggregated_p90_ms"] > 0
+    assert isinstance(inter["ratio"], float)
+    assert isinstance(payload["fleet_handoffs"], int)
+    assert payload["fleet_handoffs"] > 0
+    # cross-engine prefix affinity: sharers were routed BY prefix and
+    # the fleet paid measurably fewer prefill dispatches than the
+    # unshared fleet
+    assert payload["fleet_prefix_hit_rate"] > 0
+    assert payload["fleet_prefix_routed"] > 0
+    assert payload["fleet_prefix_prefill_dispatches"] < \
+        payload["fleet_prefix_prefill_dispatches_unshared"]
 
 
 @pytest.mark.slow

@@ -1,0 +1,545 @@
+"""Fleet-scale serving (decode/fleet.py, DESIGN.md section 20): the
+single-sequence KV handoff primitive in isolation, the multi-engine
+router's placement policies, disaggregated prefill/decode as a
+dispatch-count proof, and the kill-one-of-three chaos drill — every
+in-flight request completing byte-identically to an unkilled
+single-engine oracle at every kv_dtype.
+
+The identity proofs lean on the engine's own contract: sampling keys
+fold ``(seed, uid, position)`` and never the slot OR the engine, and a
+handed-off block's bytes are copied at the storage dtype (int8 codes
+and scales bit-exact), so migration can move a sequence anywhere in
+the fleet without moving a single token.
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_llm_code_samples_tpu.decode import (AdmissionError,
+                                                     DecodeEngine,
+                                                     EngineConfig,
+                                                     FleetRouter)
+from distributed_llm_code_samples_tpu.models import init_lm
+from distributed_llm_code_samples_tpu.runtime.telemetry import (
+    METRICS_FILENAME, TelemetryWriter, read_metrics, validate_record)
+
+V, D, L, H = 64, 32, 2, 4
+BASE = dict(block_size=8, n_blocks=33, max_slots=3, max_blocks_per_seq=6,
+            prefill_chunk=8)
+
+
+@pytest.fixture(scope="module")
+def lm_params():
+    return init_lm(jax.random.PRNGKey(0), V, D, L, max_seq_len=64)
+
+
+@pytest.fixture(scope="module")
+def prompts():
+    rng = np.random.default_rng(1)
+    return [rng.integers(0, V, size=n).tolist()
+            for n in (5, 9, 13, 6, 7, 11)]
+
+
+def _oracle(params, uids_prompts, max_new, **cfg_extra):
+    """Per-uid single-engine reference: one fresh 1-slot engine per
+    request, same uid (the sampling contract keys on uid, never on
+    slot/engine/admission order)."""
+    outs = {}
+    for uid, p in uids_prompts:
+        eng = DecodeEngine(params, H,
+                           EngineConfig(**{**BASE, "max_slots": 1},
+                                        **cfg_extra))
+        eng.submit(p, max_new, uid=uid)
+        outs[uid] = eng.run()[uid]
+    return outs
+
+
+def _mk(params, **cfg_extra):
+    return lambda eid: DecodeEngine(params, H, EngineConfig(**BASE,
+                                                            **cfg_extra))
+
+
+# ---------------------------------------------------------------------------
+# the KV handoff primitive, in isolation (no router in the loop)
+
+
+@pytest.mark.parametrize("kv_dtype", ["f32", "bf16", "int8"])
+def test_handoff_round_trip_token_identity(lm_params, prompts, kv_dtype):
+    """Export a mid-decode sequence from engine A, import into engine B
+    under DIFFERENT block numbering, drain B: the combined output is
+    byte-identical to the never-moved oracle at every storage dtype."""
+    cfg = EngineConfig(**BASE, kv_dtype=kv_dtype)
+    want = _oracle(lm_params, [(5, prompts[1])], 12,
+                   kv_dtype=kv_dtype)[5]
+    a = DecodeEngine(lm_params, H, cfg)
+    a.submit(prompts[1], 12, uid=5)
+    for _ in range(4):
+        a.step()
+    assert a.slots and any(s is not None and s.uid == 5 for s in a.slots)
+    doc = a.export_sequence(5)
+    # the source released the sequence: slot free, blocks back
+    assert all(s is None or s.uid != 5 for s in a.slots)
+    b = DecodeEngine(lm_params, H, cfg)
+    # occupy B's lowest blocks first so the import MUST renumber
+    b.submit(prompts[0], 4, uid=9)
+    b.step()
+    b.import_sequence(doc)
+    slot = next(i for i, s in enumerate(b.slots)
+                if s is not None and s.uid == 5)
+    new_blocks = list(b.slots[slot].blocks)[:doc["blocks_written"]]
+    assert new_blocks != doc["source_blocks"], \
+        "import did not renumber (the foreign-pool contract is vacuous)"
+    if kv_dtype == "int8":
+        # scales preserved bit-exactly under the new numbering
+        np.testing.assert_array_equal(
+            np.asarray(b.pool.k_scale[:, new_blocks]), doc["k_scale"])
+        np.testing.assert_array_equal(
+            np.asarray(b.pool.v_scale[:, new_blocks]), doc["v_scale"])
+        assert doc["k"].dtype == np.int8      # codes never via f32
+    done = b.run()
+    assert done[5] == want
+    # B also finished its own request untouched
+    assert done[9] is not None and len(done[9]) == len(prompts[0]) + 4
+
+
+def test_handoff_decrefs_source_share_graph(lm_params, prompts):
+    """Exporting a sharer DECREFS its shared prefix blocks on the
+    source (never scrubs — the survivor still reads them), and the
+    surviving sharer's output is untouched."""
+    cfg = EngineConfig(**BASE)
+    shared = prompts[2][:8] + prompts[3]          # 1 full shared block
+    p_a = shared[:8] + [1, 2, 3]
+    p_b = shared[:8] + [4, 5, 6]
+    want = _oracle(lm_params, [(0, p_a), (1, p_b)], 10)
+    eng = DecodeEngine(lm_params, H, cfg)
+    eng.submit(p_a, 10, uid=0)
+    eng.submit(p_b, 10, uid=1)
+    while not all(s is not None and s.prompt_done
+                  for s in eng.slots[:2]):
+        eng.step()
+    node = next(s.nodes[0] for s in eng.slots
+                if s is not None and s.uid == 0)
+    assert node is not None and node.refs == 2    # both sharers locked
+    doc = eng.export_sequence(1)
+    assert node.refs == 1, "export did not decref the share graph"
+    b = DecodeEngine(lm_params, H, cfg)
+    b.import_sequence(doc)
+    assert b.run()[1] == want[1]
+    assert eng.run()[0] == want[0]                # survivor untouched
+
+
+def test_handoff_fingerprint_and_config_rejection(lm_params, prompts):
+    """A different model init (same shapes) and a different numerics
+    config are both rejected at import — silently continuing under
+    either would break token identity, invisibly."""
+    a = DecodeEngine(lm_params, H, EngineConfig(**BASE))
+    a.submit(prompts[0], 8, uid=3)
+    for _ in range(3):
+        a.step()
+    doc = a.export_sequence(3)
+    other = init_lm(jax.random.PRNGKey(1), V, D, L, max_seq_len=64)
+    with pytest.raises(ValueError, match="model"):
+        DecodeEngine(other, H, EngineConfig(**BASE)).import_sequence(doc)
+    with pytest.raises(ValueError, match="config"):
+        DecodeEngine(lm_params, H, EngineConfig(
+            **BASE, kv_dtype="int8")).import_sequence(doc)
+    # pool-SIZE keys may differ: a smaller pool still imports
+    small = DecodeEngine(lm_params, H, EngineConfig(
+        **{**BASE, "n_blocks": 17, "max_slots": 1}))
+    small.import_sequence(doc)
+    assert small.run()[3] == _oracle(lm_params, [(3, prompts[0])], 8)[3]
+
+
+def test_handoff_rejects_mid_prefill_and_missing(lm_params, prompts):
+    eng = DecodeEngine(lm_params, H, EngineConfig(
+        **{**BASE, "prefill_chunk": 4}))
+    eng.submit(prompts[2], 8, uid=0)              # 13 tokens, chunk 4
+    eng.step()                                    # one chunk in
+    with pytest.raises(ValueError, match="mid-prefill"):
+        eng.export_sequence(0)
+    with pytest.raises(ValueError, match="not resident"):
+        eng.export_sequence(42)
+
+
+# ---------------------------------------------------------------------------
+# router placement
+
+
+def test_router_least_loaded_spreads_and_matches_oracle(lm_params,
+                                                        prompts):
+    want = _oracle(lm_params, list(enumerate(prompts)), 8)
+    fl = FleetRouter(_mk(lm_params), 2)
+    for p in prompts:
+        fl.submit(p, 8)
+    assert fl.run() == want
+    spread = sorted(len(h.engine.finished) for h in fl.handles)
+    assert spread == [3, 3], spread
+
+
+def test_router_session_affinity_pins_engine(lm_params, prompts):
+    fl = FleetRouter(_mk(lm_params), 3)
+    for p in prompts[:4]:
+        fl.submit(p, 6, session="alice")
+    eids = {fl.requests[u]["engine"] for u in range(4)}
+    assert len(eids) == 1, eids
+    assert fl.routed_by["session"] == 3           # first one routed by load
+    fl.run()
+
+
+def test_router_spillover_and_fleet_shed(lm_params, prompts):
+    """A full engine spills to the next by load; when EVERY engine
+    sheds, the request is shed fleet-wide with one router record."""
+    from distributed_llm_code_samples_tpu.decode import ServePolicy
+
+    def mk(eid):
+        return DecodeEngine(lm_params, H, EngineConfig(**BASE),
+                            policy=ServePolicy(queue_limit=1))
+    fl = FleetRouter(mk, 2, prefix_affinity=False)
+    fl.submit(prompts[0], 4, session="s")         # -> e0 (pins session)
+    # session points at e0, whose 1-deep queue is full: spill to e1
+    fl.submit(prompts[1], 4, session="s")
+    assert fl.requests[1]["engine"] == "e1"
+    # now BOTH queues are full: shed fleet-wide at the door
+    with pytest.raises(AdmissionError):
+        fl.submit(prompts[2], 4)
+    assert fl.sheds == 1
+    got = fl.run()
+    assert sorted(got) == [0, 1]
+    # the shed CONSUMED uid 2 — a later accepted request must never
+    # reuse a uid the audit trail already shows as shed (the engine's
+    # own rejected-uid discipline, at the router level)
+    uid = fl.submit(prompts[2], 4)
+    assert uid == 3
+    assert sorted(fl.run()) == [0, 1, 3]
+
+
+def test_cross_engine_prefix_affinity_dispatch_proof(lm_params):
+    """Acceptance: N sharers of one prompt routed across the fleet
+    still pay ~1 prefill over the shared prefix — the router's shadow
+    probe sends them to the engine whose radix tree is warm, so PR 9's
+    per-engine property becomes a fleet property."""
+    rng = np.random.default_rng(7)
+    pfx = rng.integers(0, V, size=16).tolist()    # 2 full shared blocks
+    sharers = [pfx + rng.integers(0, V, size=3).tolist()
+               for _ in range(4)]
+    want = _oracle(lm_params, list(enumerate(sharers)), 6)
+
+    def run(affinity, prefix_cache):
+        fl = FleetRouter(_mk(lm_params, prefix_cache=prefix_cache)
+                         if prefix_cache else
+                         (lambda eid: DecodeEngine(
+                             lm_params, H,
+                             EngineConfig(**BASE, prefix_cache=False))),
+                         2, prefix_affinity=affinity)
+        fl.submit(sharers[0], 6)                  # warm ONE tree
+        fl.run()
+        for p in sharers[1:]:
+            fl.submit(p, 6)
+        got = fl.run()
+        return fl, got
+
+    fl, got = run(True, True)
+    assert got == want
+    fl_off, got_off = run(False, False)
+    assert got_off == want
+    # every later sharer routed BY prefix, to one engine
+    assert fl.routed_by["prefix"] == 3
+    targets = {fl.requests[u]["engine"] for u in range(1, 4)}
+    assert targets == {fl.requests[0]["engine"]}
+    disp = sum(h.engine.prefill_dispatches for h in fl.handles)
+    disp_off = sum(h.engine.prefill_dispatches for h in fl_off.handles)
+    assert disp < disp_off, (disp, disp_off)
+    hits = sum(h.engine.prefix_hit_blocks for h in fl.handles)
+    assert hits == 3 * 2                          # 2 warm blocks each
+
+
+# ---------------------------------------------------------------------------
+# disaggregated prefill/decode
+
+
+def test_disaggregation_dispatch_proof(lm_params, prompts):
+    """With M=1 prefill engine: decode engines execute ZERO prefill
+    dispatches, the prefill engine executes ZERO decode dispatches
+    (it emits exactly the first pick per request, from the prefill
+    program), and the outputs match the oracle."""
+    want = _oracle(lm_params, list(enumerate(prompts)), 8)
+    fl = FleetRouter(_mk(lm_params), 3, prefill_engines=1)
+    for p in prompts:
+        fl.submit(p, 8)
+    assert fl.run() == want
+    assert fl.handoffs == len(prompts)
+    pf = fl.by_id["p0"].engine
+    assert pf.prefill_dispatches > 0
+    assert pf.tokens_generated == len(prompts)    # first picks only
+    assert all(("decode", b) not in pf._programs
+               for b in pf.slot_buckets), "prefill tier compiled decode"
+    for eid in ("e0", "e1"):
+        dec = fl.by_id[eid].engine
+        assert dec.prefill_dispatches == 0, \
+            f"{eid} ran prefill in disaggregated mode"
+        assert dec.tokens_generated > 0
+
+
+def test_disaggregation_max_new_one_finishes_on_prefill_tier(lm_params,
+                                                             prompts):
+    """max_new=1 completes at prefill: the sequence never ships, the
+    result still merges."""
+    fl = FleetRouter(_mk(lm_params), 2, prefill_engines=1)
+    fl.submit(prompts[0], 1)
+    got = fl.run()
+    assert got[0] == _oracle(lm_params, [(0, prompts[0])], 1)[0]
+    assert fl.handoffs == 0
+
+
+# ---------------------------------------------------------------------------
+# migration: pool pressure (live handoff) and engine kill (replay)
+
+
+def test_pool_pressure_migration_live(lm_params, prompts):
+    """A block-starved engine's youngest running sequence moves to a
+    peer WITH its KV (no replay: the target never prefills it), the
+    head of line admits, and every output matches the oracle."""
+    def mk(eid):
+        # e0: 5 usable blocks — two 2-block residents leave 1 free, so
+        # the 3-block head-of-line waiter starves WITH a free slot (the
+        # migration trigger, distinct from slot exhaustion)
+        nb = 6 if eid == "e0" else 33
+        return DecodeEngine(lm_params, H,
+                            EngineConfig(**{**BASE, "n_blocks": nb}))
+    want = _oracle(lm_params, list(enumerate(prompts[:4])), 8)
+    fl = FleetRouter(mk, 2)
+    for p in prompts[:4]:
+        fl.submit(p, 8, session="pin")            # all onto e0
+    got = fl.run()
+    assert got == want
+    assert fl.migrations >= 1
+    mig_uid = next(u for u, r in fl.requests.items()
+                   if r["engine"] == "e1")
+    # the migrated sequence decoded on e1 without a single prefill
+    # dispatch there beyond its own admissions (none were routed to it)
+    assert fl.by_id["e1"].engine.prefill_dispatches == 0
+    assert got[mig_uid] == want[mig_uid]
+
+
+@pytest.mark.parametrize("kv_dtype", ["f32", "bf16", "int8"])
+def test_kill_one_of_three_drill(lm_params, prompts, kv_dtype):
+    """THE fleet acceptance drill: 3 engines, kill one mid-stream —
+    every in-flight request completes byte-identically to the unkilled
+    single-engine oracle (replay fills the gap since the victim's last
+    snapshot), and the survivors compile NOTHING new after the first
+    migration wave."""
+    want = _oracle(lm_params, list(enumerate(prompts)), 8,
+                   kv_dtype=kv_dtype)
+    fl = FleetRouter(_mk(lm_params, kv_dtype=kv_dtype), 3,
+                     snapshot_every=2)            # a real replay gap
+    # warm the BOUNDED program set (every slot/chunk bucket) up front —
+    # the engine's compile surface is bucket-bounded by design, and a
+    # warm fleet is the steady state the acceptance criterion speaks
+    # about: from here, ANY compile_count motion is migration's cost
+    for h in fl.handles:
+        for b in h.engine.slot_buckets:
+            h.engine._program("decode", b)
+        for c in h.engine.chunk_buckets:
+            h.engine._program("prefill", c)
+    for p in prompts:
+        fl.submit(p, 8)
+    fl.schedule_kill("e1", 5)
+    # drive by hand so we can fence the first migration wave
+    while fl.has_work and fl.kills == 0:
+        fl.step()
+    assert fl.kills == 1 and fl.migrations >= 1
+    compiled = {h.id: h.engine.compile_count
+                for h in fl.handles if h.alive}
+    while fl.has_work:
+        fl.step()
+    got = fl.results()
+    assert got == want, {u: (got.get(u), want[u])
+                         for u in want if got.get(u) != want[u]}
+    for h in fl.handles:
+        if h.alive:
+            assert h.engine.compile_count == compiled[h.id], \
+                (h.id, "compiled new programs after the migration wave")
+    assert not fl.failed()
+
+
+def test_kill_before_any_snapshot_migrates_from_submit(lm_params,
+                                                       prompts):
+    """The step-0 snapshot discipline: a kill in round 0 — before any
+    cadence snapshot ran — still migrates every routed request (the
+    router snapshots at submit)."""
+    fl = FleetRouter(_mk(lm_params), 2, snapshot_every=50)
+    for p in prompts[:2]:
+        fl.submit(p, 6)
+    victim = fl.requests[0]["engine"]
+    fl.schedule_kill(victim, 0)
+    got = fl.run()
+    assert got == _oracle(lm_params, list(enumerate(prompts[:2])), 6)
+    assert fl.migrations >= 1
+
+
+def test_two_sequential_kills_still_complete(lm_params, prompts):
+    """Chained failures: a request migrated once can migrate again when
+    its new home dies too (the snapshot-refresh-on-migrate discipline),
+    still completing token-identically."""
+    want = _oracle(lm_params, list(enumerate(prompts[:4])), 6)
+    fl = FleetRouter(_mk(lm_params), 3)
+    for p in prompts[:4]:
+        fl.submit(p, 6)
+    fl.schedule_kill("e0", 3)
+    fl.schedule_kill("e2", 6)
+    got = fl.run()
+    assert got == want
+    assert fl.kills == 2 and not fl.failed()
+
+
+def test_kill_last_decode_engine_raises(lm_params, prompts):
+    fl = FleetRouter(_mk(lm_params), 2)
+    fl.submit(prompts[0], 6)
+    fl.kill_engine("e0")
+    with pytest.raises(RuntimeError, match="last decode engine"):
+        fl.kill_engine("e1")
+
+
+def test_fleet_construction_validation(lm_params):
+    with pytest.raises(ValueError, match="decode engine"):
+        FleetRouter(_mk(lm_params), 2, prefill_engines=2)
+    with pytest.raises(ValueError, match="n_engines"):
+        FleetRouter(_mk(lm_params), 0)
+    other = init_lm(jax.random.PRNGKey(1), V, D, L, max_seq_len=64)
+    seen = []
+
+    def mixed(eid):
+        p = lm_params if not seen else other
+        seen.append(eid)
+        return DecodeEngine(p, H, EngineConfig(**BASE))
+    with pytest.raises(ValueError, match="model identity"):
+        FleetRouter(mixed, 2)
+    fl = FleetRouter(_mk(lm_params), 2)
+    with pytest.raises(ValueError, match="unknown engine id"):
+        fl.schedule_kill("e9", 3)
+
+
+# ---------------------------------------------------------------------------
+# telemetry + report
+
+
+def test_fleet_router_records_schema_valid(lm_params, prompts,
+                                           tmp_path):
+    """Every router decision lands as a schema-v8 ``router`` record
+    with source/target engine ids; the merged report folds them into a
+    fleet summary above the per-engine blocks and onto one timeline."""
+    dirs = {}
+
+    def mk(eid):
+        dirs[eid] = str(tmp_path / eid)
+        return DecodeEngine(lm_params, H, EngineConfig(**BASE),
+                            metrics=TelemetryWriter(dirs[eid],
+                                                    meta={"engine_id":
+                                                          eid}))
+    router_dir = str(tmp_path / "router")
+    rm = TelemetryWriter(router_dir, meta={"engine_id": "router"})
+    fl = FleetRouter(mk, 3, metrics=rm)
+    for p in prompts:
+        fl.submit(p, 6)
+    fl.schedule_kill("e2", 4)
+    fl.run(log_every=2)
+    rm.close()
+    for h in fl.handles:
+        if h.alive:
+            h.engine.metrics.close()
+    records, problems = read_metrics(os.path.join(router_dir,
+                                                  METRICS_FILENAME))
+    assert not problems, problems
+    routers = [r for r in records if r["kind"] == "router"]
+    assert routers
+    for r in routers:
+        ok, reason = validate_record(r)
+        assert ok, reason
+    events = {r["event"] for r in routers}
+    assert "routed" in events and "migrated" in events
+    mig = [r for r in routers if r["event"] == "migrated"]
+    assert all(r["source"] == "e2" and r["target"] in ("e0", "e1")
+               for r in mig)
+
+    from distributed_llm_code_samples_tpu.report import report_main
+    import io
+    from contextlib import redirect_stdout
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = report_main([router_dir, dirs["e0"], dirs["e1"],
+                          dirs["e2"], "--json"])
+    assert rc == 0
+    doc = json.loads(buf.getvalue())
+    fleet = doc["fleet"]
+    assert fleet["routed"] == len(prompts)
+    assert fleet["migrations"] == len(mig)
+    assert fleet["completed"] == len(prompts)
+    assert "latency_p50_s" in fleet
+    assert fleet["migrated_by_reason"] == {"engine_killed": len(mig)}
+    # router rows ride the merged timeline with everyone else's
+    kinds = {t["source"] for t in doc["timeline"]}
+    assert "router" in kinds and "request" in kinds
+    ts = [t["t"] for t in doc["timeline"]]
+    assert ts == sorted(ts)
+
+
+# ---------------------------------------------------------------------------
+# CLI surface (parse rejections in-process: rc 2 before any engine)
+
+
+def _gen(argv):
+    from distributed_llm_code_samples_tpu.decode.generate_cli import \
+        generate_main
+    return generate_main(argv)
+
+
+BASE_ARGS = ["--prompt_lens", "3,7", "--max_new", "4", "-d", "32",
+             "-l", "2", "--heads", "4", "--vocab", "64",
+             "--max_seq_len", "64", "--block_size", "8",
+             "--prefill_chunk", "4"]
+
+
+@pytest.mark.parametrize("extra", [
+    ["--fleet", "1"],
+    ["--fleet", "-2"],
+    ["--prefill_engines", "1"],
+    ["--fleet_kill", "e1@4"],
+    ["--fleet", "2", "--prefill_engines", "2"],
+    ["--fleet", "2", "--prefill_engines", "-1"],
+    ["--fleet", "2", "--fleet_kill", "e1"],
+    ["--fleet", "2", "--fleet_kill", "@4"],
+    ["--fleet", "2", "--fleet_kill", "e1@x"],
+    ["--fleet", "2", "--fleet_kill", "e1@-3"],
+    ["--fleet", "2", "--tp", "2"],
+    ["--fleet", "2", "--snapshot_dir", "/tmp/nope"],
+    ["--fleet", "2", "--fleet_kill", "e9@2"],
+    # killing the SOLE decode engine is knowable at parse time: the
+    # fleet would have nowhere to migrate its requests
+    ["--fleet", "2", "--prefill_engines", "1", "--fleet_kill", "e0@1"],
+    # the fleet names its own streams — --engine_id would be silently
+    # ignored, so it rejects like the other single-engine-only flags
+    ["--fleet", "2", "--engine_id", "myhost"],
+])
+def test_cli_fleet_flag_rejections(extra):
+    assert _gen(BASE_ARGS + extra) == 2
+
+
+def test_cli_fleet_end_to_end_matches_single_engine(capsys):
+    """`--fleet 2` emits the same tokens per uid as the flag-free
+    single-engine CLI (the byte-identical-path satellite, proven at
+    the output level: the single-engine code path itself is untouched
+    by construction — the fleet branch returns before it)."""
+    assert _gen(BASE_ARGS) == 0
+    single = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert _gen(BASE_ARGS + ["--fleet", "2", "--prefill_engines", "1"]) \
+        == 0
+    fleet = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    a = {s["uid"]: s["tokens"] for s in single["sequences"]}
+    b = {s["uid"]: s["tokens"] for s in fleet["sequences"]}
+    assert a == b
+    assert fleet["fleet"]["handoffs"] == 2
+    assert fleet["fleet"]["engines"]["p0"]["role"] == "prefill"

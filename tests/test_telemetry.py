@@ -45,8 +45,10 @@ from distributed_llm_code_samples_tpu.runtime.telemetry import (
 # decode/engine.py verify dispatches). v7 (round 13): the decode
 # contract's shared-prefix set (prefix_hit_blocks /
 # prefill_tokens_saved / shared_blocks / cow_copies — the radix
-# prefix cache, decode/prefix.py).
-_PINNED_VERSION = 7
+# prefix cache, decode/prefix.py). v8 (round 14): the "router" kind
+# (one record per fleet-router decision: routed/handoff/migrated/shed
+# with source/target engine ids — decode/fleet.py).
+_PINNED_VERSION = 8
 _PINNED_STEP_KEYS = frozenset({
     "schema", "kind", "t", "step", "strategy", "loss", "grad_norm",
     "tokens_per_sec", "step_time_s", "mfu", "hbm_high_water_bytes",
@@ -65,31 +67,36 @@ _PINNED_REQUEST_REQUIRED = frozenset({"step", "uid", "event", "reason"})
 _PINNED_SPAN_REQUIRED = frozenset({
     "step", "uid", "span", "start_step", "duration_s",
 })
+_PINNED_ROUTER_REQUIRED = frozenset({
+    "step", "uid", "event", "source", "target",
+})
 
 
 def test_schema_version_bump_discipline():
     from distributed_llm_code_samples_tpu.runtime.telemetry import (
         ANOMALY_REQUIRED, DECODE_REQUIRED, RECORD_KINDS,
         REQUEST_REQUIRED, REQUIRED_KEYS, ROLLBACK_REQUIRED,
-        SPAN_REQUIRED)
+        ROUTER_REQUIRED, SPAN_REQUIRED)
     assert SCHEMA_VERSION == _PINNED_VERSION and \
         frozenset(STEP_KEYS) == _PINNED_STEP_KEYS and \
         frozenset(ANOMALY_REQUIRED) == _PINNED_ANOMALY_REQUIRED and \
         frozenset(ROLLBACK_REQUIRED) == _PINNED_ROLLBACK_REQUIRED and \
         frozenset(DECODE_REQUIRED) == _PINNED_DECODE_REQUIRED and \
         frozenset(REQUEST_REQUIRED) == _PINNED_REQUEST_REQUIRED and \
-        frozenset(SPAN_REQUIRED) == _PINNED_SPAN_REQUIRED, (
+        frozenset(SPAN_REQUIRED) == _PINNED_SPAN_REQUIRED and \
+        frozenset(ROUTER_REQUIRED) == _PINNED_ROUTER_REQUIRED, (
             "telemetry record schema changed: bump SCHEMA_VERSION "
             "and update the pinned sets here in the same commit")
     assert "anomaly" in RECORD_KINDS and "rollback" in RECORD_KINDS
     assert "request" in RECORD_KINDS
     assert "decode" in RECORD_KINDS
     assert "span" in RECORD_KINDS
+    assert "router" in RECORD_KINDS
     # every contract-carrying kind routes through the one table
     # validate_record reads (a new kind that skips it validates
     # envelope-only silently — this catches the drift)
     for kind in ("step", "anomaly", "rollback", "decode", "request",
-                 "span"):
+                 "span", "router"):
         assert kind in REQUIRED_KEYS, kind
 
 
@@ -204,6 +211,7 @@ def test_span_record_round_trip_and_torn_tail(tmp_path):
     ("decode", _PINNED_DECODE_REQUIRED),
     ("request", _PINNED_REQUEST_REQUIRED),
     ("span", _PINNED_SPAN_REQUIRED),
+    ("router", _PINNED_ROUTER_REQUIRED),
 ])
 def test_validate_record_names_kind_and_key(kind, required):
     """Satellite contract: every validate_record failure is ONE line
@@ -222,6 +230,31 @@ def test_validate_record_names_kind_and_key(kind, required):
     ok, reason = validate_record({"schema": SCHEMA_VERSION + 1,
                                   "kind": kind, "t": 0.0})
     assert not ok and f"{kind} record" in reason and "schema" in reason
+
+
+def test_router_record_round_trip(tmp_path):
+    """A fleet-router decision record written through the writer parses
+    back schema-valid with the v8 contract keys; source/target default
+    to null for decisions that have none (a routed request has no
+    source engine)."""
+    w = TelemetryWriter(str(tmp_path))
+    w.router({"step": 2, "uid": 7, "event": "migrated", "source": "e1",
+              "target": "e0", "reason": "engine_killed"})
+    w.router({"step": 0, "uid": 3, "event": "routed", "target": "e2",
+              "reason": "prefix", "prefix_hit_blocks": 2})
+    w.close()
+    records, problems = read_metrics(os.path.join(str(tmp_path),
+                                                  METRICS_FILENAME))
+    assert problems == []
+    mig, routed = records
+    assert mig["kind"] == "router" and mig["schema"] == SCHEMA_VERSION
+    assert mig["source"] == "e1" and mig["target"] == "e0"
+    assert mig["reason"] == "engine_killed"
+    assert routed["source"] is None and routed["target"] == "e2"
+    assert routed["prefix_hit_blocks"] == 2
+    for r in records:
+        ok, reason = validate_record(r)
+        assert ok, reason
 
 
 def test_read_metrics_survives_torn_tail(tmp_path):
