@@ -570,6 +570,28 @@ def main() -> int:
             "disaggregation win; host-dominated smoke shapes mute it)")
         paths["fleet_handoffs"] = fl_dis.handoffs
 
+        # KV-handoff transport rows (round 15, ROADMAP item 1's bench
+        # criterion down payment): every live move in the disaggregated
+        # lane was timed around export_sequence -> import_sequence, so
+        # the router's accumulators price the handoff path itself —
+        # blocks shipped per second, wire bytes (values + int8 scales
+        # at the storage dtype), and the migration-stall p90 by the
+        # CPU wall-clock proxy (a real wire transport adds
+        # serialize+ship on top; these rows are the in-process floor
+        # it is measured against).
+        durs = np.asarray(fl_dis.handoff_durations, np.float64)
+        paths["fleet_handoff_blocks_per_sec"] = round(
+            fl_dis.handoff_blocks / max(float(durs.sum()), 1e-9), 1)
+        paths["fleet_handoff_bytes"] = int(fl_dis.handoff_bytes)
+        paths["fleet_handoff_stall_p90_ms"] = round(
+            float(np.percentile(durs, 90)) * 1e3, 3)
+        paths["fleet_handoff_note"] = (
+            f"{len(durs)} live move(s) (prefill handoffs + pool-"
+            "pressure migrations) timed around export/import in the "
+            "disaggregated lane: blocks/s and stall p90 are the "
+            "in-process transport floor the ROADMAP item 1 wire "
+            "transport is measured against")
+
         # Cross-engine prefix affinity: 2*slots sharers of one system
         # prompt through a 2-replica fleet. The router probes every
         # engine's radix tree and sends sharers where the prefix is

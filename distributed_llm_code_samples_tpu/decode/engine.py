@@ -174,8 +174,11 @@ REQUEST_EVENTS = ("admitted", "preempted", "retried", "quarantined",
 # the single-sequence KV handoff wire format (export_sequence /
 # import_sequence): one uid's written blocks + int8 scales + position +
 # scheduler state, restored into a FOREIGN pool under that pool's block
-# numbering. v1 (round 14, DESIGN.md section 20).
-HANDOFF_VERSION = 1
+# numbering. v1 (round 14, DESIGN.md section 20). v2 (round 15): the
+# document carries ``t_first`` — the sequence's first-token timestamp —
+# so a migrated request's completed record still reports its true
+# ``ttft_s`` (schema v9, DESIGN.md section 21).
+HANDOFF_VERSION = 2
 
 # EngineConfig keys two engines may legitimately disagree on and still
 # exchange sequences: pool SIZE is an engine-local capacity choice.
@@ -908,6 +911,10 @@ class DecodeEngine:
             "t_submit": float(seq.t_submit),
             "position": pos,
             "next_token": int(self.next_token[slot]),
+            # the first-token mark travels with the sequence (handoff
+            # v2) so the importing engine's completed record reports
+            # the TRUE ttft_s, not a restarted clock
+            "t_first": self.tracer.pop_first_token(seq.uid),
             "blocks_written": nb_written,
             "source_blocks": phys,     # the renumbering certificate
             **extract_blocks(self.pool, phys),
@@ -1022,7 +1029,12 @@ class DecodeEngine:
         self._event("admitted", uid, reason="handoff",
                     position=int(doc["position"]), replay=0)
         # the span clock restarts at import (the resume stance: the
-        # in-transit gap is visibly unaccounted rather than invented)
+        # in-transit gap is visibly unaccounted rather than invented —
+        # report --slo attributes it to `migration` via the router's
+        # handoff record), but the first-token mark RIDES the document:
+        # the first token really happened then, on the source
+        if doc.get("t_first") is not None:
+            self.tracer.mark_first_token(uid, float(doc["t_first"]))
         self.tracer.open(uid, "replay" if seq.replaying else "decode",
                          self.global_step)
         # cross-engine prefix reuse: the imported full prompt blocks
@@ -1104,7 +1116,7 @@ class DecodeEngine:
 
     def resume_request(self, uid: int, prompt, max_new: int, out=(),
                        retries: int = 0, t_submit=None,
-                       submit_step=None) -> int:
+                       submit_step=None, t_first=None) -> int:
         """Re-enter a request from an engine snapshot
         (``decode/supervise.py``): queued for replay-resume — prompt
         re-prefilled, recorded ``out`` tokens teacher-forced, then live
@@ -1126,6 +1138,12 @@ class DecodeEngine:
                                 else int(submit_step)))
         if t_submit is not None:
             seq.t_submit = float(t_submit)
+        if t_first is not None:
+            # the snapshot persisted the first-token mark (v5): the
+            # first token really happened then, so the resumed
+            # request's completed record keeps its true ttft_s (the
+            # crash GAP still shows as unaccounted span time)
+            self.tracer.mark_first_token(seq.uid, float(t_first))
         self._next_uid = max(self._next_uid, int(uid)) + 1
         self.prompt_lens[seq.uid] = len(prompt)
         self.waiting.append(seq)
@@ -1438,10 +1456,15 @@ class DecodeEngine:
         self.finished[seq.uid] = seq.prompt + seq.out
         # ONE completion timestamp feeds both the latency record and
         # the final span close — that identity is the reconciliation
-        # the report waterfall asserts
+        # the report waterfall asserts. ttft_s decomposes the latency
+        # at the first-token mark (schema v9); null when the first
+        # token predates a crash-resume that lost the mark.
         now = time.time()
+        t_first = self.tracer.pop_first_token(seq.uid)
         self._event("completed", seq.uid,
                     latency_s=round(now - seq.t_submit, 4),
+                    ttft_s=(None if t_first is None
+                            else round(t_first - seq.t_submit, 4)),
                     n_new=len(seq.out), retries=seq.retries)
         self.tracer.close(seq.uid, self.global_step, t=now,
                           n_new=len(seq.out),
@@ -1533,6 +1556,7 @@ class DecodeEngine:
         self._event("quarantined", seq.uid, reason=reason,
                     retrying=False, retries=seq.retries)
         self.tracer.close(seq.uid, self.global_step, reason=reason)
+        self.tracer.pop_first_token(seq.uid)    # terminal: forget
         self.failed[seq.uid] = {"reason": reason, "retries": seq.retries,
                                 "n_out": len(seq.out)}
 
@@ -1555,6 +1579,7 @@ class DecodeEngine:
             self.tracer.close(seq.uid, self.global_step,
                               reason="deadline",
                               tokens=self._span_tokens.pop(seq.uid, 0))
+            self.tracer.pop_first_token(seq.uid)    # terminal: forget
             self.failed[seq.uid] = {"reason": "deadline",
                                     "retries": seq.retries,
                                     "n_out": len(seq.out)}
@@ -1662,10 +1687,21 @@ class DecodeEngine:
             self.lengths[slot] = len(seq.prompt)
             # the chunk that completes the prompt hands the span clock
             # to the next phase BEFORE the emit below may release the
-            # sequence outright (max_new == 1)
+            # sequence outright (max_new == 1). ONE timestamp serves
+            # the span boundary AND the first-token mark: the emit
+            # below appends the first live token at exactly this
+            # instant, which is what makes ttft_s reconcile with the
+            # pre-first-token span sum (runtime/tracing.py). A
+            # replaying sequence already emitted its first token in a
+            # previous life — the mark is idempotent and replay never
+            # re-marks here (its recorded first token is forced, not
+            # picked).
+            now = time.time()
+            if not seq.replaying:
+                self.tracer.mark_first_token(seq.uid, now)
             self.tracer.transition(
                 seq.uid, "replay" if seq.replaying else "decode",
-                self.global_step, tokens=c)
+                self.global_step, t=now, tokens=c)
             self._emit(slot, int(nxt))
         else:
             # one span per prefill chunk, telescoping across the engine

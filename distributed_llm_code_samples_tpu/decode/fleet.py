@@ -43,10 +43,17 @@ The three moves, each riding machinery earlier rounds already built:
   migrated sequence's remaining tokens match the un-migrated oracle
   bit for bit at every kv_dtype.
 
-Every router decision emits one schema-v8 ``router`` record (routed /
-handoff / migrated / shed with source/target engine ids); ``report
-router eng0 eng1 ...`` folds them onto the merged timeline with a
-fleet-level latency/shed summary above the per-engine blocks.
+Every router decision emits one schema-v9 ``router`` record (routed /
+handoff / migrated / shed with source/target engine ids, the pinned
+``policy`` that placed it, the candidate scores the decision saw, and
+— on live moves — ``blocks``/``bytes``/``duration_s`` measured around
+export/import, the migration-stall instrumentation); each scheduling
+round additionally emits one ``fleet`` health record (per-engine
+waiting/active/free-blocks/utilization + a load-imbalance scalar).
+``report router eng0 eng1 ...`` folds them onto the merged timeline
+with a fleet-level latency/shed summary above the per-engine blocks,
+and ``report --slo TTFT:ITL`` turns the merged streams into goodput
+numbers (DESIGN.md section 21).
 
 The router is deliberately HOST-side and in-process: engines are
 stepped round-robin (one fleet round steps every engine once), so on
@@ -178,6 +185,16 @@ class FleetRouter:
         self.kills = 0
         self.routed_by = {"least_loaded": 0, "session": 0, "prefix": 0}
         self.prefix_routed_hit_blocks = 0
+        # migration-stall instrumentation (round 15, ROADMAP item 1's
+        # bench criterion): every LIVE move (export_sequence ->
+        # import_sequence — prefill handoff or pool-pressure migration)
+        # accumulates the blocks/bytes shipped and its wall-clock
+        # duration; replay-migrations off a dead engine's snapshot ship
+        # no KV and stay out of these (their own records carry a
+        # duration_s with blocks/bytes 0)
+        self.handoff_blocks = 0
+        self.handoff_bytes = 0
+        self.handoff_durations: list[float] = []
 
     # -- introspection -------------------------------------------------
 
@@ -191,17 +208,67 @@ class FleetRouter:
     # -- telemetry -----------------------------------------------------
 
     def _record(self, event: str, uid: int, source=None, target=None,
-                reason=None, **extra) -> None:
+                reason=None, policy=None, **extra) -> None:
         if self.metrics is None:
             return
         self.metrics.router({"step": self.rounds, "uid": int(uid),
                              "event": event, "source": source,
                              "target": target, "reason": reason,
-                             **extra})
+                             "policy": policy, **extra})
 
     def _event(self, record: dict) -> None:
         if self.metrics is not None:
             self.metrics.event(record)
+
+    def _candidates(self, handles, prompt=None) -> list[dict]:
+        """The per-engine scores a placement decision saw (schema-v9
+        ``routed`` attribution): warm-block depth (null when the
+        prefix probe didn't run — prefill-tier admission, affinity
+        off, or no prompt), queue depth, active slots, pool
+        utilization. Host-side reads only — probing never steps an
+        engine."""
+        out = []
+        for h in handles:
+            e = h.engine
+            warm = None
+            if (prompt is not None and self.prefix_affinity
+                    and e.prefix is not None):
+                warm = e.prefix.warm_blocks(prompt)
+            out.append({
+                "engine": h.id,
+                "warm_blocks": warm,
+                "queue_depth": len(e.waiting),
+                "active": e.active,
+                "pool_utilization": round(e.kv_pool_utilization(), 4),
+            })
+        return out
+
+    def _fleet_record(self) -> dict:
+        """One per-round fleet health record (schema-v9 ``fleet``
+        kind): per-engine waiting/active/free-blocks/utilization and
+        the load-imbalance scalar over alive decode engines
+        (``(max - min) / max`` of ``active + waiting``; 0.0 balanced
+        or idle, toward 1.0 when one engine holds everything)."""
+        engines = {}
+        loads = []
+        for h in self.handles:
+            if not h.alive:
+                engines[h.id] = {"alive": False}
+                continue
+            e = h.engine
+            engines[h.id] = {
+                "alive": True, "role": h.role,
+                "waiting": len(e.waiting), "active": e.active,
+                "free_blocks": len(e.free_blocks),
+                "utilization": round(e.kv_pool_utilization(), 4),
+            }
+            if h.role == "decode":
+                loads.append(e.active + len(e.waiting))
+        imb = 0.0
+        if len(loads) > 1 and max(loads) > 0:
+            imb = round((max(loads) - min(loads)) / max(loads), 4)
+        return {"step": self.rounds, "engines": engines,
+                "load_imbalance": imb}
 
     # -- routing -------------------------------------------------------
 
@@ -229,12 +296,15 @@ class FleetRouter:
             avail += e.prefix.evictable_blocks()
         return need <= avail
 
-    def _route(self, prompt, session):
+    def _route(self, prompt, session, warm_by_id=None):
         """Pick the decode-tier engine for a fresh request. Precedence:
         session affinity (stickiness beats balance — the session's KV
         locality is on that engine), then prefix affinity (the engine
         with the deepest warm radix path wins, load breaking ties),
-        then least-loaded."""
+        then least-loaded. ``warm_by_id`` reuses warm-block counts a
+        caller already probed (the candidates capture) so a
+        telemetry-enabled submit walks each radix tree once, not
+        twice."""
         handles = self.alive_handles("decode")
         if not handles:
             raise RuntimeError("no alive decode engine in the fleet")
@@ -243,8 +313,12 @@ class FleetRouter:
             if eid is not None and self.by_id[eid].alive:
                 return self.by_id[eid], "session", 0
         if self.prefix_affinity:
-            warm = [(h.engine.prefix.warm_blocks(prompt), h)
-                    for h in handles if h.engine.prefix is not None]
+            if warm_by_id is not None:
+                warm = [(warm_by_id[h.id], h) for h in handles
+                        if warm_by_id.get(h.id) is not None]
+            else:
+                warm = [(h.engine.prefix.warm_blocks(prompt), h)
+                        for h in handles if h.engine.prefix is not None]
             best = max((w for w, _ in warm), default=0)
             if best > 0:
                 tied = [h for w, h in warm if w == best]
@@ -268,16 +342,33 @@ class FleetRouter:
         prompt = [int(t) for t in prompt]
         reason, hit_blocks = None, 0
         prefills = self.alive_handles("prefill")
+        # decision attribution (schema v9): the per-engine scores this
+        # placement saw, captured BEFORE any engine takes the request
+        # (only when a router stream exists — the probe is host-cheap
+        # but pointless without a record to ride); the routing decision
+        # below REUSES the captured warm-block counts, so each radix
+        # tree is walked once per submit either way
+        candidates = None
         if prefills:
             order = sorted(prefills, key=self._load_key)
             reason = "least_loaded"
+            if self.metrics is not None:
+                candidates = self._candidates(order, prompt)
         else:
-            target, reason, hit_blocks = self._route(prompt, session)
+            warm_by_id = None
+            if self.metrics is not None:
+                candidates = self._candidates(
+                    self.alive_handles("decode"), prompt)
+                warm_by_id = {c["engine"]: c["warm_blocks"]
+                              for c in candidates}
+            target, reason, hit_blocks = self._route(prompt, session,
+                                                     warm_by_id)
             others = sorted(
                 (h for h in self.alive_handles("decode")
                  if h is not target), key=self._load_key)
             order = [target] + others
         shed_reasons = []
+        spilled = False
         for h in order:
             try:
                 h.engine.submit(prompt, max_new, uid=uid)
@@ -288,6 +379,7 @@ class FleetRouter:
                 # tried is cold; recording the stale count would credit
                 # it with blocks it doesn't hold)
                 reason, hit_blocks = "least_loaded", 0
+                spilled = True
                 continue
             self.requests[uid] = {"prompt": prompt, "max_new": max_new,
                                   "engine": h.id, "session": session}
@@ -297,8 +389,14 @@ class FleetRouter:
             self.routed_by[reason] = self.routed_by.get(reason, 0) + 1
             if reason == "prefix":
                 self.prefix_routed_hit_blocks += hit_blocks
+            # policy: what ACTUALLY placed the request — "spill" when
+            # the probed target shed and the request landed on a later
+            # engine by load (the affinity-era reason would credit a
+            # policy that didn't place it)
             self._record("routed", uid, target=h.id, reason=reason,
-                         prefix_hit_blocks=hit_blocks)
+                         policy=("spill" if spilled else reason),
+                         prefix_hit_blocks=hit_blocks,
+                         candidates=candidates)
             # the step-0 snapshot discipline: a kill before the first
             # cadence snapshot must still know this request exists.
             # O(1) per submit: append the one new WAITING entry to the
@@ -317,6 +415,7 @@ class FleetRouter:
                      "out": seq.out, "max_new": seq.max_new,
                      "retries": seq.retries, "t_submit": seq.t_submit,
                      "submit_step": seq.submit_step,
+                     "t_first": None,       # no first token yet
                      "state": "WAITING"})
             return uid
         self.sheds += 1
@@ -352,6 +451,12 @@ class FleetRouter:
             for h in self.handles:
                 if h.alive:
                     h.snapshot = snapshot_state(h.engine)
+        # one fleet health record per round (schema v9): the
+        # per-engine balance view the SLO/autoscaling layer reads.
+        # ``step`` is the post-round clock — record N describes the
+        # fleet after N rounds.
+        if self.metrics is not None:
+            self.metrics.fleet(self._fleet_record())
         return did
 
     def _placement_target(self, prompt_len: int, max_new: int,
@@ -360,6 +465,37 @@ class FleetRouter:
                  if h.id not in exclude
                  and self._has_capacity(h, prompt_len, max_new)]
         return min(cands, key=self._load_key) if cands else None
+
+    @staticmethod
+    def _doc_bytes(doc: dict) -> int:
+        """Wire bytes of one handoff document's KV payload (values +
+        int8 scales at the storage dtype) — the ``bytes`` a multi-host
+        transport would actually ship (ROADMAP item 1's criterion;
+        the scheduler-state envelope is noise next to the arrays)."""
+        n = 0
+        for key in ("k", "v", "k_scale", "v_scale"):
+            arr = doc.get(key)
+            if arr is not None:
+                n += int(arr.nbytes)
+        return n
+
+    def _move(self, source: EngineHandle, target: EngineHandle,
+              uid: int):
+        """One LIVE sequence move (export -> import), instrumented:
+        returns ``(doc, blocks, bytes, duration_s)`` and feeds the
+        migration-stall accumulators (blocks shipped/s, stall p90 —
+        the wall clock is the CPU proxy for a wire transport's
+        serialize+ship+implant cost)."""
+        t0 = time.perf_counter()
+        doc = source.engine.export_sequence(uid)
+        target.engine.import_sequence(doc)
+        dur = time.perf_counter() - t0
+        blocks = int(doc["blocks_written"])
+        nbytes = self._doc_bytes(doc)
+        self.handoff_blocks += blocks
+        self.handoff_bytes += nbytes
+        self.handoff_durations.append(dur)
+        return doc, blocks, nbytes, dur
 
     def _handoff_completed_prefills(self) -> None:
         """Ship every fully-prefilled sequence off the prefill tier.
@@ -379,15 +515,15 @@ class FleetRouter:
                                                 req["max_new"])
                 if target is None:
                     continue
-                doc = ph.engine.export_sequence(uid)
-                target.engine.import_sequence(doc)
+                doc, blocks, nbytes, dur = self._move(ph, target, uid)
                 self.handoffs += 1
                 req["engine"] = target.id
                 if req["session"] is not None:
                     self._sessions[req["session"]] = target.id
                 self._record("handoff", uid, source=ph.id,
                              target=target.id, reason="prefill_done",
-                             position=doc["position"])
+                             position=doc["position"], blocks=blocks,
+                             bytes=nbytes, duration_s=round(dur, 6))
                 # refresh BOTH snapshots now: a kill before the next
                 # cadence snapshot must neither lose the moved request
                 # (target's snapshot predates it) nor resurrect it on
@@ -425,13 +561,13 @@ class FleetRouter:
                                             exclude=(h.id,))
             if target is None:
                 continue
-            doc = e.export_sequence(uid)
-            target.engine.import_sequence(doc)
+            doc, blocks, nbytes, dur = self._move(h, target, uid)
             self.migrations += 1
             self.requests[uid]["engine"] = target.id
             self._record("migrated", uid, source=h.id,
                          target=target.id, reason="pool_pressure",
-                         position=doc["position"])
+                         position=doc["position"], blocks=blocks,
+                         bytes=nbytes, duration_s=round(dur, 6))
             # the handoff snapshot-refresh discipline (see above)
             h.snapshot = snapshot_state(e)
             target.snapshot = snapshot_state(target.engine)
@@ -490,14 +626,23 @@ class FleetRouter:
         moved = 0
         for req in snap["requests"]:
             target = min(survivors, key=self._load_key)
+            t0 = time.perf_counter()
             target.engine.resume_request(
                 req["uid"], req["prompt"], req["max_new"],
                 out=req["out"], retries=req["retries"],
-                t_submit=req.get("t_submit"))
+                t_submit=req.get("t_submit"),
+                t_first=req.get("t_first"))
+            dur = time.perf_counter() - t0
             self.requests[int(req["uid"])]["engine"] = target.id
+            # a replay-migration ships no KV (the dead pool is
+            # unreachable): blocks/bytes are honestly 0 and the replay
+            # length names the catch-up cost instead; duration_s here
+            # is the re-queue cost only — the replay itself shows up
+            # in the request's own span stream
             self._record("migrated", req["uid"], source=h.id,
                          target=target.id, reason="engine_killed",
-                         replay=len(req["out"]))
+                         replay=len(req["out"]), blocks=0, bytes=0,
+                         duration_s=round(dur, 6))
             # a survivor dying right after must re-migrate this too
             target.snapshot = snapshot_state(target.engine)
             moved += 1
@@ -587,7 +732,7 @@ class FleetRouter:
                 "prefix_hit_blocks": e.prefix_hit_blocks,
                 "prefill_tokens_saved": e.prefill_tokens_saved,
             }
-        return {
+        stats = {
             "engines": per_engine,
             "rounds": self.rounds,
             "routed": self.routed,
@@ -597,4 +742,15 @@ class FleetRouter:
             "sheds": self.sheds,
             "kills": self.kills,
             "prefix_routed_hit_blocks": self.prefix_routed_hit_blocks,
+            # the migration-stall surface (live moves only): blocks +
+            # wire bytes shipped and the per-move wall-clock list's
+            # summary (bench_decode.py's fleet_handoff_* rows read the
+            # raw accumulators off the router instead)
+            "handoff_blocks": self.handoff_blocks,
+            "handoff_bytes": self.handoff_bytes,
         }
+        if self.handoff_durations:
+            import numpy as np
+            stats["handoff_stall_p90_ms"] = round(float(np.percentile(
+                np.asarray(self.handoff_durations), 90)) * 1e3, 3)
+        return stats

@@ -7,10 +7,12 @@ checkpoint plumbing), prompts are either explicit token-id lists
 (``--prompts "3,1,4;9,2"``) or deterministic random draws
 (``--prompt_lens 5,9,13`` with ``--prompt_seed``), and the run prints
 ONE JSON line with every sequence's tokens plus the engine's
-throughput/occupancy/reliability stats. ``--metrics_dir`` streams
-schema-v4 ``decode`` + ``request`` records through the unified
+throughput/occupancy/reliability stats. ``--metrics_dir`` streams the
+schema-versioned ``decode`` / ``request`` / ``span`` (and, under
+``--fleet``, ``router`` + ``fleet``) records through the unified
 telemetry writer (``runtime/telemetry.py``) — ``report`` folds them
-like any other run.
+like any other run, and ``report --slo TTFT_S:ITL_S`` computes SLO
+attainment over the completed requests (DESIGN.md section 21).
 
 ``--tp N`` runs the Megatron decode layout over an N-way model-axis
 mesh (``--fake_devices`` makes that work on CPU, as everywhere else).
@@ -203,11 +205,15 @@ def _fleet_main(args, prompts, cfg, policy, params, fleet_kill,
     router_metrics = None
 
     def _writer(eid):
+        from ..decode.fleet import PREFILL_PREFIX
         from ..runtime.telemetry import TelemetryWriter
+        role = ("router" if eid == "router" else
+                "prefill" if eid.startswith(PREFILL_PREFIX) else
+                "decode")
         w = TelemetryWriter(
             os.path.join(args.metrics_dir, eid),
             meta={"argv": list(argv or []), "subcommand": "generate",
-                  "engine_id": eid, "fleet": args.fleet,
+                  "engine_id": eid, "role": role, "fleet": args.fleet,
                   "prefill_engines": args.prefill_engines,
                   "kv_dtype": args.kv_dtype,
                   "n_prompts": len(prompts), "max_new": args.max_new,

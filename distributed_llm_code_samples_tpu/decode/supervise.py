@@ -68,7 +68,12 @@ SNAPSHOT_FILENAME = "engine_snapshot.json"
 # replayed sharer prefills, later ones hit — the ~1-prefill property
 # survives the crash) and the persisted tree is the certificate tests
 # pin the rebuild against.
-SNAPSHOT_VERSION = 4
+# v5 (round 15): request entries carry ``t_first`` — the first-token
+# timestamp (``SpanTracer.mark_first_token``) — so a crash-resumed
+# request's completed record keeps its TRUE ``ttft_s`` (schema v9).
+# The crash gap itself stays visibly unaccounted in the span stream;
+# only the first-token FACT survives, never invented wall time.
+SNAPSHOT_VERSION = 5
 
 
 # ---------------------------------------------------------------- snapshot
@@ -97,6 +102,7 @@ def snapshot_state(engine: DecodeEngine) -> dict:
             "uid": seq.uid, "prompt": seq.prompt, "out": seq.out,
             "max_new": seq.max_new, "retries": seq.retries,
             "t_submit": seq.t_submit, "submit_step": seq.submit_step,
+            "t_first": engine.tracer.first_token_t(seq.uid),
             "state": "RUNNING", "slot": slot,
             "position": int(engine.lengths[slot]),
             "prefilled": seq.prefilled,
@@ -108,6 +114,7 @@ def snapshot_state(engine: DecodeEngine) -> dict:
             "uid": seq.uid, "prompt": seq.prompt, "out": seq.out,
             "max_new": seq.max_new, "retries": seq.retries,
             "t_submit": seq.t_submit, "submit_step": seq.submit_step,
+            "t_first": engine.tracer.first_token_t(seq.uid),
             "state": "WAITING",
         })
     snap = {
@@ -257,7 +264,8 @@ def restore_engine_state(engine: DecodeEngine, snap: dict) -> None:
         engine.resume_request(req["uid"], req["prompt"], req["max_new"],
                               out=req["out"], retries=req["retries"],
                               t_submit=req.get("t_submit"),
-                              submit_step=req.get("submit_step"))
+                              submit_step=req.get("submit_step"),
+                              t_first=req.get("t_first"))
     # auto-uid assignment must clear EVERY restored uid, not just the
     # live ones resume_request walked — a fresh submit colliding with a
     # finished uid would sample in lockstep with its twin and overwrite

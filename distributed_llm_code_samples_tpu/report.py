@@ -18,7 +18,14 @@ Inputs (all optional except at least one metrics dir):
   into comm/compute overlap and per-named-scope region totals,
 - ``--postmortem``: render each stream's flight-recorder dump
   (``decode/engine.py`` ``flight_recorder.json`` — the bounded ring of
-  per-step scheduler digests persisted on quarantine/watchdog/kill).
+  per-step scheduler digests persisted on quarantine/watchdog/kill),
+- ``--slo TTFT_S:ITL_S``: goodput accounting (schema v9, DESIGN.md
+  section 21) — SLO attainment over completed requests with each
+  violation attributed to its dominant span (queued / prefill /
+  replay / decode / preempt_gap / quarantine / migration), computed
+  on the MERGED streams so a migrated request's life re-assembles
+  across engines; crash-resumed requests render UNRECONCILED, never
+  silently as attainment. Malformed specs reject rc 2.
 
 Output: step-time percentiles, throughput, MFU, HBM high-water, the
 serving summary + reliability block per engine, a per-request
@@ -49,6 +56,27 @@ from .runtime.telemetry import (FLIGHT_FILENAME, METRICS_FILENAME,
 # construction (runtime/tracing.py); the tolerance only absorbs the
 # per-record rounding (latency 4 decimals, durations 6)
 RECONCILE_TOL_S = 0.01
+
+# slack when splitting a request's spans at its first-token instant
+# (t_first is reconstructed from two 4-decimal-rounded record fields,
+# so a boundary span's end can sit ~1e-4 off the reconstruction)
+_FIRST_TOKEN_EPS_S = 5e-3
+
+# the SLO attribution vocabulary (DESIGN.md section 21): the span
+# categories a violation can be attributed to. "migration" is not a
+# span kind — it is the unaccounted wall-clock gap of a uid the router
+# moved (plus the re-admission churn that follows a kill-migration),
+# reconstructed from the merged streams; a gap WITHOUT a router
+# migration record stays "unreconciled" (a crash, not a measured
+# phase) and is never counted as attainment
+SLO_SPAN_CATEGORIES = ("queued", "prefill", "replay", "decode",
+                       "preempt_gap", "quarantine", "migration")
+
+
+def _pct3(vals, ndigits=4):
+    """(p50, p90, p99) of a non-empty value list, rounded."""
+    q = np.percentile(np.asarray(vals, np.float64), [50, 90, 99])
+    return tuple(round(float(x), ndigits) for x in q)
 
 
 def _fmt_bytes(n: int | None) -> str:
@@ -227,9 +255,11 @@ class _Stream:
         self.anomalies = by.get("anomaly", [])
         self.rollbacks = by.get("rollback", [])
         self.decodes = by.get("decode", [])
-        # schema-v8 fleet-router decision records (decode/fleet.py);
-        # the router process never resumes, so no replay dedup applies
+        # fleet-router decision records (decode/fleet.py); the router
+        # process never resumes, so no replay dedup applies
         self.routers = by.get("router", [])
+        # schema-v9 per-round fleet health records (decode/fleet.py)
+        self.fleets = by.get("fleet", [])
         # request records: drop exact replays — an in-process
         # supervisor restart resumes from a snapshot that may PREDATE
         # records already emitted, so the replayed steps re-emit
@@ -400,6 +430,22 @@ class _Stream:
             rel["latency_p50_s"] = round(float(q[0]), 4)
             rel["latency_p90_s"] = round(float(q[1]), 4)
             rel["latency_p99_s"] = round(float(q[2]), 4)
+        # schema-v9 latency decomposition: TTFT straight off the
+        # completed records, ITL from the per-decode-segment spans
+        # (duration/tokens — the segment's mean inter-token gap; the
+        # segment's first token lands at its open instant)
+        ttfts = [r["ttft_s"] for r in requests
+                 if r["event"] == "completed"
+                 and r.get("ttft_s") is not None]
+        if ttfts:
+            (rel["ttft_p50_s"], rel["ttft_p90_s"],
+             rel["ttft_p99_s"]) = _pct3(ttfts)
+        gaps = [s["duration_s"] / s["tokens"] for s in self.spans
+                if s["span"] == "decode" and s.get("tokens")
+                and s.get("duration_s") is not None]
+        if gaps:
+            (rel["itl_p50_s"], rel["itl_p90_s"],
+             rel["itl_p99_s"]) = _pct3(gaps, 6)
         return rel
 
     def recovery(self) -> dict:
@@ -429,8 +475,8 @@ class _Stream:
         latency had unaccounted wall time, e.g. a crash gap)."""
         if not self.spans:
             return {}
-        lat = {r["uid"]: r.get("latency_s") for r in self.requests
-               if r["event"] == "completed"}
+        comp = {r["uid"]: r for r in self.requests
+                if r["event"] == "completed"}
         by_uid: dict = {}
         for s in self.spans:
             by_uid.setdefault(s["uid"], []).append(s)
@@ -440,8 +486,10 @@ class _Stream:
                         key=lambda s: (s.get("start_t") or 0.0,
                                        s.get("t") or 0.0))
             total = round(sum(s.get("duration_s") or 0.0 for s in ss), 4)
-            latency = lat.get(uid)
-            out[str(uid)] = {
+            rec = comp.get(uid)
+            latency = rec.get("latency_s") if rec else None
+            ttft = rec.get("ttft_s") if rec else None
+            entry = {
                 "spans": [{
                     "span": s["span"],
                     "duration_s": s.get("duration_s"),
@@ -450,10 +498,23 @@ class _Stream:
                 } for s in ss],
                 "span_sum_s": total,
                 "latency_s": latency,
+                "ttft_s": ttft,
                 "reconciled": (latency is not None
                                and abs(total - latency)
                                <= RECONCILE_TOL_S),
             }
+            if latency is not None and ttft is not None and rec:
+                # the v9 decomposition reconciliation: the first-token
+                # mark sits exactly on a span boundary, so ttft + the
+                # post-first-token span sum telescopes to the latency
+                t_first = rec.get("t", 0.0) - latency + ttft
+                post = sum(s.get("duration_s") or 0.0 for s in ss
+                           if (s.get("t") or 0.0)
+                           > t_first + _FIRST_TOKEN_EPS_S)
+                entry["ttft_plus_post_s"] = round(ttft + post, 4)
+                entry["ttft_reconciled"] = (
+                    abs(ttft + post - latency) <= RECONCILE_TOL_S)
+            out[str(uid)] = entry
         return out
 
     def flight_recorder(self) -> dict | None:
@@ -543,6 +604,315 @@ class _Stream:
         return timeline
 
 
+def _merged_completions(streams) -> dict:
+    """uid -> its FIRST completion record across every stream (a
+    request completed on an engine after its last snapshot re-completes
+    on a survivor when that engine dies — same tokens, two records;
+    the caller saw the first one)."""
+    comp: dict = {}
+    for r in sorted((r for s in streams for r in s.requests
+                     if r["event"] == "completed"),
+                    key=lambda r: r.get("t", 0.0)):
+        comp.setdefault(r["uid"], r)
+    return comp
+
+
+def _merged_spans(streams) -> dict:
+    """uid -> its deduped spans pooled across every stream (the
+    per-stream replay dedup applied once more across streams — a
+    migrated request's life is split over several engines' files)."""
+    by_uid: dict = {}
+    seen = set()
+    for s in streams:
+        for sp in s.spans:
+            key = (sp.get("uid"), sp.get("span"), sp.get("start_step"),
+                   sp.get("step"))
+            if key in seen:
+                continue
+            seen.add(key)
+            by_uid.setdefault(sp["uid"], []).append(sp)
+    for ss in by_uid.values():
+        ss.sort(key=lambda s: (s.get("start_t") or 0.0,
+                               s.get("t") or 0.0))
+    return by_uid
+
+
+def _merged_decode_gaps(streams) -> list:
+    """Per-decode-segment mean inter-token gaps (duration/tokens)
+    pooled across streams — the fleet-wide ITL sample set."""
+    return [s.get("duration_s") / s["tokens"]
+            for ss in _merged_spans(streams).values() for s in ss
+            if s["span"] == "decode" and s.get("tokens")
+            and s.get("duration_s") is not None]
+
+
+def _slo_accounting(streams, slo_ttft: float, slo_itl: float) -> dict:
+    """Goodput accounting over the merged streams (DESIGN.md §21).
+
+    A completed request ATTAINS the SLO when its decomposition
+    reconciles AND ``ttft_s <= slo_ttft`` AND its observed inter-token
+    latency ``(latency_s - ttft_s) / (n_new - 1)`` — stalls included,
+    what the caller actually experienced — is ``<= slo_itl``. Each
+    violation is attributed to its dominant span category:
+
+    - post-first-token spans fold by kind (decode / preempt_gap /
+      quarantine), with the re-admission churn after a stall (queued /
+      prefill / replay spans) charged to the stall's CAUSE — a
+      kill-migration's replay is migration cost, not an innocent
+      "replay" line item;
+    - a wall-clock gap the spans don't cover is ``migration`` when the
+      router has a handoff/migrated record for the uid (the span
+      clock deliberately restarts on the target engine — the gap IS
+      the migration stall). A gap with NO migration record is a crash:
+      the request is UNRECONCILED and never counted as attainment.
+    """
+    comp = _merged_completions(streams)
+    spans_by_uid = _merged_spans(streams)
+    moved_t: dict = {}
+    for s in streams:
+        for r in s.routers:
+            if r["event"] in ("handoff", "migrated"):
+                t = r.get("t", 0.0)
+                moved_t[r["uid"]] = min(moved_t.get(r["uid"], t), t)
+    per_uid = []
+    counts = {"attained": 0, "violated": 0, "unreconciled": 0}
+    by_span: dict = {}
+    for uid in sorted(comp):
+        rec = comp[uid]
+        latency = rec.get("latency_s")
+        ttft = rec.get("ttft_s")
+        n_new = rec.get("n_new")
+        entry = {"uid": uid, "latency_s": latency, "ttft_s": ttft,
+                 "n_new": n_new, "migrated": uid in moved_t}
+        spans = spans_by_uid.get(uid, [])
+        if latency is None or ttft is None:
+            entry["status"] = "unreconciled"
+            entry["why"] = ("no TTFT decomposition (first token "
+                            "predates a crash-resume)")
+            counts["unreconciled"] += 1
+            per_uid.append(entry)
+            continue
+        t_first = rec.get("t", 0.0) - latency + ttft
+        pre = [s for s in spans
+               if (s.get("t") or 0.0) <= t_first + _FIRST_TOKEN_EPS_S]
+        post = [s for s in spans
+                if (s.get("t") or 0.0) > t_first + _FIRST_TOKEN_EPS_S]
+        mig_t = moved_t.get(uid)
+
+        def fold(side_spans: list) -> dict:
+            """Category totals with the cause-tracking rules (the same
+            walk on both sides of the first token — a kill BEFORE the
+            first token stalls the TTFT side, DESIGN.md §21)."""
+            cats: dict = {}
+            cause = None
+            for s in side_spans:
+                name = s["span"]
+                if name == "decode":
+                    cat, cause = "decode", None
+                elif name == "preempt_gap":
+                    cat = cause = "preempt_gap"
+                elif name == "quarantine":
+                    cat = cause = "quarantine"
+                elif (cause is None and mig_t is not None
+                      and (s.get("start_t") or 0.0)
+                      >= mig_t - _FIRST_TOKEN_EPS_S):
+                    # queued/prefill/replay after the migration with no
+                    # closer stall cause: the kill-migration's catch-up
+                    cat = cause = "migration"
+                elif cause is not None:
+                    cat = cause      # re-admission churn -> its cause
+                else:
+                    cat = name
+                cats[cat] = cats.get(cat, 0.0) + (s.get("duration_s")
+                                                  or 0.0)
+            return cats
+
+        cats = fold(post)
+        pre_cats = fold(pre)
+        post_sum = sum(s.get("duration_s") or 0.0 for s in post)
+        pre_sum = sum(s.get("duration_s") or 0.0 for s in pre)
+        # gaps the spans don't cover, on EACH side of the first token:
+        # ttft == pre-span sum by construction, so a pre-side gap is a
+        # stall whose spans died with an engine (a kill before the
+        # first token), exactly like the post-side gap of a mid-decode
+        # kill — migration when the router recorded the move, a crash
+        # (UNRECONCILED) otherwise
+        post_gap = latency - ttft - post_sum
+        pre_gap = ttft - pre_sum
+        entry["post_span_sum_s"] = round(post_sum, 4)
+        entry["gap_s"] = round(post_gap, 4)
+        if abs(pre_gap) > RECONCILE_TOL_S:
+            entry["pre_gap_s"] = round(pre_gap, 4)
+        unaccounted = None
+        for side_cats, gap in ((cats, post_gap), (pre_cats, pre_gap)):
+            if gap > RECONCILE_TOL_S and uid in moved_t:
+                side_cats["migration"] = (
+                    side_cats.get("migration", 0.0) + gap)
+            elif abs(gap) > RECONCILE_TOL_S:
+                unaccounted = gap
+        if unaccounted is not None:
+            entry["status"] = "unreconciled"
+            entry["why"] = (f"{round(unaccounted, 4)}s unaccounted "
+                            "and no router migration record — a crash "
+                            "gap, not a measured phase")
+            counts["unreconciled"] += 1
+            per_uid.append(entry)
+            continue
+        mig_total = (cats.get("migration", 0.0)
+                     + pre_cats.get("migration", 0.0))
+        if mig_total:
+            entry["migration_s"] = round(mig_total, 4)
+        itl = ((latency - ttft) / (n_new - 1)
+               if n_new and n_new > 1 else None)
+        entry["itl_s"] = None if itl is None else round(itl, 6)
+        entry["breakdown"] = {k: round(v, 4) for k, v in
+                              sorted(cats.items(),
+                                     key=lambda kv: -kv[1])}
+        entry["ttft_breakdown"] = {k: round(v, 4) for k, v in
+                                   sorted(pre_cats.items(),
+                                          key=lambda kv: -kv[1])}
+        ttft_viol = ttft > slo_ttft + 1e-9
+        itl_viol = itl is not None and itl > slo_itl + 1e-9
+        if not (ttft_viol or itl_viol):
+            entry["status"] = "attained"
+            counts["attained"] += 1
+        else:
+            entry["status"] = "violated"
+            entry["violates"] = [d for d, v in (("ttft", ttft_viol),
+                                                ("itl", itl_viol)) if v]
+            pool: dict = {}
+            if itl_viol:
+                pool.update(cats)
+            if ttft_viol:
+                for k, v in pre_cats.items():
+                    pool[k] = pool.get(k, 0.0) + v
+            attributed = (max(pool.items(), key=lambda kv: kv[1])[0]
+                          if pool else "decode")
+            entry["attributed"] = attributed
+            by_span[attributed] = by_span.get(attributed, 0) + 1
+            counts["violated"] += 1
+        per_uid.append(entry)
+    total = len(per_uid)
+    return {
+        "slo_ttft_s": slo_ttft, "slo_itl_s": slo_itl,
+        "completed": total, **counts,
+        "attainment": (round(counts["attained"] / total, 4)
+                       if total else None),
+        "violations_by_span": by_span,
+        "requests": per_uid,
+    }
+
+
+def _fleet_health(streams) -> dict | None:
+    """Fold the per-round ``fleet`` records (decode/fleet.py) into a
+    balance summary + a sampled utilization timeline."""
+    recs = sorted((r for s in streams for r in s.fleets),
+                  key=lambda r: r.get("step", 0))
+    if not recs:
+        return None
+    imbs = [r.get("load_imbalance") or 0.0 for r in recs]
+    agg: dict = {}
+    for r in recs:
+        for eid, st in (r.get("engines") or {}).items():
+            a = agg.setdefault(eid, {"alive_rounds": 0,
+                                     "dead_rounds": 0, "util": [],
+                                     "active": [], "waiting": []})
+            if not st.get("alive"):
+                a["dead_rounds"] += 1
+                continue
+            a["alive_rounds"] += 1
+            a["role"] = st.get("role")
+            a["util"].append(st.get("utilization") or 0.0)
+            a["active"].append(st.get("active") or 0)
+            a["waiting"].append(st.get("waiting") or 0)
+    n = len(recs)
+    idx = (range(n) if n <= 16 else
+           sorted({round(i * (n - 1) / 15) for i in range(16)}))
+    timeline = [{
+        "round": recs[i].get("step"),
+        "load_imbalance": recs[i].get("load_imbalance"),
+        "utilization": {
+            eid: (st.get("utilization") if st.get("alive") else None)
+            for eid, st in (recs[i].get("engines") or {}).items()},
+    } for i in idx]
+    return {
+        "records": n,
+        "rounds": recs[-1].get("step"),
+        "load_imbalance_mean": round(float(np.mean(imbs)), 4),
+        "load_imbalance_max": round(float(np.max(imbs)), 4),
+        "engines": {eid: {
+            "role": a.get("role"),
+            "alive_rounds": a["alive_rounds"],
+            "dead_rounds": a["dead_rounds"],
+            "utilization_mean": (round(float(np.mean(a["util"])), 4)
+                                 if a["util"] else None),
+            "utilization_max": (round(float(np.max(a["util"])), 4)
+                                if a["util"] else None),
+            "active_mean": (round(float(np.mean(a["active"])), 2)
+                            if a["active"] else None),
+            "waiting_max": (int(max(a["waiting"]))
+                            if a["waiting"] else None),
+        } for eid, a in sorted(agg.items())},
+        "timeline": timeline,
+    }
+
+
+def _render_fleet_health(out: list, fh: dict) -> None:
+    out.append("")
+    out.append(f"fleet health: {fh['records']} round record(s) "
+               f"(through round {fh['rounds']}), load imbalance "
+               f"mean {fh['load_imbalance_mean']} / "
+               f"max {fh['load_imbalance_max']}")
+    for eid, a in fh["engines"].items():
+        if a["alive_rounds"] == 0:
+            out.append(f"  {eid:8s} dead for all "
+                       f"{a['dead_rounds']} recorded round(s)")
+            continue
+        dead = (f", dead {a['dead_rounds']} round(s)"
+                if a["dead_rounds"] else "")
+        out.append(f"  {eid:8s} [{a.get('role')}]  util mean "
+                   f"{a['utilization_mean']} max {a['utilization_max']}"
+                   f"  active mean {a['active_mean']}  waiting max "
+                   f"{a['waiting_max']}{dead}")
+    out.append("  utilization timeline (sampled):")
+    for row in fh["timeline"]:
+        cells = "  ".join(
+            f"{eid} {'dead' if u is None else format(u, '.2f')}"
+            for eid, u in sorted(row["utilization"].items()))
+        out.append(f"    round {row['round']:>4}  "
+                   f"imb {row['load_imbalance']:.2f}  {cells}")
+
+
+def _render_slo(out: list, slo: dict) -> None:
+    out.append("")
+    pct = ("n/a" if slo["attainment"] is None
+           else f"{slo['attainment'] * 100:.1f}%")
+    out.append(f"SLO attainment (TTFT <= {slo['slo_ttft_s']}s, "
+               f"ITL <= {slo['slo_itl_s']}s): {pct} — "
+               f"{slo['attained']}/{slo['completed']} attained, "
+               f"{slo['violated']} violated, "
+               f"{slo['unreconciled']} unreconciled")
+    if slo["violations_by_span"]:
+        out.append("  violations by attributed span: " + ", ".join(
+            f"{k} {v}" for k, v in sorted(
+                slo["violations_by_span"].items(),
+                key=lambda kv: -kv[1])))
+    for e in slo["requests"]:
+        if e["status"] == "attained":
+            continue
+        if e["status"] == "unreconciled":
+            out.append(f"  uid {e['uid']} UNRECONCILED — {e.get('why')}")
+            continue
+        viol = "+".join(e.get("violates", []))
+        bd = ", ".join(f"{k} {v}s" for k, v in
+                       list(e.get("breakdown", {}).items())[:4])
+        out.append(f"  uid {e['uid']} VIOLATED ({viol}: ttft "
+                   f"{e['ttft_s']}s, itl {e['itl_s']}s) -> attributed "
+                   f"{e.get('attributed')}"
+                   + (" [migrated]" if e["migrated"] else "")
+                   + (f"  ({bd})" if bd else ""))
+
+
 def _render_engine_sections(out: list, doc: dict) -> None:
     """Text render of one stream's folded sections (appended to
     ``out``) — shared between the single- and multi-stream layouts."""
@@ -629,6 +999,15 @@ def _render_engine_sections(out: list, doc: dict) -> None:
             out.append(f"  request latency  p50 {rl['latency_p50_s']}s  "
                        f"p90 {rl['latency_p90_s']}s  "
                        f"p99 {rl['latency_p99_s']}s")
+        if "ttft_p50_s" in rl:
+            out.append(f"  TTFT             p50 {rl['ttft_p50_s']}s  "
+                       f"p90 {rl['ttft_p90_s']}s  "
+                       f"p99 {rl['ttft_p99_s']}s")
+        if "itl_p50_s" in rl:
+            out.append(f"  ITL (per decode segment)  "
+                       f"p50 {rl['itl_p50_s']}s  "
+                       f"p90 {rl['itl_p90_s']}s  "
+                       f"p99 {rl['itl_p99_s']}s")
     rec = doc.get("recovery", {})
     if (rec.get("attempts_failed") or rec.get("nonfinite_skips")
             or rec.get("attempt_log")
@@ -663,8 +1042,11 @@ def _render_waterfalls(out: list, label: str | None, wf: dict) -> None:
                     else "NOT RECONCILED — unaccounted wall time"))
         lat = ("" if w["latency_s"] is None
                else f", latency {w['latency_s']}s")
+        ttft = ("" if w.get("ttft_s") is None
+                else f", ttft {w['ttft_s']}s")
         out.append(f"  uid {uid} — {len(w['spans'])} span(s), "
-                   f"span sum {w['span_sum_s']}s{lat} ({verdict})")
+                   f"span sum {w['span_sum_s']}s{lat}{ttft} "
+                   f"({verdict})")
         for s in w["spans"]:
             dur = s.get("duration_s")
             out.append(f"    {s['span']:12s} "
@@ -726,10 +1108,35 @@ def report_main(argv=None) -> int:
                    help="render each stream's flight-recorder dump "
                         "(per-step scheduler digests persisted on "
                         "quarantine / watchdog / kill)")
+    p.add_argument("--slo", default=None, metavar="TTFT_S:ITL_S",
+                   help="serving-SLO goodput accounting over the "
+                        "merged streams: attainment of TTFT <= TTFT_S "
+                        "and observed inter-token latency <= ITL_S "
+                        "over completed requests, each violation "
+                        "attributed to its dominant span (queued / "
+                        "prefill / replay / decode / preempt_gap / "
+                        "quarantine / migration); e.g. --slo 0.5:0.05")
     p.add_argument("--json", action="store_true",
                    help="emit the folded report as one JSON object "
                         "instead of text")
     args = p.parse_args(argv)
+
+    # the train-CLI parse discipline: a malformed spec rejects rc 2
+    # BEFORE any stream is read
+    slo = None
+    if args.slo is not None:
+        parts = args.slo.split(":")
+        try:
+            if len(parts) != 2:
+                raise ValueError
+            slo = (float(parts[0]), float(parts[1]))
+            if slo[0] < 0 or slo[1] < 0:
+                raise ValueError
+        except ValueError:
+            print(f"report: unparseable --slo {args.slo!r} (want "
+                  "TTFT_S:ITL_S with both >= 0, e.g. 0.5:0.05)",
+                  file=sys.stderr)
+            return 2
 
     # an explicit --attempt_log names ONE supervisor log: attach it to
     # the first stream only — giving it to every stream would replay
@@ -821,17 +1228,21 @@ def report_main(argv=None) -> int:
         # records plus deadline expiries — never per-engine "rejected"
         # events, which a spillover leaves behind even when the request
         # lands (and completes) on the next engine
-        comp_by_uid: dict = {}
-        for r in sorted((r for s in streams for r in s.requests
-                         if r["event"] == "completed"),
-                        key=lambda r: r.get("t", 0.0)):
-            comp_by_uid.setdefault(r["uid"], r)
-        completed = list(comp_by_uid.values())
+        completed = list(_merged_completions(streams).values())
         expired_uids = {r["uid"] for s in streams for r in s.requests
                         if r["event"] == "expired"}
+        # routed-policy attribution (v9) + live-move stall stats
+        policies: dict[str, int] = {}
+        for r in router_recs:
+            if r["event"] == "routed" and r.get("policy"):
+                policies[r["policy"]] = policies.get(r["policy"], 0) + 1
+        moves = [r for r in router_recs
+                 if r["event"] in ("handoff", "migrated")
+                 and r.get("duration_s") is not None]
         fleet = {
             "engines": len([s for s in streams if s.decodes]),
             "routed": by_ev.get("routed", 0),
+            "routed_by_policy": policies,
             "handoffs": by_ev.get("handoff", 0),
             "migrations": by_ev.get("migrated", 0),
             "migrated_by_reason": mig_reasons,
@@ -839,6 +1250,14 @@ def report_main(argv=None) -> int:
             "shed_at_router": by_ev.get("shed", 0),
             "completed": len(completed),
         }
+        if moves:
+            fleet["handoff_blocks"] = sum(int(r.get("blocks") or 0)
+                                          for r in moves)
+            fleet["handoff_bytes"] = sum(int(r.get("bytes") or 0)
+                                         for r in moves)
+            fleet["handoff_stall_p90_ms"] = round(float(np.percentile(
+                np.asarray([r["duration_s"] for r in moves],
+                           np.float64), 90)) * 1e3, 3)
         lat = [r["latency_s"] for r in completed
                if r.get("latency_s") is not None]
         if lat:
@@ -846,7 +1265,24 @@ def report_main(argv=None) -> int:
             fleet["latency_p50_s"] = round(float(q[0]), 4)
             fleet["latency_p90_s"] = round(float(q[1]), 4)
             fleet["latency_p99_s"] = round(float(q[2]), 4)
+        # fleet-wide TTFT/ITL (v9): completions deduped by uid, decode
+        # segments pooled across every stream
+        ttfts = [r["ttft_s"] for r in completed
+                 if r.get("ttft_s") is not None]
+        if ttfts:
+            (fleet["ttft_p50_s"], fleet["ttft_p90_s"],
+             fleet["ttft_p99_s"]) = _pct3(ttfts)
+        gaps = _merged_decode_gaps(streams)
+        if gaps:
+            (fleet["itl_p50_s"], fleet["itl_p90_s"],
+             fleet["itl_p99_s"]) = _pct3(gaps, 6)
         doc["fleet"] = fleet
+
+    fh = _fleet_health(streams)
+    if fh:
+        doc["fleet_health"] = fh
+    if slo is not None:
+        doc["slo"] = _slo_accounting(streams, *slo)
 
     if multi:
         doc["engines"] = per_engine
@@ -917,6 +1353,28 @@ def report_main(argv=None) -> int:
             out.append(f"  fleet latency  p50 {fl['latency_p50_s']}s  "
                        f"p90 {fl['latency_p90_s']}s  "
                        f"p99 {fl['latency_p99_s']}s")
+        if fl.get("routed_by_policy"):
+            out.append("  routed by policy: " + ", ".join(
+                f"{k} {v}" for k, v in sorted(
+                    fl["routed_by_policy"].items(),
+                    key=lambda kv: -kv[1])))
+        if "ttft_p50_s" in fl:
+            out.append(f"  fleet TTFT     p50 {fl['ttft_p50_s']}s  "
+                       f"p90 {fl['ttft_p90_s']}s  "
+                       f"p99 {fl['ttft_p99_s']}s")
+        if "itl_p50_s" in fl:
+            out.append(f"  fleet ITL      p50 {fl['itl_p50_s']}s  "
+                       f"p90 {fl['itl_p90_s']}s  "
+                       f"p99 {fl['itl_p99_s']}s  (per decode segment)")
+        if "handoff_stall_p90_ms" in fl:
+            out.append(f"  KV moves       {fl['handoff_blocks']} "
+                       f"block(s) / {_fmt_bytes(fl['handoff_bytes'])} "
+                       f"shipped, stall p90 "
+                       f"{fl['handoff_stall_p90_ms']} ms")
+    if doc.get("fleet_health"):
+        _render_fleet_health(out, doc["fleet_health"])
+    if doc.get("slo"):
+        _render_slo(out, doc["slo"])
     if multi:
         for s in streams:
             sub = per_engine[s.label]

@@ -73,7 +73,22 @@ import numpy as np
 # its own pinned required-key contract (ROUTER_REQUIRED); source and
 # target carry engine ids (null where the decision has none — a routed
 # request has no source engine, a shed request no target).
-SCHEMA_VERSION = 8
+# v9 (round 15): the serving-SLO measurement layer. (1) completed
+# "request" records additionally pin ``latency_s`` AND ``ttft_s``
+# (time to first token; null when the first token predates a
+# crash-resume — the decomposition is then visibly unreconstructable,
+# never invented). (2) the "router" contract pins ``policy`` — WHY the
+# router placed a request where it did (session / prefix /
+# least_loaded / spill on routed records; null on decisions that have
+# no placement policy), with the candidate scores the decision saw
+# riding as an extra key — and handoff/migrated records carry the
+# migration-stall instrumentation (``blocks`` / ``bytes`` /
+# ``duration_s`` measured around export_sequence/import_sequence).
+# (3) adds the "fleet" kind — one per-round fleet health record
+# (per-engine waiting/active/free-blocks/utilization + a
+# load-imbalance scalar, decode/fleet.py) with its own pinned
+# required-key contract (FLEET_REQUIRED).
+SCHEMA_VERSION = 9
 
 METRICS_FILENAME = "metrics.jsonl"
 
@@ -157,11 +172,19 @@ DECODE_REQUIRED = ("step", "tokens_per_sec", "batch_occupancy",
 # crash-resume), ``uid`` the request's sequence uid, ``event`` the
 # transition (admitted / preempted / retried / quarantined / completed
 # / rejected / expired), ``reason`` why (null where the transition
-# needs none — e.g. admitted). Completed records additionally carry
-# ``latency_s`` (submit -> finish wall clock; the report tool's
-# per-request latency percentiles read it). Same version-bump
-# discipline as STEP_KEYS.
+# needs none — e.g. admitted). Completed records additionally PIN
+# (since v9) ``latency_s`` (submit -> finish wall clock; the report
+# tool's per-request latency percentiles read it) and ``ttft_s``
+# (submit -> first emitted token; null when the first token predates a
+# crash-resume, in which case the decomposition is honestly
+# unreconstructable). Same version-bump discipline as STEP_KEYS.
 REQUEST_REQUIRED = ("step", "uid", "event", "reason")
+
+# the extra keys a COMPLETED request record must also carry (v9) —
+# enforced conditionally by validate_record (other events never
+# measure a completion, so pinning them kind-wide would force
+# meaningless nulls onto every admitted/preempted/... record)
+REQUEST_COMPLETED_REQUIRED = ("latency_s", "ttft_s")
 
 # The span-record contract (``runtime/tracing.py``): one record per
 # CLOSED per-request lifecycle span. ``span`` names the phase (queued /
@@ -189,13 +212,39 @@ SPAN_NAMES = ("queued", "prefill", "replay", "decode", "quarantine",
 # engine ids involved — null where the decision has none: a freshly
 # routed request has no source engine, a shed request no target.
 # ``reason`` rides as an extra key (least_loaded / session / prefix /
-# pool_pressure / engine_killed / queue_full). Same version-bump
-# discipline as STEP_KEYS.
-ROUTER_REQUIRED = ("step", "uid", "event", "source", "target")
+# pool_pressure / engine_killed / queue_full).
+#
+# v9 decision attribution: ``policy`` is pinned — the placement policy
+# a ``routed`` decision took (one of ROUTER_POLICIES; null on events
+# that place nothing: handoff / migrated / shed) — and routed records
+# carry ``candidates`` as an extra (the per-engine scores the decision
+# saw: warm-block depth, queue depth, active slots, pool utilization).
+# ``handoff``/``migrated`` records carry the migration-stall
+# instrumentation as extras: ``blocks`` / ``bytes`` shipped and
+# ``duration_s`` measured around export_sequence/import_sequence
+# (0 blocks/bytes on a replay-migration off a dead engine's snapshot —
+# nothing ships but the token history). Same version-bump discipline
+# as STEP_KEYS.
+ROUTER_REQUIRED = ("step", "uid", "event", "source", "target", "policy")
 
 # The router decision vocabulary (decode/fleet.py emits these; report
 # renders any name, so a new decision kind is additive)
 ROUTER_EVENTS = ("routed", "handoff", "migrated", "shed")
+
+# The routed-record policy vocabulary: session / prefix affinity,
+# least-loaded admission, or spill (the probed target shed and the
+# request landed on the next engine by load — affinity lost)
+ROUTER_POLICIES = ("session", "prefix", "least_loaded", "spill")
+
+# The fleet-health-record contract (``decode/fleet.py``): one record
+# per fleet scheduling round from the router's own writer. ``step`` is
+# the router's round clock, ``engines`` maps engine id -> per-engine
+# health ({alive, role, waiting, active, free_blocks, utilization};
+# dead engines report {alive: false}), ``load_imbalance`` is the
+# (max - min) / max load spread over alive decode engines (load =
+# active + waiting; 0.0 = balanced or idle, -> 1.0 = one engine holds
+# everything). Same version-bump discipline as STEP_KEYS.
+FLEET_REQUIRED = ("step", "engines", "load_imbalance")
 
 # Non-step record kinds the stream also carries: run headers ("meta"),
 # recovery/chaos/checkpoint events ("event"), bench measurement rows
@@ -204,7 +253,7 @@ ROUTER_EVENTS = ("routed", "handoff", "migrated", "shed")
 # serving engine's "decode" cadence + "request" lifecycle + "span"
 # per-request phase records.
 RECORD_KINDS = ("step", "meta", "event", "bench", "anomaly", "rollback",
-                "decode", "request", "span", "router")
+                "decode", "request", "span", "router", "fleet")
 
 # kind -> the pinned required-key set validate_record enforces (step
 # records additionally pin their FULL key set via STEP_KEYS)
@@ -216,6 +265,7 @@ REQUIRED_KEYS = {
     "request": REQUEST_REQUIRED,
     "span": SPAN_REQUIRED,
     "router": ROUTER_REQUIRED,
+    "fleet": FLEET_REQUIRED,
 }
 
 # bf16 peak matmul FLOP/s by chip generation (public spec sheets; the
@@ -440,13 +490,24 @@ class TelemetryWriter:
     def router(self, record: dict) -> None:
         """Enqueue one fleet-router decision record: routed / handoff /
         migrated / shed (``decode/fleet.py``; ``ROUTER_REQUIRED``
-        contract — source/target default to null so a caller only names
-        the engines the decision involves)."""
+        contract — source/target/policy default to null so a caller
+        only names the engines and the placement policy the decision
+        involves)."""
         rec = dict(record)
         rec.setdefault("t", time.time())
         rec.setdefault("source", None)
         rec.setdefault("target", None)
+        rec.setdefault("policy", None)
         rec["kind"] = "router"
+        self._put(rec)
+
+    def fleet(self, record: dict) -> None:
+        """Enqueue one per-round fleet health record: per-engine
+        waiting/active/free-blocks/utilization plus the load-imbalance
+        scalar (``decode/fleet.py``; ``FLEET_REQUIRED`` contract)."""
+        rec = dict(record)
+        rec.setdefault("t", time.time())
+        rec["kind"] = "fleet"
         self._put(rec)
 
     def span(self, record: dict) -> None:
@@ -562,6 +623,13 @@ def validate_record(rec: Any) -> tuple[bool, str]:
     missing = [k for k in REQUIRED_KEYS.get(kind, ()) if k not in rec]
     if missing:
         return False, f"{label} missing required key(s) {missing}"
+    if kind == "request" and rec.get("event") == "completed":
+        # v9 conditional pin: only a completion measures a latency, so
+        # the decomposition pair is required there and nowhere else
+        missing = [k for k in REQUEST_COMPLETED_REQUIRED if k not in rec]
+        if missing:
+            return False, (f"request record (event completed) missing "
+                           f"required key(s) {missing}")
     if kind == "step" and not isinstance(rec["step"], int):
         return False, (f"step record key 'step' is "
                        f"{type(rec['step']).__name__}, not int")

@@ -35,6 +35,19 @@ RECONCILE the phase breakdown against the latency percentiles instead
 of presenting two unrelated numbers (the observability analogue of the
 repo's differential-testing stance).
 
+**First-token marks (round 15).** The tracer also keeps one
+first-token timestamp per uid (``mark_first_token``), set by the
+engine at the instant the prefill-completing chunk emits its pick —
+the same timestamp that closes the prefill span and opens the first
+decode span. Completed ``request`` records carry it as ``ttft_s``
+(schema v9), and because the mark sits exactly on a span boundary,
+``ttft_s == sum(pre-first-token spans)`` and ``ttft_s +
+sum(post-first-token spans) == latency_s`` hold by the same
+telescoping argument as the full reconciliation. The mark travels
+with the sequence (snapshot v5, handoff v2); when the first token
+predates a crash-resume with no persisted mark, ``ttft_s`` is null —
+unreconstructable, never invented.
+
 **Crash behavior.** Open spans are process state and die with it;
 emitted spans are already on disk. An in-process supervisor restart
 replays steps whose spans were already emitted — the replayed records
@@ -65,6 +78,15 @@ class SpanTracer:
     def __init__(self, metrics_fn: Callable):
         self._metrics_fn = metrics_fn
         self._open: dict[int, dict] = {}   # uid -> open-span state
+        # uid -> wall clock of the FIRST live token (round 15, the
+        # TTFT decomposition): marked once at the prefill-completing
+        # chunk's emission instant — the SAME timestamp that closes the
+        # prefill span and opens the first decode span, so
+        # ``ttft = t_first - t_submit`` equals the pre-first-token span
+        # sum EXACTLY and ``ttft + post-first-token spans == latency``
+        # telescopes by construction. Keyed by uid (not admission), so
+        # preemption/retry churn keeps the original first-token time.
+        self._first: dict[int, float] = {}
 
     def open(self, uid: int, span: str, step: int,
              t: float | None = None) -> None:
@@ -87,6 +109,25 @@ class SpanTracer:
             self._emit(uid, cur, int(step), now, extra)
         self._open[uid] = {"span": span, "start_step": int(step),
                            "start_t": now}
+
+    def mark_first_token(self, uid: int, t: float) -> None:
+        """Record ``uid``'s first-token timestamp (idempotent: the
+        first mark wins, so a replay re-reaching the prefill boundary
+        — or a restore re-installing a persisted mark — never moves
+        it)."""
+        self._first.setdefault(int(uid), float(t))
+
+    def first_token_t(self, uid: int) -> float | None:
+        """The marked first-token wall clock, or None when the first
+        token predates this tracer's life (crash-resume without a
+        persisted mark — the decomposition is then honestly
+        unreconstructable)."""
+        return self._first.get(int(uid))
+
+    def pop_first_token(self, uid: int) -> float | None:
+        """``first_token_t`` + forget — the terminal-transition form
+        (completion / terminal failure / handoff export)."""
+        return self._first.pop(int(uid), None)
 
     def close(self, uid: int, step: int, t: float | None = None,
               **extra) -> None:

@@ -1,0 +1,183 @@
+#!/usr/bin/env python
+"""bench_trend — validate the committed per-round bench artifacts and
+print the cross-round trend table.
+
+Every round leaves ``BENCH_rNN*.json`` (the driver wrapper around
+bench.py's one-line payload — or, for ``*_local`` runs, the bare
+payload) and possibly ``SCALING_rNN.json`` (bench_scaling.py's
+AOT-codegen scaling study) in the repo root. They are persistent
+artifacts other tooling parses, so their shape is a CONTRACT
+(tests/test_bench_contract.py pins the emitters; this script pins the
+accumulated files), and the trend across rounds is the repo's
+bench-trajectory story — currently told nowhere.
+
+Row contracts:
+
+- BENCH wrapper: ``{n, cmd, rc, tail, parsed}`` with ``parsed`` either
+  null (a recorded hardware outage — honest, not drift) or the payload;
+- BENCH payload: ``metric`` / ``value`` / ``unit`` headline keys with a
+  numeric ``value`` (0.0 is the documented outage-fallback headline);
+- SCALING: ``rows`` (each with ``scenario`` + ``chips``), ``summary``,
+  ``ok``.
+
+Exit codes: 0 = every artifact validates (the table prints either way);
+2 = schema drift — unparseable JSON, a wrapper/payload/scaling file
+missing contract keys, or a non-numeric headline value. A missing
+artifact directory is also rc 2 (nothing to validate is not a pass).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+BENCH_HEADLINE = ("metric", "value", "unit")
+WRAPPER_KEYS = ("n", "cmd", "rc", "tail", "parsed")
+SCALING_KEYS = ("rows", "summary", "ok")
+SCALING_ROW_KEYS = ("scenario", "chips")
+
+
+def _round_of(path: str, prefix: str) -> str:
+    return os.path.basename(path)[len(prefix):-len(".json")]
+
+
+def validate_bench(path: str, problems: list) -> dict | None:
+    """One BENCH_* artifact -> a trend row, appending any contract
+    violation to ``problems`` (None row on violation)."""
+    name = os.path.basename(path)
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except ValueError:
+        problems.append(f"{name}: unparseable JSON")
+        return None
+    if not isinstance(doc, dict):
+        problems.append(f"{name}: not a JSON object")
+        return None
+    row = {"round": _round_of(path, "BENCH_"), "file": name}
+    payload = doc
+    if "parsed" in doc or "cmd" in doc:        # the driver wrapper
+        missing = [k for k in WRAPPER_KEYS if k not in doc]
+        if missing:
+            problems.append(f"{name}: wrapper missing key(s) {missing}")
+            return None
+        payload = doc["parsed"]
+        if payload is None:
+            # a recorded outage round: the wrapper IS the artifact
+            row.update(metric=None, value=None, unit=None,
+                       note=f"outage (driver rc {doc['rc']})")
+            return row
+        if not isinstance(payload, dict):
+            problems.append(f"{name}: 'parsed' is "
+                            f"{type(payload).__name__}, not an object")
+            return None
+    missing = [k for k in BENCH_HEADLINE if k not in payload]
+    if missing:
+        problems.append(f"{name}: headline key(s) {missing} missing")
+        return None
+    if not isinstance(payload["value"], (int, float)) \
+            or isinstance(payload["value"], bool):
+        problems.append(f"{name}: headline 'value' is "
+                        f"{type(payload['value']).__name__}, not a "
+                        "number")
+        return None
+    row.update(metric=payload["metric"], value=payload["value"],
+               unit=payload["unit"])
+    if payload.get("mfu") is not None:
+        row["mfu"] = payload["mfu"]
+    if payload["value"] == 0.0 and payload.get("last_measured"):
+        row["note"] = "outage fallback (last_measured nested)"
+    return row
+
+
+def validate_scaling(path: str, problems: list) -> dict | None:
+    name = os.path.basename(path)
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except ValueError:
+        problems.append(f"{name}: unparseable JSON")
+        return None
+    missing = [k for k in SCALING_KEYS if k not in doc]
+    if missing:
+        problems.append(f"{name}: missing key(s) {missing}")
+        return None
+    if not isinstance(doc["rows"], list) or not doc["rows"]:
+        problems.append(f"{name}: 'rows' is not a non-empty list")
+        return None
+    for i, r in enumerate(doc["rows"]):
+        bad = [k for k in SCALING_ROW_KEYS
+               if not isinstance(r, dict) or k not in r]
+        if bad:
+            problems.append(f"{name}: row {i} missing key(s) {bad}")
+            return None
+    return {"round": _round_of(path, "SCALING_"), "file": name,
+            "rows": len(doc["rows"]), "ok": doc["ok"],
+            "summary": doc["summary"]}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="bench_trend",
+        description="validate the committed BENCH_*/SCALING_* round "
+                    "artifacts against their row contracts and print "
+                    "the cross-round trend table (rc 2 on drift)")
+    p.add_argument("root", nargs="?",
+                   default=os.path.dirname(os.path.dirname(
+                       os.path.abspath(__file__))),
+                   help="artifact directory (default: the repo root)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the trend as one JSON object")
+    args = p.parse_args(argv)
+
+    if not os.path.isdir(args.root):
+        print(f"bench_trend: no artifact directory at {args.root}",
+              file=sys.stderr)
+        return 2
+    problems: list[str] = []
+    bench = [validate_bench(f, problems) for f in
+             sorted(glob.glob(os.path.join(args.root, "BENCH_*.json")))]
+    scaling = [validate_scaling(f, problems) for f in
+               sorted(glob.glob(os.path.join(args.root,
+                                             "SCALING_*.json")))]
+    bench = [r for r in bench if r is not None]
+    scaling = [r for r in scaling if r is not None]
+
+    if args.json:
+        print(json.dumps({"bench": bench, "scaling": scaling,
+                          "problems": problems}, indent=1))
+    else:
+        out = [f"bench trend — {len(bench)} BENCH / {len(scaling)} "
+               f"SCALING round artifact(s) in {args.root}"]
+        if bench:
+            out.append("")
+            out.append(f"  {'round':<12} {'value':>12}  {'unit':<10} "
+                       "metric / note")
+            for r in bench:
+                if r["value"] is None:
+                    out.append(f"  {r['round']:<12} {'—':>12}  "
+                               f"{'—':<10} {r.get('note')}")
+                    continue
+                tail = r["metric"] + (f"  [{r['note']}]"
+                                      if r.get("note") else "")
+                out.append(f"  {r['round']:<12} {r['value']:>12} "
+                           f" {r['unit']:<10} {tail}")
+        if scaling:
+            out.append("")
+            for r in scaling:
+                out.append(f"  {r['round']:<12} {r['rows']:>3} "
+                           f"scaling row(s)  ok={r['ok']}  "
+                           f"({r['summary']})")
+        print("\n".join(out))
+    if problems:
+        for prob in problems:
+            print(f"bench_trend: {prob}", file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

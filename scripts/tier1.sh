@@ -426,5 +426,90 @@ rm -rf "$FLEET_DIR"
 echo "FLEET_SMOKE=OK"
 phase_done fleet_smoke
 
+echo "=== fleet SLO smoke ==="
+# The ISSUE 11 acceptance drill (DESIGN.md section 21): a 3-engine
+# fleet with one migration forced (kill e1 late, so the dead engine's
+# decode stretch becomes the migration gap), then `report --slo` over
+# the merged four-stream run must exit 0 with attainment printed, the
+# router stream must hold >= 1 schema-valid `fleet` health record, and
+# the migrated uid's violation must be attributed to `migration` — not
+# to an innocent decode span. A malformed --slo spec rejects rc 2 (the
+# train-CLI parse discipline).
+SLO_DIR=$(mktemp -d /tmp/tier1_slo.XXXXXX)
+SLO_ARGS="--prompt_lens 3,7,5 --max_new 12 -d 32 -l 2 --heads 4
+  --vocab 64 --max_seq_len 64 --block_size 8 --prefill_chunk 4
+  --log_every 2"
+if ! timeout -k 10 240 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli generate $SLO_ARGS \
+    --fleet 3 --fleet_kill e1@8 --metrics_dir "$SLO_DIR/m" \
+    > "$SLO_DIR/fleet.json"; then
+  echo "SLO_SMOKE=FAIL (fleet run)"; rm -rf "$SLO_DIR"; exit 1
+fi
+if timeout -k 10 60 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli report "$SLO_DIR/m/router" \
+    --slo banana > /dev/null 2>&1; then
+  echo "SLO_SMOKE=FAIL (malformed --slo accepted)"; rm -rf "$SLO_DIR"
+  exit 1
+fi
+if ! timeout -k 10 60 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli report "$SLO_DIR/m/router" \
+    "$SLO_DIR/m/e0" "$SLO_DIR/m/e1" "$SLO_DIR/m/e2" \
+    --slo 100:0.000001 > "$SLO_DIR/slo.txt"; then
+  echo "SLO_SMOKE=FAIL (report --slo rc)"; rm -rf "$SLO_DIR"; exit 1
+fi
+if ! timeout -k 10 60 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli report "$SLO_DIR/m/router" \
+    "$SLO_DIR/m/e0" "$SLO_DIR/m/e1" "$SLO_DIR/m/e2" \
+    --slo 100:0.000001 --json > "$SLO_DIR/slo.json"; then
+  echo "SLO_SMOKE=FAIL (report --slo --json rc)"; rm -rf "$SLO_DIR"
+  exit 1
+fi
+if ! timeout -k 10 60 env JAX_PLATFORMS=cpu python - "$SLO_DIR" <<'EOF'
+import json, os, sys
+from distributed_llm_code_samples_tpu.runtime.telemetry import (
+    METRICS_FILENAME, read_metrics, validate_record)
+base = sys.argv[1]
+text = open(os.path.join(base, "slo.txt")).read()
+assert "SLO attainment" in text and "attributed" in text, text[-800:]
+records, problems = read_metrics(
+    os.path.join(base, "m", "router", METRICS_FILENAME))
+assert not problems, problems
+fleet_recs = [r for r in records if r["kind"] == "fleet"]
+assert fleet_recs, "no schema-valid fleet record in the router stream"
+assert all(validate_record(r)[0] for r in fleet_recs)
+mig_uids = {r["uid"] for r in records if r["kind"] == "router"
+            and r["event"] == "migrated"}
+assert mig_uids, "drill forced no migration"
+doc = json.load(open(os.path.join(base, "slo.json")))
+slo = doc["slo"]
+assert slo["unreconciled"] == 0, slo
+by_uid = {e["uid"]: e for e in slo["requests"]}
+for uid in mig_uids:
+    e = by_uid[uid]
+    assert e["status"] == "violated", e
+    assert e["attributed"] == "migration", (
+        "migration-stalled uid attributed to an innocent span", e)
+# every completed uid's decomposition reconciled (ttft + post-first
+# spans + the migration gap account for the full latency)
+assert slo["completed"] == len(slo["requests"]) == 3, slo
+EOF
+then
+  echo "SLO_SMOKE=FAIL (attainment/attribution check)"
+  rm -rf "$SLO_DIR"; exit 1
+fi
+rm -rf "$SLO_DIR"
+echo "SLO_SMOKE=OK"
+phase_done slo_smoke
+
+echo "=== bench-trend smoke ==="
+# The committed BENCH_*/SCALING_* round artifacts must keep their row
+# contracts (scripts/bench_trend.py exits 2 on drift or a missing
+# headline key) — the bench-trajectory story stays parseable.
+if ! timeout -k 10 60 python scripts/bench_trend.py > /dev/null; then
+  echo "BENCH_TREND_SMOKE=FAIL"; exit 1
+fi
+echo "BENCH_TREND_SMOKE=OK"
+phase_done bench_trend_smoke
+
 echo "=== tier-1 pytest ==="
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); phase_done pytest; exit $rc

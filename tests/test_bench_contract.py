@@ -295,6 +295,95 @@ def test_bench_decode_contract():
     assert payload["fleet_prefix_routed"] > 0
     assert payload["fleet_prefix_prefill_dispatches"] < \
         payload["fleet_prefix_prefill_dispatches_unshared"]
+    # r15 handoff-transport rows (ROADMAP item 1's bench criterion):
+    # blocks shipped per second, wire bytes at the storage dtype, and
+    # the migration-stall p90 by the CPU wall-clock proxy — measured
+    # around export_sequence/import_sequence on every live move
+    assert payload["fleet_handoff_blocks_per_sec"] > 0
+    assert payload["fleet_handoff_bytes"] > 0
+    assert payload["fleet_handoff_stall_p90_ms"] > 0
+
+
+def _run_trend(root):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "bench_trend.py"), root],
+        capture_output=True, text=True, cwd=REPO,
+        timeout=load_scaled_timeout(60))
+
+
+def test_bench_trend_validates_committed_artifacts():
+    """The repo's own BENCH_*/SCALING_* round artifacts keep their row
+    contracts: scripts/bench_trend.py exits 0 and prints one trend row
+    per artifact (the bench-trajectory story stays parseable)."""
+    r = _run_trend(REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    n_bench = len([f for f in os.listdir(REPO)
+                   if f.startswith("BENCH_") and f.endswith(".json")])
+    assert f"{n_bench} BENCH" in r.stdout, r.stdout
+    assert "steps/s" in r.stdout
+
+
+def test_bench_trend_rejects_schema_drift(tmp_path):
+    """rc 2 on drift: a payload missing its headline key, a
+    non-numeric value, an unparseable file, a wrapper missing contract
+    keys, or a scaling file without rows — each named on stderr. A
+    recorded outage wrapper (parsed null) is honest data, not drift."""
+    root = str(tmp_path)
+
+    def write(name, doc):
+        with open(os.path.join(root, name), "w") as f:
+            if isinstance(doc, str):
+                f.write(doc)
+            else:
+                json.dump(doc, f)
+
+    # a valid wrapper + a valid bare payload + a recorded outage: rc 0
+    write("BENCH_r01.json", {"n": 1, "cmd": "x", "rc": 0, "tail": "",
+                             "parsed": {"metric": "m", "value": 1.5,
+                                        "unit": "steps/s"}})
+    write("BENCH_r02_local.json", {"metric": "m", "value": 2.0,
+                                   "unit": "steps/s"})
+    write("BENCH_r03.json", {"n": 1, "cmd": "x", "rc": 1, "tail": "",
+                             "parsed": None})
+    write("SCALING_r01.json", {"rows": [{"scenario": "s", "chips": 8}],
+                               "summary": "aot", "ok": True})
+    r = _run_trend(root)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "outage" in r.stdout
+
+    # missing headline key -> rc 2 naming the file and the key
+    write("BENCH_r04.json", {"n": 1, "cmd": "x", "rc": 0, "tail": "",
+                             "parsed": {"metric": "m",
+                                        "unit": "steps/s"}})
+    r = _run_trend(root)
+    assert r.returncode == 2
+    assert "BENCH_r04.json" in r.stderr and "value" in r.stderr
+    os.remove(os.path.join(root, "BENCH_r04.json"))
+
+    # non-numeric headline value -> rc 2
+    write("BENCH_r05.json", {"metric": "m", "value": "fast",
+                             "unit": "steps/s"})
+    r = _run_trend(root)
+    assert r.returncode == 2 and "not a number" in r.stderr
+    os.remove(os.path.join(root, "BENCH_r05.json"))
+
+    # unparseable JSON -> rc 2
+    write("BENCH_r06.json", "{torn")
+    r = _run_trend(root)
+    assert r.returncode == 2 and "unparseable" in r.stderr
+    os.remove(os.path.join(root, "BENCH_r06.json"))
+
+    # scaling row missing its contract keys -> rc 2
+    write("SCALING_r02.json", {"rows": [{"chips": 8}],
+                               "summary": "aot", "ok": True})
+    r = _run_trend(root)
+    assert r.returncode == 2 and "scenario" in r.stderr
+    os.remove(os.path.join(root, "SCALING_r02.json"))
+
+    # a missing artifact directory is rc 2, not a silent pass
+    r = _run_trend(os.path.join(root, "nope"))
+    assert r.returncode == 2
 
 
 @pytest.mark.slow

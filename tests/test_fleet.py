@@ -429,9 +429,11 @@ def test_fleet_construction_validation(lm_params):
 
 def test_fleet_router_records_schema_valid(lm_params, prompts,
                                            tmp_path):
-    """Every router decision lands as a schema-v8 ``router`` record
-    with source/target engine ids; the merged report folds them into a
-    fleet summary above the per-engine blocks and onto one timeline."""
+    """Every router decision lands as a schema-valid ``router`` record
+    with source/target engine ids, the pinned v9 ``policy``, and the
+    candidate scores the decision saw; each round emits a schema-v9
+    ``fleet`` health record; the merged report folds them into a fleet
+    summary above the per-engine blocks and onto one timeline."""
     dirs = {}
 
     def mk(eid):
@@ -464,6 +466,32 @@ def test_fleet_router_records_schema_valid(lm_params, prompts,
     mig = [r for r in routers if r["event"] == "migrated"]
     assert all(r["source"] == "e2" and r["target"] in ("e0", "e1")
                for r in mig)
+    # v9 decision attribution: every routed record names the policy
+    # that placed it and the per-engine scores the decision saw; a
+    # replay-migration ships no KV (blocks/bytes 0) but is timed
+    routed = [r for r in routers if r["event"] == "routed"]
+    assert all(r["policy"] in ("session", "prefix", "least_loaded",
+                               "spill") for r in routed)
+    for r in routed:
+        cands = r["candidates"]
+        assert {c["engine"] for c in cands} <= {"e0", "e1", "e2"}
+        for c in cands:
+            assert {"warm_blocks", "queue_depth", "active",
+                    "pool_utilization"} <= set(c)
+    for r in mig:
+        assert r["policy"] is None
+        assert r["blocks"] == 0 and r["bytes"] == 0
+        assert r["duration_s"] >= 0
+    # per-round fleet health records: schema-valid, one per executed
+    # round, with the killed engine reported dead after round 4
+    fleets = [r for r in records if r["kind"] == "fleet"]
+    assert fleets
+    for r in fleets:
+        ok, reason = validate_record(r)
+        assert ok, reason
+    assert fleets[-1]["engines"]["e2"] == {"alive": False}
+    assert any(r["engines"]["e2"].get("alive") for r in fleets)
+    assert all(0.0 <= r["load_imbalance"] <= 1.0 for r in fleets)
 
     from distributed_llm_code_samples_tpu.report import report_main
     import io
@@ -480,6 +508,15 @@ def test_fleet_router_records_schema_valid(lm_params, prompts,
     assert fleet["completed"] == len(prompts)
     assert "latency_p50_s" in fleet
     assert fleet["migrated_by_reason"] == {"engine_killed": len(mig)}
+    assert sum(fleet["routed_by_policy"].values()) == len(prompts)
+    # the fleet health fold rides the merged doc: per-engine balance
+    # aggregates + the sampled utilization timeline
+    fh = doc["fleet_health"]
+    assert fh["records"] == len([r for r in records
+                                 if r["kind"] == "fleet"])
+    assert fh["engines"]["e2"]["dead_rounds"] >= 1
+    assert fh["engines"]["e0"]["utilization_max"] is not None
+    assert fh["timeline"]
     # router rows ride the merged timeline with everyone else's
     kinds = {t["source"] for t in doc["timeline"]}
     assert "router" in kinds and "request" in kinds

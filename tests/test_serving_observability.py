@@ -345,7 +345,8 @@ def test_report_dedups_replayed_spans(tmp_path, capsys):
         w.span(span)
         w.span(dict(span))          # the restart's replay
         w.request({"step": 5, "uid": 0, "event": "completed",
-                   "reason": None, "latency_s": 2.0})
+                   "reason": None, "latency_s": 2.0, "ttft_s": 1.0,
+                   "t": 11.0})
     capsys.readouterr()
     assert report_main([mdir, "--json"]) == 0
     doc = json.loads(capsys.readouterr().out)
@@ -353,3 +354,8 @@ def test_report_dedups_replayed_spans(tmp_path, capsys):
     assert len(w0["spans"]) == 2
     assert w0["span_sum_s"] == pytest.approx(2.0)
     assert w0["reconciled"]
+    # the v9 decomposition: ttft + the (deduped) post-first-token span
+    # telescopes to the latency too
+    assert w0["ttft_s"] == 1.0
+    assert w0["ttft_plus_post_s"] == pytest.approx(2.0)
+    assert w0["ttft_reconciled"]

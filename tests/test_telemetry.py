@@ -47,8 +47,13 @@ from distributed_llm_code_samples_tpu.runtime.telemetry import (
 # prefill_tokens_saved / shared_blocks / cow_copies — the radix
 # prefix cache, decode/prefix.py). v8 (round 14): the "router" kind
 # (one record per fleet-router decision: routed/handoff/migrated/shed
-# with source/target engine ids — decode/fleet.py).
-_PINNED_VERSION = 8
+# with source/target engine ids — decode/fleet.py). v9 (round 15): the
+# serving-SLO layer — completed "request" records conditionally pin
+# latency_s + ttft_s, the "router" contract pins the placement
+# "policy", and the "fleet" kind (one per-round fleet health record —
+# per-engine waiting/active/free-blocks/utilization + load imbalance,
+# decode/fleet.py) lands with FLEET_REQUIRED.
+_PINNED_VERSION = 9
 _PINNED_STEP_KEYS = frozenset({
     "schema", "kind", "t", "step", "strategy", "loss", "grad_norm",
     "tokens_per_sec", "step_time_s", "mfu", "hbm_high_water_bytes",
@@ -68,23 +73,30 @@ _PINNED_SPAN_REQUIRED = frozenset({
     "step", "uid", "span", "start_step", "duration_s",
 })
 _PINNED_ROUTER_REQUIRED = frozenset({
-    "step", "uid", "event", "source", "target",
+    "step", "uid", "event", "source", "target", "policy",
 })
+_PINNED_REQUEST_COMPLETED_REQUIRED = frozenset({"latency_s", "ttft_s"})
+_PINNED_FLEET_REQUIRED = frozenset({"step", "engines",
+                                    "load_imbalance"})
 
 
 def test_schema_version_bump_discipline():
     from distributed_llm_code_samples_tpu.runtime.telemetry import (
-        ANOMALY_REQUIRED, DECODE_REQUIRED, RECORD_KINDS,
-        REQUEST_REQUIRED, REQUIRED_KEYS, ROLLBACK_REQUIRED,
-        ROUTER_REQUIRED, SPAN_REQUIRED)
+        ANOMALY_REQUIRED, DECODE_REQUIRED, FLEET_REQUIRED,
+        RECORD_KINDS, REQUEST_COMPLETED_REQUIRED, REQUEST_REQUIRED,
+        REQUIRED_KEYS, ROLLBACK_REQUIRED, ROUTER_REQUIRED,
+        SPAN_REQUIRED)
     assert SCHEMA_VERSION == _PINNED_VERSION and \
         frozenset(STEP_KEYS) == _PINNED_STEP_KEYS and \
         frozenset(ANOMALY_REQUIRED) == _PINNED_ANOMALY_REQUIRED and \
         frozenset(ROLLBACK_REQUIRED) == _PINNED_ROLLBACK_REQUIRED and \
         frozenset(DECODE_REQUIRED) == _PINNED_DECODE_REQUIRED and \
         frozenset(REQUEST_REQUIRED) == _PINNED_REQUEST_REQUIRED and \
+        frozenset(REQUEST_COMPLETED_REQUIRED) == \
+        _PINNED_REQUEST_COMPLETED_REQUIRED and \
         frozenset(SPAN_REQUIRED) == _PINNED_SPAN_REQUIRED and \
-        frozenset(ROUTER_REQUIRED) == _PINNED_ROUTER_REQUIRED, (
+        frozenset(ROUTER_REQUIRED) == _PINNED_ROUTER_REQUIRED and \
+        frozenset(FLEET_REQUIRED) == _PINNED_FLEET_REQUIRED, (
             "telemetry record schema changed: bump SCHEMA_VERSION "
             "and update the pinned sets here in the same commit")
     assert "anomaly" in RECORD_KINDS and "rollback" in RECORD_KINDS
@@ -92,11 +104,12 @@ def test_schema_version_bump_discipline():
     assert "decode" in RECORD_KINDS
     assert "span" in RECORD_KINDS
     assert "router" in RECORD_KINDS
+    assert "fleet" in RECORD_KINDS
     # every contract-carrying kind routes through the one table
     # validate_record reads (a new kind that skips it validates
     # envelope-only silently — this catches the drift)
     for kind in ("step", "anomaly", "rollback", "decode", "request",
-                 "span", "router"):
+                 "span", "router", "fleet"):
         assert kind in REQUIRED_KEYS, kind
 
 
@@ -212,6 +225,7 @@ def test_span_record_round_trip_and_torn_tail(tmp_path):
     ("request", _PINNED_REQUEST_REQUIRED),
     ("span", _PINNED_SPAN_REQUIRED),
     ("router", _PINNED_ROUTER_REQUIRED),
+    ("fleet", _PINNED_FLEET_REQUIRED),
 ])
 def test_validate_record_names_kind_and_key(kind, required):
     """Satellite contract: every validate_record failure is ONE line
@@ -234,14 +248,16 @@ def test_validate_record_names_kind_and_key(kind, required):
 
 def test_router_record_round_trip(tmp_path):
     """A fleet-router decision record written through the writer parses
-    back schema-valid with the v8 contract keys; source/target default
-    to null for decisions that have none (a routed request has no
-    source engine)."""
+    back schema-valid with the contract keys; source/target/policy
+    default to null for decisions that have none (a routed request has
+    no source engine; a migration takes no placement policy)."""
     w = TelemetryWriter(str(tmp_path))
     w.router({"step": 2, "uid": 7, "event": "migrated", "source": "e1",
-              "target": "e0", "reason": "engine_killed"})
+              "target": "e0", "reason": "engine_killed",
+              "blocks": 0, "bytes": 0, "duration_s": 0.001})
     w.router({"step": 0, "uid": 3, "event": "routed", "target": "e2",
-              "reason": "prefix", "prefix_hit_blocks": 2})
+              "reason": "prefix", "policy": "prefix",
+              "prefix_hit_blocks": 2})
     w.close()
     records, problems = read_metrics(os.path.join(str(tmp_path),
                                                   METRICS_FILENAME))
@@ -250,11 +266,67 @@ def test_router_record_round_trip(tmp_path):
     assert mig["kind"] == "router" and mig["schema"] == SCHEMA_VERSION
     assert mig["source"] == "e1" and mig["target"] == "e0"
     assert mig["reason"] == "engine_killed"
+    assert mig["policy"] is None        # writer default: no placement
+    assert mig["duration_s"] == 0.001   # the stall instrumentation
     assert routed["source"] is None and routed["target"] == "e2"
+    assert routed["policy"] == "prefix"
     assert routed["prefix_hit_blocks"] == 2
     for r in records:
         ok, reason = validate_record(r)
         assert ok, reason
+
+
+def test_fleet_record_round_trip_and_torn_tail(tmp_path):
+    """The schema-v9 fleet health kind (decode/fleet.py): writer method
+    stamps the kind + envelope, records validate, a torn tail after a
+    fleet write is reported-not-fatal, and a missing contract key
+    rejects naming kind and key."""
+    w = TelemetryWriter(str(tmp_path))
+    w.fleet({"step": 3, "engines": {
+        "e0": {"alive": True, "role": "decode", "waiting": 1,
+               "active": 2, "free_blocks": 10, "utilization": 0.5},
+        "e1": {"alive": False}},
+        "load_imbalance": 1.0})
+    w.close()
+    path = os.path.join(str(tmp_path), METRICS_FILENAME)
+    with open(path, "a") as f:
+        f.write('{"schema": 9, "kind": "fle')  # torn write
+    records, problems = read_metrics(path)
+    assert len(problems) == 1 and "torn" in problems[0]
+    [rec] = records
+    assert rec["kind"] == "fleet" and rec["schema"] == SCHEMA_VERSION
+    assert rec["engines"]["e0"]["utilization"] == 0.5
+    assert rec["engines"]["e1"] == {"alive": False}
+    assert rec["load_imbalance"] == 1.0
+    ok, reason = validate_record(rec)
+    assert ok, reason
+    bad = {k: v for k, v in rec.items() if k != "load_imbalance"}
+    ok, reason = validate_record(bad)
+    assert not ok and "fleet record" in reason \
+        and "load_imbalance" in reason
+
+
+def test_completed_request_record_conditional_pin():
+    """v9: a completed request record must carry latency_s AND ttft_s
+    (null ttft_s allowed — a crash-resumed first token is honestly
+    unreconstructable); other request events never pin them."""
+    base = {"schema": SCHEMA_VERSION, "kind": "request", "t": 0.0,
+            "step": 3, "uid": 1, "reason": None}
+    ok, reason = validate_record({**base, "event": "completed",
+                                  "latency_s": 1.5, "ttft_s": 0.5})
+    assert ok, reason
+    ok, reason = validate_record({**base, "event": "completed",
+                                  "latency_s": 1.5, "ttft_s": None})
+    assert ok, reason                    # null is a value, not absence
+    ok, reason = validate_record({**base, "event": "completed",
+                                  "latency_s": 1.5})
+    assert not ok and "completed" in reason and "ttft_s" in reason
+    ok, reason = validate_record({**base, "event": "completed",
+                                  "ttft_s": 0.5})
+    assert not ok and "latency_s" in reason
+    # an admitted record carries neither and stays valid
+    ok, reason = validate_record({**base, "event": "admitted"})
+    assert ok, reason
 
 
 def test_read_metrics_survives_torn_tail(tmp_path):
