@@ -592,6 +592,56 @@ def main() -> int:
             "in-process transport floor the ROADMAP item 1 wire "
             "transport is measured against")
 
+        # Wire-transport variant (round 16, the ROADMAP item 1
+        # criterion itself): the SAME disaggregated workload with
+        # every live move serialized through the versioned wire format
+        # (runtime/wire.py: npz + per-array CRC-32, fsync'd atomic
+        # publish) and imported from the published file — the
+        # serialize + verify + implant cost a process/multi-host
+        # transport pays per move, measured against the in-process
+        # floor above. Outputs asserted byte-identical: the wire
+        # round-trip must not move a single token.
+        import tempfile as _tf
+
+        def handoff_lane(wire_dir):
+            fl = FleetRouter(lambda eid: DecodeEngine(params, H,
+                                                      cfg()),
+                             2, prefill_engines=1, wire_dir=wire_dir)
+            for p in short:
+                fl.submit(p, new)
+            fl.submit(long_prompt, 2)
+            return fl, fl.run()
+
+        fl_floor, outs_floor = handoff_lane(None)
+        fl_w, outs_w = handoff_lane(_tf.mkdtemp(prefix="bench_wire_"))
+        if outs_w != outs_floor:
+            raise RuntimeError("wire-transport fleet outputs != "
+                               "in-process fleet (the serialization "
+                               "boundary moved a token)")
+        if fl_w.handoffs < 1 or fl_w.wire_rejects:
+            raise RuntimeError(
+                f"wire lane shipped {fl_w.handoffs} handoff(s) with "
+                f"{fl_w.wire_rejects} rejection(s) — the row would "
+                "price nothing")
+        wd = np.asarray(fl_w.handoff_durations, np.float64)
+        fd = np.asarray(fl_floor.handoff_durations, np.float64)
+        paths["fleet_handoff_wire_blocks_per_sec"] = round(
+            fl_w.handoff_blocks / max(float(wd.sum()), 1e-9), 1)
+        paths["fleet_handoff_wire_bytes"] = int(fl_w.handoff_bytes)
+        paths["fleet_handoff_wire_stall_p90_ms"] = round(
+            float(np.percentile(wd, 90)) * 1e3, 3)
+        floor_p90 = float(np.percentile(fd, 90))
+        paths["fleet_handoff_wire_vs_inproc"] = round(
+            float(np.percentile(wd, 90)) / max(floor_p90, 1e-9), 3)
+        paths["fleet_handoff_wire_note"] = (
+            f"{len(wd)} live move(s), npz+CRC per move (serialize -> "
+            "fsync'd publish -> CRC verify -> implant), byte-identical "
+            "output asserted vs the in-process lane run on the same "
+            "workload; bytes are the serialized wire size both lanes "
+            "now report (satellite: never the in-memory nbytes sum); "
+            "vs_inproc is the stall-p90 ratio — the serialization "
+            "boundary's price on top of the floor")
+
         # Cross-engine prefix affinity: 2*slots sharers of one system
         # prompt through a 2-replica fleet. The router probes every
         # engine's radix tree and sends sharers where the prefix is

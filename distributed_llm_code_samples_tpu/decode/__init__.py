@@ -9,7 +9,8 @@ from .draft import draft_tokens
 from .engine import (AdmissionError, DecodeEngine, EngineConfig,
                      FLIGHT_FILENAME, HANDOFF_VERSION, POISON_ALL,
                      POISON_NONE, REQUEST_EVENTS, ServePolicy)
-from .fleet import EngineHandle, FleetRouter
+from .fleet import (EngineHandle, FleetRouter, HandoffRef,
+                    TransportDead, TransportError, TransportTimeout)
 from .paged import (KV_DTYPES, PagedKV, SCRATCH_BLOCK, copy_block,
                     corrupt_block, extract_blocks, fused_decode_attn,
                     gather_layer, implant_block, init_pool,
@@ -20,10 +21,14 @@ from .sampling import check_sampling, check_speculation, make_pick
 from .supervise import (SNAPSHOT_FILENAME, load_snapshot,
                         restore_engine_state, snapshot_state,
                         supervise_decode, write_snapshot)
+from .worker import (ProcessEngineHandle, spawn_fleet_handles,
+                     spawn_worker)
 
 __all__ = [
     "AdmissionError", "DecodeEngine", "EngineConfig", "EngineHandle",
-    "FLIGHT_FILENAME", "FleetRouter", "HANDOFF_VERSION",
+    "FLIGHT_FILENAME", "FleetRouter", "HANDOFF_VERSION", "HandoffRef",
+    "ProcessEngineHandle", "TransportDead", "TransportError",
+    "TransportTimeout", "spawn_fleet_handles", "spawn_worker",
     "POISON_ALL", "POISON_NONE", "REQUEST_EVENTS", "ServePolicy",
     "KV_DTYPES", "PagedKV", "SCRATCH_BLOCK", "copy_block",
     "corrupt_block", "draft_tokens", "extract_blocks",

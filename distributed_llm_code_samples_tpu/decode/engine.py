@@ -177,8 +177,16 @@ REQUEST_EVENTS = ("admitted", "preempted", "retried", "quarantined",
 # numbering. v1 (round 14, DESIGN.md section 20). v2 (round 15): the
 # document carries ``t_first`` — the sequence's first-token timestamp —
 # so a migrated request's completed record still reports its true
-# ``ttft_s`` (schema v9, DESIGN.md section 21).
-HANDOFF_VERSION = 2
+# ``ttft_s`` (schema v9, DESIGN.md section 21). v3 (round 16): the
+# document is a WIRE contract, not just an in-process dict — every
+# non-array value is JSON-safe (plain ints/floats/strings/lists/dicts/
+# None), every array a numpy array AT THE STORAGE DTYPE — so it
+# round-trips the versioned npz wire format (``runtime/wire.py``:
+# per-array CRC-32, atomic publish) bit-identically across a process
+# boundary; a mismatched version is rejected BEFORE any engine state is
+# touched, like every other import_sequence check (DESIGN.md
+# section 22).
+HANDOFF_VERSION = 3
 
 # EngineConfig keys two engines may legitimately disagree on and still
 # exchange sequences: pool SIZE is an engine-local capacity choice.
@@ -203,6 +211,15 @@ class AdmissionError(RuntimeError):
     """A request was shed at submit time (bounded queue full) — the
     serving 503, distinct from the ValueError family (malformed
     requests) so callers can tell load shedding from bad input."""
+
+
+def blocks_needed(prompt_len: int, max_new: int, block_size: int) -> int:
+    """Full block reservation for one request: the final generated
+    token is returned, never cached, so ``prompt_len + max_new - 1``
+    positions round up to blocks. THE one definition — the engine's
+    admission math and the fleet transports' remote capacity probes
+    (``decode/worker.py``) must never disagree on this count."""
+    return -(-(prompt_len + max_new - 1) // block_size)
 
 
 def _buckets(limit: int) -> tuple[int, ...]:
@@ -1154,9 +1171,7 @@ class DecodeEngine:
         return seq.uid
 
     def _blocks_needed(self, t0: int, max_new: int) -> int:
-        # the final generated token is returned, never cached
-        positions = t0 + max_new - 1
-        return -(-positions // self.cfg.block_size)
+        return blocks_needed(t0, max_new, self.cfg.block_size)
 
     # -- request lifecycle (telemetry schema v4 `request` records) -----
 
@@ -2048,31 +2063,28 @@ class DecodeEngine:
 
     def dump_flight_recorder(self, reason: str) -> str | None:
         """Atomically persist the digest ring as ``flight_recorder.json``
-        next to the metrics stream (or ``self.flight_dir``): tmp +
-        fsync + rename, the checkpoint layer's publish discipline —
-        called on quarantine (engine), watchdog latch and chaos kill
-        (supervisor). Returns the path, or None when the engine has
-        nowhere to put it (no metrics dir, no explicit flight_dir)."""
+        next to the metrics stream (or ``self.flight_dir``) via
+        ``runtime/wire.py``'s publish discipline (tmp + fsync + rename
+        + dir fsync — one implementation for checkpoints, snapshots,
+        wire docs, and this dump). Called on quarantine (engine),
+        watchdog latch and chaos kill (supervisor). Returns the path,
+        or None when the engine has nowhere to put it (no metrics dir,
+        no explicit flight_dir)."""
         out_dir = self.flight_dir
         if out_dir is None and self.metrics is not None:
             out_dir = os.path.dirname(self.metrics.path)
         if out_dir is None:
             return None
+        from ..runtime.wire import publish_json
         os.makedirs(out_dir, exist_ok=True)
-        path = os.path.join(out_dir, FLIGHT_FILENAME)
-        tmp = path + ".tmp"
         doc = {"version": 1, "reason": reason,
                "step": self.global_step, "t": time.time(),
                "kv_dtype": self.cfg.kv_dtype,
                "max_slots": self.cfg.max_slots,
                "n_blocks": self.cfg.n_blocks,
                "digests": list(self.flight)}
-        with open(tmp, "w") as f:
-            json.dump(doc, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
-        return path
+        return publish_json(os.path.join(out_dir, FLIGHT_FILENAME),
+                            doc)
 
     # -- static cost attribution (DESIGN.md section 17) ----------------
 

@@ -1,5 +1,6 @@
 """Fleet-scale serving: a host-side router over N decode-engine
-replicas, with disaggregated prefill/decode and KV-handoff migration.
+replicas, with disaggregated prefill/decode and KV-handoff migration —
+round 16: across a REAL process boundary.
 
 One ``DecodeEngine`` is not "heavy traffic from millions of users":
 aggregate tokens/s scales only with what a single engine holds, and a
@@ -10,58 +11,72 @@ at the REQUEST level — plus the DistServe/Splitwise disaggregation
 argument: prefill is compute-bound and bursty, decode is memory-bound
 and steady, so co-locating them trades throughput for interference.
 
-The three moves, each riding machinery earlier rounds already built:
+The router drives every replica through ONE handle API, with three
+transports behind it:
+
+- **In-process** (``EngineHandle``): the engine lives in the router's
+  process; the PR 10 fleet, unchanged in behavior, now expressed
+  through the same driver surface the process transport uses.
+- **In-process + wire docs** (``wire_dir=``): every live KV move
+  serializes through the versioned npz wire format
+  (``runtime/wire.py`` — per-array CRC-32, atomic publish) and imports
+  from the file. Same process, real serialization boundary: the bench
+  floor for the transport, and the cheap test surface for wire
+  rejection.
+- **Process workers** (``decode/worker.py``): each engine runs in its
+  own OS process behind a socket protocol
+  (``ProcessEngineHandle``); KV crosses as wire files, an engine kill
+  is a real SIGKILL, and a silent worker is a real hung peer. The
+  router's liveness ladder (per-call deadlines -> bounded
+  ``failure.backoff_delay`` retries -> declare dead -> SIGKILL ->
+  migrate-from-last-snapshot) is what turns "a process stopped
+  answering" into "every request still completes token-identically".
+
+The three routing/migration moves, each riding machinery earlier
+rounds already built:
 
 - **Routing** (``FleetRouter.submit``): least-loaded admission over the
-  live per-engine state the schema-v5 telemetry already pins (queue
-  depth, occupancy, pool utilization), session affinity (a session's
-  requests stay on one engine), and **prefix affinity** — the router
-  probes every engine's radix tree (``PrefixCache.warm_blocks``; the
-  in-process form of a shadow index, with zero mirror drift) and sends
-  a sharer to the engine whose tree is warm, so PR 9's ~1-prefill
-  property holds FLEET-wide, not per-engine. A full target spills to
-  the next-best engine; all-full sheds at the door (the serving 503).
+  per-engine digests the handles report (queue depth, occupancy, pool
+  utilization), session affinity, and **prefix affinity** — the router
+  probes every engine's radix tree (``warm_blocks``) and sends a
+  sharer where the prefix is warm, so PR 9's ~1-prefill property holds
+  FLEET-wide. A full target spills to the next-best engine; all-full
+  sheds at the door (the serving 503).
 
 - **Disaggregated prefill/decode** (``prefill_engines=M``): M dedicated
   prefill engines run the chunked prefill; the moment a prompt
   completes, the sequence ships to a decode engine via the
   **single-sequence KV handoff** (``DecodeEngine.export_sequence`` /
-  ``import_sequence`` — PR 5's snapshot serialization generalized from
-  whole-engine metadata to one uid's written blocks + int8 scales +
-  position, restored under the foreign pool's block numbering). Decode
-  engines therefore execute ZERO prefill dispatches — a prompt burst
-  lands on the prefill tier and running decodes never stall behind it.
+  ``import_sequence``, handoff doc v3 over the wire format). Decode
+  engines execute ZERO prefill dispatches.
 
 - **Migration as the same primitive**: pool exhaustion moves the
-  youngest running sequence to a peer with capacity via the same
-  export/import (live, no replay); an engine KILL migrates its
-  in-flight requests to survivors from its last **snapshot**
-  (``supervise.snapshot_state`` — the in-memory form of PR 5's crash
-  document), where replay fills the gap since that snapshot and
-  continues token-identically. The sampling keys fold
-  ``(seed, uid, position)`` — never the slot OR the engine — so a
-  migrated sequence's remaining tokens match the un-migrated oracle
-  bit for bit at every kv_dtype.
+  youngest running sequence to a peer with capacity (live, no replay);
+  a dead engine — dropped object or SIGKILLed process — migrates its
+  in-flight requests to survivors from the router's last snapshot of
+  it, where replay fills the gap since that snapshot and continues
+  token-identically. The sampling keys fold ``(seed, uid, position)``
+  — never the slot OR the engine — so a migrated sequence's remaining
+  tokens match the un-migrated oracle bit for bit at every kv_dtype.
 
-Every router decision emits one schema-v9 ``router`` record (routed /
-handoff / migrated / shed with source/target engine ids, the pinned
-``policy`` that placed it, the candidate scores the decision saw, and
-— on live moves — ``blocks``/``bytes``/``duration_s`` measured around
-export/import, the migration-stall instrumentation); each scheduling
-round additionally emits one ``fleet`` health record (per-engine
-waiting/active/free-blocks/utilization + a load-imbalance scalar).
-``report router eng0 eng1 ...`` folds them onto the merged timeline
-with a fleet-level latency/shed summary above the per-engine blocks,
-and ``report --slo TTFT:ITL`` turns the merged streams into goodput
-numbers (DESIGN.md section 21).
+**Chaos at the boundary** (``fleet_chaos=``, the ``--fleet_chaos``
+grammar, ``runtime/chaos.py`` FLEET_KINDS): ``kill_worker@R[:IDX]``
+SIGKILLs a decode worker at the start of round R; ``hang_worker@R[:S]``
+makes one go silent (the liveness ladder must declare it dead);
+``corrupt_wire@R`` bit-flips the next wire handoff in transit (the CRC
+layer must reject it with a named reason and the request must be
+replay-rerouted with no partial import). The tier-1 drill kills one of
+three worker PROCESSES mid-stream and pins byte-identical output
+against the unkilled oracle.
 
-The router is deliberately HOST-side and in-process: engines are
-stepped round-robin (one fleet round steps every engine once), so on
-CPU the parallel-speedup claim is made as a dispatch/step-count proxy
-(aggregate tokens per fleet ROUND — what wall clock would show if the
-replicas ran on their own chips), never as fake wall-clock. Multi-host
-transport (the doc is one dict of numpy arrays — npz on a wire) is
-ROADMAP follow-up.
+Every router decision emits one schema-v10 ``router`` record; live
+moves carry ``blocks``/``bytes``/``duration_s`` plus the pinned
+``transport`` attribution ({mode, bytes, crc_verify_s, retries} —
+``bytes`` is the SERIALIZED size, what actually crosses the boundary);
+a CRC rejection emits a ``wire_rejected`` record naming the reason.
+Each round additionally emits one ``fleet`` health record.
+``report router eng0 ...`` folds them onto the merged timeline
+(DESIGN.md sections 20-22).
 """
 
 from __future__ import annotations
@@ -69,6 +84,8 @@ from __future__ import annotations
 import collections
 import time
 
+from ..runtime import wire
+from ..runtime.wire import WireError
 from .engine import AdmissionError, DecodeEngine
 from .supervise import snapshot_state
 
@@ -77,18 +94,59 @@ from .supervise import snapshot_state
 DECODE_PREFIX = "e"
 PREFILL_PREFIX = "p"
 
+# hang_worker's default silence floor (seconds) when the spec has no
+# :SECS. The ACTUAL default is derived from the target handle's
+# per-call deadline at fire time (2.5x covers the deadline, its
+# bounded-backoff retry, and scheduling slack) so the liveness ladder
+# is GUARANTEED to declare the worker dead before it wakes — a fixed
+# constant shorter than the transport's deadline would just stall the
+# run and never fire the ladder it exists to drill
+HANG_WORKER_DEFAULT_S = 30.0
+
+
+class TransportError(RuntimeError):
+    """A worker transport call failed (the process boundary's failure
+    surface). The router's liveness ladder converts these into a
+    dead-host declaration + migrate-from-last-snapshot."""
+
+
+class TransportTimeout(TransportError):
+    """A call (or its bounded-backoff retries) overran its deadline —
+    the silent-worker signature."""
+
+
+class TransportDead(TransportError):
+    """The peer is gone (EOF / reset / process exited)."""
+
+
+class HandoffRef:
+    """One exported sequence in transit: either the in-process document
+    itself (``doc``) or a published wire file (``path``), plus the
+    scalar facts the router records either way."""
+
+    __slots__ = ("uid", "position", "blocks_written", "doc", "path")
+
+    def __init__(self, uid: int, position: int, blocks_written: int,
+                 doc: dict | None = None, path: str | None = None):
+        self.uid = uid
+        self.position = position
+        self.blocks_written = blocks_written
+        self.doc = doc
+        self.path = path
+
 
 class EngineHandle:
-    """One fleet member: the engine, its role, and its liveness. A
-    killed handle drops its engine object outright — the in-process
+    """One IN-PROCESS fleet member: the engine, its role, its liveness,
+    and the driver API the router speaks (``decode/worker.py``'s
+    ``ProcessEngineHandle`` implements the same surface over a socket).
+    A killed handle drops its engine object outright — the in-process
     simulation of a dead host — keeping only the last snapshot the
     router migrates from."""
 
-    __slots__ = ("id", "engine", "role", "alive", "snapshot",
-                 "killed_at_round", "last_tokens", "last_t",
-                 "last_step_s")
+    transport = "inproc"
 
-    def __init__(self, eid: str, engine: DecodeEngine, role: str):
+    def __init__(self, eid: str, engine: DecodeEngine, role: str,
+                 wire_dir: str | None = None):
         self.id = eid
         self.engine = engine
         self.role = role                    # "prefill" | "decode"
@@ -102,38 +160,243 @@ class EngineHandle:
         # round-robin loop serializes engines in-process, so timing a
         # whole round would charge every engine for its neighbors)
         self.last_step_s = 0.0
+        # wire_dir set => every export serializes through the versioned
+        # wire format and every import reads + CRC-verifies the file
+        # (the in-process floor for the process transport)
+        self.wire_dir = wire_dir
+        self._did = False
+        self._seq = 0
+
+    # -- identity / validation ----------------------------------------
+
+    def model_meta(self) -> dict:
+        return self.engine.model_meta()
+
+    def validate_member(self) -> None:
+        if self.engine.mesh is not None:
+            raise ValueError("fleet replicas are single-device "
+                             "(KV handoff has no TP path)")
+
+    # -- reads ---------------------------------------------------------
 
     @property
     def has_work(self) -> bool:
         return self.alive and bool(self.engine.waiting
                                    or self.engine.active)
 
+    def digest(self, light: bool = False) -> dict:
+        """The scheduler-state view every routing decision reads —
+        computed live in-process; the process transport returns the
+        digest riding each worker response (same keys, zero extra
+        round-trips, the flag ignored there — cached is cached).
+        ``light=True`` skips the per-slot list for the hot-path scalar
+        reads (load keys, capacity probes, fleet records) — the O(1)
+        admission-path discipline."""
+        e = self.engine
+        d = {
+            "waiting": len(e.waiting),
+            "active": e.active,
+            "free_slots": sum(1 for s in e.slots if s is None),
+            "free_blocks": len(e.free_blocks),
+            "evictable": (e.prefix.evictable_blocks()
+                          if e.prefix is not None else 0),
+            "utilization": e.kv_pool_utilization(),
+            "head": ({"prompt_len": len(e.waiting[0].prompt),
+                      "max_new": e.waiting[0].max_new}
+                     if e.waiting else None),
+        }
+        if not light:
+            d["slots"] = [{"uid": s.uid, "prompt_done": s.prompt_done,
+                           "admit_index": s.admit_index,
+                           "prompt_len": len(s.prompt),
+                           "max_new": s.max_new}
+                          for s in e.slots if s is not None]
+        return d
+
+    def blocks_needed(self, prompt_len: int, max_new: int) -> int:
+        return self.engine._blocks_needed(prompt_len, max_new)
+
+    def max_blocks_per_seq(self) -> int:
+        return self.engine.cfg.max_blocks_per_seq
+
+    def warm_blocks(self, prompt) -> int | None:
+        """Radix-tree warm-path depth for ``prompt`` (None when the
+        prefix cache is off) — the prefix-affinity probe. Host-side
+        read only; probing never steps an engine."""
+        if self.engine.prefix is None:
+            return None
+        return self.engine.prefix.warm_blocks(prompt)
+
+    # -- scheduling ----------------------------------------------------
+
+    def submit(self, prompt, max_new: int, uid: int) -> dict:
+        """Submit; returns the WAITING snapshot entry for the router's
+        O(1) snapshot-append discipline (raises ``AdmissionError`` on a
+        full queue — the caller's spillover path)."""
+        self.engine.submit(prompt, max_new, uid=uid)
+        seq = next(s for s in reversed(self.engine.waiting)
+                   if s.uid == uid)
+        return {"uid": seq.uid, "prompt": seq.prompt, "out": seq.out,
+                "max_new": seq.max_new, "retries": seq.retries,
+                "t_submit": seq.t_submit,
+                "submit_step": seq.submit_step,
+                "t_first": None,       # no first token yet
+                "state": "WAITING"}
+
+    def resume_request(self, uid: int, prompt, max_new: int, *, out=(),
+                       retries: int = 0, t_submit=None,
+                       t_first=None) -> None:
+        self.engine.resume_request(uid, prompt, max_new, out=out,
+                                   retries=retries, t_submit=t_submit,
+                                   t_first=t_first)
+
+    def step_begin(self, prefill_only: bool = False) -> None:
+        """First half of one fleet-round step. In-process the step runs
+        here (synchronously); the process transport SENDS the step to
+        the worker so all workers step concurrently and ``step_end``
+        collects."""
+        t0 = time.perf_counter()
+        self._did = self.engine.step(prefill_only=prefill_only)
+        self.last_step_s = time.perf_counter() - t0
+
+    def step_end(self) -> bool:
+        return self._did
+
+    def fetch_snapshot(self) -> dict:
+        return snapshot_state(self.engine)
+
+    # -- the KV handoff ------------------------------------------------
+
+    def export(self, uid: int) -> HandoffRef:
+        """Export one resident fully-prefilled sequence. With a
+        ``wire_dir`` the document is serialized + atomically published
+        as a wire file (per-array CRC-32); otherwise the doc rides
+        in-process."""
+        doc = self.engine.export_sequence(uid)
+        ref = HandoffRef(uid, int(doc["position"]),
+                         int(doc["blocks_written"]))
+        if self.wire_dir is None:
+            ref.doc = doc
+        else:
+            import os
+            os.makedirs(self.wire_dir, exist_ok=True)
+            self._seq += 1
+            ref.path = os.path.join(
+                self.wire_dir, f"handoff_{self.id}_{uid}_{self._seq}.npz")
+            wire.write_doc(ref.path, doc)
+        return ref
+
+    def import_doc(self, ref: HandoffRef) -> dict:
+        """Import a handoff; returns the transport attribution
+        ({mode, crc_verify_s, and — off the wire — bytes}). A doc-
+        passing move reports no bytes here: the caller computes the
+        serialized size OUTSIDE its timed window (``_move``), so the
+        in-process stall numbers stay an honest floor for the wire
+        lane instead of quietly including a serialization of their
+        own. Raises ``WireError`` (one-line named reason) on a
+        torn/corrupted wire file, BEFORE any engine state is
+        touched."""
+        if ref.doc is not None:
+            self.engine.import_sequence(ref.doc)
+            return {"mode": "inproc", "crc_verify_s": None}
+        stats: dict = {}
+        doc = wire.read_doc(ref.path, stats)    # raises WireError
+        self.engine.import_sequence(doc)
+        import os
+        try:
+            os.unlink(ref.path)     # consumed; rejected files are kept
+        except OSError:
+            pass
+        return {"mode": "wire", "bytes": stats["bytes"],
+                "crc_verify_s": stats["crc_verify_s"]}
+
+    # -- drain/telemetry surfaces --------------------------------------
+
+    def results(self) -> dict[int, list[int]]:
+        return dict(self.engine.finished)
+
+    def failed_map(self) -> dict[int, dict]:
+        return {u: dict(i) for u, i in self.engine.failed.items()}
+
+    def stats(self) -> dict:
+        e = self.engine
+        return {
+            "engine_steps": e.global_step,
+            "tokens_generated": e.tokens_generated,
+            "prefill_dispatches": e.prefill_dispatches,
+            "compiled_programs": e.compile_count,
+            "dispatches": e.dispatch_count,
+            "finished": len(e.finished),
+            "prefix_hit_blocks": e.prefix_hit_blocks,
+            "prefill_tokens_saved": e.prefill_tokens_saved,
+        }
+
+    def emit_decode(self) -> None:
+        if self.engine.metrics is None:
+            return
+        now = time.perf_counter()
+        delta = self.engine.tokens_generated - self.last_tokens
+        dt = max(now - self.last_t, 1e-9)
+        tps = round(delta / dt, 2) if delta > 0 else None
+        self.engine.metrics.decode(self.engine.telemetry_record(tps))
+        self.last_tokens = self.engine.tokens_generated
+        self.last_t = now
+
+    # -- liveness ------------------------------------------------------
+
+    def ping(self) -> None:
+        """Heartbeat no-op in-process (the process transport's ping is
+        a real round-trip with a short deadline)."""
+
+    def hang(self, secs: float) -> None:
+        raise ValueError(
+            "hang_worker requires the process transport (an in-process "
+            "engine cannot go silent without hanging the router) — run "
+            "the fleet with --transport process")
+
+    def kill(self) -> None:
+        """Drop the engine object — the in-process dead host. Its pool,
+        like a dead host's HBM, is unreachable afterwards."""
+        self.alive = False
+        self.engine = None
+
+    def close(self) -> None:
+        """Release transport resources (no-op in-process)."""
+
 
 class FleetRouter:
-    """N ``DecodeEngine`` replicas behind one admission point.
+    """N decode-engine replicas behind one admission point.
 
     ``make_engine(engine_id)`` is a factory returning a FRESH
     single-device engine per fleet member (attach a per-engine
-    ``TelemetryWriter`` inside it; the router never shares one). All
-    engines must share the numerics-relevant ``EngineConfig`` keys and
-    the model — the handoff's own fingerprint check enforces it at
-    migration time, and the router cross-checks fingerprints up front
-    so a mismatched fleet fails at construction, not mid-drill.
+    ``TelemetryWriter`` inside it; the router never shares one), OR
+    pass pre-built ``handles=`` (the process transport:
+    ``decode/worker.py`` spawns the workers and hands their
+    ``ProcessEngineHandle``s over). All engines must share the
+    numerics-relevant ``EngineConfig`` keys and the model — the
+    handoff's own fingerprint check enforces it at migration time, and
+    the router cross-checks fingerprints up front so a mismatched fleet
+    fails at construction, not mid-drill.
 
     ``prefill_engines=M`` dedicates the first M members to prefill
     (disaggregation); ``0`` runs every engine unified. ``n_engines``
     may be 1 (the router degenerates to a pass-through — the honest
     N=1 baseline for the bench scaling rows); the CLI requires >= 2.
 
-    ``snapshot_every`` is the in-memory snapshot cadence in fleet
+    ``snapshot_every`` is the router-held snapshot cadence in fleet
     rounds (the PR 5 discipline: a kill migrates from the LAST
-    snapshot and replay fills the gap since it).
+    snapshot and replay fills the gap since it). ``wire_dir`` routes
+    every in-process live move through the wire format (serialize +
+    CRC-verify + import from the published file). ``fleet_chaos`` is a
+    validated ``FaultPlan`` of FLEET_KINDS faults, fired on the
+    router's round clock.
     """
 
     def __init__(self, make_engine, n_engines: int,
                  prefill_engines: int = 0, *, metrics=None,
                  snapshot_every: int = 1, session_affinity: bool = True,
-                 prefix_affinity: bool = True):
+                 prefix_affinity: bool = True, wire_dir: str | None = None,
+                 handles: list | None = None, fleet_chaos=None):
         if n_engines < 1:
             raise ValueError(f"n_engines must be >= 1, got {n_engines}")
         if not 0 <= prefill_engines < n_engines:
@@ -143,29 +406,70 @@ class FleetRouter:
         if snapshot_every < 1:
             raise ValueError(f"snapshot_every must be >= 1, got "
                              f"{snapshot_every}")
-        self.handles: list[EngineHandle] = []
-        for i in range(prefill_engines):
-            eid = f"{PREFILL_PREFIX}{i}"
-            self.handles.append(EngineHandle(eid, make_engine(eid),
-                                             "prefill"))
-        for i in range(n_engines - prefill_engines):
-            eid = f"{DECODE_PREFIX}{i}"
-            self.handles.append(EngineHandle(eid, make_engine(eid),
-                                             "decode"))
-        metas = [h.engine.model_meta() for h in self.handles]
+        if handles is not None:
+            if len(handles) != n_engines:
+                raise ValueError(f"{len(handles)} handle(s) for "
+                                 f"n_engines={n_engines}")
+            self.handles = list(handles)
+        else:
+            self.handles = []
+            for i in range(prefill_engines):
+                eid = f"{PREFILL_PREFIX}{i}"
+                self.handles.append(EngineHandle(
+                    eid, make_engine(eid), "prefill", wire_dir=wire_dir))
+            for i in range(n_engines - prefill_engines):
+                eid = f"{DECODE_PREFIX}{i}"
+                self.handles.append(EngineHandle(
+                    eid, make_engine(eid), "decode", wire_dir=wire_dir))
+        metas = [h.model_meta() for h in self.handles]
         if any(m != metas[0] for m in metas[1:]):
             raise ValueError("fleet engines disagree on model identity "
                              f"({metas}) — every replica must serve the "
                              "same weights")
         for h in self.handles:
-            if h.engine.mesh is not None:
-                raise ValueError("fleet replicas are single-device "
-                                 "(KV handoff has no TP path)")
+            h.validate_member()
         self.by_id = {h.id: h for h in self.handles}
         self.metrics = metrics              # the ROUTER's own writer
         self.snapshot_every = snapshot_every
         self.session_affinity = session_affinity
         self.prefix_affinity = prefix_affinity
+        self.fleet_chaos = fleet_chaos
+        if fleet_chaos is not None:
+            # every fault the plan can fire must be honorable by THIS
+            # fleet — reject at construction, not rounds later at fire
+            # time (the CLI's parse-rejection discipline, enforced once
+            # here so library callers get it too)
+            kinds = {f.kind for f in fleet_chaos.faults}
+            wired = wire_dir is not None or any(
+                h.transport == "process" for h in self.handles)
+            if "corrupt_wire" in kinds and not wired:
+                raise ValueError(
+                    "corrupt_wire needs a wire boundary to corrupt: "
+                    "run the fleet with --transport process (or an "
+                    "in-process wire_dir)")
+            decode_handles = [h for h in self.handles
+                              if h.role == "decode"]
+            if "hang_worker" in kinds and any(
+                    h.transport != "process" for h in decode_handles):
+                raise ValueError(
+                    "hang_worker requires the process transport (an "
+                    "in-process engine cannot go silent without "
+                    "hanging the router) — run the fleet with "
+                    "--transport process")
+            for f in fleet_chaos.faults:
+                if f.kind != "kill_worker":
+                    continue
+                idx = 0 if f.arg is None else int(f.arg)
+                if idx >= len(decode_handles):
+                    raise ValueError(
+                        f"kill_worker index {idx} names e{idx}, but "
+                        f"this fleet has {len(decode_handles)} decode "
+                        "engine(s)")
+                if len(decode_handles) == 1:
+                    raise ValueError(
+                        "kill_worker would kill the only decode "
+                        "engine in this fleet (the survivors have "
+                        "nowhere to migrate its requests)")
         self.rounds = 0                     # fleet scheduling rounds
         self._next_uid = 0
         self._sessions: dict = {}           # session -> engine id
@@ -185,16 +489,23 @@ class FleetRouter:
         self.kills = 0
         self.routed_by = {"least_loaded": 0, "session": 0, "prefix": 0}
         self.prefix_routed_hit_blocks = 0
-        # migration-stall instrumentation (round 15, ROADMAP item 1's
-        # bench criterion): every LIVE move (export_sequence ->
-        # import_sequence — prefill handoff or pool-pressure migration)
-        # accumulates the blocks/bytes shipped and its wall-clock
-        # duration; replay-migrations off a dead engine's snapshot ship
-        # no KV and stay out of these (their own records carry a
-        # duration_s with blocks/bytes 0)
+        # migration-stall instrumentation (ROADMAP item 1's bench
+        # criterion): every LIVE move (export -> import — prefill
+        # handoff or pool-pressure migration) accumulates the blocks
+        # and SERIALIZED bytes shipped and its wall-clock duration;
+        # replay-migrations off a dead engine's snapshot ship no KV and
+        # stay out of these (their records carry duration_s with
+        # blocks/bytes 0 and transport mode "replay")
         self.handoff_blocks = 0
         self.handoff_bytes = 0
         self.handoff_durations: list[float] = []
+        # wire-integrity accounting (round 16): rejected handoff files
+        # (CRC/torn/version — each also emitted a ``wire_rejected``
+        # router record with the one-line reason) and per-uid rejection
+        # counts (the ``retries`` field of the next successful move)
+        self.wire_rejects = 0
+        self._uid_wire_rejects: dict[int, int] = {}
+        self._corrupt_next_wire = False
 
     # -- introspection -------------------------------------------------
 
@@ -204,6 +515,18 @@ class FleetRouter:
 
     def engine(self, eid: str) -> DecodeEngine:
         return self.by_id[eid].engine
+
+    def close(self) -> None:
+        """Release every handle's transport resources (shuts down
+        worker processes under the process transport). Idempotent."""
+        for h in self.handles:
+            h.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
     # -- telemetry -----------------------------------------------------
 
@@ -229,17 +552,16 @@ class FleetRouter:
         engine."""
         out = []
         for h in handles:
-            e = h.engine
+            d = h.digest(light=True)
             warm = None
-            if (prompt is not None and self.prefix_affinity
-                    and e.prefix is not None):
-                warm = e.prefix.warm_blocks(prompt)
+            if prompt is not None and self.prefix_affinity:
+                warm = h.warm_blocks(prompt)
             out.append({
                 "engine": h.id,
                 "warm_blocks": warm,
-                "queue_depth": len(e.waiting),
-                "active": e.active,
-                "pool_utilization": round(e.kv_pool_utilization(), 4),
+                "queue_depth": d["waiting"],
+                "active": d["active"],
+                "pool_utilization": round(d["utilization"], 4),
             })
         return out
 
@@ -255,15 +577,15 @@ class FleetRouter:
             if not h.alive:
                 engines[h.id] = {"alive": False}
                 continue
-            e = h.engine
+            d = h.digest(light=True)
             engines[h.id] = {
                 "alive": True, "role": h.role,
-                "waiting": len(e.waiting), "active": e.active,
-                "free_blocks": len(e.free_blocks),
-                "utilization": round(e.kv_pool_utilization(), 4),
+                "waiting": d["waiting"], "active": d["active"],
+                "free_blocks": d["free_blocks"],
+                "utilization": round(d["utilization"], 4),
             }
             if h.role == "decode":
-                loads.append(e.active + len(e.waiting))
+                loads.append(d["active"] + d["waiting"])
         imb = 0.0
         if len(loads) > 1 and max(loads) > 0:
             imb = round((max(loads) - min(loads)) / max(loads), 4)
@@ -276,25 +598,22 @@ class FleetRouter:
         """Least-loaded ordering: queue depth first (waiting work is
         the latency the next request inherits), then slot occupancy,
         then pool pressure — engine id breaks ties deterministically."""
-        e = h.engine
-        return (len(e.waiting), e.active,
-                round(e.kv_pool_utilization(), 4), h.id)
+        d = h.digest(light=True)
+        return (d["waiting"], d["active"],
+                round(d["utilization"], 4), h.id)
 
     def _has_capacity(self, h: EngineHandle, prompt_len: int,
                       max_new: int) -> bool:
         """Can ``h`` take a handoff IMPORT right now (free slot + full
         block reservation)? Queue-based admission never needs this —
         submit/resume queue and the engine admits when space frees."""
-        e = h.engine
-        if not any(s is None for s in e.slots):
+        d = h.digest(light=True)
+        if d["free_slots"] < 1:
             return False
-        need = e._blocks_needed(prompt_len, max_new)
-        if need > e.cfg.max_blocks_per_seq:
+        need = h.blocks_needed(prompt_len, max_new)
+        if need > h.max_blocks_per_seq():
             return False
-        avail = len(e.free_blocks)
-        if e.prefix is not None:
-            avail += e.prefix.evictable_blocks()
-        return need <= avail
+        return need <= d["free_blocks"] + d["evictable"]
 
     def _route(self, prompt, session, warm_by_id=None):
         """Pick the decode-tier engine for a fresh request. Precedence:
@@ -317,8 +636,8 @@ class FleetRouter:
                 warm = [(warm_by_id[h.id], h) for h in handles
                         if warm_by_id.get(h.id) is not None]
             else:
-                warm = [(h.engine.prefix.warm_blocks(prompt), h)
-                        for h in handles if h.engine.prefix is not None]
+                warm = [(w, h) for h in handles
+                        if (w := h.warm_blocks(prompt)) is not None]
             best = max((w for w, _ in warm), default=0)
             if best > 0:
                 tied = [h for w, h in warm if w == best]
@@ -371,8 +690,8 @@ class FleetRouter:
         spilled = False
         for h in order:
             try:
-                h.engine.submit(prompt, max_new, uid=uid)
-            except AdmissionError as e:
+                entry = h.submit(prompt, max_new, uid=uid)
+            except AdmissionError:
                 shed_reasons.append(f"{h.id}: queue_full")
                 # spillover loses affinity — including the warm-block
                 # count probed for the ORIGINAL target (the next engine
@@ -399,24 +718,17 @@ class FleetRouter:
                          candidates=candidates)
             # the step-0 snapshot discipline: a kill before the first
             # cadence snapshot must still know this request exists.
-            # O(1) per submit: append the one new WAITING entry to the
-            # handle's existing snapshot instead of re-serializing the
-            # whole engine (a burst of n submissions must not pay
-            # O(n^2) host work on the admission path) — the cadence
-            # snapshot already lags by design, and kill-migration only
-            # needs the request LISTED (resume replays from `out`)
+            # O(1) per submit: append the one new WAITING entry
+            # (returned by the handle's submit) to the existing
+            # snapshot instead of re-serializing the whole engine — a
+            # burst of n submissions must not pay O(n^2) host work on
+            # the admission path; the cadence snapshot already lags by
+            # design, and kill-migration only needs the request LISTED
+            # (resume replays from `out`)
             if h.snapshot is None:
-                h.snapshot = snapshot_state(h.engine)
+                h.snapshot = h.fetch_snapshot()
             else:
-                seq = next(s for s in reversed(h.engine.waiting)
-                           if s.uid == uid)
-                h.snapshot["requests"].append(
-                    {"uid": seq.uid, "prompt": seq.prompt,
-                     "out": seq.out, "max_new": seq.max_new,
-                     "retries": seq.retries, "t_submit": seq.t_submit,
-                     "submit_step": seq.submit_step,
-                     "t_first": None,       # no first token yet
-                     "state": "WAITING"})
+                h.snapshot["requests"].append(entry)
             return uid
         self.sheds += 1
         self._record("shed", uid, reason="queue_full")
@@ -426,22 +738,87 @@ class FleetRouter:
 
     # -- the fleet round -----------------------------------------------
 
+    def _fire_fleet_chaos(self) -> bool:
+        """Fire fleet-transport faults due at the START of this round
+        (``runtime/chaos.py`` FLEET_KINDS). Returns whether any
+        fired."""
+        if self.fleet_chaos is None:
+            return False
+        fired = False
+        for f in self.fleet_chaos.fleet_due(self.rounds):
+            fired = True
+            if f.kind == "kill_worker":
+                idx = 0 if f.arg is None else int(f.arg)
+                eid = f"{DECODE_PREFIX}{idx}"
+                if eid not in self.by_id:
+                    raise ValueError(f"kill_worker index {idx} names "
+                                     f"unknown engine {eid!r}")
+                self.fleet_chaos._note(f, engine=eid)
+                self.kill_engine(eid)
+            elif f.kind == "hang_worker":
+                cands = self.alive_handles("decode")
+                if not cands:
+                    continue
+                if f.arg is None:
+                    # derived default: strictly past the target's
+                    # deadline + retry window, whatever it is tuned to
+                    deadline = getattr(cands[0], "call_deadline_s", 0.0)
+                    secs = max(HANG_WORKER_DEFAULT_S, 2.5 * deadline)
+                else:
+                    secs = float(f.arg)
+                self.fleet_chaos._note(f, engine=cands[0].id,
+                                       sleep_s=secs)
+                cands[0].hang(secs)
+            elif f.kind == "corrupt_wire":
+                self.fleet_chaos._note(f)
+                self._corrupt_next_wire = True
+        return fired
+
     def step(self) -> bool:
-        """One fleet scheduling round: fire due kills (the chaos
-        clock), step every alive engine once, ship completed prefills
-        to the decode tier, relieve pool pressure by migration, then
-        refresh the in-memory snapshots on cadence. Returns whether any
-        engine ran work this round."""
+        """One fleet scheduling round: fire due chaos + kills (the
+        round clock), step every alive engine once — CONCURRENTLY
+        under the process transport (step_begin fans out, step_end
+        collects; a worker that misses its deadline or drops its
+        connection is declared dead mid-round and its requests migrate
+        before the round continues) — heartbeat-ping the idle members,
+        ship completed prefills to the decode tier, relieve pool
+        pressure by migration, then refresh the router-held snapshots
+        on cadence. Returns whether any engine ran work this round."""
+        did = self._fire_fleet_chaos()
         killed = bool(self._kills.get(self.rounds))
         for eid in self._kills.pop(self.rounds, ()):
             self.kill_engine(eid)
-        did = killed
+        did = did or killed
+        stepping, idle = [], []
         for h in self.handles:
-            if h.has_work:
-                t0 = time.perf_counter()
-                did = h.engine.step(prefill_only=(h.role == "prefill")) \
-                    or did
-                h.last_step_s = time.perf_counter() - t0
+            (stepping if h.has_work else idle).append(h)
+        for h in stepping:
+            if not h.alive:
+                continue
+            try:
+                h.step_begin(prefill_only=(h.role == "prefill"))
+            except TransportError as e:
+                self._transport_death(h, e)
+                did = True
+        for h in stepping:
+            if not h.alive:
+                continue
+            try:
+                did = h.step_end() or did
+            except TransportError as e:
+                self._transport_death(h, e)
+                did = True
+        # heartbeat liveness: members with no work this round still
+        # answer a cheap ping (short deadline) — a dead IDLE worker is
+        # declared now, not discovered when the router finally needs it
+        # (it may hold finished results only its snapshot remembers)
+        for h in idle:
+            if not h.alive:
+                continue
+            try:
+                h.ping()
+            except TransportError as e:
+                self._transport_death(h, e)
         before = self.handoffs + self.migrations
         self._handoff_completed_prefills()
         self._migrate_pool_pressure()
@@ -450,7 +827,7 @@ class FleetRouter:
         if self.rounds % self.snapshot_every == 0:
             for h in self.handles:
                 if h.alive:
-                    h.snapshot = snapshot_state(h.engine)
+                    h.snapshot = h.fetch_snapshot()
         # one fleet health record per round (schema v9): the
         # per-engine balance view the SLO/autoscaling layer reads.
         # ``step`` is the post-round clock — record N describes the
@@ -466,36 +843,100 @@ class FleetRouter:
                  and self._has_capacity(h, prompt_len, max_new)]
         return min(cands, key=self._load_key) if cands else None
 
-    @staticmethod
-    def _doc_bytes(doc: dict) -> int:
-        """Wire bytes of one handoff document's KV payload (values +
-        int8 scales at the storage dtype) — the ``bytes`` a multi-host
-        transport would actually ship (ROADMAP item 1's criterion;
-        the scheduler-state envelope is noise next to the arrays)."""
-        n = 0
-        for key in ("k", "v", "k_scale", "v_scale"):
-            arr = doc.get(key)
-            if arr is not None:
-                n += int(arr.nbytes)
-        return n
-
     def _move(self, source: EngineHandle, target: EngineHandle,
               uid: int):
-        """One LIVE sequence move (export -> import), instrumented:
-        returns ``(doc, blocks, bytes, duration_s)`` and feeds the
-        migration-stall accumulators (blocks shipped/s, stall p90 —
-        the wall clock is the CPU proxy for a wire transport's
-        serialize+ship+implant cost)."""
+        """One LIVE sequence move (export -> serialize/ship -> verify
+        -> import), instrumented: returns ``(ref, blocks, bytes,
+        duration_s, transport)`` and feeds the migration-stall
+        accumulators. ``transport`` is the schema-v10 attribution
+        ({mode, bytes, crc_verify_s, retries}); a CRC/torn/version
+        rejection raises ``WireError`` with the target engine
+        untouched (import validates before it allocates)."""
         t0 = time.perf_counter()
-        doc = source.engine.export_sequence(uid)
-        target.engine.import_sequence(doc)
+        ref = source.export(uid)
+        if self._corrupt_next_wire and ref.path is not None:
+            _corrupt_wire_file(ref.path)
+            self._corrupt_next_wire = False
+        info = target.import_doc(ref)       # raises WireError on damage
         dur = time.perf_counter() - t0
-        blocks = int(doc["blocks_written"])
-        nbytes = self._doc_bytes(doc)
+        blocks = ref.blocks_written
+        # an in-process doc move reports the SERIALIZED size too (the
+        # satellite: bytes = what would cross a boundary, never the
+        # nbytes sum) — computed HERE, outside the timed window, so the
+        # floor's stall numbers don't include a serialization the
+        # in-process transport never performs
+        nbytes = (int(info["bytes"]) if "bytes" in info
+                  else wire.doc_wire_bytes(ref.doc))
         self.handoff_blocks += blocks
         self.handoff_bytes += nbytes
         self.handoff_durations.append(dur)
-        return doc, blocks, nbytes, dur
+        transport = {"mode": info["mode"], "bytes": nbytes,
+                     "crc_verify_s": info.get("crc_verify_s"),
+                     "retries": self._uid_wire_rejects.get(uid, 0)}
+        return ref, blocks, nbytes, dur, transport
+
+    def _replay_transport(self, uid: int) -> dict:
+        """The transport attribution for a replay-migration: no KV
+        ships (the source pool is unreachable or its export was
+        rejected), so bytes are honestly 0 and the replay length on
+        the record names the catch-up cost instead."""
+        return {"mode": "replay", "bytes": 0, "crc_verify_s": None,
+                "retries": self._uid_wire_rejects.get(uid, 0)}
+
+    def _wire_rejected(self, source: EngineHandle, target: EngineHandle,
+                       uid: int, err: WireError, context: str) -> None:
+        """A wire handoff failed integrity checks: record the named
+        reason, then re-route the request by REPLAY from the source's
+        last router-held snapshot (export already evicted it there —
+        the stale snapshot still lists the request with its emitted
+        tokens, and replay from ANY out-prefix regenerates the same
+        continuation, so token identity survives the rejected file).
+        The target engine was never touched (import validates before
+        it allocates) and remains a legitimate replay destination."""
+        self.wire_rejects += 1
+        self._uid_wire_rejects[uid] = \
+            self._uid_wire_rejects.get(uid, 0) + 1
+        self._record("wire_rejected", uid, source=source.id,
+                     target=target.id, reason=str(err))
+        self._event({"event": "wire_rejected", "uid": int(uid),
+                     "source": source.id, "target": target.id,
+                     "context": context, "reason": str(err)})
+        entry = None
+        if source.snapshot is not None:
+            entry = next((r for r in source.snapshot["requests"]
+                          if int(r["uid"]) == uid), None)
+        req = self.requests[uid]
+        dest = min(self.alive_handles("decode"), key=self._load_key)
+        t0 = time.perf_counter()
+        if entry is not None:
+            dest.resume_request(uid, entry["prompt"], entry["max_new"],
+                                out=entry["out"],
+                                retries=entry["retries"],
+                                t_submit=entry.get("t_submit"),
+                                t_first=entry.get("t_first"))
+            replay = len(entry["out"])
+        else:
+            # no snapshot entry (a submit-then-immediate-move corner):
+            # replay from the request book — more catch-up, same tokens
+            dest.resume_request(uid, req["prompt"], req["max_new"])
+            replay = 0
+        dur = time.perf_counter() - t0
+        req["engine"] = dest.id
+        if req.get("session") is not None:
+            # the reroute moved the session's KV locality with it — a
+            # stale affinity entry would split the session across two
+            # live engines (the success-path handoff updates it too)
+            self._sessions[req["session"]] = dest.id
+        self.migrations += 1
+        self._record("migrated", uid, source=source.id, target=dest.id,
+                     reason="wire_rejected", replay=replay, blocks=0,
+                     bytes=0, duration_s=round(dur, 6),
+                     transport=self._replay_transport(uid))
+        # the uid is gone from the source engine (export evicted it):
+        # refresh its snapshot so a later death can't resurrect it, and
+        # the destination's so a later death can't lose it
+        source.snapshot = source.fetch_snapshot()
+        dest.snapshot = dest.fetch_snapshot()
 
     def _handoff_completed_prefills(self) -> None:
         """Ship every fully-prefilled sequence off the prefill tier.
@@ -507,29 +948,38 @@ class FleetRouter:
         rather than silently decoding on the wrong tier — tier purity
         is what the dispatch-count proof pins."""
         for ph in self.alive_handles("prefill"):
-            ready = [s.uid for s in ph.engine.slots
-                     if s is not None and s.prompt_done]
+            if ph.digest(light=True)["active"] < 1:
+                continue        # nothing resident, nothing to ship
+            ready = [s["uid"] for s in ph.digest()["slots"]
+                     if s["prompt_done"]]
             for uid in ready:
                 req = self.requests[uid]
                 target = self._placement_target(len(req["prompt"]),
                                                 req["max_new"])
                 if target is None:
                     continue
-                doc, blocks, nbytes, dur = self._move(ph, target, uid)
+                try:
+                    ref, blocks, nbytes, dur, transport = \
+                        self._move(ph, target, uid)
+                except WireError as e:
+                    self._wire_rejected(ph, target, uid, e,
+                                        context="handoff")
+                    continue
                 self.handoffs += 1
                 req["engine"] = target.id
                 if req["session"] is not None:
                     self._sessions[req["session"]] = target.id
                 self._record("handoff", uid, source=ph.id,
                              target=target.id, reason="prefill_done",
-                             position=doc["position"], blocks=blocks,
-                             bytes=nbytes, duration_s=round(dur, 6))
+                             position=ref.position, blocks=blocks,
+                             bytes=nbytes, duration_s=round(dur, 6),
+                             transport=transport)
                 # refresh BOTH snapshots now: a kill before the next
                 # cadence snapshot must neither lose the moved request
                 # (target's snapshot predates it) nor resurrect it on
                 # the source (whose stale snapshot still lists it)
-                ph.snapshot = snapshot_state(ph.engine)
-                target.snapshot = snapshot_state(target.engine)
+                ph.snapshot = ph.fetch_snapshot()
+                target.snapshot = target.fetch_snapshot()
 
     def _migrate_pool_pressure(self) -> None:
         """A starved engine (head-of-line waiter has a free slot but
@@ -539,21 +989,20 @@ class FleetRouter:
         (the oldest resident keeps making progress), but the victim
         keeps running instead of losing its KV."""
         for h in self.alive_handles("decode"):
-            e = h.engine
-            if not e.waiting:
-                continue
-            head = e.waiting[0]
-            if not any(s is None for s in e.slots):
-                continue                    # slot-starved, not pool
-            need = e._blocks_needed(len(head.prompt), head.max_new)
-            avail = len(e.free_blocks)
-            if e.prefix is not None:
-                avail += e.prefix.evictable_blocks()
-            if need <= avail:
+            # light digest for the steady-state early exits; the
+            # per-slot list is only materialized in the rare
+            # pool-starved case that actually picks a victim
+            d = h.digest(light=True)
+            if not d["waiting"] or d["free_slots"] < 1:
+                continue                    # idle, or slot-starved
+            head = d["head"]
+            need = h.blocks_needed(head["prompt_len"], head["max_new"])
+            if need <= d["free_blocks"] + d["evictable"]:
                 continue                    # admission will take it
-            victims = [(s.admit_index, s.uid, len(s.prompt), s.max_new)
-                       for s in e.slots
-                       if s is not None and s.prompt_done]
+            victims = [(s["admit_index"], s["uid"], s["prompt_len"],
+                        s["max_new"])
+                       for s in h.digest()["slots"]
+                       if s["prompt_done"]]
             if not victims:
                 continue
             _, uid, plen, mnew = max(victims)
@@ -561,16 +1010,23 @@ class FleetRouter:
                                             exclude=(h.id,))
             if target is None:
                 continue
-            doc, blocks, nbytes, dur = self._move(h, target, uid)
+            try:
+                ref, blocks, nbytes, dur, transport = \
+                    self._move(h, target, uid)
+            except WireError as e:
+                self._wire_rejected(h, target, uid, e,
+                                    context="pool_pressure")
+                continue
             self.migrations += 1
             self.requests[uid]["engine"] = target.id
             self._record("migrated", uid, source=h.id,
                          target=target.id, reason="pool_pressure",
-                         position=doc["position"], blocks=blocks,
-                         bytes=nbytes, duration_s=round(dur, 6))
+                         position=ref.position, blocks=blocks,
+                         bytes=nbytes, duration_s=round(dur, 6),
+                         transport=transport)
             # the handoff snapshot-refresh discipline (see above)
-            h.snapshot = snapshot_state(e)
-            target.snapshot = snapshot_state(target.engine)
+            h.snapshot = h.fetch_snapshot()
+            target.snapshot = target.fetch_snapshot()
 
     # -- failure (the chaos drill's surface) ---------------------------
 
@@ -578,13 +1034,30 @@ class FleetRouter:
         """Arm a deterministic engine kill at the START of fleet round
         ``at_round`` (the round's snapshot cadence has NOT yet run —
         the last snapshot honestly lags by up to ``snapshot_every``
-        rounds, and replay fills exactly that gap)."""
+        rounds, and replay fills exactly that gap). Under the process
+        transport this is a REAL SIGKILL of the worker process."""
         if engine_id not in self.by_id:
             raise ValueError(f"unknown engine id {engine_id!r} "
                              f"(fleet: {sorted(self.by_id)})")
         if at_round < 0:
             raise ValueError(f"kill round must be >= 0, got {at_round}")
         self._kills[at_round].append(engine_id)
+
+    def _transport_death(self, h: EngineHandle, err: Exception) -> None:
+        """The liveness ladder's verdict: a worker stopped answering
+        (deadline + bounded-backoff retries exhausted, or its
+        connection dropped). Declare it dead — SIGKILL the process so a
+        zombie can't answer a stale request later — and migrate its
+        requests from the last snapshot, exactly the kill path."""
+        self._event({"event": "worker_dead", "engine": h.id,
+                     "round": self.rounds,
+                     "reason": f"{type(err).__name__}: {err}"})
+        h.kill()
+        h.killed_at_round = self.rounds
+        self.kills += 1
+        self._event({"event": "engine_killed", "engine": h.id,
+                     "round": self.rounds})
+        self._recover_dead(h)
 
     def kill_engine(self, engine_id: str) -> int:
         """Kill one engine NOW and migrate its in-flight requests to
@@ -594,20 +1067,25 @@ class FleetRouter:
         re-prefilled, recorded tokens teacher-forced, so the rebuilt KV
         write history and the remaining tokens are bit-identical to the
         uninterrupted run's). Returns the number of migrated requests.
-        The engine object is dropped — its pool, like a dead host's
-        HBM, is unreachable."""
+        In-process the engine object is dropped; under the process
+        transport the worker is SIGKILLed — a real dead host either
+        way, its pool unreachable."""
         h = self.by_id.get(engine_id)
         if h is None:
             raise ValueError(f"unknown engine id {engine_id!r}")
         if not h.alive:
             return 0
-        snap = h.snapshot
-        h.alive = False
+        h.kill()
         h.killed_at_round = self.rounds
-        h.engine = None
         self.kills += 1
         self._event({"event": "engine_killed", "engine": h.id,
                      "round": self.rounds})
+        return self._recover_dead(h)
+
+    def _recover_dead(self, h: EngineHandle) -> int:
+        """Migrate a dead member's requests off its last router-held
+        snapshot (replay-resume on survivors)."""
+        snap = h.snapshot
         if snap is None:
             return 0
         self._dead_finished.update(
@@ -627,7 +1105,7 @@ class FleetRouter:
         for req in snap["requests"]:
             target = min(survivors, key=self._load_key)
             t0 = time.perf_counter()
-            target.engine.resume_request(
+            target.resume_request(
                 req["uid"], req["prompt"], req["max_new"],
                 out=req["out"], retries=req["retries"],
                 t_submit=req.get("t_submit"),
@@ -642,9 +1120,11 @@ class FleetRouter:
             self._record("migrated", req["uid"], source=h.id,
                          target=target.id, reason="engine_killed",
                          replay=len(req["out"]), blocks=0, bytes=0,
-                         duration_s=round(dur, 6))
+                         duration_s=round(dur, 6),
+                         transport=self._replay_transport(
+                             int(req["uid"])))
             # a survivor dying right after must re-migrate this too
-            target.snapshot = snapshot_state(target.engine)
+            target.snapshot = target.fetch_snapshot()
             moved += 1
         self.migrations += moved
         return moved
@@ -656,8 +1136,12 @@ class FleetRouter:
         return any(h.has_work for h in self.handles)
 
     def _pending_kills(self) -> bool:
-        return any(self.by_id[eid].alive for ids in self._kills.values()
-                   for eid in ids)
+        scheduled = any(self.by_id[eid].alive
+                        for ids in self._kills.values() for eid in ids)
+        chaos = self.fleet_chaos is not None and any(
+            not f.fired for f in self.fleet_chaos.faults
+            if f.kind in ("kill_worker", "hang_worker"))
+        return scheduled or chaos
 
     def run(self, log_every: int = 0) -> dict[int, list[int]]:
         """Drain the fleet: round until every request finished or
@@ -679,16 +1163,13 @@ class FleetRouter:
         return self.results()
 
     def _emit_decode_records(self) -> None:
-        now = time.perf_counter()
         for h in self.handles:
-            if not h.alive or h.engine.metrics is None:
+            if not h.alive:
                 continue
-            delta = h.engine.tokens_generated - h.last_tokens
-            dt = max(now - h.last_t, 1e-9)
-            tps = round(delta / dt, 2) if delta > 0 else None
-            h.engine.metrics.decode(h.engine.telemetry_record(tps))
-            h.last_tokens = h.engine.tokens_generated
-            h.last_t = now
+            try:
+                h.emit_decode()
+            except TransportError as e:
+                self._transport_death(h, e)
 
     def results(self) -> dict[int, list[int]]:
         """Merged per-uid outcomes across the whole fleet, dead
@@ -699,14 +1180,14 @@ class FleetRouter:
         out = dict(self._dead_finished)
         for h in self.handles:
             if h.alive:
-                out.update(h.engine.finished)
+                out.update(h.results())
         return out
 
     def failed(self) -> dict[int, dict]:
         out = dict(self._dead_failed)
         for h in self.handles:
             if h.alive:
-                out.update(h.engine.failed)
+                out.update(h.failed_map())
         return out
 
     # -- the payload/bench surface -------------------------------------
@@ -720,18 +1201,8 @@ class FleetRouter:
                 per_engine[h.id] = {"alive": False,
                                     "killed_at_round": h.killed_at_round}
                 continue
-            e = h.engine
-            per_engine[h.id] = {
-                "alive": True, "role": h.role,
-                "engine_steps": e.global_step,
-                "tokens_generated": e.tokens_generated,
-                "prefill_dispatches": e.prefill_dispatches,
-                "compiled_programs": e.compile_count,
-                "dispatches": e.dispatch_count,
-                "finished": len(e.finished),
-                "prefix_hit_blocks": e.prefix_hit_blocks,
-                "prefill_tokens_saved": e.prefill_tokens_saved,
-            }
+            per_engine[h.id] = {"alive": True, "role": h.role,
+                                **h.stats()}
         stats = {
             "engines": per_engine,
             "rounds": self.rounds,
@@ -743,14 +1214,32 @@ class FleetRouter:
             "kills": self.kills,
             "prefix_routed_hit_blocks": self.prefix_routed_hit_blocks,
             # the migration-stall surface (live moves only): blocks +
-            # wire bytes shipped and the per-move wall-clock list's
-            # summary (bench_decode.py's fleet_handoff_* rows read the
-            # raw accumulators off the router instead)
+            # SERIALIZED wire bytes shipped and the per-move wall-clock
+            # list's summary (bench_decode.py's fleet_handoff_* rows
+            # read the raw accumulators off the router instead)
             "handoff_blocks": self.handoff_blocks,
             "handoff_bytes": self.handoff_bytes,
+            "wire_rejects": self.wire_rejects,
         }
         if self.handoff_durations:
             import numpy as np
             stats["handoff_stall_p90_ms"] = round(float(np.percentile(
                 np.asarray(self.handoff_durations), 90)) * 1e3, 3)
         return stats
+
+
+def _corrupt_wire_file(path: str) -> None:
+    """The ``corrupt_wire`` chaos mechanics: flip a run of bytes just
+    past the middle of a published wire file — inside the array payload
+    region for any realistic KV doc — simulating in-transit damage that
+    slipped past rename atomicity. The per-array CRC (or, for damage
+    landing on container structure, the npz parse itself) must reject
+    the import."""
+    import os
+    size = os.path.getsize(path)
+    off = max(1, int(size * 0.55))
+    with open(path, "r+b") as f:
+        f.seek(off)
+        chunk = f.read(8)
+        f.seek(off)
+        f.write(bytes(b ^ 0xFF for b in chunk))

@@ -172,7 +172,27 @@ def build_generate_parser() -> argparse.ArgumentParser:
                         "ENGINE (e.g. e1) at the start of fleet round "
                         "ROUND; its in-flight requests migrate to the "
                         "survivors and complete token-identically "
-                        "(requires --fleet)")
+                        "(requires --fleet; a real SIGKILL of the "
+                        "worker process under --transport process)")
+    # process-boundary fleet (round 16, DESIGN.md section 22)
+    p.add_argument("--transport", choices=["inproc", "process"],
+                   default="inproc",
+                   help="fleet transport: 'inproc' (replicas in the "
+                        "router's process, the PR 10 fleet) or "
+                        "'process' (each engine in its OWN worker "
+                        "process behind a socket protocol, KV handoffs "
+                        "as CRC-verified wire files — decode/worker.py; "
+                        "requires --fleet)")
+    p.add_argument("--fleet_chaos", default=None, metavar="SPEC",
+                   help="fleet-transport fault injection "
+                        "(runtime/chaos.py FLEET_KINDS): comma-"
+                        "separated KIND@ROUND[:ARG] with KIND in "
+                        "kill_worker (SIGKILL decode worker :IDX, "
+                        "default e0) / hang_worker (first decode "
+                        "worker goes silent :SECS) / corrupt_wire "
+                        "(bit-flip the next wire handoff; CRC-"
+                        "rejected); requires --fleet and --transport "
+                        "process")
     # observability
     p.add_argument("--metrics_dir", default=None)
     p.add_argument("--log_every", type=int, default=4,
@@ -186,13 +206,19 @@ def build_generate_parser() -> argparse.ArgumentParser:
 
 
 def _fleet_main(args, prompts, cfg, policy, params, fleet_kill,
-                argv) -> int:
+                fleet_chaos, argv) -> int:
     """The ``--fleet N`` run: N engine replicas behind the router
     (``decode/fleet.py``), each with its own metrics stream under
     ``--metrics_dir/<engine_id>`` plus a ``router`` stream for the
     schema-v8 routing records — ``report m/router m/p0 m/e0 ...``
     merges them onto one timeline. Prints the same one-line JSON
-    payload shape as the single-engine path, with a ``fleet`` block."""
+    payload shape as the single-engine path, with a ``fleet`` block.
+
+    ``--transport process`` (round 16) runs every replica in its OWN
+    worker process (``decode/worker.py``): the same router, the same
+    payload shape, but an engine kill is a real SIGKILL, handoffs are
+    CRC-verified wire files, and the per-engine metrics streams are
+    written by the workers themselves."""
     import json as _json
     import time as _time
 
@@ -215,6 +241,7 @@ def _fleet_main(args, prompts, cfg, policy, params, fleet_kill,
             meta={"argv": list(argv or []), "subcommand": "generate",
                   "engine_id": eid, "role": role, "fleet": args.fleet,
                   "prefill_engines": args.prefill_engines,
+                  "transport": args.transport,
                   "kv_dtype": args.kv_dtype,
                   "n_prompts": len(prompts), "max_new": args.max_new,
                   "device_kind": jax.devices()[0].device_kind})
@@ -226,13 +253,46 @@ def _fleet_main(args, prompts, cfg, policy, params, fleet_kill,
                             metrics=(_writer(eid) if args.metrics_dir
                                      else None))
 
+    router = None
+    handles = None
     t0 = _time.perf_counter()
     try:
         if args.metrics_dir:
             router_metrics = _writer("router")
-        router = FleetRouter(make_engine, args.fleet,
-                             args.prefill_engines,
-                             metrics=router_metrics)
+        if args.transport == "process":
+            import dataclasses as _dc
+            import tempfile as _tempfile
+
+            from .worker import spawn_fleet_handles
+            spool = (os.path.join(args.metrics_dir, "spool")
+                     if args.metrics_dir
+                     else _tempfile.mkdtemp(prefix="fleet_spool_"))
+            model = {"vocab": args.vocab, "model_size": args.model_size,
+                     "layers": args.layers, "heads": args.heads,
+                     "kv_heads": args.kv_heads or None,
+                     "max_seq_len": args.max_seq_len,
+                     "random_seed": args.random_seed}
+            handles = spawn_fleet_handles(
+                args.fleet, args.prefill_engines, spool,
+                model=model, config=_dc.asdict(cfg),
+                policy=_dc.asdict(policy),
+                metrics_root=args.metrics_dir or None,
+                meta={"argv": list(argv or []),
+                      "subcommand": "generate",
+                      "fleet": args.fleet, "transport": "process",
+                      "prefill_engines": args.prefill_engines,
+                      "kv_dtype": args.kv_dtype,
+                      "n_prompts": len(prompts),
+                      "max_new": args.max_new})
+            router = FleetRouter(None, args.fleet,
+                                 args.prefill_engines,
+                                 metrics=router_metrics,
+                                 handles=handles,
+                                 fleet_chaos=fleet_chaos)
+        else:
+            router = FleetRouter(make_engine, args.fleet,
+                                 args.prefill_engines,
+                                 metrics=router_metrics)
         if fleet_kill is not None:
             router.schedule_kill(*fleet_kill)
         shed = 0
@@ -242,26 +302,37 @@ def _fleet_main(args, prompts, cfg, policy, params, fleet_kill,
             except AdmissionError:
                 shed += 1       # the router recorded the shed
         router.run(log_every=args.log_every)
+        # fetch outcomes BEFORE close: under the process transport
+        # these are protocol calls the shut-down workers can't answer
+        finished = router.results()
+        failed = router.failed()
+        stats = router.fleet_stats()
     except (ValueError, RuntimeError) as e:
         # RuntimeError covers the fleet's own liveness failures (last
         # decode engine killed, fleet stalled) — a clean rc-2 error,
-        # not a traceback, with the buffered telemetry flushed
+        # not a traceback, with the buffered telemetry flushed and
+        # every worker process reaped
         print(f"error: {e}", file=sys.stderr)
         return 2
     finally:
+        if router is not None:
+            router.close()      # workers flush their telemetry + exit
+        elif handles is not None:
+            # spawn succeeded but router construction raised (e.g. a
+            # worker died before the fingerprint cross-check): the
+            # detached workers must still be reaped — no orphans
+            for h in handles:
+                h.kill()
         for w in writers:
             w.close()
     wall = _time.perf_counter() - t0
 
-    finished = router.results()
-    failed = router.failed()
     sequences = [{"uid": u, "tokens": toks,
                   "prompt_len": (len(router.requests[u]["prompt"])
                                  if u in router.requests else None)}
                  for u, toks in sorted(finished.items())]
     new_tokens = sum(len(s["tokens"]) - (s["prompt_len"] or 0)
                      for s in sequences)
-    stats = router.fleet_stats()
     payload = {
         "sequences": sequences,
         "failed": {str(u): dict(info)
@@ -270,6 +341,7 @@ def _fleet_main(args, prompts, cfg, policy, params, fleet_kill,
         "wall_s": round(wall, 4),
         "tokens_per_sec": round(new_tokens / wall, 2),
         "kv_dtype": args.kv_dtype,
+        "transport": args.transport,
         "fleet": stats,
         "fleet_rounds": stats["rounds"],
         "shed": shed,
@@ -361,11 +433,15 @@ def generate_main(argv=None) -> int:
     # parse-rejection discipline. No --fleet means the single-engine
     # code path below runs UNTOUCHED (byte-identical to a CLI without
     # these flags).
-    if not args.fleet and (args.prefill_engines or args.fleet_kill):
-        print("error: --prefill_engines/--fleet_kill are fleet flags: "
-              "pass --fleet N (N >= 2)", file=sys.stderr)
+    if not args.fleet and (args.prefill_engines or args.fleet_kill
+                           or args.transport != "inproc"
+                           or args.fleet_chaos):
+        print("error: --prefill_engines/--fleet_kill/--transport/"
+              "--fleet_chaos are fleet flags: pass --fleet N (N >= 2)",
+              file=sys.stderr)
         return 2
     fleet_kill = None
+    fleet_chaos = None
     if args.fleet:
         if args.fleet < 2:
             print(f"error: --fleet needs >= 2 engines, got "
@@ -419,6 +495,38 @@ def generate_main(argv=None) -> int:
                       file=sys.stderr)
                 return 2
             fleet_kill = (eng_id, at_round)
+        if args.fleet_chaos:
+            if args.transport != "process":
+                # hang/corrupt need a boundary that can actually fail:
+                # a worker that can go silent, a wire file that can
+                # tear — in-process has neither
+                print("error: --fleet_chaos drills the process "
+                      "boundary: pass --transport process",
+                      file=sys.stderr)
+                return 2
+            from ..runtime.chaos import FaultPlan, validate_fleet_plan
+            try:
+                fleet_chaos = FaultPlan.parse(args.fleet_chaos)
+                validate_fleet_plan(fleet_chaos)
+            except ValueError as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 2
+            n_decode = args.fleet - args.prefill_engines
+            for f in fleet_chaos.faults:
+                if f.kind != "kill_worker":
+                    continue
+                idx = 0 if f.arg is None else int(f.arg)
+                if idx >= n_decode:
+                    print(f"error: kill_worker index {idx} names "
+                          f"e{idx}, but this fleet has {n_decode} "
+                          "decode engine(s)", file=sys.stderr)
+                    return 2
+                if n_decode == 1:
+                    print("error: kill_worker would kill the only "
+                          "decode engine in this fleet (the survivors "
+                          "have nowhere to migrate its requests)",
+                          file=sys.stderr)
+                    return 2
 
     longest = max(len(pr) for pr in prompts)
     mbps = args.max_blocks_per_seq or -(
@@ -438,11 +546,17 @@ def generate_main(argv=None) -> int:
             deadline_steps=args.deadline_steps,
             max_retries=args.max_retries,
             preempt_after_steps=args.preempt_after)
-        params = init_lm(jax.random.PRNGKey(args.random_seed),
-                         args.vocab, args.model_size, args.layers,
-                         max_seq_len=args.max_seq_len,
-                         n_heads=args.heads,
-                         n_kv_heads=args.kv_heads or None)
+        # under the process transport the router never touches weights
+        # — each worker rebuilds them from the recipe (same seed, same
+        # bits) — so building them here would just double peak host
+        # memory for nothing
+        params = None
+        if not (args.fleet and args.transport == "process"):
+            params = init_lm(jax.random.PRNGKey(args.random_seed),
+                             args.vocab, args.model_size, args.layers,
+                             max_seq_len=args.max_seq_len,
+                             n_heads=args.heads,
+                             n_kv_heads=args.kv_heads or None)
         mesh = None
         tp = 1
         if args.tp > 1:
@@ -473,7 +587,7 @@ def generate_main(argv=None) -> int:
 
     if args.fleet:
         return _fleet_main(args, prompts, cfg, policy, params,
-                           fleet_kill, argv)
+                           fleet_kill, fleet_chaos, argv)
 
     metrics = None
     engine_id = args.engine_id

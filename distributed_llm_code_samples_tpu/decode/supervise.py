@@ -169,21 +169,15 @@ def snapshot_path(snapshot_dir: str) -> str:
 
 
 def write_snapshot(engine: DecodeEngine, snapshot_dir: str) -> str:
-    """Atomic publish (the checkpoint layer's discipline): write to a
-    tmp file, fsync, rename over the old snapshot — a SIGKILL between
-    any two instructions leaves either the old or the new snapshot,
-    never a torn one."""
-    from ..checkpoint import _fsync_dir
+    """Atomic publish through ``runtime/wire.py`` (the one home of the
+    tmp + fsync + rename + dir-fsync discipline this module used to
+    hand-roll): a SIGKILL between any two instructions leaves either
+    the old or the new snapshot, never a torn one. The same call is the
+    engine-WORKER snapshot publisher (``decode/worker.py``)."""
+    from ..runtime.wire import publish_json
     os.makedirs(snapshot_dir, exist_ok=True)
-    path = snapshot_path(snapshot_dir)
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(snapshot_state(engine), f)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
-    _fsync_dir(snapshot_dir)    # the rename itself survives power loss
-    return path
+    return publish_json(snapshot_path(snapshot_dir),
+                        snapshot_state(engine))
 
 
 def load_snapshot(snapshot_dir: str) -> dict | None:

@@ -1,0 +1,718 @@
+"""Engine worker process + the router-side transport client.
+
+The PR 10 fleet was a simulation of distribution: every replica lived
+in the router's process, a "kill" dropped a Python object, and the
+handoff doc never crossed a serialization boundary — so none of the
+failure modes a real fleet must survive (torn writes, half-shipped
+handoffs, silently hung workers, stale liveness) could even occur.
+This module makes the fleet span real OS processes:
+
+- ``worker_main`` runs ONE ``DecodeEngine`` in its own process behind
+  a small request/response protocol: newline-delimited JSON over an
+  ``AF_UNIX`` socket (the worker binds and accepts exactly one
+  connection — its router). Control messages are tiny; KV NEVER rides
+  the socket — handoff documents cross as versioned wire files
+  (``runtime/wire.py``: npz + per-array CRC-32, atomically published
+  in the worker's spool directory), the same staging-file pattern a
+  multi-host transport would use. Every response carries the worker's
+  scheduler-state ``digest`` so the router's routing/migration
+  decisions read fresh state with zero extra round-trips.
+
+- ``ProcessEngineHandle`` is the router side: the same driver API as
+  the in-process ``EngineHandle`` (``decode/fleet.py``), implemented
+  as protocol calls with **per-call deadlines**. The liveness ladder:
+  a recv that overruns its deadline retries under bounded exponential
+  backoff (``runtime.failure.backoff_delay`` — the training
+  supervisor's schedule, reused); exhausted retries raise
+  ``TransportTimeout``, EOF/reset raises ``TransportDead``; the router
+  converts either into a dead-host declaration (SIGKILL the process so
+  a zombie cannot answer a stale request later) and migrates its
+  requests from the last snapshot — the identical recovery path an
+  explicit kill takes, because "stopped answering" and "dead" must be
+  the same thing for recovery to be correct.
+
+- ``spawn_worker`` / ``spawn_fleet_handles`` write each worker's JSON
+  config, start ``python -m ...decode.worker CONFIG`` processes, and
+  connect with the same bounded backoff (worker startup pays the jax
+  import + program compiles; a connect refused while it boots is the
+  canonical transient transport error).
+
+Determinism across the boundary: each worker builds its params from
+the SAME ``init_lm`` seed the router's config names, and the router
+cross-checks ``model_meta()`` fingerprints at construction — so the
+process fleet serves bit-identical weights, and the engine's
+``(seed, uid, position)`` sampling contract makes every migration
+token-identical exactly as in-process. Snapshots ride the protocol
+in-band (the router holds them — the recovery state must survive the
+WORKER's death, and the router is the survivor) and are additionally
+published atomically in the worker's spool dir
+(``decode/supervise.py::write_snapshot`` via ``runtime/wire.py``) as
+the on-disk post-mortem record.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import socket
+import subprocess
+import sys
+import time
+
+from .fleet import (HandoffRef, TransportDead, TransportError,
+                    TransportTimeout)
+
+WORKER_CONFIG_FILENAME = "worker_config.json"
+WORKER_SOCKET_FILENAME = "worker.sock"
+WORKER_LOG_FILENAME = "worker.log"
+
+# per-call deadline defaults (seconds). The first step call after spawn
+# may compile XLA programs — its deadline must cover a cold compile;
+# the drills that want fast hang detection lower call_deadline_s
+# explicitly once their program set is warm.
+DEFAULT_CALL_DEADLINE_S = 120.0
+DEFAULT_PING_DEADLINE_S = 5.0
+DEFAULT_CONNECT_DEADLINE_S = 120.0
+# bounded-backoff retries for a timed-out recv before the worker is
+# declared silent (failure.backoff_delay schedule, jitter off for
+# deterministic drills)
+DEFAULT_CALL_RETRIES = 1
+
+
+# ---------------------------------------------------------------- worker
+
+def worker_main(argv=None) -> int:
+    """Run one engine worker: ``python -m
+    distributed_llm_code_samples_tpu.decode.worker CONFIG_JSON``."""
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 1:
+        print("usage: decode.worker CONFIG_JSON", file=sys.stderr)
+        return 2
+    with open(argv[0]) as f:
+        cfg = json.load(f)
+
+    # bind BEFORE the heavy jax import: the router's connect loop gets
+    # a listening socket (slow accept) instead of minutes of refusals
+    sock_path = cfg["socket_path"]
+    if os.path.exists(sock_path):
+        os.unlink(sock_path)
+    server = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    server.bind(sock_path)
+    server.listen(1)
+
+    import jax
+
+    from ..models import init_lm
+    from ..runtime.telemetry import TelemetryWriter
+    from ..runtime.wire import WireError
+    from .engine import AdmissionError, DecodeEngine, EngineConfig, \
+        ServePolicy
+    from .fleet import EngineHandle
+    from .supervise import write_snapshot
+
+    m = cfg["model"]
+    params = init_lm(jax.random.PRNGKey(m["random_seed"]), m["vocab"],
+                     m["model_size"], m["layers"],
+                     max_seq_len=m["max_seq_len"], n_heads=m["heads"],
+                     n_kv_heads=m.get("kv_heads") or None)
+    metrics = None
+    if cfg.get("metrics_dir"):
+        metrics = TelemetryWriter(cfg["metrics_dir"],
+                                  meta=cfg.get("meta") or {})
+    engine = DecodeEngine(params, m["heads"],
+                          EngineConfig(**cfg["config"]),
+                          policy=ServePolicy(**cfg["policy"]),
+                          metrics=metrics)
+    spool = cfg["spool_dir"]
+    os.makedirs(spool, exist_ok=True)
+    # the worker IS an in-process EngineHandle around its engine (wire
+    # exports land in the spool): every read surface the router's
+    # policy code consumes — digest, stats, waiting entries, decode
+    # cadence, wire export/import — is the ONE implementation in
+    # decode/fleet.py, so the transports cannot drift apart on what
+    # the router sees
+    hd = EngineHandle(cfg["engine_id"], engine, cfg.get("role",
+                                                        "decode"),
+                      wire_dir=spool)
+    last_publish_t = 0.0
+
+    def handle(req: dict) -> dict:
+        nonlocal last_publish_t
+        op = req["op"]
+        if op == "ping":
+            return {}
+        if op == "meta":
+            return {"model": engine.model_meta(),
+                    "mesh": engine.mesh is not None}
+        if op == "digest":
+            return {"digest": hd.digest()}
+        if op == "submit":
+            entry = hd.submit(req["prompt"], req["max_new"],
+                              uid=req["uid"])
+            return {"entry": entry, "digest": hd.digest()}
+        if op == "resume":
+            hd.resume_request(req["uid"], req["prompt"],
+                              req["max_new"], out=req["out"],
+                              retries=req["retries"],
+                              t_submit=req.get("t_submit"),
+                              t_first=req.get("t_first"))
+            return {"digest": hd.digest()}
+        if op == "step":
+            hd.step_begin(prefill_only=req.get("prefill_only", False))
+            return {"did": bool(hd.step_end()),
+                    "step_s": hd.last_step_s,
+                    "digest": hd.digest()}
+        if op == "snapshot":
+            # in-band to the router (the survivor that migrates from
+            # it — recovery NEVER depends on this worker's disk) AND
+            # atomically published in the spool as the on-disk
+            # post-mortem record, throttled to ~1/s: the router asks
+            # every cadence round, and paying tmp+fsync+rename+dirsync
+            # per engine per round would put 2N fsyncs/round of pure
+            # post-mortem bookkeeping on the drill's hot path
+            now = time.monotonic()
+            if now - last_publish_t >= 1.0:
+                write_snapshot(engine, spool)
+                last_publish_t = now
+            return {"snapshot": hd.fetch_snapshot()}
+        if op == "probe":
+            return {"warm": hd.warm_blocks(req["prompt"])}
+        if op == "warm":
+            # pre-build the full program set (decode/verify per slot
+            # bucket, prefill per chunk bucket, the handoff implant) so
+            # a drill can tighten per-call deadlines to STEP scale —
+            # a compile inside a deadline-bounded step would otherwise
+            # read as a silent worker (the in-process kill drill's
+            # prebuild discipline, test_fleet.py)
+            for b in engine.slot_buckets:
+                engine._program("decode", b)
+                if engine.cfg.speculate > 0:
+                    engine._program("verify", b)
+            for c in engine.chunk_buckets:
+                engine._program("prefill", c)
+            engine._program("implant", 0)
+            return {"compiled": engine.compile_count}
+        if op == "export":
+            ref = hd.export(req["uid"])     # writes the wire file
+            return {"path": ref.path,
+                    "position": ref.position,
+                    "blocks_written": ref.blocks_written,
+                    "digest": hd.digest()}
+        if op == "import":
+            info = hd.import_doc(HandoffRef(
+                -1, 0, 0, path=req["path"]))    # raises WireError
+            return {"bytes": info["bytes"],
+                    "crc_verify_s": info["crc_verify_s"],
+                    "digest": hd.digest()}
+        if op == "results":
+            return {"finished": {str(u): t
+                                 for u, t in hd.results().items()},
+                    "failed": {str(u): i
+                               for u, i in hd.failed_map().items()}}
+        if op == "stats":
+            return {"stats": hd.stats()}
+        if op == "emit_decode":
+            hd.emit_decode()
+            return {}
+        if op == "hang":
+            # chaos injection: acknowledge FIRST, then go silent — the
+            # router's NEXT call overruns its deadline against a worker
+            # that is alive but unresponsive, exactly the hung-peer
+            # failure the liveness ladder exists for
+            return {"_hang_after_reply_s": float(req["secs"])}
+        if op == "shutdown":
+            return {"_shutdown": True}
+        raise ValueError(f"unknown worker op {op!r}")
+
+    conn, _ = server.accept()
+    rfile = conn.makefile("rb")
+    try:
+        for line in rfile:
+            if not line.strip():
+                continue
+            req = json.loads(line)
+            rid = req.get("id")
+            try:
+                out = handle(req)
+                resp = {"id": rid, "ok": True, **out}
+            except AdmissionError as e:
+                resp = {"id": rid, "ok": False, "error": str(e),
+                        "error_kind": "admission"}
+            except WireError as e:
+                resp = {"id": rid, "ok": False, "error": str(e),
+                        "error_kind": "wire"}
+            except ValueError as e:
+                resp = {"id": rid, "ok": False, "error": str(e),
+                        "error_kind": "value"}
+            except Exception as e:  # noqa: BLE001 — protocol boundary
+                resp = {"id": rid, "ok": False,
+                        "error": f"{type(e).__name__}: {e}",
+                        "error_kind": "runtime"}
+            hang_s = resp.pop("_hang_after_reply_s", None)
+            done = resp.pop("_shutdown", False)
+            conn.sendall((json.dumps(resp) + "\n").encode("utf-8"))
+            if hang_s is not None:
+                time.sleep(hang_s)
+            if done:
+                break
+    finally:
+        if metrics is not None:
+            metrics.close()
+        try:
+            conn.close()
+            server.close()
+            os.unlink(sock_path)
+        except OSError:
+            pass
+    return 0
+
+
+# ----------------------------------------------- router-side transport
+
+class ProcessEngineHandle:
+    """The router's view of one engine worker PROCESS — the same driver
+    API as the in-process ``EngineHandle``, over the socket protocol.
+    Scheduler-state reads come from the digest riding every response
+    (cached; exactly as fresh as the last protocol exchange, which is
+    the last time the worker's state could have changed)."""
+
+    transport = "process"
+
+    def __init__(self, eid: str, role: str, spool_dir: str, proc,
+                 sock_path: str, *,
+                 call_deadline_s: float = DEFAULT_CALL_DEADLINE_S,
+                 ping_deadline_s: float = DEFAULT_PING_DEADLINE_S,
+                 call_retries: int = DEFAULT_CALL_RETRIES):
+        self.id = eid
+        self.role = role
+        self.spool_dir = spool_dir
+        self.proc = proc
+        self.sock_path = sock_path
+        self.call_deadline_s = call_deadline_s
+        self.ping_deadline_s = ping_deadline_s
+        self.call_retries = call_retries
+        self.alive = True
+        self.snapshot: dict | None = None
+        self.killed_at_round: int | None = None
+        self.last_step_s = 0.0
+        self.engine = None        # no in-process engine behind this id
+        self._sock: socket.socket | None = None
+        self._buf = b""
+        self._next_id = 0
+        self._digest: dict | None = None
+        self._digest_id = -1      # response id the cached digest is from
+        self._pending: dict | None = None   # in-flight step (begin/end)
+        # responses that arrived while awaiting a DIFFERENT id (the
+        # dead-host recovery path interleaves calls to a survivor whose
+        # own step is still in flight) — parked here, never dropped
+        self._resp_buf: dict[int, dict] = {}
+
+    # -- wire plumbing -------------------------------------------------
+
+    def connect(self, deadline_s: float = DEFAULT_CONNECT_DEADLINE_S
+                ) -> None:
+        """Connect to the worker's socket, retrying refusals under
+        bounded exponential backoff while it boots (jax import +
+        engine build). A worker that exits first raises
+        ``TransportDead`` with its log tail."""
+        from ..runtime.failure import backoff_delay
+        t0 = time.monotonic()
+        attempt = 0
+        while True:
+            if self.proc.poll() is not None:
+                raise TransportDead(
+                    f"worker {self.id} exited rc {self.proc.returncode} "
+                    f"before accepting: {self._log_tail()}")
+            try:
+                s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                s.connect(self.sock_path)
+                self._sock = s
+                return
+            except (FileNotFoundError, ConnectionRefusedError):
+                if time.monotonic() - t0 > deadline_s:
+                    raise TransportTimeout(
+                        f"worker {self.id} did not accept within "
+                        f"{deadline_s:.0f}s") from None
+                time.sleep(backoff_delay(attempt, 0.05, 1.0, 0.0,
+                                         random.Random(0)))
+                attempt += 1
+
+    def _log_tail(self, n: int = 400) -> str:
+        try:
+            with open(os.path.join(self.spool_dir,
+                                   WORKER_LOG_FILENAME)) as f:
+                return f.read()[-n:].replace("\n", " | ")
+        except OSError:
+            return "(no worker log)"
+
+    def _send(self, req: dict) -> int:
+        self._next_id += 1
+        req = {**req, "id": self._next_id}
+        try:
+            self._sock.sendall((json.dumps(req) + "\n").encode("utf-8"))
+        except OSError as e:
+            raise TransportDead(f"worker {self.id} send failed: "
+                                f"{type(e).__name__}: {e}") from None
+        return self._next_id
+
+    def _recv_line(self, deadline_s: float) -> bytes:
+        """One newline-framed response within ``deadline_s``, with
+        bounded-backoff retries absorbing transient slowness before the
+        silent-worker verdict."""
+        from ..runtime.failure import backoff_delay
+        for attempt in range(self.call_retries + 1):
+            end = time.monotonic() + deadline_s
+            while b"\n" not in self._buf:
+                remaining = end - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._sock.settimeout(remaining)
+                try:
+                    chunk = self._sock.recv(1 << 16)
+                except socket.timeout:
+                    break
+                except OSError as e:
+                    raise TransportDead(
+                        f"worker {self.id} connection failed: "
+                        f"{type(e).__name__}: {e}") from None
+                if not chunk:
+                    state = ("exited rc %s" % self.proc.returncode
+                             if self.proc.poll() is not None
+                             else "still running")
+                    raise TransportDead(
+                        f"worker {self.id} closed its connection "
+                        f"(process {state}): {self._log_tail()}")
+                self._buf += chunk
+            if b"\n" in self._buf:
+                line, self._buf = self._buf.split(b"\n", 1)
+                return line
+            if attempt < self.call_retries:
+                time.sleep(backoff_delay(attempt, 0.05, 2.0, 0.0,
+                                         random.Random(0)))
+        raise TransportTimeout(
+            f"worker {self.id} silent past its {deadline_s:.1f}s "
+            f"deadline ({self.call_retries + 1} attempt(s) with "
+            "backoff)")
+
+    def _call(self, op: str, deadline_s: float | None = None,
+              **payload) -> dict:
+        rid = self._send({"op": op, **payload})
+        return self._await(rid, deadline_s)
+
+    def _await(self, rid: int, deadline_s: float | None = None) -> dict:
+        deadline = (self.call_deadline_s if deadline_s is None
+                    else deadline_s)
+        while rid not in self._resp_buf:
+            resp = json.loads(self._recv_line(deadline))
+            self._resp_buf[resp.get("id")] = resp
+        resp = self._resp_buf.pop(rid)
+        if "digest" in resp and rid > self._digest_id:
+            # the worker answers in order, so the digest from the
+            # HIGHEST response id is the freshest scheduler state —
+            # an out-of-order consume must not roll the cache back
+            self._digest = resp["digest"]
+            self._digest_id = rid
+        if not resp.get("ok"):
+            self._raise_remote(resp)
+        return resp
+
+    @staticmethod
+    def _raise_remote(resp: dict):
+        from ..runtime.wire import WireError
+        from .engine import AdmissionError
+        kind = resp.get("error_kind")
+        msg = resp.get("error", "worker error")
+        if kind == "admission":
+            raise AdmissionError(msg)
+        if kind == "wire":
+            raise WireError(msg)
+        if kind == "value":
+            raise ValueError(msg)
+        raise RuntimeError(msg)
+
+    # -- the driver API (EngineHandle's surface) -----------------------
+
+    def model_meta(self) -> dict:
+        resp = self._call("meta")
+        if resp["mesh"]:
+            raise ValueError("fleet replicas are single-device "
+                             "(KV handoff has no TP path)")
+        return resp["model"]
+
+    def validate_member(self) -> None:
+        """Single-device membership is validated by ``model_meta`` (the
+        construction-time cross-check calls it on every member)."""
+
+    @property
+    def has_work(self) -> bool:
+        if not self.alive or self._digest is None:
+            return False
+        return bool(self._digest["waiting"] or self._digest["active"])
+
+    def digest(self, light: bool = False) -> dict:
+        # `light` is the in-process handle's hot-path flag; here the
+        # cached digest from the last response is returned either way
+        if self._digest is None:
+            self._call("digest")
+        return self._digest
+
+    def blocks_needed(self, prompt_len: int, max_new: int) -> int:
+        from .engine import blocks_needed
+        return blocks_needed(prompt_len, max_new, self._block_size)
+
+    def max_blocks_per_seq(self) -> int:
+        return self._max_blocks_per_seq
+
+    def warm_blocks(self, prompt) -> int | None:
+        return self._call("probe", prompt=[int(t) for t in prompt])[
+            "warm"]
+
+    def submit(self, prompt, max_new: int, uid: int) -> dict:
+        return self._call("submit", prompt=[int(t) for t in prompt],
+                          max_new=int(max_new), uid=int(uid))["entry"]
+
+    def resume_request(self, uid: int, prompt, max_new: int, *, out=(),
+                       retries: int = 0, t_submit=None,
+                       t_first=None) -> None:
+        self._call("resume", uid=int(uid),
+                   prompt=[int(t) for t in prompt],
+                   max_new=int(max_new), out=[int(t) for t in out],
+                   retries=int(retries), t_submit=t_submit,
+                   t_first=t_first)
+
+    def step_begin(self, prefill_only: bool = False) -> None:
+        """SEND the step — every worker's step runs concurrently in its
+        own process; ``step_end`` collects."""
+        rid = self._send({"op": "step", "prefill_only": prefill_only})
+        self._pending = {"rid": rid}
+
+    def step_end(self) -> bool:
+        pending, self._pending = self._pending, None
+        resp = self._await(pending["rid"])
+        self.last_step_s = float(resp["step_s"])
+        return bool(resp["did"])
+
+    def fetch_snapshot(self) -> dict:
+        return self._call("snapshot")["snapshot"]
+
+    def export(self, uid: int) -> HandoffRef:
+        resp = self._call("export", uid=int(uid))
+        return HandoffRef(uid, int(resp["position"]),
+                          int(resp["blocks_written"]),
+                          path=resp["path"])
+
+    def import_doc(self, ref: HandoffRef) -> dict:
+        resp = self._call("import", path=ref.path)
+        return {"mode": "wire", "bytes": int(resp["bytes"]),
+                "crc_verify_s": resp["crc_verify_s"]}
+
+    def _results_resp(self) -> dict:
+        """One 'results' round-trip serves both results() and
+        failed_map() (the drain path calls them back to back; the op
+        returns both halves, and re-shipping every finished token list
+        for the failed half would be pure waste). The cache is valid
+        only while NO other protocol call intervenes — any call
+        advances ``_next_id`` and invalidates it."""
+        cached = getattr(self, "_results_cache", None)
+        if cached is not None and cached[0] == self._next_id:
+            return cached[1]
+        resp = self._call("results")
+        self._results_cache = (self._next_id, resp)
+        return resp
+
+    def results(self) -> dict[int, list[int]]:
+        return {int(u): list(t) for u, t
+                in self._results_resp()["finished"].items()}
+
+    def failed_map(self) -> dict[int, dict]:
+        return {int(u): dict(i) for u, i
+                in self._results_resp()["failed"].items()}
+
+    def stats(self) -> dict:
+        return self._call("stats")["stats"]
+
+    def emit_decode(self) -> None:
+        self._call("emit_decode")
+
+    # -- liveness ------------------------------------------------------
+
+    def ping(self) -> None:
+        self._call("ping", deadline_s=self.ping_deadline_s)
+
+    def warm(self, deadline_s: float = 600.0) -> int:
+        """Pre-compile the worker's full program set (generous
+        deadline — this IS the compile phase); returns its compile
+        count. Tighten ``call_deadline_s`` after this, never before."""
+        return int(self._call("warm", deadline_s=deadline_s)["compiled"])
+
+    def hang(self, secs: float) -> None:
+        """Chaos: tell the worker to go silent for ``secs`` right after
+        acknowledging — its next real call must trip the deadline."""
+        self._call("hang", secs=float(secs))
+
+    def kill(self) -> None:
+        """SIGKILL the worker process — a real dead host. Idempotent;
+        also the zombie-fencing step of a dead declaration (a hung
+        worker that wakes later must not answer anything)."""
+        self.alive = False
+        if self.proc.poll() is None:
+            try:
+                self.proc.kill()
+            except OSError:
+                pass
+        try:
+            self.proc.wait(timeout=10)
+        except Exception:  # noqa: BLE001 — best-effort reap
+            pass
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def close(self) -> None:
+        """Graceful shutdown: ask the worker to exit (its telemetry
+        writer flushes), then reap; SIGKILL if it lingers."""
+        if not self.alive:
+            return
+        try:
+            self._call("shutdown", deadline_s=10.0)
+        except (TransportError, OSError):
+            pass
+        try:
+            self.proc.wait(timeout=15)
+        except Exception:  # noqa: BLE001
+            self.proc.kill()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+        self.alive = False
+
+
+# --------------------------------------------------------------- spawn
+
+def _start_worker_proc(eid: str, role: str, base_dir: str, *,
+                       model: dict, config: dict, policy: dict,
+                       metrics_dir=None, meta=None, env=None):
+    """Write one worker's config and start its process (detached; log
+    in its spool). Returns ``(spool, proc, sock_path)`` — connection
+    happens separately so a fleet can boot every jax import in
+    parallel before the first (slow) connect."""
+    spool = os.path.join(base_dir, eid)
+    os.makedirs(spool, exist_ok=True)
+    sock_path = os.path.join(spool, WORKER_SOCKET_FILENAME)
+    cfg = {"engine_id": eid, "role": role, "socket_path": sock_path,
+           "spool_dir": spool, "metrics_dir": metrics_dir,
+           "meta": {**(meta or {}), "engine_id": eid, "role": role},
+           "model": model, "config": config, "policy": policy}
+    cfg_path = os.path.join(spool, WORKER_CONFIG_FILENAME)
+    with open(cfg_path, "w") as f:
+        json.dump(cfg, f)
+    log = open(os.path.join(spool, WORKER_LOG_FILENAME), "w")
+    proc = subprocess.Popen(
+        [sys.executable, "-m",
+         "distributed_llm_code_samples_tpu.decode.worker", cfg_path],
+        stdout=log, stderr=subprocess.STDOUT,
+        env=None if env is None else dict(env), start_new_session=True)
+    log.close()
+    return spool, proc, sock_path
+
+
+def _connect_and_prime(h: ProcessEngineHandle, config: dict,
+                       connect_deadline_s: float) -> None:
+    """Connect a freshly-spawned handle and prime its config-derived
+    capacity math + initial digest cache. The capacity fields resolve
+    through ``EngineConfig`` itself — the exact defaulting the worker
+    applies — so a partial config dict can never make the router's
+    ``blocks_needed`` math disagree with the engine's admission
+    math."""
+    from .engine import EngineConfig
+    h.connect(deadline_s=connect_deadline_s)
+    ec = EngineConfig(**config)
+    h._block_size = ec.block_size
+    h._max_blocks_per_seq = ec.max_blocks_per_seq
+    h._call("digest")
+
+
+def spawn_worker(eid: str, role: str, base_dir: str, *, model: dict,
+                 config: dict, policy: dict, metrics_dir=None,
+                 meta=None, env=None,
+                 call_deadline_s: float = DEFAULT_CALL_DEADLINE_S,
+                 ping_deadline_s: float = DEFAULT_PING_DEADLINE_S,
+                 connect_deadline_s: float = DEFAULT_CONNECT_DEADLINE_S,
+                 ) -> ProcessEngineHandle:
+    """Start one engine worker process and connect to it. ``model`` is
+    the ``init_lm`` recipe (vocab/model_size/layers/heads/kv_heads/
+    max_seq_len/random_seed — every worker rebuilds the identical
+    weights from it); ``config``/``policy`` the EngineConfig/
+    ServePolicy kwargs. The worker's spool dir (``base_dir/eid``)
+    holds its config, socket, log, wire handoffs, and published
+    snapshots."""
+    spool, proc, sock_path = _start_worker_proc(
+        eid, role, base_dir, model=model, config=config, policy=policy,
+        metrics_dir=metrics_dir, meta=meta, env=env)
+    h = ProcessEngineHandle(eid, role, spool, proc, sock_path,
+                            call_deadline_s=call_deadline_s,
+                            ping_deadline_s=ping_deadline_s)
+    try:
+        _connect_and_prime(h, config, connect_deadline_s)
+    except TransportError:
+        h.kill()
+        raise
+    return h
+
+
+def spawn_fleet_handles(n_engines: int, prefill_engines: int,
+                        base_dir: str, *, model: dict, config: dict,
+                        policy: dict, metrics_root=None, meta=None,
+                        env=None,
+                        call_deadline_s: float = DEFAULT_CALL_DEADLINE_S,
+                        ping_deadline_s: float = DEFAULT_PING_DEADLINE_S,
+                        connect_deadline_s: float =
+                        DEFAULT_CONNECT_DEADLINE_S) -> list:
+    """Spawn the whole fleet's worker processes (prefill tier first,
+    the router's id convention), launching all of them BEFORE the
+    first connect so their jax imports boot in parallel. On any spawn
+    failure every already-started worker is killed — no orphans."""
+    from .fleet import DECODE_PREFIX, PREFILL_PREFIX
+    ids = [(f"{PREFILL_PREFIX}{i}", "prefill")
+           for i in range(prefill_engines)]
+    ids += [(f"{DECODE_PREFIX}{i}", "decode")
+            for i in range(n_engines - prefill_engines)]
+    handles: list[ProcessEngineHandle] = []
+    procs: list = []
+    try:
+        # phase 1: start every process (parallel boot)
+        for eid, role in ids:
+            mdir = (os.path.join(metrics_root, eid)
+                    if metrics_root else None)
+            spool, proc, sock_path = _start_worker_proc(
+                eid, role, base_dir, model=model, config=config,
+                policy=policy, metrics_dir=mdir, meta=meta, env=env)
+            procs.append((eid, role, spool, proc, sock_path))
+        # phase 2: connect to each
+        for eid, role, spool, proc, sock_path in procs:
+            h = ProcessEngineHandle(eid, role, spool, proc, sock_path,
+                                    call_deadline_s=call_deadline_s,
+                                    ping_deadline_s=ping_deadline_s)
+            handles.append(h)
+            _connect_and_prime(h, config, connect_deadline_s)
+        return handles
+    except Exception:
+        for h in handles:
+            h.kill()
+        for tup in procs[len(handles):]:
+            try:
+                tup[3].kill()
+            except OSError:
+                pass
+        raise
+
+
+if __name__ == "__main__":
+    sys.exit(worker_main())

@@ -1248,6 +1248,9 @@ def report_main(argv=None) -> int:
             "migrated_by_reason": mig_reasons,
             "shed": by_ev.get("shed", 0) + len(expired_uids),
             "shed_at_router": by_ev.get("shed", 0),
+            # v10: CRC/torn/version-rejected wire handoffs (each was
+            # replay-rerouted; the records carry the one-line reason)
+            "wire_rejected": by_ev.get("wire_rejected", 0),
             "completed": len(completed),
         }
         if moves:
@@ -1258,6 +1261,13 @@ def report_main(argv=None) -> int:
             fleet["handoff_stall_p90_ms"] = round(float(np.percentile(
                 np.asarray([r["duration_s"] for r in moves],
                            np.float64), 90)) * 1e3, 3)
+            # v10 transport attribution: how each move actually
+            # crossed (inproc doc / wire file / replay re-queue)
+            modes: dict[str, int] = {}
+            for r in moves:
+                mode = (r.get("transport") or {}).get("mode") or "?"
+                modes[mode] = modes.get(mode, 0) + 1
+            fleet["moves_by_transport"] = modes
         lat = [r["latency_s"] for r in completed
                if r.get("latency_s") is not None]
         if lat:
@@ -1367,10 +1377,19 @@ def report_main(argv=None) -> int:
                        f"p90 {fl['itl_p90_s']}s  "
                        f"p99 {fl['itl_p99_s']}s  (per decode segment)")
         if "handoff_stall_p90_ms" in fl:
+            via = ""
+            if fl.get("moves_by_transport"):
+                via = " via " + ", ".join(
+                    f"{k} x{v}" for k, v in sorted(
+                        fl["moves_by_transport"].items()))
             out.append(f"  KV moves       {fl['handoff_blocks']} "
                        f"block(s) / {_fmt_bytes(fl['handoff_bytes'])} "
                        f"shipped, stall p90 "
-                       f"{fl['handoff_stall_p90_ms']} ms")
+                       f"{fl['handoff_stall_p90_ms']} ms{via}")
+        if fl.get("wire_rejected"):
+            out.append(f"  wire integrity {fl['wire_rejected']} "
+                       "handoff doc(s) REJECTED (CRC/torn/version — "
+                       "replay-rerouted; reasons on the timeline)")
     if doc.get("fleet_health"):
         _render_fleet_health(out, doc["fleet_health"])
     if doc.get("slo"):

@@ -19,6 +19,7 @@ dirs use), parsed from the CLI ``--chaos`` spec grammar::
     KIND  := nan_grad | inf_grad | loss_spike | slow_step | hang
            | kill | corrupt_ckpt
            | nan_logits | hang_step | corrupt_block      # decode faults
+           | kill_worker | hang_worker | corrupt_wire    # fleet faults
 
 - ``nan_grad@s`` / ``inf_grad@s`` — step ``s`` trains on a poisoned
   (NaN/Inf) upstream gradient. With in-graph guardrails armed
@@ -103,8 +104,26 @@ PUBLISH_KINDS = ("corrupt_ckpt", "kill")
 # serving-engine faults (kill is shared: publish boundary in training,
 # snapshot boundary in serving — decode/supervise.py)
 DECODE_KINDS = ("nan_logits", "hang_step", "corrupt_block", "kill")
+# fleet-transport faults (round 16, decode/fleet.py + decode/worker.py):
+# steps are FLEET ROUNDS (the router's clock), fired by the router at
+# the start of the round —
+# - ``kill_worker@ROUND[:IDX]`` — SIGKILL decode engine e{IDX}
+#   (default e0) at the start of that round: a REAL dead host under the
+#   process transport (the worker process dies mid-stream), the
+#   dropped-object simulation in-process; recovery migrates from the
+#   router's last snapshot either way.
+# - ``hang_worker@ROUND[:SECS]`` — the first alive decode worker goes
+#   silent for SECS (default 30): its next call overruns the per-call
+#   deadline, the liveness ladder declares it dead, SIGKILLs it, and
+#   the same migration path recovers. Process transport only (an
+#   in-process engine cannot hang without hanging the router).
+# - ``corrupt_wire@ROUND`` — the next wire-serialized KV handoff at or
+#   after that round is bit-flipped in transit: the per-array CRC-32
+#   (runtime/wire.py) must reject it with a named reason and the
+#   request must be replay-rerouted, no engine importing partial state.
+FLEET_KINDS = ("kill_worker", "hang_worker", "corrupt_wire")
 KINDS = IN_SEGMENT_KINDS + PUBLISH_KINDS + tuple(
-    k for k in DECODE_KINDS if k not in PUBLISH_KINDS)
+    k for k in DECODE_KINDS if k not in PUBLISH_KINDS) + FLEET_KINDS
 
 
 @dataclass
@@ -267,6 +286,17 @@ class FaultPlan:
             if f.kind in DECODE_KINDS:
                 f.fired = f.step <= step
 
+    # ---------------------------------------------- fleet integration
+    def fleet_due(self, round_: int) -> list:
+        """Unfired fleet-transport faults scheduled for router round
+        ``round_`` (``decode/fleet.py`` fires and ``_note``s them at
+        the start of the round — before any engine steps, so the
+        round's snapshot cadence has not yet run and replay honestly
+        fills the gap since the last one)."""
+        return [f for f in self.faults
+                if f.kind in FLEET_KINDS and not f.fired
+                and f.step == round_]
+
     # ---------------------------------------------- publish integration
     def after_publish(self, step: int, path: str) -> None:
         """Fire publish-boundary faults for ``step`` on its freshly
@@ -294,8 +324,10 @@ def validate_decode_plan(plan: FaultPlan) -> None:
     for f in plan.faults:
         if f.kind not in DECODE_KINDS:
             raise ValueError(
-                f"--chaos kind {f.kind!r} is a training fault; the "
-                f"decode engine accepts {DECODE_KINDS}")
+                f"--chaos kind {f.kind!r} is not a decode fault; the "
+                f"decode engine accepts {DECODE_KINDS} (training "
+                "faults run under the train CLI, fleet-transport "
+                "faults under --fleet_chaos)")
         if f.kind == "corrupt_block":
             if f.arg is None:
                 raise ValueError(
@@ -320,6 +352,37 @@ def validate_decode_plan(plan: FaultPlan) -> None:
                 f"kill takes no :ARG (got {f.arg!r}) — it SIGKILLs "
                 "after the step's snapshot; did you mean "
                 "corrupt_block@STEP:BLOCK?")
+
+
+def validate_fleet_plan(plan: FaultPlan) -> None:
+    """Reject a ``--fleet_chaos`` spec the fleet router cannot honor:
+    only the fleet-transport kinds belong here (training/decode faults
+    have no fleet-round anchor), ``kill_worker``'s optional :IDX is a
+    non-negative integer decode-engine index, ``hang_worker``'s
+    optional :SECS a non-negative sleep, and ``corrupt_wire`` takes no
+    argument — the generate CLI's parse-rejection discipline."""
+    for f in plan.faults:
+        if f.kind not in FLEET_KINDS:
+            raise ValueError(
+                f"--fleet_chaos kind {f.kind!r} is not a fleet-"
+                f"transport fault; the fleet router accepts "
+                f"{FLEET_KINDS} (engine-level faults run under the "
+                "single-engine supervisor's --chaos)")
+        if f.kind == "kill_worker" and f.arg is not None and (
+                f.arg != int(f.arg) or f.arg < 0):
+            raise ValueError(
+                f"kill_worker arg {f.arg!r} must be a non-negative "
+                "integer decode-engine index (kill_worker@R:1 kills "
+                "e1; omit it to kill e0)")
+        if f.kind == "hang_worker" and f.arg is not None and f.arg < 0:
+            raise ValueError(
+                f"hang_worker arg {f.arg!r} must be a non-negative "
+                "sleep in seconds")
+        if f.kind == "corrupt_wire" and f.arg is not None:
+            raise ValueError(
+                f"corrupt_wire takes no :ARG (got {f.arg!r}) — it "
+                "corrupts the next wire handoff after its round; the "
+                "CRC layer decides what is detected")
 
 
 def truncate_checkpoint(path: str, frac: float = 0.5) -> str:

@@ -88,7 +88,20 @@ import numpy as np
 # (per-engine waiting/active/free-blocks/utilization + a
 # load-imbalance scalar, decode/fleet.py) with its own pinned
 # required-key contract (FLEET_REQUIRED).
-SCHEMA_VERSION = 9
+# v10 (round 16): the process-boundary transport layer. "router"
+# handoff/migrated records now PIN the move instrumentation —
+# ``blocks`` / ``bytes`` / ``duration_s`` (extras since v9) plus the
+# new ``transport`` attribution object ({mode: inproc|wire|replay,
+# bytes: the SERIALIZED npz size — what actually crosses the boundary,
+# never an in-memory nbytes sum; crc_verify_s: wire integrity-check
+# wall clock, null off the wire; retries: wire rejections this uid
+# survived before the move}) — enforced conditionally by
+# validate_record (the REQUEST_COMPLETED_REQUIRED pattern: routed/shed
+# decisions move nothing, so pinning kind-wide would force meaningless
+# nulls). A rejected wire doc (CRC mismatch / torn npz / version skew,
+# runtime/wire.py) emits a ``wire_rejected`` router record whose
+# ``reason`` carries the one-line rejection.
+SCHEMA_VERSION = 10
 
 METRICS_FILENAME = "metrics.jsonl"
 
@@ -228,8 +241,16 @@ SPAN_NAMES = ("queued", "prefill", "replay", "decode", "quarantine",
 ROUTER_REQUIRED = ("step", "uid", "event", "source", "target", "policy")
 
 # The router decision vocabulary (decode/fleet.py emits these; report
-# renders any name, so a new decision kind is additive)
-ROUTER_EVENTS = ("routed", "handoff", "migrated", "shed")
+# renders any name, so a new decision kind is additive).
+# ``wire_rejected`` (v10): a handoff wire doc failed integrity checks
+# (reason = the one-line WireError) and the request was replay-rerouted
+ROUTER_EVENTS = ("routed", "handoff", "migrated", "shed",
+                 "wire_rejected")
+
+# the extra keys a HANDOFF or MIGRATED router record must also carry
+# (v10) — the migration-stall + transport attribution, enforced
+# conditionally by validate_record (other router events move nothing)
+ROUTER_MOVE_REQUIRED = ("blocks", "bytes", "duration_s", "transport")
 
 # The routed-record policy vocabulary: session / prefix affinity,
 # least-loaded admission, or spill (the probed target shed and the
@@ -630,6 +651,14 @@ def validate_record(rec: Any) -> tuple[bool, str]:
         if missing:
             return False, (f"request record (event completed) missing "
                            f"required key(s) {missing}")
+    if kind == "router" and rec.get("event") in ("handoff", "migrated"):
+        # v10 conditional pin: only a move ships blocks/bytes and has a
+        # transport to attribute — routed/shed records place or drop a
+        # request without moving KV
+        missing = [k for k in ROUTER_MOVE_REQUIRED if k not in rec]
+        if missing:
+            return False, (f"router record (event {rec['event']}) "
+                           f"missing required key(s) {missing}")
     if kind == "step" and not isinstance(rec["step"], int):
         return False, (f"step record key 'step' is "
                        f"{type(rec['step']).__name__}, not int")

@@ -1,0 +1,258 @@
+"""Wire-format serialization + the crash-safe publish discipline.
+
+Round 6 earned the durability rules on the checkpoint path (fsync'd
+payloads, per-file CRC-32, tmp-rename-publish) and round 10 copied the
+JSON half of them into the serving snapshot writer — two implementations
+of one discipline. Round 16 needs the SAME rules a third time, at a
+harder boundary: the fleet's KV handoff documents now cross a real
+process boundary as files, where every failure mode the checkpoint
+layer defends against (torn write, bit flip, version skew) can actually
+occur mid-transfer. This module is the single home for all three
+callers:
+
+- **Integrity primitives** (``crc_file`` / ``fsync_file`` /
+  ``fsync_dir`` / ``np_dtype``): lifted verbatim from ``checkpoint.py``
+  (which now re-exports them) so the trainer's checkpoint verify and
+  the serving wire verify share one CRC and one fsync posture.
+
+- **Atomic JSON publish** (``publish_json``): write tmp, fsync, rename
+  over the target, fsync the directory — a SIGKILL between any two
+  instructions leaves either the old document or the new one, never a
+  torn one. ``decode/supervise.py``'s engine snapshots (and therefore
+  every engine-worker process's snapshot publisher) go through this.
+
+- **The handoff wire format** (``write_doc`` / ``read_doc``): one
+  ``export_sequence`` document serialized to a single npz file. Arrays
+  ride as raw uint8 byte buffers (dtype + shape recorded in the
+  header, so int8 codes and ml_dtypes bf16 round-trip bit-exactly
+  without numpy dtype-registry games); every array carries its own
+  CRC-32 in the header; the header itself is a JSON object embedded as
+  one more npz entry. ``read_doc`` REJECTS — with a one-line named
+  reason, wrapped in ``WireError`` — a truncated file, an unparseable
+  header, a wire-version mismatch, a missing array, or a per-array CRC
+  mismatch. The doc-level checks (handoff version, model fingerprint,
+  config compatibility) stay in ``DecodeEngine.import_sequence``,
+  which validates everything BEFORE touching any engine state — so a
+  rejected document can never leave a partial import behind.
+
+The module is deliberately jax-free (numpy + stdlib only): the report
+tool and the router-side transport client import it without paying the
+jax import, and the worker protocol stays testable without a backend.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import time
+import zlib
+
+import numpy as np
+
+# Version of the WIRE ENVELOPE (file layout: header entry name, byte-
+# buffer encoding, CRC placement) — distinct from the handoff DOCUMENT
+# version (``decode/engine.py::HANDOFF_VERSION``, the payload schema
+# import_sequence checks). Either mismatch is a one-line rejection.
+WIRE_VERSION = 1
+
+# the npz entry holding the JSON header (array names must not collide
+# with it; handoff docs use short lowercase names)
+_HEADER_ENTRY = "__wire_header__"
+
+
+class WireError(ValueError):
+    """A wire document failed integrity/version checks. The message is
+    ONE line naming what failed (truncation, header, version, array,
+    CRC) — the reason telemetry records and tests pin."""
+
+
+# ------------------------------------------------- integrity primitives
+
+def crc_file(path: str, chunk: int = 1 << 20) -> int:
+    """Streaming CRC-32 of a file (the checkpoint verify primitive)."""
+    crc = 0
+    with open(path, "rb") as f:
+        while block := f.read(chunk):
+            crc = zlib.crc32(block, crc)
+    return crc
+
+
+def fsync_file(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def fsync_dir(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY | getattr(os, "O_DIRECTORY", 0))
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass  # some filesystems refuse directory fsync; rename still atomic
+    finally:
+        os.close(fd)
+
+
+def np_dtype(name: str) -> np.dtype:
+    """Resolve a saved dtype name, including the ml_dtypes ones
+    (bfloat16, float8_*) numpy can't look up by string."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+# ---------------------------------------------------- atomic publishing
+
+def publish_bytes(path: str, data: bytes) -> None:
+    """Atomically publish ``data`` at ``path``: tmp + fsync + rename +
+    dir fsync. A crash between any two instructions leaves either the
+    old file or the new one — never a torn hybrid."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    fsync_dir(os.path.dirname(path) or ".")
+
+
+def publish_json(path: str, doc: dict) -> str:
+    """Atomic JSON publish (the engine-snapshot discipline,
+    ``decode/supervise.py``). Returns ``path``."""
+    publish_bytes(path, json.dumps(doc).encode("utf-8"))
+    return path
+
+
+# ------------------------------------------------- handoff wire format
+
+def _split_doc(doc: dict) -> tuple[dict, dict]:
+    """``(meta, arrays)``: numpy values go on the wire as byte buffers,
+    everything else (ints, floats, strings, lists, dicts, None) rides
+    in the JSON header verbatim."""
+    meta, arrays = {}, {}
+    for key, val in doc.items():
+        if isinstance(val, np.ndarray):
+            arrays[key] = val
+        else:
+            meta[key] = val
+    return meta, arrays
+
+
+def serialize_doc(doc: dict) -> bytes:
+    """One handoff document -> the npz wire bytes. Array entries are
+    C-contiguous uint8 views of the raw storage bytes; the header
+    records each array's dtype/shape/CRC-32 plus the non-array keys."""
+    meta, arrays = _split_doc(doc)
+    header = {"wire_version": WIRE_VERSION, "meta": meta, "arrays": {}}
+    payload = {}
+    for name, arr in arrays.items():
+        if name == _HEADER_ENTRY:
+            raise ValueError(f"array name {name!r} collides with the "
+                             "wire header entry")
+        buf = np.ascontiguousarray(arr)
+        raw = buf.view(np.uint8).reshape(-1)
+        header["arrays"][name] = {
+            "dtype": str(arr.dtype), "shape": list(arr.shape),
+            "crc32": zlib.crc32(raw.tobytes()),
+        }
+        payload[name] = raw
+    hdr = np.frombuffer(json.dumps(header).encode("utf-8"), np.uint8)
+    out = io.BytesIO()
+    np.savez(out, **{_HEADER_ENTRY: hdr}, **payload)
+    return out.getvalue()
+
+
+def deserialize_doc(data: bytes, stats: dict | None = None) -> dict:
+    """The npz wire bytes -> the handoff document, integrity-verified.
+    Raises ``WireError`` with a one-line reason on a torn/truncated
+    file, missing or unparseable header, wire-version mismatch,
+    missing array, or per-array CRC mismatch. ``stats`` (optional,
+    filled in place) reports ``bytes`` and ``crc_verify_s`` — the
+    transport instrumentation telemetry records."""
+    t0 = time.perf_counter()
+    try:
+        npz = np.load(io.BytesIO(data), allow_pickle=False)
+    except Exception as e:  # noqa: BLE001 — any load failure is a torn doc
+        raise WireError(f"unreadable wire doc (torn/truncated npz): "
+                        f"{type(e).__name__}: {e}") from None
+    def entry(name: str):
+        # the zip container checks its own per-entry CRC at READ time:
+        # damage inside an entry surfaces here as BadZipFile/zlib
+        # errors, which are torn-doc rejections like any other
+        try:
+            return npz[name]
+        except WireError:
+            raise
+        except Exception as e:  # noqa: BLE001 — any read failure
+            raise WireError(f"array {name!r} unreadable (corrupted "
+                            f"npz entry): {type(e).__name__}: "
+                            f"{e}") from None
+
+    with npz:
+        if _HEADER_ENTRY not in npz.files:
+            raise WireError("wire doc missing its header entry "
+                            f"({_HEADER_ENTRY!r})")
+        try:
+            header = json.loads(bytes(entry(_HEADER_ENTRY))
+                                .decode("utf-8"))
+        except WireError:
+            raise
+        except (ValueError, UnicodeDecodeError) as e:
+            raise WireError(f"wire doc header unparseable: "
+                            f"{type(e).__name__}: {e}") from None
+        if header.get("wire_version") != WIRE_VERSION:
+            raise WireError(f"wire version "
+                            f"{header.get('wire_version')!r} != "
+                            f"{WIRE_VERSION}")
+        doc = dict(header.get("meta", {}))
+        for name, spec in header.get("arrays", {}).items():
+            if name not in npz.files:
+                raise WireError(f"wire doc missing array {name!r}")
+            raw = entry(name)
+            got = zlib.crc32(raw.tobytes())
+            if got != int(spec["crc32"]):
+                raise WireError(
+                    f"array {name!r} CRC-32 mismatch ({got:#010x} != "
+                    f"recorded {int(spec['crc32']):#010x}) — corrupted "
+                    "in transit")
+            doc[name] = raw.view(np_dtype(spec["dtype"])) \
+                           .reshape(spec["shape"])
+    if stats is not None:
+        stats["bytes"] = len(data)
+        stats["crc_verify_s"] = round(time.perf_counter() - t0, 6)
+    return doc
+
+
+def write_doc(path: str, doc: dict) -> int:
+    """Serialize + atomically publish one handoff document at ``path``;
+    returns the wire byte count (the serialized size — what actually
+    crosses the boundary)."""
+    data = serialize_doc(doc)
+    publish_bytes(path, data)
+    return len(data)
+
+
+def read_doc(path: str, stats: dict | None = None) -> dict:
+    """Load + verify one published wire document. ``WireError`` (one
+    line, named reason) on any integrity failure — including a file
+    torn below the npz container's own structure."""
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError as e:
+        raise WireError(f"wire doc unreadable: {type(e).__name__}: "
+                        f"{e}") from None
+    return deserialize_doc(data, stats)
+
+
+def doc_wire_bytes(doc: dict) -> int:
+    """The serialized size of a handoff document — the honest ``bytes``
+    for an in-process move (``FleetRouter._doc_bytes`` previously
+    summed in-memory nbytes, undercounting scales + metadata and
+    ignoring the container)."""
+    return len(serialize_doc(doc))

@@ -426,6 +426,79 @@ rm -rf "$FLEET_DIR"
 echo "FLEET_SMOKE=OK"
 phase_done fleet_smoke
 
+echo "=== process-transport smoke ==="
+# The round-16 drill the in-process fleet cannot run (DESIGN.md
+# section 22): 3 engine WORKER PROCESSES behind the router
+# (--transport process; decode/worker.py — socket protocol, KV
+# handoffs as CRC-verified wire files), kill e1 mid-stream — a real
+# SIGKILL of a real process — and every request must complete
+# TOKEN-IDENTICALLY to the in-process fleet oracle. The merged report
+# must show the dead worker + the MIGRATED rows, and the router stream
+# must hold schema-v10 records (migrated records pinning the transport
+# attribution).
+PROC_DIR=$(mktemp -d /tmp/tier1_proc.XXXXXX)
+PROC_ARGS="--prompt_lens 3,7,5 --max_new 8 -d 32 -l 2 --heads 4
+  --vocab 64 --max_seq_len 64 --block_size 8 --prefill_chunk 4
+  --log_every 2"
+if ! timeout -k 10 240 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli generate $PROC_ARGS \
+    --fleet 3 > "$PROC_DIR/oracle.json"; then
+  echo "PROCESS_SMOKE=FAIL (in-process fleet oracle)"
+  rm -rf "$PROC_DIR"; exit 1
+fi
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli generate $PROC_ARGS \
+    --fleet 3 --transport process --fleet_kill e1@4 \
+    --metrics_dir "$PROC_DIR/m" > "$PROC_DIR/proc.json"; then
+  echo "PROCESS_SMOKE=FAIL (process fleet run)"; rm -rf "$PROC_DIR"
+  exit 1
+fi
+if ! timeout -k 10 60 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli report "$PROC_DIR/m/router" \
+    "$PROC_DIR/m/e0" "$PROC_DIR/m/e1" "$PROC_DIR/m/e2" \
+    > "$PROC_DIR/report.txt"; then
+  echo "PROCESS_SMOKE=FAIL (merged report rc)"; rm -rf "$PROC_DIR"
+  exit 1
+fi
+if ! timeout -k 10 60 env JAX_PLATFORMS=cpu python - "$PROC_DIR" <<'EOF'
+import json, os, sys
+from distributed_llm_code_samples_tpu.runtime.telemetry import (
+    METRICS_FILENAME, read_metrics, validate_record)
+base = sys.argv[1]
+oracle = json.load(open(os.path.join(base, "oracle.json")))
+proc = json.load(open(os.path.join(base, "proc.json")))
+a = {s["uid"]: s["tokens"] for s in oracle["sequences"]}
+b = {s["uid"]: s["tokens"] for s in proc["sequences"]}
+assert a == b, "process-fleet tokens != in-process fleet oracle"
+assert not proc["failed"], proc["failed"]
+assert proc["transport"] == "process", proc.get("transport")
+st = proc["fleet"]
+assert st["kills"] == 1 and st["migrations"] >= 1, st
+assert st["engines"]["e1"]["alive"] is False, st["engines"]["e1"]
+records, problems = read_metrics(
+    os.path.join(base, "m", "router", METRICS_FILENAME))
+assert not problems, problems
+routers = [r for r in records if r["kind"] == "router"]
+assert routers and all(validate_record(r)[0] for r in routers)
+migs = [r for r in routers if r["event"] == "migrated"
+        and r["source"] == "e1"]
+assert migs, routers
+assert all(r["transport"]["mode"] == "replay" for r in migs), migs
+rep = open(os.path.join(base, "report.txt")).read()
+assert "engine_killed" in rep and "MIGRATED" in rep, rep[-2000:]
+# the SIGKILLed worker's own stream survived (flushed per record)
+e1_recs, _ = read_metrics(os.path.join(base, "m", "e1",
+                                       METRICS_FILENAME))
+assert e1_recs, "dead worker left no telemetry"
+EOF
+then
+  echo "PROCESS_SMOKE=FAIL (token-identity/schema/report check)"
+  rm -rf "$PROC_DIR"; exit 1
+fi
+rm -rf "$PROC_DIR"
+echo "PROCESS_SMOKE=OK"
+phase_done process_smoke
+
 echo "=== fleet SLO smoke ==="
 # The ISSUE 11 acceptance drill (DESIGN.md section 21): a 3-engine
 # fleet with one migration forced (kill e1 late, so the dead engine's

@@ -302,6 +302,14 @@ def test_bench_decode_contract():
     assert payload["fleet_handoff_blocks_per_sec"] > 0
     assert payload["fleet_handoff_bytes"] > 0
     assert payload["fleet_handoff_stall_p90_ms"] > 0
+    # r16 wire-transport rows (runtime/wire.py through the router:
+    # serialize + fsync'd publish + CRC verify + implant per live
+    # move; byte-identity vs the in-process lane asserted INSIDE the
+    # bench, zero rejections required for the row to price anything)
+    assert payload["fleet_handoff_wire_blocks_per_sec"] > 0
+    assert payload["fleet_handoff_wire_bytes"] > 0
+    assert payload["fleet_handoff_wire_stall_p90_ms"] > 0
+    assert payload["fleet_handoff_wire_vs_inproc"] > 0
 
 
 def _run_trend(root):

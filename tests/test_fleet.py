@@ -525,6 +525,139 @@ def test_fleet_router_records_schema_valid(lm_params, prompts,
 
 
 # ---------------------------------------------------------------------------
+# the wire serialization boundary, in-process (round 16): same router,
+# every live move through runtime/wire.py — the cheap test surface for
+# the process transport's file format
+
+
+def test_wire_mode_fleet_identity_and_transport_records(lm_params,
+                                                        prompts,
+                                                        tmp_path):
+    """``wire_dir=`` routes every live move through the versioned npz
+    wire format (serialize -> publish -> CRC verify -> import): output
+    stays byte-identical to the in-process fleet, and the schema-v10
+    ``transport`` attribution on handoff records flips from
+    {mode inproc, crc null} to {mode wire, measured crc_verify_s} with
+    ``bytes`` the SERIALIZED size both ways (never the nbytes sum)."""
+
+    def run(wire_dir, mdir):
+        rm = TelemetryWriter(str(tmp_path / mdir),
+                             meta={"engine_id": "router"})
+        fl = FleetRouter(_mk(lm_params), 2, prefill_engines=1,
+                         wire_dir=wire_dir, metrics=rm)
+        for p in prompts[:3]:
+            fl.submit(p, 6)
+        outs = fl.run()
+        rm.close()
+        records, problems = read_metrics(
+            os.path.join(str(tmp_path / mdir), METRICS_FILENAME))
+        assert not problems, problems
+        return fl, outs, [r for r in records if r["kind"] == "router"]
+
+    fl_w, outs_w, recs_w = run(str(tmp_path / "wire"), "rw")
+    fl_p, outs_p, recs_p = run(None, "rp")
+    assert outs_w == outs_p
+    hand_w = [r for r in recs_w if r["event"] == "handoff"]
+    hand_p = [r for r in recs_p if r["event"] == "handoff"]
+    assert len(hand_w) == len(hand_p) == 3
+    # the per-block raw KV bytes of one full block at f32 MHA: the
+    # serialized doc must exceed the raw payload it carries (container
+    # + scheduler metadata + header), and the two lanes must agree —
+    # bytes is the serialized size regardless of transport
+    L_, Hkv, dh = 2, 4, 32 // 4
+    per_block = 2 * L_ * Hkv * BASE["block_size"] * dh * 4
+    for rw, rp in zip(hand_w, hand_p):
+        assert rw["transport"]["mode"] == "wire"
+        assert rw["transport"]["crc_verify_s"] >= 0
+        assert rw["transport"]["retries"] == 0
+        assert rp["transport"]["mode"] == "inproc"
+        assert rp["transport"]["crc_verify_s"] is None
+        # both lanes report the SERIALIZED size (> the raw KV payload
+        # the doc carries); they differ only by JSON float-repr jitter
+        # in the header (t_submit et al), never by payload
+        assert abs(rw["bytes"] - rp["bytes"]) < 64
+        assert min(rw["bytes"], rp["bytes"]) > rw["blocks"] * per_block
+        ok, reason = validate_record(rw)
+        assert ok, reason
+    # consumed wire files are cleaned up (rejects would be kept)
+    import glob
+    assert not glob.glob(str(tmp_path / "wire" / "*.npz"))
+
+
+def test_corrupt_wire_inproc_rejected_and_replayed(lm_params, prompts,
+                                                   tmp_path):
+    """``corrupt_wire`` bit-flips the next wire doc in transit: the CRC
+    layer must reject it with a named one-line reason (schema-v10
+    ``wire_rejected`` record), the request must be REPLAY-rerouted
+    (migrated record, transport mode replay, retries counting the
+    rejection), no engine imports partial state, and every token still
+    matches the clean fleet bit for bit."""
+    from distributed_llm_code_samples_tpu.runtime.chaos import (
+        FaultPlan, validate_fleet_plan)
+    plan = FaultPlan.parse("corrupt_wire@1")
+    validate_fleet_plan(plan)
+    rm = TelemetryWriter(str(tmp_path / "router"),
+                         meta={"engine_id": "router"})
+    fl = FleetRouter(_mk(lm_params), 2, prefill_engines=1,
+                     wire_dir=str(tmp_path / "wire"), metrics=rm,
+                     fleet_chaos=plan)
+    for p in prompts[:3]:
+        fl.submit(p, 6)
+    outs = fl.run()
+    rm.close()
+
+    clean = FleetRouter(_mk(lm_params), 2, prefill_engines=1)
+    for p in prompts[:3]:
+        clean.submit(p, 6)
+    assert outs == clean.run()
+    assert fl.wire_rejects == 1 and not fl.failed()
+
+    records, problems = read_metrics(
+        os.path.join(str(tmp_path / "router"), METRICS_FILENAME))
+    assert not problems, problems
+    routers = [r for r in records if r["kind"] == "router"]
+    [rej] = [r for r in routers if r["event"] == "wire_rejected"]
+    assert "CRC" in rej["reason"] or "unreadable" in rej["reason"]
+    assert "\n" not in rej["reason"]
+    replays = [r for r in routers if r["event"] == "migrated"
+               and r["reason"] == "wire_rejected"]
+    assert len(replays) == 1
+    assert replays[0]["uid"] == rej["uid"]
+    assert replays[0]["transport"]["mode"] == "replay"
+    assert replays[0]["transport"]["retries"] == 1
+    assert replays[0]["blocks"] == 0 and replays[0]["bytes"] == 0
+    # the rejected wire file is KEPT for post-mortem
+    import glob
+    assert glob.glob(str(tmp_path / "wire" / "*.npz"))
+
+
+def test_fleet_chaos_validated_at_construction(lm_params, tmp_path):
+    """Every fleet-chaos fault this fleet cannot honor rejects at
+    CONSTRUCTION, not rounds later at fire time: corrupt_wire needs a
+    wire boundary, hang_worker needs the process transport, and
+    kill_worker's index must name a decode engine that is not the sole
+    one."""
+    from distributed_llm_code_samples_tpu.runtime.chaos import FaultPlan
+    with pytest.raises(ValueError, match="corrupt_wire"):
+        FleetRouter(_mk(lm_params), 2,
+                    fleet_chaos=FaultPlan.parse("corrupt_wire@2"))
+    with pytest.raises(ValueError, match="hang_worker"):
+        FleetRouter(_mk(lm_params), 2,
+                    fleet_chaos=FaultPlan.parse("hang_worker@2"))
+    with pytest.raises(ValueError, match="kill_worker index 7"):
+        FleetRouter(_mk(lm_params), 2,
+                    fleet_chaos=FaultPlan.parse("kill_worker@2:7"))
+    with pytest.raises(ValueError, match="only decode engine"):
+        FleetRouter(_mk(lm_params), 2, prefill_engines=1,
+                    wire_dir=str(tmp_path / "w"),
+                    fleet_chaos=FaultPlan.parse("kill_worker@2"))
+    # a kill_worker plan an in-process wire fleet CAN honor constructs
+    fl = FleetRouter(_mk(lm_params), 2,
+                     fleet_chaos=FaultPlan.parse("kill_worker@2:1"))
+    assert fl.fleet_chaos is not None
+
+
+# ---------------------------------------------------------------------------
 # CLI surface (parse rejections in-process: rc 2 before any engine)
 
 
@@ -560,6 +693,25 @@ BASE_ARGS = ["--prompt_lens", "3,7", "--max_new", "4", "-d", "32",
     # the fleet names its own streams — --engine_id would be silently
     # ignored, so it rejects like the other single-engine-only flags
     ["--fleet", "2", "--engine_id", "myhost"],
+    # round 16 process-transport flags: fleet-only, and --fleet_chaos
+    # needs a boundary that can actually fail
+    ["--transport", "process"],
+    ["--fleet_chaos", "kill_worker@4"],
+    ["--fleet", "3", "--fleet_chaos", "kill_worker@4"],
+    ["--fleet", "3", "--transport", "process", "--fleet_chaos",
+     "nan_logits@3"],
+    ["--fleet", "3", "--transport", "process", "--fleet_chaos",
+     "kill_worker@4:7"],
+    ["--fleet", "3", "--transport", "process", "--fleet_chaos",
+     "kill_worker@4:-1"],
+    ["--fleet", "3", "--transport", "process", "--fleet_chaos",
+     "hang_worker@4:-2"],
+    ["--fleet", "3", "--transport", "process", "--fleet_chaos",
+     "corrupt_wire@4:0.5"],
+    # killing the SOLE decode worker is knowable at parse time, like
+    # the --fleet_kill twin above
+    ["--fleet", "2", "--prefill_engines", "1", "--transport",
+     "process", "--fleet_chaos", "kill_worker@2"],
 ])
 def test_cli_fleet_flag_rejections(extra):
     assert _gen(BASE_ARGS + extra) == 2

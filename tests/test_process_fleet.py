@@ -1,0 +1,212 @@
+"""The process-boundary fleet (decode/worker.py + decode/fleet.py,
+DESIGN.md section 22): engine workers in REAL OS processes behind the
+socket protocol, KV handoffs as CRC-verified wire files, and the chaos
+drills a single process cannot run — SIGKILL a worker mid-stream, hang
+one silent, tear a handoff file in transit — each completing every
+request token-identically against the in-process oracle.
+
+Every test here spawns worker subprocesses (jax import + engine build
+per worker), so the module is ``serial``-marked and deadlines are
+load-scaled. Worker counts are kept minimal; the model/config shapes
+are the shared test fixtures (V=64, D=32, L=2, H=4, BASE blocks) so
+every compiled program hits the persistent XLA cache.
+"""
+
+import os
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from conftest import load_scaled_timeout
+from distributed_llm_code_samples_tpu.decode import (DecodeEngine,
+                                                     EngineConfig,
+                                                     FleetRouter)
+from distributed_llm_code_samples_tpu.decode.worker import (
+    spawn_fleet_handles)
+from distributed_llm_code_samples_tpu.models import init_lm
+from distributed_llm_code_samples_tpu.runtime.chaos import (
+    FaultPlan, validate_fleet_plan)
+from distributed_llm_code_samples_tpu.runtime.telemetry import (
+    METRICS_FILENAME, TelemetryWriter, read_metrics, validate_record)
+
+pytestmark = pytest.mark.serial
+
+V, D, L, H = 64, 32, 2, 4
+BASE = dict(block_size=8, n_blocks=33, max_slots=3, max_blocks_per_seq=6,
+            prefill_chunk=8)
+MODEL = dict(vocab=V, model_size=D, layers=L, heads=H, kv_heads=None,
+             max_seq_len=64, random_seed=0)
+MAX_NEW = 8
+
+
+@pytest.fixture(scope="module")
+def lm_params():
+    return init_lm(jax.random.PRNGKey(0), V, D, L, max_seq_len=64)
+
+
+@pytest.fixture(scope="module")
+def prompts():
+    rng = np.random.default_rng(1)
+    return [rng.integers(0, V, size=n).tolist() for n in (5, 9, 13)]
+
+
+def _oracle(lm_params, prompts, **cfg_extra):
+    eng = DecodeEngine(lm_params, H, EngineConfig(**BASE, **cfg_extra))
+    for p in prompts:
+        eng.submit(p, MAX_NEW)
+    return eng.run()
+
+
+def _spawn(n, prefill, base_dir, metrics_root=None, **cfg_extra):
+    deadline = load_scaled_timeout(120.0)
+    return spawn_fleet_handles(
+        n, prefill, str(base_dir), model=MODEL,
+        config={**BASE, **cfg_extra}, policy={},
+        metrics_root=metrics_root,
+        call_deadline_s=deadline, connect_deadline_s=deadline)
+
+
+def test_process_fleet_matches_oracle_with_records(lm_params, prompts,
+                                                   tmp_path):
+    """Two worker processes behind the router: byte-identical to the
+    single-engine oracle, schema-v10 router + fleet records from the
+    router's own writer, every worker reaped on close."""
+    want = _oracle(lm_params, prompts)
+    rm = TelemetryWriter(str(tmp_path / "router"),
+                         meta={"engine_id": "router"})
+    handles = _spawn(2, 0, tmp_path / "spool")
+    fl = FleetRouter(None, 2, handles=handles, metrics=rm)
+    try:
+        for p in prompts:
+            fl.submit(p, MAX_NEW)
+        out = fl.run()
+    finally:
+        fl.close()
+        rm.close()
+    assert out == want and not fl.failed()
+    for h in handles:
+        assert h.proc.poll() is not None        # reaped, no orphans
+    records, problems = read_metrics(
+        os.path.join(str(tmp_path / "router"), METRICS_FILENAME))
+    assert not problems, problems
+    routers = [r for r in records if r["kind"] == "router"]
+    fleets = [r for r in records if r["kind"] == "fleet"]
+    assert routers and fleets
+    for r in routers + fleets:
+        ok, reason = validate_record(r)
+        assert ok, reason
+    assert {r["event"] for r in routers} == {"routed"}
+
+
+@pytest.mark.parametrize("kv_dtype", ["f32", "int8"])
+def test_process_kill_one_of_three_drill(lm_params, prompts, tmp_path,
+                                         kv_dtype):
+    """THE acceptance drill across a real process boundary: SIGKILL one
+    of three worker processes mid-stream (kill_worker@4:1 — a real
+    dead host, pid-verified) and every request completes
+    token-identically vs the unkilled oracle, at f32 and int8 KV. The
+    replay-migration records carry transport mode "replay" with
+    blocks/bytes honestly 0."""
+    want = _oracle(lm_params, prompts, kv_dtype=kv_dtype)
+    plan = FaultPlan.parse("kill_worker@4:1")
+    validate_fleet_plan(plan)
+    rm = TelemetryWriter(str(tmp_path / "router"),
+                         meta={"engine_id": "router"})
+    handles = _spawn(3, 0, tmp_path / "spool", kv_dtype=kv_dtype)
+    fl = FleetRouter(None, 3, handles=handles, metrics=rm,
+                     fleet_chaos=plan)
+    try:
+        pid = fl.by_id["e1"].proc.pid
+        for p in prompts:
+            fl.submit(p, MAX_NEW)
+        out = fl.run()
+    finally:
+        fl.close()
+        rm.close()
+    assert out == want and not fl.failed()
+    assert fl.kills == 1 and not fl.by_id["e1"].alive
+    time.sleep(0.1)
+    with pytest.raises(ProcessLookupError):
+        os.kill(pid, 0)                 # the process is REALLY dead
+    records, _ = read_metrics(
+        os.path.join(str(tmp_path / "router"), METRICS_FILENAME))
+    migs = [r for r in records if r["kind"] == "router"
+            and r["event"] == "migrated"]
+    assert migs and all(r["source"] == "e1" for r in migs)
+    for r in migs:
+        ok, reason = validate_record(r)
+        assert ok, reason
+        assert r["transport"]["mode"] == "replay"
+        assert r["blocks"] == 0 and r["bytes"] == 0
+
+
+def test_process_hang_worker_declared_dead(lm_params, prompts,
+                                           tmp_path):
+    """A silently hung worker (hang_worker@4:12 — alive but
+    unresponsive): the liveness ladder (per-call deadline ->
+    bounded-backoff retries -> declare dead -> SIGKILL) converts it
+    into a dead host, and its requests complete token-identically on
+    the survivor. Deadlines are tightened only AFTER the program set
+    is warm — a compile inside a deadline would read as a hang."""
+    want = _oracle(lm_params, prompts)
+    plan = FaultPlan.parse("hang_worker@4:12")
+    validate_fleet_plan(plan)
+    handles = _spawn(2, 0, tmp_path / "spool")
+    for h in handles:
+        h.warm(deadline_s=load_scaled_timeout(300.0))
+        h.call_deadline_s = load_scaled_timeout(3.0)
+    fl = FleetRouter(None, 2, handles=handles, fleet_chaos=plan)
+    try:
+        for p in prompts:
+            fl.submit(p, MAX_NEW)
+        out = fl.run()
+    finally:
+        fl.close()
+    assert out == want and not fl.failed()
+    assert fl.kills == 1 and not fl.by_id["e0"].alive
+    assert fl.by_id["e0"].proc.poll() is not None   # zombie fenced
+
+
+def test_process_corrupt_wire_rejected_and_replayed(lm_params, prompts,
+                                                    tmp_path):
+    """A real half-shipped handoff: the disaggregated prefill tier
+    exports over wire files, corrupt_wire@2 bit-flips the next one in
+    transit, the CRC layer rejects it with a named reason
+    (wire_rejected record), the request replays on the decode tier,
+    and all tokens still match the oracle. Undamaged handoffs cross
+    with transport mode "wire" and a measured crc_verify_s."""
+    want = _oracle(lm_params, prompts)
+    plan = FaultPlan.parse("corrupt_wire@2")
+    validate_fleet_plan(plan)
+    rm = TelemetryWriter(str(tmp_path / "router"),
+                         meta={"engine_id": "router"})
+    handles = _spawn(3, 1, tmp_path / "spool")
+    fl = FleetRouter(None, 3, prefill_engines=1, handles=handles,
+                     metrics=rm, fleet_chaos=plan)
+    try:
+        for p in prompts:
+            fl.submit(p, MAX_NEW)
+        out = fl.run()
+    finally:
+        fl.close()
+        rm.close()
+    assert out == want and not fl.failed()
+    assert fl.wire_rejects == 1
+    records, problems = read_metrics(
+        os.path.join(str(tmp_path / "router"), METRICS_FILENAME))
+    assert not problems, problems
+    routers = [r for r in records if r["kind"] == "router"]
+    [rej] = [r for r in routers if r["event"] == "wire_rejected"]
+    assert ("CRC" in rej["reason"] or "unreadable" in rej["reason"]
+            or "corrupted" in rej["reason"])
+    replays = [r for r in routers if r["event"] == "migrated"
+               and r["reason"] == "wire_rejected"]
+    assert len(replays) == 1 and replays[0]["uid"] == rej["uid"]
+    hand = [r for r in routers if r["event"] == "handoff"]
+    assert hand, "no clean handoff crossed the wire"
+    for r in hand:
+        assert r["transport"]["mode"] == "wire"
+        assert r["transport"]["crc_verify_s"] >= 0
+        assert r["bytes"] > 0 and r["blocks"] > 0

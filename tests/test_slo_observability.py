@@ -218,7 +218,9 @@ def test_slo_migration_gap_attribution(tmp_path, capsys):
         w.router({"step": 4, "uid": 0, "event": "migrated",
                   "source": "e1", "target": "e0",
                   "reason": "engine_killed", "replay": 2, "blocks": 0,
-                  "bytes": 0, "duration_s": 0.001, "t": 102.0})
+                  "bytes": 0, "duration_s": 0.001, "t": 102.0,
+                  "transport": {"mode": "replay", "bytes": 0,
+                                "crc_verify_s": None, "retries": 0}})
     doc = _report_json(capsys, [rdir, src, dst, "--slo", "1.0:0.2"])
     slo = doc["slo"]
     assert slo == json.loads(json.dumps(slo))       # serializable
@@ -257,7 +259,9 @@ def test_slo_pre_first_token_migration_attribution(tmp_path, capsys):
         w.router({"step": 3, "uid": 0, "event": "migrated",
                   "source": "e1", "target": "e0",
                   "reason": "engine_killed", "replay": 0, "blocks": 0,
-                  "bytes": 0, "duration_s": 0.001, "t": 101.4})
+                  "bytes": 0, "duration_s": 0.001, "t": 101.4,
+                  "transport": {"mode": "replay", "bytes": 0,
+                                "crc_verify_s": None, "retries": 0}})
     doc = _report_json(capsys, [rdir, src, dst, "--slo", "0.5:10"])
     slo = doc["slo"]
     assert slo["completed"] == 1 and slo["unreconciled"] == 0

@@ -52,8 +52,13 @@ from distributed_llm_code_samples_tpu.runtime.telemetry import (
 # latency_s + ttft_s, the "router" contract pins the placement
 # "policy", and the "fleet" kind (one per-round fleet health record —
 # per-engine waiting/active/free-blocks/utilization + load imbalance,
-# decode/fleet.py) lands with FLEET_REQUIRED.
-_PINNED_VERSION = 9
+# decode/fleet.py) lands with FLEET_REQUIRED. v10 (round 16): the
+# process-boundary transport — handoff/migrated router records
+# conditionally pin blocks/bytes/duration_s + the ``transport``
+# attribution ({mode, bytes, crc_verify_s, retries}; bytes = the
+# SERIALIZED wire size), and the ``wire_rejected`` router event lands
+# (a CRC/torn/version-rejected handoff doc, runtime/wire.py).
+_PINNED_VERSION = 10
 _PINNED_STEP_KEYS = frozenset({
     "schema", "kind", "t", "step", "strategy", "loss", "grad_norm",
     "tokens_per_sec", "step_time_s", "mfu", "hbm_high_water_bytes",
@@ -78,14 +83,16 @@ _PINNED_ROUTER_REQUIRED = frozenset({
 _PINNED_REQUEST_COMPLETED_REQUIRED = frozenset({"latency_s", "ttft_s"})
 _PINNED_FLEET_REQUIRED = frozenset({"step", "engines",
                                     "load_imbalance"})
+_PINNED_ROUTER_MOVE_REQUIRED = frozenset({"blocks", "bytes",
+                                          "duration_s", "transport"})
 
 
 def test_schema_version_bump_discipline():
     from distributed_llm_code_samples_tpu.runtime.telemetry import (
         ANOMALY_REQUIRED, DECODE_REQUIRED, FLEET_REQUIRED,
         RECORD_KINDS, REQUEST_COMPLETED_REQUIRED, REQUEST_REQUIRED,
-        REQUIRED_KEYS, ROLLBACK_REQUIRED, ROUTER_REQUIRED,
-        SPAN_REQUIRED)
+        REQUIRED_KEYS, ROLLBACK_REQUIRED, ROUTER_MOVE_REQUIRED,
+        ROUTER_REQUIRED, SPAN_REQUIRED)
     assert SCHEMA_VERSION == _PINNED_VERSION and \
         frozenset(STEP_KEYS) == _PINNED_STEP_KEYS and \
         frozenset(ANOMALY_REQUIRED) == _PINNED_ANOMALY_REQUIRED and \
@@ -96,6 +103,8 @@ def test_schema_version_bump_discipline():
         _PINNED_REQUEST_COMPLETED_REQUIRED and \
         frozenset(SPAN_REQUIRED) == _PINNED_SPAN_REQUIRED and \
         frozenset(ROUTER_REQUIRED) == _PINNED_ROUTER_REQUIRED and \
+        frozenset(ROUTER_MOVE_REQUIRED) == \
+        _PINNED_ROUTER_MOVE_REQUIRED and \
         frozenset(FLEET_REQUIRED) == _PINNED_FLEET_REQUIRED, (
             "telemetry record schema changed: bump SCHEMA_VERSION "
             "and update the pinned sets here in the same commit")
@@ -252,28 +261,67 @@ def test_router_record_round_trip(tmp_path):
     default to null for decisions that have none (a routed request has
     no source engine; a migration takes no placement policy)."""
     w = TelemetryWriter(str(tmp_path))
+    transport = {"mode": "replay", "bytes": 0, "crc_verify_s": None,
+                 "retries": 0}
     w.router({"step": 2, "uid": 7, "event": "migrated", "source": "e1",
               "target": "e0", "reason": "engine_killed",
-              "blocks": 0, "bytes": 0, "duration_s": 0.001})
+              "blocks": 0, "bytes": 0, "duration_s": 0.001,
+              "transport": transport})
     w.router({"step": 0, "uid": 3, "event": "routed", "target": "e2",
               "reason": "prefix", "policy": "prefix",
               "prefix_hit_blocks": 2})
+    w.router({"step": 4, "uid": 7, "event": "wire_rejected",
+              "source": "p0", "target": "e0",
+              "reason": "array 'k' CRC-32 mismatch (0x1 != 0x2)"})
     w.close()
-    records, problems = read_metrics(os.path.join(str(tmp_path),
-                                                  METRICS_FILENAME))
-    assert problems == []
-    mig, routed = records
+    path = os.path.join(str(tmp_path), METRICS_FILENAME)
+    with open(path, "a") as f:
+        f.write('{"schema": 10, "kind": "rou')  # torn write
+    records, problems = read_metrics(path)
+    assert len(problems) == 1 and "torn" in problems[0]
+    mig, routed, rej = records
     assert mig["kind"] == "router" and mig["schema"] == SCHEMA_VERSION
     assert mig["source"] == "e1" and mig["target"] == "e0"
     assert mig["reason"] == "engine_killed"
     assert mig["policy"] is None        # writer default: no placement
     assert mig["duration_s"] == 0.001   # the stall instrumentation
+    assert mig["transport"]["mode"] == "replay"
     assert routed["source"] is None and routed["target"] == "e2"
     assert routed["policy"] == "prefix"
     assert routed["prefix_hit_blocks"] == 2
+    # v10: the wire_rejected event carries the one-line WireError and
+    # needs no transport (nothing moved)
+    assert rej["event"] == "wire_rejected" and "CRC-32" in rej["reason"]
     for r in records:
         ok, reason = validate_record(r)
         assert ok, reason
+
+
+def test_router_move_record_conditional_pin():
+    """v10: a handoff/migrated router record must carry the move
+    instrumentation (blocks/bytes/duration_s) AND the transport
+    attribution; routed/shed/wire_rejected records move nothing and
+    never pin them — per event, per key."""
+    base = {"schema": SCHEMA_VERSION, "kind": "router", "t": 0.0,
+            "step": 1, "uid": 2, "source": "p0", "target": "e0",
+            "policy": None}
+    move_keys = {"blocks": 3, "bytes": 4096, "duration_s": 0.01,
+                 "transport": {"mode": "wire", "bytes": 4096,
+                               "crc_verify_s": 0.0001, "retries": 0}}
+    for event in ("handoff", "migrated"):
+        ok, reason = validate_record({**base, "event": event,
+                                      **move_keys})
+        assert ok, reason
+        for key in sorted(move_keys):
+            rec = {**base, "event": event, **move_keys}
+            del rec[key]
+            ok, reason = validate_record(rec)
+            assert not ok and event in reason and key in reason, \
+                (event, key, reason)
+            assert "\n" not in reason
+    for event in ("routed", "shed", "wire_rejected"):
+        ok, reason = validate_record({**base, "event": event})
+        assert ok, (event, reason)
 
 
 def test_fleet_record_round_trip_and_torn_tail(tmp_path):

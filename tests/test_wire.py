@@ -1,0 +1,246 @@
+"""The wire format + shared integrity discipline (runtime/wire.py,
+DESIGN.md section 22): round-trip bit-exactness per storage dtype,
+one-line named rejection of every damage class (truncated tail,
+per-array CRC mismatch, wire-version skew), the lifted-primitive
+contract (checkpoint.py and decode/supervise.py now point at wire.py's
+CRC/fsync/publish), and the no-partial-import guarantee — a rejected
+document leaves the target engine untouched, whichever layer (wire
+envelope, handoff version, model fingerprint) rejected it."""
+
+import io
+import json
+import os
+import zlib
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_llm_code_samples_tpu.decode import (DecodeEngine,
+                                                     EngineConfig)
+from distributed_llm_code_samples_tpu.models import init_lm
+from distributed_llm_code_samples_tpu.runtime import wire
+from distributed_llm_code_samples_tpu.runtime.wire import WireError
+
+V, D, L, H = 64, 32, 2, 4
+BASE = dict(block_size=8, n_blocks=33, max_slots=3, max_blocks_per_seq=6,
+            prefill_chunk=8)
+
+
+@pytest.fixture(scope="module")
+def lm_params():
+    return init_lm(jax.random.PRNGKey(0), V, D, L, max_seq_len=64)
+
+
+def _doc(kv_dtype="f32"):
+    """A handoff-shaped document with every value class the wire must
+    carry: arrays at three storage dtypes, nested JSON meta, None."""
+    import ml_dtypes
+    rng = np.random.default_rng(0)
+    k = rng.standard_normal((2, 3, 4, 8, 8)).astype(np.float32)
+    if kv_dtype == "bf16":
+        k = k.astype(ml_dtypes.bfloat16)
+    elif kv_dtype == "int8":
+        k = (k * 10).astype(np.int8)
+    return {
+        "handoff_version": 3, "uid": 7, "prompt": [1, 2, 3],
+        "out": [4, 5], "max_new": 6, "position": 5, "t_first": None,
+        "model": {"vocab": V, "wte0_sum": -1.25},
+        "config": {"kv_dtype": kv_dtype, "block_size": 8},
+        "k": k, "v": k.copy(),
+        "k_scale": (rng.standard_normal((2, 3, 4)).astype(np.float32)
+                    if kv_dtype == "int8" else None),
+        "v_scale": None,
+    }
+
+
+def _bits(a):
+    return np.asarray(a).view(np.uint8)
+
+
+# ---------------------------------------------------------------------------
+# round-trip + rejection classes (pure numpy — no engine in the loop)
+
+
+@pytest.mark.parametrize("kv_dtype", ["f32", "bf16", "int8"])
+def test_wire_round_trip_bit_exact(tmp_path, kv_dtype):
+    doc = _doc(kv_dtype)
+    path = str(tmp_path / "doc.npz")
+    n = wire.write_doc(path, doc)
+    assert n == os.path.getsize(path)
+    stats = {}
+    back = wire.read_doc(path, stats)
+    assert stats["bytes"] == n and stats["crc_verify_s"] >= 0
+    for key, val in doc.items():
+        if isinstance(val, np.ndarray):
+            assert back[key].dtype == val.dtype
+            np.testing.assert_array_equal(_bits(back[key]), _bits(val))
+        else:
+            assert back[key] == val, key
+    # the serialized size exceeds the raw array payload (container +
+    # header + scheduler metadata) — what _doc_bytes used to undercount
+    raw = sum(v.nbytes for v in doc.values()
+              if isinstance(v, np.ndarray))
+    assert wire.doc_wire_bytes(doc) == n > raw
+
+
+def test_wire_rejects_truncated_tail(tmp_path):
+    path = str(tmp_path / "doc.npz")
+    wire.write_doc(path, _doc())
+    data = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(data[: len(data) // 2])
+    with pytest.raises(WireError) as e:
+        wire.read_doc(path)
+    assert "torn" in str(e.value) or "unreadable" in str(e.value)
+    assert "\n" not in str(e.value)
+
+
+def test_wire_rejects_per_array_crc_mismatch(tmp_path):
+    """One tampered array with the header's recorded CRC left stale —
+    the zip container is rewritten consistently, so only wire.py's OWN
+    per-array CRC can catch it; the rejection names the array."""
+    path = str(tmp_path / "doc.npz")
+    wire.write_doc(path, _doc())
+    with np.load(path) as npz:
+        arrays = {m: npz[m] for m in npz.files}
+    vm = arrays["v"].copy()
+    vm[0] ^= 0xFF
+    arrays["v"] = vm
+    out = io.BytesIO()
+    np.savez(out, **arrays)     # fresh zip CRCs, stale header CRCs
+    with open(path, "wb") as f:
+        f.write(out.getvalue())
+    with pytest.raises(WireError) as e:
+        wire.read_doc(path)
+    assert "'v'" in str(e.value) and "CRC-32 mismatch" in str(e.value)
+    assert "\n" not in str(e.value)
+
+
+def test_wire_rejects_version_and_header_damage(tmp_path):
+    path = str(tmp_path / "doc.npz")
+    wire.write_doc(path, _doc())
+    with np.load(path) as npz:
+        arrays = {m: npz[m] for m in npz.files}
+    hdr = json.loads(bytes(arrays["__wire_header__"]).decode())
+    hdr["wire_version"] = 99
+    arrays["__wire_header__"] = np.frombuffer(
+        json.dumps(hdr).encode(), np.uint8)
+    out = io.BytesIO()
+    np.savez(out, **arrays)
+    with open(path, "wb") as f:
+        f.write(out.getvalue())
+    with pytest.raises(WireError, match="wire version 99"):
+        wire.read_doc(path)
+    # a missing header entry is its own named rejection
+    del arrays["__wire_header__"]
+    out = io.BytesIO()
+    np.savez(out, **arrays)
+    with open(path, "wb") as f:
+        f.write(out.getvalue())
+    with pytest.raises(WireError, match="header"):
+        wire.read_doc(path)
+
+
+def test_publish_json_atomic_replace(tmp_path):
+    path = str(tmp_path / "doc.json")
+    wire.publish_json(path, {"a": 1})
+    wire.publish_json(path, {"a": 2})
+    assert json.load(open(path)) == {"a": 2}
+    assert not os.path.exists(path + ".tmp")
+
+
+def test_lifted_primitives_are_shared():
+    """Satellite: checkpoint.py's CRC/fsync/dtype primitives ARE
+    wire.py's (re-bound, not re-implemented), and the serving snapshot
+    publisher routes through wire.publish_json — one discipline, three
+    callers."""
+    from distributed_llm_code_samples_tpu import checkpoint
+    assert checkpoint._crc_file is wire.crc_file
+    assert checkpoint._fsync_file is wire.fsync_file
+    assert checkpoint._fsync_dir is wire.fsync_dir
+    assert checkpoint._np_dtype is wire.np_dtype
+    import inspect
+
+    from distributed_llm_code_samples_tpu.decode import supervise
+    assert "publish_json" in inspect.getsource(supervise.write_snapshot)
+
+
+def test_crc_file_matches_crc32(tmp_path):
+    path = str(tmp_path / "blob")
+    data = os.urandom(1 << 16)
+    with open(path, "wb") as f:
+        f.write(data)
+    assert wire.crc_file(path) == zlib.crc32(data)
+
+
+# ---------------------------------------------------------------------------
+# no-partial-import: every rejection layer leaves the target untouched
+
+
+def _engine_state(e):
+    return (len(e.free_blocks), tuple(s.uid if s else None
+                                      for s in e.slots),
+            len(e.waiting), dict(e.finished), e.block_allocs,
+            e._next_uid)
+
+
+def _exported_doc(lm_params, kv_dtype="f32"):
+    a = DecodeEngine(lm_params, H, EngineConfig(**BASE,
+                                                kv_dtype=kv_dtype))
+    a.submit([1, 2, 3, 4, 5], 8, uid=5)
+    for _ in range(3):
+        a.step()
+    return a.export_sequence(5)
+
+
+@pytest.mark.parametrize("damage", ["truncate", "crc", "wire_version",
+                                    "handoff_version", "fingerprint"])
+def test_rejected_doc_leaves_target_untouched(lm_params, tmp_path,
+                                              damage):
+    """Each rejection layer — torn npz, per-array CRC, wire-envelope
+    version, handoff-document version, model fingerprint — fails with
+    a one-line reason BEFORE the target engine allocates anything:
+    free blocks, slots, queue, finished map, churn counters and the
+    uid clock are bit-for-bit what they were."""
+    doc = _exported_doc(lm_params)
+    path = str(tmp_path / "doc.npz")
+    if damage == "handoff_version":
+        doc = {**doc, "handoff_version": 2}
+    elif damage == "fingerprint":
+        doc = {**doc, "model": {**doc["model"], "wte0_sum": 123.0}}
+    wire.write_doc(path, doc)
+    if damage == "truncate":
+        data = open(path, "rb").read()
+        with open(path, "wb") as f:
+            f.write(data[: len(data) - 40])
+    elif damage in ("crc", "wire_version"):
+        with np.load(path) as npz:
+            arrays = {m: npz[m] for m in npz.files}
+        if damage == "crc":
+            km = arrays["k"].copy()
+            km[-1] ^= 0x55
+            arrays["k"] = km
+        else:
+            hdr = json.loads(bytes(arrays["__wire_header__"]).decode())
+            hdr["wire_version"] = 0
+            arrays["__wire_header__"] = np.frombuffer(
+                json.dumps(hdr).encode(), np.uint8)
+        out = io.BytesIO()
+        np.savez(out, **arrays)
+        with open(path, "wb") as f:
+            f.write(out.getvalue())
+
+    b = DecodeEngine(lm_params, H, EngineConfig(**BASE))
+    b.submit([9, 8, 7], 4, uid=2)
+    b.step()
+    before = _engine_state(b)
+    with pytest.raises((WireError, ValueError)) as e:
+        loaded = wire.read_doc(path)        # wire-layer damage raises
+        b.import_sequence(loaded)           # doc-layer damage raises
+    assert "\n" not in str(e.value)         # one-line reason contract
+    assert _engine_state(b) == before, \
+        f"{damage}: rejected import mutated the target engine"
+    # and the engine still works: the resident request drains normally
+    done = b.run()
+    assert len(done[2]) == 3 + 4
