@@ -127,6 +127,26 @@ should pay ~1 prefill and ~1 copy of the shared KV, not N):
   shrinks usable capacity. Snapshot v4 persists the tree + refcounts;
   resume rebuilds the share graph through replay (the first replayed
   sharer re-prefills and re-inserts, later ones hit).
+
+Live weight hot-swap layer (round 17, DESIGN.md section 23 — the
+fleet's rolling deploy rides it):
+
+- **Double-buffered weights**: ``weights: {version -> params}`` with
+  ``serving_version`` naming what new admissions take
+  (``load_weights`` / ``set_serving_version``). Weights are traced
+  OPERANDS of every compiled program, so a swap costs one device_put
+  and zero recompiles; old versions stay resident while any live
+  sequence pins them (unpinned non-serving versions retire).
+- **Per-request version pin** (``_Seq.weights_version``): set ONCE at
+  first admission, carried through replay/preemption/quarantine,
+  snapshot v6, and handoff doc v4 — an in-flight sequence finishes on
+  the version it STARTED on, wherever it lands. Dispatches group
+  ready slots by pin (one dispatch per resident version); the
+  sampling keys and per-slot gathers never reference batch
+  composition, so the mixed-version batch is token-identical to each
+  pin's single-version oracle. The radix prefix cache is
+  version-partitioned (one root per version): block bytes are a
+  function of the weights, so a v0 block is never a v1 hit.
 """
 
 from __future__ import annotations
@@ -149,6 +169,8 @@ from ..ops.norm import layernorm
 from ..runtime.guardrails import rows_finite
 from ..runtime.telemetry import FLIGHT_FILENAME
 from ..runtime.tracing import SpanTracer
+from ..runtime.weights import (BOOT_VERSION, architecture_diff,
+                               model_fingerprint, same_architecture)
 from .draft import draft_tokens
 from .paged import (PagedKV, SCRATCH_BLOCK, copy_block, corrupt_block as
                     _pool_corrupt_block, extract_blocks,
@@ -185,8 +207,13 @@ REQUEST_EVENTS = ("admitted", "preempted", "retried", "quarantined",
 # per-array CRC-32, atomic publish) bit-identically across a process
 # boundary; a mismatched version is rejected BEFORE any engine state is
 # touched, like every other import_sequence check (DESIGN.md
-# section 22).
-HANDOFF_VERSION = 3
+# section 22). v4 (round 17): the document carries the sequence's
+# ``weights_version`` pin and the fingerprint OF THAT VERSION — a
+# migrated request decodes on its pinned weights even on a target
+# already serving newer ones, so the importing engine must HOLD the
+# pinned version (the rolling deploy's double-buffer guarantees it)
+# and its fingerprint must match (DESIGN.md section 23).
+HANDOFF_VERSION = 4
 
 # EngineConfig keys two engines may legitimately disagree on and still
 # exchange sequences: pool SIZE is an engine-local capacity choice.
@@ -352,6 +379,12 @@ class _Seq:
     submit_step: int = 0
     admit_index: int = -1
     t_submit: float = field(default_factory=time.time)
+    # the weights-version pin (round 17): None until the sequence
+    # STARTS (first admission pins the engine's serving version); a
+    # pinned sequence finishes on that version through every replay,
+    # preemption, migration, and crash-resume — the hot-swap identity
+    # contract (DESIGN.md section 23)
+    weights_version: int | None = None
 
     @property
     def prompt_done(self) -> bool:
@@ -424,6 +457,26 @@ class DecodeEngine:
                 raise ValueError(f"vocab={params.vocab} not divisible by "
                                  f"model-axis size {n}")
             self.params = tp_shard_params(params, mesh)
+        # -- live weight hot-swap (round 17, DESIGN.md section 23) --
+        # double-buffered weights: version id -> params. The BOOT
+        # weights are version 0; a deploy loads a checkpoint step as a
+        # new version (``load_weights``) while the old one stays
+        # resident, so in-flight sequences finish on the version they
+        # started on (their ``_Seq.weights_version`` pin) while new
+        # admissions take ``serving_version``. Every compiled program
+        # takes params as a traced operand, so a swap never recompiles.
+        self.weights: dict[int, LMParams] = {BOOT_VERSION: self.params}
+        self.serving_version = BOOT_VERSION
+        # the architecture anchor for load_weights: held VERSIONS come
+        # and go (retirement), but the engine's shape never does — a
+        # check against weights[BOOT_VERSION] would break the third
+        # deploy, once retirement has dropped the boot buffers
+        self._arch_fingerprint = model_fingerprint(self.params,
+                                                   n_heads)
+        # uid -> pin (None until first admission) — the request-record
+        # attribution (telemetry v11: every request record carries
+        # ``weights_version``); kept like prompt_lens, per uid
+        self._pins: dict[int, int | None] = {}
         self.pool = self._init_pool()
         s, mb = cfg.max_slots, cfg.max_blocks_per_seq
         self.tables = np.full((s, mb), SCRATCH_BLOCK, np.int32)
@@ -855,25 +908,101 @@ class DecodeEngine:
 
     # -- model identity (snapshots + KV handoff pin it) ----------------
 
-    def model_meta(self) -> dict:
+    def model_meta(self, version: int | None = None) -> dict:
         """Model identity the snapshot AND the KV handoff pin: resume
-        replays recorded tokens through the CURRENT weights, and an
-        imported sequence's KV was written by the SOURCE's weights —
-        either under different weights silently breaks the
-        token-identical contract. Shapes catch a changed architecture;
-        the embedding-row fingerprint catches a changed init seed at
-        the same shape (rounded coarsely so the float reduction order —
-        which legitimately varies across TP layouts — can't cause a
-        false mismatch)."""
-        p = self.params
-        return {
-            "vocab": int(p.vocab), "d_model": int(p.d_model),
-            "n_layers": int(p.n_layers),
-            "max_seq_len": int(p.max_seq_len),
-            "n_heads": int(self.n_heads),
-            "kv_heads": int(self.kv_heads),
-            "wte0_sum": round(float(jnp.sum(p.wte[0])), 2),
-        }
+        replays recorded tokens through the pinned version's weights,
+        and an imported sequence's KV was written by the SOURCE's
+        weights for that version — either under different weights
+        silently breaks the token-identical contract. THE fingerprint
+        definition lives in ``runtime/weights.py``
+        (``model_fingerprint`` — shapes + the coarse embedding-row
+        sum); this is a re-binding per held version. Default: the
+        current serving version."""
+        ver = self.serving_version if version is None else int(version)
+        return model_fingerprint(self._params_for(ver), self.n_heads)
+
+    # -- live weight hot-swap (round 17, DESIGN.md section 23) ---------
+
+    def _params_for(self, version: int) -> LMParams:
+        try:
+            return self.weights[int(version)]
+        except KeyError:
+            raise RuntimeError(
+                f"engine does not hold weights version {version} "
+                f"(held: {sorted(self.weights)}) — a pinned sequence "
+                "can only run where its version is resident") from None
+
+    def pinned_versions(self) -> set[int]:
+        """Versions some live (resident or waiting) sequence is pinned
+        to — what ``load_weights``'s double-buffer retirement must
+        keep."""
+        pins = {s.weights_version for s in self.slots
+                if s is not None and s.weights_version is not None}
+        pins |= {s.weights_version for s in self.waiting
+                 if s.weights_version is not None}
+        return pins
+
+    def load_weights(self, version: int, params: LMParams) -> dict:
+        """Install ``params`` as weights version ``version`` —
+        double-buffered: the previous versions stay resident while any
+        live sequence pins them (an in-flight request must finish on
+        its version), and unpinned non-serving versions retire to keep
+        the buffer at ~2. The params arrive as device arrays (the
+        ledger's restore already performed the one fresh-ownership
+        device_put) and every compiled program takes them as a traced
+        operand, so this call costs zero recompiles. Architecture must
+        match the boot weights exactly — the pool layout and program
+        set are shape functions. Idempotent for an already-held
+        version with the same fingerprint."""
+        if self.mesh is not None:
+            raise ValueError(
+                "load_weights is single-device (the fleet's rolling "
+                "deploy runs single-device replicas; TP engines "
+                "redeploy by restart)")
+        version = int(version)
+        new_fp = model_fingerprint(params, self.n_heads)
+        if version in self.weights:
+            held = self.model_meta(version)
+            if held != new_fp:
+                raise ValueError(
+                    f"weights version {version} already held with a "
+                    f"different fingerprint ({held} != {new_fp}) — "
+                    "version ids are immutable once loaded")
+            return new_fp
+        if not same_architecture(self._arch_fingerprint, new_fp):
+            raise ValueError(
+                "weights architecture != engine architecture: "
+                f"{architecture_diff(self._arch_fingerprint, new_fp)} "
+                "— hot-swap requires the identical model shape (the "
+                "KV pool and compiled programs are shape functions)")
+        # double-buffer retirement: non-serving versions no live
+        # sequence pins free their buffers now (their refs-0 cached
+        # prefix blocks decay through the ordinary LRU)
+        keep = self.pinned_versions() | {self.serving_version, version}
+        for old in [v for v in self.weights if v not in keep]:
+            if self.weights[old] is self.params:
+                # the construction-time alias (static shape/vocab
+                # reads, the ledger-restore template, the static cost
+                # report) would otherwise pin the retired buffers for
+                # the process lifetime — rebind it to the incoming
+                # version; every such read is architecture-only, so
+                # any held version serves it identically
+                self.params = params
+            del self.weights[old]
+        self.weights[version] = params
+        return new_fp
+
+    def set_serving_version(self, version: int) -> None:
+        """New admissions pin ``version`` from now on; sequences
+        already pinned elsewhere are untouched (they keep decoding on
+        their own resident version — the mixed-version engine the
+        version-grouped dispatch below serves)."""
+        version = int(version)
+        if version not in self.weights:
+            raise ValueError(
+                f"cannot serve weights version {version}: not loaded "
+                f"(held: {sorted(self.weights)}) — load_weights first")
+        self.serving_version = version
 
     # -- single-sequence KV handoff (DESIGN.md section 20) -------------
 
@@ -917,7 +1046,11 @@ class DecodeEngine:
                 "poison to an innocent engine")
         doc = {
             "handoff_version": HANDOFF_VERSION,
-            "model": self.model_meta(),
+            # the pin travels (v4): the sequence's KV was written by
+            # THIS version's weights, and the target must finish it
+            # there — the fingerprint is the pinned version's
+            "weights_version": int(seq.weights_version),
+            "model": self.model_meta(seq.weights_version),
             "config": dataclasses.asdict(self.cfg),
             "uid": int(seq.uid),
             "prompt": list(seq.prompt),
@@ -964,7 +1097,13 @@ class DecodeEngine:
             raise ValueError(f"handoff version "
                              f"{doc.get('handoff_version')!r} != "
                              f"{HANDOFF_VERSION}")
-        model = self.model_meta()
+        ver = int(doc["weights_version"])
+        if ver not in self.weights:
+            raise ValueError(
+                f"engine does not hold weights version {ver} (held: "
+                f"{sorted(self.weights)}) — the imported sequence is "
+                "pinned there and would decode on the wrong weights")
+        model = self.model_meta(ver)
         if doc["model"] != model:
             diff = {k: (doc["model"].get(k), model.get(k))
                     for k in set(model) | set(doc["model"])
@@ -1025,7 +1164,9 @@ class DecodeEngine:
         seq = _Seq(uid=uid, prompt=prompt, max_new=max_new,
                    out=[int(t) for t in doc["out"]],
                    retries=int(doc["retries"]),
-                   submit_step=self.global_step)
+                   submit_step=self.global_step,
+                   weights_version=ver)
+        self._pins[uid] = ver
         seq.emitted = int(doc["emitted"])
         seq.t_submit = float(doc["t_submit"])
         seq.prefilled = len(prompt)
@@ -1059,6 +1200,42 @@ class DecodeEngine:
         # twin already cached wins and the duplicate frees)
         self._cache_full_blocks(slot)
         return uid
+
+    def release_request(self, uid: int) -> dict:
+        """Take one live request OFF this engine (waiting or resident,
+        prefilled or not) and return its replay entry — the rolling
+        deploy's drain primitive for everything the KV handoff can't
+        carry (mid-prefill or still-queued requests migrate by replay;
+        fully-prefilled residents go through ``export_sequence``
+        instead, which ships the KV). The entry is exactly what a
+        peer's ``resume_request`` takes: replay re-prefills and
+        teacher-forces on the PINNED version, so the moved request's
+        remaining tokens stay bit-identical to its unmoved oracle."""
+        uid = int(uid)
+        seq = None
+        for i, s in enumerate(self.waiting):
+            if s.uid == uid:
+                seq = s
+                del self.waiting[i]
+                break
+        if seq is None:
+            slot = next((i for i, s in enumerate(self.slots)
+                         if s is not None and s.uid == uid), None)
+            if slot is None:
+                raise ValueError(f"uid {uid} is not live on this "
+                                 "engine (finished/failed requests "
+                                 "have nothing to drain)")
+            seq = self._evict(slot)
+        self._event("handoff", uid, reason="drained",
+                    n_out=len(seq.out))
+        self.tracer.close(uid, self.global_step, reason="drained",
+                          tokens=self._span_tokens.pop(uid, 0))
+        return {"uid": uid, "prompt": list(seq.prompt),
+                "out": list(seq.out), "max_new": int(seq.max_new),
+                "retries": int(seq.retries),
+                "t_submit": float(seq.t_submit),
+                "t_first": self.tracer.pop_first_token(uid),
+                "weights_version": seq.weights_version}
 
     # -- scheduler -----------------------------------------------------
 
@@ -1123,6 +1300,7 @@ class DecodeEngine:
                 f"uid {uid} shed")
         self._next_uid = max(self._next_uid, uid) + 1
         self.prompt_lens[uid] = len(prompt)
+        self._pins.setdefault(uid, None)    # pinned at first admission
         seq = _Seq(uid=uid, prompt=prompt, max_new=max_new,
                    submit_step=self.global_step)
         self.waiting.append(seq)
@@ -1133,14 +1311,19 @@ class DecodeEngine:
 
     def resume_request(self, uid: int, prompt, max_new: int, out=(),
                        retries: int = 0, t_submit=None,
-                       submit_step=None, t_first=None) -> int:
+                       submit_step=None, t_first=None,
+                       weights_version=None) -> int:
         """Re-enter a request from an engine snapshot
         (``decode/supervise.py``): queued for replay-resume — prompt
         re-prefilled, recorded ``out`` tokens teacher-forced, then live
         generation continues token-identically (the sampling keys fold
         ``(seed, uid, position)``, never the slot or the crash).
         Bypasses ``queue_limit`` (the request was admitted once — a
-        crash must not shed it)."""
+        crash must not shed it). ``weights_version`` carries the pin
+        across the resume: a pinned request replays and finishes on
+        the version it started on (the engine must hold it by
+        admission time); None re-pins at admission — the request never
+        started."""
         prompt = [int(t) for t in prompt]
         out = [int(t) for t in out]
         if uid < 0:
@@ -1152,7 +1335,10 @@ class DecodeEngine:
         seq = _Seq(uid=int(uid), prompt=prompt, max_new=int(max_new),
                    out=out, retries=int(retries),
                    submit_step=(self.global_step if submit_step is None
-                                else int(submit_step)))
+                                else int(submit_step)),
+                   weights_version=(None if weights_version is None
+                                    else int(weights_version)))
+        self._pins[int(uid)] = seq.weights_version
         if t_submit is not None:
             seq.t_submit = float(t_submit)
         if t_first is not None:
@@ -1184,8 +1370,13 @@ class DecodeEngine:
 
     def _event(self, event: str, uid: int, reason: str | None = None,
                **extra) -> None:
+        # telemetry v11: every request record carries the uid's
+        # weights-version pin (None before first admission / for the
+        # anonymous rejected uid -1) — the per-version attribution the
+        # mixed-version report reads
         rec = {"step": self.global_step, "uid": int(uid),
-               "event": event, "reason": reason, **extra}
+               "event": event, "reason": reason,
+               "weights_version": self._pins.get(int(uid)), **extra}
         self.request_events.append(rec)
         # the flight recorder's per-step decision line (compact: the
         # digest ring is bounded memory, the durable trail is the
@@ -1250,8 +1441,17 @@ class DecodeEngine:
             free_slots = [i for i, s in enumerate(self.slots) if s is None]
             if not free_slots:
                 break
+            # the version this admission would run under: an existing
+            # pin (replay/migration — the sequence already started on
+            # that version) or the current serving version (a fresh
+            # start pins HERE, not at submit: "in-flight finishes on
+            # the version it STARTED on, new admissions take the
+            # latest" — a queued request that never prefilled takes
+            # the post-deploy weights)
+            ver = (seq.weights_version if seq.weights_version is not None
+                   else self.serving_version)
             hits = ([] if self.prefix is None
-                    else self.prefix.match(seq.prompt))
+                    else self.prefix.match(seq.prompt, ver))
             avail = len(self.free_blocks)
             if self.prefix is not None:
                 # refs-0 cached blocks are reclaimable — minus the hit
@@ -1277,6 +1477,9 @@ class DecodeEngine:
             self._head_blocked = 0
             self._head_blocked_uid = None
             self.waiting.popleft()
+            if seq.weights_version is None:
+                seq.weights_version = ver   # the pin: set ONCE, here
+            self._pins[seq.uid] = seq.weights_version
             slot = free_slots[0]
             need_priv = need - len(hits)
             if hits:
@@ -1357,8 +1560,11 @@ class DecodeEngine:
         step = self.global_step
         while len(seq.nodes) < full:
             i = len(seq.nodes)
+            # inserts land under the sequence's PINNED version root:
+            # block bytes are a function of the weights, so a block
+            # prefilled under v is only ever a hit for v-admissions
             node = self.prefix.insert(seq.prompt, i, seq.blocks[i],
-                                      step)
+                                      step, version=seq.weights_version)
             if node is None:
                 # parent path evicted/poisoned mid-prefill: the block
                 # simply stays private (correct, just unshared)
@@ -1685,7 +1891,8 @@ class DecodeEngine:
         fn = self._program("prefill", c)
         chunk = np.asarray(seq.prompt[seq.prefilled:seq.prefilled + c],
                            np.int32)
-        args = (self.params, self.pool, jnp.asarray(self.tables[slot]),
+        args = (self._params_for(seq.weights_version), self.pool,
+                jnp.asarray(self.tables[slot]),
                 jnp.int32(seq.prefilled), jnp.asarray(chunk),
                 jnp.int32(seq.uid), jnp.int32(self._poison_uid))
         self._maybe_capture(fn, *args)
@@ -1742,14 +1949,34 @@ class DecodeEngine:
             uids[j] = 0
         return b, tables, lengths, tokens, uids
 
+    def _version_groups(self, ready: list[int]) -> list[list[int]]:
+        """Split the ready slots by weights-version pin — ONE dispatch
+        per resident version (a compiled program runs one params
+        operand). Slot order is preserved within each group and the
+        common single-version case degenerates to the old whole-batch
+        dispatch; token identity is untouched either way because the
+        sampling keys and per-slot gathers never reference the batch
+        composition (the migration identity argument, applied to the
+        mixed-version engine a rolling deploy creates)."""
+        groups: dict[int, list[int]] = {}
+        for slot in ready:
+            groups.setdefault(self.slots[slot].weights_version,
+                              []).append(slot)
+        return [groups[v] for v in sorted(groups)]
+
     def _decode_step(self, ready: list[int]) -> None:
+        for group in self._version_groups(ready):
+            self._decode_dispatch(group)
+
+    def _decode_dispatch(self, ready: list[int]) -> None:
         bs = self.cfg.block_size
         for slot in ready:                  # the CoW write barrier
             self._cow_private(slot, int(self.lengths[slot]) // bs,
                               int(self.lengths[slot]) // bs)
+        params = self._params_for(self.slots[ready[0]].weights_version)
         b, tables, lengths, tokens, uids = self._marshal(ready)
         fn = self._program("decode", b)
-        args = (self.params, self.pool, jnp.asarray(tables),
+        args = (params, self.pool, jnp.asarray(tables),
                 jnp.asarray(lengths), jnp.asarray(tokens),
                 jnp.asarray(uids), jnp.int32(self._poison_uid))
         self._maybe_capture(fn, *args)
@@ -1757,7 +1984,7 @@ class DecodeEngine:
         self.pool = pool
         picks = np.asarray(picks)
         ok = np.asarray(ok)
-        self._step_decode_uids = [self.slots[s].uid for s in ready]
+        self._step_decode_uids += [self.slots[s].uid for s in ready]
         flags = [bool(ok[j]) for j in range(len(ready))]
         self._step_finite = (flags if self._step_finite is None
                              else self._step_finite + flags)
@@ -1793,6 +2020,10 @@ class DecodeEngine:
         return rec[:budget], budget
 
     def _verify_step(self, ready: list[int]) -> None:
+        for group in self._version_groups(ready):
+            self._verify_dispatch(group)
+
+    def _verify_dispatch(self, ready: list[int]) -> None:
         """The speculative decode dispatch: draft per slot (capped so
         accepted emissions can never outrun ``max_new`` or the block
         reservation — a verify step writes one KV row per emitted
@@ -1829,7 +2060,8 @@ class DecodeEngine:
             replayed[j] = n_rec
             self.drafted_tokens += len(d) - n_rec
         fn = self._program("verify", b)
-        args = (self.params, self.pool, jnp.asarray(tables),
+        params = self._params_for(self.slots[ready[0]].weights_version)
+        args = (params, self.pool, jnp.asarray(tables),
                 jnp.asarray(lengths), jnp.asarray(tokens),
                 jnp.asarray(uids), jnp.asarray(drafts),
                 jnp.asarray(dlens), jnp.int32(self._poison_uid))
@@ -1839,7 +2071,7 @@ class DecodeEngine:
         picks = np.asarray(picks)
         acc = np.asarray(acc)
         ok = np.asarray(ok)
-        self._step_decode_uids = [self.slots[s].uid for s in ready]
+        self._step_decode_uids += [self.slots[s].uid for s in ready]
         flags = []
         for j, slot in enumerate(ready):
             m = int(acc[j])
@@ -2000,6 +2232,9 @@ class DecodeEngine:
             "waiting": len(self.waiting),
             "tokens_generated": self.tokens_generated,
             "kv_dtype": self.cfg.kv_dtype,
+            # extra (v11): which weights version new admissions take —
+            # a deploy shows up as this stepping between records
+            "serving_version": self.serving_version,
             "compiled_programs": self.compile_count,
             "drafted_tokens": self.drafted_tokens,
             "accepted_tokens": self.accepted_tokens,

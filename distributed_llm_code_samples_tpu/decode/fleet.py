@@ -69,14 +69,28 @@ replay-rerouted with no partial import). The tier-1 drill kills one of
 three worker PROCESSES mid-stream and pins byte-identical output
 against the unkilled oracle.
 
-Every router decision emits one schema-v10 ``router`` record; live
+**Live weight hot-swap** (round 17, ``rolling_deploy`` /
+``schedule_deploy``, DESIGN.md section 23): publish a checkpoint (the
+trainer's existing atomic fsync+CRC publish) and roll it through the
+serving fleet with ZERO shed — drain one engine at a time over the
+same KV handoff (waiting/mid-prefill requests move by
+``release_request`` replay), swap its double-buffered weights to the
+ledger-verified step, re-admit. In-flight requests finish on their
+pinned ``weights_version`` wherever they land; new admissions take
+the deployed one. A CRC-rejected target step — or any mid-roll
+failure, a dying worker included — rolls every swapped engine back
+with one named-reason ``rolled_back`` deploy record: no engine left
+mixed. Chaos ``corrupt_deploy@R`` drills the torn-checkpoint path.
+
+Every router decision emits one schema-v11 ``router`` record; live
 moves carry ``blocks``/``bytes``/``duration_s`` plus the pinned
 ``transport`` attribution ({mode, bytes, crc_verify_s, retries} —
 ``bytes`` is the SERIALIZED size, what actually crosses the boundary);
 a CRC rejection emits a ``wire_rejected`` record naming the reason.
-Each round additionally emits one ``fleet`` health record.
+Each round additionally emits one ``fleet`` health record and each
+deploy its lifecycle ``deploy`` records.
 ``report router eng0 ...`` folds them onto the merged timeline
-(DESIGN.md sections 20-22).
+(DESIGN.md sections 20-23).
 """
 
 from __future__ import annotations
@@ -177,6 +191,30 @@ class EngineHandle:
             raise ValueError("fleet replicas are single-device "
                              "(KV handoff has no TP path)")
 
+    # -- weight lifecycle (round 17, DESIGN.md section 23) -------------
+
+    @property
+    def serving_version(self) -> int:
+        return self.engine.serving_version
+
+    def load_weights(self, version: int, ckpt_dir: str, step: int,
+                     params=None) -> dict:
+        """Install checkpoint step ``step`` as weights version
+        ``version``. In-process the ROUTER loads the checkpoint once
+        per deploy and passes the params object here (read-only across
+        replicas — engine programs donate only the pool); the process
+        transport sends the recipe and each worker restores from the
+        shared checkpoint dir itself (weights never ride the
+        socket)."""
+        if params is None:
+            from ..runtime.weights import VersionLedger
+            params = VersionLedger(ckpt_dir).load(step,
+                                                  self.engine.params)
+        return self.engine.load_weights(version, params)
+
+    def set_serving_version(self, version: int) -> None:
+        self.engine.set_serving_version(version)
+
     # -- reads ---------------------------------------------------------
 
     @property
@@ -196,6 +234,7 @@ class EngineHandle:
         d = {
             "waiting": len(e.waiting),
             "active": e.active,
+            "serving_version": e.serving_version,
             "free_slots": sum(1 for s in e.slots if s is None),
             "free_blocks": len(e.free_blocks),
             "evictable": (e.prefix.evictable_blocks()
@@ -221,11 +260,15 @@ class EngineHandle:
 
     def warm_blocks(self, prompt) -> int | None:
         """Radix-tree warm-path depth for ``prompt`` (None when the
-        prefix cache is off) — the prefix-affinity probe. Host-side
-        read only; probing never steps an engine."""
+        prefix cache is off) — the prefix-affinity probe, under the
+        SERVING version's root: a fresh admission pins the serving
+        version, so retired versions' cached blocks must not count as
+        warm (they can never be its hits) and the new version's must.
+        Host-side read only; probing never steps an engine."""
         if self.engine.prefix is None:
             return None
-        return self.engine.prefix.warm_blocks(prompt)
+        return self.engine.prefix.warm_blocks(
+            prompt, self.engine.serving_version)
 
     # -- scheduling ----------------------------------------------------
 
@@ -241,14 +284,21 @@ class EngineHandle:
                 "t_submit": seq.t_submit,
                 "submit_step": seq.submit_step,
                 "t_first": None,       # no first token yet
+                "weights_version": None,   # pins at admission
                 "state": "WAITING"}
 
     def resume_request(self, uid: int, prompt, max_new: int, *, out=(),
                        retries: int = 0, t_submit=None,
-                       t_first=None) -> None:
+                       t_first=None, weights_version=None) -> None:
         self.engine.resume_request(uid, prompt, max_new, out=out,
                                    retries=retries, t_submit=t_submit,
-                                   t_first=t_first)
+                                   t_first=t_first,
+                                   weights_version=weights_version)
+
+    def release_request(self, uid: int) -> dict:
+        """The drain primitive's replay half (rolling deploy): pop one
+        live request off the engine, returning its replay entry."""
+        return self.engine.release_request(uid)
 
     def step_begin(self, prefill_only: bool = False) -> None:
         """First half of one fleet-round step. In-process the step runs
@@ -304,7 +354,10 @@ class EngineHandle:
         self.engine.import_sequence(doc)
         import os
         try:
-            os.unlink(ref.path)     # consumed; rejected files are kept
+            # consumed; a REJECTED file is kept for post-mortem by the
+            # router's bounded retention instead (renamed *.rejected,
+            # oldest pruned past keep_rejected — FleetRouter._move)
+            os.unlink(ref.path)
         except OSError:
             pass
         return {"mode": "wire", "bytes": stats["bytes"],
@@ -396,7 +449,8 @@ class FleetRouter:
                  prefill_engines: int = 0, *, metrics=None,
                  snapshot_every: int = 1, session_affinity: bool = True,
                  prefix_affinity: bool = True, wire_dir: str | None = None,
-                 handles: list | None = None, fleet_chaos=None):
+                 handles: list | None = None, fleet_chaos=None,
+                 keep_rejected: int = 8):
         if n_engines < 1:
             raise ValueError(f"n_engines must be >= 1, got {n_engines}")
         if not 0 <= prefill_engines < n_engines:
@@ -506,6 +560,22 @@ class FleetRouter:
         self.wire_rejects = 0
         self._uid_wire_rejects: dict[int, int] = {}
         self._corrupt_next_wire = False
+        # bounded post-mortem retention for REJECTED wire docs (round
+        # 17 satellite, mirroring checkpoint.keep_last): a rejected
+        # handoff file is renamed *.rejected and the oldest are pruned
+        # past this cap — a chaos loop of rejections must not grow a
+        # worker's spool without bound. 0 keeps none.
+        if keep_rejected < 0:
+            raise ValueError(f"keep_rejected must be >= 0, got "
+                             f"{keep_rejected}")
+        self.keep_rejected = keep_rejected
+        # -- live weight hot-swap (round 17, DESIGN.md section 23) --
+        self._deploys: dict[int, tuple] = {}    # round -> (dir, step)
+        self.deploys = 0
+        self.deploy_rollbacks = 0
+        # armed by corrupt_deploy chaos: the truncation fraction to
+        # apply to the NEXT deploy's target checkpoint (None = off)
+        self._corrupt_next_deploy: float | None = None
 
     # -- introspection -------------------------------------------------
 
@@ -772,6 +842,10 @@ class FleetRouter:
             elif f.kind == "corrupt_wire":
                 self.fleet_chaos._note(f)
                 self._corrupt_next_wire = True
+            elif f.kind == "corrupt_deploy":
+                frac = 0.5 if f.arg is None else float(f.arg)
+                self.fleet_chaos._note(f, frac=frac)
+                self._corrupt_next_deploy = frac
         return fired
 
     def step(self) -> bool:
@@ -789,6 +863,14 @@ class FleetRouter:
         for eid in self._kills.pop(self.rounds, ()):
             self.kill_engine(eid)
         did = did or killed
+        # rolling deploys fire on the same round clock as kills, AFTER
+        # them (a deploy never drains onto an engine the same round is
+        # about to kill) and BEFORE any engine steps, so the deploy's
+        # drain sees the round's pre-step truth
+        dep = self._deploys.pop(self.rounds, None)
+        if dep is not None:
+            self.rolling_deploy(dep[0], step=dep[1])
+            did = True
         stepping, idle = [], []
         for h in self.handles:
             (stepping if h.has_work else idle).append(h)
@@ -857,7 +939,16 @@ class FleetRouter:
         if self._corrupt_next_wire and ref.path is not None:
             _corrupt_wire_file(ref.path)
             self._corrupt_next_wire = False
-        info = target.import_doc(ref)       # raises WireError on damage
+        try:
+            info = target.import_doc(ref)   # raises WireError on damage
+        except WireError:
+            # keep the damaged file for post-mortem — renamed so it can
+            # never be re-consumed, pruned past keep_rejected so a
+            # rejection loop can't grow the spool unboundedly (the
+            # checkpoint keep_last stance, applied to the wire spool)
+            if ref.path is not None:
+                _retain_rejected(ref.path, self.keep_rejected)
+            raise
         dur = time.perf_counter() - t0
         blocks = ref.blocks_written
         # an in-process doc move reports the SERIALIZED size too (the
@@ -884,7 +975,8 @@ class FleetRouter:
                 "retries": self._uid_wire_rejects.get(uid, 0)}
 
     def _wire_rejected(self, source: EngineHandle, target: EngineHandle,
-                       uid: int, err: WireError, context: str) -> None:
+                       uid: int, err: WireError, context: str,
+                       exclude=()) -> None:
         """A wire handoff failed integrity checks: record the named
         reason, then re-route the request by REPLAY from the source's
         last router-held snapshot (export already evicted it there —
@@ -906,14 +998,19 @@ class FleetRouter:
             entry = next((r for r in source.snapshot["requests"]
                           if int(r["uid"]) == uid), None)
         req = self.requests[uid]
-        dest = min(self.alive_handles("decode"), key=self._load_key)
+        cands = [h for h in self.alive_handles("decode")
+                 if h.id not in exclude]
+        dest = min(cands or self.alive_handles("decode"),
+                   key=self._load_key)
         t0 = time.perf_counter()
         if entry is not None:
             dest.resume_request(uid, entry["prompt"], entry["max_new"],
                                 out=entry["out"],
                                 retries=entry["retries"],
                                 t_submit=entry.get("t_submit"),
-                                t_first=entry.get("t_first"))
+                                t_first=entry.get("t_first"),
+                                weights_version=entry.get(
+                                    "weights_version"))
             replay = len(entry["out"])
         else:
             # no snapshot entry (a submit-then-immediate-move corner):
@@ -1109,7 +1206,8 @@ class FleetRouter:
                 req["uid"], req["prompt"], req["max_new"],
                 out=req["out"], retries=req["retries"],
                 t_submit=req.get("t_submit"),
-                t_first=req.get("t_first"))
+                t_first=req.get("t_first"),
+                weights_version=req.get("weights_version"))
             dur = time.perf_counter() - t0
             self.requests[int(req["uid"])]["engine"] = target.id
             # a replay-migration ships no KV (the dead pool is
@@ -1127,6 +1225,280 @@ class FleetRouter:
             target.snapshot = target.fetch_snapshot()
             moved += 1
         self.migrations += moved
+        return moved
+
+    # -- live weight hot-swap (round 17, DESIGN.md section 23) ---------
+
+    def schedule_deploy(self, ckpt_dir: str, at_round: int,
+                        step: int | None = None) -> None:
+        """Arm a rolling deploy at the START of fleet round
+        ``at_round``: the newest published step under ``ckpt_dir``
+        (or the explicit ``step``) is verified by the CRC ladder and
+        rolled through the fleet engine by engine — drain by
+        migration, swap, re-admit. Fires after that round's kills (a
+        deploy never drains onto an engine the round kills) and
+        before any engine steps."""
+        if at_round < 0:
+            raise ValueError(f"deploy round must be >= 0, got "
+                             f"{at_round}")
+        if at_round in self._deploys:
+            raise ValueError(f"a deploy is already scheduled for "
+                             f"round {at_round}")
+        self._deploys[at_round] = (ckpt_dir, step)
+
+    def _deploy_record(self, event: str, from_v, to_v, **extra) -> None:
+        """One schema-v11 ``deploy`` record (started / engine_swapped
+        / completed / rolled_back) on the router's own stream."""
+        if self.metrics is not None:
+            self.metrics.deploy({"step": self.rounds, "event": event,
+                                 "from_version": from_v,
+                                 "to_version": to_v, **extra})
+
+    def _rollback_swapped(self, swapped, from_v: int) -> None:
+        """Flip already-swapped engines back to ``from_v`` — guarded:
+        a SECOND worker dying during the rollback must not let the
+        exception escape with no rolled_back record and the fleet
+        mixed (a dead engine isn't mixed; it takes the ordinary
+        dead-host path — declare, SIGKILL, migrate-from-snapshot)."""
+        for s in swapped:
+            if not s.alive:
+                continue
+            try:
+                s.set_serving_version(from_v)
+            except TransportError as e:
+                self._transport_death(s, e)
+
+    def _find_dead(self, suspect) -> "EngineHandle":
+        """Which alive handle actually stopped answering? Ping sweep,
+        the suspect first (cheap short-deadline heartbeat, the idle-
+        member liveness probe); falls back to the suspect when every
+        ping answers (a transient that already cleared — declaring
+        the suspect dead is then the conservative verdict)."""
+        order = [suspect] + [x for x in self.handles
+                             if x.alive and x is not suspect]
+        for cand in order:
+            if not cand.alive:
+                continue
+            try:
+                cand.ping()
+            except TransportError:
+                return cand
+        return suspect
+
+    def _fleet_serving_version(self) -> int:
+        vers = sorted({int(h.digest(light=True)["serving_version"])
+                       for h in self.handles if h.alive})
+        if len(vers) != 1:
+            raise RuntimeError(
+                f"fleet engines disagree on serving version ({vers}) "
+                "— an aborted deploy left a mixed fleet behind")
+        return vers[0]
+
+    def rolling_deploy(self, ckpt_dir: str,
+                       step: int | None = None) -> dict:
+        """Publish new weights into the serving fleet with ZERO shed
+        and zero restarts: for each engine in turn, DRAIN it (every
+        fully-prefilled resident ships to a peer over the existing KV
+        handoff — the PR 10 primitive IS the drain; waiting and
+        mid-prefill requests move by replay-resume), swap its weights
+        to the ledger-verified target version, and re-admit it. The
+        fleet serves BOTH versions mid-deploy: drained requests keep
+        their ``weights_version`` pin and finish on the old weights
+        wherever they land (every engine double-buffers the old
+        version), while new admissions pin the new one.
+
+        Failure is first-class: a target step the CRC ladder rejects —
+        or any load failure mid-roll, including a worker dying — rolls
+        EVERY already-swapped engine back to the old serving version
+        (its weights never left) and emits one ``rolled_back`` deploy
+        record whose reason is the one-line named cause plus the
+        ``latest_verified_step`` fallback: deploy aborted, no engine
+        left mixed, nothing shed."""
+        from ..checkpoint import CorruptCheckpointError
+        from ..runtime.weights import VersionLedger
+        t0 = time.perf_counter()
+        ledger = VersionLedger(ckpt_dir)
+        from_v = self._fleet_serving_version()
+        if self._corrupt_next_deploy is not None:
+            # chaos corrupt_deploy: tear the target checkpoint BEFORE
+            # the ledger reads it — the CRC ladder must reject it
+            frac = self._corrupt_next_deploy
+            self._corrupt_next_deploy = None
+            tgt = step if step is not None else ledger.latest_step()
+            if tgt is not None:
+                from ..runtime.chaos import truncate_checkpoint
+                truncate_checkpoint(ledger.step_path(tgt), frac=frac)
+        target = step if step is not None else ledger.latest_step()
+
+        def rolled_back(reason: str) -> dict:
+            import sys
+            self.deploy_rollbacks += 1
+            fb = ledger.latest_verified()
+            line = (f"deploy of step_{target} rolled back: {reason} — "
+                    f"fleet stays on version {from_v} (latest "
+                    f"verified step: {fb})")
+            # the operator-visible one-liner (the checkpoint layer's
+            # stderr-notice precedent); the durable copy is the
+            # ``rolled_back`` deploy record below
+            print(f"fleet: {line}", file=sys.stderr)
+            self._deploy_record(
+                "rolled_back", from_v, target, reason=line,
+                latest_verified=fb,
+                duration_s=round(time.perf_counter() - t0, 6))
+            self._event({"event": "deploy_rolled_back",
+                         "round": self.rounds, "from_version": from_v,
+                         "to_version": target, "reason": line})
+            return {"status": "rolled_back", "reason": line,
+                    "from_version": from_v, "to_version": target,
+                    "latest_verified": fb}
+
+        if target is None:
+            return rolled_back(
+                f"no checkpoint published under {ckpt_dir}")
+        ok, why = ledger.verify(target)
+        if not ok:
+            return rolled_back(f"checkpoint step_{target} rejected "
+                               f"({why})")
+        if target == from_v:
+            return {"status": "noop", "from_version": from_v,
+                    "to_version": target}
+        self._deploy_record("started", from_v, target,
+                            ckpt_dir=ckpt_dir)
+        params = None
+        swapped: list = []
+        drained_total = 0
+        h = None
+        try:
+            for h in [x for x in self.handles if x.alive]:
+                if h.transport != "process" and params is None:
+                    # in-process: the router loads the checkpoint ONCE
+                    # and shares the (read-only, never-donated) params
+                    # across replicas; process workers restore from
+                    # the shared dir themselves — weights never ride
+                    # the socket
+                    params = ledger.load(target, h.engine.params)
+                drained_total += self._drain_engine(h)
+                t1 = time.perf_counter()
+                h.load_weights(target, ckpt_dir, target, params=params)
+                h.set_serving_version(target)
+                swapped.append(h)
+                self._deploy_record(
+                    "engine_swapped", from_v, target, engine=h.id,
+                    duration_s=round(time.perf_counter() - t1, 6))
+                h.snapshot = h.fetch_snapshot()
+        except TransportError as e:
+            # the drain touches PEERS too (imports, resumes) — blame
+            # the handle that actually stopped answering, not the one
+            # being drained: a misattributed death would SIGKILL a
+            # healthy worker and leave the real corpse marked alive
+            dead = self._find_dead(h)
+            self._transport_death(dead, e)
+            self._rollback_swapped(swapped, from_v)
+            return rolled_back(
+                f"worker {dead.id} died mid-deploy "
+                f"({type(e).__name__}: {e}); {len(swapped)} swapped "
+                "engine(s) rolled back")
+        except (CorruptCheckpointError, ValueError, RuntimeError,
+                OSError) as e:
+            # the mid-roll failure path: engines already swapped flip
+            # their serving version back (the old weights never left —
+            # that IS the double buffer), so no engine admits on a
+            # version the fleet just refused
+            self._rollback_swapped(swapped, from_v)
+            return rolled_back(
+                f"{type(e).__name__}: {e}; {len(swapped)} swapped "
+                "engine(s) rolled back")
+        self.deploys += 1
+        dur = round(time.perf_counter() - t0, 6)
+        self._deploy_record("completed", from_v, target,
+                            duration_s=dur, engines=len(swapped),
+                            drained=drained_total)
+        return {"status": "completed", "from_version": from_v,
+                "to_version": target, "engines": len(swapped),
+                "drained": drained_total, "duration_s": dur}
+
+    def _drain_engine(self, h) -> int:
+        """Empty one engine for its swap: fully-prefilled residents
+        move LIVE (export -> import, KV ships, zero replay) to a
+        decode peer with capacity; everything else — waiting,
+        mid-prefill, or no peer capacity — moves by replay-resume
+        (``release_request`` + a peer's ``resume_request``, pin
+        attached). Nothing is shed: replay-resume bypasses queue
+        limits exactly as kill-migration does. With no alive peer the
+        engine swaps IN PLACE — the double-buffered pins keep its
+        in-flight requests on their own version regardless."""
+        peers = [p for p in self.handles if p.alive and p is not h]
+        if not peers:
+            return 0
+        snap = h.fetch_snapshot()
+        h.snapshot = snap
+        moved = 0
+        for req in snap["requests"]:
+            uid = int(req["uid"])
+            live = (req.get("state") == "RUNNING"
+                    and req.get("prefilled", 0) >= len(req["prompt"]))
+            if live:
+                target = self._placement_target(
+                    len(req["prompt"]), req["max_new"],
+                    exclude=(h.id,))
+                if target is not None:
+                    try:
+                        ref, blocks, nbytes, dur, transport = \
+                            self._move(h, target, uid)
+                    except WireError as e:
+                        self._wire_rejected(h, target, uid, e,
+                                            context="deploy_drain",
+                                            exclude=(h.id,))
+                        moved += 1
+                        continue
+                    self.migrations += 1
+                    book = self.requests[uid]
+                    book["engine"] = target.id
+                    if book.get("session") is not None:
+                        self._sessions[book["session"]] = target.id
+                    self._record("migrated", uid, source=h.id,
+                                 target=target.id,
+                                 reason="deploy_drain",
+                                 position=ref.position, blocks=blocks,
+                                 bytes=nbytes,
+                                 duration_s=round(dur, 6),
+                                 transport=transport)
+                    # refresh BOTH sides per move (the handoff
+                    # discipline): a death later in this drain must
+                    # neither lose the moved request nor resurrect it
+                    # from the source's drain-start snapshot
+                    h.snapshot = h.fetch_snapshot()
+                    target.snapshot = target.fetch_snapshot()
+                    moved += 1
+                    continue
+            # replay drain (tier-preserving: prefill work re-enters
+            # the prefill tier while one exists)
+            entry = h.release_request(uid)
+            survivors = ([p for p in peers if p.role == h.role]
+                         or [p for p in peers if p.role == "decode"]
+                         or peers)
+            dest = min(survivors, key=self._load_key)
+            t1 = time.perf_counter()
+            dest.resume_request(
+                uid, entry["prompt"], entry["max_new"],
+                out=entry["out"], retries=entry["retries"],
+                t_submit=entry.get("t_submit"),
+                t_first=entry.get("t_first"),
+                weights_version=entry.get("weights_version"))
+            dur = time.perf_counter() - t1
+            self.migrations += 1
+            book = self.requests[uid]
+            book["engine"] = dest.id
+            if book.get("session") is not None:
+                self._sessions[book["session"]] = dest.id
+            self._record("migrated", uid, source=h.id, target=dest.id,
+                         reason="deploy_drain",
+                         replay=len(entry["out"]), blocks=0, bytes=0,
+                         duration_s=round(dur, 6),
+                         transport=self._replay_transport(uid))
+            h.snapshot = h.fetch_snapshot()
+            dest.snapshot = dest.fetch_snapshot()
+            moved += 1
         return moved
 
     # -- drain ---------------------------------------------------------
@@ -1202,6 +1574,9 @@ class FleetRouter:
                                     "killed_at_round": h.killed_at_round}
                 continue
             per_engine[h.id] = {"alive": True, "role": h.role,
+                                "serving_version": int(
+                                    h.digest(light=True)
+                                    ["serving_version"]),
                                 **h.stats()}
         stats = {
             "engines": per_engine,
@@ -1220,6 +1595,10 @@ class FleetRouter:
             "handoff_blocks": self.handoff_blocks,
             "handoff_bytes": self.handoff_bytes,
             "wire_rejects": self.wire_rejects,
+            # live weight hot-swap (round 17): completed rolling
+            # deploys and CRC/mid-roll rollbacks
+            "deploys": self.deploys,
+            "deploy_rollbacks": self.deploy_rollbacks,
         }
         if self.handoff_durations:
             import numpy as np
@@ -1228,18 +1607,57 @@ class FleetRouter:
         return stats
 
 
+def _retain_rejected(path: str, keep: int) -> None:
+    """Bounded post-mortem retention for a REJECTED wire doc: rename
+    it ``*.rejected`` (so no retry can re-consume the damaged bytes)
+    and prune the spool's oldest rejected files past ``keep`` — the
+    ``checkpoint.keep_last`` discipline applied to the wire spool. A
+    chaos loop of rejections must never grow a worker's spool without
+    bound."""
+    import os
+    try:
+        os.replace(path, path + ".rejected")
+    except OSError:
+        return
+    spool = os.path.dirname(path) or "."
+    try:
+        rejected = [os.path.join(spool, name)
+                    for name in os.listdir(spool)
+                    if name.endswith(".rejected")]
+    except OSError:
+        return
+
+    def age(p):
+        try:
+            return (os.path.getmtime(p), p)
+        except OSError:
+            return (0.0, p)
+
+    rejected.sort(key=age)
+    for old in (rejected if keep <= 0 else rejected[:-keep]):
+        try:
+            os.unlink(old)
+        except OSError:
+            pass
+
+
 def _corrupt_wire_file(path: str) -> None:
     """The ``corrupt_wire`` chaos mechanics: flip a run of bytes just
     past the middle of a published wire file — inside the array payload
     region for any realistic KV doc — simulating in-transit damage that
     slipped past rename atomicity. The per-array CRC (or, for damage
     landing on container structure, the npz parse itself) must reject
-    the import."""
+    the import. The flipped run is 128 bytes: a zip member's local
+    header + extra-field padding (bytes NO checksum covers) can span
+    ~70 bytes, and an 8-byte flip that happened to land entirely
+    inside that dead zone once sailed through every integrity check —
+    the run must be wider than any possible gap so it always reaches
+    CRC-covered payload."""
     import os
     size = os.path.getsize(path)
     off = max(1, int(size * 0.55))
     with open(path, "r+b") as f:
         f.seek(off)
-        chunk = f.read(8)
+        chunk = f.read(128)
         f.seek(off)
         f.write(bytes(b ^ 0xFF for b in chunk))

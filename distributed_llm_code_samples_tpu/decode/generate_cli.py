@@ -193,6 +193,33 @@ def build_generate_parser() -> argparse.ArgumentParser:
                         "(bit-flip the next wire handoff; CRC-"
                         "rejected); requires --fleet and --transport "
                         "process")
+    # live weight hot-swap (round 17, DESIGN.md section 23)
+    p.add_argument("--deploy_dir", default=None, metavar="CKPT_DIR",
+                   help="weight-version ledger: a trainer checkpoint "
+                        "dir (the existing atomic fsync+CRC publish "
+                        "IS the deploy input); with --deploy_round "
+                        "the fleet rolls the newest published step "
+                        "through every engine mid-serve (requires "
+                        "--fleet)")
+    p.add_argument("--deploy_round", type=int, default=None,
+                   metavar="ROUND",
+                   help="fleet round to START the rolling deploy at "
+                        "(drain-by-migration one engine at a time, "
+                        "zero shed; requires --deploy_dir)")
+    p.add_argument("--deploy_step", type=int, default=None,
+                   help="explicit checkpoint step to deploy (default: "
+                        "the newest published step at fire time — the "
+                        "CRC ladder then accepts it or rolls back to "
+                        "latest_verified_step)")
+    p.add_argument("--weights_from", default=None, metavar="CKPT_DIR",
+                   help="serve weights restored from a checkpoint dir "
+                        "instead of the --random_seed init (the "
+                        "pinned-version oracle surface: a single "
+                        "engine serving exactly what a deploy "
+                        "published; single-engine runs only)")
+    p.add_argument("--weights_step", type=int, default=None,
+                   help="checkpoint step for --weights_from (default: "
+                        "newest verified)")
     # observability
     p.add_argument("--metrics_dir", default=None)
     p.add_argument("--log_every", type=int, default=4,
@@ -292,9 +319,13 @@ def _fleet_main(args, prompts, cfg, policy, params, fleet_kill,
         else:
             router = FleetRouter(make_engine, args.fleet,
                                  args.prefill_engines,
-                                 metrics=router_metrics)
+                                 metrics=router_metrics,
+                                 fleet_chaos=fleet_chaos)
         if fleet_kill is not None:
             router.schedule_kill(*fleet_kill)
+        if args.deploy_round is not None:
+            router.schedule_deploy(args.deploy_dir, args.deploy_round,
+                                   step=args.deploy_step)
         shed = 0
         for pr in prompts:
             try:
@@ -435,10 +466,21 @@ def generate_main(argv=None) -> int:
     # these flags).
     if not args.fleet and (args.prefill_engines or args.fleet_kill
                            or args.transport != "inproc"
-                           or args.fleet_chaos):
+                           or args.fleet_chaos or args.deploy_dir
+                           or args.deploy_round is not None
+                           or args.deploy_step is not None):
         print("error: --prefill_engines/--fleet_kill/--transport/"
-              "--fleet_chaos are fleet flags: pass --fleet N (N >= 2)",
-              file=sys.stderr)
+              "--fleet_chaos/--deploy_* are fleet flags: pass "
+              "--fleet N (N >= 2)", file=sys.stderr)
+        return 2
+    if args.weights_from is None and args.weights_step is not None:
+        print("error: --weights_step names a step of --weights_from — "
+              "pass both", file=sys.stderr)
+        return 2
+    if args.weights_from and args.fleet:
+        print("error: --weights_from is the single-engine oracle "
+              "surface; a fleet takes new weights through "
+              "--deploy_dir/--deploy_round instead", file=sys.stderr)
         return 2
     fleet_kill = None
     fleet_chaos = None
@@ -495,21 +537,44 @@ def generate_main(argv=None) -> int:
                       file=sys.stderr)
                 return 2
             fleet_kill = (eng_id, at_round)
+        if (args.deploy_round is None) != (args.deploy_dir is None):
+            print("error: a rolling deploy needs both --deploy_dir "
+                  "(the version ledger) and --deploy_round (when to "
+                  "roll)", file=sys.stderr)
+            return 2
+        if args.deploy_step is not None and not args.deploy_dir:
+            print("error: --deploy_step names a step of --deploy_dir "
+                  "— pass both", file=sys.stderr)
+            return 2
+        if args.deploy_round is not None and args.deploy_round < 0:
+            print(f"error: --deploy_round must be >= 0, got "
+                  f"{args.deploy_round}", file=sys.stderr)
+            return 2
         if args.fleet_chaos:
-            if args.transport != "process":
-                # hang/corrupt need a boundary that can actually fail:
-                # a worker that can go silent, a wire file that can
-                # tear — in-process has neither
-                print("error: --fleet_chaos drills the process "
-                      "boundary: pass --transport process",
-                      file=sys.stderr)
-                return 2
             from ..runtime.chaos import FaultPlan, validate_fleet_plan
             try:
                 fleet_chaos = FaultPlan.parse(args.fleet_chaos)
                 validate_fleet_plan(fleet_chaos)
             except ValueError as e:
                 print(f"error: {e}", file=sys.stderr)
+                return 2
+            kinds = {f.kind for f in fleet_chaos.faults}
+            if (kinds - {"corrupt_deploy"}
+                    and args.transport != "process"):
+                # worker faults need a boundary that can actually
+                # fail: a worker that can die/go silent, a wire file
+                # that can tear — in-process has neither
+                # (corrupt_deploy tears a CHECKPOINT file, a surface
+                # both transports share)
+                print("error: --fleet_chaos drills the process "
+                      "boundary: pass --transport process "
+                      "(corrupt_deploy alone runs on either)",
+                      file=sys.stderr)
+                return 2
+            if "corrupt_deploy" in kinds and args.deploy_round is None:
+                print("error: corrupt_deploy tears a SCHEDULED "
+                      "deploy's checkpoint: pass --deploy_dir/"
+                      "--deploy_round", file=sys.stderr)
                 return 2
             n_decode = args.fleet - args.prefill_engines
             for f in fleet_chaos.faults:
@@ -557,6 +622,23 @@ def generate_main(argv=None) -> int:
                              max_seq_len=args.max_seq_len,
                              n_heads=args.heads,
                              n_kv_heads=args.kv_heads or None)
+        if args.weights_from:
+            # serve FROM a published checkpoint (the deploy drill's
+            # pinned-version oracle): the init above is the
+            # architecture template the ledger restores into — a
+            # mismatched shape rejects rc 2 like any other bad flag
+            from ..runtime.weights import VersionLedger
+            ledger = VersionLedger(args.weights_from)
+            w_step = args.weights_step
+            if w_step is None:
+                w_step = ledger.latest_verified()
+                if w_step is None:
+                    raise ValueError("no verified checkpoint under "
+                                     f"{args.weights_from}")
+            try:
+                params = ledger.load(w_step, params)
+            except (OSError, RuntimeError) as e:
+                raise ValueError(f"--weights_from: {e}") from None
         mesh = None
         tp = 1
         if args.tp > 1:

@@ -86,8 +86,24 @@ class PrefixCache:
 
     def __init__(self, block_size: int):
         self.block_size = int(block_size)
-        self.root = PrefixNode((), -1, None, 0)
+        # one root per WEIGHTS VERSION (round 17, DESIGN.md section
+        # 23): a cached block's bytes are a pure function of (tokens,
+        # EngineConfig, WEIGHTS) — under live hot-swap two versions'
+        # blocks for the same token path differ byte-for-byte, so a
+        # match must never cross versions. Versioned roots partition
+        # the tree; the pool-level accounting (_by_block, eviction,
+        # refcounts) stays global — a retired version's refs-0 blocks
+        # are reclaimed by the same LRU as everything else.
+        self.root = PrefixNode((), -1, None, 0)     # version-0 root
+        self._roots: dict[int, PrefixNode] = {0: self.root}
         self._by_block: dict[int, PrefixNode] = {}
+
+    def _root(self, version: int) -> PrefixNode:
+        root = self._roots.get(int(version))
+        if root is None:
+            root = PrefixNode((), -1, None, 0)
+            self._roots[int(version)] = root
+        return root
 
     # -- introspection --------------------------------------------------
 
@@ -95,15 +111,19 @@ class PrefixCache:
         return len(self._by_block)
 
     def nodes(self):
-        """Every cached node, preorder (stable for snapshots/tests)."""
-        out, stack = [], [self.root]
-        while stack:
-            node = stack.pop()
-            if node is not self.root:
-                out.append(node)
-            # reversed-sorted push -> sorted preorder pop
-            for edge in sorted(node.children, reverse=True):
-                stack.append(node.children[edge])
+        """Every cached node, preorder per version root (stable for
+        snapshots/tests)."""
+        out = []
+        for version in sorted(self._roots):
+            root = self._roots[version]
+            stack = [root]
+            while stack:
+                node = stack.pop()
+                if node is not root:
+                    out.append(node)
+                # reversed-sorted push -> sorted preorder pop
+                for edge in sorted(node.children, reverse=True):
+                    stack.append(node.children[edge])
         return out
 
     def evictable_blocks(self) -> int:
@@ -129,13 +149,17 @@ class PrefixCache:
         unshared engine ran."""
         return max(0, (int(prompt_len) - 1) // self.block_size)
 
-    def match(self, prompt) -> list[PrefixNode]:
+    def match(self, prompt, version: int = 0) -> list[PrefixNode]:
         """Longest cached path of full prompt blocks (capped by
-        ``match_cap``), root-outward. Stops at the first miss or
-        poisoned node; does NOT lock — admission locks only once the
-        block reservation is certain."""
+        ``match_cap``), root-outward UNDER ``version``'s root — a
+        block prefilled by other weights is never a hit. Stops at the
+        first miss or poisoned node; does NOT lock — admission locks
+        only once the block reservation is certain."""
         blk = self.block_size
-        node, out = self.root, []
+        node = self._roots.get(int(version))
+        if node is None:
+            return []
+        out = []
         for i in range(self.match_cap(len(prompt))):
             child = node.children.get(tuple(prompt[i * blk:(i + 1) * blk]))
             if child is None or child.poisoned:
@@ -144,16 +168,17 @@ class PrefixCache:
             node = child
         return out
 
-    def warm_blocks(self, prompt) -> int:
+    def warm_blocks(self, prompt, version: int = 0) -> int:
         """How many leading full blocks of ``prompt`` this tree holds
-        right now — the fleet router's prefix-affinity score
+        right now under ``version`` — the fleet router's
+        prefix-affinity score
         (``decode/fleet.py``). Read-only (no lock, no LRU touch): the
         router probes every engine's tree per admission, and a probe
         must not perturb eviction order or pin anything. In-process the
         router reads the live tree directly — this IS the shadow index,
         with zero mirror drift; a multi-host deployment would mirror
         inserts/evictions over the telemetry stream instead."""
-        return len(self.match(prompt))
+        return len(self.match(prompt, version))
 
     def lock(self, nodes, step: int) -> None:
         for n in nodes:
@@ -170,7 +195,7 @@ class PrefixCache:
     # -- insertion (prefill-complete transfer) --------------------------
 
     def insert(self, prompt, block_index: int, block: int,
-               step: int) -> PrefixNode | None:
+               step: int, version: int = 0) -> PrefixNode | None:
         """Cache prompt block ``block_index`` (just fully prefilled into
         physical ``block``). Returns the node now backing that logical
         block: a NEW node owning ``block`` (caller keeps the block in
@@ -179,9 +204,11 @@ class PrefixCache:
         caller remaps its table onto the cached block and frees its
         duplicate; the bytes are identical by the purity argument).
         Returns None when the parent path is not cached (a parent was
-        evicted mid-prefill) — the block simply stays private."""
+        evicted mid-prefill) — the block simply stays private.
+        ``version`` selects the root: an insert under weights version
+        v is only ever matchable by version-v admissions."""
         blk = self.block_size
-        node = self.root
+        node = self._root(version)
         for i in range(block_index):
             node = node.children.get(tuple(prompt[i * blk:(i + 1) * blk]))
             if node is None or node.poisoned:
@@ -221,8 +248,10 @@ class PrefixCache:
             parent = victim.parent
             self._detach(victim)
             out.append(victim.block)
-            if (parent is not self.root and parent.refs == 0
-                    and not parent.children):
+            # a real node's edge is a full block (nonempty); version
+            # roots carry the empty edge and are never eviction
+            # candidates
+            if parent.edge and parent.refs == 0 and not parent.children:
                 heapq.heappush(heap,
                                (parent.last_use, parent.block, parent))
         return out
@@ -266,12 +295,20 @@ class PrefixCache:
         certifies; tests pin the rebuild against it."""
         order = self.nodes()
         index = {id(n): i for i, n in enumerate(order)}
+        version_of = {id(root): v for v, root in self._roots.items()}
+
+        def _version(n: PrefixNode) -> int:
+            while n.parent is not None:
+                n = n.parent
+            return version_of.get(id(n), 0)
+
         return [{
             "tokens": list(n.edge),
             "block": n.block,
             "refs": n.refs,
             "last_use": n.last_use,
             "poisoned": n.poisoned,
-            "parent": (None if n.parent is self.root
+            "version": _version(n),
+            "parent": (None if not n.parent.edge
                        else index[id(n.parent)]),
         } for n in order]

@@ -73,7 +73,16 @@ SNAPSHOT_FILENAME = "engine_snapshot.json"
 # request's completed record keeps its TRUE ``ttft_s`` (schema v9).
 # The crash gap itself stays visibly unaccounted in the span stream;
 # only the first-token FACT survives, never invented wall time.
-SNAPSHOT_VERSION = 5
+# v6 (round 17): the live-weight hot-swap state (DESIGN.md section 23).
+# Request entries carry ``weights_version`` — the pin a resumed
+# request replays and finishes on (None = never admitted, pins at
+# admission) — and the snapshot pins ``serving_version`` plus
+# ``weights_versions`` (version id -> model fingerprint for every
+# resident version, the ledger-sourced identity restore validates: a
+# mixed-version engine's snapshot can only restore onto an engine
+# that HOLDS those versions). ``model`` remains the serving version's
+# fingerprint (the pre-v6 readers' key).
+SNAPSHOT_VERSION = 6
 
 
 # ---------------------------------------------------------------- snapshot
@@ -103,6 +112,7 @@ def snapshot_state(engine: DecodeEngine) -> dict:
             "max_new": seq.max_new, "retries": seq.retries,
             "t_submit": seq.t_submit, "submit_step": seq.submit_step,
             "t_first": engine.tracer.first_token_t(seq.uid),
+            "weights_version": seq.weights_version,
             "state": "RUNNING", "slot": slot,
             "position": int(engine.lengths[slot]),
             "prefilled": seq.prefilled,
@@ -115,6 +125,7 @@ def snapshot_state(engine: DecodeEngine) -> dict:
             "max_new": seq.max_new, "retries": seq.retries,
             "t_submit": seq.t_submit, "submit_step": seq.submit_step,
             "t_first": engine.tracer.first_token_t(seq.uid),
+            "weights_version": seq.weights_version,
             "state": "WAITING",
         })
     snap = {
@@ -124,6 +135,9 @@ def snapshot_state(engine: DecodeEngine) -> dict:
         "config": dataclasses.asdict(engine.cfg),
         "policy": dataclasses.asdict(engine.policy),
         "model": _model_meta(engine),
+        "serving_version": engine.serving_version,
+        "weights_versions": {str(v): engine.model_meta(v)
+                             for v in sorted(engine.weights)},
         "requests": requests,
         "finished": {str(u): t for u, t in engine.finished.items()},
         "failed": {str(u): dict(info)
@@ -216,16 +230,31 @@ def restore_engine_state(engine: DecodeEngine, snap: dict) -> None:
     if pol != snap["policy"]:
         raise ValueError(f"serve policy != snapshot policy: "
                          f"{snap['policy']} vs {pol}")
-    model = _model_meta(engine)
-    if model != snap["model"]:
-        diff = {k: (snap["model"].get(k), model.get(k))
-                for k in set(model) | set(snap["model"])
-                if snap["model"].get(k) != model.get(k)}
-        raise ValueError(
-            f"model != snapshot model: {diff} — resume replays recorded "
-            "tokens through the current weights, so the identical model "
-            "(same shape AND same init) is required for the "
-            "token-identical contract")
+    # per-version identity (snapshot v6): the engine must HOLD every
+    # version the snapshot pins, with the identical fingerprint —
+    # resume replays each request through its pinned version's
+    # weights, so any missing/mismatched version silently breaks the
+    # token-identical contract. A v0-only snapshot degenerates to the
+    # old single-model check.
+    for ver_s, want in snap["weights_versions"].items():
+        ver = int(ver_s)
+        if ver not in engine.weights:
+            raise ValueError(
+                f"engine does not hold weights version {ver} pinned "
+                f"by the snapshot (held: {sorted(engine.weights)}) — "
+                "load_weights the version before restoring")
+        model = engine.model_meta(ver)
+        if model != want:
+            diff = {k: (want.get(k), model.get(k))
+                    for k in set(model) | set(want)
+                    if want.get(k) != model.get(k)}
+            raise ValueError(
+                f"model != snapshot model for weights version {ver}: "
+                f"{diff} — resume replays recorded tokens through the "
+                "pinned weights, so the identical model (same shape "
+                "AND same init) is required for the token-identical "
+                "contract")
+    engine.set_serving_version(int(snap["serving_version"]))
     engine.step_base = int(snap["step"])
     engine.finished = {int(u): list(t)
                        for u, t in snap["finished"].items()}
@@ -259,7 +288,8 @@ def restore_engine_state(engine: DecodeEngine, snap: dict) -> None:
                               out=req["out"], retries=req["retries"],
                               t_submit=req.get("t_submit"),
                               submit_step=req.get("submit_step"),
-                              t_first=req.get("t_first"))
+                              t_first=req.get("t_first"),
+                              weights_version=req.get("weights_version"))
     # auto-uid assignment must clear EVERY restored uid, not just the
     # live ones resume_request walked — a fresh submit colliding with a
     # finished uid would sample in lockstep with its twin and overwrite

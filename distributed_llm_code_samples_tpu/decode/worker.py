@@ -156,7 +156,27 @@ def worker_main(argv=None) -> int:
                               req["max_new"], out=req["out"],
                               retries=req["retries"],
                               t_submit=req.get("t_submit"),
-                              t_first=req.get("t_first"))
+                              t_first=req.get("t_first"),
+                              weights_version=req.get(
+                                  "weights_version"))
+            return {"digest": hd.digest()}
+        if op == "release":
+            return {"entry": hd.release_request(req["uid"]),
+                    "digest": hd.digest()}
+        if op == "load_weights":
+            # the rolling deploy's swap half: restore the checkpoint
+            # step from the SHARED ledger dir (weights never ride the
+            # socket) and double-buffer it as the named version; the
+            # CRC ladder runs inside restore — a torn step raises and
+            # crosses back as the one-line rejection the router's
+            # rollback names
+            from ..runtime.weights import VersionLedger
+            new = VersionLedger(req["ckpt_dir"]).load(req["step"],
+                                                      engine.params)
+            fp = engine.load_weights(req["version"], new)
+            return {"fingerprint": fp, "digest": hd.digest()}
+        if op == "set_version":
+            engine.set_serving_version(req["version"])
             return {"digest": hd.digest()}
         if op == "step":
             hd.step_begin(prefill_only=req.get("prefill_only", False))
@@ -474,12 +494,39 @@ class ProcessEngineHandle:
 
     def resume_request(self, uid: int, prompt, max_new: int, *, out=(),
                        retries: int = 0, t_submit=None,
-                       t_first=None) -> None:
+                       t_first=None, weights_version=None) -> None:
         self._call("resume", uid=int(uid),
                    prompt=[int(t) for t in prompt],
                    max_new=int(max_new), out=[int(t) for t in out],
                    retries=int(retries), t_submit=t_submit,
-                   t_first=t_first)
+                   t_first=t_first,
+                   weights_version=(None if weights_version is None
+                                    else int(weights_version)))
+
+    def release_request(self, uid: int) -> dict:
+        return self._call("release", uid=int(uid))["entry"]
+
+    # -- weight lifecycle (round 17, DESIGN.md section 23) -------------
+
+    @property
+    def serving_version(self) -> int:
+        return int(self.digest()["serving_version"])
+
+    def load_weights(self, version: int, ckpt_dir: str, step: int,
+                     params=None) -> dict:
+        """The swap half of the rolling deploy, worker-side: the
+        worker restores checkpoint ``step`` from the SHARED ledger dir
+        itself (weights never ride the socket — the spool-file stance)
+        and double-buffers it as ``version``. ``params`` is the
+        in-process transport's shortcut and is ignored here. A torn
+        step fails the worker's own CRC ladder and crosses back as
+        the one-line rejection the router's rollback names."""
+        return self._call("load_weights", version=int(version),
+                          ckpt_dir=ckpt_dir, step=int(step))[
+                              "fingerprint"]
+
+    def set_serving_version(self, version: int) -> None:
+        self._call("set_version", version=int(version))
 
     def step_begin(self, prefill_only: bool = False) -> None:
         """SEND the step — every worker's step runs concurrently in its

@@ -260,6 +260,8 @@ class _Stream:
         self.routers = by.get("router", [])
         # schema-v9 per-round fleet health records (decode/fleet.py)
         self.fleets = by.get("fleet", [])
+        # schema-v11 rolling-deploy lifecycle records (decode/fleet.py)
+        self.deploys = by.get("deploy", [])
         # request records: drop exact replays — an in-process
         # supervisor restart resumes from a snapshot that may PREDATE
         # records already emitted, so the replayed steps re-emit
@@ -446,6 +448,17 @@ class _Stream:
         if gaps:
             (rel["itl_p50_s"], rel["itl_p90_s"],
              rel["itl_p99_s"]) = _pct3(gaps, 6)
+        # v11 per-version completions: each uid completed exactly once
+        # per stream (the replay dedup above), counted under its
+        # weights-version pin — a mid-deploy stream shows both
+        vers: dict[str, int] = {}
+        for r in requests:
+            if r["event"] == "completed" \
+                    and r.get("weights_version") is not None:
+                key = f"v{r['weights_version']}"
+                vers[key] = vers.get(key, 0) + 1
+        if vers:
+            rel["completed_by_version"] = vers
         return rel
 
     def recovery(self) -> dict:
@@ -575,6 +588,27 @@ class _Stream:
             if r.get("prefix_hit_blocks"):
                 bits.append(f"{r['prefix_hit_blocks']} warm block(s)")
             timeline.append((r["t"], "router", "  ".join(bits)))
+        for d in self.deploys:
+            ev = d["event"]
+            pair = (f"v{d.get('from_version')} -> "
+                    f"v{d.get('to_version')}")
+            if ev == "started":
+                what = f"DEPLOY STARTED {pair}"
+            elif ev == "engine_swapped":
+                what = (f"DEPLOY {pair}: engine {d.get('engine')} "
+                        "drained + swapped")
+            elif ev == "completed":
+                what = (f"DEPLOY COMPLETED {pair} across "
+                        f"{d.get('engines')} engine(s) in "
+                        f"{d.get('duration_s')}s "
+                        f"({d.get('drained')} request(s) migrated, "
+                        "zero shed)")
+            elif ev == "rolled_back":
+                what = f"DEPLOY ROLLED BACK — {d.get('reason')}"
+            else:
+                what = f"DEPLOY {ev} {pair}"
+            timeline.append((d["t"], "deploy",
+                             what + f" @ fleet round {d.get('step')}"))
         for r in self.requests:
             ev = r["event"]
             bits = [f"request {r.get('uid')} {ev.upper()}"
@@ -1008,6 +1042,10 @@ def _render_engine_sections(out: list, doc: dict) -> None:
                        f"p50 {rl['itl_p50_s']}s  "
                        f"p90 {rl['itl_p90_s']}s  "
                        f"p99 {rl['itl_p99_s']}s")
+        if len(rl.get("completed_by_version") or {}) > 1:
+            out.append("  completions by weights version: " + ", ".join(
+                f"{k} x{v}" for k, v in sorted(
+                    rl["completed_by_version"].items())))
     rec = doc.get("recovery", {})
     if (rec.get("attempts_failed") or rec.get("nonfinite_skips")
             or rec.get("attempt_log")
@@ -1253,6 +1291,24 @@ def report_main(argv=None) -> int:
             "wire_rejected": by_ev.get("wire_rejected", 0),
             "completed": len(completed),
         }
+        # v11 live-deploy surface: per-version completion counts dedup
+        # BY UID across streams first (a migrated-then-completed
+        # request may appear in two engines' files — one uid, one
+        # version, one count) and the deploy lifecycle tallies
+        vers: dict[str, int] = {}
+        for r in completed:
+            if r.get("weights_version") is not None:
+                key = f"v{r['weights_version']}"
+                vers[key] = vers.get(key, 0) + 1
+        if vers:
+            fleet["completed_by_version"] = vers
+        deploy_recs = [d for s in streams for d in s.deploys]
+        if deploy_recs:
+            fleet["deploys"] = sum(1 for d in deploy_recs
+                                   if d["event"] == "completed")
+            fleet["deploy_rollbacks"] = sum(1 for d in deploy_recs
+                                            if d["event"]
+                                            == "rolled_back")
         if moves:
             fleet["handoff_blocks"] = sum(int(r.get("blocks") or 0)
                                           for r in moves)
@@ -1390,6 +1446,14 @@ def report_main(argv=None) -> int:
             out.append(f"  wire integrity {fl['wire_rejected']} "
                        "handoff doc(s) REJECTED (CRC/torn/version — "
                        "replay-rerouted; reasons on the timeline)")
+        if "deploys" in fl or "deploy_rollbacks" in fl:
+            out.append(f"  deploys        {fl.get('deploys', 0)} "
+                       f"completed, {fl.get('deploy_rollbacks', 0)} "
+                       "rolled back (events on the timeline)")
+        if fl.get("completed_by_version"):
+            out.append("  completions by weights version: " + ", ".join(
+                f"{k} x{v}" for k, v in sorted(
+                    fl["completed_by_version"].items())))
     if doc.get("fleet_health"):
         _render_fleet_health(out, doc["fleet_health"])
     if doc.get("slo"):

@@ -16,14 +16,17 @@ the ``StepReport`` static fold) every run/bench/report shares;
 waterfall's telescoping clock).
 """
 
-from . import backend_probe, chaos, native, telemetry, tracing
+from . import backend_probe, chaos, native, telemetry, tracing, weights
 from .chaos import FaultPlan
 from .failure import (HealthCheckError, device_healthcheck, supervise)
 from .init import initialize, runtime_info, DEFAULT_COORDINATOR
 from .telemetry import StepReport, TelemetryWriter
 from .tracing import SpanTracer
+from .weights import VersionLedger, model_fingerprint
 
 __all__ = ["backend_probe", "chaos", "native", "telemetry", "tracing",
-           "initialize", "runtime_info", "DEFAULT_COORDINATOR",
-           "FaultPlan", "HealthCheckError", "device_healthcheck",
-           "supervise", "StepReport", "TelemetryWriter", "SpanTracer"]
+           "weights", "initialize", "runtime_info",
+           "DEFAULT_COORDINATOR", "FaultPlan", "HealthCheckError",
+           "device_healthcheck", "supervise", "StepReport",
+           "TelemetryWriter", "SpanTracer", "VersionLedger",
+           "model_fingerprint"]

@@ -121,7 +121,15 @@ DECODE_KINDS = ("nan_logits", "hang_step", "corrupt_block", "kill")
 #   after that round is bit-flipped in transit: the per-array CRC-32
 #   (runtime/wire.py) must reject it with a named reason and the
 #   request must be replay-rerouted, no engine importing partial state.
-FLEET_KINDS = ("kill_worker", "hang_worker", "corrupt_wire")
+# - ``corrupt_deploy@ROUND[:FRAC]`` (round 17) — the NEXT rolling
+#   deploy at or after that round reads a torn target checkpoint (its
+#   primary array file truncated to FRAC, default 0.5, just before the
+#   ledger reads it): the checkpoint CRC ladder must reject the step
+#   with a one-line named reason, the fleet must roll back to
+#   ``latest_verified_step`` — deploy aborted, no engine left serving
+#   a mixed version, nothing shed (decode/fleet.py rolling_deploy).
+FLEET_KINDS = ("kill_worker", "hang_worker", "corrupt_wire",
+               "corrupt_deploy")
 KINDS = IN_SEGMENT_KINDS + PUBLISH_KINDS + tuple(
     k for k in DECODE_KINDS if k not in PUBLISH_KINDS) + FLEET_KINDS
 
@@ -383,6 +391,12 @@ def validate_fleet_plan(plan: FaultPlan) -> None:
                 f"corrupt_wire takes no :ARG (got {f.arg!r}) — it "
                 "corrupts the next wire handoff after its round; the "
                 "CRC layer decides what is detected")
+        if f.kind == "corrupt_deploy" and f.arg is not None and not (
+                0 < f.arg < 1):
+            raise ValueError(
+                f"corrupt_deploy arg {f.arg!r} must be a truncation "
+                "fraction in (0, 1) (omit it for 0.5) — the torn "
+                "checkpoint the deploy's CRC ladder must reject")
 
 
 def truncate_checkpoint(path: str, frac: float = 0.5) -> str:

@@ -101,7 +101,19 @@ import numpy as np
 # nulls). A rejected wire doc (CRC mismatch / torn npz / version skew,
 # runtime/wire.py) emits a ``wire_rejected`` router record whose
 # ``reason`` carries the one-line rejection.
-SCHEMA_VERSION = 10
+# v11 (round 17): the live-weight hot-swap layer (DESIGN.md
+# section 23). (1) adds the "deploy" kind — one record per rolling-
+# deploy lifecycle event (started / engine_swapped / completed /
+# rolled_back, decode/fleet.py) pinning the version pair
+# (``from_version``/``to_version``); ``engine_swapped`` additionally
+# pins ``engine``, ``completed`` and ``rolled_back`` pin
+# ``duration_s``, and ``rolled_back`` pins ``reason`` (the one-line
+# named cause + the ``latest_verified_step`` fallback) — enforced
+# conditionally per event (the REQUEST_COMPLETED_REQUIRED pattern).
+# (2) every "request" record grows ``weights_version`` — the uid's
+# weights-version pin (null before first admission), the per-version
+# attribution mixed-version fleet reports dedup completions by.
+SCHEMA_VERSION = 11
 
 METRICS_FILENAME = "metrics.jsonl"
 
@@ -191,7 +203,12 @@ DECODE_REQUIRED = ("step", "tokens_per_sec", "batch_occupancy",
 # (submit -> first emitted token; null when the first token predates a
 # crash-resume, in which case the decomposition is honestly
 # unreconstructable). Same version-bump discipline as STEP_KEYS.
-REQUEST_REQUIRED = ("step", "uid", "event", "reason")
+# v11: ``weights_version`` — the uid's weights-version pin (null
+# before first admission pins it; the anonymous rejected uid -1 is
+# always null) — so a mixed-version fleet's per-version completion
+# counts are recorded data, not inference.
+REQUEST_REQUIRED = ("step", "uid", "event", "reason",
+                    "weights_version")
 
 # the extra keys a COMPLETED request record must also carry (v9) —
 # enforced conditionally by validate_record (other events never
@@ -267,6 +284,32 @@ ROUTER_POLICIES = ("session", "prefix", "least_loaded", "spill")
 # everything). Same version-bump discipline as STEP_KEYS.
 FLEET_REQUIRED = ("step", "engines", "load_imbalance")
 
+# The deploy-record contract (``decode/fleet.py`` rolling_deploy,
+# v11): one record per rolling-deploy lifecycle event. ``step`` is the
+# router's round clock, ``event`` one of DEPLOY_EVENTS,
+# ``from_version``/``to_version`` the weights-version pair (the
+# checkpoint step being deployed; ``to_version`` may be null when no
+# checkpoint was ever published). Per-event conditional pins (the
+# REQUEST_COMPLETED_REQUIRED pattern, enforced by validate_record):
+# ``engine_swapped`` carries ``engine``; ``completed`` and
+# ``rolled_back`` carry ``duration_s``; ``rolled_back`` carries
+# ``reason`` — the ONE-line named cause naming the CRC rejection or
+# mid-roll failure plus the latest_verified_step fallback. Same
+# version-bump discipline as STEP_KEYS.
+DEPLOY_REQUIRED = ("step", "event", "from_version", "to_version")
+
+# the deploy lifecycle vocabulary (report renders any name; a new
+# event is additive)
+DEPLOY_EVENTS = ("started", "engine_swapped", "completed",
+                 "rolled_back")
+
+# per-event conditional pins for deploy records (validate_record)
+DEPLOY_EVENT_REQUIRED = {
+    "engine_swapped": ("engine",),
+    "completed": ("duration_s",),
+    "rolled_back": ("duration_s", "reason"),
+}
+
 # Non-step record kinds the stream also carries: run headers ("meta"),
 # recovery/chaos/checkpoint events ("event"), bench measurement rows
 # ("bench" — bench.py's per-measurement plumbing rides the same
@@ -274,7 +317,8 @@ FLEET_REQUIRED = ("step", "engines", "load_imbalance")
 # serving engine's "decode" cadence + "request" lifecycle + "span"
 # per-request phase records.
 RECORD_KINDS = ("step", "meta", "event", "bench", "anomaly", "rollback",
-                "decode", "request", "span", "router", "fleet")
+                "decode", "request", "span", "router", "fleet",
+                "deploy")
 
 # kind -> the pinned required-key set validate_record enforces (step
 # records additionally pin their FULL key set via STEP_KEYS)
@@ -287,6 +331,7 @@ REQUIRED_KEYS = {
     "span": SPAN_REQUIRED,
     "router": ROUTER_REQUIRED,
     "fleet": FLEET_REQUIRED,
+    "deploy": DEPLOY_REQUIRED,
 }
 
 # bf16 peak matmul FLOP/s by chip generation (public spec sheets; the
@@ -505,7 +550,18 @@ class TelemetryWriter:
         rec = dict(record)
         rec.setdefault("t", time.time())
         rec.setdefault("reason", None)
+        rec.setdefault("weights_version", None)
         rec["kind"] = "request"
+        self._put(rec)
+
+    def deploy(self, record: dict) -> None:
+        """Enqueue one rolling-deploy lifecycle record: started /
+        engine_swapped / completed / rolled_back
+        (``decode/fleet.py``; ``DEPLOY_REQUIRED`` contract plus the
+        per-event conditional pins)."""
+        rec = dict(record)
+        rec.setdefault("t", time.time())
+        rec["kind"] = "deploy"
         self._put(rec)
 
     def router(self, record: dict) -> None:
@@ -658,6 +714,15 @@ def validate_record(rec: Any) -> tuple[bool, str]:
         missing = [k for k in ROUTER_MOVE_REQUIRED if k not in rec]
         if missing:
             return False, (f"router record (event {rec['event']}) "
+                           f"missing required key(s) {missing}")
+    if kind == "deploy" and rec.get("event") in DEPLOY_EVENT_REQUIRED:
+        # v11 conditional pins: only a swap names an engine, only a
+        # terminal event measures a duration, only a rollback has a
+        # named reason — pinning kind-wide would force nulls
+        missing = [k for k in DEPLOY_EVENT_REQUIRED[rec["event"]]
+                   if k not in rec]
+        if missing:
+            return False, (f"deploy record (event {rec['event']}) "
                            f"missing required key(s) {missing}")
     if kind == "step" and not isinstance(rec["step"], int):
         return False, (f"step record key 'step' is "

@@ -574,6 +574,118 @@ rm -rf "$SLO_DIR"
 echo "SLO_SMOKE=OK"
 phase_done slo_smoke
 
+echo "=== rolling-deploy smoke ==="
+# Live weight hot-swap (DESIGN.md section 23): the TRAINER publishes
+# checkpoints via the existing atomic fsync+CRC publish (-m 11, the LM
+# family at the serving shape), then a 3-engine fleet rolls the newest
+# step engine-by-engine at round 4 mid-serve (drain over the KV
+# handoff, swap, re-admit — zero shed). Every completed uid must be
+# BYTE-IDENTICAL to one of the two pinned-version single-engine
+# oracles (--random_seed 0 = the boot weights; --weights_from = the
+# deployed checkpoint) with BOTH versions represented, and the router
+# stream must hold schema-v11 deploy records. The corrupt_deploy
+# variant tears the target step: the CRC ladder must reject it with
+# the one-line rollback (stderr + rolled_back record), every request
+# completing on v0 with no engine left mixed.
+DEP_DIR=$(mktemp -d /tmp/tier1_deploy.XXXXXX)
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli -m 11 -s 4 -bs 2 -n 64 -d 32 \
+    -l 2 --heads 4 --vocab 64 --fake_devices 4 \
+    --checkpoint_dir "$DEP_DIR/ck" --checkpoint_every 2 > /dev/null
+then
+  echo "DEPLOY_SMOKE=FAIL (trainer publish)"; rm -rf "$DEP_DIR"; exit 1
+fi
+DEP_CK="$DEP_DIR/ck/train_lm_tp"
+DEP_ARGS="--prompt_lens 3,7,5,6,4,9 --max_new 8 -d 32 -l 2 --heads 4
+  --vocab 64 --max_seq_len 64 --block_size 8 --prefill_chunk 4
+  --max_slots 1 --log_every 2"
+if ! timeout -k 10 240 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli generate $DEP_ARGS \
+    > "$DEP_DIR/v0.json"; then
+  echo "DEPLOY_SMOKE=FAIL (v0 oracle)"; rm -rf "$DEP_DIR"; exit 1
+fi
+if ! timeout -k 10 240 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli generate $DEP_ARGS \
+    --weights_from "$DEP_CK" > "$DEP_DIR/vnew.json"; then
+  echo "DEPLOY_SMOKE=FAIL (deployed-version oracle)"
+  rm -rf "$DEP_DIR"; exit 1
+fi
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli generate $DEP_ARGS \
+    --fleet 3 --deploy_dir "$DEP_CK" --deploy_round 4 \
+    --metrics_dir "$DEP_DIR/m" > "$DEP_DIR/fleet.json"; then
+  echo "DEPLOY_SMOKE=FAIL (rolling deploy run)"; rm -rf "$DEP_DIR"
+  exit 1
+fi
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli generate $DEP_ARGS \
+    --fleet 3 --deploy_dir "$DEP_CK" --deploy_round 4 \
+    --fleet_chaos corrupt_deploy@4 --metrics_dir "$DEP_DIR/mc" \
+    > "$DEP_DIR/corrupt.json" 2> "$DEP_DIR/corrupt.err"; then
+  echo "DEPLOY_SMOKE=FAIL (corrupt_deploy run)"; rm -rf "$DEP_DIR"
+  exit 1
+fi
+if ! grep -q "rolled back" "$DEP_DIR/corrupt.err"; then
+  echo "DEPLOY_SMOKE=FAIL (no one-line rollback on stderr)"
+  tail -3 "$DEP_DIR/corrupt.err"; rm -rf "$DEP_DIR"; exit 1
+fi
+if ! timeout -k 10 60 env JAX_PLATFORMS=cpu python - "$DEP_DIR" <<'EOF'
+import json, os, sys
+from distributed_llm_code_samples_tpu.runtime.telemetry import (
+    METRICS_FILENAME, read_metrics, validate_record)
+base = sys.argv[1]
+v0 = {s["uid"]: s["tokens"] for s in
+      json.load(open(os.path.join(base, "v0.json")))["sequences"]}
+vn = {s["uid"]: s["tokens"] for s in
+      json.load(open(os.path.join(base, "vnew.json")))["sequences"]}
+fl = json.load(open(os.path.join(base, "fleet.json")))
+toks = {s["uid"]: s["tokens"] for s in fl["sequences"]}
+assert not fl["failed"] and fl["shed"] == 0, (fl["failed"], fl["shed"])
+st = fl["fleet"]
+assert st["deploys"] == 1 and st["deploy_rollbacks"] == 0, st
+assert st["sheds"] == 0, st
+target = {v["serving_version"] for v in st["engines"].values()}
+assert target == {4}, target            # every engine on the new step
+# token identity per pinned version: each uid matches an oracle, both
+# versions represented (old pins finished on v0, post-deploy
+# admissions decoded on the deployed weights)
+assert set(toks) == set(v0) == set(vn)
+on_old = {u for u in toks if toks[u] == v0[u]}
+on_new = {u for u in toks if toks[u] == vn[u]}
+assert on_old | on_new == set(toks), set(toks) - (on_old | on_new)
+assert on_old and on_new, (sorted(on_old), sorted(on_new))
+records, problems = read_metrics(
+    os.path.join(base, "m", "router", METRICS_FILENAME))
+assert not problems, problems
+deps = [r for r in records if r["kind"] == "deploy"]
+assert deps and all(validate_record(d)[0] for d in deps)
+assert [d["event"] for d in deps] == (
+    ["started"] + ["engine_swapped"] * 3 + ["completed"]), deps
+assert all(d["from_version"] == 0 and d["to_version"] == 4
+           for d in deps)
+# the corrupt_deploy variant: rollback record with the one-line named
+# reason, fleet stays on v0, every request completes on the v0 oracle
+co = json.load(open(os.path.join(base, "corrupt.json")))
+ctoks = {s["uid"]: s["tokens"] for s in co["sequences"]}
+assert ctoks == v0, "corrupt-deploy run diverged from the v0 oracle"
+cst = co["fleet"]
+assert cst["deploys"] == 0 and cst["deploy_rollbacks"] == 1, cst
+assert {v["serving_version"] for v in cst["engines"].values()} == {0}
+crecs, cproblems = read_metrics(
+    os.path.join(base, "mc", "router", METRICS_FILENAME))
+assert not cproblems, cproblems
+[rb] = [r for r in crecs if r["kind"] == "deploy"]
+assert rb["event"] == "rolled_back" and validate_record(rb)[0]
+assert "\n" not in rb["reason"] and "rejected" in rb["reason"], rb
+EOF
+then
+  echo "DEPLOY_SMOKE=FAIL (pinned-identity/schema check)"
+  rm -rf "$DEP_DIR"; exit 1
+fi
+rm -rf "$DEP_DIR"
+echo "DEPLOY_SMOKE=OK"
+phase_done deploy_smoke
+
 echo "=== bench-trend smoke ==="
 # The committed BENCH_*/SCALING_* round artifacts must keep their row
 # contracts (scripts/bench_trend.py exits 2 on drift or a missing

@@ -626,9 +626,11 @@ def test_corrupt_wire_inproc_rejected_and_replayed(lm_params, prompts,
     assert replays[0]["transport"]["mode"] == "replay"
     assert replays[0]["transport"]["retries"] == 1
     assert replays[0]["blocks"] == 0 and replays[0]["bytes"] == 0
-    # the rejected wire file is KEPT for post-mortem
+    # the rejected wire file is KEPT for post-mortem — renamed
+    # *.rejected so no retry can re-consume it, under the router's
+    # bounded keep_rejected retention (round 17 satellite)
     import glob
-    assert glob.glob(str(tmp_path / "wire" / "*.npz"))
+    assert glob.glob(str(tmp_path / "wire" / "*.rejected"))
 
 
 def test_fleet_chaos_validated_at_construction(lm_params, tmp_path):

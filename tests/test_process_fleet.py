@@ -142,6 +142,59 @@ def test_process_kill_one_of_three_drill(lm_params, prompts, tmp_path,
         assert r["blocks"] == 0 and r["bytes"] == 0
 
 
+def test_process_rolling_deploy_pinned_identity(lm_params, prompts,
+                                                tmp_path):
+    """The round-17 deploy drill across REAL worker processes: publish
+    a checkpoint mid-serve, roll the 3-worker fleet engine by engine
+    (each worker restores the step from the shared ledger dir itself —
+    weights never ride the socket; a ``load_weights`` worker op), and
+    every request matches its PINNED-version oracle: in-flight on the
+    boot weights, post-deploy admissions on the deployed ones. Zero
+    shed, schema-v11 deploy records on the router stream."""
+    from distributed_llm_code_samples_tpu.checkpoint import \
+        save_checkpoint
+    new_params = init_lm(jax.random.PRNGKey(7), V, D, L,
+                         max_seq_len=64)
+    ck = str(tmp_path / "ck")
+    save_checkpoint(ck, new_params, 5)
+    rm = TelemetryWriter(str(tmp_path / "router"),
+                         meta={"engine_id": "router"})
+    handles = _spawn(3, 0, tmp_path / "spool")
+    fl = FleetRouter(None, 3, handles=handles, metrics=rm)
+    try:
+        old_uids = [fl.submit(p, MAX_NEW) for p in prompts[:2]]
+        for _ in range(4):
+            fl.step()
+        res = fl.rolling_deploy(ck)
+        assert res["status"] == "completed" and res["to_version"] == 5
+        new_uid = fl.submit(prompts[2], MAX_NEW)
+        out = fl.run()
+        st = fl.fleet_stats()
+    finally:
+        fl.close()
+        rm.close()
+    assert st["sheds"] == 0 and not fl.failed()
+    assert st["deploys"] == 1
+    assert all(v["serving_version"] == 5
+               for v in st["engines"].values())
+    for i, u in enumerate(old_uids):
+        eng = DecodeEngine(lm_params, H, EngineConfig(**BASE))
+        eng.submit(prompts[i], MAX_NEW, uid=u)
+        assert out[u] == eng.run()[u], f"old-pin uid {u}"
+    eng = DecodeEngine(new_params, H, EngineConfig(**BASE))
+    eng.submit(prompts[2], MAX_NEW, uid=new_uid)
+    assert out[new_uid] == eng.run()[new_uid]
+    records, problems = read_metrics(
+        os.path.join(str(tmp_path / "router"), METRICS_FILENAME))
+    assert not problems, problems
+    deps = [r for r in records if r["kind"] == "deploy"]
+    assert [d["event"] for d in deps] == (
+        ["started"] + ["engine_swapped"] * 3 + ["completed"])
+    for d in deps:
+        ok, reason = validate_record(d)
+        assert ok, reason
+
+
 def test_process_hang_worker_declared_dead(lm_params, prompts,
                                            tmp_path):
     """A silently hung worker (hang_worker@4:12 — alive but

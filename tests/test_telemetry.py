@@ -58,7 +58,14 @@ from distributed_llm_code_samples_tpu.runtime.telemetry import (
 # attribution ({mode, bytes, crc_verify_s, retries}; bytes = the
 # SERIALIZED wire size), and the ``wire_rejected`` router event lands
 # (a CRC/torn/version-rejected handoff doc, runtime/wire.py).
-_PINNED_VERSION = 10
+# v11 (round 17): the live-weight hot-swap layer — every "request"
+# record pins ``weights_version`` (the uid's version pin, null before
+# first admission) and the "deploy" kind lands (rolling-deploy
+# lifecycle: started/engine_swapped/completed/rolled_back with the
+# from/to version pair; engine_swapped conditionally pins ``engine``,
+# completed/rolled_back pin ``duration_s``, rolled_back pins the
+# one-line ``reason`` — decode/fleet.py rolling_deploy).
+_PINNED_VERSION = 11
 _PINNED_STEP_KEYS = frozenset({
     "schema", "kind", "t", "step", "strategy", "loss", "grad_norm",
     "tokens_per_sec", "step_time_s", "mfu", "hbm_high_water_bytes",
@@ -73,7 +80,9 @@ _PINNED_DECODE_REQUIRED = frozenset({
     "accept_rate", "prefix_hit_blocks", "prefill_tokens_saved",
     "shared_blocks", "cow_copies",
 })
-_PINNED_REQUEST_REQUIRED = frozenset({"step", "uid", "event", "reason"})
+_PINNED_REQUEST_REQUIRED = frozenset({
+    "step", "uid", "event", "reason", "weights_version",
+})
 _PINNED_SPAN_REQUIRED = frozenset({
     "step", "uid", "span", "start_step", "duration_s",
 })
@@ -85,14 +94,23 @@ _PINNED_FLEET_REQUIRED = frozenset({"step", "engines",
                                     "load_imbalance"})
 _PINNED_ROUTER_MOVE_REQUIRED = frozenset({"blocks", "bytes",
                                           "duration_s", "transport"})
+_PINNED_DEPLOY_REQUIRED = frozenset({
+    "step", "event", "from_version", "to_version",
+})
+_PINNED_DEPLOY_EVENT_REQUIRED = {
+    "engine_swapped": frozenset({"engine"}),
+    "completed": frozenset({"duration_s"}),
+    "rolled_back": frozenset({"duration_s", "reason"}),
+}
 
 
 def test_schema_version_bump_discipline():
     from distributed_llm_code_samples_tpu.runtime.telemetry import (
-        ANOMALY_REQUIRED, DECODE_REQUIRED, FLEET_REQUIRED,
-        RECORD_KINDS, REQUEST_COMPLETED_REQUIRED, REQUEST_REQUIRED,
-        REQUIRED_KEYS, ROLLBACK_REQUIRED, ROUTER_MOVE_REQUIRED,
-        ROUTER_REQUIRED, SPAN_REQUIRED)
+        ANOMALY_REQUIRED, DECODE_REQUIRED, DEPLOY_EVENT_REQUIRED,
+        DEPLOY_REQUIRED, FLEET_REQUIRED, RECORD_KINDS,
+        REQUEST_COMPLETED_REQUIRED, REQUEST_REQUIRED, REQUIRED_KEYS,
+        ROLLBACK_REQUIRED, ROUTER_MOVE_REQUIRED, ROUTER_REQUIRED,
+        SPAN_REQUIRED)
     assert SCHEMA_VERSION == _PINNED_VERSION and \
         frozenset(STEP_KEYS) == _PINNED_STEP_KEYS and \
         frozenset(ANOMALY_REQUIRED) == _PINNED_ANOMALY_REQUIRED and \
@@ -105,7 +123,10 @@ def test_schema_version_bump_discipline():
         frozenset(ROUTER_REQUIRED) == _PINNED_ROUTER_REQUIRED and \
         frozenset(ROUTER_MOVE_REQUIRED) == \
         _PINNED_ROUTER_MOVE_REQUIRED and \
-        frozenset(FLEET_REQUIRED) == _PINNED_FLEET_REQUIRED, (
+        frozenset(FLEET_REQUIRED) == _PINNED_FLEET_REQUIRED and \
+        frozenset(DEPLOY_REQUIRED) == _PINNED_DEPLOY_REQUIRED and \
+        {k: frozenset(v) for k, v in DEPLOY_EVENT_REQUIRED.items()} \
+        == _PINNED_DEPLOY_EVENT_REQUIRED, (
             "telemetry record schema changed: bump SCHEMA_VERSION "
             "and update the pinned sets here in the same commit")
     assert "anomaly" in RECORD_KINDS and "rollback" in RECORD_KINDS
@@ -114,11 +135,12 @@ def test_schema_version_bump_discipline():
     assert "span" in RECORD_KINDS
     assert "router" in RECORD_KINDS
     assert "fleet" in RECORD_KINDS
+    assert "deploy" in RECORD_KINDS
     # every contract-carrying kind routes through the one table
     # validate_record reads (a new kind that skips it validates
     # envelope-only silently — this catches the drift)
     for kind in ("step", "anomaly", "rollback", "decode", "request",
-                 "span", "router", "fleet"):
+                 "span", "router", "fleet", "deploy"):
         assert kind in REQUIRED_KEYS, kind
 
 
@@ -235,6 +257,7 @@ def test_span_record_round_trip_and_torn_tail(tmp_path):
     ("span", _PINNED_SPAN_REQUIRED),
     ("router", _PINNED_ROUTER_REQUIRED),
     ("fleet", _PINNED_FLEET_REQUIRED),
+    ("deploy", _PINNED_DEPLOY_REQUIRED),
 ])
 def test_validate_record_names_kind_and_key(kind, required):
     """Satellite contract: every validate_record failure is ONE line
@@ -359,7 +382,8 @@ def test_completed_request_record_conditional_pin():
     (null ttft_s allowed — a crash-resumed first token is honestly
     unreconstructable); other request events never pin them."""
     base = {"schema": SCHEMA_VERSION, "kind": "request", "t": 0.0,
-            "step": 3, "uid": 1, "reason": None}
+            "step": 3, "uid": 1, "reason": None,
+            "weights_version": None}
     ok, reason = validate_record({**base, "event": "completed",
                                   "latency_s": 1.5, "ttft_s": 0.5})
     assert ok, reason
@@ -375,6 +399,77 @@ def test_completed_request_record_conditional_pin():
     # an admitted record carries neither and stays valid
     ok, reason = validate_record({**base, "event": "admitted"})
     assert ok, reason
+    # v11: the weights_version pin is part of the kind-wide contract —
+    # a record missing it (not merely null) rejects naming the key
+    bad = {k: v for k, v in base.items() if k != "weights_version"}
+    ok, reason = validate_record({**bad, "event": "admitted"})
+    assert not ok and "request record" in reason \
+        and "weights_version" in reason
+
+
+def test_deploy_record_round_trip_and_torn_tail(tmp_path):
+    """The schema-v11 deploy kind (decode/fleet.py rolling_deploy):
+    the writer method stamps the kind + envelope, the full lifecycle
+    round-trips, a torn tail after a deploy write is reported-not-
+    fatal, and a missing contract key rejects naming kind and key."""
+    w = TelemetryWriter(str(tmp_path))
+    w.deploy({"step": 4, "event": "started", "from_version": 0,
+              "to_version": 3, "ckpt_dir": "/ck"})
+    w.deploy({"step": 4, "event": "engine_swapped", "from_version": 0,
+              "to_version": 3, "engine": "e1", "duration_s": 0.01})
+    w.deploy({"step": 4, "event": "completed", "from_version": 0,
+              "to_version": 3, "duration_s": 0.2, "engines": 3,
+              "drained": 5})
+    w.deploy({"step": 9, "event": "rolled_back", "from_version": 3,
+              "to_version": 7, "duration_s": 0.05,
+              "reason": "checkpoint step_7 rejected (arrays.npz "
+                        "checksum mismatch)", "latest_verified": 3})
+    w.close()
+    path = os.path.join(str(tmp_path), METRICS_FILENAME)
+    with open(path, "a") as f:
+        f.write('{"schema": 11, "kind": "dep')    # torn write
+    records, problems = read_metrics(path)
+    assert len(problems) == 1 and "torn" in problems[0]
+    assert [r["event"] for r in records] == [
+        "started", "engine_swapped", "completed", "rolled_back"]
+    for rec in records:
+        assert rec["kind"] == "deploy" and rec["schema"] == SCHEMA_VERSION
+        ok, reason = validate_record(rec)
+        assert ok, reason
+    assert records[3]["from_version"] == 3 \
+        and records[3]["to_version"] == 7
+    assert "\n" not in records[3]["reason"]
+    bad = {k: v for k, v in records[0].items() if k != "to_version"}
+    ok, reason = validate_record(bad)
+    assert not ok and "deploy record" in reason and "to_version" in reason
+
+
+def test_deploy_record_per_event_conditional_pins():
+    """v11 per-event pins: engine_swapped names its engine, terminal
+    events carry duration_s, a rollback carries its one-line reason —
+    and ``started`` pins none of them (nothing has happened yet)."""
+    base = {"schema": SCHEMA_VERSION, "kind": "deploy", "t": 0.0,
+            "step": 2, "from_version": 0, "to_version": 5}
+    ok, reason = validate_record({**base, "event": "started"})
+    assert ok, reason
+    ok, reason = validate_record({**base, "event": "engine_swapped"})
+    assert not ok and "engine_swapped" in reason and "engine" in reason
+    ok, reason = validate_record({**base, "event": "engine_swapped",
+                                  "engine": "e0"})
+    assert ok, reason
+    ok, reason = validate_record({**base, "event": "completed"})
+    assert not ok and "completed" in reason and "duration_s" in reason
+    ok, reason = validate_record({**base, "event": "rolled_back",
+                                  "duration_s": 0.1})
+    assert not ok and "rolled_back" in reason and "reason" in reason
+    ok, reason = validate_record({**base, "event": "rolled_back",
+                                  "duration_s": 0.1, "reason": "torn"})
+    assert ok, reason
+    for rec in ({**base, "event": "started"},
+                {**base, "event": "rolled_back", "duration_s": 0.1,
+                 "reason": "x"}):
+        assert "\n" not in validate_record(
+            {k: v for k, v in rec.items() if k != "step"})[1]
 
 
 def test_read_metrics_survives_torn_tail(tmp_path):
