@@ -709,6 +709,211 @@ def main() -> int:
             and os.environ.get("DECODE_ENGINE", "1") != "0":
         guarded("fleet_scaling_rel", fleet_rows)
 
+    # Fleet ops rows (round 18, DESIGN.md section 24): the trace
+    # spine's overhead discipline and the process transport's measured
+    # RPC cost. (a) tracing-on/off: the SAME 2-replica fleet + workload
+    # with and without telemetry (trace ids, span/request records, the
+    # status doc) — tokens/s ratio asserted >= 0.95 AND compile counts
+    # asserted EQUAL (the spine is host metadata; a compiled program
+    # never sees a trace id). (b) fleet_rpc_*: 2 engine WORKER
+    # PROCESSES driven over the socket protocol; every response
+    # piggybacks its worker-side handle duration, so per-op overhead =
+    # router-side call wall minus worker-side handle — the socket +
+    # JSON marshal + router dwell a real transport pays — plus the
+    # heartbeat RTT percentiles off real pings.
+    def fleet_ops_rows():
+        import gc
+        import tempfile
+
+        import numpy as np
+
+        from distributed_llm_code_samples_tpu.decode import (
+            DecodeEngine, EngineConfig, FleetRouter)
+        from distributed_llm_code_samples_tpu.runtime.telemetry import (
+            TelemetryWriter)
+
+        block = int(os.environ.get("BENCH_ENGINE_BLOCK", 16))
+        # the row prices TRACING, not the workload shape — its own
+        # params are sized (the prefix-row precedent) so one engine
+        # round costs ~20 ms on CPU, the scale where a fixed ~0.1 ms
+        # of per-round host telemetry reads as the share it would be
+        # in production, not as 30% of a 1.5 ms microbenchmark round
+        ops_d = int(os.environ.get("BENCH_FLEET_OPS_D", 512))
+        ops_t0, ops_new, slots = 8, 16, 4
+        ops_params = init_lm(jax.random.PRNGKey(4), V, ops_d, L,
+                             ops_t0 + ops_new)
+        mbps = -(-(ops_t0 + ops_new) // block)
+        rng = np.random.default_rng(9)
+        ops_prompts = [rng.integers(0, V, size=ops_t0).tolist()
+                       for _ in range(4 * slots)]
+
+        def cfg_kw():
+            return dict(
+                block_size=block, n_blocks=1 + slots * mbps,
+                max_slots=slots, max_blocks_per_seq=mbps,
+                prefill_chunk=8, kv_dtype="f32")
+
+        def lane(traced):
+            writers = []
+            mdir = tempfile.mkdtemp(prefix="bench_trace_")
+
+            def mk(eid):
+                m = None
+                if traced:
+                    m = TelemetryWriter(os.path.join(mdir, eid))
+                    writers.append(m)
+                return DecodeEngine(ops_params, H,
+                                    EngineConfig(**cfg_kw()),
+                                    metrics=m)
+
+            rm = None
+            if traced:
+                rm = TelemetryWriter(os.path.join(mdir, "router"))
+                writers.append(rm)
+            fl = FleetRouter(mk, 2, metrics=rm)
+            # warm wave: every program compiles before the timed wave,
+            # identically in both lanes
+            for p in ops_prompts[:2]:
+                fl.submit(p, ops_new)
+            fl.run()
+            before = sum(h.engine.tokens_generated for h in fl.handles)
+            for p in ops_prompts:
+                fl.submit([min(t + 1, V - 1) for t in p], ops_new)
+            # per-round wall times, stepped by hand: tokens per round
+            # are IDENTICAL across lanes (same workload, token-identity
+            # by construction), so throughput ratio == round-time
+            # ratio, measured on the median with the GC parked — a
+            # collection pause landing in one lane must not masquerade
+            # as tracing cost (the 1/s-throttled status fsync is
+            # likewise one round of ~35, invisible to the median)
+            rounds = []
+            gc.collect()
+            gc.disable()
+            try:
+                while fl.has_work:
+                    t0 = time.perf_counter()
+                    fl.step()
+                    rounds.append(time.perf_counter() - t0)
+            finally:
+                gc.enable()
+            for h in fl.handles:
+                h.emit_decode()     # the cadence record per engine
+            tokens = sum(h.engine.tokens_generated
+                         for h in fl.handles) - before
+            compiles = sum(h.engine.compile_count for h in fl.handles)
+            for w in writers:
+                w.close()
+            return (float(np.median(np.asarray(rounds))), tokens,
+                    compiles)
+
+        # interleaved best-of-three per lane: container jitter still
+        # swings whole-lane medians ~10% run to run — the ratio
+        # compares each lane's BEST median round time (both lanes get
+        # the same chance at a quiet run; the repo's _throughput
+        # best-rep stance)
+        offs, ons = [], []
+        compiles_off = compiles_on = None
+        for _ in range(3):
+            med, tokens_off, compiles_off = lane(False)
+            offs.append(med)
+            med, tokens_on, compiles_on = lane(True)
+            ons.append(med)
+        if tokens_on != tokens_off:
+            raise RuntimeError(
+                f"traced lane generated {tokens_on} token(s) vs "
+                f"{tokens_off} untraced — the lanes drifted")
+        if compiles_on != compiles_off:
+            raise RuntimeError(
+                f"tracing changed the compiled surface: {compiles_on} "
+                f"vs {compiles_off} programs — the spine must stay "
+                "host-side")
+        ratio = round(min(offs) / min(ons), 3)
+        if ratio < 0.95:
+            raise RuntimeError(
+                f"tracing-on throughput is {ratio}x of tracing-off "
+                "(< 0.95): the trace spine costs more than the "
+                "overhead bound allows")
+        paths["fleet_tracing_tokens_ratio"] = ratio
+        paths["fleet_tracing_round_ms"] = {
+            "off_median": round(min(offs) * 1e3, 3),
+            "on_median": round(min(ons) * 1e3, 3),
+        }
+        paths["fleet_tracing_note"] = (
+            f"{len(ops_prompts)}-request wave through a 2-replica "
+            "fleet, telemetry on (trace ids + span/request/decode "
+            "records + fleet records + status doc) vs off: tokens per "
+            "round are identical by construction, so the >= 0.95 "
+            "throughput bound is asserted on the best median round "
+            "wall time of 3 interleaved runs per lane, with "
+            f"IDENTICAL compile counts ({compiles_on} programs both "
+            "lanes)")
+
+        # (b) the process-transport RPC rows
+        from distributed_llm_code_samples_tpu.decode.worker import (
+            spawn_fleet_handles)
+        model = {"vocab": V, "model_size": ops_d, "layers": L,
+                 "heads": H, "kv_heads": None,
+                 "max_seq_len": ops_t0 + ops_new, "random_seed": 4}
+        spool = tempfile.mkdtemp(prefix="bench_rpc_")
+        # the workers are fresh processes: BENCH_PLATFORM only pinned
+        # THIS process's jax — export it as JAX_PLATFORMS or a cpu
+        # bench's workers would initialize the real backend
+        wenv = dict(os.environ)
+        if os.environ.get("BENCH_PLATFORM"):
+            wenv["JAX_PLATFORMS"] = os.environ["BENCH_PLATFORM"]
+        handles = spawn_fleet_handles(2, 0, spool, model=model,
+                                      config=cfg_kw(), policy={},
+                                      env=wenv)
+        fl = FleetRouter(None, 2, handles=handles)
+        try:
+            for p in ops_prompts:
+                fl.submit(p, ops_new)
+            fl.run()
+            for _ in range(16):     # real heartbeat round-trips
+                for h in handles:
+                    h.ping()
+            stats = {h.id: h.rpc_stats() for h in handles}
+        finally:
+            fl.close()
+        pooled_over = []
+        pooled_call = []
+        hb = []
+        for st in stats.values():
+            for op, o in st["ops"].items():
+                if "overhead_p50_ms" in o:
+                    pooled_over.append((o["overhead_p50_ms"],
+                                        o["overhead_p99_ms"], o["n"]))
+                pooled_call.append((op, o["call_p50_ms"], o["n"]))
+            if st.get("heartbeat_rtt_p50_ms") is not None:
+                hb.append((st["heartbeat_rtt_p50_ms"],
+                           st["heartbeat_rtt_p99_ms"]))
+        if not pooled_over or not hb:
+            raise RuntimeError("process fleet produced no RPC/"
+                               "heartbeat samples — nothing to price")
+        # weighted-by-count medians across workers would overfit the
+        # smoke; report the worst worker (the tail is what matters)
+        paths["fleet_rpc_overhead_p50_ms"] = round(
+            max(p50 for p50, _p99, _n in pooled_over), 3)
+        paths["fleet_rpc_overhead_p99_ms"] = round(
+            max(p99 for _p50, p99, _n in pooled_over), 3)
+        paths["fleet_rpc_heartbeat_rtt_p50_ms"] = round(
+            max(p50 for p50, _ in hb), 3)
+        paths["fleet_rpc_heartbeat_rtt_p99_ms"] = round(
+            max(p99 for _, p99 in hb), 3)
+        paths["fleet_rpc_per_engine"] = stats
+        paths["fleet_rpc_note"] = (
+            "2 engine worker processes over AF_UNIX newline-JSON: "
+            "overhead = router-side call wall minus the worker-side "
+            "handle duration piggybacked on every response (socket + "
+            "marshal + router dwell; worst worker reported), "
+            "heartbeat RTT from real pings. Per-op detail in "
+            "fleet_rpc_per_engine; the same numbers land on the "
+            "router stream as a transport_stats event in live runs.")
+
+    if not tp_only and os.environ.get("DECODE_FLEET", "1") != "0" \
+            and os.environ.get("DECODE_ENGINE", "1") != "0":
+        guarded("fleet_rpc_overhead_p50_ms", fleet_ops_rows)
+
     # TP decode scaling on the fake-8-device CPU mesh: subprocesses
     # (fresh backend each — the current process is pinned to its
     # platform) run ONLY the tp path at tiny shape over mesh 1/2/4/8.

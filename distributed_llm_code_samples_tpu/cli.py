@@ -258,6 +258,11 @@ def main(argv=None) -> int:
         # KV engine (decode/engine.py), same dispatch pattern as report
         from .decode.generate_cli import generate_main
         return generate_main(argv[1:])
+    if argv and argv[0] == "fleetstat":
+        # live ops plane: render the router's atomic fleet status doc
+        # (jax-free — the operator's terminal pays no backend import)
+        from .fleetstat import fleetstat_main
+        return fleetstat_main(argv[1:])
     p = build_parser()
     args = p.parse_args(argv)
     if args.mixed and args.pallas:

@@ -212,8 +212,12 @@ REQUEST_EVENTS = ("admitted", "preempted", "retried", "quarantined",
 # migrated request decodes on its pinned weights even on a target
 # already serving newer ones, so the importing engine must HOLD the
 # pinned version (the rolling deploy's double-buffer guarantees it)
-# and its fingerprint must match (DESIGN.md section 23).
-HANDOFF_VERSION = 4
+# and its fingerprint must match (DESIGN.md section 23). v5 (round
+# 18): the document carries the sequence's ``trace_id`` — the causal
+# identity minted once at admission (schema v12) — so a migrated
+# request's records on the TARGET engine stitch into the same
+# cross-process trace waterfall (DESIGN.md section 24).
+HANDOFF_VERSION = 5
 
 # EngineConfig keys two engines may legitimately disagree on and still
 # exchange sequences: pool SIZE is an engine-local capacity choice.
@@ -385,6 +389,13 @@ class _Seq:
     # preemption, migration, and crash-resume — the hot-swap identity
     # contract (DESIGN.md section 23)
     weights_version: int | None = None
+    # the causal identity (round 18, schema v12): minted ONCE at
+    # submit (by the fleet router, or by the engine itself when no
+    # router fronts it) and carried verbatim through replay,
+    # preemption, quarantine, migration (handoff doc v5), crash-resume
+    # (snapshot v7), and version pins — the stitch key every
+    # request/span/router record for this sequence pins
+    trace_id: str | None = None
 
     @property
     def prompt_done(self) -> bool:
@@ -477,6 +488,16 @@ class DecodeEngine:
         # attribution (telemetry v11: every request record carries
         # ``weights_version``); kept like prompt_lens, per uid
         self._pins: dict[int, int | None] = {}
+        # -- fleet trace spine (round 18, DESIGN.md section 24) --
+        # uid -> trace_id: the causal identity every request/span
+        # record for the uid pins (schema v12). The engine mints one
+        # at submit when the caller (a fleet router) didn't — the
+        # nonce makes ids unique across engines/processes, the uid
+        # suffix makes them unique within a run. Host metadata only:
+        # no compiled program ever sees a trace id (the zero-new-
+        # compiles overhead contract).
+        self._trace_nonce = os.urandom(4).hex()
+        self._traces: dict[int, str] = {}
         self.pool = self._init_pool()
         s, mb = cfg.max_slots, cfg.max_blocks_per_seq
         self.tables = np.full((s, mb), SCRATCH_BLOCK, np.int32)
@@ -519,7 +540,9 @@ class DecodeEngine:
         # -- serving observability (round 11, DESIGN.md section 17) --
         # per-request lifecycle spans; the writer is looked up lazily
         # because run(metrics=...) re-binds it after construction
-        self.tracer = SpanTracer(lambda: self.metrics)
+        # (trace_fn: every span record pins the uid's trace_id)
+        self.tracer = SpanTracer(lambda: self.metrics,
+                                 trace_fn=self._traces.get)
         # KV-pool churn (cumulative; snapshot-persisted so they stay
         # monotonic across crash-resume) + free-block watermark window
         # (min/max since the last decode record)
@@ -1053,6 +1076,9 @@ class DecodeEngine:
             "model": self.model_meta(seq.weights_version),
             "config": dataclasses.asdict(self.cfg),
             "uid": int(seq.uid),
+            # the causal identity travels (v5): the target's records
+            # stitch into the same trace waterfall
+            "trace_id": seq.trace_id,
             "prompt": list(seq.prompt),
             "out": list(seq.out),
             "max_new": int(seq.max_new),
@@ -1165,8 +1191,11 @@ class DecodeEngine:
                    out=[int(t) for t in doc["out"]],
                    retries=int(doc["retries"]),
                    submit_step=self.global_step,
-                   weights_version=ver)
+                   weights_version=ver,
+                   trace_id=(doc.get("trace_id")
+                             or f"{self._trace_nonce}-{uid}"))
         self._pins[uid] = ver
+        self._traces[uid] = seq.trace_id
         seq.emitted = int(doc["emitted"])
         seq.t_submit = float(doc["t_submit"])
         seq.prefilled = len(prompt)
@@ -1235,14 +1264,19 @@ class DecodeEngine:
                 "retries": int(seq.retries),
                 "t_submit": float(seq.t_submit),
                 "t_first": self.tracer.pop_first_token(uid),
-                "weights_version": seq.weights_version}
+                "weights_version": seq.weights_version,
+                "trace_id": seq.trace_id}
 
     # -- scheduler -----------------------------------------------------
 
-    def submit(self, prompt, max_new: int, uid: int | None = None) -> int:
+    def submit(self, prompt, max_new: int, uid: int | None = None,
+               trace: str | None = None) -> int:
         """Queue one request. ``prompt`` is a list of token ids; the
         capacity checks run here so an impossible request fails at
-        submit time, never mid-serve."""
+        submit time, never mid-serve. ``trace`` is the caller-minted
+        trace id (the fleet router mints at fleet admission); None
+        mints one here — either way the id sticks to the uid for the
+        request's whole cross-engine life (schema v12)."""
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
@@ -1302,7 +1336,10 @@ class DecodeEngine:
         self.prompt_lens[uid] = len(prompt)
         self._pins.setdefault(uid, None)    # pinned at first admission
         seq = _Seq(uid=uid, prompt=prompt, max_new=max_new,
-                   submit_step=self.global_step)
+                   submit_step=self.global_step,
+                   trace_id=(trace if trace is not None
+                             else f"{self._trace_nonce}-{uid}"))
+        self._traces[uid] = seq.trace_id
         self.waiting.append(seq)
         # the queued span opens at t_submit — the same clock latency_s
         # measures from, so the waterfall's span sum reconciles with it
@@ -1312,7 +1349,8 @@ class DecodeEngine:
     def resume_request(self, uid: int, prompt, max_new: int, out=(),
                        retries: int = 0, t_submit=None,
                        submit_step=None, t_first=None,
-                       weights_version=None) -> int:
+                       weights_version=None,
+                       trace: str | None = None) -> int:
         """Re-enter a request from an engine snapshot
         (``decode/supervise.py``): queued for replay-resume — prompt
         re-prefilled, recorded ``out`` tokens teacher-forced, then live
@@ -1337,8 +1375,14 @@ class DecodeEngine:
                    submit_step=(self.global_step if submit_step is None
                                 else int(submit_step)),
                    weights_version=(None if weights_version is None
-                                    else int(weights_version)))
+                                    else int(weights_version)),
+                   # trace carries the causal identity across the
+                   # resume (snapshot v7 / the caller's book persisted
+                   # it); None mints fresh — a pre-v12 entry had none
+                   trace_id=(trace if trace is not None
+                             else f"{self._trace_nonce}-{int(uid)}"))
         self._pins[int(uid)] = seq.weights_version
+        self._traces[int(uid)] = seq.trace_id
         if t_submit is not None:
             seq.t_submit = float(t_submit)
         if t_first is not None:
@@ -1373,10 +1417,12 @@ class DecodeEngine:
         # telemetry v11: every request record carries the uid's
         # weights-version pin (None before first admission / for the
         # anonymous rejected uid -1) — the per-version attribution the
-        # mixed-version report reads
+        # mixed-version report reads; v12: and its trace_id (None only
+        # for requests that never entered — the anonymous rejected -1)
         rec = {"step": self.global_step, "uid": int(uid),
                "event": event, "reason": reason,
-               "weights_version": self._pins.get(int(uid)), **extra}
+               "weights_version": self._pins.get(int(uid)),
+               "trace_id": self._traces.get(int(uid)), **extra}
         self.request_events.append(rec)
         # the flight recorder's per-step decision line (compact: the
         # digest ring is bounded memory, the durable trail is the

@@ -96,9 +96,12 @@ deploy its lifecycle ``deploy`` records.
 from __future__ import annotations
 
 import collections
+import os
 import time
 
 from ..runtime import wire
+from ..runtime.telemetry import (ROUTER_POSTMORTEM_PREFIX,
+                                 STATUS_FILENAME)
 from ..runtime.wire import WireError
 from .engine import AdmissionError, DecodeEngine
 from .supervise import snapshot_state
@@ -229,12 +232,15 @@ class EngineHandle:
         round-trips, the flag ignored there — cached is cached).
         ``light=True`` skips the per-slot list for the hot-path scalar
         reads (load keys, capacity probes, fleet records) — the O(1)
-        admission-path discipline."""
+        admission-path discipline. ``tokens_generated`` rides every
+        digest (one int) so the live status doc's last-interval
+        throughput costs zero extra round-trips."""
         e = self.engine
         d = {
             "waiting": len(e.waiting),
             "active": e.active,
             "serving_version": e.serving_version,
+            "tokens_generated": e.tokens_generated,
             "free_slots": sum(1 for s in e.slots if s is None),
             "free_blocks": len(e.free_blocks),
             "evictable": (e.prefix.evictable_blocks()
@@ -272,11 +278,13 @@ class EngineHandle:
 
     # -- scheduling ----------------------------------------------------
 
-    def submit(self, prompt, max_new: int, uid: int) -> dict:
+    def submit(self, prompt, max_new: int, uid: int,
+               trace: str | None = None) -> dict:
         """Submit; returns the WAITING snapshot entry for the router's
         O(1) snapshot-append discipline (raises ``AdmissionError`` on a
-        full queue — the caller's spillover path)."""
-        self.engine.submit(prompt, max_new, uid=uid)
+        full queue — the caller's spillover path). ``trace`` is the
+        router-minted trace id the engine records verbatim."""
+        self.engine.submit(prompt, max_new, uid=uid, trace=trace)
         seq = next(s for s in reversed(self.engine.waiting)
                    if s.uid == uid)
         return {"uid": seq.uid, "prompt": seq.prompt, "out": seq.out,
@@ -285,15 +293,18 @@ class EngineHandle:
                 "submit_step": seq.submit_step,
                 "t_first": None,       # no first token yet
                 "weights_version": None,   # pins at admission
+                "trace_id": seq.trace_id,
                 "state": "WAITING"}
 
     def resume_request(self, uid: int, prompt, max_new: int, *, out=(),
                        retries: int = 0, t_submit=None,
-                       t_first=None, weights_version=None) -> None:
+                       t_first=None, weights_version=None,
+                       trace=None) -> None:
         self.engine.resume_request(uid, prompt, max_new, out=out,
                                    retries=retries, t_submit=t_submit,
                                    t_first=t_first,
-                                   weights_version=weights_version)
+                                   weights_version=weights_version,
+                                   trace=trace)
 
     def release_request(self, uid: int) -> dict:
         """The drain primitive's replay half (rolling deploy): pop one
@@ -395,6 +406,31 @@ class EngineHandle:
         self.last_tokens = self.engine.tokens_generated
         self.last_t = now
 
+    # -- transport attribution (round 18, DESIGN.md section 24) --------
+
+    def rpc_stats(self) -> dict | None:
+        """Per-op RPC cost attribution — None in-process: a method
+        call has no socket, no marshal, no deadline, so reporting
+        zeros would masquerade as a measured transport."""
+        return None
+
+    def evidence(self) -> dict:
+        """The router-side view of this member for a dead-host
+        postmortem: what the router knew when it declared death. The
+        in-process handle has no call/backoff history (calls are
+        plain method calls) — the last snapshot summary is the
+        evidence."""
+        snap = self.snapshot
+        return {
+            "transport": self.transport,
+            "alive": self.alive,
+            "last_snapshot_step": (None if snap is None
+                                   else snap.get("step")),
+            "last_snapshot_requests": (None if snap is None
+                                       else len(snap.get("requests",
+                                                         ()))),
+        }
+
     # -- liveness ------------------------------------------------------
 
     def ping(self) -> None:
@@ -450,7 +486,8 @@ class FleetRouter:
                  snapshot_every: int = 1, session_affinity: bool = True,
                  prefix_affinity: bool = True, wire_dir: str | None = None,
                  handles: list | None = None, fleet_chaos=None,
-                 keep_rejected: int = 8):
+                 keep_rejected: int = 8, status_dir: str | None = None,
+                 status_every_s: float = 1.0):
         if n_engines < 1:
             raise ValueError(f"n_engines must be >= 1, got {n_engines}")
         if not 0 <= prefill_engines < n_engines:
@@ -576,6 +613,30 @@ class FleetRouter:
         # armed by corrupt_deploy chaos: the truncation fraction to
         # apply to the NEXT deploy's target checkpoint (None = off)
         self._corrupt_next_deploy: float | None = None
+        # -- fleet trace spine + live ops plane (round 18, DESIGN.md
+        # section 24) --
+        # the router mints every request's fleet-unique trace id at
+        # admission (host metadata only — no compiled program, no
+        # extra round-trip); the nonce disambiguates routers across
+        # processes/runs, the uid suffix within a run
+        self._trace_nonce = os.urandom(4).hex()
+        # live status doc: one atomic JSON per round via
+        # wire.publish_json, throttled like the PR 12 spool snapshot
+        # (the drain-end publish is forced so a finished run's doc is
+        # always final). status_dir None (and no metrics writer) =
+        # publishing off.
+        if status_dir is None and metrics is not None:
+            status_dir = os.path.dirname(metrics.path)
+        self.status_dir = status_dir
+        if status_every_s <= 0:
+            raise ValueError(f"status_every_s must be > 0, got "
+                             f"{status_every_s}")
+        self.status_every_s = status_every_s
+        self._status_t_last = 0.0       # monotonic: last publish
+        self._status_tokens_last = 0    # fleet tokens at last publish
+        self._status_wall_last: float | None = None
+        # round wall clock (the denominator of the RPC overhead share)
+        self.round_wall_s = 0.0
 
     # -- introspection -------------------------------------------------
 
@@ -601,13 +662,20 @@ class FleetRouter:
     # -- telemetry -----------------------------------------------------
 
     def _record(self, event: str, uid: int, source=None, target=None,
-                reason=None, policy=None, **extra) -> None:
+                reason=None, policy=None, trace_id=None,
+                **extra) -> None:
         if self.metrics is None:
             return
+        if trace_id is None:
+            # every router record pins the request's trace id (v12);
+            # callers on the shed path pass it explicitly — the
+            # request book never learned a shed uid
+            trace_id = self.requests.get(int(uid), {}).get("trace")
         self.metrics.router({"step": self.rounds, "uid": int(uid),
                              "event": event, "source": source,
                              "target": target, "reason": reason,
-                             "policy": policy, **extra})
+                             "policy": policy, "trace_id": trace_id,
+                             **extra})
 
     def _event(self, record: dict) -> None:
         if self.metrics is not None:
@@ -661,6 +729,137 @@ class FleetRouter:
             imb = round((max(loads) - min(loads)) / max(loads), 4)
         return {"step": self.rounds, "engines": engines,
                 "load_imbalance": imb}
+
+    # -- live ops plane (round 18, DESIGN.md section 24) ---------------
+
+    def status_doc(self) -> dict:
+        """The live fleet status document: one atomic, self-contained
+        JSON snapshot of what an operator needs mid-run — per-engine
+        liveness/role/serving-version/queue-depth/pool watermarks,
+        deploy state, decision counters, and the throughput since the
+        last publish. Built from the light digests (cached under the
+        process transport — reading status never adds a round-trip)."""
+        engines = {}
+        tokens = 0
+        for h in self.handles:
+            if not h.alive:
+                engines[h.id] = {"alive": False,
+                                 "killed_at_round": h.killed_at_round}
+                continue
+            d = h.digest(light=True)
+            tokens += int(d.get("tokens_generated") or 0)
+            engines[h.id] = {
+                "alive": True, "role": h.role,
+                "serving_version": int(d["serving_version"]),
+                "waiting": d["waiting"], "active": d["active"],
+                "free_slots": d["free_slots"],
+                "free_blocks": d["free_blocks"],
+                "evictable_blocks": d["evictable"],
+                "utilization": round(d["utilization"], 4),
+                "last_step_s": round(h.last_step_s, 6),
+            }
+        # the interval baseline is CONSUMED by _publish_status only —
+        # an out-of-band status_doc() read (tests, an in-process
+        # consumer) must not shorten the next published interval
+        now = time.perf_counter()
+        tps = None
+        if self._status_wall_last is not None:
+            dt = now - self._status_wall_last
+            delta = tokens - self._status_tokens_last
+            if dt > 0 and delta > 0:
+                tps = round(delta / dt, 2)
+        drained = all(not e.get("waiting") and not e.get("active")
+                      for e in engines.values() if e.get("alive"))
+        return {
+            "version": 1,
+            "t": time.time(),
+            "round": self.rounds,
+            "drained": drained,
+            "engines": engines,
+            "tokens_generated": tokens,
+            "tokens_per_sec_last_interval": tps,
+            "deploy": {
+                "scheduled_rounds": sorted(self._deploys),
+                "deploys": self.deploys,
+                "rollbacks": self.deploy_rollbacks,
+            },
+            "counters": {
+                "routed": self.routed, "handoffs": self.handoffs,
+                "migrations": self.migrations, "sheds": self.sheds,
+                "kills": self.kills,
+                "wire_rejects": self.wire_rejects,
+            },
+        }
+
+    def _publish_status(self, force: bool = False) -> str | None:
+        """Publish the status doc atomically (``wire.publish_json`` —
+        a reader mid-drill sees the old doc or the new one, never a
+        torn one), throttled to ``status_every_s`` like the PR 12
+        spool snapshot: the ops plane must not put per-round fsyncs on
+        the hot path. ``force`` (the drain-end publish) skips the
+        throttle so a finished run's doc is final."""
+        if self.status_dir is None:
+            return None
+        now = time.monotonic()
+        if not force and now - self._status_t_last < self.status_every_s:
+            return None
+        self._status_t_last = now
+        doc = self.status_doc()
+        # consume the throughput-interval baseline HERE (the one
+        # production caller): the next doc's tokens_per_sec covers
+        # publish-to-publish exactly
+        self._status_wall_last = time.perf_counter()
+        self._status_tokens_last = doc["tokens_generated"]
+        os.makedirs(self.status_dir, exist_ok=True)
+        return wire.publish_json(
+            os.path.join(self.status_dir, STATUS_FILENAME), doc)
+
+    def transport_stats(self) -> dict:
+        """Per-worker RPC cost attribution (the process transport's
+        measured overhead; in-process members report None — a method
+        call has no transport to price): per-op call/handle duration
+        percentiles, per-op overhead (router-side call minus
+        worker-side handle = socket + JSON marshal), heartbeat RTTs,
+        and the round wall clock the overhead share is computed
+        against (``report``'s transport block)."""
+        return {
+            "round_wall_s": round(self.round_wall_s, 6),
+            "rounds": self.rounds,
+            "engines": {h.id: h.rpc_stats() for h in self.handles},
+        }
+
+    def emit_transport_stats(self) -> None:
+        """One ``transport_stats`` event record on the router's stream
+        (rides the schema-free event kind; ``report`` folds it into
+        the transport block). Called at drain end by ``run()``; manual
+        step() drivers call it themselves."""
+        stats = self.transport_stats()
+        if any(v for v in stats["engines"].values()):
+            self._event({"event": "transport_stats", **stats})
+
+    def _dump_router_postmortem(self, h, reason: str) -> str | None:
+        """Atomically dump the router's own evidence on a dead-host
+        declaration: the dying worker's flight recorder dies with the
+        process, but the router still holds the last digests, the
+        pending call ids, the per-op/backoff/ping history, and the
+        declaration reason — published per engine
+        (``router_postmortem_<id>.json`` next to the status doc /
+        router stream) and rendered by ``report --postmortem``."""
+        if self.status_dir is None:
+            return None
+        doc = {
+            "version": 1,
+            "engine": h.id,
+            "round": self.rounds,
+            "t": time.time(),
+            "reason": reason,
+            "evidence": h.evidence(),
+        }
+        os.makedirs(self.status_dir, exist_ok=True)
+        return wire.publish_json(
+            os.path.join(self.status_dir,
+                         f"{ROUTER_POSTMORTEM_PREFIX}{h.id}.json"),
+            doc)
 
     # -- routing -------------------------------------------------------
 
@@ -729,6 +928,12 @@ class FleetRouter:
         uid = self._next_uid
         self._next_uid += 1
         prompt = [int(t) for t in prompt]
+        # the trace spine's mint point (schema v12): ONE fleet-unique
+        # causal identity per admission, consumed like the uid whether
+        # the request lands or sheds — it rides the engine submit, all
+        # downstream request/span records, every router record, the
+        # handoff doc (v5), and the snapshots (v7)
+        trace = f"{self._trace_nonce}-{uid}"
         reason, hit_blocks = None, 0
         prefills = self.alive_handles("prefill")
         # decision attribution (schema v9): the per-engine scores this
@@ -760,7 +965,7 @@ class FleetRouter:
         spilled = False
         for h in order:
             try:
-                entry = h.submit(prompt, max_new, uid=uid)
+                entry = h.submit(prompt, max_new, uid=uid, trace=trace)
             except AdmissionError:
                 shed_reasons.append(f"{h.id}: queue_full")
                 # spillover loses affinity — including the warm-block
@@ -771,7 +976,8 @@ class FleetRouter:
                 spilled = True
                 continue
             self.requests[uid] = {"prompt": prompt, "max_new": max_new,
-                                  "engine": h.id, "session": session}
+                                  "engine": h.id, "session": session,
+                                  "trace": trace}
             if session is not None and h.role == "decode":
                 self._sessions[session] = h.id
             self.routed += 1
@@ -801,7 +1007,7 @@ class FleetRouter:
                 h.snapshot["requests"].append(entry)
             return uid
         self.sheds += 1
-        self._record("shed", uid, reason="queue_full")
+        self._record("shed", uid, reason="queue_full", trace_id=trace)
         raise AdmissionError(
             f"every fleet engine shed request uid {uid}: "
             f"[{'; '.join(shed_reasons)}]")
@@ -857,7 +1063,19 @@ class FleetRouter:
         before the round continues) — heartbeat-ping the idle members,
         ship completed prefills to the decode tier, relieve pool
         pressure by migration, then refresh the router-held snapshots
-        on cadence. Returns whether any engine ran work this round."""
+        on cadence. Returns whether any engine ran work this round.
+
+        The round's wall clock accumulates in ``round_wall_s`` (the
+        denominator of the RPC overhead share) and the live status doc
+        publishes at round end, throttled (DESIGN.md section 24)."""
+        t0 = time.perf_counter()
+        try:
+            return self._step_round()
+        finally:
+            self.round_wall_s += time.perf_counter() - t0
+            self._publish_status()
+
+    def _step_round(self) -> bool:
         did = self._fire_fleet_chaos()
         killed = bool(self._kills.get(self.rounds))
         for eid in self._kills.pop(self.rounds, ()):
@@ -1010,12 +1228,15 @@ class FleetRouter:
                                 t_submit=entry.get("t_submit"),
                                 t_first=entry.get("t_first"),
                                 weights_version=entry.get(
-                                    "weights_version"))
+                                    "weights_version"),
+                                trace=entry.get("trace_id",
+                                                req.get("trace")))
             replay = len(entry["out"])
         else:
             # no snapshot entry (a submit-then-immediate-move corner):
             # replay from the request book — more catch-up, same tokens
-            dest.resume_request(uid, req["prompt"], req["max_new"])
+            dest.resume_request(uid, req["prompt"], req["max_new"],
+                                trace=req.get("trace"))
             replay = 0
         dur = time.perf_counter() - t0
         req["engine"] = dest.id
@@ -1149,6 +1370,11 @@ class FleetRouter:
         self._event({"event": "worker_dead", "engine": h.id,
                      "round": self.rounds,
                      "reason": f"{type(err).__name__}: {err}"})
+        # the router's OWN evidence, dumped BEFORE the SIGKILL closes
+        # the book: the dead worker's flight recorder died with it —
+        # this is the half of the post-mortem only the router holds
+        self._dump_router_postmortem(
+            h, f"{type(err).__name__}: {err}")
         h.kill()
         h.killed_at_round = self.rounds
         self.kills += 1
@@ -1172,6 +1398,10 @@ class FleetRouter:
             raise ValueError(f"unknown engine id {engine_id!r}")
         if not h.alive:
             return 0
+        # same evidence discipline as the liveness-ladder death: the
+        # worker's own flight recorder is about to become unreachable
+        self._dump_router_postmortem(h, "engine killed (scheduled "
+                                        "kill / chaos)")
         h.kill()
         h.killed_at_round = self.rounds
         self.kills += 1
@@ -1207,7 +1437,9 @@ class FleetRouter:
                 out=req["out"], retries=req["retries"],
                 t_submit=req.get("t_submit"),
                 t_first=req.get("t_first"),
-                weights_version=req.get("weights_version"))
+                weights_version=req.get("weights_version"),
+                trace=req.get("trace_id", self.requests.get(
+                    int(req["uid"]), {}).get("trace")))
             dur = time.perf_counter() - t0
             self.requests[int(req["uid"])]["engine"] = target.id
             # a replay-migration ships no KV (the dead pool is
@@ -1484,7 +1716,8 @@ class FleetRouter:
                 out=entry["out"], retries=entry["retries"],
                 t_submit=entry.get("t_submit"),
                 t_first=entry.get("t_first"),
-                weights_version=entry.get("weights_version"))
+                weights_version=entry.get("weights_version"),
+                trace=entry.get("trace_id"))
             dur = time.perf_counter() - t1
             self.migrations += 1
             book = self.requests[uid]
@@ -1532,6 +1765,11 @@ class FleetRouter:
                     "fleet stalled: waiting requests but no engine ran "
                     "work and no kill is pending")
         self._emit_decode_records()
+        # drain-end ops-plane flush: the transport block lands on the
+        # router stream and the status doc publishes FINAL (forced
+        # past the throttle — a finished run's doc must say drained)
+        self.emit_transport_stats()
+        self._publish_status(force=True)
         return self.results()
 
     def _emit_decode_records(self) -> None:
@@ -1599,6 +1837,10 @@ class FleetRouter:
             # deploys and CRC/mid-roll rollbacks
             "deploys": self.deploys,
             "deploy_rollbacks": self.deploy_rollbacks,
+            # transport cost attribution (round 18): per-worker RPC
+            # op percentiles + the round wall clock (None per engine
+            # in-process — nothing to price)
+            "transport": self.transport_stats(),
         }
         if self.handoff_durations:
             import numpy as np

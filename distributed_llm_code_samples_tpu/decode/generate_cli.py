@@ -377,6 +377,11 @@ def _fleet_main(args, prompts, cfg, policy, params, fleet_kill,
         "fleet_rounds": stats["rounds"],
         "shed": shed,
     }
+    if args.metrics_dir:
+        # where the live ops plane lives: `fleetstat <this>` renders
+        # the router's atomic status doc, mid-run or after
+        payload["status_doc"] = os.path.join(args.metrics_dir,
+                                             "router")
     print(_json.dumps(payload))
     return 0
 

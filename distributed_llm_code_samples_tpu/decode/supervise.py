@@ -82,7 +82,12 @@ SNAPSHOT_FILENAME = "engine_snapshot.json"
 # mixed-version engine's snapshot can only restore onto an engine
 # that HOLDS those versions). ``model`` remains the serving version's
 # fingerprint (the pre-v6 readers' key).
-SNAPSHOT_VERSION = 6
+# v7 (round 18): request entries carry ``trace_id`` — the causal
+# identity minted once at admission (schema v12) — so a crash-resumed
+# request's records keep stitching into the SAME cross-process trace
+# waterfall (the crash gap itself stays visibly unaccounted, exactly
+# the ``t_first`` stance).
+SNAPSHOT_VERSION = 7
 
 
 # ---------------------------------------------------------------- snapshot
@@ -113,6 +118,7 @@ def snapshot_state(engine: DecodeEngine) -> dict:
             "t_submit": seq.t_submit, "submit_step": seq.submit_step,
             "t_first": engine.tracer.first_token_t(seq.uid),
             "weights_version": seq.weights_version,
+            "trace_id": seq.trace_id,
             "state": "RUNNING", "slot": slot,
             "position": int(engine.lengths[slot]),
             "prefilled": seq.prefilled,
@@ -126,6 +132,7 @@ def snapshot_state(engine: DecodeEngine) -> dict:
             "t_submit": seq.t_submit, "submit_step": seq.submit_step,
             "t_first": engine.tracer.first_token_t(seq.uid),
             "weights_version": seq.weights_version,
+            "trace_id": seq.trace_id,
             "state": "WAITING",
         })
     snap = {
@@ -289,7 +296,8 @@ def restore_engine_state(engine: DecodeEngine, snap: dict) -> None:
                               t_submit=req.get("t_submit"),
                               submit_step=req.get("submit_step"),
                               t_first=req.get("t_first"),
-                              weights_version=req.get("weights_version"))
+                              weights_version=req.get("weights_version"),
+                              trace=req.get("trace_id"))
     # auto-uid assignment must clear EVERY restored uid, not just the
     # live ones resume_request walked — a fresh submit colliding with a
     # finished uid would sample in lockstep with its twin and overwrite

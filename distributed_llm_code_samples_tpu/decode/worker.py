@@ -52,6 +52,7 @@ the on-disk post-mortem record.
 
 from __future__ import annotations
 
+import collections
 import json
 import os
 import random
@@ -149,7 +150,7 @@ def worker_main(argv=None) -> int:
             return {"digest": hd.digest()}
         if op == "submit":
             entry = hd.submit(req["prompt"], req["max_new"],
-                              uid=req["uid"])
+                              uid=req["uid"], trace=req.get("trace"))
             return {"entry": entry, "digest": hd.digest()}
         if op == "resume":
             hd.resume_request(req["uid"], req["prompt"],
@@ -158,7 +159,8 @@ def worker_main(argv=None) -> int:
                               t_submit=req.get("t_submit"),
                               t_first=req.get("t_first"),
                               weights_version=req.get(
-                                  "weights_version"))
+                                  "weights_version"),
+                              trace=req.get("trace"))
             return {"digest": hd.digest()}
         if op == "release":
             return {"entry": hd.release_request(req["uid"]),
@@ -253,6 +255,12 @@ def worker_main(argv=None) -> int:
                 continue
             req = json.loads(line)
             rid = req.get("id")
+            # worker-side handle duration rides EVERY response (the
+            # digest piggyback stance: zero extra round-trips) — the
+            # router subtracts it from its own call wall clock to get
+            # the pure RPC overhead (socket + JSON marshal), the
+            # round-18 transport attribution
+            t0 = time.perf_counter()
             try:
                 out = handle(req)
                 resp = {"id": rid, "ok": True, **out}
@@ -269,6 +277,7 @@ def worker_main(argv=None) -> int:
                 resp = {"id": rid, "ok": False,
                         "error": f"{type(e).__name__}: {e}",
                         "error_kind": "runtime"}
+            resp["handle_s"] = round(time.perf_counter() - t0, 6)
             hang_s = resp.pop("_hang_after_reply_s", None)
             done = resp.pop("_shutdown", False)
             conn.sendall((json.dumps(resp) + "\n").encode("utf-8"))
@@ -327,6 +336,25 @@ class ProcessEngineHandle:
         # dead-host recovery path interleaves calls to a survivor whose
         # own step is still in flight) — parked here, never dropped
         self._resp_buf: dict[int, dict] = {}
+        # -- RPC cost attribution + postmortem evidence (round 18) --
+        # every in-flight call id maps to (op, send time); a parked
+        # response stamps its receive time at parse, so call duration
+        # = recv - send even when consumed out of order. Per-op
+        # (call_s, handle_s) samples feed rpc_stats(); bounded rings
+        # hold the postmortem evidence (op log, ping RTTs, backoff
+        # sleeps) a dead-host declaration dumps.
+        self._sent: dict[int, tuple[str, float]] = {}
+        self._recv_t: dict[int, float] = {}
+        self.op_samples: dict[str, collections.deque] = {}
+        # unbounded run totals (the overhead-share numerator): the
+        # per-op sample rings are capped at 4096, but round_wall_s on
+        # the router is not — summing the rings would silently
+        # understate the share on a long run
+        self.call_total_s = 0.0
+        self.overhead_total_s = 0.0
+        self.op_log: "collections.deque" = collections.deque(maxlen=64)
+        self.backoff_log: "collections.deque" = collections.deque(
+            maxlen=64)
 
     # -- wire plumbing -------------------------------------------------
 
@@ -369,6 +397,10 @@ class ProcessEngineHandle:
     def _send(self, req: dict) -> int:
         self._next_id += 1
         req = {**req, "id": self._next_id}
+        # stamp the send BEFORE the marshal+sendall so the call
+        # duration prices the full router-side cost of the op
+        self._sent[self._next_id] = (req.get("op", "?"),
+                                     time.perf_counter())
         try:
             self._sock.sendall((json.dumps(req) + "\n").encode("utf-8"))
         except OSError as e:
@@ -408,8 +440,14 @@ class ProcessEngineHandle:
                 line, self._buf = self._buf.split(b"\n", 1)
                 return line
             if attempt < self.call_retries:
-                time.sleep(backoff_delay(attempt, 0.05, 2.0, 0.0,
-                                         random.Random(0)))
+                delay = backoff_delay(attempt, 0.05, 2.0, 0.0,
+                                      random.Random(0))
+                # postmortem evidence: the ladder's own retry history
+                self.backoff_log.append({"t": time.time(),
+                                         "attempt": attempt,
+                                         "backoff_s": round(delay, 3),
+                                         "deadline_s": deadline_s})
+                time.sleep(delay)
         raise TransportTimeout(
             f"worker {self.id} silent past its {deadline_s:.1f}s "
             f"deadline ({self.call_retries + 1} attempt(s) with "
@@ -425,8 +463,27 @@ class ProcessEngineHandle:
                     else deadline_s)
         while rid not in self._resp_buf:
             resp = json.loads(self._recv_line(deadline))
+            # receive time stamped at PARSE, not at consume: a parked
+            # response's call duration must not be charged for the
+            # interleaved work that delayed its pop
+            self._recv_t[resp.get("id")] = time.perf_counter()
             self._resp_buf[resp.get("id")] = resp
         resp = self._resp_buf.pop(rid)
+        sent = self._sent.pop(rid, None)
+        recv_t = self._recv_t.pop(rid, None)
+        if sent is not None and recv_t is not None:
+            op, t0 = sent
+            call_s = recv_t - t0
+            self.op_samples.setdefault(
+                op, collections.deque(maxlen=4096)).append(
+                (call_s, resp.get("handle_s")))
+            self.call_total_s += call_s
+            if resp.get("handle_s") is not None:
+                self.overhead_total_s += call_s - resp["handle_s"]
+            self.op_log.append({"op": op, "id": rid,
+                                "t": round(time.time(), 4),
+                                "call_ms": round(call_s * 1e3, 3),
+                                "ok": bool(resp.get("ok"))})
         if "digest" in resp and rid > self._digest_id:
             # the worker answers in order, so the digest from the
             # HIGHEST response id is the freshest scheduler state —
@@ -488,20 +545,24 @@ class ProcessEngineHandle:
         return self._call("probe", prompt=[int(t) for t in prompt])[
             "warm"]
 
-    def submit(self, prompt, max_new: int, uid: int) -> dict:
+    def submit(self, prompt, max_new: int, uid: int,
+               trace: str | None = None) -> dict:
         return self._call("submit", prompt=[int(t) for t in prompt],
-                          max_new=int(max_new), uid=int(uid))["entry"]
+                          max_new=int(max_new), uid=int(uid),
+                          trace=trace)["entry"]
 
     def resume_request(self, uid: int, prompt, max_new: int, *, out=(),
                        retries: int = 0, t_submit=None,
-                       t_first=None, weights_version=None) -> None:
+                       t_first=None, weights_version=None,
+                       trace=None) -> None:
         self._call("resume", uid=int(uid),
                    prompt=[int(t) for t in prompt],
                    max_new=int(max_new), out=[int(t) for t in out],
                    retries=int(retries), t_submit=t_submit,
                    t_first=t_first,
                    weights_version=(None if weights_version is None
-                                    else int(weights_version)))
+                                    else int(weights_version)),
+                   trace=trace)
 
     def release_request(self, uid: int) -> dict:
         return self._call("release", uid=int(uid))["entry"]
@@ -581,6 +642,79 @@ class ProcessEngineHandle:
 
     def emit_decode(self) -> None:
         self._call("emit_decode")
+
+    # -- transport attribution (round 18, DESIGN.md section 24) --------
+
+    def rpc_stats(self) -> dict | None:
+        """Per-op RPC cost attribution off the recorded samples:
+        router-side call duration percentiles, worker-side handle
+        durations (piggybacked on every response), and their
+        difference — the pure transport overhead (socket + JSON
+        marshal + scheduling). ``ping`` doubles as the heartbeat RTT
+        sample set. None until any call completed."""
+        if not self.op_samples:
+            return None
+
+        def pcts(vals):
+            import numpy as np
+            arr = np.asarray(vals, np.float64) * 1e3
+            return (round(float(np.percentile(arr, 50)), 3),
+                    round(float(np.percentile(arr, 99)), 3))
+
+        ops = {}
+        for op, samples in sorted(self.op_samples.items()):
+            calls = [c for c, _ in samples]
+            overheads = [c - h for c, h in samples if h is not None]
+            p50, p99 = pcts(calls)
+            entry = {"n": len(samples), "call_p50_ms": p50,
+                     "call_p99_ms": p99}
+            if overheads:
+                o50, o99 = pcts(overheads)
+                entry["overhead_p50_ms"] = o50
+                entry["overhead_p99_ms"] = o99
+            ops[op] = entry
+        # totals come from the unbounded accumulators, not the capped
+        # rings — the overhead share must cover the WHOLE run that
+        # round_wall_s covers (percentiles stay over the recent ring)
+        out = {"ops": ops,
+               "call_total_s": round(self.call_total_s, 6),
+               "overhead_total_s": round(self.overhead_total_s, 6)}
+        pings = self.op_samples.get("ping")
+        if pings:
+            p50, p99 = pcts([c for c, _ in pings])
+            out["heartbeat_rtt_p50_ms"] = p50
+            out["heartbeat_rtt_p99_ms"] = p99
+            out["heartbeats"] = len(pings)
+        return out
+
+    def evidence(self) -> dict:
+        """The router-side postmortem evidence for this worker: the
+        last cached digest (and which call delivered it), in-flight
+        call ids, the bounded op/backoff/ping history — everything the
+        router knew at declaration time. The worker's own flight
+        recorder dies with its process; this half survives because the
+        router holds it."""
+        pings = self.op_samples.get("ping") or ()
+        return {
+            "transport": self.transport,
+            "alive": self.alive,
+            "pid": self.proc.pid,
+            "process_rc": self.proc.poll(),
+            "last_digest": self._digest,
+            "last_digest_call_id": self._digest_id,
+            "pending_call_ids": sorted(self._sent),
+            "pending_step": (None if self._pending is None
+                             else self._pending.get("rid")),
+            "op_log": list(self.op_log),
+            "backoff_log": list(self.backoff_log),
+            "ping_rtt_ms": [round(c * 1e3, 3) for c, _ in pings][-16:],
+            "last_snapshot_step": (None if self.snapshot is None
+                                   else self.snapshot.get("step")),
+            "last_snapshot_requests": (
+                None if self.snapshot is None
+                else len(self.snapshot.get("requests", ()))),
+            "log_tail": self._log_tail(),
+        }
 
     # -- liveness ------------------------------------------------------
 
@@ -684,6 +818,14 @@ def _connect_and_prime(h: ProcessEngineHandle, config: dict,
     h._block_size = ec.block_size
     h._max_blocks_per_seq = ec.max_blocks_per_seq
     h._call("digest")
+    # the priming digest's wall clock is the WORKER BOOT (connect
+    # lands in the listen backlog before the jax import; the worker
+    # only answers once its engine exists) — that is spawn cost, not
+    # transport cost, and it must not pollute the per-op RPC
+    # percentiles rpc_stats() reports (the op_log keeps it: boot time
+    # is legitimate postmortem evidence)
+    h.op_samples.clear()
+    h.call_total_s = h.overhead_total_s = 0.0
 
 
 def spawn_worker(eid: str, role: str, base_dir: str, *, model: dict,

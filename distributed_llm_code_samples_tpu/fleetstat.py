@@ -1,0 +1,152 @@
+"""`fleetstat` — the live fleet status surface.
+
+The router publishes ONE atomic JSON status document per scheduling
+round (throttled; ``decode/fleet.py`` via ``wire.publish_json``,
+``runtime/telemetry.py`` ``STATUS_FILENAME``): per-engine liveness,
+role, serving version, queue depth, pool watermarks, deploy state,
+decision counters, and last-interval throughput. This tool renders it
+— once, or as a live tail (``--follow``) that exits when the fleet
+drains. Because the doc only ever REPLACES atomically, a read
+mid-drill (workers being SIGKILLed, deploys mid-roll) sees the old
+document or the new one, never a torn hybrid — the same guarantee the
+checkpoint layer earned in round 6, applied to the ops plane.
+
+Deliberately jax-free (stdlib only): the operator's terminal must not
+pay a backend import to ask "is the fleet alive".
+
+Exit codes: 0 = status rendered (a drained doc under ``--follow``
+ends the tail); 2 = no status document at the given path (or none
+appeared within ``--max_s`` under ``--follow``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from .runtime.telemetry import STATUS_FILENAME
+
+
+def _resolve(path: str) -> str:
+    """DIR (a router metrics dir holding fleet_status.json) or the
+    status file itself."""
+    if os.path.isdir(path):
+        return os.path.join(path, STATUS_FILENAME)
+    return path
+
+
+def _load(path: str) -> dict | None:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except OSError:
+        return None
+    except ValueError:
+        # racing the atomic replace is impossible (rename is atomic);
+        # an unparseable doc is real damage — surface it
+        raise
+
+
+def render(doc: dict) -> str:
+    age = max(0.0, time.time() - float(doc.get("t") or 0.0))
+    state = "DRAINED" if doc.get("drained") else "SERVING"
+    tps = doc.get("tokens_per_sec_last_interval")
+    out = [f"fleet status @ round {doc.get('round')} "
+           f"(age {age:.1f}s) — {state}, "
+           f"{doc.get('tokens_generated')} token(s)"
+           + (f", {tps} tok/s last interval" if tps is not None
+              else "")]
+    for eid, e in sorted((doc.get("engines") or {}).items()):
+        if not e.get("alive"):
+            out.append(f"  {eid:4s} DEAD (killed at round "
+                       f"{e.get('killed_at_round')})")
+            continue
+        out.append(f"  {eid:4s} [{e.get('role')}] v"
+                   f"{e.get('serving_version')}  waiting "
+                   f"{e.get('waiting')}  active {e.get('active')}  "
+                   f"free {e.get('free_blocks')} blk "
+                   f"(+{e.get('evictable_blocks')} evictable)  util "
+                   f"{e.get('utilization')}  last step "
+                   f"{(e.get('last_step_s') or 0.0) * 1e3:.1f} ms")
+    c = doc.get("counters") or {}
+    out.append("  counters: " + ", ".join(
+        f"{k} {c.get(k)}" for k in ("routed", "handoffs", "migrations",
+                                    "sheds", "kills", "wire_rejects")))
+    d = doc.get("deploy") or {}
+    out.append(f"  deploys: {d.get('deploys')} completed, "
+               f"{d.get('rollbacks')} rolled back"
+               + (f", scheduled at round(s) {d['scheduled_rounds']}"
+                  if d.get("scheduled_rounds") else ""))
+    return "\n".join(out)
+
+
+def fleetstat_main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="fleetstat",
+        description="Render the fleet's live status document "
+                    "(published atomically each round by the router "
+                    "next to its metrics stream)")
+    p.add_argument("status",
+                   help="the router's metrics dir (holding "
+                        f"{STATUS_FILENAME}) or the status file "
+                        "itself")
+    p.add_argument("--follow", action="store_true",
+                   help="poll and re-render on change; exit rc 0 when "
+                        "the doc reports the fleet drained")
+    p.add_argument("--interval", type=float, default=0.5,
+                   help="--follow poll cadence in seconds")
+    p.add_argument("--max_s", type=float, default=60.0,
+                   help="--follow gives up after this many seconds "
+                        "(rc 0 if any status was ever rendered, rc 2 "
+                        "if none appeared)")
+    p.add_argument("--json", action="store_true",
+                   help="print the raw status document")
+    args = p.parse_args(argv)
+    if args.interval <= 0 or args.max_s <= 0:
+        print("fleetstat: --interval/--max_s must be > 0",
+              file=sys.stderr)
+        return 2
+    path = _resolve(args.status)
+
+    if not args.follow:
+        doc = _load(path)
+        if doc is None:
+            print(f"fleetstat: no status document at {path} (the "
+                  "router publishes one when built with a metrics "
+                  "dir / status_dir)", file=sys.stderr)
+            return 2
+        print(json.dumps(doc, indent=1) if args.json else render(doc))
+        return 0
+
+    t_start = time.monotonic()
+    last_t = None
+    rendered = False
+    while True:
+        # re-resolve each tick: following a router dir that the run
+        # has not created yet must start rendering once it appears
+        # (resolving once would freeze the dir itself as a file path)
+        path = _resolve(args.status)
+        doc = _load(path)
+        if doc is not None and doc.get("t") != last_t:
+            last_t = doc.get("t")
+            rendered = True
+            print(json.dumps(doc) if args.json else render(doc),
+                  flush=True)
+            if doc.get("drained"):
+                return 0
+        if time.monotonic() - t_start > args.max_s:
+            if rendered:
+                print("fleetstat: --max_s elapsed before the fleet "
+                      "drained — stopping the tail")
+                return 0
+            print(f"fleetstat: no status document appeared at {path} "
+                  f"within {args.max_s:.0f}s", file=sys.stderr)
+            return 2
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(fleetstat_main())

@@ -26,6 +26,27 @@ Inputs (all optional except at least one metrics dir):
   on the MERGED streams so a migrated request's life re-assembles
   across engines; crash-resumed requests render UNRECONCILED, never
   silently as attainment. Malformed specs reject rc 2.
+- ``--trace UID``: ONE request's cross-engine, cross-process causal
+  waterfall (schema v12, DESIGN.md section 24) — every span, router
+  move, and lifecycle event for the uid across the merged streams,
+  stitched by its ``trace_id`` (minted once at admission, carried
+  through migration/replay/crash-resume) instead of uid heuristics,
+  rendered in causal order with per-engine attribution. Wall-clock
+  gaps the spans don't cover are labeled ``migration`` only when a
+  router move record explains them; an unexplained gap renders
+  UNRECONCILED — dead time is never invented into a phase. A
+  non-integer uid (or one no stream knows) rejects rc 2.
+- ``--follow``: tail mode — poll the streams, print NEW timeline
+  entries as they land, and exit rc 0 once the fleet status doc
+  (``fleet_status.json``, published atomically by the router next to
+  its stream) reports the fleet drained — or when ``--follow_max_s``
+  elapses. Works mid-drill: records flush per line and the status doc
+  only ever replaces atomically, so a SIGKILL storm can't tear what
+  the tail reads.
+
+The merged timeline is byte-deterministic: entries sort by
+``(t, stream index, per-stream record order)``, so repeated merges of
+the same dirs render identical output even under equal timestamps.
 
 Output: step-time percentiles, throughput, MFU, HBM high-water, the
 serving summary + reliability block per engine, a per-request
@@ -50,7 +71,8 @@ import sys
 import numpy as np
 
 from .runtime.telemetry import (FLIGHT_FILENAME, METRICS_FILENAME,
-                                read_metrics)
+                                ROUTER_POSTMORTEM_PREFIX,
+                                STATUS_FILENAME, read_metrics)
 
 # a completed request's span durations telescope to its latency by
 # construction (runtime/tracing.py); the tolerance only absorbs the
@@ -530,6 +552,33 @@ class _Stream:
             out[str(uid)] = entry
         return out
 
+    def router_postmortems(self) -> list[dict]:
+        """Router-side dead-host evidence dumps published next to this
+        stream (``decode/fleet.py`` publishes one per declared-dead
+        engine: last digests, pending call ids, op/backoff/ping
+        history, declaration reason — the half of the post-mortem the
+        SIGKILLed worker's own flight recorder cannot hold)."""
+        out = []
+        base = os.path.dirname(self.path)
+        try:
+            names = sorted(os.listdir(base))
+        except OSError:
+            return out
+        for name in names:
+            if not (name.startswith(ROUTER_POSTMORTEM_PREFIX)
+                    and name.endswith(".json")):
+                continue
+            path = os.path.join(base, name)
+            try:
+                with open(path) as f:
+                    doc = json.load(f)
+            except ValueError:
+                doc = {"error": f"unparseable router postmortem at "
+                                f"{path}"}
+            doc["path"] = path
+            out.append(doc)
+        return out
+
     def flight_recorder(self) -> dict | None:
         """The stream's flight-recorder dump, if one was persisted
         (decode/engine.py dumps on quarantine; the supervisor on
@@ -835,6 +884,363 @@ def _slo_accounting(streams, slo_ttft: float, slo_itl: float) -> dict:
         "violations_by_span": by_span,
         "requests": per_uid,
     }
+
+
+def _trace_doc(streams, uid: int) -> dict | None:
+    """ONE request's cross-engine causal waterfall (schema v12,
+    DESIGN.md section 24): every span, router move, and lifecycle
+    event for ``uid`` across the merged streams, stitched by the
+    request's ``trace_id`` (records carrying a DIFFERENT trace id are
+    another life of a reused uid and are excluded — the stitch key is
+    the id, not the uid). Wall-clock gaps the spans don't cover are
+    classified ``migration`` only when a router move record explains
+    them; an unexplained gap renders UNRECONCILED and the whole
+    request is flagged — dead time is never invented into a phase."""
+    reqs, spans, moves = [], [], []
+    for s in streams:
+        for r in s.requests:
+            if r.get("uid") == uid:
+                reqs.append((s.label, r))
+        for sp in s.spans:
+            if sp.get("uid") == uid:
+                spans.append((s.label, sp))
+        for r in s.routers:
+            if r.get("uid") == uid:
+                moves.append((s.label, r))
+    if not (reqs or spans or moves):
+        return None
+    problems = []
+    traces = {r.get("trace_id") for _, r in reqs + spans + moves
+              if r.get("trace_id")}
+    trace_id = None
+    if traces:
+        # the NEWEST life by record timestamp — the nonce prefix is
+        # random and carries no temporal order, so a lexicographic
+        # pick could stitch an old life of a reused uid
+        trace_id = max(
+            (r for _, r in reqs + spans + moves if r.get("trace_id")),
+            key=lambda r: r.get("t", 0.0)).get("trace_id")
+    if len(traces) > 1:
+        problems.append(
+            f"uid {uid} appears under {len(traces)} trace ids "
+            f"{sorted(traces)} — stitching the newest-by-timestamp "
+            f"({trace_id}); an older id is a different request's "
+            "life behind a reused uid")
+    if trace_id is not None:
+        keep = (trace_id, None)
+        reqs = [(l, r) for l, r in reqs if r.get("trace_id") in keep]
+        spans = [(l, r) for l, r in spans if r.get("trace_id") in keep]
+        moves = [(l, r) for l, r in moves if r.get("trace_id") in keep]
+    # spans were already replay-deduped PER STREAM (_Stream); across
+    # streams every span is genuine — two engines can emit spans with
+    # coincident (span, step) windows (fleet rounds keep global steps
+    # comparable), so the dedup key must include the engine or a real
+    # span gets dropped and renders a false UNRECONCILED gap
+    spans_d, seen = [], set()
+    for label, sp in sorted(spans,
+                            key=lambda x: (x[1].get("start_t") or 0.0,
+                                           x[1].get("t") or 0.0)):
+        key = (label, sp.get("span"), sp.get("start_step"),
+               sp.get("step"))
+        if key in seen:
+            continue
+        seen.add(key)
+        spans_d.append((label, sp))
+    moves_sorted = sorted(moves, key=lambda x: x[1].get("t", 0.0))
+    comp = None
+    for _label, r in sorted(reqs, key=lambda x: x[1].get("t", 0.0)):
+        if r["event"] == "completed":
+            comp = r
+            break
+
+    def move_row(label, mr):
+        row = {"type": "move", "event": mr["event"], "t": mr.get("t"),
+               "source": mr.get("source"), "target": mr.get("target"),
+               "reason": mr.get("reason"), "round": mr.get("step")}
+        for k in ("blocks", "bytes", "duration_s", "replay",
+                  "transport", "policy"):
+            if mr.get(k) is not None:
+                row[k] = mr[k]
+        return row
+
+    chain = []
+    span_sum = mig_gap = unrec_gap = 0.0
+    prev_end = None
+    mi = 0
+    eps = _FIRST_TOKEN_EPS_S
+    for label, sp in spans_d:
+        st = sp.get("start_t") or 0.0
+        while (mi < len(moves_sorted)
+               and moves_sorted[mi][1].get("t", 0.0) <= st + eps):
+            chain.append(move_row(*moves_sorted[mi]))
+            mi += 1
+        if prev_end is not None and st - prev_end > RECONCILE_TOL_S:
+            gap = st - prev_end
+            explained = any(
+                mr["event"] in ("handoff", "migrated", "wire_rejected")
+                and prev_end - eps <= mr.get("t", 0.0) <= st + eps
+                for _l, mr in moves)
+            cause = "migration" if explained else "UNRECONCILED"
+            if explained:
+                mig_gap += gap
+            else:
+                unrec_gap += gap
+            chain.append({"type": "gap", "cause": cause,
+                          "duration_s": round(gap, 4)})
+        row = {"type": "span", "engine": label, "span": sp["span"],
+               "duration_s": sp.get("duration_s"),
+               "start_step": sp.get("start_step"),
+               "end_step": sp.get("step")}
+        if sp.get("tokens") is not None:
+            row["tokens"] = sp["tokens"]
+        chain.append(row)
+        span_sum += sp.get("duration_s") or 0.0
+        end = sp.get("t") or st
+        prev_end = end if prev_end is None else max(prev_end, end)
+    while mi < len(moves_sorted):
+        chain.append(move_row(*moves_sorted[mi]))
+        mi += 1
+    latency = comp.get("latency_s") if comp else None
+    # the acceptance identity: covered span time + router-explained
+    # migration gaps telescope to the recorded latency (the first
+    # span opens at t_submit, the last closes on the completion
+    # timestamp); any residual is unaccounted crash time
+    reconciled = (latency is not None
+                  and unrec_gap <= RECONCILE_TOL_S
+                  and abs(span_sum + mig_gap + unrec_gap - latency)
+                  <= RECONCILE_TOL_S)
+    events = [{"engine": label, "event": r["event"],
+               "step": r.get("step"), "t": r.get("t"),
+               "reason": r.get("reason"),
+               "weights_version": r.get("weights_version")}
+              for label, r in sorted(reqs,
+                                     key=lambda x: x[1].get("t", 0.0))]
+    return {
+        "uid": uid,
+        "trace_id": trace_id,
+        "engines": sorted({l for l, _ in spans_d}
+                          | {e["engine"] for e in events}),
+        "chain": chain,
+        "events": events,
+        "span_sum_s": round(span_sum, 4),
+        "migration_gap_s": round(mig_gap, 4),
+        "unreconciled_gap_s": round(unrec_gap, 4),
+        "latency_s": latency,
+        "ttft_s": comp.get("ttft_s") if comp else None,
+        "weights_version": (comp or {}).get("weights_version"),
+        "completed": comp is not None,
+        "reconciled": reconciled,
+        "problems": problems,
+    }
+
+
+def _render_trace(out: list, tr: dict) -> None:
+    out.append("")
+    out.append(f"trace {tr['trace_id']} — uid {tr['uid']} across "
+               + (", ".join(tr["engines"]) or "(no engine)"))
+    for row in tr["chain"]:
+        if row["type"] == "span":
+            toks = (f"  {row['tokens']} token(s)"
+                    if row.get("tokens") else "")
+            dur = row.get("duration_s")
+            out.append(f"  [{row['engine']}] {row['span']:12s} "
+                       f"{dur if dur is not None else '?':>9}s  steps "
+                       f"{row.get('start_step')}.."
+                       f"{row.get('end_step')}{toks}")
+        elif row["type"] == "move":
+            arrow = ""
+            if row.get("source") or row.get("target"):
+                arrow = (f" {row.get('source') or '?'} -> "
+                         f"{row.get('target') or '?'}")
+            bits = [f"  >> {row['event'].upper()}{arrow}"
+                    + (f" ({row['reason']})" if row.get("reason")
+                       else "")
+                    + f" @ fleet round {row.get('round')}"]
+            if row.get("blocks") is not None:
+                bits.append(f"{row['blocks']} block(s) / "
+                            + _fmt_bytes(row.get("bytes")))
+            tp = row.get("transport") or {}
+            if tp.get("crc_verify_s") is not None:
+                bits.append(f"crc_verify "
+                            f"{tp['crc_verify_s'] * 1e3:.2f} ms")
+            if row.get("replay"):
+                bits.append(f"replay {row['replay']} token(s)")
+            out.append("  ".join(bits))
+        else:   # gap
+            tag = ("migration stall (router move explains it)"
+                   if row["cause"] == "migration" else
+                   "UNRECONCILED — no router record explains this "
+                   "dead time (a crash gap, never invented into a "
+                   "phase)")
+            out.append(f"  ~~ gap {row['duration_s']:>9}s  {tag}")
+    if tr["completed"]:
+        verdict = ("reconciled" if tr["reconciled"] else
+                   "NOT RECONCILED")
+        out.append(f"  span sum {tr['span_sum_s']}s + migration gaps "
+                   f"{tr['migration_gap_s']}s vs latency "
+                   f"{tr['latency_s']}s ({verdict}"
+                   + (f"; {tr['unreconciled_gap_s']}s unaccounted)"
+                      if tr["unreconciled_gap_s"] > 0 else ")"))
+        if tr.get("ttft_s") is not None:
+            out.append(f"  ttft {tr['ttft_s']}s  weights version "
+                       f"v{tr.get('weights_version')}")
+    else:
+        out.append("  (no completion record — the request did not "
+                   "finish in these streams)")
+    for prob in tr["problems"]:
+        out.append(f"  note: {prob}")
+
+
+def _transport_fold(streams) -> dict | None:
+    """The latest ``transport_stats`` event across the streams
+    (decode/fleet.py emits one at drain end): per-worker per-op RPC
+    call/overhead percentiles + the overhead share of round wall."""
+    recs = [e for s in streams for e in s.events
+            if e.get("event") == "transport_stats"]
+    if not recs:
+        return None
+    rec = max(recs, key=lambda r: r.get("t", 0.0))
+    engines = {k: v for k, v in (rec.get("engines") or {}).items() if v}
+    if not engines:
+        return None
+    wall = rec.get("round_wall_s") or 0.0
+    overhead = sum(v.get("overhead_total_s") or 0.0
+                   for v in engines.values())
+    return {
+        "rounds": rec.get("rounds"),
+        "round_wall_s": wall,
+        "rpc_overhead_total_s": round(overhead, 6),
+        "rpc_overhead_share_of_round_wall": (
+            round(overhead / wall, 4) if wall else None),
+        "engines": engines,
+    }
+
+
+def _render_transport(out: list, tr: dict) -> None:
+    out.append("")
+    share = tr.get("rpc_overhead_share_of_round_wall")
+    out.append(f"transport: RPC overhead "
+               f"{tr['rpc_overhead_total_s']}s over "
+               f"{tr['round_wall_s']}s of round wall"
+               + (f" ({share * 100:.1f}%)" if share is not None
+                  else ""))
+    for eid, st in sorted(tr["engines"].items()):
+        hb = ""
+        if st.get("heartbeat_rtt_p50_ms") is not None:
+            hb = (f"  heartbeat RTT p50 {st['heartbeat_rtt_p50_ms']} "
+                  f"ms / p99 {st['heartbeat_rtt_p99_ms']} ms "
+                  f"({st.get('heartbeats')} ping(s))")
+        out.append(f"  {eid}:{hb}")
+        for op, o in (st.get("ops") or {}).items():
+            line = (f"    {op:12s} x{o['n']:<5d} call p50 "
+                    f"{o['call_p50_ms']} ms  p99 {o['call_p99_ms']} ms")
+            if "overhead_p50_ms" in o:
+                line += (f"  overhead p50 {o['overhead_p50_ms']} ms  "
+                         f"p99 {o['overhead_p99_ms']} ms")
+            out.append(line)
+
+
+def _render_router_postmortem(out: list, label: str | None,
+                              docs: list) -> None:
+    tag = f" [{label}]" if label else ""
+    for doc in docs:
+        out.append("")
+        if doc.get("error"):
+            out.append(f"router postmortem{tag}: {doc['error']}")
+            continue
+        out.append(f"router postmortem{tag}: engine "
+                   f"{doc.get('engine')} declared dead @ round "
+                   f"{doc.get('round')} — {doc.get('reason')} "
+                   f"({doc.get('path')})")
+        ev = doc.get("evidence") or {}
+        d = ev.get("last_digest")
+        if d:
+            out.append(f"  last digest (call id "
+                       f"{ev.get('last_digest_call_id')}): waiting "
+                       f"{d.get('waiting')}, active {d.get('active')},"
+                       f" free blocks {d.get('free_blocks')}, serving "
+                       f"v{d.get('serving_version')}")
+        if ev.get("pending_call_ids"):
+            out.append(f"  pending call id(s): "
+                       f"{ev['pending_call_ids']}")
+        if ev.get("ping_rtt_ms"):
+            out.append(f"  heartbeat RTTs (ms): {ev['ping_rtt_ms']}")
+        if ev.get("backoff_log"):
+            out.append(f"  backoff retries before the verdict: "
+                       f"{len(ev['backoff_log'])}")
+        for op in (ev.get("op_log") or [])[-8:]:
+            out.append(f"    op {op.get('op'):12s} id {op.get('id')}"
+                       f"  {op.get('call_ms')} ms  "
+                       f"{'ok' if op.get('ok') else 'ERROR'}")
+        if ev.get("last_snapshot_step") is not None:
+            out.append(f"  last router-held snapshot: step "
+                       f"{ev['last_snapshot_step']} with "
+                       f"{ev.get('last_snapshot_requests')} live "
+                       "request(s) (migration source)")
+
+
+def _follow(metrics_dirs: list, interval: float, max_s: float) -> int:
+    """Tail mode: poll the streams, print NEW timeline entries as they
+    land (keyed by content — the streams are append-only JSONL), exit
+    rc 0 once a discovered fleet status doc reports the fleet drained
+    with nothing new to print, or after ``max_s``. Reads are
+    crash-safe mid-drill: records flush per line (a torn tail is
+    skipped by read_metrics) and the status doc only ever replaces
+    atomically."""
+    import time as _time
+    printed: set = set()
+    t_start = _time.monotonic()
+    t0_ref = None
+    sizes: dict = {}
+    cache: dict = {}
+    while True:
+        new = []
+        for d in metrics_dirs:
+            # re-parse a stream only when its JSONL actually grew —
+            # idle ticks must not re-validate the whole history just
+            # to find nothing (streams are append-only)
+            path = d
+            if os.path.isdir(path):
+                path = os.path.join(path, METRICS_FILENAME)
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                size = -1
+            if sizes.get(d) != size:
+                sizes[d] = size
+                s = _Stream(d, None)
+                cache[d] = ([(t, s.label, src, what)
+                             for t, src, what in s.timeline_entries()]
+                            if s.dir_exists else [])
+            for key in cache.get(d, ()):
+                if key in printed:
+                    continue
+                printed.add(key)
+                new.append(key)
+        new.sort(key=lambda x: (x[0], x[1]))
+        for t, lab, src, what in new:
+            if t0_ref is None:
+                t0_ref = t
+            print(f"  {_fmt_t(t, t0_ref)}  [{src:7s}] [{lab}] {what}",
+                  flush=True)
+        status = None
+        for d in metrics_dirs:
+            p = os.path.join(d, STATUS_FILENAME)
+            if os.path.exists(p):
+                try:
+                    with open(p) as f:
+                        status = json.load(f)
+                except ValueError:
+                    pass    # racing the atomic replace; next tick
+        if status is not None and status.get("drained") and not new:
+            print(f"report: fleet drained @ round "
+                  f"{status.get('round')} — follow complete")
+            return 0
+        if _time.monotonic() - t_start > max_s:
+            print("report: --follow_max_s elapsed without a drained "
+                  "status doc — stopping the tail")
+            return 0
+        _time.sleep(interval)
 
 
 def _fleet_health(streams) -> dict | None:
@@ -1154,10 +1560,47 @@ def report_main(argv=None) -> int:
                         "attributed to its dominant span (queued / "
                         "prefill / replay / decode / preempt_gap / "
                         "quarantine / migration); e.g. --slo 0.5:0.05")
+    p.add_argument("--trace", default=None, metavar="UID",
+                   help="render ONE request's cross-engine causal "
+                        "waterfall, stitched by its trace_id (schema "
+                        "v12): spans, router moves, and lifecycle "
+                        "events across every given stream in causal "
+                        "order, with unexplained wall-clock gaps "
+                        "flagged UNRECONCILED; rc 2 on a non-integer "
+                        "or unknown uid")
+    p.add_argument("--follow", action="store_true",
+                   help="tail mode: poll the streams, print NEW "
+                        "timeline entries as they land, exit rc 0 "
+                        "when the router's fleet status doc reports "
+                        "the fleet drained (or after --follow_max_s)")
+    p.add_argument("--follow_interval", type=float, default=0.5,
+                   help="poll cadence of --follow in seconds")
+    p.add_argument("--follow_max_s", type=float, default=60.0,
+                   help="--follow gives up (rc 0, with a note) after "
+                        "this many seconds without a drained status")
     p.add_argument("--json", action="store_true",
                    help="emit the folded report as one JSON object "
                         "instead of text")
     args = p.parse_args(argv)
+
+    # the train-CLI parse discipline: a malformed --trace uid rejects
+    # rc 2 BEFORE any stream is read
+    trace_uid = None
+    if args.trace is not None:
+        try:
+            trace_uid = int(args.trace)
+        except ValueError:
+            print(f"report: unparseable --trace {args.trace!r} (want "
+                  "a request uid, e.g. --trace 2)", file=sys.stderr)
+            return 2
+    if args.follow and args.json:
+        print("report: --follow is a live text tail; drop --json",
+              file=sys.stderr)
+        return 2
+    if args.follow_interval <= 0 or args.follow_max_s <= 0:
+        print("report: --follow_interval/--follow_max_s must be > 0",
+              file=sys.stderr)
+        return 2
 
     # the train-CLI parse discipline: a malformed spec rejects rc 2
     # BEFORE any stream is read
@@ -1197,9 +1640,21 @@ def report_main(argv=None) -> int:
             print(f"report: no metrics stream at {s.path}",
                   file=sys.stderr)
         return 2
+    if args.follow:
+        # the live tail replaces the one-shot fold (a run may still be
+        # record-free while its engines boot — the tail waits for it)
+        return _follow(args.metrics_dirs, args.follow_interval,
+                       args.follow_max_s)
     multi = len(streams) > 1
 
     if not any(s.records for s in streams):
+        if trace_uid is not None:
+            # asking to trace a uid through streams that hold nothing
+            # is an unknown-uid error, not a record-free answer
+            print(f"report: no record for uid {trace_uid} — the given "
+                  "stream(s) hold no schema-valid records",
+                  file=sys.stderr)
+            return 2
         # a record-free stream is an ANSWER (the run emitted nothing),
         # not a tooling failure: rc 0 with an explicit summary naming
         # whatever failed to validate
@@ -1225,7 +1680,7 @@ def report_main(argv=None) -> int:
     per_engine: dict = {}
     timeline = []
     waterfalls: dict = {}
-    for s in streams:
+    for si, s in enumerate(streams):
         sub = {"metrics_path": s.path, "n_records": len(s.records),
                "problems": s.problems, "run": s.header,
                "steps": s.step_stats(), "recovery": s.recovery()}
@@ -1239,9 +1694,14 @@ def report_main(argv=None) -> int:
         wf = s.waterfalls()
         if wf:
             waterfalls[s.label] = wf
-        for t, src, what in s.timeline_entries():
-            timeline.append((t, src, what, s.label))
-    timeline.sort(key=lambda x: x[0])
+        for order, (t, src, what) in enumerate(s.timeline_entries()):
+            timeline.append((t, si, order, src, what, s.label))
+    # deterministic merge: equal timestamps break ties by (stream,
+    # per-stream entry order), so repeated merges of the same dirs
+    # render byte-identical timelines (pinned by test)
+    timeline.sort(key=lambda x: (x[0], x[1], x[2]))
+    timeline = [(t, src, what, lab)
+                for t, _si, _order, src, what, lab in timeline]
 
     # ---- fleet summary (schema-v8 router records, decode/fleet.py) --
     # the fleet-LEVEL read of the merged streams: routing decisions
@@ -1347,8 +1807,20 @@ def report_main(argv=None) -> int:
     fh = _fleet_health(streams)
     if fh:
         doc["fleet_health"] = fh
+    tp = _transport_fold(streams)
+    if tp:
+        doc["transport"] = tp
     if slo is not None:
         doc["slo"] = _slo_accounting(streams, *slo)
+    if trace_uid is not None:
+        tr = _trace_doc(streams, trace_uid)
+        if tr is None:
+            print(f"report: no record for uid {trace_uid} in the "
+                  "given stream(s) — nothing to trace (pass every "
+                  "engine's metrics dir plus the router's)",
+                  file=sys.stderr)
+            return 2
+        doc["trace"] = tr
 
     if multi:
         doc["engines"] = per_engine
@@ -1364,10 +1836,15 @@ def report_main(argv=None) -> int:
                              else waterfalls[streams[0].label])
 
     flights = {}
+    rposts: dict = {}
     if args.postmortem:
         flights = {s.label: s.flight_recorder() for s in streams}
         doc["postmortem"] = (flights if multi
                              else flights[streams[0].label])
+        rposts = {s.label: v for s in streams
+                  if (v := s.router_postmortems())}
+        if rposts:
+            doc["router_postmortem"] = rposts
 
     # ---- profile folding (first stream's strategy names the scopes) --
     if args.profile_dir:
@@ -1456,8 +1933,12 @@ def report_main(argv=None) -> int:
                     fl["completed_by_version"].items())))
     if doc.get("fleet_health"):
         _render_fleet_health(out, doc["fleet_health"])
+    if doc.get("transport"):
+        _render_transport(out, doc["transport"])
     if doc.get("slo"):
         _render_slo(out, doc["slo"])
+    if doc.get("trace"):
+        _render_trace(out, doc["trace"])
     if multi:
         for s in streams:
             sub = per_engine[s.label]
@@ -1479,6 +1960,11 @@ def report_main(argv=None) -> int:
         for s in streams:
             _render_postmortem(out, s.label if multi else None,
                                flights.get(s.label))
+        for s in streams:
+            if rposts.get(s.label):
+                _render_router_postmortem(out,
+                                          s.label if multi else None,
+                                          rposts[s.label])
     if "profile" in doc:
         pr = doc["profile"]
         out.append("")

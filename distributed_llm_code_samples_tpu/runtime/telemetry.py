@@ -113,9 +113,36 @@ import numpy as np
 # (2) every "request" record grows ``weights_version`` — the uid's
 # weights-version pin (null before first admission), the per-version
 # attribution mixed-version fleet reports dedup completions by.
-SCHEMA_VERSION = 11
+# v12 (round 18): the fleet trace spine (DESIGN.md section 24).
+# Every per-request record kind — "request", "span", "router" — PINS
+# ``trace_id``: the fleet-unique causal identity minted ONCE at
+# admission (the router under a fleet, the engine itself single-
+# engine) and carried through replay, preemption, quarantine,
+# migration (handoff doc v5), crash-resume (snapshot v7), and version
+# pins — so ``report --trace UID`` stitches one cross-engine,
+# cross-process waterfall by the id itself instead of uid heuristics.
+# Null only where the record concerns no traceable request (the
+# anonymous rejected uid -1). "deploy" records pin the key too (the
+# issue's uniform-envelope stance) with a null value — a deploy event
+# concerns the fleet, not one request. Transport cost attribution
+# rides the existing "event" kind (``transport_stats``: per-worker
+# per-op RPC call/handle durations, decode/fleet.py) and the live
+# status doc (STATUS_FILENAME) is a wire-published JSON document, not
+# a stream record.
+SCHEMA_VERSION = 12
 
 METRICS_FILENAME = "metrics.jsonl"
+
+# the atomic fleet status document the router publishes each round
+# (throttled; decode/fleet.py via wire.publish_json) — defined here so
+# the router, the `fleetstat` entry point, and `report --follow` share
+# one name without the readers importing the (jax-heavy) fleet module
+STATUS_FILENAME = "fleet_status.json"
+
+# router-side dead-host postmortem dumps (decode/fleet.py publishes
+# one per declared-dead engine; report --postmortem discovers them by
+# this prefix next to the router's metrics stream)
+ROUTER_POSTMORTEM_PREFIX = "router_postmortem_"
 
 # the flight-recorder dump the decode engine publishes next to the
 # metrics stream (decode/engine.py writes it; report --postmortem
@@ -207,8 +234,11 @@ DECODE_REQUIRED = ("step", "tokens_per_sec", "batch_occupancy",
 # before first admission pins it; the anonymous rejected uid -1 is
 # always null) — so a mixed-version fleet's per-version completion
 # counts are recorded data, not inference.
+# v12: ``trace_id`` — the request's fleet-unique causal identity
+# (minted once at admission, carried through every move; null only on
+# the anonymous rejected uid -1).
 REQUEST_REQUIRED = ("step", "uid", "event", "reason",
-                    "weights_version")
+                    "weights_version", "trace_id")
 
 # the extra keys a COMPLETED request record must also carry (v9) —
 # enforced conditionally by validate_record (other events never
@@ -226,8 +256,11 @@ REQUEST_COMPLETED_REQUIRED = ("latency_s", "ttft_s")
 # ``latency_s`` — the reconciliation ``report``'s waterfall view pins.
 # Replayed spans after a snapshot-resume restart are deduplicated by
 # ``(uid, span, start_step, step)``, the request-record dedup stance.
+# v12: ``trace_id`` — the owning request's causal identity (the
+# stitch key of the cross-process trace waterfall).
 # Same version-bump discipline as STEP_KEYS.
-SPAN_REQUIRED = ("step", "uid", "span", "start_step", "duration_s")
+SPAN_REQUIRED = ("step", "uid", "span", "start_step", "duration_s",
+                 "trace_id")
 
 # The span vocabulary (runtime/tracing.py callers use these; report
 # renders any name, so a new phase is additive)
@@ -255,7 +288,9 @@ SPAN_NAMES = ("queued", "prefill", "replay", "decode", "quarantine",
 # (0 blocks/bytes on a replay-migration off a dead engine's snapshot —
 # nothing ships but the token history). Same version-bump discipline
 # as STEP_KEYS.
-ROUTER_REQUIRED = ("step", "uid", "event", "source", "target", "policy")
+# v12: ``trace_id`` — the moved/placed request's causal identity.
+ROUTER_REQUIRED = ("step", "uid", "event", "source", "target", "policy",
+                   "trace_id")
 
 # The router decision vocabulary (decode/fleet.py emits these; report
 # renders any name, so a new decision kind is additive).
@@ -296,7 +331,12 @@ FLEET_REQUIRED = ("step", "engines", "load_imbalance")
 # ``reason`` — the ONE-line named cause naming the CRC rejection or
 # mid-roll failure plus the latest_verified_step fallback. Same
 # version-bump discipline as STEP_KEYS.
-DEPLOY_REQUIRED = ("step", "event", "from_version", "to_version")
+# v12: ``trace_id`` pinned for the uniform per-kind envelope — always
+# null (a deploy event concerns the fleet, not one request; the
+# per-request deploy-drain moves carry theirs on ``migrated`` router
+# records).
+DEPLOY_REQUIRED = ("step", "event", "from_version", "to_version",
+                   "trace_id")
 
 # the deploy lifecycle vocabulary (report renders any name; a new
 # event is additive)
@@ -551,6 +591,7 @@ class TelemetryWriter:
         rec.setdefault("t", time.time())
         rec.setdefault("reason", None)
         rec.setdefault("weights_version", None)
+        rec.setdefault("trace_id", None)
         rec["kind"] = "request"
         self._put(rec)
 
@@ -561,6 +602,7 @@ class TelemetryWriter:
         per-event conditional pins)."""
         rec = dict(record)
         rec.setdefault("t", time.time())
+        rec.setdefault("trace_id", None)
         rec["kind"] = "deploy"
         self._put(rec)
 
@@ -575,6 +617,7 @@ class TelemetryWriter:
         rec.setdefault("source", None)
         rec.setdefault("target", None)
         rec.setdefault("policy", None)
+        rec.setdefault("trace_id", None)
         rec["kind"] = "router"
         self._put(rec)
 
@@ -595,6 +638,7 @@ class TelemetryWriter:
         time) so span sums reconcile with request latencies."""
         rec = dict(record)
         rec.setdefault("t", time.time())
+        rec.setdefault("trace_id", None)
         rec["kind"] = "span"
         self._put(rec)
 

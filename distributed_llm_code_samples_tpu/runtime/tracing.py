@@ -70,13 +70,18 @@ class SpanTracer:
     ``metrics_fn`` returns the live ``TelemetryWriter`` (or None) at
     emit time — the engine re-binds its writer mid-life
     (``DecodeEngine.run(metrics=...)``), so the tracer must not capture
-    it at construction. All methods are host-side and O(1); with no
-    writer attached the tracer still tracks phases (close/transition
-    stay cheap no-ops on the emit half).
+    it at construction. ``trace_fn(uid)`` returns the uid's causal
+    ``trace_id`` (schema v12: every span record pins it — the stitch
+    key of the cross-process trace waterfall; None with no trace
+    plumbed, e.g. standalone tracer tests). All methods are host-side
+    and O(1); with no writer attached the tracer still tracks phases
+    (close/transition stay cheap no-ops on the emit half).
     """
 
-    def __init__(self, metrics_fn: Callable):
+    def __init__(self, metrics_fn: Callable,
+                 trace_fn: Callable | None = None):
         self._metrics_fn = metrics_fn
+        self._trace_fn = trace_fn
         self._open: dict[int, dict] = {}   # uid -> open-span state
         # uid -> wall clock of the FIRST live token (round 15, the
         # TTFT decomposition): marked once at the prefill-completing
@@ -147,6 +152,8 @@ class SpanTracer:
             return
         metrics.span({
             "uid": uid,
+            "trace_id": (self._trace_fn(uid) if self._trace_fn
+                         is not None else None),
             "span": cur["span"],
             "start_step": cur["start_step"],
             "step": end_step,

@@ -495,9 +495,76 @@ then
   echo "PROCESS_SMOKE=FAIL (token-identity/schema/report check)"
   rm -rf "$PROC_DIR"; exit 1
 fi
-rm -rf "$PROC_DIR"
 echo "PROCESS_SMOKE=OK"
 phase_done process_smoke
+
+echo "=== trace smoke ==="
+# The ISSUE 14 spine on the PROCESS drill's own artifacts (no second
+# fleet boot): `report --trace` on the uid the SIGKILL migrated must
+# exit 0 with ONE stitched cross-process waterfall (spans from the
+# dead worker's surviving stream AND the survivor's, the kill's dead
+# time classified a migration stall, span sum + gaps reconciling with
+# the recorded latency — never UNRECONCILED); a malformed --trace arg
+# rejects rc 2; `fleetstat` reads the finished run's atomic status
+# doc rc 0 (and rc 2 with no doc).
+TRACE_UID=$(timeout -k 10 60 env JAX_PLATFORMS=cpu python - "$PROC_DIR" <<'EOF'
+import os, sys
+from distributed_llm_code_samples_tpu.runtime.telemetry import (
+    METRICS_FILENAME, read_metrics)
+records, _ = read_metrics(os.path.join(sys.argv[1], "m", "router",
+                                       METRICS_FILENAME))
+migs = [r for r in records if r["kind"] == "router"
+        and r["event"] == "migrated"]
+assert migs, "process drill migrated nothing"
+print(migs[0]["uid"])
+EOF
+)
+if [ -z "$TRACE_UID" ]; then
+  echo "TRACE_SMOKE=FAIL (no migrated uid)"; rm -rf "$PROC_DIR"; exit 1
+fi
+if ! timeout -k 10 60 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli report "$PROC_DIR/m/router" \
+    "$PROC_DIR/m/e0" "$PROC_DIR/m/e1" "$PROC_DIR/m/e2" \
+    --trace "$TRACE_UID" > "$PROC_DIR/trace.txt"; then
+  echo "TRACE_SMOKE=FAIL (report --trace rc)"; rm -rf "$PROC_DIR"
+  exit 1
+fi
+if ! timeout -k 10 60 env JAX_PLATFORMS=cpu python - "$PROC_DIR" <<'EOF'
+import sys
+text = open(sys.argv[1] + "/trace.txt").read()
+assert "trace " in text and "MIGRATED" in text, text[-800:]
+assert "reconciled" in text, text[-800:]
+assert "UNRECONCILED" not in text, text[-800:]
+EOF
+then
+  echo "TRACE_SMOKE=FAIL (waterfall content)"; rm -rf "$PROC_DIR"
+  exit 1
+fi
+if timeout -k 10 60 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli report "$PROC_DIR/m/router" \
+    --trace banana > /dev/null 2>&1; then
+  echo "TRACE_SMOKE=FAIL (malformed --trace accepted)"
+  rm -rf "$PROC_DIR"; exit 1
+fi
+if ! timeout -k 10 60 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli fleetstat \
+    "$PROC_DIR/m/router" > "$PROC_DIR/status.txt"; then
+  echo "TRACE_SMOKE=FAIL (fleetstat rc)"; rm -rf "$PROC_DIR"; exit 1
+fi
+if ! grep -q "DRAINED" "$PROC_DIR/status.txt" \
+    || ! grep -q "DEAD" "$PROC_DIR/status.txt"; then
+  echo "TRACE_SMOKE=FAIL (status content)"
+  cat "$PROC_DIR/status.txt"; rm -rf "$PROC_DIR"; exit 1
+fi
+if timeout -k 10 60 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli fleetstat \
+    "$PROC_DIR/m/e0" > /dev/null 2>&1; then
+  echo "TRACE_SMOKE=FAIL (fleetstat rc 0 with no status doc)"
+  rm -rf "$PROC_DIR"; exit 1
+fi
+rm -rf "$PROC_DIR"
+echo "TRACE_SMOKE=OK"
+phase_done trace_smoke
 
 echo "=== fleet SLO smoke ==="
 # The ISSUE 11 acceptance drill (DESIGN.md section 21): a 3-engine

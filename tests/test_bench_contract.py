@@ -310,6 +310,26 @@ def test_bench_decode_contract():
     assert payload["fleet_handoff_wire_bytes"] > 0
     assert payload["fleet_handoff_wire_stall_p90_ms"] > 0
     assert payload["fleet_handoff_wire_vs_inproc"] > 0
+    # r18 fleet ops rows (the trace spine + live ops plane): the
+    # tracing-on/off bound is ASSERTED inside the bench (>= 0.95 on
+    # median round wall, identical compile counts — an error string
+    # here means the overhead discipline broke, not noise), and the
+    # process-transport RPC rows price the socket per op off the
+    # worker-side handle durations piggybacked on every response
+    assert payload["fleet_tracing_tokens_ratio"] >= 0.95
+    assert payload["fleet_tracing_round_ms"]["off_median"] > 0
+    assert payload["fleet_rpc_overhead_p50_ms"] > 0
+    assert payload["fleet_rpc_overhead_p99_ms"] >= \
+        payload["fleet_rpc_overhead_p50_ms"]
+    assert payload["fleet_rpc_heartbeat_rtt_p50_ms"] > 0
+    assert payload["fleet_rpc_heartbeat_rtt_p99_ms"] >= \
+        payload["fleet_rpc_heartbeat_rtt_p50_ms"]
+    per_eng = payload["fleet_rpc_per_engine"]
+    assert set(per_eng) == {"e0", "e1"}
+    for st in per_eng.values():
+        assert st["ops"].get("step", {}).get("n", 0) >= 1
+        assert "overhead_p50_ms" in st["ops"]["step"]
+        assert st["heartbeats"] >= 1
 
 
 def _run_trend(root):

@@ -65,7 +65,13 @@ from distributed_llm_code_samples_tpu.runtime.telemetry import (
 # from/to version pair; engine_swapped conditionally pins ``engine``,
 # completed/rolled_back pin ``duration_s``, rolled_back pins the
 # one-line ``reason`` — decode/fleet.py rolling_deploy).
-_PINNED_VERSION = 11
+# v12 (round 18): the fleet trace spine — every per-request kind
+# ("request", "span", "router") pins ``trace_id`` (the fleet-unique
+# causal identity minted once at admission and carried through
+# replay/migration/crash-resume; null only on the anonymous rejected
+# uid -1), and "deploy" pins the key too (uniform envelope, value
+# always null — a deploy event concerns the fleet, not one request).
+_PINNED_VERSION = 12
 _PINNED_STEP_KEYS = frozenset({
     "schema", "kind", "t", "step", "strategy", "loss", "grad_norm",
     "tokens_per_sec", "step_time_s", "mfu", "hbm_high_water_bytes",
@@ -81,13 +87,13 @@ _PINNED_DECODE_REQUIRED = frozenset({
     "shared_blocks", "cow_copies",
 })
 _PINNED_REQUEST_REQUIRED = frozenset({
-    "step", "uid", "event", "reason", "weights_version",
+    "step", "uid", "event", "reason", "weights_version", "trace_id",
 })
 _PINNED_SPAN_REQUIRED = frozenset({
-    "step", "uid", "span", "start_step", "duration_s",
+    "step", "uid", "span", "start_step", "duration_s", "trace_id",
 })
 _PINNED_ROUTER_REQUIRED = frozenset({
-    "step", "uid", "event", "source", "target", "policy",
+    "step", "uid", "event", "source", "target", "policy", "trace_id",
 })
 _PINNED_REQUEST_COMPLETED_REQUIRED = frozenset({"latency_s", "ttft_s"})
 _PINNED_FLEET_REQUIRED = frozenset({"step", "engines",
@@ -95,7 +101,7 @@ _PINNED_FLEET_REQUIRED = frozenset({"step", "engines",
 _PINNED_ROUTER_MOVE_REQUIRED = frozenset({"blocks", "bytes",
                                           "duration_s", "transport"})
 _PINNED_DEPLOY_REQUIRED = frozenset({
-    "step", "event", "from_version", "to_version",
+    "step", "event", "from_version", "to_version", "trace_id",
 })
 _PINNED_DEPLOY_EVENT_REQUIRED = {
     "engine_swapped": frozenset({"engine"}),
@@ -327,7 +333,7 @@ def test_router_move_record_conditional_pin():
     never pin them — per event, per key."""
     base = {"schema": SCHEMA_VERSION, "kind": "router", "t": 0.0,
             "step": 1, "uid": 2, "source": "p0", "target": "e0",
-            "policy": None}
+            "policy": None, "trace_id": "ab12-2"}
     move_keys = {"blocks": 3, "bytes": 4096, "duration_s": 0.01,
                  "transport": {"mode": "wire", "bytes": 4096,
                                "crc_verify_s": 0.0001, "retries": 0}}
@@ -383,7 +389,7 @@ def test_completed_request_record_conditional_pin():
     unreconstructable); other request events never pin them."""
     base = {"schema": SCHEMA_VERSION, "kind": "request", "t": 0.0,
             "step": 3, "uid": 1, "reason": None,
-            "weights_version": None}
+            "weights_version": None, "trace_id": "ab12-1"}
     ok, reason = validate_record({**base, "event": "completed",
                                   "latency_s": 1.5, "ttft_s": 0.5})
     assert ok, reason
@@ -449,7 +455,8 @@ def test_deploy_record_per_event_conditional_pins():
     events carry duration_s, a rollback carries its one-line reason —
     and ``started`` pins none of them (nothing has happened yet)."""
     base = {"schema": SCHEMA_VERSION, "kind": "deploy", "t": 0.0,
-            "step": 2, "from_version": 0, "to_version": 5}
+            "step": 2, "from_version": 0, "to_version": 5,
+            "trace_id": None}
     ok, reason = validate_record({**base, "event": "started"})
     assert ok, reason
     ok, reason = validate_record({**base, "event": "engine_swapped"})
