@@ -216,8 +216,12 @@ REQUEST_EVENTS = ("admitted", "preempted", "retried", "quarantined",
 # 18): the document carries the sequence's ``trace_id`` — the causal
 # identity minted once at admission (schema v12) — so a migrated
 # request's records on the TARGET engine stitch into the same
-# cross-process trace waterfall (DESIGN.md section 24).
-HANDOFF_VERSION = 5
+# cross-process trace waterfall (DESIGN.md section 24). v6 (round
+# 19): the document carries the sequence's ``tenant`` tag (schema
+# v13) — a migrated request's per-tenant attribution survives the
+# move, so the workload plane's noisy-tenant numbers stay honest
+# through kills and deploys (DESIGN.md section 25).
+HANDOFF_VERSION = 6
 
 # EngineConfig keys two engines may legitimately disagree on and still
 # exchange sequences: pool SIZE is an engine-local capacity choice.
@@ -396,6 +400,12 @@ class _Seq:
     # (snapshot v7), and version pins — the stitch key every
     # request/span/router record for this sequence pins
     trace_id: str | None = None
+    # the tenant tag (round 19, schema v13): set at submit (None
+    # single-tenant) and carried exactly like trace_id — through
+    # replay, preemption, migration (handoff doc v6), and crash-resume
+    # (snapshot v8) — the per-tenant accounting key the workload
+    # plane's report slices pin
+    tenant: str | None = None
 
     @property
     def prompt_done(self) -> bool:
@@ -498,6 +508,10 @@ class DecodeEngine:
         # compiles overhead contract).
         self._trace_nonce = os.urandom(4).hex()
         self._traces: dict[int, str] = {}
+        # uid -> tenant tag (round 19, schema v13): the per-tenant
+        # attribution key every request/span record for the uid pins
+        # (None single-tenant) — host metadata only, like _traces
+        self._tenants: dict[int, str | None] = {}
         self.pool = self._init_pool()
         s, mb = cfg.max_slots, cfg.max_blocks_per_seq
         self.tables = np.full((s, mb), SCRATCH_BLOCK, np.int32)
@@ -542,7 +556,8 @@ class DecodeEngine:
         # because run(metrics=...) re-binds it after construction
         # (trace_fn: every span record pins the uid's trace_id)
         self.tracer = SpanTracer(lambda: self.metrics,
-                                 trace_fn=self._traces.get)
+                                 trace_fn=self._traces.get,
+                                 tenant_fn=self._tenants.get)
         # KV-pool churn (cumulative; snapshot-persisted so they stay
         # monotonic across crash-resume) + free-block watermark window
         # (min/max since the last decode record)
@@ -1079,6 +1094,9 @@ class DecodeEngine:
             # the causal identity travels (v5): the target's records
             # stitch into the same trace waterfall
             "trace_id": seq.trace_id,
+            # the tenant tag travels (v6): per-tenant attribution
+            # survives the move
+            "tenant": seq.tenant,
             "prompt": list(seq.prompt),
             "out": list(seq.out),
             "max_new": int(seq.max_new),
@@ -1193,9 +1211,11 @@ class DecodeEngine:
                    submit_step=self.global_step,
                    weights_version=ver,
                    trace_id=(doc.get("trace_id")
-                             or f"{self._trace_nonce}-{uid}"))
+                             or f"{self._trace_nonce}-{uid}"),
+                   tenant=doc.get("tenant"))
         self._pins[uid] = ver
         self._traces[uid] = seq.trace_id
+        self._tenants[uid] = seq.tenant
         seq.emitted = int(doc["emitted"])
         seq.t_submit = float(doc["t_submit"])
         seq.prefilled = len(prompt)
@@ -1265,18 +1285,22 @@ class DecodeEngine:
                 "t_submit": float(seq.t_submit),
                 "t_first": self.tracer.pop_first_token(uid),
                 "weights_version": seq.weights_version,
-                "trace_id": seq.trace_id}
+                "trace_id": seq.trace_id,
+                "tenant": seq.tenant}
 
     # -- scheduler -----------------------------------------------------
 
     def submit(self, prompt, max_new: int, uid: int | None = None,
-               trace: str | None = None) -> int:
+               trace: str | None = None,
+               tenant: str | None = None) -> int:
         """Queue one request. ``prompt`` is a list of token ids; the
         capacity checks run here so an impossible request fails at
         submit time, never mid-serve. ``trace`` is the caller-minted
         trace id (the fleet router mints at fleet admission); None
         mints one here — either way the id sticks to the uid for the
-        request's whole cross-engine life (schema v12)."""
+        request's whole cross-engine life (schema v12). ``tenant`` is
+        the request's tenant tag (schema v13; None single-tenant),
+        carried exactly like the trace id."""
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
@@ -1338,8 +1362,10 @@ class DecodeEngine:
         seq = _Seq(uid=uid, prompt=prompt, max_new=max_new,
                    submit_step=self.global_step,
                    trace_id=(trace if trace is not None
-                             else f"{self._trace_nonce}-{uid}"))
+                             else f"{self._trace_nonce}-{uid}"),
+                   tenant=tenant)
         self._traces[uid] = seq.trace_id
+        self._tenants[uid] = tenant
         self.waiting.append(seq)
         # the queued span opens at t_submit — the same clock latency_s
         # measures from, so the waterfall's span sum reconciles with it
@@ -1350,7 +1376,8 @@ class DecodeEngine:
                        retries: int = 0, t_submit=None,
                        submit_step=None, t_first=None,
                        weights_version=None,
-                       trace: str | None = None) -> int:
+                       trace: str | None = None,
+                       tenant: str | None = None) -> int:
         """Re-enter a request from an engine snapshot
         (``decode/supervise.py``): queued for replay-resume — prompt
         re-prefilled, recorded ``out`` tokens teacher-forced, then live
@@ -1380,9 +1407,13 @@ class DecodeEngine:
                    # resume (snapshot v7 / the caller's book persisted
                    # it); None mints fresh — a pre-v12 entry had none
                    trace_id=(trace if trace is not None
-                             else f"{self._trace_nonce}-{int(uid)}"))
+                             else f"{self._trace_nonce}-{int(uid)}"),
+                   # the tenant rides the resume exactly like the
+                   # trace id (snapshot v8 / handoff v6 persisted it)
+                   tenant=tenant)
         self._pins[int(uid)] = seq.weights_version
         self._traces[int(uid)] = seq.trace_id
+        self._tenants[int(uid)] = tenant
         if t_submit is not None:
             seq.t_submit = float(t_submit)
         if t_first is not None:
@@ -1422,7 +1453,8 @@ class DecodeEngine:
         rec = {"step": self.global_step, "uid": int(uid),
                "event": event, "reason": reason,
                "weights_version": self._pins.get(int(uid)),
-               "trace_id": self._traces.get(int(uid)), **extra}
+               "trace_id": self._traces.get(int(uid)),
+               "tenant": self._tenants.get(int(uid)), **extra}
         self.request_events.append(rec)
         # the flight recorder's per-step decision line (compact: the
         # digest ring is bounded memory, the durable trail is the
@@ -2201,6 +2233,19 @@ class DecodeEngine:
     @property
     def active(self) -> int:
         return sum(s is not None for s in self.slots)
+
+    def tenant_load(self) -> dict[str, int]:
+        """Per-tenant LIVE request counts (waiting + resident; None
+        tenants excluded) — the in-flight half of the per-tenant ops
+        surface (schema v13): rides the handle digest so the fleet
+        status doc's tenants block costs zero extra round-trips.
+        O(slots + waiting) host work, empty dict single-tenant."""
+        load: dict[str, int] = {}
+        for seq in list(self.waiting) + [s for s in self.slots
+                                         if s is not None]:
+            if seq.tenant is not None:
+                load[seq.tenant] = load.get(seq.tenant, 0) + 1
+        return load
 
     def mean_occupancy(self) -> float:
         return self._occ_sum / self.steps if self.steps else 0.0

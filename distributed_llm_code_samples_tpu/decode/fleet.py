@@ -249,6 +249,10 @@ class EngineHandle:
             "head": ({"prompt_len": len(e.waiting[0].prompt),
                       "max_new": e.waiting[0].max_new}
                      if e.waiting else None),
+            # per-tenant live counts (schema v13; empty single-tenant)
+            # — the in-flight half of the status doc's tenants block,
+            # riding the digest so it costs zero extra round-trips
+            "tenants": e.tenant_load(),
         }
         if not light:
             d["slots"] = [{"uid": s.uid, "prompt_done": s.prompt_done,
@@ -279,12 +283,15 @@ class EngineHandle:
     # -- scheduling ----------------------------------------------------
 
     def submit(self, prompt, max_new: int, uid: int,
-               trace: str | None = None) -> dict:
+               trace: str | None = None,
+               tenant: str | None = None) -> dict:
         """Submit; returns the WAITING snapshot entry for the router's
         O(1) snapshot-append discipline (raises ``AdmissionError`` on a
         full queue — the caller's spillover path). ``trace`` is the
-        router-minted trace id the engine records verbatim."""
-        self.engine.submit(prompt, max_new, uid=uid, trace=trace)
+        router-minted trace id the engine records verbatim; ``tenant``
+        the request's tenant tag (schema v13)."""
+        self.engine.submit(prompt, max_new, uid=uid, trace=trace,
+                           tenant=tenant)
         seq = next(s for s in reversed(self.engine.waiting)
                    if s.uid == uid)
         return {"uid": seq.uid, "prompt": seq.prompt, "out": seq.out,
@@ -294,17 +301,18 @@ class EngineHandle:
                 "t_first": None,       # no first token yet
                 "weights_version": None,   # pins at admission
                 "trace_id": seq.trace_id,
+                "tenant": seq.tenant,
                 "state": "WAITING"}
 
     def resume_request(self, uid: int, prompt, max_new: int, *, out=(),
                        retries: int = 0, t_submit=None,
                        t_first=None, weights_version=None,
-                       trace=None) -> None:
+                       trace=None, tenant=None) -> None:
         self.engine.resume_request(uid, prompt, max_new, out=out,
                                    retries=retries, t_submit=t_submit,
                                    t_first=t_first,
                                    weights_version=weights_version,
-                                   trace=trace)
+                                   trace=trace, tenant=tenant)
 
     def release_request(self, uid: int) -> dict:
         """The drain primitive's replay half (rolling deploy): pop one
@@ -610,6 +618,18 @@ class FleetRouter:
         self._deploys: dict[int, tuple] = {}    # round -> (dir, step)
         self.deploys = 0
         self.deploy_rollbacks = 0
+        # deploy-on-publish watcher (round 19, ROADMAP item 3
+        # follow-on): poll the ledger's latest_verified on a wall-clock
+        # cadence and roll forward when it advances past the fleet's
+        # serving version — the trainer's atomic publish becomes the
+        # deploy trigger, no operator in the loop (None = off)
+        self._watch: tuple | None = None    # (ckpt_dir, poll_every_s)
+        self._watch_t_last = 0.0
+        # per-tenant admission accounting (round 19, schema v13): the
+        # offered/shed half of the status doc's tenants block (the
+        # in-flight half rides the digests); None tenants excluded
+        self.tenant_offered: dict[str, int] = {}
+        self.tenant_shed: dict[str, int] = {}
         # armed by corrupt_deploy chaos: the truncation fraction to
         # apply to the NEXT deploy's target checkpoint (None = off)
         self._corrupt_next_deploy: float | None = None
@@ -741,6 +761,7 @@ class FleetRouter:
         process transport — reading status never adds a round-trip)."""
         engines = {}
         tokens = 0
+        in_flight: dict[str, int] = {}
         for h in self.handles:
             if not h.alive:
                 engines[h.id] = {"alive": False,
@@ -748,6 +769,8 @@ class FleetRouter:
                 continue
             d = h.digest(light=True)
             tokens += int(d.get("tokens_generated") or 0)
+            for t, n in (d.get("tenants") or {}).items():
+                in_flight[t] = in_flight.get(t, 0) + int(n)
             engines[h.id] = {
                 "alive": True, "role": h.role,
                 "serving_version": int(d["serving_version"]),
@@ -788,6 +811,18 @@ class FleetRouter:
                 "migrations": self.migrations, "sheds": self.sheds,
                 "kills": self.kills,
                 "wire_rejects": self.wire_rejects,
+            },
+            # per-tenant ops counters (round 19, schema v13): in-flight
+            # summed off the digests (zero extra round-trips), offered/
+            # shed from the router's own admission book — empty dict on
+            # a single-tenant fleet (the pre-v13 doc, plus this key)
+            "tenants": {
+                t: {"in_flight": in_flight.get(t, 0),
+                    "offered": self.tenant_offered.get(t, 0),
+                    "shed": self.tenant_shed.get(t, 0)}
+                for t in sorted(set(in_flight)
+                                | set(self.tenant_offered)
+                                | set(self.tenant_shed))
             },
         }
 
@@ -913,7 +948,8 @@ class FleetRouter:
                 return min(tied, key=self._load_key), "prefix", best
         return min(handles, key=self._load_key), "least_loaded", 0
 
-    def submit(self, prompt, max_new: int, session=None) -> int:
+    def submit(self, prompt, max_new: int, session=None,
+               tenant: str | None = None) -> int:
         """Route one request into the fleet; returns its fleet-global
         uid. Disaggregated fleets admit through the least-loaded
         PREFILL engine (the decode target is chosen at handoff time,
@@ -928,6 +964,9 @@ class FleetRouter:
         uid = self._next_uid
         self._next_uid += 1
         prompt = [int(t) for t in prompt]
+        if tenant is not None:
+            self.tenant_offered[tenant] = \
+                self.tenant_offered.get(tenant, 0) + 1
         # the trace spine's mint point (schema v12): ONE fleet-unique
         # causal identity per admission, consumed like the uid whether
         # the request lands or sheds — it rides the engine submit, all
@@ -965,7 +1004,8 @@ class FleetRouter:
         spilled = False
         for h in order:
             try:
-                entry = h.submit(prompt, max_new, uid=uid, trace=trace)
+                entry = h.submit(prompt, max_new, uid=uid, trace=trace,
+                                 tenant=tenant)
             except AdmissionError:
                 shed_reasons.append(f"{h.id}: queue_full")
                 # spillover loses affinity — including the warm-block
@@ -977,7 +1017,7 @@ class FleetRouter:
                 continue
             self.requests[uid] = {"prompt": prompt, "max_new": max_new,
                                   "engine": h.id, "session": session,
-                                  "trace": trace}
+                                  "trace": trace, "tenant": tenant}
             if session is not None and h.role == "decode":
                 self._sessions[session] = h.id
             self.routed += 1
@@ -1007,6 +1047,9 @@ class FleetRouter:
                 h.snapshot["requests"].append(entry)
             return uid
         self.sheds += 1
+        if tenant is not None:
+            self.tenant_shed[tenant] = \
+                self.tenant_shed.get(tenant, 0) + 1
         self._record("shed", uid, reason="queue_full", trace_id=trace)
         raise AdmissionError(
             f"every fleet engine shed request uid {uid}: "
@@ -1088,6 +1131,8 @@ class FleetRouter:
         dep = self._deploys.pop(self.rounds, None)
         if dep is not None:
             self.rolling_deploy(dep[0], step=dep[1])
+            did = True
+        if self._poll_deploy_watch():
             did = True
         stepping, idle = [], []
         for h in self.handles:
@@ -1230,13 +1275,16 @@ class FleetRouter:
                                 weights_version=entry.get(
                                     "weights_version"),
                                 trace=entry.get("trace_id",
-                                                req.get("trace")))
+                                                req.get("trace")),
+                                tenant=entry.get("tenant",
+                                                 req.get("tenant")))
             replay = len(entry["out"])
         else:
             # no snapshot entry (a submit-then-immediate-move corner):
             # replay from the request book — more catch-up, same tokens
             dest.resume_request(uid, req["prompt"], req["max_new"],
-                                trace=req.get("trace"))
+                                trace=req.get("trace"),
+                                tenant=req.get("tenant"))
             replay = 0
         dur = time.perf_counter() - t0
         req["engine"] = dest.id
@@ -1439,7 +1487,9 @@ class FleetRouter:
                 t_first=req.get("t_first"),
                 weights_version=req.get("weights_version"),
                 trace=req.get("trace_id", self.requests.get(
-                    int(req["uid"]), {}).get("trace")))
+                    int(req["uid"]), {}).get("trace")),
+                tenant=req.get("tenant", self.requests.get(
+                    int(req["uid"]), {}).get("tenant")))
             dur = time.perf_counter() - t0
             self.requests[int(req["uid"])]["engine"] = target.id
             # a replay-migration ships no KV (the dead pool is
@@ -1477,6 +1527,40 @@ class FleetRouter:
             raise ValueError(f"a deploy is already scheduled for "
                              f"round {at_round}")
         self._deploys[at_round] = (ckpt_dir, step)
+
+    def deploy_watch(self, ckpt_dir: str, poll_every_s: float) -> None:
+        """Arm the deploy-on-publish watcher: poll ``ckpt_dir``'s
+        ``latest_verified`` every ``poll_every_s`` seconds of wall
+        clock (between rounds — the poll is a directory listing plus a
+        CRC ladder, never on the per-step hot path) and roll the fleet
+        forward whenever it advances past the current serving version.
+        The trainer's existing atomic publish IS the trigger: publish a
+        checkpoint mid-serve and the fleet takes it with zero shed (the
+        ``rolling_deploy`` contract, CRC rollback included)."""
+        if poll_every_s <= 0:
+            raise ValueError(f"deploy_watch poll cadence must be > 0, "
+                             f"got {poll_every_s}")
+        self._watch = (ckpt_dir, float(poll_every_s))
+        self._watch_t_last = 0.0
+
+    def _poll_deploy_watch(self) -> bool:
+        """The watcher's per-round check (throttled): a verified step
+        newer than the fleet's serving version triggers a rolling
+        deploy NOW. Runs after scheduled deploys so an explicit
+        ``schedule_deploy`` always wins its round."""
+        if self._watch is None:
+            return False
+        ckpt_dir, every = self._watch
+        now = time.monotonic()
+        if now - self._watch_t_last < every:
+            return False
+        self._watch_t_last = now
+        from ..runtime.weights import VersionLedger
+        newest = VersionLedger(ckpt_dir).latest_verified()
+        if newest is None or newest <= self._fleet_serving_version():
+            return False
+        self.rolling_deploy(ckpt_dir, step=newest)
+        return True
 
     def _deploy_record(self, event: str, from_v, to_v, **extra) -> None:
         """One schema-v11 ``deploy`` record (started / engine_swapped
@@ -1717,7 +1801,8 @@ class FleetRouter:
                 t_submit=entry.get("t_submit"),
                 t_first=entry.get("t_first"),
                 weights_version=entry.get("weights_version"),
-                trace=entry.get("trace_id"))
+                trace=entry.get("trace_id"),
+                tenant=entry.get("tenant"))
             dur = time.perf_counter() - t1
             self.migrations += 1
             book = self.requests[uid]

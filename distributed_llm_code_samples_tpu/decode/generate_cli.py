@@ -62,7 +62,39 @@ def build_generate_parser() -> argparse.ArgumentParser:
                    help="model init seed (the cli.py convention)")
     p.add_argument("--use_rope", action="store_true",
                    help="rotary attention (must match training)")
-    # requests
+    # requests — explicit prompts, random draws, or a workload trace
+    # (round 19, DESIGN.md section 25): exactly one source
+    p.add_argument("--trace", default=None, metavar="FILE",
+                   help="replay a workload trace file "
+                        "(runtime/workload.py TRACE_VERSION 1 JSONL): "
+                        "arrivals paced on the virtual round clock "
+                        "(--trace_pace wall for real seconds), tenants "
+                        "and sessions tagged through the whole "
+                        "telemetry plane; same (trace, seed) replays "
+                        "byte-identically")
+    p.add_argument("--trace_gen", default=None, metavar="SPEC",
+                   help="generate a trace in-process and serve it "
+                        "(grammar: n=INT,arrival=poisson:R|bursty:"
+                        "R:ON:OFF|ramp:LO:HI,plen=fixed:N|uniform:"
+                        "LO:HI|zipf:A:LO:HI,max_new=...,tenants="
+                        "a:3;b:1,sessions=K[:GROW],seed=N); pair with "
+                        "--trace_out to persist the trace for replay")
+    p.add_argument("--trace_out", default=None, metavar="FILE",
+                   help="write the --trace_gen trace to FILE "
+                        "(atomic publish) so later runs can --trace "
+                        "it — the falsifiability handle")
+    p.add_argument("--trace_pace", choices=["virtual", "wall"],
+                   default=None,
+                   help="trace pacing: 'virtual' (default — offsets "
+                        "map onto scheduling rounds, fully "
+                        "deterministic, the CPU tier-1 mode) or "
+                        "'wall' (offsets are real seconds — the chip "
+                        "mode; token identity holds, admission order "
+                        "may vary with service speed)")
+    p.add_argument("--trace_steps_per_s", type=float, default=None,
+                   help="virtual-clock rate: rounds per trace second "
+                        "(default 8; higher = the same trace replayed "
+                        "onto a denser round grid)")
     p.add_argument("--prompts", default=None,
                    help="semicolon-separated comma-lists of token ids, "
                         'e.g. "3,1,4;9,2,6,5"')
@@ -211,6 +243,15 @@ def build_generate_parser() -> argparse.ArgumentParser:
                         "the newest published step at fire time — the "
                         "CRC ladder then accepts it or rolls back to "
                         "latest_verified_step)")
+    p.add_argument("--deploy_watch", type=float, default=None,
+                   metavar="SECS",
+                   help="deploy-on-publish watcher: poll --deploy_dir's "
+                        "latest VERIFIED step every SECS seconds "
+                        "mid-serve and roll the fleet forward when it "
+                        "advances — the trainer's atomic publish "
+                        "becomes the deploy trigger (requires --fleet "
+                        "and --deploy_dir; mutually exclusive with "
+                        "--deploy_round)")
     p.add_argument("--weights_from", default=None, metavar="CKPT_DIR",
                    help="serve weights restored from a checkpoint dir "
                         "instead of the --random_seed init (the "
@@ -233,7 +274,7 @@ def build_generate_parser() -> argparse.ArgumentParser:
 
 
 def _fleet_main(args, prompts, cfg, policy, params, fleet_kill,
-                fleet_chaos, argv) -> int:
+                fleet_chaos, argv, trace_doc=None) -> int:
     """The ``--fleet N`` run: N engine replicas behind the router
     (``decode/fleet.py``), each with its own metrics stream under
     ``--metrics_dir/<engine_id>`` plus a ``router`` stream for the
@@ -326,13 +367,27 @@ def _fleet_main(args, prompts, cfg, policy, params, fleet_kill,
         if args.deploy_round is not None:
             router.schedule_deploy(args.deploy_dir, args.deploy_round,
                                    step=args.deploy_step)
+        if args.deploy_watch is not None:
+            router.deploy_watch(args.deploy_dir, args.deploy_watch)
         shed = 0
-        for pr in prompts:
-            try:
-                router.submit(pr, args.max_new)
-            except AdmissionError:
-                shed += 1       # the router recorded the shed
-        router.run(log_every=args.log_every)
+        workload = None
+        if trace_doc is not None:
+            from .workload_driver import replay_trace
+            workload = replay_trace(
+                router, *trace_doc, vocab=args.vocab,
+                pace=args.trace_pace or "virtual",
+                steps_per_s=(args.trace_steps_per_s
+                             if args.trace_steps_per_s is not None
+                             else 8.0),
+                log_every=args.log_every, metrics=router_metrics)
+            shed = workload["shed"]
+        else:
+            for pr in prompts:
+                try:
+                    router.submit(pr, args.max_new)
+                except AdmissionError:
+                    shed += 1       # the router recorded the shed
+            router.run(log_every=args.log_every)
         # fetch outcomes BEFORE close: under the process transport
         # these are protocol calls the shut-down workers can't answer
         finished = router.results()
@@ -377,6 +432,8 @@ def _fleet_main(args, prompts, cfg, policy, params, fleet_kill,
         "fleet_rounds": stats["rounds"],
         "shed": shed,
     }
+    if workload is not None:
+        payload["workload"] = workload
     if args.metrics_dir:
         # where the live ops plane lives: `fleetstat <this>` renders
         # the router's atomic status doc, mid-run or after
@@ -405,11 +462,57 @@ def generate_main(argv=None) -> int:
     from .engine import AdmissionError, DecodeEngine, EngineConfig, \
         ServePolicy
 
-    if (args.prompts is None) == (args.prompt_lens is None):
-        print("error: pass exactly one of --prompts / --prompt_lens",
+    n_sources = sum(x is not None for x in
+                    (args.prompts, args.prompt_lens, args.trace,
+                     args.trace_gen))
+    if n_sources != 1:
+        print("error: pass exactly one of --prompts / --prompt_lens / "
+              "--trace / --trace_gen", file=sys.stderr)
+        return 2
+    trace_mode = args.trace is not None or args.trace_gen is not None
+    # trace-only knobs reject without a trace source (the fleet-flag
+    # discipline: silently ignoring them would break a scripted run)
+    if not trace_mode and (args.trace_out or args.trace_pace
+                           or args.trace_steps_per_s is not None):
+        print("error: --trace_out/--trace_pace/--trace_steps_per_s "
+              "shape a trace replay: pass --trace FILE or "
+              "--trace_gen SPEC", file=sys.stderr)
+        return 2
+    if args.trace_out and args.trace_gen is None:
+        print("error: --trace_out persists a GENERATED trace: pass "
+              "--trace_gen SPEC (a --trace file already exists)",
               file=sys.stderr)
         return 2
-    if args.prompts is not None:
+    if args.trace_steps_per_s is not None \
+            and args.trace_steps_per_s <= 0:
+        print(f"error: --trace_steps_per_s must be > 0, got "
+              f"{args.trace_steps_per_s}", file=sys.stderr)
+        return 2
+    if trace_mode and (args.snapshot_dir or args.chaos
+                       or args.watchdog_ms):
+        print("error: --trace replay drives the engine directly "
+              "(chaos composes at the FLEET level: --fleet_kill / "
+              "--fleet_chaos); drop --snapshot_dir/--chaos/"
+              "--watchdog_ms", file=sys.stderr)
+        return 2
+    trace_doc = None
+    if trace_mode:
+        from ..runtime.workload import (TraceError, generate_trace,
+                                        materialize_prompt,
+                                        read_trace, write_trace)
+        try:
+            if args.trace is not None:
+                trace_doc = read_trace(args.trace)
+            else:
+                trace_doc = generate_trace(args.trace_gen)
+                if args.trace_out:
+                    write_trace(args.trace_out, *trace_doc)
+            prompts = [materialize_prompt(trace_doc[0], e, args.vocab)
+                       for e in trace_doc[1]]
+        except (TraceError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+    elif args.prompts is not None:
         try:
             prompts = [[int(t) for t in grp.split(",") if t.strip()]
                        for grp in args.prompts.split(";") if grp.strip()]
@@ -473,7 +576,8 @@ def generate_main(argv=None) -> int:
                            or args.transport != "inproc"
                            or args.fleet_chaos or args.deploy_dir
                            or args.deploy_round is not None
-                           or args.deploy_step is not None):
+                           or args.deploy_step is not None
+                           or args.deploy_watch is not None):
         print("error: --prefill_engines/--fleet_kill/--transport/"
               "--fleet_chaos/--deploy_* are fleet flags: pass "
               "--fleet N (N >= 2)", file=sys.stderr)
@@ -542,10 +646,36 @@ def generate_main(argv=None) -> int:
                       file=sys.stderr)
                 return 2
             fleet_kill = (eng_id, at_round)
-        if (args.deploy_round is None) != (args.deploy_dir is None):
+        if args.deploy_watch is not None:
+            if args.deploy_watch <= 0:
+                print(f"error: --deploy_watch must be > 0 seconds, "
+                      f"got {args.deploy_watch}", file=sys.stderr)
+                return 2
+            if not args.deploy_dir:
+                print("error: --deploy_watch polls --deploy_dir's "
+                      "ledger — pass both", file=sys.stderr)
+                return 2
+            if args.deploy_round is not None:
+                print("error: --deploy_watch and --deploy_round are "
+                      "two triggers for one deploy: pick one (watch "
+                      "polls the ledger; round fires at a fixed "
+                      "round)", file=sys.stderr)
+                return 2
+            if args.deploy_step is not None:
+                # the watcher deploys whatever latest_verified
+                # advances to — silently dropping a pinned step would
+                # be exactly the ignored-flag failure this block
+                # exists to reject
+                print("error: --deploy_watch tracks the ledger's "
+                      "latest verified step; an explicit "
+                      "--deploy_step needs --deploy_round",
+                      file=sys.stderr)
+                return 2
+        elif (args.deploy_round is None) != (args.deploy_dir is None):
             print("error: a rolling deploy needs both --deploy_dir "
                   "(the version ledger) and --deploy_round (when to "
-                  "roll)", file=sys.stderr)
+                  "roll; or --deploy_watch to poll for publishes)",
+                  file=sys.stderr)
             return 2
         if args.deploy_step is not None and not args.deploy_dir:
             print("error: --deploy_step names a step of --deploy_dir "
@@ -598,9 +728,15 @@ def generate_main(argv=None) -> int:
                           file=sys.stderr)
                     return 2
 
-    longest = max(len(pr) for pr in prompts)
+    if trace_doc is not None:
+        # per-entry max_new: the reservation must cover the LONGEST
+        # (prompt + continuation) the trace asks for
+        need_tokens = max(len(pr) + int(e["max_new"])
+                          for pr, e in zip(prompts, trace_doc[1]))
+    else:
+        need_tokens = max(len(pr) for pr in prompts) + args.max_new
     mbps = args.max_blocks_per_seq or -(
-        -min(args.max_seq_len, longest + args.max_new) // args.block_size)
+        -min(args.max_seq_len, need_tokens) // args.block_size)
     n_blocks = args.n_blocks or 1 + args.max_slots * mbps
     try:
         cfg = EngineConfig(
@@ -674,7 +810,8 @@ def generate_main(argv=None) -> int:
 
     if args.fleet:
         return _fleet_main(args, prompts, cfg, policy, params,
-                           fleet_kill, fleet_chaos, argv)
+                           fleet_kill, fleet_chaos, argv,
+                           trace_doc=trace_doc)
 
     metrics = None
     engine_id = args.engine_id
@@ -702,6 +839,7 @@ def generate_main(argv=None) -> int:
 
     mesh_kw = dict(mesh=mesh, policy=policy)
     shed = 0
+    workload = None
     prior_tokens = 0
     resumed_from = None
     t0 = time.perf_counter()
@@ -726,6 +864,18 @@ def generate_main(argv=None) -> int:
                 snapshot_every=args.snapshot_every,
                 max_restarts=args.max_restarts)
             shed = engine.rejected
+        elif trace_doc is not None:
+            from .workload_driver import replay_trace
+            engine = DecodeEngine(params, args.heads, cfg,
+                                  metrics=metrics, **mesh_kw)
+            workload = replay_trace(
+                engine, *trace_doc, vocab=args.vocab,
+                pace=args.trace_pace or "virtual",
+                steps_per_s=(args.trace_steps_per_s
+                             if args.trace_steps_per_s is not None
+                             else 8.0),
+                log_every=args.log_every, metrics=metrics)
+            shed = workload["shed"]
         else:
             engine = DecodeEngine(params, args.heads, cfg,
                                   metrics=metrics, **mesh_kw)
@@ -784,6 +934,8 @@ def generate_main(argv=None) -> int:
         "expired": engine.expired,
         "shed": shed,
     }
+    if workload is not None:
+        payload["workload"] = workload
     if resumed_from is not None:
         payload["resumed_from_step"] = resumed_from
     if engine_id is not None:

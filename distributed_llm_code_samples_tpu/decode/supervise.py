@@ -87,7 +87,11 @@ SNAPSHOT_FILENAME = "engine_snapshot.json"
 # request's records keep stitching into the SAME cross-process trace
 # waterfall (the crash gap itself stays visibly unaccounted, exactly
 # the ``t_first`` stance).
-SNAPSHOT_VERSION = 7
+# v8 (round 19): request entries carry ``tenant`` — the tenant tag
+# (schema v13, None single-tenant) — so a crash-resumed or
+# kill-migrated request keeps its per-tenant attribution (the
+# workload plane's noisy-tenant numbers survive the death).
+SNAPSHOT_VERSION = 8
 
 
 # ---------------------------------------------------------------- snapshot
@@ -119,6 +123,7 @@ def snapshot_state(engine: DecodeEngine) -> dict:
             "t_first": engine.tracer.first_token_t(seq.uid),
             "weights_version": seq.weights_version,
             "trace_id": seq.trace_id,
+            "tenant": seq.tenant,
             "state": "RUNNING", "slot": slot,
             "position": int(engine.lengths[slot]),
             "prefilled": seq.prefilled,
@@ -133,6 +138,7 @@ def snapshot_state(engine: DecodeEngine) -> dict:
             "t_first": engine.tracer.first_token_t(seq.uid),
             "weights_version": seq.weights_version,
             "trace_id": seq.trace_id,
+            "tenant": seq.tenant,
             "state": "WAITING",
         })
     snap = {
@@ -297,7 +303,8 @@ def restore_engine_state(engine: DecodeEngine, snap: dict) -> None:
                               submit_step=req.get("submit_step"),
                               t_first=req.get("t_first"),
                               weights_version=req.get("weights_version"),
-                              trace=req.get("trace_id"))
+                              trace=req.get("trace_id"),
+                              tenant=req.get("tenant"))
     # auto-uid assignment must clear EVERY restored uid, not just the
     # live ones resume_request walked — a fresh submit colliding with a
     # finished uid would sample in lockstep with its twin and overwrite

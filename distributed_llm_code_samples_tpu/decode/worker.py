@@ -150,7 +150,8 @@ def worker_main(argv=None) -> int:
             return {"digest": hd.digest()}
         if op == "submit":
             entry = hd.submit(req["prompt"], req["max_new"],
-                              uid=req["uid"], trace=req.get("trace"))
+                              uid=req["uid"], trace=req.get("trace"),
+                              tenant=req.get("tenant"))
             return {"entry": entry, "digest": hd.digest()}
         if op == "resume":
             hd.resume_request(req["uid"], req["prompt"],
@@ -160,7 +161,8 @@ def worker_main(argv=None) -> int:
                               t_first=req.get("t_first"),
                               weights_version=req.get(
                                   "weights_version"),
-                              trace=req.get("trace"))
+                              trace=req.get("trace"),
+                              tenant=req.get("tenant"))
             return {"digest": hd.digest()}
         if op == "release":
             return {"entry": hd.release_request(req["uid"]),
@@ -546,15 +548,16 @@ class ProcessEngineHandle:
             "warm"]
 
     def submit(self, prompt, max_new: int, uid: int,
-               trace: str | None = None) -> dict:
+               trace: str | None = None,
+               tenant: str | None = None) -> dict:
         return self._call("submit", prompt=[int(t) for t in prompt],
                           max_new=int(max_new), uid=int(uid),
-                          trace=trace)["entry"]
+                          trace=trace, tenant=tenant)["entry"]
 
     def resume_request(self, uid: int, prompt, max_new: int, *, out=(),
                        retries: int = 0, t_submit=None,
                        t_first=None, weights_version=None,
-                       trace=None) -> None:
+                       trace=None, tenant=None) -> None:
         self._call("resume", uid=int(uid),
                    prompt=[int(t) for t in prompt],
                    max_new=int(max_new), out=[int(t) for t in out],
@@ -562,7 +565,7 @@ class ProcessEngineHandle:
                    t_first=t_first,
                    weights_version=(None if weights_version is None
                                     else int(weights_version)),
-                   trace=trace)
+                   trace=trace, tenant=tenant)
 
     def release_request(self, uid: int) -> dict:
         return self._call("release", uid=int(uid))["entry"]

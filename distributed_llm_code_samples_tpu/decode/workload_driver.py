@@ -1,0 +1,261 @@
+"""Trace replay: feed a workload trace into any serving target,
+deterministically.
+
+``runtime/workload.py`` defines what a workload IS (seeded arrivals,
+heavy-tail lengths, sessions, tenants, the versioned trace file); this
+module is the half that DRIVES one — into a single ``DecodeEngine``, an
+in-process ``FleetRouter``, or a process-transport fleet (the router
+API is transport-agnostic, so the driver never knows which). The CLI
+surface is ``generate --trace FILE`` / ``--trace_gen SPEC``.
+
+**Pacing.** Two clocks, one contract:
+
+- ``pace="virtual"`` (the CPU tier-1 mode): trace time maps onto the
+  target's scheduling rounds — an entry with offset ``t`` is submitted
+  at the START of the first round ``r`` with ``r / steps_per_s >= t``.
+  No wall clock anywhere in the loop, so the same ``(trace, seed)``
+  yields byte-identical tokens, identical admission order, and
+  identical ``workload`` records on every replay — **replay IS the
+  determinism proof**, and chaos (``kill_worker`` mid-trace, deploys)
+  composes on top because the router's round clock is the same clock.
+- ``pace="wall"`` (chip runs): offsets are real seconds from replay
+  start — the open-loop load a production fleet would see. Token
+  identity still holds (sampling never reads the clock); admission
+  order may legitimately vary with service speed, which is the point.
+
+**Accounting** (schema v13): one ``workload`` record per ``log_every``
+rounds plus a final one — trace identity, per-interval
+offered/admitted, cumulative per-tenant {offered, completed, shed} —
+through the target's existing ``TelemetryWriter`` (the emission rides
+the writer thread; nothing here touches a compiled program, and the
+zero-new-compiles-vs-hand-submission property is pinned by test).
+Sheds (``AdmissionError``) are counted per tenant by the driver — the
+router's shed record consumed the uid, but only the driver knows the
+whole offered load.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..runtime.workload import materialize_prompt, tenant_key
+from .engine import AdmissionError, DecodeEngine
+
+# consecutive no-progress rounds with live work before the replay is
+# declared stalled (mirrors DecodeEngine.run/FleetRouter.run's stall
+# refusal; a few idle rounds are legitimate while the virtual clock
+# walks toward the next arrival)
+_STALL_ROUNDS = 64
+
+
+class WorkloadDriver:
+    """One trace replay against one target.
+
+    ``target`` is a ``DecodeEngine`` or a ``FleetRouter`` (any
+    transport). ``metrics`` is the writer the ``workload`` records ride
+    (default: the router's own writer / the engine's) — per-request
+    ``request``/``span`` records flow through the engines' writers as
+    always; the driver adds only the workload plane."""
+
+    def __init__(self, target, header: dict, entries: list[dict], *,
+                 vocab: int, pace: str = "virtual",
+                 steps_per_s: float = 8.0, log_every: int = 0,
+                 metrics=None):
+        if pace not in ("virtual", "wall"):
+            raise ValueError(f"pace must be 'virtual' or 'wall', got "
+                             f"{pace!r}")
+        if steps_per_s <= 0:
+            raise ValueError(f"steps_per_s must be > 0, got "
+                             f"{steps_per_s}")
+        self.target = target
+        self.header = header
+        self.entries = entries
+        self.vocab = int(vocab)
+        self.pace = pace
+        self.steps_per_s = float(steps_per_s)
+        self.log_every = int(log_every)
+        self.is_fleet = not isinstance(target, DecodeEngine)
+        self.metrics = metrics if metrics is not None else (
+            target.metrics)
+        # the trace identity every workload record pins
+        self.trace = {"id": header["id"],
+                      "version": header["trace_version"]}
+        # driver-side books (the router/engine never see the whole
+        # offered load — sheds consume nothing downstream)
+        self.uid_tenant: dict[int, str] = {}
+        self.offered: dict[str, int] = {}
+        self.shed: dict[str, int] = {}
+        self.rounds = 0
+        self._interval_offered = 0
+        self._interval_admitted = 0
+        self.total_offered = 0
+        self.total_admitted = 0
+
+    # -- target shims (engine vs router) -------------------------------
+
+    def _has_work(self) -> bool:
+        if self.is_fleet:
+            return self.target.has_work
+        return bool(self.target.waiting or self.target.active)
+
+    def _step(self) -> bool:
+        return self.target.step()
+
+    def _pending_chaos(self) -> bool:
+        if self.is_fleet:
+            return self.target._pending_kills()
+        return False
+
+    def _submit(self, entry: dict) -> None:
+        prompt = materialize_prompt(self.header, entry, self.vocab)
+        tk = tenant_key(entry.get("tenant"))
+        self.offered[tk] = self.offered.get(tk, 0) + 1
+        self._interval_offered += 1
+        self.total_offered += 1
+        try:
+            if self.is_fleet:
+                uid = self.target.submit(prompt, int(entry["max_new"]),
+                                         session=entry.get("session"),
+                                         tenant=entry.get("tenant"))
+            else:
+                uid = self.target.submit(prompt, int(entry["max_new"]),
+                                         tenant=entry.get("tenant"))
+        except AdmissionError:
+            self.shed[tk] = self.shed.get(tk, 0) + 1
+            return
+        self.uid_tenant[uid] = tk
+        self._interval_admitted += 1
+        self.total_admitted += 1
+
+    def _completed_by_tenant(self) -> dict[str, int]:
+        """Cumulative per-tenant completions — engine-side a dict
+        read; fleet-side one ``results`` round-trip per alive worker
+        (cadence-only, the emit_decode stance)."""
+        finished = (self.target.results() if self.is_fleet
+                    else self.target.finished)
+        done: dict[str, int] = {}
+        for uid in finished:
+            tk = self.uid_tenant.get(int(uid))
+            if tk is not None:
+                done[tk] = done.get(tk, 0) + 1
+        return done
+
+    def _tenants_block(self, completed: dict) -> dict:
+        """The cumulative per-tenant book — ONE builder for the
+        workload records and the run summary."""
+        return {
+            t: {"offered": self.offered.get(t, 0),
+                "completed": completed.get(t, 0),
+                "shed": self.shed.get(t, 0)}
+            for t in sorted(set(self.offered) | set(completed)
+                            | set(self.shed))
+        }
+
+    def _emit_workload(self, completed: dict | None = None) -> None:
+        if self.metrics is None:
+            return
+        if completed is None:
+            completed = self._completed_by_tenant()
+        self.metrics.workload({
+            "step": self.rounds,
+            "trace": dict(self.trace),
+            "offered": self._interval_offered,
+            "admitted": self._interval_admitted,
+            "tenants": self._tenants_block(completed),
+        })
+        self._interval_offered = 0
+        self._interval_admitted = 0
+
+    def _emit_decode_cadence(self) -> None:
+        """Per-engine decode cadence records (the router/engine's
+        ``run()`` owns this normally; the driver steps manually, so it
+        owns the cadence here)."""
+        if self.is_fleet:
+            self.target._emit_decode_records()
+        elif self.metrics is not None:
+            now = time.perf_counter()
+            delta = self.target.tokens_generated - self._last_tokens
+            dt = max(now - self._last_t, 1e-9)
+            tps = round(delta / dt, 2) if delta > 0 else None
+            self.metrics.decode(self.target.telemetry_record(tps))
+            self._last_t, self._last_tokens = \
+                now, self.target.tokens_generated
+
+    # -- the replay loop ----------------------------------------------
+
+    def run(self) -> dict:
+        """Drain the whole trace; returns the workload summary (the
+        CLI payload's ``workload`` block)."""
+        entries = self.entries
+        i = 0
+        stalled = 0
+        t0 = time.monotonic()
+        self._last_t = time.perf_counter()
+        self._last_tokens = (0 if self.is_fleet
+                             else self.target.tokens_generated)
+        while i < len(entries) or self._has_work():
+            now_s = (self.rounds / self.steps_per_s
+                     if self.pace == "virtual"
+                     else time.monotonic() - t0)
+            while (i < len(entries)
+                   and float(entries[i]["t_offset_s"]) <= now_s + 1e-9):
+                self._submit(entries[i])
+                i += 1
+            did = self._step()
+            self.rounds += 1
+            if self.log_every > 0 and self.rounds % self.log_every == 0:
+                self._emit_decode_cadence()
+                self._emit_workload()
+            if did or not self._has_work():
+                stalled = 0
+            elif i >= len(entries) and not self._pending_chaos():
+                # live work, nothing left to arrive, no chaos pending,
+                # and the target ran nothing — the run()-stall refusal
+                stalled += 1
+                if stalled >= _STALL_ROUNDS:
+                    raise RuntimeError(
+                        "trace replay stalled: live requests but the "
+                        "target ran no work for "
+                        f"{_STALL_ROUNDS} rounds")
+            if (self.pace == "wall" and i < len(entries)
+                    and not self._has_work()):
+                # idle until the next arrival — don't busy-spin a real
+                # clock (the virtual clock advances by round instead)
+                wait = float(entries[i]["t_offset_s"]) \
+                    - (time.monotonic() - t0)
+                if wait > 0:
+                    time.sleep(min(wait, 0.05))
+        # ONE drain-end completions sweep feeds the final workload
+        # record AND the summary (under the process transport each
+        # sweep is a results round-trip per alive worker)
+        completed = self._completed_by_tenant()
+        self._emit_decode_cadence()
+        self._emit_workload(completed)
+        if self.is_fleet:
+            # the drain-end ops-plane flush FleetRouter.run performs
+            # (the driver replaced run(), so it owes the same epilogue)
+            self.target.emit_transport_stats()
+            self.target._publish_status(force=True)
+        return {
+            "trace": dict(self.trace),
+            "pace": self.pace,
+            "steps_per_s": (self.steps_per_s
+                            if self.pace == "virtual" else None),
+            "rounds": self.rounds,
+            "entries": len(entries),
+            "offered": self.total_offered,
+            "admitted": self.total_admitted,
+            "shed": self.total_offered - self.total_admitted,
+            "tenants": self._tenants_block(completed),
+        }
+
+
+def replay_trace(target, header: dict, entries: list[dict], *,
+                 vocab: int, pace: str = "virtual",
+                 steps_per_s: float = 8.0, log_every: int = 0,
+                 metrics=None) -> dict:
+    """One-call replay (see ``WorkloadDriver``): drive ``entries``
+    into ``target`` and return the workload summary."""
+    return WorkloadDriver(target, header, entries, vocab=vocab,
+                          pace=pace, steps_per_s=steps_per_s,
+                          log_every=log_every, metrics=metrics).run()

@@ -71,6 +71,10 @@ def render(doc: dict) -> str:
                    f"(+{e.get('evictable_blocks')} evictable)  util "
                    f"{e.get('utilization')}  last step "
                    f"{(e.get('last_step_s') or 0.0) * 1e3:.1f} ms")
+    tens = doc.get("tenants") or {}
+    for t, c in sorted(tens.items()):
+        out.append(f"  tenant {t:10s} in-flight {c.get('in_flight')}  "
+                   f"offered {c.get('offered')}  shed {c.get('shed')}")
     c = doc.get("counters") or {}
     out.append("  counters: " + ", ".join(
         f"{k} {c.get(k)}" for k in ("routed", "handoffs", "migrations",

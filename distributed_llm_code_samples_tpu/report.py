@@ -284,6 +284,9 @@ class _Stream:
         self.fleets = by.get("fleet", [])
         # schema-v11 rolling-deploy lifecycle records (decode/fleet.py)
         self.deploys = by.get("deploy", [])
+        # schema-v13 trace-replay interval records (the workload
+        # driver, decode/workload_driver.py)
+        self.workloads = by.get("workload", [])
         # request records: drop exact replays — an in-process
         # supervisor restart resumes from a snapshot that may PREDATE
         # records already emitted, so the replayed steps re-emit
@@ -658,6 +661,15 @@ class _Stream:
                 what = f"DEPLOY {ev} {pair}"
             timeline.append((d["t"], "deploy",
                              what + f" @ fleet round {d.get('step')}"))
+        for wrec in self.workloads:
+            tb = ", ".join(
+                f"{t}:{c.get('completed')}/{c.get('offered')}"
+                for t, c in sorted((wrec.get("tenants") or {}).items()))
+            timeline.append((
+                wrec["t"], "workld",
+                f"interval offered {wrec.get('offered')} admitted "
+                f"{wrec.get('admitted')} @ round {wrec.get('step')}"
+                + (f"  [{tb}]" if tb else "")))
         for r in self.requests:
             ev = r["event"]
             bits = [f"request {r.get('uid')} {ev.upper()}"
@@ -766,7 +778,8 @@ def _slo_accounting(streams, slo_ttft: float, slo_itl: float) -> dict:
         ttft = rec.get("ttft_s")
         n_new = rec.get("n_new")
         entry = {"uid": uid, "latency_s": latency, "ttft_s": ttft,
-                 "n_new": n_new, "migrated": uid in moved_t}
+                 "n_new": n_new, "migrated": uid in moved_t,
+                 "tenant": _tenant_of(rec)}
         spans = spans_by_uid.get(uid, [])
         if latency is None or ttft is None:
             entry["status"] = "unreconciled"
@@ -876,12 +889,25 @@ def _slo_accounting(streams, slo_ttft: float, slo_itl: float) -> dict:
             counts["violated"] += 1
         per_uid.append(entry)
     total = len(per_uid)
+    # the per-tenant goodput slice (v13): the same fold, grouped by
+    # the completed record's tenant — the noisy-tenant drill's numbers
+    by_tenant: dict = {}
+    for e in per_uid:
+        b = by_tenant.setdefault(e["tenant"], {
+            "completed": 0, "attained": 0, "violated": 0,
+            "unreconciled": 0})
+        b["completed"] += 1
+        b[e["status"]] += 1
+    for b in by_tenant.values():
+        b["attainment"] = (round(b["attained"] / b["completed"], 4)
+                           if b["completed"] else None)
     return {
         "slo_ttft_s": slo_ttft, "slo_itl_s": slo_itl,
         "completed": total, **counts,
         "attainment": (round(counts["attained"] / total, 4)
                        if total else None),
         "violations_by_span": by_span,
+        "by_tenant": by_tenant,
         "requests": per_uid,
     }
 
@@ -1323,6 +1349,162 @@ def _render_fleet_health(out: list, fh: dict) -> None:
                    f"imb {row['load_imbalance']:.2f}  {cells}")
 
 
+def _tenant_of(rec) -> str:
+    """The per-tenant bucket key (schema v13): null tenants fold under
+    the driver's single-tenant bucket — ONE definition
+    (runtime/workload.py ``tenant_key``), so record-side and
+    driver-side counts reconcile key for key by construction."""
+    from .runtime.workload import tenant_key
+    return tenant_key(rec.get("tenant"))
+
+
+def _workload_fold(streams) -> dict | None:
+    """Fold the schema-v13 workload plane: trace identity + the
+    offered-vs-served interval curve from the driver's ``workload``
+    records, and per-tenant latency/TTFT/ITL percentiles +
+    shed/quarantine counts from the per-request records — with the
+    cross-check that the driver's cumulative per-tenant counts
+    RECONCILE with the request records (sum of per-tenant completions
+    == fleet-wide completions; a mismatch renders, never hides)."""
+    wl_recs = sorted((r for s in streams for r in s.workloads),
+                     key=lambda r: (r.get("t", 0.0), r.get("step", 0)))
+    comp = _merged_completions(streams)
+    has_tenants = any(r.get("tenant") is not None
+                      for s in streams for r in s.requests)
+    if not wl_recs and not has_tenants:
+        return None
+    out: dict = {}
+    if wl_recs:
+        out["trace"] = wl_recs[0].get("trace")
+        n = len(wl_recs)
+        idx = (range(n) if n <= 16 else
+               sorted({round(i * (n - 1) / 15) for i in range(16)}))
+        out["intervals"] = [{
+            "step": wl_recs[i].get("step"),
+            "offered": wl_recs[i].get("offered"),
+            "admitted": wl_recs[i].get("admitted"),
+        } for i in idx]
+        out["offered_total"] = sum(int(r.get("offered") or 0)
+                                   for r in wl_recs)
+        out["admitted_total"] = sum(int(r.get("admitted") or 0)
+                                    for r in wl_recs)
+        # the driver's cumulative per-tenant book: the LAST record is
+        # the totals (monotonic by contract)
+        out["driver_tenants"] = wl_recs[-1].get("tenants") or {}
+    # per-tenant slices off the per-request records (merged + deduped
+    # like every fleet-level read)
+    tenants: dict = {}
+
+    def bucket(t):
+        return tenants.setdefault(t, {
+            "completed": 0, "quarantined": 0, "shed": 0,
+            "latencies": [], "ttfts": []})
+
+    for r in comp.values():
+        b = bucket(_tenant_of(r))
+        b["completed"] += 1
+        if r.get("latency_s") is not None:
+            b["latencies"].append(r["latency_s"])
+        if r.get("ttft_s") is not None:
+            b["ttfts"].append(r["ttft_s"])
+    seen_q = set()
+    seen_exp = set()
+    for s in streams:
+        for r in s.requests:
+            key = (r.get("uid"), r.get("event"), r.get("step"))
+            if r["event"] == "quarantined":
+                if key in seen_q:
+                    continue
+                seen_q.add(key)
+                bucket(_tenant_of(r))["quarantined"] += 1
+            elif r["event"] == "expired":
+                # by UID, not (uid, step): a request that expired on a
+                # dead engine after its last snapshot re-expires on the
+                # survivor it was replayed to — two records, ONE
+                # caller-visible loss (the fleet summary's
+                # expired_uids stance)
+                if r.get("uid") in seen_exp:
+                    continue
+                seen_exp.add(r.get("uid"))
+                bucket(_tenant_of(r))["shed"] += 1
+    # driver-counted admission sheds (the request records never saw a
+    # shed request's tenant — the anonymous uid -1)
+    for t, c in (out.get("driver_tenants") or {}).items():
+        if c.get("shed"):
+            bucket(t)["shed"] += int(c["shed"])
+    # per-tenant ITL off the decode-segment spans (spans pin tenant)
+    itl: dict = {}
+    for ss in _merged_spans(streams).values():
+        for sp in ss:
+            if sp["span"] == "decode" and sp.get("tokens") \
+                    and sp.get("duration_s") is not None:
+                itl.setdefault(_tenant_of(sp), []).append(
+                    sp["duration_s"] / sp["tokens"])
+    folded = {}
+    for t in sorted(tenants):
+        b = tenants[t]
+        e = {"completed": b["completed"],
+             "quarantined": b["quarantined"], "shed": b["shed"]}
+        if b["latencies"]:
+            (e["latency_p50_s"], e["latency_p90_s"],
+             e["latency_p99_s"]) = _pct3(b["latencies"])
+        if b["ttfts"]:
+            (e["ttft_p50_s"], e["ttft_p90_s"],
+             e["ttft_p99_s"]) = _pct3(b["ttfts"])
+        if itl.get(t):
+            (e["itl_p50_s"], e["itl_p90_s"],
+             e["itl_p99_s"]) = _pct3(itl[t], 6)
+        folded[t] = e
+    out["tenants"] = folded
+    # the reconciliation: per-tenant sums vs fleet totals, and the
+    # driver's book vs the records' — numbers that disagree are a
+    # measurement bug, so the report SAYS so instead of averaging it
+    total_completed = sum(e["completed"] for e in folded.values())
+    out["completed_total"] = len(comp)
+    out["reconciled"] = total_completed == len(comp)
+    if wl_recs:
+        drv = out["driver_tenants"]
+        rec_ok = all(
+            folded.get(t, {}).get("completed") == c.get("completed")
+            for t, c in drv.items())
+        out["reconciled"] = out["reconciled"] and rec_ok
+    return out
+
+
+def _render_workload(out: list, wl: dict) -> None:
+    out.append("")
+    tr = wl.get("trace") or {}
+    head = "workload"
+    if tr:
+        head += (f" [trace {tr.get('id')} v{tr.get('version')}]")
+    offered = wl.get("offered_total")
+    if offered is not None:
+        head += (f": {offered} offered, {wl.get('admitted_total')} "
+                 f"admitted, {wl.get('completed_total')} completed")
+    out.append(head + ("" if wl["reconciled"] else
+                       "  [NOT RECONCILED — per-tenant sums disagree "
+                       "with fleet totals]"))
+    for t, e in wl["tenants"].items():
+        line = (f"  tenant {t:10s} {e['completed']} completed, "
+                f"{e['shed']} shed, {e['quarantined']} quarantined")
+        if "latency_p50_s" in e:
+            line += (f"  latency p50 {e['latency_p50_s']}s "
+                     f"p99 {e['latency_p99_s']}s")
+        if "ttft_p50_s" in e:
+            line += (f"  TTFT p50 {e['ttft_p50_s']}s "
+                     f"p99 {e['ttft_p99_s']}s")
+        if "itl_p50_s" in e:
+            line += (f"  ITL p50 {e['itl_p50_s']}s "
+                     f"p99 {e['itl_p99_s']}s")
+        out.append(line)
+    if wl.get("intervals"):
+        out.append("  offered vs admitted per interval (sampled):")
+        for row in wl["intervals"]:
+            out.append(f"    round {row['step']:>4}  offered "
+                       f"{row['offered']:>3}  admitted "
+                       f"{row['admitted']:>3}")
+
+
 def _render_slo(out: list, slo: dict) -> None:
     out.append("")
     pct = ("n/a" if slo["attainment"] is None
@@ -1337,6 +1519,17 @@ def _render_slo(out: list, slo: dict) -> None:
             f"{k} {v}" for k, v in sorted(
                 slo["violations_by_span"].items(),
                 key=lambda kv: -kv[1])))
+    bt = slo.get("by_tenant") or {}
+    if bt and set(bt) != {"default"}:
+        # the per-tenant goodput slice (v13): print only on a real
+        # multi-tenant run — a single-tenant report already said it
+        for t, b in sorted(bt.items()):
+            pct = ("n/a" if b["attainment"] is None
+                   else f"{b['attainment'] * 100:.1f}%")
+            out.append(f"  tenant {t:10s} goodput {pct} — "
+                       f"{b['attained']}/{b['completed']} attained, "
+                       f"{b['violated']} violated, "
+                       f"{b['unreconciled']} unreconciled")
     for e in slo["requests"]:
         if e["status"] == "attained":
             continue
@@ -1807,6 +2000,9 @@ def report_main(argv=None) -> int:
     fh = _fleet_health(streams)
     if fh:
         doc["fleet_health"] = fh
+    wl = _workload_fold(streams)
+    if wl:
+        doc["workload"] = wl
     tp = _transport_fold(streams)
     if tp:
         doc["transport"] = tp
@@ -1933,6 +2129,8 @@ def report_main(argv=None) -> int:
                     fl["completed_by_version"].items())))
     if doc.get("fleet_health"):
         _render_fleet_health(out, doc["fleet_health"])
+    if doc.get("workload"):
+        _render_workload(out, doc["workload"])
     if doc.get("transport"):
         _render_transport(out, doc["transport"])
     if doc.get("slo"):

@@ -129,7 +129,23 @@ import numpy as np
 # per-op RPC call/handle durations, decode/fleet.py) and the live
 # status doc (STATUS_FILENAME) is a wire-published JSON document, not
 # a stream record.
-SCHEMA_VERSION = 12
+# v13 (round 19): the trace-driven workload plane (DESIGN.md
+# section 25). (1) every "request" AND "span" record pins ``tenant``
+# — the request's tenant tag (null single-tenant; null on the
+# anonymous rejected uid -1), minted at submit and carried through
+# replay, preemption, migration (handoff doc v6), and crash-resume
+# (snapshot v8) exactly like ``trace_id`` — the per-tenant
+# attribution ``report``'s workload block and the per-tenant SLO
+# slice fold. (2) adds the "workload" kind — one record per replay
+# interval from the workload driver (``decode/workload_driver.py``):
+# ``trace`` pins the trace identity ({id, version} — the
+# runtime/workload.py header), ``offered``/``admitted`` the
+# PER-INTERVAL submission counts (offered - admitted = sheds this
+# interval), and ``tenants`` the CUMULATIVE per-tenant
+# offered/completed/shed counts (monotonic across the run, so the
+# final record is the totals and the sums reconcile against the
+# per-request records — pinned by test).
+SCHEMA_VERSION = 13
 
 METRICS_FILENAME = "metrics.jsonl"
 
@@ -237,8 +253,12 @@ DECODE_REQUIRED = ("step", "tokens_per_sec", "batch_occupancy",
 # v12: ``trace_id`` — the request's fleet-unique causal identity
 # (minted once at admission, carried through every move; null only on
 # the anonymous rejected uid -1).
+# v13: ``tenant`` — the request's tenant tag (null single-tenant and
+# on the anonymous rejected uid -1), set at submit and carried like
+# ``trace_id`` — the per-tenant accounting key the workload plane
+# slices on.
 REQUEST_REQUIRED = ("step", "uid", "event", "reason",
-                    "weights_version", "trace_id")
+                    "weights_version", "trace_id", "tenant")
 
 # the extra keys a COMPLETED request record must also carry (v9) —
 # enforced conditionally by validate_record (other events never
@@ -258,9 +278,12 @@ REQUEST_COMPLETED_REQUIRED = ("latency_s", "ttft_s")
 # ``(uid, span, start_step, step)``, the request-record dedup stance.
 # v12: ``trace_id`` — the owning request's causal identity (the
 # stitch key of the cross-process trace waterfall).
+# v13: ``tenant`` — the owning request's tenant tag (null
+# single-tenant), so per-tenant ITL percentiles come straight off the
+# decode-segment spans.
 # Same version-bump discipline as STEP_KEYS.
 SPAN_REQUIRED = ("step", "uid", "span", "start_step", "duration_s",
-                 "trace_id")
+                 "trace_id", "tenant")
 
 # The span vocabulary (runtime/tracing.py callers use these; report
 # renders any name, so a new phase is additive)
@@ -350,6 +373,20 @@ DEPLOY_EVENT_REQUIRED = {
     "rolled_back": ("duration_s", "reason"),
 }
 
+# The workload-record contract (``decode/workload_driver.py``, v13):
+# one record per trace-replay interval. ``step`` is the driver's
+# virtual round clock at emit time, ``trace`` the trace identity
+# ({id, version} — the runtime/workload.py header's stable hash, so
+# two replays of one trace pin the same identity), ``offered`` /
+# ``admitted`` the PER-INTERVAL submission counts (offered - admitted
+# = sheds this interval), ``tenants`` the CUMULATIVE per-tenant
+# {offered, completed, shed} counts (monotonic — the final record is
+# the run's totals, and the per-tenant sums must reconcile with the
+# request records' per-tenant counts). Same version-bump discipline
+# as STEP_KEYS.
+WORKLOAD_REQUIRED = ("step", "trace", "offered", "admitted",
+                     "tenants")
+
 # Non-step record kinds the stream also carries: run headers ("meta"),
 # recovery/chaos/checkpoint events ("event"), bench measurement rows
 # ("bench" — bench.py's per-measurement plumbing rides the same
@@ -358,7 +395,7 @@ DEPLOY_EVENT_REQUIRED = {
 # per-request phase records.
 RECORD_KINDS = ("step", "meta", "event", "bench", "anomaly", "rollback",
                 "decode", "request", "span", "router", "fleet",
-                "deploy")
+                "deploy", "workload")
 
 # kind -> the pinned required-key set validate_record enforces (step
 # records additionally pin their FULL key set via STEP_KEYS)
@@ -372,6 +409,7 @@ REQUIRED_KEYS = {
     "router": ROUTER_REQUIRED,
     "fleet": FLEET_REQUIRED,
     "deploy": DEPLOY_REQUIRED,
+    "workload": WORKLOAD_REQUIRED,
 }
 
 # bf16 peak matmul FLOP/s by chip generation (public spec sheets; the
@@ -592,6 +630,7 @@ class TelemetryWriter:
         rec.setdefault("reason", None)
         rec.setdefault("weights_version", None)
         rec.setdefault("trace_id", None)
+        rec.setdefault("tenant", None)
         rec["kind"] = "request"
         self._put(rec)
 
@@ -621,6 +660,16 @@ class TelemetryWriter:
         rec["kind"] = "router"
         self._put(rec)
 
+    def workload(self, record: dict) -> None:
+        """Enqueue one trace-replay interval record: trace identity,
+        per-interval offered/admitted, cumulative per-tenant counts
+        (``decode/workload_driver.py``; ``WORKLOAD_REQUIRED``
+        contract)."""
+        rec = dict(record)
+        rec.setdefault("t", time.time())
+        rec["kind"] = "workload"
+        self._put(rec)
+
     def fleet(self, record: dict) -> None:
         """Enqueue one per-round fleet health record: per-engine
         waiting/active/free-blocks/utilization plus the load-imbalance
@@ -639,6 +688,7 @@ class TelemetryWriter:
         rec = dict(record)
         rec.setdefault("t", time.time())
         rec.setdefault("trace_id", None)
+        rec.setdefault("tenant", None)
         rec["kind"] = "span"
         self._put(rec)
 

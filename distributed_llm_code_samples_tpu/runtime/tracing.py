@@ -73,15 +73,20 @@ class SpanTracer:
     it at construction. ``trace_fn(uid)`` returns the uid's causal
     ``trace_id`` (schema v12: every span record pins it — the stitch
     key of the cross-process trace waterfall; None with no trace
-    plumbed, e.g. standalone tracer tests). All methods are host-side
-    and O(1); with no writer attached the tracer still tracks phases
-    (close/transition stay cheap no-ops on the emit half).
+    plumbed, e.g. standalone tracer tests). ``tenant_fn(uid)`` returns
+    the uid's tenant tag (schema v13: every span record pins it — the
+    per-tenant ITL slice reads decode-segment spans by tenant; None
+    single-tenant). All methods are host-side and O(1); with no writer
+    attached the tracer still tracks phases (close/transition stay
+    cheap no-ops on the emit half).
     """
 
     def __init__(self, metrics_fn: Callable,
-                 trace_fn: Callable | None = None):
+                 trace_fn: Callable | None = None,
+                 tenant_fn: Callable | None = None):
         self._metrics_fn = metrics_fn
         self._trace_fn = trace_fn
+        self._tenant_fn = tenant_fn
         self._open: dict[int, dict] = {}   # uid -> open-span state
         # uid -> wall clock of the FIRST live token (round 15, the
         # TTFT decomposition): marked once at the prefill-completing
@@ -154,6 +159,8 @@ class SpanTracer:
             "uid": uid,
             "trace_id": (self._trace_fn(uid) if self._trace_fn
                          is not None else None),
+            "tenant": (self._tenant_fn(uid) if self._tenant_fn
+                       is not None else None),
             "span": cur["span"],
             "start_step": cur["start_step"],
             "step": end_step,

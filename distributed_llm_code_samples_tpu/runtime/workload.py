@@ -1,0 +1,507 @@
+"""Trace-driven workload layer: replayable load traces for the fleet.
+
+Every throughput/SLO claim before round 19 was measured on hand-rolled
+prompt waves — fixed lengths, submitted all at once. DistServe's
+goodput framing only means something relative to a STATED workload,
+and Sarathi-Serve's stall-centric ITL behavior emerges specifically
+under bursty arrivals and heavy-tail lengths that fixed waves never
+exercise. This module is the missing measurement plane's input half: a
+seeded, fully deterministic trace **generator** plus a versioned JSONL
+trace **file format**, so "heavy traffic" claims are falsifiable —
+the same ``(trace, seed)`` replayed twice yields byte-identical tokens
+and identical admission order (``decode/workload_driver.py`` is the
+replay half).
+
+**The spec grammar** (``--trace_gen``; comma-separated ``key=value``,
+the ``--chaos`` parse-rejection discipline — every malformed entry is
+ONE ValueError naming the offense)::
+
+    spec    := entry ("," entry)*
+    entry   := "n=" INT                          total requests (required)
+             | "arrival=" ARRIVAL                default poisson:8
+             | "plen=" SAMPLER                   default fixed:6
+             | "max_new=" SAMPLER | INT          default fixed:4
+             | "tenants=" NAME ":" W (";" NAME ":" W)*   default none
+             | "sessions=" K [":" GROW]          default none
+             | "seed=" INT                       default 0
+    ARRIVAL := "poisson:" RATE                   open-loop, rate req/s
+             | "bursty:" RATE ":" ON_S ":" OFF_S on/off bursts
+             | "ramp:" LO ":" HI                 rate ramps LO -> HI
+    SAMPLER := "fixed:" N
+             | "uniform:" LO ":" HI
+             | "zipf:" ALPHA ":" LO ":" HI       heavy tail, clamped
+
+- **Arrivals** are OPEN-LOOP (the DistServe stance): offsets are drawn
+  up front from the seeded RNG, independent of service times, so an
+  overloaded fleet sees the queue build instead of the workload
+  politely backing off. ``bursty`` alternates ON windows at RATE with
+  silent OFF windows; ``ramp`` interpolates the rate linearly across
+  the trace (the diurnal shape compressed).
+- **Heavy-tail lengths**: ``zipf:a:lo:hi`` draws ``lo - 1 + Zipf(a)``
+  clamped to ``[lo, hi]`` — most prompts short, a heavy tail of long
+  ones, bounds explicit so a trace can never exceed an engine's
+  capacity by accident.
+- **Sessions** (``sessions=K[:GROW]``): requests are dealt round-robin
+  to K sessions; a session's turn ``t`` prompt is the first
+  ``base + t * GROW`` tokens of ONE fixed per-session token stream, so
+  each turn's prompt literally REGROWS the previous turn's as a prefix
+  — the chat-shaped workload the radix prefix cache exists for
+  (``decode/prefix.py``). GROW defaults to 4.
+- **Tenants** (``tenants=a:3;b:1``): each request is tagged with a
+  tenant drawn from the weighted mix (seeded). The tag travels the
+  whole serving plane (schema v13: pinned on request/span records,
+  folded per-tenant by ``report``) — the noisy-tenant drill is this
+  knob plus two traces.
+
+**The trace file** (``TRACE_VERSION`` 1): line 1 is the header
+``{"trace_version", "id", "seed", "spec", "n"}`` — ``id`` is a stable
+hash of ``(spec, seed)``, the identity ``workload`` telemetry records
+pin — then one JSON object per request::
+
+    {"t_offset_s", "uid_hint", "tenant", "session", "prompt_len",
+     "max_new", "turn"}
+
+``prompt_tokens`` (an explicit id list) may replace ``prompt_len`` for
+hand-written traces; generated traces store lengths and the driver
+materializes token ids deterministically from ``(seed, session)`` —
+same stream per session, which is what makes turn prompts shared
+prefixes. ``read_trace`` REJECTS damage with one-line ``TraceError``s
+(missing/ bad header, version skew, missing keys, non-monotonic
+offsets, torn tail): a trace is a determinism proof's input, so a torn
+file is rc 2, never a best-effort parse (the opposite stance from the
+telemetry stream's skip-and-report).
+
+Deliberately jax-free (stdlib + numpy): generating a trace must not
+pay a backend import, and the report/fleetstat tooling can read trace
+identities without one.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+import numpy as np
+
+TRACE_VERSION = 1
+
+# header + per-line required keys (the file-format contract
+# tests/test_workload.py pins; prompt_tokens may replace prompt_len)
+TRACE_HEADER_KEYS = ("trace_version", "id", "seed", "spec", "n")
+TRACE_ENTRY_KEYS = ("t_offset_s", "uid_hint", "tenant", "session",
+                    "max_new", "turn")
+
+ARRIVAL_KINDS = ("poisson", "bursty", "ramp")
+SAMPLER_KINDS = ("fixed", "uniform", "zipf")
+
+
+class TraceError(ValueError):
+    """A trace file failed validation (one-line named reason)."""
+
+
+# the per-tenant JSON bucket for the single-tenant (None) case — ONE
+# definition shared by the replay driver's cumulative book and the
+# report fold, so the two sides can never drift on the key and break
+# the reconciliation
+DEFAULT_TENANT = "default"
+
+
+def tenant_key(tenant) -> str:
+    return DEFAULT_TENANT if tenant is None else str(tenant)
+
+
+def _positive(name: str, val: float, *, integer: bool = False):
+    if integer and val != int(val):
+        raise ValueError(f"bad --trace_gen {name} {val!r}: must be an "
+                         "integer")
+    if val <= 0:
+        raise ValueError(f"bad --trace_gen {name} {val!r}: must be > 0")
+    return int(val) if integer else float(val)
+
+
+def _parse_sampler(name: str, text: str) -> tuple:
+    kind, _, rest = text.partition(":")
+    if kind not in SAMPLER_KINDS:
+        raise ValueError(f"bad --trace_gen {name} kind {kind!r}: known "
+                         f"samplers {SAMPLER_KINDS}")
+    parts = rest.split(":") if rest else []
+    try:
+        args = [float(x) for x in parts]
+    except ValueError:
+        raise ValueError(f"bad --trace_gen {name} args {rest!r}: "
+                         "sampler args are numbers") from None
+    if kind == "fixed":
+        if len(args) != 1:
+            raise ValueError(f"bad --trace_gen {name}: fixed takes "
+                             "exactly one arg (fixed:N)")
+        return ("fixed", _positive(name, args[0], integer=True))
+    if kind == "uniform":
+        if len(args) != 2:
+            raise ValueError(f"bad --trace_gen {name}: uniform takes "
+                             "LO:HI")
+        lo = _positive(name, args[0], integer=True)
+        hi = _positive(name, args[1], integer=True)
+        if hi < lo:
+            raise ValueError(f"bad --trace_gen {name}: uniform hi "
+                             f"{hi} < lo {lo}")
+        return ("uniform", lo, hi)
+    if len(args) != 3:
+        raise ValueError(f"bad --trace_gen {name}: zipf takes "
+                         "ALPHA:LO:HI")
+    alpha = args[0]
+    if alpha <= 1.0:
+        raise ValueError(f"bad --trace_gen {name}: zipf alpha "
+                         f"{alpha!r} must be > 1")
+    lo = _positive(name, args[1], integer=True)
+    hi = _positive(name, args[2], integer=True)
+    if hi < lo:
+        raise ValueError(f"bad --trace_gen {name}: zipf hi {hi} < lo "
+                         f"{lo}")
+    return ("zipf", alpha, lo, hi)
+
+
+def parse_trace_spec(spec: str) -> dict:
+    """Parse + validate one ``--trace_gen`` spec (see the module
+    docstring grammar). Returns the normalized spec dict the generator
+    consumes; every malformed entry raises ONE ``ValueError`` naming
+    it — the ``--chaos`` parse-rejection discipline."""
+    out = {"n": None, "arrival": ("poisson", 8.0),
+           "plen": ("fixed", 6), "max_new": ("fixed", 4),
+           "tenants": None, "sessions": None, "seed": 0,
+           "spec": spec}
+    seen = set()
+    for entry in (e.strip() for e in spec.split(",") if e.strip()):
+        if "=" not in entry:
+            raise ValueError(
+                f"bad --trace_gen entry {entry!r}: expected key=value "
+                "with key in n/arrival/plen/max_new/tenants/sessions/"
+                "seed")
+        key, _, val = entry.partition("=")
+        if key in seen:
+            raise ValueError(f"bad --trace_gen spec: duplicate key "
+                             f"{key!r}")
+        seen.add(key)
+        if key == "n":
+            try:
+                out["n"] = int(val)
+            except ValueError:
+                raise ValueError(f"bad --trace_gen n {val!r}: must be "
+                                 "an integer") from None
+            if out["n"] < 1:
+                raise ValueError(f"bad --trace_gen n {out['n']}: must "
+                                 "be >= 1")
+        elif key == "arrival":
+            kind, _, rest = val.partition(":")
+            if kind not in ARRIVAL_KINDS:
+                raise ValueError(f"bad --trace_gen arrival kind "
+                                 f"{kind!r}: known kinds "
+                                 f"{ARRIVAL_KINDS}")
+            try:
+                args = [float(x) for x in rest.split(":")] if rest \
+                    else []
+            except ValueError:
+                raise ValueError(f"bad --trace_gen arrival args "
+                                 f"{rest!r}: numbers required") \
+                    from None
+            want = {"poisson": 1, "bursty": 3, "ramp": 2}[kind]
+            if len(args) != want:
+                raise ValueError(
+                    f"bad --trace_gen arrival: {kind} takes {want} "
+                    "arg(s) (poisson:RATE / bursty:RATE:ON_S:OFF_S / "
+                    "ramp:LO:HI)")
+            for a in args:
+                _positive("arrival", a)
+            out["arrival"] = (kind, *args)
+        elif key in ("plen", "max_new"):
+            if key == "max_new" and ":" not in val:
+                # bare INT shorthand: max_new=4 == max_new=fixed:4
+                try:
+                    out["max_new"] = ("fixed",
+                                      _positive("max_new", int(val),
+                                                integer=True))
+                    continue
+                except ValueError:
+                    raise ValueError(f"bad --trace_gen max_new "
+                                     f"{val!r}") from None
+            out[key] = _parse_sampler(key, val)
+        elif key == "tenants":
+            mix = []
+            for part in (p.strip() for p in val.split(";")
+                         if p.strip()):
+                name, sep, w = part.partition(":")
+                if not name or not sep:
+                    raise ValueError(
+                        f"bad --trace_gen tenants entry {part!r}: "
+                        "expected NAME:WEIGHT (e.g. tenants=a:3;b:1)")
+                try:
+                    weight = float(w)
+                except ValueError:
+                    raise ValueError(f"bad --trace_gen tenants weight "
+                                     f"{w!r}: must be a number") \
+                        from None
+                if weight <= 0:
+                    raise ValueError(f"bad --trace_gen tenants weight "
+                                     f"{weight}: must be > 0")
+                mix.append((name, weight))
+            if not mix:
+                raise ValueError("bad --trace_gen tenants: empty mix")
+            if len({n for n, _ in mix}) != len(mix):
+                raise ValueError("bad --trace_gen tenants: duplicate "
+                                 "tenant name")
+            out["tenants"] = mix
+        elif key == "sessions":
+            parts = val.split(":")
+            try:
+                nums = [int(x) for x in parts]
+            except ValueError:
+                raise ValueError(f"bad --trace_gen sessions {val!r}: "
+                                 "want K or K:GROW (integers)") \
+                    from None
+            if len(nums) not in (1, 2) or nums[0] < 1:
+                raise ValueError(f"bad --trace_gen sessions {val!r}: "
+                                 "want K[:GROW] with K >= 1")
+            grow = nums[1] if len(nums) == 2 else 4
+            if grow < 1:
+                raise ValueError(f"bad --trace_gen sessions grow "
+                                 f"{grow}: must be >= 1")
+            out["sessions"] = (nums[0], grow)
+        elif key == "seed":
+            try:
+                out["seed"] = int(val)
+            except ValueError:
+                raise ValueError(f"bad --trace_gen seed {val!r}: must "
+                                 "be an integer") from None
+        else:
+            raise ValueError(
+                f"bad --trace_gen key {key!r}: known keys "
+                "n/arrival/plen/max_new/tenants/sessions/seed")
+    if out["n"] is None:
+        raise ValueError("bad --trace_gen spec: n=INT is required "
+                         "(total requests)")
+    return out
+
+
+def trace_id_of(spec: str, seed: int) -> str:
+    """The trace's stable identity: a hash of ``(spec, seed)`` — the
+    same generator inputs always name the same trace, with no
+    wall-clock or process entropy (replay IS the determinism proof, so
+    the id must replay too)."""
+    h = hashlib.sha256(f"{spec}\x00{seed}".encode()).hexdigest()
+    return f"tr{h[:12]}"
+
+
+def _arrivals(arrival: tuple, n: int, rng) -> list[float]:
+    """Open-loop arrival offsets (seconds, non-decreasing, first at
+    0.0 so replay always has work on round 0)."""
+    kind = arrival[0]
+    if kind == "poisson":
+        rate = arrival[1]
+        gaps = rng.exponential(1.0 / rate, size=n)
+    elif kind == "bursty":
+        rate, on_s, off_s = arrival[1], arrival[2], arrival[3]
+        gaps = []
+        t_in_window = 0.0
+        for g in rng.exponential(1.0 / rate, size=n):
+            gap = float(g)
+            t_in_window += gap
+            while t_in_window > on_s:
+                # the ON window closed mid-gap: push the arrival past
+                # the OFF window (the silent half of the duty cycle)
+                t_in_window -= on_s
+                gap += off_s
+            gaps.append(gap)
+        gaps = np.asarray(gaps)
+    else:   # ramp
+        lo, hi = arrival[1], arrival[2]
+        # rate interpolates lo -> hi across the trace: draw each gap at
+        # the CURRENT position's rate (the diurnal shape compressed)
+        fracs = np.arange(n) / max(n - 1, 1)
+        rates = lo + (hi - lo) * fracs
+        gaps = rng.exponential(1.0, size=n) / rates
+    offs = np.cumsum(gaps)
+    offs -= offs[0]                 # first arrival at t 0
+    return [round(float(t), 6) for t in offs]
+
+
+def _sample(sampler: tuple, rng) -> int:
+    kind = sampler[0]
+    if kind == "fixed":
+        return sampler[1]
+    if kind == "uniform":
+        lo, hi = sampler[1], sampler[2]
+        return int(rng.integers(lo, hi + 1))
+    alpha, lo, hi = sampler[1], sampler[2], sampler[3]
+    return int(min(hi, lo - 1 + rng.zipf(alpha)))
+
+
+def generate_trace(spec: str | dict) -> tuple[dict, list[dict]]:
+    """Generate one trace from a spec (string or pre-parsed dict):
+    returns ``(header, entries)``. Fully deterministic in
+    ``(spec, seed)`` — no wall clock, no process entropy."""
+    cfg = parse_trace_spec(spec) if isinstance(spec, str) else spec
+    n = cfg["n"]
+    rng = np.random.default_rng(cfg["seed"])
+    offsets = _arrivals(cfg["arrival"], n, rng)
+    tenants = cfg["tenants"]
+    if tenants is not None:
+        names = [t for t, _ in tenants]
+        weights = np.asarray([w for _, w in tenants], np.float64)
+        weights /= weights.sum()
+        picks = rng.choice(len(names), size=n, p=weights)
+    sessions = cfg["sessions"]
+    turn_of: dict[str, int] = {}
+    base_plen: dict[str, int] = {}
+    entries = []
+    for i in range(n):
+        session = None
+        turn = 0
+        if sessions is not None:
+            k, grow = sessions
+            session = f"s{i % k}"
+            turn = turn_of.get(session, 0)
+            turn_of[session] = turn + 1
+            if session not in base_plen:
+                base_plen[session] = _sample(cfg["plen"], rng)
+            plen = base_plen[session] + turn * grow
+        else:
+            plen = _sample(cfg["plen"], rng)
+        entries.append({
+            "t_offset_s": offsets[i],
+            "uid_hint": i,
+            "tenant": (names[int(picks[i])] if tenants is not None
+                       else None),
+            "session": session,
+            "prompt_len": plen,
+            "max_new": _sample(cfg["max_new"], rng),
+            "turn": turn,
+        })
+    header = {"trace_version": TRACE_VERSION,
+              "id": trace_id_of(cfg["spec"], cfg["seed"]),
+              "seed": cfg["seed"], "spec": cfg["spec"], "n": n}
+    return header, entries
+
+
+def write_trace(path: str, header: dict, entries: list[dict]) -> str:
+    """Persist one trace: header line + one JSON object per request,
+    through the wire layer's atomic publish (a half-written trace
+    must never replay as a shorter workload)."""
+    lines = [json.dumps(header)]
+    lines.extend(json.dumps(e) for e in entries)
+    from .wire import publish_bytes
+    publish_bytes(path, ("\n".join(lines) + "\n").encode("utf-8"))
+    return path
+
+
+def materialize_prompt(header: dict, entry: dict, vocab: int) -> list:
+    """The entry's token ids, deterministically. An explicit
+    ``prompt_tokens`` list wins (hand-written traces); otherwise the
+    ids are the first ``prompt_len`` tokens of ONE fixed stream keyed
+    by ``(trace seed, session or uid_hint)`` — the same session's
+    turns therefore share a literally identical growing prefix (the
+    prefix-cache workload), while distinct sessions/uids diverge."""
+    if entry.get("prompt_tokens") is not None:
+        toks = [int(t) for t in entry["prompt_tokens"]]
+        if any(not 0 <= t < vocab for t in toks):
+            raise TraceError(
+                f"trace entry uid_hint {entry.get('uid_hint')}: "
+                f"prompt_tokens out of vocab range [0, {vocab})")
+        return toks
+    key = entry.get("session") or f"u{entry['uid_hint']}"
+    digest = hashlib.sha256(key.encode()).digest()
+    stream_seed = [int(header["seed"]) & 0x7FFFFFFF,
+                   int.from_bytes(digest[:4], "big")]
+    rng = np.random.default_rng(stream_seed)
+    plen = int(entry["prompt_len"])
+    return rng.integers(0, vocab, size=plen).tolist()
+
+
+def read_trace(path: str) -> tuple[dict, list[dict]]:
+    """Parse + validate one trace file: ``(header, entries)``. Every
+    rejection is a one-line ``TraceError`` naming the damage — a
+    trace is a determinism proof's input, so a torn tail or missing
+    key is fatal (rc 2 at the CLI), never skipped."""
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except OSError as e:
+        raise TraceError(f"trace {path}: {e}") from None
+    lines = [ln for ln in lines if ln.strip()]
+    if not lines:
+        raise TraceError(f"trace {path}: empty file (no header line)")
+    try:
+        header = json.loads(lines[0])
+    except ValueError:
+        raise TraceError(f"trace {path}: line 1 is not a JSON header "
+                         "(torn or not a trace file)") from None
+    if not isinstance(header, dict):
+        raise TraceError(f"trace {path}: header is not a JSON object")
+    if header.get("trace_version") != TRACE_VERSION:
+        raise TraceError(
+            f"trace {path}: trace_version "
+            f"{header.get('trace_version')!r} != {TRACE_VERSION}")
+    missing = [k for k in TRACE_HEADER_KEYS if k not in header]
+    if missing:
+        raise TraceError(f"trace {path}: header missing key(s) "
+                         f"{missing}")
+    entries = []
+    prev_t = -1.0
+    for i, line in enumerate(lines[1:], 2):
+        try:
+            e = json.loads(line)
+        except ValueError:
+            raise TraceError(f"trace {path}: line {i} unparseable "
+                             "(torn write?)") from None
+        if not isinstance(e, dict):
+            raise TraceError(f"trace {path}: line {i} is not a JSON "
+                             "object")
+        missing = [k for k in TRACE_ENTRY_KEYS if k not in e]
+        if missing:
+            raise TraceError(f"trace {path}: line {i} missing key(s) "
+                             f"{missing}")
+        if "prompt_len" not in e and "prompt_tokens" not in e:
+            raise TraceError(f"trace {path}: line {i} needs "
+                             "prompt_len or prompt_tokens")
+        if e.get("prompt_tokens") is None and int(e["prompt_len"]) < 1:
+            raise TraceError(f"trace {path}: line {i} prompt_len "
+                             f"{e['prompt_len']} must be >= 1")
+        if int(e["max_new"]) < 1:
+            raise TraceError(f"trace {path}: line {i} max_new "
+                             f"{e['max_new']} must be >= 1")
+        t = float(e["t_offset_s"])
+        if t < prev_t:
+            raise TraceError(
+                f"trace {path}: line {i} t_offset_s {t} < previous "
+                f"{prev_t} (offsets must be non-decreasing — replay "
+                "submits in file order)")
+        prev_t = t
+        entries.append(e)
+    if len(entries) != int(header["n"]):
+        raise TraceError(
+            f"trace {path}: header says n={header['n']} but file "
+            f"holds {len(entries)} entr(ies) (torn tail?)")
+    return header, entries
+
+
+def _main(argv=None) -> int:
+    """``python -m ...runtime.workload SPEC OUT.jsonl`` — generate a
+    trace file standalone (the CLI's ``--trace_gen --trace_out`` pair
+    without booting an engine)."""
+    import sys
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 2:
+        print("usage: runtime.workload SPEC OUT.jsonl", file=sys.stderr)
+        return 2
+    try:
+        header, entries = generate_trace(argv[0])
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    write_trace(argv[1], header, entries)
+    print(json.dumps({"trace": argv[1], **header}))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(_main())

@@ -18,7 +18,11 @@ Row contracts:
 - BENCH payload: ``metric`` / ``value`` / ``unit`` headline keys with a
   numeric ``value`` (0.0 is the documented outage-fallback headline);
 - SCALING: ``rows`` (each with ``scenario`` + ``chips``), ``summary``,
-  ``ok``.
+  ``ok``;
+- DECODE: the bench_decode payload — headline keys, plus (round 19+)
+  the ``workload_*`` row contracts: each lane a dict with a numeric
+  ``attainment`` under a stated ``slo``, or an ``error:`` string (the
+  ``guarded()`` honest-outage wrapper).
 
 Exit codes: 0 = every artifact validates (the table prints either way);
 2 = schema drift — unparseable JSON, a wrapper/payload/scaling file
@@ -38,6 +42,14 @@ BENCH_HEADLINE = ("metric", "value", "unit")
 WRAPPER_KEYS = ("n", "cmd", "rc", "tail", "parsed")
 SCALING_KEYS = ("rows", "summary", "ok")
 SCALING_ROW_KEYS = ("scenario", "chips")
+# the round-19 workload rows (bench_decode.py): each lane is a dict
+# with a numeric "attainment" (the report --slo fold's number), the
+# whole row may instead be an "error: ..." string — the guarded()
+# honest-outage wrapper, recorded drift-free
+WORKLOAD_ROW_LANES = {
+    "workload_goodput": ("bursty", "uniform"),
+    "workload_disagg": ("colocated", "disaggregated"),
+}
 
 
 def _round_of(path: str, prefix: str) -> str:
@@ -93,6 +105,87 @@ def validate_bench(path: str, problems: list) -> dict | None:
     return row
 
 
+def _validate_workload_rows(name: str, payload: dict,
+                            problems: list) -> None:
+    """The workload_* row contracts (present in DECODE artifacts from
+    round 19 on; absence is fine — older rounds predate them). A row
+    that is an "error: ..." string is a recorded outage, honest by
+    construction; a present dict must carry its lane structure."""
+    # the two rows are emitted together (one bench function): a
+    # goodput dict WITHOUT its disagg sibling is drift, not an older
+    # round (an error-string goodput is a whole-function outage and
+    # legitimately has no sibling)
+    if isinstance(payload.get("workload_goodput"), dict) \
+            and "workload_disagg" not in payload:
+        problems.append(f"{name}: workload_goodput present but "
+                        "workload_disagg missing (the rows are "
+                        "emitted together)")
+    for key, lanes in WORKLOAD_ROW_LANES.items():
+        row = payload.get(key)
+        if row is None:
+            continue
+        if isinstance(row, str):
+            if not row.startswith("error:"):
+                problems.append(f"{name}: {key} is a string but not "
+                                "an 'error:' outage record")
+            continue
+        if not isinstance(row, dict):
+            problems.append(f"{name}: {key} is "
+                            f"{type(row).__name__}, not an object")
+            continue
+        if "slo" not in row:
+            problems.append(f"{name}: {key} missing key 'slo' (the "
+                            "stated SLO the attainment is under)")
+        for lane in lanes:
+            ln = row.get(lane)
+            if not isinstance(ln, dict):
+                problems.append(f"{name}: {key} lane {lane!r} "
+                                "missing or not an object")
+                continue
+            att = ln.get("attainment")
+            if not isinstance(att, (int, float)) \
+                    or isinstance(att, bool):
+                problems.append(f"{name}: {key} lane {lane!r} "
+                                "'attainment' is not a number")
+
+
+def validate_decode(path: str, problems: list) -> dict | None:
+    """One DECODE_* artifact -> a trend row: headline keys + the
+    workload_* row contracts when present."""
+    name = os.path.basename(path)
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except ValueError:
+        problems.append(f"{name}: unparseable JSON")
+        return None
+    if not isinstance(doc, dict):
+        problems.append(f"{name}: not a JSON object")
+        return None
+    missing = [k for k in BENCH_HEADLINE if k not in doc]
+    if missing:
+        problems.append(f"{name}: headline key(s) {missing} missing")
+        return None
+    if not isinstance(doc["value"], (int, float)) \
+            or isinstance(doc["value"], bool):
+        problems.append(f"{name}: headline 'value' is "
+                        f"{type(doc['value']).__name__}, not a number")
+        return None
+    before = len(problems)
+    _validate_workload_rows(name, doc, problems)
+    if len(problems) > before:
+        return None
+    row = {"round": _round_of(path, "DECODE_"), "file": name,
+           "metric": doc["metric"], "value": doc["value"],
+           "unit": doc["unit"]}
+    wg = doc.get("workload_goodput")
+    if isinstance(wg, dict):
+        row["workload_goodput"] = {
+            lane: wg[lane]["attainment"]
+            for lane in WORKLOAD_ROW_LANES["workload_goodput"]}
+    return row
+
+
 def validate_scaling(path: str, problems: list) -> dict | None:
     name = os.path.basename(path)
     try:
@@ -143,15 +236,21 @@ def main(argv=None) -> int:
     scaling = [validate_scaling(f, problems) for f in
                sorted(glob.glob(os.path.join(args.root,
                                              "SCALING_*.json")))]
+    decode = [validate_decode(f, problems) for f in
+              sorted(glob.glob(os.path.join(args.root,
+                                            "DECODE_*.json")))]
     bench = [r for r in bench if r is not None]
     scaling = [r for r in scaling if r is not None]
+    decode = [r for r in decode if r is not None]
 
     if args.json:
         print(json.dumps({"bench": bench, "scaling": scaling,
+                          "decode": decode,
                           "problems": problems}, indent=1))
     else:
         out = [f"bench trend — {len(bench)} BENCH / {len(scaling)} "
-               f"SCALING round artifact(s) in {args.root}"]
+               f"SCALING / {len(decode)} DECODE round artifact(s) "
+               f"in {args.root}"]
         if bench:
             out.append("")
             out.append(f"  {'round':<12} {'value':>12}  {'unit':<10} "
@@ -171,6 +270,16 @@ def main(argv=None) -> int:
                 out.append(f"  {r['round']:<12} {r['rows']:>3} "
                            f"scaling row(s)  ok={r['ok']}  "
                            f"({r['summary']})")
+        if decode:
+            out.append("")
+            for r in decode:
+                wl = ""
+                if r.get("workload_goodput"):
+                    wl = "  goodput " + ", ".join(
+                        f"{k} {v}" for k, v in
+                        sorted(r["workload_goodput"].items()))
+                out.append(f"  {r['round']:<12} {r['value']:>12} "
+                           f" {r['unit']:<10} {r['metric']}{wl}")
         print("\n".join(out))
     if problems:
         for prob in problems:

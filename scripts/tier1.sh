@@ -426,6 +426,85 @@ rm -rf "$FLEET_DIR"
 echo "FLEET_SMOKE=OK"
 phase_done fleet_smoke
 
+echo "=== workload smoke ==="
+# The round-19 trace plane (DESIGN.md section 25): generate a tiny
+# 2-tenant bursty trace (--trace_gen, persisted via --trace_out),
+# replay it TWICE through a 2-engine fleet — byte-identical tokens and
+# identical schema-v13 workload records (replay IS the determinism
+# proof) — then `report` must show per-tenant percentiles, and a
+# malformed trace file / bad --trace_gen spec must exit rc 2.
+WL_DIR=$(mktemp -d /tmp/tier1_workload.XXXXXX)
+WL_SPEC="n=10,arrival=bursty:40:0.2:0.3,plen=zipf:1.7:3:12,max_new=4,tenants=a:3;b:1,seed=5"
+WL_ARGS="-d 32 -l 2 --heads 4 --vocab 64 --max_seq_len 64 --block_size 8
+  --prefill_chunk 4 --log_every 2 --fleet 2"
+if ! timeout -k 10 240 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli generate $WL_ARGS \
+    --trace_gen "$WL_SPEC" --trace_out "$WL_DIR/trace.jsonl" \
+    --metrics_dir "$WL_DIR/m1" > "$WL_DIR/run1.json"; then
+  echo "WORKLOAD_SMOKE=FAIL (generate+replay run)"; rm -rf "$WL_DIR"
+  exit 1
+fi
+if ! timeout -k 10 240 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli generate $WL_ARGS \
+    --trace "$WL_DIR/trace.jsonl" \
+    --metrics_dir "$WL_DIR/m2" > "$WL_DIR/run2.json"; then
+  echo "WORKLOAD_SMOKE=FAIL (file replay run)"; rm -rf "$WL_DIR"
+  exit 1
+fi
+if ! timeout -k 10 60 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli report "$WL_DIR/m2/router" \
+    "$WL_DIR/m2/e0" "$WL_DIR/m2/e1" > "$WL_DIR/report.txt"; then
+  echo "WORKLOAD_SMOKE=FAIL (report rc)"; rm -rf "$WL_DIR"; exit 1
+fi
+if ! timeout -k 10 60 env JAX_PLATFORMS=cpu python - "$WL_DIR" <<'EOF_WL'
+import json, os, sys
+from distributed_llm_code_samples_tpu.runtime.telemetry import (
+    METRICS_FILENAME, read_metrics, validate_record)
+base = sys.argv[1]
+r1 = json.load(open(os.path.join(base, "run1.json")))
+r2 = json.load(open(os.path.join(base, "run2.json")))
+a = {s["uid"]: s["tokens"] for s in r1["sequences"]}
+b = {s["uid"]: s["tokens"] for s in r2["sequences"]}
+assert a == b, "trace replayed twice produced different tokens"
+assert not r1["failed"] and not r2["failed"]
+assert r1["workload"] == r2["workload"], (r1["workload"],
+                                          r2["workload"])
+assert set(r1["workload"]["tenants"]) == {"a", "b"}
+def wl_records(m):
+    recs, problems = read_metrics(
+        os.path.join(base, m, "router", METRICS_FILENAME))
+    assert not problems, problems
+    wl = [r for r in recs if r["kind"] == "workload"]
+    assert wl and all(validate_record(r)[0] for r in wl)
+    return [{k: v for k, v in r.items() if k != "t"} for r in wl]
+assert wl_records("m1") == wl_records("m2"), \
+    "workload records differ across replays"
+rep = open(os.path.join(base, "report.txt")).read()
+assert "workload [trace" in rep, rep[:800]
+assert "tenant a" in rep and "tenant b" in rep, rep[:1200]
+assert "TTFT" in rep
+EOF_WL
+then
+  echo "WORKLOAD_SMOKE=FAIL (determinism/per-tenant check)"
+  rm -rf "$WL_DIR"; exit 1
+fi
+echo '{"torn' >> "$WL_DIR/trace.jsonl"
+if timeout -k 10 60 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli generate $WL_ARGS \
+    --trace "$WL_DIR/trace.jsonl" > /dev/null 2>&1; then
+  echo "WORKLOAD_SMOKE=FAIL (torn trace file accepted)"
+  rm -rf "$WL_DIR"; exit 1
+fi
+if timeout -k 10 60 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli generate $WL_ARGS \
+    --trace_gen "n=0" > /dev/null 2>&1; then
+  echo "WORKLOAD_SMOKE=FAIL (bad --trace_gen spec accepted)"
+  rm -rf "$WL_DIR"; exit 1
+fi
+rm -rf "$WL_DIR"
+echo "WORKLOAD_SMOKE=OK"
+phase_done workload_smoke
+
 echo "=== process-transport smoke ==="
 # The round-16 drill the in-process fleet cannot run (DESIGN.md
 # section 22): 3 engine WORKER PROCESSES behind the router

@@ -330,6 +330,25 @@ def test_bench_decode_contract():
         assert st["ops"].get("step", {}).get("n", 0) >= 1
         assert "overhead_p50_ms" in st["ops"]["step"]
         assert st["heartbeats"] >= 1
+    # r19 workload rows (runtime/workload.py + the replay driver):
+    # goodput under a STATED, replayable trace — byte-identity across
+    # two replays and across colocated/disaggregated lanes is asserted
+    # INSIDE the bench, so an error string here is a broken contract
+    wg = payload["workload_goodput"]
+    assert wg["slo"] == "0.5:0.05"
+    assert wg["trace_bursty"].startswith("tr")
+    assert wg["trace_bursty"] != wg["trace_uniform"]
+    for lane in ("bursty", "uniform"):
+        att = wg[lane]["attainment"]
+        assert isinstance(att, float) and 0.0 <= att <= 1.0, (lane, wg)
+        assert wg[lane]["completed"] > 0
+    wd = payload["workload_disagg"]
+    assert wd["trace"] == wg["trace_bursty"]
+    for lane in ("colocated", "disaggregated"):
+        assert isinstance(wd[lane]["attainment"], float), (lane, wd)
+    # the two lane dicts for the SAME trace through the SAME colocated
+    # fleet are one measurement, reported once each
+    assert wd["colocated"] == wg["bursty"]
 
 
 def _run_trend(root):
@@ -408,6 +427,23 @@ def test_bench_trend_rejects_schema_drift(tmp_path):
     r = _run_trend(root)
     assert r.returncode == 2 and "scenario" in r.stderr
     os.remove(os.path.join(root, "SCALING_r02.json"))
+
+    # r19 DECODE workload rows: a lane without a numeric attainment
+    # is drift; an "error:" string lane-set is a recorded outage
+    write("DECODE_r02.json", {
+        "metric": "m", "value": 1.0, "unit": "tokens/s",
+        "workload_goodput": {"slo": "0.5:0.05",
+                             "bursty": {"attainment": 0.5},
+                             "uniform": {"attainment": "high"}}})
+    r = _run_trend(root)
+    assert r.returncode == 2
+    assert "DECODE_r02.json" in r.stderr and "uniform" in r.stderr
+    write("DECODE_r02.json", {
+        "metric": "m", "value": 1.0, "unit": "tokens/s",
+        "workload_goodput": "error: RuntimeError: lane died"})
+    r = _run_trend(root)
+    assert r.returncode == 0, r.stdout + r.stderr
+    os.remove(os.path.join(root, "DECODE_r02.json"))
 
     # a missing artifact directory is rc 2, not a silent pass
     r = _run_trend(os.path.join(root, "nope"))

@@ -458,7 +458,7 @@ def test_snapshot_resume_matches_uninterrupted(tmp_path, lm_params,
     sd = str(tmp_path / "snap")
     write_snapshot(eng, sd)
     snap = load_snapshot(sd)
-    assert snap["step"] == 5 and snap["version"] == 7
+    assert snap["step"] == 5 and snap["version"] == 8
     # v2: the KV-pool churn counters persist so schema-v5 decode
     # records stay monotonic across crash-resume
     assert snap["counters"]["block_allocs"] >= 1

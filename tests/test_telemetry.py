@@ -71,7 +71,14 @@ from distributed_llm_code_samples_tpu.runtime.telemetry import (
 # replay/migration/crash-resume; null only on the anonymous rejected
 # uid -1), and "deploy" pins the key too (uniform envelope, value
 # always null — a deploy event concerns the fleet, not one request).
-_PINNED_VERSION = 12
+# v13 (round 19): the trace-driven workload plane — "request" and
+# "span" records pin ``tenant`` (the request's tenant tag, null
+# single-tenant, carried like trace_id through replay/migration/
+# crash-resume), and the "workload" kind lands (one record per
+# trace-replay interval from decode/workload_driver.py: the trace
+# identity, per-interval offered/admitted, cumulative per-tenant
+# offered/completed/shed counts) with WORKLOAD_REQUIRED.
+_PINNED_VERSION = 13
 _PINNED_STEP_KEYS = frozenset({
     "schema", "kind", "t", "step", "strategy", "loss", "grad_norm",
     "tokens_per_sec", "step_time_s", "mfu", "hbm_high_water_bytes",
@@ -88,9 +95,11 @@ _PINNED_DECODE_REQUIRED = frozenset({
 })
 _PINNED_REQUEST_REQUIRED = frozenset({
     "step", "uid", "event", "reason", "weights_version", "trace_id",
+    "tenant",
 })
 _PINNED_SPAN_REQUIRED = frozenset({
     "step", "uid", "span", "start_step", "duration_s", "trace_id",
+    "tenant",
 })
 _PINNED_ROUTER_REQUIRED = frozenset({
     "step", "uid", "event", "source", "target", "policy", "trace_id",
@@ -102,6 +111,9 @@ _PINNED_ROUTER_MOVE_REQUIRED = frozenset({"blocks", "bytes",
                                           "duration_s", "transport"})
 _PINNED_DEPLOY_REQUIRED = frozenset({
     "step", "event", "from_version", "to_version", "trace_id",
+})
+_PINNED_WORKLOAD_REQUIRED = frozenset({
+    "step", "trace", "offered", "admitted", "tenants",
 })
 _PINNED_DEPLOY_EVENT_REQUIRED = {
     "engine_swapped": frozenset({"engine"}),
@@ -116,7 +128,7 @@ def test_schema_version_bump_discipline():
         DEPLOY_REQUIRED, FLEET_REQUIRED, RECORD_KINDS,
         REQUEST_COMPLETED_REQUIRED, REQUEST_REQUIRED, REQUIRED_KEYS,
         ROLLBACK_REQUIRED, ROUTER_MOVE_REQUIRED, ROUTER_REQUIRED,
-        SPAN_REQUIRED)
+        SPAN_REQUIRED, WORKLOAD_REQUIRED)
     assert SCHEMA_VERSION == _PINNED_VERSION and \
         frozenset(STEP_KEYS) == _PINNED_STEP_KEYS and \
         frozenset(ANOMALY_REQUIRED) == _PINNED_ANOMALY_REQUIRED and \
@@ -131,6 +143,7 @@ def test_schema_version_bump_discipline():
         _PINNED_ROUTER_MOVE_REQUIRED and \
         frozenset(FLEET_REQUIRED) == _PINNED_FLEET_REQUIRED and \
         frozenset(DEPLOY_REQUIRED) == _PINNED_DEPLOY_REQUIRED and \
+        frozenset(WORKLOAD_REQUIRED) == _PINNED_WORKLOAD_REQUIRED and \
         {k: frozenset(v) for k, v in DEPLOY_EVENT_REQUIRED.items()} \
         == _PINNED_DEPLOY_EVENT_REQUIRED, (
             "telemetry record schema changed: bump SCHEMA_VERSION "
@@ -142,11 +155,12 @@ def test_schema_version_bump_discipline():
     assert "router" in RECORD_KINDS
     assert "fleet" in RECORD_KINDS
     assert "deploy" in RECORD_KINDS
+    assert "workload" in RECORD_KINDS
     # every contract-carrying kind routes through the one table
     # validate_record reads (a new kind that skips it validates
     # envelope-only silently — this catches the drift)
     for kind in ("step", "anomaly", "rollback", "decode", "request",
-                 "span", "router", "fleet", "deploy"):
+                 "span", "router", "fleet", "deploy", "workload"):
         assert kind in REQUIRED_KEYS, kind
 
 
@@ -264,6 +278,7 @@ def test_span_record_round_trip_and_torn_tail(tmp_path):
     ("router", _PINNED_ROUTER_REQUIRED),
     ("fleet", _PINNED_FLEET_REQUIRED),
     ("deploy", _PINNED_DEPLOY_REQUIRED),
+    ("workload", _PINNED_WORKLOAD_REQUIRED),
 ])
 def test_validate_record_names_kind_and_key(kind, required):
     """Satellite contract: every validate_record failure is ONE line
@@ -389,7 +404,8 @@ def test_completed_request_record_conditional_pin():
     unreconstructable); other request events never pin them."""
     base = {"schema": SCHEMA_VERSION, "kind": "request", "t": 0.0,
             "step": 3, "uid": 1, "reason": None,
-            "weights_version": None, "trace_id": "ab12-1"}
+            "weights_version": None, "trace_id": "ab12-1",
+            "tenant": None}
     ok, reason = validate_record({**base, "event": "completed",
                                   "latency_s": 1.5, "ttft_s": 0.5})
     assert ok, reason
@@ -411,6 +427,51 @@ def test_completed_request_record_conditional_pin():
     ok, reason = validate_record({**bad, "event": "admitted"})
     assert not ok and "request record" in reason \
         and "weights_version" in reason
+
+
+def test_workload_record_round_trip_and_torn_tail(tmp_path):
+    """The schema-v13 workload kind (decode/workload_driver.py): the
+    writer method stamps the kind + envelope, records validate, a torn
+    tail after a workload write is reported-not-fatal, and a missing
+    contract key rejects naming kind and key. The tenant pin on
+    request records validates through the writer's default (null
+    single-tenant) and rejects when the key is absent."""
+    w = TelemetryWriter(str(tmp_path))
+    w.workload({"step": 8, "trace": {"id": "trabc123", "version": 1},
+                "offered": 5, "admitted": 4,
+                "tenants": {"a": {"offered": 3, "completed": 1,
+                                  "shed": 1},
+                            "b": {"offered": 2, "completed": 0,
+                                  "shed": 0}}})
+    # a request record through the writer defaults tenant to null —
+    # the single-tenant stance; the workload plane sets it explicitly
+    w.request({"step": 8, "uid": 3, "event": "admitted"})
+    w.request({"step": 9, "uid": 4, "event": "admitted",
+               "tenant": "b"})
+    w.close()
+    path = os.path.join(str(tmp_path), METRICS_FILENAME)
+    with open(path, "a") as f:
+        f.write('{"schema": 13, "kind": "wor')    # torn write
+    records, problems = read_metrics(path)
+    assert len(problems) == 1 and "torn" in problems[0]
+    wl, r1, r2 = records
+    assert wl["kind"] == "workload" and wl["schema"] == SCHEMA_VERSION
+    assert wl["trace"] == {"id": "trabc123", "version": 1}
+    assert wl["offered"] == 5 and wl["admitted"] == 4
+    assert wl["tenants"]["a"]["shed"] == 1
+    assert r1["tenant"] is None and r2["tenant"] == "b"
+    for r in records:
+        ok, reason = validate_record(r)
+        assert ok, reason
+    # missing contract keys reject naming kind + key
+    bad = {k: v for k, v in wl.items() if k != "tenants"}
+    ok, reason = validate_record(bad)
+    assert not ok and "workload record" in reason \
+        and "tenants" in reason
+    bad = {k: v for k, v in r1.items() if k != "tenant"}
+    ok, reason = validate_record(bad)
+    assert not ok and "request record" in reason \
+        and "tenant" in reason
 
 
 def test_deploy_record_round_trip_and_torn_tail(tmp_path):
