@@ -1041,6 +1041,166 @@ def main() -> int:
             and os.environ.get("DECODE_ENGINE", "1") != "0":
         guarded("workload_goodput", workload_rows)
 
+    # Policy rows (round 20, DESIGN.md section 26): the offline policy
+    # search — goodput PER POLICY over one committed trace. A
+    # noisy-dominated 2-tenant burst replays through a deliberately
+    # tight fleet under FCFS and under weighted-fair (quiet:3;noisy:1),
+    # folded by the same report --slo plane as the workload rows; a
+    # third lane runs the closed-loop autoscaler over the burst and
+    # prices its reaction time in ROUNDS (the deterministic clock —
+    # wall seconds would bench the host, not the controller). The wfq
+    # and autoscale lanes each replay twice and the outputs are
+    # asserted byte-identical: a policy row from a non-replayable
+    # episode would be noise wearing a number.
+    def policy_rows():
+        import tempfile
+
+        from distributed_llm_code_samples_tpu.decode import (
+            DecodeEngine, EngineConfig, FleetRouter)
+        from distributed_llm_code_samples_tpu.decode.autoscale import (
+            AutoscaleController)
+        from distributed_llm_code_samples_tpu.decode.fleet import (
+            EngineHandle)
+        from distributed_llm_code_samples_tpu.decode.workload_driver \
+            import replay_trace
+        from distributed_llm_code_samples_tpu.report import (
+            _Stream, _slo_accounting)
+        from distributed_llm_code_samples_tpu.runtime.policy import (
+            AutoscalePolicy, QosPolicy)
+        from distributed_llm_code_samples_tpu.runtime.telemetry import (
+            TelemetryWriter)
+        from distributed_llm_code_samples_tpu.runtime.workload import (
+            generate_trace)
+
+        block = int(os.environ.get("BENCH_ENGINE_BLOCK", 16))
+        slots = 2
+        wl_new = min(NEW, 8)
+        plen_hi = max(4, T0)
+        mbps = -(-(plen_hi + wl_new) // block)
+        slo_ttft, slo_itl = 0.5, 0.05
+
+        def cfg():
+            return EngineConfig(
+                block_size=block, n_blocks=1 + slots * mbps,
+                max_slots=slots, max_blocks_per_seq=mbps,
+                prefill_chunk=min(block, 8), kv_dtype="f32")
+
+        spec = (f"n=12,arrival=bursty:64:0.15:0.45,plen=uniform:4:"
+                f"{plen_hi},max_new={wl_new},"
+                "tenants=noisy:4;quiet:1,seed=11")
+        wfq = QosPolicy(discipline="wfq",
+                        weights=(("quiet", 3), ("noisy", 1)))
+
+        def lane(n_eng, qos=None, autoscale=None):
+            hdr, ents = generate_trace(spec)
+            mdir = tempfile.mkdtemp(prefix="bench_pol_")
+            writers = []
+
+            def mk(eid):
+                m = TelemetryWriter(os.path.join(mdir, eid))
+                writers.append(m)
+                return DecodeEngine(params, H, cfg(), metrics=m,
+                                    qos=qos)
+
+            rm = TelemetryWriter(os.path.join(mdir, "router"))
+            writers.append(rm)
+            fl = FleetRouter(mk, n_eng, metrics=rm)
+            ctl = None
+            if autoscale is not None:
+                ctl = AutoscaleController(
+                    fl, autoscale,
+                    lambda eid: EngineHandle(eid, mk(eid), "decode"),
+                    metrics=rm)
+            summary = replay_trace(fl, hdr, ents, vocab=V,
+                                   steps_per_s=8.0, log_every=4,
+                                   metrics=rm, autoscale=ctl)
+            outs = fl.results()
+            sheds = fl.sheds
+            for w in writers:
+                w.close()
+            streams = [_Stream(os.path.join(mdir, d), None)
+                       for d in sorted(os.listdir(mdir))]
+            fold = _slo_accounting(streams, slo_ttft, slo_itl)
+            row = {
+                "attainment": fold["attainment"],
+                "attained": fold["attained"],
+                "violated": fold["violated"],
+                "unreconciled": fold["unreconciled"],
+                "completed": fold["completed"],
+                "shed": summary["shed"],
+                "rounds": summary["rounds"],
+            }
+            if fold["by_tenant"]:
+                row["by_tenant_attainment"] = {
+                    t: b["attainment"]
+                    for t, b in sorted(fold["by_tenant"].items())}
+            return hdr, outs, ctl, sheds, row
+
+        hdr, outs_f, _, _, lane_fcfs = lane(2)
+        _, outs_w, _, _, lane_wfq = lane(2, qos=wfq)
+        _, outs_w2, _, _, _ = lane(2, qos=wfq)
+        if outs_w2 != outs_w:
+            raise RuntimeError(
+                "wfq lane replayed twice produced different tokens — "
+                "fair queueing leaked into sampling identity")
+        asp = AutoscalePolicy(min_engines=1, max_engines=3,
+                              up_queue=2, down_queue=1,
+                              hysteresis=2, cooldown=4)
+        _, outs_a, ctl, sheds_a, lane_as = lane(1, autoscale=asp)
+        _, outs_a2, ctl2, _, _ = lane(1, autoscale=asp)
+        if outs_a2 != outs_a:
+            raise RuntimeError(
+                "autoscaled lane replayed twice produced different "
+                "tokens — the controller's decisions read a wall "
+                "clock somewhere")
+        if ctl.history != ctl2.history:
+            raise RuntimeError(
+                "autoscaled lane replayed twice took different "
+                "scaling decisions — the control loop is not on the "
+                "round clock")
+        reaction = next((rnd for rnd, ev, _ in ctl.history
+                         if ev == "scale_up"), None)
+        if reaction is None:
+            raise RuntimeError("autoscale lane never scaled up — the "
+                               "burst did not pressure the controller")
+        for name, ln in (("fcfs", lane_fcfs), ("wfq", lane_wfq),
+                         ("autoscale", lane_as)):
+            if ln["attainment"] is None:
+                raise RuntimeError(f"policy {name} lane measured no "
+                                   "completed request")
+        paths["policy_goodput"] = {
+            "slo": f"{slo_ttft}:{slo_itl}",
+            "trace": hdr["id"],
+            "fcfs": lane_fcfs,
+            "wfq": lane_wfq,
+        }
+        paths["policy_autoscale"] = {
+            "trace": hdr["id"],
+            "reaction_rounds": reaction,
+            "scale_ups": ctl.scale_ups,
+            "scale_downs": ctl.scale_downs,
+            "sheds": sheds_a,
+            "rounds": lane_as["rounds"],
+            "attainment": lane_as["attainment"],
+        }
+        paths["policy_note"] = (
+            "12 requests, noisy:4;quiet:1 arrival mix over a bursty "
+            "trace, virtual pacing at 8 rounds/trace-second: fcfs vs "
+            "weighted-fair (quiet:3;noisy:1) through 2 tight replicas, "
+            "plus the closed-loop autoscaler growing a 1-engine fleet "
+            f"under the same burst (policy {asp.min_engines}.."
+            f"{asp.max_engines} engines, up>{asp.up_queue} "
+            f"down<{asp.down_queue} hysteresis {asp.hysteresis} "
+            f"cooldown {asp.cooldown}). reaction_rounds = round of "
+            "the first scale_up on the replay's own clock. wfq and "
+            "autoscale lanes byte-identical across two replays; "
+            "scaling histories identical. CPU wall clock — ratios "
+            "between lanes are the signal.")
+
+    if not tp_only and os.environ.get("DECODE_FLEET", "1") != "0" \
+            and os.environ.get("DECODE_ENGINE", "1") != "0":
+        guarded("policy_goodput", policy_rows)
+
     # TP decode scaling on the fake-8-device CPU mesh: subprocesses
     # (fresh backend each — the current process is pinned to its
     # platform) run ONLY the tp path at tiny shape over mesh 1/2/4/8.

@@ -1,0 +1,219 @@
+"""Closed-loop decode-tier autoscaler (DESIGN.md section 26).
+
+The controller that finally ACTS on what the fleet already records:
+between fleet rounds it reads the router's own light digests (queue
+depth per alive decode engine — zero extra round-trips, the same reads
+every routing decision makes), compares the mean waiting depth against
+the ``AutoscalePolicy`` thresholds, and scales the decode tier —
+
+- **up**: mint the next engine id, call the caller-provided ``spawn``
+  factory, WARM the new member (full program prebuild) before
+  ``add_engine`` admits it — a joining engine never pays a compile
+  under live load, so the steady state stays at zero new compiles;
+- **down**: retire the least-loaded member through the rolling-deploy
+  drain (live residents ship KV to peers, the rest replay-resume —
+  ZERO shed, enforced here with an explicit check, not assumed).
+
+Flapping and scale-to-zero are structurally impossible: the policy
+validates ``up_queue > down_queue`` (a dead band), ``hysteresis``
+consecutive rounds must agree before any action, ``cooldown`` rounds
+must pass between actions, and ``min_engines >= 1`` floors the tier.
+The one exception that IGNORES cooldown is the below-min floor repair:
+a dead worker mid-burst is replaced immediately — waiting out a
+cooldown with the fleet under its floor would be the controller
+protecting itself from the exact event it exists for.
+
+Determinism: every decision folds only the round clock and the
+digests' integer queue depths — never wall time — so the same
+``(trace, seed, policy)`` replays the same scaling episode and the
+tokens stay byte-identical (wall-clock fields on the records, like
+``spawn_s``, are attribution, not decision inputs). The controller is
+pure host-side control flow; it never touches a compiled program or a
+sampling key.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..runtime.policy import AutoscalePolicy
+
+
+class AutoscaleController:
+    """Drives one ``FleetRouter``'s decode tier against an
+    ``AutoscalePolicy``. ``spawn(eid)`` is the caller's factory
+    returning a CONNECTED decode handle for a fresh engine (in-process
+    ``EngineHandle`` or a ``spawn_worker`` process handle) — the
+    controller warms it before it takes traffic. ``tick()`` runs
+    between fleet rounds (the workload driver calls it after each
+    round step); it returns the action taken ("scale_up" /
+    "scale_down") or None."""
+
+    def __init__(self, router, policy: AutoscalePolicy, spawn, *,
+                 metrics=None):
+        self.router = router
+        self.policy = policy
+        self.spawn = spawn
+        self.metrics = metrics
+        self.cooldown_until = 0         # round clock, not wall clock
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self.history: list[tuple] = []  # (round, event, reason)
+        self._up_streak = 0
+        self._down_streak = 0
+        self._held_logged = False       # one "held" record per episode
+        self._last = (None, None, None)  # (event, reason, round)
+        self._mirror()
+
+    # -- telemetry -----------------------------------------------------
+
+    def _emit(self, event: str, reason: str, *, engines: int,
+              target: int, **extra) -> None:
+        if self.metrics is not None:
+            self.metrics.autoscale({
+                "step": self.router.rounds, "event": event,
+                "reason": reason, "engines": engines,
+                "target_engines": target, **extra})
+
+    def _mirror(self) -> None:
+        """Mirror live controller state onto the router for the status
+        doc (``fleet_status.json``'s ``autoscale`` block)."""
+        r = self.router
+        event, reason, rnd = self._last
+        r.autoscale_state = {
+            "engines": len(r.alive_handles("decode")),
+            "target_engines": self._target(),
+            "min_engines": self.policy.min_engines,
+            "max_engines": self.policy.max_engines,
+            "last_event": event,
+            "last_reason": reason,
+            "last_round": rnd,
+            "cooldown_remaining": max(0, self.cooldown_until
+                                      - r.rounds),
+            "scale_ups": self.scale_ups,
+            "scale_downs": self.scale_downs,
+        }
+
+    def _target(self) -> int:
+        """What the controller currently WANTS: the alive count plus
+        the pending intent a completed streak expresses (clamped to
+        the policy's floor/ceiling)."""
+        n = len(self.router.alive_handles("decode"))
+        want = n
+        if n < self.policy.min_engines:
+            want = self.policy.min_engines
+        elif self._up_streak >= self.policy.hysteresis:
+            want = n + 1
+        elif self._down_streak >= self.policy.hysteresis:
+            want = n - 1
+        return max(self.policy.min_engines,
+                   min(self.policy.max_engines, want))
+
+    # -- the control loop ----------------------------------------------
+
+    def tick(self):
+        """One controller decision on the router's round clock.
+        Returns "scale_up" / "scale_down" when the fleet changed, else
+        None (a "held" decision — streak complete but cooldown or a
+        bound blocks — is recorded once per episode, not returned: the
+        fleet did not change)."""
+        r = self.router
+        alive = r.alive_handles("decode")
+        n = len(alive)
+        if n < self.policy.min_engines:
+            # floor repair beats cooldown: dead capacity is replaced
+            # NOW (the chaos drill's kill_worker path)
+            return self._scale_up("below_min_floor")
+        waiting = sum(h.digest(light=True)["waiting"] for h in alive)
+        pressure = waiting / n
+        if pressure >= self.policy.up_queue:
+            self._up_streak += 1
+            self._down_streak = 0
+        elif pressure < self.policy.down_queue:
+            self._down_streak += 1
+            self._up_streak = 0
+        else:
+            # inside the dead band: both streaks reset — hysteresis
+            # counts CONSECUTIVE rounds, not rounds ever
+            if self._up_streak or self._down_streak:
+                self._held_logged = False
+            self._up_streak = self._down_streak = 0
+        in_cooldown = r.rounds < self.cooldown_until
+        action = None
+        if self._up_streak >= self.policy.hysteresis:
+            if n >= self.policy.max_engines:
+                self._held("queue_pressure", "at_max_engines", n)
+            elif in_cooldown:
+                self._held("queue_pressure", "cooldown", n)
+            else:
+                action = self._scale_up("queue_pressure")
+        elif self._down_streak >= self.policy.hysteresis:
+            if n <= self.policy.min_engines:
+                self._held("queue_idle", "at_min_engines", n)
+            elif in_cooldown:
+                self._held("queue_idle", "cooldown", n)
+            else:
+                action = self._scale_down("queue_idle")
+        if action is None:
+            self._mirror()      # keep cooldown_remaining live
+        return action
+
+    def _held(self, want_reason: str, blocked_by: str, n: int) -> None:
+        """A completed streak the controller is NOT acting on — record
+        it once per episode so the drill can see the dead band and
+        cooldown doing their job (a per-round record would spam one
+        line per held round)."""
+        if self._held_logged:
+            return
+        self._held_logged = True
+        reason = f"{want_reason}:{blocked_by}"
+        self.history.append((self.router.rounds, "held", reason))
+        self._last = ("held", reason, self.router.rounds)
+        self._emit("held", reason, engines=n, target=self._target())
+
+    def _scale_up(self, reason: str):
+        r = self.router
+        eid = r.next_decode_eid()
+        t0 = time.perf_counter()
+        handle = self.spawn(eid)
+        try:
+            compiled = handle.warm()    # BEFORE any traffic
+            r.add_engine(handle)
+        except Exception:
+            handle.kill()
+            raise
+        spawn_s = time.perf_counter() - t0
+        self.scale_ups += 1
+        self.cooldown_until = r.rounds + self.policy.cooldown
+        self._up_streak = self._down_streak = 0
+        self._held_logged = False
+        self.history.append((r.rounds, "scale_up", reason))
+        self._last = ("scale_up", reason, r.rounds)
+        self._emit("scale_up", reason,
+                   engines=len(r.alive_handles("decode")),
+                   target=self._target(), engine=eid,
+                   compiled=compiled, spawn_s=round(spawn_s, 6))
+        self._mirror()
+        return "scale_up"
+
+    def _scale_down(self, reason: str):
+        r = self.router
+        victim = min(r.alive_handles("decode"), key=r._load_key)
+        sheds_before = r.sheds
+        drained = r.retire_engine(victim.id)
+        if r.sheds != sheds_before:
+            raise RuntimeError(
+                "scale-down drain shed requests — the zero-shed "
+                "drain contract is broken")
+        self.scale_downs += 1
+        self.cooldown_until = r.rounds + self.policy.cooldown
+        self._up_streak = self._down_streak = 0
+        self._held_logged = False
+        self.history.append((r.rounds, "scale_down", reason))
+        self._last = ("scale_down", reason, r.rounds)
+        self._emit("scale_down", reason,
+                   engines=len(r.alive_handles("decode")),
+                   target=self._target(), engine=victim.id,
+                   drained=drained)
+        self._mirror()
+        return "scale_down"

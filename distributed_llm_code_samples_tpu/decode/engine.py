@@ -167,8 +167,10 @@ from ..models.attention import chunk_attn, rope
 from ..models.lm import LMParams, decode_attn
 from ..ops.norm import layernorm
 from ..runtime.guardrails import rows_finite
+from ..runtime.policy import QosPolicy
 from ..runtime.telemetry import FLIGHT_FILENAME
 from ..runtime.tracing import SpanTracer
+from ..runtime.workload import tenant_key
 from ..runtime.weights import (BOOT_VERSION, architecture_diff,
                                model_fingerprint, same_architecture)
 from .draft import draft_tokens
@@ -243,9 +245,16 @@ FLIGHT_RECORDER_STEPS = 256
 
 
 class AdmissionError(RuntimeError):
-    """A request was shed at submit time (bounded queue full) — the
-    serving 503, distinct from the ValueError family (malformed
-    requests) so callers can tell load shedding from bad input."""
+    """A request was shed at submit time (bounded queue full, or a
+    predicted deadline miss under a QoS policy) — the serving 503,
+    distinct from the ValueError family (malformed requests) so
+    callers can tell load shedding from bad input. ``reason`` names
+    the shed cause (``queue_full`` / ``predicted_deadline_miss``) so
+    the fleet router's shed records attribute it instead of guessing."""
+
+    def __init__(self, msg: str, reason: str = "queue_full"):
+        super().__init__(msg)
+        self.reason = reason
 
 
 def blocks_needed(prompt_len: int, max_new: int, block_size: int) -> int:
@@ -429,7 +438,8 @@ class DecodeEngine:
 
     def __init__(self, params: LMParams, n_heads: int,
                  config: EngineConfig | None = None, mesh=None,
-                 policy: ServePolicy | None = None, metrics=None):
+                 policy: ServePolicy | None = None, metrics=None,
+                 qos: QosPolicy | None = None):
         cfg = config or EngineConfig()
         if cfg.block_size & (cfg.block_size - 1):
             raise ValueError(f"block_size must be a power of two, got "
@@ -535,6 +545,20 @@ class DecodeEngine:
         self._occ_sum = 0.0
         self._next_uid = 0
         self.policy = policy or ServePolicy()
+        # -- tenant QoS (round 20, DESIGN.md section 26) --
+        # None = the historical strict-FCFS engine exactly. All QoS
+        # state is host-side scheduling metadata (like _head_blocked):
+        # it never enters a compiled program or a sampling key, so a
+        # policy change reorders ADMISSIONS, never a request's tokens.
+        self.qos = qos
+        # tenant_key -> tokens served (live + replayed emissions): the
+        # WFQ virtual clock's numerator. Deterministic by construction
+        # (token counts, never wall time), so the admission order a
+        # policy produces replays identically with the tokens.
+        self._tenant_served: dict[str, int] = {}
+        # uids whose budget deferral was already recorded (one qos
+        # record per uid per wait, not one per scheduler iteration)
+        self._budget_deferred: set[int] = set()
         self.metrics = metrics           # TelemetryWriter (or None)
         # host-side audit ring (the durable trail is the telemetry
         # stream; this is for in-process inspection, bounded so a
@@ -648,6 +672,22 @@ class DecodeEngine:
             self._programs[key] = fn
         self.dispatch_count += 1
         return fn
+
+    def warm(self) -> int:
+        """Prebuild the engine's full program set — every decode (and
+        verify, when speculating) slot bucket, every prefill chunk
+        bucket, and the implant program — so a freshly spawned engine
+        pays its compiles BEFORE it takes traffic (the autoscaler's
+        warm-before-traffic contract; also the worker protocol's
+        ``warm`` op). Idempotent; returns ``compile_count``."""
+        for b in self.slot_buckets:
+            self._program("decode", b)
+            if self.cfg.speculate:
+                self._program("verify", b)
+        for c in self.chunk_buckets:
+            self._program("prefill", c)
+        self._program("implant", 0)
+        return self.compile_count
 
     def _attn_qkv(self, p: LMParams, l: int, a, positions):
         """Shared q/k/v projection + rotary for one layer: ``a [N, d]``
@@ -1356,6 +1396,33 @@ class DecodeEngine:
                 f"waiting queue full ({len(self.waiting)} >= "
                 f"queue_limit {self.policy.queue_limit}); request "
                 f"uid {uid} shed")
+        if (self.qos is not None and self.qos.predictive_shed
+                and self.policy.deadline_steps > 0):
+            # admission throttling by predicted deadline miss: when
+            # even an OPTIMISTIC queue-position ETA (every engine step
+            # serves max_slots requests' tokens in parallel, nobody
+            # else's prefill costs anything) already blows the
+            # deadline, admitting the request would only burn pool
+            # blocks on work _expire_deadlines is certain to fail —
+            # shed it at the door with the ETA on the record instead
+            eta = self._eta_steps(len(prompt), max_new)
+            if eta >= self.policy.deadline_steps:
+                self.rejected += 1
+                self._event("rejected", -1 if auto_uid else uid,
+                            reason="predicted_deadline_miss",
+                            eta_steps=eta,
+                            deadline_steps=self.policy.deadline_steps,
+                            queue_len=len(self.waiting))
+                self._qos_event("predicted_miss_shed", tenant,
+                                uid=-1 if auto_uid else uid,
+                                eta_steps=eta,
+                                deadline_steps=self.policy
+                                .deadline_steps)
+                raise AdmissionError(
+                    f"predicted deadline miss (eta {eta} >= "
+                    f"deadline_steps {self.policy.deadline_steps} "
+                    f"steps); request uid {uid} shed",
+                    reason="predicted_deadline_miss")
         self._next_uid = max(self._next_uid, uid) + 1
         self.prompt_lens[uid] = len(prompt)
         self._pins.setdefault(uid, None)    # pinned at first admission
@@ -1464,6 +1531,18 @@ class DecodeEngine:
         if self.metrics is not None:
             self.metrics.request(rec)
 
+    def _qos_event(self, event: str, tenant, **extra) -> None:
+        """One tenant-QoS scheduling decision record (telemetry v14
+        ``qos`` kind): the step clock + the numbers that justified the
+        decision — all deterministic, so the decision stream replays
+        identically with the tokens."""
+        if self.metrics is not None:
+            self.metrics.qos({"step": self.global_step, "event": event,
+                              "tenant": tenant, **extra})
+        self._step_events.append(f"qos {event}"
+                                 + (f" tenant {tenant}" if tenant
+                                    else ""))
+
     def arm_poison(self, uid: int) -> None:
         """Arm the chaos nan_logits operand for the NEXT engine step:
         ``uid``'s logits row (every row for ``POISON_ALL``) comes out
@@ -1492,6 +1571,78 @@ class DecodeEngine:
 
     # -- scheduler (continued) -----------------------------------------
 
+    def _eta_steps(self, prompt_len: int, max_new: int) -> int:
+        """OPTIMISTIC engine steps from now until a newly submitted
+        request would finish: all queued + resident remaining tokens
+        plus its own, served max_slots per step (the engine's best
+        case), plus its own prefill chunks. Deliberately a lower
+        bound — predictive shedding must only fire on CERTAIN misses
+        (an optimistic ETA past the deadline is a proof, a pessimistic
+        one a guess). Deterministic: token counts and the step clock
+        only."""
+        work = max_new
+        for s in self.slots:
+            if s is not None:
+                work += max(s.max_new - len(s.out), 0)
+        for s in self.waiting:
+            work += s.max_new
+        chunks = -(-prompt_len // self.cfg.prefill_chunk)
+        return -(-work // self.cfg.max_slots) + chunks
+
+    def _resident_tokens(self) -> dict[str, int]:
+        """Per-tenant RESIDENT reserved tokens: the sum of admitted-
+        but-unfinished ``max_new`` across slots — the token-budget
+        gate's measure (reservations, not emissions: a budget caps how
+        much of the pool's future work one tenant may hold)."""
+        out: dict[str, int] = {}
+        for s in self.slots:
+            if s is not None:
+                tk = tenant_key(s.tenant)
+                out[tk] = out.get(tk, 0) + s.max_new
+        return out
+
+    def _next_waiting_index(self) -> tuple[int, float | None]:
+        """The admission order's ONE decision point: ``(index into
+        waiting of the next request to admit, its virtual time)``.
+        FCFS (no qos policy, or discipline fcfs) returns ``(0, None)``
+        — the historical strict head-of-line engine. WFQ picks among
+        each tenant's FIFO head the tenant with the smallest virtual
+        time (served_tokens / weight), ties broken by (submit_step,
+        uid) — deterministic by construction. A tenant whose resident
+        reservation would exceed ``token_budget`` is skipped (recorded
+        once per uid) unless EVERY candidate is over budget — the
+        budget shapes order, it never deadlocks the pool."""
+        if (self.qos is None or self.qos.discipline == "fcfs"
+                or len(self.waiting) <= 1):
+            return 0, None
+        heads: dict[str, tuple[int, _Seq]] = {}
+        for i, s in enumerate(self.waiting):
+            heads.setdefault(tenant_key(s.tenant), (i, s))
+        budget = self.qos.token_budget
+        if budget > 0 and len(heads) > 1:
+            resident = self._resident_tokens()
+            under = {}
+            for tk, (i, s) in heads.items():
+                if resident.get(tk, 0) + s.max_new <= budget:
+                    under[tk] = (i, s)
+                elif s.uid not in self._budget_deferred:
+                    self._budget_deferred.add(s.uid)
+                    self._qos_event("budget_deferred", s.tenant,
+                                    uid=s.uid,
+                                    resident_tokens=resident.get(tk, 0),
+                                    token_budget=budget)
+            if under:
+                heads = under
+
+        def vtime(tk: str) -> float:
+            return (self._tenant_served.get(tk, 0)
+                    / self.qos.weight_of(tk))
+
+        tk = min(heads, key=lambda k: (vtime(k), heads[k][1].submit_step,
+                                       heads[k][1].uid))
+        i, _ = heads[tk]
+        return i, round(vtime(tk), 6)
+
     def _admit(self) -> int:
         """FCFS admission: move waiting requests into free slots while
         both a slot and the request's full block reservation are
@@ -1514,7 +1665,12 @@ class DecodeEngine:
         admitted = 0
         bumped = False
         while self.waiting:
-            seq = self.waiting[0]
+            # the ONE head-selection point: FCFS index 0, or the WFQ
+            # virtual-time pick (DESIGN.md section 26) — either way
+            # the chosen request is "the head" for everything below
+            # (streaks, preemption, the blocked-queue break)
+            head_i, head_vt = self._next_waiting_index()
+            seq = self.waiting[head_i]
             need = self._blocks_needed(len(seq.prompt), seq.max_new)
             free_slots = [i for i, s in enumerate(self.slots) if s is None]
             if not free_slots:
@@ -1554,7 +1710,13 @@ class DecodeEngine:
                 break
             self._head_blocked = 0
             self._head_blocked_uid = None
-            self.waiting.popleft()
+            del self.waiting[head_i]
+            self._budget_deferred.discard(seq.uid)
+            if head_i != 0:
+                # a non-head-of-line admit is the WFQ decision made
+                # visible: record the virtual time that won it
+                self._qos_event("wfq_pick", seq.tenant, uid=seq.uid,
+                                virtual_time=head_vt)
             if seq.weights_version is None:
                 seq.weights_version = ver   # the pin: set ONCE, here
             self._pins[seq.uid] = seq.weights_version
@@ -1882,6 +2044,7 @@ class DecodeEngine:
             self.failed[seq.uid] = {"reason": "deadline",
                                     "retries": seq.retries,
                                     "n_out": len(seq.out)}
+            self._budget_deferred.discard(seq.uid)
 
         def overdue(seq: _Seq) -> bool:
             return self.global_step - seq.submit_step >= dl
@@ -1913,6 +2076,12 @@ class DecodeEngine:
             seq.out.append(tok)
             self.tokens_generated += 1
         seq.emitted += 1
+        # the WFQ virtual clock: every emission (live or teacher-
+        # forced replay — a migrated request's service on THIS engine
+        # counts as this engine's service) advances its tenant's
+        # served-token count
+        tk = tenant_key(seq.tenant)
+        self._tenant_served[tk] = self._tenant_served.get(tk, 0) + 1
         self.next_token[slot] = tok
         # the emission belongs to the CURRENT span (replay or decode
         # segment) — speculation makes steps multi-token, so span

@@ -168,6 +168,7 @@ class EngineHandle:
         self.engine = engine
         self.role = role                    # "prefill" | "decode"
         self.alive = True
+        self.retired = False                # drained out, not dead
         self.snapshot: dict | None = None   # last snapshot_state doc
         self.killed_at_round: int | None = None
         self.last_tokens = 0                # decode-record cadence state
@@ -445,6 +446,15 @@ class EngineHandle:
         """Heartbeat no-op in-process (the process transport's ping is
         a real round-trip with a short deadline)."""
 
+    def warm(self, deadline_s: float = 600.0) -> int:
+        """Pre-build the engine's full program set BEFORE it takes
+        traffic (``DecodeEngine.warm``) — the autoscaler's
+        spawn-then-warm discipline: a joining member must never pay
+        its compiles under live load. Returns the engine's compile
+        count; ``deadline_s`` is ignored in-process (the process
+        transport bounds the RPC with it)."""
+        return self.engine.warm()
+
     def hang(self, secs: float) -> None:
         raise ValueError(
             "hang_worker requires the process transport (an in-process "
@@ -657,6 +667,23 @@ class FleetRouter:
         self._status_wall_last: float | None = None
         # round wall clock (the denominator of the RPC overhead share)
         self.round_wall_s = 0.0
+        # -- closed-loop autoscaling (round 20, DESIGN.md section 26) --
+        # the controller (decode/autoscale.py) mirrors its live state
+        # here after every tick for the status doc; the router itself
+        # never decides to scale — it only provides the membership
+        # primitives (add_engine/retire_engine) and the digests the
+        # controller reads
+        self.autoscale_state: dict | None = None
+        # spawned decode members continue the e-numbering — engine ids
+        # are never reused (a retired/killed handle keeps its slot in
+        # ``handles`` for the post-mortem book)
+        self._decode_serial = sum(1 for h in self.handles
+                                  if h.role == "decode")
+        # per-tenant shed baseline consumed by _publish_status only
+        # (the tps-interval pattern): the published doc's shed_delta
+        # covers publish-to-publish exactly; an out-of-band
+        # status_doc() read must not shorten it
+        self._status_tenant_shed_last: dict[str, int] = {}
 
     # -- introspection -------------------------------------------------
 
@@ -764,8 +791,13 @@ class FleetRouter:
         in_flight: dict[str, int] = {}
         for h in self.handles:
             if not h.alive:
-                engines[h.id] = {"alive": False,
-                                 "killed_at_round": h.killed_at_round}
+                # a RETIRED member drained out gracefully (scale-down)
+                # — distinct from a death, which names the kill round
+                engines[h.id] = ({"alive": False, "retired": True}
+                                 if getattr(h, "retired", False)
+                                 else {"alive": False,
+                                       "killed_at_round":
+                                           h.killed_at_round})
                 continue
             d = h.digest(light=True)
             tokens += int(d.get("tokens_generated") or 0)
@@ -819,11 +851,22 @@ class FleetRouter:
             "tenants": {
                 t: {"in_flight": in_flight.get(t, 0),
                     "offered": self.tenant_offered.get(t, 0),
-                    "shed": self.tenant_shed.get(t, 0)}
+                    "shed": self.tenant_shed.get(t, 0),
+                    # sheds since the LAST PUBLISH (round 20): the
+                    # operator's "is it shedding NOW" signal — the
+                    # baseline is consumed by _publish_status exactly
+                    # like the tps interval's
+                    "shed_delta": (self.tenant_shed.get(t, 0)
+                                   - self._status_tenant_shed_last
+                                   .get(t, 0))}
                 for t in sorted(set(in_flight)
                                 | set(self.tenant_offered)
                                 | set(self.tenant_shed))
             },
+            # live autoscale state (round 20): mirrored by the
+            # controller after every tick — null when no controller
+            # drives this fleet
+            "autoscale": self.autoscale_state,
         }
 
     def _publish_status(self, force: bool = False) -> str | None:
@@ -845,6 +888,7 @@ class FleetRouter:
         # publish-to-publish exactly
         self._status_wall_last = time.perf_counter()
         self._status_tokens_last = doc["tokens_generated"]
+        self._status_tenant_shed_last = dict(self.tenant_shed)
         os.makedirs(self.status_dir, exist_ok=True)
         return wire.publish_json(
             os.path.join(self.status_dir, STATUS_FILENAME), doc)
@@ -1001,13 +1045,19 @@ class FleetRouter:
                  if h is not target), key=self._load_key)
             order = [target] + others
         shed_reasons = []
+        shed_causes = []
         spilled = False
         for h in order:
             try:
                 entry = h.submit(prompt, max_new, uid=uid, trace=trace,
                                  tenant=tenant)
-            except AdmissionError:
-                shed_reasons.append(f"{h.id}: queue_full")
+            except AdmissionError as e:
+                # the engine names WHY it shed (queue_full /
+                # predicted_deadline_miss) — propagate it instead of
+                # guessing, so the fleet-wide shed record and the
+                # driver's per-tenant book attribute the real cause
+                shed_causes.append(getattr(e, "reason", "queue_full"))
+                shed_reasons.append(f"{h.id}: {shed_causes[-1]}")
                 # spillover loses affinity — including the warm-block
                 # count probed for the ORIGINAL target (the next engine
                 # tried is cold; recording the stale count would credit
@@ -1050,10 +1100,15 @@ class FleetRouter:
         if tenant is not None:
             self.tenant_shed[tenant] = \
                 self.tenant_shed.get(tenant, 0) + 1
-        self._record("shed", uid, reason="queue_full", trace_id=trace)
+        # the fleet-wide record names the PRIMARY target's cause (the
+        # engine the router actually wanted — spillover engines only
+        # corroborate), and the raised error carries it for the
+        # driver's own per-reason book
+        cause = shed_causes[0] if shed_causes else "queue_full"
+        self._record("shed", uid, reason=cause, trace_id=trace)
         raise AdmissionError(
             f"every fleet engine shed request uid {uid}: "
-            f"[{'; '.join(shed_reasons)}]")
+            f"[{'; '.join(shed_reasons)}]", reason=cause)
 
     # -- the fleet round -----------------------------------------------
 
@@ -1509,6 +1564,87 @@ class FleetRouter:
         self.migrations += moved
         return moved
 
+    # -- elastic membership (round 20, DESIGN.md section 26) -----------
+
+    def next_decode_eid(self) -> str:
+        """Mint the next decode engine id. Spawned members continue
+        the e-numbering and ids are NEVER reused — a retired e1 keeps
+        its slot in the book and its replacement is e2, so every
+        record ever written still names a unique member."""
+        eid = f"{DECODE_PREFIX}{self._decode_serial}"
+        self._decode_serial += 1
+        return eid
+
+    def add_engine(self, handle) -> None:
+        """Admit one WARMED decode member into the live fleet (the
+        autoscaler's scale-up half). The construction-time gates apply
+        unchanged — model identity against the incumbents, the
+        single-device membership check, and serving-version agreement
+        — so an elastic join can never relax what ``__init__``
+        enforces. The joining engine must already be warm
+        (``EngineHandle.warm``): admission is instant and the next
+        round routes to it."""
+        if handle.id in self.by_id:
+            raise ValueError(f"engine id {handle.id!r} already in the "
+                             "fleet (ids are never reused)")
+        if handle.role != "decode":
+            raise ValueError("elastic members are decode-tier only "
+                             f"(got role {handle.role!r})")
+        incumbent = next((h for h in self.handles if h.alive), None)
+        if incumbent is not None:
+            if handle.model_meta() != incumbent.model_meta():
+                raise ValueError(
+                    "joining engine disagrees on model identity — "
+                    "every replica must serve the same weights")
+            fleet_v = self._fleet_serving_version()
+            join_v = int(handle.digest(light=True)["serving_version"])
+            if join_v != fleet_v:
+                raise ValueError(
+                    f"joining engine serves weights version {join_v} "
+                    f"but the fleet serves {fleet_v} — load the "
+                    "current checkpoint before add_engine")
+        handle.validate_member()
+        self.handles.append(handle)
+        self.by_id[handle.id] = handle
+        # the step-0 snapshot discipline: a kill before the first
+        # cadence snapshot must still know this member's requests
+        handle.snapshot = handle.fetch_snapshot()
+
+    def retire_engine(self, engine_id: str) -> int:
+        """Remove one decode member from the live fleet with ZERO shed
+        (the autoscaler's scale-down half): drain it through the
+        rolling-deploy primitive — live residents ship their KV to
+        peers, everything else replay-resumes, nothing touches a queue
+        limit — then close its transport gracefully. The handle stays
+        in ``handles`` marked ``retired`` (distinct from dead: no kill
+        round, nothing to post-mortem). Returns the number of drained
+        requests. Refuses to retire the last alive decode engine —
+        the min-floor is the controller's invariant, this is the
+        router's own."""
+        h = self.by_id.get(engine_id)
+        if h is None:
+            raise ValueError(f"unknown engine id {engine_id!r}")
+        if not h.alive:
+            raise ValueError(f"engine {engine_id!r} is not alive")
+        if h.role != "decode":
+            raise ValueError("only decode members retire (the "
+                             "prefill tier is static)")
+        if len(self.alive_handles("decode")) <= 1:
+            raise ValueError("refusing to retire the only alive "
+                             "decode engine (scale-to-zero is "
+                             "structurally impossible)")
+        drained = self._drain_engine(h)
+        h.close()
+        h.alive = False
+        h.retired = True
+        # a drained book must never resurrect requests the peers now
+        # hold — retirement is not a death, there is nothing to
+        # migrate from
+        h.snapshot = None
+        if h.transport == "inproc":
+            h.engine = None     # release the pool, like a dead host's
+        return drained
+
     # -- live weight hot-swap (round 17, DESIGN.md section 23) ---------
 
     def schedule_deploy(self, ckpt_dir: str, at_round: int,
@@ -1894,6 +2030,8 @@ class FleetRouter:
         for h in self.handles:
             if not h.alive:
                 per_engine[h.id] = {"alive": False,
+                                    "retired": getattr(h, "retired",
+                                                       False),
                                     "killed_at_round": h.killed_at_round}
                 continue
             per_engine[h.id] = {"alive": True, "role": h.role,

@@ -261,6 +261,30 @@ def build_generate_parser() -> argparse.ArgumentParser:
     p.add_argument("--weights_step", type=int, default=None,
                    help="checkpoint step for --weights_from (default: "
                         "newest verified)")
+    # closed-loop autoscaling + tenant QoS (round 20, DESIGN.md
+    # section 26)
+    p.add_argument("--qos", default=None, metavar="SPEC",
+                   help="per-tenant scheduling policy (runtime/"
+                        "policy.py): discipline=fcfs|wfq,weights="
+                        "a:3;b:1,budget=INT,predictive_shed=0|1 — "
+                        "virtual-time weighted-fair admission over "
+                        "served tokens, per-tenant resident token "
+                        "budgets, and predictive deadline-miss shed "
+                        "(host-side scheduling only: each request's "
+                        "tokens are unchanged, only WHEN it admits)")
+    p.add_argument("--autoscale", default=None, metavar="SPEC",
+                   help="closed-loop decode-tier autoscaler "
+                        "(decode/autoscale.py): min=,max=,up=,down=,"
+                        "hysteresis=,cooldown= — spawns WARMED "
+                        "engines under sustained queue pressure, "
+                        "drains idle ones with zero shed; requires "
+                        "--fleet and a trace source (the controller "
+                        "ticks on the replay's round clock)")
+    p.add_argument("--policy", default=None, metavar="LABEL",
+                   help="policy label stamped into the run's meta "
+                        "records and payload — `report --slo` folds "
+                        "per-policy attainment by it (the offline "
+                        "policy-search key over a committed trace)")
     # observability
     p.add_argument("--metrics_dir", default=None)
     p.add_argument("--log_every", type=int, default=4,
@@ -274,7 +298,8 @@ def build_generate_parser() -> argparse.ArgumentParser:
 
 
 def _fleet_main(args, prompts, cfg, policy, params, fleet_kill,
-                fleet_chaos, argv, trace_doc=None) -> int:
+                fleet_chaos, argv, trace_doc=None, qos=None,
+                autoscale=None) -> int:
     """The ``--fleet N`` run: N engine replicas behind the router
     (``decode/fleet.py``), each with its own metrics stream under
     ``--metrics_dir/<engine_id>`` plus a ``router`` stream for the
@@ -304,20 +329,25 @@ def _fleet_main(args, prompts, cfg, policy, params, fleet_kill,
         role = ("router" if eid == "router" else
                 "prefill" if eid.startswith(PREFILL_PREFIX) else
                 "decode")
-        w = TelemetryWriter(
-            os.path.join(args.metrics_dir, eid),
-            meta={"argv": list(argv or []), "subcommand": "generate",
-                  "engine_id": eid, "role": role, "fleet": args.fleet,
-                  "prefill_engines": args.prefill_engines,
-                  "transport": args.transport,
-                  "kv_dtype": args.kv_dtype,
-                  "n_prompts": len(prompts), "max_new": args.max_new,
-                  "device_kind": jax.devices()[0].device_kind})
+        meta = {"argv": list(argv or []), "subcommand": "generate",
+                "engine_id": eid, "role": role, "fleet": args.fleet,
+                "prefill_engines": args.prefill_engines,
+                "transport": args.transport,
+                "kv_dtype": args.kv_dtype,
+                "n_prompts": len(prompts), "max_new": args.max_new,
+                "device_kind": jax.devices()[0].device_kind}
+        if args.policy:
+            meta["policy"] = args.policy
+        if args.qos:
+            meta["qos"] = args.qos
+        w = TelemetryWriter(os.path.join(args.metrics_dir, eid),
+                            meta=meta)
         writers.append(w)
         return w
 
     def make_engine(eid):
         return DecodeEngine(params, args.heads, cfg, policy=policy,
+                            qos=qos,
                             metrics=(_writer(eid) if args.metrics_dir
                                      else None))
 
@@ -340,18 +370,24 @@ def _fleet_main(args, prompts, cfg, policy, params, fleet_kill,
                      "kv_heads": args.kv_heads or None,
                      "max_seq_len": args.max_seq_len,
                      "random_seed": args.random_seed}
+            worker_meta = {"argv": list(argv or []),
+                           "subcommand": "generate",
+                           "fleet": args.fleet, "transport": "process",
+                           "prefill_engines": args.prefill_engines,
+                           "kv_dtype": args.kv_dtype,
+                           "n_prompts": len(prompts),
+                           "max_new": args.max_new}
+            if args.policy:
+                worker_meta["policy"] = args.policy
+            if args.qos:
+                worker_meta["qos"] = args.qos
             handles = spawn_fleet_handles(
                 args.fleet, args.prefill_engines, spool,
                 model=model, config=_dc.asdict(cfg),
                 policy=_dc.asdict(policy),
+                qos=(qos.as_dict() if qos is not None else None),
                 metrics_root=args.metrics_dir or None,
-                meta={"argv": list(argv or []),
-                      "subcommand": "generate",
-                      "fleet": args.fleet, "transport": "process",
-                      "prefill_engines": args.prefill_engines,
-                      "kv_dtype": args.kv_dtype,
-                      "n_prompts": len(prompts),
-                      "max_new": args.max_new})
+                meta=worker_meta)
             router = FleetRouter(None, args.fleet,
                                  args.prefill_engines,
                                  metrics=router_metrics,
@@ -369,6 +405,33 @@ def _fleet_main(args, prompts, cfg, policy, params, fleet_kill,
                                    step=args.deploy_step)
         if args.deploy_watch is not None:
             router.deploy_watch(args.deploy_dir, args.deploy_watch)
+        controller = None
+        if autoscale is not None:
+            from .autoscale import AutoscaleController
+            if args.transport == "process":
+                from .worker import spawn_worker
+
+                def _spawn(eid):
+                    mdir = (os.path.join(args.metrics_dir, eid)
+                            if args.metrics_dir else None)
+                    return spawn_worker(
+                        eid, "decode", spool, model=model,
+                        config=_dc.asdict(cfg),
+                        policy=_dc.asdict(policy),
+                        qos=(qos.as_dict() if qos is not None
+                             else None),
+                        metrics_dir=mdir,
+                        meta={**worker_meta, "engine_id": eid,
+                              "role": "decode"})
+            else:
+                from .fleet import EngineHandle
+
+                def _spawn(eid):
+                    return EngineHandle(eid, make_engine(eid),
+                                        "decode")
+            controller = AutoscaleController(router, autoscale,
+                                             _spawn,
+                                             metrics=router_metrics)
         shed = 0
         workload = None
         if trace_doc is not None:
@@ -379,7 +442,8 @@ def _fleet_main(args, prompts, cfg, policy, params, fleet_kill,
                 steps_per_s=(args.trace_steps_per_s
                              if args.trace_steps_per_s is not None
                              else 8.0),
-                log_every=args.log_every, metrics=router_metrics)
+                log_every=args.log_every, metrics=router_metrics,
+                autoscale=controller)
             shed = workload["shed"]
         else:
             for pr in prompts:
@@ -434,6 +498,15 @@ def _fleet_main(args, prompts, cfg, policy, params, fleet_kill,
     }
     if workload is not None:
         payload["workload"] = workload
+    if controller is not None:
+        payload["autoscale"] = {
+            "scale_ups": controller.scale_ups,
+            "scale_downs": controller.scale_downs,
+            "history": [{"round": r, "event": e, "reason": why}
+                        for r, e, why in controller.history],
+        }
+    if args.policy:
+        payload["policy"] = args.policy
     if args.metrics_dir:
         # where the live ops plane lives: `fleetstat <this>` renders
         # the router's atomic status doc, mid-run or after
@@ -577,10 +650,20 @@ def generate_main(argv=None) -> int:
                            or args.fleet_chaos or args.deploy_dir
                            or args.deploy_round is not None
                            or args.deploy_step is not None
-                           or args.deploy_watch is not None):
+                           or args.deploy_watch is not None
+                           or args.autoscale):
         print("error: --prefill_engines/--fleet_kill/--transport/"
-              "--fleet_chaos/--deploy_* are fleet flags: pass "
-              "--fleet N (N >= 2)", file=sys.stderr)
+              "--fleet_chaos/--deploy_*/--autoscale are fleet flags: "
+              "pass --fleet N (N >= 2)", file=sys.stderr)
+        return 2
+    if args.autoscale and not trace_mode:
+        print("error: --autoscale drives the trace replay loop (the "
+              "controller ticks on the round clock between arrivals): "
+              "pass --trace FILE or --trace_gen SPEC", file=sys.stderr)
+        return 2
+    if args.policy is not None and not args.policy.strip():
+        print("error: --policy needs a non-empty label",
+              file=sys.stderr)
         return 2
     if args.weights_from is None and args.weights_step is not None:
         print("error: --weights_step names a step of --weights_from — "
@@ -752,6 +835,17 @@ def generate_main(argv=None) -> int:
             deadline_steps=args.deadline_steps,
             max_retries=args.max_retries,
             preempt_after_steps=args.preempt_after)
+        # the serving-policy layer (round 20): both specs are
+        # validated HERE so a malformed one rejects rc 2 with the
+        # parser's one-line named offense, never mid-run
+        qos = None
+        if args.qos:
+            from ..runtime.policy import parse_qos_spec
+            qos = parse_qos_spec(args.qos)
+        autoscale_policy = None
+        if args.autoscale:
+            from ..runtime.policy import parse_autoscale_spec
+            autoscale_policy = parse_autoscale_spec(args.autoscale)
         # under the process transport the router never touches weights
         # — each worker rebuilds them from the recipe (same seed, same
         # bits) — so building them here would just double peak host
@@ -811,7 +905,8 @@ def generate_main(argv=None) -> int:
     if args.fleet:
         return _fleet_main(args, prompts, cfg, policy, params,
                            fleet_kill, fleet_chaos, argv,
-                           trace_doc=trace_doc)
+                           trace_doc=trace_doc, qos=qos,
+                           autoscale=autoscale_policy)
 
     metrics = None
     engine_id = args.engine_id
@@ -831,13 +926,19 @@ def generate_main(argv=None) -> int:
             "prefix_cache": args.prefix_cache,
             "n_prompts": len(prompts), "max_new": args.max_new,
             "device_kind": jax.devices()[0].device_kind}
+        if args.policy:
+            # the offline policy-search key: `report --slo` folds
+            # per-policy attainment by this meta label
+            meta["policy"] = args.policy
+        if args.qos:
+            meta["qos"] = args.qos
         if args.snapshot_dir:
             meta["snapshot_dir"] = args.snapshot_dir
             meta["attempt_log"] = os.path.join(
                 args.snapshot_dir, "serve_supervise.jsonl")
         metrics = TelemetryWriter(args.metrics_dir, meta=meta)
 
-    mesh_kw = dict(mesh=mesh, policy=policy)
+    mesh_kw = dict(mesh=mesh, policy=policy, qos=qos)
     shed = 0
     workload = None
     prior_tokens = 0
@@ -940,6 +1041,8 @@ def generate_main(argv=None) -> int:
         payload["resumed_from_step"] = resumed_from
     if engine_id is not None:
         payload["engine_id"] = engine_id
+    if args.policy:
+        payload["policy"] = args.policy
     print(json.dumps(payload))
     return 0
 

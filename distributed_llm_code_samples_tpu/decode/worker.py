@@ -121,10 +121,14 @@ def worker_main(argv=None) -> int:
     if cfg.get("metrics_dir"):
         metrics = TelemetryWriter(cfg["metrics_dir"],
                                   meta=cfg.get("meta") or {})
+    qos = None
+    if cfg.get("qos"):
+        from ..runtime.policy import QosPolicy
+        qos = QosPolicy.from_dict(cfg["qos"])
     engine = DecodeEngine(params, m["heads"],
                           EngineConfig(**cfg["config"]),
                           policy=ServePolicy(**cfg["policy"]),
-                          metrics=metrics)
+                          metrics=metrics, qos=qos)
     spool = cfg["spool_dir"]
     os.makedirs(spool, exist_ok=True)
     # the worker IS an in-process EngineHandle around its engine (wire
@@ -208,15 +212,9 @@ def worker_main(argv=None) -> int:
             # a drill can tighten per-call deadlines to STEP scale —
             # a compile inside a deadline-bounded step would otherwise
             # read as a silent worker (the in-process kill drill's
-            # prebuild discipline, test_fleet.py)
-            for b in engine.slot_buckets:
-                engine._program("decode", b)
-                if engine.cfg.speculate > 0:
-                    engine._program("verify", b)
-            for c in engine.chunk_buckets:
-                engine._program("prefill", c)
-            engine._program("implant", 0)
-            return {"compiled": engine.compile_count}
+            # prebuild discipline, test_fleet.py); the autoscaler's
+            # spawn-then-warm path shares the same primitive
+            return {"compiled": engine.warm()}
         if op == "export":
             ref = hd.export(req["uid"])     # writes the wire file
             return {"path": ref.path,
@@ -782,18 +780,22 @@ class ProcessEngineHandle:
 
 def _start_worker_proc(eid: str, role: str, base_dir: str, *,
                        model: dict, config: dict, policy: dict,
+                       qos: dict | None = None,
                        metrics_dir=None, meta=None, env=None):
     """Write one worker's config and start its process (detached; log
     in its spool). Returns ``(spool, proc, sock_path)`` — connection
     happens separately so a fleet can boot every jax import in
-    parallel before the first (slow) connect."""
+    parallel before the first (slow) connect. ``qos`` is an optional
+    ``QosPolicy.as_dict()`` — the per-tenant scheduling policy rides
+    the config file, never the socket."""
     spool = os.path.join(base_dir, eid)
     os.makedirs(spool, exist_ok=True)
     sock_path = os.path.join(spool, WORKER_SOCKET_FILENAME)
     cfg = {"engine_id": eid, "role": role, "socket_path": sock_path,
            "spool_dir": spool, "metrics_dir": metrics_dir,
            "meta": {**(meta or {}), "engine_id": eid, "role": role},
-           "model": model, "config": config, "policy": policy}
+           "model": model, "config": config, "policy": policy,
+           "qos": qos}
     cfg_path = os.path.join(spool, WORKER_CONFIG_FILENAME)
     with open(cfg_path, "w") as f:
         json.dump(cfg, f)
@@ -832,8 +834,8 @@ def _connect_and_prime(h: ProcessEngineHandle, config: dict,
 
 
 def spawn_worker(eid: str, role: str, base_dir: str, *, model: dict,
-                 config: dict, policy: dict, metrics_dir=None,
-                 meta=None, env=None,
+                 config: dict, policy: dict, qos: dict | None = None,
+                 metrics_dir=None, meta=None, env=None,
                  call_deadline_s: float = DEFAULT_CALL_DEADLINE_S,
                  ping_deadline_s: float = DEFAULT_PING_DEADLINE_S,
                  connect_deadline_s: float = DEFAULT_CONNECT_DEADLINE_S,
@@ -847,7 +849,7 @@ def spawn_worker(eid: str, role: str, base_dir: str, *, model: dict,
     snapshots."""
     spool, proc, sock_path = _start_worker_proc(
         eid, role, base_dir, model=model, config=config, policy=policy,
-        metrics_dir=metrics_dir, meta=meta, env=env)
+        qos=qos, metrics_dir=metrics_dir, meta=meta, env=env)
     h = ProcessEngineHandle(eid, role, spool, proc, sock_path,
                             call_deadline_s=call_deadline_s,
                             ping_deadline_s=ping_deadline_s)
@@ -861,8 +863,8 @@ def spawn_worker(eid: str, role: str, base_dir: str, *, model: dict,
 
 def spawn_fleet_handles(n_engines: int, prefill_engines: int,
                         base_dir: str, *, model: dict, config: dict,
-                        policy: dict, metrics_root=None, meta=None,
-                        env=None,
+                        policy: dict, qos: dict | None = None,
+                        metrics_root=None, meta=None, env=None,
                         call_deadline_s: float = DEFAULT_CALL_DEADLINE_S,
                         ping_deadline_s: float = DEFAULT_PING_DEADLINE_S,
                         connect_deadline_s: float =
@@ -885,7 +887,8 @@ def spawn_fleet_handles(n_engines: int, prefill_engines: int,
                     if metrics_root else None)
             spool, proc, sock_path = _start_worker_proc(
                 eid, role, base_dir, model=model, config=config,
-                policy=policy, metrics_dir=mdir, meta=meta, env=env)
+                policy=policy, qos=qos, metrics_dir=mdir, meta=meta,
+                env=env)
             procs.append((eid, role, spool, proc, sock_path))
         # phase 2: connect to each
         for eid, role, spool, proc, sock_path in procs:

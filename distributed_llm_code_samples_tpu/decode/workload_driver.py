@@ -60,7 +60,7 @@ class WorkloadDriver:
     def __init__(self, target, header: dict, entries: list[dict], *,
                  vocab: int, pace: str = "virtual",
                  steps_per_s: float = 8.0, log_every: int = 0,
-                 metrics=None):
+                 metrics=None, autoscale=None):
         if pace not in ("virtual", "wall"):
             raise ValueError(f"pace must be 'virtual' or 'wall', got "
                              f"{pace!r}")
@@ -85,6 +85,19 @@ class WorkloadDriver:
         self.uid_tenant: dict[int, str] = {}
         self.offered: dict[str, int] = {}
         self.shed: dict[str, int] = {}
+        # per-REASON shed book (round 20): the engine names why it
+        # shed (queue_full / predicted_deadline_miss) and the raised
+        # AdmissionError carries it — only the driver sees every shed
+        self.shed_reasons: dict[str, int] = {}
+        # closed-loop autoscaler (decode/autoscale.py), ticked between
+        # rounds on the SAME round clock the chaos plan fires on — a
+        # scale action counts as progress for the stall refusal (a
+        # fleet mid-spawn is not stalled)
+        if autoscale is not None and not self.is_fleet:
+            raise ValueError("autoscale drives a fleet target only "
+                             "(a single engine has no membership to "
+                             "scale)")
+        self.autoscale = autoscale
         self.rounds = 0
         self._interval_offered = 0
         self._interval_admitted = 0
@@ -120,8 +133,10 @@ class WorkloadDriver:
             else:
                 uid = self.target.submit(prompt, int(entry["max_new"]),
                                          tenant=entry.get("tenant"))
-        except AdmissionError:
+        except AdmissionError as e:
             self.shed[tk] = self.shed.get(tk, 0) + 1
+            r = getattr(e, "reason", "queue_full")
+            self.shed_reasons[r] = self.shed_reasons.get(r, 0) + 1
             return
         self.uid_tenant[uid] = tk
         self._interval_admitted += 1
@@ -202,6 +217,12 @@ class WorkloadDriver:
                 self._submit(entries[i])
                 i += 1
             did = self._step()
+            if self.autoscale is not None:
+                # between-rounds controller tick, on the round clock
+                # (deterministic under virtual pacing); a scale action
+                # is progress — the stall refusal must not fire while
+                # a replacement worker is being spawned and warmed
+                did = bool(self.autoscale.tick()) or did
             self.rounds += 1
             if self.log_every > 0 and self.rounds % self.log_every == 0:
                 self._emit_decode_cadence()
@@ -246,6 +267,7 @@ class WorkloadDriver:
             "offered": self.total_offered,
             "admitted": self.total_admitted,
             "shed": self.total_offered - self.total_admitted,
+            "shed_reasons": dict(sorted(self.shed_reasons.items())),
             "tenants": self._tenants_block(completed),
         }
 
@@ -253,9 +275,12 @@ class WorkloadDriver:
 def replay_trace(target, header: dict, entries: list[dict], *,
                  vocab: int, pace: str = "virtual",
                  steps_per_s: float = 8.0, log_every: int = 0,
-                 metrics=None) -> dict:
+                 metrics=None, autoscale=None) -> dict:
     """One-call replay (see ``WorkloadDriver``): drive ``entries``
-    into ``target`` and return the workload summary."""
+    into ``target`` and return the workload summary. ``autoscale`` is
+    an ``AutoscaleController`` ticked between rounds (fleet targets
+    only)."""
     return WorkloadDriver(target, header, entries, vocab=vocab,
                           pace=pace, steps_per_s=steps_per_s,
-                          log_every=log_every, metrics=metrics).run()
+                          log_every=log_every, metrics=metrics,
+                          autoscale=autoscale).run()

@@ -61,8 +61,14 @@ def render(doc: dict) -> str:
               else "")]
     for eid, e in sorted((doc.get("engines") or {}).items()):
         if not e.get("alive"):
-            out.append(f"  {eid:4s} DEAD (killed at round "
-                       f"{e.get('killed_at_round')})")
+            if e.get("retired"):
+                # an autoscale scale-down, not a casualty: the member
+                # drained zero-shed and left on purpose
+                out.append(f"  {eid:4s} RETIRED (drained by "
+                           "scale-down)")
+            else:
+                out.append(f"  {eid:4s} DEAD (killed at round "
+                           f"{e.get('killed_at_round')})")
             continue
         out.append(f"  {eid:4s} [{e.get('role')}] v"
                    f"{e.get('serving_version')}  waiting "
@@ -73,8 +79,23 @@ def render(doc: dict) -> str:
                    f"{(e.get('last_step_s') or 0.0) * 1e3:.1f} ms")
     tens = doc.get("tenants") or {}
     for t, c in sorted(tens.items()):
+        delta = c.get("shed_delta")
         out.append(f"  tenant {t:10s} in-flight {c.get('in_flight')}  "
-                   f"offered {c.get('offered')}  shed {c.get('shed')}")
+                   f"offered {c.get('offered')}  shed {c.get('shed')}"
+                   + (f" (+{delta} this interval)" if delta else ""))
+    a = doc.get("autoscale")
+    if a:
+        cd = a.get("cooldown_remaining") or 0
+        last = (f"{a.get('last_event')} ({a.get('last_reason')}) at "
+                f"round {a.get('last_round')}"
+                if a.get("last_event") else "none yet")
+        out.append(f"  autoscale: {a.get('engines')}/"
+                   f"{a.get('target_engines')} engines "
+                   f"(bounds {a.get('min_engines')}.."
+                   f"{a.get('max_engines')})  last decision {last}  "
+                   f"cooldown {cd} round(s)  "
+                   f"+{a.get('scale_ups')}/-{a.get('scale_downs')} "
+                   "lifetime")
     c = doc.get("counters") or {}
     out.append("  counters: " + ", ".join(
         f"{k} {c.get(k)}" for k in ("routed", "handoffs", "migrations",

@@ -763,6 +763,17 @@ def _slo_accounting(streams, slo_ttft: float, slo_itl: float) -> dict:
     """
     comp = _merged_completions(streams)
     spans_by_uid = _merged_spans(streams)
+    # per-policy attribution (v14): one run serves one policy, so each
+    # completion inherits the ``--policy`` label of the stream (run)
+    # that emitted it — merged with the same first-completion-wins
+    # ordering as ``_merged_completions`` so the label matches the
+    # record the numbers came from
+    policy_of: dict = {}
+    for r, label in sorted(((r, s.header.get("policy"))
+                            for s in streams for r in s.requests
+                            if r["event"] == "completed"),
+                           key=lambda rl: rl[0].get("t", 0.0)):
+        policy_of.setdefault(r["uid"], label)
     moved_t: dict = {}
     for s in streams:
         for r in s.routers:
@@ -780,6 +791,8 @@ def _slo_accounting(streams, slo_ttft: float, slo_itl: float) -> dict:
         entry = {"uid": uid, "latency_s": latency, "ttft_s": ttft,
                  "n_new": n_new, "migrated": uid in moved_t,
                  "tenant": _tenant_of(rec)}
+        if policy_of.get(uid) is not None:
+            entry["policy"] = policy_of[uid]
         spans = spans_by_uid.get(uid, [])
         if latency is None or ttft is None:
             entry["status"] = "unreconciled"
@@ -901,6 +914,23 @@ def _slo_accounting(streams, slo_ttft: float, slo_itl: float) -> dict:
     for b in by_tenant.values():
         b["attainment"] = (round(b["attained"] / b["completed"], 4)
                            if b["completed"] else None)
+    # the per-policy goodput slice (v14): the offline policy search's
+    # comparison surface — group by the run's ``--policy`` label (a
+    # report over two labelled runs of the same trace prints both
+    # policies' attainment side by side); unlabelled runs fold nowhere
+    by_policy: dict = {}
+    for e in per_uid:
+        label = e.get("policy")
+        if label is None:
+            continue
+        b = by_policy.setdefault(label, {
+            "completed": 0, "attained": 0, "violated": 0,
+            "unreconciled": 0})
+        b["completed"] += 1
+        b[e["status"]] += 1
+    for b in by_policy.values():
+        b["attainment"] = (round(b["attained"] / b["completed"], 4)
+                           if b["completed"] else None)
     return {
         "slo_ttft_s": slo_ttft, "slo_itl_s": slo_itl,
         "completed": total, **counts,
@@ -908,6 +938,7 @@ def _slo_accounting(streams, slo_ttft: float, slo_itl: float) -> dict:
                        if total else None),
         "violations_by_span": by_span,
         "by_tenant": by_tenant,
+        "by_policy": by_policy,
         "requests": per_uid,
     }
 
@@ -1527,6 +1558,17 @@ def _render_slo(out: list, slo: dict) -> None:
             pct = ("n/a" if b["attainment"] is None
                    else f"{b['attainment'] * 100:.1f}%")
             out.append(f"  tenant {t:10s} goodput {pct} — "
+                       f"{b['attained']}/{b['completed']} attained, "
+                       f"{b['violated']} violated, "
+                       f"{b['unreconciled']} unreconciled")
+    bp = slo.get("by_policy") or {}
+    if bp:
+        # the per-policy goodput slice (v14): only labelled runs
+        # (``generate --policy``) land here — the policy-search readout
+        for p, b in sorted(bp.items()):
+            pct = ("n/a" if b["attainment"] is None
+                   else f"{b['attainment'] * 100:.1f}%")
+            out.append(f"  policy {p:10s} goodput {pct} — "
                        f"{b['attained']}/{b['completed']} attained, "
                        f"{b['violated']} violated, "
                        f"{b['unreconciled']} unreconciled")

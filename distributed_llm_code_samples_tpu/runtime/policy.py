@@ -1,0 +1,248 @@
+"""Serving policy layer: the parsed, validated knobs the closed
+control loop acts on (DESIGN.md section 26).
+
+Two policies, both plain host-side data — nothing here ever enters a
+compiled program or a sampling key, so a policy change can never
+change a request's tokens, only WHEN requests are admitted and how
+many engines serve them:
+
+- ``QosPolicy``: per-tenant scheduling discipline for the engine's
+  admission order. ``fcfs`` is the historical strict head-of-line
+  queue; ``wfq`` is virtual-time weighted fairness over SERVED tokens
+  (each tenant's virtual time advances by served_tokens / weight; the
+  waiting head with the smallest virtual time admits next), plus an
+  optional per-tenant resident token budget and predictive
+  deadline-miss shedding at the door.
+- ``AutoscalePolicy``: the between-rounds decode-tier controller's
+  thresholds. Scale up when the mean per-engine waiting depth holds
+  at or above ``up_queue`` for ``hysteresis`` consecutive rounds;
+  scale down when it holds strictly below ``down_queue`` (and the
+  fleet is above ``min_engines``). ``up_queue > down_queue`` is
+  REQUIRED (a dead band, so flapping is structurally impossible) and
+  ``min_engines >= 1`` (scale-to-zero likewise). ``cooldown`` rounds
+  must pass after any scale action before the next.
+
+**Spec grammars** (comma-separated ``key=value``, the ``--trace_gen``
+parse-rejection discipline — every malformed entry is ONE ValueError
+naming the offense, which the CLI maps to rc 2)::
+
+    --qos       discipline=fcfs|wfq          default wfq
+                weights=NAME:W(;NAME:W)*     default none (weight 1)
+                budget=INT                   default 0 (off)
+                predictive_shed=0|1          default 1
+    --autoscale min=INT                      default 1
+                max=INT                      default 4
+                up=INT                       default 4
+                down=INT                     default 1
+                hysteresis=INT               default 2
+                cooldown=INT                 default 8
+
+Deliberately jax-free (stdlib only): parsing a policy must not pay a
+backend import, and the controller itself is pure host-side control
+flow.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+QOS_DISCIPLINES = ("fcfs", "wfq")
+
+
+@dataclass(frozen=True)
+class QosPolicy:
+    """Per-tenant admission-order policy (``decode/engine.py`` reads
+    it in ``_admit``/``submit``; never inside a compiled program).
+
+    ``weights`` maps tenant name -> positive weight (an unlisted
+    tenant gets weight 1.0); ``token_budget`` caps a tenant's RESIDENT
+    reserved tokens (sum of admitted-but-unfinished ``max_new``; 0 =
+    no cap); ``predictive_shed`` sheds a request at submit when its
+    queue-position ETA already blows ``deadline_steps``."""
+
+    discipline: str = "wfq"
+    weights: tuple = field(default_factory=tuple)  # ((name, w), ...)
+    token_budget: int = 0
+    predictive_shed: bool = True
+
+    def __post_init__(self):
+        if self.discipline not in QOS_DISCIPLINES:
+            raise ValueError(f"bad QosPolicy discipline "
+                             f"{self.discipline!r}: known disciplines "
+                             f"{QOS_DISCIPLINES}")
+        for name, w in self.weights:
+            if not name or not isinstance(name, str):
+                raise ValueError(f"bad QosPolicy weight name {name!r}")
+            if not isinstance(w, (int, float)) or w <= 0:
+                raise ValueError(f"bad QosPolicy weight for "
+                                 f"{name!r}: {w!r} must be > 0")
+        if len({n for n, _ in self.weights}) != len(self.weights):
+            raise ValueError("bad QosPolicy weights: duplicate tenant")
+        if not isinstance(self.token_budget, int) \
+                or self.token_budget < 0:
+            raise ValueError(f"bad QosPolicy token_budget "
+                             f"{self.token_budget!r}: must be an "
+                             "integer >= 0")
+
+    def weight_of(self, tenant_key: str) -> float:
+        for name, w in self.weights:
+            if name == tenant_key:
+                return float(w)
+        return 1.0
+
+    def as_dict(self) -> dict:
+        return {"discipline": self.discipline,
+                "weights": [[n, w] for n, w in self.weights],
+                "token_budget": self.token_budget,
+                "predictive_shed": self.predictive_shed}
+
+    @classmethod
+    def from_dict(cls, doc: dict) -> "QosPolicy":
+        return cls(discipline=doc["discipline"],
+                   weights=tuple((n, float(w))
+                                 for n, w in doc["weights"]),
+                   token_budget=int(doc["token_budget"]),
+                   predictive_shed=bool(doc["predictive_shed"]))
+
+
+@dataclass(frozen=True)
+class AutoscalePolicy:
+    """The decode-tier controller's thresholds
+    (``decode/autoscale.py`` acts on them between fleet rounds)."""
+
+    min_engines: int = 1
+    max_engines: int = 4
+    up_queue: int = 4
+    down_queue: int = 1
+    hysteresis: int = 2
+    cooldown: int = 8
+
+    def __post_init__(self):
+        for name in ("min_engines", "max_engines", "up_queue",
+                     "down_queue", "hysteresis", "cooldown"):
+            v = getattr(self, name)
+            if not isinstance(v, int) or isinstance(v, bool):
+                raise ValueError(f"bad AutoscalePolicy {name} {v!r}: "
+                                 "must be an integer")
+        if self.min_engines < 1:
+            raise ValueError(f"bad AutoscalePolicy min_engines "
+                             f"{self.min_engines}: must be >= 1 "
+                             "(scale-to-zero is structurally "
+                             "impossible)")
+        if self.max_engines < self.min_engines:
+            raise ValueError(f"bad AutoscalePolicy max_engines "
+                             f"{self.max_engines}: must be >= "
+                             f"min_engines {self.min_engines}")
+        if self.up_queue <= self.down_queue:
+            raise ValueError(f"bad AutoscalePolicy thresholds: up "
+                             f"{self.up_queue} must be > down "
+                             f"{self.down_queue} (the dead band that "
+                             "makes flapping impossible)")
+        if self.down_queue < 0:
+            raise ValueError(f"bad AutoscalePolicy down_queue "
+                             f"{self.down_queue}: must be >= 0")
+        if self.hysteresis < 1:
+            raise ValueError(f"bad AutoscalePolicy hysteresis "
+                             f"{self.hysteresis}: must be >= 1")
+        if self.cooldown < 0:
+            raise ValueError(f"bad AutoscalePolicy cooldown "
+                             f"{self.cooldown}: must be >= 0")
+
+
+def _policy_int(flag: str, key: str, val: str) -> int:
+    try:
+        return int(val)
+    except ValueError:
+        raise ValueError(f"bad {flag} {key} {val!r}: must be an "
+                         "integer") from None
+
+
+def parse_qos_spec(spec: str) -> QosPolicy:
+    """Parse + validate one ``--qos`` spec (module-docstring grammar).
+    Every malformed entry is ONE ValueError naming the offense."""
+    out = {"discipline": "wfq", "weights": (), "token_budget": 0,
+           "predictive_shed": True}
+    seen = set()
+    for entry in (e.strip() for e in spec.split(",") if e.strip()):
+        if "=" not in entry:
+            raise ValueError(f"bad --qos entry {entry!r}: expected "
+                             "key=value with key in discipline/"
+                             "weights/budget/predictive_shed")
+        key, _, val = entry.partition("=")
+        if key in seen:
+            raise ValueError(f"bad --qos spec: duplicate key {key!r}")
+        seen.add(key)
+        if key == "discipline":
+            if val not in QOS_DISCIPLINES:
+                raise ValueError(f"bad --qos discipline {val!r}: "
+                                 f"known disciplines {QOS_DISCIPLINES}")
+            out["discipline"] = val
+        elif key == "weights":
+            mix = []
+            for part in (p.strip() for p in val.split(";")
+                         if p.strip()):
+                name, sep, w = part.partition(":")
+                if not name or not sep:
+                    raise ValueError(
+                        f"bad --qos weights entry {part!r}: expected "
+                        "NAME:WEIGHT (e.g. weights=a:3;b:1)")
+                try:
+                    weight = float(w)
+                except ValueError:
+                    raise ValueError(f"bad --qos weights weight "
+                                     f"{w!r}: must be a number") \
+                        from None
+                if weight <= 0:
+                    raise ValueError(f"bad --qos weights weight "
+                                     f"{weight}: must be > 0")
+                mix.append((name, weight))
+            if not mix:
+                raise ValueError("bad --qos weights: empty mix")
+            if len({n for n, _ in mix}) != len(mix):
+                raise ValueError("bad --qos weights: duplicate tenant "
+                                 "name")
+            out["weights"] = tuple(mix)
+        elif key == "budget":
+            b = _policy_int("--qos", "budget", val)
+            if b < 0:
+                raise ValueError(f"bad --qos budget {b}: must be "
+                                 ">= 0 (0 = off)")
+            out["token_budget"] = b
+        elif key == "predictive_shed":
+            if val not in ("0", "1"):
+                raise ValueError(f"bad --qos predictive_shed {val!r}: "
+                                 "must be 0 or 1")
+            out["predictive_shed"] = val == "1"
+        else:
+            raise ValueError(f"bad --qos key {key!r}: known keys "
+                             "discipline/weights/budget/"
+                             "predictive_shed")
+    return QosPolicy(**out)
+
+
+def parse_autoscale_spec(spec: str) -> AutoscalePolicy:
+    """Parse + validate one ``--autoscale`` spec (module-docstring
+    grammar). Every malformed entry is ONE ValueError naming the
+    offense; the cross-field constraints (up > down, min >= 1) are
+    enforced by ``AutoscalePolicy`` itself."""
+    names = {"min": "min_engines", "max": "max_engines",
+             "up": "up_queue", "down": "down_queue",
+             "hysteresis": "hysteresis", "cooldown": "cooldown"}
+    out = {}
+    seen = set()
+    for entry in (e.strip() for e in spec.split(",") if e.strip()):
+        if "=" not in entry:
+            raise ValueError(f"bad --autoscale entry {entry!r}: "
+                             "expected key=value with key in "
+                             "min/max/up/down/hysteresis/cooldown")
+        key, _, val = entry.partition("=")
+        if key in seen:
+            raise ValueError(f"bad --autoscale spec: duplicate key "
+                             f"{key!r}")
+        seen.add(key)
+        if key not in names:
+            raise ValueError(f"bad --autoscale key {key!r}: known "
+                             "keys min/max/up/down/hysteresis/"
+                             "cooldown")
+        out[names[key]] = _policy_int("--autoscale", key, val)
+    return AutoscalePolicy(**out)

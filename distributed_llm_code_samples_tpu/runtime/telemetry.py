@@ -145,7 +145,30 @@ import numpy as np
 # offered/completed/shed counts (monotonic across the run, so the
 # final record is the totals and the sums reconcile against the
 # per-request records — pinned by test).
-SCHEMA_VERSION = 13
+# v14 (round 20): the closed control loop (DESIGN.md section 26).
+# (1) adds the "autoscale" kind — one record per decode-tier scale
+# decision from the between-rounds controller
+# (``decode/autoscale.py``): ``step`` the router's round clock,
+# ``event`` one of AUTOSCALE_EVENTS (scale_up / scale_down / held),
+# ``reason`` the named trigger (queue_pressure / queue_idle /
+# below_min_floor / cooldown), ``engines`` the alive decode count
+# AFTER the decision, ``target_engines`` what the controller wants.
+# ``scale_up`` conditionally pins ``engine`` (the spawned id);
+# ``scale_down`` pins ``engine`` + ``drained`` (the zero-shed drain's
+# migrated-resident count) — the DEPLOY_EVENT_REQUIRED pattern.
+# (2) adds the "qos" kind — one record per tenant-QoS scheduling
+# decision (``decode/engine.py``): ``step`` the engine step, ``event``
+# one of QOS_EVENTS, ``tenant`` the tenant acted on (null
+# single-tenant). Per-event pins: ``predicted_miss_shed`` carries
+# ``uid``/``eta_steps``/``deadline_steps`` (the admission-time ETA
+# that blew the deadline), ``budget_deferred`` carries
+# ``uid``/``resident_tokens``/``token_budget`` (the budget that
+# deferred the admit), ``wfq_pick`` carries ``uid``/``virtual_time``
+# (the virtual-time value that won a NON-head-of-line admit).
+# Every pinned value is derived from the deterministic round/step
+# clocks and served-token counters — never the wall clock — so qos
+# and autoscale decision streams replay identically with the tokens.
+SCHEMA_VERSION = 14
 
 METRICS_FILENAME = "metrics.jsonl"
 
@@ -387,6 +410,50 @@ DEPLOY_EVENT_REQUIRED = {
 WORKLOAD_REQUIRED = ("step", "trace", "offered", "admitted",
                      "tenants")
 
+# The autoscale-record contract (``decode/autoscale.py``, v14): one
+# record per decode-tier scale decision. ``step`` is the router's
+# round clock, ``event`` one of AUTOSCALE_EVENTS, ``reason`` the named
+# trigger, ``engines`` the alive decode-engine count AFTER the
+# decision, ``target_engines`` the controller's target. Deterministic
+# by construction (round clock + queue-depth counters — wall clock
+# only in the unpinned ``t`` envelope and extras like ``spawn_s``), so
+# the decision stream replays identically with the tokens. Same
+# version-bump discipline as STEP_KEYS.
+AUTOSCALE_REQUIRED = ("step", "event", "reason", "engines",
+                      "target_engines")
+
+# the autoscale decision vocabulary (report renders any name; a new
+# event is additive)
+AUTOSCALE_EVENTS = ("scale_up", "scale_down", "held")
+
+# per-event conditional pins for autoscale records (validate_record;
+# the DEPLOY_EVENT_REQUIRED pattern): only a scale names the engine it
+# spawned/drained, and only a scale-down measures a drain
+AUTOSCALE_EVENT_REQUIRED = {
+    "scale_up": ("engine",),
+    "scale_down": ("engine", "drained"),
+}
+
+# The qos-record contract (``decode/engine.py``, v14): one record per
+# tenant-QoS scheduling decision. ``step`` is the GLOBAL engine step,
+# ``event`` one of QOS_EVENTS, ``tenant`` the tenant acted on (null
+# single-tenant). Same version-bump discipline as STEP_KEYS.
+QOS_REQUIRED = ("step", "event", "tenant")
+
+# the qos decision vocabulary (report renders any name; a new event is
+# additive)
+QOS_EVENTS = ("predicted_miss_shed", "budget_deferred", "wfq_pick")
+
+# per-event conditional pins for qos records (validate_record): each
+# decision pins exactly the numbers that justified it — the ETA that
+# blew the deadline, the budget that deferred, the virtual time that
+# won a non-FIFO admit
+QOS_EVENT_REQUIRED = {
+    "predicted_miss_shed": ("uid", "eta_steps", "deadline_steps"),
+    "budget_deferred": ("uid", "resident_tokens", "token_budget"),
+    "wfq_pick": ("uid", "virtual_time"),
+}
+
 # Non-step record kinds the stream also carries: run headers ("meta"),
 # recovery/chaos/checkpoint events ("event"), bench measurement rows
 # ("bench" — bench.py's per-measurement plumbing rides the same
@@ -395,7 +462,7 @@ WORKLOAD_REQUIRED = ("step", "trace", "offered", "admitted",
 # per-request phase records.
 RECORD_KINDS = ("step", "meta", "event", "bench", "anomaly", "rollback",
                 "decode", "request", "span", "router", "fleet",
-                "deploy", "workload")
+                "deploy", "workload", "autoscale", "qos")
 
 # kind -> the pinned required-key set validate_record enforces (step
 # records additionally pin their FULL key set via STEP_KEYS)
@@ -410,6 +477,8 @@ REQUIRED_KEYS = {
     "fleet": FLEET_REQUIRED,
     "deploy": DEPLOY_REQUIRED,
     "workload": WORKLOAD_REQUIRED,
+    "autoscale": AUTOSCALE_REQUIRED,
+    "qos": QOS_REQUIRED,
 }
 
 # bf16 peak matmul FLOP/s by chip generation (public spec sheets; the
@@ -670,6 +739,28 @@ class TelemetryWriter:
         rec["kind"] = "workload"
         self._put(rec)
 
+    def autoscale(self, record: dict) -> None:
+        """Enqueue one decode-tier scale decision record: scale_up /
+        scale_down / held (``decode/autoscale.py``;
+        ``AUTOSCALE_REQUIRED`` contract plus the per-event conditional
+        pins)."""
+        rec = dict(record)
+        rec.setdefault("t", time.time())
+        rec["kind"] = "autoscale"
+        self._put(rec)
+
+    def qos(self, record: dict) -> None:
+        """Enqueue one tenant-QoS scheduling decision record:
+        predicted_miss_shed / budget_deferred / wfq_pick
+        (``decode/engine.py``; ``QOS_REQUIRED`` contract plus the
+        per-event conditional pins — tenant defaults to null, the
+        single-tenant stance of request records)."""
+        rec = dict(record)
+        rec.setdefault("t", time.time())
+        rec.setdefault("tenant", None)
+        rec["kind"] = "qos"
+        self._put(rec)
+
     def fleet(self, record: dict) -> None:
         """Enqueue one per-round fleet health record: per-engine
         waiting/active/free-blocks/utilization plus the load-imbalance
@@ -817,6 +908,23 @@ def validate_record(rec: Any) -> tuple[bool, str]:
                    if k not in rec]
         if missing:
             return False, (f"deploy record (event {rec['event']}) "
+                           f"missing required key(s) {missing}")
+    if kind == "autoscale" and rec.get("event") in \
+            AUTOSCALE_EVENT_REQUIRED:
+        # v14 conditional pins: only a scale names the engine it
+        # spawned/drained, only a scale-down measures a drain
+        missing = [k for k in AUTOSCALE_EVENT_REQUIRED[rec["event"]]
+                   if k not in rec]
+        if missing:
+            return False, (f"autoscale record (event {rec['event']}) "
+                           f"missing required key(s) {missing}")
+    if kind == "qos" and rec.get("event") in QOS_EVENT_REQUIRED:
+        # v14 conditional pins: each qos decision carries exactly the
+        # numbers that justified it
+        missing = [k for k in QOS_EVENT_REQUIRED[rec["event"]]
+                   if k not in rec]
+        if missing:
+            return False, (f"qos record (event {rec['event']}) "
                            f"missing required key(s) {missing}")
     if kind == "step" and not isinstance(rec["step"], int):
         return False, (f"step record key 'step' is "

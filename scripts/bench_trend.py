@@ -50,6 +50,12 @@ WORKLOAD_ROW_LANES = {
     "workload_goodput": ("bursty", "uniform"),
     "workload_disagg": ("colocated", "disaggregated"),
 }
+# the round-20 policy rows (bench_decode.py's offline policy search):
+# same lane discipline as the workload rows — a dict row carries a
+# stated "slo" plus per-policy lanes with numeric attainment, and the
+# autoscale row prices the controller's reaction in rounds
+POLICY_GOODPUT_LANES = ("fcfs", "wfq")
+POLICY_AUTOSCALE_NUMS = ("reaction_rounds", "scale_ups", "attainment")
 
 
 def _round_of(path: str, prefix: str) -> str:
@@ -149,6 +155,55 @@ def _validate_workload_rows(name: str, payload: dict,
                                 "'attainment' is not a number")
 
 
+def _validate_policy_rows(name: str, payload: dict,
+                          problems: list) -> None:
+    """The policy_* row contracts (DECODE artifacts from round 20 on;
+    absence is fine — older rounds predate them). Mirrors the workload
+    row stance: an "error: ..." string is a recorded outage; a dict
+    must carry its lane structure."""
+    if isinstance(payload.get("policy_goodput"), dict) \
+            and "policy_autoscale" not in payload:
+        problems.append(f"{name}: policy_goodput present but "
+                        "policy_autoscale missing (the rows are "
+                        "emitted together)")
+    for key in ("policy_goodput", "policy_autoscale"):
+        row = payload.get(key)
+        if row is None:
+            continue
+        if isinstance(row, str):
+            if not row.startswith("error:"):
+                problems.append(f"{name}: {key} is a string but not "
+                                "an 'error:' outage record")
+            continue
+        if not isinstance(row, dict):
+            problems.append(f"{name}: {key} is "
+                            f"{type(row).__name__}, not an object")
+            continue
+        if key == "policy_goodput":
+            if "slo" not in row:
+                problems.append(f"{name}: {key} missing key 'slo' "
+                                "(the stated SLO the attainment is "
+                                "under)")
+            for lane in POLICY_GOODPUT_LANES:
+                ln = row.get(lane)
+                if not isinstance(ln, dict):
+                    problems.append(f"{name}: {key} lane {lane!r} "
+                                    "missing or not an object")
+                    continue
+                att = ln.get("attainment")
+                if not isinstance(att, (int, float)) \
+                        or isinstance(att, bool):
+                    problems.append(f"{name}: {key} lane {lane!r} "
+                                    "'attainment' is not a number")
+        else:
+            for nk in POLICY_AUTOSCALE_NUMS:
+                v = row.get(nk)
+                if not isinstance(v, (int, float)) \
+                        or isinstance(v, bool):
+                    problems.append(f"{name}: {key} {nk!r} is not a "
+                                    "number")
+
+
 def validate_decode(path: str, problems: list) -> dict | None:
     """One DECODE_* artifact -> a trend row: headline keys + the
     workload_* row contracts when present."""
@@ -173,6 +228,7 @@ def validate_decode(path: str, problems: list) -> dict | None:
         return None
     before = len(problems)
     _validate_workload_rows(name, doc, problems)
+    _validate_policy_rows(name, doc, problems)
     if len(problems) > before:
         return None
     row = {"round": _round_of(path, "DECODE_"), "file": name,
@@ -183,6 +239,11 @@ def validate_decode(path: str, problems: list) -> dict | None:
         row["workload_goodput"] = {
             lane: wg[lane]["attainment"]
             for lane in WORKLOAD_ROW_LANES["workload_goodput"]}
+    pg = doc.get("policy_goodput")
+    if isinstance(pg, dict):
+        row["policy_goodput"] = {
+            lane: pg[lane]["attainment"]
+            for lane in POLICY_GOODPUT_LANES}
     return row
 
 

@@ -714,9 +714,42 @@ BASE_ARGS = ["--prompt_lens", "3,7", "--max_new", "4", "-d", "32",
     # the --fleet_kill twin above
     ["--fleet", "2", "--prefill_engines", "1", "--transport",
      "process", "--fleet_chaos", "kill_worker@2"],
+    # round 20: --autoscale is a fleet flag, and the controller ticks
+    # on the trace replay's round clock — without a trace source it
+    # would silently never fire
+    ["--autoscale", "min=1,max=2"],
+    ["--fleet", "2", "--autoscale", "min=1,max=2"],
 ])
 def test_cli_fleet_flag_rejections(extra):
     assert _gen(BASE_ARGS + extra) == 2
+
+
+# the round-20 policy specs parse-reject in trace mode too (rc 2, one
+# line, before any engine exists) — same discipline as --trace_gen
+TRACE_FLEET_ARGS = BASE_ARGS[2:] + [
+    "--trace_gen", "n=2,plen=fixed:4,max_new=2", "--fleet", "2"]
+
+
+@pytest.mark.parametrize("extra", [
+    ["--autoscale", "min=0"],           # scale-to-zero floor
+    ["--autoscale", "max=0"],           # max < min
+    ["--autoscale", "up=1,down=1"],     # no dead band
+    ["--autoscale", "min=1,min=2"],     # duplicate key
+    ["--autoscale", "bogus"],           # not key=value
+    ["--autoscale", "min=x"],           # not an integer
+    ["--autoscale", "turbo=9"],         # unknown key
+    ["--qos", "discipline=warp"],
+    ["--qos", "weights=a:0"],
+    ["--qos", "weights=a:1;a:2"],
+    ["--qos", "weights="],
+    ["--qos", "weights=a"],
+    ["--qos", "budget=-1"],
+    ["--qos", "predictive_shed=2"],
+    ["--qos", "turbo=1"],
+    ["--policy", "   "],                # label must be non-empty
+])
+def test_cli_policy_spec_rejections(extra):
+    assert _gen(TRACE_FLEET_ARGS + extra) == 2
 
 
 def test_cli_fleet_end_to_end_matches_single_engine(capsys):

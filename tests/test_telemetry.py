@@ -78,7 +78,15 @@ from distributed_llm_code_samples_tpu.runtime.telemetry import (
 # trace-replay interval from decode/workload_driver.py: the trace
 # identity, per-interval offered/admitted, cumulative per-tenant
 # offered/completed/shed counts) with WORKLOAD_REQUIRED.
-_PINNED_VERSION = 13
+# v14 (round 20): the control plane — the "autoscale" kind (one record
+# per decode-tier scale decision from decode/autoscale.py: scale_up /
+# scale_down / held with the named trigger, alive count, and target;
+# scale_up conditionally pins the spawned ``engine``, scale_down pins
+# ``engine`` + ``drained``) and the "qos" kind (one record per tenant
+# scheduling decision from decode/engine.py: predicted_miss_shed /
+# budget_deferred / wfq_pick, each pinning exactly the numbers that
+# justified it).
+_PINNED_VERSION = 14
 _PINNED_STEP_KEYS = frozenset({
     "schema", "kind", "t", "step", "strategy", "loss", "grad_norm",
     "tokens_per_sec", "step_time_s", "mfu", "hbm_high_water_bytes",
@@ -120,12 +128,28 @@ _PINNED_DEPLOY_EVENT_REQUIRED = {
     "completed": frozenset({"duration_s"}),
     "rolled_back": frozenset({"duration_s", "reason"}),
 }
+_PINNED_AUTOSCALE_REQUIRED = frozenset({
+    "step", "event", "reason", "engines", "target_engines",
+})
+_PINNED_AUTOSCALE_EVENT_REQUIRED = {
+    "scale_up": frozenset({"engine"}),
+    "scale_down": frozenset({"engine", "drained"}),
+}
+_PINNED_QOS_REQUIRED = frozenset({"step", "event", "tenant"})
+_PINNED_QOS_EVENT_REQUIRED = {
+    "predicted_miss_shed": frozenset({"uid", "eta_steps",
+                                      "deadline_steps"}),
+    "budget_deferred": frozenset({"uid", "resident_tokens",
+                                  "token_budget"}),
+    "wfq_pick": frozenset({"uid", "virtual_time"}),
+}
 
 
 def test_schema_version_bump_discipline():
     from distributed_llm_code_samples_tpu.runtime.telemetry import (
-        ANOMALY_REQUIRED, DECODE_REQUIRED, DEPLOY_EVENT_REQUIRED,
-        DEPLOY_REQUIRED, FLEET_REQUIRED, RECORD_KINDS,
+        ANOMALY_REQUIRED, AUTOSCALE_EVENT_REQUIRED, AUTOSCALE_REQUIRED,
+        DECODE_REQUIRED, DEPLOY_EVENT_REQUIRED, DEPLOY_REQUIRED,
+        FLEET_REQUIRED, QOS_EVENT_REQUIRED, QOS_REQUIRED, RECORD_KINDS,
         REQUEST_COMPLETED_REQUIRED, REQUEST_REQUIRED, REQUIRED_KEYS,
         ROLLBACK_REQUIRED, ROUTER_MOVE_REQUIRED, ROUTER_REQUIRED,
         SPAN_REQUIRED, WORKLOAD_REQUIRED)
@@ -145,7 +169,13 @@ def test_schema_version_bump_discipline():
         frozenset(DEPLOY_REQUIRED) == _PINNED_DEPLOY_REQUIRED and \
         frozenset(WORKLOAD_REQUIRED) == _PINNED_WORKLOAD_REQUIRED and \
         {k: frozenset(v) for k, v in DEPLOY_EVENT_REQUIRED.items()} \
-        == _PINNED_DEPLOY_EVENT_REQUIRED, (
+        == _PINNED_DEPLOY_EVENT_REQUIRED and \
+        frozenset(AUTOSCALE_REQUIRED) == _PINNED_AUTOSCALE_REQUIRED and \
+        {k: frozenset(v) for k, v in AUTOSCALE_EVENT_REQUIRED.items()} \
+        == _PINNED_AUTOSCALE_EVENT_REQUIRED and \
+        frozenset(QOS_REQUIRED) == _PINNED_QOS_REQUIRED and \
+        {k: frozenset(v) for k, v in QOS_EVENT_REQUIRED.items()} \
+        == _PINNED_QOS_EVENT_REQUIRED, (
             "telemetry record schema changed: bump SCHEMA_VERSION "
             "and update the pinned sets here in the same commit")
     assert "anomaly" in RECORD_KINDS and "rollback" in RECORD_KINDS
@@ -156,11 +186,14 @@ def test_schema_version_bump_discipline():
     assert "fleet" in RECORD_KINDS
     assert "deploy" in RECORD_KINDS
     assert "workload" in RECORD_KINDS
+    assert "autoscale" in RECORD_KINDS
+    assert "qos" in RECORD_KINDS
     # every contract-carrying kind routes through the one table
     # validate_record reads (a new kind that skips it validates
     # envelope-only silently — this catches the drift)
     for kind in ("step", "anomaly", "rollback", "decode", "request",
-                 "span", "router", "fleet", "deploy", "workload"):
+                 "span", "router", "fleet", "deploy", "workload",
+                 "autoscale", "qos"):
         assert kind in REQUIRED_KEYS, kind
 
 
@@ -279,6 +312,8 @@ def test_span_record_round_trip_and_torn_tail(tmp_path):
     ("fleet", _PINNED_FLEET_REQUIRED),
     ("deploy", _PINNED_DEPLOY_REQUIRED),
     ("workload", _PINNED_WORKLOAD_REQUIRED),
+    ("autoscale", _PINNED_AUTOSCALE_REQUIRED),
+    ("qos", _PINNED_QOS_REQUIRED),
 ])
 def test_validate_record_names_kind_and_key(kind, required):
     """Satellite contract: every validate_record failure is ONE line
@@ -396,6 +431,100 @@ def test_fleet_record_round_trip_and_torn_tail(tmp_path):
     ok, reason = validate_record(bad)
     assert not ok and "fleet record" in reason \
         and "load_imbalance" in reason
+
+
+def test_autoscale_record_round_trip_and_torn_tail(tmp_path):
+    """The schema-v14 autoscale kind (decode/autoscale.py): writer
+    method stamps the kind + envelope, records validate, a torn tail
+    after an autoscale write is reported-not-fatal, and a missing
+    contract key rejects naming kind and key."""
+    w = TelemetryWriter(str(tmp_path))
+    w.autoscale({"step": 6, "event": "scale_up",
+                 "reason": "queue_pressure", "engines": 3,
+                 "target_engines": 3, "engine": "e2", "compiled": 8,
+                 "spawn_s": 0.42})
+    w.qos({"step": 9, "event": "wfq_pick", "tenant": "quiet",
+           "uid": 4, "virtual_time": 2.5})
+    w.close()
+    path = os.path.join(str(tmp_path), METRICS_FILENAME)
+    with open(path, "a") as f:
+        f.write('{"schema": 14, "kind": "auto')  # torn write
+    records, problems = read_metrics(path)
+    assert len(problems) == 1 and "torn" in problems[0]
+    up, pick = records
+    assert up["kind"] == "autoscale" and up["schema"] == SCHEMA_VERSION
+    assert up["event"] == "scale_up" and up["engine"] == "e2"
+    assert up["engines"] == 3 and up["target_engines"] == 3
+    assert up["spawn_s"] == 0.42        # extras ride along, unpinned
+    assert pick["kind"] == "qos" and pick["schema"] == SCHEMA_VERSION
+    assert pick["tenant"] == "quiet" and pick["virtual_time"] == 2.5
+    for r in records:
+        ok, reason = validate_record(r)
+        assert ok, reason
+    bad = {k: v for k, v in up.items() if k != "target_engines"}
+    ok, reason = validate_record(bad)
+    assert not ok and "autoscale record" in reason \
+        and "target_engines" in reason
+    # qos tenant defaults to null (the single-tenant stance), never
+    # silently absent
+    w2 = TelemetryWriter(str(tmp_path / "single"))
+    w2.qos({"step": 1, "event": "predicted_miss_shed", "uid": 7,
+            "eta_steps": 30, "deadline_steps": 20})
+    w2.close()
+    [rec], problems = read_metrics(
+        os.path.join(str(tmp_path / "single"), METRICS_FILENAME))
+    assert problems == []
+    assert rec["tenant"] is None
+    ok, reason = validate_record(rec)
+    assert ok, reason
+
+
+def test_autoscale_event_conditional_pin():
+    """v14: scale_up names the spawned engine, scale_down names the
+    drained engine AND the drained-resident count; held pins nothing
+    beyond the base contract — per event, per key."""
+    base = {"schema": SCHEMA_VERSION, "kind": "autoscale", "t": 0.0,
+            "step": 2, "reason": "queue_pressure", "engines": 2,
+            "target_engines": 3}
+    pins = {"scale_up": {"engine": "e2"},
+            "scale_down": {"engine": "e1", "drained": 2}}
+    for event, keys in pins.items():
+        ok, reason = validate_record({**base, "event": event, **keys})
+        assert ok, reason
+        for key in sorted(keys):
+            rec = {**base, "event": event, **keys}
+            del rec[key]
+            ok, reason = validate_record(rec)
+            assert not ok and event in reason and key in reason, \
+                (event, key, reason)
+            assert "\n" not in reason
+    ok, reason = validate_record({**base, "event": "held"})
+    assert ok, reason
+
+
+def test_qos_event_conditional_pin():
+    """v14: each qos decision pins exactly the numbers that justified
+    it (the ETA that blew the deadline, the budget that deferred, the
+    virtual time that won) — per event, per key."""
+    base = {"schema": SCHEMA_VERSION, "kind": "qos", "t": 0.0,
+            "step": 5, "tenant": "noisy"}
+    pins = {
+        "predicted_miss_shed": {"uid": 3, "eta_steps": 40,
+                                "deadline_steps": 24},
+        "budget_deferred": {"uid": 4, "resident_tokens": 96,
+                            "token_budget": 64},
+        "wfq_pick": {"uid": 5, "virtual_time": 1.25},
+    }
+    for event, keys in pins.items():
+        ok, reason = validate_record({**base, "event": event, **keys})
+        assert ok, reason
+        for key in sorted(keys):
+            rec = {**base, "event": event, **keys}
+            del rec[key]
+            ok, reason = validate_record(rec)
+            assert not ok and event in reason and key in reason, \
+                (event, key, reason)
+            assert "\n" not in reason
 
 
 def test_completed_request_record_conditional_pin():

@@ -439,6 +439,81 @@ def test_noisy_tenant_starvation_visible_and_reconciled(lm_params,
     assert "offered vs admitted" in text
 
 
+def test_wfq_flips_noisy_tenant_baseline(lm_params, tmp_path):
+    """The QoS scheduler moves the recorded FCFS baseline on the SAME
+    (trace, seed): under weighted-fair scheduling (quiet:3;noisy:1)
+    the quiet tenant's median TTFT is no longer above the noisy
+    flood's, per-tenant counts still reconcile with the fleet totals,
+    and every token is byte-identical to the FCFS run — fairness
+    reorders ADMISSION, never sampling identity."""
+    from distributed_llm_code_samples_tpu.report import report_main
+    from distributed_llm_code_samples_tpu.runtime.policy import (
+        QosPolicy)
+    header = {"trace_version": 1, "id": "trnoisy", "seed": 0,
+              "spec": "hand", "n": 10}
+    entries = (
+        [{"t_offset_s": 0.0, "uid_hint": i, "tenant": "noisy",
+          "session": None, "prompt_len": 6, "max_new": 6, "turn": 0}
+         for i in range(8)]
+        + [{"t_offset_s": 0.1, "uid_hint": 8 + j, "tenant": "quiet",
+            "session": None, "prompt_len": 6, "max_new": 6, "turn": 0}
+           for j in range(2)])
+
+    def warmed(qos=None):
+        eng = DecodeEngine(lm_params, H, _cfg(max_slots=2), qos=qos)
+        # warm the program set FIRST (same shapes), no writer: the
+        # flip assertion compares wall-clock TTFTs — a cold compile
+        # inside the flood would swamp the queueing signal
+        rng = np.random.default_rng(9)
+        for _ in range(2):
+            eng.submit(rng.integers(0, V, size=6).tolist(), 6)
+        eng.run()
+        return eng
+
+    fcfs = warmed()
+    replay_trace(fcfs, header, entries, vocab=V)
+    mdir = str(tmp_path / "m")
+    m = TelemetryWriter(mdir)
+    wfq = warmed(qos=QosPolicy(discipline="wfq",
+                               weights=(("quiet", 3), ("noisy", 1))))
+    wfq.metrics = m
+    replay_trace(wfq, header, entries, vocab=V, log_every=4, metrics=m)
+    m.close()
+    # token identity across disciplines: keys fold (seed, uid,
+    # position), so the fair schedule changed WHEN, never WHAT
+    assert wfq.finished == fcfs.finished
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = report_main([mdir, "--slo", "100:0.000001", "--json"])
+    assert rc == 0
+    doc = json.loads(buf.getvalue())
+    wl = doc["workload"]
+    assert wl["reconciled"], wl
+    assert wl["tenants"]["noisy"]["completed"] == 8
+    assert wl["tenants"]["quiet"]["completed"] == 2
+    assert sum(e["completed"] for e in wl["tenants"].values()) \
+        == wl["completed_total"] == 10
+    # THE FLIP: the baseline drill pins quiet's p50 ABOVE noisy's
+    # under FCFS; weighted-fair admission must bring it down to at
+    # most the flood's own median
+    assert wl["tenants"]["quiet"]["ttft_p50_s"] <= \
+        wl["tenants"]["noisy"]["ttft_p50_s"], wl["tenants"]
+    bt = doc["slo"]["by_tenant"]
+    assert sum(b["completed"] for b in bt.values()) \
+        == doc["slo"]["completed"]
+    # the scheduler's decisions are on the record: at least one
+    # schema-valid wfq_pick naming the tenant it favored
+    recs, problems = read_metrics(os.path.join(mdir, METRICS_FILENAME))
+    assert not problems
+    picks = [r for r in recs if r["kind"] == "qos"
+             and r["event"] == "wfq_pick"]
+    assert picks, "wfq run emitted no wfq_pick qos record"
+    for r in picks:
+        ok, reason = validate_record(r)
+        assert ok, reason
+        assert r["tenant"] in ("noisy", "quiet")
+
+
 def test_queue_limit_sheds_count_per_tenant(lm_params, tmp_path):
     """Sheds at the door land in the DRIVER's per-tenant book (the
     engine's rejected record is the anonymous uid -1): the workload
