@@ -1201,6 +1201,141 @@ def main() -> int:
             and os.environ.get("DECODE_ENGINE", "1") != "0":
         guarded("policy_goodput", policy_rows)
 
+    # round 21: the watchtower priced — burn-rate reaction to a
+    # mid-burst kill on the replay's own round clock, and the alert
+    # history's replay identity asserted via the golden-stream differ
+    def watch_rows():
+        import tempfile
+
+        from distributed_llm_code_samples_tpu.decode import (
+            DecodeEngine, EngineConfig, FleetRouter)
+        from distributed_llm_code_samples_tpu.decode.workload_driver \
+            import replay_trace
+        from distributed_llm_code_samples_tpu.report import (
+            diff_streams, load_diff_stream)
+        from distributed_llm_code_samples_tpu.runtime.telemetry import (
+            TelemetryWriter)
+        from distributed_llm_code_samples_tpu.runtime.watch import (
+            WatchPolicy, Watchtower)
+        from distributed_llm_code_samples_tpu.runtime.workload import (
+            generate_trace)
+
+        block = int(os.environ.get("BENCH_ENGINE_BLOCK", 16))
+        slots = 2
+        # lane-local request shape, NOT the bench T0/NEW: the drill's
+        # round-clock dynamics (arrival rounds, drain time, fast-window
+        # recovery) must not shift when the env resizes the model
+        wl_new = 4
+        plen_hi = 12
+        mbps = -(-(plen_hi + wl_new) // block)
+
+        def cfg():
+            return EngineConfig(
+                block_size=block, n_blocks=1 + slots * mbps,
+                max_slots=slots, max_blocks_per_seq=mbps,
+                prefill_chunk=min(block, 8), kv_dtype="f32")
+
+        # bursts separated by long OFF gaps: the kill lands under the
+        # opening burst (deadline violations -> the page), the gap
+        # drains the fast window (the resolve) while the replay is
+        # still live; the same drill the tier-1 watchtower smoke runs
+        spec = (f"n=8,arrival=bursty:30:0.15:2.5,plen=zipf:1.7:3:"
+                f"{plen_hi},max_new={wl_new},tenants=a:3;b:1,seed=7")
+        wp = WatchPolicy(deadline=8, fast=4, slow=12, incidents=1)
+        kill_round = 4
+
+        def lane(kill):
+            hdr, ents = generate_trace(spec)
+            mdir = tempfile.mkdtemp(prefix="bench_watch_")
+            writers = []
+
+            def mk(eid):
+                m = TelemetryWriter(os.path.join(mdir, eid))
+                writers.append(m)
+                return DecodeEngine(params, H, cfg(), metrics=m)
+
+            rm = TelemetryWriter(os.path.join(mdir, "router"))
+            writers.append(rm)
+            fl = FleetRouter(mk, 2, metrics=rm)
+            if kill is not None:
+                fl.schedule_kill("e1", kill)
+            tower = Watchtower(fl, wp, metrics=rm)
+            summary = replay_trace(fl, hdr, ents, vocab=V,
+                                   steps_per_s=8.0, log_every=4,
+                                   metrics=rm, watch=tower)
+            outs = fl.results()
+            for w in writers:
+                w.close()
+            return hdr, outs, tower, summary, mdir
+
+        _, _, t_healthy, _, _ = lane(None)
+        if t_healthy.history:
+            raise RuntimeError(
+                "watchtower paged a healthy replay — the drill's "
+                f"thresholds drifted: {t_healthy.history}")
+        hdr, outs1, t1, summary, m1 = lane(kill_round)
+        _, outs2, t2, _, m2 = lane(kill_round)
+        if outs2 != outs1:
+            raise RuntimeError(
+                "watched kill-drill replayed twice produced different "
+                "tokens — the watchtower leaked into scheduling")
+        if t1.history != t2.history:
+            raise RuntimeError(
+                "watched kill-drill replayed twice produced different "
+                "alert histories — a detector read a wall clock")
+        # the differ is the assertion surface the smokes use: the two
+        # replays' ALERT streams must be byte-equivalent after
+        # envelope stripping, not merely same-shaped
+        verdict = diff_streams(
+            load_diff_stream(os.path.join(m1, "router"), ("alert",)),
+            load_diff_stream(os.path.join(m2, "router"), ("alert",)))
+        if verdict["verdict"] != "identical":
+            raise RuntimeError(
+                "alert-history stream diff not identical across "
+                f"replays: {verdict}")
+        fired = next((rnd for rnd, ev, det in t1.history
+                      if ev == "fired" and det == "burn_rate"), None)
+        resolved = next((rnd for rnd, ev, det in t1.history
+                         if ev == "resolved" and det == "burn_rate"),
+                        None)
+        if fired is None:
+            raise RuntimeError(
+                "burn-rate alert never fired under the kill drill — "
+                "the detector missed a real SLO burn")
+        if resolved is None:
+            raise RuntimeError(
+                "burn-rate alert never resolved — the OFF gap should "
+                "have drained the fast window while the replay lived")
+        paths["watch_reaction"] = {
+            "trace": hdr["id"],
+            "kill_round": kill_round,
+            "fired_round": fired,
+            "reaction_rounds": fired - kill_round,
+            "resolved_round": resolved,
+            "fired": t1.fired,
+            "resolved": t1.resolved,
+            "rounds": summary["rounds"],
+        }
+        paths["watch_replay_identity"] = {
+            "trace": hdr["id"],
+            "alert_history": verdict["verdict"],
+            "alert_records": verdict["n_a"],
+        }
+        paths["watch_note"] = (
+            "8 requests, bursty arrivals with long OFF gaps, e1 "
+            f"killed at round {kill_round} under the opening burst; "
+            f"watch policy deadline={wp.deadline} rounds "
+            f"fast={wp.fast} slow={wp.slow} incidents={wp.incidents}. "
+            "healthy replay asserted alert-free; reaction_rounds = "
+            "first burn_rate fire minus the kill round, on the "
+            "replay's own round clock; alert streams asserted "
+            "byte-identical across two replays via the golden-stream "
+            "differ (scripts/stream_diff.py semantics, kinds=alert).")
+
+    if not tp_only and os.environ.get("DECODE_FLEET", "1") != "0" \
+            and os.environ.get("DECODE_ENGINE", "1") != "0":
+        guarded("watch_reaction", watch_rows)
+
     # TP decode scaling on the fake-8-device CPU mesh: subprocesses
     # (fresh backend each — the current process is pinned to its
     # platform) run ONLY the tp path at tiny shape over mesh 1/2/4/8.

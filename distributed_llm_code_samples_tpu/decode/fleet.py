@@ -674,6 +674,13 @@ class FleetRouter:
         # primitives (add_engine/retire_engine) and the digests the
         # controller reads
         self.autoscale_state: dict | None = None
+        # -- watchtower (round 21, DESIGN.md section 27) --
+        # the live alert block (runtime/watch.py mirrors it here after
+        # every tick, exactly like autoscale_state): the status doc's
+        # ``alerts`` block and the router postmortem's
+        # active-alerts-at-declaration evidence — null when no
+        # watchtower drives this fleet
+        self.watch_state: dict | None = None
         # spawned decode members continue the e-numbering — engine ids
         # are never reused (a retired/killed handle keeps its slot in
         # ``handles`` for the post-mortem book)
@@ -867,6 +874,9 @@ class FleetRouter:
             # controller after every tick — null when no controller
             # drives this fleet
             "autoscale": self.autoscale_state,
+            # live watchtower alerts (round 21): mirrored by the
+            # watchtower after every tick — null when none watches
+            "alerts": self.watch_state,
         }
 
     def _publish_status(self, force: bool = False) -> str | None:
@@ -933,6 +943,10 @@ class FleetRouter:
             "t": time.time(),
             "reason": reason,
             "evidence": h.evidence(),
+            # active-alerts-at-declaration (round 21): what the
+            # watchtower was ALREADY paging about when the router
+            # declared this engine dead — null when none watches
+            "alerts": self.watch_state,
         }
         os.makedirs(self.status_dir, exist_ok=True)
         return wire.publish_json(
@@ -1067,7 +1081,11 @@ class FleetRouter:
                 continue
             self.requests[uid] = {"prompt": prompt, "max_new": max_new,
                                   "engine": h.id, "session": session,
-                                  "trace": trace, "tenant": tenant}
+                                  "trace": trace, "tenant": tenant,
+                                  # admission round (round 21): the
+                                  # watchtower's round-denominated
+                                  # latency baseline for this uid
+                                  "round": self.rounds}
             if session is not None and h.role == "decode":
                 self._sessions[session] = h.id
             self.routed += 1

@@ -280,6 +280,19 @@ def build_generate_parser() -> argparse.ArgumentParser:
                         "drains idle ones with zero shed; requires "
                         "--fleet and a trace source (the controller "
                         "ticks on the replay's round clock)")
+    p.add_argument("--watch", default=None, metavar="SPEC",
+                   help="fleet watchtower (runtime/watch.py): "
+                        "deadline=ROUNDS,budget=F,burn=F,fast=N,"
+                        "slow=N,queue=N,imbalance=F,collapse=N,"
+                        "incidents=N — streaming detectors on the "
+                        "replay's round clock emitting `alert` "
+                        "records with a fired->resolved lifecycle "
+                        "(burn-rate over the round-denominated "
+                        "deadline, sustained queue depth/imbalance, "
+                        "throughput collapse, incident rate); active "
+                        "alerts ride fleet_status.json for fleetstat/"
+                        "report --follow; requires --fleet and a "
+                        "trace source")
     p.add_argument("--policy", default=None, metavar="LABEL",
                    help="policy label stamped into the run's meta "
                         "records and payload — `report --slo` folds "
@@ -299,7 +312,7 @@ def build_generate_parser() -> argparse.ArgumentParser:
 
 def _fleet_main(args, prompts, cfg, policy, params, fleet_kill,
                 fleet_chaos, argv, trace_doc=None, qos=None,
-                autoscale=None) -> int:
+                autoscale=None, watch=None) -> int:
     """The ``--fleet N`` run: N engine replicas behind the router
     (``decode/fleet.py``), each with its own metrics stream under
     ``--metrics_dir/<engine_id>`` plus a ``router`` stream for the
@@ -432,6 +445,10 @@ def _fleet_main(args, prompts, cfg, policy, params, fleet_kill,
             controller = AutoscaleController(router, autoscale,
                                              _spawn,
                                              metrics=router_metrics)
+        tower = None
+        if watch is not None:
+            from ..runtime.watch import Watchtower
+            tower = Watchtower(router, watch, metrics=router_metrics)
         shed = 0
         workload = None
         if trace_doc is not None:
@@ -443,7 +460,7 @@ def _fleet_main(args, prompts, cfg, policy, params, fleet_kill,
                              if args.trace_steps_per_s is not None
                              else 8.0),
                 log_every=args.log_every, metrics=router_metrics,
-                autoscale=controller)
+                autoscale=controller, watch=tower)
             shed = workload["shed"]
         else:
             for pr in prompts:
@@ -504,6 +521,13 @@ def _fleet_main(args, prompts, cfg, policy, params, fleet_kill,
             "scale_downs": controller.scale_downs,
             "history": [{"round": r, "event": e, "reason": why}
                         for r, e, why in controller.history],
+        }
+    if tower is not None:
+        payload["watch"] = {
+            "fired": tower.fired,
+            "resolved": tower.resolved,
+            "history": [{"round": r, "event": e, "detector": d}
+                        for r, e, d in tower.history],
         }
     if args.policy:
         payload["policy"] = args.policy
@@ -651,14 +675,19 @@ def generate_main(argv=None) -> int:
                            or args.deploy_round is not None
                            or args.deploy_step is not None
                            or args.deploy_watch is not None
-                           or args.autoscale):
+                           or args.autoscale or args.watch):
         print("error: --prefill_engines/--fleet_kill/--transport/"
-              "--fleet_chaos/--deploy_*/--autoscale are fleet flags: "
-              "pass --fleet N (N >= 2)", file=sys.stderr)
+              "--fleet_chaos/--deploy_*/--autoscale/--watch are "
+              "fleet flags: pass --fleet N (N >= 2)", file=sys.stderr)
         return 2
     if args.autoscale and not trace_mode:
         print("error: --autoscale drives the trace replay loop (the "
               "controller ticks on the round clock between arrivals): "
+              "pass --trace FILE or --trace_gen SPEC", file=sys.stderr)
+        return 2
+    if args.watch and not trace_mode:
+        print("error: --watch detectors fold the trace replay's round "
+              "clock (that's what makes the alert history replayable): "
               "pass --trace FILE or --trace_gen SPEC", file=sys.stderr)
         return 2
     if args.policy is not None and not args.policy.strip():
@@ -846,6 +875,10 @@ def generate_main(argv=None) -> int:
         if args.autoscale:
             from ..runtime.policy import parse_autoscale_spec
             autoscale_policy = parse_autoscale_spec(args.autoscale)
+        watch_policy = None
+        if args.watch:
+            from ..runtime.watch import parse_watch_spec
+            watch_policy = parse_watch_spec(args.watch)
         # under the process transport the router never touches weights
         # — each worker rebuilds them from the recipe (same seed, same
         # bits) — so building them here would just double peak host
@@ -906,7 +939,8 @@ def generate_main(argv=None) -> int:
         return _fleet_main(args, prompts, cfg, policy, params,
                            fleet_kill, fleet_chaos, argv,
                            trace_doc=trace_doc, qos=qos,
-                           autoscale=autoscale_policy)
+                           autoscale=autoscale_policy,
+                           watch=watch_policy)
 
     metrics = None
     engine_id = args.engine_id

@@ -60,7 +60,7 @@ class WorkloadDriver:
     def __init__(self, target, header: dict, entries: list[dict], *,
                  vocab: int, pace: str = "virtual",
                  steps_per_s: float = 8.0, log_every: int = 0,
-                 metrics=None, autoscale=None):
+                 metrics=None, autoscale=None, watch=None):
         if pace not in ("virtual", "wall"):
             raise ValueError(f"pace must be 'virtual' or 'wall', got "
                              f"{pace!r}")
@@ -98,6 +98,14 @@ class WorkloadDriver:
                              "(a single engine has no membership to "
                              "scale)")
         self.autoscale = autoscale
+        # watchtower (runtime/watch.py), ticked between rounds AFTER
+        # the autoscaler on the same round clock — detectors see the
+        # round's post-scale truth, and the alert history inherits the
+        # replay determinism the round clock gives every decision
+        if watch is not None and not self.is_fleet:
+            raise ValueError("watch drives a fleet target only (the "
+                             "detectors read the router's digests)")
+        self.watch = watch
         self.rounds = 0
         self._interval_offered = 0
         self._interval_admitted = 0
@@ -223,6 +231,8 @@ class WorkloadDriver:
                 # is progress — the stall refusal must not fire while
                 # a replacement worker is being spawned and warmed
                 did = bool(self.autoscale.tick()) or did
+            if self.watch is not None:
+                self.watch.tick()
             self.rounds += 1
             if self.log_every > 0 and self.rounds % self.log_every == 0:
                 self._emit_decode_cadence()
@@ -275,12 +285,12 @@ class WorkloadDriver:
 def replay_trace(target, header: dict, entries: list[dict], *,
                  vocab: int, pace: str = "virtual",
                  steps_per_s: float = 8.0, log_every: int = 0,
-                 metrics=None, autoscale=None) -> dict:
+                 metrics=None, autoscale=None, watch=None) -> dict:
     """One-call replay (see ``WorkloadDriver``): drive ``entries``
     into ``target`` and return the workload summary. ``autoscale`` is
-    an ``AutoscaleController`` ticked between rounds (fleet targets
-    only)."""
+    an ``AutoscaleController`` and ``watch`` a ``Watchtower``, each
+    ticked between rounds (fleet targets only)."""
     return WorkloadDriver(target, header, entries, vocab=vocab,
                           pace=pace, steps_per_s=steps_per_s,
                           log_every=log_every, metrics=metrics,
-                          autoscale=autoscale).run()
+                          autoscale=autoscale, watch=watch).run()

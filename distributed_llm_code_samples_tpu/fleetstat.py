@@ -96,6 +96,24 @@ def render(doc: dict) -> str:
                    f"cooldown {cd} round(s)  "
                    f"+{a.get('scale_ups')}/-{a.get('scale_downs')} "
                    "lifetime")
+    al = doc.get("alerts")
+    if al is not None:
+        act = al.get("active") or []
+        out.append(f"  alerts: {len(act)} active  "
+                   f"({al.get('fired')} fired / "
+                   f"{al.get('resolved')} resolved lifetime)")
+        for a in act:
+            bits = [f"    ALERT {a.get('detector')} "
+                    f"[{a.get('severity')}] since round "
+                    f"{a.get('since_round')}"]
+            if a.get("burn_fast") is not None:
+                bits.append(f"burn fast {a['burn_fast']} / slow "
+                            f"{a['burn_slow']}")
+            for k in ("waiting", "imbalance", "stalled_rounds",
+                      "incidents"):
+                if a.get(k) is not None:
+                    bits.append(f"{k} {a[k]}")
+            out.append("  ".join(bits))
     c = doc.get("counters") or {}
     out.append("  counters: " + ", ".join(
         f"{k} {c.get(k)}" for k in ("routed", "handoffs", "migrations",
@@ -127,9 +145,15 @@ def fleetstat_main(argv=None) -> int:
                    help="--follow gives up after this many seconds "
                         "(rc 0 if any status was ever rendered, rc 2 "
                         "if none appeared)")
+    p.add_argument("--follow_max_s", type=float, default=None,
+                   help="alias of --max_s (name parity with `report "
+                        "--follow_max_s` so follow scripts can treat "
+                        "the two tails interchangeably)")
     p.add_argument("--json", action="store_true",
                    help="print the raw status document")
     args = p.parse_args(argv)
+    if args.follow_max_s is not None:
+        args.max_s = args.follow_max_s
     if args.interval <= 0 or args.max_s <= 0:
         print("fleetstat: --interval/--max_s must be > 0",
               file=sys.stderr)

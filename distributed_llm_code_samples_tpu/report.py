@@ -71,6 +71,7 @@ import sys
 import numpy as np
 
 from .runtime.telemetry import (FLIGHT_FILENAME, METRICS_FILENAME,
+                                RECORD_KINDS,
                                 ROUTER_POSTMORTEM_PREFIX,
                                 STATUS_FILENAME, read_metrics)
 
@@ -287,6 +288,9 @@ class _Stream:
         # schema-v13 trace-replay interval records (the workload
         # driver, decode/workload_driver.py)
         self.workloads = by.get("workload", [])
+        # schema-v15 watchtower alert records (runtime/watch.py):
+        # fired/resolved detector transitions on the fleet round clock
+        self.alerts = by.get("alert", [])
         # request records: drop exact replays — an in-process
         # supervisor restart resumes from a snapshot that may PREDATE
         # records already emitted, so the replayed steps re-emit
@@ -670,6 +674,21 @@ class _Stream:
                 f"interval offered {wrec.get('offered')} admitted "
                 f"{wrec.get('admitted')} @ round {wrec.get('step')}"
                 + (f"  [{tb}]" if tb else "")))
+        for a in self.alerts:
+            ev = a["event"]
+            bits = [f"ALERT {a.get('detector')} {ev.upper()} "
+                    f"[{a.get('severity')}] @ fleet round "
+                    f"{a.get('step')}"]
+            if ev == "resolved" and a.get("fired_step") is not None:
+                bits.append(f"fired @ {a['fired_step']}")
+            if a.get("burn_fast") is not None:
+                bits.append(f"burn fast {a['burn_fast']} / slow "
+                            f"{a['burn_slow']}")
+            for k in ("waiting", "imbalance", "stalled_rounds",
+                      "incidents", "p95_s"):
+                if a.get(k) is not None:
+                    bits.append(f"{k} {a[k]}")
+            timeline.append((a["t"], "alert", "  ".join(bits)))
         for r in self.requests:
             ev = r["event"]
             bits = [f"request {r.get('uid')} {ev.upper()}"
@@ -1209,6 +1228,11 @@ def _render_router_postmortem(out: list, label: str | None,
                    f"{doc.get('engine')} declared dead @ round "
                    f"{doc.get('round')} — {doc.get('reason')} "
                    f"({doc.get('path')})")
+        al = (doc.get("alerts") or {}).get("active") or []
+        if al:
+            out.append("  active alert(s) at declaration: " + ", ".join(
+                f"{a['detector']} [{a['severity']}] since round "
+                f"{a['since_round']}" for a in al))
         ev = doc.get("evidence") or {}
         d = ev.get("last_digest")
         if d:
@@ -1250,6 +1274,7 @@ def _follow(metrics_dirs: list, interval: float, max_s: float) -> int:
     t0_ref = None
     sizes: dict = {}
     cache: dict = {}
+    last_alerts: str | None = None
     while True:
         new = []
         for d in metrics_dirs:
@@ -1289,6 +1314,24 @@ def _follow(metrics_dirs: list, interval: float, max_s: float) -> int:
                         status = json.load(f)
                 except ValueError:
                     pass    # racing the atomic replace; next tick
+        # live watchtower surface (v15): render the status doc's
+        # active-alert block whenever it CHANGES — the tail shows
+        # what is firing right now, not just the fired/resolved
+        # timeline entries as they land
+        if status is not None:
+            active = (status.get("alerts") or {}).get("active") or []
+            fp = json.dumps(active, sort_keys=True)
+            if fp != last_alerts and (active or last_alerts
+                                      is not None):
+                if active:
+                    print("  ACTIVE ALERTS: " + ", ".join(
+                        f"{a.get('detector')} [{a.get('severity')}] "
+                        f"since round {a.get('since_round')}"
+                        for a in active), flush=True)
+                elif last_alerts is not None:
+                    print("  active alerts: none (all resolved)",
+                          flush=True)
+                last_alerts = fp
         if status is not None and status.get("drained") and not new:
             print(f"report: fleet drained @ round "
                   f"{status.get('round')} — follow complete")
@@ -1734,6 +1777,26 @@ def _render_waterfalls(out: list, label: str | None, wf: dict) -> None:
                        f"{s.get('end_step')}")
 
 
+def _alerts_active_at(alerts: list, t: float) -> list:
+    """The watchtower alerts active (fired, unresolved) at wall time
+    ``t`` — ``alerts`` pre-sorted by envelope time. Drift alerts key
+    per metric (one detector name, two lifecycles)."""
+    active: dict = {}
+    for a in alerts:
+        if a.get("t", 0.0) > t:
+            break
+        key = (a.get("detector"), a.get("metric"))
+        if a.get("event") == "fired":
+            active[key] = a
+        else:
+            active.pop(key, None)
+    return [{"detector": a.get("detector"),
+             "severity": a.get("severity"),
+             "since_round": a.get("step")}
+            for _, a in sorted(active.items(),
+                               key=lambda kv: str(kv[0]))]
+
+
 def _render_postmortem(out: list, label: str | None,
                        fr: dict | None) -> None:
     tag = f" [{label}]" if label else ""
@@ -1748,6 +1811,10 @@ def _render_postmortem(out: list, label: str | None,
     out.append(f"postmortem{tag}: {fr.get('reason')!r} @ engine step "
                f"{fr.get('step')} — {len(fr.get('digests', []))} "
                f"step digest(s) ({fr.get('path')})")
+    if fr.get("alerts_at_dump"):
+        out.append("  active alert(s) at declaration: " + ", ".join(
+            f"{a['detector']} [{a['severity']}] since round "
+            f"{a['since_round']}" for a in fr["alerts_at_dump"]))
     for d in fr.get("digests", []):
         bits = [f"step {d.get('step'):>4}",
                 f"occ {d.get('occupancy'):.2f}",
@@ -1763,6 +1830,318 @@ def _render_postmortem(out: list, label: str | None,
         if d.get("events"):
             line += "  | " + "; ".join(d["events"])
         out.append(line)
+
+
+# ---- golden-stream diffing (v15, DESIGN.md section 27) --------------
+# Two replays of one committed trace must agree on every pinned value;
+# where they legitimately differ is WALL TIME — the unpinned envelope
+# plus any measured duration/throughput. The differ strips the
+# envelope, localizes the first divergent record, and classifies what
+# kind of drift it is so "the replays differ" is never the end of the
+# diagnosis. scripts/stream_diff.py is the standalone CLI over the
+# same functions.
+
+# a differing key is TIMING (not a determinism break) when it measures
+# wall-clock — matched by suffix so new measured fields inherit the
+# classification without a registry edit
+_TIMING_SUFFIXES = ("_s", "_ms", "_us", "_per_sec")
+_TIMING_KEYS = {"t", "t_start", "t_end", "dt", "tokens_per_sec"}
+
+
+def _is_timing_key(key: str) -> bool:
+    return key in _TIMING_KEYS or key.endswith(_TIMING_SUFFIXES)
+
+
+def load_diff_stream(metrics_dir: str,
+                     kinds: tuple | None = None) -> list[dict]:
+    """One side of a golden-stream diff: the dir's ``metrics.jsonl``
+    in append order, schema-valid records only, the unpinned wall
+    envelope (``t``) stripped. ``kinds`` filters to those record
+    kinds (e.g. ``("alert",)`` for the replay-identity check)."""
+    path = metrics_dir
+    if os.path.isdir(path):
+        path = os.path.join(path, METRICS_FILENAME)
+    records, _problems = read_metrics(path)
+    out = []
+    for r in records:
+        if kinds is not None and r.get("kind") not in kinds:
+            continue
+        r = dict(r)
+        r.pop("t", None)
+        out.append(r)
+    return out
+
+
+def diff_streams(a: list[dict], b: list[dict]) -> dict:
+    """Localize + classify the first divergence between two record
+    streams (each from ``load_diff_stream``). Returns a dict with
+    ``verdict`` one of:
+
+    - ``identical`` — byte-equivalent after envelope stripping;
+    - ``timing-only`` — records align and every differing key is a
+      wall-clock measurement (two honest replays of one run);
+    - ``token-divergence`` — a pinned content key differs, or one
+      stream holds records the other lacks (THE determinism break);
+    - ``schema-drift`` — aligned records disagree on kind/key-set/
+      schema version (different writers, not different runs).
+
+    Verdict severity is schema-drift > token-divergence > timing-only;
+    ``index``/``a``/``b``/``keys`` localize the first record of the
+    verdict's class."""
+    first: dict[str, tuple] = {}
+    for i in range(min(len(a), len(b))):
+        ra, rb = a[i], b[i]
+        if ra == rb:
+            continue
+        if (ra.get("kind") != rb.get("kind")
+                or ra.get("schema") != rb.get("schema")
+                or ra.keys() != rb.keys()):
+            first.setdefault("schema-drift", (i, ra, rb, sorted(
+                ra.keys() ^ rb.keys())))
+            continue
+        keys = sorted(k for k in ra if ra[k] != rb[k])
+        if all(_is_timing_key(k) for k in keys):
+            first.setdefault("timing-only", (i, ra, rb, keys))
+        else:
+            first.setdefault("token-divergence",
+                             (i, ra, rb,
+                              [k for k in keys
+                               if not _is_timing_key(k)]))
+    if len(a) != len(b):
+        i = min(len(a), len(b))
+        first.setdefault("token-divergence",
+                         (i, a[i] if i < len(a) else None,
+                          b[i] if i < len(b) else None, ["<length>"]))
+    for verdict in ("schema-drift", "token-divergence", "timing-only"):
+        if verdict in first:
+            i, ra, rb, keys = first[verdict]
+            return {"verdict": verdict, "index": i, "keys": keys,
+                    "a": ra, "b": rb,
+                    "n_a": len(a), "n_b": len(b)}
+    return {"verdict": "identical", "n_a": len(a), "n_b": len(b)}
+
+
+# ---- telemetry invariant audit (v15, DESIGN.md section 27) ----------
+# The one-shot auditor behind `report --audit`: every invariant the
+# writers are SUPPOSED to hold, checked over a finished run's metrics
+# dirs. The catalog is ordered — rc 2 names the FIRST violated
+# invariant and the record that broke it, so a red audit is a
+# diagnosis, not a boolean.
+
+def _audit_violation(inv: str, stream, what: str) -> str:
+    return (f"audit: VIOLATION [{inv}] in {stream.path}: {what}")
+
+
+def _audit_schema(streams) -> str | None:
+    for s in streams:
+        if s.problems:
+            return _audit_violation("schema", s, s.problems[0])
+    return None
+
+
+def _audit_span_reconciliation(streams) -> str | None:
+    """Span telescoping + request latency arithmetic: every span ends
+    at-or-after it starts (both clocks), and a completed request's
+    TTFT never exceeds its latency (``ttft_s + post-first-token time
+    == latency_s`` is the waterfall fold's reconciliation; the hard
+    invariant auditable per record is the ordering)."""
+    for s in streams:
+        for sp in s.spans:
+            if (sp.get("start_step") is not None
+                    and sp["start_step"] > sp["step"]):
+                return _audit_violation(
+                    "span_reconciliation", s,
+                    f"span {sp.get('span')!r} uid {sp.get('uid')} "
+                    f"starts at step {sp['start_step']} AFTER its end "
+                    f"step {sp['step']}")
+            if (sp.get("t_start") is not None
+                    and sp["t_start"] > sp["t"] + 1e-9):
+                return _audit_violation(
+                    "span_reconciliation", s,
+                    f"span {sp.get('span')!r} uid {sp.get('uid')} "
+                    f"t_start {sp['t_start']} after its end t "
+                    f"{sp['t']}")
+        for r in s.requests:
+            if r.get("event") != "completed":
+                continue
+            ttft, lat = r.get("ttft_s"), r.get("latency_s")
+            if (ttft is not None and lat is not None
+                    and ttft > lat + RECONCILE_TOL_S):
+                return _audit_violation(
+                    "span_reconciliation", s,
+                    f"completed uid {r.get('uid')} has ttft_s {ttft} "
+                    f"> latency_s {lat}")
+            if r.get("n_new") is not None and r["n_new"] < 1:
+                return _audit_violation(
+                    "span_reconciliation", s,
+                    f"completed uid {r.get('uid')} claims n_new "
+                    f"{r['n_new']} (< 1 token)")
+    return None
+
+
+def _audit_counter_monotonicity(streams) -> str | None:
+    """Per-stream clocks and cumulative books never run backwards —
+    across resume too (replayed records re-emit at their original,
+    stable steps)."""
+    for s in streams:
+        last_fleet = None
+        for f in s.fleets:
+            if last_fleet is not None and f["step"] <= last_fleet:
+                return _audit_violation(
+                    "counter_monotonicity", s,
+                    f"fleet round {f['step']} after round "
+                    f"{last_fleet} (round clock ran backwards)")
+            last_fleet = f["step"]
+        last = None
+        for d in s.decodes:
+            if last is not None and d["step"] < last:
+                return _audit_violation(
+                    "counter_monotonicity", s,
+                    f"decode record at step {d['step']} after step "
+                    f"{last}")
+            last = d["step"]
+        # the workload driver's cumulative per-tenant book
+        prev: dict = {}
+        for w in s.workloads:
+            for tn, c in (w.get("tenants") or {}).items():
+                for key in ("offered", "completed", "shed"):
+                    cur = int(c.get(key) or 0)
+                    if cur < prev.get((tn, key), 0):
+                        return _audit_violation(
+                            "counter_monotonicity", s,
+                            f"workload record @ round {w['step']}: "
+                            f"tenant {tn} cumulative {key} fell "
+                            f"{prev[(tn, key)]} -> {cur}")
+                    prev[(tn, key)] = cur
+    return None
+
+
+def _audit_tenant_reconciliation(streams) -> str | None:
+    """The final workload record's per-tenant book must balance:
+    completed + shed never exceeds offered, and the interval counters
+    sum to no more than the cumulative offered."""
+    for s in streams:
+        if not s.workloads:
+            continue
+        final = s.workloads[-1]
+        for tn, c in (final.get("tenants") or {}).items():
+            off = int(c.get("offered") or 0)
+            done = int(c.get("completed") or 0)
+            shed = int(c.get("shed") or 0)
+            if done + shed > off:
+                return _audit_violation(
+                    "tenant_reconciliation", s,
+                    f"tenant {tn}: completed {done} + shed {shed} > "
+                    f"offered {off} in the final workload record")
+        total_off = sum(int(w.get("offered") or 0)
+                        for w in s.workloads)
+        cum_off = sum(int(c.get("offered") or 0)
+                      for c in (final.get("tenants") or {}).values())
+        if total_off != cum_off:
+            return _audit_violation(
+                "tenant_reconciliation", s,
+                f"interval offered counts sum to {total_off} but the "
+                f"final cumulative book holds {cum_off}")
+    return None
+
+
+def _audit_trace_consistency(streams) -> str | None:
+    """One uid, one trace_id — across every stream in the set (the
+    spine of cross-process stitching; a uid with two trace ids can't
+    be traced)."""
+    seen: dict = {}
+    for s in streams:
+        for r in (*s.requests, *s.spans, *s.routers):
+            uid, tid = r.get("uid"), r.get("trace_id")
+            if uid is None or uid == -1 or tid is None:
+                continue
+            if uid in seen and seen[uid][0] != tid:
+                return _audit_violation(
+                    "trace_consistency", s,
+                    f"uid {uid} carries trace_id {tid!r} but "
+                    f"{seen[uid][1]} recorded {seen[uid][0]!r}")
+            seen.setdefault(uid, (tid, s.path))
+    return None
+
+
+def _audit_router_xref(streams) -> str | None:
+    """Router decisions cross-reference request outcomes: a uid the
+    router shed never completes, and a uid the router moved
+    (handoff/migration) was routed first."""
+    shed, routed, moved = set(), set(), {}
+    for s in streams:
+        for r in s.routers:
+            uid = r.get("uid")
+            if uid is None or uid == -1:
+                continue
+            if r["event"] == "shed":
+                shed.add(uid)
+            elif r["event"] == "routed":
+                routed.add(uid)
+            elif r["event"] in ("handoff", "migrated"):
+                moved.setdefault(uid, r)
+    if not (shed or routed or moved):
+        return None     # no router stream in the set — nothing to xref
+    for s in streams:
+        for r in s.requests:
+            if r.get("event") == "completed" and r.get("uid") in shed:
+                return _audit_violation(
+                    "router_xref", s,
+                    f"uid {r['uid']} completed but the router shed it")
+    for uid, r in sorted(moved.items()):
+        if uid not in routed:
+            for s in streams:
+                if r in s.routers:
+                    return _audit_violation(
+                        "router_xref", s,
+                        f"uid {uid} was {r['event']} @ round "
+                        f"{r.get('step')} without a routed record")
+    return None
+
+
+def _audit_dedup(streams) -> str | None:
+    """Replayed records must be REPLAYS: duplicate (uid, event, step)
+    request records within one stream agree on their deterministic
+    payload (token count), or a resume double-counted work."""
+    for s in streams:
+        by: dict = {}
+        for r in s.records:
+            if r["kind"] == "request":
+                by.setdefault((r.get("uid"), r.get("event"),
+                               r.get("step")), []).append(r)
+        for (uid, ev, step), recs in by.items():
+            if len(recs) < 2 or ev == "rejected":
+                continue
+            n_new = {r.get("n_new") for r in recs}
+            if len(n_new) > 1:
+                return _audit_violation(
+                    "dedup", s,
+                    f"uid {uid} {ev} @ step {step} recorded "
+                    f"{len(recs)}x with differing n_new "
+                    f"{sorted(n_new, key=str)}")
+    return None
+
+
+# ordered: rc 2 names the FIRST violated invariant in THIS order
+_AUDIT_CATALOG = (
+    ("schema", _audit_schema),
+    ("span_reconciliation", _audit_span_reconciliation),
+    ("counter_monotonicity", _audit_counter_monotonicity),
+    ("tenant_reconciliation", _audit_tenant_reconciliation),
+    ("trace_consistency", _audit_trace_consistency),
+    ("router_xref", _audit_router_xref),
+    ("dedup", _audit_dedup),
+)
+
+
+def audit_streams(streams) -> str | None:
+    """Run the ordered invariant catalog over the stream set; None
+    when every invariant holds, else the first violation line."""
+    for _name, check in _AUDIT_CATALOG:
+        msg = check(streams)
+        if msg is not None:
+            return msg
+    return None
 
 
 def report_main(argv=None) -> int:
@@ -1813,6 +2192,26 @@ def report_main(argv=None) -> int:
     p.add_argument("--follow_max_s", type=float, default=60.0,
                    help="--follow gives up (rc 0, with a note) after "
                         "this many seconds without a drained status")
+    p.add_argument("--audit", action="store_true",
+                   help="one-shot telemetry invariant audit over the "
+                        "given metrics dir(s): schema validity, span "
+                        "telescoping + latency arithmetic, counter "
+                        "monotonicity across resume, per-tenant "
+                        "reconciliation, trace_id consistency, "
+                        "router/request cross-references, replay "
+                        "dedup; rc 0 clean, rc 2 naming the FIRST "
+                        "violated invariant and the record")
+    p.add_argument("--diff", action="store_true",
+                   help="golden-stream diff of EXACTLY TWO metrics "
+                        "dirs: strips the wall envelope, localizes "
+                        "the first divergent record, classifies it "
+                        "timing-only / token-divergence / "
+                        "schema-drift; rc 0 when identical or "
+                        "timing-only, rc 2 otherwise")
+    p.add_argument("--kinds", default=None, metavar="K1,K2",
+                   help="--diff filter: compare only these record "
+                        "kinds (e.g. --kinds alert for the alert-"
+                        "history replay-identity check)")
     p.add_argument("--json", action="store_true",
                    help="emit the folded report as one JSON object "
                         "instead of text")
@@ -1832,6 +2231,28 @@ def report_main(argv=None) -> int:
         print("report: --follow is a live text tail; drop --json",
               file=sys.stderr)
         return 2
+    if args.audit and args.diff:
+        print("report: --audit checks one run's invariants, --diff "
+              "compares two runs — pick one", file=sys.stderr)
+        return 2
+    if args.diff and len(args.metrics_dirs) != 2:
+        print(f"report: --diff compares exactly TWO metrics dirs, got "
+              f"{len(args.metrics_dirs)}", file=sys.stderr)
+        return 2
+    if args.kinds is not None and not args.diff:
+        print("report: --kinds filters a --diff; pass --diff A B",
+              file=sys.stderr)
+        return 2
+    diff_kinds = None
+    if args.kinds is not None:
+        diff_kinds = tuple(k.strip() for k in args.kinds.split(",")
+                           if k.strip())
+        bad = [k for k in diff_kinds if k not in RECORD_KINDS]
+        if not diff_kinds or bad:
+            print(f"report: unparseable --kinds {args.kinds!r} (want "
+                  f"a comma list of record kinds from "
+                  f"{'/'.join(RECORD_KINDS)})", file=sys.stderr)
+            return 2
     if args.follow_interval <= 0 or args.follow_max_s <= 0:
         print("report: --follow_interval/--follow_max_s must be > 0",
               file=sys.stderr)
@@ -1875,6 +2296,38 @@ def report_main(argv=None) -> int:
             print(f"report: no metrics stream at {s.path}",
                   file=sys.stderr)
         return 2
+    if args.diff:
+        res = diff_streams(
+            load_diff_stream(args.metrics_dirs[0], diff_kinds),
+            load_diff_stream(args.metrics_dirs[1], diff_kinds))
+        if args.json:
+            print(json.dumps(res, indent=1))
+        else:
+            what = (f" over kinds {','.join(diff_kinds)}"
+                    if diff_kinds else "")
+            if res["verdict"] == "identical":
+                print(f"diff: identical{what} — {res['n_a']} "
+                      "record(s) each, byte-equivalent after "
+                      "envelope stripping")
+            else:
+                print(f"diff: {res['verdict']}{what} @ record "
+                      f"{res['index']} (streams hold {res['n_a']} / "
+                      f"{res['n_b']} record(s))")
+                print(f"  differing key(s): {res['keys']}")
+                print(f"  a: {json.dumps(res['a'], sort_keys=True)}")
+                print(f"  b: {json.dumps(res['b'], sort_keys=True)}")
+        return 0 if res["verdict"] in ("identical",
+                                       "timing-only") else 2
+    if args.audit:
+        msg = audit_streams(streams)
+        if msg is not None:
+            print(msg, file=sys.stderr)
+            return 2
+        n = sum(len(s.records) for s in streams)
+        print(f"audit: clean — {len(_AUDIT_CATALOG)} invariant(s) "
+              f"hold over {n} record(s) across {len(streams)} "
+              "stream(s)")
+        return 0
     if args.follow:
         # the live tail replaces the one-shot fold (a run may still be
         # record-free while its engines boot — the tail waits for it)
@@ -2077,6 +2530,17 @@ def report_main(argv=None) -> int:
     rposts: dict = {}
     if args.postmortem:
         flights = {s.label: s.flight_recorder() for s in streams}
+        # active-alerts-at-declaration (v15): the worker's flight
+        # recorder can't see the router's alert plane, so the merge
+        # folds it here — every alert fired but not yet resolved at
+        # the dump's wall time was ACTIVE while the engine died
+        all_alerts = sorted((a for s in streams for a in s.alerts),
+                            key=lambda a: (a.get("t", 0.0),
+                                           a.get("step", 0)))
+        for fr in flights.values():
+            if fr and not fr.get("error") and fr.get("t") is not None:
+                fr["alerts_at_dump"] = _alerts_active_at(
+                    all_alerts, fr["t"])
         doc["postmortem"] = (flights if multi
                              else flights[streams[0].label])
         rposts = {s.label: v for s in streams

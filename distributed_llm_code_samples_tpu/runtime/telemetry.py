@@ -168,7 +168,26 @@ import numpy as np
 # Every pinned value is derived from the deterministic round/step
 # clocks and served-token counters — never the wall clock — so qos
 # and autoscale decision streams replay identically with the tokens.
-SCHEMA_VERSION = 14
+# v15 (round 21): the watchtower plane (DESIGN.md section 27). Adds
+# the "alert" kind — one record per streaming-detector lifecycle
+# transition (``runtime/watch.py``, ticked on the fleet round clock):
+# ``step`` the router's round clock at the transition, ``event`` one
+# of ALERT_EVENTS (fired / resolved), ``detector`` the detector that
+# transitioned (ALERT_DETECTORS), ``severity`` its page/warn class,
+# ``window`` the [start_round, end_round) round window that justified
+# the transition. Per-detector conditional pins
+# (ALERT_DETECTOR_REQUIRED, the QOS_EVENT_REQUIRED pattern): each
+# alert carries exactly the numbers that justified it — the fast/slow
+# burn rates with the violation/completion counts behind them, the
+# queue depth vs its threshold, the imbalance reading, the stalled
+# round count, the incident count, the drifted percentile vs its
+# declared baseline. Every pinned value is ROUND-denominated (counts
+# and round arithmetic only — wall clock lives in the unpinned ``t``
+# envelope and in the latency_drift detector, which only runs against
+# an explicitly declared wall-clock baseline), so the alert history of
+# a virtual-clock replay is byte-identical across replays and
+# transports, exactly like the autoscale/qos decision streams.
+SCHEMA_VERSION = 15
 
 METRICS_FILENAME = "metrics.jsonl"
 
@@ -454,6 +473,47 @@ QOS_EVENT_REQUIRED = {
     "wfq_pick": ("uid", "virtual_time"),
 }
 
+# The alert-record contract (``runtime/watch.py``, v15): one record
+# per detector lifecycle transition. ``step`` is the router's round
+# clock at the transition, ``event`` one of ALERT_EVENTS, ``detector``
+# the detector name, ``severity`` its class, ``window`` the
+# [start_round, end_round) window the justifying numbers were folded
+# over. Deterministic by construction (round clock + integer counters
+# — wall clock only in the unpinned ``t`` envelope), so the alert
+# history replays identically with the tokens; the one wall-clock
+# detector (latency_drift) only runs against an explicitly declared
+# baseline. Same version-bump discipline as STEP_KEYS.
+ALERT_REQUIRED = ("step", "event", "detector", "severity", "window")
+
+# the alert lifecycle vocabulary: a detector FIRES once when its
+# windows cross threshold and RESOLVES once when they recover — never
+# a per-round repeat (report renders any name; a new event is
+# additive)
+ALERT_EVENTS = ("fired", "resolved")
+
+# the detector vocabulary (runtime/watch.py; report renders any name,
+# so a new detector is additive)
+ALERT_DETECTORS = ("burn_rate", "queue_growth", "imbalance",
+                   "collapse", "incident_rate", "latency_drift")
+
+# the severity vocabulary: "page" = goodput is burning NOW (SLO
+# budget, dead capacity, stalled tokens), "warn" = trending toward it
+ALERT_SEVERITIES = ("warn", "page")
+
+# per-detector conditional pins for alert records (validate_record;
+# the QOS_EVENT_REQUIRED pattern): every transition pins exactly the
+# numbers that justified it, on BOTH fired and resolved records (the
+# resolved record shows the recovered reading)
+ALERT_DETECTOR_REQUIRED = {
+    "burn_rate": ("burn_fast", "burn_slow", "violations",
+                  "completions"),
+    "queue_growth": ("waiting", "threshold"),
+    "imbalance": ("imbalance", "threshold"),
+    "collapse": ("stalled_rounds", "live"),
+    "incident_rate": ("incidents", "threshold"),
+    "latency_drift": ("p95_s", "baseline_s", "metric"),
+}
+
 # Non-step record kinds the stream also carries: run headers ("meta"),
 # recovery/chaos/checkpoint events ("event"), bench measurement rows
 # ("bench" — bench.py's per-measurement plumbing rides the same
@@ -462,7 +522,7 @@ QOS_EVENT_REQUIRED = {
 # per-request phase records.
 RECORD_KINDS = ("step", "meta", "event", "bench", "anomaly", "rollback",
                 "decode", "request", "span", "router", "fleet",
-                "deploy", "workload", "autoscale", "qos")
+                "deploy", "workload", "autoscale", "qos", "alert")
 
 # kind -> the pinned required-key set validate_record enforces (step
 # records additionally pin their FULL key set via STEP_KEYS)
@@ -479,6 +539,7 @@ REQUIRED_KEYS = {
     "workload": WORKLOAD_REQUIRED,
     "autoscale": AUTOSCALE_REQUIRED,
     "qos": QOS_REQUIRED,
+    "alert": ALERT_REQUIRED,
 }
 
 # bf16 peak matmul FLOP/s by chip generation (public spec sheets; the
@@ -761,6 +822,18 @@ class TelemetryWriter:
         rec["kind"] = "qos"
         self._put(rec)
 
+    def alert(self, record: dict) -> None:
+        """Enqueue one watchtower detector transition record: fired /
+        resolved (``runtime/watch.py``; ``ALERT_REQUIRED`` contract
+        plus the per-detector conditional pins — severity defaults to
+        "warn" so an experimental detector need not pick a page
+        class)."""
+        rec = dict(record)
+        rec.setdefault("t", time.time())
+        rec.setdefault("severity", "warn")
+        rec["kind"] = "alert"
+        self._put(rec)
+
     def fleet(self, record: dict) -> None:
         """Enqueue one per-round fleet health record: per-engine
         waiting/active/free-blocks/utilization plus the load-imbalance
@@ -925,6 +998,16 @@ def validate_record(rec: Any) -> tuple[bool, str]:
                    if k not in rec]
         if missing:
             return False, (f"qos record (event {rec['event']}) "
+                           f"missing required key(s) {missing}")
+    if kind == "alert" and rec.get("detector") in \
+            ALERT_DETECTOR_REQUIRED:
+        # v15 conditional pins: every detector transition carries
+        # exactly the numbers that justified it (fired AND resolved —
+        # the resolved record shows the recovered reading)
+        missing = [k for k in ALERT_DETECTOR_REQUIRED[rec["detector"]]
+                   if k not in rec]
+        if missing:
+            return False, (f"alert record (detector {rec['detector']}) "
                            f"missing required key(s) {missing}")
     if kind == "step" and not isinstance(rec["step"], int):
         return False, (f"step record key 'step' is "

@@ -1,0 +1,557 @@
+"""Fleet watchtower: streaming health detectors on the round clock
+(DESIGN.md section 27).
+
+The fleet records everything — spans, TTFT/ITL, router decisions,
+per-tenant workload curves, autoscale histories — but until this
+module nothing WATCHED those signals while a run was live: SLO
+violations were discovered by ``report --slo`` after the fact. The
+watchtower closes that gap with streaming detectors folded
+incrementally over the same deterministic observations every routing
+decision already reads, each emitting schema-v15 ``alert`` records
+with a fired→resolved lifecycle:
+
+- **burn_rate** — multi-window SLO error-budget burn. A completion
+  VIOLATES when it took more than ``deadline`` fleet rounds from
+  admission to finish (the round-denominated form of the ``--slo``
+  TTFT+ITL attainment fold: under virtual pacing, rounds ARE the
+  latency clock). Burn rate over a window = violated fraction /
+  ``budget``; the alert fires when BOTH the fast and the slow window
+  burn at >= ``burn`` (the classic multi-window page: the fast window
+  catches the spike, the slow window keeps one bad round from paging)
+  and resolves when the fast window recovers.
+- **queue_growth** — total waiting depth has held at >= ``queue`` for
+  a full fast window (sustained backlog, not one bursty round).
+- **imbalance** — the fleet record's load-imbalance scalar has held
+  at >= ``imbalance`` for a full fast window.
+- **collapse** — live work but ZERO token progress for ``collapse``
+  consecutive rounds (the throughput-collapse page a dead/hung
+  engine causes before migration catches up).
+- **incident_rate** — wire rejections + dead-engine declarations +
+  failed (quarantined/expired) requests in the slow window reached
+  ``incidents``.
+- **latency_drift** — the windowed TTFT/ITL p95 exceeds ``drift`` x
+  a DECLARED wall-clock baseline. This is the one wall-clock
+  detector, so it only runs when the operator declares a baseline
+  (``baseline=TTFT:ITL``) — and it therefore folds request records
+  (the offline ``fold_records`` path), never the live round loop,
+  which observes no wall-clock latencies.
+
+**Determinism.** Every live detector folds only the round clock and
+integer counters — queue depths, completion counts, incident counts,
+token deltas — never the wall clock, exactly like the autoscale
+controller's decisions (DESIGN.md section 26). Windows are
+ROUND-denominated, so under virtual-clock trace replay the alert
+history (fired/resolved rounds, window bounds, every pinned
+justifying number) is byte-identical across replays AND across the
+in-process/process transports — pinned by test and asserted in-bench
+via ``scripts/stream_diff.py``.
+
+The live half (``Watchtower``) runs like the autoscaler: constructed
+against a ``FleetRouter``, ticked between rounds by the workload
+driver, reading the router's own light digests (zero extra
+round-trips beyond the per-round results/failed sweeps), mirroring
+its active-alert block onto the router for the live status doc
+(``fleet_status.json`` → ``fleetstat``/``report --follow``) and
+emitting ``alert`` records through the router's writer. The offline
+half (``fold_records``) replays the same detector core over any
+recorded stream — the percentile-drift path, and a debugging lens
+over historical runs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class WatchPolicy:
+    """The watchtower's detector thresholds. A threshold of 0 (or a
+    null baseline) DISABLES its detector — a policy must enable at
+    least one (``parse_watch_spec`` enforces it for the CLI)."""
+
+    deadline: int = 0           # rounds admission->completion (burn)
+    budget: float = 0.25        # allowed violation fraction
+    burn: float = 1.0           # burn-rate threshold (both windows)
+    fast: int = 8               # fast window, rounds
+    slow: int = 32              # slow window, rounds
+    queue: int = 0              # sustained waiting-depth threshold
+    imbalance: float = 0.0      # sustained load-imbalance threshold
+    collapse: int = 0           # zero-progress rounds threshold
+    incidents: int = 0          # slow-window incident count threshold
+    drift: float = 0.0          # p95 multiple over baseline
+    baseline_ttft: float | None = None      # declared p95 TTFT, s
+    baseline_itl: float | None = None       # declared p95 ITL, s
+
+    def __post_init__(self):
+        for name in ("deadline", "fast", "slow", "queue", "collapse",
+                     "incidents"):
+            v = getattr(self, name)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                raise ValueError(f"bad WatchPolicy {name} {v!r}: must "
+                                 "be an integer >= 0")
+        if self.fast < 1:
+            raise ValueError(f"bad WatchPolicy fast {self.fast}: must "
+                             "be >= 1 (a zero-round window observes "
+                             "nothing)")
+        if self.slow <= self.fast:
+            raise ValueError(f"bad WatchPolicy slow {self.slow}: must "
+                             f"be > fast {self.fast} (the slow window "
+                             "is what keeps one bad round from paging)")
+        if not 0.0 < self.budget <= 1.0:
+            raise ValueError(f"bad WatchPolicy budget {self.budget}: "
+                             "must be in (0, 1]")
+        if self.burn <= 0:
+            raise ValueError(f"bad WatchPolicy burn {self.burn}: must "
+                             "be > 0")
+        if not 0.0 <= self.imbalance < 1.0:
+            raise ValueError(f"bad WatchPolicy imbalance "
+                             f"{self.imbalance}: must be in [0, 1)")
+        if self.drift < 0:
+            raise ValueError(f"bad WatchPolicy drift {self.drift}: "
+                             "must be >= 0")
+        if self.drift > 0 and (self.baseline_ttft is None
+                               and self.baseline_itl is None):
+            raise ValueError("bad WatchPolicy: drift > 0 needs a "
+                             "declared baseline (baseline=TTFT:ITL)")
+        for name in ("baseline_ttft", "baseline_itl"):
+            v = getattr(self, name)
+            if v is not None and v <= 0:
+                raise ValueError(f"bad WatchPolicy {name} {v}: must "
+                                 "be > 0 seconds")
+
+    def enabled(self) -> tuple[str, ...]:
+        """The detectors this policy actually runs."""
+        out = []
+        if self.deadline > 0:
+            out.append("burn_rate")
+        if self.queue > 0:
+            out.append("queue_growth")
+        if self.imbalance > 0:
+            out.append("imbalance")
+        if self.collapse > 0:
+            out.append("collapse")
+        if self.incidents > 0:
+            out.append("incident_rate")
+        if self.drift > 0:
+            out.append("latency_drift")
+        return tuple(out)
+
+    def as_dict(self) -> dict:
+        return {"deadline": self.deadline, "budget": self.budget,
+                "burn": self.burn, "fast": self.fast,
+                "slow": self.slow, "queue": self.queue,
+                "imbalance": self.imbalance, "collapse": self.collapse,
+                "incidents": self.incidents, "drift": self.drift,
+                "baseline_ttft": self.baseline_ttft,
+                "baseline_itl": self.baseline_itl}
+
+
+def _watch_num(key: str, val: str, cast):
+    try:
+        return cast(val)
+    except ValueError:
+        kind = "an integer" if cast is int else "a number"
+        raise ValueError(f"bad --watch {key} {val!r}: must be "
+                         f"{kind}") from None
+
+
+_WATCH_KEYS = ("deadline", "budget", "burn", "fast", "slow", "queue",
+               "imbalance", "collapse", "incidents", "drift",
+               "baseline")
+
+
+def parse_watch_spec(spec: str) -> WatchPolicy:
+    """Parse + validate one ``--watch`` spec (module-docstring
+    grammar: ``deadline=24,budget=0.25,fast=8,slow=32,queue=12,...``).
+    Every malformed entry is ONE ValueError naming the offense; the
+    cross-field constraints (fast < slow, budget in (0,1], drift
+    needs a baseline) are enforced by ``WatchPolicy`` itself."""
+    out: dict = {}
+    seen = set()
+    for entry in (e.strip() for e in spec.split(",") if e.strip()):
+        if "=" not in entry:
+            raise ValueError(f"bad --watch entry {entry!r}: expected "
+                             f"key=value with key in "
+                             f"{'/'.join(_WATCH_KEYS)}")
+        key, _, val = entry.partition("=")
+        if key in seen:
+            raise ValueError(f"bad --watch spec: duplicate key "
+                             f"{key!r}")
+        seen.add(key)
+        if key in ("deadline", "fast", "slow", "queue", "collapse",
+                   "incidents"):
+            out[key] = _watch_num(key, val, int)
+        elif key in ("budget", "burn", "imbalance", "drift"):
+            out[key] = _watch_num(key, val, float)
+        elif key == "baseline":
+            ttft, sep, itl = val.partition(":")
+            if not sep:
+                raise ValueError(f"bad --watch baseline {val!r}: "
+                                 "expected TTFT_S:ITL_S (declared p95 "
+                                 "baselines in seconds)")
+            out["baseline_ttft"] = _watch_num("baseline", ttft, float)
+            out["baseline_itl"] = _watch_num("baseline", itl, float)
+            out.setdefault("drift", 2.0)
+        else:
+            raise ValueError(f"bad --watch key {key!r}: known keys "
+                             f"{'/'.join(_WATCH_KEYS)}")
+    policy = WatchPolicy(**out)
+    if not policy.enabled():
+        raise ValueError("bad --watch spec: no detector enabled — set "
+                         "at least one of deadline= (burn rate), "
+                         "queue=, imbalance=, collapse=, incidents=, "
+                         "baseline= (drift)")
+    return policy
+
+
+# detector -> page class: "page" burns goodput NOW, "warn" trends
+# toward it (runtime/telemetry.py ALERT_SEVERITIES)
+_SEVERITY = {"burn_rate": "page", "queue_growth": "warn",
+             "imbalance": "warn", "collapse": "page",
+             "incident_rate": "page", "latency_drift": "warn"}
+
+
+class _Fold:
+    """The detector core both halves share: consumes one per-round
+    observation at a time, keeps the bounded window state, and returns
+    the alert transitions the round caused (record dicts ready for
+    ``TelemetryWriter.alert``, minus the envelope)."""
+
+    def __init__(self, policy: WatchPolicy):
+        self.policy = policy
+        # completion ring: (round, violated) within the slow window
+        self._completions: list[tuple[int, bool]] = []
+        # incident ring: (round, count) within the slow window
+        self._incidents: list[tuple[int, int]] = []
+        # drift sample ring: (round, ttft_s, itl_s|None)
+        self._samples: list[tuple[int, float, float | None]] = []
+        self._queue_streak = 0
+        self._imb_streak = 0
+        self._stall_streak = 0
+        # detector -> the pins it fired with (active alerts)
+        self.active: dict[str, dict] = {}
+        self.history: list[tuple[int, str, str]] = []
+
+    # -- per-round inputs (fed BEFORE round_end for that round) --------
+
+    def note_completion(self, round_: int, violated: bool) -> None:
+        self._completions.append((round_, bool(violated)))
+
+    def note_sample(self, round_: int, ttft_s, itl_s) -> None:
+        if ttft_s is not None:
+            self._samples.append((round_, float(ttft_s),
+                                  None if itl_s is None
+                                  else float(itl_s)))
+
+    def note_incidents(self, round_: int, count: int) -> None:
+        if count > 0:
+            self._incidents.append((round_, int(count)))
+
+    # -- the round boundary --------------------------------------------
+
+    def round_end(self, round_: int, *, waiting: int, active: int,
+                  imbalance: float,
+                  tokens_delta: int | None) -> list[dict]:
+        """Evaluate every enabled detector against the windows ending
+        at ``round_``; returns the fired/resolved transition records
+        (empty most rounds — the lifecycle emits once per edge, never
+        per round)."""
+        p = self.policy
+        # prune the rings to the slow window (the widest any detector
+        # reads)
+        lo = round_ - p.slow
+        self._completions = [c for c in self._completions if c[0] > lo]
+        self._incidents = [c for c in self._incidents if c[0] > lo]
+        self._samples = [s for s in self._samples if s[0] > lo]
+        out: list[dict] = []
+
+        if p.deadline > 0:
+            fast = [v for r, v in self._completions
+                    if r > round_ - p.fast]
+            slow = [v for r, v in self._completions]
+            burn_fast = (sum(fast) / len(fast) / p.budget
+                         if fast else 0.0)
+            burn_slow = (sum(slow) / len(slow) / p.budget
+                         if slow else 0.0)
+            firing = bool(fast) and burn_fast >= p.burn \
+                and burn_slow >= p.burn
+            # resolve on fast-window recovery only — the slow window
+            # keeps the page from flapping while the backlog drains
+            if "burn_rate" in self.active:
+                firing = burn_fast >= p.burn
+            self._edge(out, round_, "burn_rate", firing, p.slow, {
+                "burn_fast": round(burn_fast, 4),
+                "burn_slow": round(burn_slow, 4),
+                "violations": int(sum(fast)),
+                "completions": len(fast)})
+
+        if p.queue > 0:
+            self._queue_streak = (self._queue_streak + 1
+                                  if waiting >= p.queue else 0)
+            self._edge(out, round_, "queue_growth",
+                       self._queue_streak >= p.fast, p.fast,
+                       {"waiting": int(waiting),
+                        "threshold": p.queue})
+
+        if p.imbalance > 0:
+            self._imb_streak = (self._imb_streak + 1
+                                if imbalance >= p.imbalance else 0)
+            self._edge(out, round_, "imbalance",
+                       self._imb_streak >= p.fast, p.fast,
+                       {"imbalance": round(imbalance, 4),
+                        "threshold": p.imbalance})
+
+        if p.collapse > 0 and tokens_delta is not None:
+            live = waiting + active
+            self._stall_streak = (self._stall_streak + 1
+                                  if live > 0 and tokens_delta <= 0
+                                  else 0)
+            self._edge(out, round_, "collapse",
+                       self._stall_streak >= p.collapse,
+                       max(self._stall_streak, 1),
+                       {"stalled_rounds": self._stall_streak,
+                        "live": int(live)})
+
+        if p.incidents > 0:
+            count = sum(n for _, n in self._incidents)
+            self._edge(out, round_, "incident_rate",
+                       count >= p.incidents, p.slow,
+                       {"incidents": int(count),
+                        "threshold": p.incidents})
+
+        if p.drift > 0:
+            for metric, baseline, vals in (
+                    ("ttft", p.baseline_ttft,
+                     [t for _, t, _ in self._samples]),
+                    ("itl", p.baseline_itl,
+                     [i for _, _, i in self._samples
+                      if i is not None])):
+                if baseline is None:
+                    continue
+                p95 = _p95(vals)
+                det = f"latency_drift_{metric}"
+                firing = p95 is not None and p95 > p.drift * baseline
+                self._edge(out, round_, det, firing, p.slow, {
+                    "p95_s": (None if p95 is None
+                              else round(p95, 4)),
+                    "baseline_s": baseline, "metric": metric},
+                    detector_kind="latency_drift")
+        return out
+
+    def _edge(self, out: list, round_: int, name: str, firing: bool,
+              window: int, pins: dict,
+              detector_kind: str | None = None) -> None:
+        """One fired/resolved edge per threshold crossing. ``name``
+        keys the active table (distinct per drift metric);
+        ``detector_kind`` is the recorded detector vocabulary entry."""
+        kind = detector_kind or name
+        if firing and name not in self.active:
+            rec = {"step": round_, "event": "fired", "detector": kind,
+                   "severity": _SEVERITY[kind],
+                   "window": [max(0, round_ - window), round_], **pins}
+            self.active[name] = rec
+            self.history.append((round_, "fired", kind))
+            out.append(rec)
+        elif not firing and name in self.active:
+            fired = self.active.pop(name)
+            self.history.append((round_, "resolved", kind))
+            out.append({"step": round_, "event": "resolved",
+                        "detector": kind, "severity": _SEVERITY[kind],
+                        "window": [max(0, round_ - window), round_],
+                        "fired_step": fired["step"], **pins})
+
+    def active_block(self) -> list[dict]:
+        """The live-surface view of what is firing RIGHT NOW (the
+        status doc / fleetstat alert block): one entry per active
+        alert, its fired round and the justifying pins it fired
+        with."""
+        return [{"detector": rec["detector"],
+                 "severity": rec["severity"],
+                 "since_round": rec["step"],
+                 **{k: v for k, v in rec.items()
+                    if k not in ("step", "event", "detector",
+                                 "severity", "window")}}
+                for _, rec in sorted(self.active.items())]
+
+
+def _p95(vals: list[float]) -> float | None:
+    if not vals:
+        return None
+    s = sorted(vals)
+    return s[min(len(s) - 1, int(0.95 * (len(s) - 1) + 0.5))]
+
+
+class Watchtower:
+    """Live detectors over one ``FleetRouter``'s round clock.
+
+    Constructed like the autoscaler: ``tick()`` runs between fleet
+    rounds (the workload driver calls it after each round step),
+    reading only the router's own deterministic state — light
+    digests, the results/failed sweeps, the decision counters — and
+    emitting ``alert`` records through ``metrics``. Active alerts are
+    mirrored onto ``router.watch_state`` after every tick for the
+    live status doc."""
+
+    def __init__(self, router, policy: WatchPolicy, *, metrics=None):
+        self.router = router
+        self.policy = policy
+        self.metrics = metrics
+        self.fold = _Fold(policy)
+        self.fired = 0
+        self.resolved = 0
+        self._seen_uids: set[int] = set()
+        self._failed_last = 0
+        self._incidents_last = 0
+        self._tokens_by_engine: dict[str, int] = {}
+        self._mirror()
+
+    @property
+    def history(self) -> list[tuple[int, str, str]]:
+        return self.fold.history
+
+    def tick(self) -> list[dict]:
+        """One watchtower evaluation on the router's round clock;
+        returns the alert transitions this round emitted (empty most
+        rounds)."""
+        r = self.router
+        round_ = r.rounds
+        p = self.policy
+        alive = r.alive_handles()
+        digests = {h.id: h.digest(light=True) for h in alive}
+        waiting = sum(d["waiting"] for d in digests.values())
+        active = sum(d["active"] for d in digests.values())
+        loads = [d["active"] + d["waiting"]
+                 for eid, d in digests.items()
+                 if r.by_id[eid].role == "decode"]
+        imb = 0.0
+        if len(loads) > 1 and max(loads) > 0:
+            imb = round((max(loads) - min(loads)) / max(loads), 4)
+        # per-engine token deltas (summed over alive members only — a
+        # killed engine's counter vanishing must not read as negative
+        # progress)
+        delta = 0
+        for eid, d in digests.items():
+            cur = int(d.get("tokens_generated") or 0)
+            delta += max(0, cur - self._tokens_by_engine.get(eid, 0))
+            self._tokens_by_engine[eid] = cur
+        if p.deadline > 0:
+            # the completion sweep: every uid finishing this round is
+            # judged against the round-denominated deadline
+            for uid in r.results().keys() - self._seen_uids:
+                self._seen_uids.add(uid)
+                adm = r.requests.get(int(uid), {}).get("round")
+                if adm is None:
+                    continue
+                self.fold.note_completion(
+                    round_, (round_ - int(adm)) > p.deadline)
+        if p.incidents > 0:
+            failed = len(r.failed())
+            cum = r.wire_rejects + r.kills + failed
+            self.fold.note_incidents(round_,
+                                     cum - self._incidents_last)
+            self._incidents_last = cum
+        transitions = self.fold.round_end(
+            round_, waiting=waiting, active=active, imbalance=imb,
+            tokens_delta=delta)
+        for rec in transitions:
+            if rec["event"] == "fired":
+                self.fired += 1
+            else:
+                self.resolved += 1
+            if self.metrics is not None:
+                self.metrics.alert(dict(rec))
+        if transitions or r.watch_state is None:
+            self._mirror()
+        return transitions
+
+    def _mirror(self) -> None:
+        """Mirror the live alert block onto the router for the status
+        doc (``fleet_status.json``'s ``alerts`` block)."""
+        self.router.watch_state = {
+            "active": self.fold.active_block(),
+            "fired": self.fired,
+            "resolved": self.resolved,
+        }
+
+
+def fold_records(records: list[dict], policy: WatchPolicy) -> list[dict]:
+    """Offline replay of the detector core over a RECORDED stream (any
+    merge of per-engine + router streams, in record order): returns
+    the alert transition records the watchtower would have emitted.
+
+    The round clock is reconstructed from the stream itself — each
+    ``fleet`` record closes one round (single-engine streams, which
+    have no fleet records, close a round per ``decode`` cadence record
+    on the engine's own step clock). Completions and incidents seen
+    between round boundaries fold into the round that closes after
+    them; the latency_drift detector reads each completion's wall
+    ``ttft_s``/observed ITL against the policy's declared baseline —
+    this offline path is the ONLY place drift runs (the live round
+    loop observes no wall-clock latencies)."""
+    fold = _Fold(policy)
+    out: list[dict] = []
+    admitted: dict[int, int] = {}
+    pending: list[bool] = []        # deadline verdicts awaiting a round
+    incidents = 0
+    round_ = 0
+
+    def close_round(rnd: int, waiting: int, active: int,
+                    imb: float, tokens_delta) -> None:
+        nonlocal incidents
+        for viol in pending:
+            fold.note_completion(rnd, viol)
+        pending.clear()
+        fold.note_incidents(rnd, incidents)
+        incidents = 0
+        out.extend(fold.round_end(rnd, waiting=waiting, active=active,
+                                  imbalance=imb,
+                                  tokens_delta=tokens_delta))
+
+    for rec in records:
+        kind = rec.get("kind")
+        if kind == "fleet":
+            round_ = int(rec["step"])
+            engines = rec.get("engines") or {}
+            waiting = sum(int(e.get("waiting") or 0)
+                          for e in engines.values() if e.get("alive"))
+            act = sum(int(e.get("active") or 0)
+                      for e in engines.values() if e.get("alive"))
+            close_round(round_, waiting, act,
+                        float(rec.get("load_imbalance") or 0.0), None)
+        elif kind == "decode":
+            # single-engine streams: the cadence record is the round
+            # boundary (fleet streams carry their own fleet records —
+            # worker decode records fold as samples only, their step
+            # clock is not the router's)
+            round_ = max(round_, int(rec["step"]))
+        elif kind == "router":
+            ev = rec.get("event")
+            if ev == "routed":
+                admitted[int(rec["uid"])] = int(rec["step"])
+            elif ev == "wire_rejected":
+                incidents += 1
+        elif kind == "event":
+            if rec.get("event") == "engine_killed":
+                incidents += 1
+        elif kind == "request":
+            ev = rec.get("event")
+            if ev in ("quarantined", "expired"):
+                incidents += 1
+            elif ev == "completed":
+                uid = int(rec["uid"])
+                adm = admitted.get(uid)
+                if adm is not None and policy.deadline > 0:
+                    pending.append((round_ - adm) > policy.deadline)
+                ttft = rec.get("ttft_s")
+                lat = rec.get("latency_s")
+                n_new = rec.get("n_new")
+                itl = None
+                if (ttft is not None and lat is not None
+                        and n_new and n_new > 1):
+                    itl = (lat - ttft) / (n_new - 1)
+                fold.note_sample(round_, ttft, itl)
+    # close the trailing partial round so a stream that ends between
+    # boundaries still folds its tail completions
+    if pending or incidents:
+        close_round(round_ + 1, 0, 0, 0.0, None)
+    return out

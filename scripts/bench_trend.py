@@ -57,6 +57,12 @@ WORKLOAD_ROW_LANES = {
 POLICY_GOODPUT_LANES = ("fcfs", "wfq")
 POLICY_AUTOSCALE_NUMS = ("reaction_rounds", "scale_ups", "attainment")
 
+# the round-21 watchtower rows: the burn-rate detector's reaction to
+# a kill drill, priced in rounds, plus the alert history's replay
+# identity under the golden-stream differ
+WATCH_REACTION_NUMS = ("kill_round", "fired_round", "reaction_rounds",
+                       "fired", "resolved")
+
 
 def _round_of(path: str, prefix: str) -> str:
     return os.path.basename(path)[len(prefix):-len(".json")]
@@ -204,6 +210,46 @@ def _validate_policy_rows(name: str, payload: dict,
                                     "number")
 
 
+def _validate_watch_rows(name: str, payload: dict,
+                         problems: list) -> None:
+    """The watch_* row contracts (DECODE artifacts from round 21 on;
+    absence is fine — older rounds predate them). An "error: ..."
+    string is a recorded outage; a dict must carry the reaction
+    numbers / the differ's verdict."""
+    if isinstance(payload.get("watch_reaction"), dict) \
+            and "watch_replay_identity" not in payload:
+        problems.append(f"{name}: watch_reaction present but "
+                        "watch_replay_identity missing (the rows are "
+                        "emitted together)")
+    for key in ("watch_reaction", "watch_replay_identity"):
+        row = payload.get(key)
+        if row is None:
+            continue
+        if isinstance(row, str):
+            if not row.startswith("error:"):
+                problems.append(f"{name}: {key} is a string but not "
+                                "an 'error:' outage record")
+            continue
+        if not isinstance(row, dict):
+            problems.append(f"{name}: {key} is "
+                            f"{type(row).__name__}, not an object")
+            continue
+        if key == "watch_reaction":
+            for nk in WATCH_REACTION_NUMS:
+                v = row.get(nk)
+                if not isinstance(v, (int, float)) \
+                        or isinstance(v, bool):
+                    problems.append(f"{name}: {key} {nk!r} is not a "
+                                    "number")
+        else:
+            # the bench raises if the diff is not identical, so a
+            # surviving dict asserting anything else is row damage
+            if row.get("alert_history") != "identical":
+                problems.append(f"{name}: {key} 'alert_history' is "
+                                f"{row.get('alert_history')!r}, not "
+                                "'identical'")
+
+
 def validate_decode(path: str, problems: list) -> dict | None:
     """One DECODE_* artifact -> a trend row: headline keys + the
     workload_* row contracts when present."""
@@ -229,6 +275,7 @@ def validate_decode(path: str, problems: list) -> dict | None:
     before = len(problems)
     _validate_workload_rows(name, doc, problems)
     _validate_policy_rows(name, doc, problems)
+    _validate_watch_rows(name, doc, problems)
     if len(problems) > before:
         return None
     row = {"round": _round_of(path, "DECODE_"), "file": name,
@@ -244,6 +291,9 @@ def validate_decode(path: str, problems: list) -> dict | None:
         row["policy_goodput"] = {
             lane: pg[lane]["attainment"]
             for lane in POLICY_GOODPUT_LANES}
+    wr = doc.get("watch_reaction")
+    if isinstance(wr, dict):
+        row["watch_reaction_rounds"] = wr["reaction_rounds"]
     return row
 
 

@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""stream_diff — golden-stream divergence differ (standalone CLI).
+
+Two replays of one committed trace must agree on every pinned value
+(tokens, rounds, routing decisions, alert histories); where they
+legitimately differ is wall time. This tool compares two runs'
+``metrics.jsonl`` streams record-by-record with the unpinned wall
+envelope stripped, localizes the FIRST divergent record, and
+classifies the divergence:
+
+- ``identical``        — byte-equivalent after envelope stripping;
+- ``timing-only``      — only wall-clock measurements differ (two
+                         honest replays of one run);
+- ``token-divergence`` — a pinned content key differs, or one stream
+                         holds records the other lacks (THE
+                         determinism break);
+- ``schema-drift``     — aligned records disagree on kind/key-set/
+                         schema version (different writers).
+
+Exit codes: 0 = identical or timing-only; 2 = token-divergence,
+schema-drift, or a bad argument. ``report --diff A B`` is the same
+fold inside the report tool; this wrapper exists for scripting
+(tier-1 smokes, bench lanes) without the report CLI's surface.
+
+Usage:
+    python scripts/stream_diff.py RUN_A/metrics_dir RUN_B/metrics_dir
+    python scripts/stream_diff.py A B --kinds alert
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# repo root on sys.path so the canonical implementation (report.py's
+# diff fold) is importable when invoked as a script from anywhere
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from distributed_llm_code_samples_tpu.report import (     # noqa: E402
+    diff_streams, load_diff_stream)
+from distributed_llm_code_samples_tpu.runtime.telemetry import (  # noqa: E402
+    METRICS_FILENAME, RECORD_KINDS)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="stream_diff",
+        description="localize + classify the first divergence "
+                    "between two runs' metrics streams")
+    p.add_argument("a", help="first run's --metrics_dir")
+    p.add_argument("b", help="second run's --metrics_dir")
+    p.add_argument("--kinds", default=None, metavar="K1,K2",
+                   help="compare only these record kinds (e.g. "
+                        "--kinds alert for the alert-history "
+                        "replay-identity check)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the verdict as one JSON object")
+    args = p.parse_args(argv)
+
+    kinds = None
+    if args.kinds is not None:
+        kinds = tuple(k.strip() for k in args.kinds.split(",")
+                      if k.strip())
+        bad = [k for k in kinds if k not in RECORD_KINDS]
+        if not kinds or bad:
+            print(f"stream_diff: unparseable --kinds {args.kinds!r} "
+                  f"(want a comma list of record kinds from "
+                  f"{'/'.join(RECORD_KINDS)})", file=sys.stderr)
+            return 2
+    for d in (args.a, args.b):
+        path = d
+        if os.path.isdir(path):
+            path = os.path.join(path, METRICS_FILENAME)
+        if not os.path.exists(path) and not os.path.isdir(d):
+            print(f"stream_diff: no metrics stream at {path}",
+                  file=sys.stderr)
+            return 2
+
+    res = diff_streams(load_diff_stream(args.a, kinds),
+                       load_diff_stream(args.b, kinds))
+    if args.json:
+        print(json.dumps(res, indent=1))
+    else:
+        what = f" over kinds {','.join(kinds)}" if kinds else ""
+        if res["verdict"] == "identical":
+            print(f"diff: identical{what} — {res['n_a']} record(s) "
+                  "each, byte-equivalent after envelope stripping")
+        else:
+            print(f"diff: {res['verdict']}{what} @ record "
+                  f"{res['index']} (streams hold {res['n_a']} / "
+                  f"{res['n_b']} record(s))")
+            print(f"  differing key(s): {res['keys']}")
+            print(f"  a: {json.dumps(res['a'], sort_keys=True)}")
+            print(f"  b: {json.dumps(res['b'], sort_keys=True)}")
+    return 0 if res["verdict"] in ("identical", "timing-only") else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

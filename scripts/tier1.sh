@@ -130,6 +130,13 @@ EOF
 then
   echo "DECODE_SMOKE=FAIL (schema)"; rm -rf "$DEC_DIR"; exit 1
 fi
+# the invariant auditor must hold over every stream tier-1 produces
+# (report --audit, DESIGN.md section 27): rc 2 fails the phase
+if ! timeout -k 10 60 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli report "$DEC_DIR/metrics" \
+    --audit > /dev/null; then
+  echo "DECODE_SMOKE=FAIL (audit)"; rm -rf "$DEC_DIR"; exit 1
+fi
 rm -rf "$DEC_DIR"
 echo "DECODE_SMOKE=OK"
 phase_done decode_smoke
@@ -422,6 +429,14 @@ then
   echo "FLEET_SMOKE=FAIL (token-identity/schema/report check)"
   rm -rf "$FLEET_DIR"; exit 1
 fi
+# the merged four-stream kill drill must audit clean — the writers'
+# invariants survive a mid-stream casualty
+if ! timeout -k 10 60 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli report "$FLEET_DIR/m/router" \
+    "$FLEET_DIR/m/e0" "$FLEET_DIR/m/e1" "$FLEET_DIR/m/e2" \
+    --audit > /dev/null; then
+  echo "FLEET_SMOKE=FAIL (audit)"; rm -rf "$FLEET_DIR"; exit 1
+fi
 rm -rf "$FLEET_DIR"
 echo "FLEET_SMOKE=OK"
 phase_done fleet_smoke
@@ -487,6 +502,11 @@ EOF_WL
 then
   echo "WORKLOAD_SMOKE=FAIL (determinism/per-tenant check)"
   rm -rf "$WL_DIR"; exit 1
+fi
+if ! timeout -k 10 60 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli report "$WL_DIR/m2/router" \
+    "$WL_DIR/m2/e0" "$WL_DIR/m2/e1" --audit > /dev/null; then
+  echo "WORKLOAD_SMOKE=FAIL (audit)"; rm -rf "$WL_DIR"; exit 1
 fi
 echo '{"torn' >> "$WL_DIR/trace.jsonl"
 if timeout -k 10 60 env JAX_PLATFORMS=cpu python -m \
@@ -665,6 +685,105 @@ rm -rf "$AS_DIR"
 echo "AUTOSCALE_SMOKE=OK"
 phase_done autoscale_smoke
 
+echo "=== watchtower smoke ==="
+# The ISSUE 17 acceptance drill (DESIGN.md section 27): a bursty
+# 2-tenant trace through a 2-engine fleet, e1 killed at round 4 under
+# the opening burst with `--watch deadline=8,fast=4,slow=12,
+# incidents=1` — the burn-rate page must FIRE within the deadline
+# window of the kill and RESOLVE after migration while the replay
+# still runs; a second replay of the committed trace must agree
+# byte-for-byte on the alert history (`report --diff --kinds alert`
+# says identical, rc 0); the run's streams must audit clean; and a
+# malformed --watch spec must exit rc 2 with a one-line error.
+WT_DIR=$(mktemp -d /tmp/tier1_watch.XXXXXX)
+WT_SPEC="n=8,arrival=bursty:30:0.15:2.5,plen=zipf:1.7:3:12,max_new=4,tenants=a:3;b:1,seed=7"
+WT_ARGS="-d 32 -l 2 --heads 4 --vocab 64 --max_seq_len 64
+  --block_size 8 --prefill_chunk 4 --log_every 4 --fleet 2
+  --max_slots 2 --fleet_kill e1@4"
+WT_WATCH="deadline=8,fast=4,slow=12,incidents=1"
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli generate $WT_ARGS \
+    --watch "$WT_WATCH" --trace_gen "$WT_SPEC" \
+    --trace_out "$WT_DIR/trace.jsonl" --metrics_dir "$WT_DIR/m1" \
+    > "$WT_DIR/run1.json"; then
+  echo "WATCHTOWER_SMOKE=FAIL (kill drill run 1)"; rm -rf "$WT_DIR"
+  exit 1
+fi
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli generate $WT_ARGS \
+    --watch "$WT_WATCH" --trace "$WT_DIR/trace.jsonl" \
+    --metrics_dir "$WT_DIR/m2" > "$WT_DIR/run2.json"; then
+  echo "WATCHTOWER_SMOKE=FAIL (committed-trace replay)"
+  rm -rf "$WT_DIR"; exit 1
+fi
+if ! timeout -k 10 60 env JAX_PLATFORMS=cpu python - "$WT_DIR" <<'EOF_WT'
+import json, os, sys
+from distributed_llm_code_samples_tpu.runtime.telemetry import (
+    METRICS_FILENAME, read_metrics, validate_record)
+base = sys.argv[1]
+r1 = json.load(open(os.path.join(base, "run1.json")))
+r2 = json.load(open(os.path.join(base, "run2.json")))
+a = {s["uid"]: s["tokens"] for s in r1["sequences"]}
+b = {s["uid"]: s["tokens"] for s in r2["sequences"]}
+assert a == b, "watched replay produced different tokens"
+assert not r1["failed"] and not r2["failed"]
+w = r1["watch"]
+# the lifecycle, not just the page: fired AND resolved, both detectors
+assert w["fired"] == 2 and w["resolved"] == 2, w
+hist = [(h["round"], h["event"], h["detector"]) for h in w["history"]]
+fired = next(r for r, e, d in hist
+             if d == "burn_rate" and e == "fired")
+resolved = next(r for r, e, d in hist
+                if d == "burn_rate" and e == "resolved")
+assert fired - 4 <= 8, (fired, "page later than a deadline window "
+                        "after the kill")
+assert resolved > fired, hist
+assert r1["fleet"]["kills"] == 1, r1["fleet"]
+# the alert history is replay-deterministic in the payload too
+assert r2["watch"] == w, (w, r2["watch"])
+recs, problems = read_metrics(
+    os.path.join(base, "m1", "router", METRICS_FILENAME))
+assert not problems, problems
+alerts = [r for r in recs if r["kind"] == "alert"]
+assert [(x["step"], x["event"], x["detector"]) for x in alerts] \
+    == hist, (alerts, hist)
+assert all(validate_record(x)[0] for x in alerts)
+EOF_WT
+then
+  echo "WATCHTOWER_SMOKE=FAIL (reaction/lifecycle check)"
+  rm -rf "$WT_DIR"; exit 1
+fi
+if ! timeout -k 10 60 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli report "$WT_DIR/m1/router" \
+    "$WT_DIR/m2/router" --diff --kinds alert > "$WT_DIR/diff.txt"
+then
+  echo "WATCHTOWER_SMOKE=FAIL (alert history diverged across replays)"
+  cat "$WT_DIR/diff.txt"; rm -rf "$WT_DIR"; exit 1
+fi
+if ! grep -q "identical" "$WT_DIR/diff.txt"; then
+  echo "WATCHTOWER_SMOKE=FAIL (diff verdict not identical)"
+  cat "$WT_DIR/diff.txt"; rm -rf "$WT_DIR"; exit 1
+fi
+if ! timeout -k 10 60 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli report "$WT_DIR/m1/router" \
+    "$WT_DIR/m1/e0" "$WT_DIR/m1/e1" --audit > /dev/null; then
+  echo "WATCHTOWER_SMOKE=FAIL (audit)"; rm -rf "$WT_DIR"; exit 1
+fi
+if timeout -k 10 60 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli generate $WT_ARGS \
+    --watch "deadline=8,fast=4,slow=4" --trace_gen "$WT_SPEC" \
+    > /dev/null 2> "$WT_DIR/bad.err"; then
+  echo "WATCHTOWER_SMOKE=FAIL (malformed --watch spec accepted)"
+  rm -rf "$WT_DIR"; exit 1
+fi
+if [ "$(wc -l < "$WT_DIR/bad.err")" -ne 1 ]; then
+  echo "WATCHTOWER_SMOKE=FAIL (spec rejection not a one-line error)"
+  rm -rf "$WT_DIR"; exit 1
+fi
+rm -rf "$WT_DIR"
+echo "WATCHTOWER_SMOKE=OK"
+phase_done watchtower_smoke
+
 echo "=== trace smoke ==="
 # The ISSUE 14 spine on the PROCESS drill's own artifacts (no second
 # fleet boot): `report --trace` on the uid the SIGKILL migrated must
@@ -728,6 +847,14 @@ if timeout -k 10 60 env JAX_PLATFORMS=cpu python -m \
     "$PROC_DIR/m/e0" > /dev/null 2>&1; then
   echo "TRACE_SMOKE=FAIL (fleetstat rc 0 with no status doc)"
   rm -rf "$PROC_DIR"; exit 1
+fi
+# the process drill's surviving streams — including the SIGKILLed
+# worker's — must audit clean across the process boundary
+if ! timeout -k 10 60 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli report "$PROC_DIR/m/router" \
+    "$PROC_DIR/m/e0" "$PROC_DIR/m/e1" "$PROC_DIR/m/e2" \
+    --audit > /dev/null; then
+  echo "TRACE_SMOKE=FAIL (audit)"; rm -rf "$PROC_DIR"; exit 1
 fi
 rm -rf "$PROC_DIR"
 echo "TRACE_SMOKE=OK"

@@ -445,6 +445,38 @@ def test_bench_trend_rejects_schema_drift(tmp_path):
     assert r.returncode == 0, r.stdout + r.stderr
     os.remove(os.path.join(root, "DECODE_r02.json"))
 
+    # r21 DECODE watch rows: a non-numeric reaction is drift; the
+    # replay-identity row surviving with any verdict but "identical"
+    # is drift (the bench raises rather than emit it); an "error:"
+    # string is a recorded outage
+    write("DECODE_r03.json", {
+        "metric": "m", "value": 1.0, "unit": "tokens/s",
+        "watch_reaction": {"kill_round": 4, "fired_round": 11,
+                           "reaction_rounds": "fast", "fired": 2,
+                           "resolved": 2},
+        "watch_replay_identity": {"alert_history": "identical",
+                                  "alert_records": 4}})
+    r = _run_trend(root)
+    assert r.returncode == 2
+    assert "DECODE_r03.json" in r.stderr \
+        and "reaction_rounds" in r.stderr
+    write("DECODE_r03.json", {
+        "metric": "m", "value": 1.0, "unit": "tokens/s",
+        "watch_reaction": {"kill_round": 4, "fired_round": 11,
+                           "reaction_rounds": 7, "fired": 2,
+                           "resolved": 2},
+        "watch_replay_identity": {"alert_history": "token-divergence",
+                                  "alert_records": 4}})
+    r = _run_trend(root)
+    assert r.returncode == 2 and "identical" in r.stderr
+    write("DECODE_r03.json", {
+        "metric": "m", "value": 1.0, "unit": "tokens/s",
+        "watch_reaction": "error: RuntimeError: lane died",
+        "watch_replay_identity": "error: RuntimeError: lane died"})
+    r = _run_trend(root)
+    assert r.returncode == 0, r.stdout + r.stderr
+    os.remove(os.path.join(root, "DECODE_r03.json"))
+
     # a missing artifact directory is rc 2, not a silent pass
     r = _run_trend(os.path.join(root, "nope"))
     assert r.returncode == 2

@@ -86,7 +86,13 @@ from distributed_llm_code_samples_tpu.runtime.telemetry import (
 # scheduling decision from decode/engine.py: predicted_miss_shed /
 # budget_deferred / wfq_pick, each pinning exactly the numbers that
 # justified it).
-_PINNED_VERSION = 14
+# v15 (round 21): the watchtower — the "alert" kind (one record per
+# detector lifecycle transition from runtime/watch.py: fired /
+# resolved on the router's round clock, with the detector name,
+# severity class, and the folded [start, end] round window; each
+# detector conditionally pins exactly the numbers that justified the
+# transition, on BOTH fired and resolved records).
+_PINNED_VERSION = 15
 _PINNED_STEP_KEYS = frozenset({
     "schema", "kind", "t", "step", "strategy", "loss", "grad_norm",
     "tokens_per_sec", "step_time_s", "mfu", "hbm_high_water_bytes",
@@ -143,13 +149,26 @@ _PINNED_QOS_EVENT_REQUIRED = {
                                   "token_budget"}),
     "wfq_pick": frozenset({"uid", "virtual_time"}),
 }
+_PINNED_ALERT_REQUIRED = frozenset({
+    "step", "event", "detector", "severity", "window",
+})
+_PINNED_ALERT_DETECTOR_REQUIRED = {
+    "burn_rate": frozenset({"burn_fast", "burn_slow", "violations",
+                            "completions"}),
+    "queue_growth": frozenset({"waiting", "threshold"}),
+    "imbalance": frozenset({"imbalance", "threshold"}),
+    "collapse": frozenset({"stalled_rounds", "live"}),
+    "incident_rate": frozenset({"incidents", "threshold"}),
+    "latency_drift": frozenset({"p95_s", "baseline_s", "metric"}),
+}
 
 
 def test_schema_version_bump_discipline():
     from distributed_llm_code_samples_tpu.runtime.telemetry import (
-        ANOMALY_REQUIRED, AUTOSCALE_EVENT_REQUIRED, AUTOSCALE_REQUIRED,
-        DECODE_REQUIRED, DEPLOY_EVENT_REQUIRED, DEPLOY_REQUIRED,
-        FLEET_REQUIRED, QOS_EVENT_REQUIRED, QOS_REQUIRED, RECORD_KINDS,
+        ALERT_DETECTOR_REQUIRED, ALERT_REQUIRED, ANOMALY_REQUIRED,
+        AUTOSCALE_EVENT_REQUIRED, AUTOSCALE_REQUIRED, DECODE_REQUIRED,
+        DEPLOY_EVENT_REQUIRED, DEPLOY_REQUIRED, FLEET_REQUIRED,
+        QOS_EVENT_REQUIRED, QOS_REQUIRED, RECORD_KINDS,
         REQUEST_COMPLETED_REQUIRED, REQUEST_REQUIRED, REQUIRED_KEYS,
         ROLLBACK_REQUIRED, ROUTER_MOVE_REQUIRED, ROUTER_REQUIRED,
         SPAN_REQUIRED, WORKLOAD_REQUIRED)
@@ -175,7 +194,10 @@ def test_schema_version_bump_discipline():
         == _PINNED_AUTOSCALE_EVENT_REQUIRED and \
         frozenset(QOS_REQUIRED) == _PINNED_QOS_REQUIRED and \
         {k: frozenset(v) for k, v in QOS_EVENT_REQUIRED.items()} \
-        == _PINNED_QOS_EVENT_REQUIRED, (
+        == _PINNED_QOS_EVENT_REQUIRED and \
+        frozenset(ALERT_REQUIRED) == _PINNED_ALERT_REQUIRED and \
+        {k: frozenset(v) for k, v in ALERT_DETECTOR_REQUIRED.items()} \
+        == _PINNED_ALERT_DETECTOR_REQUIRED, (
             "telemetry record schema changed: bump SCHEMA_VERSION "
             "and update the pinned sets here in the same commit")
     assert "anomaly" in RECORD_KINDS and "rollback" in RECORD_KINDS
@@ -188,12 +210,13 @@ def test_schema_version_bump_discipline():
     assert "workload" in RECORD_KINDS
     assert "autoscale" in RECORD_KINDS
     assert "qos" in RECORD_KINDS
+    assert "alert" in RECORD_KINDS
     # every contract-carrying kind routes through the one table
     # validate_record reads (a new kind that skips it validates
     # envelope-only silently — this catches the drift)
     for kind in ("step", "anomaly", "rollback", "decode", "request",
                  "span", "router", "fleet", "deploy", "workload",
-                 "autoscale", "qos"):
+                 "autoscale", "qos", "alert"):
         assert kind in REQUIRED_KEYS, kind
 
 
@@ -525,6 +548,75 @@ def test_qos_event_conditional_pin():
             assert not ok and event in reason and key in reason, \
                 (event, key, reason)
             assert "\n" not in reason
+
+
+def test_alert_record_round_trip_and_torn_tail(tmp_path):
+    """The schema-v15 alert kind (runtime/watch.py): writer method
+    stamps the kind + envelope and defaults severity to "warn", records
+    validate, a torn tail after an alert write is reported-not-fatal,
+    and a missing contract key rejects naming kind and key."""
+    w = TelemetryWriter(str(tmp_path))
+    w.alert({"step": 11, "event": "fired", "detector": "burn_rate",
+             "severity": "page", "window": [7, 11], "burn_fast": 4.0,
+             "burn_slow": 1.0, "violations": 1, "completions": 1})
+    w.alert({"step": 16, "event": "resolved", "detector": "burn_rate",
+             "severity": "page", "window": [12, 16], "burn_fast": 0.0,
+             "burn_slow": 0.5, "violations": 0, "completions": 2,
+             "fired_step": 11})
+    w.alert({"step": 3, "event": "fired", "detector": "queue_growth",
+             "window": [0, 3], "waiting": 9, "threshold": 4})
+    w.close()
+    path = os.path.join(str(tmp_path), METRICS_FILENAME)
+    with open(path, "a") as f:
+        f.write('{"schema": 15, "kind": "aler')  # torn write
+    records, problems = read_metrics(path)
+    assert len(problems) == 1 and "torn" in problems[0]
+    fired, resolved, queue = records
+    assert fired["kind"] == "alert" and fired["schema"] == SCHEMA_VERSION
+    assert fired["event"] == "fired" and fired["severity"] == "page"
+    assert fired["window"] == [7, 11] and fired["burn_fast"] == 4.0
+    assert resolved["event"] == "resolved"
+    assert resolved["fired_step"] == 11  # extras ride along, unpinned
+    # severity defaults to "warn" (an experimental detector need not
+    # pick a page class), never silently absent
+    assert queue["severity"] == "warn" and queue["waiting"] == 9
+    for r in records:
+        ok, reason = validate_record(r)
+        assert ok, reason
+    bad = {k: v for k, v in fired.items() if k != "violations"}
+    ok, reason = validate_record(bad)
+    assert not ok and "alert record" in reason and "violations" in reason
+
+
+def test_alert_detector_conditional_pin():
+    """v15: every detector transition pins exactly the numbers that
+    justified it, on BOTH fired and resolved records (the resolved
+    record shows the recovered reading) — per detector, per key."""
+    base = {"schema": SCHEMA_VERSION, "kind": "alert", "t": 0.0,
+            "step": 9, "severity": "page", "window": [5, 9]}
+    pins = {
+        "burn_rate": {"burn_fast": 2.0, "burn_slow": 1.5,
+                      "violations": 3, "completions": 6},
+        "queue_growth": {"waiting": 12, "threshold": 4},
+        "imbalance": {"imbalance": 0.8, "threshold": 0.5},
+        "collapse": {"stalled_rounds": 6, "live": 0},
+        "incident_rate": {"incidents": 2, "threshold": 1},
+        "latency_drift": {"p95_s": 1.9, "baseline_s": 0.6,
+                          "metric": "ttft"},
+    }
+    for detector, keys in pins.items():
+        for event in ("fired", "resolved"):
+            ok, reason = validate_record({**base, "event": event,
+                                          "detector": detector, **keys})
+            assert ok, reason
+            for key in sorted(keys):
+                rec = {**base, "event": event, "detector": detector,
+                       **keys}
+                del rec[key]
+                ok, reason = validate_record(rec)
+                assert not ok and detector in reason and key in reason, \
+                    (detector, event, key, reason)
+                assert "\n" not in reason
 
 
 def test_completed_request_record_conditional_pin():
