@@ -914,6 +914,147 @@ def main() -> int:
             and os.environ.get("DECODE_ENGINE", "1") != "0":
         guarded("fleet_rpc_overhead_p50_ms", fleet_ops_rows)
 
+    # Fleet TCP rows (round 22, DESIGN.md section 28): the multi-host
+    # transport priced against the AF_UNIX lane it generalizes — the
+    # same wave through 2 worker processes per family, per-op RPC
+    # overhead pooled the same way — and the async-migration claim
+    # MEASURED: migration stall p90 with the ship window overlapped
+    # (commit-only) vs the synchronous move (export+ship+import all
+    # on the request's critical path). Byte-identity vs the
+    # in-process oracle is asserted in-bench for every lane: a number
+    # from a run that diverged would price the wrong system.
+    def fleet_tcp_rows():
+        import tempfile
+
+        import numpy as np
+
+        from distributed_llm_code_samples_tpu.decode import (
+            DecodeEngine, EngineConfig, FleetRouter)
+        from distributed_llm_code_samples_tpu.decode.worker import (
+            spawn_fleet_handles, spawn_worker)
+
+        block = 8
+        tcp_d, t0, new, slots = 64, 8, 16, 4
+        tcp_params = init_lm(jax.random.PRNGKey(6), V, tcp_d, L,
+                             t0 + new)
+        mbps = -(-(t0 + new) // block)
+        rng = np.random.default_rng(13)
+        wave = [rng.integers(0, V, size=t0).tolist()
+                for _ in range(3 * slots)]
+        model = {"vocab": V, "model_size": tcp_d, "layers": L,
+                 "heads": H, "kv_heads": None,
+                 "max_seq_len": t0 + new, "random_seed": 6}
+
+        def cfg_kw(n_blocks=None):
+            return dict(block_size=block,
+                        n_blocks=n_blocks or 1 + slots * mbps,
+                        max_slots=slots, max_blocks_per_seq=mbps,
+                        prefill_chunk=8, kv_dtype="f32")
+
+        wenv = dict(os.environ)
+        if os.environ.get("BENCH_PLATFORM"):
+            wenv["JAX_PLATFORMS"] = os.environ["BENCH_PLATFORM"]
+
+        # the in-process oracle: the byte-identity bar every lane
+        # below must meet before its numbers count
+        eng = DecodeEngine(tcp_params, H, EngineConfig(**cfg_kw()))
+        for p in wave:
+            eng.submit(p, new)
+        want = eng.run()
+
+        def rpc_lane(family):
+            spool = tempfile.mkdtemp(prefix=f"bench_{family}_")
+            handles = spawn_fleet_handles(2, 0, spool, model=model,
+                                          config=cfg_kw(), policy={},
+                                          family=family, env=wenv)
+            fl = FleetRouter(None, 2, handles=handles)
+            try:
+                for p in wave:
+                    fl.submit(p, new)
+                out = fl.run()
+                for _ in range(16):
+                    for h in handles:
+                        h.ping()
+                stats = {h.id: h.rpc_stats() for h in handles}
+            finally:
+                fl.close()
+            if out != want:
+                raise RuntimeError(
+                    f"{family} fleet outputs != in-process oracle "
+                    "(transport must be invisible to tokens)")
+            over = [(o["overhead_p50_ms"], o["overhead_p99_ms"])
+                    for st in stats.values()
+                    for o in st["ops"].values()
+                    if "overhead_p50_ms" in o]
+            if not over:
+                raise RuntimeError(f"{family} lane produced no "
+                                   "overhead samples")
+            return (round(max(p50 for p50, _ in over), 3),
+                    round(max(p99 for _, p99 in over), 3))
+
+        unix50, unix99 = rpc_lane("unix")
+        tcp50, tcp99 = rpc_lane("tcp")
+        paths["fleet_tcp_rpc_overhead_p50_ms"] = tcp50
+        paths["fleet_tcp_rpc_overhead_p99_ms"] = tcp99
+        paths["fleet_tcp_rpc_vs_unix"] = {
+            "unix_p50_ms": unix50, "unix_p99_ms": unix99,
+            "tcp_over_unix_p50": round(tcp50 / max(unix50, 1e-9), 3),
+        }
+
+        # (b) migration stall, sync vs async: a block-starved e0 with
+        # every admission pinned to it — pool pressure moves the
+        # youngest resident to the roomy e1, synchronously (the whole
+        # export+ship+import on the critical path) or async (only the
+        # commit is; the ship overlapped a decode round)
+        def stall_lane(async_migration):
+            spool = tempfile.mkdtemp(prefix="bench_tcp_mig_")
+            h0 = spawn_worker("e0", "decode", spool, model=model,
+                              config=cfg_kw(n_blocks=1 + 2 * mbps),
+                              policy={}, family="tcp", env=wenv)
+            h1 = spawn_worker("e1", "decode", spool, model=model,
+                              config=cfg_kw(), policy={},
+                              family="tcp", env=wenv)
+            fl = FleetRouter(None, 2, handles=[h0, h1],
+                             async_migration=async_migration)
+            try:
+                for p in wave[:4]:
+                    fl.submit(p, new, session="pin")
+                out = fl.run()
+            finally:
+                fl.close()
+            if fl.migrations < 1:
+                raise RuntimeError("the pressure lane never migrated "
+                                   "— nothing to price")
+            stall = round(float(np.percentile(
+                np.asarray(fl.handoff_durations), 90)) * 1e3, 3)
+            return out, stall
+
+        out_sync, sync_p90 = stall_lane(False)
+        out_async, async_p90 = stall_lane(True)
+        if out_sync != out_async:
+            raise RuntimeError(
+                "async-migration outputs != synchronous move (the "
+                "delta catch-up broke token identity)")
+        for u, toks in out_sync.items():
+            if toks != want[u]:
+                raise RuntimeError(
+                    f"pressure-lane uid {u} != in-process oracle")
+        paths["fleet_tcp_handoff_stall_p90_ms"] = {
+            "sync": sync_p90, "async": async_p90}
+        paths["fleet_tcp_note"] = (
+            "2 engine worker processes per lane, identical wave: "
+            "per-op RPC overhead (router call wall minus worker "
+            "handle duration; worst worker) over TCP loopback vs "
+            "AF_UNIX, and pool-pressure migration stall p90 with the "
+            "ship synchronous vs overlapped (async ships while the "
+            "source decodes; only the commit stalls the request). "
+            "Every lane's tokens asserted byte-identical to the "
+            "in-process oracle before its numbers are reported.")
+
+    if not tp_only and os.environ.get("DECODE_FLEET", "1") != "0" \
+            and os.environ.get("DECODE_ENGINE", "1") != "0":
+        guarded("fleet_tcp_rpc_overhead_p50_ms", fleet_tcp_rows)
+
     # Workload rows (round 19, DESIGN.md section 25): goodput under a
     # STATED, replayable trace — the DistServe framing made falsifiable.
     # Two traces with identical totals and length mix (bursty on/off vs

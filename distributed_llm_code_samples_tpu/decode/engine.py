@@ -1084,7 +1084,7 @@ class DecodeEngine:
 
     # -- single-sequence KV handoff (DESIGN.md section 20) -------------
 
-    def export_sequence(self, uid: int) -> dict:
+    def export_sequence(self, uid: int, keep: bool = False) -> dict:
         """Export one RESIDENT fully-prefilled sequence as a handoff
         document: scheduler state (prompt, emitted tokens, position,
         pending next token) plus the WRITTEN blocks' bytes and int8
@@ -1094,7 +1094,19 @@ class DecodeEngine:
         blocks DECREF (an innocent sharer's prefix is untouched — the
         quarantine stance, without the distrust), private blocks return
         to the free list clean. Generalizes the PR 5 snapshot from
-        whole-engine metadata to one sequence WITH its KV content."""
+        whole-engine metadata to one sequence WITH its KV content.
+
+        ``keep=True`` is the SHIP half of an async live migration
+        (round 22): the document is built at the current position but
+        the sequence STAYS resident and keeps decoding while the
+        snapshot ships — ``finish_export`` later evicts it and returns
+        the delta tokens emitted during the ship window, which the
+        target teacher-forces after importing the shipped document
+        (the replay contract: forced tokens rebuild KV bit-identically,
+        so the splice of shipped blocks + caught-up delta is the same
+        KV the sync path would have shipped). No handoff event is
+        emitted and no span closes until the commit — the sequence has
+        not left yet."""
         if self.mesh is not None:
             raise ValueError(
                 "KV handoff is single-device (the fleet runs "
@@ -1153,12 +1165,54 @@ class DecodeEngine:
             "source_blocks": phys,     # the renumbering certificate
             **extract_blocks(self.pool, phys),
         }
+        if keep:
+            # the ship half: the doc captured t_first by POPPING the
+            # mark — restore it, the sequence is still live here and
+            # may yet complete locally (an aborted migration must
+            # still report the true ttft_s)
+            if doc["t_first"] is not None:
+                self.tracer.mark_first_token(seq.uid, doc["t_first"])
+            return doc
         self._event("handoff", seq.uid, reason="exported",
                     n_out=len(seq.out), position=pos)
         self.tracer.close(seq.uid, self.global_step, reason="handoff",
                           tokens=self._span_tokens.pop(seq.uid, 0))
         self._evict(slot)
         return doc
+
+    def finish_export(self, uid: int) -> dict:
+        """Commit half of an async live migration: the snapshot from
+        ``export_sequence(uid, keep=True)`` has shipped, so take the
+        sequence OFF this engine now and return the delta —
+        ``{"status": "resident", "out": [...], "position": P}`` with
+        the FULL token list as of the commit (the shipped document's
+        ``out`` is a strict prefix; the difference is what the target
+        teacher-forces to catch up). If the request finished, failed,
+        or was preempted back to WAITING during the ship window, the
+        migration aborts instead: the terminal/requeued state is
+        reported (``finished`` / ``failed`` / ``waiting`` / ``gone``)
+        and NOTHING is evicted — the request never left this engine,
+        and the target discards its staged copy."""
+        slot = next((i for i, s in enumerate(self.slots)
+                     if s is not None and s.uid == uid), None)
+        if slot is None:
+            if uid in self.finished:
+                return {"status": "finished"}
+            if uid in self.failed:
+                return {"status": "failed"}
+            if any(s.uid == uid for s in self.waiting):
+                return {"status": "waiting"}
+            return {"status": "gone"}
+        seq = self.slots[slot]
+        out = [int(t) for t in seq.out]
+        pos = int(self.lengths[slot])
+        self._event("handoff", seq.uid, reason="exported",
+                    n_out=len(out), position=pos)
+        self.tracer.close(seq.uid, self.global_step, reason="handoff",
+                          tokens=self._span_tokens.pop(seq.uid, 0))
+        self.tracer.pop_first_token(seq.uid)   # travels with the doc
+        self._evict(slot)
+        return {"status": "resident", "out": out, "position": pos}
 
     def import_sequence(self, doc: dict) -> int:
         """Restore an ``export_sequence`` document into THIS engine's

@@ -184,6 +184,9 @@ class EngineHandle:
         self.wire_dir = wire_dir
         self._did = False
         self._seq = 0
+        # staged handoffs awaiting commit_import (async migration):
+        # uid -> (verified doc, wire stats, spool path or None, mode)
+        self._staged: dict[int, tuple] = {}
 
     # -- identity / validation ----------------------------------------
 
@@ -337,12 +340,14 @@ class EngineHandle:
 
     # -- the KV handoff ------------------------------------------------
 
-    def export(self, uid: int) -> HandoffRef:
+    def export(self, uid: int, keep: bool = False) -> HandoffRef:
         """Export one resident fully-prefilled sequence. With a
         ``wire_dir`` the document is serialized + atomically published
         as a wire file (per-array CRC-32); otherwise the doc rides
-        in-process."""
-        doc = self.engine.export_sequence(uid)
+        in-process. ``keep=True`` is the async-migration ship-half:
+        the sequence STAYS resident and decoding while its snapshot
+        crosses (``finish_export`` settles up at commit time)."""
+        doc = self.engine.export_sequence(uid, keep=keep)
         ref = HandoffRef(uid, int(doc["position"]),
                          int(doc["blocks_written"]))
         if self.wire_dir is None:
@@ -382,6 +387,83 @@ class EngineHandle:
             pass
         return {"mode": "wire", "bytes": stats["bytes"],
                 "crc_verify_s": stats["crc_verify_s"]}
+
+    # -- async migration (round 22, DESIGN.md section 28) --------------
+
+    def export_keep(self, uid: int) -> HandoffRef:
+        """Ship-half of an async migration: export WITHOUT evicting
+        (the worker handle names this op the same way — the router
+        calls one method on either transport)."""
+        return self.export(uid, keep=True)
+
+    def finish_export(self, uid: int) -> dict:
+        """Commit-half of an async migration on the SOURCE: evict now
+        and return the final token list (status ``"resident"``), or
+        the abort status when the request finished/failed/was
+        preempted during the ship window."""
+        return self.engine.finish_export(uid)
+
+    def stage_ref(self, ref: HandoffRef) -> dict:
+        """Stage a shipped handoff on the TARGET for a later
+        ``commit_import``: integrity-verify NOW (the wire CRC ladder
+        for a file; a doc-mode ref is already in-memory) and park the
+        verified document keyed by uid — a corrupt ship must be
+        rejected at stage time, never after the source evicted."""
+        if ref.doc is not None:
+            uid = int(ref.doc["uid"])
+            self._staged[uid] = (ref.doc, {}, None, "inproc")
+            return {"uid": uid, "mode": "inproc", "bytes": 0,
+                    "crc_verify_s": None}
+        stats: dict = {}
+        doc = wire.read_doc(ref.path, stats)    # raises WireError
+        uid = int(doc["uid"])
+        self._staged[uid] = (doc, stats, ref.path, "wire")
+        return {"uid": uid, "mode": "wire", "bytes": stats["bytes"],
+                "crc_verify_s": stats["crc_verify_s"]}
+
+    def stage_bytes(self, data: bytes) -> dict:
+        """Stage a handoff shipped as raw wire bytes (the TCP side
+        channel) — the identical CRC discipline, off the stream."""
+        stats: dict = {}
+        doc = wire.deserialize_doc(data, stats)  # raises WireError
+        uid = int(doc["uid"])
+        self._staged[uid] = (doc, stats, None, "tcp")
+        return {"uid": uid, "mode": "tcp", "bytes": stats["bytes"],
+                "crc_verify_s": stats["crc_verify_s"]}
+
+    def commit_import(self, uid: int, out=None) -> dict:
+        """Import the staged doc. ``out`` (when given) patches the
+        token list to the source's FINAL one first — ``emitted`` stays
+        at the ship point, so the engine's replay contract teacher-
+        forces the delta and rebuilds the window bit-identically (the
+        catch-up)."""
+        entry = self._staged.pop(int(uid), None)
+        if entry is None:
+            raise ValueError(f"no staged handoff for uid {uid}")
+        doc, stats, path, mode = entry
+        if out is not None:
+            doc = {**doc, "out": [int(t) for t in out]}
+        self.engine.import_sequence(doc)
+        if path is not None:
+            try:
+                os.unlink(path)     # consumed
+            except OSError:
+                pass
+        return {"mode": mode, "bytes": stats.get("bytes", 0),
+                "crc_verify_s": stats.get("crc_verify_s"),
+                "catchup_tokens": (len(doc["out"])
+                                   - int(doc["emitted"]))}
+
+    def discard_stage(self, uid: int) -> bool:
+        """Drop a staged handoff (the abort path: the request finished
+        or was preempted on the source mid-ship). Idempotent."""
+        entry = self._staged.pop(int(uid), None)
+        if entry is not None and entry[2] is not None:
+            try:
+                os.unlink(entry[2])
+            except OSError:
+                pass
+        return entry is not None
 
     # -- drain/telemetry surfaces --------------------------------------
 
@@ -505,7 +587,8 @@ class FleetRouter:
                  prefix_affinity: bool = True, wire_dir: str | None = None,
                  handles: list | None = None, fleet_chaos=None,
                  keep_rejected: int = 8, status_dir: str | None = None,
-                 status_every_s: float = 1.0):
+                 status_every_s: float = 1.0,
+                 async_migration: bool = False):
         if n_engines < 1:
             raise ValueError(f"n_engines must be >= 1, got {n_engines}")
         if not 0 <= prefill_engines < n_engines:
@@ -579,6 +662,23 @@ class FleetRouter:
                         "kill_worker would kill the only decode "
                         "engine in this fleet (the survivors have "
                         "nowhere to migrate its requests)")
+            # the round-22 network kinds drill the reconnect ladder,
+            # which only the TCP family carries (AF_UNIX keeps the
+            # round-16 EOF-is-dead semantics); slow_link only needs a
+            # socket to be slow on
+            if {"partition_worker", "drop_conn"} & kinds and any(
+                    getattr(h, "family", None) != "tcp"
+                    for h in decode_handles):
+                raise ValueError(
+                    "partition_worker/drop_conn drill the reconnect "
+                    "ladder, which only the TCP transport carries — "
+                    "run the fleet with --transport tcp")
+            if "slow_link" in kinds and any(
+                    h.transport != "process" for h in decode_handles):
+                raise ValueError(
+                    "slow_link injects socket latency and needs a "
+                    "socket to inject it on — run the fleet with "
+                    "--transport process (or tcp)")
         self.rounds = 0                     # fleet scheduling rounds
         self._next_uid = 0
         self._sessions: dict = {}           # session -> engine id
@@ -615,6 +715,19 @@ class FleetRouter:
         self.wire_rejects = 0
         self._uid_wire_rejects: dict[int, int] = {}
         self._corrupt_next_wire = False
+        # -- async live migration (round 22, DESIGN.md section 28) --
+        # opt-in: pool-pressure moves run the three-phase pipeline
+        # (export_keep -> ship-during-step -> finish_export/commit)
+        # instead of the synchronous export->import, so the source
+        # engine never stalls for the ship; uid -> the pending move
+        self.async_migration = async_migration
+        self._pending_moves: dict[int, dict] = {}
+        # reconnect accounting (schema v16 "reconnected" records):
+        # every handle that can heal a dropped connection reports here
+        self.reconnects_total = 0
+        for h in self.handles:
+            if hasattr(h, "on_reconnect"):
+                h.on_reconnect = self._note_reconnect
         # bounded post-mortem retention for REJECTED wire docs (round
         # 17 satellite, mirroring checkpoint.keep_last): a rejected
         # handoff file is renamed *.rejected and the oldest are pruned
@@ -725,11 +838,33 @@ class FleetRouter:
             # callers on the shed path pass it explicitly — the
             # request book never learned a shed uid
             trace_id = self.requests.get(int(uid), {}).get("trace")
+        if event == "migrated":
+            # schema v16: every migrated record pins the async-
+            # migration attribution, with honest defaults on the sync
+            # and replay paths — ship_s null (nothing shipped while
+            # decoding) and catchup_tokens = the replay length (the
+            # full catch-up a replay-migration teacher-forces)
+            extra.setdefault("ship_s", None)
+            extra.setdefault("catchup_tokens",
+                             int(extra.get("replay", 0)))
         self.metrics.router({"step": self.rounds, "uid": int(uid),
                              "event": event, "source": source,
                              "target": target, "reason": reason,
                              "policy": policy, "trace_id": trace_id,
                              **extra})
+
+    def _note_reconnect(self, h, info: dict) -> None:
+        """A handle healed a dropped connection (reconnect + sync +
+        sequence-numbered replay): one schema-v16 ``reconnected``
+        router record — uid -1, this is link-level, not per-request —
+        so the drill can pin that a partition cost reconnects, never
+        deaths."""
+        self.reconnects_total += 1
+        self._record("reconnected", -1, source=h.id,
+                     reason=info.get("cause"),
+                     attempts=info.get("attempts"),
+                     gap_s=info.get("gap_s"),
+                     replayed_ops=len(info.get("replayed", ())))
 
     def _event(self, record: dict) -> None:
         if self.metrics is not None:
@@ -820,6 +955,14 @@ class FleetRouter:
                 "utilization": round(d["utilization"], 4),
                 "last_step_s": round(h.last_step_s, 6),
             }
+            fam = getattr(h, "family", None)
+            if fam is not None:
+                # the operator's "which boundary is this member
+                # behind" tag (round 22): unix/tcp, with the member's
+                # survived-reconnect count alongside under tcp
+                engines[h.id]["family"] = fam
+                engines[h.id]["reconnects"] = getattr(
+                    h, "reconnects", 0)
         # the interval baseline is CONSUMED by _publish_status only —
         # an out-of-band status_doc() read (tests, an in-process
         # consumer) must not shorten the next published interval
@@ -850,6 +993,7 @@ class FleetRouter:
                 "migrations": self.migrations, "sheds": self.sheds,
                 "kills": self.kills,
                 "wire_rejects": self.wire_rejects,
+                "reconnects": self.reconnects_total,
             },
             # per-tenant ops counters (round 19, schema v13): in-flight
             # summed off the digests (zero extra round-trips), offered/
@@ -1168,6 +1312,39 @@ class FleetRouter:
                 frac = 0.5 if f.arg is None else float(f.arg)
                 self.fleet_chaos._note(f, frac=frac)
                 self._corrupt_next_deploy = frac
+            elif f.kind == "partition_worker":
+                # drop the first alive decode worker's link BOTH ways;
+                # the reconnect ladder must wait the partition out and
+                # replay — zero deaths, one "reconnected" record
+                cands = [h for h in self.alive_handles("decode")
+                         if getattr(h, "family", None) == "tcp"]
+                if not cands:
+                    continue
+                secs = 2.0 if f.arg is None else float(f.arg)
+                self.fleet_chaos._note(f, engine=cands[0].id,
+                                       secs=secs)
+                cands[0].partition(secs)
+            elif f.kind == "slow_link":
+                # permanent injected latency from this round on — a
+                # SLOW link, not a dead one: per-call deadlines must
+                # absorb it without paging the liveness ladder
+                cands = [h for h in self.alive_handles("decode")
+                         if h.transport == "process"]
+                if not cands:
+                    continue
+                ms = 50.0 if f.arg is None else float(f.arg)
+                self.fleet_chaos._note(f, engine=cands[0].id, ms=ms)
+                cands[0].slow_link(ms)
+            elif f.kind == "drop_conn":
+                # mid-message RST on the next send: the response is
+                # lost in flight; reconnect + dedup-cache replay must
+                # recover it with no duplicate side effects
+                cands = [h for h in self.alive_handles("decode")
+                         if getattr(h, "family", None) == "tcp"]
+                if not cands:
+                    continue
+                self.fleet_chaos._note(f, engine=cands[0].id)
+                cands[0].drop_conn()
         return fired
 
     def step(self) -> bool:
@@ -1218,6 +1395,12 @@ class FleetRouter:
             except TransportError as e:
                 self._transport_death(h, e)
                 did = True
+        # async live migration phase 2 (round 22): ship pending
+        # documents NOW, between the step fan-out and the collect —
+        # the stage RPCs queue behind each worker's in-flight step, so
+        # the whole fleet decodes while the KV crosses the wire
+        if self._pending_moves:
+            self._ship_pending_moves()
         for h in stepping:
             if not h.alive:
                 continue
@@ -1240,6 +1423,12 @@ class FleetRouter:
         before = self.handoffs + self.migrations
         self._handoff_completed_prefills()
         self._migrate_pool_pressure()
+        # async live migration phase 3: settle every shipped move
+        # (finish_export evicts on the source; the staged doc commits
+        # with its ship-window delta patched in — one teacher-forced
+        # catch-up on the target, zero source stall)
+        if self._pending_moves:
+            self._commit_pending_moves()
         did = did or (self.handoffs + self.migrations > before)
         self.rounds += 1
         if self.rounds % self.snapshot_every == 0:
@@ -1276,7 +1465,18 @@ class FleetRouter:
             _corrupt_wire_file(ref.path)
             self._corrupt_next_wire = False
         try:
-            info = target.import_doc(ref)   # raises WireError on damage
+            if (getattr(source, "family", None) == "tcp"
+                    or getattr(target, "family", None) == "tcp"):
+                # the spool is (notionally) not shared across hosts:
+                # stream the doc over the framed side channel instead
+                # of handing the target a path it could not open
+                data = source.fetch_wire(ref.path)
+                st = target.stage_bytes(data)
+                target.commit_import(uid)
+                info = {"mode": "tcp", "bytes": st["bytes"],
+                        "crc_verify_s": st["crc_verify_s"]}
+            else:
+                info = target.import_doc(ref)  # WireError on damage
         except WireError:
             # keep the damaged file for post-mortem — renamed so it can
             # never be re-consumed, pruned past keep_rejected so a
@@ -1309,6 +1509,204 @@ class FleetRouter:
         the record names the catch-up cost instead."""
         return {"mode": "replay", "bytes": 0, "crc_verify_s": None,
                 "retries": self._uid_wire_rejects.get(uid, 0)}
+
+    # -- async live migration (round 22, DESIGN.md section 28) ---------
+
+    def _start_move(self, source, target, uid: int,
+                    reason: str) -> None:
+        """Phase 1 (end of round N): snapshot the sequence to the
+        wire WITHOUT evicting (``export_keep``) — the source keeps
+        decoding it through the whole ship window. Phases 2/3 run
+        inside round N+1 (``_ship_pending_moves`` between the step
+        fan-out and collect; ``_commit_pending_moves`` after)."""
+        ref = source.export_keep(uid)
+        if self._corrupt_next_wire and ref.path is not None:
+            _corrupt_wire_file(ref.path)
+            self._corrupt_next_wire = False
+        self._pending_moves[uid] = {
+            "uid": uid, "source": source, "target": target,
+            "ref": ref, "reason": reason, "stage": None,
+            "t0": time.perf_counter(), "state": "exported"}
+
+    def _ship_pending_moves(self) -> None:
+        """Phase 2: stage each exported document on its target while
+        every worker decodes its in-flight step. Failures here abort
+        with the SOURCE UNDISTURBED — nothing was evicted yet, so a
+        corrupt ship costs one ``wire_rejected`` record and the
+        request never stops decoding (no replay, no reroute)."""
+        for uid, mv in list(self._pending_moves.items()):
+            if mv["state"] != "exported":
+                continue
+            source, target, ref = mv["source"], mv["target"], mv["ref"]
+            if not source.alive or not target.alive:
+                self._abort_move(mv, "member died before ship")
+                continue
+            try:
+                if (getattr(source, "family", None) == "tcp"
+                        or getattr(target, "family", None) == "tcp"):
+                    # the spool is (notionally) not shared across
+                    # hosts: stream source spool -> router -> target
+                    # over the sockets' framed side channel
+                    data = source.fetch_wire(ref.path)
+                    mv["stage"] = target.stage_bytes(data)
+                else:
+                    mv["stage"] = target.stage_ref(ref)
+            except WireError as e:
+                self.wire_rejects += 1
+                self._uid_wire_rejects[uid] = \
+                    self._uid_wire_rejects.get(uid, 0) + 1
+                self._record("wire_rejected", uid, source=source.id,
+                             target=target.id, reason=str(e))
+                self._event({"event": "wire_rejected",
+                             "uid": int(uid), "source": source.id,
+                             "target": target.id,
+                             "context": "async_ship",
+                             "reason": str(e)})
+                if ref.path is not None:
+                    _retain_rejected(ref.path, self.keep_rejected)
+                del self._pending_moves[uid]
+                continue
+            except TransportError as e:
+                # the failing member's own step collect declares the
+                # death; the move dissolves (the source still owns
+                # the request and its snapshot still lists it)
+                self._abort_move(mv, f"{type(e).__name__}: {e}")
+                continue
+            mv["state"] = "staged"
+
+    def _abort_move(self, mv: dict, why: str) -> None:
+        """Dissolve one pending move with the source outcome standing
+        (it never evicted); drop any staged doc on the target."""
+        uid = mv["uid"]
+        if mv.get("stage") is not None and mv["target"].alive:
+            try:
+                mv["target"].discard_stage(uid)
+            except (TransportError, ValueError):
+                pass
+        self._event({"event": "move_aborted", "uid": int(uid),
+                     "source": mv["source"].id,
+                     "target": mv["target"].id, "reason": why})
+        self._pending_moves.pop(uid, None)
+
+    def _drop_pending_moves(self, h) -> None:
+        """A dying member dissolves every pending move it touches: as
+        the SOURCE the sequence stayed resident through the ship
+        window so the snapshot replay recovers it; as the TARGET the
+        source still owns it — either way nothing is lost."""
+        for uid, mv in list(self._pending_moves.items()):
+            if mv["source"] is h or mv["target"] is h:
+                self._abort_move(mv, f"member {h.id} died mid-move")
+
+    def _commit_pending_moves(self) -> None:
+        """Phase 3 (after the round's collect): settle every shipped
+        move. ``finish_export`` evicts on the source and returns the
+        FINAL token list; the staged doc commits with that list
+        patched in — ``emitted`` stays at the ship point, so the
+        target's engine teacher-forces exactly the ship-window delta
+        (the one replay the moving request pays). An abort status
+        (finished/failed/preempted mid-ship) just discards the stage.
+        The recorded ``duration_s`` is the commit stall alone — the
+        ship wall is ``ship_s``, overlapped with decoding by
+        construction."""
+        for uid, mv in list(self._pending_moves.items()):
+            if mv["state"] != "staged":
+                continue
+            source, target = mv["source"], mv["target"]
+            del self._pending_moves[uid]
+            if not source.alive or not target.alive:
+                self._abort_move({**mv}, "member died before commit")
+                continue
+            t_commit = time.perf_counter()
+            try:
+                delta = source.finish_export(uid)
+            except TransportError:
+                continue    # the source's death is being declared
+            if delta.get("status") != "resident":
+                try:
+                    target.discard_stage(uid)
+                except (TransportError, ValueError):
+                    pass
+                self._event({"event": "move_aborted", "uid": int(uid),
+                             "source": source.id, "target": target.id,
+                             "reason": (f"request "
+                                        f"{delta.get('status')} "
+                                        "during ship window")})
+                continue
+            try:
+                info = target.commit_import(uid, out=delta["out"])
+            except TransportError as e:
+                self._transport_death(target, e)
+                self._resume_from_delta(source, uid, delta,
+                                        mv["reason"])
+                continue
+            except (WireError, ValueError, RuntimeError):
+                self._resume_from_delta(source, uid, delta,
+                                        mv["reason"])
+                continue
+            dur = time.perf_counter() - t_commit
+            ship_s = time.perf_counter() - mv["t0"]
+            ref, st = mv["ref"], mv["stage"]
+            blocks = ref.blocks_written
+            nbytes = int(st["bytes"]) or (
+                wire.doc_wire_bytes(ref.doc)
+                if ref.doc is not None else 0)
+            self.handoff_blocks += blocks
+            self.handoff_bytes += nbytes
+            self.handoff_durations.append(dur)
+            self.migrations += 1
+            req = self.requests[uid]
+            req["engine"] = target.id
+            if req.get("session") is not None:
+                self._sessions[req["session"]] = target.id
+            self._record(
+                "migrated", uid, source=source.id, target=target.id,
+                reason=mv["reason"], position=int(delta["position"]),
+                blocks=blocks, bytes=nbytes,
+                duration_s=round(dur, 6), ship_s=round(ship_s, 6),
+                catchup_tokens=int(info["catchup_tokens"]),
+                transport={"mode": st["mode"], "bytes": nbytes,
+                           "crc_verify_s": st.get("crc_verify_s"),
+                           "retries": self._uid_wire_rejects.get(
+                               uid, 0)})
+            # the handoff snapshot-refresh discipline: neither side's
+            # stale snapshot may lose or resurrect the moved request
+            source.snapshot = source.fetch_snapshot()
+            target.snapshot = target.fetch_snapshot()
+
+    def _resume_from_delta(self, source, uid: int, delta: dict,
+                           reason: str) -> None:
+        """Commit fallback: the source already evicted, so the only
+        correct continuation is a replay-resume from the FINAL token
+        list ``finish_export`` returned — the full-catch-up
+        degenerate case of the same teacher-forcing contract."""
+        req = self.requests[uid]
+        entry = None
+        if source.snapshot is not None:
+            entry = next((r for r in source.snapshot["requests"]
+                          if int(r["uid"]) == uid), None)
+        cands = [h for h in self.alive_handles("decode")
+                 if h.id != source.id] or self.alive_handles("decode")
+        dest = min(cands, key=self._load_key)
+        t0 = time.perf_counter()
+        dest.resume_request(
+            uid, req["prompt"], req["max_new"], out=delta["out"],
+            retries=(entry or {}).get("retries", 0),
+            t_submit=(entry or {}).get("t_submit"),
+            t_first=(entry or {}).get("t_first"),
+            weights_version=(entry or {}).get("weights_version"),
+            trace=req.get("trace"), tenant=req.get("tenant"))
+        dur = time.perf_counter() - t0
+        self.migrations += 1
+        req["engine"] = dest.id
+        if req.get("session") is not None:
+            self._sessions[req["session"]] = dest.id
+        self._record("migrated", uid, source=source.id,
+                     target=dest.id, reason=f"{reason}_commit_failed",
+                     replay=len(delta["out"]), blocks=0, bytes=0,
+                     duration_s=round(dur, 6),
+                     transport=self._replay_transport(uid))
+        source.snapshot = source.fetch_snapshot()
+        dest.snapshot = dest.fetch_snapshot()
 
     def _wire_rejected(self, source: EngineHandle, target: EngineHandle,
                        uid: int, err: WireError, context: str,
@@ -1441,13 +1839,21 @@ class FleetRouter:
             victims = [(s["admit_index"], s["uid"], s["prompt_len"],
                         s["max_new"])
                        for s in h.digest()["slots"]
-                       if s["prompt_done"]]
+                       if s["prompt_done"]
+                       and s["uid"] not in self._pending_moves]
             if not victims:
                 continue
             _, uid, plen, mnew = max(victims)
             target = self._placement_target(plen, mnew,
                                             exclude=(h.id,))
             if target is None:
+                continue
+            if self.async_migration:
+                # async live migration: snapshot now, ship during the
+                # next round's decode step, commit after its collect —
+                # the source never stalls on the wire
+                self._start_move(h, target, uid,
+                                 reason="pool_pressure")
                 continue
             try:
                 ref, blocks, nbytes, dur, transport = \
@@ -1501,6 +1907,7 @@ class FleetRouter:
         self.kills += 1
         self._event({"event": "engine_killed", "engine": h.id,
                      "round": self.rounds})
+        self._drop_pending_moves(h)
         self._recover_dead(h)
 
     def kill_engine(self, engine_id: str) -> int:
@@ -1528,6 +1935,7 @@ class FleetRouter:
         self.kills += 1
         self._event({"event": "engine_killed", "engine": h.id,
                      "round": self.rounds})
+        self._drop_pending_moves(h)
         return self._recover_dead(h)
 
     def _recover_dead(self, h: EngineHandle) -> int:
@@ -1622,6 +2030,8 @@ class FleetRouter:
                     f"but the fleet serves {fleet_v} — load the "
                     "current checkpoint before add_engine")
         handle.validate_member()
+        if hasattr(handle, "on_reconnect"):
+            handle.on_reconnect = self._note_reconnect
         self.handles.append(handle)
         self.by_id[handle.id] = handle
         # the step-0 snapshot discipline: a kill before the first
@@ -2074,6 +2484,10 @@ class FleetRouter:
             "handoff_blocks": self.handoff_blocks,
             "handoff_bytes": self.handoff_bytes,
             "wire_rejects": self.wire_rejects,
+            # network-boundary robustness (round 22): links that
+            # dropped and were healed by reconnect-and-replay instead
+            # of being declared dead
+            "reconnects": self.reconnects_total,
             # live weight hot-swap (round 17): completed rolling
             # deploys and CRC/mid-roll rollbacks
             "deploys": self.deploys,

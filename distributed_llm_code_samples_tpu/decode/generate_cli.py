@@ -207,14 +207,23 @@ def build_generate_parser() -> argparse.ArgumentParser:
                         "(requires --fleet; a real SIGKILL of the "
                         "worker process under --transport process)")
     # process-boundary fleet (round 16, DESIGN.md section 22)
-    p.add_argument("--transport", choices=["inproc", "process"],
+    p.add_argument("--transport", choices=["inproc", "process", "tcp"],
                    default="inproc",
                    help="fleet transport: 'inproc' (replicas in the "
-                        "router's process, the PR 10 fleet) or "
+                        "router's process, the PR 10 fleet), "
                         "'process' (each engine in its OWN worker "
-                        "process behind a socket protocol, KV handoffs "
-                        "as CRC-verified wire files — decode/worker.py; "
-                        "requires --fleet)")
+                        "process behind an AF_UNIX socket protocol, KV "
+                        "handoffs as CRC-verified wire files — "
+                        "decode/worker.py), or 'tcp' (the same worker "
+                        "protocol over TCP loopback with reconnect + "
+                        "sequence-numbered replay and handoffs "
+                        "streamed over a framed side channel — the "
+                        "multi-host shape; requires --fleet)")
+    p.add_argument("--async_migration", action="store_true",
+                   help="live migrations ship the KV snapshot WHILE "
+                        "the source keeps decoding; the target "
+                        "teacher-forces the ship-window delta at "
+                        "commit (token-identical; requires --fleet)")
     p.add_argument("--fleet_chaos", default=None, metavar="SPEC",
                    help="fleet-transport fault injection "
                         "(runtime/chaos.py FLEET_KINDS): comma-"
@@ -223,8 +232,15 @@ def build_generate_parser() -> argparse.ArgumentParser:
                         "default e0) / hang_worker (first decode "
                         "worker goes silent :SECS) / corrupt_wire "
                         "(bit-flip the next wire handoff; CRC-"
-                        "rejected); requires --fleet and --transport "
-                        "process")
+                        "rejected) / partition_worker (drop the first "
+                        "decode worker's link both ways for :SECS, "
+                        "then heal — reconnect-and-replay; tcp only) / "
+                        "slow_link (inject :MS latency per call on "
+                        "the first decode link — must NOT page the "
+                        "liveness ladder) / drop_conn (mid-message "
+                        "RST on the first decode link; tcp only); "
+                        "requires --fleet and --transport "
+                        "process/tcp")
     # live weight hot-swap (round 17, DESIGN.md section 23)
     p.add_argument("--deploy_dir", default=None, metavar="CKPT_DIR",
                    help="weight-version ledger: a trainer checkpoint "
@@ -370,11 +386,12 @@ def _fleet_main(args, prompts, cfg, policy, params, fleet_kill,
     try:
         if args.metrics_dir:
             router_metrics = _writer("router")
-        if args.transport == "process":
+        if args.transport in ("process", "tcp"):
             import dataclasses as _dc
             import tempfile as _tempfile
 
             from .worker import spawn_fleet_handles
+            family = "tcp" if args.transport == "tcp" else "unix"
             spool = (os.path.join(args.metrics_dir, "spool")
                      if args.metrics_dir
                      else _tempfile.mkdtemp(prefix="fleet_spool_"))
@@ -385,7 +402,8 @@ def _fleet_main(args, prompts, cfg, policy, params, fleet_kill,
                      "random_seed": args.random_seed}
             worker_meta = {"argv": list(argv or []),
                            "subcommand": "generate",
-                           "fleet": args.fleet, "transport": "process",
+                           "fleet": args.fleet,
+                           "transport": args.transport,
                            "prefill_engines": args.prefill_engines,
                            "kv_dtype": args.kv_dtype,
                            "n_prompts": len(prompts),
@@ -400,17 +418,19 @@ def _fleet_main(args, prompts, cfg, policy, params, fleet_kill,
                 policy=_dc.asdict(policy),
                 qos=(qos.as_dict() if qos is not None else None),
                 metrics_root=args.metrics_dir or None,
-                meta=worker_meta)
+                meta=worker_meta, family=family)
             router = FleetRouter(None, args.fleet,
                                  args.prefill_engines,
                                  metrics=router_metrics,
                                  handles=handles,
-                                 fleet_chaos=fleet_chaos)
+                                 fleet_chaos=fleet_chaos,
+                                 async_migration=args.async_migration)
         else:
             router = FleetRouter(make_engine, args.fleet,
                                  args.prefill_engines,
                                  metrics=router_metrics,
-                                 fleet_chaos=fleet_chaos)
+                                 fleet_chaos=fleet_chaos,
+                                 async_migration=args.async_migration)
         if fleet_kill is not None:
             router.schedule_kill(*fleet_kill)
         if args.deploy_round is not None:
@@ -421,7 +441,7 @@ def _fleet_main(args, prompts, cfg, policy, params, fleet_kill,
         controller = None
         if autoscale is not None:
             from .autoscale import AutoscaleController
-            if args.transport == "process":
+            if args.transport in ("process", "tcp"):
                 from .worker import spawn_worker
 
                 def _spawn(eid):
@@ -435,7 +455,8 @@ def _fleet_main(args, prompts, cfg, policy, params, fleet_kill,
                              else None),
                         metrics_dir=mdir,
                         meta={**worker_meta, "engine_id": eid,
-                              "role": "decode"})
+                              "role": "decode"},
+                        family=family)
             else:
                 from .fleet import EngineHandle
 
@@ -671,13 +692,15 @@ def generate_main(argv=None) -> int:
     # these flags).
     if not args.fleet and (args.prefill_engines or args.fleet_kill
                            or args.transport != "inproc"
+                           or args.async_migration
                            or args.fleet_chaos or args.deploy_dir
                            or args.deploy_round is not None
                            or args.deploy_step is not None
                            or args.deploy_watch is not None
                            or args.autoscale or args.watch):
         print("error: --prefill_engines/--fleet_kill/--transport/"
-              "--fleet_chaos/--deploy_*/--autoscale/--watch are "
+              "--async_migration/--fleet_chaos/--deploy_*/"
+              "--autoscale/--watch are "
               "fleet flags: pass --fleet N (N >= 2)", file=sys.stderr)
         return 2
     if args.autoscale and not trace_mode:
@@ -807,15 +830,23 @@ def generate_main(argv=None) -> int:
                 return 2
             kinds = {f.kind for f in fleet_chaos.faults}
             if (kinds - {"corrupt_deploy"}
-                    and args.transport != "process"):
+                    and args.transport not in ("process", "tcp")):
                 # worker faults need a boundary that can actually
                 # fail: a worker that can die/go silent, a wire file
                 # that can tear — in-process has neither
                 # (corrupt_deploy tears a CHECKPOINT file, a surface
                 # both transports share)
                 print("error: --fleet_chaos drills the process "
-                      "boundary: pass --transport process "
+                      "boundary: pass --transport process or tcp "
                       "(corrupt_deploy alone runs on either)",
+                      file=sys.stderr)
+                return 2
+            if (kinds & {"partition_worker", "drop_conn"}
+                    and args.transport != "tcp"):
+                # only the TCP transport carries a reconnect ladder
+                # to drill — an AF_UNIX EOF is an honest death
+                print("error: partition_worker/drop_conn drill the "
+                      "reconnect ladder: pass --transport tcp",
                       file=sys.stderr)
                 return 2
             if "corrupt_deploy" in kinds and args.deploy_round is None:
@@ -884,7 +915,7 @@ def generate_main(argv=None) -> int:
         # bits) — so building them here would just double peak host
         # memory for nothing
         params = None
-        if not (args.fleet and args.transport == "process"):
+        if not (args.fleet and args.transport in ("process", "tcp")):
             params = init_lm(jax.random.PRNGKey(args.random_seed),
                              args.vocab, args.model_size, args.layers,
                              max_seq_len=args.max_seq_len,

@@ -48,6 +48,51 @@ WORKER's death, and the router is the survivor) and are additionally
 published atomically in the worker's spool dir
 (``decode/supervise.py::write_snapshot`` via ``runtime/wire.py``) as
 the on-disk post-mortem record.
+
+Round 22 — the network boundary (DESIGN.md section 28). The newline-
+JSON protocol is socket-family-agnostic by construction; this round
+adds the robustness layer a real network demands:
+
+- **TCP transport** (``family="tcp"``): the worker binds
+  ``127.0.0.1:0`` BEFORE the jax import and atomically publishes the
+  bound port in its spool (``worker_port.json``); the router's
+  connect loop discovers it there. The accept loop re-accepts after a
+  dropped connection — on TCP, a broken connection is a fact of the
+  network, not a death certificate.
+
+- **Reconnect ladder + sequence-numbered replay**: a send/recv that
+  fails at the socket (reset, EOF, partition) triggers a bounded-
+  backoff reconnect (``failure.backoff_delay``) instead of an
+  immediate dead-host verdict. After reconnecting, the router
+  ``sync``s the worker's dedup state (``evict_horizon`` + cached
+  response ids) and replays its in-flight requests BY ORIGINAL ID:
+  the worker answers an already-executed id from its bounded response
+  cache (no duplicate side effects), executes a never-arrived id
+  fresh (no lost request), and refuses a non-idempotent id that fell
+  past the cache window (``replay_verdict`` — the per-op idempotency
+  audit in ``IDEMPOTENT_OPS``/``NON_IDEMPOTENT_OPS``). Only an
+  exhausted reconnect budget, a dead process, or a refused replay
+  escalates to ``TransportDead``. Per-call deadlines are untouched:
+  slow-link (deadline overrun on a live connection) and dead-host
+  (connection gone, reconnect exhausted) stay DIFFERENT verdicts.
+
+- **Length-prefixed wire side channel**: under TCP the spool dir is
+  (notionally) not shared, so handoff documents stream over the
+  socket itself — ``fetch_wire`` answers with a binary frame
+  (``runtime/wire.py`` framing) right after its JSON line, and
+  ``stage_bytes`` carries one the same way; CRC verification happens
+  at the receiving worker via the SAME ``deserialize_doc`` discipline
+  the spool path uses. The spool-file path remains the same-host
+  fast path under AF_UNIX.
+
+- **Async live migration ops**: ``export_keep`` ships a snapshot
+  while the source keeps decoding the sequence; ``stage``/
+  ``stage_bytes`` park the verified document on the target;
+  ``finish_export`` evicts at commit and returns the delta tokens;
+  ``commit_import`` patches the delta in and imports — the target
+  teacher-forces the catch-up (``DecodeEngine`` replay contract), so
+  the moving request pays one replay and the source engine never
+  stalls.
 """
 
 from __future__ import annotations
@@ -67,6 +112,9 @@ from .fleet import (HandoffRef, TransportDead, TransportError,
 WORKER_CONFIG_FILENAME = "worker_config.json"
 WORKER_SOCKET_FILENAME = "worker.sock"
 WORKER_LOG_FILENAME = "worker.log"
+# the TCP worker's atomically-published bound port (written via
+# wire.publish_json BEFORE the jax import, like the unix bind)
+WORKER_PORT_FILENAME = "worker_port.json"
 
 # per-call deadline defaults (seconds). The first step call after spawn
 # may compile XLA programs — its deadline must cover a cold compile;
@@ -79,6 +127,81 @@ DEFAULT_CONNECT_DEADLINE_S = 120.0
 # declared silent (failure.backoff_delay schedule, jitter off for
 # deterministic drills)
 DEFAULT_CALL_RETRIES = 1
+# reconnect ladder bounds (TCP family): how many times a dropped
+# connection may heal before it IS a dead-host verdict, and how long
+# one healing attempt may take (a chaos partition extends the window
+# by its own remaining duration — waiting out a partition is the
+# point, not a loophole)
+DEFAULT_MAX_RECONNECTS = 8
+DEFAULT_RECONNECT_DEADLINE_S = 30.0
+
+# ------------------------------------------ protocol idempotency audit
+#
+# Round 22: after a reconnect the router replays its in-flight
+# requests by original id. A replayed id the worker already executed
+# is answered from its bounded response cache — but when the cached
+# response has been EVICTED, re-execution is the only option, and
+# re-execution is only safe for ops that leave the same state when run
+# twice. This table is the audit: every protocol op is classified, the
+# serve loop and the router's replay_verdict() both consult it, and
+# tests/test_worker_protocol.py pins that the two sets exactly cover
+# the dispatch table.
+#
+# Idempotent = repeating the op against the post-execution state
+# yields the same state and an equivalent response: pure reads (ping,
+# meta, digest, probe, stats, results, sync), the throttled snapshot
+# publish, compile warming, absolute-value writes (set_version), and
+# the staging ops (staging the same verified document twice, or
+# discarding an already-discarded stage, converges).
+IDEMPOTENT_OPS = frozenset({
+    "ping", "meta", "digest", "snapshot", "probe", "warm", "results",
+    "stats", "sync", "fetch_wire", "set_version", "stage",
+    "stage_bytes", "discard_stage",
+})
+# Non-idempotent = re-execution duplicates a side effect or fails
+# against the state the first execution left: admissions (submit /
+# resume / commit_import — uid-already-in-use on repeat), evictions
+# (release / export / finish_export / import), engine steps, the
+# telemetry-emitting ops, the chaos ops, shutdown, and load_weights
+# (state-convergent but a full checkpoint restore is not a "harmless"
+# repeat — the dedup cache answers it instead).
+NON_IDEMPOTENT_OPS = frozenset({
+    "submit", "resume", "release", "load_weights", "step", "export",
+    "export_keep", "finish_export", "import", "commit_import",
+    "emit_decode", "hang", "shutdown",
+})
+WORKER_OPS = IDEMPOTENT_OPS | NON_IDEMPOTENT_OPS
+
+# how many responses the worker keeps for replay dedup — deep enough
+# that any in-flight window (a handful of concurrent calls) replays
+# from cache; only an id older than 256 completed calls can fall off
+RESPONSE_CACHE_DEPTH = 256
+
+
+def replay_verdict(op: str, rid: int, horizon: int, cached) -> str:
+    """The router-side replay decision for one in-flight request after
+    a reconnect, against the worker's synced dedup state
+    (``horizon`` = highest response id evicted from its cache,
+    ``cached`` = ids still held). Returns:
+
+    - ``"cached"``  — the worker executed it and still holds the
+      response: re-send the id, the worker answers from cache (no
+      re-execution, no duplicate side effects).
+    - ``"resend"``  — either the request never reached execution
+      (``rid > horizon`` and not cached ⇒ provably never ran, any op
+      is safe) or the op is idempotent (re-execution converges).
+    - ``"refuse"``  — a non-idempotent op whose response fell past
+      the dedup window: it MAY have executed and re-execution is not
+      safe, so the only honest verdict is ``TransportDead`` (the
+      snapshot-replay recovery path restores correctness).
+    """
+    if rid in cached:
+        return "cached"
+    if rid > horizon:
+        return "resend"
+    if op in IDEMPOTENT_OPS:
+        return "resend"
+    return "refuse"
 
 
 # ---------------------------------------------------------------- worker
@@ -96,11 +219,28 @@ def worker_main(argv=None) -> int:
     # bind BEFORE the heavy jax import: the router's connect loop gets
     # a listening socket (slow accept) instead of minutes of refusals
     sock_path = cfg["socket_path"]
-    if os.path.exists(sock_path):
-        os.unlink(sock_path)
-    server = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-    server.bind(sock_path)
-    server.listen(1)
+    family = cfg.get("family", "unix")
+    if family == "tcp":
+        # multi-host transport: bind an ephemeral loopback port and
+        # atomically publish it where the router's connect loop looks
+        # (a torn port file must be impossible — publish_json's
+        # tmp+fsync+rename discipline)
+        server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        server.bind((cfg.get("tcp_host", "127.0.0.1"),
+                     int(cfg.get("tcp_port", 0))))
+        server.listen(1)
+        from ..runtime.wire import publish_json
+        os.makedirs(cfg["spool_dir"], exist_ok=True)
+        publish_json(os.path.join(cfg["spool_dir"],
+                                  WORKER_PORT_FILENAME),
+                     {"port": server.getsockname()[1]})
+    else:
+        if os.path.exists(sock_path):
+            os.unlink(sock_path)
+        server = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        server.bind(sock_path)
+        server.listen(1)
 
     import jax
 
@@ -141,10 +281,23 @@ def worker_main(argv=None) -> int:
                                                         "decode"),
                       wire_dir=spool)
     last_publish_t = 0.0
+    # reconnect dedup state (round 22): every executed response is
+    # cached (bounded) keyed by request id; evict_horizon is the
+    # highest id whose response fell off — the line between "answer a
+    # replay from cache" and "refuse a non-idempotent replay"
+    resp_cache: "collections.OrderedDict[int, tuple[bytes, bytes | None]]" \
+        = collections.OrderedDict()
+    evict_horizon = -1
 
-    def handle(req: dict) -> dict:
+    def handle(req: dict, blob_in: bytes | None) -> dict:
         nonlocal last_publish_t
         op = req["op"]
+        if op == "sync":
+            # the reconnect handshake: hand the router this worker's
+            # dedup state so replay_verdict() can classify every
+            # in-flight request before resending it
+            return {"horizon": evict_horizon,
+                    "cached": sorted(resp_cache)}
         if op == "ping":
             return {}
         if op == "meta":
@@ -221,11 +374,66 @@ def worker_main(argv=None) -> int:
                     "position": ref.position,
                     "blocks_written": ref.blocks_written,
                     "digest": hd.digest()}
+        if op == "export_keep":
+            # async migration ship-half: snapshot the sequence to the
+            # wire WITHOUT evicting — this worker keeps decoding it
+            # while the document crosses; finish_export settles up
+            ref = hd.export(req["uid"], keep=True)
+            return {"path": ref.path,
+                    "position": ref.position,
+                    "blocks_written": ref.blocks_written,
+                    "digest": hd.digest()}
+        if op == "finish_export":
+            # async migration commit-half: evict now and return the
+            # full token list (the shipped snapshot + everything
+            # decoded during the ship window — the delta the target
+            # teacher-forces), or the abort status if the request
+            # finished/failed/was preempted mid-ship
+            return {"delta": hd.finish_export(req["uid"]),
+                    "digest": hd.digest()}
         if op == "import":
             info = hd.import_doc(HandoffRef(
                 -1, 0, 0, path=req["path"]))    # raises WireError
             return {"bytes": info["bytes"],
                     "crc_verify_s": info["crc_verify_s"],
+                    "digest": hd.digest()}
+        if op == "fetch_wire":
+            # TCP side channel, source side: read a published wire
+            # file out of THIS worker's spool and answer it as a
+            # binary frame right after the JSON line. Confined to the
+            # spool — the protocol must not be a remote file reader.
+            path = os.path.realpath(req["path"])
+            if not path.startswith(os.path.realpath(spool) + os.sep):
+                raise ValueError(f"fetch_wire path {req['path']!r} "
+                                 "escapes the worker spool")
+            try:
+                with open(path, "rb") as f:
+                    data = f.read()
+            except OSError as e:
+                raise ValueError(f"wire doc unreadable: "
+                                 f"{type(e).__name__}: {e}") from None
+            return {"_blob": data, "nbytes": len(data)}
+        if op == "stage":
+            # same-host staging: read + CRC-verify the wire file NOW
+            # (a corrupt document must be rejected at stage time, not
+            # at commit) and park the verified doc for commit_import
+            info = hd.stage_ref(HandoffRef(-1, 0, 0,
+                                           path=req["path"]))
+            return {**info, "digest": hd.digest()}
+        if op == "stage_bytes":
+            # TCP staging: the frame after the request line IS the
+            # wire doc; deserialize_doc runs the same CRC discipline
+            # the spool path gets
+            info = hd.stage_bytes(blob_in or b"")
+            return {**info, "digest": hd.digest()}
+        if op == "commit_import":
+            info = hd.commit_import(req["uid"], out=req.get("out"))
+            return {"bytes": info["bytes"],
+                    "crc_verify_s": info["crc_verify_s"],
+                    "catchup_tokens": info["catchup_tokens"],
+                    "digest": hd.digest()}
+        if op == "discard_stage":
+            return {"had": hd.discard_stage(req["uid"]),
                     "digest": hd.digest()}
         if op == "results":
             return {"finished": {str(u): t
@@ -247,51 +455,150 @@ def worker_main(argv=None) -> int:
             return {"_shutdown": True}
         raise ValueError(f"unknown worker op {op!r}")
 
-    conn, _ = server.accept()
-    rfile = conn.makefile("rb")
-    try:
-        for line in rfile:
-            if not line.strip():
-                continue
-            req = json.loads(line)
-            rid = req.get("id")
-            # worker-side handle duration rides EVERY response (the
-            # digest piggyback stance: zero extra round-trips) — the
-            # router subtracts it from its own call wall clock to get
-            # the pure RPC overhead (socket + JSON marshal), the
-            # round-18 transport attribution
-            t0 = time.perf_counter()
+    from ..runtime import wire as wire_mod
+
+    def serve(conn: socket.socket) -> bool:
+        """One connection's request loop. Returns True only on a clean
+        shutdown op; False means the peer dropped — the accept loop
+        re-accepts (on a real network a broken connection is a retry,
+        not a death)."""
+        nonlocal evict_horizon
+        rfile = conn.makefile("rb")
+        try:
+            while True:
+                try:
+                    line = rfile.readline()
+                except OSError:
+                    return False
+                if not line:
+                    return False        # EOF: peer gone, re-accept
+                if not line.strip():
+                    continue
+                req = json.loads(line)
+                rid = req.get("id")
+                # binary request payload: a length-prefixed frame
+                # rides the stream right after the JSON line. Read it
+                # BEFORE the dedup check — a replayed stage_bytes
+                # re-sends its frame too, and leaving it unread would
+                # desync the stream into garbage JSON.
+                blob_in = None
+                if req.get("op") == "stage_bytes":
+                    try:
+                        prefix = rfile.read(wire_mod.FRAME_PREFIX_LEN)
+                        n = wire_mod.unpack_frame_len(prefix)
+                        blob_in = rfile.read(n)
+                    except (OSError, WireError):
+                        return False    # torn mid-frame: re-accept
+                    if len(blob_in) != n:
+                        # the request never fully arrived — do NOT
+                        # execute or advance dedup state; the peer
+                        # replays it on the healed connection
+                        return False
+                # sequence-numbered dedup: an id we already answered
+                # replays its CACHED response — the replayed request
+                # must not re-execute (exactly-once side effects)
+                if rid is not None and rid in resp_cache:
+                    payload, frame = resp_cache[rid]
+                    try:
+                        conn.sendall(payload if frame is None
+                                     else payload + frame)
+                    except OSError:
+                        return False
+                    continue
+                if (rid is not None and rid <= evict_horizon
+                        and req.get("op") not in IDEMPOTENT_OPS):
+                    # executed-and-evicted (or unknowable): refusing
+                    # is the only honest answer for a non-idempotent
+                    # op — the router escalates to TransportDead and
+                    # the snapshot-replay recovery restores state
+                    resp = {"id": rid, "ok": False,
+                            "error": (f"non-idempotent op "
+                                      f"{req.get('op')!r} replayed "
+                                      f"past the dedup window (id "
+                                      f"{rid} <= evict horizon "
+                                      f"{evict_horizon})"),
+                            "error_kind": "replay_unsafe",
+                            "handle_s": 0.0}
+                    try:
+                        conn.sendall((json.dumps(resp) + "\n")
+                                     .encode("utf-8"))
+                    except OSError:
+                        return False
+                    continue
+                # worker-side handle duration rides EVERY response
+                # (the digest piggyback stance: zero extra round-
+                # trips) — the router subtracts it from its own call
+                # wall clock to get the pure RPC overhead (socket +
+                # JSON marshal), the round-18 transport attribution
+                t0 = time.perf_counter()
+                blob_out = None
+                try:
+                    out = handle(req, blob_in)
+                    blob_out = out.pop("_blob", None)
+                    resp = {"id": rid, "ok": True, **out}
+                except AdmissionError as e:
+                    resp = {"id": rid, "ok": False, "error": str(e),
+                            "error_kind": "admission"}
+                except WireError as e:
+                    resp = {"id": rid, "ok": False, "error": str(e),
+                            "error_kind": "wire"}
+                except ValueError as e:
+                    resp = {"id": rid, "ok": False, "error": str(e),
+                            "error_kind": "value"}
+                except Exception as e:  # noqa: BLE001 — protocol boundary
+                    resp = {"id": rid, "ok": False,
+                            "error": f"{type(e).__name__}: {e}",
+                            "error_kind": "runtime"}
+                resp["handle_s"] = round(time.perf_counter() - t0, 6)
+                hang_s = resp.pop("_hang_after_reply_s", None)
+                done = resp.pop("_shutdown", False)
+                if blob_out is not None:
+                    resp["frame"] = True
+                payload = (json.dumps(resp) + "\n").encode("utf-8")
+                frame = (None if blob_out is None
+                         else wire_mod.pack_frame(blob_out))
+                if rid is not None:
+                    # cache AFTER execution, BEFORE the send: a
+                    # response lost to a dropped connection must
+                    # still be answerable on replay
+                    resp_cache[rid] = (payload, frame)
+                    while len(resp_cache) > RESPONSE_CACHE_DEPTH:
+                        old, _ = resp_cache.popitem(last=False)
+                        evict_horizon = max(evict_horizon, old)
+                try:
+                    conn.sendall(payload if frame is None
+                                 else payload + frame)
+                except OSError:
+                    return False        # response waits in the cache
+                if hang_s is not None:
+                    time.sleep(hang_s)
+                if done:
+                    return True
+        finally:
             try:
-                out = handle(req)
-                resp = {"id": rid, "ok": True, **out}
-            except AdmissionError as e:
-                resp = {"id": rid, "ok": False, "error": str(e),
-                        "error_kind": "admission"}
-            except WireError as e:
-                resp = {"id": rid, "ok": False, "error": str(e),
-                        "error_kind": "wire"}
-            except ValueError as e:
-                resp = {"id": rid, "ok": False, "error": str(e),
-                        "error_kind": "value"}
-            except Exception as e:  # noqa: BLE001 — protocol boundary
-                resp = {"id": rid, "ok": False,
-                        "error": f"{type(e).__name__}: {e}",
-                        "error_kind": "runtime"}
-            resp["handle_s"] = round(time.perf_counter() - t0, 6)
-            hang_s = resp.pop("_hang_after_reply_s", None)
-            done = resp.pop("_shutdown", False)
-            conn.sendall((json.dumps(resp) + "\n").encode("utf-8"))
-            if hang_s is not None:
-                time.sleep(hang_s)
+                rfile.close()
+            except OSError:
+                pass
+
+    try:
+        while True:
+            conn, _ = server.accept()
+            try:
+                done = serve(conn)
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
             if done:
                 break
     finally:
         if metrics is not None:
             metrics.close()
         try:
-            conn.close()
             server.close()
-            os.unlink(sock_path)
+            if family != "tcp":
+                os.unlink(sock_path)
         except OSError:
             pass
     return 0
@@ -309,7 +616,7 @@ class ProcessEngineHandle:
     transport = "process"
 
     def __init__(self, eid: str, role: str, spool_dir: str, proc,
-                 sock_path: str, *,
+                 sock_path: str, *, family: str = "unix",
                  call_deadline_s: float = DEFAULT_CALL_DEADLINE_S,
                  ping_deadline_s: float = DEFAULT_PING_DEADLINE_S,
                  call_retries: int = DEFAULT_CALL_RETRIES):
@@ -318,9 +625,31 @@ class ProcessEngineHandle:
         self.spool_dir = spool_dir
         self.proc = proc
         self.sock_path = sock_path
+        self.family = family
         self.call_deadline_s = call_deadline_s
         self.ping_deadline_s = ping_deadline_s
         self.call_retries = call_retries
+        # -- reconnect ladder (round 22) -- TCP gets a reconnect
+        # budget by default (a dropped connection is a retry, not a
+        # death); AF_UNIX keeps the round-16 semantics (EOF = dead)
+        # unless a test opts in by raising max_reconnects
+        self.max_reconnects = (DEFAULT_MAX_RECONNECTS
+                               if family == "tcp" else 0)
+        self.reconnect_deadline_s = DEFAULT_RECONNECT_DEADLINE_S
+        self.reconnects = 0
+        self.reconnect_log: "collections.deque" = collections.deque(
+            maxlen=16)
+        # router hook: called (handle, info) after every successful
+        # reconnect+replay — FleetRouter emits the schema-v16
+        # "reconnected" record from it
+        self.on_reconnect = None
+        # in-flight requests by id, exactly as sent (plus any binary
+        # frame) — the reconnect replay re-sends these verbatim
+        self._sent_req: dict[int, tuple[dict, bytes | None]] = {}
+        # -- network chaos hooks (runtime/chaos.py) --
+        self._partition_until = 0.0     # monotonic heal time
+        self.slow_link_s = 0.0          # injected per-send latency
+        self._drop_after_send = False   # mid-message RST armed
         self.alive = True
         self.snapshot: dict | None = None
         self.killed_at_round: int | None = None
@@ -362,8 +691,9 @@ class ProcessEngineHandle:
                 ) -> None:
         """Connect to the worker's socket, retrying refusals under
         bounded exponential backoff while it boots (jax import +
-        engine build). A worker that exits first raises
-        ``TransportDead`` with its log tail."""
+        engine build; under TCP also the not-yet-published port file).
+        A worker that exits first raises ``TransportDead`` with its
+        log tail."""
         from ..runtime.failure import backoff_delay
         t0 = time.monotonic()
         attempt = 0
@@ -373,9 +703,7 @@ class ProcessEngineHandle:
                     f"worker {self.id} exited rc {self.proc.returncode} "
                     f"before accepting: {self._log_tail()}")
             try:
-                s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-                s.connect(self.sock_path)
-                self._sock = s
+                self._sock = self._open_sock()
                 return
             except (FileNotFoundError, ConnectionRefusedError):
                 if time.monotonic() - t0 > deadline_s:
@@ -386,6 +714,30 @@ class ProcessEngineHandle:
                                          random.Random(0)))
                 attempt += 1
 
+    def _open_sock(self) -> socket.socket:
+        """One raw connect attempt on the configured family. TCP
+        resolves the worker's atomically-published port file each
+        attempt (a restarted worker republished a fresh port)."""
+        if self.family == "tcp":
+            path = os.path.join(self.spool_dir, WORKER_PORT_FILENAME)
+            with open(path) as f:     # FileNotFoundError: still booting
+                port = int(json.load(f)["port"])
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            try:
+                s.connect(("127.0.0.1", port))
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                s.close()
+                raise
+            return s
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        try:
+            s.connect(self.sock_path)
+        except OSError:
+            s.close()
+            raise
+        return s
+
     def _log_tail(self, n: int = 400) -> str:
         try:
             with open(os.path.join(self.spool_dir,
@@ -394,24 +746,203 @@ class ProcessEngineHandle:
         except OSError:
             return "(no worker log)"
 
-    def _send(self, req: dict) -> int:
+    def _teardown_sock(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+        # partial lines/frames from the torn stream are garbage on
+        # the healed one — the replay re-delivers complete responses
+        self._buf = b""
+
+    def _conn_lost(self, err) -> None:
+        """A CONNECTION-level failure (send error, recv error, EOF) —
+        the round-22 fork in the liveness ladder. A dead process or an
+        exhausted reconnect budget escalates to ``TransportDead``;
+        otherwise the ladder reconnects and replays, and the caller
+        carries on against the healed link. Deadline overruns never
+        come here — slow-link stays ``TransportTimeout``."""
+        if self.proc.poll() is not None:
+            raise TransportDead(
+                f"worker {self.id} closed its connection (process "
+                f"exited rc {self.proc.returncode}): "
+                f"{self._log_tail()}")
+        if self.reconnects >= self.max_reconnects:
+            raise TransportDead(
+                f"worker {self.id} connection failed "
+                f"({type(err).__name__}: {err}) with no reconnect "
+                f"budget left ({self.reconnects}/"
+                f"{self.max_reconnects})")
+        self._reconnect(err)
+
+    def _reconnect(self, cause) -> None:
+        """Heal a dropped connection: bounded-backoff re-connect
+        (waiting out any armed chaos partition), then the ``sync``
+        handshake and a sequence-numbered replay of every in-flight
+        request by original id — the worker answers executed ids from
+        its dedup cache, executes never-arrived ids fresh, and a
+        non-idempotent id past the cache window is refused here as
+        ``TransportDead`` (see ``replay_verdict``)."""
+        from ..runtime.failure import backoff_delay
+        t_gone = time.monotonic()
+        self._teardown_sock()
+        # an armed partition extends the window by its remaining
+        # duration: waiting the partition out is the drill's point
+        deadline = self.reconnect_deadline_s + max(
+            0.0, self._partition_until - t_gone)
+        attempt = 0
+        while True:
+            if self.proc.poll() is not None:
+                raise TransportDead(
+                    f"worker {self.id} died during reconnect "
+                    f"(rc {self.proc.returncode}): {self._log_tail()}")
+            now = time.monotonic()
+            if now - t_gone > deadline:
+                raise TransportDead(
+                    f"worker {self.id} reconnect deadline "
+                    f"({deadline:.1f}s) exhausted after "
+                    f"{type(cause).__name__}: {cause}")
+            if now < self._partition_until:
+                # the link is partitioned BOTH ways: no connect can
+                # succeed before the heal time
+                time.sleep(min(0.05, self._partition_until - now))
+                continue
+            try:
+                self._sock = self._open_sock()
+                break
+            except OSError:
+                delay = backoff_delay(attempt, 0.05, 1.0, 0.0,
+                                      random.Random(0))
+                self.backoff_log.append({"t": time.time(),
+                                         "attempt": attempt,
+                                         "backoff_s": round(delay, 3),
+                                         "deadline_s": round(deadline,
+                                                             3),
+                                         "phase": "reconnect"})
+                time.sleep(delay)
+                attempt += 1
+        sync = self._sync_call()
+        horizon, cached = int(sync["horizon"]), set(sync["cached"])
+        replayed = []
+        for rid in sorted(self._sent_req):
+            req, frame = self._sent_req[rid]
+            verdict = replay_verdict(req.get("op", "?"), rid, horizon,
+                                     cached)
+            if verdict == "refuse":
+                raise TransportDead(
+                    f"worker {self.id}: non-idempotent op "
+                    f"{req.get('op')!r} (id {rid}) lost past the "
+                    "dedup window — refusing replay without a "
+                    "sequence ack")
+            payload = (json.dumps(req) + "\n").encode("utf-8")
+            if frame is not None:
+                payload += frame
+            try:
+                self._sock.sendall(payload)
+            except OSError as e:
+                raise TransportDead(
+                    f"worker {self.id} reconnect replay failed: "
+                    f"{type(e).__name__}: {e}") from None
+            replayed.append({"id": rid, "op": req.get("op"),
+                             "verdict": verdict})
+        self.reconnects += 1
+        info = {"attempts": attempt + 1,
+                "gap_s": round(time.monotonic() - t_gone, 4),
+                "cause": f"{type(cause).__name__}: {cause}",
+                "replayed": replayed}
+        self.reconnect_log.append({"t": time.time(), **info})
+        if self.on_reconnect is not None:
+            self.on_reconnect(self, info)
+
+    def _sync_call(self, deadline_s: float = 10.0) -> dict:
+        """The reconnect handshake, OUTSIDE the replay bookkeeping (it
+        must not itself be replayed). A second failure here is an
+        honest dead-host verdict — the link dropped twice inside one
+        healing attempt."""
         self._next_id += 1
-        req = {**req, "id": self._next_id}
+        rid = self._next_id
+        try:
+            self._sock.sendall(
+                (json.dumps({"op": "sync", "id": rid}) + "\n")
+                .encode("utf-8"))
+        except OSError as e:
+            raise TransportDead(f"worker {self.id} sync send failed: "
+                                f"{type(e).__name__}: {e}") from None
+        end = time.monotonic() + deadline_s
+        while True:
+            if b"\n" in self._buf:
+                line, self._buf = self._buf.split(b"\n", 1)
+                resp = json.loads(line)
+                if resp.get("id") == rid:
+                    return resp
+                continue    # stale pre-replay noise: impossible on a
+                # fresh connection, but skipping is strictly safer
+            remaining = end - time.monotonic()
+            if remaining <= 0:
+                raise TransportDead(
+                    f"worker {self.id} sync handshake timed out "
+                    f"({deadline_s:.1f}s)")
+            self._sock.settimeout(remaining)
+            try:
+                chunk = self._sock.recv(1 << 16)
+            except socket.timeout:
+                continue
+            except OSError as e:
+                raise TransportDead(
+                    f"worker {self.id} sync recv failed: "
+                    f"{type(e).__name__}: {e}") from None
+            if not chunk:
+                raise TransportDead(
+                    f"worker {self.id} closed during sync handshake")
+            self._buf += chunk
+
+    def _send(self, req: dict, frame: bytes | None = None) -> int:
+        self._next_id += 1
+        # capture the id NOW: a send that trips the reconnect ladder
+        # runs the sync handshake, which takes the NEXT id off this
+        # counter — returning self._next_id after _send_wire would
+        # hand the caller the sync's id and strand the real response
+        rid = self._next_id
+        req = {**req, "id": rid}
         # stamp the send BEFORE the marshal+sendall so the call
         # duration prices the full router-side cost of the op
-        self._sent[self._next_id] = (req.get("op", "?"),
-                                     time.perf_counter())
+        self._sent[rid] = (req.get("op", "?"), time.perf_counter())
+        # replay store: the request exactly as sent, until its
+        # response is parsed off the stream
+        self._sent_req[rid] = (req, frame)
+        self._send_wire(req, frame)
+        return rid
+
+    def _send_wire(self, req: dict, frame: bytes | None) -> None:
+        payload = (json.dumps(req) + "\n").encode("utf-8")
+        if frame is not None:
+            payload += frame
+        if self.slow_link_s > 0:
+            time.sleep(self.slow_link_s)  # chaos: injected link latency
         try:
-            self._sock.sendall((json.dumps(req) + "\n").encode("utf-8"))
+            if self._sock is None:
+                raise OSError("connection is down")
+            self._sock.sendall(payload)
         except OSError as e:
-            raise TransportDead(f"worker {self.id} send failed: "
-                                f"{type(e).__name__}: {e}") from None
-        return self._next_id
+            # the request is already in the replay store: a
+            # successful reconnect re-sends it, so returning here
+            # means "sent on the healed link"
+            self._conn_lost(e)
+            return
+        if self._drop_after_send:
+            # drop_conn chaos: tear the connection with the response
+            # in flight — the canonical mid-message RST
+            self._drop_after_send = False
+            self._teardown_sock()
 
     def _recv_line(self, deadline_s: float) -> bytes:
         """One newline-framed response within ``deadline_s``, with
         bounded-backoff retries absorbing transient slowness before the
-        silent-worker verdict."""
+        silent-worker verdict. Connection failures fork to the
+        reconnect ladder (``_conn_lost``) — a healed link restarts the
+        deadline window; deadline overruns stay ``TransportTimeout``."""
         from ..runtime.failure import backoff_delay
         for attempt in range(self.call_retries + 1):
             end = time.monotonic() + deadline_s
@@ -419,22 +950,24 @@ class ProcessEngineHandle:
                 remaining = end - time.monotonic()
                 if remaining <= 0:
                     break
+                if self._sock is None:
+                    self._conn_lost(OSError("connection is down"))
+                    end = time.monotonic() + deadline_s
+                    continue
                 self._sock.settimeout(remaining)
                 try:
                     chunk = self._sock.recv(1 << 16)
                 except socket.timeout:
                     break
                 except OSError as e:
-                    raise TransportDead(
-                        f"worker {self.id} connection failed: "
-                        f"{type(e).__name__}: {e}") from None
+                    self._conn_lost(e)
+                    end = time.monotonic() + deadline_s
+                    continue
                 if not chunk:
-                    state = ("exited rc %s" % self.proc.returncode
-                             if self.proc.poll() is not None
-                             else "still running")
-                    raise TransportDead(
-                        f"worker {self.id} closed its connection "
-                        f"(process {state}): {self._log_tail()}")
+                    self._conn_lost(
+                        EOFError("worker closed its connection"))
+                    end = time.monotonic() + deadline_s
+                    continue
                 self._buf += chunk
             if b"\n" in self._buf:
                 line, self._buf = self._buf.split(b"\n", 1)
@@ -453,9 +986,53 @@ class ProcessEngineHandle:
             f"deadline ({self.call_retries + 1} attempt(s) with "
             "backoff)")
 
+    def _recv_exact(self, n: int, deadline_s: float) -> bytes | None:
+        """``n`` raw bytes off the stream (a binary frame). Returns
+        None when the connection tore mid-frame and the ladder
+        reconnected — the replayed request delivers a fresh complete
+        response, so the caller discards this torn one."""
+        end = time.monotonic() + deadline_s
+        out = bytearray()
+        while len(out) < n:
+            if self._buf:
+                take = min(n - len(out), len(self._buf))
+                out += self._buf[:take]
+                self._buf = self._buf[take:]
+                continue
+            remaining = end - time.monotonic()
+            if remaining <= 0:
+                raise TransportTimeout(
+                    f"worker {self.id} silent mid-frame past its "
+                    f"{deadline_s:.1f}s deadline")
+            if self._sock is None:
+                self._conn_lost(OSError("connection is down"))
+                return None
+            self._sock.settimeout(remaining)
+            try:
+                chunk = self._sock.recv(1 << 16)
+            except socket.timeout:
+                continue
+            except OSError as e:
+                self._conn_lost(e)
+                return None
+            if not chunk:
+                self._conn_lost(EOFError("worker closed mid-frame"))
+                return None
+            self._buf += chunk
+        return bytes(out)
+
+    def _recv_frame(self, deadline_s: float) -> bytes | None:
+        from ..runtime import wire as wire_mod
+        prefix = self._recv_exact(wire_mod.FRAME_PREFIX_LEN,
+                                  deadline_s)
+        if prefix is None:
+            return None
+        return self._recv_exact(wire_mod.unpack_frame_len(prefix),
+                                deadline_s)
+
     def _call(self, op: str, deadline_s: float | None = None,
-              **payload) -> dict:
-        rid = self._send({"op": op, **payload})
+              frame: bytes | None = None, **payload) -> dict:
+        rid = self._send({"op": op, **payload}, frame=frame)
         return self._await(rid, deadline_s)
 
     def _await(self, rid: int, deadline_s: float | None = None) -> dict:
@@ -463,11 +1040,20 @@ class ProcessEngineHandle:
                     else deadline_s)
         while rid not in self._resp_buf:
             resp = json.loads(self._recv_line(deadline))
+            if resp.get("frame"):
+                # a binary frame rides right after this line — it
+                # MUST come off the stream before the next readline
+                blob = self._recv_frame(deadline)
+                if blob is None:
+                    continue  # torn mid-frame: the replay re-delivers
+                resp["_blob"] = blob
             # receive time stamped at PARSE, not at consume: a parked
             # response's call duration must not be charged for the
             # interleaved work that delayed its pop
             self._recv_t[resp.get("id")] = time.perf_counter()
             self._resp_buf[resp.get("id")] = resp
+            # answered ⇒ no longer in flight ⇒ out of the replay store
+            self._sent_req.pop(resp.get("id"), None)
         resp = self._resp_buf.pop(rid)
         sent = self._sent.pop(rid, None)
         recv_t = self._recv_t.pop(rid, None)
@@ -506,6 +1092,10 @@ class ProcessEngineHandle:
             raise WireError(msg)
         if kind == "value":
             raise ValueError(msg)
+        if kind == "replay_unsafe":
+            # the worker itself refused a non-idempotent replay — the
+            # same dead-host verdict the router-side refusal takes
+            raise TransportDead(msg)
         raise RuntimeError(msg)
 
     # -- the driver API (EngineHandle's surface) -----------------------
@@ -616,6 +1206,59 @@ class ProcessEngineHandle:
         return {"mode": "wire", "bytes": int(resp["bytes"]),
                 "crc_verify_s": resp["crc_verify_s"]}
 
+    # -- async migration + TCP side channel (round 22) -----------------
+
+    def export_keep(self, uid: int) -> HandoffRef:
+        """Ship-half of an async migration: snapshot ``uid`` to the
+        wire WITHOUT evicting — the worker keeps decoding it."""
+        resp = self._call("export_keep", uid=int(uid))
+        return HandoffRef(uid, int(resp["position"]),
+                          int(resp["blocks_written"]),
+                          path=resp["path"])
+
+    def finish_export(self, uid: int) -> dict:
+        """Commit-half: evict ``uid`` now and return its final token
+        list (``{"status": "resident", "out": [...], "position": n}``)
+        — or the abort status when the request finished/failed/was
+        preempted during the ship window."""
+        return self._call("finish_export", uid=int(uid))["delta"]
+
+    def fetch_wire(self, path: str) -> bytes:
+        """Pull a published wire file off THIS worker's spool as raw
+        bytes (the TCP streaming side channel's source half)."""
+        return self._call("fetch_wire", path=path)["_blob"]
+
+    def stage_ref(self, ref: HandoffRef) -> dict:
+        """Same-host staging: the target worker reads + CRC-verifies
+        the spool file now and parks the doc for ``commit_import``."""
+        resp = self._call("stage", path=ref.path)
+        return {"uid": int(resp["uid"]), "mode": "wire",
+                "bytes": int(resp["bytes"]),
+                "crc_verify_s": resp["crc_verify_s"]}
+
+    def stage_bytes(self, data: bytes) -> dict:
+        """TCP staging: stream the wire doc over the socket as a
+        length-prefixed frame; the worker CRC-verifies on arrival."""
+        from ..runtime.wire import pack_frame
+        resp = self._call("stage_bytes", frame=pack_frame(data))
+        return {"uid": int(resp["uid"]), "mode": "tcp",
+                "bytes": int(resp["bytes"]),
+                "crc_verify_s": resp["crc_verify_s"]}
+
+    def commit_import(self, uid: int, out=None) -> dict:
+        """Import the staged doc; ``out`` (when given) patches the
+        token list to the source's final one first — the engine
+        teacher-forces the delta (the catch-up replay)."""
+        resp = self._call("commit_import", uid=int(uid),
+                          out=(None if out is None
+                               else [int(t) for t in out]))
+        return {"bytes": int(resp["bytes"]),
+                "crc_verify_s": resp["crc_verify_s"],
+                "catchup_tokens": int(resp["catchup_tokens"])}
+
+    def discard_stage(self, uid: int) -> bool:
+        return bool(self._call("discard_stage", uid=int(uid))["had"])
+
     def _results_resp(self) -> dict:
         """One 'results' round-trip serves both results() and
         failed_map() (the drain path calls them back to back; the op
@@ -698,6 +1341,9 @@ class ProcessEngineHandle:
         pings = self.op_samples.get("ping") or ()
         return {
             "transport": self.transport,
+            "family": self.family,
+            "reconnects": self.reconnects,
+            "reconnect_log": list(self.reconnect_log),
             "alive": self.alive,
             "pid": self.proc.pid,
             "process_rc": self.proc.poll(),
@@ -732,6 +1378,29 @@ class ProcessEngineHandle:
         """Chaos: tell the worker to go silent for ``secs`` right after
         acknowledging — its next real call must trip the deadline."""
         self._call("hang", secs=float(secs))
+
+    # -- network chaos (round 22, runtime/chaos.py) --------------------
+
+    def partition(self, secs: float) -> None:
+        """Chaos: drop the link BOTH ways for ``secs`` — the socket
+        closes now, and no reconnect can complete before the heal
+        time; the ladder waits the partition out instead of declaring
+        death."""
+        self._partition_until = time.monotonic() + float(secs)
+        self._teardown_sock()
+
+    def slow_link(self, ms: float) -> None:
+        """Chaos: inject ``ms`` of latency ahead of every send — a
+        SLOW link, not a dead one; per-call deadlines must absorb it
+        without paging the liveness ladder."""
+        self.slow_link_s = float(ms) / 1e3
+
+    def drop_conn(self) -> None:
+        """Chaos: arm a mid-message connection drop — the next send
+        tears the socket with the response in flight; the reconnect
+        replay must lose no response and duplicate no side effect
+        (the worker's dedup cache answers the replayed id)."""
+        self._drop_after_send = True
 
     def kill(self) -> None:
         """SIGKILL the worker process — a real dead host. Idempotent;
@@ -780,14 +1449,16 @@ class ProcessEngineHandle:
 
 def _start_worker_proc(eid: str, role: str, base_dir: str, *,
                        model: dict, config: dict, policy: dict,
-                       qos: dict | None = None,
+                       qos: dict | None = None, family: str = "unix",
                        metrics_dir=None, meta=None, env=None):
     """Write one worker's config and start its process (detached; log
     in its spool). Returns ``(spool, proc, sock_path)`` — connection
     happens separately so a fleet can boot every jax import in
     parallel before the first (slow) connect. ``qos`` is an optional
     ``QosPolicy.as_dict()`` — the per-tenant scheduling policy rides
-    the config file, never the socket."""
+    the config file, never the socket. ``family`` picks the socket:
+    ``"unix"`` (spool-local, same-host) or ``"tcp"`` (loopback
+    ephemeral port, published atomically in the spool)."""
     spool = os.path.join(base_dir, eid)
     os.makedirs(spool, exist_ok=True)
     sock_path = os.path.join(spool, WORKER_SOCKET_FILENAME)
@@ -795,7 +1466,7 @@ def _start_worker_proc(eid: str, role: str, base_dir: str, *,
            "spool_dir": spool, "metrics_dir": metrics_dir,
            "meta": {**(meta or {}), "engine_id": eid, "role": role},
            "model": model, "config": config, "policy": policy,
-           "qos": qos}
+           "qos": qos, "family": family}
     cfg_path = os.path.join(spool, WORKER_CONFIG_FILENAME)
     with open(cfg_path, "w") as f:
         json.dump(cfg, f)
@@ -835,6 +1506,7 @@ def _connect_and_prime(h: ProcessEngineHandle, config: dict,
 
 def spawn_worker(eid: str, role: str, base_dir: str, *, model: dict,
                  config: dict, policy: dict, qos: dict | None = None,
+                 family: str = "unix",
                  metrics_dir=None, meta=None, env=None,
                  call_deadline_s: float = DEFAULT_CALL_DEADLINE_S,
                  ping_deadline_s: float = DEFAULT_PING_DEADLINE_S,
@@ -849,8 +1521,10 @@ def spawn_worker(eid: str, role: str, base_dir: str, *, model: dict,
     snapshots."""
     spool, proc, sock_path = _start_worker_proc(
         eid, role, base_dir, model=model, config=config, policy=policy,
-        qos=qos, metrics_dir=metrics_dir, meta=meta, env=env)
+        qos=qos, family=family, metrics_dir=metrics_dir, meta=meta,
+        env=env)
     h = ProcessEngineHandle(eid, role, spool, proc, sock_path,
+                            family=family,
                             call_deadline_s=call_deadline_s,
                             ping_deadline_s=ping_deadline_s)
     try:
@@ -864,6 +1538,7 @@ def spawn_worker(eid: str, role: str, base_dir: str, *, model: dict,
 def spawn_fleet_handles(n_engines: int, prefill_engines: int,
                         base_dir: str, *, model: dict, config: dict,
                         policy: dict, qos: dict | None = None,
+                        family: str = "unix",
                         metrics_root=None, meta=None, env=None,
                         call_deadline_s: float = DEFAULT_CALL_DEADLINE_S,
                         ping_deadline_s: float = DEFAULT_PING_DEADLINE_S,
@@ -887,12 +1562,13 @@ def spawn_fleet_handles(n_engines: int, prefill_engines: int,
                     if metrics_root else None)
             spool, proc, sock_path = _start_worker_proc(
                 eid, role, base_dir, model=model, config=config,
-                policy=policy, qos=qos, metrics_dir=mdir, meta=meta,
-                env=env)
+                policy=policy, qos=qos, family=family,
+                metrics_dir=mdir, meta=meta, env=env)
             procs.append((eid, role, spool, proc, sock_path))
         # phase 2: connect to each
         for eid, role, spool, proc, sock_path in procs:
             h = ProcessEngineHandle(eid, role, spool, proc, sock_path,
+                                    family=family,
                                     call_deadline_s=call_deadline_s,
                                     ping_deadline_s=ping_deadline_s)
             handles.append(h)

@@ -70,7 +70,14 @@ def render(doc: dict) -> str:
                 out.append(f"  {eid:4s} DEAD (killed at round "
                            f"{e.get('killed_at_round')})")
             continue
-        out.append(f"  {eid:4s} [{e.get('role')}] v"
+        # a worker-backed member names its socket family; a TCP link
+        # that has survived reconnects says so (round 22)
+        fam = ""
+        if e.get("family"):
+            fam = f" <{e['family']}>"
+            if e.get("reconnects"):
+                fam = f" <{e['family']}, {e['reconnects']} reconnect(s)>"
+        out.append(f"  {eid:4s} [{e.get('role')}]{fam} v"
                    f"{e.get('serving_version')}  waiting "
                    f"{e.get('waiting')}  active {e.get('active')}  "
                    f"free {e.get('free_blocks')} blk "
@@ -117,7 +124,9 @@ def render(doc: dict) -> str:
     c = doc.get("counters") or {}
     out.append("  counters: " + ", ".join(
         f"{k} {c.get(k)}" for k in ("routed", "handoffs", "migrations",
-                                    "sheds", "kills", "wire_rejects")))
+                                    "sheds", "kills", "wire_rejects")
+        ) + (f", reconnects {c['reconnects']}"
+             if c.get("reconnects") is not None else ""))
     d = doc.get("deploy") or {}
     out.append(f"  deploys: {d.get('deploys')} completed, "
                f"{d.get('rollbacks')} rolled back"

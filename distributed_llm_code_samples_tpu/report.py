@@ -1852,6 +1852,42 @@ def _is_timing_key(key: str) -> bool:
     return key in _TIMING_KEYS or key.endswith(_TIMING_SUFFIXES)
 
 
+# inside a record's nested ``transport`` attribution these keys name
+# HOW the bytes moved, not WHAT moved — two honest replays of one run
+# under different transports (inproc vs process vs tcp) legitimately
+# disagree on them while every pinned value (tokens, bytes, blocks,
+# positions) must still match
+_TRANSPORT_EQUIV_KEYS = {"mode"}
+
+
+def _transport_equiv(va, vb) -> bool:
+    """True when two ``transport`` values differ only by carrier: the
+    meta record's transport label (a string), or a migration record's
+    attribution dict differing only in ``mode`` and wall-clock
+    measurements (``crc_verify_s`` — the in-process mode honestly
+    reports None where a wire mode reports a verify wall). Any pinned
+    content key (``bytes``, ``retries``) must agree."""
+    if isinstance(va, str) and isinstance(vb, str):
+        return True
+    if not (isinstance(va, dict) and isinstance(vb, dict)):
+        return False
+    if va.keys() != vb.keys():
+        return False
+    return all(va[k] == vb[k] or k in _TRANSPORT_EQUIV_KEYS
+               or _is_timing_key(k) for k in va)
+
+
+def _is_benign_diff(key: str, ra: dict, rb: dict) -> bool:
+    """A differing key that does NOT break determinism: a wall-clock
+    measurement, or a transport attribution differing only by
+    carrier (the transport-mode-only class — two transports replaying
+    one trace token-identically)."""
+    if _is_timing_key(key):
+        return True
+    return key == "transport" and _transport_equiv(ra.get(key),
+                                                   rb.get(key))
+
+
 def load_diff_stream(metrics_dir: str,
                      kinds: tuple | None = None) -> list[dict]:
     """One side of a golden-stream diff: the dir's ``metrics.jsonl``
@@ -1879,7 +1915,8 @@ def diff_streams(a: list[dict], b: list[dict]) -> dict:
 
     - ``identical`` — byte-equivalent after envelope stripping;
     - ``timing-only`` — records align and every differing key is a
-      wall-clock measurement (two honest replays of one run);
+      wall-clock measurement or a transport-mode-only attribution
+      (two honest replays of one run — possibly on two transports);
     - ``token-divergence`` — a pinned content key differs, or one
       stream holds records the other lacks (THE determinism break);
     - ``schema-drift`` — aligned records disagree on kind/key-set/
@@ -1900,13 +1937,13 @@ def diff_streams(a: list[dict], b: list[dict]) -> dict:
                 ra.keys() ^ rb.keys())))
             continue
         keys = sorted(k for k in ra if ra[k] != rb[k])
-        if all(_is_timing_key(k) for k in keys):
+        if all(_is_benign_diff(k, ra, rb) for k in keys):
             first.setdefault("timing-only", (i, ra, rb, keys))
         else:
             first.setdefault("token-divergence",
                              (i, ra, rb,
                               [k for k in keys
-                               if not _is_timing_key(k)]))
+                               if not _is_benign_diff(k, ra, rb)]))
     if len(a) != len(b):
         i = min(len(a), len(b))
         first.setdefault("token-divergence",

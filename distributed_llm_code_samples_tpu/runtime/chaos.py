@@ -128,8 +128,26 @@ DECODE_KINDS = ("nan_logits", "hang_step", "corrupt_block", "kill")
 #   with a one-line named reason, the fleet must roll back to
 #   ``latest_verified_step`` — deploy aborted, no engine left serving
 #   a mixed version, nothing shed (decode/fleet.py rolling_deploy).
+# - ``partition_worker@ROUND[:SECS]`` (round 22) — the link to the
+#   first alive decode worker drops BOTH WAYS for SECS (default 2):
+#   the router's next call fails at the socket, the reconnect ladder
+#   (bounded backoff + sequence-numbered replay) waits out the
+#   partition and resumes on the healed link — zero declared deaths,
+#   one ``reconnected`` router record. Process transport only.
+# - ``slow_link@ROUND[:MS]`` (round 22) — every call to the first
+#   alive decode worker pays MS (default 50) of injected one-way
+#   latency from that round on: calls slow down but stay inside their
+#   deadline, so the liveness ladder must NOT page — slow-link and
+#   dead-host are different verdicts. Process transport only.
+# - ``drop_conn@ROUND`` (round 22) — the connection to the first alive
+#   decode worker is closed mid-message right after the next request
+#   is sent: the response is lost in flight, reconnect replays the
+#   sequence-numbered request, and the worker's dedup cache answers
+#   it without re-executing — no duplicate side effects, no lost
+#   response. Process transport only.
 FLEET_KINDS = ("kill_worker", "hang_worker", "corrupt_wire",
-               "corrupt_deploy")
+               "corrupt_deploy", "partition_worker", "slow_link",
+               "drop_conn")
 KINDS = IN_SEGMENT_KINDS + PUBLISH_KINDS + tuple(
     k for k in DECODE_KINDS if k not in PUBLISH_KINDS) + FLEET_KINDS
 
@@ -397,6 +415,21 @@ def validate_fleet_plan(plan: FaultPlan) -> None:
                 f"corrupt_deploy arg {f.arg!r} must be a truncation "
                 "fraction in (0, 1) (omit it for 0.5) — the torn "
                 "checkpoint the deploy's CRC ladder must reject")
+        if f.kind == "partition_worker" and f.arg is not None \
+                and f.arg < 0:
+            raise ValueError(
+                f"partition_worker arg {f.arg!r} must be a non-"
+                "negative partition duration in seconds (omit it "
+                "for 2)")
+        if f.kind == "slow_link" and f.arg is not None and f.arg < 0:
+            raise ValueError(
+                f"slow_link arg {f.arg!r} must be a non-negative "
+                "per-call latency in milliseconds (omit it for 50)")
+        if f.kind == "drop_conn" and f.arg is not None:
+            raise ValueError(
+                f"drop_conn takes no :ARG (got {f.arg!r}) — it drops "
+                "the connection mid-message once; reconnect-and-"
+                "replay decides the rest")
 
 
 def truncate_checkpoint(path: str, frac: float = 0.5) -> str:

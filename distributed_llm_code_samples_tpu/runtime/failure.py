@@ -48,9 +48,14 @@ def backoff_delay(attempt: int, base_s: float, max_s: float,
     """Jittered exponential backoff: ``base_s * 2^attempt`` capped at
     ``max_s``, scaled by ``uniform(1-j, 1+j)`` from the caller's seeded
     RNG — deterministic in tests, thundering-herd-safe in fleets.
-    Shared by the training supervisor below and the serving supervisor
-    (``decode/supervise.py``) so the two restart ladders cannot drift
-    on the schedule."""
+    Shared by the training supervisor below, the serving supervisor
+    (``decode/supervise.py``), and every transport ladder in
+    ``decode/worker.py`` (boot connect, timed-out recv retries, and
+    the round-22 reconnect state machine) so the restart and
+    reconnect schedules cannot drift apart. Bounds contract (pinned by
+    tests/test_failure.py): with jitter ``j`` the delay stays within
+    ``[(1-j) * min(base_s * 2^attempt, max_s), (1+j) * ...]``, and the
+    jitter-free schedule is monotone non-decreasing in ``attempt``."""
     b = min(base_s * (2 ** attempt), max_s)
     return b * (1.0 + jitter * (2.0 * rng.random() - 1.0))
 

@@ -187,7 +187,23 @@ import numpy as np
 # an explicitly declared wall-clock baseline), so the alert history of
 # a virtual-clock replay is byte-identical across replays and
 # transports, exactly like the autoscale/qos decision streams.
-SCHEMA_VERSION = 15
+# v16 (round 22): the network boundary (DESIGN.md section 28). The
+# router-record vocabulary gains the ``reconnected`` event — one
+# record per transport reconnect (the liveness ladder's non-death
+# verdict: a dropped connection that healed under bounded backoff and
+# sequence-numbered replay, with ``attempts`` / ``gap_s`` / the
+# replayed op list as extras and the anonymous uid -1 — a reconnect
+# belongs to the link, not a request). ``transport.mode`` on move
+# records gains "tcp" (a handoff streamed over the length-prefixed
+# TCP side channel, CRC-verified at the target). ``migrated`` records
+# now ALSO pin ``ship_s`` (the async-migration ship window: export to
+# commit wall clock; null on a sync or replay migration — nothing
+# overlapped) and ``catchup_tokens`` (tokens teacher-forced on the
+# target after arrival: the delta emitted during an async ship
+# window, the full replay length on a replay-migration, 0 on a sync
+# handoff) — the numbers behind the "a handoff costs the moving
+# request one replay, never a source-engine stall" contract.
+SCHEMA_VERSION = 16
 
 METRICS_FILENAME = "metrics.jsonl"
 
@@ -361,13 +377,22 @@ ROUTER_REQUIRED = ("step", "uid", "event", "source", "target", "policy",
 # renders any name, so a new decision kind is additive).
 # ``wire_rejected`` (v10): a handoff wire doc failed integrity checks
 # (reason = the one-line WireError) and the request was replay-rerouted
+# ``reconnected`` (v16): a dropped worker connection healed under the
+# reconnect ladder instead of becoming a dead-host declaration
 ROUTER_EVENTS = ("routed", "handoff", "migrated", "shed",
-                 "wire_rejected")
+                 "wire_rejected", "reconnected")
 
 # the extra keys a HANDOFF or MIGRATED router record must also carry
 # (v10) — the migration-stall + transport attribution, enforced
 # conditionally by validate_record (other router events move nothing)
 ROUTER_MOVE_REQUIRED = ("blocks", "bytes", "duration_s", "transport")
+
+# the extra keys a MIGRATED record must ALSO carry (v16) — the async-
+# migration contract: how long the snapshot shipped while the source
+# kept decoding (``ship_s``, null when nothing overlapped) and how
+# many tokens the target teacher-forced to catch up
+# (``catchup_tokens``) — enforced conditionally by validate_record
+ROUTER_MIGRATED_REQUIRED = ("ship_s", "catchup_tokens")
 
 # The routed-record policy vocabulary: session / prefix affinity,
 # least-loaded admission, or spill (the probed target shed and the
@@ -973,6 +998,13 @@ def validate_record(rec: Any) -> tuple[bool, str]:
         if missing:
             return False, (f"router record (event {rec['event']}) "
                            f"missing required key(s) {missing}")
+    if kind == "router" and rec.get("event") == "migrated":
+        # v16 conditional pin: every migration names its ship window
+        # and catch-up cost — the async-migration contract's numbers
+        missing = [k for k in ROUTER_MIGRATED_REQUIRED if k not in rec]
+        if missing:
+            return False, (f"router record (event migrated) missing "
+                           f"required key(s) {missing}")
     if kind == "deploy" and rec.get("event") in DEPLOY_EVENT_REQUIRED:
         # v11 conditional pins: only a swap names an engine, only a
         # terminal event measures a duration, only a rollback has a

@@ -256,3 +256,47 @@ def doc_wire_bytes(doc: dict) -> int:
     summed in-memory nbytes, undercounting scales + metadata and
     ignoring the container)."""
     return len(serialize_doc(doc))
+
+
+# ------------------------------------- length-prefixed stream framing
+#
+# The TCP side channel (round 22): the worker protocol's newline-JSON
+# control plane cannot carry the npz wire bytes (binary, embedded
+# newlines), so a handoff streamed over the SAME socket rides as a
+# length-prefixed binary frame immediately after the JSON line that
+# announces it. The frame is just the prefix — integrity stays with
+# the npz payload's own per-array CRC-32 (deserialize_doc verifies at
+# the receiving end, exactly as it does for a spool file), so the
+# framing layer never invents a second checksum discipline.
+
+# 8-byte big-endian unsigned length — one prefix, no magic, no flags
+# (version/identity live inside the npz header it frames)
+FRAME_PREFIX_LEN = 8
+# a frame larger than this is a protocol desync, not a handoff (the
+# largest real doc is a few MB of KV blocks) — reject before
+# allocating the claimed size
+MAX_FRAME_BYTES = 1 << 31
+
+
+def pack_frame(data: bytes) -> bytes:
+    """``data`` as one length-prefixed frame (prefix + payload)."""
+    if len(data) > MAX_FRAME_BYTES:
+        raise ValueError(f"frame of {len(data)} bytes exceeds the "
+                         f"{MAX_FRAME_BYTES}-byte cap")
+    return len(data).to_bytes(FRAME_PREFIX_LEN, "big") + data
+
+
+def unpack_frame_len(prefix: bytes) -> int:
+    """Decode a frame's length prefix; ``WireError`` on a short read
+    or an implausible length (protocol desync — the peer is not
+    speaking the frame discipline)."""
+    if len(prefix) != FRAME_PREFIX_LEN:
+        raise WireError(f"frame prefix truncated ({len(prefix)} of "
+                        f"{FRAME_PREFIX_LEN} bytes) — stream torn "
+                        "mid-frame")
+    n = int.from_bytes(prefix, "big")
+    if n > MAX_FRAME_BYTES:
+        raise WireError(f"frame length {n} exceeds the "
+                        f"{MAX_FRAME_BYTES}-byte cap — protocol "
+                        "desync, not a handoff")
+    return n

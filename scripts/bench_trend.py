@@ -63,6 +63,14 @@ POLICY_AUTOSCALE_NUMS = ("reaction_rounds", "scale_ups", "attainment")
 WATCH_REACTION_NUMS = ("kill_round", "fired_round", "reaction_rounds",
                        "fired", "resolved")
 
+# the round-22 multi-host transport rows (bench_decode.py
+# fleet_tcp_rows): per-op RPC overhead over TCP loopback, the
+# comparison lane vs AF_UNIX, and the migration stall p90 sync vs
+# async — emitted together by one bench function
+FLEET_TCP_VS_UNIX_NUMS = ("unix_p50_ms", "unix_p99_ms",
+                          "tcp_over_unix_p50")
+FLEET_TCP_STALL_LANES = ("sync", "async")
+
 
 def _round_of(path: str, prefix: str) -> str:
     return os.path.basename(path)[len(prefix):-len(".json")]
@@ -250,6 +258,51 @@ def _validate_watch_rows(name: str, payload: dict,
                                 "'identical'")
 
 
+def _validate_fleet_tcp_rows(name: str, payload: dict,
+                             problems: list) -> None:
+    """The fleet_tcp_* row contracts (DECODE artifacts from round 22
+    on; absence is fine — older rounds predate them). One bench
+    function emits the whole set, so a numeric headline without its
+    siblings is drift; an "error: ..." string is a recorded outage."""
+    head = payload.get("fleet_tcp_rpc_overhead_p50_ms")
+    if head is None:
+        return
+    if isinstance(head, str):
+        if not head.startswith("error:"):
+            problems.append(f"{name}: fleet_tcp_rpc_overhead_p50_ms "
+                            "is a string but not an 'error:' outage "
+                            "record")
+        return
+    for nk in ("fleet_tcp_rpc_overhead_p50_ms",
+               "fleet_tcp_rpc_overhead_p99_ms"):
+        v = payload.get(nk)
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            problems.append(f"{name}: {nk!r} is not a number")
+    vs = payload.get("fleet_tcp_rpc_vs_unix")
+    if not isinstance(vs, dict):
+        problems.append(f"{name}: fleet_tcp_rpc_vs_unix missing or "
+                        "not an object (the rows are emitted "
+                        "together)")
+    else:
+        for nk in FLEET_TCP_VS_UNIX_NUMS:
+            v = vs.get(nk)
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                problems.append(f"{name}: fleet_tcp_rpc_vs_unix "
+                                f"{nk!r} is not a number")
+    stall = payload.get("fleet_tcp_handoff_stall_p90_ms")
+    if not isinstance(stall, dict):
+        problems.append(f"{name}: fleet_tcp_handoff_stall_p90_ms "
+                        "missing or not an object (the rows are "
+                        "emitted together)")
+    else:
+        for lane in FLEET_TCP_STALL_LANES:
+            v = stall.get(lane)
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                problems.append(f"{name}: fleet_tcp_handoff_stall_"
+                                f"p90_ms lane {lane!r} is not a "
+                                "number")
+
+
 def validate_decode(path: str, problems: list) -> dict | None:
     """One DECODE_* artifact -> a trend row: headline keys + the
     workload_* row contracts when present."""
@@ -276,6 +329,7 @@ def validate_decode(path: str, problems: list) -> dict | None:
     _validate_workload_rows(name, doc, problems)
     _validate_policy_rows(name, doc, problems)
     _validate_watch_rows(name, doc, problems)
+    _validate_fleet_tcp_rows(name, doc, problems)
     if len(problems) > before:
         return None
     row = {"round": _round_of(path, "DECODE_"), "file": name,
@@ -294,6 +348,9 @@ def validate_decode(path: str, problems: list) -> dict | None:
     wr = doc.get("watch_reaction")
     if isinstance(wr, dict):
         row["watch_reaction_rounds"] = wr["reaction_rounds"]
+    ft = doc.get("fleet_tcp_handoff_stall_p90_ms")
+    if isinstance(ft, dict):
+        row["fleet_tcp_stall_p90_ms"] = dict(ft)
     return row
 
 

@@ -597,6 +597,99 @@ fi
 echo "PROCESS_SMOKE=OK"
 phase_done process_smoke
 
+echo "=== tcp-transport smoke ==="
+# The round-22 network-boundary drill (DESIGN.md section 28): the
+# SAME 3-worker fleet over TCP loopback (--transport tcp — reconnect
+# ladder + sequence-numbered replay, handoffs streamed over the
+# framed side channel) with the link to one worker PARTITIONED
+# mid-stream (partition_worker@4:2 — drops both ways, heals) and
+# another worker SIGKILLed under async live migration
+# (kill_worker@8:1 --async_migration). Tokens must be byte-identical
+# to the AF_UNIX oracle, the partition must cost a reconnect and
+# ZERO dead-host declarations (kills == the 1 scheduled SIGKILL, no
+# worker_dead events), the router stream must hold >=1 schema-v16
+# reconnected record, and `report --audit` over the streams must be
+# rc 0. Malformed --transport/chaos combinations must reject rc 2
+# with one stderr line.
+TCP_DIR=$(mktemp -d /tmp/tier1_tcp.XXXXXX)
+TCP_ARGS="--prompt_lens 3,7,5 --max_new 8 -d 32 -l 2 --heads 4
+  --vocab 64 --max_seq_len 64 --block_size 8 --prefill_chunk 4
+  --log_every 2"
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli generate $TCP_ARGS \
+    --fleet 3 --transport process > "$TCP_DIR/oracle.json"; then
+  echo "TCP_SMOKE=FAIL (AF_UNIX fleet oracle)"
+  rm -rf "$TCP_DIR"; exit 1
+fi
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli generate $TCP_ARGS \
+    --fleet 3 --transport tcp --async_migration \
+    --fleet_chaos partition_worker@4:2,kill_worker@8:1 \
+    --metrics_dir "$TCP_DIR/m" > "$TCP_DIR/tcp.json"; then
+  echo "TCP_SMOKE=FAIL (tcp chaos drill run)"; rm -rf "$TCP_DIR"
+  exit 1
+fi
+if ! timeout -k 10 60 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli report --audit \
+    "$TCP_DIR/m/router" "$TCP_DIR/m/e0" "$TCP_DIR/m/e1" \
+    "$TCP_DIR/m/e2" > "$TCP_DIR/audit.txt"; then
+  echo "TCP_SMOKE=FAIL (report --audit rc)"; rm -rf "$TCP_DIR"
+  exit 1
+fi
+if ! timeout -k 10 60 env JAX_PLATFORMS=cpu python - "$TCP_DIR" <<'EOF'
+import json, os, sys
+from distributed_llm_code_samples_tpu.runtime.telemetry import (
+    METRICS_FILENAME, read_metrics, validate_record)
+base = sys.argv[1]
+oracle = json.load(open(os.path.join(base, "oracle.json")))
+tcp = json.load(open(os.path.join(base, "tcp.json")))
+a = {s["uid"]: s["tokens"] for s in oracle["sequences"]}
+b = {s["uid"]: s["tokens"] for s in tcp["sequences"]}
+assert a == b, "tcp-fleet tokens != AF_UNIX fleet oracle"
+assert not tcp["failed"], tcp["failed"]
+assert tcp["transport"] == "tcp", tcp.get("transport")
+st = tcp["fleet"]
+# the partition healed: ONE kill (the scheduled SIGKILL), >=1
+# reconnect, zero dead-host declarations
+assert st["kills"] == 1 and st["reconnects"] >= 1, st
+assert st["engines"]["e1"]["alive"] is False, st["engines"]["e1"]
+records, problems = read_metrics(
+    os.path.join(base, "m", "router", METRICS_FILENAME))
+assert not problems, problems
+assert not [r for r in records
+            if r.get("event") == "worker_dead"], "false death"
+routers = [r for r in records if r["kind"] == "router"]
+assert routers and all(validate_record(r)[0] for r in routers)
+recon = [r for r in routers if r["event"] == "reconnected"]
+assert recon and all(r["schema"] == 16 for r in recon), routers
+migs = [r for r in routers if r["event"] == "migrated"]
+assert migs and all("ship_s" in r and "catchup_tokens" in r
+                    for r in migs), migs
+EOF
+then
+  echo "TCP_SMOKE=FAIL (token-identity/reconnect/schema check)"
+  rm -rf "$TCP_DIR"; exit 1
+fi
+# malformed --transport/chaos combinations: rc 2, one stderr line
+for BAD in \
+    "--fleet 3 --transport process --fleet_chaos partition_worker@4" \
+    "--fleet 3 --fleet_chaos drop_conn@3" \
+    "--fleet 3 --transport tcp --fleet_chaos slow_link@3:-5" \
+    "--transport tcp"; do
+  if timeout -k 10 60 env JAX_PLATFORMS=cpu python -m \
+      distributed_llm_code_samples_tpu.cli generate $TCP_ARGS $BAD \
+      > /dev/null 2> "$TCP_DIR/err.txt"; then
+    echo "TCP_SMOKE=FAIL (accepted: $BAD)"; rm -rf "$TCP_DIR"; exit 1
+  fi
+  if [ "$(wc -l < "$TCP_DIR/err.txt")" -ne 1 ]; then
+    echo "TCP_SMOKE=FAIL (not one stderr line: $BAD)"
+    cat "$TCP_DIR/err.txt"; rm -rf "$TCP_DIR"; exit 1
+  fi
+done
+rm -rf "$TCP_DIR"
+echo "TCP_SMOKE=OK"
+phase_done tcp_smoke
+
 echo "=== autoscale smoke ==="
 # The ISSUE 16 closed loop (DESIGN.md section 26): a bursty 2-tenant
 # trace through a 2-engine PROCESS fleet with kill_worker mid-burst —

@@ -477,6 +477,51 @@ def test_bench_trend_rejects_schema_drift(tmp_path):
     assert r.returncode == 0, r.stdout + r.stderr
     os.remove(os.path.join(root, "DECODE_r03.json"))
 
+    # r22 DECODE fleet_tcp rows: one bench function emits the set, so
+    # a numeric overhead headline without its stall sibling is drift,
+    # a non-numeric stall lane is drift, and a complete set passes;
+    # an "error:" string is a recorded outage
+    write("DECODE_r04x.json", {
+        "metric": "m", "value": 1.0, "unit": "tokens/s",
+        "fleet_tcp_rpc_overhead_p50_ms": 0.4,
+        "fleet_tcp_rpc_overhead_p99_ms": 1.2,
+        "fleet_tcp_rpc_vs_unix": {"unix_p50_ms": 0.3,
+                                  "unix_p99_ms": 0.9,
+                                  "tcp_over_unix_p50": 1.33}})
+    r = _run_trend(root)
+    assert r.returncode == 2
+    assert "DECODE_r04x.json" in r.stderr \
+        and "fleet_tcp_handoff_stall_p90_ms" in r.stderr
+    write("DECODE_r04x.json", {
+        "metric": "m", "value": 1.0, "unit": "tokens/s",
+        "fleet_tcp_rpc_overhead_p50_ms": 0.4,
+        "fleet_tcp_rpc_overhead_p99_ms": 1.2,
+        "fleet_tcp_rpc_vs_unix": {"unix_p50_ms": 0.3,
+                                  "unix_p99_ms": 0.9,
+                                  "tcp_over_unix_p50": 1.33},
+        "fleet_tcp_handoff_stall_p90_ms": {"sync": 12.5,
+                                           "async": "fast"}})
+    r = _run_trend(root)
+    assert r.returncode == 2 and "async" in r.stderr
+    write("DECODE_r04x.json", {
+        "metric": "m", "value": 1.0, "unit": "tokens/s",
+        "fleet_tcp_rpc_overhead_p50_ms": 0.4,
+        "fleet_tcp_rpc_overhead_p99_ms": 1.2,
+        "fleet_tcp_rpc_vs_unix": {"unix_p50_ms": 0.3,
+                                  "unix_p99_ms": 0.9,
+                                  "tcp_over_unix_p50": 1.33},
+        "fleet_tcp_handoff_stall_p90_ms": {"sync": 12.5,
+                                           "async": 1.8}})
+    r = _run_trend(root)
+    assert r.returncode == 0, r.stdout + r.stderr
+    write("DECODE_r04x.json", {
+        "metric": "m", "value": 1.0, "unit": "tokens/s",
+        "fleet_tcp_rpc_overhead_p50_ms":
+            "error: RuntimeError: lane died"})
+    r = _run_trend(root)
+    assert r.returncode == 0, r.stdout + r.stderr
+    os.remove(os.path.join(root, "DECODE_r04x.json"))
+
     # a missing artifact directory is rc 2, not a silent pass
     r = _run_trend(os.path.join(root, "nope"))
     assert r.returncode == 2

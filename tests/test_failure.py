@@ -19,7 +19,44 @@ from distributed_llm_code_samples_tpu.models import init_ffn_stack
 from distributed_llm_code_samples_tpu.parallel import train_single
 from distributed_llm_code_samples_tpu.runtime import native
 from distributed_llm_code_samples_tpu.runtime.failure import (
-    HealthCheckError, device_healthcheck, supervise)
+    HealthCheckError, backoff_delay, device_healthcheck, supervise)
+
+
+# ------------------------------------------------------------------ backoff
+
+def test_backoff_delay_bounds():
+    """The bounds contract every retry ladder leans on (supervisors
+    AND the round-22 reconnect state machine): with jitter ``j`` the
+    delay stays within ``[(1-j)*min(base*2^a, cap),
+    (1+j)*min(base*2^a, cap)]`` for every attempt — never negative,
+    never past ``(1+j)*cap`` no matter how large ``attempt`` grows."""
+    import random
+    base_s, cap, j = 0.05, 1.0, 0.3
+    rng = random.Random(7)
+    for attempt in range(40):
+        b = min(base_s * (2 ** attempt), cap)
+        lo, hi = (1 - j) * b, (1 + j) * b
+        for _ in range(20):
+            d = backoff_delay(attempt, base_s, cap, j, rng)
+            assert lo <= d <= hi, (attempt, d, lo, hi)
+            assert d >= 0.0
+
+
+def test_backoff_delay_jitter_free_schedule():
+    """With jitter 0 the schedule is exact, deterministic (the RNG is
+    never consulted into the result), and monotone non-decreasing in
+    ``attempt`` — the property that makes reconnect gaps in drill
+    transcripts reproducible run to run."""
+    import random
+    delays = [backoff_delay(a, 0.05, 1.0, 0.0, random.Random(0))
+              for a in range(12)]
+    assert delays == [min(0.05 * (2 ** a), 1.0) for a in range(12)]
+    assert all(d1 <= d2 for d1, d2 in zip(delays, delays[1:]))
+    assert delays[-1] == 1.0            # the cap holds
+    # two differently-seeded RNGs agree when jitter is off
+    assert delays == [backoff_delay(a, 0.05, 1.0, 0.0,
+                                    random.Random(99))
+                      for a in range(12)]
 
 
 # ----------------------------------------------------------------- watchdog

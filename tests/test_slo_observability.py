@@ -219,6 +219,7 @@ def test_slo_migration_gap_attribution(tmp_path, capsys):
                   "source": "e1", "target": "e0",
                   "reason": "engine_killed", "replay": 2, "blocks": 0,
                   "bytes": 0, "duration_s": 0.001, "t": 102.0,
+                  "ship_s": None, "catchup_tokens": 2,
                   "transport": {"mode": "replay", "bytes": 0,
                                 "crc_verify_s": None, "retries": 0}})
     doc = _report_json(capsys, [rdir, src, dst, "--slo", "1.0:0.2"])
@@ -260,6 +261,7 @@ def test_slo_pre_first_token_migration_attribution(tmp_path, capsys):
                   "source": "e1", "target": "e0",
                   "reason": "engine_killed", "replay": 0, "blocks": 0,
                   "bytes": 0, "duration_s": 0.001, "t": 101.4,
+                  "ship_s": None, "catchup_tokens": 0,
                   "transport": {"mode": "replay", "bytes": 0,
                                 "crc_verify_s": None, "retries": 0}})
     doc = _report_json(capsys, [rdir, src, dst, "--slo", "0.5:10"])

@@ -92,7 +92,15 @@ from distributed_llm_code_samples_tpu.runtime.telemetry import (
 # severity class, and the folded [start, end] round window; each
 # detector conditionally pins exactly the numbers that justified the
 # transition, on BOTH fired and resolved records).
-_PINNED_VERSION = 15
+# v16 (round 22): the multi-host transport — the router-event
+# vocabulary gains ``reconnected`` (a dropped worker connection healed
+# under the reconnect ladder instead of becoming a dead-host
+# declaration), ``transport.mode`` gains ``tcp``, and every
+# ``migrated`` record conditionally pins the async-migration pair
+# (``ship_s`` = the overlapped ship window, null when nothing
+# overlapped; ``catchup_tokens`` = tokens the target teacher-forced
+# to catch up) with ROUTER_MIGRATED_REQUIRED.
+_PINNED_VERSION = 16
 _PINNED_STEP_KEYS = frozenset({
     "schema", "kind", "t", "step", "strategy", "loss", "grad_norm",
     "tokens_per_sec", "step_time_s", "mfu", "hbm_high_water_bytes",
@@ -123,6 +131,8 @@ _PINNED_FLEET_REQUIRED = frozenset({"step", "engines",
                                     "load_imbalance"})
 _PINNED_ROUTER_MOVE_REQUIRED = frozenset({"blocks", "bytes",
                                           "duration_s", "transport"})
+_PINNED_ROUTER_MIGRATED_REQUIRED = frozenset({"ship_s",
+                                              "catchup_tokens"})
 _PINNED_DEPLOY_REQUIRED = frozenset({
     "step", "event", "from_version", "to_version", "trace_id",
 })
@@ -170,8 +180,9 @@ def test_schema_version_bump_discipline():
         DEPLOY_EVENT_REQUIRED, DEPLOY_REQUIRED, FLEET_REQUIRED,
         QOS_EVENT_REQUIRED, QOS_REQUIRED, RECORD_KINDS,
         REQUEST_COMPLETED_REQUIRED, REQUEST_REQUIRED, REQUIRED_KEYS,
-        ROLLBACK_REQUIRED, ROUTER_MOVE_REQUIRED, ROUTER_REQUIRED,
-        SPAN_REQUIRED, WORKLOAD_REQUIRED)
+        ROLLBACK_REQUIRED, ROUTER_EVENTS, ROUTER_MIGRATED_REQUIRED,
+        ROUTER_MOVE_REQUIRED, ROUTER_REQUIRED, SPAN_REQUIRED,
+        WORKLOAD_REQUIRED)
     assert SCHEMA_VERSION == _PINNED_VERSION and \
         frozenset(STEP_KEYS) == _PINNED_STEP_KEYS and \
         frozenset(ANOMALY_REQUIRED) == _PINNED_ANOMALY_REQUIRED and \
@@ -184,6 +195,9 @@ def test_schema_version_bump_discipline():
         frozenset(ROUTER_REQUIRED) == _PINNED_ROUTER_REQUIRED and \
         frozenset(ROUTER_MOVE_REQUIRED) == \
         _PINNED_ROUTER_MOVE_REQUIRED and \
+        frozenset(ROUTER_MIGRATED_REQUIRED) == \
+        _PINNED_ROUTER_MIGRATED_REQUIRED and \
+        "reconnected" in ROUTER_EVENTS and \
         frozenset(FLEET_REQUIRED) == _PINNED_FLEET_REQUIRED and \
         frozenset(DEPLOY_REQUIRED) == _PINNED_DEPLOY_REQUIRED and \
         frozenset(WORKLOAD_REQUIRED) == _PINNED_WORKLOAD_REQUIRED and \
@@ -368,6 +382,7 @@ def test_router_record_round_trip(tmp_path):
     w.router({"step": 2, "uid": 7, "event": "migrated", "source": "e1",
               "target": "e0", "reason": "engine_killed",
               "blocks": 0, "bytes": 0, "duration_s": 0.001,
+              "ship_s": None, "catchup_tokens": 3,
               "transport": transport})
     w.router({"step": 0, "uid": 3, "event": "routed", "target": "e2",
               "reason": "prefix", "policy": "prefix",
@@ -388,6 +403,8 @@ def test_router_record_round_trip(tmp_path):
     assert mig["policy"] is None        # writer default: no placement
     assert mig["duration_s"] == 0.001   # the stall instrumentation
     assert mig["transport"]["mode"] == "replay"
+    # v16: the async-migration pair rides every migrated record
+    assert mig["ship_s"] is None and mig["catchup_tokens"] == 3
     assert routed["source"] is None and routed["target"] == "e2"
     assert routed["policy"] == "prefix"
     assert routed["prefix_hit_blocks"] == 2
@@ -410,18 +427,22 @@ def test_router_move_record_conditional_pin():
     move_keys = {"blocks": 3, "bytes": 4096, "duration_s": 0.01,
                  "transport": {"mode": "wire", "bytes": 4096,
                                "crc_verify_s": 0.0001, "retries": 0}}
+    # v16: a migration additionally pins the async-migration pair —
+    # a handoff never does (nothing catches up on a prefill handoff)
+    mig_keys = {"ship_s": 0.42, "catchup_tokens": 2}
     for event in ("handoff", "migrated"):
+        extra = mig_keys if event == "migrated" else {}
         ok, reason = validate_record({**base, "event": event,
-                                      **move_keys})
+                                      **move_keys, **extra})
         assert ok, reason
-        for key in sorted(move_keys):
-            rec = {**base, "event": event, **move_keys}
+        for key in sorted({**move_keys, **extra}):
+            rec = {**base, "event": event, **move_keys, **extra}
             del rec[key]
             ok, reason = validate_record(rec)
             assert not ok and event in reason and key in reason, \
                 (event, key, reason)
             assert "\n" not in reason
-    for event in ("routed", "shed", "wire_rejected"):
+    for event in ("routed", "shed", "wire_rejected", "reconnected"):
         ok, reason = validate_record({**base, "event": event})
         assert ok, (event, reason)
 

@@ -332,6 +332,46 @@ def test_diff_streams_classification():
     assert res["verdict"] == "schema-drift" and res["index"] == 2
 
 
+def test_diff_streams_transport_mode_equivalence():
+    """Two honest replays of ONE run on two transports (inproc vs
+    tcp): the move records agree on every content key and differ only
+    in the transport attribution's mode + its timing members — that is
+    a timing-only verdict (rc 0 surface), NOT schema-drift. A
+    transport dict that disagrees on a content member (bytes shipped,
+    retries) is still a real divergence."""
+    t_wire = {"mode": "wire", "bytes": 4096, "crc_verify_s": 0.0001,
+              "retries": 0}
+    t_tcp = {"mode": "tcp", "bytes": 4096, "crc_verify_s": 0.0009,
+             "retries": 0}
+    base = {"schema": 16, "kind": "router", "step": 3, "uid": 1,
+            "event": "migrated", "source": "e0", "target": "e1",
+            "blocks": 3, "bytes": 4096, "duration_s": 0.01,
+            "ship_s": None, "catchup_tokens": 2}
+    res = diff_streams([{**base, "transport": t_wire}],
+                       [{**base, "transport": t_tcp,
+                         "duration_s": 0.03}])
+    assert res["verdict"] == "timing-only", res
+    # both differing keys are localized, both classified benign
+    assert res["keys"] == ["duration_s", "transport"], res
+    # a plain-string transport tag (meta records) is mode-only too
+    meta = {"schema": 16, "kind": "meta", "step": 0, "uid": -1}
+    res = diff_streams([{**meta, "transport": "process"}],
+                       [{**meta, "transport": "tcp"}])
+    assert res["verdict"] == "timing-only", res
+    # bytes disagreeing inside the attribution IS a divergence: the
+    # two runs did not ship the same document
+    res = diff_streams(
+        [{**base, "transport": t_wire}],
+        [{**base, "transport": {**t_tcp, "bytes": 9999}}])
+    assert res["verdict"] == "token-divergence", res
+    assert res["keys"] == ["transport"], res
+    # same for retries: a replayed send is observable behavior
+    res = diff_streams(
+        [{**base, "transport": t_wire}],
+        [{**base, "transport": {**t_tcp, "retries": 2}}])
+    assert res["verdict"] == "token-divergence", res
+
+
 def test_stream_diff_cli(lm_params, tmp_path):
     """The standalone differ: same rc discipline as report --diff,
     runnable without the report CLI's surface."""
