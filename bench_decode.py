@@ -384,6 +384,159 @@ def main() -> int:
     if not tp_only and os.environ.get("DECODE_ENGINE", "1") != "0":
         guarded("engine_prefix_cache_tokens_per_sec", prefix_rows)
 
+    # KV-spill rows (round 23, DESIGN.md section 29): the session-churn
+    # workload the tiered hierarchy exists for — K DISTINCT sessions
+    # each returning M times through a device pool sized for the
+    # running pair only, so retention of all K prefixes must overflow
+    # the device and land in the host tier. The spill engine restores
+    # the evicted prefixes through the implant program; the no-spill
+    # engine (same tiny pool) re-prefills them. Both are asserted
+    # byte-identical to a big-pool oracle, so the dispatch/capacity
+    # deltas come at equal tokens.
+    def kv_spill_rows():
+        import numpy as np
+
+        from distributed_llm_code_samples_tpu.decode import (
+            DecodeEngine, EngineConfig)
+
+        block = int(os.environ.get("BENCH_ENGINE_BLOCK", 16))
+        K, M = 8, 3
+        pfx_blocks = max(2, -(-T0 // block))
+        plen = pfx_blocks * block + 3
+        mbps_sp = -(-(plen + NEW) // block)
+        rng = np.random.default_rng(23)
+        sessions = [rng.integers(0, V, size=plen).tolist()
+                    for _ in range(K)]
+        sp_params = init_lm(jax.random.PRNGKey(0), V, D, L, plen + NEW)
+        slots = 2
+        # scratch + the two running reservations + one extra block of
+        # slack: all K sessions' cached prefixes (K * pfx_blocks) can
+        # never stay device-resident together
+        small = 1 + slots * mbps_sp + 1
+
+        def run(n_blocks, spill_blocks):
+            cfg = EngineConfig(
+                block_size=block, n_blocks=n_blocks, max_slots=slots,
+                max_blocks_per_seq=mbps_sp,
+                prefill_chunk=min(block,
+                                  1 << (plen.bit_length() - 1)),
+                kv_dtype="f32", prefix_cache=True,
+                spill_blocks=spill_blocks,
+                # proactive watermark demotion: keep a running-pair
+                # cushion free so cached prefixes park in the host
+                # tier instead of dying to pool-pressure eviction
+                spill_low_water=(slots * mbps_sp if spill_blocks
+                                 else 0))
+            eng = DecodeEngine(sp_params, H, cfg)
+            outs, peak_warm = [], 0
+            t0 = time.perf_counter()
+            for _ in range(M):          # the M returns, in rounds
+                uids = [eng.submit(p, NEW) for p in sessions]
+                while eng.waiting or eng.active:
+                    eng.step()
+                    # warm = restorable without re-prefill, device
+                    # resident + host tier (promotion consumes tier
+                    # entries, so sample the peak, not the drain)
+                    warm = eng.prefix.evictable_blocks() + (
+                        0 if eng.spill is None else len(eng.spill))
+                    if warm > peak_warm:
+                        peak_warm = warm
+                outs += [eng.finished[u] for u in uids]
+            dt = time.perf_counter() - t0
+            return outs, eng, K * M * NEW / dt, peak_warm
+
+        oracle_outs, _, _, _ = run(1 + 2 * K * mbps_sp, 0)  # no evict
+        base_outs, base_eng, base_tps, warm_base = run(small, 0)
+        outs, eng, tps, warm = run(small, 2 * K * pfx_blocks)
+        if outs != oracle_outs or base_outs != oracle_outs:
+            raise RuntimeError("spill-tier output != big-pool oracle "
+                               "(bit-identity contract violated)")
+        if eng.restores == 0 or eng.restore_tokens_saved == 0:
+            raise RuntimeError("session churn drove zero restores — "
+                               "the row measured nothing")
+        # restore-vs-reprefill: every restored block is prefill the
+        # no-spill engine re-paid; the dispatch counts must agree
+        if eng.prefill_dispatches >= base_eng.prefill_dispatches:
+            raise RuntimeError(
+                f"spill engine paid {eng.prefill_dispatches} prefill "
+                f"dispatches vs {base_eng.prefill_dispatches} without "
+                "the tier — restores saved nothing")
+        # effective resident-session capacity: peak warm (restorable-
+        # without-re-prefill) prefix blocks over the run, device +
+        # host tier vs device only on the same pool
+        gain = warm / max(warm_base, 1)
+        if gain < 2.0:
+            raise RuntimeError(
+                f"warm-prefix capacity with the tier is only {gain:.2f}x"
+                " the no-spill pool (acceptance floor is 2x)")
+        paths["kv_spill_tokens_per_sec"] = round(tps, 1)
+        paths["kv_spill_vs_no_spill"] = round(tps / base_tps, 3)
+        paths["kv_spill_capacity_gain"] = round(gain, 3)
+        paths["kv_spill_restores"] = eng.restores
+        paths["kv_spill_restore_tokens_saved"] = eng.restore_tokens_saved
+        paths["kv_spill_restore_stall_s"] = round(eng.restore_stall_s, 4)
+        paths["kv_spill_spilled_blocks"] = eng.spilled_blocks
+        paths["kv_spill_prefill_dispatches"] = eng.prefill_dispatches
+        paths["kv_spill_prefill_dispatches_no_spill"] = \
+            base_eng.prefill_dispatches
+        paths["kv_spill_note"] = (
+            f"{K} distinct sessions x {M} returns through a "
+            f"{small - 1}-block device pool (running pair only) + a "
+            f"{2 * K * pfx_blocks}-block host tier: returning prefixes "
+            "restore via the donated implant program instead of "
+            "re-prefilling (dispatch counts), warm-prefix capacity = "
+            "peak device evictable + host tier blocks over the run vs "
+            "the same pool without the tier (asserted >= 2x), outputs "
+            "asserted byte-identical to a big-pool oracle")
+
+        # sub-block sharing row: 2*B requests share a SHORT system
+        # prompt (one full block + a half-block tail — whole-block
+        # matching alone leaves the tail unshared) and differ in a
+        # 3-token user suffix; prefix_partial CoW-copies the shared
+        # rows so the partial hit saves prefill too. f32: output
+        # byte-identical to the partial-off engine by the row-purity
+        # argument (DESIGN.md section 29).
+        sh = rng.integers(0, V, size=block + block // 2).tolist()
+        pp_prompts = [sh + rng.integers(0, V, size=3).tolist()
+                      for _ in range(2 * B)]
+        pplen = len(pp_prompts[0])
+        mbps_pp = -(-(pplen + NEW) // block)
+        pp_params = init_lm(jax.random.PRNGKey(0), V, D, L, pplen + NEW)
+
+        def run_pp(partial):
+            cfg = EngineConfig(
+                block_size=block, n_blocks=1 + B * mbps_pp,
+                max_slots=B, max_blocks_per_seq=mbps_pp,
+                prefill_chunk=min(block,
+                                  1 << (pplen.bit_length() - 1)),
+                kv_dtype="f32", prefix_cache=True,
+                prefix_partial=partial)
+            eng = DecodeEngine(pp_params, H, cfg)
+            outs = eng.generate(pp_prompts[:1], NEW)       # warm
+            outs += eng.generate(pp_prompts[1:], NEW)      # wave
+            return outs, eng
+
+        pbase_outs, pbase_eng = run_pp(False)
+        pouts, peng = run_pp(True)
+        if pouts != pbase_outs:
+            raise RuntimeError("prefix_partial output != whole-block "
+                               "engine at f32 (row-purity violated)")
+        if peng.partial_hits == 0:
+            raise RuntimeError("half-block system prompt produced zero "
+                               "partial hits")
+        paths["kv_spill_partial_hits"] = peng.partial_hits
+        paths["kv_spill_partial_tokens_saved"] = (
+            peng.prefill_tokens_saved - pbase_eng.prefill_tokens_saved)
+        paths["kv_spill_partial_note"] = (
+            f"2*B requests sharing a {block + block // 2}-token system "
+            "prompt (1 full block + a half block): whole-block matching "
+            "saves the full block only; prefix_partial CoW-copies the "
+            "half-block rows too (partial_hits, extra tokens_saved), "
+            "f32 outputs asserted byte-identical to partial-off")
+
+    if not tp_only and os.environ.get("DECODE_ENGINE", "1") != "0":
+        guarded("kv_spill_tokens_per_sec", kv_spill_rows)
+
     # Fused-vs-gather kernel ratio (round 12): the same engine workload
     # through EngineConfig(kernel=...) per KV dtype. Off-chip this runs
     # the Pallas INTERPRETER (a correctness lane, orders of magnitude

@@ -173,13 +173,16 @@ from ..runtime.tracing import SpanTracer
 from ..runtime.workload import tenant_key
 from ..runtime.weights import (BOOT_VERSION, architecture_diff,
                                model_fingerprint, same_architecture)
+from ..runtime import wire
 from .draft import draft_tokens
-from .paged import (PagedKV, SCRATCH_BLOCK, copy_block, corrupt_block as
+from .paged import (PagedKV, SCRATCH_BLOCK, copy_block, copy_block_rows,
+                    corrupt_block as
                     _pool_corrupt_block, extract_blocks,
                     fused_decode_attn, gather_layer, implant_block,
                     init_pool, kv_bytes_per_token, pool_bytes,
                     scrub_blocks, write_chunk, write_rows)
 from .prefix import PrefixCache
+from .spill import SpillTier
 from .sampling import check_sampling, check_speculation, make_pick
 
 # poison operand values for the compiled steps (chaos nan_logits
@@ -223,14 +226,24 @@ REQUEST_EVENTS = ("admitted", "preempted", "retried", "quarantined",
 # v13) — a migrated request's per-tenant attribution survives the
 # move, so the workload plane's noisy-tenant numbers stay honest
 # through kills and deploys (DESIGN.md section 25).
-HANDOFF_VERSION = 6
+# v7 (round 22): the config schema grew the spill-tier capacity keys
+# (``spill_blocks`` / ``spill_restore_per_step`` / ``spill_low_water``)
+# — engine-local capacity knobs, pool-size class, so two engines may
+# disagree on them and still exchange sequences.
+HANDOFF_VERSION = 7
 
 # EngineConfig keys two engines may legitimately disagree on and still
-# exchange sequences: pool SIZE is an engine-local capacity choice.
+# exchange sequences: pool SIZE is an engine-local capacity choice —
+# device pool shape AND the host spill tier behind it (a spilled block
+# restores bit-identically, so tier sizing never touches numerics).
 # Every other key participates in the token-identity proof (sampling
 # keys, chunk grouping — hence int8 requant history — kernel and
-# speculation paths) and must match exactly.
-_HANDOFF_POOL_KEYS = ("n_blocks", "max_slots", "max_blocks_per_seq")
+# speculation paths) and must match exactly; ``prefix_partial`` is
+# deliberately NOT here — at int8 a sub-block share carries the
+# donor's frozen scale, so the flag is a numerics key.
+_HANDOFF_POOL_KEYS = ("n_blocks", "max_slots", "max_blocks_per_seq",
+                      "spill_blocks", "spill_restore_per_step",
+                      "spill_low_water")
 
 # flight recorder: bounded ring of per-step scheduler digests, dumped
 # atomically on quarantine / watchdog latch / chaos kill — the "what
@@ -304,7 +317,26 @@ class EngineConfig:
     shared-prefix radix cache (``decode/prefix.py``) — host-side only,
     so the flag never changes a compiled program; it lives in the
     config because snapshot-resume must restore onto the same sharing
-    policy."""
+    policy.
+
+    The KV memory hierarchy (round 22, DESIGN.md section 29):
+    ``spill_blocks`` sizes the host-RAM spill tier in blocks
+    (``decode/spill.py``; 0 = off, requires the prefix cache) —
+    pool-pressure demotion moves refs-0 cached blocks there instead of
+    discarding them, and a radix hit on a spilled edge restores the
+    bytes through the implant program instead of re-prefilling.
+    ``spill_restore_per_step`` budgets restores per engine step (the
+    chunked-prefill stance: promotion must never stall running
+    decodes — an over-budget admission keeps its partial restores and
+    finishes next step). ``spill_low_water`` demotes proactively
+    whenever the free list dips below it (0 = demand-only).
+    ``prefix_partial`` enables SUB-BLOCK sharing: a partial-block
+    radix hit row-copies the shared prefix rows into a private block
+    (``paged.copy_block_rows``) and prefills past them. Exact at
+    f32/bf16 (rows are per-row pure); at int8 the borrowed rows carry
+    the donor's FROZEN per-block scale — deterministic, but not
+    bit-equal to an unshared run — which is why the flag is off by
+    default and a numerics key for handoff."""
     block_size: int = 16
     n_blocks: int = 65
     max_slots: int = 4
@@ -319,6 +351,10 @@ class EngineConfig:
     speculate: int = 0
     kernel: str = "gather"
     prefix_cache: bool = True
+    spill_blocks: int = 0
+    spill_restore_per_step: int = 2
+    spill_low_water: int = 0
+    prefix_partial: bool = False
 
     @property
     def capacity(self) -> int:
@@ -453,6 +489,22 @@ class DecodeEngine:
                 f"prefill_chunk must be a power of two >= 1, got "
                 f"{cfg.prefill_chunk} (power-of-two chunks are what "
                 "keeps a chunk inside one block — paged.write_chunk)")
+        if cfg.spill_blocks < 0:
+            raise ValueError(f"spill_blocks must be >= 0, got "
+                             f"{cfg.spill_blocks}")
+        if cfg.spill_restore_per_step < 1:
+            raise ValueError(
+                f"spill_restore_per_step must be >= 1, got "
+                f"{cfg.spill_restore_per_step} (a zero budget would "
+                "starve every admission whose prefix spilled)")
+        if cfg.spill_low_water < 0:
+            raise ValueError(f"spill_low_water must be >= 0, got "
+                             f"{cfg.spill_low_water}")
+        if (cfg.spill_blocks > 0 or cfg.prefix_partial) \
+                and not cfg.prefix_cache:
+            raise ValueError(
+                "spill_blocks / prefix_partial extend the radix prefix "
+                "cache; they require prefix_cache=True")
         check_sampling(cfg.temperature, cfg.top_k, cfg.top_p, params.vocab)
         check_speculation(cfg.speculate, cfg.temperature)
         if cfg.kernel not in ("gather", "fused"):
@@ -598,8 +650,14 @@ class DecodeEngine:
         # -- shared-prefix KV reuse (round 13, DESIGN.md section 19) --
         # the radix tree over full prompt blocks; None = sharing off
         # (every block private, the round-9..12 engine exactly)
-        self.prefix = (PrefixCache(cfg.block_size) if cfg.prefix_cache
-                       else None)
+        # -- KV memory hierarchy (round 22, DESIGN.md section 29) --
+        # the host-RAM spill tier behind the device pool; None = the
+        # round-13 single-tier cache exactly (demotion discards)
+        self.spill = (SpillTier(cfg.spill_blocks)
+                      if cfg.prefix_cache and cfg.spill_blocks > 0
+                      else None)
+        self.prefix = (PrefixCache(cfg.block_size, spill=self.spill)
+                       if cfg.prefix_cache else None)
         # cumulative, snapshot-persisted (monotonic across crash-resume
         # like the churn trio): hit blocks mapped at admission, prompt
         # tokens those hits skipped, copy-on-write triggers (0 in
@@ -609,6 +667,22 @@ class DecodeEngine:
         self.prefill_tokens_saved = 0
         self.cow_copies = 0
         self.prefix_lookup_blocks = 0
+        # spill-tier counters (schema v17, cumulative and snapshot-
+        # persisted like the churn trio — the TIER dies with the
+        # process, these survive it): blocks demoted to host RAM,
+        # wire bytes they serialized to, blocks promoted back through
+        # the implant program, prompt tokens those promotions kept off
+        # the prefill path, host wall-clock the promotions cost (the
+        # stall budget's measured term), and sub-block partial hits
+        self.spilled_blocks = 0
+        self.spill_bytes = 0
+        self.restores = 0
+        self.restore_tokens_saved = 0
+        self.restore_stall_s = 0.0
+        self.partial_hits = 0
+        # per-step promotion budget state (reset in step())
+        self._restores_left = cfg.spill_restore_per_step
+        self._step_restores = 0
         # prefill program dispatches (the shared-prefix win is provable
         # as a dispatch count: N sharers run ~1 prefill pass over the
         # shared prefix, not N); snapshot-persisted
@@ -667,6 +741,7 @@ class DecodeEngine:
                        "prefill": self._build_prefill,
                        "verify": self._build_verify,
                        "cow": self._build_cow,
+                       "cow_rows": self._build_cow_rows,
                        "implant": self._build_implant}[kind]
             fn = builder(bucket)
             self._programs[key] = fn
@@ -974,6 +1049,15 @@ class DecodeEngine:
         which steady state never does — the recompile-guard tests keep
         holding with the barrier armed."""
         return jax.jit(copy_block, donate_argnums=(0,))
+
+    def _build_cow_rows(self, _bucket: int):
+        """The sub-block share copy (``paged.copy_block_rows``) as one
+        compiled program for every (src, dst, rows) triple — all three
+        are traced operands, so a partial hit never recompiles. Donated
+        like the step programs; built lazily on the first partial hit
+        (``prefix_partial`` off keeps the program set byte-identical to
+        the round-13 engine's)."""
+        return jax.jit(copy_block_rows, donate_argnums=(0,))
 
     def _build_implant(self, _bucket: int):
         """The KV-handoff import copy (``paged.implant_block``) as one
@@ -1623,6 +1707,21 @@ class DecodeEngine:
             if node is not None:
                 node.poisoned = True
 
+    def corrupt_spill(self, spill_id: int) -> bool:
+        """Chaos ``corrupt_spill``: flip one byte of a HOST-TIER entry
+        (``SpillTier.corrupt``) — the host-RAM bit rot the wire CRC
+        ladder exists to catch. The damage is latent until a radix hit
+        tries to restore the entry: ``take``'s CRC check raises, the
+        edge detaches, and the restoring request quarantines
+        (``corrupt_spill`` reason) while every survivor — including
+        sharers of the RESIDENT prefix above the damaged edge — is
+        untouched. Returns False when the entry no longer exists
+        (already restored or dropped: the fault found nothing, exactly
+        like poisoning an already-freed block)."""
+        if self.spill is None:
+            return False
+        return self.spill.corrupt(int(spill_id))
+
     # -- scheduler (continued) -----------------------------------------
 
     def _eta_steps(self, prompt_len: int, max_new: int) -> int:
@@ -1740,13 +1839,23 @@ class DecodeEngine:
                    else self.serving_version)
             hits = ([] if self.prefix is None
                     else self.prefix.match(seq.prompt, ver))
+            # split the matched path at its spilled suffix (the device-
+            # leaf demotion rule guarantees the suffix shape): resident
+            # hits map straight into the table, spilled hits must
+            # RESTORE into fresh device blocks first — they draw on the
+            # free list exactly like misses; what the hit saves is the
+            # prefill, not the block
+            n_res = 0
+            while n_res < len(hits) and not hits[n_res].spilled:
+                n_res += 1
+            resident, spilled_sfx = hits[:n_res], hits[n_res:]
             avail = len(self.free_blocks)
             if self.prefix is not None:
                 # refs-0 cached blocks are reclaimable — minus the hit
                 # nodes themselves (about to be locked, not evicted)
                 avail += (self.prefix.evictable_blocks()
-                          - sum(1 for n in hits if n.refs == 0))
-            if need - len(hits) > avail:
+                          - sum(1 for n in resident if n.refs == 0))
+            if need - n_res > avail:
                 pa = self.policy.preempt_after_steps
                 if pa > 0:
                     if self._head_blocked_uid != seq.uid:
@@ -1764,6 +1873,41 @@ class DecodeEngine:
                 break
             self._head_blocked = 0
             self._head_blocked_uid = None
+            if spilled_sfx:
+                step = self.global_step
+                todo = spilled_sfx[:max(0, self._restores_left)]
+                # pin the resident prefix (and each node as it comes
+                # back) so restore-pressure demotion can't reclaim the
+                # matched path out from under its own admission
+                self.prefix.lock(resident, step)
+                locked = list(resident)
+                corrupt = None
+                try:
+                    for node in todo:
+                        self._restore_node(node)
+                        self.prefix.lock([node], step)
+                        locked.append(node)
+                except wire.WireError:
+                    corrupt = todo[len(locked) - n_res]
+                finally:
+                    for n in locked:
+                        self.prefix.release(n, step)
+                if corrupt is not None:
+                    # CRC caught a damaged host-tier entry: the edge
+                    # (with its now-unreachable spilled descendants)
+                    # leaves the tree, and the request that would have
+                    # trusted those bytes quarantines from the queue —
+                    # survivors never read them
+                    self.free_blocks.extend(
+                        self.prefix.detach_subtree(corrupt))
+                    self._quarantine_waiting(head_i, "corrupt_spill")
+                    continue
+                if len(todo) < len(spilled_sfx):
+                    # promotion budget exhausted: keep what restored
+                    # (resident, refs-0, warm — next step's budget
+                    # continues from there) and defer the admission —
+                    # a restore burst must never stall running decodes
+                    break
             del self.waiting[head_i]
             self._budget_deferred.discard(seq.uid)
             if head_i != 0:
@@ -1796,6 +1940,25 @@ class DecodeEngine:
             # clock starts past it (>= 1 token always remains, so the
             # first pick still comes from the prefill program)
             seq.prefilled = len(hits) * self.cfg.block_size
+            if self.cfg.prefix_partial:
+                # sub-block sharing: the longest resident edge sharing
+                # a PARTIAL leading run of the remaining tokens donates
+                # its first m rows into this sequence's first private
+                # block (one compiled row-masked copy — scales freeze
+                # at share time), and the prefill clock starts past
+                # them. need_priv >= 1 always (the final-token block
+                # is never a hit), so the destination exists.
+                part = self.prefix.partial_match(seq.prompt, hits, ver)
+                if part is not None:
+                    donor, m = part
+                    fn = self._program("cow_rows", 0)
+                    self.pool = fn(self.pool, jnp.int32(donor.block),
+                                   jnp.int32(seq.blocks[len(hits)]),
+                                   jnp.int32(m))
+                    donor.last_use = self.global_step  # LRU touch
+                    seq.prefilled += m
+                    self.partial_hits += 1
+                    self.prefill_tokens_saved += m
             self.block_allocs += need
             row = np.full((self.cfg.max_blocks_per_seq,), SCRATCH_BLOCK,
                           np.int32)
@@ -1823,7 +1986,13 @@ class DecodeEngine:
         reclaimable capacity. A reclaimed block the chaos layer
         corrupted is scrubbed on the way out (the ANY-release scrub
         contract: a poisoned refs-0 cached block has no owner whose
-        eviction would otherwise scrub it)."""
+        eviction would otherwise scrub it). With the spill tier armed,
+        reclamation DEMOTES instead of discarding — same LRU order,
+        same freed device blocks, but the bytes move to host RAM and
+        the edges stay matchable."""
+        if self.spill is not None:
+            self._demote(n)
+            return
         got = self.prefix.evict_lru(n, self.global_step)
         bad = [b for b in got if b in self._corrupted]
         if bad:
@@ -1831,6 +2000,83 @@ class DecodeEngine:
             self._corrupted.difference_update(bad)
             self.block_scrubs += len(bad)
         self.free_blocks.extend(got)
+
+    def _demote(self, n: int) -> None:
+        """Spill up to ``n`` refs-0 cached device-leaves to the host
+        tier (``prefix.spill_victims`` — LRU, non-detaching): each
+        victim's bytes leave the device through ``extract_blocks`` as
+        ONE wire document (storage dtype + int8 scales, per-array
+        CRC-32 — ``decode/spill.py``), the node flips to spilled, and
+        the device block joins the free list. Poisoned / chaos-
+        corrupted victims NEVER spill: the tier stores only bytes the
+        purity argument certifies — those detach-and-scrub exactly as
+        the single-tier engine did. A tier-capacity overflow drops the
+        oldest-spilled entries; their now-unrestorable edges detach
+        from the tree (FIFO by spill id IS LRU by spill time — a
+        spilled node's clock cannot advance until restore)."""
+        for node in self.prefix.spill_victims(n, self.global_step):
+            b = node.block
+            if node.poisoned or b in self._corrupted:
+                sub = self.prefix.detach_subtree(node)
+                bad = [x for x in sub if x in self._corrupted]
+                if bad:
+                    self.pool = scrub_blocks(self.pool, bad)
+                    self._corrupted.difference_update(bad)
+                    self.block_scrubs += len(bad)
+                self.free_blocks.extend(sub)
+                continue
+            got = extract_blocks(self.pool, [b])
+            doc = {"k": got["k"][:, 0], "v": got["v"][:, 0],
+                   "k_scale": (None if got["k_scale"] is None
+                               else got["k_scale"][:, 0]),
+                   "v_scale": (None if got["v_scale"] is None
+                               else got["v_scale"][:, 0])}
+            before = self.spill.bytes_spilled
+            sid, dropped = self.spill.put(node, doc)
+            self.prefix.mark_spilled(node, sid)
+            self.spilled_blocks += 1
+            self.spill_bytes += self.spill.bytes_spilled - before
+            self.free_blocks.append(b)
+            for victim in dropped:
+                if victim.parent is not None:    # still in the tree
+                    self.free_blocks.extend(
+                        self.prefix.detach_subtree(victim))
+
+    def _restore_node(self, node) -> None:
+        """Promote ONE spilled node back into a fresh device block: CRC-
+        verify the tier entry (``SpillTier.take`` — raises
+        ``wire.WireError`` on damage, the caller's quarantine path),
+        implant the bytes through the same donated compiled program the
+        KV handoff uses, and re-enter the node into every block-indexed
+        view with a fresh LRU clock. The host wall-clock this costs is
+        the ``restore_stall_s`` term the per-step budget bounds; each
+        restored block is ``block_size`` prompt tokens that did NOT
+        re-prefill."""
+        t0 = time.perf_counter()
+        # secure the destination BEFORE consuming the tier entry: a
+        # corrupt entry (WireError below) must leave the free list
+        # untouched for the survivors
+        if not self.free_blocks:
+            self._reclaim_cached(1)
+        if not self.free_blocks:
+            raise RuntimeError(
+                "spill restore needs a free block and the pool has "
+                "none (admission checked availability — this is a "
+                "bookkeeping bug)")
+        doc = self.spill.take(node.spill_id)
+        dst = self.free_blocks.pop(0)
+        args = [jnp.asarray(doc["k"]), jnp.asarray(doc["v"])]
+        if doc["k_scale"] is not None:
+            args += [jnp.asarray(doc["k_scale"]),
+                     jnp.asarray(doc["v_scale"])]
+        fn = self._program("implant", 0)
+        self.pool = fn(self.pool, jnp.int32(dst), *args)
+        self.prefix.mark_restored(node, dst, self.global_step)
+        self.restores += 1
+        self.restore_tokens_saved += self.cfg.block_size
+        self.restore_stall_s += time.perf_counter() - t0
+        self._step_restores += 1
+        self._restores_left -= 1
 
     def _cache_full_blocks(self, slot: int) -> None:
         """Transfer a slot's newly fully-prefilled FULL prompt blocks
@@ -2075,6 +2321,42 @@ class DecodeEngine:
         self.failed[seq.uid] = {"reason": reason, "retries": seq.retries,
                                 "n_out": len(seq.out)}
 
+    def _quarantine_waiting(self, head_i: int, reason: str) -> None:
+        """Quarantine a request that faulted BEFORE taking a slot — the
+        spill-restore failure mode: its radix hit named a host-tier
+        entry whose CRC check failed (``corrupt_spill``), so the
+        request that would have trusted those bytes is the one
+        quarantined, at its waiting-queue position. No slot, no blocks,
+        no pool bytes were touched; the corrupt edge is already
+        detached, so a retry re-matches WITHOUT it and re-prefills the
+        lost span cleanly. Same retry-or-fail ladder as the running
+        quarantine, same record vocabulary — a report reader sees one
+        quarantine story with two entry points."""
+        seq = self.waiting[head_i]
+        del self.waiting[head_i]
+        self._budget_deferred.discard(seq.uid)
+        self.quarantined += 1
+        self._dump_reason = f"quarantine uid {seq.uid} ({reason})"
+        self.tracer.transition(seq.uid, "quarantine", self.global_step,
+                               reason=reason,
+                               tokens=self._span_tokens.pop(seq.uid, 0))
+        if seq.retries < self.policy.max_retries:
+            seq.retries += 1
+            self.retried += 1
+            self._event("quarantined", seq.uid, reason=reason,
+                        retrying=True)
+            self._event("retried", seq.uid, reason=reason,
+                        attempt=seq.retries,
+                        max_retries=self.policy.max_retries)
+            self._requeue(seq)
+            return
+        self._event("quarantined", seq.uid, reason=reason,
+                    retrying=False, retries=seq.retries)
+        self.tracer.close(seq.uid, self.global_step, reason=reason)
+        self.tracer.pop_first_token(seq.uid)    # terminal: forget
+        self.failed[seq.uid] = {"reason": reason, "retries": seq.retries,
+                                "n_out": len(seq.out)}
+
     def _expire_deadlines(self) -> None:
         """Per-request TTL: fail any request (waiting or running) still
         unfinished ``deadline_steps`` engine steps after submission —
@@ -2186,6 +2468,15 @@ class DecodeEngine:
         # ever straddles a block boundary (paged.write_chunk's contract)
         c = max(b for b in self.chunk_buckets if b <= remaining)
         bs = self.cfg.block_size
+        if seq.prefilled % bs:
+            # a sub-block partial hit started the clock mid-block: cap
+            # the chunk at the largest power of two that stays inside
+            # the current block (write_chunk's single-block contract);
+            # once the clock reaches the boundary, normal chunking
+            # resumes — same greedy power-of-two discipline, just
+            # anchored to the block edge instead of offset zero
+            gap = bs - seq.prefilled % bs
+            c = max(b for b in self.chunk_buckets if b <= min(c, gap))
         self._cow_private(slot, seq.prefilled // bs,
                           (seq.prefilled + c - 1) // bs)
         self.prefill_dispatches += 1
@@ -2412,6 +2703,16 @@ class DecodeEngine:
         self._step_finite = None
         self._step_prefill_uid = None
         self._step_decode_uids = []
+        # spill-tier housekeeping: a fresh promotion budget each step
+        # (the restore analogue of one-prefill-chunk-per-step), and the
+        # proactive low-watermark demotion — keep a cushion of free
+        # blocks so admission bursts don't pay the demotion walk inline
+        self._restores_left = self.cfg.spill_restore_per_step
+        self._step_restores = 0
+        if (self.spill is not None and self.cfg.spill_low_water > 0
+                and len(self.free_blocks) < self.cfg.spill_low_water):
+            self._demote(self.cfg.spill_low_water
+                         - len(self.free_blocks))
         self._expire_deadlines()
         self._admit()
         did = False
@@ -2432,6 +2733,12 @@ class DecodeEngine:
                 self._verify_step(ready)
             else:
                 self._decode_step(ready)
+            did = True
+        if self._step_restores:
+            # budget-deferred admission: restores ran compiled implant
+            # work this step even if no prefill/decode dispatched —
+            # that IS progress (run()'s stall guard must see it; the
+            # deferred head admits once the budget catches up)
             did = True
         if did:
             self.steps += 1
@@ -2580,6 +2887,25 @@ class DecodeEngine:
                                         else
                                         self.prefix.evictable_blocks()),
             "prefill_dispatches": self.prefill_dispatches,
+            # v17 KV-memory-hierarchy keys (pinned): demotion volume
+            # (cumulative blocks + wire bytes), promotion wins
+            # (restores, the prompt tokens they kept off the prefill
+            # path, the host wall-clock they cost — the budgeted
+            # stall term), sub-block partial hits, and the host tier's
+            # instantaneous occupancy fraction (0.0 with the tier off)
+            "spilled_blocks": self.spilled_blocks,
+            "spill_bytes": self.spill_bytes,
+            "restores": self.restores,
+            "restore_tokens_saved": self.restore_tokens_saved,
+            "restore_stall_s": round(self.restore_stall_s, 6),
+            "partial_hits": self.partial_hits,
+            "host_tier_utilization": (
+                round(self.spill.utilization(), 4)
+                if self.spill is not None else 0.0),
+            # extra: the tier's instantaneous entry count (occupancy's
+            # numerator — what fleetstat renders beside the pool line)
+            "spill_tier_blocks": (0 if self.spill is None
+                                  else len(self.spill)),
             "quarantined": self.quarantined,
             "retried": self.retried,
             "preempted": self.preempted,

@@ -250,6 +250,11 @@ class EngineHandle:
             "evictable": (e.prefix.evictable_blocks()
                           if e.prefix is not None else 0),
             "utilization": e.kv_pool_utilization(),
+            # KV spill tier (round 23, schema v17): host-tier occupancy
+            # + cumulative clean restores — zeros when the tier is off
+            "spill_tier_blocks": (0 if e.spill is None
+                                  else len(e.spill)),
+            "spill_restores": e.restores,
             "head": ({"prompt_len": len(e.waiting[0].prompt),
                       "max_new": e.waiting[0].max_new}
                      if e.waiting else None),
@@ -910,6 +915,8 @@ class FleetRouter:
                 "waiting": d["waiting"], "active": d["active"],
                 "free_blocks": d["free_blocks"],
                 "utilization": round(d["utilization"], 4),
+                "spill_tier_blocks": d.get("spill_tier_blocks", 0),
+                "spill_restores": d.get("spill_restores", 0),
             }
             if h.role == "decode":
                 loads.append(d["active"] + d["waiting"])
@@ -953,6 +960,8 @@ class FleetRouter:
                 "free_blocks": d["free_blocks"],
                 "evictable_blocks": d["evictable"],
                 "utilization": round(d["utilization"], 4),
+                "spill_tier_blocks": d.get("spill_tier_blocks", 0),
+                "spill_restores": d.get("spill_restores", 0),
                 "last_step_s": round(h.last_step_s, 6),
             }
             fam = getattr(h, "family", None)

@@ -142,6 +142,30 @@ def build_generate_parser() -> argparse.ArgumentParser:
                         "stays byte-identical (default on; "
                         "--no-prefix_cache restores the private-"
                         "blocks-only engine)")
+    # KV memory hierarchy (round 23, DESIGN.md section 29)
+    p.add_argument("--spill_blocks", type=int, default=0,
+                   help="host-RAM KV spill tier capacity in blocks "
+                        "(decode/spill.py): pool-pressure evictions of "
+                        "cached prefix blocks demote their bytes to "
+                        "host RAM instead of discarding, and a radix "
+                        "hit on the spilled edge restores via the "
+                        "compiled implant program instead of "
+                        "re-prefilling (0 = tier off; requires "
+                        "--prefix_cache)")
+    p.add_argument("--spill_restore_per_step", type=int, default=2,
+                   help="max spilled blocks promoted back per engine "
+                        "step — the restore budget that keeps a "
+                        "returning session's promotion from stalling "
+                        "running decodes (admission defers past it)")
+    p.add_argument("--prefix_partial", default=False,
+                   action=argparse.BooleanOptionalAction,
+                   help="sub-block prefix sharing: a partial-block "
+                        "radix hit CoW-copies the shared leading rows "
+                        "into a fresh block so short shared system "
+                        "prompts save prefill too (f32/bf16 output "
+                        "stays byte-identical; int8 rows reuse the "
+                        "donor's frozen scale — deterministic, "
+                        "documented in DESIGN.md section 29)")
     # parallel strategy
     p.add_argument("--tp", type=int, default=1,
                    help="model-axis size for the Megatron decode layout "
@@ -889,7 +913,10 @@ def generate_main(argv=None) -> int:
             temperature=args.temperature, top_k=args.top_k,
             top_p=args.top_p, seed=args.sample_seed,
             use_rope=args.use_rope, speculate=args.speculate,
-            kernel=args.kernel, prefix_cache=args.prefix_cache)
+            kernel=args.kernel, prefix_cache=args.prefix_cache,
+            spill_blocks=args.spill_blocks,
+            spill_restore_per_step=args.spill_restore_per_step,
+            prefix_partial=args.prefix_partial)
         policy = ServePolicy(
             queue_limit=args.queue_limit,
             deadline_steps=args.deadline_steps,
@@ -1093,6 +1120,11 @@ def generate_main(argv=None) -> int:
         "prefill_tokens_saved": engine.prefill_tokens_saved,
         "prefill_dispatches": engine.prefill_dispatches,
         "cow_copies": engine.cow_copies,
+        "spill_blocks": args.spill_blocks,
+        "spilled_blocks": engine.spilled_blocks,
+        "restores": engine.restores,
+        "restore_tokens_saved": engine.restore_tokens_saved,
+        "partial_hits": engine.partial_hits,
         "quarantined": engine.quarantined,
         "retried": engine.retried,
         "preempted": engine.preempted,

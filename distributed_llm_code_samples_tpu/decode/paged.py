@@ -302,6 +302,34 @@ def copy_block(pool: PagedKV, src, dst) -> PagedKV:
     return out
 
 
+def copy_block_rows(pool: PagedKV, src, dst, n_rows) -> PagedKV:
+    """Row-masked ``copy_block``: copy only the first ``n_rows`` token
+    rows of ``src`` into ``dst`` (rows past the mask are zeroed, the
+    scrubbed-free-block state a fresh prefill expects) — the device
+    half of SUB-BLOCK prefix sharing. A partial radix hit clones just
+    the shared prefix rows into a private block and the borrower's
+    prefill resumes past them, so sharing no longer quantizes to whole
+    blocks. The int8 per-block SCALES copy whole: they freeze at share
+    time exactly as whole-block sharing froze them (a per-row slice of
+    a per-block scale does not exist), which is why the borrowed rows
+    stay bit-identical to the donor's bytes rather than to an unshared
+    re-prefill. All three operands may be traced scalars — one
+    compiled program serves every (src, dst, rows) triple."""
+    src = jnp.asarray(src, jnp.int32)
+    dst = jnp.asarray(dst, jnp.int32)
+    n = jnp.asarray(n_rows, jnp.int32)
+    mask = (jnp.arange(pool.block_size) < n)[None, None, :, None]
+    z = jnp.zeros((), pool.k.dtype)
+    out = pool._replace(
+        k=pool.k.at[:, dst].set(jnp.where(mask, pool.k[:, src], z)),
+        v=pool.v.at[:, dst].set(jnp.where(mask, pool.v[:, src], z)))
+    if pool.k_scale is not None:
+        out = out._replace(
+            k_scale=pool.k_scale.at[:, dst].set(pool.k_scale[:, src]),
+            v_scale=pool.v_scale.at[:, dst].set(pool.v_scale[:, src]))
+    return out
+
+
 def extract_blocks(pool: PagedKV, blocks) -> dict:
     """Host-side copy of the named physical blocks' bytes — the export
     half of the single-sequence KV handoff (``decode/fleet.py``):

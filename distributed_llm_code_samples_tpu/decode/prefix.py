@@ -44,6 +44,23 @@ Refcounts and lifetime:
   sharer must never zero an innocent survivor's prefix. The engine
   scrubs-and-detaches at refs == 0.
 
+The spill tier (``decode/spill.py``, round 19):
+
+- A ``spilled`` node's bytes live in host RAM (``spill_id`` keys the
+  tier entry); ``block`` is -1 and the node leaves ``_by_block``, so
+  every block-indexed view (eviction, evictable/shared counts,
+  ``node_for_block``) sees residents only. The node STAYS in the tree
+  and still MATCHES — that is the whole point: a radix hit on a
+  spilled edge restores bytes instead of re-prefilling them.
+- Demotion picks DEVICE-LEAVES (refs-0 residents whose children are
+  all spilled), so a resident node's ancestors are always resident
+  and the spilled nodes of any matched path form a SUFFIX — restore
+  walks the suffix root-outward with no ordering puzzles.
+- Poisoned nodes NEVER spill (the engine detaches-and-scrubs them as
+  before): the tier stores only bytes the purity argument certifies.
+- Detach in any form forgets the tier entry — the host copy of an
+  unreachable edge is garbage, not cache.
+
 Everything here is plain host Python (the device never sees the tree);
 the engine owns all pool writes and free-list edits.
 """
@@ -60,7 +77,7 @@ class PrefixNode:
     of the last lock/insert (the LRU clock)."""
 
     __slots__ = ("edge", "block", "refs", "last_use", "poisoned",
-                 "parent", "children")
+                 "spilled", "spill_id", "parent", "children")
 
     def __init__(self, edge, block, parent, step):
         self.edge = edge
@@ -68,6 +85,8 @@ class PrefixNode:
         self.refs = 0
         self.last_use = int(step)
         self.poisoned = False
+        self.spilled = False
+        self.spill_id: int | None = None
         self.parent = parent
         self.children: dict[tuple[int, ...], PrefixNode] = {}
 
@@ -84,8 +103,12 @@ class PrefixNode:
 class PrefixCache:
     """The host-side radix tree over full prompt blocks."""
 
-    def __init__(self, block_size: int):
+    def __init__(self, block_size: int, spill=None):
         self.block_size = int(block_size)
+        # host-RAM spill tier (decode/spill.py) or None: when set,
+        # pool-pressure demotion spills refs-0 device-leaves into it
+        # instead of discarding them, and detach drops their entries.
+        self.spill = spill
         # one root per WEIGHTS VERSION (round 17, DESIGN.md section
         # 23): a cached block's bytes are a pure function of (tokens,
         # EngineConfig, WEIGHTS) — under live hot-swap two versions'
@@ -154,7 +177,11 @@ class PrefixCache:
         ``match_cap``), root-outward UNDER ``version``'s root — a
         block prefilled by other weights is never a hit. Stops at the
         first miss or poisoned node; does NOT lock — admission locks
-        only once the block reservation is certain."""
+        only once the block reservation is certain. SPILLED nodes
+        match like residents (restoring host bytes beats a
+        re-prefill); by the device-leaf demotion rule they form a
+        suffix of the returned path, which the engine restores
+        root-outward before locking."""
         blk = self.block_size
         node = self._roots.get(int(version))
         if node is None:
@@ -179,6 +206,42 @@ class PrefixCache:
         with zero mirror drift; a multi-host deployment would mirror
         inserts/evictions over the telemetry stream instead."""
         return len(self.match(prompt, version))
+
+    def partial_match(self, prompt, hits,
+                      version: int = 0) -> tuple[PrefixNode, int] | None:
+        """Sub-block probe past the full-block walk: among the children
+        of the last hit node (the root when ``hits`` is empty), find
+        the RESIDENT, non-poisoned edge sharing the longest leading
+        run of the remaining prompt tokens. Returns ``(donor, m)`` —
+        the borrower CoW-copies the donor block's first ``m`` rows
+        into a private block and prefills from row ``m`` — or None
+        when no edge shares at least one token. ``m`` is capped at
+        ``len(remaining) - 1`` so at least one token ALWAYS prefills
+        (the engine's first-pick rule), and is strictly < block_size
+        (a full-edge match would have been a full-block hit). Spilled
+        donors are skipped: a partial hit never forces a restore —
+        the row copy needs device-resident source bytes. Read-only,
+        like ``match``."""
+        blk = self.block_size
+        node = hits[-1] if hits else self._roots.get(int(version))
+        if node is None:
+            return None
+        rest = [int(t) for t in prompt[len(hits) * blk:]]
+        best, best_m = None, 0
+        for edge, child in node.children.items():
+            if child.poisoned or child.spilled:
+                continue
+            m = 0
+            for a, b in zip(edge, rest):
+                if a != b:
+                    break
+                m += 1
+            if m > best_m:
+                best, best_m = child, m
+        best_m = min(best_m, len(rest) - 1)
+        if best is None or best_m < 1:
+            return None
+        return best, best_m
 
     def lock(self, nodes, step: int) -> None:
         for n in nodes:
@@ -256,19 +319,87 @@ class PrefixCache:
                                (parent.last_use, parent.block, parent))
         return out
 
+    # -- spill tier demotion / promotion (decode/spill.py) --------------
+
+    def spill_victims(self, n_blocks: int, step: int) -> list[PrefixNode]:
+        """LRU selection of up to ``n_blocks`` demotion victims: refs-0
+        RESIDENT nodes that are device-leaves (every child already
+        spilled), least-recently-used first — the same reclamation
+        order as ``evict_lru``, but NON-DETACHING: the engine decides
+        per victim whether the bytes spill to the host tier
+        (``mark_spilled``) or detach-and-scrub (poisoned/corrupted —
+        those never spill). Device-leaf-only selection is what keeps
+        spilled nodes a SUFFIX of every path: a parent is only
+        eligible once all its children are off-device, so a resident
+        node's ancestors are resident. As in ``evict_lru``, a parent
+        is pushed as its last resident child is picked, so one call
+        drains whole cold paths oldest-outward."""
+        heap = [(n.last_use, n.block, n) for n in self._by_block.values()
+                if n.refs == 0
+                and all(c.spilled for c in n.children.values())]
+        heapq.heapify(heap)
+        picked: list[PrefixNode] = []
+        picked_ids: set[int] = set()
+        while heap and len(picked) < n_blocks:
+            _, _, victim = heapq.heappop(heap)
+            picked.append(victim)
+            picked_ids.add(id(victim))
+            parent = victim.parent
+            if (parent.edge and parent.refs == 0
+                    and all(c.spilled or id(c) in picked_ids
+                            for c in parent.children.values())):
+                heapq.heappush(heap,
+                               (parent.last_use, parent.block, parent))
+        return picked
+
+    def mark_spilled(self, node: PrefixNode, spill_id: int) -> int:
+        """Demote: the node's bytes now live in tier entry
+        ``spill_id``; its device block (returned, for the free list)
+        is no longer backing it. The node leaves every block-indexed
+        view but keeps its place in the tree — it still matches."""
+        block = node.block
+        self._by_block.pop(block, None)
+        node.block = -1
+        node.spilled = True
+        node.spill_id = int(spill_id)
+        return block
+
+    def mark_restored(self, node: PrefixNode, block: int,
+                      step: int) -> None:
+        """Promote: the tier entry's bytes were implanted into device
+        ``block``; the node is resident again with a fresh LRU clock
+        (a just-restored edge is the warmest thing in the tree)."""
+        node.spilled = False
+        node.spill_id = None
+        node.block = int(block)
+        node.last_use = int(step)
+        self._by_block[node.block] = node
+
+    # -- internal detach plumbing ---------------------------------------
+
+    def _forget(self, node: PrefixNode) -> None:
+        """Drop a detaching node's spill-tier entry: the host copy of
+        an unreachable edge is garbage, not cache."""
+        if node.spilled and self.spill is not None:
+            self.spill.drop(node.spill_id)
+        node.spilled = False
+        node.spill_id = None
+
     def _detach(self, node: PrefixNode) -> None:
         del node.parent.children[node.edge]
         self._by_block.pop(node.block, None)
+        self._forget(node)
         node.parent = None
 
     def detach_subtree(self, node: PrefixNode) -> list[int]:
-        """Remove ``node`` and every descendant, returning their block
-        ids (all refs-0 by the monotone-refs invariant — callers only
-        detach at refs == 0). Used when a block can no longer be
-        trusted (quarantine with no sharers left, chaos corruption):
-        descendants stay physically clean but become unreachable once
-        the path through ``node`` is gone, so they return to the free
-        list with it."""
+        """Remove ``node`` and every descendant, returning their DEVICE
+        block ids (all refs-0 by the monotone-refs invariant — callers
+        only detach at refs == 0; spilled descendants hold no device
+        block and their tier entries are dropped). Used when a block
+        can no longer be trusted (quarantine with no sharers left,
+        chaos corruption): descendants stay physically clean but
+        become unreachable once the path through ``node`` is gone, so
+        they return to the free list with it."""
         if node.refs != 0:
             raise RuntimeError(f"detach of live prefix block "
                                f"{node.block} (refs {node.refs})")
@@ -277,8 +408,11 @@ class PrefixCache:
         self._detach(node)
         while stack:
             cur = stack.pop()
-            out.append(cur.block)
+            if cur.block >= 0:
+                out.append(cur.block)
             self._by_block.pop(cur.block, None)
+            if cur is not node:
+                self._forget(cur)
             stack.extend(cur.children.values())
             cur.children = {}
         return out
@@ -308,6 +442,10 @@ class PrefixCache:
             "refs": n.refs,
             "last_use": n.last_use,
             "poisoned": n.poisoned,
+            # tree SHAPE only: a spilled node's host bytes die with
+            # the process (the tier is never persisted) — resume
+            # replay re-prefills the edge like any other lost block
+            "spilled": n.spilled,
             "version": _version(n),
             "parent": (None if not n.parent.edge
                        else index[id(n.parent)]),

@@ -1,0 +1,139 @@
+"""Host-RAM KV spill tier: the second rung of the KV memory hierarchy.
+
+The prefix cache (``decode/prefix.py``) keeps refs-0 blocks device-
+resident only until pool pressure LRU-evicts them, so "millions of
+sessions" capacity is bounded by device pool size: a returning session
+whose prefix was evicted pays a full re-prefill. This module is the
+bounded host-side store those evictions DEMOTE into instead — one wire
+document per block, at the storage dtype plus the int8 per-block
+scales, so a later radix hit on the spilled edge PROMOTES the bytes
+back through the one compiled donated implant program
+(``decode/engine.py``) instead of re-prefilling.
+
+Integrity is ``runtime/wire.py``'s CRC discipline, reused verbatim:
+``put`` serializes the block document with per-array CRC-32 headers
+and ``take`` verifies them on the way out, so a spilled block
+corrupted in host RAM is DETECTED at restore (one ``WireError`` line
+naming the damaged array) — never decoded. The engine quarantines the
+restoring request; survivors never read the bytes.
+
+Watermark policy (the tier's half — the engine owns demotion):
+
+- **High watermark = capacity.** ``put`` past ``capacity_blocks``
+  drops the oldest-spilled entries (LRU by spill time; a spilled
+  node's clock cannot advance — nothing touches it until restore) and
+  returns their nodes so the caller detaches the now-unrestorable
+  edges from the radix tree. The host tier is BOUNDED, never a leak.
+- **Promotion consumes the entry.** ``take`` removes the host copy
+  whether the CRC verdict is clean (the bytes are device-resident
+  again) or corrupt (the bytes are evidence, not cache).
+
+Lifetime: the tier is process memory, nothing more. A kill loses it;
+the engine snapshot records the radix TREE SHAPE only (never spilled
+bytes), and resume rebuilds the share graph via replay exactly as it
+does for device blocks. There is deliberately no persistence path —
+a second durability discipline for bytes that replay reconstructs
+for free would be complexity without a failure mode to pay for it.
+
+Plain host Python + numpy (via ``runtime/wire``): the device never
+sees this module; the engine owns all pool writes and free-list edits.
+"""
+
+from __future__ import annotations
+
+from ..runtime import wire
+
+
+class SpillTier:
+    """Bounded host-RAM store of spilled KV blocks, keyed by a
+    monotone spill id. Entries are ``wire.serialize_doc`` bytes
+    (CRC-32 per array), insertion-ordered for LRU-by-spill-time
+    overflow drops."""
+
+    def __init__(self, capacity_blocks: int):
+        if int(capacity_blocks) < 1:
+            raise ValueError(f"spill tier capacity must be >= 1 block, "
+                             f"got {capacity_blocks}")
+        self.capacity = int(capacity_blocks)
+        self._store: dict[int, bytes] = {}    # spill_id -> wire bytes
+        self._nodes: dict[int, object] = {}   # spill_id -> PrefixNode
+        self._next_id = 0
+        # lifetime counters (the engine folds these into telemetry)
+        self.spills = 0          # entries ever admitted
+        self.drops = 0           # entries removed without a restore
+        self.restores = 0        # clean CRC-verified promotions
+        self.bytes_spilled = 0   # cumulative wire bytes admitted
+        self.bytes_resident = 0  # wire bytes held right now
+
+    def __len__(self) -> int:
+        return len(self._store)
+
+    def utilization(self) -> float:
+        """Occupancy fraction (``host_tier_utilization``)."""
+        return len(self._store) / self.capacity
+
+    def put(self, node, doc: dict) -> tuple[int, list]:
+        """Admit one block document for ``node``; returns ``(spill_id,
+        overflow_victims)`` where the victims are the oldest-spilled
+        NODES whose entries were dropped to hold the capacity bound —
+        the caller must detach them (their edges are no longer
+        restorable). ``doc`` carries the ``extract_blocks`` arrays for
+        ONE block (k/v at the storage dtype, scales or None)."""
+        data = wire.serialize_doc(doc)
+        sid = self._next_id
+        self._next_id += 1
+        self._store[sid] = data
+        self._nodes[sid] = node
+        self.spills += 1
+        self.bytes_spilled += len(data)
+        self.bytes_resident += len(data)
+        victims = []
+        while len(self._store) > self.capacity:
+            old = next(iter(self._store))
+            victims.append(self._nodes[old])
+            self.drop(old)
+        return sid, victims
+
+    def drop(self, spill_id: int) -> bool:
+        """Remove an entry without restoring it (overflow, a detached
+        node, corruption evidence consumed). Idempotent."""
+        data = self._store.pop(spill_id, None)
+        self._nodes.pop(spill_id, None)
+        if data is None:
+            return False
+        self.bytes_resident -= len(data)
+        self.drops += 1
+        return True
+
+    def take(self, spill_id: int) -> dict:
+        """Promote: CRC-verify and return the block document, removing
+        the host copy either way. Raises ``wire.WireError`` (one line
+        naming the damage) when the stored bytes fail any integrity
+        check — the caller's quarantine path; the entry is consumed so
+        the damage cannot be re-served. ``KeyError`` if absent."""
+        data = self._store.pop(spill_id)
+        self._nodes.pop(spill_id, None)
+        self.bytes_resident -= len(data)
+        try:
+            doc = wire.deserialize_doc(data)
+        except wire.WireError:
+            self.drops += 1
+            raise
+        self.restores += 1
+        return doc
+
+    def corrupt(self, spill_id: int) -> bool:
+        """Chaos injection (``corrupt_spill@s:id``): flip one byte in
+        the stored wire bytes — the host-RAM bit flip the CRC ladder
+        exists to catch. Flips in the back half of the buffer (array
+        payload, not the zip directory) so the damage reaches the
+        per-array CRC check rather than dying as an unreadable file —
+        either way ``take`` raises ``WireError``. False if absent
+        (already restored or dropped — the fault found nothing)."""
+        data = self._store.get(spill_id)
+        if data is None:
+            return False
+        buf = bytearray(data)
+        buf[(3 * len(buf)) // 4] ^= 0xFF
+        self._store[spill_id] = bytes(buf)
+        return True

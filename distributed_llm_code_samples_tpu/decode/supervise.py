@@ -91,7 +91,15 @@ SNAPSHOT_FILENAME = "engine_snapshot.json"
 # (schema v13, None single-tenant) — so a crash-resumed or
 # kill-migrated request keeps its per-tenant attribution (the
 # workload plane's noisy-tenant numbers survive the death).
-SNAPSHOT_VERSION = 8
+# v9 (round 23): counters grow the KV-spill set (spilled_blocks /
+# spill_bytes / restores / restore_tokens_saved / restore_stall_s /
+# partial_hits — schema v17) and the persisted ``prefix_tree`` nodes
+# carry ``spilled``. The host tier's BYTES are deliberately NOT
+# persisted: the spill tier is process memory (decode/spill.py), so
+# resume restores an engine whose tier is EMPTY and replay re-prefills
+# — exactly the v4 stance on device block content. The tree's
+# ``spilled`` flags are certificate, not restore input.
+SNAPSHOT_VERSION = 9
 
 
 # ---------------------------------------------------------------- snapshot
@@ -174,6 +182,12 @@ def snapshot_state(engine: DecodeEngine) -> dict:
             "cow_copies": engine.cow_copies,
             "prefix_lookup_blocks": engine.prefix_lookup_blocks,
             "prefill_dispatches": engine.prefill_dispatches,
+            "spilled_blocks": engine.spilled_blocks,
+            "spill_bytes": engine.spill_bytes,
+            "restores": engine.restores,
+            "restore_tokens_saved": engine.restore_tokens_saved,
+            "restore_stall_s": engine.restore_stall_s,
+            "partial_hits": engine.partial_hits,
         },
         "prefix_tree": (None if engine.prefix is None
                         else engine.prefix.snapshot()),
@@ -292,6 +306,12 @@ def restore_engine_state(engine: DecodeEngine, snap: dict) -> None:
     engine.cow_copies = int(c["cow_copies"])
     engine.prefix_lookup_blocks = int(c["prefix_lookup_blocks"])
     engine.prefill_dispatches = int(c["prefill_dispatches"])
+    engine.spilled_blocks = int(c["spilled_blocks"])
+    engine.spill_bytes = int(c["spill_bytes"])
+    engine.restores = int(c["restores"])
+    engine.restore_tokens_saved = int(c["restore_tokens_saved"])
+    engine.restore_stall_s = float(c["restore_stall_s"])
+    engine.partial_hits = int(c["partial_hits"])
     # snap["prefix_tree"] is deliberately NOT loaded: the pool content
     # it indexed died with the process, so a fresh engine's tree starts
     # empty and replay re-inserts as it re-prefills — the persisted
@@ -411,6 +431,9 @@ def supervise_decode(make_engine, requests=(), *, snapshot_dir: str,
                 elif f.kind == "corrupt_block":
                     chaos._note(f, block=int(f.arg))
                     _eng.corrupt_block(int(f.arg))
+                elif f.kind == "corrupt_spill":
+                    chaos._note(f, spill_id=int(f.arg),
+                                hit=_eng.corrupt_spill(int(f.arg)))
                 # kill fires in after_step, behind the snapshot
 
         def after_step(local_step: int, _eng=engine, _dog=dog) -> None:

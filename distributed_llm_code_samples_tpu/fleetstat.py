@@ -77,12 +77,19 @@ def render(doc: dict) -> str:
             fam = f" <{e['family']}>"
             if e.get("reconnects"):
                 fam = f" <{e['family']}, {e['reconnects']} reconnect(s)>"
+        # host-RAM spill tier (round 23): only shown when the engine
+        # holds spilled blocks or has ever restored — a tier-less
+        # engine's line stays byte-identical to the pre-v17 render
+        spill = ""
+        if e.get("spill_tier_blocks") or e.get("spill_restores"):
+            spill = (f"  spill {e.get('spill_tier_blocks')} blk "
+                     f"({e.get('spill_restores')} restore(s))")
         out.append(f"  {eid:4s} [{e.get('role')}]{fam} v"
                    f"{e.get('serving_version')}  waiting "
                    f"{e.get('waiting')}  active {e.get('active')}  "
                    f"free {e.get('free_blocks')} blk "
                    f"(+{e.get('evictable_blocks')} evictable)  util "
-                   f"{e.get('utilization')}  last step "
+                   f"{e.get('utilization')}{spill}  last step "
                    f"{(e.get('last_step_s') or 0.0) * 1e3:.1f} ms")
     tens = doc.get("tenants") or {}
     for t, c in sorted(tens.items()):

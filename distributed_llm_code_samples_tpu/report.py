@@ -424,6 +424,24 @@ class _Stream:
                 serving["shared_blocks_max"] = int(max(shared))
         if last.get("cow_copies") is not None:
             serving["cow_copies"] = last["cow_copies"]
+        # schema-v17 KV spill keys: cumulative demotions/promotions
+        # through the host-RAM tier, the prefill tokens restores
+        # skipped, the wall clock the donated implant path cost, and
+        # the peak host-tier occupancy — only when the tier ever held
+        # a block (a tier-less run's summary stays pre-v17)
+        if last.get("spilled_blocks"):
+            serving["spilled_blocks"] = last["spilled_blocks"]
+            serving["spill_bytes"] = last.get("spill_bytes")
+            serving["restores"] = last.get("restores")
+            serving["restore_tokens_saved"] = last.get(
+                "restore_tokens_saved")
+            serving["restore_stall_s"] = last.get("restore_stall_s")
+            util = [d["host_tier_utilization"] for d in decodes
+                    if d.get("host_tier_utilization") is not None]
+            if util:
+                serving["host_tier_utilization_max"] = max(util)
+        if last.get("partial_hits"):
+            serving["partial_hits"] = last["partial_hits"]
         return serving
 
     def reliability(self) -> dict | None:
@@ -1687,6 +1705,17 @@ def _render_engine_sections(out: list, doc: dict) -> None:
                        f"prefill token(s), peak "
                        f"{sv.get('shared_blocks_max')} shared block(s), "
                        f"{sv.get('cow_copies')} CoW cop(ies)")
+        if "spilled_blocks" in sv:
+            out.append(f"  KV spill    {sv['spilled_blocks']} "
+                       f"demotion(s) ({_fmt_bytes(sv.get('spill_bytes'))}"
+                       f"), {sv.get('restores')} restore(s) saving "
+                       f"{sv.get('restore_tokens_saved')} prefill "
+                       f"token(s) in {sv.get('restore_stall_s')}s, "
+                       f"peak host tier "
+                       f"{sv.get('host_tier_utilization_max')}")
+        if "partial_hits" in sv:
+            out.append(f"  KV spill    {sv['partial_hits']} sub-block "
+                       "partial hit(s)")
         if "kv_pool_utilization_max" in sv:
             out.append("  KV pool     max utilization "
                        f"{sv['kv_pool_utilization_max']}")

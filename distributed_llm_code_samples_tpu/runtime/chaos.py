@@ -19,6 +19,7 @@ dirs use), parsed from the CLI ``--chaos`` spec grammar::
     KIND  := nan_grad | inf_grad | loss_spike | slow_step | hang
            | kill | corrupt_ckpt
            | nan_logits | hang_step | corrupt_block      # decode faults
+           | corrupt_spill                               # decode faults
            | kill_worker | hang_worker | corrupt_wire    # fleet faults
 
 - ``nan_grad@s`` / ``inf_grad@s`` — step ``s`` trains on a poisoned
@@ -80,6 +81,15 @@ supervisor (``decode/supervise.py``) around each ``DecodeEngine.step``:
   factory-fresh pool. A corrupted free block is caught by the next
   request that reserves it — quarantined once, scrubbed, clean on
   retry.
+- ``corrupt_spill@s:id`` — spill-tier entry ``id`` (the monotone spill
+  id ``decode/spill.py`` minted at demotion — ids count from 0 in
+  spill order) has one byte flipped in host RAM before step ``s``,
+  simulating host-memory rot in the KV spill tier. The wire CRC
+  (``runtime/wire.py``) catches it at RESTORE: the promoting request
+  is quarantined with reason ``corrupt_spill``, the damaged edge is
+  detached from the radix tree, and survivors decode bit-identically
+  — the corrupted bytes are never implanted. A miss (entry already
+  restored or dropped) is a no-op, noted with ``hit: false``.
 - ``kill@s`` — SIGKILL right AFTER the engine snapshot for step ``s``
   is persisted (the crash-between-steps failure mode). As with the
   training-side kill, keying on the snapshot boundary makes the fault
@@ -103,7 +113,8 @@ IN_SEGMENT_KINDS = ("nan_grad", "inf_grad", "loss_spike", "slow_step",
 PUBLISH_KINDS = ("corrupt_ckpt", "kill")
 # serving-engine faults (kill is shared: publish boundary in training,
 # snapshot boundary in serving — decode/supervise.py)
-DECODE_KINDS = ("nan_logits", "hang_step", "corrupt_block", "kill")
+DECODE_KINDS = ("nan_logits", "hang_step", "corrupt_block",
+                "corrupt_spill", "kill")
 # fleet-transport faults (round 16, decode/fleet.py + decode/worker.py):
 # steps are FLEET ROUNDS (the router's clock), fired by the router at
 # the start of the round —
@@ -363,6 +374,15 @@ def validate_decode_plan(plan: FaultPlan) -> None:
                 raise ValueError(
                     f"corrupt_block arg {f.arg!r} must be a "
                     "non-negative integer block id")
+        if f.kind == "corrupt_spill":
+            if f.arg is None:
+                raise ValueError(
+                    "corrupt_spill requires :ID (the monotone spill-"
+                    "tier entry id to damage), e.g. corrupt_spill@9:0")
+            if f.arg != int(f.arg) or f.arg < 0:
+                raise ValueError(
+                    f"corrupt_spill arg {f.arg!r} must be a "
+                    "non-negative integer spill id")
         if f.kind == "nan_logits" and f.arg is not None and (
                 f.arg != int(f.arg) or f.arg < 0):
             raise ValueError(

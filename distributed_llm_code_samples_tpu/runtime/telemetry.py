@@ -203,7 +203,19 @@ import numpy as np
 # window, the full replay length on a replay-migration, 0 on a sync
 # handoff) — the numbers behind the "a handoff costs the moving
 # request one replay, never a source-engine stall" contract.
-SCHEMA_VERSION = 16
+# v17 (round 23): the KV memory hierarchy (DESIGN.md section 29).
+# Decode records gain the ``kv_spill`` key family —
+# ``spilled_blocks`` / ``spill_bytes`` / ``restores`` /
+# ``restore_tokens_saved`` cumulative (snapshot-persisted, monotonic
+# across crash-resume like the churn trio; the BYTES are not — the
+# host tier dies with the process and resume rebuilds via replay),
+# ``restore_stall_s`` the cumulative wall clock spent inside the
+# donated implant path (the stall budget the restore-per-step cap
+# bounds), ``partial_hits`` cumulative sub-block CoW shares, and
+# ``host_tier_utilization`` the instantaneous spill-tier occupancy
+# fraction (0.0 when the tier is off). All keys are pinned even with
+# the tier disabled (zeros) — the uniform-envelope stance.
+SCHEMA_VERSION = 17
 
 METRICS_FILENAME = "metrics.jsonl"
 
@@ -290,7 +302,10 @@ DECODE_REQUIRED = ("step", "tokens_per_sec", "batch_occupancy",
                    "kv_fragmentation", "kv_bytes_stored",
                    "drafted_tokens", "accepted_tokens", "accept_rate",
                    "prefix_hit_blocks", "prefill_tokens_saved",
-                   "shared_blocks", "cow_copies")
+                   "shared_blocks", "cow_copies",
+                   "spilled_blocks", "spill_bytes", "restores",
+                   "restore_tokens_saved", "restore_stall_s",
+                   "partial_hits", "host_tier_utilization")
 
 # The request-record contract: one record per serving-request lifecycle
 # transition (``decode/engine.py``). ``step`` is the GLOBAL engine step

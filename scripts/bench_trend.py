@@ -303,6 +303,52 @@ def _validate_fleet_tcp_rows(name: str, payload: dict,
                                 "number")
 
 
+KV_SPILL_NUMS = ("kv_spill_vs_no_spill", "kv_spill_capacity_gain",
+                 "kv_spill_restores", "kv_spill_restore_tokens_saved",
+                 "kv_spill_restore_stall_s", "kv_spill_spilled_blocks",
+                 "kv_spill_prefill_dispatches",
+                 "kv_spill_prefill_dispatches_no_spill",
+                 "kv_spill_partial_hits",
+                 "kv_spill_partial_tokens_saved")
+
+
+def _validate_kv_spill_rows(name: str, payload: dict,
+                            problems: list) -> None:
+    """The kv_spill_* row contracts (DECODE artifacts from round 23
+    on; absence is fine — older rounds predate the tier). One bench
+    function emits the whole set, so a numeric headline without its
+    siblings is drift; an "error: ..." string is a recorded outage.
+    The capacity-gain acceptance floor (>= 2x the no-spill pool) is
+    re-checked here so a drifted artifact cannot quietly regress it."""
+    head = payload.get("kv_spill_tokens_per_sec")
+    if head is None:
+        return
+    if isinstance(head, str):
+        if not head.startswith("error:"):
+            problems.append(f"{name}: kv_spill_tokens_per_sec is a "
+                            "string but not an 'error:' outage record")
+        return
+    if not isinstance(head, (int, float)) or isinstance(head, bool):
+        problems.append(f"{name}: kv_spill_tokens_per_sec is not a "
+                        "number")
+        return
+    for nk in KV_SPILL_NUMS:
+        v = payload.get(nk)
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            problems.append(f"{name}: {nk!r} is not a number (the "
+                            "kv_spill rows are emitted together)")
+    gain = payload.get("kv_spill_capacity_gain")
+    if isinstance(gain, (int, float)) and not isinstance(gain, bool) \
+            and gain < 2.0:
+        problems.append(f"{name}: kv_spill_capacity_gain {gain} is "
+                        "below the 2x acceptance floor")
+    restores = payload.get("kv_spill_restores")
+    if isinstance(restores, int) and not isinstance(restores, bool) \
+            and restores < 1:
+        problems.append(f"{name}: kv_spill_restores is 0 — the "
+                        "session-churn row measured nothing")
+
+
 def validate_decode(path: str, problems: list) -> dict | None:
     """One DECODE_* artifact -> a trend row: headline keys + the
     workload_* row contracts when present."""
@@ -330,6 +376,7 @@ def validate_decode(path: str, problems: list) -> dict | None:
     _validate_policy_rows(name, doc, problems)
     _validate_watch_rows(name, doc, problems)
     _validate_fleet_tcp_rows(name, doc, problems)
+    _validate_kv_spill_rows(name, doc, problems)
     if len(problems) > before:
         return None
     row = {"round": _round_of(path, "DECODE_"), "file": name,
@@ -351,6 +398,9 @@ def validate_decode(path: str, problems: list) -> dict | None:
     ft = doc.get("fleet_tcp_handoff_stall_p90_ms")
     if isinstance(ft, dict):
         row["fleet_tcp_stall_p90_ms"] = dict(ft)
+    kg = doc.get("kv_spill_capacity_gain")
+    if isinstance(kg, (int, float)) and not isinstance(kg, bool):
+        row["kv_spill_capacity_gain"] = kg
     return row
 
 
@@ -446,6 +496,9 @@ def main(argv=None) -> int:
                     wl = "  goodput " + ", ".join(
                         f"{k} {v}" for k, v in
                         sorted(r["workload_goodput"].items()))
+                if r.get("kv_spill_capacity_gain") is not None:
+                    wl += ("  kv_spill_capacity_gain "
+                           f"{r['kv_spill_capacity_gain']}")
                 out.append(f"  {r['round']:<12} {r['value']:>12} "
                            f" {r['unit']:<10} {r['metric']}{wl}")
         print("\n".join(out))

@@ -244,6 +244,74 @@ rm -rf "$PFX_DIR"
 echo "PREFIX_SMOKE=OK"
 phase_done prefix_smoke
 
+echo "=== kv-spill smoke ==="
+# The ISSUE 19 session-churn drill: 4 DISTINCT 9-token sessions
+# returning 3 times through an 11-block device pool (block 4 — the
+# running pair only; retention of all four prefixes cannot stay
+# device-resident) with a 32-block host-RAM spill tier. Returning
+# prefixes must RESTORE through the donated implant program instead of
+# re-prefilling: tokens BYTE-IDENTICAL to a big-pool no-spill oracle,
+# the output summary must report restores > 0, the metrics stream must
+# hold >= 1 schema-v17 decode record with restores > 0, and `report
+# --audit` must hold over the stream (decode/spill.py, DESIGN.md
+# section 29).
+SPL_DIR=$(mktemp -d /tmp/tier1_spill.XXXXXX)
+SPL_P1="1,2,3,4,5,6,7,8,9"
+SPL_P2="9,8,7,6,5,4,3,2,1"
+SPL_P3="11,12,13,14,15,16,17,18,19"
+SPL_P4="21,22,23,24,25,26,27,28,29"
+SPL_RET="$SPL_P1;$SPL_P2;$SPL_P3;$SPL_P4"
+SPL_ARGS="--prompts $SPL_RET;$SPL_RET;$SPL_RET --max_new 6 -d 32 -l 2
+  --heads 4 --vocab 64 --max_seq_len 64 --block_size 4
+  --prefill_chunk 4 --max_slots 2 --max_blocks_per_seq 8 --log_every 2"
+if ! timeout -k 10 240 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli generate $SPL_ARGS \
+    --n_blocks 64 > "$SPL_DIR/oracle.json"; then
+  echo "SPILL_SMOKE=FAIL (big-pool oracle)"; rm -rf "$SPL_DIR"; exit 1
+fi
+if ! timeout -k 10 240 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli generate $SPL_ARGS \
+    --n_blocks 11 --spill_blocks 32 --metrics_dir "$SPL_DIR/metrics" \
+    > "$SPL_DIR/spill.json"; then
+  echo "SPILL_SMOKE=FAIL (tiered run)"; rm -rf "$SPL_DIR"; exit 1
+fi
+if ! timeout -k 10 60 env JAX_PLATFORMS=cpu python - "$SPL_DIR" <<'EOF'
+import json, os, sys
+from distributed_llm_code_samples_tpu.runtime.telemetry import (
+    METRICS_FILENAME, read_metrics, validate_record)
+base = sys.argv[1]
+oracle = json.load(open(os.path.join(base, "oracle.json")))
+spill = json.load(open(os.path.join(base, "spill.json")))
+a = {s["uid"]: s["tokens"] for s in oracle["sequences"]}
+b = {s["uid"]: s["tokens"] for s in spill["sequences"]}
+assert a == b, "tiered-KV tokens != big-pool no-spill oracle"
+assert spill["restores"] > 0, spill["restores"]
+assert spill["spilled_blocks"] >= spill["restores"], (
+    spill["spilled_blocks"], spill["restores"])
+assert spill["restore_tokens_saved"] > 0, spill["restore_tokens_saved"]
+records, problems = read_metrics(
+    os.path.join(base, "metrics", METRICS_FILENAME))
+assert not problems, problems
+decs = [r for r in records if r["kind"] == "decode"]
+assert decs, "no schema-valid decode record in the smoke stream"
+assert all(validate_record(d)[0] for d in decs)
+assert all(d["schema"] == 17 for d in decs)
+assert any(d["restores"] > 0 for d in decs), (
+    [d["restores"] for d in decs])
+EOF
+then
+  echo "SPILL_SMOKE=FAIL (identity/schema check)"; rm -rf "$SPL_DIR"
+  exit 1
+fi
+if ! timeout -k 10 60 env JAX_PLATFORMS=cpu python -m \
+    distributed_llm_code_samples_tpu.cli report "$SPL_DIR/metrics" \
+    --audit > /dev/null; then
+  echo "SPILL_SMOKE=FAIL (audit)"; rm -rf "$SPL_DIR"; exit 1
+fi
+rm -rf "$SPL_DIR"
+echo "SPILL_SMOKE=OK"
+phase_done spill_smoke
+
 echo "=== serving-chaos smoke ==="
 # kill@4 mid-decode under the engine supervisor: run 1 SIGKILLs itself
 # right after the step-4 snapshot (rc 137); run 2 (same command) resumes
@@ -607,7 +675,7 @@ echo "=== tcp-transport smoke ==="
 # (kill_worker@8:1 --async_migration). Tokens must be byte-identical
 # to the AF_UNIX oracle, the partition must cost a reconnect and
 # ZERO dead-host declarations (kills == the 1 scheduled SIGKILL, no
-# worker_dead events), the router stream must hold >=1 schema-v16
+# worker_dead events), the router stream must hold >=1 schema-v17
 # reconnected record, and `report --audit` over the streams must be
 # rc 0. Malformed --transport/chaos combinations must reject rc 2
 # with one stderr line.
@@ -661,7 +729,7 @@ assert not [r for r in records
 routers = [r for r in records if r["kind"] == "router"]
 assert routers and all(validate_record(r)[0] for r in routers)
 recon = [r for r in routers if r["event"] == "reconnected"]
-assert recon and all(r["schema"] == 16 for r in recon), routers
+assert recon and all(r["schema"] == 17 for r in recon), routers
 migs = [r for r in routers if r["event"] == "migrated"]
 assert migs and all("ship_s" in r and "catchup_tokens" in r
                     for r in migs), migs

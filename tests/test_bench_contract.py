@@ -349,6 +349,22 @@ def test_bench_decode_contract():
     # the two lane dicts for the SAME trace through the SAME colocated
     # fleet are one measurement, reported once each
     assert wd["colocated"] == wg["bursty"]
+    # r23 kv_spill rows (byte-identity vs the big-pool oracle, the
+    # >= 2x capacity floor, and restore-beats-reprefill are asserted
+    # INSIDE the bench — an error string here means a contract
+    # violation): session churn spilled and restored, restores saved
+    # re-prefill dispatches, and the sub-block row shared a half block
+    assert payload["kv_spill_tokens_per_sec"] > 0
+    assert payload["kv_spill_restores"] > 0
+    assert payload["kv_spill_restore_tokens_saved"] > 0
+    assert payload["kv_spill_spilled_blocks"] >= \
+        payload["kv_spill_restores"]
+    assert payload["kv_spill_capacity_gain"] >= 2.0
+    assert payload["kv_spill_prefill_dispatches"] < \
+        payload["kv_spill_prefill_dispatches_no_spill"]
+    assert payload["kv_spill_restore_stall_s"] >= 0
+    assert payload["kv_spill_partial_hits"] > 0
+    assert payload["kv_spill_partial_tokens_saved"] > 0
 
 
 def _run_trend(root):
@@ -521,6 +537,54 @@ def test_bench_trend_rejects_schema_drift(tmp_path):
     r = _run_trend(root)
     assert r.returncode == 0, r.stdout + r.stderr
     os.remove(os.path.join(root, "DECODE_r04x.json"))
+
+    # r23 DECODE kv_spill rows: one bench function emits the set, so a
+    # numeric headline without its siblings is drift, a capacity gain
+    # below the 2x acceptance floor is drift (a quietly-regressed
+    # artifact must not validate), zero restores is drift, a complete
+    # set passes, and an "error:" string is a recorded outage
+    kv_ok = {"kv_spill_vs_no_spill": 1.1,
+             "kv_spill_capacity_gain": 3.5, "kv_spill_restores": 6,
+             "kv_spill_restore_tokens_saved": 96,
+             "kv_spill_restore_stall_s": 0.02,
+             "kv_spill_spilled_blocks": 8,
+             "kv_spill_prefill_dispatches": 10,
+             "kv_spill_prefill_dispatches_no_spill": 24,
+             "kv_spill_partial_hits": 3,
+             "kv_spill_partial_tokens_saved": 18}
+    write("DECODE_r05x.json", {
+        "metric": "m", "value": 1.0, "unit": "tokens/s",
+        "kv_spill_tokens_per_sec": 50.0,
+        "kv_spill_vs_no_spill": 1.1})
+    r = _run_trend(root)
+    assert r.returncode == 2
+    assert "DECODE_r05x.json" in r.stderr \
+        and "kv_spill_capacity_gain" in r.stderr
+    write("DECODE_r05x.json", dict(
+        {"metric": "m", "value": 1.0, "unit": "tokens/s",
+         "kv_spill_tokens_per_sec": 50.0}, **dict(
+            kv_ok, kv_spill_capacity_gain=1.4)))
+    r = _run_trend(root)
+    assert r.returncode == 2 and "2x acceptance floor" in r.stderr
+    write("DECODE_r05x.json", dict(
+        {"metric": "m", "value": 1.0, "unit": "tokens/s",
+         "kv_spill_tokens_per_sec": 50.0}, **dict(
+            kv_ok, kv_spill_restores=0)))
+    r = _run_trend(root)
+    assert r.returncode == 2 and "kv_spill_restores" in r.stderr
+    write("DECODE_r05x.json", dict(
+        {"metric": "m", "value": 1.0, "unit": "tokens/s",
+         "kv_spill_tokens_per_sec": 50.0}, **kv_ok))
+    r = _run_trend(root)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "kv_spill_capacity_gain" in r.stdout
+    write("DECODE_r05x.json", {
+        "metric": "m", "value": 1.0, "unit": "tokens/s",
+        "kv_spill_tokens_per_sec":
+            "error: RuntimeError: lane died"})
+    r = _run_trend(root)
+    assert r.returncode == 0, r.stdout + r.stderr
+    os.remove(os.path.join(root, "DECODE_r05x.json"))
 
     # a missing artifact directory is rc 2, not a silent pass
     r = _run_trend(os.path.join(root, "nope"))

@@ -76,7 +76,8 @@ def test_decode_chaos_grammar_parse():
         ("corrupt_block", 4, 2.0), ("kill", 7, None)]
     validate_decode_plan(plan)          # decode-legal spec passes
     assert set(DECODE_KINDS) == {"nan_logits", "hang_step",
-                                 "corrupt_block", "kill"}
+                                 "corrupt_block", "corrupt_spill",
+                                 "kill"}
 
 
 @pytest.mark.parametrize("spec,msg", [
@@ -84,6 +85,8 @@ def test_decode_chaos_grammar_parse():
     ("loss_spike@2:10", "training fault"),
     ("corrupt_block@3", "requires :BLOCK"),
     ("corrupt_block@3:1.5", "non-negative integer"),
+    ("corrupt_spill@3", "requires :ID"),
+    ("corrupt_spill@3:1.5", "non-negative integer"),
     ("nan_logits@3:-2", "non-negative integer"),
     ("hang_step@2:-1", "non-negative sleep"),
     ("kill@4:2", "takes no :ARG"),
@@ -458,7 +461,7 @@ def test_snapshot_resume_matches_uninterrupted(tmp_path, lm_params,
     sd = str(tmp_path / "snap")
     write_snapshot(eng, sd)
     snap = load_snapshot(sd)
-    assert snap["step"] == 5 and snap["version"] == 8
+    assert snap["step"] == 5 and snap["version"] == 9
     # v2: the KV-pool churn counters persist so schema-v5 decode
     # records stay monotonic across crash-resume
     assert snap["counters"]["block_allocs"] >= 1

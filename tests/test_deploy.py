@@ -170,7 +170,7 @@ def test_handoff_v4_rejects_unheld_version(lm_params, new_params,
     for _ in range(3):
         src.step()
     doc = src.export_sequence(3)
-    assert doc["handoff_version"] == 6      # v6 (round 19): + tenant
+    assert doc["handoff_version"] == 7      # v7 (round 23): prefix_partial numerics key
     assert doc["weights_version"] == NEW_STEP
     assert doc["model"] == model_fingerprint(new_params, H)
     dst = DecodeEngine(lm_params, H, EngineConfig(**BASE))

@@ -520,7 +520,7 @@ def test_snapshot_v4_kill_resume_rebuilds_share_graph(tmp_path,
     sd = str(tmp_path / "snap")
     write_snapshot(eng, sd)
     snap = load_snapshot(sd)
-    assert snap["version"] == 8
+    assert snap["version"] == 9
     tree = snap["prefix_tree"]
     # the certificate: 2 shared nodes, every live sharer holding a ref
     assert [n["refs"] for n in tree] == [3, 3]

@@ -100,7 +100,14 @@ from distributed_llm_code_samples_tpu.runtime.telemetry import (
 # (``ship_s`` = the overlapped ship window, null when nothing
 # overlapped; ``catchup_tokens`` = tokens the target teacher-forced
 # to catch up) with ROUTER_MIGRATED_REQUIRED.
-_PINNED_VERSION = 16
+# v17 (round 23): the KV memory hierarchy — decode records pin the
+# ``kv_spill`` key family (spilled_blocks / spill_bytes / restores /
+# restore_tokens_saved cumulative and snapshot-persisted;
+# restore_stall_s the cumulative implant-path wall clock;
+# partial_hits cumulative sub-block CoW shares;
+# host_tier_utilization the instantaneous spill-tier occupancy,
+# 0.0 when the tier is off — zeros pinned even when disabled).
+_PINNED_VERSION = 17
 _PINNED_STEP_KEYS = frozenset({
     "schema", "kind", "t", "step", "strategy", "loss", "grad_norm",
     "tokens_per_sec", "step_time_s", "mfu", "hbm_high_water_bytes",
@@ -113,7 +120,9 @@ _PINNED_DECODE_REQUIRED = frozenset({
     "block_allocs", "block_frees", "block_scrubs", "kv_fragmentation",
     "kv_bytes_stored", "drafted_tokens", "accepted_tokens",
     "accept_rate", "prefix_hit_blocks", "prefill_tokens_saved",
-    "shared_blocks", "cow_copies",
+    "shared_blocks", "cow_copies", "spilled_blocks", "spill_bytes",
+    "restores", "restore_tokens_saved", "restore_stall_s",
+    "partial_hits", "host_tier_utilization",
 })
 _PINNED_REQUEST_REQUIRED = frozenset({
     "step", "uid", "event", "reason", "weights_version", "trace_id",

@@ -128,7 +128,7 @@ def test_trace_id_survives_snapshot_resume(lm_params, tmp_path):
     want_trace = eng._traces[0]
     write_snapshot(eng, snap_dir)
     snap = load_snapshot(snap_dir)
-    assert snap["version"] == 8     # v8 (round 19): + tenant
+    assert snap["version"] == 9     # v9 (round 23): + KV-spill set
     [entry] = [r for r in snap["requests"] if r["uid"] == 0]
     assert entry["trace_id"] == want_trace
     fresh = DecodeEngine(lm_params, H, EngineConfig(**BASE))
